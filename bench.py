@@ -1,0 +1,83 @@
+"""Benchmark: prints ONE JSON line {metric, value, unit, vs_baseline}.
+
+Round-1 benchmark: GPT-2 125M causal-LM training throughput on one chip
+(BASELINE config 1 scaled to the available device), bf16 params + fp32
+Adam, fused train step. ``vs_baseline`` reports measured MFU divided by the
+reference's published 54% MFU (Ulysses blog headline, BASELINE.md) — the
+portable efficiency yardstick when the hardware differs from the reference's
+A100/H100 runs.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import hcache_deepspeed_tpu as hds
+    from hcache_deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from hcache_deepspeed_tpu.platform import get_platform
+
+    batch, seq = 8, 1024
+    mcfg = GPT2Config(n_layer=12, n_embd=768, n_head=12, n_positions=seq,
+                      vocab_size=50257, dtype="bfloat16", remat=False)
+    model = GPT2LMHeadModel(mcfg)
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(
+        0, mcfg.vocab_size, (batch, seq), dtype=np.int32)}
+
+    cfg = {
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                     example_batch=data)
+
+    # warmup / compile (sync via host fetch of the loss scalar — through a
+    # tunnelled PJRT backend block_until_ready alone may not drain the queue)
+    for _ in range(3):
+        loss = float(engine.train_batch(batch=data))
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = float(engine.train_batch(batch=data))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    n_params = sum(x.size for x in jax.tree.leaves(engine.state["params"]))
+    # 6N (fwd+bwd) weight FLOPs + 12*L*S*d attention FLOPs per token
+    flops_per_token = 6 * n_params + 12 * mcfg.n_layer * seq * mcfg.n_embd
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    peak = get_platform().peak_tflops("bfloat16")
+    mfu = achieved_tflops / peak if peak else 0.0
+    vs_baseline = (mfu / 0.54) if peak else 0.0
+
+    print(json.dumps({
+        "metric": "gpt2-125m train tokens/sec/chip (bf16, seq1024)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "achieved_tflops": round(achieved_tflops, 2),
+            "peak_tflops": peak,
+            "loss": float(loss),
+            "n_params": int(n_params),
+            "step_time_ms": round(dt / steps * 1000, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Communication operation logging.
+
+Reference analog: ``deepspeed/utils/comms_logging.py`` ``CommsLogger`` fed by
+``@timed_op`` wrappers on every collective (``comm/comm.py:101-134``), and
+``dist.log_summary()`` (``comm/comm.py:428``).
+
+On TPU collectives are issued inside traced/compiled programs, so per-call
+host-side wall timing is meaningless; instead we record, at *trace time*, the
+op type, message size and mesh axes for every collective the facade emits, and
+report aggregate counts/volumes. Wall-clock attribution comes from the XLA
+profiler (``platform.profiler_start``), which names each collective.
+"""
+
+import math
+from collections import defaultdict
+
+from ..utils.logging import log_dist
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    units = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    return f"{round(size_bytes / 1024 ** i, 2)} {units[i]}"
+
+
+class CommsLogger:
+    def __init__(self, enabled=False, verbose=False, prof_all=True,
+                 prof_ops=None, debug=False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # op_name -> msg_size -> [count, total_bytes]
+        self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, 0]))
+
+    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+
+    def should_log(self, op_name):
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name, axes, msg_size):
+        if not self.should_log(op_name):
+            return
+        key = f"{op_name}@{','.join(axes) if axes else 'world'}"
+        rec = self.comms_dict[key][msg_size]
+        rec[0] += 1
+        rec[1] += msg_size
+        if self.verbose:
+            log_dist(f"comm op: {key} | msg size: {convert_size(msg_size)}",
+                     ranks=[0])
+
+    def log_all(self):
+        if not self.comms_dict:
+            log_dist("comms logger: no collectives recorded", ranks=[0])
+            return
+        lines = [f"{'Comm op (axis group)':<40} {'Message size':>14} "
+                 f"{'Count':>8} {'Total volume':>14}"]
+        for op, sizes in sorted(self.comms_dict.items()):
+            for size, (count, total) in sorted(sizes.items()):
+                lines.append(f"{op:<40} {convert_size(size):>14} {count:>8} "
+                             f"{convert_size(total):>14}")
+        log_dist("\n".join(lines), ranks=[0])
+
+    def reset(self):
+        self.comms_dict.clear()
+
+
+_comms_logger = CommsLogger()
+
+
+def get_comms_logger() -> CommsLogger:
+    return _comms_logger

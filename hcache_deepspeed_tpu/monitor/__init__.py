@@ -1,0 +1,3 @@
+from .monitor import MonitorMaster
+
+__all__ = ["MonitorMaster"]

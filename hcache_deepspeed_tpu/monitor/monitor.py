@@ -1,0 +1,126 @@
+"""Experiment monitoring.
+
+Reference analog: ``deepspeed/monitor/monitor.py:30 MonitorMaster`` fanning
+out to TensorBoard/W&B/Comet/CSV writers, configured by the monitor blocks of
+the JSON config. Events are ``(label, value, step)`` tuples written from rank
+0 (here: process 0) only.
+"""
+
+import csv
+import os
+
+from ..utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter
+            except Exception:
+                logger.warning("tensorboard not available; disabling "
+                               "TensorBoardMonitor")
+                self.enabled = False
+                return
+        log_dir = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+        os.makedirs(log_dir, exist_ok=True)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list, flush=True):
+        if not self.enabled or self.summary_writer is None:
+            return
+        for label, value, step in event_list:
+            self.summary_writer.add_scalar(label, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        self._files = {}
+        if self.enabled:
+            self.log_dir = os.path.join(cfg.output_path or "./csv_logs",
+                                        cfg.job_name)
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            fname = os.path.join(self.log_dir,
+                                 label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", label])
+                w.writerow([step, value])
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        if not self.enabled:
+            return
+        try:
+            import wandb
+            self._wandb = wandb
+            wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+        except Exception:
+            logger.warning("wandb not available; disabling WandbMonitor")
+            self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self._wandb.log({label: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Reference: monitor/monitor.py:30 — rank-0 fan-out to all writers."""
+
+    def __init__(self, hds_config):
+        import jax
+        self._is_writer = True
+        try:
+            self._is_writer = jax.process_index() == 0
+        except Exception:
+            pass
+        self.writers = []
+        if self._is_writer:
+            tb = TensorBoardMonitor(hds_config.tensorboard)
+            if tb.enabled:
+                self.writers.append(tb)
+            cm = CSVMonitor(hds_config.csv_monitor)
+            if cm.enabled:
+                self.writers.append(cm)
+            wb = WandbMonitor(hds_config.wandb)
+            if wb.enabled:
+                self.writers.append(wb)
+
+    @property
+    def enabled(self):
+        return bool(self.writers)
+
+    def write_events(self, event_list):
+        for w in self.writers:
+            w.write_events(event_list)

@@ -1,0 +1,50 @@
+"""Platform detection and singleton access.
+
+Reference analog: ``accelerator/real_accelerator.py:51`` ``get_accelerator()``
+— env override first (there ``DS_ACCELERATOR``, here ``HDS_PLATFORM``), then
+auto-detection. Detection here simply asks JAX for its default backend, since
+the PJRT plugin system already did the probing.
+"""
+
+import os
+
+from .abstract import Platform
+from .tpu import CPUPlatform, TPUPlatform
+
+_PLATFORMS = {
+    "tpu": TPUPlatform,
+    "cpu": CPUPlatform,
+}
+
+_platform = None
+
+
+def get_platform() -> Platform:
+    global _platform
+    if _platform is None:
+        override = os.environ.get("HDS_PLATFORM")
+        if override:
+            if override not in _PLATFORMS:
+                raise ValueError(
+                    f"HDS_PLATFORM={override!r} not in {sorted(_PLATFORMS)}")
+            _platform = _PLATFORMS[override]()
+        else:
+            import jax
+            backend = jax.default_backend()
+            # Any non-CPU PJRT backend (tpu, or a tunnelled TPU plugin) gets
+            # the TPU platform; CPU gets the host platform.
+            _platform = CPUPlatform() if backend == "cpu" else TPUPlatform()
+    return _platform
+
+
+def set_platform(name_or_platform):
+    """Force the platform (tests)."""
+    global _platform
+    if isinstance(name_or_platform, Platform):
+        _platform = name_or_platform
+    else:
+        _platform = _PLATFORMS[name_or_platform]()
+    return _platform
+
+
+__all__ = ["Platform", "TPUPlatform", "CPUPlatform", "get_platform", "set_platform"]

@@ -1,0 +1,122 @@
+"""TPU platform implementation.
+
+The TPU analog of the reference's ``accelerator/cuda_accelerator.py``: it maps
+the small Platform surface onto JAX/XLA. Collectives ride ICI within a slice
+and DCN across slices — both are reached through ``jax.lax`` collectives over
+mesh axes, so ``communication_backend_name`` names the transport rather than a
+library (the reference returns 'nccl' and routes through torch.distributed).
+"""
+
+import contextlib
+
+import jax
+
+from .abstract import Platform
+
+# Peak dense-matmul bf16 TFLOP/s per chip, by TPU generation (public specs).
+_PEAK_BF16_TFLOPS = {
+    "v2": 22.5,
+    "v3": 61.5,
+    "v4": 137.5,
+    "v5 lite": 98.3,
+    "v5e": 98.3,
+    "v5p": 229.1,
+    "v6e": 459.2,
+}
+
+
+class TPUPlatform(Platform):
+    name = "tpu"
+
+    def device_count(self):
+        return jax.device_count()
+
+    def local_device_count(self):
+        return jax.local_device_count()
+
+    def process_count(self):
+        return jax.process_count()
+
+    def process_index(self):
+        return jax.process_index()
+
+    def communication_backend_name(self):
+        return "xla-ici-dcn"
+
+    def supports_host_offload(self):
+        return True
+
+    def supports_pallas(self):
+        return True
+
+    def device_kind(self):
+        devs = jax.devices()
+        return devs[0].device_kind if devs else "unknown"
+
+    def peak_tflops(self, dtype="bfloat16"):
+        kind = self.device_kind().lower()
+        for key, tflops in _PEAK_BF16_TFLOPS.items():
+            if key in kind:
+                if dtype in ("float32", "fp32"):
+                    return tflops / 2
+                return tflops
+        return 0.0
+
+    def memory_stats(self, device=None):
+        device = device or jax.local_devices()[0]
+        stats = device.memory_stats() or {}
+        return {
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        }
+
+    def profiler_start(self, log_dir):
+        jax.profiler.start_trace(log_dir)
+
+    def profiler_stop(self):
+        jax.profiler.stop_trace()
+
+    def annotate(self, name):
+        return jax.profiler.TraceAnnotation(name)
+
+
+class CPUPlatform(TPUPlatform):
+    """Host-only platform (CI, unit tests on a forced multi-device CPU mesh).
+
+    Reference analog: ``accelerator/cpu_accelerator.py`` — used so the whole
+    runtime can execute without accelerator hardware.
+    """
+    name = "cpu"
+
+    def communication_backend_name(self):
+        return "xla-host"
+
+    def supports_host_offload(self):
+        return False  # arrays already live in host memory
+
+    def supports_pallas(self):
+        return False  # interpret mode only
+
+    def peak_tflops(self, dtype="bfloat16"):
+        return 0.0
+
+    def memory_stats(self, device=None):
+        try:
+            import psutil
+            vm = psutil.virtual_memory()
+            return {
+                "bytes_in_use": vm.used,
+                "bytes_limit": vm.total,
+                "peak_bytes_in_use": 0,
+            }
+        except Exception:
+            return {"bytes_in_use": 0, "bytes_limit": 0, "peak_bytes_in_use": 0}
+
+    def profiler_start(self, log_dir):
+        with contextlib.suppress(Exception):
+            jax.profiler.start_trace(log_dir)
+
+    def profiler_stop(self):
+        with contextlib.suppress(Exception):
+            jax.profiler.stop_trace()

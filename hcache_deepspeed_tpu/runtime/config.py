@@ -1,0 +1,329 @@
+"""Training config system.
+
+Reference analog: ``deepspeed/runtime/config.py`` (1,046 LoC
+``DeepSpeedConfig``) + ``runtime/constants.py`` + per-subsystem pydantic
+models (zero ``runtime/zero/config.py``, monitor, comms, …). The JSON schema
+deliberately accepts the reference's keys (``train_batch_size``,
+``zero_optimization.stage``, ``bf16.enabled`` …) so existing configs port
+over; TPU-specific knobs live under ``mesh`` and new subsections.
+"""
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from ..utils.logging import logger
+from .config_utils import HDSConfigModel
+
+
+class HDSConfigError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ #
+# Precision
+# ------------------------------------------------------------------ #
+class FP16Config(HDSConfigModel):
+    """Reference: fp16 dict (runtime/config.py; loss scaler fp16/loss_scaler.py:91)."""
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(HDSConfigModel):
+    """Reference: bf16 dict → BF16_Optimizer (runtime/bf16_optimizer.py:35).
+    On TPU this is the native mode: bf16 params/compute, fp32 master+state."""
+    enabled: bool = False
+    immediate_grad_update: bool = True
+
+
+# ------------------------------------------------------------------ #
+# ZeRO
+# ------------------------------------------------------------------ #
+class OffloadConfig(HDSConfigModel):
+    """Reference: runtime/zero/offload_config.py."""
+    device: str = "none"  # none | cpu (host memory) | nvme
+    nvme_path: str = "/tmp/hds_nvme"
+    pin_memory: bool = True
+    buffer_count: int = 4
+    ratio: float = 1.0
+
+
+class ZeroConfig(HDSConfigModel):
+    """Reference: runtime/zero/config.py (361 LoC).
+
+    TPU mapping: stage 1/2/3 become sharding choices over the ``data`` mesh
+    axis (optimizer state / +gradients / +params). Bucket sizes map to XLA
+    collective-combining thresholds; overlap_comm is the latency-hiding
+    scheduler (always on); prefetch maps to XLA's async collective start.
+    """
+    stage: int = 0
+    reduce_bucket_size: int = Field(500_000_000, alias="reduce_bucket_size")
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition size
+    zero_quantized_weights: bool = False  # ZeRO++ qwZ
+    zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    min_shard_size: int = 2 ** 14  # params smaller than this stay replicated
+    shard_min_dim: bool = False
+
+
+# ------------------------------------------------------------------ #
+# Optimizer / scheduler
+# ------------------------------------------------------------------ #
+class OptimizerConfig(HDSConfigModel):
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(HDSConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ------------------------------------------------------------------ #
+# Mesh / parallelism (TPU-specific; subsumes reference's mpu + elastic bits)
+# ------------------------------------------------------------------ #
+class MeshConfig(HDSConfigModel):
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+
+class PipelineConfig(HDSConfigModel):
+    """Reference: PipelineModule kwargs + pipeline dict (pipe/module.py:86)."""
+    stages: int = 1
+    partition_method: str = "uniform"  # uniform | parameters | type:<regex>
+    activation_checkpoint_interval: int = 0
+    micro_batches: Optional[int] = None  # default: gradient_accumulation_steps
+
+
+class ActivationCheckpointingConfig(HDSConfigModel):
+    """Reference: runtime/activation_checkpointing/config + checkpointing.py.
+    TPU mapping: jax.checkpoint policies; partition_activations → offload to
+    sequence-sharded storage is native when seq axis exists."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: named remat policy (nothing_saveable, dots_saveable,
+    # dots_with_no_batch_dims_saveable, save_anything_but_these_names, ...)
+    policy: Optional[str] = None
+
+
+# ------------------------------------------------------------------ #
+# Monitoring / logging
+# ------------------------------------------------------------------ #
+class TensorBoardConfig(HDSConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "HDSJobName"
+
+
+class WandbConfig(HDSConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "hds_tpu"
+
+
+class CSVConfig(HDSConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "HDSJobName"
+
+
+class CommsLoggerConfig(HDSConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = Field(default_factory=list)
+    debug: bool = False
+
+
+class FlopsProfilerConfig(HDSConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+# ------------------------------------------------------------------ #
+# Elasticity (reference: elasticity/config.py)
+# ------------------------------------------------------------------ #
+class ElasticityConfig(HDSConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+# ------------------------------------------------------------------ #
+# Data types
+# ------------------------------------------------------------------ #
+class DataTypesConfig(HDSConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class CheckpointConfig(HDSConfigModel):
+    """Reference: checkpoint dict keys on runtime/config.py."""
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    async_save: bool = False
+
+
+class CompileConfig(HDSConfigModel):
+    """Reference: DeepCompile (runtime/config.py compile block). On TPU the
+    compiler is XLA; these knobs steer jit: donation, remat, combining."""
+    enabled: bool = True
+    donate_params: bool = True
+    remat_policy: Optional[str] = None
+    collective_combining_mb: int = 0  # 0 = XLA default
+
+
+# ------------------------------------------------------------------ #
+# Top-level
+# ------------------------------------------------------------------ #
+class HDSConfig(HDSConfigModel):
+    # batch trinity (reference: runtime/config.py batch resolution)
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    sparse_gradients: bool = False
+    memory_breakdown: bool = False
+
+    seed: int = 1234
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    zero_allow_untested_optimizer: bool = False
+    zero_force_ds_cpu_optimizer: bool = True
+
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    sequence_parallel_size: int = 1
+    tensor_parallel: Dict[str, Any] = Field(default_factory=dict)
+
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(
+        default_factory=FlopsProfilerConfig)
+
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    compile: CompileConfig = Field(default_factory=CompileConfig)
+
+    # ------------------------------------------------------------------ #
+    def resolve_batch_sizes(self, dp_world_size: int):
+        """Batch-size trinity: train = micro * grad_accum * dp_world.
+
+        Reference: DeepSpeedConfig._configure_train_batch_size — any two
+        determine the third; all three must stay consistent.
+        """
+        train, micro, gas = (self.train_batch_size,
+                             self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp_world_size)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp_world_size
+        elif train is not None:
+            gas = 1
+            micro = train // dp_world_size
+        elif micro is not None:
+            gas = 1
+            train = micro * dp_world_size
+        else:
+            raise HDSConfigError(
+                "need at least train_batch_size or "
+                "train_micro_batch_size_per_gpu in config")
+        if micro * gas * dp_world_size != train or micro <= 0 or gas <= 0:
+            raise HDSConfigError(
+                f"batch sizes inconsistent: train_batch_size={train} != "
+                f"micro({micro}) * grad_accum({gas}) * dp_world"
+                f"({dp_world_size})")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+        return train, micro, gas
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    @classmethod
+    def from_any(cls, config: Union[None, str, Dict, "HDSConfig"]) -> "HDSConfig":
+        if config is None:
+            return cls()
+        if isinstance(config, HDSConfig):
+            return config
+        if isinstance(config, str):
+            with open(config) as fh:
+                config = json.load(fh)
+        if not isinstance(config, dict):
+            raise HDSConfigError(f"cannot parse config of type {type(config)}")
+        return cls.model_validate(config)
+
+
+def load_config(config) -> HDSConfig:
+    cfg = HDSConfig.from_any(config)
+    if cfg.fp16.enabled and cfg.bf16.enabled:
+        raise HDSConfigError("fp16 and bf16 cannot both be enabled")
+    return cfg

@@ -1,0 +1,50 @@
+"""Config model base.
+
+Reference analog: ``deepspeed/runtime/config_utils.py`` —
+``DeepSpeedConfigModel``: a pydantic base with extra-field tolerance and a
+deprecated-field mechanism (old key auto-forwards to new key with a warning).
+Re-implemented on pydantic v2.
+"""
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from ..utils.logging import logger
+
+
+class HDSConfigModel(BaseModel):
+    """Base for all config blocks.
+
+    Unknown keys are kept (and warned about) rather than rejected, so configs
+    written for the reference still parse. Deprecated fields are declared via
+    ``json_schema_extra={"deprecated": True, "new_param": "x"}``.
+    """
+
+    model_config = ConfigDict(extra="allow",
+                              validate_assignment=False,
+                              populate_by_name=True,
+                              arbitrary_types_allowed=True,
+                              protected_namespaces=())
+
+    @model_validator(mode="after")
+    def _warn_extra_and_forward_deprecated(self):
+        extras = getattr(self, "__pydantic_extra__", None) or {}
+        for key in extras:
+            logger.warning(
+                f"{type(self).__name__}: unknown config key '{key}' ignored")
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra or {}
+            if isinstance(extra, dict) and extra.get("deprecated"):
+                if getattr(self, name, None) != field.default:
+                    new_param = extra.get("new_param")
+                    logger.warning(
+                        f"{type(self).__name__}: '{name}' is deprecated"
+                        + (f"; use '{new_param}'" if new_param else ""))
+                    if new_param and getattr(self, new_param, None) in (
+                            None, type(self).model_fields[new_param].default):
+                        object.__setattr__(self, new_param, getattr(self, name))
+        return self
+
+
+def get_scalar_param(config_dict, key, default):
+    """Reference: hand-rolled scalar getter used throughout runtime/config.py."""
+    return config_dict.get(key, default)

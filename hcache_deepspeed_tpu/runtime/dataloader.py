@@ -1,0 +1,102 @@
+"""Data loading.
+
+Reference analog: ``deepspeed/runtime/dataloader.py`` ``DeepSpeedDataLoader``
+(DistributedSampler keyed by dp rank + curriculum hooks) wired by
+``engine.deepspeed_io`` (engine.py:1854).
+
+TPU-native: one controller process feeds many chips, so the loader yields
+*process-local* batches (numpy pytrees); the engine turns them into globally
+sharded ``jax.Array``s via ``make_array_from_process_local_data``. Multi-host
+sharding-by-rank happens here (each process reads its slice), matching the
+reference's DistributedSampler.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+
+
+class HDSDataLoader:
+    """Iterates a dataset of numpy pytrees in micro-batches.
+
+    ``dataset``: a sequence (len + __getitem__ of pytrees) or dict of arrays
+    with equal leading dim.
+    """
+
+    def __init__(self, dataset, micro_batch_size, *, shuffle=True, seed=0,
+                 drop_last=True, process_index=None, process_count=None):
+        self.micro_batch_size = micro_batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        if isinstance(dataset, dict):
+            lengths = {k: len(v) for k, v in dataset.items()}
+            if len(set(lengths.values())) != 1:
+                raise ValueError(f"ragged dataset arrays: {lengths}")
+            self._arrays = {k: np.asarray(v) for k, v in dataset.items()}
+            self._length = next(iter(lengths.values()))
+            self._getter = lambda idx: {k: v[idx] for k, v in
+                                        self._arrays.items()}
+        else:
+            self._arrays = None
+            self._length = len(dataset)
+            self._getter = lambda idx: _stack([dataset[i] for i in idx])
+        self.epoch = 0
+
+    def __len__(self):
+        per_proc = self._length // self.process_count
+        n = per_proc // self.micro_batch_size
+        if not self.drop_last and per_proc % self.micro_batch_size:
+            n += 1
+        return n
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        order = np.arange(self._length)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        # contiguous per-process shard (reference: DistributedSampler)
+        per_proc = self._length // self.process_count
+        start = self.process_index * per_proc
+        local = order[start:start + per_proc]
+        n_batches = len(self)
+        for b in range(n_batches):
+            idx = local[b * self.micro_batch_size:(b + 1) * self.micro_batch_size]
+            yield self._getter(idx)
+        self.epoch += 1
+
+    @property
+    def samples_per_epoch(self):
+        return len(self) * self.micro_batch_size * self.process_count
+
+
+def _stack(items):
+    return jax.tree.map(lambda *xs: np.stack(xs), *items)
+
+
+class RepeatingLoader:
+    """Reference: deepspeed/runtime/dataloader.py RepeatingLoader — wraps a
+    loader to restart automatically (pipeline engine consumes streams)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

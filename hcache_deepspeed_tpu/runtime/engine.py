@@ -1,0 +1,674 @@
+"""Training engine.
+
+Reference analog: ``deepspeed/runtime/engine.py:189 DeepSpeedEngine`` (3,990
+LoC) — the central wrapper exposing ``forward/backward/step`` with gradient
+accumulation, precision management, ZeRO wiring, checkpointing, timers and
+monitoring.
+
+TPU-native re-design
+--------------------
+The reference interleaves eager ops with hook-driven communication. Here the
+entire micro-step (fwd+bwd+grad-accumulate) and the optimizer step are each a
+single jitted XLA program over the global mesh; ZeRO is expressed purely as
+NamedShardings on the state pytree (see ``runtime/zero/sharding.py``) and all
+communication is inserted by the partitioner:
+
+* stage 1/2/3 gather/reduce-scatter schedules come from param/grad/opt
+  shardings; overlap comes from XLA's latency-hiding scheduler (the
+  reference's ``overlap_comm`` + prefetch coordinator).
+* mixed precision: params live in compute dtype (bf16/fp16), fp32 master
+  weights live beside the optimizer state (the reference's
+  ``bf16_optimizer.py`` / ``fp16/fused_optimizer.py`` design) so stage-3
+  all-gathers move 16-bit data only.
+* fp16 keeps the reference's dynamic loss scaling semantics
+  (``fp16/loss_scaler.py:91``): scale up after a good window, halve on
+  overflow, skip the step.
+
+The 3-call API is preserved: ``forward`` runs the fused fwd+bwd program and
+caches the gradient update, ``backward`` commits it, ``step`` applies the
+optimizer at gradient-accumulation boundaries. ``train_batch`` additionally
+offers the fully fused path (one dispatch per optimizer step, microbatches
+scanned on device).
+"""
+
+import os
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..comm import comm as dist
+from ..parallel.topology import (MeshTopology, TopologySpec, get_topology,
+                                 initialize_topology)
+from ..platform import get_platform
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BATCH_TIMER,
+                           FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
+                           SynchronizedWallClockTimer, ThroughputTimer)
+from .config import HDSConfig, load_config
+from .lr_schedules import build_scheduler
+from .optimizers import OptimizerDef, build_optimizer
+from .zero.sharding import ZeroShardingPolicy
+
+_OVERFLOW_CHECK = "overflow"
+
+
+def _cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, tree)
+
+
+def _global_norm(tree):
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+class ModelAdapter:
+    """Uniform functional interface over user models.
+
+    Accepts a flax.linen Module (``__call__(batch, train=...)`` or
+    ``__call__(batch)``) or a bare apply function
+    ``apply_fn(params, batch, rng, train) -> loss | (loss, aux) | outputs``.
+    When ``loss_fn`` is given, the model output feeds
+    ``loss_fn(outputs, batch) -> loss``.
+    """
+
+    def __init__(self, model, loss_fn: Optional[Callable] = None):
+        self.loss_fn = loss_fn
+        self.module = None
+        if hasattr(model, "apply") and hasattr(model, "init"):
+            self.module = model
+
+            def apply_fn(params, batch, rng, train):
+                rngs = {"dropout": rng} if rng is not None else None
+                try:
+                    return model.apply({"params": params}, batch,
+                                       train=train, rngs=rngs)
+                except TypeError:
+                    return model.apply({"params": params}, batch, rngs=rngs)
+
+            self.apply_fn = apply_fn
+        elif callable(model):
+            self.apply_fn = model
+        else:
+            raise TypeError(f"model must be a flax Module or callable, "
+                            f"got {type(model)}")
+
+    def init_params(self, rng, example_batch):
+        if self.module is None:
+            raise ValueError("param init requires a flax Module or explicit "
+                             "init_params")
+        try:
+            variables = self.module.init(rng, example_batch, train=False)
+        except TypeError:
+            variables = self.module.init(rng, example_batch)
+        return variables["params"]
+
+    def loss(self, params, batch, rng, train=True):
+        out = self.apply_fn(params, batch, rng, train)
+        if self.loss_fn is not None:
+            out = self.loss_fn(out, batch)
+        if isinstance(out, tuple):
+            loss, aux = out[0], out[1] if len(out) > 1 else None
+        else:
+            loss, aux = out, None
+        return loss.astype(jnp.float32), aux
+
+
+class HDSEngine:
+    """The training engine. See module docstring."""
+
+    def __init__(self,
+                 model,
+                 config: HDSConfig,
+                 *,
+                 init_params=None,
+                 example_batch=None,
+                 loss_fn=None,
+                 optimizer: Optional[OptimizerDef] = None,
+                 lr_scheduler=None,
+                 topology: Optional[MeshTopology] = None,
+                 tp_spec_fn=None,
+                 batch_spec_fn=None,
+                 training_data=None):
+        self.config = config
+        self.platform = get_platform()
+        self.adapter = ModelAdapter(model, loss_fn)
+        self.module = self.adapter.module or model
+
+        # ---- topology (reference: groups wiring, engine.py:1242-1308) ----
+        if topology is None:
+            spec = TopologySpec(pipe=config.mesh.pipe, data=config.mesh.data,
+                                expert=config.mesh.expert,
+                                seq=max(config.mesh.seq,
+                                        config.sequence_parallel_size),
+                                tensor=config.mesh.tensor)
+            topology = initialize_topology(spec)
+        self.topology = topology
+        self.mesh = topology.mesh
+
+        # ---- batch trinity ----
+        config.resolve_batch_sizes(topology.dp_world_size())
+        self.train_batch_size = config.train_batch_size
+        self.micro_batch_size = config.train_micro_batch_size_per_gpu
+        self.gradient_accumulation_steps = config.gradient_accumulation_steps
+
+        # ---- precision ----
+        self.compute_dtype = config.compute_dtype
+        self.fp16_enabled = config.fp16.enabled
+        self.bf16_enabled = config.bf16.enabled
+        self.mixed_precision = self.compute_dtype != jnp.float32
+        grad_dtype_name = config.data_types.grad_accum_dtype
+        self.grad_accum_dtype = (jnp.dtype(grad_dtype_name) if grad_dtype_name
+                                 else jnp.float32)
+
+        # ---- optimizer / scheduler ----
+        if optimizer is None:
+            if config.optimizer is not None:
+                optimizer = build_optimizer(config.optimizer.type,
+                                            config.optimizer.params)
+            else:
+                optimizer = build_optimizer("adamw", {})
+        self.optimizer_def = optimizer
+        base_lr = (config.optimizer.params.get("lr", 1e-3)
+                   if config.optimizer else 1e-3)
+        if lr_scheduler is None:
+            sched_cfg = config.scheduler
+            lr_scheduler = build_scheduler(
+                sched_cfg.type if sched_cfg else None,
+                dict(sched_cfg.params) if sched_cfg else {}, base_lr)
+        self.lr_scheduler = lr_scheduler
+        self._current_lr = float(self.lr_scheduler.get_lr(0))
+
+        # ---- ZeRO sharding policy ----
+        zcfg = config.zero_optimization
+        self.zero_stage = zcfg.stage
+        self.policy = ZeroShardingPolicy(zcfg.stage, topology,
+                                         tp_spec_fn=tp_spec_fn,
+                                         min_shard_size=zcfg.min_shard_size)
+        self._batch_spec_fn = batch_spec_fn
+
+        # ---- parameter init (sharded at creation; reference: zero.Init) ----
+        self._rng_seed = config.seed
+        self._init_state(init_params, example_batch)
+
+        # ---- counters ----
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._pending = None  # loss between forward() and backward()
+        self._data_iter = None  # persistent train_batch iterator
+
+        # ---- timers / monitor ----
+        self.wall_clock_breakdown = config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer(
+            synchronize=self.wall_clock_breakdown)
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size,
+            steps_per_output=config.steps_per_print)
+        from ..monitor.monitor import MonitorMaster
+        self.monitor = MonitorMaster(config)
+
+        # ---- dataloader ----
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+
+        # ---- compiled functions ----
+        self._build_step_functions()
+
+        log_dist(
+            f"HDSEngine ready: mesh={topology}, zero_stage={self.zero_stage}, "
+            f"dtype={jnp.dtype(self.compute_dtype).name}, "
+            f"batch={self.train_batch_size} "
+            f"(micro={self.micro_batch_size} x gas="
+            f"{self.gradient_accumulation_steps} x "
+            f"dp={topology.dp_world_size()})", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # State init
+    # ------------------------------------------------------------------ #
+    def _init_state(self, init_params, example_batch):
+        policy = self.policy
+        mesh = self.mesh
+
+        if init_params is None:
+            if example_batch is None:
+                raise ValueError("need init_params or example_batch")
+            rng = jax.random.PRNGKey(self._rng_seed)
+            shapes = jax.eval_shape(
+                lambda r: self.adapter.init_params(r, example_batch), rng)
+            param_shardings = policy.named(policy.param_specs(shapes))
+            init_fn = jax.jit(
+                lambda r: _cast_tree(
+                    self.adapter.init_params(r, example_batch),
+                    self.compute_dtype),
+                out_shardings=param_shardings)
+            params = init_fn(rng)
+        else:
+            params = _cast_tree(init_params, self.compute_dtype)
+            param_shardings = policy.named(policy.param_specs(params))
+            params = jax.device_put(params, param_shardings)
+
+        self.param_shardings = param_shardings
+        self.param_specs = policy.param_specs(params)
+        self.grad_specs = policy.grad_specs(params)
+        self.grad_shardings = policy.named(self.grad_specs)
+        opt_specs = policy.opt_specs(params)
+        self.opt_param_shardings = policy.named(opt_specs)
+
+        # fp32 master weights, sharded like optimizer state (stage>=1)
+        master = None
+        if self.mixed_precision:
+            master = jax.jit(lambda p: _cast_tree(p, jnp.float32),
+                             out_shardings=self.opt_param_shardings)(params)
+
+        # optimizer state: replicate scalars, shard per-param tensors
+        opt_state = jax.jit(
+            self.optimizer_def.init,
+            out_shardings=None)(master if master is not None else params)
+        opt_state = self._place_opt_state(opt_state)
+
+        grad_acc = jax.jit(
+            lambda p: jax.tree.map(
+                lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), p),
+            out_shardings=self.grad_shardings)(params)
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        loss_scale = jax.device_put(jnp.asarray(
+            float(2 ** self.config.fp16.initial_scale_power
+                  if self.fp16_enabled and self.config.fp16.loss_scale == 0
+                  else (self.config.fp16.loss_scale or 1.0)), jnp.float32),
+            repl)
+
+        self.state = {
+            "params": params,
+            "master": master,
+            "opt": opt_state,
+            "grad_acc": grad_acc,
+            "loss_scale": loss_scale,
+            "good_steps": jax.device_put(jnp.zeros((), jnp.int32), repl),
+            "hysteresis": jax.device_put(
+                jnp.asarray(self.config.fp16.hysteresis, jnp.int32), repl),
+        }
+
+    def _place_opt_state(self, opt_state):
+        """Shard optimizer-state tensors like their params; replicate scalars."""
+        mesh = self.mesh
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def place(key, sub):
+            if key == "step" or not isinstance(sub, dict):
+                return jax.device_put(sub, repl)
+            return jax.device_put(sub, self.opt_param_shardings)
+
+        return {k: place(k, v) for k, v in opt_state.items()}
+
+    # ------------------------------------------------------------------ #
+    # Compiled step functions
+    # ------------------------------------------------------------------ #
+    def _build_step_functions(self):
+        policy = self.policy
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps
+        fp16 = self.fp16_enabled
+        clip = self.config.gradient_clipping
+        fp16_cfg = self.config.fp16
+        opt_update = self.optimizer_def.update
+        compute_dtype = self.compute_dtype
+        mixed = self.mixed_precision
+        grad_shardings = self.grad_shardings
+        param_shardings = self.param_shardings
+
+        def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train):
+            def scaled_loss(p):
+                loss, _aux = self.adapter.loss(p, batch, rng, train=train)
+                return loss * loss_scale / gas
+
+            loss_s, grads = jax.value_and_grad(scaled_loss)(params)
+            grads = jax.lax.with_sharding_constraint(
+                _cast_tree(grads, self.grad_accum_dtype), grad_shardings)
+            new_acc = jax.tree.map(jnp.add, grad_acc, grads)
+            # report the unscaled loss
+            return loss_s * gas / loss_scale, new_acc
+
+        self._micro_fwd_bwd = jax.jit(
+            micro_fwd_bwd,
+            donate_argnums=(1,),
+            static_argnums=(5,))
+
+        def eval_loss(params, batch):
+            loss, aux = self.adapter.loss(params, batch, None, train=False)
+            return loss
+
+        self._eval_loss = jax.jit(eval_loss)
+
+        def apply_step(state, lr):
+            grads = state["grad_acc"]
+            scale = state["loss_scale"]
+            inv = 1.0 / scale
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+            if fp16:
+                finite = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+            else:
+                finite = jnp.bool_(True)
+
+            grad_norm = _global_norm(grads)
+            if clip > 0:
+                coef = jnp.minimum(clip / (grad_norm + 1e-6), 1.0)
+                grads = jax.tree.map(lambda g: g * coef, grads)
+
+            master = state["master"] if mixed else state["params"]
+
+            def do_update(_):
+                updates, new_opt = opt_update(grads, state["opt"], master, lr)
+                new_master = jax.tree.map(jnp.add, master, updates)
+                return new_master, new_opt
+
+            def skip_update(_):
+                return master, state["opt"]
+
+            new_master, new_opt = jax.lax.cond(finite, do_update, skip_update,
+                                               operand=None)
+            if mixed:
+                new_params = jax.lax.with_sharding_constraint(
+                    _cast_tree(new_master, compute_dtype), param_shardings)
+                out_master = new_master
+            else:
+                new_params = jax.lax.with_sharding_constraint(
+                    new_master, param_shardings)
+                out_master = None
+
+            # dynamic loss scale update (reference: DynamicLossScaler,
+            # fp16/loss_scaler.py:91 — hysteresis overflows tolerated before
+            # halving; scale doubles after a good window)
+            if fp16 and fp16_cfg.loss_scale == 0:
+                window = fp16_cfg.loss_scale_window
+                min_scale = fp16_cfg.min_loss_scale
+                hyst0 = jnp.int32(fp16_cfg.hysteresis)
+                good = state["good_steps"]
+                hyst = state["hysteresis"]
+
+                def on_good(_):
+                    scale2, good2 = jax.lax.cond(
+                        good + 1 >= window,
+                        lambda __: (scale * 2.0, jnp.zeros((), jnp.int32)),
+                        lambda __: (scale, good + 1), None)
+                    hyst2 = hyst if fp16_cfg.consecutive_hysteresis else hyst0
+                    return scale2, good2, hyst2
+
+                def on_overflow(_):
+                    return jax.lax.cond(
+                        hyst <= 1,
+                        lambda __: (jnp.maximum(scale / 2.0, min_scale),
+                                    jnp.zeros((), jnp.int32), hyst0),
+                        lambda __: (scale, jnp.zeros((), jnp.int32),
+                                    hyst - 1), None)
+
+                new_scale, new_good, new_hyst = jax.lax.cond(
+                    finite, on_good, on_overflow, operand=None)
+            else:
+                new_scale, new_good = scale, state["good_steps"]
+                new_hyst = state["hysteresis"]
+
+            zero_acc = jax.tree.map(jnp.zeros_like, state["grad_acc"])
+            new_state = {
+                "params": new_params,
+                "master": out_master,
+                "opt": new_opt,
+                "grad_acc": zero_acc,
+                "loss_scale": new_scale,
+                "good_steps": new_good,
+                "hysteresis": new_hyst,
+            }
+            return new_state, finite, grad_norm
+
+        self._apply_step = jax.jit(apply_step, donate_argnums=(0,))
+
+        # fully fused train_batch: scan microbatches then apply
+        def fused_train_batch(state, batches, lr, rng):
+            def body(acc, xs):
+                grad_acc, loss_sum = acc
+                batch, key = xs
+                loss, grad_acc = micro_fwd_bwd(
+                    state["params"], grad_acc, state["loss_scale"], batch,
+                    key, True)
+                return (grad_acc, loss_sum + loss), None
+
+            keys = jax.random.split(rng, gas)
+            (grad_acc, loss_sum), _ = jax.lax.scan(
+                body, (state["grad_acc"], jnp.zeros((), jnp.float32)),
+                (batches, keys))
+            state = dict(state, grad_acc=grad_acc)
+            new_state, finite, grad_norm = apply_step(state, lr)
+            return new_state, loss_sum / gas, finite, grad_norm
+
+        self._fused_train_batch = jax.jit(fused_train_batch,
+                                          donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ #
+    # Batch placement
+    # ------------------------------------------------------------------ #
+    def _batch_sharding(self, leaf):
+        if self._batch_spec_fn is not None:
+            return NamedSharding(self.mesh, self._batch_spec_fn(leaf))
+        batch_axes = self.topology.batch_shard_axes()
+        seq_axes = self.topology.sequence_shard_axes()
+        spec = [batch_axes if batch_axes else None]
+        if leaf.ndim >= 2 and seq_axes:
+            spec.append(seq_axes)
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def _shard_batch(self, batch, extra_leading=False):
+        """Host pytree -> globally sharded jax.Arrays."""
+
+        def place(x):
+            x = np.asarray(x)
+            if extra_leading:
+                # [gas, micro, ...]: shard dim1
+                sh = self._batch_sharding(x[0])
+                spec = PartitionSpec(None, *sh.spec)
+                sh = NamedSharding(self.mesh, spec)
+            else:
+                sh = self._batch_sharding(x)
+            if jax.process_count() > 1:
+                from jax import make_array_from_process_local_data
+                return make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(place, batch)
+
+    def _next_rng(self):
+        return jax.random.fold_in(jax.random.PRNGKey(self._rng_seed),
+                                  self.micro_steps + 1)
+
+    # ------------------------------------------------------------------ #
+    # Public API (reference: engine.forward :2041 / backward :2204 /
+    # step :2338 / train_batch pipe/engine.py:338)
+    # ------------------------------------------------------------------ #
+    def is_gradient_accumulation_boundary(self):
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps == 0
+
+    def forward(self, batch):
+        """Run the fused fwd+bwd micro-step; returns the (unscaled) loss.
+
+        The gradient contribution is accumulated into engine state here
+        (fwd+bwd are one fused XLA program — the input grad buffer is
+        donated, so state is updated immediately to never hold a deleted
+        array); ``backward()`` then only advances the micro-step counter.
+        """
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).start()
+        batch = self._shard_batch(batch)
+        loss, new_acc = self._micro_fwd_bwd(
+            self.state["params"], self.state["grad_acc"],
+            self.state["loss_scale"], batch, self._next_rng(), True)
+        self.state["grad_acc"] = new_acc
+        self._pending = loss
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def backward(self, loss=None):
+        """Book-keeping half of the fused fwd+bwd (see ``forward``)."""
+        if self._pending is None:
+            raise RuntimeError("backward() called without forward()")
+        if self.wall_clock_breakdown:
+            self.timers(BACKWARD_GLOBAL_TIMER).start()
+        self._pending = None
+        self.micro_steps += 1
+        if self.wall_clock_breakdown:
+            self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def step(self):
+        """Apply the optimizer at gradient-accumulation boundaries."""
+        if self.micro_steps % self.gradient_accumulation_steps != 0:
+            return
+        if self.wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).start()
+        lr = jnp.asarray(self._current_lr, jnp.float32)
+        self.state, finite, grad_norm = self._apply_step(self.state, lr)
+        self._after_step(finite)
+        if self.wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).stop()
+            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                             STEP_GLOBAL_TIMER])
+
+    def _after_step(self, finite):
+        self.global_steps += 1
+        skipped = self.fp16_enabled and not bool(finite)
+        if skipped:
+            self.skipped_steps += 1
+            log_dist(f"overflow: skipping step {self.global_steps}, "
+                     f"loss scale -> {float(self.state['loss_scale'])}",
+                     ranks=[0])
+        else:
+            # reference semantics: overflow-skipped steps do not advance the
+            # lr schedule (fp16/fused_optimizer.py skips scheduler coupling)
+            self._current_lr = float(self.lr_scheduler.step())
+        if self.monitor.enabled and \
+                self.global_steps % self.config.steps_per_print == 0:
+            self.monitor.write_events([
+                ("Train/lr", self._current_lr, self.global_steps)])
+
+    def train_batch(self, data_iter=None, batch=None):
+        """One full optimizer step: gas micro-batches fused on device.
+
+        ``batch``: a pytree whose leaves have leading dim
+        ``gas * micro_batch`` (or exactly the micro shape when gas==1);
+        alternatively pull gas batches from ``data_iter``.
+        """
+        self.tput_timer.start()
+        if self.wall_clock_breakdown:
+            self.timers(BATCH_TIMER).start()
+        gas = self.gradient_accumulation_steps
+        if batch is None:
+            if data_iter is None:
+                if self.training_dataloader is None:
+                    raise ValueError("train_batch needs data_iter or batch")
+                # persistent iterator: successive calls walk the dataset
+                # (restarting each call would train on the first gas
+                # micro-batches forever)
+                if self._data_iter is None:
+                    from .dataloader import RepeatingLoader
+                    self._data_iter = iter(
+                        RepeatingLoader(self.training_dataloader))
+                data_iter = self._data_iter
+            micro_batches = [next(data_iter) for _ in range(gas)]
+            batch = jax.tree.map(lambda *xs: np.stack(xs), *micro_batches)
+        else:
+            batch = jax.tree.map(
+                lambda x: np.asarray(x).reshape(
+                    (gas, -1) + np.asarray(x).shape[1:]), batch)
+        batch = self._shard_batch(batch, extra_leading=True)
+        lr = jnp.asarray(self._current_lr, jnp.float32)
+        self.state, loss, finite, grad_norm = self._fused_train_batch(
+            self.state, batch, lr, self._next_rng())
+        self.micro_steps += gas
+        self._after_step(finite)
+        if self.wall_clock_breakdown:
+            self.timers(BATCH_TIMER).stop()
+        self.tput_timer.stop(report_speed=True)
+        if self.monitor.enabled and \
+                self.global_steps % self.config.steps_per_print == 0:
+            self.monitor.write_events([
+                ("Train/loss", float(loss), self.global_steps)])
+        return loss
+
+    def eval_batch(self, batch):
+        batch = self._shard_batch(batch)
+        return self._eval_loss(self.state["params"], batch)
+
+    # ------------------------------------------------------------------ #
+    # Introspection (reference: get_lr, get_global_grad_norm, ...)
+    # ------------------------------------------------------------------ #
+    def get_lr(self):
+        return [self._current_lr]
+
+    def get_loss_scale(self):
+        return float(self.state["loss_scale"])
+
+    @property
+    def params(self):
+        return self.state["params"]
+
+    def get_global_grad_norm(self):
+        return None  # populated per-step in train_batch path if needed
+
+    def deepspeed_io(self, dataset, batch_size=None, **kw):
+        from .dataloader import HDSDataLoader
+        if batch_size is None:
+            # train_micro_batch_size_per_gpu is per *chip* (reference: per
+            # GPU process); one controller feeds all its local chips, so a
+            # process-local micro-batch covers its share of the dp world.
+            global_micro = self.micro_batch_size * \
+                self.topology.dp_world_size()
+            batch_size = max(global_micro // jax.process_count(), 1)
+        return HDSDataLoader(dataset, batch_size, **kw)
+
+    # ------------------------------------------------------------------ #
+    # Checkpointing (reference: engine.py:3274 save_checkpoint /
+    # :2928 load_checkpoint; sharded + resharding-tolerant like the
+    # universal checkpoint)
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        from .checkpointing import save_checkpoint as _save
+        tag = tag or f"global_step{self.global_steps}"
+        meta = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "lr_scheduler": self.lr_scheduler.state_dict(),
+            "current_lr": self._current_lr,
+            "client_state": client_state or {},
+        }
+        _save(save_dir, tag, self.state, meta, save_latest=save_latest)
+        log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        **kw):
+        from .checkpointing import load_checkpoint as _load
+        state, meta = _load(load_dir, tag, self.state,
+                            load_optimizer_states=load_optimizer_states)
+        if state is None:
+            return None, {}
+        self.state = state
+        self.global_steps = meta.get("global_steps", 0)
+        self.micro_steps = meta.get("micro_steps", 0)
+        self.skipped_steps = meta.get("skipped_steps", 0)
+        if "lr_scheduler" in meta:
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self._current_lr = meta.get("current_lr", self._current_lr)
+        log_dist(f"loaded checkpoint from {load_dir}", ranks=[0])
+        return load_dir, meta.get("client_state", {})

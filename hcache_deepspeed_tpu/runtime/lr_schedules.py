@@ -1,0 +1,168 @@
+"""Learning-rate schedules.
+
+Reference analog: ``deepspeed/runtime/lr_schedules.py`` (878 LoC) —
+LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR. Same schedule
+math, but expressed as pure ``step -> lr`` callables; the engine feeds the
+scalar into the jitted train step each boundary, so schedule changes never
+trigger recompilation.
+"""
+
+import math
+
+
+class LRSchedule:
+    """step -> lr; mirrors the torch scheduler interface loosely."""
+
+    def __init__(self):
+        self.last_step = 0
+
+    def get_lr(self, step: int) -> float:
+        raise NotImplementedError
+
+    def step(self, increment: int = 1):
+        self.last_step += increment
+        return self.get_lr(self.last_step)
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd):
+        self.last_step = sd["last_step"]
+
+
+class ConstantLR(LRSchedule):
+    def __init__(self, lr: float):
+        super().__init__()
+        self.lr = lr
+
+    def get_lr(self, step):
+        return self.lr
+
+
+class WarmupLR(LRSchedule):
+    """Reference: WarmupLR — linear (or log) ramp then constant."""
+
+    def __init__(self, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, warmup_type="log", **_):
+        super().__init__()
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.warmup_steps = max(warmup_num_steps, 1)
+        self.warmup_type = warmup_type
+
+    def _warmup_factor(self, step):
+        frac = min(step / self.warmup_steps, 1.0)
+        if self.warmup_type == "log" and 0 < frac < 1:
+            return math.log(1 + frac * (math.e - 1))
+        return frac
+
+    def get_lr(self, step):
+        if step < self.warmup_steps:
+            f = self._warmup_factor(step)
+            return self.min_lr + f * (self.max_lr - self.min_lr)
+        return self.max_lr
+
+
+class WarmupDecayLR(WarmupLR):
+    """Reference: WarmupDecayLR — warmup then linear decay to 0 at total steps."""
+
+    def __init__(self, total_num_steps, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, warmup_type="log", **_):
+        super().__init__(warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         warmup_type)
+        self.total_steps = total_num_steps
+
+    def get_lr(self, step):
+        if step < self.warmup_steps:
+            return super().get_lr(step)
+        frac = (self.total_steps - step) / max(
+            self.total_steps - self.warmup_steps, 1)
+        return max(self.max_lr * max(frac, 0.0), 0.0)
+
+
+class WarmupCosineLR(LRSchedule):
+    """Reference: WarmupCosineLR — ratio-based warmup then cosine decay."""
+
+    def __init__(self, total_num_steps, warmup_min_ratio=0.0,
+                 warmup_num_steps=1000, cos_min_ratio=0.0001, lr=0.001, **_):
+        super().__init__()
+        self.total_steps = total_num_steps
+        self.warmup_min_ratio = warmup_min_ratio
+        self.warmup_steps = max(warmup_num_steps, 1)
+        self.cos_min_ratio = cos_min_ratio
+        self.base_lr = lr
+
+    def get_lr(self, step):
+        if step < self.warmup_steps:
+            ratio = self.warmup_min_ratio + (1 - self.warmup_min_ratio) * (
+                step / self.warmup_steps)
+        else:
+            frac = min((step - self.warmup_steps) /
+                       max(self.total_steps - self.warmup_steps, 1), 1.0)
+            cos = 0.5 * (1 + math.cos(math.pi * frac))
+            ratio = self.cos_min_ratio + (1 - self.cos_min_ratio) * cos
+        return self.base_lr * ratio
+
+
+class OneCycle(LRSchedule):
+    """Reference: OneCycle — cycle up/down then decay."""
+
+    def __init__(self, cycle_min_lr, cycle_max_lr, cycle_first_step_size=2000,
+                 cycle_second_step_size=None, decay_step_size=0,
+                 decay_lr_rate=0.0, **_):
+        super().__init__()
+        self.min_lr = cycle_min_lr
+        self.max_lr = cycle_max_lr
+        self.first = cycle_first_step_size
+        self.second = cycle_second_step_size or cycle_first_step_size
+        self.decay_step_size = decay_step_size
+        self.decay_lr_rate = decay_lr_rate
+
+    def get_lr(self, step):
+        if step <= self.first:
+            return self.min_lr + (self.max_lr - self.min_lr) * step / self.first
+        if step <= self.first + self.second:
+            frac = (step - self.first) / self.second
+            return self.max_lr - (self.max_lr - self.min_lr) * frac
+        if self.decay_step_size > 0:
+            decays = (step - self.first - self.second) / self.decay_step_size
+            return max(self.min_lr - decays * self.decay_lr_rate, 0.0)
+        return self.min_lr
+
+
+class LRRangeTest(LRSchedule):
+    """Reference: LRRangeTest — LR sweep for tuning."""
+
+    def __init__(self, lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                 lr_range_test_step_rate=1.0, lr_range_test_staircase=False, **_):
+        super().__init__()
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def get_lr(self, step):
+        interval = step // self.step_size if self.staircase else \
+            step / self.step_size
+        return self.min_lr * (1 + interval * self.step_rate)
+
+
+SCHEDULES = {
+    "WarmupLR": WarmupLR,
+    "WarmupDecayLR": WarmupDecayLR,
+    "WarmupCosineLR": WarmupCosineLR,
+    "OneCycle": OneCycle,
+    "LRRangeTest": LRRangeTest,
+}
+
+
+def build_scheduler(sched_type, params, base_lr):
+    if sched_type is None:
+        return ConstantLR(base_lr)
+    if sched_type not in SCHEDULES:
+        raise ValueError(f"unknown scheduler '{sched_type}'; "
+                         f"have {sorted(SCHEDULES)}")
+    cls = SCHEDULES[sched_type]
+    if cls is WarmupCosineLR:
+        params = {"lr": base_lr, **params}
+    return cls(**params)

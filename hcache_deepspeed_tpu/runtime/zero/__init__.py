@@ -1,0 +1,3 @@
+from .sharding import ZeroShardingPolicy, choose_shard_spec
+
+__all__ = ["ZeroShardingPolicy", "choose_shard_spec"]

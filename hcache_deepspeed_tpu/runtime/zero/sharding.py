@@ -1,0 +1,136 @@
+"""ZeRO as sharding specs.
+
+Reference analog: the whole of ``deepspeed/runtime/zero/`` —
+``stage_1_and_2.py:98 DeepSpeedZeroOptimizer`` (flatten/partition/IPG-bucket
+machinery), ``stage3.py:112`` + ``partition_parameters.py`` +
+``partitioned_param_coordinator.py`` (per-module gather/release hooks with
+trace-based prefetch).
+
+TPU-native re-design (SURVEY.md §7): none of that machinery is ported.
+A ZeRO stage is a *choice of NamedSharding* for each of the three state
+families, over the ``data`` mesh axis:
+
+=====  ==============  ==========  ==========
+stage  optimizer state  gradients   parameters
+=====  ==============  ==========  ==========
+0      replicated      replicated  replicated
+1      sharded         replicated  replicated
+2      sharded         sharded     replicated
+3      sharded         sharded     sharded
+=====  ==============  ==========  ==========
+
+XLA then *derives* the reference's hand-written communication schedule:
+sharded grads turn the gradient reduction into reduce-scatter (stage 2's IPG
+bucketing), sharded params make pjit insert all-gathers right before use with
+the latency-hiding scheduler overlapping them with compute (stage 3's
+prefetch coordinator), and collective-combining replaces bucket sizes.
+What remains here is only the *placement policy*: which dim of each array
+carries the shard axis.
+"""
+
+from typing import Optional
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...parallel.topology import MeshTopology
+
+
+def _axes_size(topo: MeshTopology, axes) -> int:
+    size = 1
+    for a in axes:
+        size *= topo.axis_size(a)
+    return size
+
+
+def choose_shard_spec(shape,
+                      topo: MeshTopology,
+                      shard_axes,
+                      base_spec: Optional[PartitionSpec] = None,
+                      min_size: int = 2 ** 14) -> PartitionSpec:
+    """Place ``shard_axes`` (e.g. ``('data',)``) on the best free dim.
+
+    Policy: prefer the largest dim divisible by the shard-group size that is
+    not already taken by tensor/expert sharding in ``base_spec``. Small
+    arrays (< min_size elements) stay replicated — the analog of the
+    reference's ``stage3_param_persistence_threshold`` (small params are
+    kept gathered because per-param collective overhead dominates).
+    """
+    if not shard_axes:
+        return base_spec or PartitionSpec()
+    base = tuple(base_spec) if base_spec is not None else ()
+    base = base + (None,) * (len(shape) - len(base))
+    n = _axes_size(topo, shard_axes)
+    if n <= 1 or int(np.prod(shape or (1,))) < max(min_size, 1):
+        return PartitionSpec(*base)
+    # candidate dims: unsharded in base, divisible by n
+    candidates = [d for d in range(len(shape))
+                  if base[d] is None and shape[d] % n == 0 and shape[d] >= n]
+    if not candidates:
+        return PartitionSpec(*base)
+    best = max(candidates, key=lambda d: shape[d])
+    new = list(base)
+    new[best] = shard_axes[0] if len(shard_axes) == 1 else tuple(shard_axes)
+    return PartitionSpec(*new)
+
+
+class ZeroShardingPolicy:
+    """Computes the three sharding pytrees for a param pytree.
+
+    ``tp_spec_fn(path, leaf) -> PartitionSpec`` supplies tensor/expert
+    sharding from the model's logical rules; ZeRO sharding composes on top.
+    """
+
+    def __init__(self, stage: int, topo: MeshTopology, tp_spec_fn=None,
+                 min_shard_size: int = 2 ** 14):
+        if stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero stage must be 0-3, got {stage}")
+        self.stage = stage
+        self.topo = topo
+        self.tp_spec_fn = tp_spec_fn or (lambda path, leaf: PartitionSpec())
+        self.min_shard_size = min_shard_size
+        self.zero_axes = topo.zero_shard_axes()
+
+    # Each returns a PartitionSpec for one leaf.
+    def param_spec(self, path, leaf) -> PartitionSpec:
+        base = self.tp_spec_fn(path, leaf)
+        if self.stage >= 3:
+            return choose_shard_spec(leaf.shape, self.topo, self.zero_axes,
+                                     base, self.min_shard_size)
+        return base
+
+    def grad_spec(self, path, leaf) -> PartitionSpec:
+        base = self.tp_spec_fn(path, leaf)
+        if self.stage >= 2:
+            return choose_shard_spec(leaf.shape, self.topo, self.zero_axes,
+                                     base, self.min_shard_size)
+        return base
+
+    def opt_spec(self, path, leaf) -> PartitionSpec:
+        base = self.tp_spec_fn(path, leaf)
+        if self.stage >= 1:
+            return choose_shard_spec(leaf.shape, self.topo, self.zero_axes,
+                                     base, self.min_shard_size)
+        return base
+
+    # ---------------- pytree-level helpers ---------------- #
+    def _tree_specs(self, params, spec_fn):
+        import jax
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: spec_fn(path, leaf), params)
+
+    def param_specs(self, params):
+        return self._tree_specs(params, self.param_spec)
+
+    def grad_specs(self, params):
+        return self._tree_specs(params, self.grad_spec)
+
+    def opt_specs(self, params):
+        return self._tree_specs(params, self.opt_spec)
+
+    def named(self, spec_tree):
+        import jax
+        mesh = self.topo.mesh
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))

@@ -1,0 +1,63 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``
+(logger + ``log_dist`` rank filtering). On TPU pods there is one Python
+process per host, so "rank" here is the JAX process index.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name="hds_tpu", level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"))
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("HDS_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # jax.distributed not initialised / no backend yet
+        return int(os.environ.get("RANK", 0))
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process ranks (None/[-1] = all)."""
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message, _seen=set()):
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,129 @@
+"""Wall-clock timers.
+
+Reference analog: ``deepspeed/utils/timer.py`` — ``SynchronizedWallClockTimer``
+(named start/stop timers synchronising the device) and ``ThroughputTimer``
+(samples/sec, tokens/sec). On TPU "synchronise" means draining async dispatch
+(`block_until_ready`), and per-op timing belongs to the XLA profiler; these
+timers bracket host-visible phases (fwd/bwd/step/io) exactly like the
+reference's ``wall_clock_breakdown`` mode.
+"""
+
+import time
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+BATCH_TIMER = "train_batch"
+
+
+class _Timer:
+    def __init__(self, name, synchronize_fn=None):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.count = 0
+        self._sync = synchronize_fn
+
+    def start(self):
+        if self._sync:
+            self._sync()
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, record=True):
+        if not self.started:
+            return
+        if self._sync:
+            self._sync()
+        if record:
+            self.elapsed_ += time.perf_counter() - self.start_time
+            self.count += 1
+        self.started = False
+
+    def elapsed(self, reset=True):
+        value = self.elapsed_
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self):
+        return self.elapsed_ / max(self.count, 1)
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.count = 0
+
+
+class SynchronizedWallClockTimer:
+    def __init__(self, synchronize=True):
+        self.timers = {}
+        sync_fn = None
+        if synchronize:
+            def sync_fn():
+                try:
+                    from ..platform import get_platform
+                    get_platform().synchronize()
+                except Exception:
+                    pass
+        self._sync_fn = sync_fn
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name, self._sync_fn)
+        return self.timers[name]
+
+    def log(self, names=None, reset=True, ranks=None):
+        names = names or list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0
+                parts.append(f"{name}: {ms:.2f}ms")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    """Reference: ThroughputTimer — tracks samples/sec after warmup."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self, global_step=True, report_speed=True):
+        if self._start is None:
+            return
+        duration = time.perf_counter() - self._start
+        self._start = None
+        if global_step:
+            self.global_step_count += 1
+            if self.global_step_count >= self.start_step:
+                self.total_elapsed_time += duration
+                self.step_elapsed_time += duration
+                if report_speed and self.steps_per_output and \
+                        self.global_step_count % self.steps_per_output == 0:
+                    log_dist(
+                        f"step={self.global_step_count}, "
+                        f"throughput={self.avg_samples_per_sec():.2f} "
+                        f"samples/sec", ranks=[0])
+
+    def avg_samples_per_sec(self):
+        counted = max(self.global_step_count - self.start_step + 1, 1)
+        if self.total_elapsed_time <= 0:
+            return 0.0
+        return self.batch_size * counted / self.total_elapsed_time

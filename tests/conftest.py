@@ -1,0 +1,37 @@
+"""Test harness.
+
+Reference analog: ``tests/unit/common.py`` — there, multi-process
+``torch.multiprocessing`` + file-store rendezvous simulates a cluster; here
+the TPU-native analog is a *virtual 8-device CPU mesh* via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4): every sharding,
+collective and ZeRO path executes exactly as it would across chips, inside
+one process.
+
+These env vars must be set before JAX initialises its backends, which is why
+they live at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("HDS_LOG_LEVEL", "warning")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    yield
+    from hcache_deepspeed_tpu.parallel import topology
+    topology.reset_topology()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

@@ -1,0 +1,73 @@
+import pytest
+
+from hcache_deepspeed_tpu.runtime.config import (HDSConfig, HDSConfigError,
+                                                 load_config)
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = load_config({"train_batch_size": 8})
+        assert cfg.zero_optimization.stage == 0
+        assert not cfg.fp16.enabled and not cfg.bf16.enabled
+
+    def test_reference_keys_parse(self):
+        # a config written for the reference framework parses unchanged
+        cfg = load_config({
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+            "steps_per_print": 100,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 1e-4, "betas": [0.9, 0.999],
+                                     "eps": 1e-8, "weight_decay": 0.01}},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0,
+                                     "warmup_max_lr": 1e-4,
+                                     "warmup_num_steps": 1000}},
+            "gradient_clipping": 1.0,
+            "fp16": {"enabled": False, "loss_scale": 0,
+                     "initial_scale_power": 16},
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 2,
+                "allgather_bucket_size": 5e8,
+                "reduce_bucket_size": 5e8,
+                "overlap_comm": True,
+                "contiguous_gradients": True,
+                "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            },
+            "wall_clock_breakdown": False,
+        })
+        assert cfg.zero_optimization.stage == 2
+        assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+        assert cfg.optimizer.params["betas"] == [0.9, 0.999]
+        assert cfg.scheduler.type == "WarmupLR"
+
+    def test_batch_trinity(self):
+        cfg = load_config({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2})
+        train, micro, gas = cfg.resolve_batch_sizes(dp_world_size=4)
+        assert (train, micro, gas) == (32, 2, 4)
+
+    def test_batch_trinity_infer_train(self):
+        cfg = load_config({"train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 3})
+        train, micro, gas = cfg.resolve_batch_sizes(dp_world_size=4)
+        assert train == 24
+
+    def test_batch_trinity_inconsistent(self):
+        cfg = load_config({"train_batch_size": 10,
+                           "train_micro_batch_size_per_gpu": 4,
+                           "gradient_accumulation_steps": 1})
+        with pytest.raises(HDSConfigError):
+            cfg.resolve_batch_sizes(dp_world_size=4)
+
+    def test_fp16_bf16_conflict(self):
+        with pytest.raises(HDSConfigError):
+            load_config({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}})
+
+    def test_unknown_key_tolerated(self):
+        cfg = load_config({"train_batch_size": 8,
+                           "some_future_key": {"x": 1}})
+        assert cfg.train_batch_size == 8

@@ -1,0 +1,76 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from hcache_deepspeed_tpu.parallel.topology import (MeshTopology,
+                                                    TopologySpec,
+                                                    get_topology,
+                                                    initialize_topology)
+from hcache_deepspeed_tpu.runtime.zero.sharding import (ZeroShardingPolicy,
+                                                        choose_shard_spec)
+
+
+class TestTopology:
+    def test_default_all_data(self):
+        topo = MeshTopology()
+        assert topo.data_size == len(jax.devices())
+        assert topo.world_size == len(jax.devices())
+        assert topo.batch_shard_axes() == ("data",)
+
+    def test_resolve_spec(self):
+        spec = TopologySpec(pipe=2, tensor=2).resolve(8)
+        assert spec.data == 2
+
+    def test_bad_spec(self):
+        with pytest.raises(ValueError):
+            TopologySpec(pipe=3).resolve(8)
+
+    def test_grad_reduce_axes(self):
+        topo = MeshTopology(TopologySpec(pipe=1, data=2, expert=2, seq=2,
+                                         tensor=1))
+        assert topo.grad_reduce_axes() == ("data", "expert", "seq")
+        assert topo.grad_reduce_axes(expert_param=True) == ("data", "seq")
+        assert topo.dp_world_size() == 4
+
+    def test_singleton(self):
+        t1 = initialize_topology(TopologySpec(data=4, tensor=2))
+        assert get_topology() is t1
+        assert t1.tensor_size == 2
+
+
+class TestZeroSharding:
+    def _topo(self):
+        return MeshTopology(TopologySpec(data=8))
+
+    def test_choose_spec_picks_divisible_dim(self):
+        topo = self._topo()
+        spec = choose_shard_spec((6, 128, 512), topo, ("data",), min_size=1)
+        assert spec == PartitionSpec(None, None, "data")
+
+    def test_choose_spec_small_stays_replicated(self):
+        topo = self._topo()
+        spec = choose_shard_spec((4, 4), topo, ("data",), min_size=2 ** 14)
+        assert spec == PartitionSpec(None, None)
+
+    def test_choose_spec_respects_base(self):
+        topo = MeshTopology(TopologySpec(data=4, tensor=2))
+        base = PartitionSpec(None, "tensor")
+        spec = choose_shard_spec((1024, 512), topo, ("data",), base, min_size=1)
+        assert spec == PartitionSpec("data", "tensor")
+
+    @pytest.mark.parametrize("stage,expect", [
+        (0, (False, False, False)),
+        (1, (False, False, True)),
+        (2, (False, True, True)),
+        (3, (True, True, True)),
+    ])
+    def test_stage_table(self, stage, expect):
+        topo = self._topo()
+        policy = ZeroShardingPolicy(stage, topo, min_shard_size=1)
+        leaf = np.zeros((256, 64), np.float32)
+        shard_param, shard_grad, shard_opt = expect
+        is_sharded = lambda s: any(x is not None for x in tuple(s))
+        assert is_sharded(policy.param_spec((), leaf)) == shard_param
+        assert is_sharded(policy.grad_spec((), leaf)) == shard_grad
+        assert is_sharded(policy.opt_spec((), leaf)) == shard_opt
