@@ -109,12 +109,14 @@ def init_distributed(dist_backend=None,
         jax.distributed.initialize(**kwargs)
     else:
         # Cloud TPU pod slices auto-discover through the metadata server;
-        # initialize() is then arg-free. On single host it's a no-op need.
-        if jax.process_count() == 1 and _looks_like_pod():
+        # initialize() is then arg-free. Probe the env FIRST — touching
+        # jax.process_count() would initialise the backend and make
+        # jax.distributed.initialize() impossible.
+        if _looks_like_pod():
             try:
                 jax.distributed.initialize()
             except Exception as e:  # already initialised or not a pod
-                logger.debug(f"jax.distributed.initialize() skipped: {e}")
+                logger.warning(f"jax.distributed.initialize() skipped: {e}")
     _initialized = True
 
 
@@ -151,13 +153,11 @@ def get_global_device_count():
 
 
 def barrier():
-    """Host-level barrier: a tiny psum across all devices, blocking."""
+    """Host-level barrier across all processes."""
     if jax.process_count() == 1:
         return
-    x = jnp.zeros((), dtype=jnp.float32)
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("hds_barrier")
-    del x
 
 
 # ------------------------------------------------------------------ #

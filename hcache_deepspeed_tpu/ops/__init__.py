@@ -1,0 +1,86 @@
+"""Op registry.
+
+Reference analog: ``op_builder/`` (4.5k LoC) — per-op builders with
+``is_compatible()`` probes, JIT/AOT compilation, and per-accelerator routing
+(``op_builder/builder.py:117``, ``accelerator.create_op_builder``).
+
+TPU-native: kernels are Pallas (compiled through XLA, no separate toolchain),
+so "building" disappears; what remains is the *routing and probing* surface:
+every op has a reference jnp implementation (always correct, runs anywhere —
+the analog of the reference's torch fallbacks) and may have a Pallas
+implementation used when the platform supports it. ``get_op(name)`` returns
+the best available callable; ``HDS_DISABLE_PALLAS=1`` forces references
+(the analog of ``DS_BUILD_OPS=0``).
+"""
+
+import os
+
+from ..utils.logging import logger
+
+_REGISTRY = {}
+
+
+class OpImpl:
+    def __init__(self, name, reference_fn, pallas_fn=None, is_compatible=None):
+        self.name = name
+        self.reference_fn = reference_fn
+        self.pallas_fn = pallas_fn
+        self._is_compatible = is_compatible
+
+    def compatible(self):
+        """Can the pallas path run natively here? (reference:
+        OpBuilder.is_compatible)"""
+        if self.pallas_fn is None:
+            return False
+        if os.environ.get("HDS_DISABLE_PALLAS") == "1":
+            return False
+        if self._is_compatible is not None and not self._is_compatible():
+            return False
+        from ..platform import get_platform
+        return get_platform().supports_pallas()
+
+    def best(self):
+        return self.pallas_fn if self.compatible() else self.reference_fn
+
+
+def register_op(name, reference_fn, pallas_fn=None, is_compatible=None):
+    _REGISTRY[name] = OpImpl(name, reference_fn, pallas_fn, is_compatible)
+    return _REGISTRY[name]
+
+
+def get_op(name):
+    """Best implementation of ``name`` for the current platform."""
+    _ensure_loaded()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown op '{name}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name].best()
+
+
+def get_op_impl(name) -> OpImpl:
+    _ensure_loaded()
+    return _REGISTRY[name]
+
+
+def op_report():
+    """Reference: bin/ds_report — op-by-op compatibility table."""
+    _ensure_loaded()
+    lines = [f"{'op':<24} {'pallas':<8} {'active'}"]
+    for name, impl in sorted(_REGISTRY.items()):
+        native = impl.compatible()
+        lines.append(f"{name:<24} {'yes' if impl.pallas_fn else 'no':<8} "
+                     f"{'pallas' if native else 'reference'}")
+    return "\n".join(lines)
+
+
+_loaded = False
+
+
+def _ensure_loaded():
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import flash_attention, quantizer, rms_norm, rope  # noqa: F401
+
+
+__all__ = ["register_op", "get_op", "get_op_impl", "op_report"]

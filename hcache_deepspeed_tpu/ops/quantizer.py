@@ -1,0 +1,90 @@
+"""Group-wise integer quantization.
+
+Reference analog: ``csrc/quantization/`` (2.9k LoC: quantize.cu,
+dequantize.cu, quant_reduce.cu, swizzled_quantize.cu) — int8/int4 groupwise
+symmetric quantization backing ZeRO++ qwZ/qgZ. Here: a Pallas kernel for
+the hot path and a jnp reference; the "fused quantized reduction"
+(quant_reduce.cu) maps to quantize → all_to_all → dequant-accumulate in
+``runtime/comm`` (EQuARX-style, PAPERS.md).
+
+Symmetric per-group scaling: values in a group share scale = absmax/127.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import register_op
+
+
+def _pack_groups(x, group_size):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if n % group_size:
+        pad = group_size - n % group_size
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, group_size), n
+
+
+def reference_quantize(x, group_size=256, num_bits=8):
+    qmax = 2 ** (num_bits - 1) - 1
+    groups, n = _pack_groups(x.astype(jnp.float32), group_size)
+    scale = jnp.max(jnp.abs(groups), axis=-1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(groups / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale.astype(jnp.float32), x.shape, n
+
+
+def reference_dequantize(q, scale, orig_shape, orig_n):
+    out = (q.astype(jnp.float32) * scale).reshape(-1)[:orig_n]
+    return out.reshape(orig_shape)
+
+
+def _quant_kernel(x_ref, q_ref, s_ref, *, qmax):
+    x = x_ref[:].astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q_ref[:] = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax).astype(
+        jnp.int8)
+    s_ref[:] = scale
+
+
+def pallas_quantize(x, group_size=256, num_bits=8, interpret=None,
+                    block_groups=64):
+    if interpret is None:
+        from ..platform import get_platform
+        interpret = not get_platform().supports_pallas()
+    qmax = 2 ** (num_bits - 1) - 1
+    groups, n = _pack_groups(x.astype(jnp.float32), group_size)
+    G = groups.shape[0]
+    block_groups = min(block_groups, G)
+    if G % block_groups:
+        return reference_quantize(x, group_size, num_bits)
+    q, scale = pl.pallas_call(
+        functools.partial(_quant_kernel, qmax=qmax),
+        grid=(G // block_groups,),
+        in_specs=[pl.BlockSpec((block_groups, group_size), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((block_groups, group_size), lambda i: (i, 0)),
+            pl.BlockSpec((block_groups, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((G, group_size), jnp.int8),
+            jax.ShapeDtypeStruct((G, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(groups)
+    return q, scale, x.shape, n
+
+
+def quantize(x, group_size=256, num_bits=8):
+    from . import get_op
+    return get_op("quantize")(x, group_size=group_size, num_bits=num_bits)
+
+
+dequantize = reference_dequantize
+
+register_op("quantize", reference_quantize, pallas_quantize)
+register_op("dequantize", reference_dequantize)

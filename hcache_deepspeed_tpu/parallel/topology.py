@@ -91,7 +91,10 @@ class MeshTopology:
     # Sizes (reference: get_*_parallel_world_size in utils/groups.py)
     # -------------------------------------------------------------- #
     def axis_size(self, axis):
-        return self.mesh.shape[axis]
+        # externally supplied meshes may carry a subset of the canonical
+        # axes; absent axes have size 1
+        return self.mesh.shape.get(axis, 1) if hasattr(self.mesh.shape, "get") \
+            else dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get(axis, 1)
 
     @property
     def pipe_size(self):

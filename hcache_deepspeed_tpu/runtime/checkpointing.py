@@ -59,7 +59,12 @@ def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True):
     if not os.path.isdir(path):
         logger.warning(f"checkpoint {path} not found")
         return None, {}
-    with open(os.path.join(path, _META_NAME)) as fh:
+    meta_path = os.path.join(path, _META_NAME)
+    if not os.path.exists(meta_path):
+        logger.warning(f"checkpoint meta {meta_path} missing "
+                       "(interrupted save?); refusing to load")
+        return None, {}
+    with open(meta_path) as fh:
         meta = json.load(fh)
 
     template = {k: v for k, v in template_state.items() if v is not None}

@@ -31,30 +31,24 @@ offers the fully fused path (one dispatch per optimizer step, microbatches
 scanned on device).
 """
 
-import os
-import time
-from functools import partial
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..comm import comm as dist
-from ..parallel.topology import (MeshTopology, TopologySpec, get_topology,
+from ..parallel.topology import (MeshTopology, TopologySpec,
                                  initialize_topology)
 from ..platform import get_platform
-from ..utils.logging import log_dist, logger
+from ..utils.logging import log_dist
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BATCH_TIMER,
                            FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
                            SynchronizedWallClockTimer, ThroughputTimer)
-from .config import HDSConfig, load_config
+from .config import HDSConfig
 from .lr_schedules import build_scheduler
 from .optimizers import OptimizerDef, build_optimizer
 from .zero.sharding import ZeroShardingPolicy
-
-_OVERFLOW_CHECK = "overflow"
 
 
 def _cast_tree(tree, dtype):
@@ -84,14 +78,14 @@ class ModelAdapter:
         self.module = None
         if hasattr(model, "apply") and hasattr(model, "init"):
             self.module = model
+            self._takes_train = self._call_takes_train(model)
 
             def apply_fn(params, batch, rng, train):
                 rngs = {"dropout": rng} if rng is not None else None
-                try:
+                if self._takes_train:
                     return model.apply({"params": params}, batch,
                                        train=train, rngs=rngs)
-                except TypeError:
-                    return model.apply({"params": params}, batch, rngs=rngs)
+                return model.apply({"params": params}, batch, rngs=rngs)
 
             self.apply_fn = apply_fn
         elif callable(model):
@@ -100,13 +94,22 @@ class ModelAdapter:
             raise TypeError(f"model must be a flax Module or callable, "
                             f"got {type(model)}")
 
+    @staticmethod
+    def _call_takes_train(model):
+        import inspect
+        try:
+            sig = inspect.signature(type(model).__call__)
+        except (TypeError, ValueError):
+            return False
+        return "train" in sig.parameters
+
     def init_params(self, rng, example_batch):
         if self.module is None:
             raise ValueError("param init requires a flax Module or explicit "
                              "init_params")
-        try:
+        if self._takes_train:
             variables = self.module.init(rng, example_batch, train=False)
-        except TypeError:
+        else:
             variables = self.module.init(rng, example_batch)
         return variables["params"]
 

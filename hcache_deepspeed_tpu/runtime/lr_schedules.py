@@ -51,10 +51,12 @@ class WarmupLR(LRSchedule):
         self.warmup_type = warmup_type
 
     def _warmup_factor(self, step):
-        frac = min(step / self.warmup_steps, 1.0)
-        if self.warmup_type == "log" and 0 < frac < 1:
-            return math.log(1 + frac * (math.e - 1))
-        return frac
+        if step >= self.warmup_steps:
+            return 1.0
+        if self.warmup_type == "log" and self.warmup_steps > 1:
+            # reference formula: log(step+1) / log(warmup_num_steps)
+            return math.log(step + 1) / math.log(self.warmup_steps)
+        return step / self.warmup_steps
 
     def get_lr(self, step):
         if step < self.warmup_steps:

@@ -1,0 +1,138 @@
+"""Kernel numerics vs reference (reference analog: tests/unit/ops/* —
+kernel-vs-torch numerics). Pallas kernels run in interpret mode on CPU, so
+the same code path that compiles on TPU is validated here."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.ops import get_op_impl, op_report
+from hcache_deepspeed_tpu.ops.flash_attention import (pallas_attention,
+                                                      reference_attention)
+from hcache_deepspeed_tpu.ops.quantizer import (pallas_quantize,
+                                                reference_dequantize,
+                                                reference_quantize)
+from hcache_deepspeed_tpu.ops.rms_norm import (pallas_rms_norm,
+                                               reference_rms_norm)
+from hcache_deepspeed_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+class TestFlashAttention:
+    def _qkv(self, B=2, T=128, H=4, D=64, dtype=jnp.float32, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (B, T, H, D)
+        return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_fwd_matches_reference(self, causal):
+        q, k, v = self._qkv()
+        ref = reference_attention(q, k, v, causal=causal)
+        got = pallas_attention(q, k, v, causal=causal, block_q=64,
+                               block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_bwd_matches_reference(self):
+        q, k, v = self._qkv(B=1, T=128, H=2, D=32)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        def loss_pl(q, k, v):
+            return jnp.sum(pallas_attention(q, k, v, causal=True,
+                                            block_q=64, block_k=64,
+                                            interpret=True) ** 2)
+
+        ref_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        got_grads = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+        for g, r in zip(got_grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_non_divisible_falls_back(self):
+        q, k, v = self._qkv(T=100)
+        out = pallas_attention(q, k, v, interpret=True)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+
+class TestRMSNorm:
+    def test_fwd(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 256))
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) + 1.0
+        ref = reference_rms_norm(x, w)
+        got = pallas_rms_norm(x, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bwd(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 128))
+        w = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
+
+        ref = jax.grad(lambda x, w: jnp.sum(reference_rms_norm(x, w) ** 2),
+                       argnums=(0, 1))(x, w)
+        got = jax.grad(
+            lambda x, w: jnp.sum(pallas_rms_norm(x, w, interpret=True) ** 2),
+            argnums=(0, 1))(x, w)
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        cos, sin = rope_frequencies(64, 128)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 64))
+        out = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+    def test_position_zero_identity(self):
+        cos, sin = rope_frequencies(32, 8)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 32))
+        out = apply_rope(x, cos, sin, positions=jnp.zeros((1, 1), jnp.int32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n
+        cos, sin = rope_frequencies(32, 64)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 1, 32))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, 32))
+        def dot_at(m, n):
+            qm = apply_rope(q, cos, sin, jnp.full((1, 1), m, jnp.int32))
+            kn = apply_rope(k, cos, sin, jnp.full((1, 1), n, jnp.int32))
+            return float(jnp.sum(qm * kn))
+        assert abs(dot_at(5, 3) - dot_at(10, 8)) < 1e-4
+
+
+class TestQuantizer:
+    @pytest.mark.parametrize("num_bits", [8, 4])
+    def test_roundtrip_error_bounded(self, num_bits):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1000,))
+        q, s, shape, n = reference_quantize(x, group_size=256,
+                                            num_bits=num_bits)
+        out = reference_dequantize(q, s, shape, n)
+        err = np.abs(np.asarray(out) - np.asarray(x)).max()
+        step = np.abs(np.asarray(x)).max() / (2 ** (num_bits - 1) - 1)
+        assert err <= step
+
+    def test_pallas_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4096,))
+        q1, s1, _, _ = reference_quantize(x, group_size=256)
+        q2, s2, _, _ = pallas_quantize(x, group_size=256, interpret=True)
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+
+
+class TestRegistry:
+    def test_report(self):
+        report = op_report()
+        assert "flash_attention" in report
+
+    def test_cpu_uses_reference(self):
+        impl = get_op_impl("flash_attention")
+        assert not impl.compatible()  # CPU: pallas not native
+        assert impl.best() is impl.reference_fn
