@@ -1,1 +1,6 @@
 """Model zoo (flax.linen, TPU-first)."""
+
+from .gpt2 import (GPT2Config, GPT2LMHeadModel, causal_lm_loss,  # noqa: F401
+                   gpt2_125m, gpt2_tiny, gpt2_tp_spec_fn)
+from .llama import (LlamaConfig, LlamaForCausalLM, llama2_7b,  # noqa: F401
+                    llama2_13b, llama3_8b, llama_tiny, llama_tp_spec_fn)

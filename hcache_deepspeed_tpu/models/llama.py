@@ -1,0 +1,245 @@
+"""Llama model family (Llama-2 / Llama-3 style) in flax.linen — the
+flagship model for the BASELINE north-star config (ZeRO-3 Llama-2-7B).
+
+Reference analog: the inference-v2 llama implementation
+(``deepspeed/inference/v2/model_implementations/llama_v2/model.py``) and the
+HF-Llama AutoTP sharding policy (``deepspeed/module_inject/auto_tp.py``).
+This module is the *training-side* definition, built TPU-first:
+
+* pre-norm RMSNorm (Pallas kernel via ``ops.rms_norm``),
+* rotary embeddings (``ops.rope``; XLA fuses into the QKV matmul),
+* grouped-query attention (n_kv_heads <= n_heads) through the Pallas flash
+  attention kernel (``ops.flash_attention``),
+* SwiGLU MLP,
+* static shapes, bf16-friendly, remat-able blocks,
+* Megatron-style TP rules exposed via ``llama_tp_spec_fn`` (column-split
+  q/k/v/gate/up, row-split o/down, vocab-split embed/lm_head) so the same
+  module runs pure-DP, ZeRO-sharded, or TP without code changes,
+* optional Ulysses sequence parallelism: pass ``attention_fn`` (see
+  ``sequence/layer.py``) to swap the core attention for the
+  all-to-all-wrapped one.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..ops.flash_attention import attention as flash_attention
+from ..ops.rms_norm import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+from ..parallel.topology import TENSOR_AXIS
+from .gpt2 import causal_lm_loss
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 32          # < n_head => GQA; == 1 => MQA
+    max_positions: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+    remat: bool = False
+    use_flash: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_head
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def llama2_7b(**kw):
+    defaults = dict(vocab_size=32000, hidden_size=4096,
+                    intermediate_size=11008, n_layer=32, n_head=32,
+                    n_kv_head=32, max_positions=4096, dtype="bfloat16",
+                    remat=True)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def llama2_13b(**kw):
+    defaults = dict(hidden_size=5120, intermediate_size=13824, n_layer=40,
+                    n_head=40, n_kv_head=40, dtype="bfloat16", remat=True)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def llama3_8b(**kw):
+    defaults = dict(vocab_size=128256, hidden_size=4096,
+                    intermediate_size=14336, n_layer=32, n_head=32,
+                    n_kv_head=8, max_positions=8192, rope_theta=500000.0,
+                    dtype="bfloat16", remat=True)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def llama_tiny(**kw):
+    """Test-scale config (reference tests' SimpleModel analog)."""
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    n_layer=2, n_head=4, n_kv_head=2, max_positions=128)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+    attention_fn: Optional[Callable] = None  # Ulysses hook
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+
+        q = nn.Dense(H * D, use_bias=False, dtype=x.dtype, name="q_proj")(x)
+        k = nn.Dense(KV * D, use_bias=False, dtype=x.dtype, name="k_proj")(x)
+        v = nn.Dense(KV * D, use_bias=False, dtype=x.dtype, name="v_proj")(x)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, KV, D)
+        v = v.reshape(B, T, KV, D)
+
+        cos, sin = rope_frequencies(D, cfg.max_positions, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        if KV < H:  # GQA: broadcast kv heads to query heads
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        if self.attention_fn is not None:
+            y = self.attention_fn(q, k, v, causal=True)
+        elif cfg.use_flash:
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            from ..ops.flash_attention import reference_attention
+            y = reference_attention(q, k, v, causal=True)
+        y = y.reshape(B, T, H * D)
+        return nn.Dense(C, use_bias=False, dtype=x.dtype, name="o_proj")(y)
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=x.dtype,
+                        name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=x.dtype,
+                      name="up_proj")(x)
+        h = nn.silu(gate) * up
+        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=x.dtype,
+                        name="down_proj")(h)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],),
+                       jnp.float32)
+        return rms_norm(x, w, eps=self.eps)
+
+
+class LlamaBlock(nn.Module):
+    """Returns ``(x, aux_loss)`` — dense blocks report 0 aux; an MoE
+    ``mlp_cls`` (models/mixtral.py) returns its load-balancing loss, which
+    the top-level model sums and folds into the training loss (the
+    reference collects ``MOELayer.l_aux`` the same way, moe/sharded_moe.py)."""
+    cfg: LlamaConfig
+    attention_fn: Optional[Callable] = None
+    mlp_cls: Any = None  # MoE swap-in point (models/mixtral.py)
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        x = x + LlamaAttention(cfg, attention_fn=self.attention_fn,
+                               name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, name="input_layernorm")(x), train)
+        h = RMSNorm(cfg.rms_norm_eps, name="post_attention_layernorm")(x)
+        if self.mlp_cls is None:
+            y = LlamaMLP(cfg, name="mlp")(h)
+            aux = jnp.zeros((), jnp.float32)
+        else:
+            out = self.mlp_cls(cfg, name="mlp")(h, train)
+            y, aux = out if isinstance(out, tuple) \
+                else (out, jnp.zeros((), jnp.float32))
+        return x + y, aux
+
+
+class LlamaForCausalLM(nn.Module):
+    """Batch contract matches GPT2LMHeadModel: {"input_ids": [B,T] int32,
+    optional "labels" (-100 ignore), optional "attention_mask"}. Returns the
+    mean causal-LM loss (fp32 scalar)."""
+    cfg: LlamaConfig
+    attention_fn: Optional[Callable] = None
+    mlp_cls: Any = None
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        dtype = cfg.compute_dtype
+
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                         name="embed_tokens")
+        x = embed(ids)
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=(2,))
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.n_layer):
+            x, aux = block(cfg, attention_fn=self.attention_fn,
+                           mlp_cls=self.mlp_cls, name=f"layers_{i}")(x, train)
+            aux_total = aux_total + aux
+        x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=dtype,
+                              name="lm_head")(x)
+
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)),
+                             constant_values=-100)
+        loss = causal_lm_loss(logits, labels)
+        aux_coef = getattr(cfg, "moe_aux_loss_coef", 0.0)
+        if aux_coef:
+            loss = loss + aux_coef * aux_total
+        return loss
+
+
+def llama_tp_spec_fn(path, leaf):
+    """Megatron-style TP rules (reference: AutoTP policy for HF Llama,
+    module_inject/auto_tp.py — shard qkv/gate/up column-wise, o/down
+    row-wise, vocab dims of embed/lm_head)."""
+    names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    joined = "/".join(str(n) for n in names)
+    if leaf.ndim < 2:
+        return PartitionSpec()
+    if "embed_tokens" in joined or "lm_head" in joined:
+        return PartitionSpec(None, TENSOR_AXIS)
+    if any(n in joined for n in ("q_proj", "k_proj", "v_proj",
+                                 "gate_proj", "up_proj", "w1", "w3")):
+        return PartitionSpec(None, TENSOR_AXIS)  # column parallel
+    if any(n in joined for n in ("o_proj", "down_proj", "w2")):
+        return PartitionSpec(TENSOR_AXIS, None)  # row parallel
+    return PartitionSpec()

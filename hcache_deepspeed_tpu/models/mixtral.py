@@ -1,0 +1,63 @@
+"""Mixtral-style MoE causal LM: the Llama block with an MoE FFN.
+
+Reference analog: the MoE training path (``deepspeed/moe/``) applied to a
+llama-architecture trunk, and inference-v2's mixtral policy
+(``inference/v2/model_implementations`` engine_factory mapping). Expert
+parameters carry a leading ``[E, ...]`` dim sharded on the ``expert`` mesh
+axis; everything else follows ``models/llama.py``.
+"""
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec
+
+from ..moe.layer import MoEMLP
+from ..parallel.topology import EXPERT_AXIS, TENSOR_AXIS
+from .llama import LlamaConfig, LlamaForCausalLM, llama_tp_spec_fn
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 1.25
+    min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
+
+
+def mixtral_8x7b(**kw):
+    defaults = dict(vocab_size=32000, hidden_size=4096,
+                    intermediate_size=14336, n_layer=32, n_head=32,
+                    n_kv_head=8, max_positions=8192, rope_theta=1e6,
+                    num_experts=8, top_k=2, dtype="bfloat16", remat=True)
+    defaults.update(kw)
+    return MixtralConfig(**defaults)
+
+
+def mixtral_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    n_layer=2, n_head=4, n_kv_head=2, max_positions=128,
+                    num_experts=4, top_k=2)
+    defaults.update(kw)
+    return MixtralConfig(**defaults)
+
+
+def MixtralForCausalLM(cfg: MixtralConfig, attention_fn=None):
+    return LlamaForCausalLM(cfg, attention_fn=attention_fn, mlp_cls=MoEMLP)
+
+
+def mixtral_tp_spec_fn(path, leaf):
+    """TP + EP rules: expert stacks shard their leading E dim on ``expert``
+    (+ optionally their ff dim on ``tensor``); dense params follow llama."""
+    names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    joined = "/".join(str(n) for n in names)
+    if "experts" in joined and leaf.ndim == 3:
+        if any(w in joined for w in ("w1", "w3")):
+            return PartitionSpec(EXPERT_AXIS, None, TENSOR_AXIS)
+        if "w2" in joined:
+            return PartitionSpec(EXPERT_AXIS, TENSOR_AXIS, None)
+        return PartitionSpec(EXPERT_AXIS)
+    if joined.endswith("wg") or "/wg" in joined:
+        return PartitionSpec()  # router replicated, fp32
+    return llama_tp_spec_fn(path, leaf)

@@ -1,0 +1,11 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference analog: ``deepspeed/moe/`` — ``MoE`` wrapper (layer.py:17),
+``TopKGate`` (sharded_moe.py:374), ``MOELayer`` all-to-all dispatch
+(sharded_moe.py:533), ``Experts`` (experts.py:13).
+"""
+
+from .sharded_moe import (TopKGate, gate_load_balancing_loss,  # noqa: F401
+                          top_k_gating)
+from .layer import MoE, MOELayer, MoEMLP  # noqa: F401
+from .experts import SwiGLUExperts  # noqa: F401

@@ -1,0 +1,30 @@
+"""Stacked expert FFNs.
+
+Reference analog: ``deepspeed/moe/experts.py:13 Experts`` — a ModuleList of
+per-expert FFN copies, each rank holding E/ep of them. TPU-native form: ONE
+set of stacked parameters ``[E, ...]`` whose leading expert dim is sharded
+on the ``expert`` mesh axis (see ``moe_spec_fn``), computed as a batched
+einsum so the MXU sees one big grouped matmul instead of E small ones.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SwiGLUExperts(nn.Module):
+    """[E, C, d] -> [E, C, d] llama-style SwiGLU experts."""
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+
+    @nn.compact
+    def __call__(self, x):
+        E, d, f = self.num_experts, self.hidden_size, self.intermediate_size
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w1 = self.param("w1", init, (E, d, f), jnp.float32)  # gate
+        w3 = self.param("w3", init, (E, d, f), jnp.float32)  # up
+        w2 = self.param("w2", init, (E, f, d), jnp.float32)  # down
+        dt = x.dtype
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", x, w1.astype(dt))) * \
+            jnp.einsum("ecd,edf->ecf", x, w3.astype(dt))
+        return jnp.einsum("ecf,efd->ecd", h, w2.astype(dt))

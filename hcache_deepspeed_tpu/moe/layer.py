@@ -1,0 +1,130 @@
+"""MoE layer: gate -> all-to-all dispatch -> experts -> all-to-all combine.
+
+Reference analog: ``deepspeed/moe/layer.py:17 MoE`` and
+``sharded_moe.py:533 MOELayer`` — einsum dispatch into [E, C, d], NCCL
+``all_to_all_single`` across the expert-parallel group, local expert
+compute, inverse all-to-all, einsum combine. TPU-native: the dispatch
+buffer gets a sharding constraint placing the expert dim on the ``expert``
+mesh axis; with tokens batch-sharded on entry, GSPMD lowers the resharding
+to exactly the reference's all-to-all pair, and XLA overlaps it with the
+gate/expert compute.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.topology import EXPERT_AXIS, get_topology
+from .experts import SwiGLUExperts
+from .sharded_moe import top_k_gating
+
+
+class MOELayer(nn.Module):
+    """Token-routing core. Input [B, T, d] -> ([B, T, d], aux_loss).
+
+    Static shapes: per-device capacity buffers, overflow dropped (the
+    reference's drop_tokens=True semantics; capacity_factor tunes slack).
+    """
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 1.25
+    min_capacity: int = 4
+    experts_cls: type = SwiGLUExperts
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, T, d = x.shape
+        E = self.num_experts
+        S = B * T
+        tokens = x.reshape(S, d)
+
+        # fp32 router (reference: gate runs in fp32, sharded_moe.py:183)
+        wg = self.param("wg", nn.initializers.lecun_normal(), (d, E),
+                        jnp.float32)
+        logits = tokens.astype(jnp.float32) @ wg
+
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        aux, combine, dispatch, _counts = top_k_gating(
+            logits, self.k, cf, self.min_capacity)
+
+        dispatched = jnp.einsum("sec,sd->ecd",
+                                dispatch.astype(x.dtype), tokens)
+
+        # place expert dim on the expert mesh axis -> GSPMD all-to-all
+        topo = self._topo()
+        if topo is not None and topo.expert_size > 1:
+            sh = NamedSharding(topo.mesh,
+                               PartitionSpec(EXPERT_AXIS, None, None))
+            dispatched = jax.lax.with_sharding_constraint(dispatched, sh)
+
+        expert_out = self.experts_cls(
+            self.num_experts, self.hidden_size, self.intermediate_size,
+            name="experts")(dispatched)
+
+        if topo is not None and topo.expert_size > 1:
+            expert_out = jax.lax.with_sharding_constraint(
+                expert_out, NamedSharding(
+                    topo.mesh, PartitionSpec(EXPERT_AXIS, None, None)))
+
+        out = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), expert_out)
+        return out.reshape(B, T, d), aux
+
+    @staticmethod
+    def _topo():
+        try:
+            return get_topology()
+        except Exception:
+            return None
+
+
+class MoEMLP(nn.Module):
+    """Drop-in ``mlp_cls`` for LlamaBlock: built from the model config
+    (expects ``num_experts``/``top_k``/``capacity_factor`` attrs, see
+    models/mixtral.py)."""
+    cfg: object
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        return MOELayer(
+            num_experts=cfg.num_experts,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            k=getattr(cfg, "top_k", 2),
+            capacity_factor=getattr(cfg, "capacity_factor", 1.25),
+            eval_capacity_factor=getattr(cfg, "eval_capacity_factor", 1.25),
+            min_capacity=getattr(cfg, "min_capacity", 4),
+            name="moe")(x, train)
+
+
+class MoE(nn.Module):
+    """API-parity wrapper (reference: ``deepspeed.moe.layer.MoE``) around
+    MOELayer for use outside the model zoo. Returns (output, aux_loss,
+    exp_counts-placeholder)."""
+    hidden_size: int
+    expert_intermediate_size: int
+    num_experts: int = 1
+    ep_size: int = 1   # informational; the mesh decides actual EP degree
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+
+    @nn.compact
+    def __call__(self, hidden_states, train: bool = True):
+        out, aux = MOELayer(
+            num_experts=self.num_experts,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.expert_intermediate_size,
+            k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            name="deepspeed_moe")(hidden_states, train)
+        return out, aux, None

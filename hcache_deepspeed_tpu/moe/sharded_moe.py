@@ -1,0 +1,96 @@
+"""Top-k gating with capacity + auxiliary load-balancing loss.
+
+Reference analog: ``deepspeed/moe/sharded_moe.py`` — ``top1gating`` (:290),
+``top2gating`` (:374), ``topkgating`` (:449), ``TopKGate`` (:183). The
+reference builds dispatch/combine tensors with einsum over one-hot masks and
+drops tokens beyond ``capacity = ceil(k * S / E * capacity_factor)``; that
+formulation is already XLA-native (static shapes, no host control flow) and
+is kept, minus the torch-specific tutel/jit paths.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def gate_load_balancing_loss(probs, expert_mask):
+    """Switch-style aux loss: E * sum_e mean_prob_e * token_frac_e.
+
+    probs: [S, E] softmax gate probabilities; expert_mask: [S, E] 0/1 of
+    primary-expert assignment (reference: ``l_aux`` in top1gating :317)."""
+    E = probs.shape[-1]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(expert_mask.astype(probs.dtype), axis=0)
+    return E * jnp.sum(me * ce)
+
+
+def top_k_gating(logits, k, capacity_factor=1.0, min_capacity=4):
+    """Compute dispatch/combine tensors for top-k routing.
+
+    logits: [S, E]. Returns (aux_loss, combine [S,E,C], dispatch [S,E,C]
+    bool, exp_counts [E]).
+
+    Capacity semantics follow the reference (:449 topkgating): each expert
+    accepts up to C = max(ceil(k*S/E * capacity_factor), min_capacity)
+    tokens; overflow tokens are dropped (their combine weight is 0) in
+    routing order.
+    """
+    import math
+    S, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # static capacity (shapes must be static under jit)
+    capacity = max(int(math.ceil(k * S / E * capacity_factor)), min_capacity)
+
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [S, k]
+
+    # positions within each expert's buffer, assigned in (choice, token)
+    # order so primary choices win buffer slots over secondary ones —
+    # the reference fills top-1 before top-2 the same way.
+    onehot = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)  # [S, k, E]
+    flat = onehot.transpose(1, 0, 2).reshape(k * S, E)  # choice-major
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # [k*S, E]
+    pos = pos_flat.reshape(k, S, E).transpose(1, 0, 2)  # [S, k, E]
+    within = (pos * onehot).sum(-1)  # [S, k] position in chosen expert
+    keep = within < capacity
+
+    exp_counts = flat.sum(0)
+
+    # aux loss uses the primary expert assignment
+    aux = gate_load_balancing_loss(probs, onehot[:, 0, :])
+
+    # normalise kept top-k probs (reference: top2 normalisation w/ eps)
+    w = topk_probs * keep.astype(topk_probs.dtype)
+    denom = jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
+    w = w / denom
+
+    # combine [S, E, C]: sum over k of w * onehot(expert) ⊗ onehot(position)
+    exp_oh = jax.nn.one_hot(topk_idx, E, dtype=w.dtype)       # [S,k,E]
+    posn_oh = jax.nn.one_hot(within, capacity, dtype=w.dtype)  # [S,k,C]
+    combine = jnp.einsum("ske,skc,sk->sec", exp_oh, posn_oh, w)
+    dispatch = combine > 0
+    return aux, combine, dispatch, exp_counts
+
+
+class TopKGate:
+    """Callable gate: params are the routing weight [d, E].
+
+    Reference: ``TopKGate`` (sharded_moe.py:183) — an nn.Linear in fp32 plus
+    the gating function; here the linear lives in the flax layer
+    (moe/layer.py) and this class holds the routing math/config.
+    """
+
+    def __init__(self, k=2, capacity_factor=1.0, eval_capacity_factor=1.0,
+                 min_capacity=4, drop_tokens=True):
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.drop_tokens = drop_tokens
+
+    def __call__(self, logits, train=True):
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if not self.drop_tokens:
+            # no-drop: capacity = S (every expert can take every token),
+            # i.e. cf = E/k since C = ceil(k*S/E * E/k) = S
+            cf = logits.shape[-1] / self.k
+        return top_k_gating(logits, self.k, cf, self.min_capacity)

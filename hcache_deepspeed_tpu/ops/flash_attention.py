@@ -298,13 +298,17 @@ def pallas_attention(q, k, v, causal=True, scale=None, block_q=128,
                      block_k=128, interpret=None):
     B, T, H, D = q.shape
     scale = scale or _default_scale(D)
+    if interpret is None:
+        from ..platform import get_platform
+        interpret = not get_platform().supports_pallas()
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     if T % block_q or T % block_k:
         return reference_attention(q, k, v, causal=causal, scale=scale)
-    if interpret is None:
-        from ..platform import get_platform
-        interpret = not get_platform().supports_pallas()
+    if not interpret and (block_q % 8 or block_k % 128):
+        # Mosaic tiling: the s=[block_q, block_k] tile needs a (8,128)-
+        # aligned layout on real hardware; unaligned shapes fall back
+        return reference_attention(q, k, v, causal=causal, scale=scale)
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
 
 

@@ -101,7 +101,10 @@ class ModelAdapter:
             sig = inspect.signature(type(model).__call__)
         except (TypeError, ValueError):
             return False
-        return "train" in sig.parameters
+        if "train" in sig.parameters:
+            return True
+        return any(p.kind is inspect.Parameter.VAR_KEYWORD
+                   for p in sig.parameters.values())
 
     def init_params(self, rng, example_batch):
         if self.module is None:

@@ -1,0 +1,11 @@
+"""Sequence parallelism (Ulysses) + long-context engines.
+
+Reference analog: ``deepspeed/sequence/`` — ``DistributedAttention``
+(layer.py:311), ``_SeqAllToAll`` (layer.py:257), sequence-parallel vocab
+cross-entropy (cross_entropy.py), and the FPDT chunked long-context engine
+(fpdt_layer.py).
+"""
+
+from .layer import (DistributedAttention, seq_all_to_all,  # noqa: F401
+                    ulysses_attention)
+from .cross_entropy import vocab_sequence_parallel_cross_entropy  # noqa: F401

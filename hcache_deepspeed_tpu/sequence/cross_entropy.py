@@ -1,0 +1,51 @@
+"""Sequence-parallel vocab cross-entropy.
+
+Reference analog: ``deepspeed/sequence/cross_entropy.py`` —
+``vocab_sequence_parallel_cross_entropy`` computes the softmax CE when
+logits are *vocab*-sharded across the sequence-parallel group: local max /
+local sum-exp are combined with allreduces so no rank materialises the full
+vocab. Explicit-collective form for shard_map code; under plain jit the
+engine's loss is already partitioner-sharded and needs no special handling.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.topology import SEQ_AXIS
+
+
+def vocab_sequence_parallel_cross_entropy(logits, labels,
+                                          axis_name=SEQ_AXIS,
+                                          vocab_start=None):
+    """CE over vocab-sharded logits inside shard_map.
+
+    logits: [B, T, V_local] — the vocab dim sharded over ``axis_name``.
+    labels: [B, T] global ids (-100 = ignore).
+    vocab_start: this shard's first vocab id (default rank * V_local).
+    """
+    V_local = logits.shape[-1]
+    idx = jax.lax.axis_index(axis_name)
+    if vocab_start is None:
+        vocab_start = idx * V_local
+    logits = logits.astype(jnp.float32)
+
+    # numerically stable log-softmax across shards
+    local_max = jnp.max(logits, axis=-1)
+    global_max = jax.lax.pmax(local_max, axis_name)
+    shifted = logits - global_max[..., None]
+    local_sumexp = jnp.sum(jnp.exp(shifted), axis=-1)
+    global_sumexp = jax.lax.psum(local_sumexp, axis_name)
+    log_z = jnp.log(global_sumexp)
+
+    valid = labels != -100
+    local_labels = jnp.where(valid, labels, 0) - vocab_start
+    in_shard = (local_labels >= 0) & (local_labels < V_local)
+    safe = jnp.clip(local_labels, 0, V_local - 1)
+    picked = jnp.take_along_axis(shifted, safe[..., None],
+                                 axis=-1).squeeze(-1)
+    picked = jnp.where(in_shard, picked, 0.0)
+    # each label lives in exactly one shard -> psum assembles the full term
+    picked = jax.lax.psum(picked, axis_name)
+
+    nll = jnp.where(valid, log_z - picked, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

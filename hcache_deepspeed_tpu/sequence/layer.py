@@ -1,0 +1,105 @@
+"""Ulysses sequence-parallel attention.
+
+Reference analog: ``deepspeed/sequence/layer.py`` — ``_SeqAllToAll`` (:257)
+scatters heads / gathers sequence before local attention and inverts after;
+``DistributedAttention`` (:311) wraps any local attention callable. The
+reference drives NCCL ``all_to_all_single`` by hand (plus a dual-stream
+overlap path, :347); on TPU both collective choice and overlap belong to
+XLA, so this module provides the same capability in two idiomatic forms:
+
+1. ``ulysses_attention`` — *sharding-constraint* form for code running under
+   ``jit`` over the global mesh (the engine's train step). Activations
+   arrive sequence-sharded ``[B, T/sp, H, D]``; a resharding constraint to
+   head-sharded ``[B, T, H/sp, D]`` makes GSPMD insert exactly the
+   head-scatter/seq-gather all-to-all on the ``seq`` axis, the local flash
+   kernel runs on full sequences with H/sp heads, and the output constraint
+   restores sequence sharding. XLA overlaps the all-to-alls with neighbouring
+   compute (the reference's ``sp_stream`` overlap, for free).
+
+2. ``seq_all_to_all`` / ``DistributedAttention`` — *explicit collective*
+   form (``lax.all_to_all``) for code already inside ``shard_map`` over the
+   ``seq`` axis (the pipeline engine's stages, custom kernels, tests).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.topology import SEQ_AXIS, get_topology
+
+
+def seq_all_to_all(x, axis_name=SEQ_AXIS, scatter_dim=2, gather_dim=1):
+    """Explicit all-to-all: split ``scatter_dim`` across the axis, gather
+    ``gather_dim``. Equivalent to the reference's ``_SeqAllToAll.forward``
+    (layer.py:257). Must run inside shard_map/pmap over ``axis_name``."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=scatter_dim,
+                              concat_axis=gather_dim, tiled=True)
+
+
+class DistributedAttention:
+    """Ulysses wrapper over a local attention callable.
+
+    Reference: ``DistributedAttention`` (sequence/layer.py:311) —
+    q/k/v arrive ``[B, T_local, H, D]`` (sequence-sharded); heads are
+    scattered / sequence gathered via all-to-all, ``local_attn`` runs on
+    ``[B, T, H_local, D]``, and the output is transformed back. Explicit
+    collective form: call inside ``shard_map`` over ``seq``.
+    """
+
+    def __init__(self, local_attn: Callable, axis_name: str = SEQ_AXIS,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attn
+        self.axis_name = axis_name
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, q, k, v, *args, **kwargs):
+        a2a = lambda x: seq_all_to_all(x, self.axis_name, self.scatter_idx,
+                                       self.gather_idx)
+        out = self.local_attn(a2a(q), a2a(k), a2a(v), *args, **kwargs)
+        # inverse: scatter sequence back, gather heads
+        return seq_all_to_all(out, self.axis_name,
+                              scatter_dim=self.gather_idx,
+                              gather_dim=self.scatter_idx)
+
+
+def ulysses_attention(q, k, v, causal=True, scale=None, topology=None,
+                      local_attn: Optional[Callable] = None):
+    """Sharding-constraint Ulysses for use under jit over the global mesh.
+
+    q/k/v: ``[B, T, H, D]`` logical arrays whose T dim is sharded on the
+    ``seq`` mesh axis (the engine's batch sharding). Internally resharded to
+    head-parallel for the local attention (GSPMD inserts the all-to-all
+    pair), then back.
+    """
+    topo = topology or get_topology()
+    if topo.seq_size <= 1:
+        from ..ops.flash_attention import attention as flash
+        return (local_attn or flash)(q, k, v, causal=causal, scale=scale)
+
+    mesh = topo.mesh
+    batch_axes = topo.batch_shard_axes() or None
+    heads = NamedSharding(mesh, PartitionSpec(batch_axes, None, SEQ_AXIS,
+                                              None))
+    seqs = NamedSharding(mesh, PartitionSpec(batch_axes, SEQ_AXIS, None,
+                                             None))
+
+    wsc = jax.lax.with_sharding_constraint
+    qh, kh, vh = (wsc(x, heads) for x in (q, k, v))
+    from ..ops.flash_attention import attention as flash
+    out = (local_attn or flash)(qh, kh, vh, causal=causal, scale=scale)
+    out = wsc(out, heads)
+    return wsc(out, seqs)
+
+
+def make_ulysses_attention_fn(topology=None, local_attn=None):
+    """Returns an ``attention_fn`` pluggable into the model zoo's attention
+    modules (e.g. ``LlamaAttention(attention_fn=...)``)."""
+
+    def attention_fn(q, k, v, causal=True, scale=None):
+        return ulysses_attention(q, k, v, causal=causal, scale=scale,
+                                 topology=topology, local_attn=local_attn)
+
+    return attention_fn

@@ -21,6 +21,12 @@ os.environ.setdefault("HDS_LOG_LEVEL", "warning")
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# jax may have been preloaded at interpreter startup (before this conftest
+# ran), in which case the env vars above were read too late; force the
+# platform through the live config instead. Backends are still lazy at
+# collection time, so this takes effect.
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(autouse=True)
 def _reset_singletons():
