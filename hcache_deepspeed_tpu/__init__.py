@@ -50,17 +50,22 @@ def initialize(args=None,
     cfg = load_config(config if config is not None else config_params)
     comm.init_distributed()
 
-    engine = HDSEngine(model,
-                       cfg,
-                       init_params=init_params,
-                       example_batch=example_batch,
-                       loss_fn=loss_fn,
-                       optimizer=optimizer,
-                       lr_scheduler=lr_scheduler,
-                       topology=topology,
-                       tp_spec_fn=tp_spec_fn,
-                       batch_spec_fn=batch_spec_fn,
-                       training_data=training_data)
+    from .runtime.pipe.module import PipelineModule
+    engine_cls = HDSEngine
+    if isinstance(model, PipelineModule):
+        from .runtime.pipe.engine import PipelineEngine
+        engine_cls = PipelineEngine
+    engine = engine_cls(model,
+                        cfg,
+                        init_params=init_params,
+                        example_batch=example_batch,
+                        loss_fn=loss_fn,
+                        optimizer=optimizer,
+                        lr_scheduler=lr_scheduler,
+                        topology=topology,
+                        tp_spec_fn=tp_spec_fn,
+                        batch_spec_fn=batch_spec_fn,
+                        training_data=training_data)
     return engine, engine.optimizer_def, engine.training_dataloader, \
         engine.lr_scheduler
 

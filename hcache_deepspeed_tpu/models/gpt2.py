@@ -8,7 +8,7 @@ changes. The causal-LM loss is computed in fp32.
 """
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -134,9 +134,13 @@ class GPT2LMHeadModel(nn.Module):
 
         labels = batch.get("labels")
         if labels is None:
-            labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)),
-                             constant_values=-100)
+            labels = default_lm_labels(ids)
         return causal_lm_loss(logits, labels)
+
+
+def default_lm_labels(ids):
+    """Next-token labels from input ids: shift left, ignore final position."""
+    return jnp.pad(ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
 
 
 def causal_lm_loss(logits, labels):
@@ -166,3 +170,86 @@ def gpt2_tp_spec_fn(path, leaf):
     if "c_proj" in joined:
         return PartitionSpec(TENSOR_AXIS, None)  # row parallel
     return PartitionSpec()
+
+
+# ------------------------------------------------------------------ #
+# Pipeline-parallel layer factory (reference: PipelineModule usage —
+# deepspeed/runtime/pipe/module.py:86; GPT2 layer decomposition follows
+# the Megatron-on-DeepSpeed examples' GPT2ModelPipe)
+# ------------------------------------------------------------------ #
+class TiedEmbed(nn.Module):
+    """One embedding table usable as input embed ('embed') or tied LM head
+    ('attend'); both modes share identical param structure so a
+    ``TiedLayerSpec`` slot can serve first and last pipeline layers
+    (reference: tied-weight sync, pipe/engine.py:275)."""
+    vocab_size: int
+    features: int
+    dtype: Any = jnp.float32
+    mode: str = "embed"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        emb = nn.Embed(self.vocab_size, self.features, dtype=self.dtype,
+                       name="weight")
+        if self.mode == "embed":
+            ids = x["input_ids"] if isinstance(x, dict) else x
+            return emb(ids)
+        return emb.attend(x)
+
+
+class GPT2PosEmbed(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        T = x.shape[1]
+        wpe = nn.Embed(self.cfg.n_positions, self.cfg.n_embd,
+                       dtype=self.cfg.compute_dtype, name="wpe")
+        x = x + wpe(jnp.arange(T)[None, :])
+        if train and self.cfg.dropout > 0:
+            x = nn.Dropout(self.cfg.dropout, deterministic=False)(x)
+        return x
+
+
+class GPT2PipeBlock(nn.Module):
+    """Block with the pipeline body contract ``(x, train) -> x``."""
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return Block(self.cfg, name="block")(x, None, train)
+
+
+class GPT2FinalNorm(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.LayerNorm(epsilon=self.cfg.layer_norm_epsilon,
+                            dtype=self.cfg.compute_dtype, name="ln_f")(x)
+
+
+def lm_loss_fn(logits, batch):
+    """Pipeline loss head: labels from the batch (shifted ids fallback)."""
+    ids = batch["input_ids"]
+    labels = batch.get("labels")
+    if labels is None:
+        labels = default_lm_labels(ids)
+    return causal_lm_loss(logits, labels)
+
+
+def gpt2_pipeline_layers(cfg: GPT2Config):
+    """(layers, loss_fn) for ``PipelineModule``: tied embed/head, positional
+    embed, n_layer homogeneous blocks, final norm."""
+    from ..runtime.pipe.module import LayerSpec, TiedLayerSpec
+    dtype = cfg.compute_dtype
+    layers = [
+        TiedLayerSpec("wte", TiedEmbed, cfg.vocab_size, cfg.n_embd,
+                      dtype=dtype, mode="embed"),
+        LayerSpec(GPT2PosEmbed, cfg),
+        *[LayerSpec(GPT2PipeBlock, cfg) for _ in range(cfg.n_layer)],
+        LayerSpec(GPT2FinalNorm, cfg),
+        TiedLayerSpec("wte", TiedEmbed, cfg.vocab_size, cfg.n_embd,
+                      dtype=dtype, mode="attend"),
+    ]
+    return layers, lm_loss_fn

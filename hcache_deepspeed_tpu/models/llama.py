@@ -32,7 +32,7 @@ from ..ops.flash_attention import attention as flash_attention
 from ..ops.rms_norm import rms_norm
 from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.topology import TENSOR_AXIS
-from .gpt2 import causal_lm_loss
+from .gpt2 import causal_lm_loss, default_lm_labels
 
 
 @dataclass(frozen=True)
@@ -218,8 +218,7 @@ class LlamaForCausalLM(nn.Module):
 
         labels = batch.get("labels")
         if labels is None:
-            labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)),
-                             constant_values=-100)
+            labels = default_lm_labels(ids)
         loss = causal_lm_loss(logits, labels)
         aux_coef = getattr(cfg, "moe_aux_loss_coef", 0.0)
         if aux_coef:
@@ -238,8 +237,10 @@ def llama_tp_spec_fn(path, leaf):
     if "embed_tokens" in joined or "lm_head" in joined:
         return PartitionSpec(None, TENSOR_AXIS)
     if any(n in joined for n in ("q_proj", "k_proj", "v_proj",
-                                 "gate_proj", "up_proj", "w1", "w3")):
+                                 "gate_proj", "up_proj")):
         return PartitionSpec(None, TENSOR_AXIS)  # column parallel
-    if any(n in joined for n in ("o_proj", "down_proj", "w2")):
+    if any(n in joined for n in ("o_proj", "down_proj")):
         return PartitionSpec(TENSOR_AXIS, None)  # row parallel
+    # stacked MoE expert tensors (w1/w2/w3, [E, ...]) belong to
+    # mixtral_tp_spec_fn, which handles the expert leading dim
     return PartitionSpec()

@@ -1,0 +1,6 @@
+"""Pipeline parallelism (reference: ``deepspeed/runtime/pipe/``)."""
+
+from .engine import PipelineEngine  # noqa: F401
+from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .schedule import (InferenceSchedule, TrainSchedule,  # noqa: F401
+                       bubble_fraction, peak_in_flight)

@@ -1,0 +1,75 @@
+"""Pipeline training engine.
+
+Reference analog: ``deepspeed/runtime/pipe/engine.py`` —
+``PipelineEngine(DeepSpeedEngine)`` whose ``train_batch`` (:338) consumes
+gradient-accumulation-many microbatches in one pipelined optimizer step via
+``_exec_schedule`` (:1409).
+
+Here the schedule executor is compiled into the model itself
+(``PipelineModule._pipelined_body``), so this engine only re-routes the
+batch plumbing: the whole global batch enters one fused step and the
+microbatch loop happens *inside* the differentiable pipeline, not in the
+engine's gradient-accumulation scan.
+"""
+
+from typing import Optional
+
+from ...parallel.topology import MeshTopology
+from ...utils.logging import log_dist
+from ..config import HDSConfig
+from ..engine import HDSEngine
+from .module import PipelineModule
+
+
+class PipelineEngine(HDSEngine):
+    """Engine for ``PipelineModule`` models.
+
+    ``config.gradient_accumulation_steps`` (or ``pipeline.micro_batches``)
+    becomes the pipeline microbatch count; the engine itself runs gas=1 —
+    one fused XLA dispatch per optimizer step, exactly the reference's
+    "one train_batch() = one schedule execution" contract.
+    """
+
+    def __init__(self, module: PipelineModule, config: HDSConfig, **kw):
+        if not isinstance(module, PipelineModule):
+            raise TypeError("PipelineEngine requires a PipelineModule")
+        topology: Optional[MeshTopology] = kw.get("topology") \
+            or module.topology
+
+        config.resolve_batch_sizes(topology.dp_world_size())
+        n_micro = config.pipeline.micro_batches or \
+            config.gradient_accumulation_steps
+        module.n_microbatches = n_micro
+        self._pipe_micro_batches = n_micro
+
+        # fold microbatching into the model: engine-level gas = 1, the
+        # per-step batch is micro * n_micro
+        config = config.model_copy(deep=True)
+        config.gradient_accumulation_steps = 1
+        config.train_micro_batch_size_per_gpu = (
+            config.train_micro_batch_size_per_gpu * n_micro)
+        config.train_batch_size = (
+            config.train_micro_batch_size_per_gpu *
+            topology.dp_world_size())
+
+        kw["topology"] = topology
+        # stacked-blocks pipe sharding composed with any user TP rules
+        kw["tp_spec_fn"] = module.tp_spec_fn(kw.get("tp_spec_fn"))
+        if kw.get("init_params") is None and "example_batch" in kw:
+            import jax
+            kw["init_params"] = module.init_params(
+                jax.random.PRNGKey(config.seed), kw["example_batch"])
+
+        super().__init__(module, config, **kw)
+        self.is_pipe_parallel = True
+        log_dist(
+            f"PipelineEngine: stages={module.num_stages}, "
+            f"micro_batches={n_micro}", ranks=[0])
+
+    @property
+    def micro_batches(self):
+        return self._pipe_micro_batches
+
+    def train_batch(self, data_iter=None, batch=None):
+        """One pipelined optimizer step (reference: pipe/engine.py:338)."""
+        return super().train_batch(data_iter=data_iter, batch=batch)
