@@ -1,0 +1,8 @@
+"""Inference stack (reference: ``deepspeed/inference/v2/``) — ragged
+batching over a paged KV cache + the fork's HCache restore path."""
+
+from .config import (HCacheConfig, KVCacheConfig,  # noqa: F401
+                     RaggedInferenceEngineConfig, StateManagerConfig)
+from .engine_v2 import InferenceEngineV2  # noqa: F401
+from .factory import build_engine, build_hf_engine  # noqa: F401
+from .scheduling import SchedulingError, SchedulingResult  # noqa: F401

@@ -1,0 +1,47 @@
+"""Inference-v2 engine configuration.
+
+Reference analogs: ``deepspeed/inference/v2/config_v2.py``
+(``RaggedInferenceEngineConfig``) and
+``deepspeed/inference/v2/ragged/manager_configs.py``
+(``DSStateManagerConfig``: max_tracked_sequences, max_ragged_batch_size,
+max_ragged_sequence_count, memory_config). Same knob names where they still
+mean something on TPU.
+"""
+
+from typing import Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import HDSConfigModel
+
+
+class KVCacheConfig(HDSConfigModel):
+    """Reference: ``AllocationMode``/``KVCacheConfig`` in manager_configs —
+     'reserve' (fraction of free HBM) or explicit block count."""
+    block_size: int = 64              # tokens per KV block (ref: KV_BLOCK)
+    num_blocks: Optional[int] = None  # explicit pool size
+    memory_fraction: float = 0.8      # used when num_blocks is None (TPU:
+    #                                   sized from platform free-memory)
+    cache_dtype: str = "bfloat16"
+
+
+class StateManagerConfig(HDSConfigModel):
+    max_tracked_sequences: int = 2048
+    max_ragged_batch_size: int = 768      # max total tokens per forward
+    max_ragged_sequence_count: int = 512  # max sequences per forward
+    max_context: int = 8192               # max tokens of any one sequence
+
+
+class HCacheConfig(HDSConfigModel):
+    """The fork delta: latent capture + restore_kv (no reference config —
+    the fork hard-enables it; here it is a switch)."""
+    enable_latents: bool = True
+
+
+class RaggedInferenceEngineConfig(HDSConfigModel):
+    state_manager: StateManagerConfig = Field(
+        default_factory=StateManagerConfig)
+    kv_cache: KVCacheConfig = Field(default_factory=KVCacheConfig)
+    hcache: HCacheConfig = Field(default_factory=HCacheConfig)
+    # tensor_parallel degree for sharding the KV-head dim over the mesh
+    tensor_parallel: int = 1

@@ -1,0 +1,74 @@
+"""Engine factory.
+
+Reference analog: ``deepspeed/inference/v2/engine_factory.py:69
+build_hf_engine`` — maps a model family name/config to its policy (llama,
+mistral, mixtral, opt, falcon, phi, qwen...). Here the family table maps to
+our training-model configs whose param trees the paged inference model
+consumes directly.
+"""
+
+from typing import Any, Dict, Optional
+
+from ..models.llama import LlamaConfig
+from .config import RaggedInferenceEngineConfig
+from .engine_v2 import InferenceEngineV2
+
+
+def _llama_like(hf: Dict[str, Any]) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf.get("vocab_size", 32000),
+        hidden_size=hf.get("hidden_size", 4096),
+        intermediate_size=hf.get("intermediate_size", 11008),
+        n_layer=hf.get("num_hidden_layers", 32),
+        n_head=hf.get("num_attention_heads", 32),
+        n_kv_head=hf.get("num_key_value_heads",
+                         hf.get("num_attention_heads", 32)),
+        max_positions=hf.get("max_position_embeddings", 4096),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=hf.get("torch_dtype", "bfloat16"),
+    )
+
+
+#: model_type -> config adapter (reference: the policy map in
+#: engine_factory.py — llama/mistral/qwen2 share the llama block layout)
+MODEL_FAMILIES = {
+    "llama": _llama_like,
+    "mistral": _llama_like,
+    "qwen2": _llama_like,
+}
+
+
+def build_engine(model=None, config=None, *, model_config=None, params=None,
+                 engine_config: Optional[RaggedInferenceEngineConfig] = None,
+                 **kw) -> InferenceEngineV2:
+    """``hcache_deepspeed_tpu.init_inference`` backend. Accepts either a
+    ready ``(model_config, params)`` pair or an HF-style config dict via
+    ``model``."""
+    if engine_config is None and isinstance(config, dict):
+        engine_config = RaggedInferenceEngineConfig(**config)
+    if model_config is None:
+        if isinstance(model, LlamaConfig):
+            model_config = model
+        elif isinstance(model, dict):
+            family = model.get("model_type", "llama")
+            if family not in MODEL_FAMILIES:
+                raise ValueError(
+                    f"unsupported model family {family!r}; known: "
+                    f"{sorted(MODEL_FAMILIES)}")
+            model_config = MODEL_FAMILIES[family](model)
+        else:
+            raise TypeError("build_engine needs model_config+params, a "
+                            "LlamaConfig, or an HF config dict")
+    if params is None:
+        raise ValueError("build_engine requires params (a trained "
+                         "LlamaForCausalLM param tree)")
+    return InferenceEngineV2(model_config, params,
+                             config=engine_config)
+
+
+def build_hf_engine(hf_config: Dict[str, Any], params,
+                    engine_config=None) -> InferenceEngineV2:
+    return build_engine(model=hf_config, params=params,
+                        engine_config=engine_config)

@@ -1,0 +1,251 @@
+"""Paged-KV inference model for the Llama/GPT family.
+
+Reference analogs:
+* ``deepspeed/inference/v2/model_implementations/llama_v2/model.py`` —
+  per-layer forward producing logits **and latents** (:203-220, the HCache
+  fork delta) and ``restore_kv`` (:222-252),
+* ``deepspeed/inference/v2/modules/implementations/attention/
+  dense_blocked_attention.py`` — blocked flash attention + the
+  cache-write-only ``restore_kv`` hook (:182),
+* the ragged kernel set (``kernels/ragged_ops/``): here each of
+  atom-builder/blocked-flash/kv-rotary collapses into a single jitted
+  gather/scatter + attention program.
+
+TPU-native design
+-----------------
+One compiled function family, bucketed on static shapes:
+
+``forward_chunk(params, cache, tokens[B,T], start[B], tables[B,NB], len[B])``
+    processes T new tokens for each of B sequences against the paged cache
+    (T=1 ⇒ ragged decode batch; B=1, T=bucket ⇒ prefill, including chunked
+    continuation since ``start`` offsets positions). Writes KV via one flat
+    scatter (invalid lanes dropped), reads via one flat gather per layer,
+    layers run under ``lax.scan`` over stacked params with the cache
+    threaded as scan xs/ys so XLA updates it in place (donated).
+
+``restore_layer(layer_params, latents[B,T,H], ...)``
+    the HCache delta: replay ONLY the K/V projection + RoPE + cache write
+    from saved latents — one layer per dispatch so the engine can overlap
+    host→HBM latent copies with compute (the reference's dual-stream
+    io_stream/compute pattern, engine-side).
+
+Latents = post-input_layernorm hidden states (the exact tensor the
+reference snapshots at llama_v2/model.py:211).
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig
+from ..ops.rms_norm import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+
+
+def stack_layer_params(params: Dict[str, Any], n_layers: int,
+                       prefix: str = "layers_"):
+    """[per-layer dicts] -> one pytree with leading layer dim (scan xs)."""
+    layers = [params[f"{prefix}{i}"] for i in range(n_layers)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+class PagedInferenceModel:
+    """Functional paged-attention transformer consuming *training* params
+    from ``models.llama.LlamaForCausalLM`` (same names/shapes — a trained
+    checkpoint drops in directly, the analog of the reference's checkpoint
+    loading into inference containers)."""
+
+    def __init__(self, cfg: LlamaConfig, params, *, block_size: int,
+                 max_blocks_per_seq: int, capture_latents: bool = True):
+        self.cfg = cfg
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.capture_latents = capture_latents
+        self.n_layers = cfg.n_layer
+
+        self.embed = params["embed_tokens"]["embedding"]
+        self.norm_w = params["norm"]["weight"]
+        if cfg.tie_word_embeddings:
+            self.lm_head = self.embed.T
+        else:
+            self.lm_head = params["lm_head"]["kernel"]
+        self.layer_params = stack_layer_params(params, cfg.n_layer)
+        self.cos, self.sin = rope_frequencies(cfg.head_dim,
+                                              cfg.max_positions,
+                                              cfg.rope_theta)
+        self._fwd = jax.jit(self._forward_chunk, donate_argnums=(0, 1))
+        self._restore = jax.jit(self._restore_layer, donate_argnums=(0, 1))
+
+    # -------------------------------------------------------------- #
+    # Layer math (mirrors models/llama.py LlamaBlock exactly)
+    # -------------------------------------------------------------- #
+    def _qkv(self, lp, h, positions):
+        """h: [B, T, H]; returns q [B,T,Hq,D], k/v [B,T,KV,D] (roped)."""
+        cfg = self.cfg
+        B, T, _ = h.shape
+        q = (h @ lp["self_attn"]["q_proj"]["kernel"]).reshape(
+            B, T, cfg.n_head, cfg.head_dim)
+        k = (h @ lp["self_attn"]["k_proj"]["kernel"]).reshape(
+            B, T, cfg.n_kv_head, cfg.head_dim)
+        v = (h @ lp["self_attn"]["v_proj"]["kernel"]).reshape(
+            B, T, cfg.n_kv_head, cfg.head_dim)
+        q = apply_rope(q, self.cos, self.sin, positions)
+        k = apply_rope(k, self.cos, self.sin, positions)
+        return q, k, v
+
+    def _scatter_kv(self, ck, cv, k, v, flat_idx):
+        """ck/cv: [P, KV, D]; k/v: [B, T, KV, D]; flat_idx: [B, T] (OOB ⇒
+        dropped — padded lanes use an index past the pool end)."""
+        kv_shape = (-1,) + k.shape[2:]
+        ck = ck.at[flat_idx.reshape(-1)].set(
+            k.reshape(kv_shape).astype(ck.dtype), mode="drop")
+        cv = cv.at[flat_idx.reshape(-1)].set(
+            v.reshape(kv_shape).astype(cv.dtype), mode="drop")
+        return ck, cv
+
+    def _paged_attention(self, q, ck, cv, tables, q_positions, kv_len):
+        """q: [B, T, Hq, D]; ck/cv: [P, KV, D]; tables: [B, NB];
+        q_positions: [B, T] absolute; kv_len: [B] valid cache length.
+        Returns [B, T, Hq*D]."""
+        cfg = self.cfg
+        B, T, Hq, D = q.shape
+        BS = self.block_size
+        NB = tables.shape[1]
+        S = NB * BS
+        # flat gather indices for every cache position of each sequence
+        pos = jnp.arange(S)
+        gather = tables[:, pos // BS] * BS + pos % BS          # [B, S]
+        k_seq = ck[gather]                                     # [B,S,KV,D]
+        v_seq = cv[gather]
+        if cfg.n_kv_head < Hq:
+            rep = Hq // cfg.n_kv_head
+            k_seq = jnp.repeat(k_seq, rep, axis=2)
+            v_seq = jnp.repeat(v_seq, rep, axis=2)
+        scale = 1.0 / np.sqrt(D)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k_seq) * scale
+        # causal over absolute positions + cache-length bound
+        valid = (pos[None, None, :] <= q_positions[:, :, None]) & \
+                (pos[None, None, :] < kv_len[:, None, None])
+        scores = jnp.where(valid[:, None], scores.astype(jnp.float32),
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v_seq)
+        return out.reshape(B, T, Hq * D)
+
+    def _layer_step(self, x, lp, ck, cv, tables, positions, flat_idx,
+                    kv_len):
+        cfg = self.cfg
+        # fp32 norm weights promote under standard dtype rules — pin the
+        # residual stream to the compute dtype
+        h = rms_norm(x, lp["input_layernorm"]["weight"],
+                     eps=cfg.rms_norm_eps).astype(cfg.compute_dtype)
+        latent = h if self.capture_latents else jnp.zeros(
+            (x.shape[0], x.shape[1], 0), h.dtype)
+        q, k, v = self._qkv(lp, h, positions)
+        ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
+        attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
+        x = x + attn @ lp["self_attn"]["o_proj"]["kernel"]
+        h2 = rms_norm(x, lp["post_attention_layernorm"]["weight"],
+                      eps=cfg.rms_norm_eps).astype(cfg.compute_dtype)
+        gate = h2 @ lp["mlp"]["gate_proj"]["kernel"]
+        up = h2 @ lp["mlp"]["up_proj"]["kernel"]
+        x = x + (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
+        return x.astype(cfg.compute_dtype), ck, cv, latent
+
+    # -------------------------------------------------------------- #
+    # forward_chunk: the one compiled family (prefill & ragged decode)
+    # -------------------------------------------------------------- #
+    def _forward_chunk(self, cache_k, cache_v, tokens, start,
+                       tables, t_len):
+        """tokens: [B, T] int32; start: [B] first absolute position;
+        tables: [B, NB]; t_len: [B] valid new tokens (≤ T).
+        Returns (cache_k', cache_v', logits [B, V], latents [L, B, T, H])."""
+        B, T = tokens.shape
+        BS = self.block_size
+        P = cache_k.shape[1]
+        x = self.embed[tokens].astype(self.cfg.compute_dtype)
+
+        offs = jnp.arange(T)
+        positions = start[:, None] + offs[None, :]              # [B, T]
+        token_valid = offs[None, :] < t_len[:, None]
+        local_blk = positions // BS                             # in-table idx
+        flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
+            positions % BS
+        flat_idx = jnp.where(token_valid, flat_idx, P)          # drop pads
+        kv_len = start + t_len
+
+        def step(x, xs):
+            lp, ck, cv = xs
+            x, ck, cv, latent = self._layer_step(
+                x, lp, ck, cv, tables, positions, flat_idx, kv_len)
+            return x, (ck, cv, latent)
+
+        x, (cache_k, cache_v, latents) = jax.lax.scan(
+            step, x, (self.layer_params, cache_k, cache_v))
+
+        x = rms_norm(x, self.norm_w, eps=self.cfg.rms_norm_eps)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(t_len - 1, 0)[:, None, None], axis=1)[:, 0]
+        logits = (last @ self.lm_head).astype(jnp.float32)
+        return cache_k, cache_v, logits, latents
+
+    def forward_chunk(self, cache, tokens, start, tables, t_len):
+        ck, cv, logits, latents = self._fwd(
+            cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
+            jnp.asarray(t_len, jnp.int32))
+        cache.replace(ck, cv)
+        return logits, latents
+
+    # -------------------------------------------------------------- #
+    # HCache restore (the fork's flagship delta)
+    # -------------------------------------------------------------- #
+    def _restore_layer(self, cache_k, cache_v, layer, latent, start,
+                       tables, t_len):
+        """Replay K/V projection + RoPE + blocked cache write for ONE layer
+        from saved latents (reference: llama_v2/model.py:222-252 +
+        dense_blocked_attention.py:182 — QKV GEMM + kv-rotary cache write,
+        no attention, no MLP). The full cache is donated, so each dispatch
+        updates layer ``layer`` in place; the layer's weights are sliced
+        from the stacked tree *inside* the compiled program (no per-call
+        host-side slicing)."""
+        lp = jax.tree.map(lambda p: p[layer], self.layer_params)
+        B, T, _ = latent.shape
+        BS = self.block_size
+        P = cache_k.shape[1]
+        offs = jnp.arange(T)
+        positions = start[:, None] + offs[None, :]
+        token_valid = offs[None, :] < t_len[:, None]
+        local_blk = positions // BS
+        flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
+            positions % BS
+        flat_idx = jnp.where(token_valid, flat_idx, P).reshape(-1)
+        _, k, v = self._qkv(lp, latent.astype(self.cfg.compute_dtype),
+                            positions)
+        kv_shape = (-1,) + k.shape[2:]
+        cache_k = cache_k.at[layer, flat_idx].set(
+            k.reshape(kv_shape).astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[layer, flat_idx].set(
+            v.reshape(kv_shape).astype(cache_v.dtype), mode="drop")
+        return cache_k, cache_v
+
+    def restore_kv(self, cache, latents, start, tables, t_len):
+        """latents: host array [L, B, T, H] (numpy). Per-layer dispatch with
+        the next layer's host→HBM copy issued before this layer's compute —
+        JAX's async dispatch gives the reference's dual-stream overlap
+        (io_stream copy / compute wait-event chain, llama_v2/model.py:229)."""
+        start = jnp.asarray(start, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        t_len = jnp.asarray(t_len, jnp.int32)
+        ck, cv = cache.k, cache.v
+        dev = list(ck.devices())[0]
+        buf = jax.device_put(np.asarray(latents[0]), dev)  # layer-0 H2D
+        for l in range(self.n_layers):
+            cur = buf
+            if l + 1 < self.n_layers:  # double buffer: prefetch next layer
+                buf = jax.device_put(np.asarray(latents[l + 1]), dev)
+            ck, cv = self._restore(ck, cv, jnp.int32(l), cur, start,
+                                   tables, t_len)
+        cache.replace(ck, cv)

@@ -1,0 +1,5 @@
+"""Ragged batching state (reference: ``deepspeed/inference/v2/ragged/``)."""
+
+from .blocked_allocator import BlockedAllocator  # noqa: F401
+from .kv_cache import BlockedKVCache, StateManager  # noqa: F401
+from .sequence import SequenceDescriptor  # noqa: F401

@@ -1,0 +1,47 @@
+"""KV-cache block allocator.
+
+Reference analog: ``deepspeed/inference/v2/ragged/blocked_allocator.py:11
+BlockedAllocator`` — a free-list allocator handing out fixed-size KV cache
+block ids (there via an int32 linked-list tensor; here a plain Python
+free list, since on TPU the block ids live host-side and only the gather
+indices built from them reach the device).
+"""
+
+from typing import Iterable, List
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> List[int]:
+        if num_blocks < 1:
+            raise ValueError(f"invalid allocation size {num_blocks}")
+        if num_blocks > len(self._free):
+            raise ValueError(
+                f"cannot allocate {num_blocks} blocks, only "
+                f"{len(self._free)} free")
+        out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        return out
+
+    def free(self, blocks: Iterable[int]) -> None:
+        blocks = list(blocks)
+        live = set(self._free)
+        for b in blocks:
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"invalid block id {b}")
+            if b in live:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)

@@ -190,7 +190,8 @@ class LlamaForCausalLM(nn.Module):
     mlp_cls: Any = None
 
     @nn.compact
-    def __call__(self, batch, train: bool = False):
+    def __call__(self, batch, train: bool = False,
+                 return_logits: bool = False):
         cfg = self.cfg
         ids = batch["input_ids"]
         B, T = ids.shape
@@ -216,6 +217,8 @@ class LlamaForCausalLM(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=dtype,
                               name="lm_head")(x)
 
+        if return_logits:
+            return logits
         labels = batch.get("labels")
         if labels is None:
             labels = default_lm_labels(ids)

@@ -9,7 +9,9 @@ Here the schedule executor is compiled into the model itself
 (``PipelineModule._pipelined_body``), so this engine only re-routes the
 batch plumbing: the whole global batch enters one fused step and the
 microbatch loop happens *inside* the differentiable pipeline, not in the
-engine's gradient-accumulation scan.
+engine's gradient-accumulation scan. ``train_batch`` itself is inherited
+unchanged — one call = one pipelined optimizer step, the reference's
+``pipe/engine.py:338`` contract.
 """
 
 from typing import Optional
@@ -39,6 +41,15 @@ class PipelineEngine(HDSEngine):
         config.resolve_batch_sizes(topology.dp_world_size())
         n_micro = config.pipeline.micro_batches or \
             config.gradient_accumulation_steps
+        if config.pipeline.micro_batches and \
+                config.gradient_accumulation_steps > 1 and \
+                config.pipeline.micro_batches != \
+                config.gradient_accumulation_steps:
+            raise ValueError(
+                f"pipeline.micro_batches={config.pipeline.micro_batches} "
+                f"conflicts with gradient_accumulation_steps="
+                f"{config.gradient_accumulation_steps}; the pipeline "
+                f"microbatch count IS the accumulation count")
         module.n_microbatches = n_micro
         self._pipe_micro_batches = n_micro
 
@@ -69,7 +80,3 @@ class PipelineEngine(HDSEngine):
     @property
     def micro_batches(self):
         return self._pipe_micro_batches
-
-    def train_batch(self, data_iter=None, batch=None):
-        """One pipelined optimizer step (reference: pipe/engine.py:338)."""
-        return super().train_batch(data_iter=data_iter, batch=batch)

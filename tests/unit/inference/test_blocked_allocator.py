@@ -1,0 +1,47 @@
+"""Reference analog: ``tests/unit/inference/v2/ragged/test_blocked_allocator.py``."""
+
+import pytest
+
+from hcache_deepspeed_tpu.inference.ragged import BlockedAllocator
+
+
+class TestBlockedAllocator:
+
+    def test_allocate_and_free(self):
+        alloc = BlockedAllocator(16)
+        assert alloc.free_blocks == 16
+        a = alloc.allocate(4)
+        assert len(a) == 4 and len(set(a)) == 4
+        assert alloc.free_blocks == 12
+        b = alloc.allocate(12)
+        assert alloc.free_blocks == 0
+        assert not set(a) & set(b)
+        alloc.free(a)
+        assert alloc.free_blocks == 4
+        c = alloc.allocate(4)
+        assert sorted(c) == sorted(a)
+
+    @pytest.mark.parametrize("bad", [0, -1])
+    def test_bad_sizes(self, bad):
+        alloc = BlockedAllocator(4)
+        with pytest.raises(ValueError):
+            alloc.allocate(bad)
+        with pytest.raises(ValueError):
+            BlockedAllocator(bad)
+
+    def test_overallocate(self):
+        alloc = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="only 4 free"):
+            alloc.allocate(5)
+
+    def test_double_free(self):
+        alloc = BlockedAllocator(4)
+        blocks = alloc.allocate(2)
+        alloc.free(blocks)
+        with pytest.raises(ValueError, match="double free"):
+            alloc.free(blocks)
+
+    def test_invalid_free(self):
+        alloc = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="invalid block"):
+            alloc.free([7])

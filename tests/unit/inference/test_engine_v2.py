@@ -1,0 +1,253 @@
+"""Inference v2 engine tests.
+
+Reference analog: ``tests/unit/inference/v2/`` (module/kernel/e2e tests).
+The reference has NO tests for the fork's ``restore_kv`` (SURVEY.md §4) —
+the restore tests here are new coverage.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig,
+                                            SchedulingError,
+                                            SchedulingResult, build_hf_engine)
+from hcache_deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                               llama_tiny)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama_tiny(max_positions=128, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    return cfg, model, params
+
+
+def make_engine(cfg, params, **over):
+    kw = dict(state_manager={"max_tracked_sequences": 8,
+                             "max_ragged_batch_size": 128,
+                             "max_ragged_sequence_count": 4,
+                             "max_context": 128},
+              kv_cache={"block_size": 16, "num_blocks": 24,
+                        "cache_dtype": "float32"})
+    kw.update(over)
+    return InferenceEngineV2(cfg, params,
+                             config=RaggedInferenceEngineConfig(**kw))
+
+
+def full_logits(model, params, tokens):
+    """Reference: full-context forward through the *training* model."""
+    out = model.apply({"params": params},
+                      {"input_ids": np.asarray(tokens, np.int32)[None]},
+                      train=False, return_logits=True)
+    return np.asarray(out)[0]
+
+
+class TestPrefillDecode:
+
+    def test_prefill_matches_full_forward(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, (13,))
+        logits, latents = engine.put([7], [tokens])
+        ref = full_logits(model, params, tokens)
+        np.testing.assert_allclose(logits[0], ref[-1], atol=2e-2)
+        # latents: [L, T, H] per sequence
+        assert latents[0].shape == (cfg.n_layer, 13, cfg.hidden_size)
+
+    def test_incremental_decode_matches_full_forward(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(1)
+        tokens = list(rng.integers(0, cfg.vocab_size, (9,)))
+        engine.put([1], [tokens])
+        for step in range(5):
+            nxt = int(rng.integers(0, cfg.vocab_size))
+            tokens.append(nxt)
+            logits, _ = engine.put([1], [[nxt]])
+            ref = full_logits(model, params, tokens)
+            np.testing.assert_allclose(logits[0], ref[-1], atol=2e-2)
+
+    def test_ragged_batch_mixed(self, tiny_model):
+        """Two decoding sequences + one fresh prefill in one put()."""
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(2)
+        s1 = list(rng.integers(0, cfg.vocab_size, (7,)))
+        s2 = list(rng.integers(0, cfg.vocab_size, (12,)))
+        engine.put([1, 2], [s1, s2])
+        s3 = list(rng.integers(0, cfg.vocab_size, (5,)))
+        n1, n2 = int(rng.integers(256)), int(rng.integers(256))
+        logits, latents = engine.put([1, 2, 3], [[n1], [n2], s3])
+        s1.append(n1)
+        s2.append(n2)
+        np.testing.assert_allclose(logits[0],
+                                   full_logits(model, params, s1)[-1],
+                                   atol=2e-2)
+        np.testing.assert_allclose(logits[1],
+                                   full_logits(model, params, s2)[-1],
+                                   atol=2e-2)
+        np.testing.assert_allclose(logits[2],
+                                   full_logits(model, params, s3)[-1],
+                                   atol=2e-2)
+        assert latents[0].shape[1] == 1 and latents[2].shape[1] == 5
+
+    def test_greedy_generation_consistency(self, tiny_model):
+        """Greedy engine generation == greedy full-recompute generation."""
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(3)
+        prompt = list(rng.integers(0, cfg.vocab_size, (6,)))
+        logits, _ = engine.put([42], [prompt])
+        engine_seq = list(prompt)
+        for _ in range(8):
+            nxt = int(np.argmax(logits[0]))
+            engine_seq.append(nxt)
+            logits, _ = engine.put([42], [[nxt]])
+
+        ref_seq = list(prompt)
+        for _ in range(8):
+            ref = full_logits(model, params, ref_seq)
+            ref_seq.append(int(np.argmax(ref[-1])))
+        assert engine_seq == ref_seq
+
+
+class TestHCacheRestore:
+    """The fork's flagship: restore_kv rebuilds KV from latents."""
+
+    def test_restore_equals_recompute(self, tiny_model):
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(4)
+        prompt = list(rng.integers(0, cfg.vocab_size, (11,)))
+
+        # path A: prefill, keep cache, decode
+        engine_a = make_engine(cfg, params)
+        logits_a, latents = engine_a.put([1], [prompt])
+        nxt = int(np.argmax(logits_a[0]))
+        dec_a, _ = engine_a.put([1], [[nxt]])
+
+        # path B: restore from latents (no prefill forward), then decode
+        engine_b = make_engine(cfg, params)
+        engine_b.restore_kv([1], [prompt], [latents[0]])
+        seq = engine_b.state.get_sequence(1)
+        assert seq.seen_tokens == len(prompt)
+        dec_b, _ = engine_b.put([1], [[nxt]])
+
+        np.testing.assert_allclose(dec_b[0], dec_a[0], atol=2e-2)
+
+    def test_restore_then_long_generation(self, tiny_model):
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(5)
+        prompt = list(rng.integers(0, cfg.vocab_size, (9,)))
+
+        engine = make_engine(cfg, params)
+        logits, latents = engine.put([1], [prompt])
+        engine.flush(1)
+        assert engine.state.get_sequence(1) is None
+
+        engine.restore_kv([1], [prompt], [latents[0]])
+        seq = list(prompt)
+        cur = int(np.argmax(logits[0]))
+        for _ in range(6):
+            seq.append(cur)
+            out, _ = engine.put([1], [[cur]])
+            ref = full_logits(model, params, seq)
+            np.testing.assert_allclose(out[0], ref[-1], atol=2e-2)
+            cur = int(np.argmax(out[0]))
+
+    def test_latents_disabled(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params, hcache={"enable_latents": False})
+        logits, latents = engine.put([1], [[1, 2, 3]])
+        assert latents[0] is None or latents[0].shape[-1] == 0
+
+
+class TestScheduling:
+
+    def test_sequence_limit(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        res = engine.can_schedule(list(range(9)), [1] * 9)
+        assert res == SchedulingResult.EngineSequenceLimitExceeded
+        res = engine.can_schedule(list(range(5)), [1] * 5)
+        assert res == SchedulingResult.BatchSequenceLimitExceeded
+
+    def test_token_limit(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        assert engine.can_schedule([1, 2], [100, 100]) == \
+            SchedulingResult.BatchTokenLimitExceeded
+
+    def test_seq_len_limit(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        assert engine.can_schedule([1], [300]) == \
+            SchedulingResult.BatchTokenLimitExceeded
+        # within batch budget but beyond per-seq context
+        engine2 = make_engine(cfg, params,
+                              state_manager={"max_ragged_batch_size": 1024,
+                                             "max_context": 64})
+        assert engine2.can_schedule([1], [100]) == \
+            SchedulingResult.SequenceTokenLimitExceeded
+
+    def test_kv_limit_and_error(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params,
+                             kv_cache={"block_size": 16, "num_blocks": 3,
+                                       "cache_dtype": "float32"})
+        # 2 usable blocks (1 reserved scratch) = 32 tokens
+        assert engine.can_schedule([1], [64]) == \
+            SchedulingResult.KVCacheLimitExceeded
+        with pytest.raises(SchedulingError):
+            engine.put([1], [list(range(64))])
+
+    def test_query_budget(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        tokens, blocks = engine.query(5, 1000, 1000)
+        assert tokens == 128  # max_context cap
+        assert blocks == 128 // 16
+        engine.put([5], [[1, 2, 3]])
+        tokens2, blocks2 = engine.query(5, 1000, 1000)
+        assert tokens2 == 125
+        assert blocks2 == 8 - 1  # one block already held
+
+    def test_flush_frees_blocks(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        free0 = engine.state.free_blocks
+        engine.put([1], [list(range(40))])
+        assert engine.state.free_blocks < free0
+        engine.flush(1)
+        assert engine.state.free_blocks == free0
+
+
+class TestFactory:
+
+    def test_build_hf_engine(self, tiny_model):
+        cfg, _, params = tiny_model
+        hf = {"model_type": "llama", "vocab_size": cfg.vocab_size,
+              "hidden_size": cfg.hidden_size,
+              "intermediate_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.n_layer,
+              "num_attention_heads": cfg.n_head,
+              "num_key_value_heads": cfg.n_kv_head,
+              "max_position_embeddings": cfg.max_positions,
+              "torch_dtype": "float32"}
+        engine = build_hf_engine(
+            hf, params,
+            engine_config=RaggedInferenceEngineConfig(
+                kv_cache={"block_size": 16, "num_blocks": 16,
+                          "cache_dtype": "float32"},
+                state_manager={"max_context": 128}))
+        logits, _ = engine.put([1], [[1, 2, 3]])
+        assert logits.shape == (1, cfg.vocab_size)
+
+    def test_unknown_family(self, tiny_model):
+        cfg, _, params = tiny_model
+        with pytest.raises(ValueError, match="unsupported model family"):
+            build_hf_engine({"model_type": "rwkv"}, params)

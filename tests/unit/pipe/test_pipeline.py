@@ -62,6 +62,21 @@ class TestSchedules:
         assert len(steps) == 3 + 2 - 1
         assert steps[0] == []  # stage 1 idle on tick 0 (bubble)
 
+    def test_tick_work_table_matches_schedule(self):
+        """The compiled executor's where(stage==0, fresh, carried) logic
+        equals the gpipe_tick_work table; the table must agree with the
+        InferenceSchedule enumeration."""
+        from hcache_deepspeed_tpu.runtime.pipe.schedule import \
+            gpipe_tick_work
+        M, S = 5, 3
+        table = gpipe_tick_work(M, S)
+        for sid in range(S):
+            steps = InferenceSchedule(M, S, sid).steps()
+            for t, cmds in enumerate(steps):
+                fwd = [c.micro_batch_id for c in cmds
+                       if type(c) is ForwardPass]
+                assert table[t][sid] == (fwd[0] if fwd else None)
+
     def test_bubble(self):
         assert bubble_fraction(8, 4) == pytest.approx(3 / 11)
         assert bubble_fraction(1, 1) == 0.0
