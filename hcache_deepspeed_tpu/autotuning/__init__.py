@@ -1,0 +1,3 @@
+"""Autotuning (reference: ``deepspeed/autotuning/``)."""
+
+from .autotuner import Autotuner, ExperimentResult  # noqa: F401

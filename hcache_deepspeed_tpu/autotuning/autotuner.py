@@ -1,0 +1,96 @@
+"""Autotuner: micro-batch / remat sweep driver.
+
+Reference analog: ``deepspeed/autotuning/`` — the Autotuner launches
+experiment grids over micro-batch size and ZeRO stage, measures
+throughput, and reports the fastest viable config. TPU re-design: no
+subprocess relaunches — a candidate is one jit compile + a few timed
+steps in-process (XLA gives OOM back as an exception, the reference's
+"experiment failed" signal), so a sweep that costs the reference minutes
+of cluster relaunches is seconds of compiles.
+"""
+
+import itertools
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+class ExperimentResult:
+    def __init__(self, config: Dict, throughput: float = 0.0,
+                 error: Optional[str] = None):
+        self.config = config
+        self.throughput = throughput
+        self.error = error
+
+    @property
+    def ok(self):
+        return self.error is None
+
+    def __repr__(self):
+        status = f"{self.throughput:.1f} samples/s" if self.ok \
+            else f"FAILED({self.error})"
+        return f"Experiment({self.config} -> {status})"
+
+
+class Autotuner:
+    """Sweep driver. ``run_fn(candidate_config) -> step_callable`` builds
+    a candidate (typically ``hds.initialize`` + a train_batch closure);
+    the tuner times it and picks the fastest.
+
+    Candidate axes follow the reference's tuning space: micro batch size,
+    ZeRO stage, remat on/off (the reference's activation-checkpointing
+    flag in the DEFAULT_TUNING_SPACE).
+    """
+
+    def __init__(self, run_fn: Callable[[Dict], Callable],
+                 micro_batch_sizes: List[int],
+                 zero_stages: List[int] = (0,),
+                 remat: List[bool] = (False,),
+                 warmup_steps: int = 2, measure_steps: int = 4):
+        self.run_fn = run_fn
+        self.space = [
+            {"micro_batch": mb, "zero_stage": z, "remat": r}
+            for mb, z, r in itertools.product(micro_batch_sizes,
+                                              zero_stages, remat)
+        ]
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.results: List[ExperimentResult] = []
+
+    def _measure(self, candidate: Dict) -> ExperimentResult:
+        try:
+            step = self.run_fn(candidate)
+            for _ in range(self.warmup_steps):
+                step()
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                step()
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            samples = candidate["micro_batch"]
+            return ExperimentResult(candidate, throughput=samples / dt)
+        except Exception as e:  # OOM / trace errors = failed experiment
+            return ExperimentResult(candidate, error=type(e).__name__)
+
+    def tune(self) -> ExperimentResult:
+        self.results = []
+        for candidate in self.space:
+            result = self._measure(candidate)
+            logger.info(f"autotune: {result}")
+            self.results.append(result)
+        ok = [r for r in self.results if r.ok]
+        if not ok:
+            raise RuntimeError(
+                f"no viable config among {len(self.space)} candidates")
+        best = max(ok, key=lambda r: r.throughput)
+        logger.info(f"autotune best: {best}")
+        return best
+
+    def summary(self) -> str:
+        lines = [f"{'micro':>6} {'zero':>5} {'remat':>6} {'samples/s':>10}"]
+        for r in self.results:
+            tput = f"{r.throughput:.1f}" if r.ok else r.error
+            lines.append(
+                f"{r.config['micro_batch']:>6} {r.config['zero_stage']:>5} "
+                f"{str(r.config['remat']):>6} {tput:>10}")
+        return "\n".join(lines)

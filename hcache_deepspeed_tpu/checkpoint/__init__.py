@@ -1,0 +1,6 @@
+"""Checkpoint tooling (reference: ``deepspeed/checkpoint/``)."""
+
+from .universal import (checkpoint_info,  # noqa: F401
+                        convert_zero_checkpoint_to_fp32_state_dict,
+                        get_fp32_state_dict_from_zero_checkpoint,
+                        load_state_tree)

@@ -1,0 +1,117 @@
+"""Universal checkpoint utilities.
+
+Reference analogs:
+* ``deepspeed/checkpoint/ds_to_universal.py:469`` — offline converter from
+  zero shards to per-param fp32 fragment folders,
+* ``deepspeed/checkpoint/universal_checkpoint.py:22
+  load_hp_checkpoint_state`` — runtime matcher from fragments to a new
+  topology,
+* ``zero_to_fp32.py`` (760 LoC) — the shard-merging consolidation script
+  shipped into every checkpoint dir (``runtime/engine.py:3674``).
+
+TPU-native: the on-disk format written by ``runtime/checkpointing.py`` is
+ALREADY universal — orbax stores param-name-keyed arrays with their global
+shapes, so "convert to universal" is the identity and "load under a new
+topology" is restore-with-new-shardings. What remains of the reference's
+machinery is the consolidation path (shards → one host fp32 state dict,
+for HF export and offline tooling), provided here both as a library call
+and a CLI:
+
+    python -m hcache_deepspeed_tpu.checkpoint.universal <ckpt_dir> out.npz
+"""
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}")
+        with open(latest) as fh:
+            tag = fh.read().strip()
+    return tag
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + str(k) + "."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def load_state_tree(checkpoint_dir: str, tag: Optional[str] = None):
+    """Restore a checkpoint's full state pytree to *host* numpy arrays
+    without needing the original mesh (offline consolidation — the
+    ``zero_to_fp32`` capability: every shard is merged by orbax on read)."""
+    import orbax.checkpoint as ocp
+    tag = _resolve_tag(checkpoint_dir, tag)
+    path = os.path.abspath(os.path.join(checkpoint_dir, tag, "state"))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
+    restored = ocp.PyTreeCheckpointer().restore(path)
+    return restored
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Reference: ``zero_to_fp32.py
+    get_fp32_state_dict_from_zero_checkpoint`` — returns a flat
+    ``{param_name: fp32 ndarray}`` of the *master* weights (fp32 master if
+    present, else the params)."""
+    state = load_state_tree(checkpoint_dir, tag)
+    source = state.get("master") or state["params"]
+    flat = _flatten(source)
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str,
+        tag: Optional[str] = None) -> None:
+    """Reference: ``zero_to_fp32.py`` CLI entry — writes one consolidated
+    host file (.npz) usable without jax/orbax."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+
+
+def checkpoint_info(checkpoint_dir: str, tag: Optional[str] = None) -> Dict:
+    """Reference: ``deepspeed/checkpoint/deepspeed_checkpoint.py`` — the
+    inspection API (step counts, keys, shapes) used by reshape tooling."""
+    tag = _resolve_tag(checkpoint_dir, tag)
+    meta_path = os.path.join(checkpoint_dir, tag, "hds_meta.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    state = load_state_tree(checkpoint_dir, tag)
+    flat = _flatten(state.get("params", {}))
+    return {
+        "tag": tag,
+        "meta": meta,
+        "num_params": int(sum(np.size(v) for v in flat.values())),
+        "param_shapes": {k: tuple(np.shape(v)) for k, v in flat.items()},
+    }
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Consolidate a sharded HDS-TPU checkpoint into one "
+                    "fp32 .npz (zero_to_fp32 analog)")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file,
+                                               tag=args.tag)
+    print(f"wrote {args.output_file}")
+
+
+if __name__ == "__main__":
+    main()

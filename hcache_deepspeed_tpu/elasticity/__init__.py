@@ -1,0 +1,4 @@
+"""Elasticity (reference: ``deepspeed/elasticity/``)."""
+
+from .elasticity import (ElasticityError, compute_elastic_config,  # noqa: F401
+                         get_compatible_gpus)

@@ -1,0 +1,107 @@
+"""Elastic training batch arithmetic.
+
+Reference analog: ``deepspeed/elasticity/elasticity.py`` —
+``get_compatible_gpus`` (:83), ``_get_compatible_gpus_v01/v02`` (:126) and
+``compute_elastic_config`` (:233): given an acceptable micro-batch menu
+and a max global batch, enumerate the chip counts a run can elastically
+resize across without changing the *global* batch size. Pure arithmetic —
+ported semantics, TPU naming (chips, not GPUs).
+
+v0.2 adds hardware granularity: chip counts must be multiples of the ICI
+slice granule (e.g. a v5e tray), the reference's ``model_parallel_size``×
+``num_gpus_per_node`` constraint.
+"""
+
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def _candidate_batches(max_acceptable_batch_size: int,
+                       micro_batches: List[int]) -> List[int]:
+    """All global batch sizes ≤ max that are a multiple of some micro batch
+    (reference: get_candidate_batch_sizes) — in decreasing 'divisibility'
+    preference order."""
+    candidates = set()
+    for mb in micro_batches:
+        batch = (max_acceptable_batch_size // mb) * mb
+        if batch > 0:
+            candidates.add(batch)
+    return sorted(candidates, reverse=True)
+
+
+def get_compatible_gpus(batch_size: int, micro_batches: List[int],
+                        min_gpus: int = 1, max_gpus: int = 10000,
+                        granule: int = 1) -> List[int]:
+    """Chip counts w such that batch_size = micro * gas * w for some menu
+    micro and integer gas ≥ 1 (reference :83)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        replicas = batch_size // mb          # micro * w combinations
+        w = granule
+        while w <= min(replicas, max_gpus):
+            if replicas % w == 0 and w >= min_gpus:
+                valid.add(w)
+            w += granule
+    return sorted(valid)
+
+
+def compute_elastic_config(elastic_config: Dict,
+                           world_size: int = 0) -> Tuple[int, List[int], Dict]:
+    """Pick the final (global batch, valid chip counts) and, when
+    ``world_size`` is known, the per-chip micro batch + gas
+    (reference :233)."""
+    cfg = dict(elastic_config)
+    if not cfg.get("enabled", False):
+        raise ElasticityError("elasticity is not enabled in config")
+    micro_batches = sorted(cfg.get("micro_batch_sizes", [])) \
+        or [cfg.get("micro_batch", 1)]
+    max_batch = cfg.get("max_train_batch_size", 0)
+    if max_batch <= 0 or not micro_batches:
+        raise ElasticityError(
+            "elasticity requires max_train_batch_size and "
+            "micro_batch_sizes")
+    min_gpus = cfg.get("min_gpus", 1)
+    max_gpus = cfg.get("max_gpus", 10000)
+    granule = cfg.get("model_parallel_size", 1) * \
+        cfg.get("num_gpus_per_node", 1)
+    prefer_larger = cfg.get("prefer_larger_batch", True)
+
+    best = None  # (num_valid, batch, valid_gpus)
+    for batch in _candidate_batches(max_batch, micro_batches):
+        valid = get_compatible_gpus(batch, micro_batches, min_gpus,
+                                    max_gpus, granule)
+        if not valid:
+            continue
+        key = (len(valid), batch if prefer_larger else -batch)
+        if best is None or key > best[0]:
+            best = (key, batch, valid)
+    if best is None:
+        raise ElasticityError(
+            f"no batch size ≤ {max_batch} is compatible with chips in "
+            f"[{min_gpus}, {max_gpus}] x granule {granule}")
+    _, final_batch, valid_gpus = best
+
+    detail = {}
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityError(
+                f"world size {world_size} not in the elastic schedule "
+                f"{valid_gpus}")
+        # largest menu micro batch that divides the per-chip share
+        per_chip = final_batch // world_size
+        micro = max((m for m in micro_batches if per_chip % m == 0),
+                    default=None)
+        if micro is None:
+            raise ElasticityError(
+                f"no menu micro batch divides per-chip batch {per_chip}")
+        detail = {"micro_batch": micro, "gas": per_chip // micro}
+        logger.info(f"elasticity: batch={final_batch} chips={world_size} "
+                    f"micro={micro} gas={detail['gas']}")
+    return final_batch, valid_gpus, detail

@@ -76,9 +76,9 @@ class InferenceEngineV2:
         """'reserve' allocation mode: size the pool from free device memory
         (reference: memory_config reserve fraction)."""
         from ..platform import get_platform
-        per_token = (2 * model_config.n_layer * model_config.n_kv_head *
-                     model_config.head_dim *
-                     jnp.dtype(kv_cfg.cache_dtype).itemsize)
+        per_token = BlockedKVCache.token_bytes(
+            model_config.n_layer, model_config.n_kv_head,
+            model_config.head_dim, kv_cfg.cache_dtype)
         free = get_platform().available_memory()
         if free <= 0:          # unknown limit (e.g. CPU test platform)
             free = 1 << 30

@@ -46,10 +46,17 @@ class BlockedKVCache:
         self.k = k
         self.v = v
 
+    @staticmethod
+    def token_bytes(n_layers: int, n_kv_heads: int, head_dim: int,
+                    dtype) -> int:
+        """KV bytes per cached token (k + v across all layers)."""
+        return (2 * n_layers * n_kv_heads * head_dim *
+                jnp.dtype(dtype).itemsize)
+
     @property
     def per_token_bytes(self) -> int:
-        return (2 * self.n_layers * self.n_kv_heads * self.head_dim *
-                jnp.dtype(self.dtype).itemsize)
+        return self.token_bytes(self.n_layers, self.n_kv_heads,
+                                self.head_dim, self.dtype)
 
     def replace(self, k, v):
         self.k, self.v = k, v

@@ -1,0 +1,50 @@
+"""Per-host bootstrap.
+
+Reference analog: ``deepspeed/launcher/launch.py:133 main`` — there it
+forks one process per local GPU rank with RANK/LOCAL_RANK env and signal
+fan-out. On TPU one process drives every local chip, so this module only
+normalizes the rendezvous env (mapping MPI/Slurm-provided ranks onto the
+``HDS_*`` variables) and execs the user script; signal handling stays with
+the shell. Exposed for launchers (mpirun/srun) that run the same command
+on every node.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def infer_process_env(env=None):
+    """Map scheduler-provided rank env (OpenMPI, Slurm, torchrun-style) to
+    HDS_* (reference: the env discovery in comm.py:705-808 + launch.py)."""
+    env = dict(env if env is not None else os.environ)
+    if "HDS_PROCESS_ID" not in env:
+        for key in ("OMPI_COMM_WORLD_RANK", "SLURM_PROCID", "RANK"):
+            if key in env:
+                env["HDS_PROCESS_ID"] = env[key]
+                break
+    if "HDS_NUM_PROCESSES" not in env:
+        for key in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS", "WORLD_SIZE"):
+            if key in env:
+                env["HDS_NUM_PROCESSES"] = env[key]
+                break
+    if "HDS_COORDINATOR_ADDRESS" not in env:
+        addr = env.get("MASTER_ADDR")
+        port = env.get("MASTER_PORT", "7777")
+        if addr:
+            env["HDS_COORDINATOR_ADDRESS"] = f"{addr}:{port}"
+    return env
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m hcache_deepspeed_tpu.launcher.launch "
+              "<script> [args...]", file=sys.stderr)
+        return 2
+    env = infer_process_env()
+    return subprocess.call([sys.executable] + argv, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

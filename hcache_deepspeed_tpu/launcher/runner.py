@@ -1,0 +1,309 @@
+"""Multi-host launcher CLI.
+
+Reference analog: ``deepspeed/launcher/runner.py:419 main`` — hostfile
+parsing (:213-383), --include/--exclude filtering, world-info encoding,
+MultiNodeRunner selection, env propagation via ``.deepspeed_env``.
+
+TPU model: ONE process per host (a host drives all its local chips via
+jax), so "slots" in the hostfile are chips-per-host for accounting, not
+process fan-out. Rank-0's host is the jax.distributed coordinator; each
+host gets ``HDS_COORDINATOR_ADDRESS/HDS_NUM_PROCESSES/HDS_PROCESS_ID`` and
+``jax.distributed.initialize`` replaces torch's init_process_group
+rendezvous (SURVEY.md §5). On GCP TPU pods, ``--tpu-pod`` instead defers
+to the metadata-provided topology (jax auto-detects) and the launcher only
+fans the command out.
+"""
+
+import argparse
+import base64
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+
+from ..utils.logging import logger
+
+ENV_FILE = ".hds_env"
+
+
+def parse_hostfile(path_or_lines):
+    """'host slots=N' lines → OrderedDict{host: slots}. Reference:
+    runner.py fetch_hostfile/_parse_hostfile."""
+    if isinstance(path_or_lines, str):
+        with open(path_or_lines) as fh:
+            lines = fh.readlines()
+    else:
+        lines = list(path_or_lines)
+    resources = OrderedDict()
+    for line in lines:
+        line = line.split("#")[0].strip()
+        if not line:
+            continue
+        m = re.match(r"^(\S+)(?:\s+slots=(\d+))?$", line)
+        if m is None:
+            raise ValueError(f"malformed hostfile line: {line!r}")
+        host, slots = m.group(1), int(m.group(2) or 1)
+        if host in resources:
+            raise ValueError(f"duplicate host {host} in hostfile")
+        resources[host] = slots
+    if not resources:
+        raise ValueError("hostfile is empty")
+    return resources
+
+
+def parse_inclusion_exclusion(resources, include_str="", exclude_str=""):
+    """Filter hosts/slots with the reference's node[:slot[,slot]] syntax
+    (runner.py parse_resource_filter)."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    def parse_filter(s):
+        out = OrderedDict()
+        for part in filter(None, s.split("@")):
+            if ":" in part:
+                host, slots = part.split(":")
+                out[host] = sorted(int(x) for x in slots.split(","))
+            else:
+                out[part] = None
+        return out
+
+    if include_str:
+        wanted = parse_filter(include_str)
+        unknown = set(wanted) - set(resources)
+        if unknown:
+            raise ValueError(f"unknown hosts in --include: {sorted(unknown)}")
+        return OrderedDict(
+            (h, len(s) if (s := wanted[h]) is not None else resources[h])
+            for h in resources if h in wanted)
+    if exclude_str:
+        banned = parse_filter(exclude_str)
+        unknown = set(banned) - set(resources)
+        if unknown:
+            raise ValueError(f"unknown hosts in --exclude: {sorted(unknown)}")
+        out = OrderedDict()
+        for h, slots in resources.items():
+            if h in banned:
+                if banned[h] is None:
+                    continue
+                remaining = slots - len(banned[h])
+                if remaining > 0:
+                    out[h] = remaining
+            else:
+                out[h] = slots
+        if not out:
+            raise ValueError("all hosts excluded")
+        return out
+    return OrderedDict(resources)
+
+
+def encode_world_info(resources):
+    return base64.urlsafe_b64encode(
+        json.dumps(dict(resources)).encode()).decode()
+
+
+def decode_world_info(blob):
+    return json.loads(base64.urlsafe_b64decode(blob.encode()).decode())
+
+
+def _load_exports(env_file=ENV_FILE, export_envs=()):
+    exports = {}
+    if os.path.exists(env_file):
+        with open(env_file) as fh:
+            for line in fh:
+                if "=" in line and not line.startswith("#"):
+                    k, v = line.strip().split("=", 1)
+                    exports[k] = v
+    for kv in export_envs:
+        k, v = kv.split("=", 1)
+        exports[k] = v
+    return exports
+
+
+def _quoted_script(user_script, user_args):
+    return " ".join([shlex.quote(user_script)] +
+                    [shlex.quote(a) for a in user_args])
+
+
+def build_launch_commands(resources, user_script, user_args,
+                          coordinator_port=7777, env_file=ENV_FILE,
+                          export_envs=(), tpu_pod=False):
+    """One command line per host. Reference: MultiNodeRunner.get_cmd
+    (multinode_runner.py:55-409) — PDSH-style per-host commands.
+
+    ``tpu_pod``: GCP TPU pod slices auto-discover topology from metadata
+    (jax.distributed.initialize() with no args), so no HDS_* rendezvous
+    env is injected — the launcher only fans the command out.
+    """
+    hosts = list(resources)
+    coordinator = f"{hosts[0]}:{coordinator_port}"
+    exports = _load_exports(env_file, export_envs)
+
+    cmds = []
+    for pid, host in enumerate(hosts):
+        env = dict(exports, HDS_LOCAL_SLOTS=str(resources[host]))
+        if not tpu_pod:
+            env.update(HDS_COORDINATOR_ADDRESS=coordinator,
+                       HDS_NUM_PROCESSES=str(len(hosts)),
+                       HDS_PROCESS_ID=str(pid))
+        env_prefix = " ".join(f"{k}={shlex.quote(v)}"
+                              for k, v in sorted(env.items()))
+        cmds.append((host, f"{env_prefix} {sys.executable} "
+                     f"{_quoted_script(user_script, user_args)}"))
+    return cmds
+
+
+def build_rank_agnostic_command(resources, user_script, user_args,
+                                coordinator_port=7777, env_file=ENV_FILE,
+                                export_envs=(), tpu_pod=False):
+    """ONE command valid on every rank, for launchers that replicate a
+    single command line (mpirun/srun). The process id is intentionally NOT
+    in the env — ``launcher.launch`` maps the scheduler's rank variable
+    (OMPI_COMM_WORLD_RANK / SLURM_PROCID) onto HDS_PROCESS_ID at startup."""
+    hosts = list(resources)
+    env = _load_exports(env_file, export_envs)
+    if not tpu_pod:
+        env.update(HDS_COORDINATOR_ADDRESS=f"{hosts[0]}:{coordinator_port}",
+                   HDS_NUM_PROCESSES=str(len(hosts)))
+    env_prefix = " ".join(f"{k}={shlex.quote(v)}"
+                          for k, v in sorted(env.items()))
+    return (f"{env_prefix} {sys.executable} -m "
+            f"hcache_deepspeed_tpu.launcher.launch "
+            f"{_quoted_script(user_script, user_args)}").strip()
+
+
+class MultiNodeRunner:
+    """Command fan-out backends (reference: multinode_runner.py — PDSH/
+    OpenMPI/Slurm each build one cluster command).
+
+    ``get_cmd(launch)`` takes a ``LaunchSpec`` and returns the list of
+    subprocess argv vectors to run from the driver host.
+    """
+
+    name = "ssh"
+
+    def __init__(self, args):
+        self.args = args
+
+    def backend_exists(self):
+        return True
+
+    def get_cmd(self, launch):
+        raise NotImplementedError
+
+
+class LaunchSpec:
+    def __init__(self, resources, user_script, user_args,
+                 coordinator_port=7777, export_envs=(), tpu_pod=False):
+        self.resources = resources
+        self.kw = dict(coordinator_port=coordinator_port,
+                       export_envs=export_envs, tpu_pod=tpu_pod)
+        self.user_script = user_script
+        self.user_args = user_args
+
+    def per_host_cmds(self):
+        return build_launch_commands(self.resources, self.user_script,
+                                     self.user_args, **self.kw)
+
+    def rank_agnostic_cmd(self):
+        return build_rank_agnostic_command(self.resources, self.user_script,
+                                           self.user_args, **self.kw)
+
+
+class SSHRunner(MultiNodeRunner):
+    """Reference: PDSHRunner — here plain ssh per host (pdsh-less); each
+    host gets its own env-complete command."""
+
+    def get_cmd(self, launch):
+        return [["ssh", "-o", "StrictHostKeyChecking=no", host, cmd]
+                for host, cmd in launch.per_host_cmds()]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun replicates ONE command to every rank, so the command must be
+    rank-agnostic: HDS_PROCESS_ID comes from OMPI_COMM_WORLD_RANK via
+    ``launcher.launch`` at startup."""
+
+    name = "openmpi"
+
+    def get_cmd(self, launch):
+        hosts = ",".join(launch.resources)
+        n = len(launch.resources)
+        return [["mpirun", "-np", str(n), "--host", hosts,
+                 "bash", "-c", launch.rank_agnostic_cmd()]]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun replicates ONE command; rank comes from SLURM_PROCID via
+    ``launcher.launch``."""
+
+    name = "slurm"
+
+    def get_cmd(self, launch):
+        n = len(launch.resources)
+        return [["srun", f"--nodes={n}", "--ntasks-per-node=1",
+                 "bash", "-c", launch.rank_agnostic_cmd()]]
+
+
+RUNNERS = {"ssh": SSHRunner, "openmpi": OpenMPIRunner, "slurm": SlurmRunner}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hds", description="hcache_deepspeed_tpu multi-host launcher "
+        "(reference: the `deepspeed` CLI)")
+    parser.add_argument("-H", "--hostfile", default="/job/hostfile")
+    parser.add_argument("-i", "--include", default="")
+    parser.add_argument("-e", "--exclude", default="")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=sorted(RUNNERS))
+    parser.add_argument("--coordinator-port", type=int, default=7777)
+    parser.add_argument("--export", action="append", default=[],
+                        help="KEY=VALUE env to propagate")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print per-host commands, don't execute")
+    parser.add_argument("--tpu-pod", action="store_true",
+                        help="GCP TPU pod: rely on jax auto-topology; "
+                        "launcher only fans out the command")
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if os.path.exists(args.hostfile):
+        resources = parse_hostfile(args.hostfile)
+    else:
+        logger.warning(f"hostfile {args.hostfile} not found; "
+                       "single-host launch")
+        resources = OrderedDict(localhost=1)
+    resources = parse_inclusion_exclusion(resources, args.include,
+                                          args.exclude)
+
+    if len(resources) == 1 and next(iter(resources)) in (
+            "localhost", "127.0.0.1"):
+        env = dict(os.environ)
+        cmd = [sys.executable, args.user_script] + args.user_args
+        if args.dry_run:
+            print(" ".join(map(shlex.quote, cmd)))
+            return 0
+        return subprocess.call(cmd, env=env)
+
+    launch = LaunchSpec(resources, args.user_script, args.user_args,
+                        coordinator_port=args.coordinator_port,
+                        export_envs=args.export, tpu_pod=args.tpu_pod)
+    runner = RUNNERS[args.launcher](args)
+    cluster_cmds = runner.get_cmd(launch)
+    if args.dry_run:
+        for c in cluster_cmds:
+            print(" ".join(map(shlex.quote, c)))
+        return 0
+    procs = [subprocess.Popen(c) for c in cluster_cmds]
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+"""Profiling (reference: ``deepspeed/profiling/``)."""
+
+from .flops_profiler import (FlopsProfiler, analyze_fn,  # noqa: F401
+                             count_params, get_model_profile)

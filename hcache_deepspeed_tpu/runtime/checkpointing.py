@@ -30,24 +30,34 @@ def _ckpt_path(save_dir, tag):
     return os.path.join(save_dir, str(tag))
 
 
-def save_checkpoint(save_dir, tag, state, meta, save_latest=True):
-    import orbax.checkpoint as ocp
+def save_checkpoint(save_dir, tag, state, meta, save_latest=True,
+                    checkpoint_engine=None):
+    from .checkpoint_engine import SyncCheckpointEngine
     path = os.path.abspath(_ckpt_path(save_dir, tag))
     os.makedirs(path, exist_ok=True)
     # drop None leaves (e.g. master=None in fp32 mode): orbax can't store None
     to_save = {k: v for k, v in state.items() if v is not None}
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(path, _STATE_DIR), to_save, force=True)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, _META_NAME), "w") as fh:
-            json.dump({**meta, "state_keys": sorted(to_save)}, fh)
-        if save_latest:
-            with open(os.path.join(save_dir, _LATEST), "w") as fh:
-                fh.write(str(tag))
+    engine = checkpoint_engine or SyncCheckpointEngine()
+    engine.save(os.path.join(path, _STATE_DIR), to_save)
+
+    def commit():
+        # only after the state is durable (async: deferred to wait()) may
+        # the meta file and the 'latest' pointer appear — the load-side
+        # missing-meta guard depends on this ordering
+        if jax.process_index() == 0:
+            with open(os.path.join(path, _META_NAME), "w") as fh:
+                json.dump({**meta, "state_keys": sorted(to_save)}, fh)
+            if save_latest:
+                with open(os.path.join(save_dir, _LATEST), "w") as fh:
+                    fh.write(str(tag))
+
+    engine.on_saved(commit)
 
 
-def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True):
+def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True,
+                    checkpoint_engine=None):
     import orbax.checkpoint as ocp
+    from .checkpoint_engine import SyncCheckpointEngine
     if tag is None:
         latest = os.path.join(load_dir, _LATEST)
         if not os.path.exists(latest):
@@ -68,15 +78,14 @@ def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True):
         meta = json.load(fh)
 
     template = {k: v for k, v in template_state.items() if v is not None}
-    ckptr = ocp.PyTreeCheckpointer()
+    engine = checkpoint_engine or SyncCheckpointEngine()
     # Restore with the *current* shardings: resharding-on-load gives
     # topology-change resume (the universal checkpoint capability).
     restore_args = jax.tree.map(
         lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding, dtype=x.dtype)
         if isinstance(x, jax.Array) else ocp.RestoreArgs(), template)
-    restored = ckptr.restore(
-        os.path.join(path, _STATE_DIR), item=template,
-        restore_args=restore_args)
+    restored = engine.restore(
+        os.path.join(path, _STATE_DIR), template, restore_args)
     if not load_optimizer_states and "opt" in template_state:
         restored["opt"] = template_state["opt"]
     out = dict(template_state)

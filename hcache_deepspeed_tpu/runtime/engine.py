@@ -646,6 +646,16 @@ class HDSEngine:
     # :2928 load_checkpoint; sharded + resharding-tolerant like the
     # universal checkpoint)
     # ------------------------------------------------------------------ #
+    @property
+    def checkpoint_engine(self):
+        """Lazy engine (reference: runtime/checkpoint_engine/ — torch sync
+        vs nebula async, selected by ``checkpoint.async_save``)."""
+        if getattr(self, "_ckpt_engine", None) is None:
+            from .checkpoint_engine import build_checkpoint_engine
+            self._ckpt_engine = build_checkpoint_engine(
+                self.config.checkpoint.async_save)
+        return self._ckpt_engine
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         from .checkpointing import save_checkpoint as _save
@@ -658,15 +668,43 @@ class HDSEngine:
             "current_lr": self._current_lr,
             "client_state": client_state or {},
         }
-        _save(save_dir, tag, self.state, meta, save_latest=save_latest)
+        _save(save_dir, tag, self.state, meta, save_latest=save_latest,
+              checkpoint_engine=self.checkpoint_engine)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+        return True
+
+    def wait_for_checkpoint(self):
+        """Commit barrier for async saves (nebula semantics)."""
+        self.checkpoint_engine.wait()
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
+        """Consolidated 16-bit weights for export (reference:
+        engine.py:3749 save_16bit_model / zero3 consolidated state dict).
+        Shards are gathered with an XLA all-gather-to-replicated (every
+        host then holds the full arrays locally); only process 0 writes."""
+        import os
+
+        from ..checkpoint.universal import _flatten
+        replicate = jax.jit(
+            lambda t: t,
+            out_shardings=NamedSharding(self.mesh, PartitionSpec()))
+        host = jax.tree.map(lambda x: np.asarray(x),
+                            replicate(self.state["params"]))
+        if jax.process_index() != 0:
+            return True
+        os.makedirs(save_dir, exist_ok=True)
+        flat = _flatten(host)
+        path = os.path.join(save_dir, save_filename)
+        np.savez(path, **flat)
+        log_dist(f"saved 16bit model to {path}", ranks=[0])
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         **kw):
         from .checkpointing import load_checkpoint as _load
         state, meta = _load(load_dir, tag, self.state,
-                            load_optimizer_states=load_optimizer_states)
+                            load_optimizer_states=load_optimizer_states,
+                            checkpoint_engine=self.checkpoint_engine)
         if state is None:
             return None, {}
         self.state = state

@@ -1,0 +1,82 @@
+"""Reference analog: ``tests/unit/elasticity/test_elastic.py`` — batch/chip
+compatibility arithmetic."""
+
+import pytest
+
+from hcache_deepspeed_tpu.autotuning import Autotuner
+from hcache_deepspeed_tpu.elasticity import (ElasticityError,
+                                             compute_elastic_config,
+                                             get_compatible_gpus)
+
+BASE = {
+    "enabled": True,
+    "max_train_batch_size": 10000,
+    "micro_batch_sizes": [8, 12, 16, 17],
+    "min_gpus": 32,
+    "max_gpus": 1500,
+}
+
+
+class TestElasticity:
+
+    def test_compatible_gpus(self):
+        # batch 48, micros {8, 12}: replicas 6 or 4 -> w in {1..6}∪{1..4}
+        out = get_compatible_gpus(48, [8, 12], min_gpus=1, max_gpus=64)
+        assert out == [1, 2, 3, 4, 6]
+
+    def test_granule(self):
+        out = get_compatible_gpus(64, [8], min_gpus=1, max_gpus=64,
+                                  granule=4)
+        assert out == [4, 8]
+
+    def test_compute_config(self):
+        final_batch, valid, _ = compute_elastic_config(BASE)
+        assert final_batch <= BASE["max_train_batch_size"]
+        assert valid and all(BASE["min_gpus"] <= w <= BASE["max_gpus"]
+                             for w in valid)
+        # every valid world size actually factors the batch
+        for w in valid[:5]:
+            _, _, detail = compute_elastic_config(BASE, world_size=w)
+            assert detail["micro_batch"] * detail["gas"] * w == final_batch
+
+    def test_incompatible_world_size(self):
+        final_batch, valid, _ = compute_elastic_config(BASE)
+        bad = max(valid) + 1
+        while bad in valid:
+            bad += 1
+        with pytest.raises(ElasticityError, match="not in the elastic"):
+            compute_elastic_config(BASE, world_size=bad)
+
+    def test_disabled(self):
+        with pytest.raises(ElasticityError, match="not enabled"):
+            compute_elastic_config({"enabled": False})
+
+
+class TestAutotuner:
+
+    def test_picks_fastest_and_skips_failures(self):
+        import time
+
+        def run_fn(cand):
+            if cand["micro_batch"] == 64:
+                raise MemoryError("oom")  # surfaced at build time
+
+            def step():
+                time.sleep(0.001 if cand["micro_batch"] == 16 else 0.005)
+            return step
+
+        tuner = Autotuner(run_fn, micro_batch_sizes=[4, 16, 64],
+                          warmup_steps=1, measure_steps=2)
+        best = tuner.tune()
+        assert best.config["micro_batch"] == 16
+        failed = [r for r in tuner.results if not r.ok]
+        assert len(failed) == 1 and failed[0].error == "MemoryError"
+        assert "samples/s" in tuner.summary()
+
+    def test_all_fail(self):
+        def run_fn(cand):
+            raise RuntimeError("nope")
+
+        tuner = Autotuner(run_fn, micro_batch_sizes=[4])
+        with pytest.raises(RuntimeError, match="no viable config"):
+            tuner.tune()

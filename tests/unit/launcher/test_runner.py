@@ -1,0 +1,158 @@
+"""Launcher tests — pure unit, no cluster.
+
+Reference analog: ``tests/unit/launcher/`` (hostfile parsing + runner
+command construction).
+"""
+
+import pytest
+
+from hcache_deepspeed_tpu.launcher import (LaunchSpec, OpenMPIRunner,
+                                           SlurmRunner, SSHRunner,
+                                           build_launch_commands,
+                                           build_rank_agnostic_command,
+                                           decode_world_info,
+                                           encode_world_info, parse_hostfile,
+                                           parse_inclusion_exclusion)
+from hcache_deepspeed_tpu.launcher.launch import infer_process_env
+
+
+HOSTFILE = [
+    "worker-0 slots=4",
+    "worker-1 slots=4",
+    "# comment",
+    "worker-2 slots=8",
+    "",
+]
+
+
+class TestHostfile:
+
+    def test_parse(self):
+        res = parse_hostfile(HOSTFILE)
+        assert res == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+
+    def test_default_slots(self):
+        assert parse_hostfile(["justahost"]) == {"justahost": 1}
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_hostfile(["a slots=1", "a slots=2"])
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError, match="malformed"):
+            parse_hostfile(["host slots=abc"])
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            parse_hostfile(["# only comments"])
+
+
+class TestIncludeExclude:
+
+    def setup_method(self):
+        self.res = parse_hostfile(HOSTFILE)
+
+    def test_include_hosts(self):
+        out = parse_inclusion_exclusion(self.res, include_str="worker-1")
+        assert out == {"worker-1": 4}
+
+    def test_include_slots(self):
+        out = parse_inclusion_exclusion(self.res,
+                                        include_str="worker-2:0,1,2")
+        assert out == {"worker-2": 3}
+
+    def test_exclude_host(self):
+        out = parse_inclusion_exclusion(self.res, exclude_str="worker-0")
+        assert list(out) == ["worker-1", "worker-2"]
+
+    def test_exclude_slots(self):
+        out = parse_inclusion_exclusion(self.res, exclude_str="worker-2:0,1")
+        assert out["worker-2"] == 6
+
+    def test_both_rejected(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            parse_inclusion_exclusion(self.res, "worker-0", "worker-1")
+
+    def test_unknown_host_rejected(self):
+        with pytest.raises(ValueError, match="unknown hosts"):
+            parse_inclusion_exclusion(self.res, include_str="nope")
+
+
+class TestWorldInfo:
+
+    def test_roundtrip(self):
+        res = parse_hostfile(HOSTFILE)
+        assert decode_world_info(encode_world_info(res)) == dict(res)
+
+
+class TestLaunchCommands:
+
+    def test_per_host_env(self):
+        res = parse_hostfile(HOSTFILE)
+        cmds = build_launch_commands(res, "train.py", ["--foo", "1"])
+        assert len(cmds) == 3
+        host0, cmd0 = cmds[0]
+        assert host0 == "worker-0"
+        assert "HDS_COORDINATOR_ADDRESS=worker-0:7777" in cmd0
+        assert "HDS_PROCESS_ID=0" in cmd0
+        assert "HDS_NUM_PROCESSES=3" in cmd0
+        _, cmd2 = cmds[2]
+        assert "HDS_PROCESS_ID=2" in cmd2
+        assert "train.py --foo 1" in cmd2
+
+    def test_runner_cmds(self):
+        res = parse_hostfile(["a slots=1", "b slots=1"])
+        launch = LaunchSpec(res, "t.py", [])
+        ssh = SSHRunner(None).get_cmd(launch)
+        assert len(ssh) == 2 and ssh[0][0] == "ssh" and ssh[1][3] == "b"
+        mpi = OpenMPIRunner(None).get_cmd(launch)
+        assert mpi[0][:3] == ["mpirun", "-np", "2"]
+        slurm = SlurmRunner(None).get_cmd(launch)
+        assert slurm[0][0] == "srun" and "--nodes=2" in slurm[0]
+
+    def test_replicated_runners_are_rank_agnostic(self):
+        """mpirun/srun replicate ONE command — it must NOT pin a process
+        id; the rank comes from the scheduler env via launcher.launch."""
+        res = parse_hostfile(["a slots=1", "b slots=1"])
+        launch = LaunchSpec(res, "t.py", [])
+        for runner in (OpenMPIRunner(None), SlurmRunner(None)):
+            cmd = runner.get_cmd(launch)[0][-1]
+            assert "HDS_PROCESS_ID" not in cmd
+            assert "HDS_COORDINATOR_ADDRESS=a:7777" in cmd
+            assert "hcache_deepspeed_tpu.launcher.launch" in cmd
+        # the replicated command resolves its rank via infer_process_env
+        env = infer_process_env({"HDS_COORDINATOR_ADDRESS": "a:7777",
+                                 "HDS_NUM_PROCESSES": "2",
+                                 "OMPI_COMM_WORLD_RANK": "1"})
+        assert env["HDS_PROCESS_ID"] == "1"
+
+    def test_tpu_pod_omits_rendezvous_env(self):
+        """--tpu-pod: jax auto-discovers topology from pod metadata, the
+        launcher must not inject HDS_* rendezvous variables."""
+        res = parse_hostfile(["a slots=4", "b slots=4"])
+        for _, cmd in build_launch_commands(res, "t.py", [], tpu_pod=True):
+            assert "HDS_COORDINATOR_ADDRESS" not in cmd
+            assert "HDS_PROCESS_ID" not in cmd
+        agnostic = build_rank_agnostic_command(res, "t.py", [],
+                                               tpu_pod=True)
+        assert "HDS_COORDINATOR_ADDRESS" not in agnostic
+
+
+class TestLaunchEnv:
+
+    def test_mpi_env_mapping(self):
+        env = infer_process_env({"OMPI_COMM_WORLD_RANK": "3",
+                                 "OMPI_COMM_WORLD_SIZE": "8",
+                                 "MASTER_ADDR": "h0"})
+        assert env["HDS_PROCESS_ID"] == "3"
+        assert env["HDS_NUM_PROCESSES"] == "8"
+        assert env["HDS_COORDINATOR_ADDRESS"] == "h0:7777"
+
+    def test_slurm_env_mapping(self):
+        env = infer_process_env({"SLURM_PROCID": "1", "SLURM_NTASKS": "4"})
+        assert env["HDS_PROCESS_ID"] == "1"
+        assert env["HDS_NUM_PROCESSES"] == "4"
+
+    def test_existing_env_wins(self):
+        env = infer_process_env({"HDS_PROCESS_ID": "7", "RANK": "1"})
+        assert env["HDS_PROCESS_ID"] == "7"
