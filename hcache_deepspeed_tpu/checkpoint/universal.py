@@ -60,16 +60,32 @@ def load_state_tree(checkpoint_dir: str, tag: Optional[str] = None):
     return restored
 
 
+def _keystr_to_dotted(key: str) -> str:
+    """jax keystr "['a']['b']" -> "a.b" (offload masters are keyed by
+    keystr; device masters by nesting — normalize to one naming)."""
+    return key.replace("']['", ".").strip("[']")
+
+
 def get_fp32_state_dict_from_zero_checkpoint(
         checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
     """Reference: ``zero_to_fp32.py
     get_fp32_state_dict_from_zero_checkpoint`` — returns a flat
-    ``{param_name: fp32 ndarray}`` of the *master* weights (fp32 master if
-    present, else the params)."""
+    ``{param_name: fp32 ndarray}`` of the *master* weights: the device
+    fp32 master, else the host-offloaded master (ZeRO-Offload runs), else
+    the params."""
     state = load_state_tree(checkpoint_dir, tag)
-    source = state.get("master") or state["params"]
-    flat = _flatten(source)
-    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+    offload = state.get("offload") or {}
+    if state.get("master"):
+        flat = _flatten(state["master"])
+    elif offload.get("master"):
+        flat = {_keystr_to_dotted(k): v
+                for k, v in offload["master"].items()}
+    else:
+        flat = _flatten(state["params"])
+    # offload masters are stored flat — reshape to the param shapes
+    shapes = {k: np.shape(v) for k, v in _flatten(state["params"]).items()}
+    return {k: np.asarray(v, np.float32).reshape(shapes.get(k, np.shape(v)))
+            for k, v in flat.items()}
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(

@@ -282,7 +282,8 @@ def main(argv=None):
 
     if len(resources) == 1 and next(iter(resources)) in (
             "localhost", "127.0.0.1"):
-        env = dict(os.environ)
+        # same env propagation as the multi-host paths
+        env = dict(os.environ, **_load_exports(export_envs=args.export))
         cmd = [sys.executable, args.user_script] + args.user_args
         if args.dry_run:
             print(" ".join(map(shlex.quote, cmd)))

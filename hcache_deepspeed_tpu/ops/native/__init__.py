@@ -1,0 +1,6 @@
+"""Native (C++) host ops (reference: ``csrc/`` + ``op_builder/``)."""
+
+from .aio import AsyncIOBuilder, AsyncIOHandle  # noqa: F401
+from .builder import NativeOpBuilder  # noqa: F401
+from .cpu_adam import (CPUAdagrad, CPUAdam, CPUAdamBuilder,  # noqa: F401
+                       CPULion)

@@ -1,0 +1,109 @@
+"""Async file I/O (DeepNVMe analog).
+
+Reference analog: ``csrc/aio/py_lib/py_ds_aio.cpp`` — the ``aio_handle``
+object with ``async_pread/async_pwrite/wait`` used by ZeRO-Infinity's
+swap layer. Same surface over the C thread-pool library
+(``csrc/aio/hds_aio.cpp``) via ctypes; buffers are numpy arrays (host
+memory is the only pinning domain that matters on a TPU-VM).
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .builder import NativeOpBuilder, csrc_path
+
+
+class AsyncIOBuilder(NativeOpBuilder):
+    def __init__(self):
+        super().__init__("hds_aio", [csrc_path("aio", "hds_aio.cpp")])
+
+    def load(self):
+        lib = self.jit_load()
+        lib.hds_aio_create.restype = ctypes.c_int64
+        lib.hds_aio_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        for fn in (lib.hds_aio_submit_read, lib.hds_aio_submit_write):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                           ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.hds_aio_wait.restype = ctypes.c_int64
+        lib.hds_aio_wait.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.hds_aio_drain.restype = ctypes.c_int64
+        lib.hds_aio_drain.argtypes = [ctypes.c_int64]
+        lib.hds_aio_destroy.restype = ctypes.c_int
+        lib.hds_aio_destroy.argtypes = [ctypes.c_int64]
+        return lib
+
+
+class AsyncIOHandle:
+    """Reference: ``aio_handle`` (deepspeed_aio_thread.cpp) —
+    submit/wait async reads+writes of host arrays against files."""
+
+    def __init__(self, num_threads: int = 4, queue_depth: int = 32):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.hds_aio_create(num_threads, queue_depth)
+        if self._h <= 0:
+            raise RuntimeError("failed to create aio handle")
+        self._expected = {}  # request id -> nbytes (short-read detection)
+
+    @staticmethod
+    def _buf(arr: np.ndarray):
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("aio buffers must be C-contiguous")
+        return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+    def async_pwrite(self, arr: np.ndarray, path: str,
+                     offset: int = 0) -> int:
+        ptr, nbytes = self._buf(arr)
+        rid = self._lib.hds_aio_submit_write(self._h, path.encode(), ptr,
+                                             nbytes, offset)
+        if rid < 0:
+            raise OSError(-rid, f"aio write submit failed for {path}")
+        self._expected[rid] = nbytes
+        return rid
+
+    def async_pread(self, arr: np.ndarray, path: str,
+                    offset: int = 0) -> int:
+        ptr, nbytes = self._buf(arr)
+        rid = self._lib.hds_aio_submit_read(self._h, path.encode(), ptr,
+                                            nbytes, offset)
+        if rid < 0:
+            raise OSError(-rid, f"aio read submit failed for {path}")
+        self._expected[rid] = nbytes
+        return rid
+
+    def wait(self, request_id: int) -> int:
+        result = self._lib.hds_aio_wait(self._h, request_id)
+        if result < 0:
+            raise OSError(-result, "aio request failed")
+        expected = self._expected.pop(request_id, None)
+        if expected is not None and result != expected:
+            # a truncated swap file must never silently leave the tail of
+            # the destination buffer as uninitialized memory
+            raise OSError(
+                f"aio short transfer: {result} of {expected} bytes")
+        return result
+
+    def drain(self) -> int:
+        self._expected.clear()  # drain doesn't verify per-request sizes
+        return self._lib.hds_aio_drain(self._h)
+
+    def sync_pwrite(self, arr: np.ndarray, path: str,
+                    offset: int = 0) -> int:
+        return self.wait(self.async_pwrite(arr, path, offset))
+
+    def sync_pread(self, arr: np.ndarray, path: str,
+                   offset: int = 0) -> int:
+        return self.wait(self.async_pread(arr, path, offset))
+
+    def close(self):
+        if getattr(self, "_h", 0) > 0:
+            self._lib.hds_aio_destroy(self._h)
+            self._h = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,84 @@
+"""Native op build system.
+
+Reference analog: ``op_builder/builder.py`` — ``OpBuilder`` ABC with
+``jit_load`` (:542): compile C++ sources on first use, cache the shared
+object, expose ``is_compatible`` probes. Re-design: no torch
+cpp_extension — a bare g++ invocation producing a plain C-ABI .so loaded
+with ctypes (pybind11 is deliberately absent; SURVEY.md §7 native plan).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+def csrc_path(*parts) -> str:
+    return os.path.join(_REPO_ROOT, "csrc", *parts)
+
+
+def _build_dir() -> str:
+    d = os.environ.get("HDS_BUILD_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "hds_tpu", "build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class NativeOpBuilder:
+    """One native library: sources + flags -> cached .so -> ctypes CDLL."""
+
+    def __init__(self, name: str, sources: List[str],
+                 extra_flags: Optional[List[str]] = None):
+        self.name = name
+        self.sources = sources
+        self.extra_flags = list(extra_flags or [])
+        self._lib = None
+
+    # reference: OpBuilder.is_compatible — can we build/run here?
+    def is_compatible(self) -> bool:
+        from shutil import which
+        return which("g++") is not None and all(
+            os.path.exists(s) for s in self.sources)
+
+    def _so_path(self) -> str:
+        tag = hashlib.sha256()
+        for s in self.sources:
+            with open(s, "rb") as fh:
+                tag.update(fh.read())
+        tag.update(" ".join(self.extra_flags).encode())
+        return os.path.join(_build_dir(),
+                            f"{self.name}-{tag.hexdigest()[:16]}.so")
+
+    def jit_load(self) -> ctypes.CDLL:
+        """Compile-if-needed and dlopen (reference: builder.py:542)."""
+        if self._lib is not None:
+            return self._lib
+        so = self._so_path()
+        if not os.path.exists(so):
+            import tempfile
+            fd, tmp = tempfile.mkstemp(suffix=".so",
+                                       dir=os.path.dirname(so))
+            os.close(fd)
+            cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    "-pthread"] + self.extra_flags +
+                   self.sources + ["-o", tmp])
+            logger.info(f"building native op {self.name}: {' '.join(cmd)}")
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+                # per-process temp + atomic rename: concurrent builders
+                # (shared cache dir) each install a complete .so
+                os.replace(tmp, so)
+            except subprocess.CalledProcessError as e:
+                os.unlink(tmp)
+                raise RuntimeError(
+                    f"native build of {self.name} failed:\n{e.stderr}") \
+                    from e
+        self._lib = ctypes.CDLL(so)
+        return self._lib

@@ -1,0 +1,103 @@
+"""SIMD CPU optimizers (host-offload step).
+
+Reference analog: ``deepspeed.ops.adam.DeepSpeedCPUAdam`` over
+``csrc/adam/cpu_adam*.cpp`` (+ adagrad/lion siblings) — the optimizer that
+steps CPU-resident fp32 states for ZeRO-Offload. numpy-buffer interface
+via ctypes; semantics match optax.adamw (bias correction, decoupled decay)
+so host and device steps are interchangeable.
+"""
+
+import ctypes
+
+import numpy as np
+
+from .builder import NativeOpBuilder, csrc_path
+
+
+class CPUAdamBuilder(NativeOpBuilder):
+    def __init__(self):
+        super().__init__("hds_cpu_adam",
+                         [csrc_path("adam", "hds_cpu_adam.cpp")],
+                         extra_flags=["-march=native", "-funroll-loops"])
+
+    def load(self):
+        lib = self.jit_load()
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.hds_cpu_adam_step.restype = None
+        lib.hds_cpu_adam_step.argtypes = [
+            f32p, f32p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int64]
+        lib.hds_cpu_adagrad_step.restype = None
+        lib.hds_cpu_adagrad_step.argtypes = [
+            f32p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float]
+        lib.hds_cpu_lion_step.restype = None
+        lib.hds_cpu_lion_step.argtypes = [
+            f32p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        return lib
+
+
+def _f32(arr: np.ndarray):
+    if arr.dtype != np.float32 or not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("cpu optimizer buffers must be contiguous fp32")
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class CPUAdam:
+    """In-place AdamW over flat fp32 numpy buffers.
+
+    ``step(params, grads, m, v)`` mutates params/m/v. One instance tracks
+    the step count (reference: Adam_Optimizer::Step state)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self._lib = CPUAdamBuilder().load()
+
+    def step(self, params, grads, exp_avg, exp_avg_sq, lr=None, step=None):
+        """``step``: explicit 1-based step id (bias correction); when None
+        the instance counter is bumped (single-tensor usage)."""
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        self._lib.hds_cpu_adam_step(
+            _f32(params), _f32(grads), _f32(exp_avg), _f32(exp_avg_sq),
+            params.size, ctypes.c_float(lr if lr is not None else self.lr),
+            ctypes.c_float(self.beta1), ctypes.c_float(self.beta2),
+            ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+            step)
+
+
+class CPUAdagrad:
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self._lib = CPUAdamBuilder().load()
+
+    def step(self, params, grads, state, lr=None):
+        self._lib.hds_cpu_adagrad_step(
+            _f32(params), _f32(grads), _f32(state), params.size,
+            ctypes.c_float(lr if lr is not None else self.lr),
+            ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay))
+
+
+class CPULion:
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.weight_decay = weight_decay
+        self._lib = CPUAdamBuilder().load()
+
+    def step(self, params, grads, exp_avg, lr=None):
+        self._lib.hds_cpu_lion_step(
+            _f32(params), _f32(grads), _f32(exp_avg), params.size,
+            ctypes.c_float(lr if lr is not None else self.lr),
+            ctypes.c_float(self.beta1), ctypes.c_float(self.beta2),
+            ctypes.c_float(self.weight_decay))

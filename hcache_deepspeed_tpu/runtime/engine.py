@@ -175,6 +175,7 @@ class HDSEngine:
                                  else jnp.float32)
 
         # ---- optimizer / scheduler ----
+        self._user_optimizer = optimizer is not None
         if optimizer is None:
             if config.optimizer is not None:
                 optimizer = build_optimizer(config.optimizer.type,
@@ -199,6 +200,14 @@ class HDSEngine:
                                          tp_spec_fn=tp_spec_fn,
                                          min_shard_size=zcfg.min_shard_size)
         self._batch_spec_fn = batch_spec_fn
+
+        # ---- optimizer-state host offload (ZeRO-Offload / -Infinity) ----
+        self.offload_device = zcfg.offload_optimizer.device
+        self._offload = None
+        if self.offload_device not in ("none", "cpu", "nvme"):
+            raise ValueError(
+                f"offload_optimizer.device must be none|cpu|nvme, got "
+                f"{self.offload_device!r}")
 
         # ---- parameter init (sharded at creation; reference: zero.Init) ----
         self._rng_seed = config.seed
@@ -269,17 +278,48 @@ class HDSEngine:
         opt_specs = policy.opt_specs(params)
         self.opt_param_shardings = policy.named(opt_specs)
 
-        # fp32 master weights, sharded like optimizer state (stage>=1)
+        # fp32 master weights: on device sharded like optimizer state
+        # (stage>=1), or on HOST when the optimizer is offloaded
+        # (reference: ZeRO-Offload — grads D2H, SIMD step, params H2D)
         master = None
-        if self.mixed_precision:
-            master = jax.jit(lambda p: _cast_tree(p, jnp.float32),
-                             out_shardings=self.opt_param_shardings)(params)
-
-        # optimizer state: replicate scalars, shard per-param tensors
-        opt_state = jax.jit(
-            self.optimizer_def.init,
-            out_shardings=None)(master if master is not None else params)
-        opt_state = self._place_opt_state(opt_state)
+        opt_state = {}
+        if self.offload_device != "none":
+            from .offload import HostOffloadAdam
+            if self._user_optimizer:
+                raise ValueError(
+                    "offload_optimizer steps on host via the C++ CPUAdam "
+                    "kernel and cannot honor a user-supplied optimizer "
+                    "object; configure the optimizer via the JSON config")
+            opt_cfg = dict(self.config.optimizer.params) \
+                if self.config.optimizer else {}
+            opt_type = (self.config.optimizer.type.lower()
+                        if self.config.optimizer else "adamw")
+            if opt_type not in ("adam", "adamw", "fusedadam"):
+                raise ValueError(
+                    f"offload_optimizer supports adam/adamw, got "
+                    f"{opt_type}")
+            if opt_cfg.get("adam_w_mode") is False:
+                raise ValueError(
+                    "offload_optimizer implements decoupled (AdamW) decay "
+                    "only; adam_w_mode=False is not supported")
+            opt_cfg.pop("adam_w_mode", None)
+            self._offload = HostOffloadAdam(
+                jax.device_get(params), optimizer_cfg=opt_cfg,
+                clip=self.config.gradient_clipping,
+                nvme_dir=(self.config.zero_optimization.offload_optimizer
+                          .nvme_path
+                          if self.offload_device == "nvme" else None))
+        else:
+            if self.mixed_precision:
+                master = jax.jit(
+                    lambda p: _cast_tree(p, jnp.float32),
+                    out_shardings=self.opt_param_shardings)(params)
+            # optimizer state: replicate scalars, shard per-param tensors
+            opt_state = jax.jit(
+                self.optimizer_def.init,
+                out_shardings=None)(master if master is not None
+                                    else params)
+            opt_state = self._place_opt_state(opt_state)
 
         grad_acc = jax.jit(
             lambda p: jax.tree.map(
@@ -438,6 +478,11 @@ class HDSEngine:
             return new_state, finite, grad_norm
 
         self._apply_step = jax.jit(apply_step, donate_argnums=(0,))
+        # out_shardings pinned: zeros_like is a constant, so without the
+        # pin XLA would place the fresh buffers on one device
+        self._zero_grads = jax.jit(
+            lambda g: jax.tree.map(jnp.zeros_like, g), donate_argnums=(0,),
+            out_shardings=grad_shardings)
 
         # fully fused train_batch: scan microbatches then apply
         def fused_train_batch(state, batches, lr, rng):
@@ -541,13 +586,61 @@ class HDSEngine:
             return
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
-        lr = jnp.asarray(self._current_lr, jnp.float32)
-        self.state, finite, grad_norm = self._apply_step(self.state, lr)
+        if self._offload is not None:
+            finite = self._offload_step()
+        else:
+            lr = jnp.asarray(self._current_lr, jnp.float32)
+            self.state, finite, grad_norm = self._apply_step(self.state, lr)
         self._after_step(finite)
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).stop()
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
+
+    def _offload_step(self) -> bool:
+        """ZeRO-Offload step: grads D2H, SIMD host update of fp32 master +
+        moments (C++ kernel, NVMe-swapped when configured), params H2D."""
+        scale = float(self.state["loss_scale"])
+        grads = self._offload.grads_to_host(self.state["grad_acc"])
+        ok = self._offload.step(grads, self._current_lr, loss_scale=scale,
+                                check_finite=self.fp16_enabled)
+        if ok:
+            self.state["params"] = jax.device_put(
+                self._offload.params_tree(self.compute_dtype),
+                self.param_shardings)
+        self.state["grad_acc"] = self._zero_grads(self.state["grad_acc"])
+        self._update_loss_scale_host(ok)
+        return ok
+
+    def _update_loss_scale_host(self, finite: bool):
+        """Host-side mirror of the jitted dynamic loss-scale update."""
+        cfg = self.config.fp16
+        if not (self.fp16_enabled and cfg.loss_scale == 0):
+            return
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        scale = float(self.state["loss_scale"])
+        good = int(self.state["good_steps"])
+        hyst = int(self.state["hysteresis"])
+        if finite:
+            if good + 1 >= cfg.loss_scale_window:
+                scale, good = scale * 2.0, 0
+            else:
+                good += 1
+            if not cfg.consecutive_hysteresis:
+                hyst = cfg.hysteresis
+        else:
+            if hyst <= 1:
+                scale = max(scale / 2.0, cfg.min_loss_scale)
+                hyst = cfg.hysteresis
+            else:
+                hyst -= 1
+            good = 0
+        self.state["loss_scale"] = jax.device_put(
+            jnp.asarray(scale, jnp.float32), repl)
+        self.state["good_steps"] = jax.device_put(
+            jnp.asarray(good, jnp.int32), repl)
+        self.state["hysteresis"] = jax.device_put(
+            jnp.asarray(hyst, jnp.int32), repl)
 
     def _after_step(self, finite):
         self.global_steps += 1
@@ -577,6 +670,33 @@ class HDSEngine:
         if self.wall_clock_breakdown:
             self.timers(BATCH_TIMER).start()
         gas = self.gradient_accumulation_steps
+        if self._offload is not None:
+            # offloaded step is host-side: run the micro-batch loop through
+            # forward/backward/step instead of the fused device program
+            if batch is None and data_iter is None:
+                if self.training_dataloader is None:
+                    raise ValueError("train_batch needs data_iter or batch")
+                if self._data_iter is None:
+                    from .dataloader import RepeatingLoader
+                    self._data_iter = iter(
+                        RepeatingLoader(self.training_dataloader))
+                data_iter = self._data_iter
+            losses = []
+            for i in range(gas):
+                if batch is not None:
+                    micro = jax.tree.map(
+                        lambda x: np.asarray(x).reshape(
+                            (gas, -1) + np.asarray(x).shape[1:])[i], batch)
+                else:
+                    micro = next(data_iter)
+                losses.append(self.forward(micro))
+                self.backward()
+            self.step()
+            loss = float(np.mean([float(l) for l in losses]))
+            self.tput_timer.stop(report_speed=True)
+            if self.wall_clock_breakdown:
+                self.timers(BATCH_TIMER).stop()
+            return jnp.asarray(loss)
         if batch is None:
             if data_iter is None:
                 if self.training_dataloader is None:
@@ -668,7 +788,10 @@ class HDSEngine:
             "current_lr": self._current_lr,
             "client_state": client_state or {},
         }
-        _save(save_dir, tag, self.state, meta, save_latest=save_latest,
+        state = self.state
+        if self._offload is not None:
+            state = dict(state, offload=self._offload.state_dict())
+        _save(save_dir, tag, state, meta, save_latest=save_latest,
               checkpoint_engine=self.checkpoint_engine)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
         return True
@@ -702,11 +825,17 @@ class HDSEngine:
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         **kw):
         from .checkpointing import load_checkpoint as _load
-        state, meta = _load(load_dir, tag, self.state,
+        template = self.state
+        if self._offload is not None:
+            template = dict(template,
+                            offload=self._offload.template_state_dict())
+        state, meta = _load(load_dir, tag, template,
                             load_optimizer_states=load_optimizer_states,
                             checkpoint_engine=self.checkpoint_engine)
         if state is None:
             return None, {}
+        if self._offload is not None and "offload" in state:
+            self._offload.load_state_dict(state.pop("offload"))
         self.state = state
         self.global_steps = meta.get("global_steps", 0)
         self.micro_steps = meta.get("micro_steps", 0)

@@ -121,6 +121,33 @@ class TestConsolidation:
         assert info["num_params"] > 0
         assert info["meta"]["global_steps"] == 1
 
+    def test_fp32_consolidation_uses_offload_master(self, eight_devices,
+                                                    tmp_path):
+        """ZeRO-Offload runs keep fp32 masters on HOST — consolidation
+        must export those, not the upcast bf16 params."""
+        from hcache_deepspeed_tpu.checkpoint import \
+            get_fp32_state_dict_from_zero_checkpoint
+        from hcache_deepspeed_tpu.ops.native import CPUAdamBuilder
+        if not CPUAdamBuilder().is_compatible():
+            pytest.skip("no g++ toolchain")
+        cfg = gpt2_tiny()
+        batch = _batch(cfg)
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(data=8))
+        config = _config(2)
+        config["bf16"] = {"enabled": True}
+        config["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+        engine, _, _, _ = hds.initialize(
+            model=GPT2LMHeadModel(cfg), config=config,
+            example_batch=batch, topology=topo)
+        engine.train_batch(batch=batch)
+        engine.save_checkpoint(tmp_path, tag="off")
+        sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path), "off")
+        key = "wte.embedding"
+        host_master = engine._offload.master[
+            "['wte']['embedding']"].reshape(cfg.vocab_size, cfg.n_embd)
+        assert sd[key].shape == (cfg.vocab_size, cfg.n_embd)
+        np.testing.assert_allclose(sd[key], host_master, atol=0)
+
     def test_save_16bit_model(self, eight_devices, tmp_path):
         cfg = gpt2_tiny()
         batch = _batch(cfg)

@@ -1,0 +1,143 @@
+"""Native C++ op tests.
+
+Reference analogs: ``tests/unit/ops/aio/test_aio.py`` (read/write parity,
+async submit/wait) and ``tests/unit/ops/adam/test_cpu_adam.py`` (SIMD
+Adam vs reference numerics).
+"""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.ops.native import (AsyncIOBuilder, AsyncIOHandle,
+                                             CPUAdam, CPUAdamBuilder,
+                                             CPULion)
+
+
+@pytest.fixture(scope="module")
+def aio():
+    builder = AsyncIOBuilder()
+    if not builder.is_compatible():
+        pytest.skip("no g++ toolchain")
+    return AsyncIOHandle(num_threads=2)
+
+
+class TestAsyncIO:
+
+    def test_write_read_roundtrip(self, aio, tmp_path):
+        data = np.random.default_rng(0).standard_normal(
+            1 << 16).astype(np.float32)
+        path = str(tmp_path / "blob.bin")
+        n = aio.sync_pwrite(data, path)
+        assert n == data.nbytes
+        out = np.empty_like(data)
+        assert aio.sync_pread(out, path) == data.nbytes
+        np.testing.assert_array_equal(out, data)
+
+    def test_async_overlap(self, aio, tmp_path):
+        rng = np.random.default_rng(1)
+        bufs = [rng.standard_normal(1 << 14).astype(np.float32)
+                for _ in range(8)]
+        rids = [aio.async_pwrite(b, str(tmp_path / f"f{i}.bin"))
+                for i, b in enumerate(bufs)]
+        for rid in rids:
+            aio.wait(rid)
+        outs = [np.empty_like(b) for b in bufs]
+        rids = [aio.async_pread(o, str(tmp_path / f"f{i}.bin"))
+                for i, o in enumerate(outs)]
+        for rid in rids:
+            aio.wait(rid)
+        for o, b in zip(outs, bufs):
+            np.testing.assert_array_equal(o, b)
+
+    def test_offset_io(self, aio, tmp_path):
+        path = str(tmp_path / "off.bin")
+        a = np.arange(64, dtype=np.float32)
+        b = np.arange(64, 128, dtype=np.float32)
+        aio.sync_pwrite(a, path, offset=0)
+        aio.sync_pwrite(b, path, offset=a.nbytes)
+        out = np.empty(128, np.float32)
+        aio.sync_pread(out, path)
+        np.testing.assert_array_equal(out, np.arange(128, dtype=np.float32))
+
+    def test_missing_file_error(self, aio, tmp_path):
+        out = np.empty(16, np.float32)
+        with pytest.raises(OSError):
+            aio.wait(aio.async_pread(out, str(tmp_path / "nope.bin")))
+
+
+def _ref_adamw(p, g, m, v, lr, b1, b2, eps, wd, step):
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** step)
+    vhat = v / (1 - b2 ** step)
+    p = p - lr * (mhat / (np.sqrt(vhat) + eps) + wd * p)
+    return p, m, v
+
+
+class TestCPUAdam:
+
+    @pytest.fixture(scope="class")
+    def lib(self):
+        builder = CPUAdamBuilder()
+        if not builder.is_compatible():
+            pytest.skip("no g++ toolchain")
+        return builder.load()
+
+    @pytest.mark.parametrize("n", [7, 1024, 100_001])
+    def test_matches_reference(self, lib, n):
+        rng = np.random.default_rng(0)
+        p = rng.standard_normal(n).astype(np.float32)
+        ref_p = p.copy()
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        ref_m, ref_v = m.copy(), v.copy()
+        opt = CPUAdam(lr=1e-2, betas=(0.9, 0.95), eps=1e-8,
+                      weight_decay=0.01)
+        for step in range(1, 4):
+            g = rng.standard_normal(n).astype(np.float32)
+            opt.step(p, g.copy(), m, v)
+            ref_p, ref_m, ref_v = _ref_adamw(ref_p, g, ref_m, ref_v,
+                                             1e-2, 0.9, 0.95, 1e-8, 0.01,
+                                             step)
+            np.testing.assert_allclose(p, ref_p, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(m, ref_m, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(v, ref_v, rtol=2e-5, atol=2e-6)
+
+    def test_matches_device_optimizer(self, lib):
+        """Host SIMD step == the engine's device adamw (optax semantics)."""
+        import jax
+        import jax.numpy as jnp
+
+        from hcache_deepspeed_tpu.runtime.optimizers import build_optimizer
+        n = 512
+        rng = np.random.default_rng(2)
+        p0 = rng.standard_normal(n).astype(np.float32)
+        g0 = rng.standard_normal(n).astype(np.float32)
+
+        opt_def = build_optimizer("adamw", {"lr": 1e-3, "betas": [0.9, 0.999],
+                                            "eps": 1e-8,
+                                            "weight_decay": 0.0})
+        state = opt_def.init({"w": jnp.asarray(p0)})
+        updates, state = opt_def.update({"w": jnp.asarray(g0)}, state,
+                                        {"w": jnp.asarray(p0)},
+                                        jnp.float32(1e-3))
+        dev_p = np.asarray(jnp.asarray(p0) + updates["w"])
+
+        host_p, m, v = p0.copy(), np.zeros(n, np.float32), \
+            np.zeros(n, np.float32)
+        CPUAdam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8).step(
+            host_p, g0.copy(), m, v)
+        np.testing.assert_allclose(host_p, dev_p, rtol=1e-5, atol=1e-6)
+
+    def test_lion(self, lib):
+        n = 256
+        rng = np.random.default_rng(3)
+        p = rng.standard_normal(n).astype(np.float32)
+        m = np.zeros(n, np.float32)
+        g = rng.standard_normal(n).astype(np.float32)
+        p0 = p.copy()
+        CPULion(lr=1e-3, betas=(0.9, 0.99)).step(p, g.copy(), m)
+        c = 0.9 * 0 + 0.1 * g
+        np.testing.assert_allclose(p, p0 - 1e-3 * np.sign(c), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(m, 0.01 * g, rtol=1e-4, atol=1e-6)
