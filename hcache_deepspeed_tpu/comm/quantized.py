@@ -1,0 +1,134 @@
+"""Quantized + compressed collectives (ZeRO++ / 1-bit family).
+
+Reference analogs:
+* ``deepspeed/runtime/comm/coalesced_collectives.py`` —
+  ``all_to_all_quant_reduce`` (:81, qgZ: quantized gradient all-to-all
+  reduction) and ``reduce_scatter_coalesced`` (:158),
+* ``csrc/quantization/quant_reduce.cu`` / ``swizzled_quantize.cu`` — the
+  fused kernels those wrap,
+* ``deepspeed/runtime/comm/compressed.py`` — error-feedback 1-bit
+  compressed allreduce backing OnebitAdam (sign + scale with server-side
+  averaging).
+
+TPU re-design: each collective is a ``shard_map`` program over the named
+axis — quantize (Pallas int8 kernel) → move int8 bytes over ICI →
+dequantize-accumulate — so the wire volume drops 2-4x vs bf16/fp32
+exactly like the CUDA path, but the compiler schedules it (EQuARX-style,
+PAPERS.md). Must run under jit (partial-manual shard_map).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quantizer import reference_dequantize, reference_quantize
+from ..parallel.topology import DATA_AXIS, get_topology
+
+
+def _shmap(fn, mesh, axis, in_specs, out_specs):
+    return functools.partial(
+        jax.shard_map, mesh=mesh, axis_names={axis},
+        in_specs=in_specs, out_specs=out_specs, check_vma=False)(fn)
+
+
+def quantized_all_gather(x, axis=DATA_AXIS, group_size=256, num_bits=8,
+                         topology=None):
+    """All-gather with int8 wire format (qwZ: quantized weight gather).
+
+    x: [S, ...] sharded on dim 0 over ``axis``; returns the gathered
+    full array (dequantized). Reference: quantized_gather inside
+    partition_parameters.py:770 CUDAQuantizer usage.
+    """
+    topo = topology or get_topology()
+    n = topo.axis_size(axis)
+    if n == 1:
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    def gather(x_local):
+        q, scale, shape, count = reference_quantize(
+            x_local, group_size, num_bits)
+        q_all = jax.lax.all_gather(q, axis)          # int8 on the wire
+        s_all = jax.lax.all_gather(scale, axis)
+        deq = jax.vmap(
+            lambda qi, si: reference_dequantize(qi, si, shape, count)
+        )(q_all, s_all)
+        return deq.reshape((-1,) + x_local.shape[1:])
+
+    return _shmap(gather, topo.mesh, axis, (P(axis),), P())(x)
+
+
+def quant_reduce_local(x_local, axis=DATA_AXIS, group_size=256,
+                       num_bits=8):
+    """qgZ body, for use INSIDE a manual (shard_map) region.
+
+    x_local: this device's gradient [T, ...], T divisible by the axis
+    size. Quantizes each destination slice, all_to_all's int8 bytes,
+    dequant-averages — returns this device's [T/n, ...] slice of the
+    mean. Reference: coalesced_collectives.py:81 + quant_reduce.cu.
+    """
+    n = jax.lax.axis_size(axis)
+    T = x_local.shape[0]
+    parts = x_local.reshape((n, T // n) + x_local.shape[1:])
+
+    def quant_part(p):
+        return reference_quantize(p, group_size, num_bits)[:2]
+
+    qs, scales = jax.vmap(quant_part)(parts)
+    qs = jax.lax.all_to_all(qs, axis, 0, 0)        # int8 on the wire
+    scales = jax.lax.all_to_all(scales, axis, 0, 0)
+    part_shape = parts.shape[1:]
+    part_count = int(np.prod(part_shape))
+    deq = jax.vmap(lambda qi, si: reference_dequantize(
+        qi, si, part_shape, part_count))(qs, scales)
+    return jnp.mean(deq, axis=0)
+
+
+def all_to_all_quant_reduce(x, axis=DATA_AXIS, group_size=256, num_bits=8,
+                            topology=None):
+    """Quantized reduce-scatter over ``axis`` (qgZ).
+
+    x: [n, T, ...] sharded on dim 0 — row i is device i's local gradient.
+    Returns the global [T, ...] mean (each device ends with its 1/n
+    slice; the returned global array is the concatenation).
+    """
+    topo = topology or get_topology()
+    n = topo.axis_size(axis)
+    if n == 1:
+        return x[0]
+    from jax.sharding import PartitionSpec as P
+
+    def a2a_reduce(x_local):
+        return quant_reduce_local(x_local[0], axis, group_size, num_bits)
+
+    return _shmap(a2a_reduce, topo.mesh, axis, (P(axis),), P(axis))(x)
+
+
+def compressed_allreduce(x, error, axis=DATA_AXIS, topology=None):
+    """Error-feedback 1-bit allreduce (reference:
+    runtime/comm/compressed.py compressed_allreduce): compensate with the
+    carried error, transmit sign + per-device mean magnitude, average
+    across the axis, return (averaged tensor, new local error).
+
+    x, error: identical-shaped local tensors (replicated layout)."""
+    topo = topology or get_topology()
+    n = topo.axis_size(axis)
+    if n == 1:
+        return x, jnp.zeros_like(x)
+    from jax.sharding import PartitionSpec as P
+
+    def allreduce(x, error):
+        compensated = x + error
+        scale = jnp.mean(jnp.abs(compensated))
+        sign = jnp.sign(compensated)          # in {-1, 0, 1}
+        decompressed = sign * scale
+        new_error = compensated - decompressed
+        # sign as int8 on the wire; server-side averaging = psum / n
+        avg = jax.lax.psum(sign.astype(jnp.int8).astype(jnp.float32) *
+                           scale, axis) / n
+        return avg, new_error
+
+    return _shmap(allreduce, topo.mesh, axis, (P(), P()), (P(), P()))(
+        x, error)

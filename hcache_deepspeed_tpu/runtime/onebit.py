@@ -1,0 +1,116 @@
+"""1-bit Adam: communication-compressed data parallelism.
+
+Reference analog: ``deepspeed/runtime/fp16/onebit/adam.py:14 OnebitAdam``
+(+ the compressed backends in ``runtime/comm/``): a warmup stage of plain
+Adam with full-precision gradient allreduce, then a compression stage
+where the *momentum* is synchronized via error-feedback 1-bit allreduce
+and the variance term is frozen.
+
+TPU re-design: one ``shard_map``-over-``data`` train step. Each device
+computes its LOCAL gradient (batch shard, no automatic psum), then:
+
+* warmup (step < freeze_step): full-precision ``psum`` of gradients,
+  normal Adam update of m and v,
+* compression (step >= freeze_step): local momentum update
+  ``m = b1*m + (1-b1)*g`` with the LOCAL gradient, then the 1-bit
+  error-feedback allreduce of ``m`` (sign + scale over ICI — 32x less
+  wire volume), v frozen.
+
+State per device: (m_local, v_frozen, worker_error) — the worker error
+is intentionally *unsynchronized* (that is the 1-bit algorithm). At the
+jit level that per-device state must therefore be carried as an
+axis-stacked sharded array ([n, ...] with dim 0 on ``data``) and sliced
+to the local [1, ...] → [...] view inside the manual region — an
+out_spec that claims replication for a varying value is undefined
+behavior. See tests/unit/comm/test_quantized.py for the pattern.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..comm.quantized import compressed_allreduce
+from ..parallel.topology import DATA_AXIS
+
+
+class OnebitAdamState(NamedTuple):
+    m: any
+    v: any
+    error: any
+    step: any
+
+
+def onebit_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                freeze_step=100, axis=DATA_AXIS, topology=None):
+    """Returns (init_fn, update_fn) for use inside a shard_map train step.
+
+    ``update_fn(local_grads, state, params, lr=None)`` expects UNREDUCED
+    per-device gradients and performs its own (full or compressed)
+    cross-device synchronization.
+    """
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return OnebitAdamState(m=zeros(), v=zeros(), error=zeros(),
+                               step=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params, lr_now=None, compressed=False):
+        """``compressed`` is a TRACE-TIME flag (the reference flips stages
+        at ``freeze_step`` from the host too): collectives differ between
+        stages and XLA cannot put them inside a data-dependent branch —
+        the caller selects the stage, e.g.
+        ``compressed = engine_step >= freeze_step``."""
+        lr_now = lr if lr_now is None else lr_now
+        step = state.step + 1
+
+        if not compressed:
+            g = jax.tree.map(lambda x: jax.lax.pmean(x, axis), grads)
+            m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                             state.m, g)
+            v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                             state.v, g)
+            err = state.error
+        else:
+            m_local = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                                   state.m, grads)
+            flat_m, treedef = jax.tree.flatten(m_local)
+            flat_e = jax.tree.leaves(state.error)
+            synced, new_err = [], []
+            for m_i, e_i in zip(flat_m, flat_e):
+                s, e = _compressed_allreduce_inside(m_i, e_i)
+                synced.append(s)
+                new_err.append(e)
+            m = jax.tree.unflatten(treedef, synced)
+            err = jax.tree.unflatten(treedef, new_err)
+            v = state.v  # frozen
+
+        bc1 = 1 - b1 ** step.astype(jnp.float32)
+        bc2 = 1 - b2 ** step.astype(jnp.float32)
+
+        def upd(p, m, v):
+            mhat = m / bc1
+            vhat = v / bc2
+            return -(lr_now * (mhat / (jnp.sqrt(vhat) + eps) +
+                               weight_decay * p))
+
+        updates = jax.tree.map(upd, params, m, v)
+        return updates, OnebitAdamState(m=m, v=v, error=err, step=step)
+
+    def _compressed_allreduce_inside(x, error):
+        """compressed_allreduce body for use when already inside the
+        shard_map (no re-wrapping)."""
+        n = jax.lax.psum(jnp.ones(()), axis)
+        compensated = x + error
+        scale = jnp.mean(jnp.abs(compensated))
+        sign = jnp.sign(compensated)
+        new_error = compensated - sign * scale
+        avg = jax.lax.psum(sign * scale, axis) / n
+        return avg, new_error
+
+    return init, update
+
+
+__all__ = ["onebit_adam", "OnebitAdamState", "compressed_allreduce"]

@@ -9,3 +9,6 @@ cross-entropy (cross_entropy.py), and the FPDT chunked long-context engine
 from .layer import (DistributedAttention, seq_all_to_all,  # noqa: F401
                     ulysses_attention)
 from .cross_entropy import vocab_sequence_parallel_cross_entropy  # noqa: F401
+from .fpdt import (HostOffloadKV, chunked_attention,  # noqa: F401
+                   chunked_lm_loss)
+from .ring import make_ring_attention_fn, ring_attention  # noqa: F401

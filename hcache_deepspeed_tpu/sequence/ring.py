@@ -1,0 +1,137 @@
+"""Ring attention: P2P sequence parallelism for long context.
+
+Reference analog: none — the reference's tree has no ring-attention P2P
+variant (SURVEY.md §5: its long-context story is Ulysses all-to-all +
+FPDT chunking); this module supplies the equivalent capability the
+TPU-native way, as called for by the survey's long-context plan.
+
+Design: Q/K/V arrive sequence-sharded over the ``seq`` mesh axis
+([B, T/n, H, D] per device). Each device keeps its Q block resident while
+K/V blocks rotate around the ring with ``lax.ppermute`` (neighbor hops on
+ICI); partial attention is merged with the online-softmax update (the
+same update_out_and_lse recurrence FPDT uses, fpdt_layer.py:58). The
+whole loop is a ``lax.scan`` inside ``shard_map`` manual over ``seq``
+only, so it is differentiable (autodiff transposes the scan + ppermute
+into the reverse ring) and composes with data/tensor sharding on auto
+axes. Causality is handled per (q_block, kv_block) pair: full blocks
+below the diagonal, masked on the diagonal, skipped above it via
+``jnp.where`` on the block index — no dynamic control flow.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.topology import SEQ_AXIS, get_topology
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Online-softmax merge of two partial attention results.
+
+    o: [B, T, H, D]; lse: [B, H, T] log-sum-exp. The FPDT
+    ``update_out_and_lse`` recurrence, associative formulation. Fully
+    masked partials carry lse = -inf; the merge must stay NaN-free (and
+    NaN-free in the backward) when either or both sides are -inf, so the
+    exponentials are taken against a finite-clamped max."""
+    max_lse = jnp.maximum(lse1, lse2)
+    safe_max = jnp.where(jnp.isfinite(max_lse), max_lse, 0.0)
+    w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(lse1 - safe_max), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse2), jnp.exp(lse2 - safe_max), 0.0)
+    denom = w1 + w2
+    safe_denom = jnp.maximum(denom, 1e-38)
+    out = (o1 * w1.transpose(0, 2, 1)[..., None] +
+           o2 * w2.transpose(0, 2, 1)[..., None]) / \
+        safe_denom.transpose(0, 2, 1)[..., None]
+    new_lse = jnp.where(denom > 0, safe_max + jnp.log(safe_denom),
+                        -jnp.inf)
+    return out, new_lse
+
+
+def _block_attention(q, k, v, scale, mask):
+    """Partial attention of one (q-block, kv-block) pair.
+
+    Returns (out [B,T,H,D], lse [B,H,T]); fully-masked rows produce
+    -inf lse => zero weight in the merge."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    big_neg = jnp.finfo(jnp.float32).min
+    scores = jnp.where(mask, scores.astype(jnp.float32), big_neg)
+    lse = jax.nn.logsumexp(scores, axis=-1)                    # [B,H,Tq]
+    # fully masked rows: lse == big_neg; normalize against a clamped lse
+    # so exp stays 0 (never exp(-inf - -inf) = NaN), and report -inf lse
+    fully_masked = lse <= big_neg / 2
+    safe_lse = jnp.where(fully_masked, 0.0, lse)
+    probs = jnp.exp(scores - safe_lse[..., None])
+    probs = jnp.where(fully_masked[..., None], 0.0, probs)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    return out, jnp.where(fully_masked, -jnp.inf, lse)
+
+
+def ring_attention(q, k, v, causal=True, scale=None, axis_name=SEQ_AXIS,
+                   topology=None):
+    """Sequence-sharded exact attention over the ``seq`` ring.
+
+    q/k/v: [B, T_global, H, D] arrays sequence-sharded on dim 1 (the
+    standard activation sharding under ``seq`` parallelism). Must run
+    under jit (partial-manual shard_map).
+    """
+    topo = topology or get_topology()
+    n = topo.axis_size(axis_name)
+    if n == 1:
+        from ..ops.flash_attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    mesh = topo.mesh
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, axis_name)
+
+    @functools.partial(jax.shard_map, mesh=mesh, axis_names={axis_name},
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+    def ring(q, k, v):
+        B, T, H, _ = q.shape  # local block length T = T_global / n
+        my = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        rel = jnp.arange(T)
+        neg_inf_lse = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+
+        def step(carry, i):
+            out, lse, kv = carry
+            ki, vi = kv
+            src = (my - i) % n  # whose kv block we hold at hop i
+            if causal:
+                # diagonal: causal triangle; below: all ones; above: none
+                diag = rel[:, None] >= rel[None, :]
+                full = jnp.ones((T, T), bool)
+                none = jnp.zeros((T, T), bool)
+                mask = jnp.where(src == my, diag,
+                                 jnp.where(src < my, full, none))
+            else:
+                mask = jnp.ones((T, T), bool)
+            o_i, lse_i = _block_attention(q, ki, vi, scale,
+                                          mask[None, None])
+            out, lse = _merge(out, lse, o_i, lse_i)
+            kv = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, axis_name, perm), (ki, vi))
+            return (out, lse, kv), None
+
+        out0 = jnp.zeros_like(q)
+        (out, lse, _), _ = jax.lax.scan(
+            step, (out0, neg_inf_lse, (k, v)), jnp.arange(n))
+        return out
+
+    return ring(q, k, v)
+
+
+def make_ring_attention_fn(topology=None, axis_name=SEQ_AXIS):
+    """Drop-in ``attention_fn`` for the model families (same contract as
+    ``make_ulysses_attention_fn``)."""
+
+    def attention_fn(q, k, v, causal=True, scale=None):
+        return ring_attention(q, k, v, causal=causal, scale=scale,
+                              axis_name=axis_name, topology=topology)
+
+    return attention_fn
