@@ -27,6 +27,22 @@ import pytest  # noqa: E402
 # collection time, so this takes effect.
 jax.config.update("jax_platforms", "cpu")
 
+# HARNESS RULE — one collective launch in flight at a time.
+#
+# XLA's CPU in-process collectives make every participating device thread
+# block in a rendezvous (rendezvous.cc). Device programs run on a *shared*
+# thread pool, so if a Python loop enqueues many launches without
+# synchronizing, pool threads end up parked in different launches'
+# rendezvous and the process dies with SIGABRT after the 40s termination
+# timeout — taking all of pytest down (empirically deterministic on a
+# 1-core host with 8 virtual devices; `jax_cpu_enable_async_dispatch=False`
+# does NOT cover sharded computations and does not help).
+#
+# Any test loop that repeatedly calls a jitted function containing
+# psum/all_gather/etc must therefore `jax.block_until_ready(...)` (or fetch
+# a scalar) every iteration — which is also what the real engine train loop
+# does by fetching the loss.
+
 
 @pytest.fixture(autouse=True)
 def _reset_singletons():

@@ -120,12 +120,17 @@ class TestOnebitAdam:
         def loss(p):
             return float(jnp.mean((p["w"] - target) ** 2))
 
+        # block every iteration: unsynchronized launches of
+        # collective-bearing programs deadlock XLA's CPU rendezvous
+        # (see tests/conftest.py harness rule)
         l0 = loss(params)
         for _ in range(15):          # warmup stage
             params, state = warm_step(params, state, noise_sharded)
+            jax.block_until_ready(params)
         l_warm = loss(params)
         for _ in range(60):          # compression stage
             params, state = comp_step(params, state, noise_sharded)
+            jax.block_until_ready(params)
         l_final = loss(params)
         assert int(jax.device_get(jax.tree.leaves(state.step)[0])) == 75
         assert l_warm < l0
