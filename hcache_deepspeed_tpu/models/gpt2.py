@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from ..ops.flash_attention import attention as flash_attention
 from ..parallel.topology import TENSOR_AXIS
 
 
@@ -29,6 +30,7 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     dtype: str = "float32"
     remat: bool = False
+    use_flash: bool = True
 
     @property
     def compute_dtype(self):
@@ -60,17 +62,22 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(C // H).astype(
-            x.dtype)
-        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
-        big_neg = jnp.finfo(jnp.float32).min
-        att = jnp.where(causal[None, None], att.astype(jnp.float32), big_neg)
-        if mask is not None:
-            att = jnp.where(mask[:, None, None, :], att, big_neg)
-        att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
-        if train and cfg.dropout > 0:
-            att = nn.Dropout(cfg.dropout, deterministic=False)(att)
-        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        use_dropout = train and cfg.dropout > 0
+        if cfg.use_flash and mask is None and not use_dropout:
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                C // H).astype(x.dtype)
+            causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+            big_neg = jnp.finfo(jnp.float32).min
+            att = jnp.where(causal[None, None], att.astype(jnp.float32),
+                            big_neg)
+            if mask is not None:
+                att = jnp.where(mask[:, None, None, :], att, big_neg)
+            att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
+            if use_dropout:
+                att = nn.Dropout(cfg.dropout, deterministic=False)(att)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
         return nn.Dense(C, dtype=x.dtype, name="c_proj")(y)
 
 

@@ -13,15 +13,20 @@ import jax
 
 from .abstract import Platform
 
-# Peak dense-matmul bf16 TFLOP/s per chip, by TPU generation (public specs).
+# Peak dense-matmul bf16 TFLOP/s per *jax device*, by TPU generation
+# (public specs). v2/v3 expose one TensorCore per device (half a chip);
+# v4 onward expose the whole chip (megacore / single core), so the
+# per-device peak is the full chip figure: v4 275, v5e 197, v5p 459,
+# v6e 918.
 _PEAK_BF16_TFLOPS = {
     "v2": 22.5,
     "v3": 61.5,
-    "v4": 137.5,
-    "v5 lite": 98.3,
-    "v5e": 98.3,
-    "v5p": 229.1,
-    "v6e": 459.2,
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
 }
 
 
