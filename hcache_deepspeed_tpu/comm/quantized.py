@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.quantizer import reference_dequantize, reference_quantize
+from ..ops.quantizer import dequantize, quantize
 from ..parallel.topology import DATA_AXIS, get_topology
 
 
@@ -48,12 +48,12 @@ def quantized_all_gather(x, axis=DATA_AXIS, group_size=256, num_bits=8,
     from jax.sharding import PartitionSpec as P
 
     def gather(x_local):
-        q, scale, shape, count = reference_quantize(
+        q, scale, shape, count = quantize(
             x_local, group_size, num_bits)
         q_all = jax.lax.all_gather(q, axis)          # int8 on the wire
         s_all = jax.lax.all_gather(scale, axis)
         deq = jax.vmap(
-            lambda qi, si: reference_dequantize(qi, si, shape, count)
+            lambda qi, si: dequantize(qi, si, shape, count)
         )(q_all, s_all)
         return deq.reshape((-1,) + x_local.shape[1:])
 
@@ -74,14 +74,14 @@ def quant_reduce_local(x_local, axis=DATA_AXIS, group_size=256,
     parts = x_local.reshape((n, T // n) + x_local.shape[1:])
 
     def quant_part(p):
-        return reference_quantize(p, group_size, num_bits)[:2]
+        return quantize(p, group_size, num_bits)[:2]
 
     qs, scales = jax.vmap(quant_part)(parts)
     qs = jax.lax.all_to_all(qs, axis, 0, 0)        # int8 on the wire
     scales = jax.lax.all_to_all(scales, axis, 0, 0)
     part_shape = parts.shape[1:]
     part_count = int(np.prod(part_shape))
-    deq = jax.vmap(lambda qi, si: reference_dequantize(
+    deq = jax.vmap(lambda qi, si: dequantize(
         qi, si, part_shape, part_count))(qs, scales)
     return jnp.mean(deq, axis=0)
 

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig
+from ..ops.paged_attention import paged_attention
 from ..ops.rms_norm import rms_norm
 from ..ops.rope import apply_rope, rope_frequencies
 
@@ -108,30 +109,16 @@ class PagedInferenceModel:
     def _paged_attention(self, q, ck, cv, tables, q_positions, kv_len):
         """q: [B, T, Hq, D]; ck/cv: [P, KV, D]; tables: [B, NB];
         q_positions: [B, T] absolute; kv_len: [B] valid cache length.
-        Returns [B, T, Hq*D]."""
-        cfg = self.cfg
+        Returns [B, T, Hq*D].
+
+        Dispatches to the Pallas ragged paged-attention kernel
+        (``ops/paged_attention.py`` — the blocked_flash analog): block-
+        table-indexed flash over valid blocks only, no dense [B, S_max]
+        gather, no GQA repeat."""
         B, T, Hq, D = q.shape
-        BS = self.block_size
-        NB = tables.shape[1]
-        S = NB * BS
-        # flat gather indices for every cache position of each sequence
-        pos = jnp.arange(S)
-        gather = tables[:, pos // BS] * BS + pos % BS          # [B, S]
-        k_seq = ck[gather]                                     # [B,S,KV,D]
-        v_seq = cv[gather]
-        if cfg.n_kv_head < Hq:
-            rep = Hq // cfg.n_kv_head
-            k_seq = jnp.repeat(k_seq, rep, axis=2)
-            v_seq = jnp.repeat(v_seq, rep, axis=2)
-        scale = 1.0 / np.sqrt(D)
-        scores = jnp.einsum("bthd,bshd->bhts", q, k_seq) * scale
-        # causal over absolute positions + cache-length bound
-        valid = (pos[None, None, :] <= q_positions[:, :, None]) & \
-                (pos[None, None, :] < kv_len[:, None, None])
-        scores = jnp.where(valid[:, None], scores.astype(jnp.float32),
-                           jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v_seq)
+        start = q_positions[:, 0]  # chunk rows are consecutive positions
+        out = paged_attention(q, ck, cv, tables, start, kv_len,
+                              self.block_size)
         return out.reshape(B, T, Hq * D)
 
     def _layer_step(self, x, lp, ck, cv, tables, positions, flat_idx,

@@ -80,7 +80,8 @@ def _ensure_loaded():
     if _loaded:
         return
     _loaded = True
-    from . import flash_attention, quantizer, rms_norm, rope  # noqa: F401
+    from . import (flash_attention, paged_attention, quantizer,  # noqa: F401
+                   rms_norm, rope)
 
 
 __all__ = ["register_op", "get_op", "get_op_impl", "op_report"]

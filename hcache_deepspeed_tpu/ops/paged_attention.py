@@ -1,0 +1,204 @@
+"""Ragged paged attention (Pallas TPU) — the inference engine's hot kernel.
+
+Reference analog: the inference-v2 ragged kernel set —
+``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash/`` (flash
+attention over a blocked KV cache driven by a block table) and the atom
+builder that windows it. On TPU the idiomatic form is a grid over
+(sequence, kv-head, cache-block) with the block table in scalar-prefetch
+memory so each grid step's ``index_map`` DMAs exactly the cache block the
+table names — no ``[B, S_max]`` gather materialization, no GQA
+``jnp.repeat``; online softmax accumulates across a sequence's valid
+blocks only.
+
+Ragged batching contract (matches ``inference/model.py``):
+
+* ``q``        [B, T, Hq, D] — T=1 rows for a ragged decode batch, or a
+  prefill chunk (B=1, T=bucket); padded query rows are dropped by the
+  caller.
+* ``k_pool``/``v_pool`` [P, KV, D] — the flat block pool, P = NBLK * BS.
+* ``tables``   [B, NB] int32 — per-sequence block table (0-padded).
+* ``start``    [B] first absolute position of the chunk's queries.
+* ``kv_len``   [B] valid cache length (= start + t_len).
+
+Cost scales with the *actual* context: trailing table slots clamp to the
+last valid block in the ``index_map``, and Pallas skips the DMA when the
+block index repeats, so out-of-range blocks cost neither bandwidth nor
+(predicated-off) FLOPs.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import register_op
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------ #
+# Reference implementation (CPU/debug; also the parity oracle)
+# ------------------------------------------------------------------ #
+def reference_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
+                              block_size):
+    """Dense-gather oracle. [B,T,Hq,D] out, grouped GQA (no repeat)."""
+    B, T, Hq, D = q.shape
+    KV = k_pool.shape[1]
+    G = Hq // KV
+    BS = block_size
+    NB = tables.shape[1]
+    S = NB * BS
+    pos = jnp.arange(S)
+    gather = tables[:, pos // BS] * BS + pos % BS            # [B, S]
+    k_seq = k_pool[gather]                                   # [B,S,KV,D]
+    v_seq = v_pool[gather]
+    qg = q.reshape(B, T, KV, G, D)
+    scale = 1.0 / np.sqrt(D)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k_seq) * scale
+    q_pos = start[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    valid = (pos[None, None, :] <= q_pos[:, :, None]) & \
+            (pos[None, None, :] < kv_len[:, None, None])     # [B,T,S]
+    scores = jnp.where(valid[:, None, None], scores.astype(jnp.float32),
+                       _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_seq)
+    return out.reshape(B, T, Hq, D)
+
+
+# ------------------------------------------------------------------ #
+# Pallas kernel
+# ------------------------------------------------------------------ #
+def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
+            q_ref, k_ref, v_ref,                 # [1,1,TGp,D], [1,BS,1,D]
+            o_ref,                               # [1,1,TGp,D]
+            acc, m_s, l_s,                       # VMEM scratch
+            *, scale, G, BS, TGp):
+    b, nb = pl.program_id(0), pl.program_id(2)
+    nblocks = pl.num_programs(2)
+
+    @pl.when(nb == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    kvlen = kvlen_ref[b]
+    start = start_ref[b]
+    run = nb * BS < kvlen
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)                  # [TGp, D]
+        k = k_ref[0, :, 0].astype(jnp.float32)               # [BS, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [TGp, BS]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (TGp, BS), 0)
+        cols = nb * BS + jax.lax.broadcasted_iota(jnp.int32, (TGp, BS), 1)
+        row_pos = start + rows // G
+        ok = (cols <= row_pos) & (cols < kvlen)
+        s = jnp.where(ok, s, _NEG_INF)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:, :1] = corr * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        m_s[:, :1] = m_new
+        v = v_ref[0, :, 0].astype(jnp.float32)               # [BS, D]
+        acc[:] = acc[:] * corr + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(nb == nblocks - 1)
+    def _out():
+        l = l_s[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
+
+
+def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
+                           block_size, interpret=None):
+    if interpret is None:
+        from ..platform import get_platform
+        interpret = not get_platform().supports_pallas()
+    B, T, Hq, D = q.shape
+    KV = k_pool.shape[1]
+    G = Hq // KV
+    BS = block_size
+    NB = tables.shape[1]
+    NBLK = k_pool.shape[0] // BS
+
+    # [B, KV, T*G, D] query layout: one contiguous row block per kv head
+    qg = q.reshape(B, T, KV, G, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, KV, T * G, D)
+    TG = T * G
+    TGp = max(8, -(-TG // 8) * 8)  # Mosaic sublane alignment
+    if TGp != TG:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, TGp - TG), (0, 0)))
+
+    kp = k_pool.reshape(NBLK, BS, KV, D)
+    vp = v_pool.reshape(NBLK, BS, KV, D)
+    tables = jnp.asarray(tables, jnp.int32)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+
+    def page_index(b, h, nb, tables_ref, kvlen_ref, start_ref):
+        # clamp out-of-range slots to the last valid block: repeated block
+        # index ⇒ Pallas skips the DMA, so dead slots cost nothing
+        last = jnp.maximum(kvlen_ref[b] - 1, 0) // BS
+        return (tables_ref[b, jnp.minimum(nb, last)], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KV, NB),
+        in_specs=[
+            pl.BlockSpec((1, 1, TGp, D),
+                         lambda b, h, nb, *refs: (b, h, 0, 0)),
+            pl.BlockSpec((1, BS, 1, D), page_index),
+            pl.BlockSpec((1, BS, 1, D), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, TGp, D),
+                               lambda b, h, nb, *refs: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((TGp, D), jnp.float32),
+            pltpu.VMEM((TGp, 128), jnp.float32),
+            pltpu.VMEM((TGp, 128), jnp.float32),
+        ],
+    )
+    kern = functools.partial(_kernel, scale=1.0 / np.sqrt(D), G=G, BS=BS,
+                             TGp=TGp)
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, TGp, D), q.dtype),
+        interpret=interpret,
+    )(tables, kv_len, start, qg, kp, vp)
+    out = out[:, :, :TG].reshape(B, KV, T, G, D).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, T, Hq, D)
+
+
+def _dispatch_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
+                              block_size):
+    B, T, Hq, D = q.shape
+    KV = k_pool.shape[1]
+    if Hq % KV:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of kv heads ({KV})")
+    # alignment guards: the kernel needs whole, sublane-aligned blocks
+    if k_pool.shape[0] % block_size or block_size % 8:
+        return reference_paged_attention(q, k_pool, v_pool, tables, start,
+                                         kv_len, block_size)
+    return pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
+                                  block_size)
+
+
+def paged_attention(q, k_pool, v_pool, tables, start, kv_len, block_size):
+    from . import get_op
+    return get_op("paged_attention")(q, k_pool, v_pool, tables, start,
+                                     kv_len, block_size)
+
+
+register_op("paged_attention", reference_paged_attention,
+            _dispatch_paged_attention)

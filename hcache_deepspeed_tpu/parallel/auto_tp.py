@@ -1,0 +1,164 @@
+"""AutoTP — automatic tensor-parallel sharding rules from the module tree.
+
+Reference analog: ``deepspeed/module_inject/auto_tp.py:193 AutoTP``
+(module-graph analysis that picks which Linears become column-parallel
+``LinearLayer`` vs row-parallel ``LinearAllreduce``), the per-arch policy
+tables, and ``tp_model_init`` (``deepspeed/__init__.py:369``).
+
+TPU re-design: there is no module graph to rewrite — sharding is a
+*PartitionSpec per parameter leaf*, and XLA inserts the collectives. So
+AutoTP reduces to classifying each kernel in the parameter pytree:
+
+1. **Name rules** — the HF-family projection names the reference's
+   policies encode (q/k/v/gate/up/c_attn/… → column; o/down/c_proj/… →
+   row; router gates → replicated).
+2. **Vocab rule** — ``nn.Embed`` tables split their feature dim; a kernel
+   whose output dim equals the detected vocab size (untied LM head)
+   splits that vocab dim.
+3. **Shape rule** — unmatched rectangular kernels: expanding
+   (in < out) → column, contracting (in > out) → row (the
+   fused-QKV / MLP-up vs MLP-down signature).
+4. **Sibling rule** — an unmatched *square* kernel in a block that
+   already has column-classified siblings and no row yet is the block's
+   output projection → row (the reference's "last linear before the
+   residual becomes LinearAllreduce" scan, auto_tp.py).
+5. Anything still ambiguous stays replicated — under GSPMD a missing
+   constraint can cost performance but never correctness (unlike the
+   reference's physical module surgery, a wrong guess cannot change the
+   math the compiler produces).
+
+Expert stacks (leading ``[E, ...]`` dim under an ``experts`` module) shard
+E on the ``expert`` axis and their in/out dims per the same col/row rules.
+"""
+
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+from .topology import EXPERT_AXIS, TENSOR_AXIS
+
+# exact path-segment names (substring matching would confuse the MoE
+# router "gate" with "gate_proj")
+COLUMN_NAMES = frozenset({
+    "q_proj", "k_proj", "v_proj", "qkv_proj", "query_key_value", "Wqkv",
+    "gate_proj", "up_proj", "c_attn", "c_fc", "w1", "w3", "wi", "fc1",
+    "query", "key", "value", "dense_h_to_4h", "in_proj", "fc_in",
+})
+ROW_NAMES = frozenset({
+    "o_proj", "down_proj", "c_proj", "w2", "wo", "fc2", "out_proj",
+    "dense_4h_to_h", "fc_out", "attn_out",
+})
+ROUTER_NAMES = frozenset({"wg", "router", "gate"})
+EXPERT_STACK_NAMES = frozenset({"experts", "expert", "moe"})
+
+
+def _segments(path) -> Tuple[str, ...]:
+    return tuple(str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path)
+
+
+_LAYER_IDX = re.compile(r"^(.+_)?\d+$")  # h_0, layers_3, bare "5" — not fc1
+
+
+def _block_key(segs: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Group leaves by their repeated-layer module (h_0, layers_3, ...):
+    the innermost path prefix ending in a layer-index segment. Kernel
+    names that merely end in a digit (fc1, w2) are not layer indices."""
+    for i in range(len(segs) - 1, -1, -1):
+        seg = segs[i]
+        if seg.isdigit() or ("_" in seg
+                             and _LAYER_IDX.match(seg)
+                             and seg.rsplit("_", 1)[1].isdigit()):
+            return segs[:i + 1]
+    return segs[:1]
+
+
+def derive_tp_specs(param_tree, *, tensor_axis=TENSOR_AXIS,
+                    expert_axis=EXPERT_AXIS) -> Dict[Tuple[str, ...], Any]:
+    """Classify every leaf of ``param_tree`` (arrays or ShapeDtypeStructs).
+
+    Returns {path-segments: PartitionSpec}.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(param_tree)[0]
+    info = [(_segments(path), leaf.shape) for path, leaf in leaves]
+
+    # vocab detection from embedding tables ([V, E] nn.Embed leaves)
+    vocab_dims = {shape[0] for segs, shape in info
+                  if segs[-1] == "embedding" and len(shape) == 2}
+
+    specs: Dict[Tuple[str, ...], Any] = {}
+    unresolved = []  # (segs, shape) square kernels for the sibling rule
+
+    for segs, shape in info:
+        name_set = set(segs)
+        if len(shape) < 2:
+            specs[segs] = PartitionSpec()
+            continue
+        if name_set & ROUTER_NAMES:
+            specs[segs] = PartitionSpec()  # replicated fp32 router
+            continue
+        if len(shape) == 3 and (name_set & EXPERT_STACK_NAMES):
+            # stacked experts [E, in, out]
+            if name_set & ROW_NAMES or shape[1] > shape[2]:
+                specs[segs] = PartitionSpec(expert_axis, tensor_axis, None)
+            elif name_set & COLUMN_NAMES or shape[1] < shape[2]:
+                specs[segs] = PartitionSpec(expert_axis, None, tensor_axis)
+            else:
+                specs[segs] = PartitionSpec(expert_axis)
+            continue
+        if segs[-1] == "embedding":
+            specs[segs] = PartitionSpec(None, tensor_axis)
+            continue
+        if len(shape) == 2 and shape[1] in vocab_dims and \
+                shape[0] not in vocab_dims:
+            specs[segs] = PartitionSpec(None, tensor_axis)  # untied LM head
+            continue
+        if name_set & COLUMN_NAMES:
+            specs[segs] = PartitionSpec(
+                *([None] * (len(shape) - 1)), tensor_axis)
+            continue
+        if name_set & ROW_NAMES:
+            specs[segs] = PartitionSpec(
+                tensor_axis, *([None] * (len(shape) - 1)))
+            continue
+        if len(shape) == 2 and shape[0] < shape[1]:
+            specs[segs] = PartitionSpec(None, tensor_axis)
+            continue
+        if len(shape) == 2 and shape[0] > shape[1]:
+            specs[segs] = PartitionSpec(tensor_axis, None)
+            continue
+        unresolved.append((segs, shape))
+
+    # sibling rule for square kernels
+    by_block: Dict[Tuple[str, ...], Dict[str, int]] = {}
+    for segs, spec in specs.items():
+        blk = by_block.setdefault(_block_key(segs), {"col": 0, "row": 0})
+        if len(spec) >= 1 and spec[-1] == tensor_axis:
+            blk["col"] += 1
+        elif len(spec) >= 1 and spec[0] == tensor_axis:
+            blk["row"] += 1
+    for segs, shape in unresolved:
+        blk = by_block.get(_block_key(segs), {"col": 0, "row": 0})
+        if blk["col"] > 0:
+            # square kernel among column-classified siblings: it is an
+            # output projection closing a col-parallel group → row
+            specs[segs] = PartitionSpec(tensor_axis, None)
+        else:
+            specs[segs] = PartitionSpec()  # ambiguous: replicate (safe)
+    return specs
+
+
+def auto_tp_spec_fn(param_tree, *, tensor_axis=TENSOR_AXIS,
+                    expert_axis=EXPERT_AXIS):
+    """``tp_spec_fn(path, leaf) -> PartitionSpec`` derived from the tree
+    (drop-in for the hand-written per-model spec fns; reference:
+    ``tp_model_init``)."""
+    table = derive_tp_specs(param_tree, tensor_axis=tensor_axis,
+                            expert_axis=expert_axis)
+
+    def spec_fn(path, leaf):
+        return table.get(_segments(path), PartitionSpec())
+
+    return spec_fn

@@ -199,7 +199,22 @@ class HDSEngine:
         self.policy = ZeroShardingPolicy(zcfg.stage, topology,
                                          tp_spec_fn=tp_spec_fn,
                                          min_shard_size=zcfg.min_shard_size)
+        # AutoTP (reference: tp_model_init, module_inject/auto_tp.py:193):
+        # with tensor/expert axes active and no hand-written rules, derive
+        # PartitionSpecs from the parameter tree at init time.
+        self._auto_tp = tp_spec_fn is None and (
+            topology.tensor_size > 1 or topology.expert_size > 1)
         self._batch_spec_fn = batch_spec_fn
+
+        # ---- ZeRO++ (qwZ / qgZ / hpZ) ----
+        self._zeropp = (zcfg.zero_quantized_weights
+                        or zcfg.zero_quantized_gradients
+                        or zcfg.zero_hpz_partition_size > 1)
+        if self._zeropp:
+            from .zero.zeropp import validate_zeropp
+            validate_zeropp(zcfg, zcfg.stage, topology.data_size)
+            if topology.data_size == 1:
+                self._zeropp = False  # single data shard: nothing to wire
 
         # ---- optimizer-state host offload (ZeRO-Offload / -Infinity) ----
         self.offload_device = zcfg.offload_optimizer.device
@@ -219,6 +234,7 @@ class HDSEngine:
         self.skipped_steps = 0
         self._pending = None  # loss between forward() and backward()
         self._data_iter = None  # persistent train_batch iterator
+        self._last_grad_norm = None  # device scalar from the latest step
 
         # ---- timers / monitor ----
         self.wall_clock_breakdown = config.wall_clock_breakdown
@@ -259,6 +275,9 @@ class HDSEngine:
             rng = jax.random.PRNGKey(self._rng_seed)
             shapes = jax.eval_shape(
                 lambda r: self.adapter.init_params(r, example_batch), rng)
+            if self._auto_tp:
+                from ..parallel.auto_tp import auto_tp_spec_fn
+                policy.tp_spec_fn = auto_tp_spec_fn(shapes)
             param_shardings = policy.named(policy.param_specs(shapes))
             init_fn = jax.jit(
                 lambda r: _cast_tree(
@@ -268,6 +287,9 @@ class HDSEngine:
             params = init_fn(rng)
         else:
             params = _cast_tree(init_params, self.compute_dtype)
+            if self._auto_tp:
+                from ..parallel.auto_tp import auto_tp_spec_fn
+                policy.tp_spec_fn = auto_tp_spec_fn(params)
             param_shardings = policy.named(policy.param_specs(params))
             params = jax.device_put(params, param_shardings)
 
@@ -359,6 +381,35 @@ class HDSEngine:
     # ------------------------------------------------------------------ #
     # Compiled step functions
     # ------------------------------------------------------------------ #
+    def _resolve_remat_policy(self):
+        """``compile.remat_policy`` (or ``activation_checkpointing.policy``)
+        → a ``jax.checkpoint_policies`` member. The reference's
+        activation-checkpointing subsystem
+        (runtime/activation_checkpointing/checkpointing.py) maps onto
+        ``jax.checkpoint`` applied around the loss/model computation."""
+        name = self.config.compile.remat_policy or \
+            self.config.activation_checkpointing.policy
+        if not name:
+            return None
+        if name in ("full", "all", "nothing"):
+            name = "nothing_saveable"
+        # whitelist of actual policies — jax.checkpoint_policies also holds
+        # *factories* (save_only_these_names, ...) that would silently
+        # disable remat if passed straight to jax.checkpoint
+        allowed = ("everything_saveable", "nothing_saveable",
+                   "dots_saveable", "checkpoint_dots",
+                   "dots_with_no_batch_dims_saveable",
+                   "checkpoint_dots_with_no_batch_dims",
+                   "offload_dot_with_no_batch_dims")
+        pol = getattr(jax.checkpoint_policies, name, None)
+        if name not in allowed or pol is None:
+            from .config import HDSConfigError
+            avail = [n for n in allowed
+                     if hasattr(jax.checkpoint_policies, n)]
+            raise HDSConfigError(
+                f"unknown remat policy {name!r}; available: {avail}")
+        return pol
+
     def _build_step_functions(self):
         policy = self.policy
         mesh = self.mesh
@@ -371,11 +422,20 @@ class HDSEngine:
         mixed = self.mixed_precision
         grad_shardings = self.grad_shardings
         param_shardings = self.param_shardings
+        remat_policy = self._resolve_remat_policy()
 
         def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train):
-            def scaled_loss(p):
+            def raw_loss(p):
                 loss, _aux = self.adapter.loss(p, batch, rng, train=train)
-                return loss * loss_scale / gas
+                return loss
+
+            if remat_policy is not None:
+                loss_of_p = jax.checkpoint(raw_loss, policy=remat_policy)
+            else:
+                loss_of_p = raw_loss
+
+            def scaled_loss(p):
+                return loss_of_p(p) * loss_scale / gas
 
             loss_s, grads = jax.value_and_grad(scaled_loss)(params)
             grads = jax.lax.with_sharding_constraint(
@@ -383,6 +443,20 @@ class HDSEngine:
             new_acc = jax.tree.map(jnp.add, grad_acc, grads)
             # report the unscaled loss
             return loss_s * gas / loss_scale, new_acc
+
+        prepare_secondary = None
+        if self._zeropp:
+            from .zero.zeropp import build_zeropp_micro_fn
+            micro_fwd_bwd, prepare_secondary = build_zeropp_micro_fn(
+                adapter_loss=self.adapter.loss,
+                mesh=mesh,
+                param_specs=self.param_specs,
+                grad_specs=self.grad_specs,
+                batch_spec_of=lambda leaf: self._batch_sharding(leaf).spec,
+                gas=gas,
+                grad_accum_dtype=self.grad_accum_dtype,
+                remat_policy=remat_policy,
+                zcfg=self.config.zero_optimization)
 
         self._micro_fwd_bwd = jax.jit(
             micro_fwd_bwd,
@@ -486,12 +560,22 @@ class HDSEngine:
 
         # fully fused train_batch: scan microbatches then apply
         def fused_train_batch(state, batches, lr, rng):
+            # hpZ: refresh the secondary partition once, reuse across the
+            # whole gradient-accumulation scan
+            secondary = prepare_secondary(state["params"]) \
+                if prepare_secondary is not None else None
+
             def body(acc, xs):
                 grad_acc, loss_sum = acc
                 batch, key = xs
-                loss, grad_acc = micro_fwd_bwd(
-                    state["params"], grad_acc, state["loss_scale"], batch,
-                    key, True)
+                if secondary is not None:
+                    loss, grad_acc = micro_fwd_bwd(
+                        state["params"], grad_acc, state["loss_scale"],
+                        batch, key, True, secondary)
+                else:
+                    loss, grad_acc = micro_fwd_bwd(
+                        state["params"], grad_acc, state["loss_scale"],
+                        batch, key, True)
                 return (grad_acc, loss_sum + loss), None
 
             keys = jax.random.split(rng, gas)
@@ -591,6 +675,7 @@ class HDSEngine:
         else:
             lr = jnp.asarray(self._current_lr, jnp.float32)
             self.state, finite, grad_norm = self._apply_step(self.state, lr)
+            self._last_grad_norm = grad_norm
         self._after_step(finite)
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).stop()
@@ -610,6 +695,8 @@ class HDSEngine:
                 self.param_shardings)
         self.state["grad_acc"] = self._zero_grads(self.state["grad_acc"])
         self._update_loss_scale_host(ok)
+        self._last_grad_norm = getattr(self._offload, "last_grad_norm",
+                                       None)
         return ok
 
     def _update_loss_scale_host(self, finite: bool):
@@ -719,6 +806,7 @@ class HDSEngine:
         lr = jnp.asarray(self._current_lr, jnp.float32)
         self.state, loss, finite, grad_norm = self._fused_train_batch(
             self.state, batch, lr, self._next_rng())
+        self._last_grad_norm = grad_norm
         self.micro_steps += gas
         self._after_step(finite)
         if self.wall_clock_breakdown:
@@ -748,7 +836,13 @@ class HDSEngine:
         return self.state["params"]
 
     def get_global_grad_norm(self):
-        return None  # populated per-step in train_batch path if needed
+        """Global (pre-clip) gradient norm of the latest optimizer step, or
+        None before the first step (reference: engine.get_global_grad_norm).
+        The norm is computed inside the fused step; fetching it here is the
+        only host sync."""
+        if self._last_grad_norm is None:
+            return None
+        return float(self._last_grad_norm)
 
     def deepspeed_io(self, dataset, batch_size=None, **kw):
         from .dataloader import HDSDataLoader

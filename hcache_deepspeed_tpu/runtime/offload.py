@@ -161,9 +161,11 @@ class HostOffloadAdam:
                 np.multiply(g, inv, out=g)
             sq = float(np.dot(g, g))
             if check_finite and not np.isfinite(sq):
+                self.last_grad_norm = float("inf")
                 return False
             total_sq += sq
         norm = np.sqrt(total_sq)
+        self.last_grad_norm = float(norm)  # pre-clip global norm
         if self.clip > 0 and norm > self.clip:
             coef = np.float32(self.clip / (norm + 1e-6))
             for key in self._keys:

@@ -1,0 +1,127 @@
+"""AutoTP: automatic PartitionSpec derivation from the parameter tree
+(reference: module_inject/auto_tp.py:193 AutoTP + tp_model_init)."""
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel, gpt2_tiny,
+                                              gpt2_tp_spec_fn)
+from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM, llama_tiny,
+                                               llama_tp_spec_fn)
+from hcache_deepspeed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                 mixtral_tiny,
+                                                 mixtral_tp_spec_fn)
+from hcache_deepspeed_tpu.parallel.auto_tp import (auto_tp_spec_fn,
+                                                   derive_tp_specs)
+
+
+def _batch(b=2, t=32):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 256, (b, t), dtype=np.int32)}
+
+
+def _mismatches(model, hand_fn):
+    shapes = jax.eval_shape(lambda r: model.init(r, _batch()),
+                            jax.random.PRNGKey(0))
+    auto = auto_tp_spec_fn(shapes)
+    bad = []
+
+    def chk(path, leaf):
+        if hand_fn(path, leaf) != auto(path, leaf):
+            bad.append(path)
+        return 0
+
+    jax.tree_util.tree_map_with_path(chk, shapes)
+    return bad
+
+
+class TestAutoMatchesHandRules:
+    def test_gpt2(self):
+        assert _mismatches(GPT2LMHeadModel(gpt2_tiny()),
+                           gpt2_tp_spec_fn) == []
+
+    def test_llama(self):
+        assert _mismatches(LlamaForCausalLM(llama_tiny()),
+                           llama_tp_spec_fn) == []
+
+    def test_mixtral(self):
+        assert _mismatches(MixtralForCausalLM(mixtral_tiny()),
+                           mixtral_tp_spec_fn) == []
+
+
+class BertishLayer(nn.Module):
+    """An architecture AutoTP has no name rules tuned for: BERT-style
+    attention with a square un-hinted output projection named 'dense'."""
+    d: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        q = nn.Dense(self.d, name="query")(x)
+        k = nn.Dense(self.d, name="key")(x)
+        v = nn.Dense(self.d, name="value")(x)
+        att = nn.Dense(self.d, name="dense")(q + k + v)
+        h = nn.Dense(4 * self.d, name="intermediate")(att)
+        return nn.Dense(self.d, name="output")(nn.gelu(h))
+
+
+class BertishModel(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        for i in range(2):
+            x = BertishLayer(name=f"layer_{i}")(x)
+        return x
+
+
+class TestUnseenModel:
+    def test_bertish_classification(self):
+        model = BertishModel()
+        shapes = jax.eval_shape(
+            lambda r: model.init(r, np.zeros((2, 8, 64), np.float32)),
+            jax.random.PRNGKey(0))
+        table = derive_tp_specs(shapes)
+        got = {segs[-2]: spec for segs, spec in table.items()
+               if segs[-1] == "kernel" and "layer_0" in segs}
+        # q/k/v column by name; intermediate column by shape (64->256);
+        # output row by shape (256->64); square 'dense' row by the
+        # sibling rule (block has columns, no row yet)
+        assert got["query"] == P(None, "tensor")
+        assert got["key"] == P(None, "tensor")
+        assert got["value"] == P(None, "tensor")
+        assert got["intermediate"] == P(None, "tensor")
+        assert got["output"] == P("tensor", None)
+        assert got["dense"] == P("tensor", None)
+
+
+class TestEngineAutoTP:
+    def test_tp_training_without_spec_fn(self, eight_devices):
+        """tensor=2 mesh, no tp_spec_fn passed: engine derives the rules
+        and params actually land sharded on the tensor axis."""
+        from hcache_deepspeed_tpu.parallel import topology as topo_mod
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(
+            topo_mod.TopologySpec(data=4, tensor=2))
+        try:
+            model = LlamaForCausalLM(llama_tiny())
+            cfg = {"train_batch_size": 8,
+                   "train_micro_batch_size_per_gpu": 2,
+                   "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                   "zero_optimization": {"stage": 1}}
+            engine, _, _, _ = hds.initialize(
+                model=model, config=cfg, example_batch=_batch(8),
+                topology=topo)
+            losses = [float(engine.train_batch(batch=_batch(8)))
+                      for _ in range(4)]
+            assert losses[-1] < losses[0]
+            # q_proj kernels must be sharded over 'tensor'
+            flat = jax.tree_util.tree_flatten_with_path(
+                engine.state["params"])[0]
+            q_specs = [leaf.sharding.spec for path, leaf in flat
+                       if "q_proj" in str(path)]
+            assert q_specs and all(
+                "tensor" in str(s) for s in q_specs), q_specs
+        finally:
+            topo_mod.reset_topology()
