@@ -115,6 +115,7 @@ class PipelineConfig(HDSConfigModel):
     partition_method: str = "uniform"  # uniform | parameters | type:<regex>
     activation_checkpoint_interval: int = 0
     micro_batches: Optional[int] = None  # default: gradient_accumulation_steps
+    schedule: str = "1f1b"  # 1f1b (TrainSchedule) | gpipe
 
 
 class ActivationCheckpointingConfig(HDSConfigModel):

@@ -50,7 +50,12 @@ class PipelineEngine(HDSEngine):
                 f"conflicts with gradient_accumulation_steps="
                 f"{config.gradient_accumulation_steps}; the pipeline "
                 f"microbatch count IS the accumulation count")
+        if config.pipeline.schedule not in ("1f1b", "gpipe"):
+            raise ValueError(
+                f"pipeline.schedule must be '1f1b' or 'gpipe', got "
+                f"{config.pipeline.schedule!r}")
         module.n_microbatches = n_micro
+        module.schedule = config.pipeline.schedule
         self._pipe_micro_batches = n_micro
 
         # fold microbatching into the model: engine-level gas = 1, the
