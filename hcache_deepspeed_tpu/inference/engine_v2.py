@@ -35,8 +35,14 @@ def _bucket(n: int, minimum: int = 8) -> int:
 class InferenceEngineV2:
 
     def __init__(self, model_config, params,
-                 config: RaggedInferenceEngineConfig = None):
+                 config: RaggedInferenceEngineConfig = None,
+                 topology=None):
+        """``topology``: a MeshTopology with a ``tensor`` axis enables
+        tensor-parallel serving — sharded heads/KV blocks, per-layer
+        allreduce (reference: TP sharding throughout the v2 model
+        implementations, llama_v2/model.py:160,169)."""
         self.config = config or RaggedInferenceEngineConfig()
+        self.topology = topology
         sm_cfg = self.config.state_manager
         kv_cfg = self.config.kv_cache
 
@@ -59,14 +65,16 @@ class InferenceEngineV2:
         # block 0 is reserved scratch: padded decode lanes write there
         self._scratch_block = self.state.allocator.allocate(1)[0]
 
-        self.cache = BlockedKVCache(
-            model_config.n_layer, num_blocks, self.block_size,
-            model_config.n_kv_head, model_config.head_dim,
-            dtype=jnp.dtype(kv_cfg.cache_dtype))
         self.model = PagedInferenceModel(
             model_config, params, block_size=self.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,
-            capture_latents=self.config.hcache.enable_latents)
+            capture_latents=self.config.hcache.enable_latents,
+            topology=topology)
+        self.cache = BlockedKVCache(
+            model_config.n_layer, num_blocks, self.block_size,
+            model_config.n_kv_head, model_config.head_dim,
+            dtype=jnp.dtype(kv_cfg.cache_dtype),
+            sharding=self.model.cache_sharding())
         log_dist(f"InferenceEngineV2: {num_blocks} KV blocks x "
                  f"{self.block_size} tokens, max_context="
                  f"{self.max_context}", ranks=[0])

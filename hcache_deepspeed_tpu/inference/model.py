@@ -43,6 +43,7 @@ from ..models.llama import LlamaConfig
 from ..ops.paged_attention import paged_attention
 from ..ops.rms_norm import rms_norm
 from ..ops.rope import apply_rope, rope_frequencies
+from ..parallel.topology import TENSOR_AXIS
 
 
 def stack_layer_params(params: Dict[str, Any], n_layers: int,
@@ -59,39 +60,131 @@ class PagedInferenceModel:
     loading into inference containers)."""
 
     def __init__(self, cfg: LlamaConfig, params, *, block_size: int,
-                 max_blocks_per_seq: int, capture_latents: bool = True):
+                 max_blocks_per_seq: int, capture_latents: bool = True,
+                 topology=None):
         self.cfg = cfg
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.capture_latents = capture_latents
         self.n_layers = cfg.n_layer
+        self.topology = topology
+        self.tp = topology.tensor_size if topology is not None else 1
 
-        self.embed = params["embed_tokens"]["embedding"]
-        self.norm_w = params["norm"]["weight"]
-        if cfg.tie_word_embeddings:
-            self.lm_head = self.embed.T
-        else:
-            self.lm_head = params["lm_head"]["kernel"]
-        self.layer_params = stack_layer_params(params, cfg.n_layer)
+        self.tied = cfg.tie_word_embeddings
+        self.params = {
+            "embed": params["embed_tokens"]["embedding"],
+            "norm": params["norm"]["weight"],
+            "layers": stack_layer_params(params, cfg.n_layer),
+        }
+        if not self.tied:
+            self.params["lm_head"] = params["lm_head"]["kernel"]
+        if self.tp > 1:
+            self._validate_tp()
+            self.params = jax.device_put(self.params,
+                                         self._param_shardings())
         self.cos, self.sin = rope_frequencies(cfg.head_dim,
                                               cfg.max_positions,
                                               cfg.rope_theta)
-        self._fwd = jax.jit(self._forward_chunk, donate_argnums=(0, 1))
-        self._restore = jax.jit(self._restore_layer, donate_argnums=(0, 1))
+        fwd, restore = self._forward_chunk, self._restore_layer
+        if self.tp > 1:
+            fwd, restore = self._wrap_tp(fwd, restore)
+        self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
+        self._restore = jax.jit(restore, donate_argnums=(1, 2))
+
+    # -------------------------------------------------------------- #
+    # Tensor parallelism (reference: per-layer allreduce + sharded heads,
+    # inference/v2/model_implementations/llama_v2/model.py:160,169 and
+    # the sharding framework model_implementations/sharding/)
+    # -------------------------------------------------------------- #
+    def _validate_tp(self):
+        cfg, tp = self.cfg, self.tp
+        for name, val in (("n_head", cfg.n_head),
+                          ("n_kv_head", cfg.n_kv_head),
+                          ("intermediate_size", cfg.intermediate_size),
+                          ("vocab_size", cfg.vocab_size)):
+            if val % tp:
+                raise ValueError(f"{name}={val} not divisible by "
+                                 f"tensor parallel degree {tp}")
+
+    def _param_spec_tree(self):
+        from jax.sharding import PartitionSpec as P
+        col3 = P(None, None, TENSOR_AXIS)   # stacked [L, in, out] column
+        row3 = P(None, TENSOR_AXIS, None)   # stacked [L, in, out] row
+
+        def layer_spec(path, leaf):
+            joined = "/".join(str(getattr(k, "key", k)) for k in path)
+            if any(n in joined for n in ("q_proj", "k_proj", "v_proj",
+                                         "gate_proj", "up_proj")):
+                return col3
+            if any(n in joined for n in ("o_proj", "down_proj")):
+                return row3
+            return P()
+
+        specs = {
+            # tied: ONE vocab-row-sharded table serves embed + LM head
+            # (the reference's vocab-parallel embedding); untied: embed
+            # replicated, head column-sharded
+            "embed": P(TENSOR_AXIS, None) if self.tied else P(),
+            "norm": P(),
+            "layers": jax.tree_util.tree_map_with_path(
+                layer_spec, self.params["layers"]),
+        }
+        if not self.tied:
+            specs["lm_head"] = P(None, TENSOR_AXIS)
+        return specs
+
+    def _param_shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self.topology.mesh
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self._param_spec_tree(),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def cache_sharding(self):
+        """Sharding for the [L, P, KV, D] block pool: KV heads split over
+        ``tensor``. None on single chip."""
+        if self.tp == 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.topology.mesh,
+                             P(None, None, TENSOR_AXIS, None))
+
+    def _wrap_tp(self, fwd, restore):
+        from jax.sharding import PartitionSpec as P
+        mesh = self.topology.mesh
+        pspecs = self._param_spec_tree()
+        cache_spec = P(None, None, TENSOR_AXIS, None)  # [L, P, KV, D]
+        rep = P()
+
+        fwd_m = jax.shard_map(
+            fwd, mesh=mesh, axis_names={TENSOR_AXIS},
+            in_specs=(pspecs, cache_spec, cache_spec, rep, rep, rep, rep),
+            out_specs=(cache_spec, cache_spec, rep, rep),
+            check_vma=False)
+        restore_m = jax.shard_map(
+            restore, mesh=mesh, axis_names={TENSOR_AXIS},
+            in_specs=(pspecs, cache_spec, cache_spec, rep, rep, rep, rep,
+                      rep),
+            out_specs=(cache_spec, cache_spec),
+            check_vma=False)
+        return fwd_m, restore_m
 
     # -------------------------------------------------------------- #
     # Layer math (mirrors models/llama.py LlamaBlock exactly)
     # -------------------------------------------------------------- #
     def _qkv(self, lp, h, positions):
-        """h: [B, T, H]; returns q [B,T,Hq,D], k/v [B,T,KV,D] (roped)."""
+        """h: [B, T, H]; returns q [B,T,Hq,D], k/v [B,T,KV,D] (roped).
+        Head counts come from the kernel widths so the same code runs on
+        the full model or a tensor-parallel shard (H/tp local heads)."""
         cfg = self.cfg
         B, T, _ = h.shape
-        q = (h @ lp["self_attn"]["q_proj"]["kernel"]).reshape(
-            B, T, cfg.n_head, cfg.head_dim)
-        k = (h @ lp["self_attn"]["k_proj"]["kernel"]).reshape(
-            B, T, cfg.n_kv_head, cfg.head_dim)
-        v = (h @ lp["self_attn"]["v_proj"]["kernel"]).reshape(
-            B, T, cfg.n_kv_head, cfg.head_dim)
+        D = cfg.head_dim
+        qk = lp["self_attn"]["q_proj"]["kernel"]
+        kk = lp["self_attn"]["k_proj"]["kernel"]
+        vk = lp["self_attn"]["v_proj"]["kernel"]
+        q = (h @ qk).reshape(B, T, qk.shape[-1] // D, D)
+        k = (h @ kk).reshape(B, T, kk.shape[-1] // D, D)
+        v = (h @ vk).reshape(B, T, vk.shape[-1] // D, D)
         q = apply_rope(q, self.cos, self.sin, positions)
         k = apply_rope(k, self.cos, self.sin, positions)
         return q, k, v
@@ -133,18 +226,24 @@ class PagedInferenceModel:
         q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
         attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
-        x = x + attn @ lp["self_attn"]["o_proj"]["kernel"]
+        proj = attn @ lp["self_attn"]["o_proj"]["kernel"]
+        if self.tp > 1:   # row-parallel partial sum (reference :160)
+            proj = jax.lax.psum(proj, TENSOR_AXIS)
+        x = x + proj
         h2 = rms_norm(x, lp["post_attention_layernorm"]["weight"],
                       eps=cfg.rms_norm_eps).astype(cfg.compute_dtype)
         gate = h2 @ lp["mlp"]["gate_proj"]["kernel"]
         up = h2 @ lp["mlp"]["up_proj"]["kernel"]
-        x = x + (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
+        mlp = (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
+        if self.tp > 1:   # (reference :169)
+            mlp = jax.lax.psum(mlp, TENSOR_AXIS)
+        x = x + mlp
         return x.astype(cfg.compute_dtype), ck, cv, latent
 
     # -------------------------------------------------------------- #
     # forward_chunk: the one compiled family (prefill & ragged decode)
     # -------------------------------------------------------------- #
-    def _forward_chunk(self, cache_k, cache_v, tokens, start,
+    def _forward_chunk(self, params, cache_k, cache_v, tokens, start,
                        tables, t_len):
         """tokens: [B, T] int32; start: [B] first absolute position;
         tables: [B, NB]; t_len: [B] valid new tokens (≤ T).
@@ -152,7 +251,7 @@ class PagedInferenceModel:
         B, T = tokens.shape
         BS = self.block_size
         P = cache_k.shape[1]
-        x = self.embed[tokens].astype(self.cfg.compute_dtype)
+        x = self._embed_lookup(params["embed"], tokens)
 
         offs = jnp.arange(T)
         positions = start[:, None] + offs[None, :]              # [B, T]
@@ -170,17 +269,40 @@ class PagedInferenceModel:
             return x, (ck, cv, latent)
 
         x, (cache_k, cache_v, latents) = jax.lax.scan(
-            step, x, (self.layer_params, cache_k, cache_v))
+            step, x, (params["layers"], cache_k, cache_v))
 
-        x = rms_norm(x, self.norm_w, eps=self.cfg.rms_norm_eps)
+        x = rms_norm(x, params["norm"], eps=self.cfg.rms_norm_eps)
         last = jnp.take_along_axis(
             x, jnp.maximum(t_len - 1, 0)[:, None, None], axis=1)[:, 0]
-        logits = (last @ self.lm_head).astype(jnp.float32)
+        head = params["embed"].T if self.tied else params["lm_head"]
+        logits = (last @ head).astype(jnp.float32)
+        if self.tp > 1:
+            # vocab is sharded either way (tied: rows of the table;
+            # untied: head columns) — gather the full logits row
+            # (reference: allgather logits if tp>1, llama_v2/model.py:181)
+            logits = jax.lax.all_gather(logits, TENSOR_AXIS, axis=1,
+                                        tiled=True)
         return cache_k, cache_v, logits, latents
+
+    def _embed_lookup(self, table, tokens):
+        """Embedding lookup. Under TP with tied embeddings the table is
+        vocab-row-sharded: mask out-of-range ids locally and psum (the
+        reference's vocab-parallel embedding)."""
+        if self.tp > 1 and self.tied:
+            vshard = table.shape[0]
+            vstart = jax.lax.axis_index(TENSOR_AXIS) * vshard
+            rel = tokens - vstart
+            ok = (rel >= 0) & (rel < vshard)
+            x = table[jnp.clip(rel, 0, vshard - 1)]
+            x = jnp.where(ok[..., None], x, 0)
+            x = jax.lax.psum(x, TENSOR_AXIS)
+        else:
+            x = table[tokens]
+        return x.astype(self.cfg.compute_dtype)
 
     def forward_chunk(self, cache, tokens, start, tables, t_len):
         ck, cv, logits, latents = self._fwd(
-            cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
+            self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
             jnp.asarray(t_len, jnp.int32))
         cache.replace(ck, cv)
@@ -189,8 +311,8 @@ class PagedInferenceModel:
     # -------------------------------------------------------------- #
     # HCache restore (the fork's flagship delta)
     # -------------------------------------------------------------- #
-    def _restore_layer(self, cache_k, cache_v, layer, latent, start,
-                       tables, t_len):
+    def _restore_layer(self, params, cache_k, cache_v, layer, latent,
+                       start, tables, t_len):
         """Replay K/V projection + RoPE + blocked cache write for ONE layer
         from saved latents (reference: llama_v2/model.py:222-252 +
         dense_blocked_attention.py:182 — QKV GEMM + kv-rotary cache write,
@@ -198,7 +320,7 @@ class PagedInferenceModel:
         updates layer ``layer`` in place; the layer's weights are sliced
         from the stacked tree *inside* the compiled program (no per-call
         host-side slicing)."""
-        lp = jax.tree.map(lambda p: p[layer], self.layer_params)
+        lp = jax.tree.map(lambda p: p[layer], params["layers"])
         B, T, _ = latent.shape
         BS = self.block_size
         P = cache_k.shape[1]
@@ -227,12 +349,16 @@ class PagedInferenceModel:
         tables = jnp.asarray(tables, jnp.int32)
         t_len = jnp.asarray(t_len, jnp.int32)
         ck, cv = cache.k, cache.v
-        dev = list(ck.devices())[0]
+        if self.tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            dev = NamedSharding(self.topology.mesh, PartitionSpec())
+        else:
+            dev = list(ck.devices())[0]
         buf = jax.device_put(np.asarray(latents[0]), dev)  # layer-0 H2D
         for l in range(self.n_layers):
             cur = buf
             if l + 1 < self.n_layers:  # double buffer: prefetch next layer
                 buf = jax.device_put(np.asarray(latents[l + 1]), dev)
-            ck, cv = self._restore(ck, cv, jnp.int32(l), cur, start,
-                                   tables, t_len)
+            ck, cv = self._restore(self.params, ck, cv, jnp.int32(l), cur,
+                                   start, tables, t_len)
         cache.replace(ck, cv)

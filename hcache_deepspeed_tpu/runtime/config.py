@@ -203,6 +203,19 @@ class CheckpointConfig(HDSConfigModel):
     async_save: bool = False
 
 
+class CurriculumLearningConfig(HDSConfigModel):
+    """Reference: runtime/data_pipeline/curriculum_scheduler.py + the
+    legacy ``curriculum_learning`` engine block. ``seqlen`` curricula are
+    applied by the engine itself (batch seq truncation); other metrics go
+    through ``data_pipeline.CurriculumSampler``."""
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
 class CompileConfig(HDSConfigModel):
     """Reference: DeepCompile (runtime/config.py compile block). On TPU the
     compiler is XLA; these knobs steer jit: donation, remat, combining."""
@@ -250,6 +263,8 @@ class HDSConfig(HDSConfigModel):
 
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig)
+    curriculum_learning: CurriculumLearningConfig = Field(
+        default_factory=CurriculumLearningConfig)
 
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

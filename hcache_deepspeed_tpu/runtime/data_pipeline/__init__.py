@@ -1,0 +1,11 @@
+"""Data-efficiency pipeline (reference: deepspeed/runtime/data_pipeline/):
+curriculum learning scheduler + curriculum-aware sampler + random-LTD."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import CurriculumSampler
+from .random_ltd import (RandomLTDScheduler, random_ltd_layer,
+                         sample_tokens, scatter_back)
+
+__all__ = ["CurriculumScheduler", "CurriculumSampler",
+           "RandomLTDScheduler", "random_ltd_layer", "sample_tokens",
+           "scatter_back"]

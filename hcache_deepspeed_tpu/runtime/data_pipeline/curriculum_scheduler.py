@@ -1,0 +1,94 @@
+"""Curriculum learning scheduler.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+— the same three fixed schedules (``fixed_linear``, ``fixed_root``,
+``fixed_discrete``) plus a ``custom`` callable, with identical difficulty
+arithmetic (floor to ``difficulty_step`` multiples, clamp at max). On TPU
+the ``difficulty_step`` granularity does double duty: it also bounds the
+number of distinct batch shapes a seqlen curriculum produces, i.e. the
+number of XLA recompilations.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any],
+                 custom_fn: Optional[Callable[[int], int]] = None):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires '{key}'")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.schedule = dict(config.get("schedule_config", {}))
+        self.current_difficulty = self.min_difficulty
+        self.custom_fn = custom_fn
+
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in self.schedule:
+                    raise ValueError(
+                        f"{self.schedule_type} schedule requires "
+                        f"schedule_config '{key}'")
+            if self.schedule_type == "fixed_root" and \
+                    "root_degree" not in self.schedule:
+                raise ValueError(
+                    "fixed_root schedule requires schedule_config "
+                    "'root_degree'")
+        elif self.schedule_type == "fixed_discrete":
+            diff = self.schedule.get("difficulty")
+            max_step = self.schedule.get("max_step")
+            if not diff or max_step is None or \
+                    len(diff) != len(max_step) + 1:
+                raise ValueError(
+                    "fixed_discrete needs len(difficulty) == "
+                    "len(max_step) + 1")
+        elif self.schedule_type == "custom":
+            if custom_fn is None:
+                raise ValueError("custom schedule requires custom_fn")
+        else:
+            raise ValueError(
+                f"unsupported curriculum schedule {self.schedule_type!r}")
+
+    # formulas mirror the reference exactly
+    # (curriculum_scheduler.py:122-152)
+    def _fixed_discrete(self, step: int) -> int:
+        diff = self.schedule["difficulty"]
+        max_step = self.schedule["max_step"]
+        if step > max_step[-1]:
+            return diff[-1]
+        for i, ms in enumerate(max_step):
+            if step <= ms:
+                return diff[i]
+        return diff[-1]
+
+    def _fixed_root(self, step: int, root_degree: int) -> int:
+        frac = (float(step) / self.schedule["total_curriculum_step"]) ** (
+            1.0 / root_degree)
+        d = math.floor(frac * (self.max_difficulty - self.min_difficulty) +
+                       self.min_difficulty)
+        d -= d % self.schedule["difficulty_step"]
+        # flooring to the step multiple must never undercut the minimum
+        return max(min(d, self.max_difficulty), self.min_difficulty)
+
+    def get_difficulty(self, step: int) -> int:
+        if self.schedule_type == "fixed_discrete":
+            return self._fixed_discrete(step)
+        if self.schedule_type == "fixed_linear":
+            return self._fixed_root(step, 1)
+        if self.schedule_type == "fixed_root":
+            return self._fixed_root(step, self.schedule["root_degree"])
+        return self.custom_fn(step)
+
+    def update_difficulty(self, step: int) -> int:
+        if self.current_difficulty < self.max_difficulty:
+            self.current_difficulty = self.get_difficulty(step)
+        return self.current_difficulty
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, state):
+        self.current_difficulty = state["current_difficulty"]

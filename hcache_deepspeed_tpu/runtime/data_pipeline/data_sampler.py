@@ -1,0 +1,58 @@
+"""Curriculum-aware data sampling.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/data_sampling/`` —
+``DeepSpeedDataSampler`` + the offline data analyzer that buckets samples
+by a difficulty metric, then draws each batch from the pool of samples
+whose difficulty is within the scheduler's current level.
+
+TPU-native simplification: the metric is supplied per sample (an array or
+a callable evaluated once up front — the analyzer's output), the pool is
+a sorted index array, and each batch is drawn uniformly from the admitted
+prefix. Deterministic per (seed, step) so every data-parallel process
+draws the same global batch and takes its own shard.
+"""
+
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+
+class CurriculumSampler:
+    """Yields index batches whose sample difficulty ≤ current level."""
+
+    def __init__(self, metric: Union[Sequence, Callable], n_samples: int,
+                 batch_size: int, scheduler, seed: int = 1234,
+                 drop_last: bool = True):
+        if callable(metric):
+            values = np.asarray([metric(i) for i in range(n_samples)])
+        else:
+            values = np.asarray(metric)
+            if len(values) != n_samples:
+                raise ValueError(
+                    f"metric length {len(values)} != n_samples {n_samples}")
+        self.order = np.argsort(values, kind="stable")
+        self.sorted_values = values[self.order]
+        self.batch_size = batch_size
+        self.scheduler = scheduler
+        self.seed = seed
+        self.step = 0
+
+    def admitted(self) -> np.ndarray:
+        """Indices currently admitted by the difficulty level."""
+        hi = np.searchsorted(self.sorted_values,
+                             self.scheduler.current_difficulty, "right")
+        hi = max(hi, min(self.batch_size, len(self.order)))  # never empty
+        return self.order[:hi]
+
+    def next_batch(self) -> np.ndarray:
+        self.scheduler.update_difficulty(self.step + 1)
+        pool = self.admitted()
+        rng = np.random.default_rng((self.seed, self.step))
+        idx = rng.choice(pool, size=self.batch_size,
+                         replace=len(pool) < self.batch_size)
+        self.step += 1
+        return idx
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()

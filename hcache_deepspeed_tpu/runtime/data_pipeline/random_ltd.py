@@ -1,0 +1,73 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference analogs:
+* ``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py`` —
+  the per-layer random token selection wrapper,
+* ``deepspeed/runtime/data_pipeline/data_routing/scheduler.py`` — the
+  kept-token schedule,
+* ``csrc/random_ltd/{gather_scatter.cu,token_sort.cu}`` — the gather /
+  scatter-back kernels.
+
+TPU re-design: token selection is a per-batch random permutation prefix;
+gather/scatter are ``jnp.take`` / ``.at[].set`` (XLA fuses them — the
+CUDA kernels dissolve). The kept-token count is a *static* bucket per
+compile (the scheduler quantizes to ``ltd_step`` multiples, bounding
+recompiles exactly like the seqlen curriculum).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Linear kept-token schedule (reference: data_routing/scheduler.py)."""
+
+    def __init__(self, min_tokens: int, max_tokens: int,
+                 total_steps: int, step_size: int = 16):
+        self.min_tokens = min_tokens
+        self.max_tokens = max_tokens
+        self.total_steps = total_steps
+        self.step_size = step_size
+        self.current = min_tokens
+
+    def update(self, step: int) -> int:
+        frac = min(1.0, step / max(self.total_steps, 1))
+        n = int(self.min_tokens +
+                frac * (self.max_tokens - self.min_tokens))
+        n -= n % self.step_size
+        self.current = max(self.min_tokens,
+                           min(n, self.max_tokens))
+        return self.current
+
+
+def sample_tokens(x: jnp.ndarray, keep: int, rng) -> Tuple[jnp.ndarray,
+                                                           jnp.ndarray]:
+    """x: [B, T, H] → (sampled [B, keep, H], idx [B, keep]).
+
+    Random subset per batch row, order-preserving (sorted indices keep
+    positional structure — the reference sorts too, token_sort.cu)."""
+    B, T, _ = x.shape
+    noise = jax.random.uniform(rng, (B, T))
+    idx = jnp.sort(jnp.argsort(noise, axis=1)[:, :keep], axis=1)
+    return jnp.take_along_axis(x, idx[..., None], axis=1), idx
+
+
+def scatter_back(x: jnp.ndarray, sampled_out: jnp.ndarray,
+                 idx: jnp.ndarray) -> jnp.ndarray:
+    """Write the processed subset back into the full sequence; dropped
+    tokens keep their pre-layer values (the LTD bypass)."""
+    B = x.shape[0]
+    b = jnp.arange(B)[:, None]
+    return x.at[b, idx].set(sampled_out.astype(x.dtype))
+
+
+def random_ltd_layer(layer_fn, x, keep: int, rng, *args, **kwargs):
+    """Apply ``layer_fn`` to a random ``keep``-token subset of ``x``;
+    dropped tokens bypass the layer (reference: basic_layer.py forward)."""
+    if keep >= x.shape[1]:
+        return layer_fn(x, *args, **kwargs)
+    sampled, idx = sample_tokens(x, keep, rng)
+    out = layer_fn(sampled, *args, **kwargs)
+    return scatter_back(x, out, idx)

@@ -228,6 +228,21 @@ class HDSEngine:
         self._rng_seed = config.seed
         self._init_state(init_params, example_batch)
 
+        # ---- curriculum learning (reference: data_pipeline) ----
+        self.curriculum_scheduler = None
+        self.curriculum_difficulty = None
+        ccfg = config.curriculum_learning
+        if ccfg.enabled:
+            from .config import HDSConfigError
+            if ccfg.curriculum_type != "seqlen":
+                raise HDSConfigError(
+                    f"engine-applied curriculum supports 'seqlen' only "
+                    f"(got {ccfg.curriculum_type!r}); use "
+                    f"data_pipeline.CurriculumSampler for other metrics")
+            from .data_pipeline import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                ccfg.model_dump())
+
         # ---- counters ----
         self.global_steps = 0
         self.micro_steps = 0
@@ -756,6 +771,11 @@ class HDSEngine:
         self.tput_timer.start()
         if self.wall_clock_breakdown:
             self.timers(BATCH_TIMER).start()
+        cur_d = None
+        if self.curriculum_scheduler is not None:
+            cur_d = self._curriculum_difficulty_for_step()
+            if batch is not None:
+                batch = self._truncate_seq(batch, cur_d)
         gas = self.gradient_accumulation_steps
         if self._offload is not None:
             # offloaded step is host-side: run the micro-batch loop through
@@ -776,6 +796,8 @@ class HDSEngine:
                             (gas, -1) + np.asarray(x).shape[1:])[i], batch)
                 else:
                     micro = next(data_iter)
+                    if cur_d is not None:
+                        micro = self._truncate_seq(micro, cur_d)
                 losses.append(self.forward(micro))
                 self.backward()
             self.step()
@@ -797,6 +819,9 @@ class HDSEngine:
                         RepeatingLoader(self.training_dataloader))
                 data_iter = self._data_iter
             micro_batches = [next(data_iter) for _ in range(gas)]
+            if cur_d is not None:
+                micro_batches = [self._truncate_seq(m, cur_d)
+                                 for m in micro_batches]
             batch = jax.tree.map(lambda *xs: np.stack(xs), *micro_batches)
         else:
             batch = jax.tree.map(
@@ -817,6 +842,39 @@ class HDSEngine:
             self.monitor.write_events([
                 ("Train/loss", float(loss), self.global_steps)])
         return loss
+
+    def _curriculum_difficulty_for_step(self):
+        d = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        self.curriculum_difficulty = d
+        return d
+
+    @staticmethod
+    def _truncate_seq(batch, d):
+        """Truncate sequence leaves' dim 1 to ``d`` (the reference's
+        legacy seqlen curriculum: shorter sequences early in training).
+        Only leaves sharing the batch's sequence length (dim 1 of
+        ``input_ids``, else the longest dim 1) are touched — other
+        rank≥2 leaves (e.g. soft labels) pass through.
+        ``difficulty_step`` bounds the number of distinct shapes, i.e.
+        XLA recompiles."""
+        leaves = {k: np.asarray(v) for k, v in batch.items()} \
+            if isinstance(batch, dict) else None
+        if leaves and "input_ids" in leaves and \
+                leaves["input_ids"].ndim >= 2:
+            seq_len = leaves["input_ids"].shape[1]
+        else:
+            seq_len = max((np.asarray(x).shape[1]
+                           for x in jax.tree.leaves(batch)
+                           if np.asarray(x).ndim >= 2), default=0)
+
+        def trunc(x):
+            x = np.asarray(x)
+            if x.ndim >= 2 and x.shape[1] == seq_len and seq_len > d:
+                return x[:, :d]
+            return x
+
+        return jax.tree.map(trunc, batch)
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)

@@ -1,0 +1,208 @@
+"""Data-efficiency pipeline (reference: deepspeed/runtime/data_pipeline/):
+curriculum scheduler formulas, curriculum sampler admission, engine seqlen
+curriculum changing batch shapes over steps, random-LTD token routing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+from hcache_deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumSampler, CurriculumScheduler, RandomLTDScheduler,
+    random_ltd_layer, sample_tokens, scatter_back)
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 8}})
+        # reference formula: floor(step/total * (max-min) + min), floored
+        # to difficulty_step multiples, clamped at max
+        assert s.update_difficulty(0) == 8
+        assert s.update_difficulty(5) == 32  # 0.5*56+8=36 -> 32
+        assert s.update_difficulty(10) == 64
+        assert s.update_difficulty(100) == 64
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8, "root_degree": 2}})
+        # sqrt(25/100)=0.5 -> floor(0.5*56+8)=36 -> 32
+        assert s.get_difficulty(25) == 32
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 3,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [1, 2, 3],
+                                "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 1
+        assert s.get_difficulty(7) == 2
+        assert s.get_difficulty(11) == 3
+
+    def test_monotone_nondecreasing(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 128,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 50,
+                                "difficulty_step": 8}})
+        ds = [s.update_difficulty(t) for t in range(60)]
+        assert all(a <= b for a, b in zip(ds, ds[1:]))
+        assert ds[0] == 8 and ds[-1] == 128
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError, match="total_curriculum_step"):
+            CurriculumScheduler({
+                "min_difficulty": 1, "max_difficulty": 2,
+                "schedule_type": "fixed_linear"})
+
+
+class TestCurriculumSampler:
+    def test_admission_grows_with_difficulty(self):
+        sched = CurriculumScheduler({
+            "min_difficulty": 10, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 10}})
+        lengths = np.arange(100)  # sample i has difficulty i
+        s = CurriculumSampler(lengths, 100, batch_size=4, scheduler=sched)
+        b0 = s.next_batch()
+        assert np.all(lengths[b0] <= max(10, 4))
+        for _ in range(10):
+            b = s.next_batch()
+        assert np.all(lengths[b] <= 100)
+        # later pools admit strictly more than the first
+        assert len(s.admitted()) > 12
+
+
+class TestEngineSeqlenCurriculum:
+    def test_batch_shapes_change_over_steps(self, eight_devices):
+        model = GPT2LMHeadModel(gpt2_tiny(n_positions=64, use_flash=False))
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True,
+                "min_difficulty": 16,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 16}},
+        }
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 256, (8, 64),
+                                           dtype=np.int32)}
+        engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                         example_batch=batch)
+        seen = []
+        for _ in range(6):
+            loss = float(engine.train_batch(batch=batch))
+            seen.append(engine.curriculum_difficulty)
+        assert seen[0] == 16 and seen[-1] == 64
+        assert len(set(seen)) >= 3  # shapes actually changed over steps
+        assert np.isfinite(loss)
+
+    def test_curriculum_applies_on_data_iter_path(self, eight_devices):
+        """train_batch(data_iter=...) must truncate too (not only the
+        batch= path)."""
+        model = GPT2LMHeadModel(gpt2_tiny(n_positions=64, use_flash=False))
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True,
+                "min_difficulty": 16,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 16}},
+        }
+        rng = np.random.default_rng(0)
+        engine, _, _, _ = hds.initialize(
+            model=model, config=cfg,
+            example_batch={"input_ids": rng.integers(
+                0, 256, (8, 64), dtype=np.int32)})
+
+        def it():
+            while True:
+                yield {"input_ids": rng.integers(0, 256, (8, 64),
+                                                 dtype=np.int32)}
+
+        data_iter = it()
+        seen = []
+        for _ in range(3):
+            engine.train_batch(data_iter=data_iter)
+            seen.append(engine.curriculum_difficulty)
+        assert seen == [16, 32, 48]
+
+    def test_soft_label_leaves_untouched(self, eight_devices):
+        from hcache_deepspeed_tpu.runtime.engine import HDSEngine
+        batch = {"input_ids": np.zeros((4, 64), np.int32),
+                 "soft_labels": np.zeros((4, 512), np.float32)}
+        out = HDSEngine._truncate_seq(batch, 16)
+        assert out["input_ids"].shape == (4, 16)
+        assert out["soft_labels"].shape == (4, 512)
+
+    def test_fixed_root_never_undercuts_min(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 10, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(1) >= 10
+
+    def test_non_seqlen_type_rejected(self, eight_devices):
+        model = GPT2LMHeadModel(gpt2_tiny())
+        from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+        with pytest.raises(HDSConfigError, match="seqlen"):
+            hds.initialize(model=model, config={
+                "train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {}},
+                "curriculum_learning": {"enabled": True,
+                                        "curriculum_type": "vocab_rarity"},
+            }, example_batch={"input_ids": np.zeros((8, 16), np.int32)})
+
+
+class TestRandomLTD:
+    def test_scheduler_ramp(self):
+        s = RandomLTDScheduler(min_tokens=64, max_tokens=256,
+                               total_steps=100, step_size=16)
+        assert s.update(0) == 64
+        assert s.update(50) == 160
+        assert s.update(100) == 256
+        assert s.update(1000) == 256
+
+    def test_dropped_tokens_bypass(self):
+        rng = jax.random.PRNGKey(0)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 16, 4)), jnp.float32)
+        out = random_ltd_layer(lambda h: h * 2.0, x, keep=8, rng=rng)
+        doubled = np.isclose(np.asarray(out), 2 * np.asarray(x)).all(-1)
+        kept = np.isclose(np.asarray(out), np.asarray(x)).all(-1)
+        assert doubled.sum(axis=1).tolist() == [8, 8]   # 8 processed
+        assert kept.sum(axis=1).tolist() == [8, 8]      # 8 bypassed
+
+    def test_keep_all_is_identity_wrap(self):
+        rng = jax.random.PRNGKey(1)
+        x = jnp.ones((1, 8, 2))
+        out = random_ltd_layer(lambda h: h + 1, x, keep=8, rng=rng)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_sample_scatter_roundtrip(self):
+        rng = jax.random.PRNGKey(2)
+        x = jnp.asarray(np.arange(24).reshape(1, 12, 2), jnp.float32)
+        sampled, idx = sample_tokens(x, 5, rng)
+        assert sampled.shape == (1, 5, 2)
+        assert np.all(np.diff(np.asarray(idx)[0]) > 0)  # order-preserving
+        back = scatter_back(x, sampled, idx)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
