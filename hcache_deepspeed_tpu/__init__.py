@@ -12,6 +12,7 @@ from . import comm  # noqa: F401
 from .platform import get_platform  # noqa: F401
 from .runtime.config import HDSConfig, load_config  # noqa: F401
 from .runtime.engine import HDSEngine
+from .runtime.hybrid_engine import HybridEngine  # noqa: F401
 from .utils.logging import log_dist, logger  # noqa: F401
 
 

@@ -220,6 +220,96 @@ class InferenceEngineV2:
             latents_out[i] = np.asarray(latents)[:, 0, :len(seq_tokens)]
 
     # -------------------------------------------------------------- #
+    # Serving loop (reference: the generate() surface the v1 engine
+    # exposes via HF and hybrid_engine.py wraps; v2's counterpart is the
+    # mii serving loop — here a built-in utility)
+    # -------------------------------------------------------------- #
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 eos_token_id: int = None, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0, return_logits: bool = False):
+        """Batched prefill + ragged decode loop.
+
+        ``prompts``: list of token-id lists. Greedy when temperature==0,
+        else softmax sampling (optionally top-k). Returns the generated
+        continuations (without the prompt), plus per-step logits when
+        ``return_logits`` (for RLHF-style log-prob computation). Sequences
+        are flushed from the KV cache on completion.
+        """
+        rng = np.random.default_rng(seed)
+        base = max(self.state._seqs.keys(), default=-1) + 1
+        uids = [base + i for i in range(len(prompts))]
+
+        def sample(row):
+            if temperature <= 0:
+                return int(np.argmax(row))
+            logits = row.astype(np.float64) / temperature
+            k = min(top_k, len(logits))
+            if k > 0:
+                kth = np.partition(logits, -k)[-k]
+                logits = np.where(logits < kth, -np.inf, logits)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            return int(rng.choice(len(p), p=p))
+
+        def run_wave(wave):
+            """Prefill + decode one admitted wave to completion."""
+            try:
+                step_logits, _ = self.put([uids[i] for i in wave],
+                                          [prompts[i] for i in wave])
+                cur = {i: step_logits[j] for j, i in enumerate(wave)}
+                active = list(wave)
+                while active:
+                    finished = []
+                    for i in active:
+                        tok = sample(cur[i])
+                        outs[i].append(tok)
+                        if return_logits:
+                            logit_trace[i].append(cur[i])
+                        if (eos_token_id is not None and
+                                tok == eos_token_id) or \
+                                len(outs[i]) >= max_new_tokens:
+                            finished.append(i)
+                    active = [i for i in active if i not in finished]
+                    if not active:
+                        break
+                    step_logits, _ = self.put(     # ragged decode
+                        [uids[i] for i in active],
+                        [[outs[i][-1]] for i in active])
+                    for j, i in enumerate(active):
+                        cur[i] = step_logits[j]
+            finally:
+                for i in wave:
+                    if self.state.get_sequence(uids[i]) is not None:
+                        self.flush(uids[i])
+
+        outs = [[] for _ in prompts]
+        logit_trace = [[] for _ in prompts]
+        # wave admission against the engine's own scheduling limits
+        # (prompt + decode budget), so oversized request sets run in
+        # waves instead of raising SchedulingError
+        pending = list(range(len(prompts)))
+        while pending:
+            wave = []
+            for i in pending:
+                cand = wave + [i]
+                lens = [len(prompts[j]) + max_new_tokens for j in cand]
+                if self.can_schedule([uids[j] for j in cand], lens) == \
+                        SchedulingResult.Success:
+                    wave.append(i)
+            if not wave:
+                # nothing fits even alone — surface the engine's verdict
+                i = pending[0]
+                result = self.can_schedule([uids[i]], [len(prompts[i])])
+                raise SchedulingError(
+                    result if result != SchedulingResult.Success
+                    else SchedulingResult.BatchTokenLimitExceeded)
+            run_wave(wave)
+            pending = [i for i in pending if i not in wave]
+        if return_logits:
+            return outs, [np.stack(t) if t else None for t in logit_trace]
+        return outs
+
+    # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)
     # -------------------------------------------------------------- #
     def restore_kv(self, batch_uids: Iterable[int], batch_tokens: Iterable,

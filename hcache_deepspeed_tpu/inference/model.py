@@ -71,17 +71,9 @@ class PagedInferenceModel:
         self.tp = topology.tensor_size if topology is not None else 1
 
         self.tied = cfg.tie_word_embeddings
-        self.params = {
-            "embed": params["embed_tokens"]["embedding"],
-            "norm": params["norm"]["weight"],
-            "layers": stack_layer_params(params, cfg.n_layer),
-        }
-        if not self.tied:
-            self.params["lm_head"] = params["lm_head"]["kernel"]
         if self.tp > 1:
             self._validate_tp()
-            self.params = jax.device_put(self.params,
-                                         self._param_shardings())
+        self.load_params(params)
         self.cos, self.sin = rope_frequencies(cfg.head_dim,
                                               cfg.max_positions,
                                               cfg.rope_theta)
@@ -90,6 +82,28 @@ class PagedInferenceModel:
             fwd, restore = self._wrap_tp(fwd, restore)
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
+
+    def load_params(self, params):
+        """(Re)load training-layout parameters into the serving layout —
+        stacked layers, sharded when TP. Called at construction and by the
+        hybrid engine after each training phase (reference:
+        runtime/hybrid_engine.py — inference containers refreshed from
+        ZeRO training params). Shapes are unchanged, so the compiled
+        forward/restore functions are reused without retracing."""
+        new = {
+            "embed": params["embed_tokens"]["embedding"],
+            "norm": params["norm"]["weight"],
+            "layers": stack_layer_params(params, self.cfg.n_layer),
+        }
+        if not self.tied:
+            new["lm_head"] = params["lm_head"]["kernel"]
+        new = jax.tree.map(
+            lambda p: jnp.asarray(p, self.cfg.compute_dtype)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+            new)
+        if self.tp > 1:
+            new = jax.device_put(new, self._param_shardings_for(new))
+        self.params = new
 
     # -------------------------------------------------------------- #
     # Tensor parallelism (reference: per-layer allreduce + sharded heads,
@@ -106,8 +120,9 @@ class PagedInferenceModel:
                 raise ValueError(f"{name}={val} not divisible by "
                                  f"tensor parallel degree {tp}")
 
-    def _param_spec_tree(self):
+    def _param_spec_tree(self, params=None):
         from jax.sharding import PartitionSpec as P
+        params = params if params is not None else self.params
         col3 = P(None, None, TENSOR_AXIS)   # stacked [L, in, out] column
         row3 = P(None, TENSOR_AXIS, None)   # stacked [L, in, out] row
 
@@ -127,17 +142,18 @@ class PagedInferenceModel:
             "embed": P(TENSOR_AXIS, None) if self.tied else P(),
             "norm": P(),
             "layers": jax.tree_util.tree_map_with_path(
-                layer_spec, self.params["layers"]),
+                layer_spec, params["layers"]),
         }
         if not self.tied:
             specs["lm_head"] = P(None, TENSOR_AXIS)
         return specs
 
-    def _param_shardings(self):
+    def _param_shardings_for(self, params):
         from jax.sharding import NamedSharding, PartitionSpec
         mesh = self.topology.mesh
         return jax.tree.map(
-            lambda s: NamedSharding(mesh, s), self._param_spec_tree(),
+            lambda s: NamedSharding(mesh, s),
+            self._param_spec_tree(params),
             is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def cache_sharding(self):

@@ -1,0 +1,108 @@
+"""Hybrid engine: RLHF-style train ↔ generate mode flipping.
+
+Reference analog: ``deepspeed/runtime/hybrid_engine.py:30
+DeepSpeedHybridEngine`` — wraps a ZeRO training engine, and for
+``generate()`` gathers the training parameters into inference-kernel
+containers, runs generation, then repartitions for training.
+
+TPU re-design: no container surgery. The training engine's parameters
+(flax tree, possibly ZeRO/TP-sharded over the mesh) and the paged
+inference model's parameters (stacked per-layer tree) share names and
+shapes, so the mode flip is a *resharding copy*: ``device_put`` from the
+training shardings to the serving layout (device-to-device on the same
+chips — the analog of the reference's allgather into containers, done by
+XLA's resharding instead of hand-written gathers). The inference side is
+the full ragged engine (paged KV, continuous batching, HCache), not a
+stripped generate path.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..inference.config import RaggedInferenceEngineConfig
+from ..inference.engine_v2 import InferenceEngineV2
+from ..utils.logging import log_dist
+from .engine import HDSEngine
+
+
+class HybridEngine:
+    """Wraps a training :class:`HDSEngine` whose model is a causal LM of
+    the Llama family (``models.llama.LlamaForCausalLM`` layout) and serves
+    ``generate()`` from the same weights.
+
+    Parameters refresh into the serving layout lazily: the first
+    ``generate()`` after one or more ``train_batch()`` calls pays one
+    resharding copy (reference: ``hybrid_engine.py`` gathers before
+    generation when ZeRO-3 partitioned).
+    """
+
+    def __init__(self, engine: HDSEngine, model_config,
+                 inference_config: Optional[
+                     RaggedInferenceEngineConfig] = None,
+                 topology=None):
+        self.engine = engine
+        self.model_config = model_config
+        self._inference_config = inference_config
+        self._topology = topology
+        self._infer: Optional[InferenceEngineV2] = None
+        self._params_step = -1  # train step the serving params reflect
+
+    # ------------------------ training side ------------------------ #
+    def train_batch(self, *a, **kw):
+        return self.engine.train_batch(*a, **kw)
+
+    def forward(self, *a, **kw):
+        return self.engine.forward(*a, **kw)
+
+    def backward(self, *a, **kw):
+        return self.engine.backward(*a, **kw)
+
+    def step(self, *a, **kw):
+        return self.engine.step(*a, **kw)
+
+    def save_checkpoint(self, *a, **kw):
+        return self.engine.save_checkpoint(*a, **kw)
+
+    def load_checkpoint(self, *a, **kw):
+        out = self.engine.load_checkpoint(*a, **kw)
+        self._params_step = -1  # force refresh
+        return out
+
+    # ----------------------- inference side ------------------------ #
+    def _raw_params(self):
+        """The training param tree in HF layout (the flax 'params'
+        collection)."""
+        params = self.engine.state["params"]
+        return params.get("params", params)
+
+    def _ensure_infer(self):
+        if self._infer is None:
+            self._infer = InferenceEngineV2(
+                self.model_config, self._raw_params(),
+                config=self._inference_config, topology=self._topology)
+            self._params_step = self.engine.global_steps
+            log_dist("HybridEngine: inference engine materialized",
+                     ranks=[0])
+        elif self._params_step != self.engine.global_steps:
+            # train stepped since the serving params were loaded
+            self._infer.model.load_params(self._raw_params())
+            self._params_step = self.engine.global_steps
+        return self._infer
+
+    @property
+    def inference_engine(self) -> InferenceEngineV2:
+        return self._ensure_infer()
+
+    def generate(self, prompts: List[List[int]], **kw):
+        """Generate continuations with the CURRENT training weights
+        (reference: hybrid_engine.generate — gather, generate, scatter)."""
+        return self._ensure_infer().generate(prompts, **kw)
+
+    def eval_batch(self, *a, **kw):
+        return self.engine.eval_batch(*a, **kw)
+
+    def __getattr__(self, name):
+        # delegate everything else (lr, counters, monitors, ...) to the
+        # training engine
+        return getattr(self.engine, name)

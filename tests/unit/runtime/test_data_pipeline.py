@@ -74,12 +74,13 @@ class TestCurriculumSampler:
         lengths = np.arange(100)  # sample i has difficulty i
         s = CurriculumSampler(lengths, 100, batch_size=4, scheduler=sched)
         b0 = s.next_batch()
-        assert np.all(lengths[b0] <= max(10, 4))
+        assert np.all(lengths[b0] <= 19)  # difficulty 19 after step 1
+        n_admitted_first = len(s.admitted())
         for _ in range(10):
             b = s.next_batch()
-        assert np.all(lengths[b] <= 100)
-        # later pools admit strictly more than the first
-        assert len(s.admitted()) > 12
+        # at max difficulty (100) the whole dataset is admitted
+        assert len(s.admitted()) == 100
+        assert len(s.admitted()) > n_admitted_first
 
 
 class TestEngineSeqlenCurriculum:
@@ -137,12 +138,24 @@ class TestEngineSeqlenCurriculum:
                 yield {"input_ids": rng.integers(0, 256, (8, 64),
                                                  dtype=np.int32)}
 
+        # spy on the shapes actually entering the device step
+        sharded_shapes = []
+        orig = engine._shard_batch
+
+        def spy(batch, **kw):
+            sharded_shapes.append(
+                jax.tree.leaves(batch)[0].shape)
+            return orig(batch, **kw)
+
+        engine._shard_batch = spy
         data_iter = it()
         seen = []
         for _ in range(3):
             engine.train_batch(data_iter=data_iter)
             seen.append(engine.curriculum_difficulty)
         assert seen == [16, 32, 48]
+        # the [gas, micro, seq] stacks must actually be truncated
+        assert [s[-1] for s in sharded_shapes] == [16, 32, 48]
 
     def test_soft_label_leaves_untouched(self, eight_devices):
         from hcache_deepspeed_tpu.runtime.engine import HDSEngine

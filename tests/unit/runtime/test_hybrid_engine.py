@@ -1,0 +1,148 @@
+"""Hybrid engine (reference: runtime/hybrid_engine.py:30) — RLHF-style
+train ↔ generate with shared weights — and the engine_v2 generate() loop."""
+
+import jax
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from hcache_deepspeed_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from hcache_deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+
+
+def _infer_config():
+    return RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 128,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 16, "num_blocks": 32,
+                  "cache_dtype": "float32"})
+
+
+def _train_engine(mcfg):
+    model = LlamaForCausalLM(mcfg)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32), dtype=np.int32)}
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+           "zero_optimization": {"stage": 2, "min_shard_size": 1}}
+    engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                     example_batch=batch)
+    return engine, batch
+
+
+class TestGenerate:
+    def _engine(self):
+        mcfg = llama_tiny(max_positions=128)
+        model = LlamaForCausalLM(mcfg)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            {"input_ids": np.zeros((1, 8), np.int32)},
+            train=False)["params"]
+        return InferenceEngineV2(mcfg, params, config=_infer_config())
+
+    def test_greedy_batch(self):
+        eng = self._engine()
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 256, (n,)).tolist() for n in (5, 9)]
+        outs = eng.generate(prompts, max_new_tokens=6)
+        assert [len(o) for o in outs] == [6, 6]
+        assert all(0 <= t < 256 for o in outs for t in o)
+        # all sequences flushed — pool back to empty
+        assert eng.state.n_tracked_sequences == 0
+
+    def test_greedy_matches_stepwise_decode(self):
+        eng = self._engine()
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, 256, (7,)).tolist()
+        outs = eng.generate([prompt], max_new_tokens=4)
+        # manual greedy loop must agree
+        logits, _ = eng.put([99], [prompt])
+        toks = []
+        tok = int(np.argmax(logits[0]))
+        for _ in range(4):
+            toks.append(tok)
+            logits, _ = eng.put([99], [[tok]])
+            tok = int(np.argmax(logits[0]))
+        eng.flush(99)
+        assert outs[0] == toks
+
+    def test_eos_stops_and_logits_returned(self):
+        eng = self._engine()
+        prompt = [1, 2, 3]
+        outs, traces = eng.generate([prompt], max_new_tokens=5,
+                                    return_logits=True)
+        eos = outs[0][1] if len(outs[0]) > 1 else None
+        assert traces[0].shape[0] == len(outs[0])
+        if eos is not None:
+            outs2 = eng.generate([prompt], max_new_tokens=5,
+                                 eos_token_id=eos)
+            assert outs2[0][-1] == eos or len(outs2[0]) == 5
+
+    def test_sampling_temperature(self):
+        eng = self._engine()
+        prompt = [5, 6, 7, 8]
+        a = eng.generate([prompt], max_new_tokens=5, temperature=1.5,
+                         seed=1)
+        c = eng.generate([prompt], max_new_tokens=5, temperature=1.5,
+                         seed=1)
+        assert a == c          # deterministic per seed
+        # different seeds must differ at least once across a few tries
+        assert any(
+            eng.generate([prompt], max_new_tokens=5, temperature=1.5,
+                         seed=s) != a for s in range(2, 6))
+
+    def test_oversized_request_runs_in_waves(self):
+        eng = self._engine()  # max_ragged_sequence_count = 4
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 256, (4,)).tolist() for _ in range(6)]
+        outs = eng.generate(prompts, max_new_tokens=3)
+        assert [len(o) for o in outs] == [3] * 6
+        assert eng.state.n_tracked_sequences == 0
+
+    def test_topk_larger_than_vocab_ok(self):
+        eng = self._engine()
+        outs = eng.generate([[1, 2, 3]], max_new_tokens=3,
+                            temperature=1.0, top_k=10_000)
+        assert len(outs[0]) == 3
+
+
+class TestHybridEngine:
+    def test_generate_reflects_training(self, eight_devices):
+        mcfg = llama_tiny(max_positions=128)
+        engine, batch = _train_engine(mcfg)
+        hybrid = HybridEngine(engine, mcfg,
+                              inference_config=_infer_config())
+        prompt = [3, 1, 4, 1, 5]
+        before = hybrid.generate([prompt], max_new_tokens=4)
+        for _ in range(6):
+            hybrid.train_batch(batch=batch)
+        after = hybrid.generate([prompt], max_new_tokens=4)
+        # weights changed: greedy continuation should change too (tiny
+        # random model, aggressive lr — practically always differs)
+        assert before != after
+
+    def test_no_retrace_between_refreshes(self, eight_devices):
+        """Param refresh reuses compiled fns: generating twice after a
+        train step must not rebuild the inference engine."""
+        mcfg = llama_tiny(max_positions=128)
+        engine, batch = _train_engine(mcfg)
+        hybrid = HybridEngine(engine, mcfg,
+                              inference_config=_infer_config())
+        hybrid.generate([[1, 2, 3]], max_new_tokens=2)
+        infer0 = hybrid.inference_engine
+        hybrid.train_batch(batch=batch)
+        hybrid.generate([[1, 2, 3]], max_new_tokens=2)
+        assert hybrid.inference_engine is infer0
+
+    def test_delegation(self, eight_devices):
+        mcfg = llama_tiny(max_positions=128)
+        engine, batch = _train_engine(mcfg)
+        hybrid = HybridEngine(engine, mcfg,
+                              inference_config=_infer_config())
+        loss = float(hybrid.train_batch(batch=batch))
+        assert np.isfinite(loss)
+        assert hybrid.global_steps == 1  # __getattr__ delegation
