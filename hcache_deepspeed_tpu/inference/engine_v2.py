@@ -284,25 +284,37 @@ class InferenceEngineV2:
 
         outs = [[] for _ in prompts]
         logit_trace = [[] for _ in prompts]
-        # wave admission against the engine's own scheduling limits
-        # (prompt + decode budget), so oversized request sets run in
-        # waves instead of raising SchedulingError
+        # wave admission against the engine's own scheduling limits, so
+        # oversized request sets run in waves instead of raising
+        # SchedulingError. The per-forward budget (can_schedule) sees the
+        # PROMPT lengths (decodes are 1-token forwards); KV growth over
+        # the whole generation is budgeted against the free block pool.
+        for i, p in enumerate(prompts):
+            if len(p) + max_new_tokens > self.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
         pending = list(range(len(prompts)))
         while pending:
             wave = []
+            blocks_left = self.state.allocator.free_blocks
             for i in pending:
                 cand = wave + [i]
-                lens = [len(prompts[j]) + max_new_tokens for j in cand]
+                need = -(-(len(prompts[i]) + max_new_tokens) //
+                         self.block_size) + 1
+                if need > blocks_left:
+                    continue
+                lens = [len(prompts[j]) for j in cand]
                 if self.can_schedule([uids[j] for j in cand], lens) == \
                         SchedulingResult.Success:
                     wave.append(i)
+                    blocks_left -= need
             if not wave:
                 # nothing fits even alone — surface the engine's verdict
                 i = pending[0]
                 result = self.can_schedule([uids[i]], [len(prompts[i])])
                 raise SchedulingError(
                     result if result != SchedulingResult.Success
-                    else SchedulingResult.BatchTokenLimitExceeded)
+                    else SchedulingResult.KVCacheLimitExceeded)
             run_wave(wave)
             pending = [i for i in pending if i not in wave]
         if return_logits:

@@ -80,8 +80,8 @@ def _ensure_loaded():
     if _loaded:
         return
     _loaded = True
-    from . import (flash_attention, paged_attention, quantizer,  # noqa: F401
-                   rms_norm, rope)
+    from . import (flash_attention, fp_quantizer,  # noqa: F401
+                   paged_attention, quantizer, rms_norm, rope)
 
 
 __all__ = ["register_op", "get_op", "get_op_impl", "op_report"]

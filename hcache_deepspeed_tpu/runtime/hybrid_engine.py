@@ -18,8 +18,6 @@ stripped generate path.
 
 from typing import List, Optional
 
-import numpy as np
-
 from ..inference.config import RaggedInferenceEngineConfig
 from ..inference.engine_v2 import InferenceEngineV2
 from ..utils.logging import log_dist

@@ -102,15 +102,186 @@ def onebit_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
     def _compressed_allreduce_inside(x, error):
         """compressed_allreduce body for use when already inside the
         shard_map (no re-wrapping)."""
-        n = jax.lax.psum(jnp.ones(()), axis)
-        compensated = x + error
-        scale = jnp.mean(jnp.abs(compensated))
-        sign = jnp.sign(compensated)
-        new_error = compensated - sign * scale
-        avg = jax.lax.psum(sign * scale, axis) / n
-        return avg, new_error
+        return _compressed_allreduce_body(x, error, axis)
 
     return init, update
 
 
-__all__ = ["onebit_adam", "OnebitAdamState", "compressed_allreduce"]
+def _compressed_allreduce_body(x, error, axis):
+    """Error-feedback 1-bit allreduce body for use inside shard_map."""
+    n = jax.lax.psum(jnp.ones(()), axis)
+    compensated = x + error
+    scale = jnp.mean(jnp.abs(compensated))
+    sign = jnp.sign(compensated)
+    new_error = compensated - sign * scale
+    avg = jax.lax.psum(sign * scale, axis) / n
+    return avg, new_error
+
+
+class OnebitLambState(NamedTuple):
+    m: any
+    v: any
+    error: any
+    coeff: any   # per-leaf trust-ratio coefficient, frozen at stage flip
+    step: any
+
+
+def onebit_lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                freeze_step=100, max_coeff=10.0, min_coeff=0.01,
+                axis=DATA_AXIS):
+    """1-bit LAMB (reference: ``runtime/fp16/onebit/lamb.py:15``).
+
+    Warmup: full-precision gradient pmean + LAMB (layerwise trust-ratio)
+    update, tracking each leaf's coefficient. Compression: 1-bit
+    error-feedback allreduce of the momentum, variance AND the per-leaf
+    trust coefficients frozen at their last warmup values (the
+    reference's "fused lamb coefficients frozen" rule).
+
+    Same shard_map calling convention as :func:`onebit_adam`.
+    """
+    b1, b2 = betas
+
+    def init(params):
+        zeros = lambda: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        ones = jax.tree.map(lambda p: jnp.ones((), jnp.float32), params)
+        return OnebitLambState(m=zeros(), v=zeros(), error=zeros(),
+                               coeff=ones,
+                               step=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params, lr_now=None, compressed=False):
+        lr_now = lr if lr_now is None else lr_now
+        step = state.step + 1
+        bc1 = 1 - b1 ** step.astype(jnp.float32)
+        bc2 = 1 - b2 ** step.astype(jnp.float32)
+
+        if not compressed:
+            g = jax.tree.map(lambda x: jax.lax.pmean(x, axis), grads)
+            m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                             state.m, g)
+            v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                             state.v, g)
+            err = state.error
+
+            def upd_warm(p, m_, v_):
+                u = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps) + \
+                    weight_decay * p
+                pn = jnp.linalg.norm(p.reshape(-1))
+                un = jnp.linalg.norm(u.reshape(-1))
+                # trust ratio defaults to 1 when either norm is zero
+                # (reference LAMB semantics; avoids the zero-init stall)
+                coeff = jnp.where(
+                    (pn > 0) & (un > 0),
+                    jnp.clip(pn / jnp.maximum(un, 1e-12), min_coeff,
+                             max_coeff),
+                    1.0)
+                return -(lr_now * coeff * u), coeff
+
+            out = jax.tree.map(upd_warm, params, m, v)
+            updates = jax.tree.map(lambda t: t[0], out,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+            coeff = jax.tree.map(lambda t: t[1], out,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+        else:
+            m_local = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                                   state.m, grads)
+            flat_m, treedef = jax.tree.flatten(m_local)
+            flat_e = jax.tree.leaves(state.error)
+            pairs = [_compressed_allreduce_body(m_i, e_i, axis)
+                     for m_i, e_i in zip(flat_m, flat_e)]
+            m = jax.tree.unflatten(treedef, [p[0] for p in pairs])
+            err = jax.tree.unflatten(treedef, [p[1] for p in pairs])
+            v = state.v       # frozen
+            coeff = state.coeff  # frozen trust ratios
+
+            def upd_comp(p, m_, v_, c):
+                u = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps) + \
+                    weight_decay * p
+                return -(lr_now * c * u)
+
+            updates = jax.tree.map(upd_comp, params, m, v, coeff)
+
+        return updates, OnebitLambState(m=m, v=v, error=err, coeff=coeff,
+                                        step=step)
+
+    return init, update
+
+
+class ZeroOneAdamState(NamedTuple):
+    m: any
+    v: any
+    error: any
+    step: any
+
+
+def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                  weight_decay=0.0, var_freeze_step=100,
+                  local_step_scaler=100, local_step_clipper=8,
+                  axis=DATA_AXIS):
+    """0/1 Adam (reference: ``runtime/fp16/onebit/zoadam.py:14``).
+
+    Both synchronizations are throttled: the variance is updated only
+    while ``step < var_freeze_step`` (then frozen), and the 1-bit
+    momentum allreduce runs only on *sync steps* — between syncs each
+    device takes local steps. The sync interval doubles every
+    ``local_step_scaler`` steps, capped at ``2**local_step_clipper``
+    (the reference's learning-rate/variance update policies).
+
+    ``sync_interval(step)`` gives the host-side schedule;
+    ``update(..., sync=..., update_var=...)`` takes the trace-time stage
+    flags exactly like :func:`onebit_adam`'s ``compressed``.
+    """
+    b1, b2 = betas
+
+    def sync_interval(step: int) -> int:
+        return min(2 ** (step // local_step_scaler),
+                   2 ** local_step_clipper)
+
+    def is_sync_step(step: int) -> bool:
+        return step % sync_interval(step) == 0
+
+    def init(params):
+        zeros = lambda: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return ZeroOneAdamState(m=zeros(), v=zeros(), error=zeros(),
+                                step=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params, lr_now=None, sync=True,
+               update_var=True):
+        lr_now = lr if lr_now is None else lr_now
+        step = state.step + 1
+
+        m_local = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                               state.m, grads)
+        if sync:
+            flat_m, treedef = jax.tree.flatten(m_local)
+            flat_e = jax.tree.leaves(state.error)
+            pairs = [_compressed_allreduce_body(m_i, e_i, axis)
+                     for m_i, e_i in zip(flat_m, flat_e)]
+            m = jax.tree.unflatten(treedef, [p[0] for p in pairs])
+            err = jax.tree.unflatten(treedef, [p[1] for p in pairs])
+        else:
+            m, err = m_local, state.error
+
+        if update_var:
+            v = jax.tree.map(lambda v, m_: b2 * v + (1 - b2) * m_ * m_,
+                             state.v, m)
+        else:
+            v = state.v
+
+        bc1 = 1 - b1 ** step.astype(jnp.float32)
+        bc2 = 1 - b2 ** step.astype(jnp.float32)
+
+        def upd(p, m_, v_):
+            return -(lr_now * ((m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps) +
+                               weight_decay * p))
+
+        updates = jax.tree.map(upd, params, m, v)
+        return updates, ZeroOneAdamState(m=m, v=v, error=err, step=step)
+
+    return init, update, sync_interval, is_sync_step
+
+
+__all__ = ["onebit_adam", "OnebitAdamState", "onebit_lamb",
+           "OnebitLambState", "zero_one_adam", "ZeroOneAdamState",
+           "compressed_allreduce"]

@@ -1,0 +1,107 @@
+"""FP8/FP6 group quantizer (reference: csrc/fp_quantizer/fp_quantize.cu)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.ops.fp_quantizer import (
+    dequantize_fp6, dequantize_fp8, pallas_quantize_fp8,
+    reference_quantize_fp6, reference_quantize_fp8, selective_dequantize)
+
+
+def _x(shape, seed=0, scale=3.0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape) * scale,
+        jnp.float32)
+
+
+class TestFP8:
+    @pytest.mark.parametrize("fmt,rtol", [("e4m3", 0.08), ("e5m2", 0.2)])
+    def test_roundtrip_error_bound(self, fmt, rtol):
+        x = _x((64, 256))
+        q, s, shape, n = reference_quantize_fp8(x, 256, fmt)
+        assert q.dtype == (jnp.float8_e4m3fn if fmt == "e4m3"
+                           else jnp.float8_e5m2)
+        out = dequantize_fp8(q, s, shape, n)
+        err = np.abs(np.asarray(out) - np.asarray(x))
+        # per-group max sets the scale; elementwise error ≤ grid step
+        assert np.max(err / (np.abs(np.asarray(x)) + 1e-3)) < rtol * 4
+        assert np.mean(err) < rtol * np.mean(np.abs(np.asarray(x)))
+
+    def test_pallas_matches_reference(self):
+        x = _x((32, 512), seed=1)
+        qr, sr, shr, nr = reference_quantize_fp8(x, 256)
+        qp, sp, shp, np_ = pallas_quantize_fp8(x, 256, interpret=True)
+        # reduction order differs → scales agree to float assoc. noise;
+        # compare the dequantized values
+        np.testing.assert_allclose(np.asarray(sr), np.asarray(sp),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(dequantize_fp8(qr, sr, shr, nr)),
+            np.asarray(dequantize_fp8(qp, sp, shp, np_)), rtol=2e-2,
+            atol=1e-3)
+
+    def test_zero_tensor(self):
+        x = jnp.zeros((4, 256))
+        q, s, shape, n = reference_quantize_fp8(x, 256)
+        np.testing.assert_array_equal(
+            np.asarray(dequantize_fp8(q, s, shape, n)), 0.0)
+
+    def test_padding_tail(self):
+        x = _x((3, 100))  # 300 elems, group 256 → padded
+        q, s, shape, n = reference_quantize_fp8(x, 256)
+        out = dequantize_fp8(q, s, shape, n)
+        assert out.shape == x.shape
+
+
+class TestFP6:
+    def test_roundtrip_error_bound(self):
+        x = _x((16, 256), seed=2)
+        q, s, shape, n = reference_quantize_fp6(x, 256)
+        assert q.dtype == jnp.uint8
+        out = dequantize_fp6(q, s, shape, n)
+        err = np.abs(np.asarray(out) - np.asarray(x))
+        xs = np.abs(np.asarray(x))
+        # E3M2: 2 mantissa bits → ≤ 12.5% relative on normals; near-zero
+        # values bottom out at the subnormal step (scale * 2^-2 / 4)
+        scale_max = float(np.max(np.asarray(s)))
+        assert np.max(err) < scale_max * 2.01  # half max grid spacing
+        normal = xs > scale_max  # comfortably in the normal range
+        assert np.max((err / np.maximum(xs, 1e-9))[normal]) < 0.13
+        assert np.mean(err / (xs + 1e-2)) < 0.08
+
+    def test_exact_grid_values(self):
+        # values on the E3M2 grid (scaled so max maps to 28) roundtrip
+        vals = jnp.asarray([[0.0, 1.0, 1.25, 1.5, 1.75, 2.0, -3.5, 28.0]])
+        q, s, shape, n = reference_quantize_fp6(vals, 8)
+        out = np.asarray(dequantize_fp6(q, s, shape, n))
+        np.testing.assert_allclose(out, np.asarray(vals), rtol=1e-6)
+
+    def test_code_range_is_6_bits(self):
+        x = _x((8, 256), seed=3)
+        q, _, _, _ = reference_quantize_fp6(x, 256)
+        assert int(np.max(np.asarray(q))) < 64
+
+
+class TestSelectiveDequant:
+    def test_rows_match_full(self):
+        x = _x((16, 128), seed=4)
+        q, s, shape, n = reference_quantize_fp8(x, 128)
+        full = np.asarray(dequantize_fp8(q, s, shape, n))
+        sel = np.asarray(selective_dequantize(q, s, shape, n,
+                                              np.asarray([2, 5, 11])))
+        np.testing.assert_allclose(sel, full[[2, 5, 11]])
+
+    def test_fp6_rows(self):
+        x = _x((8, 128), seed=5)
+        q, s, shape, n = reference_quantize_fp6(x, 128)
+        full = np.asarray(dequantize_fp6(q, s, shape, n))
+        sel = np.asarray(selective_dequantize(q, s, shape, n,
+                                              slice(1, 4), fmt="fp6"))
+        np.testing.assert_allclose(sel, full[1:4])
+
+    def test_misaligned_rows_rejected(self):
+        x = _x((4, 100), seed=6)
+        q, s, shape, n = reference_quantize_fp8(x, 64)
+        with pytest.raises(ValueError, match="aligned"):
+            selective_dequantize(q, s, shape, n, slice(0, 2))

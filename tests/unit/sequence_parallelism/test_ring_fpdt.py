@@ -158,3 +158,63 @@ class TestHostOffloadKV:
                                   jnp.asarray(v), causal=True)
         np.testing.assert_allclose(np.asarray(out),
                                    np.asarray(ref)[:, q_start:], atol=2e-5)
+
+
+class TestOffloadedChunkedAttention:
+    """Training-capable offloaded FPDT attention (reference:
+    fpdt_layer.py:510 _FPDTGPUOffloadingAttentionImpl_)."""
+
+    def _qkv(self, B=2, T=256, H=4, D=32, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(
+            rng.standard_normal((B, T, H, D)), jnp.float32)
+        return mk(), mk(), mk()
+
+    def test_matches_plain_chunked(self):
+        from hcache_deepspeed_tpu.sequence.fpdt import (
+            chunked_attention, offloaded_chunked_attention)
+        q, k, v = self._qkv()
+        a = chunked_attention(q, k, v, q_chunk=64)
+        b = offloaded_chunked_attention(q, k, v, q_chunk=64)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+    def test_backward_through_offload_tags(self):
+        from hcache_deepspeed_tpu.sequence.fpdt import (
+            chunked_attention, offloaded_chunked_attention)
+        q, k, v = self._qkv(seed=1)
+
+        def loss_off(q, k, v):
+            return offloaded_chunked_attention(
+                q, k, v, q_chunk=64).astype(jnp.float32).sum()
+
+        def loss_ref(q, k, v):
+            return chunked_attention(
+                q, k, v, q_chunk=64).astype(jnp.float32).sum()
+
+        g_off = jax.jit(jax.grad(loss_off, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g_off, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_offload_policy_compiles_and_matches(self):
+        """jax.checkpoint(step, policy=fpdt_offload_policy()): the tagged
+        KV residuals route to pinned host memory; numerics unchanged.
+        Skipped when the backend has no host memory space."""
+        from hcache_deepspeed_tpu.sequence.fpdt import (
+            fpdt_offload_policy, offloaded_chunked_attention)
+        q, k, v = self._qkv(seed=2)
+
+        def step(q, k, v):
+            return offloaded_chunked_attention(
+                q, k, v, q_chunk=64).astype(jnp.float32).sum()
+
+        wrapped = jax.checkpoint(step, policy=fpdt_offload_policy())
+        try:
+            g = jax.jit(jax.grad(wrapped, argnums=(0,)))(q, k, v)[0]
+        except Exception as e:  # backend without pinned_host space
+            pytest.skip(f"host offload unsupported here: {e}")
+        g_ref = jax.jit(jax.grad(step, argnums=(0,)))(q, k, v)[0]
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=1e-4)
