@@ -1,0 +1,108 @@
+"""Elastic agent: worker monitoring + resize-and-restart.
+
+Reference analog: ``deepspeed/elasticity/elastic_agent.py:32
+DSElasticAgent`` (extends torch-elastic's ``LocalElasticAgent``: monitors
+worker processes, and on membership change restarts the group with
+DeepSpeed env injected) plus the ``--elastic_training`` launcher path.
+
+TPU re-design: workers are the per-host launcher processes. The agent
+spawns them via a caller-supplied ``cmd_fn(world_size, restart_count) ->
+argv list``, polls liveness, and on any worker death kills the group
+and relaunches: a partial failure (some workers survive) shrinks to the
+largest batch-compatible world size ≤ the survivor count
+(``compute_elastic_config`` arithmetic); a whole-group failure retries
+at the same size (torch-elastic's app-crash behavior). Bounded by
+``max_restarts``; a clean all-zero exit ends the run. ``cmd_fn``
+receives ``(world_size, restart_count, worker_idx)``."""
+
+import subprocess
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..utils.logging import log_dist
+from .elasticity import compute_elastic_config
+
+
+class ElasticAgentError(RuntimeError):
+    pass
+
+
+class ElasticAgent:
+    def __init__(self, cmd_fn: Callable[[int, int, int], Sequence[str]],
+                 world_size: int,
+                 elastic_config: Optional[dict] = None,
+                 max_restarts: int = 3,
+                 poll_interval: float = 0.2):
+        self.cmd_fn = cmd_fn
+        self.world_size = world_size
+        self.elastic_config = elastic_config
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.restart_count = 0
+        self._procs: List[subprocess.Popen] = []
+
+    # -------------------------------------------------------------- #
+    def _spawn(self, n: int):
+        self._procs = [
+            subprocess.Popen(list(self.cmd_fn(n, self.restart_count, i)))
+            for i in range(n)]
+        log_dist(f"ElasticAgent: spawned {n} workers "
+                 f"(restart {self.restart_count})", ranks=[0])
+
+    def _kill_all(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs = []
+
+    def _resize(self, alive: int) -> int:
+        """Next world size after losing workers: the largest
+        batch-compatible count ≤ alive (reference: elasticity v0.1/0.2
+        arithmetic), or simply ``alive`` without an elastic config."""
+        if alive < 1:
+            raise ElasticAgentError("no workers left to restart with")
+        if self.elastic_config is None:
+            return alive
+        _batch, valid_gpus, _micro = compute_elastic_config(
+            self.elastic_config)
+        fits = [g for g in valid_gpus if g <= alive]
+        if not fits:
+            raise ElasticAgentError(
+                f"no batch-compatible world size <= {alive} "
+                f"(valid: {valid_gpus})")
+        return max(fits)
+
+    # -------------------------------------------------------------- #
+    def run(self) -> int:
+        """Monitor loop. Returns the final world size on success."""
+        n = self.world_size
+        self._spawn(n)
+        try:
+            while True:
+                time.sleep(self.poll_interval)
+                codes = [p.poll() for p in self._procs]
+                if all(c == 0 for c in codes):
+                    log_dist("ElasticAgent: clean exit", ranks=[0])
+                    return n
+                failed = [i for i, c in enumerate(codes)
+                          if c is not None and c != 0]
+                if failed:
+                    self.restart_count += 1
+                    if self.restart_count > self.max_restarts:
+                        raise ElasticAgentError(
+                            f"exceeded max_restarts={self.max_restarts}")
+                    alive = n - len(failed)
+                    if alive == 0:
+                        alive = n  # whole-group app crash: retry as-is
+                    log_dist(f"ElasticAgent: workers {failed} died; "
+                             f"resizing", ranks=[0])
+                    self._kill_all()
+                    n = self._resize(alive)
+                    self._spawn(n)
+        finally:
+            self._kill_all()

@@ -24,7 +24,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from . import register_op
-from .quantizer import _pack_groups
+from .quantizer import _pack_groups, reference_dequantize
 
 _FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
 _FP8_DTYPE = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
@@ -84,8 +84,7 @@ def pallas_quantize_fp8(x, group_size=2048, fmt="e4m3", interpret=None,
 
 
 def dequantize_fp8(q, scale, orig_shape, orig_n):
-    out = (q.astype(jnp.float32) * scale).reshape(-1)[:orig_n]
-    return out.reshape(orig_shape)
+    return reference_dequantize(q, scale, orig_shape, orig_n)
 
 
 # ------------------------------------------------------------------ #
@@ -143,11 +142,12 @@ def dequantize_fp6(codes, scale, orig_shape, orig_n):
 # Selective dequantization (reference: fp_quantize.cpp
 # selective_dequantize — dequantize only a row range of the tensor)
 # ------------------------------------------------------------------ #
-def selective_dequantize(q, scale, orig_shape, orig_n, rows, fmt="fp8"):
+def selective_dequantize(q, scale, orig_shape, orig_n, rows):
     """Dequantize rows ``rows`` (slice or index array on dim 0) of the
     original tensor without touching the rest. Requires the row stride
     be a multiple of the group size (the reference imposes the same
-    alignment)."""
+    alignment). The format is inferred from ``q.dtype`` (uint8 = FP6
+    codes, float8 = FP8)."""
     row_elems = int(np.prod(orig_shape[1:]))
     group_size = q.shape[-1]
     if row_elems % group_size:
@@ -159,9 +159,9 @@ def selective_dequantize(q, scale, orig_shape, orig_n, rows, fmt="fp8"):
     gidx = (rows[:, None] * gpr + np.arange(gpr)[None, :]).reshape(-1)
     qs = q[gidx]
     ss = scale[gidx]
-    dec = _fp6_decode(qs) if fmt == "fp6" else qs.astype(jnp.float32)
-    out = (dec * ss).reshape((len(rows),) + tuple(orig_shape[1:]))
-    return out
+    dec = _fp6_decode(qs) if qs.dtype == jnp.uint8 \
+        else qs.astype(jnp.float32)
+    return (dec * ss).reshape((len(rows),) + tuple(orig_shape[1:]))
 
 
 def quantize_fp8(x, group_size=2048, fmt="e4m3"):

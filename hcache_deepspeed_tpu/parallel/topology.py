@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+ZERO_AXIS = "zero"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
@@ -38,8 +39,12 @@ TENSOR_AXIS = "tensor"
 #: canonical axis order, slowest-varying first. ``pipe`` leads so that on
 #: multi-slice systems pipeline P2P (lowest volume per step) is what crosses
 #: DCN, and tensor-parallel (highest volume, per-layer) stays innermost on ICI
-#: — the layout recipe from the scaling playbook.
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+#: — the layout recipe from the scaling playbook. ``zero`` (the MiCS
+#: shard-group axis, usually 1) sits inside ``data`` so ZeRO gathers stay on
+#: the fast links while the cross-group gradient allreduce rides the outer
+#: axis (reference: runtime/zero/mics.py hierarchical partitioning).
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, ZERO_AXIS, EXPERT_AXIS, SEQ_AXIS,
+             TENSOR_AXIS)
 
 
 @dataclass(frozen=True)
@@ -49,21 +54,24 @@ class TopologySpec:
     expert: int = 1
     seq: int = 1
     tensor: int = 1
+    zero: int = 1  # MiCS shard-group size (1 = ZeRO shards over data)
 
     def resolve(self, n_devices: int) -> "TopologySpec":
-        fixed = self.pipe * self.expert * self.seq * self.tensor
+        fixed = self.pipe * self.zero * self.expert * self.seq * self.tensor
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"device count {n_devices} not divisible by "
-                    f"pipe*expert*seq*tensor={fixed}")
+                    f"pipe*zero*expert*seq*tensor={fixed}")
             data = n_devices // fixed
-        if self.pipe * data * self.expert * self.seq * self.tensor != n_devices:
+        if self.pipe * data * self.zero * self.expert * self.seq * \
+                self.tensor != n_devices:
             raise ValueError(
-                f"mesh {self.pipe}x{data}x{self.expert}x{self.seq}x"
-                f"{self.tensor} != device count {n_devices}")
-        return TopologySpec(self.pipe, data, self.expert, self.seq, self.tensor)
+                f"mesh {self.pipe}x{data}x{self.zero}x{self.expert}x"
+                f"{self.seq}x{self.tensor} != device count {n_devices}")
+        return TopologySpec(self.pipe, data, self.expert, self.seq,
+                            self.tensor, self.zero)
 
 
 class MeshTopology:
@@ -78,12 +86,14 @@ class MeshTopology:
                 raise ValueError(f"unknown mesh axes {missing}; use {MESH_AXES}")
             self.mesh = mesh
             sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-            self.spec = TopologySpec(*(sizes.get(a, 1) for a in MESH_AXES))
+            self.spec = TopologySpec(**{a: sizes.get(a, 1)
+                                        for a in MESH_AXES})
             return
         devices = devices if devices is not None else jax.devices()
         spec = (spec or TopologySpec()).resolve(len(devices))
         self.spec = spec
-        shape = (spec.pipe, spec.data, spec.expert, spec.seq, spec.tensor)
+        shape = (spec.pipe, spec.data, spec.zero, spec.expert, spec.seq,
+                 spec.tensor)
         dev_array = np.asarray(devices).reshape(shape)
         self.mesh = Mesh(dev_array, MESH_AXES)
 
@@ -124,14 +134,19 @@ class MeshTopology:
     # Derived groups (reference: dp group = world/(mp*pp); expert-data
     # groups; sp-data groups)
     # -------------------------------------------------------------- #
+    @property
+    def zero_size(self):
+        return self.axis_size(ZERO_AXIS)
+
     def batch_shard_axes(self):
         """Axes the global batch dimension is split over.
 
         Expert-parallel ranks consume distinct micro-batches, exactly like
         the reference where EP ranks are drawn from the DP group
-        (``_create_expert_and_data_parallel``, groups.py:236).
+        (``_create_expert_and_data_parallel``, groups.py:236). The MiCS
+        ``zero`` axis carries data-parallel replicas too.
         """
-        return tuple(a for a in (DATA_AXIS, EXPERT_AXIS)
+        return tuple(a for a in (DATA_AXIS, ZERO_AXIS, EXPERT_AXIS)
                      if self.axis_size(a) > 1)
 
     def sequence_shard_axes(self):
@@ -145,18 +160,26 @@ class MeshTopology:
         (reference: separate expert/non-expert reduction,
         ``runtime/engine.py:2623-2666``).
         """
-        axes = [DATA_AXIS, SEQ_AXIS] if expert_param else \
-               [DATA_AXIS, EXPERT_AXIS, SEQ_AXIS]
+        axes = [DATA_AXIS, ZERO_AXIS, SEQ_AXIS] if expert_param else \
+               [DATA_AXIS, ZERO_AXIS, EXPERT_AXIS, SEQ_AXIS]
         return tuple(a for a in axes if self.axis_size(a) > 1)
 
     def zero_shard_axes(self):
-        """Axes ZeRO partitions parameters/grads/optimizer state over."""
+        """Axes ZeRO partitions parameters/grads/optimizer state over.
+
+        With a MiCS shard group (``zero`` axis > 1) state shards over the
+        group only and REPLICATES over ``data`` — XLA's gathers then span
+        the group's fast links while the gradient allreduce crosses
+        groups (reference: runtime/zero/mics.py shard groups +
+        ``mics_hierarchical_params_gather``)."""
+        if self.zero_size > 1:
+            return (ZERO_AXIS,)
         return tuple(a for a in (DATA_AXIS,) if self.axis_size(a) > 1)
 
     def dp_world_size(self):
-        """Replica count for batch-size accounting (dp × ep × sp... no:
-        sp ranks share a batch element's sequence, so only dp × ep)."""
-        return self.data_size * self.expert_size
+        """Replica count for batch-size accounting (dp × zero × ep; sp
+        ranks share a batch element's sequence, so seq is excluded)."""
+        return self.data_size * self.zero_size * self.expert_size
 
     # -------------------------------------------------------------- #
     # Sharding helpers
@@ -178,8 +201,9 @@ class MeshTopology:
         return self.sharding(*spec)
 
     def __repr__(self):
-        return (f"MeshTopology(pipe={self.pipe_size}, data={self.data_size}, "
-                f"expert={self.expert_size}, seq={self.seq_size}, "
+        zero = f", zero={self.zero_size}" if self.zero_size > 1 else ""
+        return (f"MeshTopology(pipe={self.pipe_size}, data={self.data_size}"
+                f"{zero}, expert={self.expert_size}, seq={self.seq_size}, "
                 f"tensor={self.tensor_size})")
 
 

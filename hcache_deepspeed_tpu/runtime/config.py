@@ -107,6 +107,7 @@ class MeshConfig(HDSConfigModel):
     expert: int = 1
     seq: int = 1
     tensor: int = 1
+    zero: int = 1  # MiCS shard-group size (runtime/zero/mics.py analog)
 
 
 class PipelineConfig(HDSConfigModel):

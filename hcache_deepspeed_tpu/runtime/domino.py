@@ -1,0 +1,50 @@
+"""Domino: communication-hiding tensor parallelism.
+
+Reference analog: ``deepspeed/runtime/domino/transformer.py`` (605 LoC) +
+``async_linear.py`` — the transformer layer splits each micro-batch into
+two half-batches and hand-schedules async TP allreduces so one half's
+collective overlaps the other half's compute (NoOper/HANDLE_DIC event
+machinery).
+
+TPU re-design: the *mechanism* dissolves — XLA's latency-hiding scheduler
+overlaps any collective with any independent compute automatically. What
+remains load-bearing is the *program shape*: the layer must present two
+independent half-batch compute→allreduce chains for the scheduler to
+interleave. ``domino_split`` restructures a TP transformer layer exactly
+that way: x → [x0, x1]; attention(x0); attention(x1) (x0's psum now
+overlaps x1's attention math); MLP likewise, carrying the halves through
+the residual stream and re-concatenating at the end. Numerically
+identical to the unsplit layer for any batch-pointwise layer function.
+"""
+
+import jax.numpy as jnp
+
+
+def domino_split(layer_fn, x, *args, **kwargs):
+    """Run ``layer_fn`` (a TP block: [B, T, H] -> [B, T, H] containing
+    tensor-axis psums) over two half-batches so XLA overlaps one half's
+    collectives with the other half's compute.
+
+    ``layer_fn`` must be batch-pointwise (no cross-batch reductions) —
+    true of transformer blocks. Odd batches put the extra row in the
+    first half.
+    """
+    B = x.shape[0]
+    if B < 2:
+        return layer_fn(x, *args, **kwargs)
+    h = (B + 1) // 2
+    y0 = layer_fn(x[:h], *args, **kwargs)
+    y1 = layer_fn(x[h:], *args, **kwargs)
+    return jnp.concatenate([y0, y1], axis=0)
+
+
+class DominoTransformer:
+    """Layer wrapper applying :func:`domino_split` to every call
+    (reference: ``DominoTransformerLayer`` — same layer, comm-hiding
+    execution shape)."""
+
+    def __init__(self, layer_fn):
+        self.layer_fn = layer_fn
+
+    def __call__(self, x, *args, **kwargs):
+        return domino_split(self.layer_fn, x, *args, **kwargs)

@@ -154,7 +154,8 @@ class HDSEngine:
                                 expert=config.mesh.expert,
                                 seq=max(config.mesh.seq,
                                         config.sequence_parallel_size),
-                                tensor=config.mesh.tensor)
+                                tensor=config.mesh.tensor,
+                                zero=config.mesh.zero)
             topology = initialize_topology(spec)
         self.topology = topology
         self.mesh = topology.mesh

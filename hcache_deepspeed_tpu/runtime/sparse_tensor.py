@@ -1,0 +1,59 @@
+"""Sparse (row-wise) gradients for embedding tables.
+
+Reference analog: ``deepspeed/runtime/sparse_tensor.py`` (``SparseTensor``
+wrapping torch sparse grads) + the sparse allreduce path
+(``runtime/engine.py:2683 sparse_allreduce_fallback`` — allgather of
+(indices, values) across data parallel ranks).
+
+TPU re-design: a gradient of an embedding lookup touches only the looked-
+up rows, so it is carried as ``(ids [N], values [N, E])`` — the COO rows.
+The cross-replica reduction is an all-gather of both arrays over the
+``data`` axis (ragged concat, exactly the reference's allgather fallback);
+densification is a single ``segment_sum`` scatter-add. A row-sparse
+optimizer step then touches only ``unique(ids)`` rows instead of the full
+vocab — the win the reference gets from torch's sparse Adam.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.topology import DATA_AXIS
+
+
+class SparseGrad(NamedTuple):
+    """COO row gradient of a [V, E] table: duplicate ids allowed."""
+    ids: jnp.ndarray      # [N] int32 row indices
+    values: jnp.ndarray   # [N, E]
+    num_rows: int         # V (static)
+
+    def to_dense(self):
+        return jax.ops.segment_sum(self.values, self.ids,
+                                   num_segments=self.num_rows)
+
+
+def embedding_sparse_grad(ids, g_out, num_rows):
+    """The sparse gradient of ``table[ids]`` given the output cotangent:
+    rows ``ids.ravel()`` with values ``g_out`` flattened to [N, E]."""
+    E = g_out.shape[-1]
+    return SparseGrad(ids.reshape(-1).astype(jnp.int32),
+                      g_out.reshape(-1, E), num_rows)
+
+
+def sparse_allreduce(sp: SparseGrad, axis=DATA_AXIS) -> SparseGrad:
+    """Cross-replica sum: all-gather ids+values (the reference's
+    allgather fallback, engine.py:2683) and reconcatenate; values are
+    pre-divided so the result is the MEAN gradient, matching the dense
+    reduction convention. Call inside a shard_map manual over ``axis``."""
+    n = jax.lax.axis_size(axis)
+    ids = jax.lax.all_gather(sp.ids, axis, tiled=True)
+    vals = jax.lax.all_gather(sp.values / n, axis, tiled=True)
+    return SparseGrad(ids, vals, sp.num_rows)
+
+
+def apply_row_sparse_update(table, sp: SparseGrad, lr):
+    """SGD-style row-sparse apply: a scatter-add touching only the
+    referenced rows (reference: torch sparse optimizer semantics).
+    Duplicate ids accumulate."""
+    return table.at[sp.ids].add((-lr * sp.values).astype(table.dtype))

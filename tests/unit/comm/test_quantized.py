@@ -225,8 +225,13 @@ class TestOnebitLambAndZeroOneAdam:
         assert 0.01 <= c <= 10.0
 
     def test_zero_one_adam_converges(self, data8):
+        """Local steps desynchronize m/v AND params across devices, so
+        everything per-device is carried axis-stacked ([n, ...] on
+        'data') — a replicated out_spec for varying values is undefined
+        behavior (see onebit.py docstring)."""
+        import functools
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from hcache_deepspeed_tpu.runtime.onebit import zero_one_adam
-        from jax.sharding import PartitionSpec as P
         init, update, sync_interval, is_sync = zero_one_adam(
             lr=0.05, var_freeze_step=20, local_step_scaler=20,
             local_step_clipper=3)
@@ -234,29 +239,69 @@ class TestOnebitLambAndZeroOneAdam:
         assert sync_interval(10 ** 6) == 8  # clipper cap
         assert is_sync(0) and not is_sync(21)
 
-        def spec_fn(state):
-            # local steps desynchronize m across devices -> stacked
-            return state._replace(
-                m=jax.tree.map(lambda _: P("data"), state.m),
-                v=jax.tree.map(lambda _: P("data"), state.v),
-                error=jax.tree.map(lambda _: P("data"), state.error),
-                step=P())
+        rng = np.random.default_rng(0)
+        target = rng.standard_normal((64,)).astype(np.float32)
+        params = {"w": jnp.zeros((8, 64), jnp.float32)}   # stacked
+        state0 = init({"w": jnp.zeros((64,), jnp.float32)})
+        state = state0._replace(
+            m=jax.tree.map(lambda m: jnp.tile(m, (8, 1)), state0.m),
+            v=jax.tree.map(lambda v: jnp.tile(v, (8, 1)), state0.v),
+            error=jax.tree.map(lambda e: jnp.tile(e, (8, 1)),
+                               state0.error))
+        state_specs = state._replace(
+            m=jax.tree.map(lambda _: P("data"), state.m),
+            v=jax.tree.map(lambda _: P("data"), state.v),
+            error=jax.tree.map(lambda _: P("data"), state.error),
+            step=P())
+        noise = 0.05 * rng.standard_normal((8, 1)).astype(np.float32)
+        noise_sharded = jax.device_put(
+            noise, NamedSharding(data8.mesh, P("data")))
 
-        def make_update(g, s, p, flags):
-            sync, update_var = flags
-            s = s._replace(m=jax.tree.map(lambda m: m[0], s.m),
-                           v=jax.tree.map(lambda v: v[0], s.v))
-            u, new = update(g, s, p, sync=sync, update_var=update_var)
-            return u, new._replace(
-                m=jax.tree.map(lambda m: m[None], new.m),
-                v=jax.tree.map(lambda v: v[None], new.v))
+        step_cache = {}
 
-        losses, state = self._harness(
-            data8, init, make_update,
-            [((True, True), 20),     # full sync + var updates
-             ((True, False), 20),    # var frozen, synced momentum
-             ((False, False), 4),    # local steps between syncs
-             ((True, False), 16)],
-            spec_fn)
+        def get_step(flags):
+            if flags not in step_cache:
+                sync, update_var = flags
+
+                @functools.partial(
+                    jax.shard_map, mesh=data8.mesh, axis_names={"data"},
+                    in_specs=(P("data"), state_specs, P("data")),
+                    out_specs=(P("data"), state_specs),
+                    check_vma=False)
+                def train_step(params, state, local_noise):
+                    p = {"w": params["w"][0]}
+                    tgt = jnp.asarray(target) + local_noise[0]
+                    grads = {"w": p["w"] - tgt}
+                    local = state._replace(
+                        m=jax.tree.map(lambda m: m[0], state.m),
+                        v=jax.tree.map(lambda v: v[0], state.v),
+                        error=jax.tree.map(lambda e: e[0], state.error))
+                    u, new = update(grads, local, p, sync=sync,
+                                    update_var=update_var)
+                    new = new._replace(
+                        m=jax.tree.map(lambda m: m[None], new.m),
+                        v=jax.tree.map(lambda v: v[None], new.v),
+                        error=jax.tree.map(lambda e: e[None], new.error))
+                    p = jax.tree.map(lambda a, b: (a + b)[None], p, u)
+                    return p, new
+
+                step_cache[flags] = jax.jit(train_step)
+            return step_cache[flags]
+
+        def loss(p):
+            # mean loss across per-device replicas
+            w = np.asarray(p["w"])
+            return float(np.mean((w - target[None]) ** 2))
+
+        losses = [loss(params)]
+        for flags, n in [((True, True), 20),    # full sync + var update
+                         ((True, False), 20),   # var frozen
+                         ((False, False), 4),   # local steps
+                         ((True, False), 16)]:
+            step_fn = get_step(flags)
+            for _ in range(n):
+                params, state = step_fn(params, state, noise_sharded)
+                jax.block_until_ready(params)
+            losses.append(loss(params))
         assert losses[1] < losses[0]
         assert losses[-1] < losses[1]

@@ -97,7 +97,7 @@ class TestSelectiveDequant:
         q, s, shape, n = reference_quantize_fp6(x, 128)
         full = np.asarray(dequantize_fp6(q, s, shape, n))
         sel = np.asarray(selective_dequantize(q, s, shape, n,
-                                              slice(1, 4), fmt="fp6"))
+                                              slice(1, 4)))
         np.testing.assert_allclose(sel, full[1:4])
 
     def test_misaligned_rows_rejected(self):

@@ -1,0 +1,77 @@
+"""MiCS (reference: runtime/zero/mics.py) — ZeRO-3 within shard groups,
+replication across: the ``zero`` mesh axis carries the shard group; ZeRO
+state shards over it only, so gathers span the group while gradients
+reduce across the full dp world."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (16, 32), dtype=np.int32)}
+
+
+def _train(mesh_cfg, steps=5):
+    model = GPT2LMHeadModel(gpt2_tiny(use_flash=False))
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3, "min_shard_size": 1},
+        "mesh": mesh_cfg,
+    }
+    engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                     example_batch=_batch())
+    batch = _batch()
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(steps)]
+    return engine, losses
+
+
+class TestMiCS:
+    def test_topology_zero_axis(self, eight_devices):
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(
+            topo_mod.TopologySpec(data=2, zero=4))
+        try:
+            assert topo.zero_size == 4 and topo.data_size == 2
+            assert topo.zero_shard_axes() == ("zero",)
+            assert topo.dp_world_size() == 8
+            assert "zero" in topo.batch_shard_axes()
+        finally:
+            topo_mod.reset_topology()
+
+    def test_params_shard_over_group_only(self, eight_devices):
+        topo_mod.reset_topology()
+        try:
+            engine, losses = _train({"data": 2, "zero": 4})
+            assert losses[-1] < losses[0]
+            flat = jax.tree_util.tree_flatten_with_path(
+                engine.state["params"])[0]
+            big = [leaf for path, leaf in flat if leaf.size >= 2 ** 10]
+            assert big, "no large leaves?"
+            for leaf in big:
+                spec = leaf.sharding.spec
+                assert any(e == "zero" or
+                           (isinstance(e, tuple) and "zero" in e)
+                           for e in spec if e is not None), spec
+                assert not any(e == "data" for e in spec
+                               if e is not None), spec
+        finally:
+            topo_mod.reset_topology()
+
+    def test_loss_parity_with_plain_zero3(self, eight_devices):
+        topo_mod.reset_topology()
+        try:
+            _, mics = _train({"data": 2, "zero": 4})
+            topo_mod.reset_topology()
+            _, plain = _train({"data": 8})
+            np.testing.assert_allclose(mics, plain, rtol=1e-4)
+        finally:
+            topo_mod.reset_topology()

@@ -1,0 +1,173 @@
+"""Sparse embedding gradients (reference: runtime/sparse_tensor.py +
+engine.py:2683), Domino comm-hiding TP shape (runtime/domino/), and the
+elastic agent (elasticity/elastic_agent.py:32)."""
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+from hcache_deepspeed_tpu.runtime.domino import DominoTransformer, \
+    domino_split
+from hcache_deepspeed_tpu.runtime.sparse_tensor import (
+    SparseGrad, apply_row_sparse_update, embedding_sparse_grad,
+    sparse_allreduce)
+
+
+class TestSparseGrad:
+    def test_to_dense_matches_autodiff(self):
+        V, E = 32, 8
+        rng = np.random.default_rng(0)
+        table = jnp.asarray(rng.standard_normal((V, E)), jnp.float32)
+        ids = jnp.asarray([3, 7, 3, 1], jnp.int32)
+        g_out = jnp.asarray(rng.standard_normal((4, E)), jnp.float32)
+
+        dense = jax.grad(
+            lambda t: (t[ids] * g_out).sum())(table)
+        sp = embedding_sparse_grad(ids, g_out, V)
+        np.testing.assert_allclose(np.asarray(sp.to_dense()),
+                                   np.asarray(dense), atol=1e-6)
+
+    def test_sparse_allreduce_matches_dense(self, eight_devices):
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(data=8))
+        try:
+            V, E, N = 16, 4, 8
+            rng = np.random.default_rng(1)
+            ids = rng.integers(0, V, (8, N)).astype(np.int32)
+            vals = rng.standard_normal((8, N, E)).astype(np.float32)
+
+            @functools.partial(
+                jax.shard_map, mesh=topo.mesh, axis_names={"data"},
+                in_specs=(P("data"), P("data")), out_specs=P(),
+                check_vma=False)
+            def reduced_dense(ids_l, vals_l):
+                sp = SparseGrad(ids_l[0], vals_l[0], V)
+                return sparse_allreduce(sp).to_dense()
+
+            ids_s = jax.device_put(ids, NamedSharding(topo.mesh,
+                                                      P("data")))
+            vals_s = jax.device_put(vals, NamedSharding(topo.mesh,
+                                                        P("data")))
+            out = np.asarray(jax.jit(reduced_dense)(ids_s, vals_s))
+            # oracle: mean over replicas of each replica's dense grad
+            expect = np.zeros((V, E), np.float32)
+            for r in range(8):
+                for i, v in zip(ids[r], vals[r]):
+                    expect[i] += v / 8
+            np.testing.assert_allclose(out, expect, atol=1e-5)
+        finally:
+            topo_mod.reset_topology()
+
+    def test_row_sparse_update_touches_only_rows(self):
+        V, E = 10, 4
+        table = jnp.ones((V, E), jnp.float32)
+        sp = SparseGrad(jnp.asarray([2, 2, 5], jnp.int32),
+                        jnp.ones((3, E), jnp.float32), V)
+        new = apply_row_sparse_update(table, sp, lr=0.1)
+        np.testing.assert_allclose(np.asarray(new[2]), 1 - 0.2)
+        np.testing.assert_allclose(np.asarray(new[5]), 1 - 0.1)
+        untouched = np.asarray([i for i in range(V) if i not in (2, 5)])
+        np.testing.assert_allclose(np.asarray(new)[untouched], 1.0)
+
+
+class TestDomino:
+    def test_split_matches_unsplit(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+
+        def layer(x):
+            return jax.nn.gelu(x @ w)
+
+        x = jnp.asarray(rng.standard_normal((6, 4, 8)), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(domino_split(layer, x)),
+            np.asarray(layer(x)), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(DominoTransformer(layer)(x)),
+            np.asarray(layer(x)), atol=1e-6)
+
+    def test_odd_and_single_batches(self):
+        def layer(x):
+            return x * 2.0
+
+        for B in (1, 3, 5):
+            x = jnp.ones((B, 2, 4))
+            np.testing.assert_allclose(
+                np.asarray(domino_split(layer, x)), 2.0)
+
+
+class TestElasticAgent:
+    def test_clean_exit(self):
+        from hcache_deepspeed_tpu.elasticity.elastic_agent import \
+            ElasticAgent
+        agent = ElasticAgent(
+            lambda n, r, i: [sys.executable, "-c", "pass"],
+            world_size=3, poll_interval=0.05)
+        assert agent.run() == 3
+
+    def test_shrink_after_single_worker_loss(self):
+        from hcache_deepspeed_tpu.elasticity.elastic_agent import \
+            ElasticAgent
+
+        def cmd(n, restart, idx):
+            if restart == 0 and idx == n - 1:   # one worker "lost"
+                return [sys.executable, "-c", "import sys; sys.exit(1)"]
+            if restart == 0:                    # survivors keep running
+                return [sys.executable, "-c",
+                        "import time; time.sleep(30)"]
+            return [sys.executable, "-c", "pass"]
+
+        agent = ElasticAgent(cmd, world_size=4, poll_interval=0.05,
+                             max_restarts=2)
+        final = agent.run()
+        assert agent.restart_count == 1
+        assert final == 3
+
+    def test_group_crash_retries_same_size(self):
+        from hcache_deepspeed_tpu.elasticity.elastic_agent import \
+            ElasticAgent
+
+        def cmd(n, restart, idx):
+            if restart == 0:
+                return [sys.executable, "-c", "import sys; sys.exit(1)"]
+            return [sys.executable, "-c", "pass"]
+
+        agent = ElasticAgent(cmd, world_size=4, poll_interval=0.05,
+                             max_restarts=2)
+        assert agent.run() == 4
+        assert agent.restart_count == 1
+
+    def test_elastic_config_resize(self):
+        from hcache_deepspeed_tpu.elasticity.elastic_agent import \
+            ElasticAgent
+
+        def cmd(n, restart, idx):
+            if restart == 0 and idx >= n - 3:   # lose 3 of 8
+                return [sys.executable, "-c", "import sys; sys.exit(1)"]
+            if restart == 0:
+                return [sys.executable, "-c",
+                        "import time; time.sleep(30)"]
+            return [sys.executable, "-c", "pass"]
+
+        agent = ElasticAgent(
+            cmd, world_size=8, poll_interval=0.05, max_restarts=2,
+            elastic_config={"enabled": True, "max_train_batch_size": 64,
+                            "micro_batch_sizes": [2, 4]})
+        final = agent.run()
+        # 5 survivors -> largest batch-compatible count <= 5
+        assert final <= 5 and agent.restart_count == 1
+
+    def test_max_restarts_exceeded(self):
+        from hcache_deepspeed_tpu.elasticity.elastic_agent import (
+            ElasticAgent, ElasticAgentError)
+        agent = ElasticAgent(
+            lambda n, r, i: [sys.executable, "-c",
+                             "import sys; sys.exit(1)"],
+            world_size=2, poll_interval=0.05, max_restarts=1)
+        with pytest.raises(ElasticAgentError, match="max_restarts"):
+            agent.run()
