@@ -1,0 +1,15 @@
+"""Compression subsystem (reference: deepspeed/compression/ +
+runtime/{quantize,progressive_layer_drop,eigenvalue}.py): MoQ
+quantize-aware training, progressive layer drop, Hessian eigenvalues."""
+
+from .eigenvalue import (hessian_eigenvalue, layer_eigenvalues,
+                         moq_bit_assignment)
+from .progressive_layer_drop import ProgressiveLayerDrop, pld_layer
+from .quantize import (QuantizeScheduler, fake_quantize,
+                       fake_quantize_traced, quantize_param_tree,
+                       quantize_param_tree_traced)
+
+__all__ = ["fake_quantize", "fake_quantize_traced", "QuantizeScheduler",
+           "quantize_param_tree", "quantize_param_tree_traced",
+           "ProgressiveLayerDrop", "pld_layer", "hessian_eigenvalue",
+           "layer_eigenvalues", "moq_bit_assignment"]

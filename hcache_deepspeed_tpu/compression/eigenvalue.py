@@ -1,0 +1,85 @@
+"""Hessian eigenvalue estimation (power iteration).
+
+Reference analog: ``deepspeed/runtime/eigenvalue.py`` — per-layer
+largest-eigenvalue estimates of the loss Hessian via power iteration on
+Hessian-vector products; MoQ uses the estimates to decide which layers
+tolerate aggressive quantization.
+
+TPU re-design: the HVP is ``jvp(grad(loss))`` — one extra forward-
+backward per iteration, fully jitted; no autograd-graph retention tricks
+needed. Estimates are per parameter subtree (the "layer" granularity the
+reference uses module names for).
+"""
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _normalize(tree):
+    norm = jnp.sqrt(sum(jnp.vdot(x, x).real
+                        for x in jax.tree.leaves(tree)))
+    norm = jnp.maximum(norm, 1e-12)
+    return jax.tree.map(lambda x: x / norm, tree), norm
+
+
+def hessian_eigenvalue(loss_fn: Callable, params, max_iter: int = 20,
+                       tol: float = 1e-2, seed: int = 0):
+    """Largest eigenvalue of the Hessian of ``loss_fn(params)`` by power
+    iteration on HVPs (reference: eigenvalue.py compute_eigenvalue).
+    Returns (eigenvalue, iterations_used)."""
+    grad_fn = jax.grad(loss_fn)
+
+    @jax.jit
+    def hvp(v):
+        return jax.jvp(grad_fn, (params,), (v,))[1]
+
+    key = jax.random.PRNGKey(seed)
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    v = jax.tree.unflatten(treedef, [
+        jax.random.normal(k, p.shape, jnp.float32)
+        for k, p in zip(keys, leaves)])
+    v, _ = _normalize(v)
+
+    prev = 0.0
+    for i in range(max_iter):
+        hv = hvp(v)
+        v, norm = _normalize(hv)
+        eig = float(norm)
+        if prev and abs(eig - prev) / max(abs(prev), 1e-12) < tol:
+            return eig, i + 1
+        prev = eig
+    return prev, max_iter
+
+
+def layer_eigenvalues(loss_fn: Callable, params: Dict, max_iter: int = 20,
+                      tol: float = 1e-2, seed: int = 0) -> Dict[str, float]:
+    """Per-top-level-subtree eigenvalue estimates: the Hessian block of
+    each subtree with the rest of the parameters frozen (the reference's
+    per-layer loop, eigenvalue.py:' for block in self.layer_num')."""
+    out = {}
+    for name in params:
+        def sub_loss(sub, name=name):
+            merged = dict(params)
+            merged[name] = sub
+            return loss_fn(merged)
+
+        eig, _ = hessian_eigenvalue(sub_loss, params[name],
+                                    max_iter=max_iter, tol=tol, seed=seed)
+        out[name] = eig
+    return out
+
+
+def moq_bit_assignment(eigenvalues: Dict[str, float], low_bits: int = 4,
+                       high_bits: int = 8) -> Dict[str, int]:
+    """MoQ layer policy: high-curvature (sensitive) layers keep more
+    bits (reference: MoQ eigenvalue-driven schedule)."""
+    if not eigenvalues:
+        return {}
+    vals = np.asarray(list(eigenvalues.values()))
+    median = float(np.median(vals))
+    return {k: (high_bits if v >= median else low_bits)
+            for k, v in eigenvalues.items()}

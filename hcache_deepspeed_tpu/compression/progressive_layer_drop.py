@@ -1,0 +1,52 @@
+"""Progressive layer dropping (PLD).
+
+Reference analog: ``deepspeed/runtime/progressive_layer_drop.py`` —
+``theta(t) = (1 - theta) * exp(-gamma * t) + theta`` keep probability,
+decreasing over training; layers are stochastically bypassed with the
+residual identity, scaled at the layer level.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    """Keep-probability schedule (reference: same formula + state)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping "
+                 f"(theta = {self.theta})", ranks=[0])
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def layer_keep_prob(self, layer_idx: int, n_layers: int) -> float:
+        """Deeper layers drop more (the PLD paper's i/L ramp)."""
+        frac = (layer_idx + 1) / max(n_layers, 1)
+        return 1.0 - frac * (1.0 - self.current_theta)
+
+
+def pld_layer(layer_fn, x, keep_prob, rng, *args, **kwargs):
+    """Stochastically bypass ``layer_fn`` (must be residual-style:
+    x -> x + f(x)): with probability 1-keep_prob the layer contributes
+    nothing; when kept, its residual delta is scaled by 1/keep_prob so
+    the expectation matches the full network (inverted-dropout
+    convention)."""
+    if keep_prob >= 1.0:
+        return layer_fn(x, *args, **kwargs)
+    keep = jax.random.bernoulli(rng, keep_prob)
+    out = layer_fn(x, *args, **kwargs)
+    delta = (out - x) / keep_prob
+    return jnp.where(keep, x + delta, x)

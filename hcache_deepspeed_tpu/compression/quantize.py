@@ -1,0 +1,123 @@
+"""MoQ — quantize-aware training (Mixture of Quantization).
+
+Reference analogs: ``deepspeed/compression/`` (weight quantization
+config groups) and ``deepspeed/runtime/quantize.py`` (the MoQ
+``Quantizer``: symmetric/asymmetric fake quantization with a bit
+schedule that tightens from ``start_bits`` to ``target_bits`` over
+training, optionally driven by the eigenvalue estimate).
+
+TPU re-design: fake quantization is a pure function with a
+straight-through estimator VJP (``round`` passes gradients through
+unchanged), applied to the parameter pytree before the forward — one
+fused XLA pass, no module surgery. The bit width is a trace-time
+constant per schedule stage, so each bit level compiles once.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_bwd(_, g):
+    return (g,)  # straight-through
+
+
+_ste_round.defvjp(_ste_fwd, _ste_bwd)
+
+
+def fake_quantize(x, bits: int, symmetric: bool = True, groups: int = 1):
+    """Quantize-dequantize ``x`` to ``bits`` with a straight-through
+    gradient (reference: runtime/quantize.py Quantizer.compute_quantization).
+    ``groups`` splits the flattened tensor into equal scale groups."""
+    if bits >= 32:
+        return x
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(groups, -1)
+    qmax = 2.0 ** (bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(_ste_round(flat / scale), -qmax - 1, qmax)
+        out = q * scale
+    else:
+        lo = jnp.min(flat, axis=-1, keepdims=True)
+        hi = jnp.max(flat, axis=-1, keepdims=True)
+        span = jnp.where(hi - lo == 0, 1.0, hi - lo)
+        scale = span / (2.0 ** bits - 1)
+        q = _ste_round((flat - lo) / scale)
+        out = q * scale + lo
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+class QuantizeScheduler:
+    """Bit schedule: start_bits → target_bits, halving the distance every
+    ``quantize_period`` steps (the reference's MoQ period doubling —
+    runtime/quantize.py:update_fp16_ratio semantics simplified to the
+    bit staircase it produces)."""
+
+    def __init__(self, start_bits: int = 16, target_bits: int = 8,
+                 quantize_period: int = 100, schedule_offset: int = 0):
+        self.start_bits = start_bits
+        self.target_bits = target_bits
+        self.quantize_period = quantize_period
+        self.schedule_offset = schedule_offset
+
+    def bits_at(self, step: int) -> int:
+        if step < self.schedule_offset:
+            return 32  # quantization not engaged yet
+        k = (step - self.schedule_offset) // self.quantize_period
+        bits = self.start_bits
+        for _ in range(k):
+            if bits <= self.target_bits:
+                break
+            bits = max(bits - max((bits - self.target_bits + 1) // 2, 1),
+                       self.target_bits)
+        return bits
+
+
+def quantize_param_tree(params, bits: int, groups: int = 1,
+                        min_size: int = 2 ** 12):
+    """Fake-quantize every floating leaf with ≥ ``min_size`` elements
+    (small leaves — norms, biases — stay full precision, matching the
+    reference's modules-to-quantize selection)."""
+    def leaf(p):
+        if not jnp.issubdtype(p.dtype, jnp.floating) or p.size < min_size:
+            return p
+        return fake_quantize(p, bits, groups=groups)
+
+    return jax.tree.map(leaf, params)
+
+
+def fake_quantize_traced(x, bits, groups: int = 1):
+    """``fake_quantize`` with a TRACED bit width (device scalar), so the
+    engine's compiled step serves every schedule stage without
+    retracing; ``bits >= 32`` passes through unchanged."""
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(groups, -1)
+    bits_f = bits.astype(jnp.float32)
+    qmax = 2.0 ** (bits_f - 1.0) - 1.0
+    scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(_ste_round(flat / scale), -qmax - 1.0, qmax)
+    out = (q * scale).reshape(orig_shape).astype(orig_dtype)
+    return jnp.where(bits_f >= 32.0, x, out)
+
+
+def quantize_param_tree_traced(params, bits, groups: int = 1,
+                               min_size: int = 2 ** 12):
+    def leaf(p):
+        if not jnp.issubdtype(p.dtype, jnp.floating) or p.size < min_size:
+            return p
+        return fake_quantize_traced(p, bits, groups=groups)
+
+    return jax.tree.map(leaf, params)

@@ -1,0 +1,164 @@
+"""Block-sparse attention.
+
+Reference analog: ``deepspeed/ops/sparse_attention/`` (Triton-era
+block-sparse kernels + ``SparseSelfAttention`` with fixed / bigbird /
+variable sparsity configs) and ``csrc/sparse_attention/utils.cpp``.
+
+TPU re-design: the layout is STATIC (a numpy bool [nq, nk] block mask),
+so each query block's active key blocks are known at trace time. The
+kernel form is the flash/online-softmax scan used everywhere else in
+this repo, but the inner scan runs over a *padded per-row active-block
+index list* instead of all key blocks — compute (and with Pallas-style
+revisiting, bandwidth) scales with the number of active blocks, not
+T²/block². Differentiable (plain jnp + scan: autodiff gives the
+backward); the dense-equivalent masked softmax is the parity oracle.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .flash_attention import _NEG_INF
+
+
+# ------------------------------------------------------------------ #
+# Layout builders (reference: sparse_attention/sparsity_config.py)
+# ------------------------------------------------------------------ #
+def make_local_layout(n_blocks: int, window: int = 1,
+                      causal: bool = True) -> np.ndarray:
+    """Sliding-window: block i attends to blocks [i-window, i]."""
+    lay = np.zeros((n_blocks, n_blocks), bool)
+    for i in range(n_blocks):
+        lo = max(0, i - window)
+        hi = i + 1 if causal else min(n_blocks, i + window + 1)
+        lay[i, lo:hi] = True
+    return lay
+
+
+def make_fixed_layout(n_blocks: int, local_window: int = 1,
+                      global_stride: int = 4,
+                      causal: bool = True) -> np.ndarray:
+    """The reference's 'fixed' pattern: local window + periodic global
+    columns every ``global_stride`` blocks."""
+    lay = make_local_layout(n_blocks, local_window, causal)
+    for j in range(0, n_blocks, global_stride):
+        if causal:
+            lay[j:, j] = True
+        else:
+            lay[:, j] = True
+    return lay
+
+
+def make_bigbird_layout(n_blocks: int, local_window: int = 1,
+                        num_global: int = 1, num_random: int = 1,
+                        causal: bool = True, seed: int = 0) -> np.ndarray:
+    """BigBird: local + leading global blocks + random blocks."""
+    lay = make_local_layout(n_blocks, local_window, causal)
+    lay[:, :num_global] = True
+    rng = np.random.default_rng(seed)
+    for i in range(n_blocks):
+        hi = i + 1 if causal else n_blocks
+        if hi > 0:
+            picks = rng.integers(0, hi, size=num_random)
+            lay[i, picks] = True
+    if causal:
+        lay &= np.tril(np.ones((n_blocks, n_blocks), bool))
+    return lay
+
+
+# ------------------------------------------------------------------ #
+# Attention
+# ------------------------------------------------------------------ #
+def sparse_attention(q, k, v, layout: np.ndarray, block_size: int,
+                     causal: bool = True, scale: Optional[float] = None):
+    """q/k/v: [B, T, H, D]; layout: bool [T/bs, T/bs] static block mask.
+
+    Online-softmax over each query block's ACTIVE key blocks only.
+    Rows/blocks with no active keys produce zeros.
+    """
+    layout = np.asarray(layout, bool)
+    B, T, H, D = q.shape
+    bs = block_size
+    if T % bs:
+        raise ValueError(f"T={T} not divisible by block_size={bs}")
+    nq = T // bs
+    if layout.shape != (nq, nq):
+        raise ValueError(f"layout {layout.shape} != ({nq}, {nq})")
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    if causal:
+        layout = layout & np.tril(np.ones((nq, nq), bool))
+
+    # padded per-row active block lists (static)
+    max_active = max(int(layout.sum(1).max()), 1)
+    idx = np.zeros((nq, max_active), np.int32)
+    valid = np.zeros((nq, max_active), bool)
+    for i in range(nq):
+        act = np.nonzero(layout[i])[0]
+        idx[i, :len(act)] = act
+        valid[i, :len(act)] = True
+    idx_j = jnp.asarray(idx)
+    valid_j = jnp.asarray(valid)
+
+    qs = q.reshape(B, nq, bs, H, D)
+    ks = k.reshape(B, nq, bs, H, D)
+    vs = v.reshape(B, nq, bs, H, D)
+
+    def one_q_block(qi):
+        q_blk = qs[:, qi].astype(jnp.float32)           # [B, bs, H, D]
+
+        def kv_step(carry, a):
+            out, m, l = carry
+            ki = idx_j[qi, a]
+            ok = valid_j[qi, a]
+            k_blk = ks[:, ki].astype(jnp.float32)
+            v_blk = vs[:, ki].astype(jnp.float32)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk) * scale
+            rows = qi * bs + jnp.arange(bs)
+            cols = ki * bs + jnp.arange(bs)
+            mask = ok & (rows[:, None] >= cols[None, :] if causal
+                         else jnp.ones((bs, bs), bool))
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            corr = jnp.exp(m - m_new)
+            l_new = corr * l + jnp.sum(p, axis=-1)
+            out_new = out * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v_blk)
+            return (out_new, m_new, l_new), None
+
+        out0 = jnp.zeros((B, H, bs, D), jnp.float32)
+        m0 = jnp.full((B, H, bs), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, bs), jnp.float32)
+        (out, m, l), _ = jax.lax.scan(kv_step, (out0, m0, l0),
+                                      jnp.arange(max_active))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (out / l[..., None]).transpose(0, 2, 1, 3)  # [B, bs, H, D]
+
+    outs = [one_q_block(i) for i in range(nq)]
+    return jnp.concatenate(outs, axis=1).astype(q.dtype)
+
+
+def reference_masked_attention(q, k, v, layout, block_size, causal=True,
+                               scale=None):
+    """Dense oracle: full attention with the block mask expanded."""
+    B, T, H, D = q.shape
+    bs = block_size
+    nq = T // bs
+    layout = np.asarray(layout, bool)
+    if causal:
+        layout = layout & np.tril(np.ones((nq, nq), bool))
+    dense = np.kron(layout, np.ones((bs, bs), bool))
+    if causal:
+        dense &= np.tril(np.ones((T, T), bool))
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(jnp.asarray(dense)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / l, v.astype(jnp.float32))
+    return out.astype(q.dtype)

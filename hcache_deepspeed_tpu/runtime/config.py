@@ -204,6 +204,30 @@ class CheckpointConfig(HDSConfigModel):
     async_save: bool = False
 
 
+class WeightQuantizationConfig(HDSConfigModel):
+    """MoQ quantize-aware training (reference: deepspeed/compression/
+    weight_quantization shared_parameters + runtime/quantize.py)."""
+    enabled: bool = False
+    start_bits: int = 16
+    target_bits: int = 8
+    quantize_period: int = 100
+    schedule_offset: int = 0
+    quantize_groups: int = 1
+
+
+class PLDConfig(HDSConfigModel):
+    """Progressive layer drop (reference: progressive_layer_drop.py)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+class CompressionConfig(HDSConfigModel):
+    weight_quantization: WeightQuantizationConfig = Field(
+        default_factory=WeightQuantizationConfig)
+    progressive_layer_drop: PLDConfig = Field(default_factory=PLDConfig)
+
+
 class CurriculumLearningConfig(HDSConfigModel):
     """Reference: runtime/data_pipeline/curriculum_scheduler.py + the
     legacy ``curriculum_learning`` engine block. ``seqlen`` curricula are
@@ -266,6 +290,8 @@ class HDSConfig(HDSConfigModel):
         default_factory=ActivationCheckpointingConfig)
     curriculum_learning: CurriculumLearningConfig = Field(
         default_factory=CurriculumLearningConfig)
+    compression_training: CompressionConfig = Field(
+        default_factory=CompressionConfig)
 
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

@@ -212,10 +212,22 @@ class HDSEngine:
                         or zcfg.zero_quantized_gradients
                         or zcfg.zero_hpz_partition_size > 1)
         if self._zeropp:
+            from .config import HDSConfigError
             from .zero.zeropp import validate_zeropp
+            if topology.zero_size > 1:
+                # the manual ZeRO++ step is wired to the data axis; with
+                # a MiCS shard group ZeRO state lives on the zero axis
+                raise HDSConfigError(
+                    "ZeRO++ (qwZ/qgZ/hpZ) is not supported together "
+                    "with a MiCS shard group (mesh.zero > 1)")
             validate_zeropp(zcfg, zcfg.stage, topology.data_size)
             if topology.data_size == 1:
                 self._zeropp = False  # single data shard: nothing to wire
+            if self._zeropp and \
+                    config.compression_training.weight_quantization.enabled:
+                raise HDSConfigError(
+                    "MoQ weight quantization is not supported on the "
+                    "manual ZeRO++ step; disable one of the two")
 
         # ---- optimizer-state host offload (ZeRO-Offload / -Infinity) ----
         self.offload_device = zcfg.offload_optimizer.device
@@ -228,6 +240,23 @@ class HDSEngine:
         # ---- parameter init (sharded at creation; reference: zero.Init) ----
         self._rng_seed = config.seed
         self._init_state(init_params, example_batch)
+
+        # ---- compression training (reference: compression/ + MoQ) ----
+        self._moq = None
+        self.progressive_layer_drop = None
+        comp = config.compression_training
+        if comp.weight_quantization.enabled:
+            from ..compression import QuantizeScheduler
+            wq = comp.weight_quantization
+            self._moq = QuantizeScheduler(
+                start_bits=wq.start_bits, target_bits=wq.target_bits,
+                quantize_period=wq.quantize_period,
+                schedule_offset=wq.schedule_offset)
+        if comp.progressive_layer_drop.enabled:
+            from ..compression import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=comp.progressive_layer_drop.theta,
+                gamma=comp.progressive_layer_drop.gamma)
 
         # ---- curriculum learning (reference: data_pipeline) ----
         self.curriculum_scheduler = None
@@ -440,8 +469,16 @@ class HDSEngine:
         param_shardings = self.param_shardings
         remat_policy = self._resolve_remat_policy()
 
-        def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train):
+        moq_groups = self.config.compression_training \
+            .weight_quantization.quantize_groups
+
+        def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train,
+                          moq_bits=None):
             def raw_loss(p):
+                if self._moq is not None and moq_bits is not None:
+                    from ..compression import quantize_param_tree_traced
+                    p = quantize_param_tree_traced(p, moq_bits,
+                                                   groups=moq_groups)
                 loss, _aux = self.adapter.loss(p, batch, rng, train=train)
                 return loss
 
@@ -575,7 +612,7 @@ class HDSEngine:
             out_shardings=grad_shardings)
 
         # fully fused train_batch: scan microbatches then apply
-        def fused_train_batch(state, batches, lr, rng):
+        def fused_train_batch(state, batches, lr, rng, moq_bits=None):
             # hpZ: refresh the secondary partition once, reuse across the
             # whole gradient-accumulation scan
             secondary = prepare_secondary(state["params"]) \
@@ -588,6 +625,10 @@ class HDSEngine:
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
                         batch, key, True, secondary)
+                elif moq_bits is not None:
+                    loss, grad_acc = micro_fwd_bwd(
+                        state["params"], grad_acc, state["loss_scale"],
+                        batch, key, True, moq_bits=moq_bits)
                 else:
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
@@ -659,9 +700,14 @@ class HDSEngine:
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._shard_batch(batch)
+        moq_kw = {}
+        if self._moq is not None:
+            moq_kw["moq_bits"] = jnp.asarray(
+                self._moq.bits_at(self.global_steps), jnp.int32)
         loss, new_acc = self._micro_fwd_bwd(
             self.state["params"], self.state["grad_acc"],
-            self.state["loss_scale"], batch, self._next_rng(), True)
+            self.state["loss_scale"], batch, self._next_rng(), True,
+            **moq_kw)
         self.state["grad_acc"] = new_acc
         self._pending = loss
         if self.wall_clock_breakdown:
@@ -747,6 +793,8 @@ class HDSEngine:
 
     def _after_step(self, finite):
         self.global_steps += 1
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         skipped = self.fp16_enabled and not bool(finite)
         if skipped:
             self.skipped_steps += 1
@@ -830,8 +878,12 @@ class HDSEngine:
                     (gas, -1) + np.asarray(x).shape[1:]), batch)
         batch = self._shard_batch(batch, extra_leading=True)
         lr = jnp.asarray(self._current_lr, jnp.float32)
+        moq_bits = None
+        if self._moq is not None:
+            moq_bits = jnp.asarray(
+                self._moq.bits_at(self.global_steps), jnp.int32)
         self.state, loss, finite, grad_norm = self._fused_train_batch(
-            self.state, batch, lr, self._next_rng())
+            self.state, batch, lr, self._next_rng(), moq_bits)
         self._last_grad_norm = grad_norm
         self.micro_steps += gas
         self._after_step(finite)

@@ -1,0 +1,178 @@
+"""Compression subsystem (reference: deepspeed/compression/ +
+runtime/{quantize,progressive_layer_drop,eigenvalue}.py) and block-sparse
+attention (ops/sparse_attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.compression import (
+    ProgressiveLayerDrop, QuantizeScheduler, fake_quantize,
+    fake_quantize_traced, hessian_eigenvalue, layer_eigenvalues,
+    moq_bit_assignment, pld_layer)
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+
+
+class TestFakeQuantize:
+    def test_error_shrinks_with_bits(self):
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (64, 64)), jnp.float32)
+        errs = [float(jnp.mean(jnp.abs(fake_quantize(x, b) - x)))
+                for b in (4, 8, 16)]
+        assert errs[0] > errs[1] > errs[2]
+
+    def test_straight_through_gradient(self):
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (4096,)), jnp.float32)
+        g = jax.grad(lambda x: (fake_quantize(x, 8) ** 2).sum())(x)
+        # STE bypasses round only; the scale (group max) keeps its true
+        # gradient, so compare away from the extremes
+        assert np.all(np.isfinite(np.asarray(g)))
+        mask = np.abs(np.asarray(x)) < 0.9 * np.abs(np.asarray(x)).max()
+        np.testing.assert_allclose(
+            np.asarray(g)[mask],
+            np.asarray(2 * fake_quantize(x, 8))[mask],
+            rtol=1e-5, atol=1e-4)
+
+    def test_traced_bits_matches_static(self):
+        x = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (32, 32)), jnp.float32)
+        a = fake_quantize(x, 8)
+        b = fake_quantize_traced(x, jnp.asarray(8, jnp.int32))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6)
+        passthru = fake_quantize_traced(x, jnp.asarray(32, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(passthru), np.asarray(x))
+
+    def test_scheduler_staircase(self):
+        s = QuantizeScheduler(start_bits=16, target_bits=8,
+                              quantize_period=10, schedule_offset=5)
+        assert s.bits_at(0) == 32
+        assert s.bits_at(5) == 16
+        bits = [s.bits_at(t) for t in range(5, 60)]
+        assert bits[-1] == 8
+        assert all(a >= b for a, b in zip(bits, bits[1:]))
+
+    def test_engine_moq_trains(self, eight_devices):
+        model = GPT2LMHeadModel(gpt2_tiny(use_flash=False))
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "compression_training": {
+                "weight_quantization": {
+                    "enabled": True, "start_bits": 16, "target_bits": 8,
+                    "quantize_period": 2, "schedule_offset": 1}},
+        }
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 256, (8, 32),
+                                           dtype=np.int32)}
+        engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                         example_batch=batch)
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(12)]
+        assert losses[-1] < losses[0]
+        assert engine._moq.bits_at(engine.global_steps) == 8
+
+
+class TestPLD:
+    def test_theta_schedule(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        t = [pld.update_state(s) for s in (0, 100, 10000)]
+        assert t[0] == pytest.approx(1.0)
+        assert t[0] > t[1] > t[2]
+        assert t[2] == pytest.approx(0.5, abs=1e-3)
+
+    def test_layer_keep_prob_ramps_with_depth(self):
+        pld = ProgressiveLayerDrop(theta=0.5)
+        pld.update_state(10 ** 6)
+        ps = [pld.layer_keep_prob(i, 4) for i in range(4)]
+        assert all(a > b for a, b in zip(ps, ps[1:]))
+
+    def test_pld_layer_expectation(self):
+        x = jnp.ones((2, 4))
+        fn = lambda h: h + 1.0  # noqa: E731
+        outs = [pld_layer(fn, x, 0.5, jax.random.PRNGKey(s))
+                for s in range(200)]
+        mean = np.mean([np.asarray(o) for o in outs], axis=0)
+        # E[out] = x + keep_prob * delta/keep_prob = x + 1
+        np.testing.assert_allclose(mean, 2.0, atol=0.15)
+        assert pld_layer(fn, x, 1.0, jax.random.PRNGKey(0)).sum() == \
+            float((x + 1).sum())
+
+
+class TestEigenvalue:
+    def test_quadratic_exact(self):
+        # f(x) = 0.5 x^T A x with known top eigenvalue
+        evals = np.asarray([1.0, 3.0, 7.0], np.float32)
+        A = jnp.diag(jnp.asarray(evals))
+        x = jnp.ones((3,), jnp.float32)
+        eig, iters = hessian_eigenvalue(
+            lambda p: 0.5 * p @ A @ p, x, max_iter=100, tol=1e-4)
+        assert eig == pytest.approx(7.0, rel=1e-2)
+
+    def test_layerwise_and_moq_policy(self):
+        params = {"a": jnp.ones((4,)), "b": jnp.ones((4,))}
+
+        def loss(p):
+            return (10.0 * (p["a"] ** 2).sum() +
+                    0.1 * (p["b"] ** 2).sum())
+
+        eigs = layer_eigenvalues(loss, params, max_iter=50)
+        assert eigs["a"] > eigs["b"]
+        bits = moq_bit_assignment(eigs, low_bits=4, high_bits=8)
+        assert bits["a"] == 8 and bits["b"] == 4
+
+
+class TestSparseAttention:
+    def _qkv(self, B=2, T=128, H=2, D=16, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.standard_normal((B, T, H, D)), jnp.float32)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("builder,kw", [
+        ("make_local_layout", {"window": 1}),
+        ("make_fixed_layout", {"local_window": 1, "global_stride": 3}),
+        ("make_bigbird_layout", {"local_window": 1, "num_global": 1,
+                                 "num_random": 1}),
+    ])
+    def test_matches_dense_oracle(self, builder, kw):
+        from hcache_deepspeed_tpu.ops import sparse_attention as sa
+        q, k, v = self._qkv()
+        bs = 16
+        layout = getattr(sa, builder)(128 // bs, **kw)
+        out = sa.sparse_attention(q, k, v, layout, bs)
+        ref = sa.reference_masked_attention(q, k, v, layout, bs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_differentiable(self):
+        from hcache_deepspeed_tpu.ops import sparse_attention as sa
+        q, k, v = self._qkv(T=64, seed=3)
+        layout = sa.make_local_layout(4, window=1)
+
+        def loss(q, k, v):
+            return sa.sparse_attention(q, k, v, layout, 16).sum()
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        gref = jax.jit(jax.grad(
+            lambda q, k, v: sa.reference_masked_attention(
+                q, k, v, layout, 16).sum(), argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g, gref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_full_layout_equals_flash_reference(self):
+        from hcache_deepspeed_tpu.ops import sparse_attention as sa
+        from hcache_deepspeed_tpu.ops.flash_attention import \
+            reference_attention
+        q, k, v = self._qkv(T=64, seed=4)
+        layout = np.ones((4, 4), bool)
+        out = sa.sparse_attention(q, k, v, layout, 16, causal=True)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
