@@ -43,8 +43,8 @@ def pld_layer(layer_fn, x, keep_prob, rng, *args, **kwargs):
     x -> x + f(x)): with probability 1-keep_prob the layer contributes
     nothing; when kept, its residual delta is scaled by 1/keep_prob so
     the expectation matches the full network (inverted-dropout
-    convention)."""
-    if keep_prob >= 1.0:
+    convention). ``keep_prob`` may be a traced scalar."""
+    if isinstance(keep_prob, (int, float)) and keep_prob >= 1.0:
         return layer_fn(x, *args, **kwargs)
     keep = jax.random.bernoulli(rng, keep_prob)
     out = layer_fn(x, *args, **kwargs)

@@ -35,20 +35,33 @@ def _ste_bwd(_, g):
 _ste_round.defvjp(_ste_fwd, _ste_bwd)
 
 
+def _leaf_groups(x, groups: int) -> int:
+    """Per-leaf group count: fall back to one scale group when the leaf
+    size is not divisible (a global quantize_groups must not crash odd-
+    sized parameters)."""
+    return groups if groups > 0 and x.size % groups == 0 else 1
+
+
+def _symmetric_quantize(flat, qmax):
+    """Shared symmetric core (static and traced paths)."""
+    scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(_ste_round(flat / scale), -qmax - 1.0, qmax)
+    return q * scale
+
+
 def fake_quantize(x, bits: int, symmetric: bool = True, groups: int = 1):
     """Quantize-dequantize ``x`` to ``bits`` with a straight-through
     gradient (reference: runtime/quantize.py Quantizer.compute_quantization).
     ``groups`` splits the flattened tensor into equal scale groups."""
     if bits >= 32:
         return x
+    groups = _leaf_groups(x, groups)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(groups, -1)
     qmax = 2.0 ** (bits - 1) - 1
     if symmetric:
-        scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True) / qmax
-        scale = jnp.where(scale == 0, 1.0, scale)
-        q = jnp.clip(_ste_round(flat / scale), -qmax - 1, qmax)
-        out = q * scale
+        out = _symmetric_quantize(flat, qmax)
     else:
         lo = jnp.min(flat, axis=-1, keepdims=True)
         hi = jnp.max(flat, axis=-1, keepdims=True)
@@ -102,14 +115,13 @@ def fake_quantize_traced(x, bits, groups: int = 1):
     """``fake_quantize`` with a TRACED bit width (device scalar), so the
     engine's compiled step serves every schedule stage without
     retracing; ``bits >= 32`` passes through unchanged."""
+    groups = _leaf_groups(x, groups)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(groups, -1)
     bits_f = bits.astype(jnp.float32)
     qmax = 2.0 ** (bits_f - 1.0) - 1.0
-    scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True) / qmax
-    scale = jnp.where(scale == 0, 1.0, scale)
-    q = jnp.clip(_ste_round(flat / scale), -qmax - 1.0, qmax)
-    out = (q * scale).reshape(orig_shape).astype(orig_dtype)
+    out = _symmetric_quantize(flat, qmax).reshape(orig_shape).astype(
+        orig_dtype)
     return jnp.where(bits_f >= 32.0, x, out)
 
 

@@ -65,7 +65,12 @@ class InferenceEngineV2:
         # block 0 is reserved scratch: padded decode lanes write there
         self._scratch_block = self.state.allocator.allocate(1)[0]
 
-        self.model = PagedInferenceModel(
+        from ..models.gpt2 import GPT2Config
+        model_cls = PagedInferenceModel
+        if isinstance(model_config, GPT2Config):
+            from .model_gpt2 import PagedGPT2Model
+            model_cls = PagedGPT2Model
+        self.model = model_cls(
             model_config, params, block_size=self.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,
             capture_latents=self.config.hcache.enable_latents,

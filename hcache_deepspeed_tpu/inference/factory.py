@@ -31,12 +31,28 @@ def _llama_like(hf: Dict[str, Any]) -> LlamaConfig:
     )
 
 
+def _gpt2_like(hf: Dict[str, Any]):
+    from ..models.gpt2 import GPT2Config
+    return GPT2Config(
+        vocab_size=hf.get("vocab_size", 50257),
+        n_positions=hf.get("n_positions", hf.get("n_ctx", 1024)),
+        n_embd=hf.get("n_embd", 768),
+        n_layer=hf.get("n_layer", 12),
+        n_head=hf.get("n_head", 12),
+        layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        dtype=hf.get("torch_dtype", "float32"),
+    )
+
+
 #: model_type -> config adapter (reference: the policy map in
-#: engine_factory.py — llama/mistral/qwen2 share the llama block layout)
+#: engine_factory.py — llama/mistral/qwen2/phi3 share the llama block
+#: layout; gpt2 has its own paged model, model_gpt2.py)
 MODEL_FAMILIES = {
     "llama": _llama_like,
     "mistral": _llama_like,
     "qwen2": _llama_like,
+    "phi3": _llama_like,
+    "gpt2": _gpt2_like,
 }
 
 
@@ -49,7 +65,8 @@ def build_engine(model=None, config=None, *, model_config=None, params=None,
     if engine_config is None and isinstance(config, dict):
         engine_config = RaggedInferenceEngineConfig(**config)
     if model_config is None:
-        if isinstance(model, LlamaConfig):
+        from ..models.gpt2 import GPT2Config
+        if isinstance(model, (LlamaConfig, GPT2Config)):
             model_config = model
         elif isinstance(model, dict):
             family = model.get("model_type", "llama")
@@ -60,7 +77,8 @@ def build_engine(model=None, config=None, *, model_config=None, params=None,
             model_config = MODEL_FAMILIES[family](model)
         else:
             raise TypeError("build_engine needs model_config+params, a "
-                            "LlamaConfig, or an HF config dict")
+                            "LlamaConfig/GPT2Config, or an HF config "
+                            "dict")
     if params is None:
         raise ValueError("build_engine requires params (a trained "
                          "LlamaForCausalLM param tree)")

@@ -1,0 +1,183 @@
+"""Paged-KV inference model for the GPT-2 architecture family.
+
+Reference analog: the v1 kernel-injection containers for gpt2/gpt-neo
+(``module_inject/containers/gpt2.py``) and the v2 model-implementation
+framework's per-arch layer containers — a SECOND architecture served by
+the same ragged engine: LayerNorm (not RMSNorm), learned absolute
+position embeddings (no RoPE), fused c_attn QKV with biases, MHA, tied
+LM head.
+
+Consumes ``models.gpt2.GPT2LMHeadModel`` training params directly
+(wte/wpe/h_i/ln_f names), mirrors :class:`PagedInferenceModel`'s
+engine-facing contract (``forward_chunk``, ``restore_kv``,
+``cache_sharding``) so ``InferenceEngineV2`` runs either family.
+Latents (HCache) = the post-ln_1 hidden states, the same pre-QKV
+snapshot point the llama model uses.
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt2 import GPT2Config
+from ..ops.paged_attention import paged_attention
+from .model import stack_layer_params
+
+
+class PagedGPT2Model:
+    def __init__(self, cfg: GPT2Config, params, *, block_size: int,
+                 max_blocks_per_seq: int, capture_latents: bool = True,
+                 topology=None):
+        if topology is not None and topology.tensor_size > 1:
+            raise NotImplementedError(
+                "tensor-parallel serving is implemented for the llama "
+                "family; gpt2 serves single-chip / data-parallel")
+        self.cfg = cfg
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.capture_latents = capture_latents
+        self.n_layers = cfg.n_layer
+        self.topology = topology
+        self.tp = 1
+
+        self.params = {
+            "wte": params["wte"]["embedding"],
+            "wpe": params["wpe"]["embedding"],
+            "ln_f": {k: params["ln_f"][k] for k in ("scale", "bias")},
+            "layers": stack_layer_params(params, cfg.n_layer,
+                                         prefix="h_"),
+        }
+        self._fwd = jax.jit(self._forward_chunk, donate_argnums=(1, 2))
+        self._restore = jax.jit(self._restore_layer, donate_argnums=(1, 2))
+
+    def cache_sharding(self):
+        return None
+
+    # -------------------------------------------------------------- #
+    @staticmethod
+    def _ln(x, p, eps):
+        mu = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+        out = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+        return (out * p["scale"] + p["bias"]).astype(x.dtype)
+
+    def _qkv(self, lp, h):
+        """h: [B, T, C] -> q/k/v [B, T, H, D] (fused c_attn, biases)."""
+        cfg = self.cfg
+        B, T, C = h.shape
+        H = cfg.n_head
+        D = C // H
+        qkv = h @ lp["attn"]["c_attn"]["kernel"] + \
+            lp["attn"]["c_attn"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        return (q.reshape(B, T, H, D), k.reshape(B, T, H, D),
+                v.reshape(B, T, H, D))
+
+    def _scatter_kv(self, ck, cv, k, v, flat_idx):
+        kv_shape = (-1,) + k.shape[2:]
+        ck = ck.at[flat_idx.reshape(-1)].set(
+            k.reshape(kv_shape).astype(ck.dtype), mode="drop")
+        cv = cv.at[flat_idx.reshape(-1)].set(
+            v.reshape(kv_shape).astype(cv.dtype), mode="drop")
+        return ck, cv
+
+    def _layer_step(self, x, lp, ck, cv, tables, positions, flat_idx,
+                    kv_len):
+        cfg = self.cfg
+        eps = cfg.layer_norm_epsilon
+        h = self._ln(x, lp["ln_1"], eps)
+        latent = h if self.capture_latents else jnp.zeros(
+            (x.shape[0], x.shape[1], 0), h.dtype)
+        q, k, v = self._qkv(lp, h)
+        ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
+        B, T, Hq, D = q.shape
+        attn = paged_attention(q, ck, cv, tables, positions[:, 0], kv_len,
+                               self.block_size).reshape(B, T, Hq * D)
+        x = x + attn @ lp["attn"]["c_proj"]["kernel"] + \
+            lp["attn"]["c_proj"]["bias"]
+        h2 = self._ln(x, lp["ln_2"], eps)
+        ff = jax.nn.gelu(h2 @ lp["mlp"]["c_fc"]["kernel"] +
+                         lp["mlp"]["c_fc"]["bias"], approximate=True)
+        x = x + ff @ lp["mlp"]["c_proj"]["kernel"] + \
+            lp["mlp"]["c_proj"]["bias"]
+        return x.astype(self.cfg.compute_dtype), ck, cv, latent
+
+    # -------------------------------------------------------------- #
+    def _forward_chunk(self, params, cache_k, cache_v, tokens, start,
+                       tables, t_len):
+        B, T = tokens.shape
+        BS = self.block_size
+        P = cache_k.shape[1]
+        offs = jnp.arange(T)
+        positions = start[:, None] + offs[None, :]
+        token_valid = offs[None, :] < t_len[:, None]
+        local_blk = positions // BS
+        flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
+            positions % BS
+        flat_idx = jnp.where(token_valid, flat_idx, P)
+        kv_len = start + t_len
+
+        x = (params["wte"][tokens] + params["wpe"][positions]).astype(
+            self.cfg.compute_dtype)
+
+        def step(x, xs):
+            lp, ck, cv = xs
+            x, ck, cv, latent = self._layer_step(
+                x, lp, ck, cv, tables, positions, flat_idx, kv_len)
+            return x, (ck, cv, latent)
+
+        x, (cache_k, cache_v, latents) = jax.lax.scan(
+            step, x, (params["layers"], cache_k, cache_v))
+
+        x = self._ln(x, params["ln_f"], self.cfg.layer_norm_epsilon)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(t_len - 1, 0)[:, None, None], axis=1)[:, 0]
+        logits = (last @ params["wte"].T).astype(jnp.float32)
+        return cache_k, cache_v, logits, latents
+
+    def forward_chunk(self, cache, tokens, start, tables, t_len):
+        ck, cv, logits, latents = self._fwd(
+            self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
+            jnp.asarray(t_len, jnp.int32))
+        cache.replace(ck, cv)
+        return logits, latents
+
+    # -------------------------------------------------------------- #
+    def _restore_layer(self, params, cache_k, cache_v, layer, latent,
+                       start, tables, t_len):
+        lp = jax.tree.map(lambda p: p[layer], params["layers"])
+        B, T, _ = latent.shape
+        BS = self.block_size
+        P = cache_k.shape[1]
+        offs = jnp.arange(T)
+        positions = start[:, None] + offs[None, :]
+        token_valid = offs[None, :] < t_len[:, None]
+        local_blk = positions // BS
+        flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
+            positions % BS
+        flat_idx = jnp.where(token_valid, flat_idx, P).reshape(-1)
+        _, k, v = self._qkv(lp, latent.astype(self.cfg.compute_dtype))
+        kv_shape = (-1,) + k.shape[2:]
+        cache_k = cache_k.at[layer, flat_idx].set(
+            k.reshape(kv_shape).astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[layer, flat_idx].set(
+            v.reshape(kv_shape).astype(cache_v.dtype), mode="drop")
+        return cache_k, cache_v
+
+    def restore_kv(self, cache, latents, start, tables, t_len):
+        start = jnp.asarray(start, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        t_len = jnp.asarray(t_len, jnp.int32)
+        ck, cv = cache.k, cache.v
+        dev = list(ck.devices())[0]
+        buf = jax.device_put(np.asarray(latents[0]), dev)
+        for l in range(self.n_layers):  # noqa: E741
+            cur = buf
+            if l + 1 < self.n_layers:
+                buf = jax.device_put(np.asarray(latents[l + 1]), dev)
+            ck, cv = self._restore(self.params, ck, cv, jnp.int32(l), cur,
+                                   start, tables, t_len)
+        cache.replace(ck, cv)

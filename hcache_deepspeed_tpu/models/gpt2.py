@@ -36,6 +36,20 @@ class GPT2Config:
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
 
+    # engine-facing aliases (the ragged inference engine's generic
+    # surface: n_kv_head/head_dim/max_positions)
+    @property
+    def n_kv_head(self):
+        return self.n_head  # MHA
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+    @property
+    def max_positions(self):
+        return self.n_positions
+
 
 def gpt2_125m(**kw):
     return GPT2Config(**kw)
@@ -117,7 +131,8 @@ class GPT2LMHeadModel(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, batch, train: bool = False):
+    def __call__(self, batch, train: bool = False,
+                 return_logits: bool = False, pld_theta=None):
         cfg = self.cfg
         ids = batch["input_ids"]
         mask = batch.get("attention_mask")
@@ -133,11 +148,26 @@ class GPT2LMHeadModel(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(3,))
+        use_pld = pld_theta is not None and train
+        if use_pld:
+            pld_key = self.make_rng("dropout") if self.has_rng("dropout") \
+                else jax.random.PRNGKey(0)
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x, mask, train)
+            blk = block(cfg, name=f"h_{i}")
+            if use_pld:
+                # progressive layer drop: deeper layers drop more
+                # (compression/progressive_layer_drop.py ramp)
+                from ..compression.progressive_layer_drop import pld_layer
+                keep = 1.0 - ((i + 1) / cfg.n_layer) * (1.0 - pld_theta)
+                x = pld_layer(lambda h, blk=blk: blk(h, mask, train), x,
+                              keep, jax.random.fold_in(pld_key, i))
+            else:
+                x = blk(x, mask, train)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
                          name="ln_f")(x)
         logits = wte.attend(x)  # tied LM head (GPT-2 ties wte/lm_head)
+        if return_logits:
+            return logits
 
         labels = batch.get("labels")
         if labels is None:

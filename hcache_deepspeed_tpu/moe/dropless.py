@@ -1,0 +1,81 @@
+"""Dropless MoE: grouped-GEMM expert compute without capacity buffers.
+
+Reference analog: the MoE-GEMM kernel path
+(``inference/v2/kernels/cutlass_ops/moe_gemm`` + ``moe_gather`` /
+``moe_scatter`` ragged ops) — tokens sorted by expert, one grouped GEMM
+over the ragged groups, scattered back. No token is ever dropped (the
+megablocks formulation), unlike the capacity-factor path in
+``moe/layer.py``.
+
+TPU-native: sort-by-expert is an ``argsort`` (static [N*k] shape), the
+grouped GEMMs are ``lax.ragged_dot`` (``ops/grouped_gemm.py``), and the
+combine is a ``segment_sum`` — all differentiable, the whole layer jits
+as one program. Expert-parallel sharding note: this layer computes all
+experts' GEMMs from one token stream, so it composes with tensor/data
+sharding; the expert-axis a2a path keeps using the capacity layer.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.grouped_gemm import grouped_matmul
+
+
+def dropless_route(logits, k):
+    """Top-k routing without capacity: returns (probs [N,k], experts
+    [N,k], aux load-balancing loss) — same aux formula as the capacity
+    gate (fraction-mean * prob-mean * E)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)  # renormalize
+    # aux loss (reference: sharded_moe.py load-balancing)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(
+        jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * E
+    return topv, topi, aux
+
+
+class DroplessMoEMLP(nn.Module):
+    """[B, T, d] -> ([B, T, d], aux). SwiGLU experts, grouped GEMM."""
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    k: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, T, d = x.shape
+        E, f = self.num_experts, self.intermediate_size
+        N = B * T
+        tokens = x.reshape(N, d)
+
+        wg = self.param("wg", nn.initializers.lecun_normal(), (d, E),
+                        jnp.float32)
+        logits = tokens.astype(jnp.float32) @ wg
+        probs, experts, aux = dropless_route(logits, self.k)
+
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w1 = self.param("w1", init, (E, d, f), jnp.float32)
+        w3 = self.param("w3", init, (E, d, f), jnp.float32)
+        w2 = self.param("w2", init, (E, f, d), jnp.float32)
+
+        # sort the [N*k] token-expert pairs by expert
+        flat_e = experts.reshape(-1)                     # [N*k]
+        order = jnp.argsort(flat_e, stable=True)
+        token_of = order // self.k                       # source token
+        xs = tokens[token_of]                            # sorted inputs
+        group_sizes = jnp.bincount(flat_e, length=E)
+
+        dt = x.dtype
+        h = jax.nn.silu(grouped_matmul(xs, w1.astype(dt), group_sizes)) \
+            * grouped_matmul(xs, w3.astype(dt), group_sizes)
+        ys = grouped_matmul(h, w2.astype(dt), group_sizes)   # [N*k, d]
+
+        # weight by gate prob and combine back per token
+        gate = probs.reshape(-1)[order].astype(dt)
+        out = jax.ops.segment_sum(ys * gate[:, None], token_of,
+                                  num_segments=N)
+        return out.reshape(B, T, d).astype(dt), aux.astype(jnp.float32)

@@ -1,0 +1,38 @@
+"""Grouped GEMM — variable-sized per-expert matmuls.
+
+Reference analog: ``deepspeed/inference/v2/kernels/cutlass_ops/moe_gemm/``
+(CUTLASS grouped GEMM over expert-sorted token groups) — the kernel
+dropless MoE depends on.
+
+TPU-native form: ``jax.lax.ragged_dot`` — XLA's native ragged
+(group-sizes-driven) matmul, which Mosaic lowers onto the MXU with one
+kernel over all groups; differentiable, so it serves training too. The
+reference implementation below (segment-id gather + einsum) is the
+numerics oracle and the CPU fallback shape."""
+
+import jax
+import jax.numpy as jnp
+
+from . import register_op
+
+
+def reference_grouped_matmul(x, w, group_sizes):
+    """x: [N, K] tokens sorted by group; w: [G, K, M]; group_sizes: [G]
+    with sum == N. Returns [N, M] where row i uses its group's matrix."""
+    N = x.shape[0]
+    seg = jnp.repeat(jnp.arange(w.shape[0]), group_sizes,
+                     total_repeat_length=N)
+    return jnp.einsum("nk,nkm->nm", x, w[seg])
+
+
+def ragged_grouped_matmul(x, w, group_sizes):
+    return jax.lax.ragged_dot(x, w, group_sizes.astype(jnp.int32))
+
+
+def grouped_matmul(x, w, group_sizes):
+    from . import get_op
+    return get_op("grouped_matmul")(x, w, group_sizes)
+
+
+register_op("grouped_matmul", reference_grouped_matmul,
+            ragged_grouped_matmul)

@@ -121,7 +121,9 @@ def sparse_attention(q, k, v, layout: np.ndarray, block_size: int,
                          else jnp.ones((bs, bs), bool))
             s = jnp.where(mask[None, None], s, _NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            p = jnp.exp(s - m_new[..., None])
+            # explicit zeroing: _NEG_INF is finite, so rows with no valid
+            # key would otherwise get exp(0)=1 against the padding block
+            p = jnp.exp(s - m_new[..., None]) * mask[None, None]
             corr = jnp.exp(m - m_new)
             l_new = corr * l + jnp.sum(p, axis=-1)
             out_new = out * corr[..., None] + jnp.einsum(
@@ -155,9 +157,10 @@ def reference_masked_attention(q, k, v, layout, block_size, causal=True,
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    s = jnp.where(jnp.asarray(dense)[None, None], s, _NEG_INF)
+    dense_j = jnp.asarray(dense)[None, None]
+    s = jnp.where(dense_j, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
+    p = jnp.exp(s - m) * dense_j  # all-masked rows -> exactly zero
     l = jnp.sum(p, axis=-1, keepdims=True)
     l = jnp.where(l == 0.0, 1.0, l)
     out = jnp.einsum("bhqk,bkhd->bqhd", p / l, v.astype(jnp.float32))

@@ -79,12 +79,18 @@ class ModelAdapter:
         if hasattr(model, "apply") and hasattr(model, "init"):
             self.module = model
             self._takes_train = self._call_takes_train(model)
+            self._takes_pld = self._call_takes(model, "pld_theta")
 
-            def apply_fn(params, batch, rng, train):
+            def apply_fn(params, batch, rng, train, pld_theta=None):
                 rngs = {"dropout": rng} if rng is not None else None
+                kw = {}
                 if self._takes_train:
+                    kw["train"] = train
+                if self._takes_pld and pld_theta is not None:
+                    kw["pld_theta"] = pld_theta
+                if kw:
                     return model.apply({"params": params}, batch,
-                                       train=train, rngs=rngs)
+                                       rngs=rngs, **kw)
                 return model.apply({"params": params}, batch, rngs=rngs)
 
             self.apply_fn = apply_fn
@@ -95,7 +101,16 @@ class ModelAdapter:
                             f"got {type(model)}")
 
     @staticmethod
-    def _call_takes_train(model):
+    def _call_takes(model, name):
+        import inspect
+        try:
+            sig = inspect.signature(type(model).__call__)
+        except (TypeError, ValueError):
+            return False
+        return name in sig.parameters
+
+    @classmethod
+    def _call_takes_train(cls, model):
         import inspect
         try:
             sig = inspect.signature(type(model).__call__)
@@ -116,8 +131,12 @@ class ModelAdapter:
             variables = self.module.init(rng, example_batch)
         return variables["params"]
 
-    def loss(self, params, batch, rng, train=True):
-        out = self.apply_fn(params, batch, rng, train)
+    def loss(self, params, batch, rng, train=True, pld_theta=None):
+        if self.module is not None:
+            out = self.apply_fn(params, batch, rng, train,
+                                pld_theta=pld_theta)
+        else:  # bare apply_fn callables have the 4-arg contract
+            out = self.apply_fn(params, batch, rng, train)
         if self.loss_fn is not None:
             out = self.loss_fn(out, batch)
         if isinstance(out, tuple):
@@ -227,6 +246,12 @@ class HDSEngine:
                     config.compression_training.weight_quantization.enabled:
                 raise HDSConfigError(
                     "MoQ weight quantization is not supported on the "
+                    "manual ZeRO++ step; disable one of the two")
+            if self._zeropp and \
+                    config.compression_training.progressive_layer_drop \
+                    .enabled:
+                raise HDSConfigError(
+                    "progressive layer drop is not supported on the "
                     "manual ZeRO++ step; disable one of the two")
 
         # ---- optimizer-state host offload (ZeRO-Offload / -Infinity) ----
@@ -473,13 +498,14 @@ class HDSEngine:
             .weight_quantization.quantize_groups
 
         def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train,
-                          moq_bits=None):
+                          moq_bits=None, pld_theta=None):
             def raw_loss(p):
                 if self._moq is not None and moq_bits is not None:
                     from ..compression import quantize_param_tree_traced
                     p = quantize_param_tree_traced(p, moq_bits,
                                                    groups=moq_groups)
-                loss, _aux = self.adapter.loss(p, batch, rng, train=train)
+                loss, _aux = self.adapter.loss(p, batch, rng, train=train,
+                                               pld_theta=pld_theta)
                 return loss
 
             if remat_policy is not None:
@@ -612,7 +638,8 @@ class HDSEngine:
             out_shardings=grad_shardings)
 
         # fully fused train_batch: scan microbatches then apply
-        def fused_train_batch(state, batches, lr, rng, moq_bits=None):
+        def fused_train_batch(state, batches, lr, rng, moq_bits=None,
+                              pld_theta=None):
             # hpZ: refresh the secondary partition once, reuse across the
             # whole gradient-accumulation scan
             secondary = prepare_secondary(state["params"]) \
@@ -625,14 +652,15 @@ class HDSEngine:
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
                         batch, key, True, secondary)
-                elif moq_bits is not None:
-                    loss, grad_acc = micro_fwd_bwd(
-                        state["params"], grad_acc, state["loss_scale"],
-                        batch, key, True, moq_bits=moq_bits)
                 else:
+                    kw = {}
+                    if moq_bits is not None:
+                        kw["moq_bits"] = moq_bits
+                    if pld_theta is not None:
+                        kw["pld_theta"] = pld_theta
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
-                        batch, key, True)
+                        batch, key, True, **kw)
                 return (grad_acc, loss_sum + loss), None
 
             keys = jax.random.split(rng, gas)
@@ -700,14 +728,17 @@ class HDSEngine:
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._shard_batch(batch)
-        moq_kw = {}
+        extra_kw = {}
         if self._moq is not None:
-            moq_kw["moq_bits"] = jnp.asarray(
+            extra_kw["moq_bits"] = jnp.asarray(
                 self._moq.bits_at(self.global_steps), jnp.int32)
+        if self.progressive_layer_drop is not None:
+            extra_kw["pld_theta"] = jnp.asarray(
+                self.progressive_layer_drop.get_theta(), jnp.float32)
         loss, new_acc = self._micro_fwd_bwd(
             self.state["params"], self.state["grad_acc"],
             self.state["loss_scale"], batch, self._next_rng(), True,
-            **moq_kw)
+            **extra_kw)
         self.state["grad_acc"] = new_acc
         self._pending = loss
         if self.wall_clock_breakdown:
@@ -882,8 +913,12 @@ class HDSEngine:
         if self._moq is not None:
             moq_bits = jnp.asarray(
                 self._moq.bits_at(self.global_steps), jnp.int32)
+        pld_theta = None
+        if self.progressive_layer_drop is not None:
+            pld_theta = jnp.asarray(
+                self.progressive_layer_drop.get_theta(), jnp.float32)
         self.state, loss, finite, grad_norm = self._fused_train_batch(
-            self.state, batch, lr, self._next_rng(), moq_bits)
+            self.state, batch, lr, self._next_rng(), moq_bits, pld_theta)
         self._last_grad_norm = grad_norm
         self.micro_steps += gas
         self._after_step(finite)

@@ -1,0 +1,149 @@
+"""GPT-2 family in the ragged engine (reference: the v1 gpt2 injection
+container + v2 per-arch model implementations) and the dropless
+grouped-GEMM MoE (cutlass_ops/moe_gemm analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+
+
+def _engine(cfg, params):
+    return InferenceEngineV2(cfg, params, config=RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 128,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 16, "num_blocks": 24,
+                  "cache_dtype": "float32"}))
+
+
+def _setup():
+    cfg = gpt2_tiny(n_positions=128, use_flash=False)
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (1, 16), dtype=np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    return cfg, model, params
+
+
+class TestPagedGPT2:
+    def test_prefill_matches_training_model_logits(self):
+        cfg, model, params = _setup()
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(0, 256, (20,)).astype(np.int32)
+        eng = _engine(cfg, params)
+        logits, _ = eng.put([1], [prompt.tolist()])
+        # oracle: the training model's full forward, last position
+        full = model.apply({"params": params},
+                           {"input_ids": prompt[None]},
+                           return_logits=True)
+        np.testing.assert_allclose(np.asarray(logits)[0],
+                                   np.asarray(full)[0, -1], atol=2e-4)
+
+    def test_decode_matches_training_model(self):
+        cfg, model, params = _setup()
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, 256, (9,)).astype(np.int32)
+        eng = _engine(cfg, params)
+        logits, _ = eng.put([5], [prompt.tolist()])
+        tok = int(np.argmax(np.asarray(logits)[0]))
+        seq = list(prompt) + [tok]
+        for _ in range(3):
+            logits, _ = eng.put([5], [[tok]])
+            full = model.apply({"params": params},
+                               {"input_ids": np.asarray(seq)[None]},
+                               return_logits=True)
+            np.testing.assert_allclose(
+                np.asarray(logits)[0], np.asarray(full)[0, -1], atol=2e-4)
+            tok = int(np.argmax(np.asarray(logits)[0]))
+            seq.append(tok)
+
+    def test_restore_kv_roundtrip(self):
+        cfg, model, params = _setup()
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, 256, (20,)).astype(np.int32).tolist()
+        eng = _engine(cfg, params)
+        logits, latents = eng.put([7], [prompt])
+        tok = int(np.argmax(np.asarray(logits)[0]))
+        direct, _ = eng.put([7], [[tok]])
+        eng.flush(7)
+        eng.restore_kv([7], [prompt], [latents[0]])
+        restored, _ = eng.put([7], [[tok]])
+        np.testing.assert_allclose(np.asarray(direct),
+                                   np.asarray(restored), atol=2e-4)
+
+    def test_generate_loop(self):
+        cfg, _, params = _setup()
+        eng = _engine(cfg, params)
+        outs = eng.generate([[1, 2, 3], [9, 9]], max_new_tokens=4)
+        assert [len(o) for o in outs] == [4, 4]
+
+    def test_factory_family(self):
+        from hcache_deepspeed_tpu.inference.factory import MODEL_FAMILIES
+        mc = MODEL_FAMILIES["gpt2"]({"model_type": "gpt2", "n_embd": 64,
+                                     "n_layer": 2, "n_head": 4,
+                                     "vocab_size": 256})
+        assert mc.n_embd == 64 and mc.head_dim == 16
+        assert "phi3" in MODEL_FAMILIES
+
+
+class TestDroplessMoE:
+    def test_grouped_matmul_parity(self):
+        from hcache_deepspeed_tpu.ops.grouped_gemm import (
+            ragged_grouped_matmul, reference_grouped_matmul)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((12, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((3, 8, 6)), jnp.float32)
+        gs = jnp.asarray([5, 0, 7], jnp.int32)  # empty group included
+        a = reference_grouped_matmul(x, w, gs)
+        b = ragged_grouped_matmul(x, w, gs)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+    def test_dropless_no_tokens_dropped(self):
+        """Unlike the capacity layer, every token contributes: with k=1
+        and all tokens routed to one expert, outputs match that expert's
+        dense FFN (capacity layers would drop the overflow)."""
+        from hcache_deepspeed_tpu.moe.dropless import DroplessMoEMLP
+        rng = np.random.default_rng(1)
+        # positive activations so the biased gate column dominates
+        x = jnp.asarray(np.abs(rng.standard_normal((2, 8, 16))),
+                        jnp.float32)
+        layer = DroplessMoEMLP(num_experts=4, hidden_size=16,
+                               intermediate_size=32, k=1)
+        params = layer.init(jax.random.PRNGKey(0), x)
+        # force all routing to expert 2 by biasing the gate
+        wg = np.zeros((16, 4), np.float32)
+        wg[:, 2] = 1.0
+        params = jax.tree_util.tree_map_with_path(
+            lambda p, leaf: jnp.asarray(wg) if "wg" in str(p) else leaf,
+            params)
+        out, aux = layer.apply(params, x)
+        p = params["params"]
+        h = jax.nn.silu(x @ p["w1"][2]) * (x @ p["w3"][2])
+        expect = h @ p["w2"][2]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   atol=1e-5)
+
+    def test_dropless_trains(self):
+        from hcache_deepspeed_tpu.moe.dropless import DroplessMoEMLP
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+        tgt = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+        layer = DroplessMoEMLP(num_experts=4, hidden_size=16,
+                               intermediate_size=32, k=2)
+        params = layer.init(jax.random.PRNGKey(0), x)
+
+        def loss(p):
+            out, aux = layer.apply(p, x)
+            return ((out - tgt) ** 2).mean() + 0.01 * aux
+
+        l0 = float(loss(params))
+        g = jax.jit(jax.grad(loss))(params)
+        params2 = jax.tree.map(lambda p, gg: p - 0.1 * gg, params, g)
+        assert float(loss(params2)) < l0

@@ -104,6 +104,39 @@ class TestPLD:
             float((x + 1).sum())
 
 
+class TestPLDEngineWiring:
+    def test_pld_changes_training_and_theta_decays(self, eight_devices):
+        """PLD must actually alter the compiled step (stochastic layer
+        bypass), not just tick a schedule."""
+        def run(pld_enabled):
+            model = GPT2LMHeadModel(gpt2_tiny(use_flash=False))
+            cfg = {
+                "train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "compression_training": {
+                    "progressive_layer_drop": {
+                        "enabled": pld_enabled, "theta": 0.1,
+                        "gamma": 0.5}},
+            }
+            rng = np.random.default_rng(0)
+            batch = {"input_ids": rng.integers(0, 256, (8, 32),
+                                               dtype=np.int32)}
+            engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                             example_batch=batch)
+            losses = [float(engine.train_batch(batch=batch))
+                      for _ in range(5)]
+            return engine, losses
+
+        e_pld, l_pld = run(True)
+        _, l_plain = run(False)
+        # same seed/model: with aggressive dropping the trajectories
+        # must diverge, and theta must have decayed toward its floor
+        assert l_pld != l_plain
+        assert e_pld.progressive_layer_drop.get_theta() < 0.3
+        assert all(np.isfinite(l_pld))
+
+
 class TestEigenvalue:
     def test_quadratic_exact(self):
         # f(x) = 0.5 x^T A x with known top eigenvalue
