@@ -66,10 +66,14 @@ class InferenceEngineV2:
         self._scratch_block = self.state.allocator.allocate(1)[0]
 
         from ..models.gpt2 import GPT2Config
+        from ..models.mixtral import MixtralConfig
         model_cls = PagedInferenceModel
         if isinstance(model_config, GPT2Config):
             from .model_gpt2 import PagedGPT2Model
             model_cls = PagedGPT2Model
+        elif isinstance(model_config, MixtralConfig):
+            from .model_moe import PagedMoEModel
+            model_cls = PagedMoEModel
         self.model = model_cls(
             model_config, params, block_size=self.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,

@@ -44,15 +44,39 @@ def _gpt2_like(hf: Dict[str, Any]):
     )
 
 
+def _mixtral_like(hf: Dict[str, Any]):
+    from ..models.mixtral import MixtralConfig
+    return MixtralConfig(
+        vocab_size=hf.get("vocab_size", 32000),
+        hidden_size=hf.get("hidden_size", 4096),
+        intermediate_size=hf.get("intermediate_size", 14336),
+        n_layer=hf.get("num_hidden_layers", 32),
+        n_head=hf.get("num_attention_heads", 32),
+        n_kv_head=hf.get("num_key_value_heads", 8),
+        max_positions=hf.get("max_position_embeddings", 8192),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 1e6),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        num_experts=hf.get("num_local_experts", hf.get("num_experts", 8)),
+        top_k=hf.get("num_experts_per_tok", 2),
+        dtype=hf.get("torch_dtype", "bfloat16"),
+    )
+
+
 #: model_type -> config adapter (reference: the policy map in
-#: engine_factory.py — llama/mistral/qwen2/phi3 share the llama block
-#: layout; gpt2 has its own paged model, model_gpt2.py)
+#: engine_factory.py:69 — llama/mistral/qwen2/phi3 share the llama block
+#: layout; mixtral routes through the MoE paged model (model_moe.py);
+#: gpt2 has its own paged model (model_gpt2.py). qwen2_moe is NOT mapped
+#: to the mixtral adapter on purpose: it adds a shared expert and skips
+#: top-k renormalisation (norm_topk_prob=False), which PagedMoEModel does
+#: not implement — mapping it anyway would serve wrong logits silently.
 MODEL_FAMILIES = {
     "llama": _llama_like,
     "mistral": _llama_like,
     "qwen2": _llama_like,
     "phi3": _llama_like,
     "gpt2": _gpt2_like,
+    "mixtral": _mixtral_like,
 }
 
 

@@ -97,13 +97,24 @@ class PagedInferenceModel:
         }
         if not self.tied:
             new["lm_head"] = params["lm_head"]["kernel"]
-        new = jax.tree.map(
-            lambda p: jnp.asarray(p, self.cfg.compute_dtype)
-            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
-            new)
+        def cast(path, p):
+            p = jnp.asarray(p)
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            if self._keep_fp32(path):
+                return p.astype(jnp.float32)
+            return p.astype(self.cfg.compute_dtype)
+        new = jax.tree_util.tree_map_with_path(cast, new)
         if self.tp > 1:
             new = jax.device_put(new, self._param_shardings_for(new))
         self.params = new
+
+    @staticmethod
+    def _keep_fp32(path) -> bool:
+        """Leaves that must stay fp32 regardless of compute dtype (the MoE
+        family pins its router here — near-tie routing logits flip expert
+        selection under bf16 rounding)."""
+        return False
 
     # -------------------------------------------------------------- #
     # Tensor parallelism (reference: per-layer allreduce + sharded heads,
@@ -248,13 +259,18 @@ class PagedInferenceModel:
         x = x + proj
         h2 = rms_norm(x, lp["post_attention_layernorm"]["weight"],
                       eps=cfg.rms_norm_eps).astype(cfg.compute_dtype)
+        x = x + self._mlp_out(lp, h2)
+        return x.astype(cfg.compute_dtype), ck, cv, latent
+
+    def _mlp_out(self, lp, h2):
+        """SwiGLU MLP on the post-attention hidden states. Overridden by
+        the MoE family (model_moe.py) with routed grouped-GEMM experts."""
         gate = h2 @ lp["mlp"]["gate_proj"]["kernel"]
         up = h2 @ lp["mlp"]["up_proj"]["kernel"]
         mlp = (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
         if self.tp > 1:   # (reference :169)
             mlp = jax.lax.psum(mlp, TENSOR_AXIS)
-        x = x + mlp
-        return x.astype(cfg.compute_dtype), ck, cv, latent
+        return mlp
 
     # -------------------------------------------------------------- #
     # forward_chunk: the one compiled family (prefill & ragged decode)

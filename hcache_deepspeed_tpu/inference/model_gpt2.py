@@ -42,15 +42,22 @@ class PagedGPT2Model:
         self.topology = topology
         self.tp = 1
 
+        self.load_params(params)
+        self._fwd = jax.jit(self._forward_chunk, donate_argnums=(1, 2))
+        self._restore = jax.jit(self._restore_layer, donate_argnums=(1, 2))
+
+    def load_params(self, params):
+        """(Re)load training-layout params into the serving layout — the
+        hybrid engine's per-phase refresh contract (see
+        PagedInferenceModel.load_params). Shapes unchanged ⇒ compiled
+        functions are reused."""
         self.params = {
             "wte": params["wte"]["embedding"],
             "wpe": params["wpe"]["embedding"],
             "ln_f": {k: params["ln_f"][k] for k in ("scale", "bias")},
-            "layers": stack_layer_params(params, cfg.n_layer,
+            "layers": stack_layer_params(params, self.cfg.n_layer,
                                          prefix="h_"),
         }
-        self._fwd = jax.jit(self._forward_chunk, donate_argnums=(1, 2))
-        self._restore = jax.jit(self._restore_layer, donate_argnums=(1, 2))
 
     def cache_sharding(self):
         return None

@@ -1,0 +1,83 @@
+"""Paged-KV inference model for MoE (Mixtral-family) architectures.
+
+Reference analog: the mixtral / qwen2-moe policies in
+``deepspeed/inference/v2/engine_factory.py:69`` and the MoE module stack —
+``modules/implementations/moe/cutlass_multi_gemm.py`` (top-k gating +
+moe_scatter + grouped GEMM + moe_gather) backed by
+``kernels/cutlass_ops/moe_gemm`` and ``kernels/ragged_ops/{top_k_gating,
+moe_scatter,moe_gather}``.
+
+TPU-native form: the llama paged trunk (:class:`PagedInferenceModel`)
+with the dense SwiGLU MLP swapped for dropless routed experts — fp32
+router, top-k renormalised gates, tokens sorted by expert with one
+``lax.ragged_dot`` grouped GEMM per projection (``ops/grouped_gemm.py``),
+segment-sum combine. No capacity buffers, no token drops — serving
+latency must not depend on routing luck.
+
+Consumes ``models.mixtral.MixtralForCausalLM`` training params directly
+(``layers_i/mlp/moe/{wg, experts/{w1,w2,w3}}``), so a trained Mixtral
+checkpoint (or the hybrid engine's live training params) serves without a
+conversion step.
+
+Tensor parallelism: expert FFN dims shard on ``tensor`` exactly like the
+dense path (w1/w3 column, w2 row, one psum after combine); the router is
+replicated. The expert mesh axis is a *training* concern (a2a dispatch,
+``moe/layer.py``) — serving shards experts' insides, not their identity,
+matching the reference's TP-sharded MoE inference.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..models.mixtral import MixtralConfig
+from ..moe.dropless import dropless_expert_ffn
+from ..parallel.topology import TENSOR_AXIS
+from .model import PagedInferenceModel
+
+
+class PagedMoEModel(PagedInferenceModel):
+    """Serves :class:`~..models.mixtral.MixtralConfig` checkpoints through
+    the ragged engine (same ``forward_chunk`` / ``restore_kv`` / TP
+    contract as the llama model)."""
+
+    def __init__(self, cfg: MixtralConfig, params, **kw):
+        if not isinstance(cfg, MixtralConfig):
+            raise TypeError("PagedMoEModel needs a MixtralConfig")
+        super().__init__(cfg, params, **kw)
+
+    @staticmethod
+    def _keep_fp32(path) -> bool:
+        """The router weight stays fp32 (training gates run fp32,
+        moe/layer.py:47; bf16 rounding of near-tie logits would select
+        different experts at serve time than at train time)."""
+        return str(getattr(path[-1], "key", path[-1])) == "wg"
+
+    # -------------------------------------------------------------- #
+    def _mlp_out(self, lp, h2):
+        moe = lp["mlp"]["moe"]
+        B, T, d = h2.shape
+        out, _aux = dropless_expert_ffn(
+            h2.reshape(B * T, d), moe["wg"], moe["experts"]["w1"],
+            moe["experts"]["w3"], moe["experts"]["w2"], self.cfg.top_k)
+        out = out.reshape(B, T, d)
+        if self.tp > 1:   # row-parallel partial sum over expert ff shards
+            out = jax.lax.psum(out, TENSOR_AXIS)
+        return out
+
+    # -------------------------------------------------------------- #
+    def _param_spec_tree(self, params=None):
+        specs = super()._param_spec_tree(params)
+
+        def fix(path, spec):
+            joined = "/".join(str(getattr(k, "key", k)) for k in path)
+            if "/moe/" in joined or joined.endswith("/wg"):
+                if "w1" in joined or "w3" in joined:
+                    return P(None, None, None, TENSOR_AXIS)  # [L,E,d,f]
+                if "w2" in joined:
+                    return P(None, None, TENSOR_AXIS, None)  # [L,E,f,d]
+                return P()                                   # router fp32
+            return spec
+        specs["layers"] = jax.tree_util.tree_map_with_path(
+            fix, specs["layers"],
+            is_leaf=lambda x: isinstance(x, P))
+        return specs

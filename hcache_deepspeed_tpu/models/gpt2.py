@@ -150,8 +150,13 @@ class GPT2LMHeadModel(nn.Module):
             block = nn.remat(Block, static_argnums=(3,))
         use_pld = pld_theta is not None and train
         if use_pld:
-            pld_key = self.make_rng("dropout") if self.has_rng("dropout") \
-                else jax.random.PRNGKey(0)
+            if not self.has_rng("dropout"):
+                # a fixed fallback key would drop the SAME layer subset
+                # every step — stochastic depth needs a fresh key
+                raise ValueError(
+                    "progressive layer drop requires a 'dropout' rng: "
+                    "model.apply(..., rngs={'dropout': key})")
+            pld_key = self.make_rng("dropout")
         for i in range(cfg.n_layer):
             blk = block(cfg, name=f"h_{i}")
             if use_pld:

@@ -24,6 +24,9 @@ class MixtralConfig(LlamaConfig):
     eval_capacity_factor: float = 1.25
     min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
+    #: route through the dropless grouped-GEMM path (moe/dropless.py)
+    #: instead of capacity buffers; same param tree either way
+    dropless: bool = False
 
 
 def mixtral_8x7b(**kw):

@@ -38,6 +38,70 @@ def dropless_route(logits, k):
     return topv, topi, aux
 
 
+def dropless_expert_ffn(tokens, wg, w1, w3, w2, k):
+    """The routed grouped-GEMM SwiGLU computation shared by the training
+    layer below and the paged serving model (inference/model_moe.py).
+    tokens: [N, d]; returns ([N, d], aux)."""
+    N, d = tokens.shape
+    E = wg.shape[-1]
+    dt = tokens.dtype
+    logits = tokens.astype(jnp.float32) @ wg
+    probs, experts, aux = dropless_route(logits, k)
+    flat_e = experts.reshape(-1)                     # [N*k]
+    order = jnp.argsort(flat_e, stable=True)
+    token_of = order // k
+    xs = tokens[token_of]
+    group_sizes = jnp.bincount(flat_e, length=E)
+    h = jax.nn.silu(grouped_matmul(xs, w1.astype(dt), group_sizes)) \
+        * grouped_matmul(xs, w3.astype(dt), group_sizes)
+    ys = grouped_matmul(h, w2.astype(dt), group_sizes)   # [N*k, d]
+    gate = probs.reshape(-1)[order].astype(dt)
+    out = jax.ops.segment_sum(ys * gate[:, None], token_of,
+                              num_segments=N)
+    return out, aux
+
+
+class _ExpertWeights(nn.Module):
+    """Declares the stacked [E, ...] expert tensors under the SAME param
+    paths as ``SwiGLUExperts`` (``.../experts/{w1,w2,w3}``) so capacity
+    and dropless layers share checkpoints and the paged serving model
+    consumes either."""
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+
+    @nn.compact
+    def __call__(self):
+        E, d, f = self.num_experts, self.hidden_size, self.intermediate_size
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w1 = self.param("w1", init, (E, d, f), jnp.float32)
+        w3 = self.param("w3", init, (E, d, f), jnp.float32)
+        w2 = self.param("w2", init, (E, f, d), jnp.float32)
+        return w1, w3, w2
+
+
+class DroplessMOELayer(nn.Module):
+    """Drop-in replacement for ``MOELayer`` (same param tree: ``wg`` +
+    ``experts/{w1,w2,w3}``) computing with the dropless grouped-GEMM path
+    instead of capacity buffers. [B, T, d] -> ([B, T, d], aux)."""
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    k: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, T, d = x.shape
+        wg = self.param("wg", nn.initializers.lecun_normal(),
+                        (d, self.num_experts), jnp.float32)
+        w1, w3, w2 = _ExpertWeights(
+            self.num_experts, self.hidden_size, self.intermediate_size,
+            name="experts")()
+        out, aux = dropless_expert_ffn(x.reshape(B * T, d), wg, w1, w3, w2,
+                                       self.k)
+        return out.reshape(B, T, d).astype(x.dtype), aux.astype(jnp.float32)
+
+
 class DroplessMoEMLP(nn.Module):
     """[B, T, d] -> ([B, T, d], aux). SwiGLU experts, grouped GEMM."""
     num_experts: int
@@ -49,33 +113,12 @@ class DroplessMoEMLP(nn.Module):
     def __call__(self, x, train: bool = True):
         B, T, d = x.shape
         E, f = self.num_experts, self.intermediate_size
-        N = B * T
-        tokens = x.reshape(N, d)
-
         wg = self.param("wg", nn.initializers.lecun_normal(), (d, E),
                         jnp.float32)
-        logits = tokens.astype(jnp.float32) @ wg
-        probs, experts, aux = dropless_route(logits, self.k)
-
         init = nn.initializers.lecun_normal(batch_axis=(0,))
         w1 = self.param("w1", init, (E, d, f), jnp.float32)
         w3 = self.param("w3", init, (E, d, f), jnp.float32)
         w2 = self.param("w2", init, (E, f, d), jnp.float32)
-
-        # sort the [N*k] token-expert pairs by expert
-        flat_e = experts.reshape(-1)                     # [N*k]
-        order = jnp.argsort(flat_e, stable=True)
-        token_of = order // self.k                       # source token
-        xs = tokens[token_of]                            # sorted inputs
-        group_sizes = jnp.bincount(flat_e, length=E)
-
-        dt = x.dtype
-        h = jax.nn.silu(grouped_matmul(xs, w1.astype(dt), group_sizes)) \
-            * grouped_matmul(xs, w3.astype(dt), group_sizes)
-        ys = grouped_matmul(h, w2.astype(dt), group_sizes)   # [N*k, d]
-
-        # weight by gate prob and combine back per token
-        gate = probs.reshape(-1)[order].astype(dt)
-        out = jax.ops.segment_sum(ys * gate[:, None], token_of,
-                                  num_segments=N)
-        return out.reshape(B, T, d).astype(dt), aux.astype(jnp.float32)
+        out, aux = dropless_expert_ffn(x.reshape(B * T, d), wg, w1, w3, w2,
+                                       self.k)
+        return out.reshape(B, T, d).astype(x.dtype), aux.astype(jnp.float32)

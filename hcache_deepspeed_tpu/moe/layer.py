@@ -92,6 +92,14 @@ class MoEMLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         cfg = self.cfg
+        if getattr(cfg, "dropless", False):
+            from .dropless import DroplessMOELayer
+            return DroplessMOELayer(
+                num_experts=cfg.num_experts,
+                hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                k=getattr(cfg, "top_k", 2),
+                name="moe")(x, train)
         return MOELayer(
             num_experts=cfg.num_experts,
             hidden_size=cfg.hidden_size,

@@ -101,25 +101,27 @@ class ModelAdapter:
                             f"got {type(model)}")
 
     @staticmethod
-    def _call_takes(model, name):
+    def _call_signature(model):
         import inspect
         try:
-            sig = inspect.signature(type(model).__call__)
+            return inspect.signature(type(model).__call__)
         except (TypeError, ValueError):
-            return False
-        return name in sig.parameters
+            return None
+
+    @classmethod
+    def _call_takes(cls, model, name):
+        sig = cls._call_signature(model)
+        return sig is not None and name in sig.parameters
 
     @classmethod
     def _call_takes_train(cls, model):
         import inspect
-        try:
-            sig = inspect.signature(type(model).__call__)
-        except (TypeError, ValueError):
+        sig = cls._call_signature(model)
+        if sig is None:
             return False
-        if "train" in sig.parameters:
-            return True
-        return any(p.kind is inspect.Parameter.VAR_KEYWORD
-                   for p in sig.parameters.values())
+        return "train" in sig.parameters or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values())
 
     def init_params(self, rng, example_batch):
         if self.module is None:
