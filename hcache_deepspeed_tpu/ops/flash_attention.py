@@ -66,8 +66,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        # matmuls stay in the input dtype (bf16 hits the MXU at full
+        # rate; an fp32 upcast here would run at ~1/8 peak on v5e) with
+        # fp32 accumulation via preferred_element_type
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -83,9 +86,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         corr = jnp.exp(m_prev - m_new)
         l_s[:, :1] = corr * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         m_s[:, :1] = m_new
-        v = v_ref[0, 0].astype(jnp.float32)
         acc[:] = acc[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _out():
@@ -147,10 +150,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -164,7 +167,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_acc[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -187,10 +190,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -202,12 +205,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse)
+        pc = p.astype(do.dtype)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pc, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -294,20 +298,32 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def pallas_attention(q, k, v, causal=True, scale=None, block_q=128,
-                     block_k=128, interpret=None):
+def pallas_attention(q, k, v, causal=True, scale=None, block_q=512,
+                     block_k=512, interpret=None):
     B, T, H, D = q.shape
     scale = scale or _default_scale(D)
     if interpret is None:
         from ..platform import get_platform
         interpret = not get_platform().supports_pallas()
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
+    # largest block <= requested that divides T (stepping down through
+    # 128-multiples keeps e.g. T=1280 on the kernel at block 256 instead
+    # of silently falling back to the O(T^2)-memory reference path)
+    def fit(block):
+        block = min(block, T)
+        while block >= 128 and T % block:
+            block -= 128
+        return block
+    block_q, block_k = fit(block_q), fit(block_k)
+    if block_q < 128 or block_k < 128 or T % block_q or T % block_k:
         return reference_attention(q, k, v, causal=causal, scale=scale)
     if not interpret and (block_q % 8 or block_k % 128):
         # Mosaic tiling: the s=[block_q, block_k] tile needs a (8,128)-
         # aligned layout on real hardware; unaligned shapes fall back
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if not interpret and D % 128 and D != 64:
+        # lane (last-dim) tiling: D must be 128-aligned (64 is the one
+        # sublane-packable exception Mosaic handles well); e.g. D=96
+        # crashes the compiler
         return reference_attention(q, k, v, causal=causal, scale=scale)
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
 
