@@ -15,7 +15,13 @@ from .engine_v2 import InferenceEngineV2
 
 
 def _llama_like(hf: Dict[str, Any]) -> LlamaConfig:
+    # HF Qwen2 carries q/k/v biases; its config spells llama-style keys.
+    # (Qwen-v1 does NOT map here — it uses seq_length/layer_norm_epsilon
+    # and a fused c_attn, so mapping it would mis-read the config.)
+    bias_default = hf.get("model_type") == "qwen2"
     return LlamaConfig(
+        attention_bias=hf.get("attention_bias",
+                              hf.get("qkv_bias", bias_default)),
         vocab_size=hf.get("vocab_size", 32000),
         hidden_size=hf.get("hidden_size", 4096),
         intermediate_size=hf.get("intermediate_size", 11008),

@@ -141,8 +141,14 @@ class PagedInferenceModel:
             joined = "/".join(str(getattr(k, "key", k)) for k in path)
             if any(n in joined for n in ("q_proj", "k_proj", "v_proj",
                                          "gate_proj", "up_proj")):
-                return col3
+                # stacked kernel [L, in, out] -> col; stacked bias
+                # [L, out] follows its column shards
+                return col3 if leaf.ndim == 3 else P(None, TENSOR_AXIS)
             if any(n in joined for n in ("o_proj", "down_proj")):
+                if leaf.ndim != 3:
+                    raise NotImplementedError(
+                        "bias on a row-parallel projection would be "
+                        "added once per shard before the psum")
                 return row3
             return P()
 
@@ -206,12 +212,17 @@ class PagedInferenceModel:
         cfg = self.cfg
         B, T, _ = h.shape
         D = cfg.head_dim
-        qk = lp["self_attn"]["q_proj"]["kernel"]
-        kk = lp["self_attn"]["k_proj"]["kernel"]
-        vk = lp["self_attn"]["v_proj"]["kernel"]
-        q = (h @ qk).reshape(B, T, qk.shape[-1] // D, D)
-        k = (h @ kk).reshape(B, T, kk.shape[-1] // D, D)
-        v = (h @ vk).reshape(B, T, vk.shape[-1] // D, D)
+        def proj(p, x):
+            y = x @ p["kernel"]
+            if "bias" in p:   # qwen-style attention biases
+                y = y + p["bias"]
+            return y
+        qk = lp["self_attn"]["q_proj"]
+        kk = lp["self_attn"]["k_proj"]
+        vk = lp["self_attn"]["v_proj"]
+        q = proj(qk, h).reshape(B, T, qk["kernel"].shape[-1] // D, D)
+        k = proj(kk, h).reshape(B, T, kk["kernel"].shape[-1] // D, D)
+        v = proj(vk, h).reshape(B, T, vk["kernel"].shape[-1] // D, D)
         q = apply_rope(q, self.cos, self.sin, positions)
         k = apply_rope(k, self.cos, self.sin, positions)
         return q, k, v

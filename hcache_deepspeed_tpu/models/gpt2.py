@@ -30,7 +30,16 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     dtype: str = "float32"
     remat: bool = False
+    #: jax.checkpoint_policies name for per-block remat (e.g.
+    #: "dots_with_no_batch_dims_saveable" keeps matmul outputs and only
+    #: recomputes elementwise ops — far cheaper than full remat while
+    #: still bounding live activations); implies remat when set
+    remat_policy: str = ""
     use_flash: bool = True
+    #: > 0: compute the LM loss in sequence chunks of this size without
+    #: materializing the full [B, T, V] fp32 logits (FPDT chunked-loss
+    #: trade: one extra head GEMM per chunk in backward)
+    loss_chunk: int = 0
 
     @property
     def compute_dtype(self):
@@ -146,8 +155,10 @@ class GPT2LMHeadModel(nn.Module):
             x = nn.Dropout(cfg.dropout, deterministic=False)(x)
 
         block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=(3,))
+        if cfg.remat or cfg.remat_policy:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy) \
+                if cfg.remat_policy else None
+            block = nn.remat(Block, static_argnums=(3,), policy=policy)
         use_pld = pld_theta is not None and train
         if use_pld:
             if not self.has_rng("dropout"):
@@ -170,14 +181,30 @@ class GPT2LMHeadModel(nn.Module):
                 x = blk(x, mask, train)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
                          name="ln_f")(x)
-        logits = wte.attend(x)  # tied LM head (GPT-2 ties wte/lm_head)
         if return_logits:
-            return logits
+            return wte.attend(x)  # tied LM head (GPT-2 ties wte/lm_head)
 
         labels = batch.get("labels")
         if labels is None:
             labels = default_lm_labels(ids)
-        return causal_lm_loss(logits, labels)
+        if cfg.loss_chunk:
+            if T % cfg.loss_chunk == 0:
+                from ..sequence.fpdt import chunked_lm_loss
+                head = wte.embedding.astype(dtype).T
+                return chunked_lm_loss(x, head, labels,
+                                       chunk=cfg.loss_chunk)
+            _warn_loss_chunk_fallback(T, cfg.loss_chunk)
+        return causal_lm_loss(wte.attend(x), labels)
+
+
+def _warn_loss_chunk_fallback(T, chunk):
+    """The chunked path exists to avoid the [B, T, V] fp32 logits; a
+    silent fallback would OOM at exactly the scale the flag targets."""
+    from ..utils.logging import logger
+    logger.warning(
+        "loss_chunk=%d does not divide T=%d — falling back to the "
+        "full-logits loss (materializes [B, T, V] fp32). Pad the "
+        "sequence or pick a divisor.", chunk, T)
 
 
 def default_lm_labels(ids):

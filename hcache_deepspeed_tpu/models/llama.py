@@ -49,7 +49,15 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: str = "float32"
     remat: bool = False
+    #: jax.checkpoint_policies name for per-block remat (implies remat;
+    #: see GPT2Config.remat_policy)
+    remat_policy: str = ""
     use_flash: bool = True
+    #: biases on q/k/v projections (qwen / qwen1.5-style; llama: False)
+    attention_bias: bool = False
+    #: > 0: chunked LM loss — no full [B, T, V] fp32 logits (see
+    #: GPT2Config.loss_chunk)
+    loss_chunk: int = 0
 
     @property
     def head_dim(self):
@@ -103,9 +111,10 @@ class LlamaAttention(nn.Module):
         B, T, C = x.shape
         H, KV, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
 
-        q = nn.Dense(H * D, use_bias=False, dtype=x.dtype, name="q_proj")(x)
-        k = nn.Dense(KV * D, use_bias=False, dtype=x.dtype, name="k_proj")(x)
-        v = nn.Dense(KV * D, use_bias=False, dtype=x.dtype, name="v_proj")(x)
+        ab = cfg.attention_bias
+        q = nn.Dense(H * D, use_bias=ab, dtype=x.dtype, name="q_proj")(x)
+        k = nn.Dense(KV * D, use_bias=ab, dtype=x.dtype, name="k_proj")(x)
+        v = nn.Dense(KV * D, use_bias=ab, dtype=x.dtype, name="v_proj")(x)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, KV, D)
         v = v.reshape(B, T, KV, D)
@@ -181,6 +190,19 @@ class LlamaBlock(nn.Module):
         return x + y, aux
 
 
+class _HeadKernel(nn.Module):
+    """Declares the LM-head weight at the ``lm_head/kernel`` path (the
+    tree nn.Dense would create) while handing the raw kernel back, so the
+    chunked loss can stream it without a full-logits GEMM."""
+    hidden: int
+    vocab: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          (self.hidden, self.vocab), jnp.float32)
+
+
 class LlamaForCausalLM(nn.Module):
     """Batch contract matches GPT2LMHeadModel: {"input_ids": [B,T] int32,
     optional "labels" (-100 ignore), optional "attention_mask"}. Returns the
@@ -202,8 +224,11 @@ class LlamaForCausalLM(nn.Module):
         x = embed(ids)
 
         block = LlamaBlock
-        if cfg.remat:
-            block = nn.remat(LlamaBlock, static_argnums=(2,))
+        if cfg.remat or cfg.remat_policy:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy) \
+                if cfg.remat_policy else None
+            block = nn.remat(LlamaBlock, static_argnums=(2,),
+                             policy=policy)
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layer):
             x, aux = block(cfg, attention_fn=self.attention_fn,
@@ -212,17 +237,26 @@ class LlamaForCausalLM(nn.Module):
         x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
 
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x)
+            head_kernel = embed.embedding.T.astype(dtype)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=dtype,
-                              name="lm_head")(x)
+            # same param path as nn.Dense(name="lm_head") would declare
+            head_kernel = _HeadKernel(cfg.hidden_size, cfg.vocab_size,
+                                      name="lm_head")().astype(dtype)
 
         if return_logits:
-            return logits
+            return x @ head_kernel
         labels = batch.get("labels")
         if labels is None:
             labels = default_lm_labels(ids)
-        loss = causal_lm_loss(logits, labels)
+        if cfg.loss_chunk and T % cfg.loss_chunk == 0:
+            from ..sequence.fpdt import chunked_lm_loss
+            loss = chunked_lm_loss(x, head_kernel, labels,
+                                   chunk=cfg.loss_chunk)
+        else:
+            if cfg.loss_chunk:
+                from .gpt2 import _warn_loss_chunk_fallback
+                _warn_loss_chunk_fallback(T, cfg.loss_chunk)
+            loss = causal_lm_loss(x @ head_kernel, labels)
         aux_coef = getattr(cfg, "moe_aux_loss_coef", 0.0)
         if aux_coef:
             loss = loss + aux_coef * aux_total

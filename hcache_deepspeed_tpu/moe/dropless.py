@@ -102,23 +102,6 @@ class DroplessMOELayer(nn.Module):
         return out.reshape(B, T, d).astype(x.dtype), aux.astype(jnp.float32)
 
 
-class DroplessMoEMLP(nn.Module):
-    """[B, T, d] -> ([B, T, d], aux). SwiGLU experts, grouped GEMM."""
-    num_experts: int
-    hidden_size: int
-    intermediate_size: int
-    k: int = 2
-
-    @nn.compact
-    def __call__(self, x, train: bool = True):
-        B, T, d = x.shape
-        E, f = self.num_experts, self.intermediate_size
-        wg = self.param("wg", nn.initializers.lecun_normal(), (d, E),
-                        jnp.float32)
-        init = nn.initializers.lecun_normal(batch_axis=(0,))
-        w1 = self.param("w1", init, (E, d, f), jnp.float32)
-        w3 = self.param("w3", init, (E, d, f), jnp.float32)
-        w2 = self.param("w2", init, (E, f, d), jnp.float32)
-        out, aux = dropless_expert_ffn(x.reshape(B * T, d), wg, w1, w3, w2,
-                                       self.k)
-        return out.reshape(B, T, d).astype(x.dtype), aux.astype(jnp.float32)
+#: back-compat alias — the one dropless module (param tree ``wg`` +
+#: ``experts/{w1,w2,w3}``, shared with the capacity MOELayer)
+DroplessMoEMLP = DroplessMOELayer

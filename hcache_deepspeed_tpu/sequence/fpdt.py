@@ -95,17 +95,25 @@ def chunked_lm_loss(hidden, lm_head_kernel, labels, chunk=1024):
     hs = hidden.reshape(B, n, chunk, H)
     ls = labels.reshape(B, n, chunk)
 
-    def step(acc, i):
-        nll_sum, count = acc
-        logits = (hs[:, i] @ lm_head_kernel).astype(jnp.float32)
-        lab = ls[:, i]
+    # remat the chunk body: without it, autodiff-of-scan saves every
+    # chunk's [B, chunk, V] fp32 logits as residuals — exactly the
+    # materialization this function exists to avoid. With it, backward
+    # recomputes each chunk's logits GEMM (the FPDT trade).
+    @jax.checkpoint
+    def chunk_nll(h_blk, lab):
+        logits = (h_blk @ lm_head_kernel).astype(jnp.float32)
         valid = lab != -100
         safe = jnp.where(valid, lab, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, safe[..., None],
                                    axis=-1).squeeze(-1)
         nll = jnp.where(valid, nll, 0.0)
-        return (nll_sum + nll.sum(), count + valid.sum()), None
+        return nll.sum(), valid.sum()
+
+    def step(acc, i):
+        nll_sum, count = acc
+        nll, valid = chunk_nll(hs[:, i], ls[:, i])
+        return (nll_sum + nll, count + valid), None
 
     (nll_sum, count), _ = jax.lax.scan(
         step, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),

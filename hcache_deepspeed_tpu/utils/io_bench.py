@@ -1,0 +1,100 @@
+"""Storage I/O benchmark + tuner.
+
+Reference analogs: ``bin/ds_io`` (csrc/aio perf harness driving
+``deepspeed_py_aio_handle``) and ``bin/ds_nvme_tune``
+(``deepspeed/nvme/`` parameter sweep). One module serves both CLI shims:
+``run_bench`` measures read/write GB/s for one (threads, queue_depth,
+block) point through the C++ aio thread pool (``ops/native/aio.py``),
+``tune`` sweeps the grid and prints the best point — the numbers that
+feed ``aio`` config blocks for ZeRO-Offload / swap.
+"""
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def _mb(n):
+    return n * 1024 * 1024
+
+
+def run_bench(path: str, size_mb: int = 256, threads: int = 4,
+              queue_depth: int = 32, block_mb: int = 8,
+              read: bool = True, write: bool = True) -> dict:
+    """Returns {write_gbs, read_gbs} for one configuration point."""
+    from ..ops.native.aio import AsyncIOHandle
+    handle = AsyncIOHandle(num_threads=threads, queue_depth=queue_depth)
+    nblocks = max(size_mb // block_mb, 1)
+    blocks = [np.random.randint(0, 256, _mb(block_mb), np.uint8)
+              for _ in range(min(nblocks, 4))]
+    out = {"size_mb": size_mb, "threads": threads,
+           "queue_depth": queue_depth, "block_mb": block_mb}
+    paths = [f"{path}.blk{i}" for i in range(nblocks)]
+    try:
+        if write:
+            t0 = time.perf_counter()
+            ids = [handle.async_pwrite(blocks[i % len(blocks)], p)
+                   for i, p in enumerate(paths)]
+            for rid in ids:
+                handle.wait(rid)
+            dt = time.perf_counter() - t0
+            out["write_gbs"] = round(size_mb / 1024 / dt, 3)
+        if read:
+            bufs = [np.empty(_mb(block_mb), np.uint8)
+                    for _ in range(min(nblocks, 4))]
+            t0 = time.perf_counter()
+            ids = [handle.async_pread(bufs[i % len(bufs)], p)
+                   for i, p in enumerate(paths)]
+            for rid in ids:
+                handle.wait(rid)
+            dt = time.perf_counter() - t0
+            out["read_gbs"] = round(size_mb / 1024 / dt, 3)
+    finally:
+        handle.close()
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return out
+
+
+def tune(path: str, size_mb: int = 256) -> dict:
+    """Sweep (threads, queue_depth, block) and report the best point
+    (reference: ds_nvme_tune's grid over the same knobs)."""
+    best, results = None, []
+    for threads in (1, 2, 4, 8):
+        for qd in (8, 32):
+            for block_mb in (1, 8):
+                r = run_bench(path, size_mb=size_mb, threads=threads,
+                              queue_depth=qd, block_mb=block_mb)
+                results.append(r)
+                score = r.get("read_gbs", 0) + r.get("write_gbs", 0)
+                if best is None or score > best[0]:
+                    best = (score, r)
+    return {"best": best[1], "results": results}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("hds_io")
+    p.add_argument("--path", default=None,
+                   help="target file prefix (default: a tempfile)")
+    p.add_argument("--size-mb", type=int, default=256)
+    p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--queue-depth", type=int, default=32)
+    p.add_argument("--block-mb", type=int, default=8)
+    p.add_argument("--tune", action="store_true",
+                   help="sweep the knob grid (hds_nvme_tune mode)")
+    args = p.parse_args(argv)
+    path = args.path or os.path.join(tempfile.gettempdir(), "hds_io_bench")
+    if args.tune:
+        print(json.dumps(tune(path, size_mb=args.size_mb), indent=2))
+    else:
+        print(json.dumps(run_bench(
+            path, size_mb=args.size_mb, threads=args.threads,
+            queue_depth=args.queue_depth, block_mb=args.block_mb)))
+    return 0

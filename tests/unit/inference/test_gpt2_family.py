@@ -125,8 +125,8 @@ class TestDroplessMoE:
             params)
         out, aux = layer.apply(params, x)
         p = params["params"]
-        h = jax.nn.silu(x @ p["w1"][2]) * (x @ p["w3"][2])
-        expect = h @ p["w2"][2]
+        h = jax.nn.silu(x @ p["experts"]["w1"][2]) * (x @ p["experts"]["w3"][2])
+        expect = h @ p["experts"]["w2"][2]
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                    atol=1e-5)
 

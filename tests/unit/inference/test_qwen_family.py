@@ -1,0 +1,76 @@
+"""Qwen family: the llama trunk with q/k/v projection biases
+(reference: engine_factory.py qwen/qwen2 policies; HF Qwen2 uses
+attention biases)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig,
+                                            build_hf_engine)
+from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM, llama_tiny)
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen():
+    cfg = llama_tiny(max_positions=128, use_flash=False,
+                     attention_bias=True)
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    return cfg, model, params
+
+
+def full_logits(model, params, tokens):
+    out = model.apply({"params": params},
+                      {"input_ids": np.asarray(tokens, np.int32)[None]},
+                      train=False, return_logits=True)
+    return np.asarray(out)[0]
+
+
+def test_params_have_biases(tiny_qwen):
+    _, _, params = tiny_qwen
+    assert "bias" in params["layers_0"]["self_attn"]["q_proj"]
+
+
+def test_prefill_decode_parity(tiny_qwen):
+    cfg, model, params = tiny_qwen
+    engine = InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 4, "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24,
+                      "cache_dtype": "float32"}))
+    rng = np.random.default_rng(0)
+    tokens = list(rng.integers(0, cfg.vocab_size, (10,)))
+    logits, _ = engine.put([1], [tokens])
+    np.testing.assert_allclose(logits[0],
+                               full_logits(model, params, tokens)[-1],
+                               atol=2e-2)
+    nxt = int(np.argmax(logits[0]))
+    tokens.append(nxt)
+    dec, _ = engine.put([1], [[nxt]])
+    np.testing.assert_allclose(dec[0],
+                               full_logits(model, params, tokens)[-1],
+                               atol=2e-2)
+
+
+def test_hf_factory_qwen2_sets_bias(tiny_qwen):
+    cfg, _, params = tiny_qwen
+    hf = {"model_type": "qwen2", "vocab_size": cfg.vocab_size,
+          "hidden_size": cfg.hidden_size,
+          "intermediate_size": cfg.intermediate_size,
+          "num_hidden_layers": cfg.n_layer,
+          "num_attention_heads": cfg.n_head,
+          "num_key_value_heads": cfg.n_kv_head,
+          "max_position_embeddings": 128,
+          "torch_dtype": "float32"}
+    engine = build_hf_engine(
+        hf, params,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 4, "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24}))
+    assert engine.model.cfg.attention_bias
+    logits, _ = engine.put([1], [[1, 2, 3]])
+    assert np.isfinite(np.asarray(logits)).all()
