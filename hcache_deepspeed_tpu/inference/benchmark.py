@@ -1,0 +1,107 @@
+"""Serving benchmark: prefill + ragged-decode throughput.
+
+Reference analog: the FastGen benchmark harness behind
+``blogs/deepspeed-fastgen/README.md`` (throughput/latency curves for the
+v2 ragged engine). Measures, for a model served by
+:class:`InferenceEngineV2`:
+
+* prefill tokens/sec at a given prompt length,
+* steady-state decode tokens/sec at several concurrent-batch sizes,
+* decode latency as a function of *actual* context length (the paged
+  kernel's work should scale with tokens in cache, not max_context).
+
+CLI: ``bin/hds_serve_bench`` (JSON lines, one per measurement).
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _engine(model_size: str, max_context: int, batch: int):
+    import jax
+
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from .config import RaggedInferenceEngineConfig
+    from .engine_v2 import InferenceEngineV2
+
+    sizes = {
+        "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                     n_layer=2, n_head=4, n_kv_head=2),
+        "1b": dict(vocab_size=32000, hidden_size=2048,
+                   intermediate_size=5504, n_layer=24, n_head=16,
+                   n_kv_head=16),
+        "7b": dict(vocab_size=32000, hidden_size=4096,
+                   intermediate_size=11008, n_layer=32, n_head=32,
+                   n_kv_head=32),
+    }
+    cfg = LlamaConfig(max_positions=max_context, dtype="bfloat16",
+                      use_flash=False, **sizes[model_size])
+    model = LlamaForCausalLM(cfg)
+    batch_init = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch_init,
+                        train=False)["params"]
+    blocks_needed = batch * (-(-max_context // 64)) + 2
+    eng = InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": max(batch, 8),
+                           "max_ragged_batch_size": 8192,
+                           "max_ragged_sequence_count": max(batch, 8),
+                           "max_context": max_context},
+            kv_cache={"block_size": 64, "num_blocks": blocks_needed,
+                      "cache_dtype": "bfloat16"},
+            hcache={"enable_latents": False}))
+    return cfg, eng
+
+
+def run(model_size="tiny", max_context=512, prompt_len=128,
+        decode_steps=64, batches=(1, 4, 8)):
+    results = []
+    rng = np.random.default_rng(0)
+    for batch in batches:
+        cfg, eng = _engine(model_size, max_context, batch)
+        prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+                   for _ in range(batch)]
+        uids = list(range(batch))
+
+        t0 = time.perf_counter()
+        logits, _ = eng.put(uids, prompts)
+        prefill_s = time.perf_counter() - t0
+        results.append({"phase": "prefill", "batch": batch,
+                        "prompt_len": prompt_len,
+                        "tokens_per_sec": round(batch * prompt_len /
+                                                prefill_s, 1)})
+
+        # warm the decode dispatch, then steady-state loop
+        nxt = [int(np.argmax(l)) for l in logits]
+        logits, _ = eng.put(uids, [[t] for t in nxt])
+        t0 = time.perf_counter()
+        for _ in range(decode_steps):
+            nxt = [int(np.argmax(l)) for l in logits]
+            logits, _ = eng.put(uids, [[t] for t in nxt])
+        dt = time.perf_counter() - t0
+        results.append({"phase": "decode", "batch": batch,
+                        "context": prompt_len,
+                        "tokens_per_sec": round(batch * decode_steps / dt,
+                                                1),
+                        "ms_per_step": round(dt / decode_steps * 1000, 2)})
+        for u in uids:
+            eng.flush(u)
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("hds_serve_bench")
+    p.add_argument("--model", default="tiny", choices=("tiny", "1b", "7b"))
+    p.add_argument("--max-context", type=int, default=512)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--decode-steps", type=int, default=64)
+    p.add_argument("--batches", type=int, nargs="+", default=[1, 4, 8])
+    args = p.parse_args(argv)
+    for r in run(args.model, args.max_context, args.prompt_len,
+                 args.decode_steps, tuple(args.batches)):
+        print(json.dumps(r), flush=True)
+    return 0

@@ -177,8 +177,14 @@ class InferenceEngineV2:
         if decode_idx:
             self._run_decode(batch_uids, batch_tokens, decode_idx,
                              logits_out, latents_out)
+        # prefills batch per length bucket: one dispatch per (B, T)
+        # bucket instead of one jit call per sequence (round-1 latency
+        # hygiene finding; reference batches prefills in one ragged pass)
+        groups: Dict[int, List[int]] = {}
         for i in prefill_idx:
-            self._run_prefill(batch_uids[i], batch_tokens[i], i,
+            groups.setdefault(_bucket(len(batch_tokens[i])), []).append(i)
+        for T, idx in sorted(groups.items()):
+            self._run_prefill(batch_uids, batch_tokens, idx, T,
                               logits_out, latents_out)
 
         for uid in batch_uids:
@@ -214,19 +220,32 @@ class InferenceEngineV2:
             if self.config.hcache.enable_latents:
                 latents_out[i] = latents[:, j]
 
-    def _run_prefill(self, uid, seq_tokens, i, logits_out, latents_out):
-        seq = self.state.get_sequence(uid)
-        T = _bucket(len(seq_tokens))
-        tok = np.zeros((1, T), np.int32)
-        tok[0, :len(seq_tokens)] = seq_tokens
-        start = np.asarray([seq.seen_tokens], np.int32)
-        t_len = np.asarray([len(seq_tokens)], np.int32)
-        tables = self.state.block_table(seq, self.max_blocks_per_seq)[None]
+    def _run_prefill(self, uids, tokens, idx, T, logits_out, latents_out):
+        """One batched dispatch for all prefills in a length bucket;
+        padded rows (t_len=0) write to the scratch block like padded
+        decode lanes."""
+        B = _bucket(len(idx), minimum=1)
+        tok = np.zeros((B, T), np.int32)
+        start = np.zeros((B,), np.int32)
+        t_len = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
+        tables[:, 0] = self._scratch_block
+        for j, i in enumerate(idx):
+            seq = self.state.get_sequence(uids[i])
+            tok[j, :len(tokens[i])] = tokens[i]
+            start[j] = seq.seen_tokens
+            t_len[j] = len(tokens[i])
+            tables[j] = self.state.block_table(seq,
+                                               self.max_blocks_per_seq)
         logits, latents = self.model.forward_chunk(self.cache, tok, start,
                                                    tables, t_len)
-        logits_out[i] = np.asarray(logits)[0]
+        logits = np.asarray(logits)
         if self.config.hcache.enable_latents:
-            latents_out[i] = np.asarray(latents)[:, 0, :len(seq_tokens)]
+            latents = np.asarray(latents)      # [L, B, T, H]
+        for j, i in enumerate(idx):
+            logits_out[i] = logits[j]
+            if self.config.hcache.enable_latents:
+                latents_out[i] = latents[:, j, :len(tokens[i])]
 
     # -------------------------------------------------------------- #
     # Serving loop (reference: the generate() surface the v1 engine

@@ -29,11 +29,33 @@ def run_bench(path: str, size_mb: int = 256, threads: int = 4,
     from ..ops.native.aio import AsyncIOHandle
     handle = AsyncIOHandle(num_threads=threads, queue_depth=queue_depth)
     nblocks = max(size_mb // block_mb, 1)
+    total_mb = nblocks * block_mb   # bytes actually moved (!= size_mb
+    # when block_mb does not divide it — throughput must use this)
     blocks = [np.random.randint(0, 256, _mb(block_mb), np.uint8)
               for _ in range(min(nblocks, 4))]
-    out = {"size_mb": size_mb, "threads": threads,
+    out = {"size_mb": total_mb, "threads": threads,
            "queue_depth": queue_depth, "block_mb": block_mb}
     paths = [f"{path}.blk{i}" for i in range(nblocks)]
+
+    def _fsync_all():
+        for p in paths:
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+    def _drop_cache_all():
+        # evict our pages so reads hit storage, not the page cache (the
+        # aio pool is deliberately buffered; the reference ds_io gets
+        # this via O_DIRECT)
+        for p in paths:
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+            finally:
+                os.close(fd)
+
     try:
         if write:
             t0 = time.perf_counter()
@@ -41,9 +63,11 @@ def run_bench(path: str, size_mb: int = 256, threads: int = 4,
                    for i, p in enumerate(paths)]
             for rid in ids:
                 handle.wait(rid)
+            _fsync_all()   # durability inside the timed region
             dt = time.perf_counter() - t0
-            out["write_gbs"] = round(size_mb / 1024 / dt, 3)
+            out["write_gbs"] = round(total_mb / 1024 / dt, 3)
         if read:
+            _drop_cache_all()
             bufs = [np.empty(_mb(block_mb), np.uint8)
                     for _ in range(min(nblocks, 4))]
             t0 = time.perf_counter()
@@ -52,7 +76,7 @@ def run_bench(path: str, size_mb: int = 256, threads: int = 4,
             for rid in ids:
                 handle.wait(rid)
             dt = time.perf_counter() - t0
-            out["read_gbs"] = round(size_mb / 1024 / dt, 3)
+            out["read_gbs"] = round(total_mb / 1024 / dt, 3)
     finally:
         handle.close()
         for p in paths:
