@@ -89,6 +89,28 @@ def argparse_suppress():
     return argparse.SUPPRESS
 
 
+def tp_model_init(model, tp_size: int = 1, dtype=None, config=None,
+                  **kwargs):
+    """Prepare a model for tensor-parallel training/inference.
+
+    Reference: ``deepspeed.tp_model_init`` (``deepspeed/__init__.py:369``)
+    — there it rewrites nn.Modules into ``LinearLayer``/
+    ``LinearAllreduce``; here sharding is declarative, so this ensures a
+    topology with a ``tensor`` axis of ``tp_size`` exists and returns the
+    model unchanged — ``initialize``'s AutoTP derives the PartitionSpecs
+    from the parameter tree (``parallel/auto_tp.py``).
+    """
+    from .parallel import topology as topo_mod
+    topo = topo_mod._topology   # None unless explicitly initialized
+    if topo is None:
+        topo_mod.initialize_topology(topo_mod.TopologySpec(tensor=tp_size))
+    elif topo.tensor_size != tp_size:
+        raise ValueError(
+            f"active topology has tensor={topo.tensor_size}, requested "
+            f"tp_size={tp_size}; reset the topology first")
+    return model
+
+
 def init_inference(model=None, config=None, **kwargs):
     """Reference: deepspeed/__init__.py:291. Implemented by the inference
     package (ragged batching engine v2 + HCache restore)."""

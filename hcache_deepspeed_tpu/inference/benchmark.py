@@ -78,15 +78,41 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
         # warm the decode dispatch, then steady-state loop
         nxt = [int(np.argmax(l)) for l in logits]
         logits, _ = eng.put(uids, [[t] for t in nxt])
+        ctx0 = prompt_len + 1
         t0 = time.perf_counter()
         for _ in range(decode_steps):
             nxt = [int(np.argmax(l)) for l in logits]
             logits, _ = eng.put(uids, [[t] for t in nxt])
         dt = time.perf_counter() - t0
         results.append({"phase": "decode", "batch": batch,
-                        "context": prompt_len,
+                        "context": [ctx0, ctx0 + decode_steps],
                         "tokens_per_sec": round(batch * decode_steps / dt,
                                                 1),
+                        "ms_per_step": round(dt / decode_steps * 1000, 2)})
+        for u in uids:
+            eng.flush(u)
+
+    # context scaling: decode step latency must track tokens-in-cache
+    # (the paged kernel reads valid blocks only), not max_context
+    batch = batches[0]
+    for ctx in (max_context // 4, max_context // 2,
+                max_context - decode_steps - 1):
+        if ctx < 8:
+            continue
+        cfg, eng = _engine(model_size, max_context, batch)
+        prompts = [list(rng.integers(0, cfg.vocab_size, (ctx,)))
+                   for _ in range(batch)]
+        uids = list(range(batch))
+        logits, _ = eng.put(uids, prompts)
+        nxt = [int(np.argmax(l)) for l in logits]
+        logits, _ = eng.put(uids, [[t] for t in nxt])   # warm decode
+        t0 = time.perf_counter()
+        for _ in range(decode_steps):
+            nxt = [int(np.argmax(l)) for l in logits]
+            logits, _ = eng.put(uids, [[t] for t in nxt])
+        dt = time.perf_counter() - t0
+        results.append({"phase": "decode-context-scaling", "batch": batch,
+                        "context": ctx,
                         "ms_per_step": round(dt / decode_steps * 1000, 2)})
         for u in uids:
             eng.flush(u)

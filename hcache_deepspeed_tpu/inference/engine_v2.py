@@ -65,12 +65,24 @@ class InferenceEngineV2:
         # block 0 is reserved scratch: padded decode lanes write there
         self._scratch_block = self.state.allocator.allocate(1)[0]
 
+        from ..models.falcon import FalconConfig
         from ..models.gpt2 import GPT2Config
         from ..models.mixtral import MixtralConfig
+        from ..models.opt import OPTConfig
+        from ..models.phi import PhiConfig
         model_cls = PagedInferenceModel
         if isinstance(model_config, GPT2Config):
             from .model_gpt2 import PagedGPT2Model
             model_cls = PagedGPT2Model
+        elif isinstance(model_config, OPTConfig):
+            from .model_opt import PagedOPTModel
+            model_cls = PagedOPTModel
+        elif isinstance(model_config, FalconConfig):
+            from .model_falcon import PagedFalconModel
+            model_cls = PagedFalconModel
+        elif isinstance(model_config, PhiConfig):
+            from .model_phi import PagedPhiModel
+            model_cls = PagedPhiModel
         elif isinstance(model_config, MixtralConfig):
             from .model_moe import PagedMoEModel
             model_cls = PagedMoEModel
@@ -230,13 +242,12 @@ class InferenceEngineV2:
         t_len = np.zeros((B,), np.int32)
         tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
         tables[:, 0] = self._scratch_block
+        tables[:len(idx)] = self._tables(idx, uids)
         for j, i in enumerate(idx):
             seq = self.state.get_sequence(uids[i])
             tok[j, :len(tokens[i])] = tokens[i]
             start[j] = seq.seen_tokens
             t_len[j] = len(tokens[i])
-            tables[j] = self.state.block_table(seq,
-                                               self.max_blocks_per_seq)
         logits, latents = self.model.forward_chunk(self.cache, tok, start,
                                                    tables, t_len)
         logits = np.asarray(logits)

@@ -50,6 +50,56 @@ def _gpt2_like(hf: Dict[str, Any]):
     )
 
 
+def _opt_like(hf: Dict[str, Any]):
+    from ..models.opt import OPTConfig
+    return OPTConfig(
+        vocab_size=hf.get("vocab_size", 50272),
+        hidden_size=hf.get("hidden_size", 768),
+        ffn_dim=hf.get("ffn_dim", 3072),
+        n_layer=hf.get("num_hidden_layers", 12),
+        n_head=hf.get("num_attention_heads", 12),
+        max_positions=hf.get("max_position_embeddings", 2048),
+        dtype=hf.get("torch_dtype", "float32"),
+    )
+
+
+def _falcon_like(hf: Dict[str, Any]):
+    from ..models.falcon import FalconConfig
+    n_head = hf.get("num_attention_heads", hf.get("n_head", 71))
+    if hf.get("new_decoder_architecture", False):
+        kv = hf.get("num_kv_heads", 8)
+    else:
+        kv = n_head if not hf.get("multi_query", True) else 1
+    return FalconConfig(
+        vocab_size=hf.get("vocab_size", 65024),
+        hidden_size=hf.get("hidden_size", 4544),
+        n_layer=hf.get("num_hidden_layers", hf.get("n_layer", 32)),
+        n_head=n_head,
+        n_kv_head=kv,
+        max_positions=hf.get("max_position_embeddings", 2048),
+        layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        dtype=hf.get("torch_dtype", "bfloat16"),
+    )
+
+
+def _phi_like(hf: Dict[str, Any]):
+    from ..models.phi import PhiConfig
+    return PhiConfig(
+        vocab_size=hf.get("vocab_size", 51200),
+        hidden_size=hf.get("hidden_size", 2560),
+        intermediate_size=hf.get("intermediate_size", 10240),
+        n_layer=hf.get("num_hidden_layers", 32),
+        n_head=hf.get("num_attention_heads", 32),
+        max_positions=hf.get("max_position_embeddings", 2048),
+        layer_norm_epsilon=hf.get("layer_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        partial_rotary_factor=hf.get("partial_rotary_factor", 0.4),
+        dtype=hf.get("torch_dtype", "float32"),
+    )
+
+
 def _mixtral_like(hf: Dict[str, Any]):
     from ..models.mixtral import MixtralConfig
     return MixtralConfig(
@@ -82,6 +132,9 @@ MODEL_FAMILIES = {
     "qwen2": _llama_like,
     "phi3": _llama_like,
     "gpt2": _gpt2_like,
+    "opt": _opt_like,
+    "falcon": _falcon_like,
+    "phi": _phi_like,
     "mixtral": _mixtral_like,
 }
 
@@ -95,8 +148,12 @@ def build_engine(model=None, config=None, *, model_config=None, params=None,
     if engine_config is None and isinstance(config, dict):
         engine_config = RaggedInferenceEngineConfig(**config)
     if model_config is None:
+        from ..models.falcon import FalconConfig
         from ..models.gpt2 import GPT2Config
-        if isinstance(model, (LlamaConfig, GPT2Config)):
+        from ..models.opt import OPTConfig
+        from ..models.phi import PhiConfig
+        if isinstance(model, (LlamaConfig, GPT2Config, OPTConfig,
+                              FalconConfig, PhiConfig)):
             model_config = model
         elif isinstance(model, dict):
             family = model.get("model_type", "llama")
@@ -107,8 +164,9 @@ def build_engine(model=None, config=None, *, model_config=None, params=None,
             model_config = MODEL_FAMILIES[family](model)
         else:
             raise TypeError("build_engine needs model_config+params, a "
-                            "LlamaConfig/GPT2Config, or an HF config "
-                            "dict")
+                            "model-family config (LlamaConfig/GPT2Config/"
+                            "OPTConfig/FalconConfig/PhiConfig/"
+                            "MixtralConfig), or an HF config dict")
     if params is None:
         raise ValueError("build_engine requires params (a trained "
                          "LlamaForCausalLM param tree)")

@@ -314,11 +314,10 @@ class PagedInferenceModel:
         x, (cache_k, cache_v, latents) = jax.lax.scan(
             step, x, (params["layers"], cache_k, cache_v))
 
-        x = rms_norm(x, params["norm"], eps=self.cfg.rms_norm_eps)
+        x = self._final_norm(params, x)
         last = jnp.take_along_axis(
             x, jnp.maximum(t_len - 1, 0)[:, None, None], axis=1)[:, 0]
-        head = params["embed"].T if self.tied else params["lm_head"]
-        logits = (last @ head).astype(jnp.float32)
+        logits = self._head_logits(params, last)
         if self.tp > 1:
             # vocab is sharded either way (tied: rows of the table;
             # untied: head columns) — gather the full logits row
@@ -326,6 +325,16 @@ class PagedInferenceModel:
             logits = jax.lax.all_gather(logits, TENSOR_AXIS, axis=1,
                                         tiled=True)
         return cache_k, cache_v, logits, latents
+
+    def _final_norm(self, params, x):
+        """Final RMSNorm; LayerNorm families (falcon) override."""
+        return rms_norm(x, params["norm"], eps=self.cfg.rms_norm_eps)
+
+    def _head_logits(self, params, last):
+        """LM head on the last valid position; biased-head families
+        (phi) override."""
+        head = params["embed"].T if self.tied else params["lm_head"]
+        return (last @ head).astype(jnp.float32)
 
     def _embed_lookup(self, table, tokens):
         """Embedding lookup. Under TP with tied embeddings the table is

@@ -1,0 +1,78 @@
+"""Paged-KV serving for the Falcon family.
+
+Reference analog: the falcon policy in
+``deepspeed/inference/v2/engine_factory.py:69`` +
+``model_implementations/falcon/``. Reuses the llama paged trunk's
+KV plumbing (RoPE + GQA/MQA paged attention); overrides the layer to
+Falcon's **parallel** form — one shared LayerNorm feeding both the
+attention and GELU-MLP branches — and the final norm to LayerNorm.
+
+Latents (HCache) = the post-input_layernorm hidden states, the same
+pre-QKV snapshot the llama model uses, so ``restore_kv`` (QKV-only
+replay) works unchanged.
+
+Serving is single-chip / data-parallel for now (the TP spec tree is
+llama-shaped); reference TP falcon support maps to a later
+`_param_spec_tree` override.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models.falcon import FalconConfig
+from .model import PagedInferenceModel, stack_layer_params
+
+
+class PagedFalconModel(PagedInferenceModel):
+    def __init__(self, cfg: FalconConfig, params, **kw):
+        if not isinstance(cfg, FalconConfig):
+            raise TypeError("PagedFalconModel needs a FalconConfig")
+        if kw.get("topology") is not None and \
+                kw["topology"].tensor_size > 1:
+            raise NotImplementedError(
+                "tensor-parallel serving is implemented for the llama "
+                "family; falcon serves single-chip / data-parallel")
+        super().__init__(cfg, params, **kw)
+
+    def load_params(self, params):
+        new = {
+            "embed": params["embed_tokens"]["embedding"],
+            "norm": {k: params["ln_f"][k] for k in ("scale", "bias")},
+            "layers": stack_layer_params(params, self.cfg.n_layer),
+        }
+        if not self.tied:
+            new["lm_head"] = params["lm_head"]["kernel"]
+        def cast(path, p):
+            p = jnp.asarray(p)
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            return p.astype(self.cfg.compute_dtype)
+        self.params = jax.tree_util.tree_map_with_path(cast, new)
+
+    @staticmethod
+    def _ln(x, p, eps):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(var + eps)
+        return (out * p["scale"] + p["bias"]).astype(x.dtype)
+
+    def _final_norm(self, params, x):
+        return self._ln(x, params["norm"], self.cfg.layer_norm_epsilon)
+
+    def _layer_step(self, x, lp, ck, cv, tables, positions, flat_idx,
+                    kv_len):
+        """Parallel residual (falcon-7b): x + attn(h) + mlp(h) with ONE
+        shared input LayerNorm h."""
+        cfg = self.cfg
+        h = self._ln(x, lp["input_layernorm"], cfg.layer_norm_epsilon)
+        latent = h if self.capture_latents else jnp.zeros(
+            (x.shape[0], x.shape[1], 0), h.dtype)
+        q, k, v = self._qkv(lp, h, positions)
+        ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
+        attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
+        attn = attn @ lp["self_attn"]["o_proj"]["kernel"]
+        up = h @ lp["dense_h_to_4h"]["kernel"]
+        mlp = jax.nn.gelu(up) @ lp["dense_4h_to_h"]["kernel"]
+        x = x + attn + mlp
+        return x.astype(cfg.compute_dtype), ck, cv, latent

@@ -102,14 +102,21 @@ class PagedGPT2Model:
         B, T, Hq, D = q.shape
         attn = paged_attention(q, ck, cv, tables, positions[:, 0], kv_len,
                                self.block_size).reshape(B, T, Hq * D)
-        x = x + attn @ lp["attn"]["c_proj"]["kernel"] + \
-            lp["attn"]["c_proj"]["bias"]
+        x = x + self._attn_proj(lp, attn)
         h2 = self._ln(x, lp["ln_2"], eps)
+        x = x + self._mlp_out(lp, h2)
+        return x.astype(self.cfg.compute_dtype), ck, cv, latent
+
+    def _attn_proj(self, lp, attn):
+        p = lp["attn"]["c_proj"]
+        return attn @ p["kernel"] + p["bias"]
+
+    def _mlp_out(self, lp, h2):
+        """GELU MLP; the OPT family overrides with ReLU fc1/fc2."""
         ff = jax.nn.gelu(h2 @ lp["mlp"]["c_fc"]["kernel"] +
                          lp["mlp"]["c_fc"]["bias"], approximate=True)
-        x = x + ff @ lp["mlp"]["c_proj"]["kernel"] + \
+        return ff @ lp["mlp"]["c_proj"]["kernel"] + \
             lp["mlp"]["c_proj"]["bias"]
-        return x.astype(self.cfg.compute_dtype), ck, cv, latent
 
     # -------------------------------------------------------------- #
     def _forward_chunk(self, params, cache_k, cache_v, tokens, start,

@@ -1,0 +1,88 @@
+"""Paged-KV serving for the Phi family.
+
+Reference analog: the phi policy in
+``deepspeed/inference/v2/engine_factory.py:69`` +
+``model_implementations/phi/``. Builds on the falcon parallel-block
+serving model; adds partial rotary (only ``rotary_dim`` features
+rotate), biased q/k/v/dense/fc projections, and the biased untied LM
+head.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models.phi import PhiConfig, partial_rope
+from ..ops.rope import rope_frequencies
+from .model import stack_layer_params
+from .model_falcon import PagedFalconModel
+
+
+class PagedPhiModel(PagedFalconModel):
+    def __init__(self, cfg: PhiConfig, params, **kw):
+        if not isinstance(cfg, PhiConfig):
+            raise TypeError("PagedPhiModel needs a PhiConfig")
+        # skip PagedFalconModel's FalconConfig check, keep its TP guard
+        if kw.get("topology") is not None and \
+                kw["topology"].tensor_size > 1:
+            raise NotImplementedError(
+                "tensor-parallel serving is implemented for the llama "
+                "family; phi serves single-chip / data-parallel")
+        super(PagedFalconModel, self).__init__(cfg, params, **kw)
+        # rope tables over the rotated slice only
+        self.cos, self.sin = rope_frequencies(cfg.rotary_dim,
+                                              cfg.max_positions,
+                                              cfg.rope_theta)
+
+    def load_params(self, params):
+        new = {
+            "embed": params["embed_tokens"]["embedding"],
+            "norm": {k: params["final_layernorm"][k]
+                     for k in ("scale", "bias")},
+            "lm_head": {k: params["lm_head"][k]
+                        for k in ("kernel", "bias")},
+            "layers": stack_layer_params(params, self.cfg.n_layer),
+        }
+
+        def cast(path, p):
+            p = jnp.asarray(p)
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            return p.astype(self.cfg.compute_dtype)
+        self.params = jax.tree_util.tree_map_with_path(cast, new)
+
+    def _qkv(self, lp, h, positions):
+        cfg = self.cfg
+        B, T, _ = h.shape
+        H, D = cfg.n_head, cfg.head_dim
+        a = lp["self_attn"]
+        q = (h @ a["q_proj"]["kernel"] +
+             a["q_proj"]["bias"]).reshape(B, T, H, D)
+        k = (h @ a["k_proj"]["kernel"] +
+             a["k_proj"]["bias"]).reshape(B, T, H, D)
+        v = (h @ a["v_proj"]["kernel"] +
+             a["v_proj"]["bias"]).reshape(B, T, H, D)
+        q = partial_rope(q, self.cos, self.sin, positions,
+                         rotary_dim=cfg.rotary_dim)
+        k = partial_rope(k, self.cos, self.sin, positions,
+                         rotary_dim=cfg.rotary_dim)
+        return q, k, v
+
+    def _layer_step(self, x, lp, ck, cv, tables, positions, flat_idx,
+                    kv_len):
+        cfg = self.cfg
+        h = self._ln(x, lp["input_layernorm"], cfg.layer_norm_epsilon)
+        latent = h if self.capture_latents else jnp.zeros(
+            (x.shape[0], x.shape[1], 0), h.dtype)
+        q, k, v = self._qkv(lp, h, positions)
+        ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
+        attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
+        d = lp["self_attn"]["dense"]
+        attn = attn @ d["kernel"] + d["bias"]
+        up = h @ lp["fc1"]["kernel"] + lp["fc1"]["bias"]
+        mlp = jax.nn.gelu(up) @ lp["fc2"]["kernel"] + lp["fc2"]["bias"]
+        x = x + attn + mlp
+        return x.astype(cfg.compute_dtype), ck, cv, latent
+
+    def _head_logits(self, params, last):
+        head = params["lm_head"]
+        return (last @ head["kernel"] + head["bias"]).astype(jnp.float32)

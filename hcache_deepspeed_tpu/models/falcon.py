@@ -1,0 +1,120 @@
+"""Falcon model family (tiiuae/falcon-*) in flax.linen.
+
+Reference analog: the falcon policy in
+``deepspeed/inference/v2/engine_factory.py:69`` +
+``model_implementations/falcon/``. Falcon-7B-style architecture:
+**parallel** attention + MLP branches off ONE shared input LayerNorm
+(``x + attn(ln(x)) + mlp(ln(x))``), rotary embeddings, multi-query /
+grouped-query attention, GELU MLP, no projection biases, tied LM head.
+
+Deviation from the HF layout, on purpose: HF falcon fuses q/k/v into a
+single ``query_key_value`` with group-striped interleaving; here the
+projections are separate ``q_proj/k_proj/v_proj`` (the TPU-friendly
+layout the rest of the zoo uses — converting an HF checkpoint is a
+de-stripe + split, not a math change). Attention itself reuses
+:class:`~.llama.LlamaAttention` (rope + GQA + flash).
+"""
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .gpt2 import causal_lm_loss, default_lm_labels
+from .llama import LlamaAttention
+
+
+@dataclass(frozen=True)
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    n_layer: int = 32
+    n_head: int = 71
+    n_kv_head: int = 1             # falcon-7b is MQA; 40b/180b GQA
+    max_positions: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: str = "float32"
+    remat: bool = False
+    use_flash: bool = True
+    attention_bias: bool = False   # LlamaAttention contract
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_head
+
+    @property
+    def ffn_dim(self):
+        return 4 * self.hidden_size
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def falcon_7b(**kw):
+    defaults = dict(dtype="bfloat16", remat=True)
+    defaults.update(kw)
+    return FalconConfig(**defaults)
+
+
+def falcon_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+                    n_kv_head=1, max_positions=128)
+    defaults.update(kw)
+    return FalconConfig(**defaults)
+
+
+class FalconBlock(nn.Module):
+    """Parallel residual: both branches read the same normed input, so
+    the block has ONE LayerNorm (falcon-7b ``parallel_attn`` +
+    ``num_ln_in_parallel_attn=1``)."""
+    cfg: FalconConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=x.dtype,
+                         name="input_layernorm")(x)
+        attn = LlamaAttention(cfg, name="self_attn")(h, train)
+        up = nn.Dense(cfg.ffn_dim, use_bias=False, dtype=x.dtype,
+                      name="dense_h_to_4h")(h)
+        mlp = nn.Dense(cfg.hidden_size, use_bias=False, dtype=x.dtype,
+                       name="dense_4h_to_h")(nn.gelu(up))
+        return x + attn + mlp
+
+
+class FalconForCausalLM(nn.Module):
+    """Same batch contract as the rest of the model zoo."""
+    cfg: FalconConfig
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False,
+                 return_logits: bool = False):
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        dtype = cfg.compute_dtype
+
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                         name="embed_tokens")
+        x = embed(ids)
+        block = FalconBlock
+        if cfg.remat:
+            block = nn.remat(FalconBlock, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"layers_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
+                         name="ln_f")(x)
+
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=dtype,
+                              name="lm_head")(x)
+        if return_logits:
+            return logits
+        labels = batch.get("labels")
+        if labels is None:
+            labels = default_lm_labels(ids)
+        return causal_lm_loss(logits, labels)

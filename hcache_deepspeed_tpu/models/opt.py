@@ -1,0 +1,143 @@
+"""OPT model family (facebook/opt-*) in flax.linen.
+
+Reference analog: the OPT kernel-injection policy
+(``deepspeed/module_inject/containers/opt.py``) and the v2 engine
+factory's opt mapping (``inference/v2/engine_factory.py:69``,
+``model_implementations/opt/``). Architecture (pre-norm variants,
+opt-1.3b+): LayerNorm, learned position embeddings with the OPT +2
+offset, separate biased q/k/v/out projections, ReLU fc1/fc2 MLP, tied
+LM head. Param names mirror the HF layout (``self_attn.q_proj``,
+``fc1``, ``self_attn_layer_norm``, ``final_layer_norm``) so trained
+checkpoints map one-to-one.
+"""
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import attention as flash_attention
+from .gpt2 import causal_lm_loss, default_lm_labels
+
+#: OPT reserves the first two rows of the position table (HF
+#: OPTLearnedPositionalEmbedding hard-codes the same constant)
+POSITION_OFFSET = 2
+
+
+@dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    n_layer: int = 12
+    n_head: int = 12
+    max_positions: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    dtype: str = "float32"
+    remat: bool = False
+    use_flash: bool = True
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    # engine-facing aliases (ragged engine generic surface)
+    @property
+    def n_kv_head(self):
+        return self.n_head
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_head
+
+    @property
+    def n_embd(self):
+        return self.hidden_size
+
+
+def opt_125m(**kw):
+    return OPTConfig(**kw)
+
+
+def opt_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, ffn_dim=128,
+                    n_layer=2, n_head=4, max_positions=128)
+    defaults.update(kw)
+    return OPTConfig(**defaults)
+
+
+class OPTAttention(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+        q = nn.Dense(C, dtype=x.dtype, name="q_proj")(x)
+        k = nn.Dense(C, dtype=x.dtype, name="k_proj")(x)
+        v = nn.Dense(C, dtype=x.dtype, name="v_proj")(x)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        if cfg.use_flash:
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            from ..ops.flash_attention import reference_attention
+            y = reference_attention(q, k, v, causal=True)
+        return nn.Dense(C, dtype=x.dtype,
+                        name="out_proj")(y.reshape(B, T, C))
+
+
+class OPTBlock(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        eps = cfg.layer_norm_epsilon
+        h = nn.LayerNorm(epsilon=eps, dtype=x.dtype,
+                         name="self_attn_layer_norm")(x)
+        x = x + OPTAttention(cfg, name="self_attn")(h, train)
+        h = nn.LayerNorm(epsilon=eps, dtype=x.dtype,
+                         name="final_layer_norm")(x)
+        h = nn.relu(nn.Dense(cfg.ffn_dim, dtype=x.dtype, name="fc1")(h))
+        return x + nn.Dense(cfg.hidden_size, dtype=x.dtype,
+                            name="fc2")(h)
+
+
+class OPTForCausalLM(nn.Module):
+    """Same batch contract as GPT2LMHeadModel / LlamaForCausalLM."""
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False,
+                 return_logits: bool = False):
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        dtype = cfg.compute_dtype
+
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                         name="embed_tokens")
+        pos = nn.Embed(cfg.max_positions + POSITION_OFFSET,
+                       cfg.hidden_size, dtype=dtype,
+                       name="embed_positions")
+        x = embed(ids) + pos(jnp.arange(T)[None, :] + POSITION_OFFSET)
+
+        block = OPTBlock
+        if cfg.remat:
+            block = nn.remat(OPTBlock, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"layers_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
+                         name="final_layer_norm")(x)
+
+        logits = embed.attend(x)   # OPT ties the LM head
+        if return_logits:
+            return logits
+        labels = batch.get("labels")
+        if labels is None:
+            labels = default_lm_labels(ids)
+        return causal_lm_loss(logits, labels)

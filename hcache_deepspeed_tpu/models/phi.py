@@ -1,0 +1,149 @@
+"""Phi model family (microsoft/phi-1 / phi-1.5 / phi-2) in flax.linen.
+
+Reference analog: the phi policy in
+``deepspeed/inference/v2/engine_factory.py:69`` +
+``model_implementations/phi/``. Architecture: parallel attention + MLP
+branches off one shared input LayerNorm, **partial** rotary embeddings
+(only the first ``rotary_dim`` of each head is rotated), biased
+q/k/v/dense projections, biased GELU fc1/fc2 MLP, final LayerNorm, and
+an untied LM head **with bias**.
+"""
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.flash_attention import attention as flash_attention
+from ..ops.rope import apply_rope, rope_frequencies
+from .gpt2 import causal_lm_loss, default_lm_labels
+
+
+@dataclass(frozen=True)
+class PhiConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 2560
+    intermediate_size: int = 10240
+    n_layer: int = 32
+    n_head: int = 32
+    max_positions: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    rope_theta: float = 10000.0
+    partial_rotary_factor: float = 0.4
+    dtype: str = "float32"
+    remat: bool = False
+    use_flash: bool = True
+    tie_word_embeddings: bool = False   # phi's head is untied (+ bias)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_head
+
+    @property
+    def rotary_dim(self):
+        # HF: int(partial_rotary_factor * head_dim), rounded to even
+        rd = int(self.partial_rotary_factor * self.head_dim)
+        return rd - rd % 2
+
+    @property
+    def n_kv_head(self):
+        return self.n_head   # MHA
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def phi_2(**kw):
+    defaults = dict(dtype="bfloat16", remat=True)
+    defaults.update(kw)
+    return PhiConfig(**defaults)
+
+
+def phi_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    n_layer=2, n_head=4, max_positions=128,
+                    partial_rotary_factor=0.5)
+    defaults.update(kw)
+    return PhiConfig(**defaults)
+
+
+def partial_rope(x, cos, sin, positions=None, rotary_dim=None):
+    """Rotate the first ``rotary_dim`` features of each head, pass the
+    rest through (HF PhiAttention's rotary slice)."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    rot = apply_rope(rot, cos, sin, positions)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+class PhiAttention(nn.Module):
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+        q = nn.Dense(C, dtype=x.dtype, name="q_proj")(x)
+        k = nn.Dense(C, dtype=x.dtype, name="k_proj")(x)
+        v = nn.Dense(C, dtype=x.dtype, name="v_proj")(x)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_positions,
+                                    cfg.rope_theta)
+        q = partial_rope(q, cos, sin, rotary_dim=cfg.rotary_dim)
+        k = partial_rope(k, cos, sin, rotary_dim=cfg.rotary_dim)
+        if cfg.use_flash:
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            from ..ops.flash_attention import reference_attention
+            y = reference_attention(q, k, v, causal=True)
+        return nn.Dense(C, dtype=x.dtype, name="dense")(
+            y.reshape(B, T, C))
+
+
+class PhiBlock(nn.Module):
+    """Parallel residual off one shared LayerNorm (HF PhiDecoderLayer)."""
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=x.dtype,
+                         name="input_layernorm")(x)
+        attn = PhiAttention(cfg, name="self_attn")(h, train)
+        up = nn.Dense(cfg.intermediate_size, dtype=x.dtype, name="fc1")(h)
+        mlp = nn.Dense(cfg.hidden_size, dtype=x.dtype,
+                       name="fc2")(nn.gelu(up))
+        return x + attn + mlp
+
+
+class PhiForCausalLM(nn.Module):
+    cfg: PhiConfig
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False,
+                 return_logits: bool = False):
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        dtype = cfg.compute_dtype
+
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=dtype,
+                     name="embed_tokens")(ids)
+        block = PhiBlock
+        if cfg.remat:
+            block = nn.remat(PhiBlock, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"layers_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype,
+                         name="final_layernorm")(x)
+
+        logits = nn.Dense(cfg.vocab_size, dtype=dtype,
+                          name="lm_head")(x)   # biased, untied
+        if return_logits:
+            return logits
+        labels = batch.get("labels")
+        if labels is None:
+            labels = default_lm_labels(ids)
+        return causal_lm_loss(logits, labels)

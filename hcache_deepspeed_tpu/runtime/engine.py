@@ -171,13 +171,33 @@ class HDSEngine:
 
         # ---- topology (reference: groups wiring, engine.py:1242-1308) ----
         if topology is None:
-            spec = TopologySpec(pipe=config.mesh.pipe, data=config.mesh.data,
-                                expert=config.mesh.expert,
-                                seq=max(config.mesh.seq,
-                                        config.sequence_parallel_size),
-                                tensor=config.mesh.tensor,
-                                zero=config.mesh.zero)
-            topology = initialize_topology(spec)
+            from ..parallel import topology as topo_mod
+            default_mesh = (config.mesh.pipe == config.mesh.expert ==
+                            config.mesh.tensor == config.mesh.zero == 1
+                            and config.mesh.data == -1
+                            and max(config.mesh.seq,
+                                    config.sequence_parallel_size) == 1)
+            existing = topo_mod._topology
+            user_initialized = existing is not None and not getattr(
+                existing, "_engine_owned", False)
+            if user_initialized and default_mesh:
+                # a USER-initialized topology (initialize_topology /
+                # tp_model_init) wins over a config that doesn't ask for
+                # any parallel axes — the reference's mpu-precedence rule
+                # (groups.py: supplied mpu overrides config groups). A
+                # topology a previous engine derived from ITS config must
+                # not leak into this one (hence the ownership flag).
+                topology = existing
+            else:
+                spec = TopologySpec(pipe=config.mesh.pipe,
+                                    data=config.mesh.data,
+                                    expert=config.mesh.expert,
+                                    seq=max(config.mesh.seq,
+                                            config.sequence_parallel_size),
+                                    tensor=config.mesh.tensor,
+                                    zero=config.mesh.zero)
+                topology = initialize_topology(spec)
+                topology._engine_owned = True
         self.topology = topology
         self.mesh = topology.mesh
 

@@ -1,0 +1,115 @@
+"""Phi family (parallel block, partial rotary, biased head) through the
+ragged engine (reference: the phi policy in engine_factory.py:69 /
+model_implementations/phi/)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig,
+                                            build_hf_engine)
+from hcache_deepspeed_tpu.inference.model_phi import PagedPhiModel
+from hcache_deepspeed_tpu.models.phi import PhiForCausalLM, phi_tiny
+
+
+@pytest.fixture(scope="module")
+def tiny_phi():
+    cfg = phi_tiny(use_flash=False)
+    model = PhiForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    return cfg, model, params
+
+
+def make_engine(cfg, params):
+    return InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8, "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24,
+                      "cache_dtype": "float32"}))
+
+
+def full_logits(model, params, tokens):
+    out = model.apply({"params": params},
+                      {"input_ids": np.asarray(tokens, np.int32)[None]},
+                      train=False, return_logits=True)
+    return np.asarray(out)[0]
+
+
+class TestPhiPagedInference:
+
+    def test_engine_selects_phi_model(self, tiny_phi):
+        cfg, _, params = tiny_phi
+        engine = make_engine(cfg, params)
+        assert isinstance(engine.model, PagedPhiModel)
+
+    def test_rotary_dim_is_partial(self, tiny_phi):
+        cfg, _, _ = tiny_phi
+        assert 0 < cfg.rotary_dim < cfg.head_dim
+
+    def test_training_model_trains(self, tiny_phi):
+        cfg, model, params = tiny_phi
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (2, 16),
+                                           dtype=np.int32)}
+
+        def loss_fn(p):
+            return model.apply({"params": p}, batch, train=True)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree.leaves(grads))
+
+    def test_prefill_decode_parity(self, tiny_phi):
+        cfg, model, params = tiny_phi
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(1)
+        tokens = list(rng.integers(0, cfg.vocab_size, (11,)))
+        logits, _ = engine.put([1], [tokens])
+        np.testing.assert_allclose(logits[0],
+                                   full_logits(model, params, tokens)[-1],
+                                   atol=2e-2)
+        for _ in range(4):
+            nxt = int(np.argmax(logits[0]))
+            tokens.append(nxt)
+            logits, _ = engine.put([1], [[nxt]])
+            np.testing.assert_allclose(
+                logits[0], full_logits(model, params, tokens)[-1],
+                atol=2e-2)
+
+    def test_restore_equals_recompute(self, tiny_phi):
+        cfg, model, params = tiny_phi
+        rng = np.random.default_rng(2)
+        prompt = list(rng.integers(0, cfg.vocab_size, (9,)))
+        engine_a = make_engine(cfg, params)
+        logits_a, latents = engine_a.put([1], [prompt])
+        nxt = int(np.argmax(logits_a[0]))
+        dec_a, _ = engine_a.put([1], [[nxt]])
+
+        engine_b = make_engine(cfg, params)
+        engine_b.restore_kv([1], [prompt], [latents[0]])
+        dec_b, _ = engine_b.put([1], [[nxt]])
+        np.testing.assert_allclose(dec_b[0], dec_a[0], atol=2e-2)
+
+    def test_hf_factory_phi(self, tiny_phi):
+        cfg, _, params = tiny_phi
+        hf = {"model_type": "phi", "vocab_size": cfg.vocab_size,
+              "hidden_size": cfg.hidden_size,
+              "intermediate_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.n_layer,
+              "num_attention_heads": cfg.n_head,
+              "max_position_embeddings": cfg.max_positions,
+              "partial_rotary_factor": cfg.partial_rotary_factor,
+              "torch_dtype": "float32"}
+        engine = build_hf_engine(
+            hf, params,
+            engine_config=RaggedInferenceEngineConfig(
+                state_manager={"max_tracked_sequences": 4,
+                               "max_context": 128},
+                kv_cache={"block_size": 16, "num_blocks": 24}))
+        assert isinstance(engine.model, PagedPhiModel)
+        logits, _ = engine.put([1], [[1, 2, 3]])
+        assert np.isfinite(np.asarray(logits)).all()
