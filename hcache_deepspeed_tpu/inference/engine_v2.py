@@ -90,7 +90,7 @@ class InferenceEngineV2:
             model_config, params, block_size=self.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,
             capture_latents=self.config.hcache.enable_latents,
-            topology=topology)
+            topology=topology, quantization=self.config.quantization)
         self.cache = BlockedKVCache(
             model_config.n_layer, num_blocks, self.block_size,
             model_config.n_kv_head, model_config.head_dim,

@@ -46,6 +46,35 @@ from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.topology import TENSOR_AXIS
 
 
+def maybe_quantize_serving_params(tree, quantization):
+    """Weight-only int quantization of a serving param tree (reference:
+    ``deepspeed/inference/quantization`` — v1's int8 QuantLinear).
+    Routers and embedding tables keep full precision (the embedding
+    doubles as the tied LM head; the fp32 router picks experts). The
+    stacked per-layer weights quantize with layer-aligned groups so the
+    compiled layer loop dequantizes ONE layer at a time — resident
+    weights stay int8."""
+    if not quantization:
+        return tree
+    from ..ops.quantizer import quantize_tree
+
+    def segs(path):
+        return ["%s" % getattr(k, "key", k) for k in path]
+
+    def skip(path):
+        joined = "/".join(segs(path))
+        return "wg" in joined or "embed" in joined or "wte" in joined \
+            or "wpe" in joined
+
+    def batched(path):
+        s = segs(path)
+        return bool(s) and s[0] == "layers"
+    return quantize_tree(tree, group_size=quantization.group_size,
+                         num_bits=quantization.bits,
+                         min_size=quantization.min_size, skip=skip,
+                         batched=batched)
+
+
 def stack_layer_params(params: Dict[str, Any], n_layers: int,
                        prefix: str = "layers_"):
     """[per-layer dicts] -> one pytree with leading layer dim (scan xs)."""
@@ -61,7 +90,7 @@ class PagedInferenceModel:
 
     def __init__(self, cfg: LlamaConfig, params, *, block_size: int,
                  max_blocks_per_seq: int, capture_latents: bool = True,
-                 topology=None):
+                 topology=None, quantization=None):
         self.cfg = cfg
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
@@ -69,6 +98,12 @@ class PagedInferenceModel:
         self.n_layers = cfg.n_layer
         self.topology = topology
         self.tp = topology.tensor_size if topology is not None else 1
+        self.quantization = quantization if (
+            quantization is not None and quantization.enabled) else None
+        if self.quantization and self.tp > 1:
+            raise NotImplementedError(
+                "weight-only quantized serving is single-chip/DP for "
+                "now (the TP spec tree maps full-precision leaves)")
 
         self.tied = cfg.tie_word_embeddings
         if self.tp > 1:
@@ -105,9 +140,13 @@ class PagedInferenceModel:
                 return p.astype(jnp.float32)
             return p.astype(self.cfg.compute_dtype)
         new = jax.tree_util.tree_map_with_path(cast, new)
+        new = self._maybe_quantize(new)
         if self.tp > 1:
             new = jax.device_put(new, self._param_shardings_for(new))
         self.params = new
+
+    def _maybe_quantize(self, tree):
+        return maybe_quantize_serving_params(tree, self.quantization)
 
     @staticmethod
     def _keep_fp32(path) -> bool:
@@ -291,6 +330,12 @@ class PagedInferenceModel:
         """tokens: [B, T] int32; start: [B] first absolute position;
         tables: [B, NB]; t_len: [B] valid new tokens (≤ T).
         Returns (cache_k', cache_v', logits [B, V], latents [L, B, T, H])."""
+        from ..ops.quantizer import dequantize_tree
+        # non-layer leaves (head) dequantize here; the stacked layers stay
+        # int8 and dequantize ONE layer at a time inside the scan step —
+        # resident HBM holds int8 weights + one bf16 layer, not L of them
+        params = {k: (v if k == "layers" else dequantize_tree(v))
+                  for k, v in params.items()}
         B, T = tokens.shape
         BS = self.block_size
         P = cache_k.shape[1]
@@ -307,6 +352,7 @@ class PagedInferenceModel:
 
         def step(x, xs):
             lp, ck, cv = xs
+            lp = dequantize_tree(lp)   # one layer's weights only
             x, ck, cv, latent = self._layer_step(
                 x, lp, ck, cv, tables, positions, flat_idx, kv_len)
             return x, (ck, cv, latent)
@@ -372,7 +418,12 @@ class PagedInferenceModel:
         updates layer ``layer`` in place; the layer's weights are sliced
         from the stacked tree *inside* the compiled program (no per-call
         host-side slicing)."""
+        from ..ops.quantizer import dequantize_tree
+        # slice THEN dequantize: batched QuantizedTensors slice their
+        # leading dim through tree.map, so only this layer's weights are
+        # ever materialized full-precision
         lp = jax.tree.map(lambda p: p[layer], params["layers"])
+        lp = dequantize_tree(lp)
         B, T, _ = latent.shape
         BS = self.block_size
         P = cache_k.shape[1]

@@ -47,7 +47,8 @@ class PagedFalconModel(PagedInferenceModel):
             if not jnp.issubdtype(p.dtype, jnp.floating):
                 return p
             return p.astype(self.cfg.compute_dtype)
-        self.params = jax.tree_util.tree_map_with_path(cast, new)
+        self.params = self._maybe_quantize(
+            jax.tree_util.tree_map_with_path(cast, new))
 
     @staticmethod
     def _ln(x, p, eps):

@@ -29,7 +29,7 @@ from .model import stack_layer_params
 class PagedGPT2Model:
     def __init__(self, cfg: GPT2Config, params, *, block_size: int,
                  max_blocks_per_seq: int, capture_latents: bool = True,
-                 topology=None):
+                 topology=None, quantization=None):
         if topology is not None and topology.tensor_size > 1:
             raise NotImplementedError(
                 "tensor-parallel serving is implemented for the llama "
@@ -41,6 +41,8 @@ class PagedGPT2Model:
         self.n_layers = cfg.n_layer
         self.topology = topology
         self.tp = 1
+        self.quantization = quantization if (
+            quantization is not None and quantization.enabled) else None
 
         self.load_params(params)
         self._fwd = jax.jit(self._forward_chunk, donate_argnums=(1, 2))
@@ -51,13 +53,14 @@ class PagedGPT2Model:
         hybrid engine's per-phase refresh contract (see
         PagedInferenceModel.load_params). Shapes unchanged ⇒ compiled
         functions are reused."""
-        self.params = {
+        from .model import maybe_quantize_serving_params
+        self.params = maybe_quantize_serving_params({
             "wte": params["wte"]["embedding"],
             "wpe": params["wpe"]["embedding"],
             "ln_f": {k: params["ln_f"][k] for k in ("scale", "bias")},
             "layers": stack_layer_params(params, self.cfg.n_layer,
                                          prefix="h_"),
-        }
+        }, self.quantization)
 
     def cache_sharding(self):
         return None
@@ -121,6 +124,10 @@ class PagedGPT2Model:
     # -------------------------------------------------------------- #
     def _forward_chunk(self, params, cache_k, cache_v, tokens, start,
                        tables, t_len):
+        from ..ops.quantizer import dequantize_tree
+        # stacked layers stay int8; each scan step dequantizes one layer
+        params = {k: (v if k == "layers" else dequantize_tree(v))
+                  for k, v in params.items()}
         B, T = tokens.shape
         BS = self.block_size
         P = cache_k.shape[1]
@@ -138,6 +145,7 @@ class PagedGPT2Model:
 
         def step(x, xs):
             lp, ck, cv = xs
+            lp = dequantize_tree(lp)   # one layer's weights only
             x, ck, cv, latent = self._layer_step(
                 x, lp, ck, cv, tables, positions, flat_idx, kv_len)
             return x, (ck, cv, latent)
@@ -162,7 +170,9 @@ class PagedGPT2Model:
     # -------------------------------------------------------------- #
     def _restore_layer(self, params, cache_k, cache_v, layer, latent,
                        start, tables, t_len):
+        from ..ops.quantizer import dequantize_tree
         lp = jax.tree.map(lambda p: p[layer], params["layers"])
+        lp = dequantize_tree(lp)   # slice then dequantize: one layer
         B, T, _ = latent.shape
         BS = self.block_size
         P = cache_k.shape[1]

@@ -32,9 +32,10 @@ class PagedOPTModel(PagedGPT2Model):
         semantics coincide (ln_1 := self_attn_layer_norm, ln_2 :=
         per-layer final_layer_norm); attention/MLP weights keep their
         OPT names and are consumed by the overridden hooks below."""
+        from .model import maybe_quantize_serving_params
         layers = stack_layer_params(params, self.cfg.n_layer,
                                     prefix="layers_")
-        self.params = {
+        self.params = maybe_quantize_serving_params({
             "wte": params["embed_tokens"]["embedding"],
             # slice the reserved rows: trunk positions index from 0
             "wpe": params["embed_positions"]["embedding"][POSITION_OFFSET:],
@@ -46,7 +47,7 @@ class PagedOPTModel(PagedGPT2Model):
                 "attn": layers["self_attn"],
                 "mlp": {"fc1": layers["fc1"], "fc2": layers["fc2"]},
             },
-        }
+        }, self.quantization)
 
     def _qkv(self, lp, h):
         cfg = self.cfg

@@ -48,7 +48,8 @@ class PagedPhiModel(PagedFalconModel):
             if not jnp.issubdtype(p.dtype, jnp.floating):
                 return p
             return p.astype(self.cfg.compute_dtype)
-        self.params = jax.tree_util.tree_map_with_path(cast, new)
+        self.params = self._maybe_quantize(
+            jax.tree_util.tree_map_with_path(cast, new))
 
     def _qkv(self, lp, h, positions):
         cfg = self.cfg

@@ -88,3 +88,103 @@ dequantize = reference_dequantize
 
 register_op("quantize", reference_quantize, pallas_quantize)
 register_op("dequantize", reference_dequantize)
+
+
+# ------------------------------------------------------------------ #
+# Weight-only quantization container (reference:
+# deepspeed/inference/quantization — v1's QuantLinear keeps int8 weights
+# and dequantizes in forward; here a pytree node so quantized params
+# flow through jit and dequantize inside the compiled program)
+# ------------------------------------------------------------------ #
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Groupwise-int-quantized weight: (q int8, scale f32) children with
+    static (shape, n, dtype) aux — drop-in pytree leaf replacement.
+
+    Two layouts:
+    * flat — q ``[G, group]``: one tensor, ``shape``/``n`` describe it.
+    * batched — q ``[L, G, group]``: a stack of L per-layer tensors with
+      layer-aligned groups, so slicing the leading dim (``lax.scan`` xs,
+      ``x[layer]``) yields a valid flat QuantizedTensor of one layer —
+      the property the serving models rely on to dequantize per layer
+      inside the compiled loop instead of materializing all layers.
+      ``shape``/``n`` describe the PER-LAYER tensor.
+    """
+
+    def __init__(self, q, scale, shape, n, dtype):
+        self.q, self.scale = q, scale
+        self.shape, self.n = tuple(shape), int(n)
+        self.dtype = dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.shape, self.n, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    def dequantize(self):
+        if self.q.ndim == 3:   # batched [L, G, group]
+            L = self.q.shape[0]
+            out = (self.q.astype(jnp.float32) * self.scale).reshape(L, -1)
+            return out[:, :self.n].reshape((L,) + self.shape).astype(
+                self.dtype)
+        return dequantize(self.q, self.scale, self.shape,
+                          self.n).astype(self.dtype)
+
+    @classmethod
+    def make(cls, x, group_size=256, num_bits=8):
+        q, scale, shape, n = quantize(x, group_size=group_size,
+                                      num_bits=num_bits)
+        return cls(q, scale, shape, n, x.dtype)
+
+    @classmethod
+    def make_batched(cls, x, group_size=256, num_bits=8):
+        """Quantize a stacked ``[L, ...]`` weight with groups that never
+        straddle layer boundaries. Returns None when the per-layer size
+        is not a group multiple (caller keeps the leaf unquantized)."""
+        L = x.shape[0]
+        per_shape = x.shape[1:]
+        n = 1
+        for d in per_shape:
+            n *= d
+        if n % group_size:
+            return None
+        # per-layer sizes are group multiples, so flat groups align with
+        # layers and a plain quantize produces layer-pure groups
+        q, scale, _, _ = quantize(x, group_size=group_size,
+                                  num_bits=num_bits)
+        G = n // group_size
+        return cls(q.reshape(L, G, group_size), scale.reshape(L, G, 1),
+                   per_shape, n, x.dtype)
+
+
+def quantize_tree(tree, *, group_size=256, num_bits=8, min_size=4096,
+                  skip=lambda path: False,
+                  batched=lambda path: False):
+    """Replace every large floating matmul-weight leaf (ndim >= 2) with a
+    :class:`QuantizedTensor`. ``skip(path)`` exempts leaves (routers,
+    norms...); ``batched(path)`` marks stacked ``[L, ...]`` leaves that
+    must keep a sliceable leading dim."""
+    def one(path, leaf):
+        leaf = jnp.asarray(leaf)
+        if (leaf.ndim < 2 or leaf.size < min_size
+                or not jnp.issubdtype(leaf.dtype, jnp.floating)
+                or skip(path)):
+            return leaf
+        if batched(path):
+            qt = QuantizedTensor.make_batched(leaf, group_size=group_size,
+                                              num_bits=num_bits)
+            return leaf if qt is None else qt
+        return QuantizedTensor.make(leaf, group_size=group_size,
+                                    num_bits=num_bits)
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def dequantize_tree(tree):
+    """Inverse of :func:`quantize_tree`; no-op on unquantized trees.
+    Called at the top of a jitted forward so XLA streams the dequant
+    into the consuming matmuls."""
+    return jax.tree.map(
+        lambda x: x.dequantize() if isinstance(x, QuantizedTensor) else x,
+        tree, is_leaf=lambda x: isinstance(x, QuantizedTensor))
