@@ -290,72 +290,93 @@ class InferenceEngineV2:
             p /= p.sum()
             return int(rng.choice(len(p), p=p))
 
-        def run_wave(wave):
-            """Prefill + decode one admitted wave to completion."""
-            try:
-                step_logits, _ = self.put([uids[i] for i in wave],
-                                          [prompts[i] for i in wave])
-                cur = {i: step_logits[j] for j, i in enumerate(wave)}
-                active = list(wave)
-                while active:
-                    finished = []
-                    for i in active:
-                        tok = sample(cur[i])
-                        outs[i].append(tok)
-                        if return_logits:
-                            logit_trace[i].append(cur[i])
-                        if (eos_token_id is not None and
-                                tok == eos_token_id) or \
-                                len(outs[i]) >= max_new_tokens:
-                            finished.append(i)
-                    active = [i for i in active if i not in finished]
-                    if not active:
-                        break
-                    step_logits, _ = self.put(     # ragged decode
-                        [uids[i] for i in active],
-                        [[outs[i][-1]] for i in active])
-                    for j, i in enumerate(active):
-                        cur[i] = step_logits[j]
-            finally:
-                for i in wave:
-                    if self.state.get_sequence(uids[i]) is not None:
-                        self.flush(uids[i])
-
         outs = [[] for _ in prompts]
         logit_trace = [[] for _ in prompts]
-        # wave admission against the engine's own scheduling limits, so
-        # oversized request sets run in waves instead of raising
-        # SchedulingError. The per-forward budget (can_schedule) sees the
-        # PROMPT lengths (decodes are 1-token forwards); KV growth over
-        # the whole generation is budgeted against the free block pool.
-        for i, p in enumerate(prompts):
+        for p in prompts:
             if len(p) + max_new_tokens > self.max_context:
                 raise SchedulingError(
                     SchedulingResult.SequenceTokenLimitExceeded)
+
+        def need_blocks(i):
+            """Whole-generation KV budget, committed at admission."""
+            return -(-(len(prompts[i]) + max_new_tokens) //
+                     self.block_size) + 1
+
+        # Continuous batching (the FastGen scheduler semantics): every
+        # iteration admits whatever pending prompts still fit, then runs
+        # ONE ragged put() mixing their prefills with the active
+        # sequences' decodes; finished sequences flush mid-flight and
+        # their blocks let new prompts join without draining the batch.
         pending = list(range(len(prompts)))
-        while pending:
-            wave = []
-            blocks_left = self.state.allocator.free_blocks
-            for i in pending:
-                cand = wave + [i]
-                need = -(-(len(prompts[i]) + max_new_tokens) //
-                         self.block_size) + 1
-                if need > blocks_left:
-                    continue
-                lens = [len(prompts[j]) for j in cand]
-                if self.can_schedule([uids[j] for j in cand], lens) == \
-                        SchedulingResult.Success:
-                    wave.append(i)
-                    blocks_left -= need
-            if not wave:
-                # nothing fits even alone — surface the engine's verdict
-                i = pending[0]
-                result = self.can_schedule([uids[i]], [len(prompts[i])])
-                raise SchedulingError(
-                    result if result != SchedulingResult.Success
-                    else SchedulingResult.KVCacheLimitExceeded)
-            run_wave(wave)
-            pending = [i for i in pending if i not in wave]
+        active: List[int] = []
+        live: List[int] = []            # active + this step's admissions
+        reserved: Dict[int, int] = {}   # admission-time block commitment
+        cur: Dict[int, np.ndarray] = {}
+        headroom_changed = True   # admission can only change on finish
+        try:
+            while pending or active:
+                admit = []
+                if pending and headroom_changed:
+                    # headroom the still-running reservations hold back
+                    # (measured against the allocator's own state, not a
+                    # re-derivation of its policy)
+                    held = sum(
+                        reserved[i] - self.state.get_sequence(
+                            uids[i]).cur_allocated_blocks - 1
+                        for i in active)
+                    blocks_left = self.state.allocator.free_blocks - held
+                    for i in list(pending):
+                        cand = admit + [i]
+                        if need_blocks(i) > blocks_left:
+                            continue
+                        lens = [1] * len(active) + \
+                            [len(prompts[j]) for j in cand]
+                        uid_c = [uids[j] for j in active + cand]
+                        if self.can_schedule(uid_c, lens) == \
+                                SchedulingResult.Success:
+                            admit.append(i)
+                            blocks_left -= need_blocks(i)
+                headroom_changed = False
+                if not active and not admit:
+                    # nothing fits even alone — surface the verdict
+                    i = pending[0]
+                    result = self.can_schedule([uids[i]],
+                                               [len(prompts[i])])
+                    raise SchedulingError(
+                        result if result != SchedulingResult.Success
+                        else SchedulingResult.KVCacheLimitExceeded)
+
+                step = active + admit
+                live = step   # put() may allocate before raising
+                toks = [[outs[i][-1]] for i in active] + \
+                    [prompts[i] for i in admit]
+                step_logits, _ = self.put([uids[i] for i in step], toks)
+                for j, i in enumerate(step):
+                    cur[i] = step_logits[j]
+                for i in admit:
+                    reserved[i] = need_blocks(i)
+                pending = [i for i in pending if i not in admit]
+                active = step
+
+                finished = []
+                for i in active:
+                    tok = sample(cur[i])
+                    outs[i].append(tok)
+                    if return_logits:
+                        logit_trace[i].append(cur[i])
+                    if (eos_token_id is not None and
+                            tok == eos_token_id) or \
+                            len(outs[i]) >= max_new_tokens:
+                        finished.append(i)
+                for i in finished:
+                    self.flush(uids[i])
+                    reserved.pop(i, None)
+                    headroom_changed = True
+                active = [i for i in active if i not in finished]
+        finally:
+            for i in set(active) | set(live):
+                if self.state.get_sequence(uids[i]) is not None:
+                    self.flush(uids[i])
         if return_logits:
             return outs, [np.stack(t) if t else None for t in logit_trace]
         return outs
