@@ -312,7 +312,11 @@ class InferenceEngineV2:
         live: List[int] = []            # active + this step's admissions
         reserved: Dict[int, int] = {}   # admission-time block commitment
         cur: Dict[int, np.ndarray] = {}
-        headroom_changed = True   # admission can only change on finish
+        # admission headroom changes when a sequence finishes (KV blocks
+        # free) AND one step after any prefill (the ragged token budget
+        # that blocked a co-admission frees once the prefill becomes a
+        # 1-token decode)
+        headroom_changed = True
         try:
             while pending or active:
                 admit = []
@@ -336,7 +340,7 @@ class InferenceEngineV2:
                                 SchedulingResult.Success:
                             admit.append(i)
                             blocks_left -= need_blocks(i)
-                headroom_changed = False
+                headroom_changed = bool(admit)
                 if not active and not admit:
                     # nothing fits even alone — surface the verdict
                     i = pending[0]

@@ -81,8 +81,8 @@ def _ensure_loaded():
         return
     _loaded = True
     from . import (flash_attention, fp_quantizer,  # noqa: F401
-                   grouped_gemm, paged_attention, quantizer, rms_norm,
-                   rope)
+                   grouped_gemm, paged_attention, quantized_matmul,
+                   quantizer, rms_norm, rope)
 
 
 __all__ = ["register_op", "get_op", "get_op_impl", "op_report"]

@@ -1,0 +1,118 @@
+"""Fused int8-weight matmul: ``x @ dequant(q, scale)`` in one kernel.
+
+Reference analog: the weight-only-quantized linear path of the v1
+inference kernels (``deepspeed/inference/quantization`` +
+``csrc/quantization`` dequant kernels fused into the GEMM consumers).
+
+TPU form: the weight stays int8 in HBM; each grid step streams one
+``[block_k, block_n]`` int8 tile into VMEM, dequantizes it there
+(int8 -> compute dtype, times its per-group scales) and feeds the MXU —
+HBM traffic for weights is half of bf16, and no full-precision copy of
+the weight ever exists in HBM.
+
+Scale layout: per-(k-group, n) — ``scale[g, n]`` covers rows
+``g*group_k : (g+1)*group_k`` of column ``n`` (the groupwise layout
+``QuantizedTensor`` uses is flat; ``quantize_for_matmul`` below produces
+this 2D layout instead, which is what a matmul kernel can actually use).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import register_op
+
+
+def quantize_for_matmul(w, group_k=256, num_bits=8):
+    """w: [K, N] -> (q int8 [K, N], scale f32 [K//group_k, N]).
+    Groups run down the contraction dim so a [block_k, N] tile needs only
+    its own scale rows."""
+    K, N = w.shape
+    if K % group_k:
+        raise ValueError(f"K={K} not divisible by group_k={group_k}")
+    qmax = 2 ** (num_bits - 1) - 1
+    g = w.astype(jnp.float32).reshape(K // group_k, group_k, N)
+    scale = jnp.max(jnp.abs(g), axis=1) / qmax          # [G, N]
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(g / scale[:, None, :]), -qmax - 1,
+                 qmax).astype(jnp.int8).reshape(K, N)
+    return q, scale.astype(jnp.float32)
+
+
+def reference_quantized_matmul(x, q, scale, group_k=256):
+    """Numerics oracle: dequantize fully, then matmul."""
+    K, N = q.shape
+    w = q.astype(jnp.float32).reshape(K // group_k, group_k, N) \
+        * scale[:, None, :]
+    return x @ w.reshape(K, N).astype(x.dtype)
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, block_k, group_k):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    x = x_ref[0]                        # [block_m, block_k]
+    qt = q_ref[0]                       # [block_k, block_n] int8
+    s = s_ref[0]                        # [block_k//group_k, block_n]
+    # dequantize the weight tile in VMEM, then one MXU dot
+    w = qt.astype(x.dtype) * jnp.repeat(
+        s, group_k, axis=0, total_repeat_length=qt.shape[0]).astype(x.dtype)
+    acc[:] += jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _out():
+        o_ref[0] = acc[:].astype(o_ref.dtype)
+
+
+def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
+                            block_n=256, block_k=256, interpret=None):
+    """x: [M, K] (bf16/f32); q: [K, N] int8; scale: [K//group_k, N]."""
+    M, K = x.shape
+    K2, N = q.shape
+    assert K == K2
+    if interpret is None:
+        from ..platform import get_platform
+        interpret = not get_platform().supports_pallas()
+    block_m = min(block_m, M)
+    block_n = min(block_n, N)
+    block_k = min(block_k, K)
+    if (M % block_m or N % block_n or K % block_k
+            or block_k % group_k
+            or (not interpret and (block_m % 8 or block_n % 128))):
+        return reference_quantized_matmul(x, q, scale, group_k=group_k)
+    grid = (M // block_m, N // block_n, K // block_k)
+    sg = block_k // group_k
+    kern = functools.partial(_qmm_kernel, block_k=block_k, group_k=group_k)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_m, block_k),
+                         lambda mi, ni, ki: (0, mi, ki)),
+            pl.BlockSpec((1, block_k, block_n),
+                         lambda mi, ni, ki: (0, ki, ni)),
+            pl.BlockSpec((1, sg, block_n),
+                         lambda mi, ni, ki: (0, ki, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, block_m, block_n),
+                               lambda mi, ni, ki: (0, mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((1, M, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        interpret=interpret,
+    )(x[None], q[None], scale[None])[0]
+
+
+def quantized_matmul(x, q, scale, group_k=256):
+    from . import get_op
+    return get_op("quantized_matmul")(x, q, scale, group_k=group_k)
+
+
+register_op("quantized_matmul", reference_quantized_matmul,
+            pallas_quantized_matmul)

@@ -1,0 +1,38 @@
+"""Memory introspection helpers.
+
+Reference analog: ``deepspeed/runtime/utils.py see_memory_usage`` —
+rank-0 logging of allocator stats at labeled points. TPU form: the
+platform's ``memory_stats`` (XLA device stats) plus host RSS.
+"""
+
+import os
+
+from ..platform import get_platform
+from .logging import log_dist
+
+
+def _host_rss_gb():
+    try:
+        with open(f"/proc/{os.getpid()}/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1024 ** 3
+    except (OSError, ValueError, IndexError):
+        return float("nan")
+
+
+def see_memory_usage(message: str, force: bool = False, ranks=(0,)):
+    """Log device + host memory at a labeled point (reference signature:
+    see_memory_usage(message, force)). ``force`` is accepted for parity;
+    logging is always rank-filtered, never torch-allocator-gated."""
+    del force
+    stats = get_platform().memory_stats() or {}
+    used = stats.get("bytes_in_use", stats.get("used", 0)) / 1024 ** 3
+    limit = stats.get("bytes_limit", stats.get("total", 0)) / 1024 ** 3
+    peak = stats.get("peak_bytes_in_use", 0) / 1024 ** 3
+    rss = _host_rss_gb()
+    log_dist(
+        f"{message} | device used {used:.2f}GB peak {peak:.2f}GB "
+        f"limit {limit:.2f}GB | host rss {rss:.2f}GB",
+        ranks=list(ranks))
+    return {"device_used_gb": used, "device_peak_gb": peak,
+            "device_limit_gb": limit, "host_rss_gb": rss}
