@@ -123,14 +123,15 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        if KV < H:  # GQA: broadcast kv heads to query heads
-            rep = H // KV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
         if self.attention_fn is not None:
+            if KV < H:  # custom fns (Ulysses/ring) take dense heads
+                rep = H // KV
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
             y = self.attention_fn(q, k, v, causal=True)
         elif cfg.use_flash:
+            # GQA-native: the kernel's index map shares kv blocks across
+            # each query-head group — no repeat, KV HBM reads drop H/KV x
             y = flash_attention(q, k, v, causal=True)
         else:
             from ..ops.flash_attention import reference_attention

@@ -37,8 +37,14 @@ def _default_scale(head_dim):
 # Reference implementation (always available; CPU/debug path)
 # ------------------------------------------------------------------ #
 def reference_attention(q, k, v, causal=True, scale=None):
-    """[B, T, H, D] in/out, plain jnp (XLA-fused) attention."""
+    """[B, T, H, D] in/out, plain jnp (XLA-fused) attention. GQA: k/v may
+    carry fewer heads (KV divides H) — they broadcast to the query
+    heads."""
     B, T, H, D = q.shape
+    if k.shape[2] != H:   # GQA/MQA: expand kv heads
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = scale or _default_scale(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -100,8 +106,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
 
 def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
     B, T, H, D = q.shape
+    KV = k.shape[2]
+    rep = H // KV   # GQA: q head h reads kv head h // rep — no repeat,
+    #                 the index map shares each kv block across the group
     qt = q.transpose(0, 2, 1, 3)  # [B,H,T,D]
-    kt = k.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)  # [B,KV,T,D]
     vt = v.transpose(0, 2, 1, 3)
     nq, nk = T // block_q, T // block_k
     grid = (B, H, nq, nk)
@@ -112,8 +121,10 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h // rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h // rep, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -225,6 +236,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_pallas(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     B, T, H, D = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        # GQA backward: run the dense-head kernels on expanded k/v, then
+        # sum each group's dk/dv back onto its shared kv head (the fwd
+        # saves the COMPACT k/v, so residual memory stays KV-sized)
+        rep = H // KV
+        dq, dk, dv = _bwd_pallas(
+            scale, causal, block_q, block_k, interpret,
+            (q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+             out, lse), g)
+        dk = dk.reshape(B, T, KV, rep, D).sum(axis=3)
+        dv = dv.reshape(B, T, KV, rep, D).sum(axis=3)
+        return dq, dk, dv
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     dot = g.transpose(0, 2, 1, 3)
     ot = out.transpose(0, 2, 1, 3)
@@ -301,6 +325,9 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def pallas_attention(q, k, v, causal=True, scale=None, block_q=512,
                      block_k=512, interpret=None):
     B, T, H, D = q.shape
+    if H % k.shape[2]:
+        raise ValueError(
+            f"q heads {H} not divisible by kv heads {k.shape[2]}")
     scale = scale or _default_scale(D)
     if interpret is None:
         from ..platform import get_platform

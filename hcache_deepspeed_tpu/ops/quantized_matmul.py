@@ -85,7 +85,11 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
     block_k = min(block_k, K)
     if (M % block_m or N % block_n or K % block_k
             or block_k % group_k
-            or (not interpret and (block_m % 8 or block_n % 128))):
+            or (not interpret and (block_m % 8 or block_n % 128
+                                   or block_k % 128))):
+        # block_k is x's lane dim and q's sublane dim — it needs 128
+        # alignment on hardware just like the others (a 96-wide tile
+        # crashes Mosaic; see the same guard in flash_attention.py)
         return reference_quantized_matmul(x, q, scale, group_k=group_k)
     grid = (M // block_m, N // block_n, K // block_k)
     sg = block_k // group_k
