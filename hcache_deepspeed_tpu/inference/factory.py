@@ -119,13 +119,39 @@ def _mixtral_like(hf: Dict[str, Any]):
     )
 
 
+def _qwen2_moe_like(hf: Dict[str, Any]):
+    from ..models.mixtral import Qwen2MoeConfig
+    return Qwen2MoeConfig(
+        vocab_size=hf.get("vocab_size", 151936),
+        hidden_size=hf.get("hidden_size", 3584),
+        # expert FFN width is moe_intermediate_size (the dense
+        # intermediate_size key refers to layers qwen2-moe doesn't use)
+        intermediate_size=hf.get("moe_intermediate_size", 2560),
+        shared_expert_intermediate_size=hf.get(
+            "shared_expert_intermediate_size", 20480),
+        n_layer=hf.get("num_hidden_layers", 28),
+        n_head=hf.get("num_attention_heads", 28),
+        n_kv_head=hf.get("num_key_value_heads", 4),
+        max_positions=hf.get("max_position_embeddings", 32768),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        rope_theta=hf.get("rope_theta", 1e6),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        num_experts=hf.get("num_experts", 64),
+        top_k=hf.get("num_experts_per_tok", 8),
+        norm_topk_prob=hf.get("norm_topk_prob", False),
+        attention_bias=hf.get("attention_bias",
+                              hf.get("qkv_bias", True)),
+        dtype=hf.get("torch_dtype", "bfloat16"),
+    )
+
+
 #: model_type -> config adapter (reference: the policy map in
 #: engine_factory.py:69 — llama/mistral/qwen2/phi3 share the llama block
-#: layout; mixtral routes through the MoE paged model (model_moe.py);
-#: gpt2 has its own paged model (model_gpt2.py). qwen2_moe is NOT mapped
-#: to the mixtral adapter on purpose: it adds a shared expert and skips
-#: top-k renormalisation (norm_topk_prob=False), which PagedMoEModel does
-#: not implement — mapping it anyway would serve wrong logits silently.
+#: layout; mixtral/qwen2_moe route through the MoE paged model
+#: (model_moe.py: dropless grouped GEMM, and for qwen2_moe the shared
+#: expert + raw top-k gate mass); gpt2/opt/falcon/phi have their own
+#: paged trunks. qwen-v1 stays unmapped (different config keys and a
+#: fused striped c_attn).
 MODEL_FAMILIES = {
     "llama": _llama_like,
     "mistral": _llama_like,
@@ -136,6 +162,7 @@ MODEL_FAMILIES = {
     "falcon": _falcon_like,
     "phi": _phi_like,
     "mixtral": _mixtral_like,
+    "qwen2_moe": _qwen2_moe_like,
 }
 
 

@@ -45,6 +45,14 @@ class PagedMoEModel(PagedInferenceModel):
             raise TypeError("PagedMoEModel needs a MixtralConfig")
         super().__init__(cfg, params, **kw)
 
+    def _validate_tp(self):
+        super()._validate_tp()
+        shared = getattr(self.cfg, "shared_expert_intermediate_size", 0)
+        if shared and shared % self.tp:
+            raise ValueError(
+                f"shared_expert_intermediate_size={shared} not divisible "
+                f"by tensor parallel degree {self.tp}")
+
     @staticmethod
     def _keep_fp32(path) -> bool:
         """The router weight stays fp32 (training gates run fp32,
@@ -56,10 +64,19 @@ class PagedMoEModel(PagedInferenceModel):
     def _mlp_out(self, lp, h2):
         moe = lp["mlp"]["moe"]
         B, T, d = h2.shape
+        renorm = getattr(self.cfg, "norm_topk_prob", True)
         out, _aux = dropless_expert_ffn(
             h2.reshape(B * T, d), moe["wg"], moe["experts"]["w1"],
-            moe["experts"]["w3"], moe["experts"]["w2"], self.cfg.top_k)
+            moe["experts"]["w3"], moe["experts"]["w2"], self.cfg.top_k,
+            renorm)
         out = out.reshape(B, T, d)
+        if "shared_gate_proj" in moe:   # qwen2-moe shared expert
+            gate = h2 @ moe["shared_gate_proj"]["kernel"]
+            up = h2 @ moe["shared_up_proj"]["kernel"]
+            shared = (jax.nn.silu(gate) * up) @ \
+                moe["shared_down_proj"]["kernel"]
+            sg = h2 @ moe["shared_expert_gate"]["kernel"]
+            out = out + jax.nn.sigmoid(sg) * shared
         if self.tp > 1:   # row-parallel partial sum over expert ff shards
             out = jax.lax.psum(out, TENSOR_AXIS)
         return out
@@ -71,6 +88,12 @@ class PagedMoEModel(PagedInferenceModel):
         def fix(path, spec):
             joined = "/".join(str(getattr(k, "key", k)) for k in path)
             if "/moe/" in joined or joined.endswith("/wg"):
+                if "shared" in joined:
+                    # shared-expert kernels carry gate_proj/up_proj/
+                    # down_proj in their names — the base col/row rules
+                    # already classified them ("shared_expert_gate"
+                    # matches neither and stays replicated)
+                    return spec
                 if "w1" in joined or "w3" in joined:
                     return P(None, None, None, TENSOR_AXIS)  # [L,E,d,f]
                 if "w2" in joined:

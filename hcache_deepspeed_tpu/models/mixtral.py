@@ -29,6 +29,38 @@ class MixtralConfig(LlamaConfig):
     dropless: bool = False
 
 
+@dataclass(frozen=True)
+class Qwen2MoeConfig(MixtralConfig):
+    """Qwen2-MoE (HF qwen2_moe): mixtral trunk + a gated shared expert
+    every token passes through, raw (un-renormalized) top-k gate mass,
+    and attention biases. Requires the dropless path (the shared expert
+    lives in DroplessMOELayer)."""
+    shared_expert_intermediate_size: int = 5632
+    norm_topk_prob: bool = False
+    dropless: bool = True
+    attention_bias: bool = True
+
+
+def qwen2_moe_a14b(**kw):
+    defaults = dict(vocab_size=151936, hidden_size=3584,
+                    intermediate_size=2560, n_layer=28, n_head=28,
+                    n_kv_head=4, max_positions=32768, rope_theta=1e6,
+                    num_experts=64, top_k=8,
+                    shared_expert_intermediate_size=20480,
+                    dtype="bfloat16", remat=True)
+    defaults.update(kw)
+    return Qwen2MoeConfig(**defaults)
+
+
+def qwen2_moe_tiny(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    n_layer=2, n_head=4, n_kv_head=2, max_positions=128,
+                    num_experts=4, top_k=2,
+                    shared_expert_intermediate_size=96)
+    defaults.update(kw)
+    return Qwen2MoeConfig(**defaults)
+
+
 def mixtral_8x7b(**kw):
     defaults = dict(vocab_size=32000, hidden_size=4096,
                     intermediate_size=14336, n_layer=32, n_head=32,

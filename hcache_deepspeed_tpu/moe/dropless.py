@@ -22,14 +22,17 @@ import jax.numpy as jnp
 from ..ops.grouped_gemm import grouped_matmul
 
 
-def dropless_route(logits, k):
+def dropless_route(logits, k, renormalize=True):
     """Top-k routing without capacity: returns (probs [N,k], experts
     [N,k], aux load-balancing loss) — same aux formula as the capacity
-    gate (fraction-mean * prob-mean * E)."""
+    gate (fraction-mean * prob-mean * E). ``renormalize=False`` keeps
+    the raw softmax mass of the selected experts (qwen2-moe's
+    norm_topk_prob=False semantics)."""
     N, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = jax.lax.top_k(probs, k)
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)  # renormalize
+    if renormalize:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     # aux loss (reference: sharded_moe.py load-balancing)
     me = jnp.mean(probs, axis=0)
     ce = jnp.mean(
@@ -38,7 +41,7 @@ def dropless_route(logits, k):
     return topv, topi, aux
 
 
-def dropless_expert_ffn(tokens, wg, w1, w3, w2, k):
+def dropless_expert_ffn(tokens, wg, w1, w3, w2, k, renormalize=True):
     """The routed grouped-GEMM SwiGLU computation shared by the training
     layer below and the paged serving model (inference/model_moe.py).
     tokens: [N, d]; returns ([N, d], aux)."""
@@ -46,7 +49,7 @@ def dropless_expert_ffn(tokens, wg, w1, w3, w2, k):
     E = wg.shape[-1]
     dt = tokens.dtype
     logits = tokens.astype(jnp.float32) @ wg
-    probs, experts, aux = dropless_route(logits, k)
+    probs, experts, aux = dropless_route(logits, k, renormalize)
     flat_e = experts.reshape(-1)                     # [N*k]
     order = jnp.argsort(flat_e, stable=True)
     token_of = order // k
@@ -83,11 +86,18 @@ class _ExpertWeights(nn.Module):
 class DroplessMOELayer(nn.Module):
     """Drop-in replacement for ``MOELayer`` (same param tree: ``wg`` +
     ``experts/{w1,w2,w3}``) computing with the dropless grouped-GEMM path
-    instead of capacity buffers. [B, T, d] -> ([B, T, d], aux)."""
+    instead of capacity buffers. [B, T, d] -> ([B, T, d], aux).
+
+    ``shared_expert_size > 0`` adds the qwen2-moe shared expert: a dense
+    SwiGLU every token passes through, gated per token by
+    ``sigmoid(x @ shared_expert_gate)`` and added to the routed output
+    (HF Qwen2MoeSparseMoeBlock)."""
     num_experts: int
     hidden_size: int
     intermediate_size: int
     k: int = 2
+    renormalize: bool = True
+    shared_expert_size: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -98,8 +108,20 @@ class DroplessMOELayer(nn.Module):
             self.num_experts, self.hidden_size, self.intermediate_size,
             name="experts")()
         out, aux = dropless_expert_ffn(x.reshape(B * T, d), wg, w1, w3, w2,
-                                       self.k)
-        return out.reshape(B, T, d).astype(x.dtype), aux.astype(jnp.float32)
+                                       self.k, self.renormalize)
+        out = out.reshape(B, T, d)
+        if self.shared_expert_size:
+            gate = nn.Dense(self.shared_expert_size, use_bias=False,
+                            dtype=x.dtype, name="shared_gate_proj")(x)
+            up = nn.Dense(self.shared_expert_size, use_bias=False,
+                          dtype=x.dtype, name="shared_up_proj")(x)
+            shared = nn.Dense(d, use_bias=False, dtype=x.dtype,
+                              name="shared_down_proj")(
+                nn.silu(gate) * up)
+            sg = nn.Dense(1, use_bias=False, dtype=x.dtype,
+                          name="shared_expert_gate")(x)
+            out = out + jax.nn.sigmoid(sg) * shared
+        return out.astype(x.dtype), aux.astype(jnp.float32)
 
 
 #: back-compat alias — the one dropless module (param tree ``wg`` +

@@ -92,6 +92,16 @@ class MoEMLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         cfg = self.cfg
+        if not getattr(cfg, "dropless", False):
+            if getattr(cfg, "shared_expert_intermediate_size", 0):
+                raise ValueError(
+                    "shared_expert_intermediate_size requires "
+                    "dropless=True (the shared expert lives in "
+                    "DroplessMOELayer)")
+            if not getattr(cfg, "norm_topk_prob", True):
+                raise ValueError(
+                    "norm_topk_prob=False requires dropless=True (the "
+                    "capacity gate always renormalizes top-k mass)")
         if getattr(cfg, "dropless", False):
             from .dropless import DroplessMOELayer
             return DroplessMOELayer(
@@ -99,6 +109,9 @@ class MoEMLP(nn.Module):
                 hidden_size=cfg.hidden_size,
                 intermediate_size=cfg.intermediate_size,
                 k=getattr(cfg, "top_k", 2),
+                renormalize=getattr(cfg, "norm_topk_prob", True),
+                shared_expert_size=getattr(
+                    cfg, "shared_expert_intermediate_size", 0),
                 name="moe")(x, train)
         return MOELayer(
             num_experts=cfg.num_experts,

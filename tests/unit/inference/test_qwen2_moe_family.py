@@ -1,0 +1,123 @@
+"""Qwen2-MoE family: shared expert + raw top-k gate mass
+(reference: the qwen2-moe policy in engine_factory.py:69;
+HF Qwen2MoeSparseMoeBlock semantics)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig,
+                                            build_hf_engine)
+from hcache_deepspeed_tpu.inference.model_moe import PagedMoEModel
+from hcache_deepspeed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                 Qwen2MoeConfig,
+                                                 qwen2_moe_tiny)
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2_moe():
+    cfg = qwen2_moe_tiny(max_positions=128, use_flash=False)
+    model = MixtralForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    return cfg, model, params
+
+
+def make_engine(cfg, params):
+    return InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8, "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24,
+                      "cache_dtype": "float32"}))
+
+
+def full_logits(model, params, tokens):
+    out = model.apply({"params": params},
+                      {"input_ids": np.asarray(tokens, np.int32)[None]},
+                      train=False, return_logits=True)
+    return np.asarray(out)[0]
+
+
+def test_params_carry_shared_expert_and_biases(tiny_qwen2_moe):
+    cfg, _, params = tiny_qwen2_moe
+    moe = params["layers_0"]["mlp"]["moe"]
+    assert "shared_gate_proj" in moe and "shared_expert_gate" in moe
+    assert "bias" in params["layers_0"]["self_attn"]["q_proj"]
+
+
+def test_training_model_trains(tiny_qwen2_moe):
+    cfg, model, params = tiny_qwen2_moe
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (2, 16),
+                                       dtype=np.int32)}
+
+    def loss_fn(p):
+        return model.apply({"params": p}, batch, train=True)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    sg = grads["layers_0"]["mlp"]["moe"]["shared_expert_gate"]["kernel"]
+    assert float(np.abs(np.asarray(sg)).sum()) > 0
+
+
+def test_prefill_decode_parity(tiny_qwen2_moe):
+    cfg, model, params = tiny_qwen2_moe
+    engine = make_engine(cfg, params)
+    assert isinstance(engine.model, PagedMoEModel)
+    rng = np.random.default_rng(1)
+    tokens = list(rng.integers(0, cfg.vocab_size, (11,)))
+    logits, _ = engine.put([1], [tokens])
+    np.testing.assert_allclose(logits[0],
+                               full_logits(model, params, tokens)[-1],
+                               atol=2e-2)
+    for _ in range(4):
+        nxt = int(np.argmax(logits[0]))
+        tokens.append(nxt)
+        logits, _ = engine.put([1], [[nxt]])
+        np.testing.assert_allclose(
+            logits[0], full_logits(model, params, tokens)[-1], atol=2e-2)
+
+
+def test_raw_gate_mass_differs_from_renormalized(tiny_qwen2_moe):
+    """norm_topk_prob=False must actually change the math (guards against
+    the flag silently defaulting to mixtral renormalization)."""
+    import dataclasses
+    cfg, model, params = tiny_qwen2_moe
+    cfg_renorm = dataclasses.replace(cfg, norm_topk_prob=True)
+    model2 = MixtralForCausalLM(cfg_renorm)
+    rng = np.random.default_rng(2)
+    tokens = list(rng.integers(0, cfg.vocab_size, (9,)))
+    a = full_logits(model, params, tokens)
+    b = full_logits(model2, params, tokens)
+    assert np.abs(a - b).max() > 1e-4
+
+
+def test_hf_factory_qwen2_moe(tiny_qwen2_moe):
+    cfg, _, params = tiny_qwen2_moe
+    hf = {"model_type": "qwen2_moe", "vocab_size": cfg.vocab_size,
+          "hidden_size": cfg.hidden_size,
+          "moe_intermediate_size": cfg.intermediate_size,
+          "shared_expert_intermediate_size":
+              cfg.shared_expert_intermediate_size,
+          "num_hidden_layers": cfg.n_layer,
+          "num_attention_heads": cfg.n_head,
+          "num_key_value_heads": cfg.n_kv_head,
+          "max_position_embeddings": 128,
+          "num_experts": cfg.num_experts,
+          "num_experts_per_tok": cfg.top_k,
+          "norm_topk_prob": False,
+          "rms_norm_eps": cfg.rms_norm_eps,
+          "rope_theta": cfg.rope_theta,
+          "torch_dtype": "float32"}
+    engine = build_hf_engine(
+        hf, params,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 4,
+                           "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24}))
+    assert isinstance(engine.model.cfg, Qwen2MoeConfig)
+    assert not engine.model.cfg.norm_topk_prob
+    logits, _ = engine.put([1], [[1, 2, 3]])
+    assert np.isfinite(np.asarray(logits)).all()

@@ -66,6 +66,28 @@ class TestTPMoEServing:
                                        atol=2e-4)
             tok = int(np.argmax(np.asarray(lr)[0]))
 
+    def test_qwen2_moe_shared_expert_tp(self, tp_topo):
+        """Shared expert shards like a dense MLP; logits match
+        single-chip."""
+        from hcache_deepspeed_tpu.models.mixtral import qwen2_moe_tiny
+        cfg = qwen2_moe_tiny(max_positions=128, use_flash=False,
+                             hidden_size=64, intermediate_size=128,
+                             shared_expert_intermediate_size=96)
+        model = MixtralForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": np.zeros((2, 16), np.int32)},
+                            train=False)["params"]
+        ref = _engine(cfg, params)
+        tp = _engine(cfg, params, topology=tp_topo)
+        sgp = tp.model.params["layers"]["mlp"]["moe"]["shared_gate_proj"]
+        assert "tensor" in str(sgp["kernel"].sharding.spec)
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, cfg.vocab_size, (16,)).tolist()
+        lr, _ = ref.put([1], [prompt])
+        lt, _ = tp.put([1], [prompt])
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lt),
+                                   atol=2e-4)
+
     def test_expert_weights_sharded(self, tp_topo):
         cfg, params = _setup()
         tp = _engine(cfg, params, topology=tp_topo)
