@@ -132,6 +132,12 @@ class PagedInferenceModel:
         }
         if not self.tied:
             new["lm_head"] = params["lm_head"]["kernel"]
+        self.params = self._finalize_params(new)
+
+    def _finalize_params(self, new):
+        """Shared load_params tail for every family: dtype cast (with
+        the `_keep_fp32` exemptions), optional weight quantization, TP
+        placement."""
         def cast(path, p):
             p = jnp.asarray(p)
             if not jnp.issubdtype(p.dtype, jnp.floating):
@@ -143,7 +149,7 @@ class PagedInferenceModel:
         new = self._maybe_quantize(new)
         if self.tp > 1:
             new = jax.device_put(new, self._param_shardings_for(new))
-        self.params = new
+        return new
 
     def _maybe_quantize(self, tree):
         return maybe_quantize_serving_params(tree, self.quantization)
@@ -170,38 +176,59 @@ class PagedInferenceModel:
                 raise ValueError(f"{name}={val} not divisible by "
                                  f"tensor parallel degree {tp}")
 
-    def _param_spec_tree(self, params=None):
+    #: per-family projection name tables for the TP spec builder: names
+    #: matched as substrings of the param path. Subclasses override
+    #: (falcon: dense_h_to_4h/dense_4h_to_h; phi: fc1/dense/fc2).
+    _COL_NAMES = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
+    _ROW_NAMES = ("o_proj", "down_proj")
+    #: a row-parallel projection bias is only legal when the family's
+    #: layer math adds it AFTER the psum (phi does; llama has none)
+    _ROW_BIAS_OK = False
+
+    def _layer_leaf_spec(self, path, leaf):
         from jax.sharding import PartitionSpec as P
-        params = params if params is not None else self.params
-        col3 = P(None, None, TENSOR_AXIS)   # stacked [L, in, out] column
-        row3 = P(None, TENSOR_AXIS, None)   # stacked [L, in, out] row
+        joined = "/".join(str(getattr(k, "key", k)) for k in path)
+        if any(n in joined for n in self._COL_NAMES):
+            # stacked kernel [L, in, out] -> col; stacked bias [L, out]
+            # follows its column shards
+            return P(None, None, TENSOR_AXIS) if leaf.ndim == 3 \
+                else P(None, TENSOR_AXIS)
+        if any(n in joined for n in self._ROW_NAMES):
+            if leaf.ndim != 3:
+                if self._ROW_BIAS_OK:
+                    return P()   # replicated, added once after the psum
+                raise NotImplementedError(
+                    "bias on a row-parallel projection would be "
+                    "added once per shard before the psum")
+            return P(None, TENSOR_AXIS, None)
+        return P()
 
-        def layer_spec(path, leaf):
-            joined = "/".join(str(getattr(k, "key", k)) for k in path)
-            if any(n in joined for n in ("q_proj", "k_proj", "v_proj",
-                                         "gate_proj", "up_proj")):
-                # stacked kernel [L, in, out] -> col; stacked bias
-                # [L, out] follows its column shards
-                return col3 if leaf.ndim == 3 else P(None, TENSOR_AXIS)
-            if any(n in joined for n in ("o_proj", "down_proj")):
-                if leaf.ndim != 3:
-                    raise NotImplementedError(
-                        "bias on a row-parallel projection would be "
-                        "added once per shard before the psum")
-                return row3
-            return P()
-
-        specs = {
+    def _top_leaf_spec(self, key, path, leaf):
+        """Specs for the non-layer entries (embed / norm / lm_head)."""
+        from jax.sharding import PartitionSpec as P
+        if key == "embed":
             # tied: ONE vocab-row-sharded table serves embed + LM head
             # (the reference's vocab-parallel embedding); untied: embed
             # replicated, head column-sharded
-            "embed": P(TENSOR_AXIS, None) if self.tied else P(),
-            "norm": P(),
-            "layers": jax.tree_util.tree_map_with_path(
-                layer_spec, params["layers"]),
-        }
-        if not self.tied:
-            specs["lm_head"] = P(None, TENSOR_AXIS)
+            return P(TENSOR_AXIS, None) if self.tied else P()
+        if key == "lm_head":
+            names = [str(getattr(k, "key", k)) for k in path]
+            if names and names[-1] == "bias":
+                return P(TENSOR_AXIS)      # vocab-sharded head bias
+            return P(None, TENSOR_AXIS)
+        return P()                         # norms etc. replicate
+
+    def _param_spec_tree(self, params=None):
+        import functools
+        params = params if params is not None else self.params
+        specs = {}
+        for key, sub in params.items():
+            if key == "layers":
+                specs[key] = jax.tree_util.tree_map_with_path(
+                    self._layer_leaf_spec, sub)
+            else:
+                specs[key] = jax.tree_util.tree_map_with_path(
+                    functools.partial(self._top_leaf_spec, key), sub)
         return specs
 
     def _param_shardings_for(self, params):

@@ -11,15 +11,17 @@ Latents (HCache) = the post-input_layernorm hidden states, the same
 pre-QKV snapshot the llama model uses, so ``restore_kv`` (QKV-only
 replay) works unchanged.
 
-Serving is single-chip / data-parallel for now (the TP spec tree is
-llama-shaped); reference TP falcon support maps to a later
-`_param_spec_tree` override.
+Tensor-parallel serving: GQA configs shard q + kv heads and the MLP
+dims (one psum covers the attention and MLP row-parallel partials of
+the parallel block); MQA (n_kv_head=1) is rejected — it would need KV
+replication, which the cache layout doesn't model.
 """
 
 import jax
 import jax.numpy as jnp
 
 from ..models.falcon import FalconConfig
+from ..parallel.topology import TENSOR_AXIS
 from .model import PagedInferenceModel, stack_layer_params
 
 
@@ -27,12 +29,22 @@ class PagedFalconModel(PagedInferenceModel):
     def __init__(self, cfg: FalconConfig, params, **kw):
         if not isinstance(cfg, FalconConfig):
             raise TypeError("PagedFalconModel needs a FalconConfig")
-        if kw.get("topology") is not None and \
-                kw["topology"].tensor_size > 1:
-            raise NotImplementedError(
-                "tensor-parallel serving is implemented for the llama "
-                "family; falcon serves single-chip / data-parallel")
         super().__init__(cfg, params, **kw)
+
+    def _validate_tp(self):
+        """GQA falcon (40b/180b-style) shards KV heads; MQA (falcon-7b,
+        n_kv_head=1) would need KV replication — rejected explicitly."""
+        cfg, tp = self.cfg, self.tp
+        for name, val in (("n_head", cfg.n_head),
+                          ("n_kv_head", cfg.n_kv_head),
+                          ("ffn_dim", cfg.ffn_dim),
+                          ("vocab_size", cfg.vocab_size)):
+            if val % tp:
+                raise ValueError(f"{name}={val} not divisible by "
+                                 f"tensor parallel degree {tp}")
+
+    _COL_NAMES = ("q_proj", "k_proj", "v_proj", "dense_h_to_4h")
+    _ROW_NAMES = ("o_proj", "dense_4h_to_h")
 
     def load_params(self, params):
         new = {
@@ -42,13 +54,7 @@ class PagedFalconModel(PagedInferenceModel):
         }
         if not self.tied:
             new["lm_head"] = params["lm_head"]["kernel"]
-        def cast(path, p):
-            p = jnp.asarray(p)
-            if not jnp.issubdtype(p.dtype, jnp.floating):
-                return p
-            return p.astype(self.cfg.compute_dtype)
-        self.params = self._maybe_quantize(
-            jax.tree_util.tree_map_with_path(cast, new))
+        self.params = self._finalize_params(new)
 
     @staticmethod
     def _ln(x, p, eps):
@@ -75,5 +81,8 @@ class PagedFalconModel(PagedInferenceModel):
         attn = attn @ lp["self_attn"]["o_proj"]["kernel"]
         up = h @ lp["dense_h_to_4h"]["kernel"]
         mlp = jax.nn.gelu(up) @ lp["dense_4h_to_h"]["kernel"]
-        x = x + attn + mlp
+        both = attn + mlp
+        if self.tp > 1:   # one psum covers both row-parallel partials
+            both = jax.lax.psum(both, TENSOR_AXIS)
+        x = x + both
         return x.astype(cfg.compute_dtype), ck, cv, latent

@@ -32,8 +32,9 @@ class PagedGPT2Model:
                  topology=None, quantization=None):
         if topology is not None and topology.tensor_size > 1:
             raise NotImplementedError(
-                "tensor-parallel serving is implemented for the llama "
-                "family; gpt2 serves single-chip / data-parallel")
+                "tensor-parallel serving covers the llama/mixtral/"
+                "qwen2-moe/falcon-GQA/phi families; the gpt2 trunk "
+                "(gpt2, opt) serves single-chip / data-parallel")
         self.cfg = cfg
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ..models.phi import PhiConfig, partial_rope
 from ..ops.rope import rope_frequencies
+from ..parallel.topology import TENSOR_AXIS
 from .model import stack_layer_params
 from .model_falcon import PagedFalconModel
 
@@ -21,17 +22,26 @@ class PagedPhiModel(PagedFalconModel):
     def __init__(self, cfg: PhiConfig, params, **kw):
         if not isinstance(cfg, PhiConfig):
             raise TypeError("PagedPhiModel needs a PhiConfig")
-        # skip PagedFalconModel's FalconConfig check, keep its TP guard
-        if kw.get("topology") is not None and \
-                kw["topology"].tensor_size > 1:
-            raise NotImplementedError(
-                "tensor-parallel serving is implemented for the llama "
-                "family; phi serves single-chip / data-parallel")
+        # skip PagedFalconModel's FalconConfig check
         super(PagedFalconModel, self).__init__(cfg, params, **kw)
-        # rope tables over the rotated slice only
+        # rope tables over the rotated slice only (must exist before the
+        # first jitted call, which __init__ does not trigger)
         self.cos, self.sin = rope_frequencies(cfg.rotary_dim,
                                               cfg.max_positions,
                                               cfg.rope_theta)
+
+    def _validate_tp(self):
+        cfg, tp = self.cfg, self.tp
+        for name, val in (("n_head", cfg.n_head),
+                          ("intermediate_size", cfg.intermediate_size),
+                          ("vocab_size", cfg.vocab_size)):
+            if val % tp:
+                raise ValueError(f"{name}={val} not divisible by "
+                                 f"tensor parallel degree {tp}")
+
+    _COL_NAMES = ("q_proj", "k_proj", "v_proj", "fc1")
+    _ROW_NAMES = ("dense", "fc2")
+    _ROW_BIAS_OK = True   # _layer_step adds row biases after the psum
 
     def load_params(self, params):
         new = {
@@ -43,25 +53,20 @@ class PagedPhiModel(PagedFalconModel):
             "layers": stack_layer_params(params, self.cfg.n_layer),
         }
 
-        def cast(path, p):
-            p = jnp.asarray(p)
-            if not jnp.issubdtype(p.dtype, jnp.floating):
-                return p
-            return p.astype(self.cfg.compute_dtype)
-        self.params = self._maybe_quantize(
-            jax.tree_util.tree_map_with_path(cast, new))
+        self.params = self._finalize_params(new)
 
     def _qkv(self, lp, h, positions):
         cfg = self.cfg
         B, T, _ = h.shape
-        H, D = cfg.n_head, cfg.head_dim
+        D = cfg.head_dim
         a = lp["self_attn"]
-        q = (h @ a["q_proj"]["kernel"] +
-             a["q_proj"]["bias"]).reshape(B, T, H, D)
-        k = (h @ a["k_proj"]["kernel"] +
-             a["k_proj"]["bias"]).reshape(B, T, H, D)
-        v = (h @ a["v_proj"]["kernel"] +
-             a["v_proj"]["bias"]).reshape(B, T, H, D)
+        # head counts from the (possibly TP-sharded) kernel widths
+        q = h @ a["q_proj"]["kernel"] + a["q_proj"]["bias"]
+        k = h @ a["k_proj"]["kernel"] + a["k_proj"]["bias"]
+        v = h @ a["v_proj"]["kernel"] + a["v_proj"]["bias"]
+        q = q.reshape(B, T, q.shape[-1] // D, D)
+        k = k.reshape(B, T, k.shape[-1] // D, D)
+        v = v.reshape(B, T, v.shape[-1] // D, D)
         q = partial_rope(q, self.cos, self.sin, positions,
                          rotary_dim=cfg.rotary_dim)
         k = partial_rope(k, self.cos, self.sin, positions,
@@ -78,10 +83,15 @@ class PagedPhiModel(PagedFalconModel):
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
         attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
         d = lp["self_attn"]["dense"]
-        attn = attn @ d["kernel"] + d["bias"]
+        attn = attn @ d["kernel"]
         up = h @ lp["fc1"]["kernel"] + lp["fc1"]["bias"]
-        mlp = jax.nn.gelu(up) @ lp["fc2"]["kernel"] + lp["fc2"]["bias"]
-        x = x + attn + mlp
+        mlp = jax.nn.gelu(up) @ lp["fc2"]["kernel"]
+        both = attn + mlp
+        if self.tp > 1:
+            # row-parallel partials psum together; their (replicated)
+            # biases add exactly once, after the sum
+            both = jax.lax.psum(both, TENSOR_AXIS)
+        x = x + both + d["bias"] + lp["fc2"]["bias"]
         return x.astype(cfg.compute_dtype), ck, cv, latent
 
     def _head_logits(self, params, last):

@@ -1,0 +1,82 @@
+"""Tensor-parallel serving for the falcon (GQA) and phi trunks
+(reference: TP sharding across v2 model implementations)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from hcache_deepspeed_tpu.models.falcon import (FalconForCausalLM,
+                                                falcon_tiny)
+from hcache_deepspeed_tpu.models.phi import PhiForCausalLM, phi_tiny
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+
+def _engine(cfg, params, topology=None):
+    return InferenceEngineV2(
+        cfg, params, topology=topology,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24,
+                      "cache_dtype": "float32"}))
+
+
+@pytest.fixture
+def tp_topo(eight_devices):
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(data=4, tensor=2))
+    yield topo
+    topo_mod.reset_topology()
+
+
+def _init(model, cfg):
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    return model.init(jax.random.PRNGKey(0), batch,
+                      train=False)["params"]
+
+
+def _parity(cfg, model, params, tp_topo):
+    ref = _engine(cfg, params)
+    tp = _engine(cfg, params, topology=tp_topo)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, (14,)).tolist()
+    lr, _ = ref.put([1], [prompt])
+    lt, _ = tp.put([1], [prompt])
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lt), atol=2e-4)
+    tok = int(np.argmax(np.asarray(lr)[0]))
+    for _ in range(3):
+        lr, _ = ref.put([1], [[tok]])
+        lt, _ = tp.put([1], [[tok]])
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lt),
+                                   atol=2e-4)
+        tok = int(np.argmax(np.asarray(lr)[0]))
+
+
+def test_falcon_gqa_tp_parity(tp_topo):
+    cfg = falcon_tiny(use_flash=False, n_head=4, n_kv_head=2)
+    model = FalconForCausalLM(cfg)
+    _parity(cfg, model, _init(model, cfg), tp_topo)
+
+
+def test_falcon_mqa_tp_rejected(tp_topo):
+    cfg = falcon_tiny(use_flash=False, n_head=4, n_kv_head=1)
+    model = FalconForCausalLM(cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        _engine(cfg, _init(model, cfg), topology=tp_topo)
+
+
+def test_phi_tp_parity(tp_topo):
+    cfg = phi_tiny(use_flash=False)
+    model = PhiForCausalLM(cfg)
+    _parity(cfg, model, _init(model, cfg), tp_topo)
+
+
+def test_phi_head_bias_sharded(tp_topo):
+    cfg = phi_tiny(use_flash=False)
+    model = PhiForCausalLM(cfg)
+    tp = _engine(cfg, _init(model, cfg), topology=tp_topo)
+    head = tp.model.params["lm_head"]
+    assert "tensor" in str(head["kernel"].sharding.spec)
+    assert "tensor" in str(head["bias"].sharding.spec)
