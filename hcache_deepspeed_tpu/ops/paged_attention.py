@@ -91,8 +91,10 @@ def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)                  # [TGp, D]
-        k = k_ref[0, :, 0].astype(jnp.float32)               # [BS, D]
+        # matmuls stay in the input dtype (bf16 MXU rate) with fp32
+        # accumulation — an fp32 upcast here runs at ~1/8 peak
+        q = q_ref[0, 0]                                      # [TGp, D]
+        k = k_ref[0, :, 0].astype(q.dtype)                   # [BS, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [TGp, BS]
@@ -107,9 +109,9 @@ def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
         corr = jnp.exp(m_prev - m_new)
         l_s[:, :1] = corr * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         m_s[:, :1] = m_new
-        v = v_ref[0, :, 0].astype(jnp.float32)               # [BS, D]
+        v = v_ref[0, :, 0]                                   # [BS, D]
         acc[:] = acc[:] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(nb == nblocks - 1)
     def _out():
