@@ -1,0 +1,71 @@
+"""3D-parallel training sampler: (a) pipeline-parallel GPT-2 with the
+compiled 1F1B executor, (b) dropless Mixtral over data x expert x tensor.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/moe_pipeline_3d.py
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+
+def pipeline_example():
+    from hcache_deepspeed_tpu.models.gpt2 import (gpt2_pipeline_layers,
+                                                  gpt2_tiny)
+    from hcache_deepspeed_tpu.runtime.pipe import PipelineModule
+
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(pipe=2, data=4))
+    cfg = gpt2_tiny(n_layer=4)
+    layers, loss_fn = gpt2_pipeline_layers(cfg)
+    module = PipelineModule(layers, loss_fn, topology=topo)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 32),
+                                       dtype=np.int32)}
+    engine, _, _, _ = hds.initialize(
+        model=module, topology=topo, example_batch=batch,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1, "min_shard_size": 1}})
+    for step in range(4):
+        print(f"pipe step {step}: "
+              f"loss {float(engine.train_batch(batch=batch)):.4f}")
+    topo_mod.reset_topology()
+
+
+def moe_example():
+    from hcache_deepspeed_tpu.models.mixtral import (MixtralForCausalLM,
+                                                     mixtral_tiny)
+
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(data=2, expert=2, tensor=2))
+    cfg = mixtral_tiny(dropless=True, use_flash=False)
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32),
+                                       dtype=np.int32)}
+    engine, _, _, _ = hds.initialize(
+        model=MixtralForCausalLM(cfg), topology=topo,
+        example_batch=batch,
+        config={"train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2, "min_shard_size": 1}})
+    for step in range(4):
+        print(f"moe step {step}: "
+              f"loss {float(engine.train_batch(batch=batch)):.4f}")
+    topo_mod.reset_topology()
+
+
+if __name__ == "__main__":
+    pipeline_example()
+    moe_example()

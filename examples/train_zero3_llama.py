@@ -1,0 +1,57 @@
+"""ZeRO-3 Llama training on a device mesh (the BASELINE north-star
+config shape, scaled down so it also runs on a virtual CPU mesh).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_zero3_llama.py
+
+On a pod, drop the env vars and raise the model/config sizes.
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM,
+                                               llama_tiny)
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+
+def main():
+    import jax
+    n = len(jax.devices())
+    tensor = 2 if n % 2 == 0 else 1
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(data=n // tensor, tensor=tensor))
+
+    cfg = llama_tiny(max_positions=256)   # swap for llama2_7b() at scale
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    dp = topo.dp_world_size()
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (2 * dp, 128),
+                                       dtype=np.int32)}
+
+    engine, _, _, _ = hds.initialize(
+        model=model, example_batch=batch, topology=topo,
+        config={
+            "train_batch_size": 2 * dp,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+            "zero_optimization": {"stage": 3, "min_shard_size": 1},
+        })
+
+    for step in range(10):
+        loss = float(engine.train_batch(batch=batch))
+        print(f"step {step}: loss {loss:.4f}")
+    engine.save_checkpoint("/tmp/hds_example_ckpt")
+    print("checkpoint saved; resume with engine.load_checkpoint(...)")
+
+
+if __name__ == "__main__":
+    main()
