@@ -49,6 +49,11 @@ class QuantizationConfig(HDSConfigModel):
     group_size: int = 256
     #: leaves smaller than this stay full precision (norms, biases)
     min_size: int = 4096
+    #: route the llama-trunk families' layer matmuls through the fused
+    #: int8-weight Pallas kernel (ops/quantized_matmul.py) instead of
+    #: dequantize-then-matmul — weights stream int8 from HBM and
+    #: dequantize tile-by-tile in VMEM
+    use_fused_kernel: bool = False
 
 
 class RaggedInferenceEngineConfig(HDSConfigModel):

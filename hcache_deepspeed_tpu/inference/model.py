@@ -46,6 +46,13 @@ from ..ops.rope import apply_rope, rope_frequencies
 from ..parallel.topology import TENSOR_AXIS
 
 
+def join_path(path):
+    """Stable "a/b/c" rendering of a pytree key path (DictKey.key for
+    mappings, str(entry) otherwise) — the one place path-key handling
+    lives for quantization skip-lists and TP name rules."""
+    return "/".join(str(getattr(k, "key", k)) for k in path)
+
+
 def maybe_quantize_serving_params(tree, quantization):
     """Weight-only int quantization of a serving param tree (reference:
     ``deepspeed/inference/quantization`` — v1's int8 QuantLinear).
@@ -58,17 +65,14 @@ def maybe_quantize_serving_params(tree, quantization):
         return tree
     from ..ops.quantizer import quantize_tree
 
-    def segs(path):
-        return ["%s" % getattr(k, "key", k) for k in path]
-
     def skip(path):
-        joined = "/".join(segs(path))
+        joined = join_path(path)
         return "wg" in joined or "embed" in joined or "wte" in joined \
             or "wpe" in joined
 
     def batched(path):
-        s = segs(path)
-        return bool(s) and s[0] == "layers"
+        s = join_path(path).split("/")
+        return bool(s[0]) and s[0] == "layers"
     return quantize_tree(tree, group_size=quantization.group_size,
                          num_bits=quantization.bits,
                          min_size=quantization.min_size, skip=skip,
@@ -152,7 +156,40 @@ class PagedInferenceModel:
         return new
 
     def _maybe_quantize(self, tree):
-        return maybe_quantize_serving_params(tree, self.quantization)
+        qc = self.quantization
+        if not (qc and qc.use_fused_kernel):
+            return maybe_quantize_serving_params(tree, qc)
+        # fused mode: stacked [L, K, N] projection kernels become
+        # MatmulQuantizedTensor (consumed in-place by the fused kernel
+        # via _mm; NOT dequantized by the scan step); everything else
+        # follows the dequant-on-use path
+        from ..ops.quantized_matmul import MatmulQuantizedTensor
+
+        names = self._COL_NAMES + self._ROW_NAMES
+
+        def fused(path, leaf):
+            joined = join_path(path)
+            leaf_a = jnp.asarray(leaf)
+            if (path and str(getattr(path[0], "key", path[0])) == "layers"
+                    and leaf_a.ndim == 3
+                    and any(n in joined for n in names)
+                    and joined.endswith("kernel")
+                    and leaf_a.shape[-2] % qc.group_size == 0
+                    and leaf_a.size >= qc.min_size):
+                return MatmulQuantizedTensor.make(
+                    leaf_a, group_k=qc.group_size, num_bits=qc.bits)
+            return leaf
+        tree = jax.tree_util.tree_map_with_path(fused, tree)
+        return maybe_quantize_serving_params(tree, qc)
+
+    @staticmethod
+    def _mm(x, w):
+        """Matmul that transparently routes fused-quantized weights
+        through the int8 Pallas kernel."""
+        from ..ops.quantized_matmul import MatmulQuantizedTensor
+        if isinstance(w, MatmulQuantizedTensor):
+            return w.matmul(x)
+        return x @ w
 
     @staticmethod
     def _keep_fp32(path) -> bool:
@@ -187,7 +224,7 @@ class PagedInferenceModel:
 
     def _layer_leaf_spec(self, path, leaf):
         from jax.sharding import PartitionSpec as P
-        joined = "/".join(str(getattr(k, "key", k)) for k in path)
+        joined = join_path(path)
         if any(n in joined for n in self._COL_NAMES):
             # stacked kernel [L, in, out] -> col; stacked bias [L, out]
             # follows its column shards
@@ -279,16 +316,19 @@ class PagedInferenceModel:
         B, T, _ = h.shape
         D = cfg.head_dim
         def proj(p, x):
-            y = x @ p["kernel"]
+            y = self._mm(x, p["kernel"])
             if "bias" in p:   # qwen-style attention biases
                 y = y + p["bias"]
             return y
         qk = lp["self_attn"]["q_proj"]
         kk = lp["self_attn"]["k_proj"]
         vk = lp["self_attn"]["v_proj"]
-        q = proj(qk, h).reshape(B, T, qk["kernel"].shape[-1] // D, D)
-        k = proj(kk, h).reshape(B, T, kk["kernel"].shape[-1] // D, D)
-        v = proj(vk, h).reshape(B, T, vk["kernel"].shape[-1] // D, D)
+        q = proj(qk, h)
+        k = proj(kk, h)
+        v = proj(vk, h)
+        q = q.reshape(B, T, q.shape[-1] // D, D)
+        k = k.reshape(B, T, k.shape[-1] // D, D)
+        v = v.reshape(B, T, v.shape[-1] // D, D)
         q = apply_rope(q, self.cos, self.sin, positions)
         k = apply_rope(k, self.cos, self.sin, positions)
         return q, k, v
@@ -330,7 +370,7 @@ class PagedInferenceModel:
         q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
         attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
-        proj = attn @ lp["self_attn"]["o_proj"]["kernel"]
+        proj = self._mm(attn, lp["self_attn"]["o_proj"]["kernel"])
         if self.tp > 1:   # row-parallel partial sum (reference :160)
             proj = jax.lax.psum(proj, TENSOR_AXIS)
         x = x + proj
@@ -342,9 +382,10 @@ class PagedInferenceModel:
     def _mlp_out(self, lp, h2):
         """SwiGLU MLP on the post-attention hidden states. Overridden by
         the MoE family (model_moe.py) with routed grouped-GEMM experts."""
-        gate = h2 @ lp["mlp"]["gate_proj"]["kernel"]
-        up = h2 @ lp["mlp"]["up_proj"]["kernel"]
-        mlp = (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
+        gate = self._mm(h2, lp["mlp"]["gate_proj"]["kernel"])
+        up = self._mm(h2, lp["mlp"]["up_proj"]["kernel"])
+        mlp = self._mm(jax.nn.silu(gate) * up,
+                       lp["mlp"]["down_proj"]["kernel"])
         if self.tp > 1:   # (reference :169)
             mlp = jax.lax.psum(mlp, TENSOR_AXIS)
         return mlp

@@ -78,9 +78,9 @@ class PagedFalconModel(PagedInferenceModel):
         q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
         attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
-        attn = attn @ lp["self_attn"]["o_proj"]["kernel"]
-        up = h @ lp["dense_h_to_4h"]["kernel"]
-        mlp = jax.nn.gelu(up) @ lp["dense_4h_to_h"]["kernel"]
+        attn = self._mm(attn, lp["self_attn"]["o_proj"]["kernel"])
+        up = self._mm(h, lp["dense_h_to_4h"]["kernel"])
+        mlp = self._mm(jax.nn.gelu(up), lp["dense_4h_to_h"]["kernel"])
         both = attn + mlp
         if self.tp > 1:   # one psum covers both row-parallel partials
             both = jax.lax.psum(both, TENSOR_AXIS)

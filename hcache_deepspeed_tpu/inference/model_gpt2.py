@@ -44,6 +44,10 @@ class PagedGPT2Model:
         self.tp = 1
         self.quantization = quantization if (
             quantization is not None and quantization.enabled) else None
+        if self.quantization and self.quantization.use_fused_kernel:
+            raise NotImplementedError(
+                "fused-kernel quantized serving covers the llama-trunk "
+                "families; the gpt2 trunk uses the dequant-on-use path")
 
         self.load_params(params)
         self._fwd = jax.jit(self._forward_chunk, donate_argnums=(1, 2))

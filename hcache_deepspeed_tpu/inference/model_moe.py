@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 from ..models.mixtral import MixtralConfig
 from ..moe.dropless import dropless_expert_ffn
 from ..parallel.topology import TENSOR_AXIS
-from .model import PagedInferenceModel
+from .model import PagedInferenceModel, join_path
 
 
 class PagedMoEModel(PagedInferenceModel):
@@ -71,10 +71,10 @@ class PagedMoEModel(PagedInferenceModel):
             renorm)
         out = out.reshape(B, T, d)
         if "shared_gate_proj" in moe:   # qwen2-moe shared expert
-            gate = h2 @ moe["shared_gate_proj"]["kernel"]
-            up = h2 @ moe["shared_up_proj"]["kernel"]
-            shared = (jax.nn.silu(gate) * up) @ \
-                moe["shared_down_proj"]["kernel"]
+            gate = self._mm(h2, moe["shared_gate_proj"]["kernel"])
+            up = self._mm(h2, moe["shared_up_proj"]["kernel"])
+            shared = self._mm(jax.nn.silu(gate) * up,
+                              moe["shared_down_proj"]["kernel"])
             sg = h2 @ moe["shared_expert_gate"]["kernel"]
             out = out + jax.nn.sigmoid(sg) * shared
         if self.tp > 1:   # row-parallel partial sum over expert ff shards
@@ -86,7 +86,7 @@ class PagedMoEModel(PagedInferenceModel):
         specs = super()._param_spec_tree(params)
 
         def fix(path, spec):
-            joined = "/".join(str(getattr(k, "key", k)) for k in path)
+            joined = join_path(path)
             if "/moe/" in joined or joined.endswith("/wg"):
                 if "shared" in joined:
                     # shared-expert kernels carry gate_proj/up_proj/

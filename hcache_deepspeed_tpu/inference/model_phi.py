@@ -61,9 +61,9 @@ class PagedPhiModel(PagedFalconModel):
         D = cfg.head_dim
         a = lp["self_attn"]
         # head counts from the (possibly TP-sharded) kernel widths
-        q = h @ a["q_proj"]["kernel"] + a["q_proj"]["bias"]
-        k = h @ a["k_proj"]["kernel"] + a["k_proj"]["bias"]
-        v = h @ a["v_proj"]["kernel"] + a["v_proj"]["bias"]
+        q = self._mm(h, a["q_proj"]["kernel"]) + a["q_proj"]["bias"]
+        k = self._mm(h, a["k_proj"]["kernel"]) + a["k_proj"]["bias"]
+        v = self._mm(h, a["v_proj"]["kernel"]) + a["v_proj"]["bias"]
         q = q.reshape(B, T, q.shape[-1] // D, D)
         k = k.reshape(B, T, k.shape[-1] // D, D)
         v = v.reshape(B, T, v.shape[-1] // D, D)
@@ -83,9 +83,9 @@ class PagedPhiModel(PagedFalconModel):
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
         attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
         d = lp["self_attn"]["dense"]
-        attn = attn @ d["kernel"]
-        up = h @ lp["fc1"]["kernel"] + lp["fc1"]["bias"]
-        mlp = jax.nn.gelu(up) @ lp["fc2"]["kernel"]
+        attn = self._mm(attn, d["kernel"])
+        up = self._mm(h, lp["fc1"]["kernel"]) + lp["fc1"]["bias"]
+        mlp = self._mm(jax.nn.gelu(up), lp["fc2"]["kernel"])
         both = attn + mlp
         if self.tp > 1:
             # row-parallel partials psum together; their (replicated)

@@ -27,19 +27,56 @@ from . import register_op
 
 
 def quantize_for_matmul(w, group_k=256, num_bits=8):
-    """w: [K, N] -> (q int8 [K, N], scale f32 [K//group_k, N]).
-    Groups run down the contraction dim so a [block_k, N] tile needs only
-    its own scale rows."""
-    K, N = w.shape
+    """w: [K, N] (or stacked [L, K, N]) -> (q int8 same shape, scale f32
+    [(L,) G, N]). Groups run down the contraction dim so a [block_k, N]
+    tile needs only its own scale rows."""
+    *lead, K, N = w.shape
     if K % group_k:
         raise ValueError(f"K={K} not divisible by group_k={group_k}")
     qmax = 2 ** (num_bits - 1) - 1
-    g = w.astype(jnp.float32).reshape(K // group_k, group_k, N)
-    scale = jnp.max(jnp.abs(g), axis=1) / qmax          # [G, N]
+    g = w.astype(jnp.float32).reshape(*lead, K // group_k, group_k, N)
+    scale = jnp.max(jnp.abs(g), axis=-2) / qmax         # [*lead, G, N]
     scale = jnp.where(scale == 0, 1.0, scale)
-    q = jnp.clip(jnp.round(g / scale[:, None, :]), -qmax - 1,
-                 qmax).astype(jnp.int8).reshape(K, N)
+    q = jnp.clip(jnp.round(g / scale[..., None, :]), -qmax - 1,
+                 qmax).astype(jnp.int8).reshape(*lead, K, N)
     return q, scale.astype(jnp.float32)
+
+
+@jax.tree_util.register_pytree_node_class
+class MatmulQuantizedTensor:
+    """Int8 weight in the fused-kernel layout: q ``[(L,) K, N]`` with
+    per-(k-group, n) scales ``[(L,) G, N]``. Slicing the leading dim
+    (lax.scan xs) yields a valid per-layer tensor, like
+    ``QuantizedTensor``'s batched form. Consumed by ``quantized_matmul``
+    — NOT dequantized by ``dequantize_tree`` (that is the point)."""
+
+    def __init__(self, q, scale, group_k, dtype):
+        self.q, self.scale = q, scale
+        self.group_k = int(group_k)
+        self.dtype = dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.group_k, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @classmethod
+    def make(cls, w, group_k=256, num_bits=8):
+        q, scale = quantize_for_matmul(w, group_k=group_k,
+                                       num_bits=num_bits)
+        return cls(q, scale, group_k, w.dtype)
+
+    def matmul(self, x):
+        """x: [..., K] -> [..., N] through the fused kernel (per-layer
+        2D q only — slice the stack first)."""
+        if self.q.ndim != 2:
+            raise ValueError("slice the layer stack before matmul")
+        lead = x.shape[:-1]
+        out = quantized_matmul(x.reshape(-1, x.shape[-1]), self.q,
+                               self.scale, group_k=self.group_k)
+        return out.reshape(*lead, self.q.shape[-1])
 
 
 def reference_quantized_matmul(x, q, scale, group_k=256):

@@ -166,7 +166,11 @@ def quantize_tree(tree, *, group_size=256, num_bits=8, min_size=4096,
     :class:`QuantizedTensor`. ``skip(path)`` exempts leaves (routers,
     norms...); ``batched(path)`` marks stacked ``[L, ...]`` leaves that
     must keep a sliceable leading dim."""
+    from .quantized_matmul import MatmulQuantizedTensor
+
     def one(path, leaf):
+        if isinstance(leaf, (QuantizedTensor, MatmulQuantizedTensor)):
+            return leaf   # already quantized (e.g. fused-kernel layout)
         leaf = jnp.asarray(leaf)
         if (leaf.ndim < 2 or leaf.size < min_size
                 or not jnp.issubdtype(leaf.dtype, jnp.floating)
@@ -178,7 +182,10 @@ def quantize_tree(tree, *, group_size=256, num_bits=8, min_size=4096,
             return leaf if qt is None else qt
         return QuantizedTensor.make(leaf, group_size=group_size,
                                     num_bits=num_bits)
-    return jax.tree_util.tree_map_with_path(one, tree)
+    return jax.tree_util.tree_map_with_path(
+        one, tree,
+        is_leaf=lambda x: isinstance(
+            x, (QuantizedTensor, MatmulQuantizedTensor)))
 
 
 def dequantize_tree(tree):

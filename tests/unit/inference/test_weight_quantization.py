@@ -16,14 +16,15 @@ from hcache_deepspeed_tpu.ops.quantizer import (QuantizedTensor,
                                                 quantize_tree)
 
 
-def _engine(cfg, params, quantized):
+def _engine(cfg, params, quantized, fused=False):
     kw = dict(state_manager={"max_tracked_sequences": 4,
                              "max_context": 128},
               kv_cache={"block_size": 16, "num_blocks": 24,
                         "cache_dtype": "float32"})
     if quantized:
         kw["quantization"] = {"enabled": True, "bits": 8,
-                              "group_size": 64, "min_size": 1024}
+                              "group_size": 64, "min_size": 1024,
+                              "use_fused_kernel": fused}
     return InferenceEngineV2(cfg, params,
                              config=RaggedInferenceEngineConfig(**kw))
 
@@ -135,6 +136,39 @@ class TestQuantizedServing:
         scale = np.abs(lf).max() + 1e-6
         assert np.abs(lf - lq).max() / scale < 0.15
         assert lq[np.argmax(lf)] >= lq.max() - 0.1 * scale
+
+    def test_fused_kernel_mode_close_to_fp(self, family):
+        """use_fused_kernel routes layer matmuls through the int8-weight
+        kernel (its k-groups differ from the dequant path's flat groups,
+        so the comparison target is the fp baseline, same tolerance as
+        the dequant mode)."""
+        if family in ("gpt2", "opt"):
+            pytest.skip("fused mode is llama-trunk only")
+        cfg, params = self._setup(family)
+        rng = np.random.default_rng(5)
+        prompt = list(rng.integers(0, cfg.vocab_size, (10,)))
+        fp = _engine(cfg, params, quantized=False)
+        qf = _engine(cfg, params, quantized=True, fused=True)
+        from hcache_deepspeed_tpu.ops.quantized_matmul import \
+            MatmulQuantizedTensor
+        leaves = jax.tree.leaves(
+            qf.model.params,
+            is_leaf=lambda x: isinstance(x, MatmulQuantizedTensor))
+        assert any(isinstance(l, MatmulQuantizedTensor) for l in leaves)
+        lfp, _ = fp.put([1], [prompt])
+        lf, _ = qf.put([1], [prompt])
+        lfp, lf = np.asarray(lfp[0]), np.asarray(lf[0])
+        scale = np.abs(lfp).max() + 1e-6
+        assert np.abs(lfp - lf).max() / scale < 0.15
+        # restore works through the fused weights too
+        qf2 = _engine(cfg, params, quantized=True, fused=True)
+        _, latents = qf.put([2], [prompt])
+        qf2.restore_kv([2], [prompt], [latents[0]])
+        nxt = int(np.argmax(lf))
+        da, _ = qf.put([2], [[nxt]])
+        db, _ = qf2.put([2], [[nxt]])
+        np.testing.assert_allclose(np.asarray(db[0]), np.asarray(da[0]),
+                                   atol=2e-2)
 
     def test_restore_kv_with_quantized_weights(self, family):
         cfg, params = self._setup(family)
