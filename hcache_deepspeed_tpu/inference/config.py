@@ -30,6 +30,11 @@ class StateManagerConfig(HDSConfigModel):
     max_ragged_batch_size: int = 768      # max total tokens per forward
     max_ragged_sequence_count: int = 512  # max sequences per forward
     max_context: int = 8192               # max tokens of any one sequence
+    #: > 0: prefills longer than this process in chunks of this size
+    #: (the FastGen Dynamic-SplitFuse idea) — prompt length is then
+    #: bounded by max_context, not by the per-forward token budget,
+    #: and long prefills stop monopolizing a forward
+    prefill_chunk: int = Field(0, ge=0)
 
 
 class HCacheConfig(HDSConfigModel):

@@ -138,7 +138,11 @@ class InferenceEngineV2:
             return SchedulingResult.EngineSequenceLimitExceeded
         if len(uids) > sm.max_ragged_sequence_count:
             return SchedulingResult.BatchSequenceLimitExceeded
-        if sum(lengths) > sm.max_ragged_batch_size:
+        # with chunked prefill each forward sees at most prefill_chunk
+        # tokens per sequence, so the batch budget counts the chunk
+        per_fwd = [min(n, sm.prefill_chunk) if sm.prefill_chunk else n
+                   for n in lengths]
+        if sum(per_fwd) > sm.max_ragged_batch_size:
             return SchedulingResult.BatchTokenLimitExceeded
         blocks = 0
         for uid, n in zip(uids, lengths):
@@ -168,6 +172,35 @@ class InferenceEngineV2:
                                        [len(t) for t in batch_tokens])
             if result != SchedulingResult.Success:
                 raise SchedulingError(result)
+
+        # chunked prefill (Dynamic SplitFuse): run the leading chunks of
+        # long prompts round by round — all sequences' chunk-k heads
+        # share ONE dispatch (the shape can_schedule budgeted), KV
+        # allocated as it grows, latents accumulated — leaving tails
+        # <= chunk for the normal mixed decode/prefill batch below
+        chunk = self.config.state_manager.prefill_chunk
+        lead_latents: Dict[int, List] = {}
+        if chunk:
+            while True:
+                long_idx = [i for i, t in enumerate(batch_tokens)
+                            if len(t) > chunk]
+                if not long_idx:
+                    break
+                heads: List = [None] * len(batch_tokens)
+                for i in long_idx:
+                    heads[i] = batch_tokens[i][:chunk]
+                    seq = self.state.get_or_create_sequence(batch_uids[i])
+                    self.state.maybe_allocate_kv(seq, chunk)
+                    seq.pre_forward(chunk)
+                part_l: List = [None] * len(batch_tokens)
+                part_t: List = [None] * len(batch_tokens)
+                self._run_prefill(batch_uids, heads, long_idx,
+                                  _bucket(chunk), part_l, part_t)
+                for i in long_idx:
+                    self.state.get_sequence(batch_uids[i]).post_forward()
+                    if self.config.hcache.enable_latents:
+                        lead_latents.setdefault(i, []).append(part_t[i])
+                    batch_tokens[i] = batch_tokens[i][chunk:]
 
         for uid, tokens in zip(batch_uids, batch_tokens):
             seq = self.state.get_or_create_sequence(uid)
@@ -201,6 +234,12 @@ class InferenceEngineV2:
 
         for uid in batch_uids:
             self.state.get_sequence(uid).post_forward()
+
+        if lead_latents:   # chunked prefill: stitch per-chunk latents
+            for i, parts in lead_latents.items():
+                tail = [latents_out[i]] if latents_out[i] is not None \
+                    else []
+                latents_out[i] = np.concatenate(parts + tail, axis=1)
 
         return np.stack(logits_out), latents_out
 

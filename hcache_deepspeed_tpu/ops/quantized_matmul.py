@@ -50,13 +50,12 @@ class MatmulQuantizedTensor:
     ``QuantizedTensor``'s batched form. Consumed by ``quantized_matmul``
     — NOT dequantized by ``dequantize_tree`` (that is the point)."""
 
-    def __init__(self, q, scale, group_k, dtype):
+    def __init__(self, q, scale, group_k):
         self.q, self.scale = q, scale
         self.group_k = int(group_k)
-        self.dtype = dtype
 
     def tree_flatten(self):
-        return (self.q, self.scale), (self.group_k, self.dtype)
+        return (self.q, self.scale), (self.group_k,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -66,7 +65,7 @@ class MatmulQuantizedTensor:
     def make(cls, w, group_k=256, num_bits=8):
         q, scale = quantize_for_matmul(w, group_k=group_k,
                                        num_bits=num_bits)
-        return cls(q, scale, group_k, w.dtype)
+        return cls(q, scale, group_k)
 
     def matmul(self, x):
         """x: [..., K] -> [..., N] through the fused kernel (per-layer
