@@ -13,8 +13,10 @@ reference's atom-builder/CUDA-graph machinery dissolves into those static
 buckets.
 """
 
+import functools
 from typing import Dict, Iterable, List, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -172,6 +174,7 @@ class InferenceEngineV2:
                                        [len(t) for t in batch_tokens])
             if result != SchedulingResult.Success:
                 raise SchedulingError(result)
+        self._reject_suspended(batch_uids)
 
         # chunked prefill (Dynamic SplitFuse): run the leading chunks of
         # long prompts round by round — all sequences' chunk-k heads
@@ -433,6 +436,8 @@ class InferenceEngineV2:
         latents without a full forward: allocate blocks, then per layer
         replay the K/V projection + RoPE + cache write with host→HBM copies
         double-buffered against compute."""
+        batch_uids = list(batch_uids)
+        self._reject_suspended(batch_uids)
         for uid, tokens, latents in zip(batch_uids, batch_tokens,
                                         batch_latents):
             if latents is None:
@@ -463,6 +468,76 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     def flush(self, uid: int) -> None:
         self.state.flush_sequence(uid)
+
+    # -------------------------------------------------------------- #
+    # Host offload of a sequence's KV (reference: BlockedKVCache's
+    # optional host-offloaded blocks, ragged/kv_cache.py:40). Unlike
+    # HCache restore (recompute-from-latents), suspend/resume moves the
+    # EXACT cache contents — bit-identical continuation, no QKV replay.
+    # -------------------------------------------------------------- #
+    def _reject_suspended(self, uids):
+        """Both cache write paths (put, restore_kv) must refuse suspended
+        sequences BEFORE any allocation/bookkeeping — writing against the
+        stale seen_tokens would corrupt the host copy's accounting."""
+        for uid in uids:
+            seq = self.state.get_sequence(uid)
+            if seq is not None and seq.host_kv is not None:
+                raise RuntimeError(
+                    f"sequence {uid} is suspended (KV on host); call "
+                    "resume_sequence first")
+
+    def _token_slots(self, seq, n):
+        """Flat pool indices of the sequence's first n token slots."""
+        t = np.arange(n)
+        blocks = np.asarray(seq.blocks, np.int64)
+        return blocks[t // self.block_size] * self.block_size + \
+            t % self.block_size
+
+    def suspend_sequence(self, uid: int) -> None:
+        """Copy the sequence's KV to host memory and free its pool
+        blocks. The sequence stays tracked; ``resume_sequence`` swaps it
+        back in (possibly into different blocks)."""
+        seq = self.state.get_sequence(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if seq.host_kv is not None:
+            return   # already suspended
+        idx = self._token_slots(seq, seq.seen_tokens)
+        seq.host_kv = (np.asarray(self.cache.k[:, idx]),
+                       np.asarray(self.cache.v[:, idx]))
+        if seq.blocks:
+            self.state.allocator.free(seq.blocks)
+            seq.blocks = []
+
+    def resume_sequence(self, uid: int) -> None:
+        seq = self.state.get_sequence(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if seq.host_kv is None:
+            return   # not suspended
+        need = self.state.blocks_needed(seq, 0)
+        if need > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+        self.state.maybe_allocate_kv(seq, 0)
+        host_k, host_v = seq.host_kv
+        seq.host_kv = None
+        if seq.seen_tokens == 0:
+            return
+        idx = self._token_slots(seq, seq.seen_tokens)
+        k, v = self._swap_in(
+            self.cache.k, self.cache.v, jnp.asarray(idx),
+            jnp.asarray(host_k, self.cache.k.dtype),
+            jnp.asarray(host_v, self.cache.v.dtype))
+        self.cache.replace(k, v)
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def _swap_in(k, v, idx, host_k, host_v):
+        """Donated scatter: the pool buffers update in place instead of
+        allocating a second full-size pool copy (the pool is sized to
+        nearly fill HBM in reserve mode — an eager .at[].set would OOM
+        exactly at production sizes)."""
+        return k.at[:, idx].set(host_k), v.at[:, idx].set(host_v)
 
     def serialize(self) -> Dict:
         """Host-side engine state (reference serializes scheduling state)."""

@@ -16,6 +16,9 @@ class SequenceDescriptor:
         self.seen_tokens = 0            # tokens whose KV is materialized
         self.in_flight_tokens = 0       # tokens in the current forward
         self.blocks: List[int] = []     # KV pool block ids, in order
+        #: host copy of the KV while suspended (engine.suspend_sequence;
+        #: reference: BlockedKVCache's host-offloaded blocks)
+        self.host_kv = None
 
     @property
     def cur_allocated_blocks(self) -> int:

@@ -1,0 +1,120 @@
+"""Sequence KV host offload (reference: BlockedKVCache's optional
+host-offloaded blocks) — exact suspend/resume, vs HCache restore's
+recompute-from-latents."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig,
+                                            SchedulingError)
+from hcache_deepspeed_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama_tiny(max_positions=128, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    return cfg, params
+
+
+def make_engine(cfg, params, num_blocks=12):
+    return InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": num_blocks,
+                      "cache_dtype": "float32"}))
+
+
+def test_suspend_frees_blocks_resume_continues_exactly(tiny):
+    cfg, params = tiny
+    rng = np.random.default_rng(0)
+    prompt = list(rng.integers(0, cfg.vocab_size, (20,)))
+
+    ref = make_engine(cfg, params)
+    lr, _ = ref.put([1], [prompt])
+    tok = int(np.argmax(lr[0]))
+    ref_dec, _ = ref.put([1], [[tok]])
+
+    eng = make_engine(cfg, params)
+    le, _ = eng.put([1], [prompt])
+    free_before = eng.state.free_blocks
+    eng.suspend_sequence(1)
+    assert eng.state.free_blocks > free_before        # blocks released
+    with pytest.raises(RuntimeError, match="suspended"):
+        eng.put([1], [[tok]])
+    eng.resume_sequence(1)
+    dec, _ = eng.put([1], [[tok]])
+    np.testing.assert_allclose(np.asarray(dec[0]),
+                               np.asarray(ref_dec[0]), atol=1e-5)
+
+
+def test_suspended_blocks_reusable_by_others(tiny):
+    cfg, params = tiny
+    rng = np.random.default_rng(1)
+    # pool of 12 blocks (1 scratch): two 80-token sequences (5 blocks
+    # each) cannot coexist with a third — suspend makes room
+    s1 = list(rng.integers(0, cfg.vocab_size, (80,)))
+    s2 = list(rng.integers(0, cfg.vocab_size, (80,)))
+    eng = make_engine(cfg, params, num_blocks=12)
+    l1, _ = eng.put([1], [s1])
+    eng.suspend_sequence(1)
+    l2, _ = eng.put([2], [s2])      # fits only because 1 is suspended
+    eng.flush(2)
+    eng.resume_sequence(1)
+    tok = int(np.argmax(l1[0]))
+    dec, _ = eng.put([1], [[tok]])
+    ref = make_engine(cfg, params)
+    ref.put([1], [s1])
+    ref_dec, _ = ref.put([1], [[tok]])
+    np.testing.assert_allclose(np.asarray(dec[0]),
+                               np.asarray(ref_dec[0]), atol=1e-5)
+
+
+def test_resume_without_room_raises(tiny):
+    cfg, params = tiny
+    rng = np.random.default_rng(2)
+    # 8 blocks (1 scratch -> 7 usable): each 80-token seq needs 5
+    eng = make_engine(cfg, params, num_blocks=8)
+    s1 = list(rng.integers(0, cfg.vocab_size, (80,)))
+    s2 = list(rng.integers(0, cfg.vocab_size, (80,)))
+    eng.put([1], [s1])
+    eng.suspend_sequence(1)
+    eng.put([2], [s2])              # occupies the freed blocks
+    with pytest.raises(SchedulingError):
+        eng.resume_sequence(1)
+    eng.flush(2)
+    eng.resume_sequence(1)          # room again
+
+
+def test_idempotent_and_empty(tiny):
+    cfg, params = tiny
+    eng = make_engine(cfg, params)
+    eng.put([1], [[1, 2, 3]])
+    eng.suspend_sequence(1)
+    eng.suspend_sequence(1)         # no-op
+    eng.resume_sequence(1)
+    eng.resume_sequence(1)          # no-op
+    with pytest.raises(KeyError):
+        eng.suspend_sequence(99)
+    # zero-token sequence: suspend/resume is a no-op, not a crash
+    eng.state.get_or_create_sequence(5)
+    eng.suspend_sequence(5)
+    eng.resume_sequence(5)
+    logits, _ = eng.put([5], [[7, 8]])
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_restore_kv_rejects_suspended(tiny):
+    cfg, params = tiny
+    eng = make_engine(cfg, params)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    _, latents = eng.put([1], [prompt])
+    eng.suspend_sequence(1)
+    with pytest.raises(RuntimeError, match="suspended"):
+        eng.restore_kv([1], [prompt], [latents[0]])
