@@ -124,7 +124,11 @@ class LlamaAttention(nn.Module):
         k = apply_rope(k, cos, sin)
 
         if self.attention_fn is not None:
-            if KV < H:  # custom fns (Ulysses/ring) take dense heads
+            if KV < H and not getattr(self.attention_fn, "supports_gqa",
+                                      False):
+                # fns without GQA support (e.g. ring) take dense heads;
+                # Ulysses declares supports_gqa and moves compact k/v
+                # through its all-to-alls (H/KV x less wire)
                 rep = H // KV
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)

@@ -361,4 +361,10 @@ def attention(q, k, v, causal=True, scale=None):
     return get_op("flash_attention")(q, k, v, causal=causal, scale=scale)
 
 
+# both paths accept compact GQA k/v (KV heads < q heads) natively —
+# wrappers (Ulysses) consult this to skip the dense-head expansion
+reference_attention.supports_gqa = True
+pallas_attention.supports_gqa = True
+attention.supports_gqa = True
+
 register_op("flash_attention", reference_attention, pallas_attention)

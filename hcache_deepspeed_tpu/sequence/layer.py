@@ -30,6 +30,20 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..parallel.topology import SEQ_AXIS, get_topology
 
 
+def _maybe_expand_kv(q, k, v, sp):
+    """GQA under Ulysses: compact k/v heads scatter across ``seq`` only
+    when sp divides them — the a2a then moves KV-sized tensors (H/KV x
+    less wire than the repeated layout) and the GQA-native local flash
+    kernel does the group broadcast. Indivisible KV expands to q's
+    heads (the old behavior)."""
+    KV, H = k.shape[2], q.shape[2]
+    if KV != H and (sp <= 1 or KV % sp):
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def seq_all_to_all(x, axis_name=SEQ_AXIS, scatter_dim=2, gather_dim=1):
     """Explicit all-to-all: split ``scatter_dim`` across the axis, gather
     ``gather_dim``. Equivalent to the reference's ``_SeqAllToAll.forward``
@@ -49,13 +63,26 @@ class DistributedAttention:
     """
 
     def __init__(self, local_attn: Callable, axis_name: str = SEQ_AXIS,
-                 scatter_idx: int = 2, gather_idx: int = 1):
+                 scatter_idx: int = 2, gather_idx: int = 1,
+                 supports_gqa: Optional[bool] = None):
         self.local_attn = local_attn
         self.axis_name = axis_name
         self.scatter_idx = scatter_idx
         self.gather_idx = gather_idx
+        #: whether LOCAL attention accepts compact GQA k/v; derived from
+        #: the callable unless stated — a wrapped kernel written for
+        #: dense heads must keep getting dense heads
+        self.supports_gqa = getattr(local_attn, "supports_gqa", False) \
+            if supports_gqa is None else supports_gqa
 
     def __call__(self, q, k, v, *args, **kwargs):
+        if self.supports_gqa:
+            k, v = _maybe_expand_kv(
+                q, k, v, jax.lax.axis_size(self.axis_name))
+        elif k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         a2a = lambda x: seq_all_to_all(x, self.axis_name, self.scatter_idx,
                                        self.gather_idx)
         out = self.local_attn(a2a(q), a2a(k), a2a(v), *args, **kwargs)
@@ -78,6 +105,15 @@ def ulysses_attention(q, k, v, causal=True, scale=None, topology=None,
     if topo.seq_size <= 1:
         from ..ops.flash_attention import attention as flash
         return (local_attn or flash)(q, k, v, causal=causal, scale=scale)
+
+    if local_attn is None or getattr(local_attn, "supports_gqa", False):
+        # the built-in flash path (and GQA-declaring custom kernels)
+        # take compact k/v; others get dense heads
+        k, v = _maybe_expand_kv(q, k, v, topo.seq_size)
+    elif k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
 
     mesh = topo.mesh
     batch_axes = topo.batch_shard_axes() or None
@@ -102,4 +138,8 @@ def make_ulysses_attention_fn(topology=None, local_attn=None):
         return ulysses_attention(q, k, v, causal=causal, scale=scale,
                                  topology=topology, local_attn=local_attn)
 
+    # compact k/v accepted iff the local kernel handles GQA (the built-in
+    # flash path does)
+    attention_fn.supports_gqa = local_attn is None or getattr(
+        local_attn, "supports_gqa", False)
     return attention_fn

@@ -105,6 +105,124 @@ class TestUlyssesSharded:
         assert np.isfinite(l1) and l1 < l0, (l0, l1)
 
 
+class TestUlyssesGQA:
+    """GQA-compact k/v through the all-to-alls (KV heads divisible by
+    sp): H/KV x less kv wire, same math as dense heads."""
+
+    def test_sharded_form_matches_dense(self, eight_devices):
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=2,
+                                                                  data=4))
+        rng = np.random.default_rng(0)
+        B, T, H, KV, D = 2, 32, 8, 2, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        ref = reference_attention(q, k, v, causal=True)
+
+        seq_sharding = NamedSharding(topo.mesh,
+                                     PartitionSpec(None, "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, seq_sharding) for x in (q, k, v))
+        fn = jax.jit(functools.partial(ulysses_attention, causal=True,
+                                       topology=topo))
+        out = fn(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_shard_map_form_matches_dense(self, eight_devices):
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=2,
+                                                                  data=4))
+        rng = np.random.default_rng(1)
+        B, T, H, KV, D = 2, 32, 8, 2, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        ref = reference_attention(q, k, v, causal=True)
+
+        from jax import shard_map
+        # partial() drops the function attribute — opt in explicitly
+        # (reference_attention is GQA-native)
+        dist_attn = DistributedAttention(
+            functools.partial(reference_attention, causal=True),
+            supports_gqa=True)
+        assert dist_attn.supports_gqa
+        spec = PartitionSpec(None, "seq", None, None)
+        out = shard_map(dist_attn, mesh=topo.mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_wrapped_plain_kernel_gets_dense_heads(self, eight_devices):
+        """A local kernel without GQA support must receive equal head
+        counts even when compact k/v go in."""
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=2,
+                                                                  data=4))
+        seen = {}
+
+        def plain_kernel(q, k, v, causal=True):
+            seen["shapes"] = (q.shape[2], k.shape[2])
+            return reference_attention(q, k, v, causal=causal)
+
+        rng = np.random.default_rng(5)
+        B, T, H, KV, D = 2, 32, 8, 2, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        from jax import shard_map
+        dist_attn = DistributedAttention(plain_kernel)
+        assert not dist_attn.supports_gqa
+        spec = PartitionSpec(None, "seq", None, None)
+        out = shard_map(dist_attn, mesh=topo.mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+        assert seen["shapes"][0] == seen["shapes"][1]   # dense heads
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_indivisible_kv_falls_back_to_expand(self, eight_devices):
+        """KV=3 heads, sp=2: expansion path, still correct."""
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=2,
+                                                                  data=4))
+        rng = np.random.default_rng(2)
+        B, T, H, KV, D = 2, 32, 6, 3, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        ref = reference_attention(q, k, v, causal=True)
+        seq_sharding = NamedSharding(topo.mesh,
+                                     PartitionSpec(None, "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, seq_sharding) for x in (q, k, v))
+        fn = jax.jit(functools.partial(ulysses_attention, causal=True,
+                                       topology=topo))
+        out = fn(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_gqa_llama_trains_with_ulysses(self, eight_devices):
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM,
+                                                       llama_tiny)
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=2,
+                                                                  data=4))
+        cfg = llama_tiny(n_head=4, n_kv_head=2)   # GQA, KV % sp == 0
+        attention_fn = make_ulysses_attention_fn(topology=topo)
+        assert attention_fn.supports_gqa
+        model = LlamaForCausalLM(cfg, attention_fn=attention_fn)
+        rng = np.random.default_rng(3)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 64),
+                                           dtype=np.int32)}
+        engine, _, _, _ = hds.initialize(
+            model=model, example_batch=batch, topology=topo,
+            config={"train_batch_size": 8,
+                    "train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                    "zero_optimization": {"stage": 2,
+                                          "min_shard_size": 1}})
+        l0 = float(engine.train_batch(batch=batch))
+        for _ in range(4):
+            l1 = float(engine.train_batch(batch=batch))
+        assert np.isfinite(l1) and l1 < l0
+
+
 class TestSPCrossEntropy:
     def test_matches_dense(self, eight_devices):
         topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=8))
