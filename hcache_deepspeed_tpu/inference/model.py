@@ -113,9 +113,12 @@ class PagedInferenceModel:
         if self.tp > 1:
             self._validate_tp()
         self.load_params(params)
-        self.cos, self.sin = rope_frequencies(cfg.head_dim,
-                                              cfg.max_positions,
-                                              cfg.rope_theta)
+        theta = getattr(cfg, "rope_theta", None)
+        self.cos = self.sin = None
+        if theta is not None:
+            self.cos, self.sin = rope_frequencies(cfg.head_dim,
+                                                  cfg.max_positions,
+                                                  theta)
         fwd, restore = self._forward_chunk, self._restore_layer
         if self.tp > 1:
             fwd, restore = self._wrap_tp(fwd, restore)
@@ -407,10 +410,10 @@ class PagedInferenceModel:
         B, T = tokens.shape
         BS = self.block_size
         P = cache_k.shape[1]
-        x = self._embed_lookup(params["embed"], tokens)
-
         offs = jnp.arange(T)
         positions = start[:, None] + offs[None, :]              # [B, T]
+        x = self._embed_lookup(params["embed"], tokens) + \
+            self._embed_extra(params, positions)
         token_valid = offs[None, :] < t_len[:, None]
         local_blk = positions // BS                             # in-table idx
         flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
@@ -449,6 +452,11 @@ class PagedInferenceModel:
         (phi) override."""
         head = params["embed"].T if self.tied else params["lm_head"]
         return (last @ head).astype(jnp.float32)
+
+    def _embed_extra(self, params, positions):
+        """Additive embedding term (learned positions in the gpt2/opt
+        trunk); rope families add nothing here."""
+        return jnp.zeros((), self.cfg.compute_dtype)
 
     def _embed_lookup(self, table, tokens):
         """Embedding lookup. Under TP with tied embeddings the table is

@@ -4,99 +4,115 @@ Reference analog: the v1 kernel-injection containers for gpt2/gpt-neo
 (``module_inject/containers/gpt2.py``) and the v2 model-implementation
 framework's per-arch layer containers — a SECOND architecture served by
 the same ragged engine: LayerNorm (not RMSNorm), learned absolute
-position embeddings (no RoPE), fused c_attn QKV with biases, MHA, tied
-LM head.
+position embeddings (no RoPE), biased projections, MHA, tied LM head.
 
-Consumes ``models.gpt2.GPT2LMHeadModel`` training params directly
-(wte/wpe/h_i/ln_f names), mirrors :class:`PagedInferenceModel`'s
-engine-facing contract (``forward_chunk``, ``restore_kv``,
-``cache_sharding``) so ``InferenceEngineV2`` runs either family.
-Latents (HCache) = the post-ln_1 hidden states, the same pre-QKV
-snapshot point the llama model uses.
+Built on :class:`PagedInferenceModel`'s trunk, which supplies the KV
+plumbing, TP machinery (vocab-parallel tied embedding, sharded KV,
+per-layer psum), quantized serving and HCache restore. The fused HF
+``c_attn`` splits into separate q/k/v at load time — the TP-shardable
+layout (a column shard of the fused [C, 3C] kernel would mix whole-q
+with half-k); biases on the row-parallel projections add once, after
+the psum. Latents (HCache) = the post-ln_1 hidden states.
 """
-
-from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..models.gpt2 import GPT2Config
-from ..ops.paged_attention import paged_attention
-from .model import stack_layer_params
+from ..parallel.topology import TENSOR_AXIS
+from .model import PagedInferenceModel, stack_layer_params
 
 
-class PagedGPT2Model:
-    def __init__(self, cfg: GPT2Config, params, *, block_size: int,
-                 max_blocks_per_seq: int, capture_latents: bool = True,
-                 topology=None, quantization=None):
-        if topology is not None and topology.tensor_size > 1:
-            raise NotImplementedError(
-                "tensor-parallel serving covers the llama/mixtral/"
-                "qwen2-moe/falcon-GQA/phi families; the gpt2 trunk "
-                "(gpt2, opt) serves single-chip / data-parallel")
-        self.cfg = cfg
-        self.block_size = block_size
-        self.max_blocks_per_seq = max_blocks_per_seq
-        self.capture_latents = capture_latents
-        self.n_layers = cfg.n_layer
-        self.topology = topology
-        self.tp = 1
-        self.quantization = quantization if (
-            quantization is not None and quantization.enabled) else None
-        if self.quantization and self.quantization.use_fused_kernel:
-            raise NotImplementedError(
-                "fused-kernel quantized serving covers the llama-trunk "
-                "families; the gpt2 trunk uses the dequant-on-use path")
+class PagedGPT2Model(PagedInferenceModel):
+    _COL_NAMES = ("q_proj", "k_proj", "v_proj", "c_fc")
+    _ROW_NAMES = ("c_proj",)          # attn and mlp output projections
+    _ROW_BIAS_OK = True               # added after the psum below
 
-        self.load_params(params)
-        self._fwd = jax.jit(self._forward_chunk, donate_argnums=(1, 2))
-        self._restore = jax.jit(self._restore_layer, donate_argnums=(1, 2))
+    def __init__(self, cfg: GPT2Config, params, **kw):
+        if not isinstance(cfg, GPT2Config):
+            raise TypeError("PagedGPT2Model needs a GPT2Config")
+        super().__init__(cfg, params, **kw)
 
-    def load_params(self, params):
-        """(Re)load training-layout params into the serving layout — the
-        hybrid engine's per-phase refresh contract (see
-        PagedInferenceModel.load_params). Shapes unchanged ⇒ compiled
-        functions are reused."""
-        from .model import maybe_quantize_serving_params
-        self.params = maybe_quantize_serving_params({
-            "wte": params["wte"]["embedding"],
-            "wpe": params["wpe"]["embedding"],
-            "ln_f": {k: params["ln_f"][k] for k in ("scale", "bias")},
-            "layers": stack_layer_params(params, self.cfg.n_layer,
-                                         prefix="h_"),
-        }, self.quantization)
-
-    def cache_sharding(self):
-        return None
+    def _validate_tp(self):
+        cfg, tp = self.cfg, self.tp
+        for name, val in (("n_head", cfg.n_head),
+                          ("n_embd", cfg.n_embd),
+                          ("vocab_size", cfg.vocab_size)):
+            if val % tp:
+                raise ValueError(f"{name}={val} not divisible by "
+                                 f"tensor parallel degree {tp}")
 
     # -------------------------------------------------------------- #
+    def load_params(self, params):
+        """Training layout -> serving layout: fused c_attn [C, 3C]
+        splits into q/k/v [C, C] (+ biases), everything stacked."""
+        layers = stack_layer_params(params, self.cfg.n_layer, prefix="h_")
+        ca_k = layers["attn"]["c_attn"]["kernel"]      # [L, C, 3C]
+        ca_b = layers["attn"]["c_attn"]["bias"]        # [L, 3C]
+        qk, kk, vk = jnp.split(ca_k, 3, axis=-1)
+        qb, kb, vb = jnp.split(ca_b, 3, axis=-1)
+        new = {
+            "embed": params["wte"]["embedding"],
+            "wpe": params["wpe"]["embedding"],
+            "norm": {k: params["ln_f"][k] for k in ("scale", "bias")},
+            "layers": {
+                "ln_1": layers["ln_1"],
+                "ln_2": layers["ln_2"],
+                "attn": {
+                    "q_proj": {"kernel": qk, "bias": qb},
+                    "k_proj": {"kernel": kk, "bias": kb},
+                    "v_proj": {"kernel": vk, "bias": vb},
+                    "c_proj": layers["attn"]["c_proj"],
+                },
+                "mlp": layers["mlp"],
+            },
+        }
+        self.params = self._finalize_params(new)
+
+    # -------------------------------------------------------------- #
+    def _top_leaf_spec(self, key, path, leaf):
+        from jax.sharding import PartitionSpec as P
+        if key == "wpe":
+            return P()            # positions replicate
+        return super()._top_leaf_spec(key, path, leaf)
+
+    def _embed_extra(self, params, positions):
+        return params["wpe"][positions].astype(self.cfg.compute_dtype)
+
     @staticmethod
     def _ln(x, p, eps):
-        mu = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
-        var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
-        out = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(var + eps)
         return (out * p["scale"] + p["bias"]).astype(x.dtype)
 
-    def _qkv(self, lp, h):
-        """h: [B, T, C] -> q/k/v [B, T, H, D] (fused c_attn, biases)."""
-        cfg = self.cfg
-        B, T, C = h.shape
-        H = cfg.n_head
-        D = C // H
-        qkv = h @ lp["attn"]["c_attn"]["kernel"] + \
-            lp["attn"]["c_attn"]["bias"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        return (q.reshape(B, T, H, D), k.reshape(B, T, H, D),
-                v.reshape(B, T, H, D))
+    def _final_norm(self, params, x):
+        return self._ln(x, params["norm"], self.cfg.layer_norm_epsilon)
 
-    def _scatter_kv(self, ck, cv, k, v, flat_idx):
-        kv_shape = (-1,) + k.shape[2:]
-        ck = ck.at[flat_idx.reshape(-1)].set(
-            k.reshape(kv_shape).astype(ck.dtype), mode="drop")
-        cv = cv.at[flat_idx.reshape(-1)].set(
-            v.reshape(kv_shape).astype(cv.dtype), mode="drop")
-        return ck, cv
+    # -------------------------------------------------------------- #
+    def _qkv(self, lp, h, positions):
+        """Separate biased projections, no rope; head counts from the
+        (possibly TP-sharded) kernel widths."""
+        B, T, _ = h.shape
+        D = self.cfg.head_dim
+        a = lp["attn"]
+        q = self._mm(h, a["q_proj"]["kernel"]) + a["q_proj"]["bias"]
+        k = self._mm(h, a["k_proj"]["kernel"]) + a["k_proj"]["bias"]
+        v = self._mm(h, a["v_proj"]["kernel"]) + a["v_proj"]["bias"]
+        return (q.reshape(B, T, q.shape[-1] // D, D),
+                k.reshape(B, T, k.shape[-1] // D, D),
+                v.reshape(B, T, v.shape[-1] // D, D))
+
+    def _attn_out_parts(self, lp, attn):
+        p = lp["attn"]["c_proj"]
+        return self._mm(attn, p["kernel"]), p["bias"]
+
+    def _mlp_out_parts(self, lp, h2):
+        m = lp["mlp"]
+        ff = jax.nn.gelu(self._mm(h2, m["c_fc"]["kernel"]) +
+                         m["c_fc"]["bias"], approximate=True)
+        return self._mm(ff, m["c_proj"]["kernel"]), m["c_proj"]["bias"]
 
     def _layer_step(self, x, lp, ck, cv, tables, positions, flat_idx,
                     kv_len):
@@ -105,108 +121,16 @@ class PagedGPT2Model:
         h = self._ln(x, lp["ln_1"], eps)
         latent = h if self.capture_latents else jnp.zeros(
             (x.shape[0], x.shape[1], 0), h.dtype)
-        q, k, v = self._qkv(lp, h)
+        q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)
-        B, T, Hq, D = q.shape
-        attn = paged_attention(q, ck, cv, tables, positions[:, 0], kv_len,
-                               self.block_size).reshape(B, T, Hq * D)
-        x = x + self._attn_proj(lp, attn)
+        attn = self._paged_attention(q, ck, cv, tables, positions, kv_len)
+        ap, ab = self._attn_out_parts(lp, attn)
+        if self.tp > 1:
+            ap = jax.lax.psum(ap, TENSOR_AXIS)
+        x = x + ap + ab           # row bias once, after the psum
         h2 = self._ln(x, lp["ln_2"], eps)
-        x = x + self._mlp_out(lp, h2)
-        return x.astype(self.cfg.compute_dtype), ck, cv, latent
-
-    def _attn_proj(self, lp, attn):
-        p = lp["attn"]["c_proj"]
-        return attn @ p["kernel"] + p["bias"]
-
-    def _mlp_out(self, lp, h2):
-        """GELU MLP; the OPT family overrides with ReLU fc1/fc2."""
-        ff = jax.nn.gelu(h2 @ lp["mlp"]["c_fc"]["kernel"] +
-                         lp["mlp"]["c_fc"]["bias"], approximate=True)
-        return ff @ lp["mlp"]["c_proj"]["kernel"] + \
-            lp["mlp"]["c_proj"]["bias"]
-
-    # -------------------------------------------------------------- #
-    def _forward_chunk(self, params, cache_k, cache_v, tokens, start,
-                       tables, t_len):
-        from ..ops.quantizer import dequantize_tree
-        # stacked layers stay int8; each scan step dequantizes one layer
-        params = {k: (v if k == "layers" else dequantize_tree(v))
-                  for k, v in params.items()}
-        B, T = tokens.shape
-        BS = self.block_size
-        P = cache_k.shape[1]
-        offs = jnp.arange(T)
-        positions = start[:, None] + offs[None, :]
-        token_valid = offs[None, :] < t_len[:, None]
-        local_blk = positions // BS
-        flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
-            positions % BS
-        flat_idx = jnp.where(token_valid, flat_idx, P)
-        kv_len = start + t_len
-
-        x = (params["wte"][tokens] + params["wpe"][positions]).astype(
-            self.cfg.compute_dtype)
-
-        def step(x, xs):
-            lp, ck, cv = xs
-            lp = dequantize_tree(lp)   # one layer's weights only
-            x, ck, cv, latent = self._layer_step(
-                x, lp, ck, cv, tables, positions, flat_idx, kv_len)
-            return x, (ck, cv, latent)
-
-        x, (cache_k, cache_v, latents) = jax.lax.scan(
-            step, x, (params["layers"], cache_k, cache_v))
-
-        x = self._ln(x, params["ln_f"], self.cfg.layer_norm_epsilon)
-        last = jnp.take_along_axis(
-            x, jnp.maximum(t_len - 1, 0)[:, None, None], axis=1)[:, 0]
-        logits = (last @ params["wte"].T).astype(jnp.float32)
-        return cache_k, cache_v, logits, latents
-
-    def forward_chunk(self, cache, tokens, start, tables, t_len):
-        ck, cv, logits, latents = self._fwd(
-            self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
-            jnp.asarray(t_len, jnp.int32))
-        cache.replace(ck, cv)
-        return logits, latents
-
-    # -------------------------------------------------------------- #
-    def _restore_layer(self, params, cache_k, cache_v, layer, latent,
-                       start, tables, t_len):
-        from ..ops.quantizer import dequantize_tree
-        lp = jax.tree.map(lambda p: p[layer], params["layers"])
-        lp = dequantize_tree(lp)   # slice then dequantize: one layer
-        B, T, _ = latent.shape
-        BS = self.block_size
-        P = cache_k.shape[1]
-        offs = jnp.arange(T)
-        positions = start[:, None] + offs[None, :]
-        token_valid = offs[None, :] < t_len[:, None]
-        local_blk = positions // BS
-        flat_idx = tables[jnp.arange(B)[:, None], local_blk] * BS + \
-            positions % BS
-        flat_idx = jnp.where(token_valid, flat_idx, P).reshape(-1)
-        _, k, v = self._qkv(lp, latent.astype(self.cfg.compute_dtype))
-        kv_shape = (-1,) + k.shape[2:]
-        cache_k = cache_k.at[layer, flat_idx].set(
-            k.reshape(kv_shape).astype(cache_k.dtype), mode="drop")
-        cache_v = cache_v.at[layer, flat_idx].set(
-            v.reshape(kv_shape).astype(cache_v.dtype), mode="drop")
-        return cache_k, cache_v
-
-    def restore_kv(self, cache, latents, start, tables, t_len):
-        start = jnp.asarray(start, jnp.int32)
-        tables = jnp.asarray(tables, jnp.int32)
-        t_len = jnp.asarray(t_len, jnp.int32)
-        ck, cv = cache.k, cache.v
-        dev = list(ck.devices())[0]
-        buf = jax.device_put(np.asarray(latents[0]), dev)
-        for l in range(self.n_layers):  # noqa: E741
-            cur = buf
-            if l + 1 < self.n_layers:
-                buf = jax.device_put(np.asarray(latents[l + 1]), dev)
-            ck, cv = self._restore(self.params, ck, cv, jnp.int32(l), cur,
-                                   start, tables, t_len)
-        cache.replace(ck, cv)
+        mp, mb = self._mlp_out_parts(lp, h2)
+        if self.tp > 1:
+            mp = jax.lax.psum(mp, TENSOR_AXIS)
+        x = x + mp + mb
+        return x.astype(cfg.compute_dtype), ck, cv, latent

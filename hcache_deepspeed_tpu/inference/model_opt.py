@@ -4,17 +4,15 @@ Reference analog: the opt policy in
 ``deepspeed/inference/v2/engine_factory.py:69`` +
 ``model_implementations/opt/`` (and v1's
 ``module_inject/containers/opt.py``). Reuses the GPT-2 paged trunk
-(LayerNorm + learned positions, no RoPE); OPT differs in separate
-biased q/k/v projections, a ReLU fc1/fc2 MLP, and the +2 position
-offset — the offset is baked in by slicing the first two rows off the
-position table at load time, so the trunk's ``wpe[positions]`` lookup
-stays untouched.
+(LayerNorm + learned positions, no RoPE, bias-after-psum TP); OPT
+differs in separate (already-unfused) q/k/v projections, a ReLU
+fc1/fc2 MLP, and the +2 position offset — baked in by slicing the
+first two rows off the position table at load time.
 
 Consumes ``models.opt.OPTForCausalLM`` training params directly.
 """
 
 import jax
-import jax.numpy as jnp
 
 from ..models.opt import POSITION_OFFSET, OPTConfig
 from .model import stack_layer_params
@@ -22,24 +20,36 @@ from .model_gpt2 import PagedGPT2Model
 
 
 class PagedOPTModel(PagedGPT2Model):
+    _COL_NAMES = ("q_proj", "k_proj", "v_proj", "fc1")
+    _ROW_NAMES = ("out_proj", "fc2")
+    _ROW_BIAS_OK = True
+
     def __init__(self, cfg: OPTConfig, params, **kw):
         if not isinstance(cfg, OPTConfig):
             raise TypeError("PagedOPTModel needs an OPTConfig")
-        super().__init__(cfg, params, **kw)
+        # skip PagedGPT2Model's GPT2Config check
+        super(PagedGPT2Model, self).__init__(cfg, params, **kw)
+
+    def _validate_tp(self):
+        cfg, tp = self.cfg, self.tp
+        for name, val in (("n_head", cfg.n_head),
+                          ("ffn_dim", cfg.ffn_dim),
+                          ("vocab_size", cfg.vocab_size)):
+            if val % tp:
+                raise ValueError(f"{name}={val} not divisible by "
+                                 f"tensor parallel degree {tp}")
 
     def load_params(self, params):
-        """Map HF-layout OPT names onto the gpt2 serving layout where the
-        semantics coincide (ln_1 := self_attn_layer_norm, ln_2 :=
-        per-layer final_layer_norm); attention/MLP weights keep their
-        OPT names and are consumed by the overridden hooks below."""
-        from .model import maybe_quantize_serving_params
+        """HF-layout OPT names onto the gpt2 serving layout (ln_1 :=
+        self_attn_layer_norm, ln_2 := per-layer final_layer_norm; the
+        attention projections are already separate)."""
         layers = stack_layer_params(params, self.cfg.n_layer,
                                     prefix="layers_")
-        self.params = maybe_quantize_serving_params({
-            "wte": params["embed_tokens"]["embedding"],
+        new = {
+            "embed": params["embed_tokens"]["embedding"],
             # slice the reserved rows: trunk positions index from 0
             "wpe": params["embed_positions"]["embedding"][POSITION_OFFSET:],
-            "ln_f": {k: params["final_layer_norm"][k]
+            "norm": {k: params["final_layer_norm"][k]
                      for k in ("scale", "bias")},
             "layers": {
                 "ln_1": layers["self_attn_layer_norm"],
@@ -47,24 +57,15 @@ class PagedOPTModel(PagedGPT2Model):
                 "attn": layers["self_attn"],
                 "mlp": {"fc1": layers["fc1"], "fc2": layers["fc2"]},
             },
-        }, self.quantization)
+        }
+        self.params = self._finalize_params(new)
 
-    def _qkv(self, lp, h):
-        cfg = self.cfg
-        B, T, C = h.shape
-        H, D = cfg.n_head, cfg.head_dim
-        a = lp["attn"]
-        q = h @ a["q_proj"]["kernel"] + a["q_proj"]["bias"]
-        k = h @ a["k_proj"]["kernel"] + a["k_proj"]["bias"]
-        v = h @ a["v_proj"]["kernel"] + a["v_proj"]["bias"]
-        return (q.reshape(B, T, H, D), k.reshape(B, T, H, D),
-                v.reshape(B, T, H, D))
+    def _attn_out_parts(self, lp, attn):
+        p = lp["attn"]["out_proj"]
+        return self._mm(attn, p["kernel"]), p["bias"]
 
-    def _attn_proj(self, lp, attn):
-        o = lp["attn"]["out_proj"]
-        return attn @ o["kernel"] + o["bias"]
-
-    def _mlp_out(self, lp, h2):
+    def _mlp_out_parts(self, lp, h2):
         m = lp["mlp"]
-        ff = jax.nn.relu(h2 @ m["fc1"]["kernel"] + m["fc1"]["bias"])
-        return ff @ m["fc2"]["kernel"] + m["fc2"]["bias"]
+        ff = jax.nn.relu(self._mm(h2, m["fc1"]["kernel"]) +
+                         m["fc1"]["bias"])
+        return self._mm(ff, m["fc2"]["kernel"]), m["fc2"]["bias"]

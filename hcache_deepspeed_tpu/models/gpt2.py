@@ -52,6 +52,10 @@ class GPT2Config:
         return self.n_head  # MHA
 
     @property
+    def tie_word_embeddings(self):
+        return True          # GPT-2 ties wte / LM head
+
+    @property
     def head_dim(self):
         return self.n_embd // self.n_head
 

@@ -48,6 +48,10 @@ class OPTConfig:
         return self.n_head
 
     @property
+    def tie_word_embeddings(self):
+        return True          # OPT ties embed_tokens / LM head
+
+    @property
     def head_dim(self):
         return self.hidden_size // self.n_head
 

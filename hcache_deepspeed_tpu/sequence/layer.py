@@ -30,14 +30,14 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..parallel.topology import SEQ_AXIS, get_topology
 
 
-def _maybe_expand_kv(q, k, v, sp):
+def _maybe_expand_kv(q, k, v, sp, force_dense=False):
     """GQA under Ulysses: compact k/v heads scatter across ``seq`` only
     when sp divides them — the a2a then moves KV-sized tensors (H/KV x
     less wire than the repeated layout) and the GQA-native local flash
-    kernel does the group broadcast. Indivisible KV expands to q's
-    heads (the old behavior)."""
+    kernel does the group broadcast. Indivisible KV (or a local kernel
+    that needs dense heads, ``force_dense``) expands to q's heads."""
     KV, H = k.shape[2], q.shape[2]
-    if KV != H and (sp <= 1 or KV % sp):
+    if KV != H and (force_dense or KV % sp):
         rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
@@ -76,13 +76,9 @@ class DistributedAttention:
             if supports_gqa is None else supports_gqa
 
     def __call__(self, q, k, v, *args, **kwargs):
-        if self.supports_gqa:
-            k, v = _maybe_expand_kv(
-                q, k, v, jax.lax.axis_size(self.axis_name))
-        elif k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        k, v = _maybe_expand_kv(q, k, v,
+                                jax.lax.axis_size(self.axis_name),
+                                force_dense=not self.supports_gqa)
         a2a = lambda x: seq_all_to_all(x, self.axis_name, self.scatter_idx,
                                        self.gather_idx)
         out = self.local_attn(a2a(q), a2a(k), a2a(v), *args, **kwargs)
@@ -102,18 +98,17 @@ def ulysses_attention(q, k, v, causal=True, scale=None, topology=None,
     pair), then back.
     """
     topo = topology or get_topology()
+    # the built-in flash path (and GQA-declaring custom kernels) take
+    # compact k/v; others get dense heads — including on the sp=1 fast
+    # path, so behavior doesn't change with topology
+    dense = not (local_attn is None
+                 or getattr(local_attn, "supports_gqa", False))
     if topo.seq_size <= 1:
         from ..ops.flash_attention import attention as flash
+        k, v = _maybe_expand_kv(q, k, v, 1, force_dense=dense)
         return (local_attn or flash)(q, k, v, causal=causal, scale=scale)
 
-    if local_attn is None or getattr(local_attn, "supports_gqa", False):
-        # the built-in flash path (and GQA-declaring custom kernels)
-        # take compact k/v; others get dense heads
-        k, v = _maybe_expand_kv(q, k, v, topo.seq_size)
-    elif k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = _maybe_expand_kv(q, k, v, topo.seq_size, force_dense=dense)
 
     mesh = topo.mesh
     batch_axes = topo.batch_shard_axes() or None

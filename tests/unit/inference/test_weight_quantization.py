@@ -141,9 +141,7 @@ class TestQuantizedServing:
         """use_fused_kernel routes layer matmuls through the int8-weight
         kernel (its k-groups differ from the dequant path's flat groups,
         so the comparison target is the fp baseline, same tolerance as
-        the dequant mode)."""
-        if family in ("gpt2", "opt"):
-            pytest.skip("fused mode is llama-trunk only")
+        the dequant mode); every trunk supports it."""
         cfg, params = self._setup(family)
         rng = np.random.default_rng(5)
         prompt = list(rng.integers(0, cfg.vocab_size, (10,)))
