@@ -13,7 +13,7 @@ fused XLA pass, no module surgery. The bit width is a trace-time
 constant per schedule stage, so each bit level compiles once.
 """
 
-from typing import Optional
+
 
 import jax
 import jax.numpy as jnp

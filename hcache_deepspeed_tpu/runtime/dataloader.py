@@ -11,7 +11,6 @@ sharding-by-rank happens here (each process reads its slice), matching the
 reference's DistributedSampler.
 """
 
-import math
 
 import numpy as np
 

@@ -23,7 +23,7 @@ TPU re-design:
 """
 
 import functools
-from typing import Optional
+
 
 import jax
 import jax.numpy as jnp
