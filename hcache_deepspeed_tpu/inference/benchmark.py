@@ -20,7 +20,8 @@ import time
 import numpy as np
 
 
-def _engine(model_size: str, max_context: int, batch: int):
+def _engine(model_size: str, max_context: int, batch: int,
+            quantize: str = "", prefill_chunk: int = 0):
     import jax
 
     from ..models.llama import LlamaConfig, LlamaForCausalLM
@@ -44,25 +45,35 @@ def _engine(model_size: str, max_context: int, batch: int):
     params = model.init(jax.random.PRNGKey(0), batch_init,
                         train=False)["params"]
     blocks_needed = batch * (-(-max_context // 64)) + 2
+    quant = {}
+    if quantize:
+        quant = {"enabled": True, "bits": 8, "group_size": 64,
+                 "min_size": 1024,
+                 "use_fused_kernel": quantize == "fused"}
     eng = InferenceEngineV2(
         cfg, params,
         config=RaggedInferenceEngineConfig(
             state_manager={"max_tracked_sequences": max(batch, 8),
                            "max_ragged_batch_size": 8192,
                            "max_ragged_sequence_count": max(batch, 8),
-                           "max_context": max_context},
+                           "max_context": max_context,
+                           "prefill_chunk": prefill_chunk},
             kv_cache={"block_size": 64, "num_blocks": blocks_needed,
                       "cache_dtype": "bfloat16"},
+            quantization=quant,
             hcache={"enable_latents": False}))
     return cfg, eng
 
 
 def run(model_size="tiny", max_context=512, prompt_len=128,
-        decode_steps=64, batches=(1, 4, 8)):
+        decode_steps=64, batches=(1, 4, 8), quantize="",
+        prefill_chunk=0):
     results = []
     rng = np.random.default_rng(0)
     for batch in batches:
-        cfg, eng = _engine(model_size, max_context, batch)
+        cfg, eng = _engine(model_size, max_context, batch,
+                           quantize=quantize,
+                           prefill_chunk=prefill_chunk)
         prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
                    for _ in range(batch)]
         uids = list(range(batch))
@@ -126,8 +137,15 @@ def main(argv=None):
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--decode-steps", type=int, default=64)
     p.add_argument("--batches", type=int, nargs="+", default=[1, 4, 8])
+    p.add_argument("--quantize", default="", choices=("", "int8", "fused"),
+                   help="weight-only int8 serving; 'fused' routes through "
+                        "the int8-weight Pallas kernel")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="Dynamic-SplitFuse chunk size (0 = off)")
     args = p.parse_args(argv)
     for r in run(args.model, args.max_context, args.prompt_len,
-                 args.decode_steps, tuple(args.batches)):
+                 args.decode_steps, tuple(args.batches),
+                 quantize=args.quantize,
+                 prefill_chunk=args.prefill_chunk):
         print(json.dumps(r), flush=True)
     return 0
