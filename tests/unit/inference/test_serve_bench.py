@@ -1,0 +1,16 @@
+"""Serving benchmark smoke (reference: the FastGen bench harness) —
+keeps the measurement tool itself green across engine changes."""
+
+from hcache_deepspeed_tpu.inference.benchmark import run
+
+
+def test_serve_bench_all_modes():
+    for kw in ({}, {"quantize": "int8"}, {"prefill_chunk": 32}):
+        results = run(model_size="tiny", max_context=128, prompt_len=32,
+                      decode_steps=4, batches=(1,), **kw)
+        phases = {r["phase"] for r in results}
+        assert "prefill" in phases and "decode" in phases
+        assert "decode-context-scaling" in phases
+        for r in results:
+            if "tokens_per_sec" in r:
+                assert r["tokens_per_sec"] > 0
