@@ -25,9 +25,10 @@ from .engine import HDSEngine
 
 
 class HybridEngine:
-    """Wraps a training :class:`HDSEngine` whose model is a causal LM of
-    the Llama family (``models.llama.LlamaForCausalLM`` layout) and serves
-    ``generate()`` from the same weights.
+    """Wraps a training :class:`HDSEngine` whose model is any causal LM
+    the ragged engine serves (llama/gpt2/opt/falcon/phi/mixtral/
+    qwen2-moe layouts — the paged models consume training param trees
+    directly) and serves ``generate()`` from the same weights.
 
     Parameters refresh into the serving layout lazily: the first
     ``generate()`` after one or more ``train_batch()`` calls pays one
