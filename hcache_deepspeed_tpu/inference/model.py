@@ -104,10 +104,16 @@ class PagedInferenceModel:
         self.tp = topology.tensor_size if topology is not None else 1
         self.quantization = quantization if (
             quantization is not None and quantization.enabled) else None
-        if self.quantization and self.tp > 1:
+        if self.quantization and self.tp > 1 \
+                and not self.quantization.use_fused_kernel:
+            # the dequant-mode batched layout quantizes each layer's FLAT
+            # stream — groups cross the would-be shard boundary. The
+            # fused layout's k-groups run down K per column, so col/row
+            # shards stay group-pure.
             raise NotImplementedError(
-                "weight-only quantized serving is single-chip/DP for "
-                "now (the TP spec tree maps full-precision leaves)")
+                "tensor-parallel quantized serving requires "
+                "quantization.use_fused_kernel=true (the dequant-mode "
+                "flat groups straddle shard boundaries)")
 
         self.tied = cfg.tie_word_embeddings
         if self.tp > 1:
@@ -173,16 +179,33 @@ class PagedInferenceModel:
         def fused(path, leaf):
             joined = join_path(path)
             leaf_a = jnp.asarray(leaf)
-            if (path and str(getattr(path[0], "key", path[0])) == "layers"
+            if not (path and str(getattr(path[0], "key",
+                                         path[0])) == "layers"
                     and leaf_a.ndim == 3
                     and any(n in joined for n in names)
                     and joined.endswith("kernel")
                     and leaf_a.shape[-2] % qc.group_size == 0
                     and leaf_a.size >= qc.min_size):
-                return MatmulQuantizedTensor.make(
-                    leaf_a, group_k=qc.group_size, num_bits=qc.bits)
-            return leaf
+                return leaf
+            if self.tp > 1:
+                # shard-alignment: col shards split N (scales follow);
+                # row shards split K and its group dim, so the local K
+                # must stay a group multiple. Misaligned leaves stay
+                # full precision (sharded by the name rules as usual).
+                K, N = leaf_a.shape[-2], leaf_a.shape[-1]
+                if any(n in joined for n in self._ROW_NAMES):
+                    if K % self.tp or (K // self.tp) % qc.group_size:
+                        return leaf
+                elif N % self.tp:
+                    return leaf
+            return MatmulQuantizedTensor.make(
+                leaf_a, group_k=qc.group_size, num_bits=qc.bits)
         tree = jax.tree_util.tree_map_with_path(fused, tree)
+        if self.tp > 1:
+            # non-layer leaves (untied head) would quantize in the FLAT
+            # layout whose groups straddle the vocab shard — they stay
+            # full precision under TP
+            return tree
         return maybe_quantize_serving_params(tree, qc)
 
     @staticmethod

@@ -43,6 +43,16 @@ class PagedMoEModel(PagedInferenceModel):
     def __init__(self, cfg: MixtralConfig, params, **kw):
         if not isinstance(cfg, MixtralConfig):
             raise TypeError("PagedMoEModel needs a MixtralConfig")
+        topo = kw.get("topology")
+        quant = kw.get("quantization")
+        if topo is not None and topo.tensor_size > 1 and quant is not None \
+                and quant.enabled:
+            # raise the accurate family-level message BEFORE the base
+            # class suggests use_fused_kernel (which would not help here)
+            raise NotImplementedError(
+                "tensor-parallel quantized serving is not available for "
+                "the MoE family (expert-stack quantization groups are "
+                "not shard-aligned)")
         super().__init__(cfg, params, **kw)
 
     def _validate_tp(self):
