@@ -80,9 +80,9 @@ def _ensure_loaded():
     if _loaded:
         return
     _loaded = True
-    from . import (flash_attention, fp_quantizer,  # noqa: F401
-                   grouped_gemm, paged_attention, quantized_matmul,
-                   quantizer, rms_norm, rope)
+    from . import (evoformer_attention, flash_attention,  # noqa: F401
+                   fp_quantizer, grouped_gemm, paged_attention,
+                   quantized_matmul, quantizer, rms_norm, rope)
 
 
 __all__ = ["register_op", "get_op", "get_op_impl", "op_report"]

@@ -1,0 +1,471 @@
+"""Evoformer (DS4Science) attention: flash attention with additive biases.
+
+Reference analog: ``csrc/deepspeed4science/evoformer_attn/`` (~14.9k LoC of
+CUTLASS fused attention) behind ``DS4Sci_EvoformerAttention`` — AlphaFold-style
+MSA-row / triangle attention where the logits take up to two additive biases:
+
+- ``bias1`` with shape ``[B, N, 1, 1, K]`` — the per-row mask bias
+  (broadcast over heads and query positions),
+- ``bias2`` with shape ``[B, 1, H, Q, K]`` — the pair-representation bias
+  (broadcast over the N MSA rows; trainable, so it needs a gradient).
+
+TPU-native design: not a CUTLASS port — the same online-softmax blocked
+kernel family as ``ops/flash_attention.py`` with the bias tiles streamed
+alongside K/V (their BlockSpec index maps express the broadcasts, so no
+materialized ``[B, N, H, Q, K]`` logits exist at any point). The backward
+is the standard two-kernel flash backward plus one recompute kernel per
+requested bias gradient whose grid order makes the broadcast-sum an
+in-VMEM block accumulation (db2 sums over N with n as the innermost grid
+axis; db1 sums over heads and query blocks with a fused (h, qi) axis).
+fp32 accumulation throughout; matmuls stay in the input dtype for full
+MXU rate.
+
+Layout: q/k/v ``[B, N, S, H, D]`` (batch, rows, seq, heads, head_dim),
+matching the reference op's calling convention.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import register_op
+from .flash_attention import _NEG_INF, _default_scale, _fit_block
+
+
+# ------------------------------------------------------------------ #
+# Reference implementation (always available; full autodiff)
+# ------------------------------------------------------------------ #
+def reference_evoformer_attention(q, k, v, bias1=None, bias2=None,
+                                  scale=None):
+    """[B, N, S, H, D] in/out; biases broadcast against [B, N, H, S, S]."""
+    B, N, S, H, D = q.shape
+    scale = scale or _default_scale(D)
+    s = jnp.einsum("bnqhd,bnkhd->bnhqk", q, k).astype(jnp.float32) * scale
+    if bias1 is not None:
+        s = s + bias1.astype(jnp.float32)
+    if bias2 is not None:
+        s = s + bias2.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnhqk,bnkhd->bnqhd", p, v)
+
+
+# ------------------------------------------------------------------ #
+# shared kernel pieces
+# ------------------------------------------------------------------ #
+def _split_bias_refs(rest, has_b1, has_b2):
+    """Input refs after q/k/v, in declaration order: [b1?, b2?, *extras]."""
+    i = 0
+    b1_ref = b2_ref = None
+    if has_b1:
+        b1_ref = rest[i]
+        i += 1
+    if has_b2:
+        b2_ref = rest[i]
+        i += 1
+    return b1_ref, b2_ref, rest[i:]
+
+
+def _logits(q_ref, k_ref, b1_ref, b2_ref, scale):
+    s = jax.lax.dot_general(
+        q_ref[0, 0, 0], k_ref[0, 0, 0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if b1_ref is not None:
+        s = s + b1_ref[0, 0, 0].astype(jnp.float32)  # [block_k] row bias
+    if b2_ref is not None:
+        s = s + b2_ref[0, 0, 0].astype(jnp.float32)  # [block_q, block_k]
+    return s
+
+
+def _bias_specs(block_q, block_k, has_b1, has_b2, order):
+    """BlockSpecs for bias1 [B,N,1,K] and bias2 [B,1,H,Q,K]; ``order``
+    maps grid ids to (b, n, h, qi, ki) — the index maps express the
+    broadcasts (bias1 ignores h/qi, bias2 ignores n)."""
+    specs = []
+    if has_b1:
+        def b1_map(*ids):
+            b, n, h, qi, ki = order(*ids)
+            return (b, n, 0, ki)
+        specs.append(pl.BlockSpec((1, 1, 1, block_k), b1_map))
+    if has_b2:
+        def b2_map(*ids):
+            b, n, h, qi, ki = order(*ids)
+            return (b, 0, h, qi, ki)
+        specs.append(pl.BlockSpec((1, 1, 1, block_q, block_k), b2_map))
+    return specs
+
+
+# ------------------------------------------------------------------ #
+# Pallas forward
+# ------------------------------------------------------------------ #
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, has_b1, has_b2):
+    b1_ref, b2_ref, rest = _split_bias_refs(rest, has_b1, has_b2)
+    o_ref, lse_ref, acc, m_s, l_s = rest
+    ki = pl.program_id(4)
+    nk = pl.num_programs(4)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    s = _logits(q_ref, k_ref, b1_ref, b2_ref, scale)
+    m_prev = m_s[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_s[:, :1] = corr * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    m_s[:, :1] = m_new
+    acc[:] = acc[:] * corr + jax.lax.dot(
+        p.astype(v_ref.dtype), v_ref[0, 0, 0],
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _out():
+        l = l_s[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, 0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = m_s[:, :1] + jnp.log(l)
+
+
+def _fwd_pallas(q, k, v, bias1, bias2, scale, block_q, block_k, interpret):
+    B, N, S, H, D = q.shape
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    qt = q.transpose(0, 1, 3, 2, 4)  # [B,N,H,S,D]
+    kt = k.transpose(0, 1, 3, 2, 4)
+    vt = v.transpose(0, 1, 3, 2, 4)
+    nq, nk = S // block_q, S // block_k
+    order = lambda b, n, h, qi, ki: (b, n, h, qi, ki)
+    qs = pl.BlockSpec((1, 1, 1, block_q, D),
+                      lambda b, n, h, qi, ki: (b, n, h, qi, 0))
+    ks = pl.BlockSpec((1, 1, 1, block_k, D),
+                      lambda b, n, h, qi, ki: (b, n, h, ki, 0))
+    in_specs = [qs, ks, ks] + _bias_specs(block_q, block_k, has_b1,
+                                          has_b2, order)
+    inputs = [qt, kt, vt]
+    if has_b1:
+        inputs.append(bias1.reshape(B, N, 1, S))
+    if has_b2:
+        inputs.append(bias2)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, has_b1=has_b1,
+                          has_b2=has_b2),
+        grid=(B, N, H, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, block_q, D),
+                         lambda b, n, h, qi, ki: (b, n, h, qi, 0)),
+            pl.BlockSpec((1, 1, 1, block_q, 1),
+                         lambda b, n, h, qi, ki: (b, n, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, N, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, N, H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return out.transpose(0, 1, 3, 2, 4), lse
+
+
+# ------------------------------------------------------------------ #
+# Pallas backward
+# ------------------------------------------------------------------ #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, has_b1, has_b2):
+    b1_ref, b2_ref, rest = _split_bias_refs(rest, has_b1, has_b2)
+    do_ref, lse_ref, delta_ref, dq_ref, dq_acc = rest
+    ki = pl.program_id(4)
+    nk = pl.num_programs(4)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    p = jnp.exp(_logits(q_ref, k_ref, b1_ref, b2_ref, scale)
+                - lse_ref[0, 0, 0])
+    dp = jax.lax.dot_general(
+        do_ref[0, 0, 0], v_ref[0, 0, 0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta_ref[0, 0, 0]) * scale).astype(k_ref.dtype)
+    dq_acc[:] += jax.lax.dot(ds, k_ref[0, 0, 0],
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _out():
+        dq_ref[0, 0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, has_b1, has_b2):
+    b1_ref, b2_ref, rest = _split_bias_refs(rest, has_b1, has_b2)
+    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    qi = pl.program_id(4)
+    nq = pl.num_programs(4)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    p = jnp.exp(_logits(q_ref, k_ref, b1_ref, b2_ref, scale)
+                - lse_ref[0, 0, 0])
+    do = do_ref[0, 0, 0]
+    pc = p.astype(do.dtype)
+    dv_acc[:] += jax.lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v_ref[0, 0, 0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta_ref[0, 0, 0]) * scale).astype(q_ref.dtype)
+    dk_acc[:] += jax.lax.dot_general(ds, q_ref[0, 0, 0],
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _out():
+        dk_ref[0, 0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_db_kernel(q_ref, k_ref, v_ref, *rest, scale, has_b1, has_b2,
+                   which, acc_axis_id, acc_axis_len):
+    """Recompute ds for one (q-block, k-block) tile and accumulate its
+    broadcast-sum into the bias gradient. ``which`` selects db2 (sum over
+    rows N) or db1 (sum over heads and query blocks). Note d(bias) = ds
+    WITHOUT the *scale factor — the biases add to the logits after
+    scaling."""
+    b1_ref, b2_ref, rest = _split_bias_refs(rest, has_b1, has_b2)
+    do_ref, lse_ref, delta_ref, db_ref, db_acc = rest
+    step = pl.program_id(acc_axis_id)
+
+    @pl.when(step == 0)
+    def _init():
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    p = jnp.exp(_logits(q_ref, k_ref, b1_ref, b2_ref, scale)
+                - lse_ref[0, 0, 0])
+    dp = jax.lax.dot_general(
+        do_ref[0, 0, 0], v_ref[0, 0, 0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, 0, 0])
+    if which == "b2":
+        db_acc[:] += ds
+    else:  # b1: one [block_k] row — reduce the tile's query rows here
+        db_acc[:1] += jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(step == acc_axis_len - 1)
+    def _out():
+        if which == "b2":
+            db_ref[0, 0, 0] = db_acc[:].astype(db_ref.dtype)
+        else:
+            db_ref[0, 0, 0, 0] = db_acc[0].astype(db_ref.dtype)
+
+
+def _bwd_pallas(scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias1, bias2, out, lse = res
+    B, N, S, H, D = q.shape
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    qt, kt, vt = (x.transpose(0, 1, 3, 2, 4) for x in (q, k, v))
+    dot = g.transpose(0, 1, 3, 2, 4)
+    ot = out.transpose(0, 1, 3, 2, 4)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,N,H,S,1]
+    nq, nk = S // block_q, S // block_k
+
+    def inputs():
+        xs = [qt, kt, vt]
+        if has_b1:
+            xs.append(bias1.reshape(B, N, 1, S))
+        if has_b2:
+            xs.append(bias2)
+        return xs + [dot, lse, delta]
+
+    def specs(order):
+        qs = pl.BlockSpec((1, 1, 1, block_q, D),
+                          lambda *ids: order(*ids)[:3] + (order(*ids)[3], 0))
+        ks = pl.BlockSpec((1, 1, 1, block_k, D),
+                          lambda *ids: order(*ids)[:3] + (order(*ids)[4], 0))
+        rs = pl.BlockSpec((1, 1, 1, block_q, 1),
+                          lambda *ids: order(*ids)[:3] + (order(*ids)[3], 0))
+        return ([qs, ks, ks]
+                + _bias_specs(block_q, block_k, has_b1, has_b2, order)
+                + [qs, rs, rs])
+
+    def order_q(b, n, h, qi, ki):
+        return (b, n, h, qi, ki)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, has_b1=has_b1,
+                          has_b2=has_b2),
+        grid=(B, N, H, nq, nk),
+        in_specs=specs(order_q),
+        out_specs=pl.BlockSpec((1, 1, 1, block_q, D),
+                               lambda b, n, h, qi, ki: (b, n, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, N, H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(*inputs())
+
+    def order_kv(b, n, h, ki, qi):
+        return (b, n, h, qi, ki)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, has_b1=has_b1,
+                          has_b2=has_b2),
+        grid=(B, N, H, nk, nq),
+        in_specs=specs(order_kv),
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, block_k, D),
+                         lambda b, n, h, ki, qi: (b, n, h, ki, 0)),
+            pl.BlockSpec((1, 1, 1, block_k, D),
+                         lambda b, n, h, ki, qi: (b, n, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, N, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, N, H, S, D), q.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(*inputs())
+
+    db1 = db2 = None
+    if has_b2:
+        # db2[b, 0, h, q, k] = sum_n ds: n innermost → consecutive grid
+        # steps revisit the same output block, accumulating in VMEM
+        def order2(b, h, qi, ki, n):
+            return (b, n, h, qi, ki)
+
+        db2 = pl.pallas_call(
+            functools.partial(_bwd_db_kernel, scale=scale, has_b1=has_b1,
+                              has_b2=has_b2, which="b2", acc_axis_id=4,
+                              acc_axis_len=N),
+            grid=(B, H, nq, nk, N),
+            in_specs=specs(order2),
+            out_specs=pl.BlockSpec(
+                (1, 1, 1, block_q, block_k),
+                lambda b, h, qi, ki, n: (b, 0, h, qi, ki)),
+            out_shape=jax.ShapeDtypeStruct((B, 1, H, S, S), bias2.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, block_k), jnp.float32)],
+            interpret=interpret,
+        )(*inputs())
+    if has_b1:
+        # db1[b, n, 0, 0, k] = sum_{h,q} ds: fuse (h, qi) into one
+        # innermost grid axis so the revisit-accumulate rule applies
+        HQ = H * nq
+
+        def order1(b, n, ki, hq):
+            return (b, n, hq // nq, hq % nq, ki)
+
+        db1 = pl.pallas_call(
+            functools.partial(_bwd_db_kernel, scale=scale, has_b1=has_b1,
+                              has_b2=has_b2, which="b1", acc_axis_id=3,
+                              acc_axis_len=HQ),
+            grid=(B, N, nk, HQ),
+            in_specs=specs(order1),
+            out_specs=pl.BlockSpec(
+                (1, 1, 1, 1, block_k),
+                lambda b, n, ki, hq: (b, n, 0, 0, ki)),
+            out_shape=jax.ShapeDtypeStruct((B, N, 1, 1, S), bias1.dtype),
+            # one sublane tile — the kernel only accumulates row 0
+            scratch_shapes=[pltpu.VMEM((8, block_k), jnp.float32)],
+            interpret=interpret,
+        )(*inputs())
+
+    to_bnshd = lambda x: x.transpose(0, 1, 3, 2, 4)
+    return to_bnshd(dq), to_bnshd(dk), to_bnshd(dv), db1, db2
+
+
+# ------------------------------------------------------------------ #
+# custom_vjp wrapper (sentinel 0-d arrays stand in for absent biases —
+# custom_vjp needs a fixed differentiable signature; sentinels never
+# reach pallas_call, the input lists are built per-flag)
+# ------------------------------------------------------------------ #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _evo(q, k, v, bias1, bias2, scale, block_q, block_k, interpret):
+    out, _ = _fwd_pallas(q, k, v,
+                         bias1 if bias1.ndim else None,
+                         bias2 if bias2.ndim else None,
+                         scale, block_q, block_k, interpret)
+    return out
+
+
+def _evo_fwd(q, k, v, bias1, bias2, scale, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v,
+                           bias1 if bias1.ndim else None,
+                           bias2 if bias2.ndim else None,
+                           scale, block_q, block_k, interpret)
+    return out, (q, k, v, bias1, bias2, out, lse)
+
+
+def _evo_bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias1, bias2, out, lse = res
+    dq, dk, dv, db1, db2 = _bwd_pallas(
+        scale, block_q, block_k, interpret,
+        (q, k, v,
+         bias1 if bias1.ndim else None,
+         bias2 if bias2.ndim else None, out, lse), g)
+    if db1 is None:
+        db1 = jnp.zeros_like(bias1)
+    else:
+        db1 = db1.reshape(bias1.shape)
+    if db2 is None:
+        db2 = jnp.zeros_like(bias2)
+    return dq, dk, dv, db1, db2
+
+
+_evo.defvjp(_evo_fwd, _evo_bwd)
+
+
+def pallas_evoformer_attention(q, k, v, bias1=None, bias2=None, scale=None,
+                               block_q=128, block_k=128, interpret=None):
+    B, N, S, H, D = q.shape
+    scale = scale or _default_scale(D)
+    if interpret is None:
+        from ..platform import get_platform
+        interpret = not get_platform().supports_pallas()
+
+    block_q, block_k = _fit_block(block_q, S), _fit_block(block_k, S)
+    ok = (block_q >= 128 and block_k >= 128 and S % block_q == 0
+          and S % block_k == 0)
+    if not interpret and (block_q % 8 or block_k % 128):
+        # Mosaic tiling: the [block_q, block_k] logits tile needs an
+        # (8,128)-aligned layout on real hardware (same guard as
+        # flash_attention)
+        ok = False
+    if not interpret and D % 128 and D not in (32, 64):
+        # lane tiling: D must be 128-aligned (32/64 are the packable
+        # exceptions Mosaic handles; evoformer head dims are typically 32)
+        ok = False
+    if not ok:
+        return reference_evoformer_attention(q, k, v, bias1, bias2,
+                                             scale=scale)
+    b1 = jnp.zeros((), q.dtype) if bias1 is None else bias1
+    b2 = jnp.zeros((), q.dtype) if bias2 is None else bias2
+    return _evo(q, k, v, b1, b2, scale, block_q, block_k, interpret)
+
+
+def evoformer_attention(q, k, v, biases=(), scale=None):
+    """Dispatching entry point, reference-op calling convention:
+    ``biases`` is a sequence of up to two tensors — ``[B,N,1,1,K]`` mask
+    bias and/or ``[B,1,H,Q,K]`` pair bias, recognised by shape."""
+    bias1 = bias2 = None
+    for b in biases:
+        if b is None:
+            continue
+        if b.shape[2] == 1 and b.shape[3] == 1:
+            bias1 = b
+        else:
+            bias2 = b
+    from . import get_op
+    return get_op("evoformer_attention")(q, k, v, bias1=bias1, bias2=bias2,
+                                         scale=scale)
+
+
+register_op("evoformer_attention", reference_evoformer_attention,
+            pallas_evoformer_attention)

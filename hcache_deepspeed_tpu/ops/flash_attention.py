@@ -33,6 +33,17 @@ def _default_scale(head_dim):
     return 1.0 / (head_dim ** 0.5)
 
 
+def _fit_block(block, seq_len):
+    """Largest block <= requested that divides seq_len (stepping down
+    through 128-multiples keeps e.g. T=1280 on the kernel at block 256
+    instead of silently falling back to the O(T^2)-memory reference
+    path)."""
+    block = min(block, seq_len)
+    while block >= 128 and seq_len % block:
+        block -= 128
+    return block
+
+
 # ------------------------------------------------------------------ #
 # Reference implementation (always available; CPU/debug path)
 # ------------------------------------------------------------------ #
@@ -332,15 +343,7 @@ def pallas_attention(q, k, v, causal=True, scale=None, block_q=512,
     if interpret is None:
         from ..platform import get_platform
         interpret = not get_platform().supports_pallas()
-    # largest block <= requested that divides T (stepping down through
-    # 128-multiples keeps e.g. T=1280 on the kernel at block 256 instead
-    # of silently falling back to the O(T^2)-memory reference path)
-    def fit(block):
-        block = min(block, T)
-        while block >= 128 and T % block:
-            block -= 128
-        return block
-    block_q, block_k = fit(block_q), fit(block_k)
+    block_q, block_k = _fit_block(block_q, T), _fit_block(block_k, T)
     if block_q < 128 or block_k < 128 or T % block_q or T % block_k:
         return reference_attention(q, k, v, causal=causal, scale=scale)
     if not interpret and (block_q % 8 or block_k % 128):
