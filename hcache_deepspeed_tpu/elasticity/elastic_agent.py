@@ -32,12 +32,17 @@ class ElasticAgent:
                  world_size: int,
                  elastic_config: Optional[dict] = None,
                  max_restarts: int = 3,
-                 poll_interval: float = 0.2):
+                 poll_interval: float = 0.2,
+                 grace_period: Optional[float] = None):
         self.cmd_fn = cmd_fn
         self.world_size = world_size
         self.elastic_config = elastic_config
         self.max_restarts = max_restarts
         self.poll_interval = poll_interval
+        # after the first death, wait this long before counting survivors
+        # so a group-wide crash in flight isn't misread as a partial one
+        self.grace_period = (max(10 * poll_interval, 1.0)
+                             if grace_period is None else grace_period)
         self.restart_count = 0
         self._procs: List[subprocess.Popen] = []
 
@@ -96,6 +101,15 @@ class ElasticAgent:
                     if self.restart_count > self.max_restarts:
                         raise ElasticAgentError(
                             f"exceeded max_restarts={self.max_restarts}")
+                    # grace window: coincident crashes still in flight
+                    # must count as dead, not as survivors (a worker that
+                    # is *about* to fail is not a resize candidate); skip
+                    # it when the first poll already shows nobody left
+                    if len(failed) < len(self._procs):
+                        time.sleep(self.grace_period)
+                        codes = [p.poll() for p in self._procs]
+                        failed = [i for i, c in enumerate(codes)
+                                  if c is not None and c != 0]
                     alive = n - len(failed)
                     if alive == 0:
                         alive = n  # whole-group app crash: retry as-is

@@ -119,6 +119,30 @@ def _mixtral_like(hf: Dict[str, Any]):
     )
 
 
+def _qwen_v1_like(hf: Dict[str, Any]) -> LlamaConfig:
+    """Qwen (v1) spells its config in its own keys — ``seq_length`` for the
+    context window, ``layer_norm_epsilon`` for the RMSNorm eps, an
+    ``intermediate_size`` that is TWICE the SwiGLU branch width (the HF
+    module builds w1/w2 at intermediate_size // 2), qkv bias always on, and
+    ``rotary_emb_base``. Architecturally it is the llama block layout
+    (RMSNorm + rope + SwiGLU, MHA, untied head), so it maps onto our llama
+    trunk once those keys are translated."""
+    return LlamaConfig(
+        attention_bias=True,
+        vocab_size=hf.get("vocab_size", 151936),
+        hidden_size=hf.get("hidden_size", 4096),
+        intermediate_size=hf.get("intermediate_size", 22016) // 2,
+        n_layer=hf.get("num_hidden_layers", 32),
+        n_head=hf.get("num_attention_heads", 32),
+        n_kv_head=hf.get("num_attention_heads", 32),  # MHA: no GQA in v1
+        max_positions=hf.get("seq_length", 8192),
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-6),
+        rope_theta=hf.get("rotary_emb_base", 10000.0),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=hf.get("torch_dtype", "bfloat16"),
+    )
+
+
 def _qwen2_moe_like(hf: Dict[str, Any]):
     from ..models.mixtral import Qwen2MoeConfig
     return Qwen2MoeConfig(
@@ -150,11 +174,12 @@ def _qwen2_moe_like(hf: Dict[str, Any]):
 #: layout; mixtral/qwen2_moe route through the MoE paged model
 #: (model_moe.py: dropless grouped GEMM, and for qwen2_moe the shared
 #: expert + raw top-k gate mass); gpt2/opt/falcon/phi have their own
-#: paged trunks. qwen-v1 stays unmapped (different config keys and a
-#: fused striped c_attn).
+#: paged trunks; qwen (v1) translates its idiosyncratic config keys
+#: onto the llama trunk (_qwen_v1_like).
 MODEL_FAMILIES = {
     "llama": _llama_like,
     "mistral": _llama_like,
+    "qwen": _qwen_v1_like,
     "qwen2": _llama_like,
     "phi3": _llama_like,
     "gpt2": _gpt2_like,

@@ -56,6 +56,51 @@ def test_prefill_decode_parity(tiny_qwen):
                                atol=2e-2)
 
 
+def test_hf_factory_qwen_v1_translates_keys(tiny_qwen):
+    # qwen (v1) spells context/eps/rope in its own keys and reports a
+    # doubled SwiGLU intermediate_size; the adapter must translate all of
+    # them onto the llama trunk and force qkv biases on
+    cfg, _, _ = tiny_qwen
+    hf = {"model_type": "qwen", "vocab_size": cfg.vocab_size,
+          "hidden_size": cfg.hidden_size,
+          "intermediate_size": cfg.intermediate_size * 2,
+          "num_hidden_layers": cfg.n_layer,
+          "num_attention_heads": cfg.n_head,
+          "seq_length": 128,
+          # non-default values so the key translation is actually
+          # exercised (defaults would mask a wrong .get key)
+          "layer_norm_epsilon": 1e-5,
+          "rotary_emb_base": 5e5,
+          "torch_dtype": "float32"}
+    import dataclasses
+
+    from hcache_deepspeed_tpu.inference.factory import MODEL_FAMILIES
+    mcfg = dataclasses.replace(MODEL_FAMILIES["qwen"](hf),
+                               use_flash=cfg.use_flash)
+    assert mcfg.attention_bias
+    assert mcfg.intermediate_size == cfg.intermediate_size
+    assert mcfg.max_positions == 128
+    assert mcfg.rms_norm_eps == 1e-5
+    assert mcfg.rope_theta == 5e5
+    assert mcfg.n_kv_head == mcfg.n_head  # v1 is MHA (fixture is GQA,
+    # so params are initialised fresh from the translated config)
+    model = LlamaForCausalLM(mcfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        {"input_ids": np.zeros((1, 8), np.int32)},
+                        train=False)["params"]
+    engine = build_hf_engine(
+        hf, params,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 4, "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 24,
+                      "cache_dtype": "float32"}))
+    rng = np.random.default_rng(1)
+    tokens = list(rng.integers(0, cfg.vocab_size, (6,)))
+    logits, _ = engine.put([1], [tokens])
+    np.testing.assert_allclose(
+        logits[0], full_logits(model, params, tokens)[-1], atol=2e-2)
+
+
 def test_hf_factory_qwen2_sets_bias(tiny_qwen):
     cfg, _, params = tiny_qwen
     hf = {"model_type": "qwen2", "vocab_size": cfg.vocab_size,
