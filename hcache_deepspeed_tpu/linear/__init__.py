@@ -1,0 +1,17 @@
+"""Optimized-linear subsystem: LoRA fine-tuning + quantized frozen base.
+
+Reference analog: ``deepspeed/linear/`` (OptimizedLinear,
+LoRAOptimizedLinear, QuantizedParameter/QuantizedLinear, LoRAConfig,
+QuantizationConfig). The ``context_manager.Init`` module-swap has no TPU
+analog — flax models either use :class:`OptimizedLinear` directly or,
+for existing models, the engine applies the tree-level LoRA transform
+(``runtime.config`` ``lora`` block) with no model changes at all.
+"""
+
+from .config import DEFAULT_TARGET_MODS, LoRAConfig, QuantizationConfig
+from .lora import init_lora_params, merge_lora, quantize_base
+from .optimized_linear import OptimizedLinear
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "DEFAULT_TARGET_MODS",
+           "OptimizedLinear", "init_lora_params", "merge_lora",
+           "quantize_base"]

@@ -1,0 +1,158 @@
+"""Tree-level LoRA: adapter init, frozen-base quantization, merge.
+
+Reference analog: ``deepspeed/linear/optimized_linear.py
+LoRAOptimizedLinear`` — there, an ``nn.Module`` replaces each targeted
+``nn.Linear`` (frozen, possibly quantized, possibly sharded base weight +
+trainable ``lora_weight_1/2``), installed by module surgery.
+
+TPU re-design: no module surgery. The model stays untouched; LoRA is a
+*parameter-tree transformation* used by the engine's compiled train step:
+
+- ``init_lora_params(rng, params, cfg)`` builds a small trainable tree of
+  ``{a, b}`` factors for every targeted 2D kernel,
+- ``quantize_base(params, cfg)`` optionally replaces those kernels with
+  groupwise-quantized storage (``ops/quantizer.QuantizedTensor`` /
+  ``ops/fp_quantizer``) — the QLoRA memory shape,
+- ``merge_lora(frozen, lora, cfg)`` produces the effective parameters
+  ``W + (alpha/r) * a @ b`` inside the jitted step; XLA fuses the
+  dequantize+add into the consumer matmuls.
+
+The optimizer then only ever sees the adapter tree — optimizer state and
+master weights for the base disappear, which is the reference's memory
+win, obtained without hooks.
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import LoRAConfig
+
+_SEP = "/"
+
+
+def _kernel_paths(params, target_mods) -> Dict[str, Tuple[int, int]]:
+    """Flat-path -> (in, out) for every targeted 2D ``kernel`` leaf.
+
+    A leaf is targeted when its name is ``kernel``, it is 2D, and any
+    path component matches a ``target_mods`` entry (reference:
+    AutoTP-style name matching, ``auto_tp.py``)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for path, leaf in flat:
+        names = [getattr(k, "key", getattr(k, "name", str(k)))
+                 for k in path]
+        if names[-1] != "kernel" or getattr(leaf, "ndim", 0) != 2:
+            continue
+        if not any(m in names for m in target_mods):
+            continue
+        out[_SEP.join(str(n) for n in names[:-1])] = leaf.shape
+    return out
+
+
+def init_lora_params(rng, params, cfg: LoRAConfig,
+                     dtype=None) -> Dict[str, Dict[str, Any]]:
+    """Trainable adapter tree: ``{module_path: {"a": [in,r], "b": [r,out]}}``.
+
+    ``a`` is scaled-normal (fan-in), ``b`` zeros — so the merged model
+    starts exactly at the base model (standard LoRA init; reference:
+    LoRAOptimizedLinear.init_lora)."""
+    targets = _kernel_paths(params, cfg.target_mods)
+    if not targets:
+        raise ValueError(
+            f"LoRA found no 2D 'kernel' parameters matching target_mods="
+            f"{cfg.target_mods}")
+    keys = jax.random.split(rng, len(targets))
+    tree = {}
+    for key, (path, (fan_in, fan_out)) in zip(keys, sorted(targets.items())):
+        leaf_dtype = dtype or jnp.float32
+        tree[path] = {
+            "a": (jax.random.normal(key, (fan_in, cfg.lora_r))
+                  * (1.0 / fan_in ** 0.5)).astype(leaf_dtype),
+            "b": jnp.zeros((cfg.lora_r, fan_out), leaf_dtype),
+        }
+    return tree
+
+
+def quantize_base(params, cfg: LoRAConfig):
+    """Replace targeted kernels with quantized storage (QLoRA base).
+
+    Integer groupwise (``q_bits`` 8/4) via ``ops/quantizer``; fp8/fp6 via
+    ``ops/fp_quantizer`` when ``mantissa_bits`` > 0. Non-targeted leaves
+    pass through untouched."""
+    qcfg = cfg.quantization
+    if qcfg is None:
+        return params
+    targets = set(_kernel_paths(params, cfg.target_mods))
+    from ..ops.quantizer import QuantizedTensor
+
+    if qcfg.mantissa_bits > 0:
+        # FP8 base (reference: fp_quantizer mantissa_bits): e4m3 for 3
+        # mantissa bits, e5m2 for 2. The (q, scale, shape, n) layout is
+        # QuantizedTensor's, so the same container (and its dequantize)
+        # carries fp8 codes.
+        from ..ops.fp_quantizer import quantize_fp8
+        if qcfg.q_bits != 8 or qcfg.mantissa_bits not in (2, 3):
+            raise ValueError(
+                "fp base quantization supports q_bits=8 with "
+                f"mantissa_bits 2 (e5m2) or 3 (e4m3); got "
+                f"q_bits={qcfg.q_bits} mantissa_bits={qcfg.mantissa_bits}")
+        fmt = "e4m3" if qcfg.mantissa_bits == 3 else "e5m2"
+
+        def make(x):
+            q, scale, shape, n = quantize_fp8(
+                x, group_size=qcfg.group_size, fmt=fmt)
+            return QuantizedTensor(q, scale, shape, n, x.dtype)
+    else:
+        def make(x):
+            return QuantizedTensor.make(x, group_size=qcfg.group_size,
+                                        num_bits=qcfg.q_bits)
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            path = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out[k] = walk(v, path)
+            elif k == "kernel" and prefix in targets:
+                out[k] = make(v)
+            else:
+                out[k] = v
+        return out
+
+    return walk(params, "")
+
+
+def _dequant(leaf):
+    return leaf.dequantize() if hasattr(leaf, "dequantize") else leaf
+
+
+def merge_lora(frozen, lora, cfg: LoRAConfig):
+    """Effective parameter tree: ``W + (alpha/r) * a @ b`` at every
+    adapted kernel, plain (dequantized) weights everywhere else. Pure and
+    trace-friendly — called inside the jitted loss so gradients flow to
+    ``lora`` only (``frozen`` arrives as a non-differentiated argument)."""
+    scale = cfg.scaling
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            path = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out[k] = walk(v, path)
+            elif k == "kernel" and prefix in lora:
+                base = _dequant(v)
+                ab = lora[prefix]["a"].astype(jnp.float32) @ \
+                    lora[prefix]["b"].astype(jnp.float32)
+                out[k] = (base.astype(jnp.float32)
+                          + scale * ab).astype(base.dtype)
+            else:
+                out[k] = _dequant(v)
+        return out
+
+    return walk(frozen, "")

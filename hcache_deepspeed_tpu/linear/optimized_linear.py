@@ -1,0 +1,86 @@
+"""OptimizedLinear: a Dense layer with optional LoRA and quantized base.
+
+Reference analog: ``deepspeed/linear/optimized_linear.py`` —
+``OptimizedLinear.__new__`` dispatches to ``nn.Linear`` /
+``QuantizedLinear`` / ``LoRAOptimizedLinear`` by config.
+
+TPU/flax form: one ``nn.Module``; the dispatch happens in which variable
+collections hold the weight:
+
+- plain: ``kernel`` in the ``params`` collection (trainable) — exactly
+  ``nn.Dense``;
+- LoRA: the base kernel moves to the ``frozen_base`` collection
+  (excluded from gradients/optimizer by construction — flax only
+  differentiates ``params``), and trainable ``lora_a``/``lora_b`` live
+  in ``params``;
+- quantized (+ LoRA): ``frozen_base`` stores the groupwise-quantized
+  codes and scales; forward dequantizes on the fly and XLA folds the
+  dequant into the consumer matmul.
+
+For whole-model LoRA fine-tuning with the engine, prefer the tree-level
+API (``linear.lora``) — this module is the reference-parity surface for
+building new models with adapter-ready linears.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .config import LoRAConfig, QuantizationConfig
+
+
+class OptimizedLinear(nn.Module):
+    features: int
+    use_bias: bool = False
+    lora: Optional[LoRAConfig] = None
+    quantization: Optional[QuantizationConfig] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel_init = nn.initializers.lecun_normal()
+        if self.quantization is not None and self.lora is None:
+            raise ValueError(
+                "quantization without LoRA freezes the whole layer; use "
+                "QuantizationConfig together with LoRAConfig (reference "
+                "QuantizedLinear is inference-side: ops/quantizer."
+                "quantize_tree covers it)")
+
+        if self.lora is None:
+            y = nn.Dense(self.features, use_bias=self.use_bias,
+                         dtype=self.dtype, name="dense")(x)
+            return y
+
+        qcfg = self.quantization
+
+        def base_init(rng):
+            w = kernel_init(rng, (in_features, self.features), jnp.float32)
+            w = w.astype(self.dtype)
+            if qcfg is not None:
+                from ..ops.quantizer import QuantizedTensor
+                return QuantizedTensor.make(w, group_size=qcfg.group_size,
+                                            num_bits=qcfg.q_bits)
+            return w
+
+        base = self.variable("frozen_base", "kernel", base_init,
+                             self.make_rng("params")
+                             if self.has_rng("params") else None).value
+        w = base.dequantize() if hasattr(base, "dequantize") else base
+
+        r = self.lora.lora_r
+        a = self.param("lora_a",
+                       lambda rng: kernel_init(
+                           rng, (in_features, r),
+                           jnp.float32).astype(self.dtype))
+        b = self.param("lora_b", nn.initializers.zeros, (r, self.features),
+                       self.dtype)
+        y = x @ w.astype(x.dtype)
+        y = y + self.lora.scaling * ((x @ a.astype(x.dtype))
+                                     @ b.astype(x.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.dtype)
+            y = y + bias
+        return y

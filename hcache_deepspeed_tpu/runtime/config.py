@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from pydantic import Field
 
+from ..linear.config import DEFAULT_TARGET_MODS as _DEFAULT_TARGET_MODS
 from ..utils.logging import logger
 from .config_utils import HDSConfigModel
 
@@ -241,6 +242,28 @@ class CurriculumLearningConfig(HDSConfigModel):
     schedule_config: Dict[str, Any] = Field(default_factory=dict)
 
 
+class LoRAQuantizationConfig(HDSConfigModel):
+    """Reference: deepspeed/linear/config.py QuantizationConfig."""
+    enabled: bool = False
+    q_bits: int = 8
+    group_size: int = 512
+    mantissa_bits: int = 0  # 0 = int groupwise; 2/3 = fp8 e5m2/e4m3
+
+
+class LoRATrainingConfig(HDSConfigModel):
+    """Reference: deepspeed/linear/config.py LoRAConfig — engine-level
+    LoRA fine-tuning. The optimizer sees only the adapter factors; base
+    weights are frozen (optionally quantized, QLoRA-style) and keep the
+    engine's parameter sharding (the ``base_weight_sharding`` analog)."""
+    enabled: bool = False
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    target_mods: List[str] = Field(
+        default_factory=lambda: list(_DEFAULT_TARGET_MODS))
+    quantization: LoRAQuantizationConfig = Field(
+        default_factory=LoRAQuantizationConfig)
+
+
 class CompileConfig(HDSConfigModel):
     """Reference: DeepCompile (runtime/config.py compile block). On TPU the
     compiler is XLA; these knobs steer jit: donation, remat, combining."""
@@ -292,6 +315,7 @@ class HDSConfig(HDSConfigModel):
         default_factory=CurriculumLearningConfig)
     compression_training: CompressionConfig = Field(
         default_factory=CompressionConfig)
+    lora: LoRATrainingConfig = Field(default_factory=LoRATrainingConfig)
 
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

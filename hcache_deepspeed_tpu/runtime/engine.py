@@ -276,6 +276,25 @@ class HDSEngine:
                     "progressive layer drop is not supported on the "
                     "manual ZeRO++ step; disable one of the two")
 
+        # ---- LoRA fine-tuning (reference: deepspeed/linear/) ----
+        self._lora = config.lora if config.lora.enabled else None
+        if self._lora is not None:
+            from .config import HDSConfigError
+            if self._zeropp:
+                raise HDSConfigError(
+                    "LoRA is not supported together with the manual "
+                    "ZeRO++ step (the base weights are frozen — there "
+                    "is no gradient traffic for qgZ to compress)")
+            if config.compression_training.weight_quantization.enabled:
+                raise HDSConfigError(
+                    "LoRA and MoQ weight quantization are mutually "
+                    "exclusive; LoRA's quantization block covers the "
+                    "frozen base")
+            if zcfg.offload_optimizer.device != "none":
+                raise HDSConfigError(
+                    "LoRA already shrinks optimizer state to the adapter "
+                    "factors; offload_optimizer is not supported with it")
+
         # ---- optimizer-state host offload (ZeRO-Offload / -Infinity) ----
         self.offload_device = zcfg.offload_optimizer.device
         self._offload = None
@@ -385,6 +404,39 @@ class HDSEngine:
             param_shardings = policy.named(policy.param_specs(params))
             params = jax.device_put(params, param_shardings)
 
+        # ---- LoRA: the trainable tree becomes the adapter factors; the
+        # full (optionally quantized) tree is frozen engine state. Every
+        # downstream structure (specs, master, optimizer, grad buffers)
+        # is then adapter-shaped — the reference's memory win
+        # (deepspeed/linear: frozen base + tiny trainable lora params).
+        frozen = None
+        if self._lora is not None:
+            from ..linear import (LoRAConfig, QuantizationConfig,
+                                  init_lora_params, quantize_base)
+            lc = self._lora
+            qc = None
+            if lc.quantization.enabled:
+                qc = QuantizationConfig(
+                    q_bits=lc.quantization.q_bits,
+                    group_size=lc.quantization.group_size,
+                    mantissa_bits=lc.quantization.mantissa_bits)
+            self._lora_cfg = LoRAConfig(
+                lora_r=lc.lora_r, lora_alpha=lc.lora_alpha,
+                target_mods=list(lc.target_mods), quantization=qc)
+            adapters = init_lora_params(
+                jax.random.fold_in(jax.random.PRNGKey(self._rng_seed), 7),
+                params, self._lora_cfg, dtype=self.compute_dtype)
+            frozen = params
+            if qc is not None:
+                # quantized codes/scales are fresh group-layout arrays —
+                # replicate them (the unquantized path keeps the base's
+                # ZeRO/TP placement, the base_weight_sharding analog)
+                frozen = jax.device_put(
+                    quantize_base(params, self._lora_cfg),
+                    NamedSharding(mesh, PartitionSpec()))
+            param_shardings = policy.named(policy.param_specs(adapters))
+            params = jax.device_put(adapters, param_shardings)
+
         self.param_shardings = param_shardings
         self.param_specs = policy.param_specs(params)
         self.grad_specs = policy.grad_specs(params)
@@ -449,6 +501,7 @@ class HDSEngine:
 
         self.state = {
             "params": params,
+            "frozen": frozen,
             "master": master,
             "opt": opt_state,
             "grad_acc": grad_acc,
@@ -519,9 +572,14 @@ class HDSEngine:
         moq_groups = self.config.compression_training \
             .weight_quantization.quantize_groups
 
+        lora_cfg = getattr(self, "_lora_cfg", None)
+
         def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train,
-                          moq_bits=None, pld_theta=None):
+                          frozen=None, moq_bits=None, pld_theta=None):
             def raw_loss(p):
+                if lora_cfg is not None:
+                    from ..linear import merge_lora
+                    p = merge_lora(frozen, p, lora_cfg)
                 if self._moq is not None and moq_bits is not None:
                     from ..compression import quantize_param_tree_traced
                     p = quantize_param_tree_traced(p, moq_bits,
@@ -564,7 +622,10 @@ class HDSEngine:
             donate_argnums=(1,),
             static_argnums=(5,))
 
-        def eval_loss(params, batch):
+        def eval_loss(params, batch, frozen=None):
+            if lora_cfg is not None:
+                from ..linear import merge_lora
+                params = merge_lora(frozen, params, lora_cfg)
             loss, aux = self.adapter.loss(params, batch, None, train=False)
             return loss
 
@@ -643,6 +704,7 @@ class HDSEngine:
             zero_acc = jax.tree.map(jnp.zeros_like, state["grad_acc"])
             new_state = {
                 "params": new_params,
+                "frozen": state.get("frozen"),
                 "master": out_master,
                 "opt": new_opt,
                 "grad_acc": zero_acc,
@@ -676,6 +738,8 @@ class HDSEngine:
                         batch, key, True, secondary)
                 else:
                     kw = {}
+                    if lora_cfg is not None:
+                        kw["frozen"] = state["frozen"]
                     if moq_bits is not None:
                         kw["moq_bits"] = moq_bits
                     if pld_theta is not None:
@@ -751,6 +815,8 @@ class HDSEngine:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._shard_batch(batch)
         extra_kw = {}
+        if self._lora is not None:
+            extra_kw["frozen"] = self.state["frozen"]
         if self._moq is not None:
             extra_kw["moq_bits"] = jnp.asarray(
                 self._moq.bits_at(self.global_steps), jnp.int32)
@@ -988,6 +1054,9 @@ class HDSEngine:
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)
+        if self._lora is not None:
+            return self._eval_loss(self.state["params"], batch,
+                                   frozen=self.state["frozen"])
         return self._eval_loss(self.state["params"], batch)
 
     # ------------------------------------------------------------------ #
@@ -1073,8 +1142,18 @@ class HDSEngine:
         replicate = jax.jit(
             lambda t: t,
             out_shardings=NamedSharding(self.mesh, PartitionSpec()))
-        host = jax.tree.map(lambda x: np.asarray(x),
-                            replicate(self.state["params"]))
+        if self._lora is not None:
+            # export the MERGED model (base + alpha/r * a@b) so the file
+            # is a drop-in full-weight checkpoint
+            from ..linear import merge_lora
+            merged = jax.jit(
+                lambda f, p: merge_lora(f, p, self._lora_cfg),
+                out_shardings=NamedSharding(self.mesh, PartitionSpec()))(
+                    self.state["frozen"], self.state["params"])
+            host = jax.tree.map(lambda x: np.asarray(x), merged)
+        else:
+            host = jax.tree.map(lambda x: np.asarray(x),
+                                replicate(self.state["params"]))
         if jax.process_index() != 0:
             return True
         os.makedirs(save_dir, exist_ok=True)

@@ -1,0 +1,230 @@
+"""LoRA / OptimizedLinear subsystem (reference: deepspeed/linear/).
+
+Covers the flax module forms, the tree-level transform, and the engine
+integration: adapter-only optimizer state, frozen base, QLoRA quantized
+base, checkpoint roundtrip, merged 16-bit export.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                         QuantizationConfig,
+                                         init_lora_params, merge_lora,
+                                         quantize_base)
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+
+TARGETS = ["c_attn", "c_proj", "c_fc"]  # gpt2 projection names
+
+
+def _data(batch, seq=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (batch, seq), dtype=np.int32)}
+
+
+def _lora_config(**lora_over):
+    lora = {"enabled": True, "lora_r": 4, "lora_alpha": 8.0,
+            "target_mods": TARGETS}
+    lora.update(lora_over)
+    return {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+        "lora": lora,
+    }
+
+
+def _make_engine(config):
+    model = GPT2LMHeadModel(gpt2_tiny())
+    engine, _, _, _ = hds.initialize(
+        model=model, config=config, example_batch=_data(1))
+    return engine
+
+
+# ------------------------------------------------------------------ #
+# flax module
+# ------------------------------------------------------------------ #
+class TestOptimizedLinear:
+    def test_plain_is_dense(self):
+        m = OptimizedLinear(features=8, dtype=jnp.float32)
+        x = jnp.ones((2, 4))
+        v = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(v, x)
+        assert y.shape == (2, 8)
+        assert "dense" in v["params"]
+
+    def test_lora_starts_at_base(self):
+        # b = 0 at init → the adapted layer equals its frozen base
+        cfg = LoRAConfig(lora_r=2, lora_alpha=4.0)
+        m = OptimizedLinear(features=8, lora=cfg, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4)),
+                        jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        assert set(v["params"]) == {"lora_a", "lora_b"}
+        assert "kernel" in v["frozen_base"]
+        y = m.apply(v, x)
+        base = x @ v["frozen_base"]["kernel"]
+        np.testing.assert_allclose(y, base, atol=1e-6)
+
+    def test_lora_quantized_base(self):
+        cfg = LoRAConfig(lora_r=2)
+        q = QuantizationConfig(q_bits=8, group_size=16)
+        m = OptimizedLinear(features=8, lora=cfg, quantization=q,
+                            dtype=jnp.float32)
+        x = jnp.ones((2, 4))
+        v = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(v, x)
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_quantization_requires_lora(self):
+        m = OptimizedLinear(features=8,
+                            quantization=QuantizationConfig())
+        with pytest.raises(ValueError, match="quantization without LoRA"):
+            m.init(jax.random.PRNGKey(0), jnp.ones((1, 4)))
+
+
+# ------------------------------------------------------------------ #
+# tree-level transform
+# ------------------------------------------------------------------ #
+class TestLoraTree:
+    def _params(self):
+        model = GPT2LMHeadModel(gpt2_tiny())
+        return model.init(jax.random.PRNGKey(0), _data(1),
+                          train=False)["params"]
+
+    def test_init_targets_only_matched_kernels(self):
+        params = self._params()
+        cfg = LoRAConfig(lora_r=4, target_mods=["c_attn"])
+        tree = init_lora_params(jax.random.PRNGKey(1), params, cfg)
+        assert tree and all("c_attn" in path for path in tree)
+        for sub in tree.values():
+            assert sub["a"].shape[1] == 4 and sub["b"].shape[0] == 4
+            np.testing.assert_array_equal(sub["b"], 0.0)
+
+    def test_no_match_raises(self):
+        params = self._params()
+        with pytest.raises(ValueError, match="no 2D 'kernel'"):
+            init_lora_params(jax.random.PRNGKey(1), params,
+                             LoRAConfig(target_mods=["nonexistent"]))
+
+    def test_merge_identity_at_init(self):
+        params = self._params()
+        cfg = LoRAConfig(lora_r=4, target_mods=TARGETS)
+        tree = init_lora_params(jax.random.PRNGKey(1), params, cfg)
+        merged = merge_lora(params, tree, cfg)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(merged)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    @pytest.mark.parametrize("qcfg", [
+        QuantizationConfig(q_bits=8, group_size=64),
+        QuantizationConfig(q_bits=8, group_size=64, mantissa_bits=3),
+    ], ids=["int8", "fp8"])
+    def test_quantized_base_roundtrip_error_bounded(self, qcfg):
+        params = self._params()
+        cfg = LoRAConfig(lora_r=4, target_mods=TARGETS, quantization=qcfg)
+        frozen = quantize_base(params, cfg)
+        tree = init_lora_params(jax.random.PRNGKey(1), params, cfg)
+        merged = merge_lora(frozen, tree, cfg)
+        # b=0 → merged == dequantized base; error vs fp32 base bounded
+        flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat_m = dict(
+            (jax.tree_util.keystr(p), l)
+            for p, l in jax.tree_util.tree_flatten_with_path(merged)[0])
+        for path, leaf in flat_p:
+            got = flat_m[jax.tree_util.keystr(path)]
+            scale = float(np.abs(np.asarray(leaf)).max()) or 1.0
+            np.testing.assert_allclose(np.asarray(got), np.asarray(leaf),
+                                       atol=0.05 * scale)
+
+
+# ------------------------------------------------------------------ #
+# engine integration
+# ------------------------------------------------------------------ #
+class TestLoraEngine:
+    def test_trains_and_freezes_base(self, eight_devices):
+        engine = _make_engine(_lora_config())
+        frozen_before = jax.tree.map(np.asarray, engine.state["frozen"])
+        losses = [float(engine.train_batch(batch=_data(8, seed=s)))
+                  for s in range(8)]
+        assert losses[-1] < losses[0]
+        # base unchanged; adapters moved
+        for a, b in zip(jax.tree.leaves(frozen_before),
+                        jax.tree.leaves(engine.state["frozen"])):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        assert any("c_attn" in p for p in engine.state["params"])
+
+    def test_optimizer_state_is_adapter_sized(self, eight_devices):
+        engine = _make_engine(_lora_config())
+        n_adapter = sum(x.size for x in
+                        jax.tree.leaves(engine.state["params"]))
+        n_frozen = sum(np.prod(x.shape) for x in
+                       jax.tree.leaves(engine.state["frozen"]))
+        # moment buffers must track adapters, not the model
+        for sub in engine.state["opt"].values():
+            if isinstance(sub, dict):
+                assert sum(x.size for x in jax.tree.leaves(sub)) == \
+                    n_adapter
+        assert n_adapter < n_frozen / 5
+
+    def test_qlora_trains(self, eight_devices):
+        engine = _make_engine(_lora_config(
+            quantization={"enabled": True, "q_bits": 8, "group_size": 64}))
+        from hcache_deepspeed_tpu.ops.quantizer import QuantizedTensor
+        kinds = [type(x) for x in jax.tree.leaves(
+            engine.state["frozen"],
+            is_leaf=lambda x: isinstance(x, QuantizedTensor))]
+        assert QuantizedTensor in kinds
+        losses = [float(engine.train_batch(batch=_data(8, seed=s)))
+                  for s in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_eval_and_unfused_path(self, eight_devices):
+        engine = _make_engine(_lora_config())
+        ev = float(engine.eval_batch(_data(8)))
+        assert np.isfinite(ev)
+        loss = engine.forward(_data(8))
+        engine.backward(loss)
+        engine.step()
+        assert np.isfinite(float(loss))
+
+    def test_checkpoint_roundtrip(self, eight_devices, tmp_path):
+        engine = _make_engine(_lora_config())
+        for s in range(2):
+            engine.train_batch(batch=_data(8, seed=s))
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        ref = jax.tree.map(np.asarray, engine.state["params"])
+
+        engine2 = _make_engine(_lora_config())
+        engine2.load_checkpoint(str(tmp_path), tag="t")
+        for a, b in zip(jax.tree.leaves(ref),
+                        jax.tree.leaves(engine2.state["params"])):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # training continues after restore
+        loss = float(engine2.train_batch(batch=_data(8, seed=9)))
+        assert np.isfinite(loss)
+
+    def test_16bit_export_is_merged(self, eight_devices, tmp_path):
+        engine = _make_engine(_lora_config())
+        engine.train_batch(batch=_data(8))
+        engine.save_16bit_model(str(tmp_path), "m.npz")
+        blob = np.load(str(tmp_path / "m.npz"))
+        merged = merge_lora(engine.state["frozen"],
+                            engine.state["params"], engine._lora_cfg)
+        flat = dict(
+            (".".join(str(getattr(k, "key", k)) for k in p), l)
+            for p, l in jax.tree_util.tree_flatten_with_path(merged)[0])
+        key = next(k for k in blob.files if "c_attn" in k)
+        want = flat[key] if key in flat else None
+        assert want is not None
+        np.testing.assert_allclose(blob[key], np.asarray(want), atol=1e-5)
+
+    def test_lora_conflicts_rejected(self, eight_devices):
+        with pytest.raises(Exception, match="offload_optimizer"):
+            _make_engine({**_lora_config(),
+                          "zero_optimization":
+                              {"offload_optimizer": {"device": "cpu"}}})
