@@ -1,0 +1,403 @@
+"""HF checkpoint → framework param-tree converter.
+
+Reference analog: the v2 engine-factory path builds engines straight from
+HF checkpoints (``deepspeed/inference/v2/engine_factory.py:69`` +
+``model_implementations/.../containers`` mapping HF tensor names onto
+kernel parameters), and v1's ``module_inject/load_checkpoint.py`` /
+``runtime/state_dict_factory.py`` do the same for injection policies.
+
+Here the same capability is a pure function: an HF ``state_dict`` (torch
+tensors, numpy arrays, or a ``.safetensors``/``.bin`` file) becomes the
+nested flax param tree our training models and the paged serving models
+share. The name mapping is thin because the model implementations
+deliberately mirror HF module names; what remains is layout:
+
+- HF ``nn.Linear`` stores ``weight [out, in]`` → flax ``kernel [in, out]``
+  (transpose);
+- GPT-2-era ``Conv1D`` already stores ``[in, out]`` (no transpose);
+- embeddings are ``[vocab, dim]`` on both sides;
+- flax ``LayerNorm`` calls its weight ``scale`` (HF: ``weight``).
+
+Supported model types: llama, mistral, qwen2 (llama trunk), gpt2, opt.
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["convert_hf_state_dict", "hf_config_to_model"]
+
+#: buffers that are not parameters (causal masks, rope caches, ...)
+_SKIP_SUFFIXES = (".attn.bias", ".attn.masked_bias",
+                  ".rotary_emb.inv_freq")
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (possibly bf16, which numpy can't hold) — go through
+    # float32; the engine casts to its compute dtype on placement anyway
+    t = t.detach().cpu()
+    if str(t.dtype) in ("torch.bfloat16", "torch.float16"):
+        t = t.float()
+    return t.numpy()
+
+
+def _set(tree: Dict[str, Any], path, value):
+    node = tree
+    for part in path[:-1]:
+        node = node.setdefault(part, {})
+    node[path[-1]] = value
+
+
+def _convert_llama_trunk(sd, layer_hook=None):
+    """Shared llama-trunk mapping (llama / mistral / qwen2 / mixtral
+    attention): ``model.layers.N.*`` nn.Linear weights (transpose),
+    RMSNorm ``weight``, optional q/k/v biases, optional untied
+    ``lm_head``. ``layer_hook(tree, prefix, rest, w) -> bool`` claims
+    family-specific layer tensors (mixtral's ``block_sparse_moe``)."""
+    tree: Dict[str, Any] = {}
+    for name, w in sd.items():
+        if name.endswith(_SKIP_SUFFIXES):
+            continue
+        w = _to_numpy(w)
+        parts = name.split(".")
+        if parts[0] == "model":
+            parts = parts[1:]
+        if parts[0] == "embed_tokens":
+            _set(tree, ("embed_tokens", "embedding"), w)
+        elif parts[0] == "norm":
+            _set(tree, ("norm", "weight"), w)
+        elif parts[0] == "lm_head":
+            _set(tree, ("lm_head", "kernel"), w.T)
+        elif parts[0] == "layers":
+            n, rest = parts[1], parts[2:]
+            prefix = f"layers_{n}"
+            if rest[0] in ("input_layernorm", "post_attention_layernorm"):
+                _set(tree, (prefix, rest[0], "weight"), w)
+            elif layer_hook is not None and layer_hook(tree, prefix,
+                                                      rest, w):
+                pass
+            elif rest[0] in ("self_attn", "mlp"):
+                group, proj, kind = rest[0], rest[1], rest[2]
+                if kind == "weight":
+                    _set(tree, (prefix, group, proj, "kernel"), w.T)
+                else:
+                    _set(tree, (prefix, group, proj, "bias"), w)
+            else:
+                raise ValueError(
+                    f"unrecognized llama-family tensor {name!r}")
+        else:
+            raise ValueError(f"unrecognized llama-family tensor {name!r}")
+    return tree
+
+
+def _convert_llama(sd):
+    return _convert_llama_trunk(sd)
+
+
+def _convert_gpt2(sd):
+    """gpt2: Conv1D weights are already [in, out]; ln ``weight`` →
+    ``scale``; ``lm_head`` is tied to wte (skipped)."""
+    tree: Dict[str, Any] = {}
+    for name, w in sd.items():
+        if name.endswith(_SKIP_SUFFIXES) or name == "lm_head.weight":
+            continue
+        w = _to_numpy(w)
+        parts = name.split(".")
+        if parts[0] == "transformer":
+            parts = parts[1:]
+        if parts[0] in ("wte", "wpe"):
+            _set(tree, (parts[0], "embedding"), w)
+        elif parts[0] in ("ln_f",):
+            _set(tree, ("ln_f", "scale" if parts[1] == "weight" else "bias"),
+                 w)
+        elif parts[0] == "h":
+            n, rest = parts[1], parts[2:]
+            prefix = f"h_{n}"
+            if rest[0] in ("ln_1", "ln_2"):
+                _set(tree, (prefix, rest[0],
+                            "scale" if rest[1] == "weight" else "bias"), w)
+            else:  # attn/mlp Conv1D: [in, out] already
+                group, proj, kind = rest[0], rest[1], rest[2]
+                _set(tree, (prefix, group, proj,
+                            "kernel" if kind == "weight" else "bias"), w)
+        else:
+            raise ValueError(f"unrecognized gpt2 tensor {name!r}")
+    return tree
+
+
+def _convert_opt(sd):
+    """opt: ``model.decoder.*`` nn.Linear (transpose); learned positional
+    embeddings carry OPT's +2 offset which the model implementation
+    already accounts for; ``final_layer_norm`` → ``ln_f``-style names kept
+    as the model spells them."""
+    tree: Dict[str, Any] = {}
+    for name, w in sd.items():
+        if name.endswith(_SKIP_SUFFIXES) or name == "lm_head.weight":
+            continue
+        w = _to_numpy(w)
+        parts = name.split(".")
+        if parts[:2] == ["model", "decoder"]:
+            parts = parts[2:]
+        elif parts[0] == "decoder":
+            parts = parts[1:]
+        if parts[0] == "embed_tokens":
+            _set(tree, ("embed_tokens", "embedding"), w)
+        elif parts[0] == "embed_positions":
+            _set(tree, ("embed_positions", "embedding"), w)
+        elif parts[0] == "final_layer_norm":
+            _set(tree, ("final_layer_norm",
+                        "scale" if parts[1] == "weight" else "bias"), w)
+        elif parts[0] == "layers":
+            n, rest = parts[1], parts[2:]
+            prefix = f"layers_{n}"
+            if rest[0] in ("self_attn_layer_norm", "final_layer_norm"):
+                _set(tree, (prefix, rest[0],
+                            "scale" if rest[1] == "weight" else "bias"), w)
+            elif rest[0] == "self_attn":
+                proj, kind = rest[1], rest[2]
+                _set(tree, (prefix, "self_attn", proj,
+                            "kernel" if kind == "weight" else "bias"),
+                     w.T if kind == "weight" else w)
+            else:  # fc1 / fc2
+                proj, kind = rest[0], rest[1]
+                _set(tree, (prefix, proj,
+                            "kernel" if kind == "weight" else "bias"),
+                     w.T if kind == "weight" else w)
+        else:
+            raise ValueError(f"unrecognized opt tensor {name!r}")
+    return tree
+
+
+def _split_falcon_qkv(w, n_head, n_kv, head_dim, new_arch):
+    """Split falcon's fused ``query_key_value.weight`` [out, in] into
+    q/k/v [out_x, in]. Three layouts (matching HF's ``_split_heads``):
+    old-arch MQA (7b): [q-block | k | v]; old-arch MHA: per-head
+    interleave [q_h, k_h, v_h]; new decoder architecture (grouped): per
+    kv group [q-group | k | v]."""
+    if not new_arch:
+        if n_kv == n_head:  # MHA: per-head interleave
+            g = w.reshape(n_head, 3, head_dim, w.shape[-1])
+            return (g[:, 0].reshape(n_head * head_dim, -1),
+                    g[:, 1].reshape(n_head * head_dim, -1),
+                    g[:, 2].reshape(n_head * head_dim, -1))
+        q_rows = n_head * head_dim
+        kv_rows = n_kv * head_dim
+        return (w[:q_rows], w[q_rows:q_rows + kv_rows],
+                w[q_rows + kv_rows:q_rows + 2 * kv_rows])
+    per = n_head // n_kv
+    g = w.reshape(n_kv, per + 2, head_dim, w.shape[-1])
+    q = g[:, :per].reshape(n_head * head_dim, -1)
+    k = g[:, per].reshape(n_kv * head_dim, -1)
+    v = g[:, per + 1].reshape(n_kv * head_dim, -1)
+    return q, k, v
+
+
+def _convert_falcon(sd, hf_config=None):
+    """falcon (7b-style single-ln parallel-attention blocks): fused
+    ``query_key_value`` is split into q/k/v; tied embeddings (lm_head
+    skipped). The dual-layernorm 40b layout (``ln_attn``/``ln_mlp``) is
+    not modeled — rejected explicitly."""
+    if any(".ln_attn." in k for k in sd):
+        raise ValueError(
+            "dual-layernorm falcon (new_decoder_architecture with "
+            "ln_attn/ln_mlp) is not modeled; only single-ln parallel "
+            "blocks convert")
+    if any(k.endswith(("query_key_value.bias", "dense.bias",
+                       "dense_h_to_4h.bias", "dense_4h_to_h.bias"))
+           for k in sd):
+        raise ValueError(
+            "falcon checkpoints with linear biases (config bias=True) "
+            "are not modeled — the falcon family here is the bias-free "
+            "7b-style block")
+    if hf_config is None:
+        raise ValueError(
+            "falcon conversion needs hf_config (head counts decide the "
+            "fused query_key_value split); pass the transformers model "
+            "itself or hf_config=<config dict>")
+    hf = hf_config
+    n_head = hf.get("num_attention_heads", hf.get("n_head", 71))
+    hidden = hf.get("hidden_size", 4544)
+    head_dim = hidden // n_head
+    new_arch = hf.get("new_decoder_architecture", False)
+    if new_arch:
+        n_kv = hf.get("num_kv_heads", 8)
+    else:
+        n_kv = n_head if not hf.get("multi_query", True) else 1
+    tree: Dict[str, Any] = {}
+    for name, w in sd.items():
+        if name.endswith(_SKIP_SUFFIXES) or name == "lm_head.weight":
+            continue
+        w = _to_numpy(w)
+        parts = name.split(".")
+        if parts[0] == "transformer":
+            parts = parts[1:]
+        if parts[0] == "word_embeddings":
+            _set(tree, ("embed_tokens", "embedding"), w)
+        elif parts[0] == "ln_f":
+            _set(tree, ("ln_f", "scale" if parts[1] == "weight" else "bias"),
+                 w)
+        elif parts[0] == "h":
+            n, rest = parts[1], parts[2:]
+            prefix = f"layers_{n}"
+            if rest[0] == "input_layernorm":
+                _set(tree, (prefix, "input_layernorm",
+                            "scale" if rest[1] == "weight" else "bias"), w)
+            elif rest[:2] == ["self_attention", "query_key_value"]:
+                q, k, v = _split_falcon_qkv(w, n_head, n_kv, head_dim,
+                                            new_arch)
+                _set(tree, (prefix, "self_attn", "q_proj", "kernel"), q.T)
+                _set(tree, (prefix, "self_attn", "k_proj", "kernel"), k.T)
+                _set(tree, (prefix, "self_attn", "v_proj", "kernel"), v.T)
+            elif rest[:2] == ["self_attention", "dense"]:
+                _set(tree, (prefix, "self_attn", "o_proj", "kernel"), w.T)
+            elif rest[0] == "mlp":
+                _set(tree, (prefix, rest[1], "kernel"), w.T)
+            else:
+                raise ValueError(f"unrecognized falcon tensor {name!r}")
+        else:
+            raise ValueError(f"unrecognized falcon tensor {name!r}")
+    return tree
+
+
+def _convert_phi(sd):
+    """phi: llama-style paths but LayerNorm (scale+bias), ``self_attn.
+    dense`` output projection, layer-level fc1/fc2, biased everything,
+    untied biased lm_head."""
+    tree: Dict[str, Any] = {}
+    for name, w in sd.items():
+        if name.endswith(_SKIP_SUFFIXES):
+            continue
+        w = _to_numpy(w)
+        parts = name.split(".")
+        if parts[0] == "model":
+            parts = parts[1:]
+        if parts[0] == "embed_tokens":
+            _set(tree, ("embed_tokens", "embedding"), w)
+        elif parts[0] == "final_layernorm":
+            _set(tree, ("final_layernorm",
+                        "scale" if parts[1] == "weight" else "bias"), w)
+        elif parts[0] == "lm_head":
+            _set(tree, ("lm_head", "kernel" if parts[1] == "weight"
+                        else "bias"), w.T if parts[1] == "weight" else w)
+        elif parts[0] == "layers":
+            n, rest = parts[1], parts[2:]
+            prefix = f"layers_{n}"
+            if rest[0] == "input_layernorm":
+                _set(tree, (prefix, "input_layernorm",
+                            "scale" if rest[1] == "weight" else "bias"), w)
+            elif rest[0] == "self_attn":
+                proj, kind = rest[1], rest[2]
+                _set(tree, (prefix, "self_attn", proj,
+                            "kernel" if kind == "weight" else "bias"),
+                     w.T if kind == "weight" else w)
+            elif rest[0] == "mlp":
+                proj, kind = rest[1], rest[2]
+                _set(tree, (prefix, proj,
+                            "kernel" if kind == "weight" else "bias"),
+                     w.T if kind == "weight" else w)
+            else:
+                raise ValueError(f"unrecognized phi tensor {name!r}")
+        else:
+            raise ValueError(f"unrecognized phi tensor {name!r}")
+    return tree
+
+
+def _convert_mixtral(sd):
+    """mixtral: the llama trunk + ``block_sparse_moe`` — the router gate
+    transposes onto ``mlp/moe/wg`` and the per-expert w1/w3/w2 linears
+    stack into the dropless grouped-GEMM layout ``[E, in, out]``."""
+    experts: Dict[tuple, Dict[int, np.ndarray]] = {}
+
+    def moe_hook(tree, prefix, rest, w):
+        if rest[0] != "block_sparse_moe":
+            return False
+        if rest[1] == "gate":
+            _set(tree, (prefix, "mlp", "moe", "wg"), w.T)
+        else:  # experts.E.w{1,2,3}.weight — stack later
+            e, wn = int(rest[2]), rest[3]
+            experts.setdefault((prefix, wn), {})[e] = w.T
+        return True
+
+    tree = _convert_llama_trunk(sd, layer_hook=moe_hook)
+    for (prefix, wn), per_e in experts.items():
+        stacked = np.stack([per_e[i] for i in range(len(per_e))])
+        _set(tree, (prefix, "mlp", "moe", "experts", wn), stacked)
+    return tree
+
+
+_CONVERTERS = {
+    "llama": _convert_llama,
+    "mistral": _convert_llama,
+    "qwen2": _convert_llama,
+    "gpt2": _convert_gpt2,
+    "opt": _convert_opt,
+    "falcon": _convert_falcon,
+    "phi": _convert_phi,
+    "mixtral": _convert_mixtral,
+}
+
+
+def convert_hf_state_dict(state_dict, model_type: str,
+                          hf_config=None) -> Dict[str, Any]:
+    """HF ``state_dict`` (name → tensor) → nested flax param tree.
+
+    ``state_dict`` may also be a transformers ``PreTrainedModel`` (its
+    ``state_dict()`` is taken — and its config, for families whose
+    weight layout depends on head counts) or a path to a
+    ``.safetensors`` file. ``hf_config`` (dict or transformers config)
+    is required for falcon when passing a bare state_dict."""
+    if hasattr(state_dict, "state_dict"):
+        if hf_config is None and hasattr(state_dict, "config"):
+            hf_config = state_dict.config
+        state_dict = state_dict.state_dict()
+    elif isinstance(state_dict, str):
+        if state_dict.endswith(".safetensors"):
+            from safetensors.numpy import load_file
+            state_dict = load_file(state_dict)
+        else:
+            import torch
+            state_dict = torch.load(state_dict, map_location="cpu",
+                                    weights_only=True)
+    if model_type not in _CONVERTERS:
+        raise ValueError(f"no HF converter for model_type={model_type!r}; "
+                         f"have {sorted(_CONVERTERS)}")
+    if model_type == "falcon":
+        if hf_config is not None and not isinstance(hf_config, dict):
+            hf_config = hf_config.to_dict()
+        return _convert_falcon(dict(state_dict), hf_config)
+    return _CONVERTERS[model_type](dict(state_dict))
+
+
+def hf_config_to_model(hf_config) -> tuple:
+    """(model_config, flax model) from a transformers config object or
+    plain dict — the config-side counterpart of
+    :func:`convert_hf_state_dict`, sharing the engine factory's family
+    table."""
+    from ..inference.factory import MODEL_FAMILIES
+    hf = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    family = hf.get("model_type")
+    if family not in MODEL_FAMILIES:
+        raise ValueError(f"unsupported model family {family!r}")
+    cfg = MODEL_FAMILIES[family](hf)
+    from ..models.falcon import FalconConfig, FalconForCausalLM
+    from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..models.mixtral import MixtralConfig, MixtralForCausalLM
+    from ..models.opt import OPTConfig, OPTForCausalLM
+    from ..models.phi import PhiConfig, PhiForCausalLM
+    # most-derived first: MixtralConfig (and Qwen2MoeConfig under it)
+    # subclass LlamaConfig
+    for cfg_cls, model_cls in ((MixtralConfig, MixtralForCausalLM),
+                               (LlamaConfig, LlamaForCausalLM),
+                               (GPT2Config, GPT2LMHeadModel),
+                               (OPTConfig, OPTForCausalLM),
+                               (FalconConfig, FalconForCausalLM),
+                               (PhiConfig, PhiForCausalLM)):
+        if isinstance(cfg, cfg_cls):
+            return cfg, model_cls(cfg)
+    raise ValueError(
+        f"hf_config_to_model has no model class for "
+        f"{type(cfg).__name__} (build the model directly and use "
+        f"convert_hf_state_dict for the weights)")

@@ -33,7 +33,7 @@ def _llama_like(hf: Dict[str, Any]) -> LlamaConfig:
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         rope_theta=hf.get("rope_theta", 10000.0),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
-        dtype=hf.get("torch_dtype", "bfloat16"),
+        dtype=hf.get("torch_dtype") or "bfloat16",
     )
 
 
@@ -46,7 +46,7 @@ def _gpt2_like(hf: Dict[str, Any]):
         n_layer=hf.get("n_layer", 12),
         n_head=hf.get("n_head", 12),
         layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
-        dtype=hf.get("torch_dtype", "float32"),
+        dtype=hf.get("torch_dtype") or "float32",
     )
 
 
@@ -59,7 +59,7 @@ def _opt_like(hf: Dict[str, Any]):
         n_layer=hf.get("num_hidden_layers", 12),
         n_head=hf.get("num_attention_heads", 12),
         max_positions=hf.get("max_position_embeddings", 2048),
-        dtype=hf.get("torch_dtype", "float32"),
+        dtype=hf.get("torch_dtype") or "float32",
     )
 
 
@@ -80,7 +80,7 @@ def _falcon_like(hf: Dict[str, Any]):
         layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
         rope_theta=hf.get("rope_theta", 10000.0),
         tie_word_embeddings=hf.get("tie_word_embeddings", True),
-        dtype=hf.get("torch_dtype", "bfloat16"),
+        dtype=hf.get("torch_dtype") or "bfloat16",
     )
 
 
@@ -96,7 +96,7 @@ def _phi_like(hf: Dict[str, Any]):
         layer_norm_epsilon=hf.get("layer_norm_eps", 1e-5),
         rope_theta=hf.get("rope_theta", 10000.0),
         partial_rotary_factor=hf.get("partial_rotary_factor", 0.4),
-        dtype=hf.get("torch_dtype", "float32"),
+        dtype=hf.get("torch_dtype") or "float32",
     )
 
 
@@ -115,7 +115,7 @@ def _mixtral_like(hf: Dict[str, Any]):
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
         num_experts=hf.get("num_local_experts", hf.get("num_experts", 8)),
         top_k=hf.get("num_experts_per_tok", 2),
-        dtype=hf.get("torch_dtype", "bfloat16"),
+        dtype=hf.get("torch_dtype") or "bfloat16",
     )
 
 
@@ -139,7 +139,7 @@ def _qwen_v1_like(hf: Dict[str, Any]) -> LlamaConfig:
         rms_norm_eps=hf.get("layer_norm_epsilon", 1e-6),
         rope_theta=hf.get("rotary_emb_base", 10000.0),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
-        dtype=hf.get("torch_dtype", "bfloat16"),
+        dtype=hf.get("torch_dtype") or "bfloat16",
     )
 
 
@@ -165,7 +165,7 @@ def _qwen2_moe_like(hf: Dict[str, Any]):
         norm_topk_prob=hf.get("norm_topk_prob", False),
         attention_bias=hf.get("attention_bias",
                               hf.get("qkv_bias", True)),
-        dtype=hf.get("torch_dtype", "bfloat16"),
+        dtype=hf.get("torch_dtype") or "bfloat16",
     )
 
 

@@ -19,9 +19,9 @@ class QuantizationConfig:
     """Groupwise quantization of the frozen base weights (QLoRA-style).
 
     Reference: ``deepspeed/linear/config.py QuantizationConfig`` —
-    ``q_bits``/``group_size`` map directly; ``mantissa_bits`` selects the
-    FP-quantizer family (fp8/fp6) instead of integer groupwise when > 0
-    (reference: ``csrc/fp_quantizer``; here ``ops/fp_quantizer``).
+    ``q_bits``/``group_size`` map directly; ``mantissa_bits`` > 0 selects
+    an fp8 base (3 → e4m3, 2 → e5m2; reference: ``csrc/fp_quantizer``,
+    here ``ops/fp_quantizer``) instead of integer groupwise.
     """
     q_bits: int = 8
     group_size: int = 512

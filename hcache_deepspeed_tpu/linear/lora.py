@@ -75,12 +75,20 @@ def init_lora_params(rng, params, cfg: LoRAConfig,
     return tree
 
 
+def _is_quantized(leaf):
+    return hasattr(leaf, "dequantize")
+
+
+def _path_names(path):
+    return [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
+
+
 def quantize_base(params, cfg: LoRAConfig):
     """Replace targeted kernels with quantized storage (QLoRA base).
 
-    Integer groupwise (``q_bits`` 8/4) via ``ops/quantizer``; fp8/fp6 via
-    ``ops/fp_quantizer`` when ``mantissa_bits`` > 0. Non-targeted leaves
-    pass through untouched."""
+    Integer groupwise (``q_bits`` 8/4) via ``ops/quantizer``; fp8
+    (e4m3/e5m2 selected by ``mantissa_bits`` 3/2) via
+    ``ops/fp_quantizer``. Non-targeted leaves pass through untouched."""
     qcfg = cfg.quantization
     if qcfg is None:
         return params
@@ -109,25 +117,14 @@ def quantize_base(params, cfg: LoRAConfig):
             return QuantizedTensor.make(x, group_size=qcfg.group_size,
                                         num_bits=qcfg.q_bits)
 
-    def walk(node, prefix):
-        if not isinstance(node, dict):
-            return node
-        out = {}
-        for k, v in node.items():
-            path = f"{prefix}{_SEP}{k}" if prefix else str(k)
-            if isinstance(v, dict):
-                out[k] = walk(v, path)
-            elif k == "kernel" and prefix in targets:
-                out[k] = make(v)
-            else:
-                out[k] = v
-        return out
+    def visit(path, leaf):
+        names = _path_names(path)
+        if names[-1] == "kernel" and _SEP.join(names[:-1]) in targets:
+            return make(leaf)
+        return leaf
 
-    return walk(params, "")
-
-
-def _dequant(leaf):
-    return leaf.dequantize() if hasattr(leaf, "dequantize") else leaf
+    # tree_map_with_path handles any Mapping pytree (dict, FrozenDict)
+    return jax.tree_util.tree_map_with_path(visit, params)
 
 
 def merge_lora(frozen, lora, cfg: LoRAConfig):
@@ -136,23 +133,27 @@ def merge_lora(frozen, lora, cfg: LoRAConfig):
     trace-friendly — called inside the jitted loss so gradients flow to
     ``lora`` only (``frozen`` arrives as a non-differentiated argument)."""
     scale = cfg.scaling
+    consumed = set()
 
-    def walk(node, prefix):
-        if not isinstance(node, dict):
-            return node
-        out = {}
-        for k, v in node.items():
-            path = f"{prefix}{_SEP}{k}" if prefix else str(k)
-            if isinstance(v, dict):
-                out[k] = walk(v, path)
-            elif k == "kernel" and prefix in lora:
-                base = _dequant(v)
-                ab = lora[prefix]["a"].astype(jnp.float32) @ \
-                    lora[prefix]["b"].astype(jnp.float32)
-                out[k] = (base.astype(jnp.float32)
-                          + scale * ab).astype(base.dtype)
-            else:
-                out[k] = _dequant(v)
-        return out
+    def visit(path, leaf):
+        if _is_quantized(leaf):
+            leaf = leaf.dequantize()
+        names = _path_names(path)
+        prefix = _SEP.join(names[:-1])
+        if names[-1] == "kernel" and prefix in lora:
+            consumed.add(prefix)
+            ab = lora[prefix]["a"].astype(jnp.float32) @ \
+                lora[prefix]["b"].astype(jnp.float32)
+            return (leaf.astype(jnp.float32)
+                    + scale * ab).astype(leaf.dtype)
+        return leaf
 
-    return walk(frozen, "")
+    merged = jax.tree_util.tree_map_with_path(visit, frozen,
+                                              is_leaf=_is_quantized)
+    unused = set(lora) - consumed
+    if unused:
+        raise ValueError(
+            f"merge_lora: adapters for {sorted(unused)} matched no kernel "
+            "in the frozen tree — the trees disagree (wrong model or "
+            "path layout)")
+    return merged

@@ -77,7 +77,13 @@ def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True,
     with open(meta_path) as fh:
         meta = json.load(fh)
 
-    template = {k: v for k, v in template_state.items() if v is not None}
+    # restore only what this checkpoint actually stored (state_keys);
+    # template entries it lacks — e.g. the frozen LoRA base, which new
+    # checkpoints omit but old ones persisted — carry over from the live
+    # state via the `out.update(restored)` merge below
+    saved_keys = set(meta.get("state_keys", template_state.keys()))
+    template = {k: v for k, v in template_state.items()
+                if v is not None and k in saved_keys}
     engine = checkpoint_engine or SyncCheckpointEngine()
     # Restore with the *current* shardings: resharding-on-load gives
     # topology-change resume (the universal checkpoint capability).

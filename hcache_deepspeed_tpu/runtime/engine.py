@@ -428,12 +428,25 @@ class HDSEngine:
                 params, self._lora_cfg, dtype=self.compute_dtype)
             frozen = params
             if qc is not None:
-                # quantized codes/scales are fresh group-layout arrays —
-                # replicate them (the unquantized path keeps the base's
-                # ZeRO/TP placement, the base_weight_sharding analog)
+                # the flat [G, group] quantized layout cannot carry a
+                # kernel's tensor/expert-parallel sharding — reject that
+                # combination instead of silently replicating a base that
+                # was TP-sharded in bf16
+                if self.topology.tensor_size > 1 or \
+                        self.topology.expert_size > 1:
+                    from .config import HDSConfigError
+                    raise HDSConfigError(
+                        "lora.quantization with tensor/expert "
+                        "parallelism is not supported: the quantized "
+                        "group layout drops TP shardings (use an "
+                        "unquantized LoRA base, which keeps them)")
+                # otherwise run the fresh codes/scales through the same
+                # policy: ZeRO-3 shards the [G, group] codes on their
+                # leading dim, and at stage <3 (replicated params) the
+                # int8/fp8 codes are strictly smaller than the bf16 base
+                frozen = quantize_base(params, self._lora_cfg)
                 frozen = jax.device_put(
-                    quantize_base(params, self._lora_cfg),
-                    NamedSharding(mesh, PartitionSpec()))
+                    frozen, policy.named(policy.param_specs(frozen)))
             param_shardings = policy.named(policy.param_specs(adapters))
             params = jax.device_put(adapters, param_shardings)
 
@@ -1122,6 +1135,13 @@ class HDSEngine:
         state = self.state
         if self._offload is not None:
             state = dict(state, offload=self._offload.state_dict())
+        if self._lora is not None:
+            # adapter-only checkpoints (reference LoRA semantics): the
+            # frozen base never changes and is reconstructed at engine
+            # init (same seed, or the same init_params the run started
+            # from) — persisting it every save would write the whole
+            # model for a fine-tune that trains <1% of it
+            state = {k: v for k, v in state.items() if k != "frozen"}
         _save(save_dir, tag, state, meta, save_latest=save_latest,
               checkpoint_engine=self.checkpoint_engine)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])

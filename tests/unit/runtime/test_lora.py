@@ -199,6 +199,11 @@ class TestLoraEngine:
         engine.save_checkpoint(str(tmp_path), tag="t")
         ref = jax.tree.map(np.asarray, engine.state["params"])
 
+        # adapter-only checkpoint: the frozen base must not be persisted
+        import json
+        meta = json.load(open(tmp_path / "t" / "hds_meta.json"))
+        assert "frozen" not in meta["state_keys"]
+
         engine2 = _make_engine(_lora_config())
         engine2.load_checkpoint(str(tmp_path), tag="t")
         for a, b in zip(jax.tree.leaves(ref),
