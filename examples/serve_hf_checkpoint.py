@@ -1,0 +1,53 @@
+"""Serve a HF checkpoint (reference: build_hf_engine, engine_factory.py:69).
+
+    JAX_PLATFORMS=cpu python examples/serve_hf_checkpoint.py
+
+A transformers model's state_dict converts straight into the paged
+serving engine's param tree; generation is greedy-decode-identical to
+the torch model. At scale, point ``convert_hf_state_dict`` at a
+``.safetensors`` file instead of an in-memory model.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import torch
+    import transformers
+
+    from hcache_deepspeed_tpu.checkpoint.hf_loader import \
+        convert_hf_state_dict
+    from hcache_deepspeed_tpu.inference import (RaggedInferenceEngineConfig,
+                                                build_hf_engine)
+
+    # stand-in for e.g. LlamaForCausalLM.from_pretrained(...)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32),
+                          convert_hf_state_dict(hf_model, "llama"))
+    engine = build_hf_engine(
+        {**hf_model.config.to_dict(), "torch_dtype": "float32"}, params,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8, "max_context": 128},
+            kv_cache={"block_size": 16, "num_blocks": 64,
+                      "cache_dtype": "float32"}))
+
+    prompt = [3, 17, 250, 99, 1]
+    out = engine.generate([prompt], max_new_tokens=16)
+    print("prompt:", prompt)
+    print("generated:", list(out[0]))
+
+
+if __name__ == "__main__":
+    main()

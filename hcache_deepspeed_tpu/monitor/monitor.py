@@ -95,6 +95,38 @@ class WandbMonitor(Monitor):
             self._wandb.log({label: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Reference: ``deepspeed/monitor/comet.py CometMonitor`` — thin
+    wrapper over ``comet_ml.Experiment.log_metric``; disabled with a
+    warning when the SDK is absent (it is not baked into TPU images)."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        if not self.enabled:
+            return
+        try:
+            import comet_ml
+            kw = {"api_key": cfg.api_key or None,
+                  "project_name": cfg.project or None,
+                  "workspace": cfg.workspace or None}
+            if cfg.is_offline:
+                self._exp = comet_ml.OfflineExperiment(**kw)
+            else:
+                self._exp = comet_ml.Experiment(**kw)
+            if cfg.experiment_name:
+                self._exp.set_name(cfg.experiment_name)
+        except Exception:
+            logger.warning("comet_ml not available; disabling CometMonitor")
+            self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self._exp.log_metric(label, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """Reference: monitor/monitor.py:30 — rank-0 fan-out to all writers."""
 
@@ -116,6 +148,9 @@ class MonitorMaster(Monitor):
             wb = WandbMonitor(hds_config.wandb)
             if wb.enabled:
                 self.writers.append(wb)
+            cmt = CometMonitor(hds_config.comet)
+            if cmt.enabled:
+                self.writers.append(cmt)
 
     @property
     def enabled(self):

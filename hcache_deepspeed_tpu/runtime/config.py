@@ -9,7 +9,7 @@ over; TPU-specific knobs live under ``mesh`` and new subsections.
 """
 
 import json
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Literal, Optional, Union
 
 from pydantic import Field
 
@@ -149,6 +149,25 @@ class WandbConfig(HDSConfigModel):
     group: Optional[str] = None
     team: Optional[str] = None
     project: str = "hds_tpu"
+
+
+class CometConfig(HDSConfigModel):
+    """Reference: monitor/config.py CometConfig. ``mode`` (when set)
+    wins over ``online`` — the two reference knobs describe the same
+    choice."""
+    enabled: bool = False
+    project: str = ""
+    workspace: str = ""
+    api_key: str = ""
+    experiment_name: str = ""
+    online: bool = True
+    mode: Literal["", "online", "offline"] = ""
+
+    @property
+    def is_offline(self) -> bool:
+        if self.mode:
+            return self.mode == "offline"
+        return not self.online
 
 
 class CSVConfig(HDSConfigModel):
@@ -319,6 +338,7 @@ class HDSConfig(HDSConfigModel):
 
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
+    comet: CometConfig = Field(default_factory=CometConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(

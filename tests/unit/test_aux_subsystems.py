@@ -30,6 +30,29 @@ class TestMonitor:
             rows = list(csv.reader(f))
         assert any("1.5" in c for r in rows for c in r)
 
+    def test_comet_disables_gracefully_without_sdk(self, tmp_path,
+                                                   monkeypatch):
+        # force the import failure (deterministic even on machines that
+        # have comet_ml): an enabled comet block must warn and disable
+        # rather than crash, and the master still fans out to the
+        # writers that do work
+        import sys
+        monkeypatch.setitem(sys.modules, "comet_ml", None)
+        from hcache_deepspeed_tpu.monitor.monitor import (CometMonitor,
+                                                          MonitorMaster)
+        from hcache_deepspeed_tpu.runtime.config import load_config
+        cfg = load_config({
+            "train_batch_size": 1,
+            "comet": {"enabled": True, "project": "p"},
+            "csv_monitor": {"enabled": True,
+                            "output_path": str(tmp_path),
+                            "job_name": "c"},
+        })
+        assert not CometMonitor(cfg.comet).enabled
+        master = MonitorMaster(cfg)
+        assert master.enabled  # csv writer survives
+        master.write_events([("Train/loss", 1.0, 1)])
+
     def test_master_fans_out_and_respects_enabled(self, tmp_path):
         from hcache_deepspeed_tpu.monitor.monitor import MonitorMaster
         from hcache_deepspeed_tpu.runtime.config import load_config
