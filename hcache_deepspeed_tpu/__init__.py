@@ -49,6 +49,17 @@ def initialize(args=None,
     """
     assert model is not None, "deepspeed.initialize requires a model"
     cfg = load_config(config if config is not None else config_params)
+    # persistent compilation cache (the AOT half of DeepCompile):
+    # compiled executables are keyed by HLO+flags and reused across
+    # process restarts. Set unconditionally from THIS config so a later
+    # initialize() without cache_dir doesn't keep writing to a previous
+    # engine's cache directory.
+    import jax as _jax
+    _jax.config.update("jax_compilation_cache_dir",
+                       cfg.compile.cache_dir or None)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                       cfg.compile.cache_min_compile_time_secs)
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     comm.init_distributed()
 
     from .runtime.pipe.module import PipelineModule

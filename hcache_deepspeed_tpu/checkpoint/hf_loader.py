@@ -321,9 +321,61 @@ def _convert_mixtral(sd):
         return True
 
     tree = _convert_llama_trunk(sd, layer_hook=moe_hook)
+    _stack_experts(tree, experts)
+    return tree
+
+
+def _stack_experts(tree, experts):
+    """Stack collected per-expert matrices into ``mlp/moe/experts/wN``
+    ``[E, in, out]`` grouped-GEMM arrays; a hole in the expert index
+    range means a partial (multi-shard) state_dict."""
     for (prefix, wn), per_e in experts.items():
+        missing = [i for i in range(len(per_e)) if i not in per_e]
+        if missing:
+            raise ValueError(
+                f"{prefix}: experts {missing} absent for {wn} — pass a "
+                "complete state_dict (merge safetensors shards first)")
         stacked = np.stack([per_e[i] for i in range(len(per_e))])
         _set(tree, (prefix, "mlp", "moe", "experts", wn), stacked)
+
+
+def _convert_qwen2_moe(sd):
+    """qwen2_moe: the llama trunk + ``mlp.gate`` router, per-expert
+    gate/up/down linears stacked into the grouped-GEMM w1/w3/w2 layout,
+    and the always-on gated shared expert."""
+    experts: Dict[tuple, Dict[int, np.ndarray]] = {}
+    _W = {"gate_proj": "w1", "up_proj": "w3", "down_proj": "w2"}
+    _S = {"gate_proj": "shared_gate_proj", "up_proj": "shared_up_proj",
+          "down_proj": "shared_down_proj"}
+
+    def moe_hook(tree, prefix, rest, w):
+        if rest[0] != "mlp":
+            return False
+        if rest[1] in ("gate_proj", "up_proj", "down_proj"):
+            # a dense-MLP layer (decoder_sparse_step > 1 /
+            # mlp_only_layers): the MoE trunk here has moe/* at every
+            # layer, so this layout cannot load — fail clearly now
+            # rather than with a tree-structure error later
+            raise ValueError(
+                f"{prefix}: dense mlp.{rest[1]} found — qwen2_moe "
+                "checkpoints with dense-MLP layers (decoder_sparse_step "
+                "> 1 or mlp_only_layers) are not supported")
+        if rest[1] == "gate":
+            _set(tree, (prefix, "mlp", "moe", "wg"), w.T)
+        elif rest[1] == "experts":
+            e, proj = int(rest[2]), rest[3]
+            experts.setdefault((prefix, _W[proj]), {})[e] = w.T
+        elif rest[1] == "shared_expert":
+            _set(tree, (prefix, "mlp", "moe", _S[rest[2]], "kernel"), w.T)
+        elif rest[1] == "shared_expert_gate":
+            _set(tree, (prefix, "mlp", "moe", "shared_expert_gate",
+                        "kernel"), w.T)
+        else:
+            return False
+        return True
+
+    tree = _convert_llama_trunk(sd, layer_hook=moe_hook)
+    _stack_experts(tree, experts)
     return tree
 
 
@@ -336,6 +388,7 @@ _CONVERTERS = {
     "falcon": _convert_falcon,
     "phi": _convert_phi,
     "mixtral": _convert_mixtral,
+    "qwen2_moe": _convert_qwen2_moe,
 }
 
 

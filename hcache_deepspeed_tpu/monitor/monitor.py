@@ -107,18 +107,21 @@ class CometMonitor(Monitor):
             return
         try:
             import comet_ml
-            kw = {"api_key": cfg.api_key or None,
-                  "project_name": cfg.project or None,
-                  "workspace": cfg.workspace or None}
-            if cfg.is_offline:
-                self._exp = comet_ml.OfflineExperiment(**kw)
-            else:
-                self._exp = comet_ml.Experiment(**kw)
-            if cfg.experiment_name:
-                self._exp.set_name(cfg.experiment_name)
-        except Exception:
+        except ImportError:
             logger.warning("comet_ml not available; disabling CometMonitor")
             self.enabled = False
+            return
+        # real experiment-creation failures (bad key, auth, network)
+        # propagate — silently dropping every metric would be worse
+        kw = {"api_key": cfg.api_key or None,
+              "project_name": cfg.project or None,
+              "workspace": cfg.workspace or None}
+        if cfg.is_offline:
+            self._exp = comet_ml.OfflineExperiment(**kw)
+        else:
+            self._exp = comet_ml.Experiment(**kw)
+        if cfg.experiment_name:
+            self._exp.set_name(cfg.experiment_name)
 
     def write_events(self, event_list):
         if not self.enabled:

@@ -203,6 +203,27 @@ class TestMixtralParity:
                       _logits_hf(hf_model), atol=1e-3)
 
 
+class TestQwen2MoeParity:
+    def test_logit_parity_with_shared_expert(self):
+        cfg = transformers.Qwen2MoeConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=96,
+            shared_expert_intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+            decoder_sparse_step=1, tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf_model = transformers.Qwen2MoeForCausalLM(cfg).eval()
+        mcfg, model = hf_config_to_model(hf_model.config)
+        mcfg = dataclasses.replace(mcfg, use_flash=False, dtype="float32")
+        from hcache_deepspeed_tpu.models.mixtral import MixtralForCausalLM
+        model = MixtralForCausalLM(mcfg)
+        params = convert_hf_state_dict(hf_model, "qwen2_moe")
+        _assert_close(_logits_ours(model, mcfg, params),
+                      _logits_hf(hf_model), atol=1e-3)
+
+
 class TestErrors:
     def test_unknown_family(self):
         with pytest.raises(ValueError, match="no HF converter"):

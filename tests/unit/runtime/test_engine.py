@@ -257,3 +257,49 @@ class TestGradNorm:
         engine.train_batch(batch=_data(8))
         norm = engine.get_global_grad_norm()
         assert norm is not None and np.isfinite(norm) and norm > 0
+
+
+class TestCompilationCache:
+    def test_cache_reused_across_processes(self, tmp_path):
+        # compile.cache_dir turns on JAX's persistent compilation cache:
+        # a first process writes executables, a SECOND process reuses
+        # them (measured as a large drop in init+first-step wall time —
+        # in-process jit caching cannot explain a cross-process speedup)
+        import os
+        import subprocess
+        import sys
+
+        cache = str(tmp_path / "xla_cache")
+        child = f'''
+import time, numpy as np
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+batch = {{"input_ids": np.zeros((8, 16), np.int32)}}
+t0 = time.time()
+engine, _, _, _ = hds.initialize(
+    model=GPT2LMHeadModel(gpt2_tiny()), example_batch=batch,
+    config={{"train_batch_size": 8,
+            "optimizer": {{"type": "Adam", "params": {{"lr": 1e-3}}}},
+            "compile": {{"cache_dir": {cache!r},
+                        "cache_min_compile_time_secs": 0.0}},
+            "steps_per_print": 10**9}})
+float(engine.train_batch(batch=batch))
+print("ELAPSED", time.time() - t0)
+'''
+        env = dict(os.environ,
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))),
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        times = []
+        for _ in range(2):
+            out = subprocess.run([sys.executable, "-c", child], env=env,
+                                 capture_output=True, text=True,
+                                 timeout=400)
+            assert out.returncode == 0, out.stderr[-2000:]
+            times.append(float(out.stdout.split("ELAPSED")[1]))
+        assert os.listdir(cache), "persistent cache dir stayed empty"
+        assert times[1] < 0.7 * times[0], \
+            f"no cross-process reuse: cold {times[0]:.1f}s, " \
+            f"warm {times[1]:.1f}s"

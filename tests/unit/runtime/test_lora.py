@@ -228,6 +228,24 @@ class TestLoraEngine:
         assert want is not None
         np.testing.assert_allclose(blob[key], np.asarray(want), atol=1e-5)
 
+    def test_lora_on_tp_mesh(self, eight_devices):
+        # unquantized LoRA composes with tensor parallelism: the frozen
+        # base keeps its TP sharding, adapters replicate, training runs
+        # (conftest's autouse fixture resets the topology afterwards)
+        engine = _make_engine({**_lora_config(),
+                               "mesh": {"data": 4, "tensor": 2}})
+        fixed = _data(8, seed=0)
+        losses = [float(engine.train_batch(batch=fixed))
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_qlora_rejects_tp_mesh(self, eight_devices):
+        with pytest.raises(Exception, match="tensor/expert"):
+            _make_engine({**_lora_config(
+                quantization={"enabled": True, "q_bits": 8,
+                              "group_size": 64}),
+                "mesh": {"data": 4, "tensor": 2}})
+
     def test_lora_conflicts_rejected(self, eight_devices):
         with pytest.raises(Exception, match="offload_optimizer"):
             _make_engine({**_lora_config(),
