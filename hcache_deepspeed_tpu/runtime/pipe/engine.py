@@ -54,6 +54,12 @@ class PipelineEngine(HDSEngine):
             raise ValueError(
                 f"pipeline.schedule must be '1f1b' or 'gpipe', got "
                 f"{config.pipeline.schedule!r}")
+        if config.lora.enabled:
+            raise ValueError(
+                "LoRA is not supported with the pipeline engine: its "
+                "stacked-block kernels are 3D and the adapter transform "
+                "targets per-layer 2D kernels (fine-tune with ZeRO/TP "
+                "meshes instead)")
         module.n_microbatches = n_micro
         module.schedule = config.pipeline.schedule
         self._pipe_micro_batches = n_micro

@@ -246,6 +246,19 @@ class TestLoraEngine:
                               "group_size": 64}),
                 "mesh": {"data": 4, "tensor": 2}})
 
+    def test_lora_rejected_on_pipeline_engine(self, eight_devices):
+        from hcache_deepspeed_tpu.models.gpt2 import gpt2_pipeline_layers
+        from hcache_deepspeed_tpu.parallel import topology as topo_mod
+        from hcache_deepspeed_tpu.runtime.pipe.module import PipelineModule
+        topo = topo_mod.initialize_topology(
+            topo_mod.TopologySpec(pipe=2, data=4))
+        layers, loss_fn = gpt2_pipeline_layers(gpt2_tiny())
+        module = PipelineModule(layers, loss_fn, topology=topo,
+                                n_microbatches=2)
+        with pytest.raises(ValueError, match="pipeline engine"):
+            hds.initialize(model=module, example_batch=_data(1),
+                           topology=topo, config=_lora_config())
+
     def test_lora_conflicts_rejected(self, eight_devices):
         with pytest.raises(Exception, match="offload_optimizer"):
             _make_engine({**_lora_config(),
