@@ -44,9 +44,13 @@ def _torch_losses(hf_model, batches):
     return losses
 
 
-def _ours_losses(hf_model, batches, **extra):
+def _ours_losses(hf_model, batches, model_type="gpt2", **extra):
+    import dataclasses
     mcfg, model = hf_config_to_model(hf_model.config)
-    params = convert_hf_state_dict(hf_model, "gpt2")
+    if model_type != "gpt2":   # llama family defaults to bf16 + flash
+        mcfg = dataclasses.replace(mcfg, dtype="float32", use_flash=False)
+        model = type(model)(mcfg)
+    params = convert_hf_state_dict(hf_model, model_type)
     engine, _, _, _ = hds.initialize(
         model=model, init_params=params,
         config={
@@ -83,4 +87,22 @@ class TestTorchLossParity:
         # fp32 end to end: the trajectories agree to float tolerance
         # (measured ~2e-7); any loss-shift / bias-correction / eps /
         # weight-decay-coupling mismatch is orders of magnitude larger
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_llama_adamw_loss_trajectories_match(self, eight_devices):
+        # the llama trunk pins rope / rmsnorm / SwiGLU / GQA *gradients*
+        # against transformers, not just the forward
+        cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            attention_dropout=0.0, tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf_model = transformers.LlamaForCausalLM(cfg).train()
+        batches = _batches()
+        want = _torch_losses(hf_model, batches)
+
+        torch.manual_seed(0)
+        hf_fresh = transformers.LlamaForCausalLM(cfg)
+        got = _ours_losses(hf_fresh.eval(), batches, model_type="llama")
         np.testing.assert_allclose(got, want, rtol=1e-4)
