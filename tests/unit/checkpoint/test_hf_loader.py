@@ -224,6 +224,70 @@ class TestQwen2MoeParity:
                       _logits_hf(hf_model), atol=1e-3)
 
 
+def _tiny_hf(family):
+    torch.manual_seed(0)
+    if family == "gpt2":
+        return transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+            n_head=4, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0)).eval()
+    if family == "opt":
+        return transformers.OPTForCausalLM(transformers.OPTConfig(
+            vocab_size=256, hidden_size=64, ffn_dim=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128, word_embed_proj_dim=64,
+            do_layer_norm_before=True, dropout=0.0)).eval()
+    if family == "falcon":
+        return transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, bias=False, parallel_attn=True,
+            alibi=False, multi_query=True,
+            new_decoder_architecture=False, attention_dropout=0.0,
+            hidden_dropout=0.0)).eval()
+    if family == "phi":
+        return transformers.PhiForCausalLM(transformers.PhiConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128, partial_rotary_factor=0.5,
+            resid_pdrop=0.0, embd_pdrop=0.0,
+            attention_dropout=0.0)).eval()
+    if family == "mixtral":
+        return transformers.MixtralForCausalLM(transformers.MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            num_local_experts=4, num_experts_per_tok=2,
+            tie_word_embeddings=False)).eval()
+    raise KeyError(family)
+
+
+class TestServingEveryConvertedFamily:
+    """The full switch path per family: HF weights → converter → paged
+    serving engine, prefill logits vs the torch model."""
+
+    @pytest.mark.parametrize("family", ["gpt2", "opt", "falcon", "phi",
+                                        "mixtral"])
+    def test_prefill_parity(self, family):
+        from hcache_deepspeed_tpu.inference import (
+            RaggedInferenceEngineConfig, build_hf_engine)
+        hf_model = _tiny_hf(family)
+        params = jax.tree.map(
+            lambda x: np.asarray(x, np.float32),
+            convert_hf_state_dict(hf_model, family))
+        engine = build_hf_engine(
+            {**hf_model.config.to_dict(), "torch_dtype": "float32"},
+            params,
+            engine_config=RaggedInferenceEngineConfig(
+                state_manager={"max_tracked_sequences": 4,
+                               "max_context": 128},
+                kv_cache={"block_size": 16, "num_blocks": 32,
+                          "cache_dtype": "float32"}))
+        toks = list(TOKENS[0][:6])
+        logits, _ = engine.put([1], [toks])
+        _assert_close(np.asarray(logits[0]), _logits_hf(hf_model)[5],
+                      atol=3e-3)
+
+
 class TestErrors:
     def test_unknown_family(self):
         with pytest.raises(ValueError, match="no HF converter"):
