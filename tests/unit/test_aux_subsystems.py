@@ -162,3 +162,41 @@ def test_io_bench_runs(tmp_path):
                     queue_depth=8, block_mb=4)
     assert out["write_gbs"] > 0 and out["read_gbs"] > 0
     assert not any(p.startswith("blk") for p in os.listdir(tmp_path))
+
+
+def _run_bench(watchdog_secs, timeout):
+    """Launch bench.py tiny-smoke on the CPU backend; returns the JSON
+    lines and the completed process."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, PYTHONPATH=root, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               HDS_BENCH_TINY="1",
+               HDS_BENCH_WATCHDOG_SECS=watchdog_secs)
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")], env=env,
+        capture_output=True, text=True, timeout=timeout)
+    return [l for l in out.stdout.splitlines()
+            if l.startswith("{")], out
+
+
+class TestBenchScript:
+    def test_smoke_config_prints_json_line(self):
+        # bench.py must emit exactly one parseable JSON line (the driver
+        # contract), exercised on the CPU backend via the tiny config
+        import json
+        lines, out = _run_bench(watchdog_secs="300", timeout=400)
+        assert len(lines) == 1, out.stdout + out.stderr[-500:]
+        rec = json.loads(lines[0])
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+        assert rec["value"] > 0 and "error" not in rec
+
+    def test_watchdog_emits_error_line_when_stuck(self):
+        # a watchdog shorter than any possible completion forces the
+        # unreachable-relay path regardless of backend health
+        import json
+        lines, out = _run_bench(watchdog_secs="0.1", timeout=200)
+        assert lines and "error" in json.loads(lines[0])
+        assert out.returncode == 2
