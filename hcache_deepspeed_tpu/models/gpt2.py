@@ -311,6 +311,39 @@ def lm_loss_fn(logits, batch):
     return causal_lm_loss(logits, labels)
 
 
+def gpt2_flat_to_pipeline(params, cfg: GPT2Config):
+    """Flat ``GPT2LMHeadModel`` param tree → ``PipelineModule`` layout.
+
+    Reference analog: ``PipelineModule.load_state_dir`` + the layer
+    checkpoint files — loading a non-pipeline checkpoint into a pipeline
+    run. Here it is a pure tree reshape: per-layer ``h_i`` subtrees stack
+    into the body's leading layer dim, the tied embedding fills the
+    ``wte`` slot, and positional/final layers move to their pre/post
+    spots (indices fixed by ``gpt2_pipeline_layers``'s spec list). Works
+    on any flat source — a training run or
+    ``checkpoint.hf_loader.convert_hf_state_dict``."""
+    n = cfg.n_layer
+    missing = [k for k in ["wte", "wpe", "ln_f"] +
+               [f"h_{i}" for i in range(n)] if k not in params]
+    if missing:
+        raise ValueError(f"flat gpt2 tree is missing {missing}")
+    extra = [k for k in params
+             if k.startswith("h_") and int(k.split("_")[1]) >= n]
+    if extra:
+        raise ValueError(
+            f"flat gpt2 tree has layers beyond cfg.n_layer={n}: {extra} "
+            "(checkpoint/config layer-count mismatch)")
+    block_tree = jax.tree.map(lambda *xs: jnp.stack(xs),
+                              *[params[f"h_{i}"] for i in range(n)])
+    return {
+        # pre layer_0 is the tied embed (lives under tied/), layer_1 wpe
+        "pre": {"layer_1": {"wpe": dict(params["wpe"])}},
+        "post": {"layer_0": {"ln_f": dict(params["ln_f"])}},
+        "tied": {"wte": {"weight": dict(params["wte"])}},
+        "blocks": {"block": block_tree},
+    }
+
+
 def gpt2_pipeline_layers(cfg: GPT2Config):
     """(layers, loss_fn) for ``PipelineModule``: tied embed/head, positional
     embed, n_layer homogeneous blocks, final norm."""
