@@ -1,0 +1,33 @@
+"""Shared helpers for per-family pipeline decompositions."""
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_flat_layers(params, layer_prefix, n_layer, required,
+                      model_name):
+    """Validate a flat param tree and stack its per-layer subtrees into
+    the pipeline body's leading layer dim.
+
+    ``required``: non-layer keys that must exist. Rejects both missing
+    layers and layers beyond ``n_layer`` (checkpoint/config mismatch)."""
+    missing = [k for k in list(required) +
+               [f"{layer_prefix}{i}" for i in range(n_layer)]
+               if k not in params]
+    if missing:
+        raise ValueError(f"flat {model_name} tree is missing {missing}")
+
+    def layer_index(key):
+        suffix = key[len(layer_prefix):]
+        return int(suffix) if suffix.isdigit() else -1
+
+    extra = [k for k in params if k.startswith(layer_prefix)
+             and layer_index(k) >= n_layer]
+    if extra:
+        raise ValueError(
+            f"flat {model_name} tree has layers beyond "
+            f"n_layer={n_layer}: {extra} (checkpoint/config layer-count "
+            "mismatch)")
+    return jax.tree.map(lambda *xs: jnp.stack(xs),
+                        *[params[f"{layer_prefix}{i}"]
+                          for i in range(n_layer)])

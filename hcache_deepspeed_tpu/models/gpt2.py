@@ -285,12 +285,19 @@ class GPT2PosEmbed(nn.Module):
 
 
 class GPT2PipeBlock(nn.Module):
-    """Block with the pipeline body contract ``(x, train) -> x``."""
+    """Block with the pipeline body contract ``(x, train) -> x``.
+    Honors ``cfg.remat``/``remat_policy`` like the flat model."""
     cfg: GPT2Config
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        return Block(self.cfg, name="block")(x, None, train)
+        block = Block
+        if self.cfg.remat or self.cfg.remat_policy:
+            policy = getattr(jax.checkpoint_policies,
+                             self.cfg.remat_policy) \
+                if self.cfg.remat_policy else None
+            block = nn.remat(Block, static_argnums=(3,), policy=policy)
+        return block(self.cfg, name="block")(x, None, train)
 
 
 class GPT2FinalNorm(nn.Module):
@@ -322,19 +329,10 @@ def gpt2_flat_to_pipeline(params, cfg: GPT2Config):
     spots (indices fixed by ``gpt2_pipeline_layers``'s spec list). Works
     on any flat source — a training run or
     ``checkpoint.hf_loader.convert_hf_state_dict``."""
-    n = cfg.n_layer
-    missing = [k for k in ["wte", "wpe", "ln_f"] +
-               [f"h_{i}" for i in range(n)] if k not in params]
-    if missing:
-        raise ValueError(f"flat gpt2 tree is missing {missing}")
-    extra = [k for k in params
-             if k.startswith("h_") and int(k.split("_")[1]) >= n]
-    if extra:
-        raise ValueError(
-            f"flat gpt2 tree has layers beyond cfg.n_layer={n}: {extra} "
-            "(checkpoint/config layer-count mismatch)")
-    block_tree = jax.tree.map(lambda *xs: jnp.stack(xs),
-                              *[params[f"h_{i}"] for i in range(n)])
+    from ._pipe_util import stack_flat_layers
+    block_tree = stack_flat_layers(params, "h_", cfg.n_layer,
+                                   required=["wte", "wpe", "ln_f"],
+                                   model_name="gpt2")
     return {
         # pre layer_0 is the tied embed (lives under tied/), layer_1 wpe
         "pre": {"layer_1": {"wpe": dict(params["wpe"])}},

@@ -268,6 +268,98 @@ class LlamaForCausalLM(nn.Module):
         return loss
 
 
+# ------------------------------------------------------------------ #
+# Pipeline decomposition (reference: PipelineModule layer specs —
+# pipe/module.py; the gpt2 decomposition is the template)
+# ------------------------------------------------------------------ #
+class LlamaPipeEmbed(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ids = x["input_ids"] if isinstance(x, dict) else x
+        return nn.Embed(self.cfg.vocab_size, self.cfg.hidden_size,
+                        dtype=self.cfg.compute_dtype,
+                        name="embed_tokens")(ids)
+
+
+class LlamaPipeBlock(nn.Module):
+    """Block with the pipeline body contract ``(x, train) -> x`` (dense
+    aux loss is zero and dropped; MoE blocks are not pipeline-decomposed
+    here). Honors ``cfg.remat``/``remat_policy`` like the flat model."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        block = LlamaBlock
+        if self.cfg.remat or self.cfg.remat_policy:
+            policy = getattr(jax.checkpoint_policies,
+                             self.cfg.remat_policy) \
+                if self.cfg.remat_policy else None
+            block = nn.remat(LlamaBlock, static_argnums=(2,),
+                             policy=policy)
+        out, _aux = block(self.cfg, name="block")(x, train)
+        return out
+
+
+class LlamaPipeFinalNorm(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return RMSNorm(self.cfg.rms_norm_eps, name="norm")(x)
+
+
+class LlamaPipeHead(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kernel = _HeadKernel(self.cfg.hidden_size, self.cfg.vocab_size,
+                             name="lm_head")()
+        return x @ kernel.astype(x.dtype)
+
+
+def llama_pipeline_layers(cfg: LlamaConfig):
+    """(layers, loss_fn) for ``PipelineModule``: embed, n_layer
+    homogeneous blocks, final RMSNorm, untied LM head."""
+    if cfg.tie_word_embeddings:
+        raise ValueError(
+            "llama_pipeline_layers supports untied embeddings only (a "
+            "tied head would need a TiedLayerSpec pair like gpt2's)")
+    if cfg.loss_chunk:
+        from ..utils.logging import logger
+        logger.warning(
+            "llama_pipeline_layers: cfg.loss_chunk is not applied — the "
+            "pipeline loss head computes full logits (the chunked loss "
+            "needs the fused head+loss layer of the flat model)")
+    from ..runtime.pipe.module import LayerSpec
+    from .gpt2 import lm_loss_fn
+    layers = [
+        LayerSpec(LlamaPipeEmbed, cfg),
+        *[LayerSpec(LlamaPipeBlock, cfg) for _ in range(cfg.n_layer)],
+        LayerSpec(LlamaPipeFinalNorm, cfg),
+        LayerSpec(LlamaPipeHead, cfg),
+    ]
+    return layers, lm_loss_fn
+
+
+def llama_flat_to_pipeline(params, cfg: LlamaConfig):
+    """Flat ``LlamaForCausalLM`` tree (training run or
+    ``checkpoint.hf_loader``) → ``PipelineModule`` layout; see
+    ``gpt2.gpt2_flat_to_pipeline`` for the contract."""
+    from ._pipe_util import stack_flat_layers
+    block_tree = stack_flat_layers(
+        params, "layers_", cfg.n_layer,
+        required=["embed_tokens", "norm", "lm_head"], model_name="llama")
+    return {
+        "pre": {"layer_0": {"embed_tokens": dict(params["embed_tokens"])}},
+        "blocks": {"block": block_tree},
+        "post": {"layer_0": {"norm": dict(params["norm"])},
+                 "layer_1": {"lm_head": dict(params["lm_head"])}},
+    }
+
+
 def llama_tp_spec_fn(path, leaf):
     """Megatron-style TP rules (reference: AutoTP policy for HF Llama,
     module_inject/auto_tp.py — shard qkv/gate/up column-wise, o/down

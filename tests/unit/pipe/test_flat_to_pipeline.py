@@ -62,5 +62,44 @@ class TestFlatToPipeline:
         model = GPT2LMHeadModel(gpt2_tiny(n_layer=4))
         flat = model.init(jax.random.PRNGKey(0), _batch(1),
                           train=False)["params"]
-        with pytest.raises(ValueError, match="beyond cfg.n_layer"):
+        with pytest.raises(ValueError, match="beyond n_layer"):
             gpt2_flat_to_pipeline(flat, cfg)
+
+
+class TestLlamaPipeline:
+    def test_pipeline_matches_flat_model_and_trains(self, eight_devices):
+        from hcache_deepspeed_tpu.models.llama import (
+            LlamaForCausalLM, llama_flat_to_pipeline,
+            llama_pipeline_layers, llama_tiny)
+        cfg = llama_tiny(n_layer=4, use_flash=False)
+        flat_model = LlamaForCausalLM(cfg)
+        flat = flat_model.init(jax.random.PRNGKey(0), _batch(1),
+                               train=False)["params"]
+
+        topo = topo_mod.initialize_topology(
+            topo_mod.TopologySpec(pipe=2, data=4))
+        layers, loss_fn = llama_pipeline_layers(cfg)
+        module = PipelineModule(layers, loss_fn, topology=topo,
+                                n_microbatches=2)
+        engine, _, _, _ = hds.initialize(
+            model=module, example_batch=_batch(1), topology=topo,
+            init_params=llama_flat_to_pipeline(flat, cfg),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10 ** 9})
+
+        batch = _batch(8)
+        want = float(flat_model.apply({"params": flat}, batch,
+                                      train=False))
+        got = float(engine.eval_batch(batch))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_tied_embeddings_rejected(self):
+        from hcache_deepspeed_tpu.models.llama import (
+            llama_pipeline_layers, llama_tiny)
+        with pytest.raises(ValueError, match="untied"):
+            llama_pipeline_layers(llama_tiny(tie_word_embeddings=True))
