@@ -18,7 +18,10 @@ deliberately mirror HF module names; what remains is layout:
 - embeddings are ``[vocab, dim]`` on both sides;
 - flax ``LayerNorm`` calls its weight ``scale`` (HF: ``weight``).
 
-Supported model types: llama, mistral, qwen2 (llama trunk), gpt2, opt.
+Supported model types: llama, mistral, qwen2, phi3 (llama trunk —
+phi3's fused qkv/gate_up split by head counts), gpt2, opt, falcon
+(fused QKV split, all three layouts), phi, mixtral, qwen2_moe (expert
+stacking into the grouped-GEMM layout).
 """
 
 from typing import Any, Dict
@@ -339,6 +342,48 @@ def _stack_experts(tree, experts):
         _set(tree, (prefix, "mlp", "moe", "experts", wn), stacked)
 
 
+def _convert_phi3(sd, hf_config=None):
+    """phi3: the llama trunk with fused projections — ``qkv_proj`` rows
+    are [q | k | v] blocks (head counts from the config decide the
+    split) and ``gate_up_proj`` rows are [gate | up] halves."""
+    if hf_config is None:
+        raise ValueError(
+            "phi3 conversion needs hf_config (head counts decide the "
+            "fused qkv_proj split); pass the transformers model itself "
+            "or hf_config=<config dict>")
+    hf = hf_config
+    n_head = hf.get("num_attention_heads", 32)
+    n_kv = hf.get("num_key_value_heads", n_head)
+    head_dim = hf.get("hidden_size", 3072) // n_head
+    q_rows = n_head * head_dim
+    kv_rows = n_kv * head_dim
+
+    def fused_hook(tree, prefix, rest, w):
+        if rest[:2] == ["self_attn", "qkv_proj"]:
+            if w.shape[0] != q_rows + 2 * kv_rows:
+                raise ValueError(
+                    f"{prefix}: qkv_proj has {w.shape[0]} rows but the "
+                    f"config's head counts imply "
+                    f"{q_rows + 2 * kv_rows} — wrong hf_config for this "
+                    "checkpoint")
+            q = w[:q_rows]
+            k = w[q_rows:q_rows + kv_rows]
+            v = w[q_rows + kv_rows:q_rows + 2 * kv_rows]
+            for name, part in (("q_proj", q), ("k_proj", k),
+                               ("v_proj", v)):
+                _set(tree, (prefix, "self_attn", name, "kernel"), part.T)
+            return True
+        if rest[:2] == ["mlp", "gate_up_proj"]:
+            half = w.shape[0] // 2
+            _set(tree, (prefix, "mlp", "gate_proj", "kernel"),
+                 w[:half].T)
+            _set(tree, (prefix, "mlp", "up_proj", "kernel"), w[half:].T)
+            return True
+        return False
+
+    return _convert_llama_trunk(sd, layer_hook=fused_hook)
+
+
 def _convert_qwen2_moe(sd):
     """qwen2_moe: the llama trunk + ``mlp.gate`` router, per-expert
     gate/up/down linears stacked into the grouped-GEMM w1/w3/w2 layout,
@@ -387,6 +432,7 @@ _CONVERTERS = {
     "opt": _convert_opt,
     "falcon": _convert_falcon,
     "phi": _convert_phi,
+    "phi3": _convert_phi3,
     "mixtral": _convert_mixtral,
     "qwen2_moe": _convert_qwen2_moe,
 }
@@ -400,7 +446,8 @@ def convert_hf_state_dict(state_dict, model_type: str,
     ``state_dict()`` is taken — and its config, for families whose
     weight layout depends on head counts) or a path to a
     ``.safetensors`` file. ``hf_config`` (dict or transformers config)
-    is required for falcon when passing a bare state_dict."""
+    is required for falcon and phi3 when passing a bare state_dict
+    (their fused-projection splits need the head counts)."""
     if hasattr(state_dict, "state_dict"):
         if hf_config is None and hasattr(state_dict, "config"):
             hf_config = state_dict.config
@@ -416,10 +463,10 @@ def convert_hf_state_dict(state_dict, model_type: str,
     if model_type not in _CONVERTERS:
         raise ValueError(f"no HF converter for model_type={model_type!r}; "
                          f"have {sorted(_CONVERTERS)}")
-    if model_type == "falcon":
+    if model_type in ("falcon", "phi3"):
         if hf_config is not None and not isinstance(hf_config, dict):
             hf_config = hf_config.to_dict()
-        return _convert_falcon(dict(state_dict), hf_config)
+        return _CONVERTERS[model_type](dict(state_dict), hf_config)
     return _CONVERTERS[model_type](dict(state_dict))
 
 

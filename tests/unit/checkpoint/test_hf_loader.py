@@ -181,6 +181,28 @@ class TestPhiParity:
                       _logits_hf(hf_model))
 
 
+class TestPhi3Parity:
+    def test_logit_parity_with_fused_splits(self):
+        cfg = transformers.Phi3Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            pad_token_id=0, resid_pdrop=0.0, embd_pdrop=0.0,
+            attention_dropout=0.0)
+        torch.manual_seed(0)
+        hf_model = transformers.Phi3ForCausalLM(cfg).eval()
+        mcfg, model = hf_config_to_model(hf_model.config)
+        mcfg = dataclasses.replace(mcfg, use_flash=False, dtype="float32")
+        model = type(model)(mcfg)
+        params = convert_hf_state_dict(hf_model, "phi3")
+        _assert_close(_logits_ours(model, mcfg, params),
+                      _logits_hf(hf_model))
+
+    def test_config_required(self):
+        with pytest.raises(ValueError, match="needs hf_config"):
+            convert_hf_state_dict({}, "phi3")
+
+
 class TestMixtralParity:
     def test_logit_parity(self):
         cfg = transformers.MixtralConfig(
