@@ -307,15 +307,19 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     def generate(self, prompts, max_new_tokens: int = 32,
                  eos_token_id: int = None, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0, return_logits: bool = False):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 return_logits: bool = False):
         """Batched prefill + ragged decode loop.
 
         ``prompts``: list of token-id lists. Greedy when temperature==0,
-        else softmax sampling (optionally top-k). Returns the generated
-        continuations (without the prompt), plus per-step logits when
-        ``return_logits`` (for RLHF-style log-prob computation). Sequences
-        are flushed from the KV cache on completion.
+        else softmax sampling (optionally top-k and/or nucleus top-p).
+        Returns the generated continuations (without the prompt), plus
+        per-step logits when ``return_logits`` (for RLHF-style log-prob
+        computation). Sequences are flushed from the KV cache on
+        completion.
         """
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         rng = np.random.default_rng(seed)
         base = max(self.state._seqs.keys(), default=-1) + 1
         uids = [base + i for i in range(len(prompts))]
@@ -330,6 +334,14 @@ class InferenceEngineV2:
                 logits = np.where(logits < kth, -np.inf, logits)
             p = np.exp(logits - logits.max())
             p /= p.sum()
+            if top_p < 1.0:
+                # nucleus: smallest prob-sorted set with mass >= top_p
+                order = np.argsort(p)[::-1]
+                keep_sorted = np.cumsum(p[order]) - p[order] < top_p
+                keep = np.zeros_like(p, dtype=bool)
+                keep[order] = keep_sorted
+                p = np.where(keep, p, 0.0)
+                p /= p.sum()
             return int(rng.choice(len(p), p=p))
 
         outs = [[] for _ in prompts]
