@@ -1,11 +1,13 @@
 """Data-efficiency pipeline (reference: deepspeed/runtime/data_pipeline/):
-curriculum learning scheduler + curriculum-aware sampler + random-LTD."""
+curriculum learning scheduler + curriculum-aware sampler + offline data
+analyzer + random-LTD."""
 
 from .curriculum_scheduler import CurriculumScheduler
+from .data_analyzer import DataAnalyzer, load_metric
 from .data_sampler import CurriculumSampler
 from .random_ltd import (RandomLTDScheduler, random_ltd_layer,
                          sample_tokens, scatter_back)
 
-__all__ = ["CurriculumScheduler", "CurriculumSampler",
-           "RandomLTDScheduler", "random_ltd_layer", "sample_tokens",
-           "scatter_back"]
+__all__ = ["CurriculumScheduler", "CurriculumSampler", "DataAnalyzer",
+           "load_metric", "RandomLTDScheduler", "random_ltd_layer",
+           "sample_tokens", "scatter_back"]

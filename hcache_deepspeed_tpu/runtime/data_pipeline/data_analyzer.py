@@ -1,0 +1,135 @@
+"""Offline dataset analysis for curriculum learning.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/data_sampling/
+data_analyzer.py`` (~900 LoC) — a map-reduce over the training set that
+computes per-sample difficulty metrics on sharded workers, then merges
+them into index files the curriculum sampler consumes.
+
+Lean TPU-native form: the same worker-sharded map → merge → index
+pipeline with numpy + ``.npz`` artifacts (no mmap buffer zoo). Two
+metric types, as in the reference:
+
+- ``single_value_per_sample`` — one value per sample (e.g. sequence
+  length, vocab rarity); the merge concatenates worker shards and also
+  emits the value→samples index (samples sorted by metric) that
+  ``CurriculumSampler`` takes as its ``metric``.
+- ``accumulate_value_over_samples`` — one running total over the whole
+  set (e.g. a vocabulary histogram); the merge sums worker partials.
+"""
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_TYPES = ("single_value_per_sample", "accumulate_value_over_samples")
+
+
+class DataAnalyzer:
+    def __init__(self, dataset: Sequence,
+                 metric_functions: List[Callable],
+                 metric_names: Optional[List[str]] = None,
+                 metric_types: Optional[List[str]] = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1,
+                 worker_id: int = 0):
+        if metric_names is None:
+            metric_names = [f"metric_{i}"
+                            for i in range(len(metric_functions))]
+        if metric_types is None:
+            metric_types = ["single_value_per_sample"] * \
+                len(metric_functions)
+        if not (len(metric_functions) == len(metric_names)
+                == len(metric_types)):
+            raise ValueError("metric_functions/names/types lengths differ")
+        bad = [t for t in metric_types if t not in _TYPES]
+        if bad:
+            raise ValueError(f"unknown metric types {bad}; know {_TYPES}")
+        if not 0 <= worker_id < num_workers:
+            raise ValueError(f"worker_id {worker_id} outside "
+                             f"num_workers {num_workers}")
+        if "sample_ids" in metric_names:
+            raise ValueError(
+                "'sample_ids' is reserved for the shard index; rename "
+                "the metric")
+        if num_workers > len(dataset):
+            raise ValueError(
+                f"num_workers {num_workers} > dataset size "
+                f"{len(dataset)} would leave workers with empty shards")
+        self.dataset = dataset
+        self.metric_functions = metric_functions
+        self.metric_names = metric_names
+        self.metric_types = metric_types
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # ---------------- map ---------------- #
+    def _shard_indices(self, worker_id):
+        return range(worker_id, len(self.dataset), self.num_workers)
+
+    def run_map(self) -> Dict[str, np.ndarray]:
+        """Compute this worker's shard and persist it. Returns
+        {metric_name: values} (per-sample arrays for single-value
+        metrics, running totals for accumulated ones)."""
+        idx = np.fromiter(self._shard_indices(self.worker_id), np.int64)
+        out = {"sample_ids": idx}
+        for fn, name, typ in zip(self.metric_functions, self.metric_names,
+                                 self.metric_types):
+            vals = [fn(self.dataset[int(i)]) for i in idx]
+            if typ == "single_value_per_sample":
+                out[name] = np.asarray(vals)
+            else:
+                out[name] = np.sum(np.asarray(vals, dtype=np.float64),
+                                   axis=0)
+        os.makedirs(self.save_path, exist_ok=True)
+        np.savez(os.path.join(self.save_path,
+                              f"map_worker{self.worker_id}.npz"), **out)
+        return out
+
+    # ---------------- reduce ---------------- #
+    def run_reduce(self) -> Dict[str, np.ndarray]:
+        """Merge every worker's map output into the final index files:
+        per-sample values in dataset order, plus ``<name>_index`` —
+        sample ids sorted by ascending metric (the curriculum order).
+        Missing worker files raise (partial map)."""
+        shards = []
+        for w in range(self.num_workers):
+            path = os.path.join(self.save_path, f"map_worker{w}.npz")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"worker {w} map output missing ({path}); run "
+                    "run_map on every worker first")
+            shards.append(dict(np.load(path)))
+        n = len(self.dataset)
+        merged: Dict[str, np.ndarray] = {}
+        for name, typ in zip(self.metric_names, self.metric_types):
+            if typ == "single_value_per_sample":
+                values = np.zeros(n, dtype=np.asarray(
+                    shards[0][name]).dtype)
+                for sh in shards:
+                    values[sh["sample_ids"]] = sh[name]
+                merged[name] = values
+                merged[f"{name}_index"] = np.argsort(values, kind="stable")
+            else:
+                merged[name] = np.sum([sh[name] for sh in shards], axis=0)
+        np.savez(os.path.join(self.save_path, "metrics.npz"), **merged)
+        return merged
+
+    def run_map_reduce(self) -> Dict[str, np.ndarray]:
+        """Single-process convenience: map every shard, then reduce."""
+        for w in range(self.num_workers):
+            DataAnalyzer(self.dataset, self.metric_functions,
+                         self.metric_names, self.metric_types,
+                         self.save_path, self.num_workers, w).run_map()
+        return self.run_reduce()
+
+
+def load_metric(save_path: str, name: str) -> np.ndarray:
+    """Per-sample metric values from a completed analysis — feed
+    directly to ``CurriculumSampler(metric=...)``."""
+    blob = np.load(os.path.join(save_path, "metrics.npz"))
+    if name not in blob:
+        raise KeyError(f"metric {name!r} not in analysis; have "
+                       f"{sorted(blob.files)}")
+    return blob[name]
