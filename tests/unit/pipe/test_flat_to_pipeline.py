@@ -103,3 +103,43 @@ class TestLlamaPipeline:
             llama_pipeline_layers, llama_tiny)
         with pytest.raises(ValueError, match="untied"):
             llama_pipeline_layers(llama_tiny(tie_word_embeddings=True))
+
+
+class TestPipeResizeResume:
+    def test_checkpoint_resumes_at_different_pipe_degree(
+            self, eight_devices, tmp_path):
+        """The stacked-blocks layout is topology-free: a checkpoint
+        trained at pipe=2 restores at pipe=4 (resharding-on-load) and
+        continues training — the pipe axis of the universal-checkpoint
+        reshape matrix (dp/tp/zero/EP are covered elsewhere)."""
+        cfg = gpt2_tiny(n_layer=4)
+        batch = _batch(8)
+
+        def build(pipe, data):
+            topo = topo_mod.initialize_topology(
+                topo_mod.TopologySpec(pipe=pipe, data=data))
+            layers, loss_fn = gpt2_pipeline_layers(cfg)
+            module = PipelineModule(layers, loss_fn, topology=topo,
+                                    n_microbatches=2)
+            engine, _, _, _ = hds.initialize(
+                model=module, example_batch=_batch(1), topology=topo,
+                config={"train_batch_size": 8,
+                        "optimizer": {"type": "Adam",
+                                      "params": {"lr": 1e-3}},
+                        "steps_per_print": 10 ** 9})
+            return engine
+
+        engine = build(pipe=2, data=4)
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(3)]
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        ref = jax.tree.map(np.asarray, engine.state["params"])
+        topo_mod.reset_topology()
+
+        engine2 = build(pipe=4, data=2)
+        engine2.load_checkpoint(str(tmp_path), tag="t")
+        for a, b in zip(jax.tree.leaves(ref),
+                        jax.tree.leaves(engine2.state["params"])):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        l2 = float(engine2.train_batch(batch=batch))
+        assert np.isfinite(l2) and l2 < losses[0]
