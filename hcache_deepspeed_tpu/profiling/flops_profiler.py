@@ -24,15 +24,25 @@ def _fmt(n, units=(("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3))):
     return f"{n:.2f} "
 
 
+def extract_cost(compiled) -> Dict[str, float]:
+    """{flops, bytes_accessed} from a compiled executable's cost
+    analysis; tolerates the None and list-of-dicts return shapes."""
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+    }
+
+
 def analyze_fn(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict:
     """Compile ``fn`` and return {flops, bytes_accessed, peak_memory}."""
     compiled = jax.jit(fn, static_argnums=static_argnums).lower(
         *args, **kwargs).compile()
-    cost = compiled.cost_analysis() or {}
     mem = compiled.memory_analysis()
     return {
-        "flops": float(cost.get("flops", 0.0)),
-        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        **extract_cost(compiled),
         "peak_memory_bytes": getattr(mem, "temp_size_in_bytes", 0) +
         getattr(mem, "argument_size_in_bytes", 0),
         "compiled": compiled,

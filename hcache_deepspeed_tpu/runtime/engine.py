@@ -33,6 +33,8 @@ scanned on device).
 
 from typing import Callable, Optional
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -961,6 +963,13 @@ class HDSEngine:
         if self._offload is not None:
             # offloaded step is host-side: run the micro-batch loop through
             # forward/backward/step instead of the fused device program
+            if self.config.flops_profiler.enabled and \
+                    not getattr(self, "_flops_offload_warned", False):
+                self._flops_offload_warned = True
+                log_dist("flops profiler: not supported on the "
+                         "offload_optimizer path (no fused device "
+                         "program to analyze); no report will be "
+                         "emitted", ranks=[0])
             if batch is None and data_iter is None:
                 if self.training_dataloader is None:
                     raise ValueError("train_batch needs data_iter or batch")
@@ -1018,8 +1027,20 @@ class HDSEngine:
         if self.progressive_layer_drop is not None:
             pld_theta = jnp.asarray(
                 self.progressive_layer_drop.get_theta(), jnp.float32)
+        fp_cfg = self.config.flops_profiler
+        profiling = (fp_cfg.enabled
+                     and self.global_steps == fp_cfg.profile_step)
+        if profiling:
+            # drain prior in-flight device work so the timed window is
+            # exactly this step
+            jax.block_until_ready(self.state)
+            t0 = time.perf_counter()
         self.state, loss, finite, grad_norm = self._fused_train_batch(
             self.state, batch, lr, self._next_rng(), moq_bits, pld_theta)
+        if profiling:
+            loss.block_until_ready()
+            self._print_flops_profile(batch, lr, moq_bits, pld_theta,
+                                      time.perf_counter() - t0)
         self._last_grad_norm = grad_norm
         self.micro_steps += gas
         self._after_step(finite)
@@ -1031,6 +1052,40 @@ class HDSEngine:
             self.monitor.write_events([
                 ("Train/loss", float(loss), self.global_steps)])
         return loss
+
+    def _print_flops_profile(self, shaped_batch, lr, moq_bits, pld_theta,
+                             step_seconds):
+        """``flops_profiler`` config block (reference: the engine calls
+        the profiler at ``profile_step``, engine.py:301,1985). The cost
+        comes from XLA's analysis of the ACTUAL fused train program —
+        fusion-aware, unlike operator-level MAC counting. Numbers are
+        PER DEVICE (the analyzed program is the partitioned SPMD
+        module), matching the reference's per-GPU reporting."""
+        from ..profiling.flops_profiler import FlopsProfiler, extract_cost
+        fp_cfg = self.config.flops_profiler
+        prof = FlopsProfiler(engine=self, config=fp_cfg)
+        try:
+            # AOT lower/compile does not reuse the live jit executable —
+            # this is a one-off second compile of the train program
+            log_dist("flops profiler: compiling the train program for "
+                     "cost analysis (one-off, may take a while)",
+                     ranks=[0])
+            cost = extract_cost(self._fused_train_batch.lower(
+                self.state, shaped_batch, lr, jax.random.PRNGKey(0),
+                moq_bits, pld_theta).compile())
+            prof.flops = cost["flops"]
+            prof.bytes_accessed = cost["bytes_accessed"]
+            prof.duration = step_seconds
+            lines = []
+            prof.print_model_profile(out=lines.append)
+            text = "\n".join(lines)
+            if fp_cfg.output_file and jax.process_index() == 0:
+                with open(fp_cfg.output_file, "w") as fh:
+                    fh.write(text + "\n")
+            log_dist(text, ranks=[0])
+        except Exception as exc:   # profiling must never kill training
+            log_dist(f"flops profiler: report unavailable ({exc})",
+                     ranks=[0])
 
     def _curriculum_difficulty_for_step(self):
         d = self.curriculum_scheduler.update_difficulty(
