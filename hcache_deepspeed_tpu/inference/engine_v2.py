@@ -20,11 +20,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..platform import get_platform
 from ..utils.logging import log_dist
 from .config import RaggedInferenceEngineConfig
 from .model import PagedInferenceModel
 from .ragged.kv_cache import BlockedKVCache, StateManager
 from .scheduling import SchedulingError, SchedulingResult
+
+
+def _annotated(name):
+    """Trace-annotate a serving entry point (reference:
+    instrument_w_nvtx on the v2 engine's hot methods). ``get_platform``
+    is called per invocation (cheap singleton) so test platform
+    overrides are respected."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with get_platform().annotate(name):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -160,6 +175,7 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     # put (reference: engine_v2.py:131)
     # -------------------------------------------------------------- #
+    @_annotated("hds.serve.put")
     def put(self, batch_uids: Iterable[int],
             batch_tokens: Iterable, do_checks: bool = True):
         """One forward over a ragged batch. Returns
@@ -442,6 +458,7 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)
     # -------------------------------------------------------------- #
+    @_annotated("hds.serve.restore_kv")
     def restore_kv(self, batch_uids: Iterable[int], batch_tokens: Iterable,
                    batch_latents: Iterable) -> None:
         """Rebuild the blocked KV cache for ``batch_uids`` from saved

@@ -838,10 +838,11 @@ class HDSEngine:
         if self.progressive_layer_drop is not None:
             extra_kw["pld_theta"] = jnp.asarray(
                 self.progressive_layer_drop.get_theta(), jnp.float32)
-        loss, new_acc = self._micro_fwd_bwd(
-            self.state["params"], self.state["grad_acc"],
-            self.state["loss_scale"], batch, self._next_rng(), True,
-            **extra_kw)
+        with self.platform.annotate("hds.fwd_bwd"):
+            loss, new_acc = self._micro_fwd_bwd(
+                self.state["params"], self.state["grad_acc"],
+                self.state["loss_scale"], batch, self._next_rng(), True,
+                **extra_kw)
         self.state["grad_acc"] = new_acc
         self._pending = loss
         if self.wall_clock_breakdown:
@@ -867,10 +868,13 @@ class HDSEngine:
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
         if self._offload is not None:
-            finite = self._offload_step()
+            with self.platform.annotate("hds.optimizer_step"):
+                finite = self._offload_step()
         else:
             lr = jnp.asarray(self._current_lr, jnp.float32)
-            self.state, finite, grad_norm = self._apply_step(self.state, lr)
+            with self.platform.annotate("hds.optimizer_step"):
+                self.state, finite, grad_norm = self._apply_step(
+                    self.state, lr)
             self._last_grad_norm = grad_norm
         self._after_step(finite)
         if self.wall_clock_breakdown:
@@ -1035,8 +1039,11 @@ class HDSEngine:
             # exactly this step
             jax.block_until_ready(self.state)
             t0 = time.perf_counter()
-        self.state, loss, finite, grad_norm = self._fused_train_batch(
-            self.state, batch, lr, self._next_rng(), moq_bits, pld_theta)
+        # trace annotation (reference: instrument_w_nvtx on hot paths)
+        with self.platform.annotate("hds.train_batch"):
+            self.state, loss, finite, grad_norm = self._fused_train_batch(
+                self.state, batch, lr, self._next_rng(), moq_bits,
+                pld_theta)
         if profiling:
             loss.block_until_ready()
             self._print_flops_profile(batch, lr, moq_bits, pld_theta,
