@@ -181,3 +181,41 @@ class TestQuantizedServing:
         dec_b, _ = b.put([1], [[nxt]])
         np.testing.assert_allclose(np.asarray(dec_b[0]),
                                    np.asarray(dec_a[0]), atol=2e-2)
+
+
+class TestInt4Serving:
+    """bits=4 rides the same weight-only path (reference: the int4
+    groupwise quantizer, csrc/quantization quantize_intX)."""
+
+    def _setup(self):
+        from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM,
+                                                       llama_tiny)
+        cfg = llama_tiny(max_positions=128, use_flash=False)
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": np.zeros((1, 8), np.int32)},
+                            train=False)["params"]
+        return cfg, params
+
+    def test_int4_serves_with_bounded_drift(self):
+        cfg, params = self._setup()
+        kw = dict(state_manager={"max_tracked_sequences": 4,
+                                 "max_context": 128},
+                  kv_cache={"block_size": 16, "num_blocks": 24,
+                            "cache_dtype": "float32"})
+        fp = InferenceEngineV2(cfg, params,
+                               config=RaggedInferenceEngineConfig(**kw))
+        q4 = InferenceEngineV2(
+            cfg, params,
+            config=RaggedInferenceEngineConfig(
+                **kw, quantization={"enabled": True, "bits": 4,
+                                    "group_size": 32, "min_size": 1024}))
+        rng = np.random.default_rng(5)
+        prompt = list(rng.integers(0, cfg.vocab_size, (10,)))
+        lf, _ = fp.put([1], [prompt])
+        lq, _ = q4.put([1], [prompt])
+        lf, lq = np.asarray(lf[0]), np.asarray(lq[0])
+        assert np.isfinite(lq).all()
+        scale = np.abs(lf).max() + 1e-6
+        # int4 is coarser than int8: wider but still bounded drift
+        assert np.abs(lf - lq).max() / scale < 0.45
