@@ -9,7 +9,9 @@ TPU re-design: no module surgery. The model stays untouched; LoRA is a
 *parameter-tree transformation* used by the engine's compiled train step:
 
 - ``init_lora_params(rng, params, cfg)`` builds a small trainable tree of
-  ``{a, b}`` factors for every targeted 2D kernel,
+  ``{a, b}`` factors for every targeted weight — 2D kernels, and 3D
+  expert-stacked matrices (per-expert adapter pairs; beyond the
+  reference, which never adapts experts),
 - ``quantize_base(params, cfg)`` optionally replaces those kernels with
   groupwise-quantized storage (``ops/quantizer.QuantizedTensor`` /
   ``ops/fp_quantizer``) — the QLoRA memory shape,
@@ -32,22 +34,44 @@ from .config import LoRAConfig
 _SEP = "/"
 
 
-def _kernel_paths(params, target_mods) -> Dict[str, Tuple[int, int]]:
-    """Flat-path -> (in, out) for every targeted 2D ``kernel`` leaf.
+def _path_names(path):
+    return [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
 
-    A leaf is targeted when its name is ``kernel``, it is 2D, and any
-    path component matches a ``target_mods`` entry (reference:
-    AutoTP-style name matching, ``auto_tp.py``)."""
+
+def _adapter_key(names, keys):
+    """The adapter-tree key for a weight leaf, or None: 2D kernels are
+    keyed by module prefix (the ``kernel`` level implied), 3D
+    expert-stacked leaves by their full path."""
+    prefix = _SEP.join(names[:-1])
+    if names[-1] == "kernel" and prefix in keys:
+        return prefix
+    full = _SEP.join(names)
+    return full if full in keys else None
+
+
+def _kernel_paths(params, target_mods) -> Dict[str, Tuple[int, ...]]:
+    """Flat-path -> shape for every targeted weight leaf.
+
+    Two leaf forms are targeted (reference: AutoTP-style name matching,
+    ``auto_tp.py``):
+
+    - a 2D ``kernel`` under a module whose name matches ``target_mods``
+      (keyed by the module path — the ``kernel`` level is implied);
+    - a 3D expert-stacked matrix ``[E, in, out]`` whose OWN name matches
+      ``target_mods`` (e.g. the dropless MoE ``w1``/``w3``/``w2``),
+      keyed by the full leaf path. Each expert then gets its own
+      adapter pair (beyond the reference, which never adapts experts).
+    """
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     out = {}
     for path, leaf in flat:
-        names = [getattr(k, "key", getattr(k, "name", str(k)))
-                 for k in path]
-        if names[-1] != "kernel" or getattr(leaf, "ndim", 0) != 2:
-            continue
-        if not any(m in names for m in target_mods):
-            continue
-        out[_SEP.join(str(n) for n in names[:-1])] = leaf.shape
+        names = _path_names(path)
+        ndim = getattr(leaf, "ndim", 0)
+        if names[-1] == "kernel" and ndim == 2 and \
+                any(m in names for m in target_mods):
+            out[_SEP.join(names[:-1])] = leaf.shape
+        elif ndim == 3 and names[-1] in target_mods:
+            out[_SEP.join(names)] = leaf.shape
     return out
 
 
@@ -61,26 +85,33 @@ def init_lora_params(rng, params, cfg: LoRAConfig,
     targets = _kernel_paths(params, cfg.target_mods)
     if not targets:
         raise ValueError(
-            f"LoRA found no 2D 'kernel' parameters matching target_mods="
-            f"{cfg.target_mods}")
+            f"LoRA found no adaptable weights for target_mods="
+            f"{cfg.target_mods}: 2D 'kernel' leaves match by ANCESTOR "
+            "module name (e.g. 'q_proj'), 3D expert stacks by their OWN "
+            "leaf name (e.g. 'w1'/'w3'/'w2')")
     keys = jax.random.split(rng, len(targets))
     tree = {}
-    for key, (path, (fan_in, fan_out)) in zip(keys, sorted(targets.items())):
+    for key, (path, shape) in zip(keys, sorted(targets.items())):
         leaf_dtype = dtype or jnp.float32
-        tree[path] = {
-            "a": (jax.random.normal(key, (fan_in, cfg.lora_r))
-                  * (1.0 / fan_in ** 0.5)).astype(leaf_dtype),
-            "b": jnp.zeros((cfg.lora_r, fan_out), leaf_dtype),
-        }
+        if len(shape) == 3:   # expert-stacked [E, in, out]
+            n_e, fan_in, fan_out = shape
+            tree[path] = {
+                "a": (jax.random.normal(key, (n_e, fan_in, cfg.lora_r))
+                      * (1.0 / fan_in ** 0.5)).astype(leaf_dtype),
+                "b": jnp.zeros((n_e, cfg.lora_r, fan_out), leaf_dtype),
+            }
+        else:
+            fan_in, fan_out = shape
+            tree[path] = {
+                "a": (jax.random.normal(key, (fan_in, cfg.lora_r))
+                      * (1.0 / fan_in ** 0.5)).astype(leaf_dtype),
+                "b": jnp.zeros((cfg.lora_r, fan_out), leaf_dtype),
+            }
     return tree
 
 
 def _is_quantized(leaf):
     return hasattr(leaf, "dequantize")
-
-
-def _path_names(path):
-    return [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
 
 
 def quantize_base(params, cfg: LoRAConfig):
@@ -118,8 +149,7 @@ def quantize_base(params, cfg: LoRAConfig):
                                         num_bits=qcfg.q_bits)
 
     def visit(path, leaf):
-        names = _path_names(path)
-        if names[-1] == "kernel" and _SEP.join(names[:-1]) in targets:
+        if _adapter_key(_path_names(path), targets) is not None:
             return make(leaf)
         return leaf
 
@@ -138,12 +168,15 @@ def merge_lora(frozen, lora, cfg: LoRAConfig):
     def visit(path, leaf):
         if _is_quantized(leaf):
             leaf = leaf.dequantize()
-        names = _path_names(path)
-        prefix = _SEP.join(names[:-1])
-        if names[-1] == "kernel" and prefix in lora:
-            consumed.add(prefix)
-            ab = lora[prefix]["a"].astype(jnp.float32) @ \
-                lora[prefix]["b"].astype(jnp.float32)
+        key = _adapter_key(_path_names(path), lora)
+        if key is not None:
+            consumed.add(key)
+            a = lora[key]["a"].astype(jnp.float32)
+            b = lora[key]["b"].astype(jnp.float32)
+            if a.ndim == 3:   # per-expert adapters [E, in, r] @ [E, r, out]
+                ab = jnp.einsum("eir,ero->eio", a, b)
+            else:
+                ab = a @ b
             return (leaf.astype(jnp.float32)
                     + scale * ab).astype(leaf.dtype)
         return leaf

@@ -428,6 +428,24 @@ class HDSEngine:
             adapters = init_lora_params(
                 jax.random.fold_in(jax.random.PRNGKey(self._rng_seed), 7),
                 params, self._lora_cfg, dtype=self.compute_dtype)
+            # adapter leaves must not inherit model TP rules (a hand
+            # tp_spec_fn pattern-matching e.g. expert paths would shard
+            # the tiny rank dim); adapters replicate on tensor/expert
+            # axes — ZeRO still shards them at stage >= 3 via the base
+            # spec. The adapter tree's structure is unmistakable: flat
+            # "/"-joined path keys at the top level with {a, b} children.
+            model_tp_fn = policy.tp_spec_fn
+            adapter_roots = set(adapters)
+
+            def lora_aware_tp_fn(path, leaf):
+                names = [str(getattr(k, "key", getattr(k, "name", k)))
+                         for k in path]
+                if names and names[0] in adapter_roots and \
+                        names[-1] in ("a", "b"):
+                    return PartitionSpec()
+                return model_tp_fn(path, leaf)
+
+            policy.tp_spec_fn = lora_aware_tp_fn
             frozen = params
             if qc is not None:
                 # the flat [G, group] quantized layout cannot carry a
