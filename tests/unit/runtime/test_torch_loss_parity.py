@@ -89,6 +89,45 @@ class TestTorchLossParity:
         # weight-decay-coupling mismatch is orders of magnitude larger
         np.testing.assert_allclose(got, want, rtol=1e-4)
 
+    def test_mixtral_adamw_loss_trajectories_match(self, eight_devices):
+        # MoE: exact top-k routing + expert gradients vs transformers.
+        # HF's default loss is pure CE (router aux only with
+        # output_router_logits), so our aux coefficient is zeroed.
+        import dataclasses
+        cfg = transformers.MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            num_local_experts=4, num_experts_per_tok=2,
+            attention_dropout=0.0, tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf_model = transformers.MixtralForCausalLM(cfg).train()
+        batches = _batches()
+        want = _torch_losses(hf_model, batches)
+
+        torch.manual_seed(0)
+        hf_fresh = transformers.MixtralForCausalLM(cfg).eval()
+        mcfg, _ = hf_config_to_model(hf_fresh.config)
+        mcfg = dataclasses.replace(mcfg, use_flash=False,
+                                   dtype="float32", dropless=True,
+                                   moe_aux_loss_coef=0.0)
+        from hcache_deepspeed_tpu.models.mixtral import MixtralForCausalLM
+        model = MixtralForCausalLM(mcfg)
+        params = convert_hf_state_dict(hf_fresh, "mixtral")
+        engine, _, _, _ = hds.initialize(
+            model=model, init_params=params,
+            config={
+                "train_batch_size": BATCH,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": LR, "betas": list(BETAS),
+                                         "eps": EPS,
+                                         "weight_decay": WD}},
+                "steps_per_print": 10 ** 9,
+            })
+        got = [float(engine.train_batch(batch={"input_ids": b}))
+               for b in batches]
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
     def test_llama_adamw_loss_trajectories_match(self, eight_devices):
         # the llama trunk pins rope / rmsnorm / SwiGLU / GQA *gradients*
         # against transformers, not just the forward
