@@ -9,13 +9,22 @@ reference's published 54% MFU (Ulysses blog headline, BASELINE.md) —
 the portable efficiency yardstick when the hardware differs from the
 reference's A100/H100 runs.
 
-Round-2 measured points on the v5e chip (see memory/axon-env-and-bench):
-this config ran at 49.9% MFU; batch>=16 or 760M variants crash the
-remote compile helper, so the largest reliable point ships.
+Candidate-runner structure: the axon relay's *remote compile* service
+is a separate failure domain from program *execution* — when it wedges,
+already-compiled programs still run but any new shape hangs forever at
+compile. So the parent process runs each candidate config in a child
+process with a hard timeout (a hung compile sits in a C call and can
+only be killed from outside), measures every candidate that fits in the
+wall-clock budget, and reports the best by MFU. The list ends with the
+config known to be server-side compile-cached, so a wedged compile
+service still produces a real number; a candidate timing out (the wedge
+signature) skips straight to that cached config. Child mode is selected
+with ``HDS_BENCH_CHILD=<config name>``.
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -25,9 +34,44 @@ import numpy as np
 # Wall-clock watchdog: through the axon tunnel a dead relay makes the
 # first JAX call hang forever at backend init. A clean JSON error line
 # beats an infinite hang for whoever is recording this run.
-_WATCHDOG_SECS = float(os.environ.get("HDS_BENCH_WATCHDOG_SECS", 900))
+_WATCHDOG_SECS = float(os.environ.get("HDS_BENCH_WATCHDOG_SECS", 1800))
+# per-candidate budget (compile + 30 measured steps; a healthy relay
+# compiles this program in ~60-90s)
+_CAND_SECS = float(os.environ.get("HDS_BENCH_CAND_SECS", 420))
+# floor reserved for the final cache-proven candidate (it completes in
+# ~200s when the relay executes at all)
+_LAST_RESERVE = 300.0
 _DONE = threading.Event()   # set before the success print: a timer that
 # fires in the completion window must not add a second JSON line
+_CHILD = None               # current candidate subprocess, for the watchdog
+
+# Ordered best-first; the LAST entry must be the config known to be in
+# the relay's server-side compile cache (it is what previous rounds ran),
+# so it still reports when the remote-compile service is wedged.
+# All are GPT-2 350M-class (n_params within 1% of each other); the
+# model-shape deltas are TPU layout fixes, not model shrinkage:
+#   hd128  — 8 heads x head_dim 128: full-depth MXU contractions in the
+#            flash kernel (at head_dim 64 the systolic array runs half
+#            empty during QK^T / AV)
+#   vpad   — vocab 50304 (128-multiple): lane-aligned LM-head matmul
+#   lchunk — chunked LM loss: no [B, T, V] fp32 logits materialization
+CANDIDATES = ["350m-hd128-lchunk-b8", "350m-hd128-b8", "350m-b8"]
+
+# Configs beyond CANDIDATES stay reachable for manual measurement via
+# HDS_BENCH_CHILD=<name> (how new candidates get vetted on the chip
+# before joining the list).
+CONFIGS = {
+    # the round-2 measured point: 50.3% MFU, server-cache-proven
+    "350m-b8": dict(batch=8, n_head=16, vocab_size=50257, loss_chunk=0),
+    "350m-hd128-b8": dict(batch=8, n_head=8, vocab_size=50304,
+                          loss_chunk=0),
+    "350m-hd128-lchunk-b8": dict(batch=8, n_head=8, vocab_size=50304,
+                                 loss_chunk=256),
+    "350m-hd128-b16": dict(batch=16, n_head=8, vocab_size=50304,
+                           loss_chunk=0),
+    "350m-vpad-b8": dict(batch=8, n_head=16, vocab_size=50304,
+                         loss_chunk=0),
+}
 
 
 def _metric_label():
@@ -40,6 +84,11 @@ def _arm_watchdog():
     def fire():
         if _DONE.is_set():
             return
+        if _CHILD is not None:
+            try:
+                _CHILD.kill()   # don't orphan a child wedged on the relay
+            except Exception:
+                pass
         print(json.dumps({
             "metric": _metric_label(),
             "value": 0.0,
@@ -56,9 +105,20 @@ def _arm_watchdog():
     return t
 
 
-def main():
-    watchdog = _arm_watchdog()
+def run_config(name):
+    """Measure one candidate; prints the result JSON line."""
     import jax
+
+    if os.environ.get("HDS_BENCH_TINY") == "1":
+        # The smoke config must never touch the TPU relay: the axon
+        # plugin initialises alongside cpu even under JAX_PLATFORMS=cpu
+        # (its register() runs from sitecustomize), and a wedged relay
+        # then hangs backend init. Forcing the platform through the live
+        # config (the conftest trick) keeps the smoke path host-only.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
     import hcache_deepspeed_tpu as hds
     from hcache_deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
@@ -71,14 +131,18 @@ def main():
         mcfg = GPT2Config(n_layer=2, n_embd=64, n_head=4, n_positions=seq,
                           vocab_size=256, dtype="bfloat16", remat=False)
     else:
-        batch, seq = 8, 1024
-        mcfg = GPT2Config(n_layer=24, n_embd=1024, n_head=16,
-                          n_positions=seq, vocab_size=50257,
-                          dtype="bfloat16", remat=False)
+        spec = CONFIGS[name]
+        batch, seq = spec["batch"], 1024
+        mcfg = GPT2Config(n_layer=24, n_embd=1024, n_head=spec["n_head"],
+                          n_positions=seq, vocab_size=spec["vocab_size"],
+                          dtype="bfloat16", remat=False,
+                          loss_chunk=spec["loss_chunk"])
     model = GPT2LMHeadModel(mcfg)
     rng = np.random.default_rng(0)
+    # clamp below every config's vocab so the sampled batch is identical
+    # across padded-vocab variants
     data = {"input_ids": rng.integers(
-        0, mcfg.vocab_size, (batch, seq), dtype=np.int32)}
+        0, min(mcfg.vocab_size, 50257), (batch, seq), dtype=np.int32)}
 
     cfg = {
         "train_batch_size": batch,
@@ -118,13 +182,14 @@ def main():
     vs_baseline = (mfu / 0.54) if peak else 0.0
 
     _DONE.set()
-    watchdog.cancel()
     print(json.dumps({
         "metric": _metric_label(),
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(vs_baseline, 4),
         "extra": {
+            "config": "tiny" if os.environ.get("HDS_BENCH_TINY") == "1"
+                      else name,
             "mfu": round(mfu, 4),
             "achieved_tflops": round(achieved_tflops, 2),
             "peak_tflops": peak,
@@ -132,7 +197,92 @@ def main():
             "n_params": int(n_params),
             "step_time_ms": round(dt / steps * 1000, 2),
         },
-    }))
+    }), flush=True)
+
+
+def _run_candidate_subprocess(name, timeout):
+    """Run one candidate in a child (a hung remote compile can only be
+    SIGKILLed from outside); returns (parsed result dict | None, timed_out)."""
+    global _CHILD
+    env = dict(os.environ, HDS_BENCH_CHILD=name)
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env, text=True)
+    _CHILD = proc
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[bench] candidate {name}: no result in {timeout:.0f}s "
+              "(remote compile wedged?)", file=sys.stderr)
+        return None, True
+    finally:
+        _CHILD = None
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "value" in parsed and "error" not in parsed:
+                return parsed, False
+    print(f"[bench] candidate {name}: exited rc={proc.returncode} "
+          f"without a result line; last output:\n"
+          + "\n".join(out.splitlines()[-5:]), file=sys.stderr)
+    return None, False
+
+
+def main():
+    child = os.environ.get("HDS_BENCH_CHILD")
+    if child or os.environ.get("HDS_BENCH_TINY") == "1":
+        # child / smoke mode: measure exactly one config in-process
+        watchdog = _arm_watchdog()
+        run_config(child or CANDIDATES[-1])
+        watchdog.cancel()
+        return 0
+
+    watchdog = _arm_watchdog()
+    deadline = time.monotonic() + _WATCHDOG_SECS - 60
+    results = []
+    names = list(CANDIDATES)
+    while names:
+        name = names.pop(0)
+        last = not names
+        remaining = deadline - time.monotonic()
+        if last:
+            timeout = remaining
+        else:
+            timeout = min(_CAND_SECS, remaining - _LAST_RESERVE)
+        if timeout <= 60:
+            print(f"[bench] skipping {name}: budget exhausted",
+                  file=sys.stderr)
+            continue
+        result, timed_out = _run_candidate_subprocess(name, timeout)
+        if result is not None:
+            results.append(result)
+        elif timed_out and not last:
+            # the wedge signature: nothing new will compile — jump
+            # straight to the cache-proven config
+            print("[bench] compile service looks wedged; skipping to "
+                  "the cached config", file=sys.stderr)
+            names = names[-1:]
+    _DONE.set()
+    watchdog.cancel()
+    if results:
+        best = max(results, key=lambda r: (r.get("extra", {}).get("mfu", 0.0),
+                                           r.get("value", 0.0)))
+        print(json.dumps(best), flush=True)
+        return 0
+    print(json.dumps({
+        "metric": _metric_label(),
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "error": "no candidate produced a result (TPU relay down?)",
+    }), flush=True)
+    return 2
 
 
 if __name__ == "__main__":

@@ -44,12 +44,16 @@ def _torch_losses(hf_model, batches):
     return losses
 
 
-def _ours_losses(hf_model, batches, model_type="gpt2", **extra):
+def _ours_losses(hf_model, batches, model_type="gpt2", replace_cfg=None,
+                 **extra):
     import dataclasses
     mcfg, model = hf_config_to_model(hf_model.config)
     if model_type != "gpt2":   # llama family defaults to bf16 + flash
-        mcfg = dataclasses.replace(mcfg, dtype="float32", use_flash=False)
-        model = type(model)(mcfg)
+        mcfg = dataclasses.replace(mcfg, dtype="float32", use_flash=False,
+                                   **(replace_cfg or {}))
+        # clone(), not type(model)(mcfg): MoE families build the llama
+        # trunk with mlp_cls=MoEMLP, which reconstruction would drop
+        model = model.clone(cfg=mcfg)
     params = convert_hf_state_dict(hf_model, model_type)
     engine, _, _, _ = hds.initialize(
         model=model, init_params=params,
@@ -93,7 +97,6 @@ class TestTorchLossParity:
         # MoE: exact top-k routing + expert gradients vs transformers.
         # HF's default loss is pure CE (router aux only with
         # output_router_logits), so our aux coefficient is zeroed.
-        import dataclasses
         cfg = transformers.MixtralConfig(
             vocab_size=256, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4,
@@ -106,26 +109,10 @@ class TestTorchLossParity:
         want = _torch_losses(hf_model, batches)
 
         torch.manual_seed(0)
-        hf_fresh = transformers.MixtralForCausalLM(cfg).eval()
-        mcfg, _ = hf_config_to_model(hf_fresh.config)
-        mcfg = dataclasses.replace(mcfg, use_flash=False,
-                                   dtype="float32", dropless=True,
-                                   moe_aux_loss_coef=0.0)
-        from hcache_deepspeed_tpu.models.mixtral import MixtralForCausalLM
-        model = MixtralForCausalLM(mcfg)
-        params = convert_hf_state_dict(hf_fresh, "mixtral")
-        engine, _, _, _ = hds.initialize(
-            model=model, init_params=params,
-            config={
-                "train_batch_size": BATCH,
-                "optimizer": {"type": "AdamW",
-                              "params": {"lr": LR, "betas": list(BETAS),
-                                         "eps": EPS,
-                                         "weight_decay": WD}},
-                "steps_per_print": 10 ** 9,
-            })
-        got = [float(engine.train_batch(batch={"input_ids": b}))
-               for b in batches]
+        hf_fresh = transformers.MixtralForCausalLM(cfg)
+        got = _ours_losses(hf_fresh.eval(), batches, model_type="mixtral",
+                           replace_cfg=dict(dropless=True,
+                                            moe_aux_loss_coef=0.0))
         np.testing.assert_allclose(got, want, rtol=2e-4)
 
     def test_llama_adamw_loss_trajectories_match(self, eight_devices):
