@@ -105,6 +105,17 @@ def init_distributed(dist_backend=None,
             kwargs["num_processes"] = num_processes
         if process_id >= 0:
             kwargs["process_id"] = process_id
+        if _platform_is_cpu():
+            # Cross-process collectives on the CPU backend need a
+            # transport (TPU rides ICI/DCN natively); gloo is jax's
+            # built-in one. The reference's analog is the CCL backend
+            # for CPU runs (SURVEY §2.2). Must be set before backends
+            # initialise.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:   # older jax spelling
+                logger.warning(f"cpu collectives unavailable: {e}")
         logger.info(f"jax.distributed.initialize({kwargs})")
         jax.distributed.initialize(**kwargs)
     else:
@@ -125,6 +136,18 @@ def _env_int(name, default):
         return int(os.environ.get(name, default))
     except (TypeError, ValueError):
         return default
+
+
+def _platform_is_cpu():
+    """True when jax may run on the cpu backend — decided WITHOUT
+    touching jax.devices()/default_backend(), which would initialise the
+    backend and foreclose jax.distributed.initialize(). Unset platform
+    counts as cpu (jax falls back to cpu when no accelerator is found,
+    and the gloo knob is harmless on TPU)."""
+    cfg = getattr(jax.config, "jax_platforms", None)
+    platforms = cfg or os.environ.get("JAX_PLATFORMS", "")
+    first = platforms.split(",")[0].strip().lower()
+    return first in ("", "cpu")
 
 
 def _looks_like_pod():

@@ -10,7 +10,6 @@ on every node.
 """
 
 import os
-import subprocess
 import sys
 
 
@@ -43,7 +42,10 @@ def main(argv=None):
               "<script> [args...]", file=sys.stderr)
         return 2
     env = infer_process_env()
-    return subprocess.call([sys.executable] + argv, env=env)
+    # exec, not a child process: the worker must BE this process so the
+    # scheduler's signals (and a supervisor's kill) reach it directly —
+    # a wrapper child would orphan the worker on timeout kills
+    os.execve(sys.executable, [sys.executable] + argv, env)
 
 
 if __name__ == "__main__":

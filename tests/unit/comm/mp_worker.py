@@ -1,0 +1,70 @@
+"""Two-process data-parallel worker for the multi-host rendezvous test.
+
+Run by ``test_multiprocess.py`` through the per-host launcher
+(``launcher/launch.py``) with torchrun-style env (RANK / WORLD_SIZE /
+MASTER_ADDR / MASTER_PORT), the same path a real multi-host deployment
+takes (reference: ``deepspeed/launcher/launch.py`` spawning ranks that
+each call ``deepspeed.init_distributed``). Each process owns ONE cpu
+device, so the two processes form a genuine 2-device ``data`` mesh with
+cross-process collectives riding gloo — the CI stand-in for DCN.
+
+``HDS_TEST_ZERO_STAGE`` (default 0) picks the ZeRO stage — stage 3
+shards every parameter across the process boundary, so the per-layer
+weight gathers themselves ride the cross-process transport.
+
+Prints one line per step: ``LOSS <rank> <step> <loss>``.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import hcache_deepspeed_tpu as hds
+    from hcache_deepspeed_tpu.comm import comm
+    from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+    from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+    comm.init_distributed()   # HDS_* env, normalized by launcher.launch
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+    rank = jax.process_index()
+
+    topo = topo_mod.initialize_topology(topo_mod.TopologySpec(data=2))
+    mcfg = gpt2_tiny()
+    model = GPT2LMHeadModel(mcfg)
+
+    # global batch 4 = 2 rows per process; every leaf handed to
+    # train_batch is the PROCESS-LOCAL shard (the engine rebuilds the
+    # global array via make_array_from_process_local_data)
+    rng = np.random.default_rng(7)
+    global_batches = [rng.integers(0, mcfg.vocab_size, (4, 16),
+                                   dtype=np.int32) for _ in range(3)]
+    engine, _, _, _ = hds.initialize(
+        model=model, topology=topo,
+        example_batch={"input_ids": global_batches[0][2 * rank:2 * rank + 2]},
+        config={
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "stage": int(os.environ.get("HDS_TEST_ZERO_STAGE", "0")),
+                "min_shard_size": 1,
+            },
+            "steps_per_print": 10 ** 9,
+        })
+    for step, gb in enumerate(global_batches):
+        local = gb[2 * rank:2 * rank + 2]
+        loss = float(engine.train_batch(batch={"input_ids": local}))
+        print(f"LOSS {rank} {step} {loss:.8f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

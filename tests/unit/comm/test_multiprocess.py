@@ -1,0 +1,121 @@
+"""True multi-process rendezvous + data-parallel training.
+
+The reference simulates multi-node with N local processes and a
+file-store rendezvous (``tests/unit/common.py:129 DistributedExec``).
+The TPU-native analog here is the real thing scaled down: two OS
+processes, each owning one cpu device, rendezvous through
+``jax.distributed`` (coordination service) with cross-process
+collectives over gloo — exercising the exact code path a multi-host
+TPU pod takes (``comm.init_distributed`` → ``jax.distributed.initialize``
+→ global mesh spanning processes), which the in-process 8-device mesh
+tests cannot reach.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mp_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_workers(port, timeout=420, zero_stage=0):
+    """Spawn two ranks through the per-host launcher (torchrun-style env),
+    exercising launcher.launch's env normalization on the way."""
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ,
+                   PYTHONPATH=REPO,   # replaces the axon site dir: the
+                   # workers must never touch the TPU relay
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="",      # 1 cpu device per process (the
+                   # conftest's 8-device flag would leak in otherwise)
+                   HDS_TEST_ZERO_STAGE=str(zero_stage),
+                   RANK=str(rank), WORLD_SIZE="2",
+                   MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port))
+        env.pop("HDS_PROCESS_ID", None)
+        env.pop("HDS_NUM_PROCESSES", None)
+        env.pop("HDS_COORDINATOR_ADDRESS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "hcache_deepspeed_tpu.launcher.launch",
+             WORKER],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def _parse_losses(out):
+    losses = {}
+    for line in out.splitlines():
+        if line.startswith("LOSS "):
+            _, rank, step, val = line.split()
+            losses[int(step)] = float(val)
+    return losses
+
+
+class TestMultiProcess:
+    @pytest.mark.parametrize("zero_stage", [0, 3], ids=["dp", "zero3"])
+    def test_two_process_dp_training_matches_single_process(self,
+                                                            zero_stage):
+        port = _free_port()
+        procs, outs = _launch_workers(port, zero_stage=zero_stage)
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out[-2000:]
+        l0, l1 = (_parse_losses(o) for o in outs)
+        assert set(l0) == set(l1) == {0, 1, 2}, (l0, l1)
+        # both ranks observe the identical global loss (replicated) —
+        # gradient sync drift would diverge them from step 1 on
+        for step in range(3):
+            assert l0[step] == pytest.approx(l1[step], rel=1e-6), (l0, l1)
+
+        # and the 2-process run matches the same training done in one
+        # process on the full global batch (loss parity across the
+        # process boundary: collectives did exactly a mean over dp)
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,
+                                                      gpt2_tiny)
+        from hcache_deepspeed_tpu.parallel import topology as topo_mod
+        import jax
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(data=1),
+                                            devices=jax.devices()[:1])
+        mcfg = gpt2_tiny()
+        rng = np.random.default_rng(7)
+        batches = [rng.integers(0, mcfg.vocab_size, (4, 16),
+                                dtype=np.int32) for _ in range(3)]
+        engine, _, _, _ = hds.initialize(
+            model=GPT2LMHeadModel(mcfg), topology=topo,
+            example_batch={"input_ids": batches[0]},
+            config={
+                "train_batch_size": 4,
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 10 ** 9,
+            })
+        for step, b in enumerate(batches):
+            ref = float(engine.train_batch(batch={"input_ids": b}))
+            # stage 3 reorders reductions (reduce-scatter + gather), so
+            # its float tolerance is looser than plain dp allreduce
+            tol = 2e-5 if zero_stage == 0 else 2e-4
+            assert l0[step] == pytest.approx(ref, rel=tol), (
+                step, l0[step], ref)

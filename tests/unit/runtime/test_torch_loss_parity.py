@@ -48,12 +48,14 @@ def _ours_losses(hf_model, batches, model_type="gpt2", replace_cfg=None,
                  **extra):
     import dataclasses
     mcfg, model = hf_config_to_model(hf_model.config)
+    overrides = dict(replace_cfg or {})
     if model_type != "gpt2":   # llama family defaults to bf16 + flash
-        mcfg = dataclasses.replace(mcfg, dtype="float32", use_flash=False,
-                                   **(replace_cfg or {}))
+        overrides.setdefault("dtype", "float32")
+        overrides.setdefault("use_flash", False)
+    if overrides:
         # clone(), not type(model)(mcfg): MoE families build the llama
         # trunk with mlp_cls=MoEMLP, which reconstruction would drop
-        model = model.clone(cfg=mcfg)
+        model = model.clone(cfg=dataclasses.replace(mcfg, **overrides))
     params = convert_hf_state_dict(hf_model, model_type)
     engine, _, _, _ = hds.initialize(
         model=model, init_params=params,
