@@ -551,9 +551,13 @@ class PagedInferenceModel:
         tables = jnp.asarray(tables, jnp.int32)
         t_len = jnp.asarray(t_len, jnp.int32)
         ck, cv = cache.k, cache.v
-        if self.tp > 1:
-            from jax.sharding import NamedSharding, PartitionSpec
-            dev = NamedSharding(self.topology.mesh, PartitionSpec())
+        # Latents replicate over whatever mesh the cache actually lives
+        # on (derived from the array, not self.tp: a hybrid engine hands
+        # over caches/params resident on the TRAINING mesh, which can be
+        # multi-device even when the serving tensor axis is 1).
+        from jax.sharding import NamedSharding, PartitionSpec
+        if isinstance(ck.sharding, NamedSharding):
+            dev = NamedSharding(ck.sharding.mesh, PartitionSpec())
         else:
             dev = list(ck.devices())[0]
         buf = jax.device_put(np.asarray(latents[0]), dev)  # layer-0 H2D

@@ -77,9 +77,18 @@ class HybridEngine:
 
     def _ensure_infer(self):
         if self._infer is None:
+            # default the serving mesh to the training mesh: the param
+            # tree handed over is resident there, and a topology-less
+            # serving engine would assume single-device placement
+            # (reference: hybrid_engine keeps the training TP group)
+            topo = self._topology
+            if topo is None:
+                topo = getattr(self.engine, "topology", None)
+                if topo is not None and topo.mesh.size == 1:
+                    topo = None   # true single-device: plain placement
             self._infer = InferenceEngineV2(
                 self.model_config, self._raw_params(),
-                config=self._inference_config, topology=self._topology)
+                config=self._inference_config, topology=topo)
             self._params_step = self.engine.global_steps
             log_dist("HybridEngine: inference engine materialized",
                      ranks=[0])
