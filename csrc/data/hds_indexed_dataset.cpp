@@ -1,0 +1,270 @@
+// Indexed token dataset + prefetching batch loader (C ABI, ctypes).
+//
+// The data-pipeline IO layer: a memory-mapped binary token stream with a
+// document index, and a background-thread loader that materializes
+// shuffled fixed-length LM samples into double-buffered batches so the
+// host never stalls the accelerator step loop on disk reads.
+// Reference analog: deepspeed/runtime/data_pipeline (python-side
+// sampling) + the Megatron-style indexed dataset its examples train
+// from; native here per the build plan's "IO stays C++" stance
+// (SURVEY.md 2.5 #7 note).
+//
+// .idx layout (little endian):
+//   8 bytes  magic "HDSIDX1\0"
+//   u32      dtype code (2 = uint16, 4 = int32)
+//   u32      reserved (0)
+//   u64      n_docs
+//   u64[n_docs+1] cumulative token offsets (offs[0] = 0)
+// .bin: the raw token stream, n_tokens * dtype_size bytes.
+//
+// Sampling model: the stream is cut into floor((n_tokens-1)/seq) chunks
+// of seq+1 overlapping-by-one tokens (input/label shift); each epoch
+// visits every chunk once in an order given by a SplitMix64-keyed
+// Fisher-Yates shuffle, reproducible in python (see
+// runtime/data/indexed_dataset.py _permutation).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'H', 'D', 'S', 'I', 'D', 'X', '1', '\0'};
+
+struct Dataset {
+  int fd = -1;
+  const uint8_t* bin = nullptr;   // mmap'd token stream
+  size_t bin_bytes = 0;
+  uint32_t dtype = 0;             // 2 = uint16, 4 = int32
+  std::vector<uint64_t> offs;     // cumulative token offsets
+};
+
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// Fisher-Yates keyed by SplitMix64 — identical to the python fallback.
+void permutation(uint64_t n, uint64_t seed, std::vector<uint64_t>* out) {
+  out->resize(n);
+  for (uint64_t i = 0; i < n; ++i) (*out)[i] = i;
+  for (uint64_t i = n; i > 1; --i) {
+    uint64_t j = splitmix64(seed ^ (i - 1)) % i;
+    std::swap((*out)[i - 1], (*out)[j]);
+  }
+}
+
+inline int32_t token_at(const Dataset* ds, uint64_t i) {
+  if (ds->dtype == 2) {
+    uint16_t v;
+    std::memcpy(&v, ds->bin + i * 2, 2);
+    return static_cast<int32_t>(v);
+  }
+  int32_t v;
+  std::memcpy(&v, ds->bin + i * 4, 4);
+  return v;
+}
+
+struct Loader {
+  const Dataset* ds = nullptr;
+  uint64_t seq = 0, batch = 0, seed = 0;
+  uint64_t n_chunks = 0;
+  uint64_t sample_len = 0;        // seq + 1
+
+  // producer state
+  std::vector<uint64_t> order;
+  uint64_t epoch = 0, cursor = 0;
+
+  // ring of prepared batches
+  struct Slot {
+    std::vector<int32_t> data;    // [batch, seq+1]
+    uint64_t epoch = 0;
+    bool full = false;
+  };
+  std::vector<Slot> ring;
+  size_t head = 0, tail = 0;      // head: consumer, tail: producer
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  void fill_one(Slot* slot) {
+    slot->data.resize(batch * sample_len);
+    for (uint64_t b = 0; b < batch; ++b) {
+      if (cursor == n_chunks) {
+        ++epoch;
+        cursor = 0;
+        permutation(n_chunks, seed + epoch, &order);
+      }
+      uint64_t chunk = order[cursor++];
+      uint64_t base = chunk * seq;           // sample_len tokens from here
+      int32_t* dst = slot->data.data() + b * sample_len;
+      for (uint64_t t = 0; t < sample_len; ++t)
+        dst[t] = token_at(ds, base + t);
+    }
+    slot->epoch = epoch;
+  }
+
+  void run() {
+    for (;;) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_empty.wait(lk, [&] { return stop.load() || !ring[tail].full; });
+      if (stop.load()) return;
+      Slot* slot = &ring[tail];
+      lk.unlock();
+      fill_one(slot);              // disk/mmap work outside the lock
+      lk.lock();
+      slot->full = true;
+      tail = (tail + 1) % ring.size();
+      cv_full.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hds_idx_open(const char* prefix) {
+  std::string p(prefix);
+  FILE* f = std::fopen((p + ".idx").c_str(), "rb");
+  if (!f) return nullptr;
+  char magic[8];
+  uint32_t dtype = 0, reserved = 0;
+  uint64_t n_docs = 0;
+  bool ok = std::fread(magic, 1, 8, f) == 8 &&
+            std::memcmp(magic, kMagic, 8) == 0 &&
+            std::fread(&dtype, 4, 1, f) == 1 &&
+            std::fread(&reserved, 4, 1, f) == 1 &&
+            std::fread(&n_docs, 8, 1, f) == 1 &&
+            (dtype == 2 || dtype == 4);
+  auto* ds = new Dataset();
+  if (ok) {
+    ds->offs.resize(n_docs + 1);
+    ok = std::fread(ds->offs.data(), 8, n_docs + 1, f) == n_docs + 1;
+  }
+  std::fclose(f);
+  if (ok) {
+    // reject corrupt indexes up front: offsets must be monotone, and
+    // the total must be small enough that offs.back() * dtype cannot
+    // wrap uint64 and defeat the file-size check below
+    for (size_t i = 0; ok && i + 1 < ds->offs.size(); ++i)
+      ok = ds->offs[i] <= ds->offs[i + 1];
+    ok = ok && ds->offs[0] == 0 &&
+         ds->offs.back() <= UINT64_MAX / 8;
+  }
+  if (ok) {
+    ds->dtype = dtype;
+    ds->fd = ::open((p + ".bin").c_str(), O_RDONLY);
+    ok = ds->fd >= 0;
+  }
+  if (ok) {
+    struct stat st;
+    ok = ::fstat(ds->fd, &st) == 0 &&
+         static_cast<uint64_t>(st.st_size) >= ds->offs.back() * dtype;
+    if (ok) {
+      ds->bin_bytes = static_cast<size_t>(st.st_size);
+      void* m = ::mmap(nullptr, ds->bin_bytes, PROT_READ, MAP_PRIVATE,
+                       ds->fd, 0);
+      ok = m != MAP_FAILED;
+      if (ok) ds->bin = static_cast<const uint8_t*>(m);
+    }
+  }
+  if (!ok) {
+    if (ds->fd >= 0) ::close(ds->fd);
+    delete ds;
+    return nullptr;
+  }
+  return ds;
+}
+
+void hds_idx_close(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (!ds) return;
+  if (ds->bin) ::munmap(const_cast<uint8_t*>(ds->bin), ds->bin_bytes);
+  if (ds->fd >= 0) ::close(ds->fd);
+  delete ds;
+}
+
+uint64_t hds_idx_num_docs(void* h) {
+  return static_cast<Dataset*>(h)->offs.size() - 1;
+}
+
+uint64_t hds_idx_total_tokens(void* h) {
+  return static_cast<Dataset*>(h)->offs.back();
+}
+
+int hds_idx_dtype(void* h) {
+  return static_cast<int>(static_cast<Dataset*>(h)->dtype);
+}
+
+uint64_t hds_idx_doc_len(void* h, uint64_t i) {
+  auto* ds = static_cast<Dataset*>(h);
+  return ds->offs[i + 1] - ds->offs[i];
+}
+
+void hds_idx_read_doc(void* h, uint64_t i, int32_t* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  const uint64_t start = ds->offs[i], end = ds->offs[i + 1];
+  for (uint64_t t = start; t < end; ++t) *out++ = token_at(ds, t);
+}
+
+void* hds_loader_create(void* h, uint64_t seq, uint64_t batch,
+                        uint64_t seed, int ring_slots) {
+  auto* ds = static_cast<Dataset*>(h);
+  const uint64_t total = ds->offs.back();
+  if (total < seq + 1 || seq == 0 || batch == 0) return nullptr;
+  auto* ld = new Loader();
+  ld->ds = ds;
+  ld->seq = seq;
+  ld->batch = batch;
+  ld->seed = seed;
+  ld->sample_len = seq + 1;
+  ld->n_chunks = (total - 1) / seq;
+  ld->ring.resize(ring_slots < 2 ? 2 : ring_slots);
+  permutation(ld->n_chunks, ld->seed, &ld->order);
+  ld->worker = std::thread([ld] { ld->run(); });
+  return ld;
+}
+
+// Blocks until a batch is ready; copies [batch, seq+1] int32 into `out`
+// and returns the epoch the batch came from.
+uint64_t hds_loader_next(void* h, int32_t* out) {
+  auto* ld = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(ld->mu);
+  ld->cv_full.wait(lk, [&] { return ld->ring[ld->head].full; });
+  Loader::Slot* slot = &ld->ring[ld->head];
+  std::memcpy(out, slot->data.data(), slot->data.size() * 4);
+  uint64_t epoch = slot->epoch;
+  slot->full = false;
+  ld->head = (ld->head + 1) % ld->ring.size();
+  ld->cv_empty.notify_one();
+  return epoch;
+}
+
+void hds_loader_destroy(void* h) {
+  auto* ld = static_cast<Loader*>(h);
+  if (!ld) return;
+  {
+    std::lock_guard<std::mutex> lk(ld->mu);
+    ld->stop.store(true);
+  }
+  ld->cv_empty.notify_all();
+  ld->worker.join();
+  delete ld;
+}
+
+}  // extern "C"

@@ -1,0 +1,2 @@
+from .indexed_dataset import (IndexedDataset, IndexedDatasetWriter,  # noqa
+                              NativeTokenLoader, write_indexed_dataset)

@@ -1,0 +1,324 @@
+"""Indexed token dataset + native prefetching batch loader.
+
+The storage layer of the data pipeline (reference:
+``deepspeed/runtime/data_pipeline`` samples *from* such datasets; the
+format itself is the Megatron-style idx/bin pair the reference's
+training examples consume). The hot path — shuffled fixed-length LM
+sample assembly — runs in a C++ worker thread over a memory-mapped
+token stream (``csrc/data/hds_indexed_dataset.cpp``) so batches are
+ready before the step loop asks; a pure-python fallback mirrors the
+exact sampling order for environments without a compiler.
+
+Format (little endian):
+  ``<prefix>.idx``  magic ``HDSIDX1\\0`` | u32 dtype (2=uint16, 4=int32)
+                    | u32 reserved | u64 n_docs | u64[n_docs+1]
+                    cumulative token offsets
+  ``<prefix>.bin``  the raw token stream
+
+Sampling: the stream is cut into ``floor((N-1)/seq)`` chunks of
+``seq+1`` tokens (the +1 is the label shift); every epoch visits each
+chunk once, ordered by a SplitMix64-keyed Fisher-Yates shuffle seeded
+``seed + epoch`` — bit-identical between the C++ and python paths.
+"""
+
+import ctypes
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+_MAGIC = b"HDSIDX1\x00"
+_DTYPES = {2: np.uint16, 4: np.int32}
+
+
+# ------------------------------------------------------------------ #
+# Writer
+# ------------------------------------------------------------------ #
+class IndexedDatasetWriter:
+    """Stream documents (1-D int arrays) into an idx/bin pair."""
+
+    def __init__(self, prefix: str, dtype=np.uint16):
+        code = {np.uint16: 2, np.int32: 4}.get(np.dtype(dtype).type)
+        if code is None:
+            raise ValueError(f"dtype must be uint16 or int32, got {dtype}")
+        self.prefix = prefix
+        self.code = code
+        self.dtype = np.dtype(dtype)
+        os.makedirs(os.path.dirname(os.path.abspath(prefix)), exist_ok=True)
+        self._bin = open(prefix + ".bin", "wb")
+        self._offs = [0]
+
+    def add_doc(self, tokens) -> None:
+        raw = np.asarray(tokens)
+        if raw.ndim != 1:
+            raise ValueError("a document is a 1-D token array")
+        if raw.size:
+            lo, hi = int(raw.min()), int(raw.max())
+            if lo < 0 or hi > np.iinfo(self.dtype).max:
+                raise ValueError(
+                    f"token ids [{lo}, {hi}] out of range for "
+                    f"{self.dtype} storage")
+        arr = np.ascontiguousarray(raw, dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self._offs.append(self._offs[-1] + arr.size)
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(self.prefix + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(np.uint32(self.code).tobytes())
+            f.write(np.uint32(0).tobytes())
+            f.write(np.uint64(len(self._offs) - 1).tobytes())
+            f.write(np.asarray(self._offs, dtype=np.uint64).tobytes())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # a crashed ingest must not leave a valid-looking truncated
+            # dataset behind — drop the partial pair
+            self._bin.close()
+            for suffix in (".bin", ".idx"):
+                try:
+                    os.remove(self.prefix + suffix)
+                except OSError:
+                    pass
+            return False
+        self.finalize()
+
+
+def write_indexed_dataset(prefix: str, docs, dtype=np.uint16) -> str:
+    with IndexedDatasetWriter(prefix, dtype=dtype) as w:
+        for d in docs:
+            w.add_doc(d)
+    return prefix
+
+
+# ------------------------------------------------------------------ #
+# Native library
+# ------------------------------------------------------------------ #
+def _builder():
+    from ...ops.native.builder import NativeOpBuilder, csrc_path
+
+    class IndexedDatasetBuilder(NativeOpBuilder):
+        def __init__(self):
+            super().__init__(
+                "hds_indexed_dataset",
+                [csrc_path("data", "hds_indexed_dataset.cpp")])
+
+    return IndexedDatasetBuilder()
+
+
+_LIB = None
+
+
+def _load_lib():
+    global _LIB
+    if _LIB is None:
+        b = _builder()
+        if not b.is_compatible():
+            raise RuntimeError("no g++ / sources for the native loader")
+        lib = b.jit_load()
+        lib.hds_idx_open.restype = ctypes.c_void_p
+        lib.hds_idx_open.argtypes = [ctypes.c_char_p]
+        lib.hds_idx_close.argtypes = [ctypes.c_void_p]
+        for fn, res in (("hds_idx_num_docs", ctypes.c_uint64),
+                        ("hds_idx_total_tokens", ctypes.c_uint64),
+                        ("hds_idx_dtype", ctypes.c_int)):
+            getattr(lib, fn).restype = res
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.hds_idx_doc_len.restype = ctypes.c_uint64
+        lib.hds_idx_doc_len.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.hds_idx_read_doc.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.hds_loader_create.restype = ctypes.c_void_p
+        lib.hds_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int]
+        lib.hds_loader_next.restype = ctypes.c_uint64
+        lib.hds_loader_next.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_int32)]
+        lib.hds_loader_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    try:
+        _load_lib()
+        return True
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------------ #
+# Reader
+# ------------------------------------------------------------------ #
+class IndexedDataset:
+    """Memory-mapped document reader (native when possible)."""
+
+    def __init__(self, prefix: str, use_native: Optional[bool] = None):
+        self.prefix = prefix
+        self._handle = None
+        self._lib = None
+        if use_native is None:
+            use_native = native_available()
+        if use_native:
+            lib = _load_lib()
+            h = lib.hds_idx_open(prefix.encode())
+            if not h:
+                raise FileNotFoundError(
+                    f"cannot open indexed dataset {prefix!r}")
+            self._lib, self._handle = lib, h
+            self.dtype = _DTYPES[lib.hds_idx_dtype(h)]
+            self._n_docs = lib.hds_idx_num_docs(h)
+            self.total_tokens = lib.hds_idx_total_tokens(h)
+        else:
+            offs, code = _read_idx(prefix)
+            self._offs = offs
+            self.dtype = _DTYPES[code]
+            self._n_docs = len(offs) - 1
+            self.total_tokens = int(offs[-1])
+            self._mm = np.memmap(prefix + ".bin", dtype=self.dtype,
+                                 mode="r")
+
+    def __len__(self):
+        return int(self._n_docs)
+
+    def __getitem__(self, i) -> np.ndarray:
+        if not 0 <= i < self._n_docs:
+            raise IndexError(i)
+        if self._handle:
+            n = self._lib.hds_idx_doc_len(self._handle, i)
+            out = np.empty(n, np.int32)
+            self._lib.hds_idx_read_doc(
+                self._handle, i,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return out
+        lo, hi = int(self._offs[i]), int(self._offs[i + 1])
+        return np.asarray(self._mm[lo:hi], dtype=np.int32)
+
+    def close(self):
+        if self._handle:
+            self._lib.hds_idx_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _read_idx(prefix):
+    with open(prefix + ".idx", "rb") as f:
+        if f.read(8) != _MAGIC:
+            raise ValueError(f"{prefix}.idx: bad magic")
+        code = int(np.frombuffer(f.read(4), np.uint32)[0])
+        f.read(4)
+        n_docs = int(np.frombuffer(f.read(8), np.uint64)[0])
+        offs = np.frombuffer(f.read(8 * (n_docs + 1)), np.uint64)
+    if code not in _DTYPES:
+        raise ValueError(f"{prefix}.idx: unknown dtype code {code}")
+    return offs, code
+
+
+# ------------------------------------------------------------------ #
+# Shuffle (shared algorithm, bit-identical to the C++ side)
+# ------------------------------------------------------------------ #
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(x):
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def _permutation(n: int, seed: int) -> np.ndarray:
+    out = np.arange(n, dtype=np.uint64)
+    for i in range(n, 1, -1):
+        j = _splitmix64((seed ^ (i - 1)) & _M64) % i
+        out[i - 1], out[j] = out[j], out[i - 1]
+    return out
+
+
+# ------------------------------------------------------------------ #
+# Loader
+# ------------------------------------------------------------------ #
+class NativeTokenLoader:
+    """Infinite iterator of LM batches from an indexed dataset.
+
+    Yields ``{"input_ids": [B, seq], "labels": [B, seq]}`` (labels =
+    inputs shifted by one — the +1 token in each chunk). Batch assembly
+    and epoch reshuffling run in a C++ worker thread with a ring of
+    prepared batches; ``use_native=False`` runs the same sampling in
+    python (identical order, no prefetch).
+    """
+
+    def __init__(self, prefix: str, seq_len: int, batch_size: int,
+                 seed: int = 0, ring_slots: int = 4,
+                 use_native: Optional[bool] = None):
+        if use_native is None:
+            use_native = native_available()
+        self.seq = int(seq_len)
+        self.batch = int(batch_size)
+        self.seed = int(seed)
+        self.epoch = 0
+        self._native = None
+        self.dataset = IndexedDataset(prefix, use_native=use_native)
+        n_tok = self.dataset.total_tokens
+        if n_tok < self.seq + 1:
+            raise ValueError(
+                f"dataset has {n_tok} tokens < seq_len+1={self.seq + 1}")
+        self.n_chunks = (n_tok - 1) // self.seq
+        if use_native:
+            lib = _load_lib()
+            self._native = lib.hds_loader_create(
+                self.dataset._handle, self.seq, self.batch, self.seed,
+                int(ring_slots))
+            if not self._native:
+                raise RuntimeError("hds_loader_create failed")
+            self._lib = lib
+        else:
+            self._order = _permutation(self.n_chunks, self.seed)
+            self._cursor = 0
+            # the fallback IndexedDataset already mmaps the stream
+            self._stream = self.dataset._mm
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        out = np.empty((self.batch, self.seq + 1), np.int32)
+        if self._native:
+            self.epoch = int(self._lib.hds_loader_next(
+                self._native,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))))
+        else:
+            for b in range(self.batch):
+                if self._cursor == self.n_chunks:
+                    self.epoch += 1
+                    self._cursor = 0
+                    self._order = _permutation(self.n_chunks,
+                                               self.seed + self.epoch)
+                base = int(self._order[self._cursor]) * self.seq
+                self._cursor += 1
+                out[b] = self._stream[base:base + self.seq + 1]
+        return {"input_ids": np.ascontiguousarray(out[:, :-1]),
+                "labels": np.ascontiguousarray(out[:, 1:])}
+
+    def close(self):
+        if self._native:
+            self._lib.hds_loader_destroy(self._native)
+            self._native = None
+        self.dataset.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -62,12 +62,14 @@ def _launch_workers(port, timeout=420, zero_stage=0):
     def drain(i):
         outs[i] = procs[i].communicate()[0]
 
+    import time
     threads = [threading.Thread(target=drain, args=(i,)) for i in range(2)]
     try:
+        deadline = time.monotonic() + timeout   # shared across both joins
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=timeout)
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
     finally:
         for p in procs:
             if p.poll() is None:
