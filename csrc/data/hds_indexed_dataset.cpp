@@ -151,6 +151,20 @@ void* hds_idx_open(const char* prefix) {
             std::fread(&reserved, 4, 1, f) == 1 &&
             std::fread(&n_docs, 8, 1, f) == 1 &&
             (dtype == 2 || dtype == 4);
+  if (ok) {
+    // n_docs comes from the file: bound it by the file's actual size
+    // (24-byte header + 8 * (n_docs + 1) offsets) BEFORE resize —
+    // a wrapped n_docs+1 or a bad_alloc must not escape into ctypes
+    long pos = std::ftell(f);
+    ok = pos == 24 && std::fseek(f, 0, SEEK_END) == 0;
+    if (ok) {
+      long end = std::ftell(f);
+      ok = end >= 0 &&
+           static_cast<uint64_t>(end - 24) / 8 >= 1 &&
+           n_docs == static_cast<uint64_t>(end - 24) / 8 - 1;
+    }
+    ok = ok && std::fseek(f, 24, SEEK_SET) == 0;
+  }
   auto* ds = new Dataset();
   if (ok) {
     ds->offs.resize(n_docs + 1);

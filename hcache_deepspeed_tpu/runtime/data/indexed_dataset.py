@@ -213,15 +213,31 @@ class IndexedDataset:
 
 
 def _read_idx(prefix):
+    """Mirrors the native open's validation: header-size-consistent
+    n_docs, monotone offsets starting at 0, and a bin file at least as
+    large as the index claims."""
+    idx_size = os.path.getsize(prefix + ".idx")
     with open(prefix + ".idx", "rb") as f:
         if f.read(8) != _MAGIC:
             raise ValueError(f"{prefix}.idx: bad magic")
         code = int(np.frombuffer(f.read(4), np.uint32)[0])
         f.read(4)
         n_docs = int(np.frombuffer(f.read(8), np.uint64)[0])
+        if idx_size != 24 + 8 * (n_docs + 1):
+            raise ValueError(
+                f"{prefix}.idx: header claims {n_docs} docs but the "
+                f"file holds {(idx_size - 24) // 8 - 1}")
         offs = np.frombuffer(f.read(8 * (n_docs + 1)), np.uint64)
     if code not in _DTYPES:
         raise ValueError(f"{prefix}.idx: unknown dtype code {code}")
+    if offs[0] != 0 or np.any(np.diff(offs.astype(np.int64)) < 0):
+        raise ValueError(f"{prefix}.idx: offsets not monotone from 0")
+    bin_tokens = os.path.getsize(prefix + ".bin") \
+        // np.dtype(_DTYPES[code]).itemsize
+    if int(offs[-1]) > bin_tokens:
+        raise ValueError(
+            f"{prefix}.idx: index spans {int(offs[-1])} tokens but "
+            f"{prefix}.bin holds {bin_tokens}")
     return offs, code
 
 

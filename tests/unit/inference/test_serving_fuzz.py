@@ -1,0 +1,163 @@
+"""Randomized differential test of the serving engine lifecycle.
+
+Reference analog: the reference tests each ragged-engine operation in
+isolation (``tests/unit/inference/v2``); nothing there exercises random
+*interleavings* of scheduling, decode, eviction, HCache restore and KV
+suspend/resume under block-pool pressure. Every decode's logits are
+checked against a full-context recompute through the training model, so
+any cross-sequence KV corruption, stale block reuse after flush, or
+restore/resume bookkeeping drift surfaces as a numeric mismatch at the
+exact op that broke it.
+"""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import SchedulingResult
+
+from .test_engine_v2 import full_logits, make_engine, tiny_model  # noqa: F401
+
+MAX_CTX = 96
+
+
+class _Shadow:
+    """Host-side ground truth for one sequence."""
+
+    def __init__(self, tokens, latents):
+        self.tokens = list(tokens)
+        self.latents = latents          # [L, T, H] accumulated
+        self.alive = True
+        self.suspended = False
+
+    def absorb(self, new_tokens, new_latents):
+        self.tokens.extend(int(t) for t in np.atleast_1d(new_tokens))
+        if new_latents is not None:
+            self.latents = new_latents if self.latents is None else \
+                np.concatenate([self.latents, new_latents], axis=1)
+
+
+class TestServingLifecycleFuzz:
+
+    def _check_decode(self, model, params, sh, logits):
+        ref = full_logits(model, params, sh.tokens)
+        np.testing.assert_allclose(logits, ref[-1], atol=2e-2)
+
+    def test_random_interleavings_match_recompute(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(
+            cfg, params,
+            state_manager={"max_tracked_sequences": 6,
+                           "max_ragged_batch_size": 128,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": MAX_CTX},
+            # small pool: scheduling pressure is part of the test
+            kv_cache={"block_size": 16, "num_blocks": 30,
+                      "cache_dtype": "float32"})
+        rng = np.random.default_rng(42)
+        shadows = {}           # uid -> _Shadow (alive or restorable)
+        next_uid = 0
+        counts = {"new": 0, "decode": 0, "flush": 0, "restore": 0,
+                  "suspend": 0, "resume": 0, "rejected": 0}
+
+        def alive(pred=lambda s: True):
+            return [u for u, s in shadows.items() if s.alive and pred(s)]
+
+        for _ in range(90):
+            op = rng.choice(["new", "decode", "decode", "decode", "flush",
+                             "flush", "restore", "restore", "suspend",
+                             "resume"])
+            if op == "new" and len(alive()) < 4:
+                prompt = rng.integers(0, cfg.vocab_size,
+                                      (int(rng.integers(3, 24)),))
+                if engine.can_schedule([next_uid], [len(prompt)]) != \
+                        SchedulingResult.Success:
+                    counts["rejected"] += 1
+                    continue
+                logits, latents = engine.put([next_uid], [prompt])
+                sh = _Shadow(prompt, latents[0])
+                shadows[next_uid] = sh
+                self._check_decode(model, params, sh, logits[0])
+                counts["new"] += 1
+                next_uid += 1
+            elif op == "decode":
+                cands = alive(lambda s: not s.suspended
+                              and len(s.tokens) < MAX_CTX - 1)
+                if not cands:
+                    continue
+                uid = int(rng.choice(cands))
+                sh = shadows[uid]
+                tok = int(rng.integers(0, cfg.vocab_size))
+                if engine.can_schedule([uid], [1]) != \
+                        SchedulingResult.Success:
+                    counts["rejected"] += 1
+                    continue
+                logits, latents = engine.put([uid], [[tok]])
+                sh.absorb([tok], latents[0])
+                self._check_decode(model, params, sh, logits[0])
+                counts["decode"] += 1
+            elif op == "flush":
+                cands = alive(lambda s: not s.suspended)
+                if not cands:
+                    continue
+                uid = int(rng.choice(cands))
+                engine.flush(uid)
+                assert engine.state.get_sequence(uid) is None
+                shadows[uid].alive = False
+                counts["flush"] += 1
+            elif op == "restore":
+                cands = [u for u, s in shadows.items()
+                         if not s.alive and s.latents is not None
+                         and len(s.tokens) < MAX_CTX - 1]
+                if not cands or len(alive()) >= 4:
+                    continue
+                uid = int(rng.choice(cands))
+                sh = shadows[uid]
+                if engine.can_schedule([uid], [len(sh.tokens)]) != \
+                        SchedulingResult.Success:
+                    counts["rejected"] += 1
+                    continue
+                engine.restore_kv([uid], [sh.tokens], [sh.latents])
+                assert engine.state.get_sequence(uid).seen_tokens == \
+                    len(sh.tokens)
+                sh.alive = True
+                sh.suspended = False
+                counts["restore"] += 1
+            elif op == "suspend":
+                cands = alive(lambda s: not s.suspended)
+                if not cands:
+                    continue
+                uid = int(rng.choice(cands))
+                engine.suspend_sequence(uid)
+                shadows[uid].suspended = True
+                # writes against a suspended sequence must be refused
+                with pytest.raises(Exception):
+                    engine.put([uid], [[0]])
+                counts["suspend"] += 1
+            elif op == "resume":
+                cands = alive(lambda s: s.suspended)
+                if not cands:
+                    continue
+                uid = int(rng.choice(cands))
+                engine.resume_sequence(uid)
+                shadows[uid].suspended = False
+                # the first decode after resume proves the KV round-trip
+                sh = shadows[uid]
+                if len(sh.tokens) < MAX_CTX - 1:
+                    tok = int(rng.integers(0, cfg.vocab_size))
+                    logits, latents = engine.put([uid], [[tok]])
+                    sh.absorb([tok], latents[0])
+                    self._check_decode(model, params, sh, logits[0])
+                counts["resume"] += 1
+
+        # the run must actually have exercised the lifecycle
+        assert counts["new"] >= 3 and counts["decode"] >= 8, counts
+        assert counts["flush"] >= 1 and counts["restore"] >= 1, counts
+        assert counts["suspend"] >= 1 and counts["resume"] >= 1, counts
+
+        # drain: every tracked sequence still flushes cleanly and the
+        # block pool returns to empty (no leaked blocks)
+        for uid in alive():
+            if shadows[uid].suspended:
+                engine.resume_sequence(uid)
+            engine.flush(uid)
+        assert engine.state.n_tracked_sequences == 0

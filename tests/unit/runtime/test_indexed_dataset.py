@@ -36,7 +36,7 @@ class TestRoundtrip:
         rng = np.random.default_rng(1)
         docs = _docs(rng)
         pfx = write_indexed_dataset(str(tmp_path / "d"), docs, dtype=dtype)
-        for use_native in ({True, NATIVE} == {True}) * [True] + [False]:
+        for use_native in [True] * NATIVE + [False]:
             ds = IndexedDataset(pfx, use_native=use_native)
             assert len(ds) == len(docs)
             assert ds.total_tokens == sum(len(d) for d in docs)
@@ -55,23 +55,29 @@ class TestRoundtrip:
         with pytest.raises(Exception):
             IndexedDataset(str(tmp_path / "absent"), use_native=False)
 
-    @pytest.mark.skipif(not NATIVE, reason="needs g++")
     def test_corrupt_index_rejected(self, tmp_path, prefix):
-        # overflow-bait offsets (offs.back() * dtype wraps uint64) and
-        # non-monotone offsets must fail cleanly at open, not SIGSEGV in
-        # the prefetch thread
+        # overflow-bait offsets (offs.back() * dtype wraps uint64),
+        # non-monotone offsets, and a header n_docs inconsistent with
+        # the file size must all fail cleanly at open — not SIGSEGV in
+        # the prefetch thread or silently truncate
         import shutil
-        for bad_offs in ([0, 1 << 62], [0, 10, 5]):
+        cases = [([0, 1 << 62], None), ([0, 10, 5], None),
+                 ([0, 10], 999)]          # n_docs lies about the size
+        for bad_offs, fake_docs in cases:
             pfx = str(tmp_path / "bad")
             shutil.copy(prefix + ".bin", pfx + ".bin")
             with open(pfx + ".idx", "wb") as f:
                 f.write(b"HDSIDX1\x00")
                 f.write(np.uint32(2).tobytes())
                 f.write(np.uint32(0).tobytes())
-                f.write(np.uint64(len(bad_offs) - 1).tobytes())
+                f.write(np.uint64(fake_docs if fake_docs is not None
+                                  else len(bad_offs) - 1).tobytes())
                 f.write(np.asarray(bad_offs, np.uint64).tobytes())
-            with pytest.raises(FileNotFoundError):
-                IndexedDataset(pfx, use_native=True)
+            if NATIVE:
+                with pytest.raises(FileNotFoundError):
+                    IndexedDataset(pfx, use_native=True)
+            with pytest.raises(ValueError):
+                IndexedDataset(pfx, use_native=False)
 
     def test_failed_ingest_leaves_no_dataset(self, tmp_path):
         pfx = str(tmp_path / "partial")
