@@ -1,0 +1,62 @@
+"""Pretrain GPT-2 from a native indexed token dataset.
+
+Builds a tiny corpus on the fly, then streams shuffled LM batches from
+the C++ prefetching loader into the fused train step. Run on CPU with:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/pretrain_indexed_gpt2.py
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    # honor the env var even when jax was preloaded before it could be
+    # read (site customizations) — the conftest trick
+    jax.config.update("jax_platforms", "cpu")
+
+import hcache_deepspeed_tpu as hds  # noqa: E402
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,  # noqa: E402
+                                              gpt2_tiny)
+from hcache_deepspeed_tpu.runtime.data import (NativeTokenLoader,  # noqa: E402
+                                               write_indexed_dataset)
+
+
+def main():
+    mcfg = gpt2_tiny()
+    rng = np.random.default_rng(0)
+    corpus_dir = tempfile.mkdtemp()
+    prefix = write_indexed_dataset(
+        os.path.join(corpus_dir, "corpus"),
+        [rng.integers(0, mcfg.vocab_size, (int(rng.integers(32, 256)),))
+         for _ in range(64)])
+
+    loader = NativeTokenLoader(prefix, seq_len=32, batch_size=8, seed=1)
+    engine, _, _, _ = hds.initialize(
+        model=GPT2LMHeadModel(mcfg),
+        example_batch=next(loader),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+            "zero_optimization": {"stage": 2, "min_shard_size": 1},
+            "steps_per_print": 20,
+        })
+    for step in range(40):
+        loss = float(engine.train_batch(batch=next(loader)))
+        if step % 10 == 0:
+            print(f"step {step:3d}  epoch {loader.epoch}  "
+                  f"loss {loss:.4f}")
+    loader.close()
+    print("done; final loss", round(loss, 4))
+
+
+if __name__ == "__main__":
+    main()
