@@ -220,12 +220,26 @@ class HDSEngine:
 
         # ---- optimizer / scheduler ----
         self._user_optimizer = optimizer is not None
+        self._onebit = None
         if optimizer is None:
             if config.optimizer is not None:
-                optimizer = build_optimizer(config.optimizer.type,
-                                            config.optimizer.params)
+                from .onebit_wiring import OnebitOptimizer, is_onebit_type
+                if is_onebit_type(config.optimizer.type):
+                    optimizer = OnebitOptimizer(config.optimizer.type,
+                                                config.optimizer.params)
+                    self._onebit = optimizer
+                else:
+                    optimizer = build_optimizer(config.optimizer.type,
+                                                config.optimizer.params)
             else:
                 optimizer = build_optimizer("adamw", {})
+        else:
+            # a user-constructed OnebitOptimizer routes onto the manual
+            # compressed step like the config path (raw onebit factory
+            # tuples cannot be detected — construct the adapter instead)
+            from .onebit_wiring import OnebitOptimizer
+            if isinstance(optimizer, OnebitOptimizer):
+                self._onebit = optimizer
         self.optimizer_def = optimizer
         base_lr = (config.optimizer.params.get("lr", 1e-3)
                    if config.optimizer else 1e-3)
@@ -296,6 +310,11 @@ class HDSEngine:
                 raise HDSConfigError(
                     "LoRA already shrinks optimizer state to the adapter "
                     "factors; offload_optimizer is not supported with it")
+
+        # ---- 1-bit optimizers (reference: runtime/fp16/onebit/) ----
+        if self._onebit is not None:
+            from .onebit_wiring import validate_onebit
+            validate_onebit(config, topology)
 
         # ---- optimizer-state host offload (ZeRO-Offload / -Infinity) ----
         self.offload_device = zcfg.offload_optimizer.device
@@ -514,16 +533,35 @@ class HDSEngine:
                     lambda p: _cast_tree(p, jnp.float32),
                     out_shardings=self.opt_param_shardings)(params)
             # optimizer state: replicate scalars, shard per-param tensors
-            opt_state = jax.jit(
-                self.optimizer_def.init,
-                out_shardings=None)(master if master is not None
-                                    else params)
-            opt_state = self._place_opt_state(opt_state)
+            if self._onebit is not None:
+                from .onebit_wiring import init_onebit_state
+                opt_state = init_onebit_state(
+                    self, self._onebit,
+                    master if master is not None else params)
+            else:
+                opt_state = jax.jit(
+                    self.optimizer_def.init,
+                    out_shardings=None)(master if master is not None
+                                        else params)
+                opt_state = self._place_opt_state(opt_state)
 
-        grad_acc = jax.jit(
-            lambda p: jax.tree.map(
-                lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), p),
-            out_shardings=self.grad_shardings)(params)
+        if self._onebit is not None:
+            # per-device UNREDUCED accumulation: [n_data, ...] stacked,
+            # leading dim sharded on data (see onebit_wiring docstring)
+            from .onebit_wiring import stacked_grad_specs
+            n_data = self.topology.data_size
+            self.grad_specs = stacked_grad_specs(self.grad_specs, n_data)
+            self.grad_shardings = self.policy.named(self.grad_specs)
+            grad_acc = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros((n_data,) + x.shape,
+                                        self.grad_accum_dtype), p),
+                out_shardings=self.grad_shardings)(params)
+        else:
+            grad_acc = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), p),
+                out_shardings=self.grad_shardings)(params)
 
         repl = NamedSharding(mesh, PartitionSpec())
         loss_scale = jax.device_put(jnp.asarray(
@@ -589,6 +627,8 @@ class HDSEngine:
         return pol
 
     def _build_step_functions(self):
+        if self._onebit is not None:
+            return self._build_onebit_step_functions()
         policy = self.policy
         mesh = self.mesh
         gas = self.gradient_accumulation_steps
@@ -792,6 +832,49 @@ class HDSEngine:
 
         self._fused_train_batch = jax.jit(fused_train_batch,
                                           donate_argnums=(0,))
+
+    def _build_onebit_step_functions(self):
+        """Manual compressed-collective step for the 1-bit optimizers
+        (see onebit_wiring). Stage flags are host-side and change the
+        collective pattern, so each flag combination gets its own
+        compiled program, selected per step."""
+        from .onebit_wiring import build_onebit_step_fns
+        micro_fn, make_apply, make_fused = build_onebit_step_fns(
+            engine=self, opt=self._onebit)
+        self._micro_fwd_bwd = jax.jit(micro_fn, donate_argnums=(1,),
+                                      static_argnums=(5,))
+        apply_cache, fused_cache = {}, {}
+        onebit = self._onebit
+        grad_shardings = self.grad_shardings
+
+        def _flags_key():
+            flags = onebit.flags_at(self.global_steps)
+            return flags, tuple(sorted(flags.items()))
+
+        def apply_dispatch(state, lr):
+            flags, key = _flags_key()
+            if key not in apply_cache:
+                apply_cache[key] = make_apply(flags)
+            return apply_cache[key](state, lr)
+
+        def fused_dispatch(state, batches, lr, rng, moq_bits=None,
+                           pld_theta=None):
+            flags, key = _flags_key()
+            if key not in fused_cache:
+                fused_cache[key] = make_fused(flags)
+            return fused_cache[key](state, batches, lr, rng)
+
+        self._apply_step = apply_dispatch
+        self._fused_train_batch = fused_dispatch
+
+        def eval_loss(params, batch, frozen=None):
+            loss, aux = self.adapter.loss(params, batch, None, train=False)
+            return loss
+
+        self._eval_loss = jax.jit(eval_loss)
+        self._zero_grads = jax.jit(
+            lambda g: jax.tree.map(jnp.zeros_like, g), donate_argnums=(0,),
+            out_shardings=grad_shardings)
 
     # ------------------------------------------------------------------ #
     # Batch placement

@@ -1,0 +1,335 @@
+"""Engine wiring for the 1-bit optimizers (``optimizer.type`` config).
+
+Reference analog: ``deepspeed/runtime/engine.py`` `_configure_optimizer`
+selects OnebitAdam / OnebitLamb / ZeroOneAdam by config name
+(``runtime/fp16/onebit/{adam,lamb,zoadam}.py``), and their compressed
+all-reduce replaces the engine's gradient synchronization.
+
+TPU wiring. The GSPMD train step cannot host these optimizers: under
+``pjit`` the gradient is already globally averaged by the time the
+optimizer runs, which defeats compression (the full-precision allreduce
+it exists to avoid would already have happened). So, like the ZeRO++
+path (``zero/zeropp.py``), the micro fwd+bwd becomes a partial-manual
+``shard_map`` over ``data`` that accumulates UNREDUCED per-device
+gradients — stacked ``[n_data, ...]`` arrays sharded on their leading
+dim — and the optimizer step runs inside a second ``shard_map`` where
+the 1-bit factories (``runtime/onebit.py``) perform their own warmup
+psum or compressed sign+scale synchronization over ICI.
+
+Stage flags (warmup vs compressed, sync vs local step) change the
+collective pattern, so they are TRACE-TIME booleans: the engine keeps
+one compiled program per flag combination and picks by host-side step
+count — exactly the reference's host-side ``freeze_step`` flip.
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.topology import DATA_AXIS
+from .onebit import onebit_adam, onebit_lamb, zero_one_adam
+from .zero.zeropp import project_spec, project_spec_tree
+
+_KINDS = ("onebitadam", "onebitlamb", "zerooneadam", "01adam")
+
+
+def _normalize(name: str) -> str:
+    return name.lower().replace("_", "").replace("-", "")
+
+
+def is_onebit_type(name: str) -> bool:
+    return _normalize(name) in _KINDS
+
+
+class OnebitOptimizer:
+    """Adapter exposing the 1-bit factories through the engine's
+    ``optimizer_def`` surface (init/update/name) plus the host-side
+    stage schedule (``flags_at``)."""
+
+    def __init__(self, name: str, params: Dict[str, Any]):
+        kind = _normalize(name)
+        if kind == "01adam":
+            kind = "zerooneadam"
+        kw = dict(params)
+        for drop in ("torch_adam", "cuda_aware", "comm_backend_name",
+                     "adam_w_mode"):
+            kw.pop(drop, None)
+        if "betas" in kw:
+            kw["betas"] = tuple(kw["betas"])
+        self.kind = self.name = kind
+        if kind == "onebitadam":
+            freeze = int(kw.get("freeze_step", 100))
+            self.init, self.update = onebit_adam(**kw)
+            self.flags_at = lambda step: {"compressed": step >= freeze}
+        elif kind == "onebitlamb":
+            freeze = int(kw.get("freeze_step", 100))
+            self.init, self.update = onebit_lamb(**kw)
+            self.flags_at = lambda step: {"compressed": step >= freeze}
+        elif kind == "zerooneadam":
+            var_freeze = int(kw.get("var_freeze_step", 100))
+            (self.init, self.update,
+             self._sync_interval, _is_sync) = zero_one_adam(**kw)
+            # Engine wiring syncs EVERY step: a skipped sync desynchronizes
+            # momentum AND params per device (see the onebit.py docstring),
+            # which the engine's replicated-params invariant cannot carry.
+            # The 1-bit momentum compression and the variance-freeze
+            # policy are retained; step-throttled local steps remain
+            # available through the direct shard_map API
+            # (tests/unit/comm/test_quantized.py pattern).
+            self.flags_at = lambda step: {
+                "sync": True,
+                "update_var": step < var_freeze}
+        else:
+            raise ValueError(f"not a 1-bit optimizer: {name!r}")
+
+
+def validate_onebit(config, topology) -> None:
+    """The wired feature set (reference: the 1-bit optimizers likewise
+    exclude ZeRO>1, fp16-partitioning machinery, etc.)."""
+    from .config import HDSConfigError
+    bad = []
+    if config.fp16.enabled:
+        bad.append("fp16 loss scaling (use bf16 or fp32)")
+    zcfg = config.zero_optimization
+    if zcfg.stage > 0:
+        bad.append("zero_optimization.stage > 0")
+    if (zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients
+            or zcfg.zero_hpz_partition_size > 1):
+        bad.append("ZeRO++ flags")
+    if zcfg.offload_optimizer.device != "none":
+        bad.append("offload_optimizer")
+    if config.lora.enabled:
+        bad.append("lora")
+    if config.compression_training.weight_quantization.enabled:
+        bad.append("MoQ weight quantization")
+    if config.compression_training.progressive_layer_drop.enabled:
+        bad.append("progressive layer drop")
+    if config.flops_profiler.enabled:
+        bad.append("flops_profiler (AOT-lowers the fused step)")
+    if config.gradient_clipping:
+        bad.append("gradient_clipping (norms of unreduced local "
+                   "gradients are not the global norm)")
+    if bad:
+        raise HDSConfigError(
+            "1-bit optimizers run on the manual compressed-collective "
+            "step, which does not support: " + "; ".join(bad))
+    if topology.zero_size > 1 or topology.pipe_size > 1:
+        raise HDSConfigError(
+            "1-bit optimizers are wired to the data axis only "
+            "(no MiCS shard groups, no pipeline engine)")
+
+
+def stacked_grad_specs(grad_specs, n_data):
+    """[n_data, ...] accumulation layout: leading dim on ``data``, the
+    leaf's own (tensor/expert) sharding shifted right by one."""
+    return jax.tree.map(
+        lambda s: PartitionSpec(DATA_AXIS, *s), grad_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def build_onebit_step_fns(*, engine, opt: OnebitOptimizer):
+    """Returns ``(micro_fwd_bwd, make_apply, make_fused)``.
+
+    ``micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train)``
+    matches the engine's GSPMD signature but accumulates per-device
+    gradients into the stacked layout. ``make_apply(flags)`` /
+    ``make_fused(flags)`` build one jitted program per stage-flag
+    combination (cached by the engine, selected by host step count).
+    """
+    mesh = engine.mesh
+    gas = engine.gradient_accumulation_steps
+    adapter_loss = engine.adapter.loss
+    grad_accum_dtype = engine.grad_accum_dtype
+    remat_policy = engine._resolve_remat_policy()
+    mixed = engine.mixed_precision
+    compute_dtype = engine.compute_dtype
+    param_shardings = engine.param_shardings
+    batch_spec_of = lambda leaf: engine._batch_sharding(leaf).spec  # noqa
+
+    params_proj = project_spec_tree(engine.param_specs, DATA_AXIS)
+    # grad_acc is the STACKED [n_data, ...] layout: its manual in_spec is
+    # always "dim 0 on data" (the leaf's own tensor/expert sharding rides
+    # the auto axes)
+    acc_proj_stacked = jax.tree.map(
+        lambda s: PartitionSpec(DATA_AXIS), engine.grad_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    repl = PartitionSpec()
+
+    def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train):
+        batch_proj = jax.tree.map(
+            lambda leaf: project_spec(batch_spec_of(leaf), DATA_AXIS),
+            batch)
+
+        def inner(params_l, acc_l, batch_l, rng):
+            n = jax.lax.axis_size(DATA_AXIS)
+
+            def raw_loss(p):
+                loss, _aux = adapter_loss(p, batch_l, rng, train=train)
+                return loss
+
+            loss_fn = jax.checkpoint(raw_loss, policy=remat_policy) \
+                if remat_policy is not None else raw_loss
+            loss_s, grads = jax.value_and_grad(
+                lambda p: loss_fn(p) / gas)(params_l)
+            new_acc = jax.tree.map(
+                lambda a, g: a + g.astype(grad_accum_dtype)[None],
+                acc_l, grads)
+            return jax.lax.psum(loss_s, DATA_AXIS) / n * gas, new_acc
+
+        shmapped = jax.shard_map(
+            inner, mesh=mesh, axis_names={DATA_AXIS},
+            in_specs=(params_proj, acc_proj_stacked, batch_proj, repl),
+            out_specs=(repl, acc_proj_stacked), check_vma=False)
+        loss, new_acc = shmapped(params, grad_acc, batch, rng)
+        return loss, new_acc
+
+    # optimizer-state specs inside the manual region: error is stacked
+    # (per-device, dim 0 on data); everything else replicated over data
+    _stacked = PartitionSpec(DATA_AXIS)
+
+    def _field(path):
+        return str(getattr(path[0], "name",
+                           getattr(path[0], "key", path[0])))
+
+    def _state_proj(opt_state):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: _stacked if _field(path) == "error"
+            else repl, opt_state)
+
+    def _apply_body(flags):
+        def apply_step(state, lr):
+            opt_state = state["opt"]
+            master = state["master"] if mixed else state["params"]
+            state_proj = _state_proj(opt_state)
+
+            def inner(acc_l, opt_l, master_l, lr):
+                n = jax.lax.axis_size(DATA_AXIS)
+                grads = jax.tree.map(lambda a: a[0].astype(jnp.float32),
+                                     acc_l)
+                opt_local = jax.tree_util.tree_map_with_path(
+                    lambda path, leaf: leaf[0]
+                    if _field(path) == "error" else leaf, opt_l)
+                finite_l = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g))
+                     for g in jax.tree.leaves(grads)]))
+                finite = jax.lax.psum(
+                    1.0 - finite_l.astype(jnp.float32), DATA_AXIS) == 0
+                # reporting proxy: rms of per-device grad norms (the true
+                # global-mean-grad norm would need the full allreduce the
+                # compression exists to avoid)
+                sq = sum(jnp.sum(jnp.square(g))
+                         for g in jax.tree.leaves(grads))
+                grad_norm = jnp.sqrt(jax.lax.psum(sq, DATA_AXIS) / n)
+
+                updates, new_opt = opt.update(grads, opt_local, master_l,
+                                              lr, **flags)
+                # masked select instead of lax.cond on overflow: the
+                # update contains collectives, which must execute
+                # unconditionally on every device
+                new_master = jax.tree.map(
+                    lambda old, u: jnp.where(finite, old + u, old),
+                    master_l, updates)
+                new_opt = jax.tree.map(
+                    lambda new, old: jnp.where(finite, new, old),
+                    new_opt, opt_local)
+                new_opt = jax.tree_util.tree_map_with_path(
+                    lambda path, leaf: leaf[None]
+                    if _field(path) == "error" else leaf, new_opt)
+                zero_acc = jax.tree.map(jnp.zeros_like, acc_l)
+                return new_master, new_opt, zero_acc, finite, grad_norm
+
+            shmapped = jax.shard_map(
+                inner, mesh=mesh, axis_names={DATA_AXIS},
+                in_specs=(acc_proj_stacked, state_proj, params_proj,
+                          repl),
+                out_specs=(params_proj, state_proj, acc_proj_stacked,
+                           repl, repl),
+                check_vma=False)
+            new_master, new_opt, zero_acc, finite, grad_norm = shmapped(
+                state["grad_acc"], opt_state, master, lr)
+
+            if mixed:
+                new_params = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda x: x.astype(compute_dtype),
+                                 new_master), param_shardings)
+                out_master = new_master
+            else:
+                new_params = jax.lax.with_sharding_constraint(
+                    new_master, param_shardings)
+                out_master = None
+            new_state = dict(state, params=new_params, master=out_master,
+                             opt=new_opt, grad_acc=zero_acc)
+            return new_state, finite, grad_norm
+
+        return apply_step
+
+    def make_apply(flags):
+        return jax.jit(_apply_body(flags), donate_argnums=(0,))
+
+    def make_fused(flags):
+        apply_body = _apply_body(flags)
+
+        def fused(state, batches, lr, rng):
+            def body(acc, xs):
+                grad_acc, loss_sum = acc
+                batch, key = xs
+                loss, grad_acc = micro_fwd_bwd(
+                    state["params"], grad_acc, state["loss_scale"],
+                    batch, key, True)
+                return (grad_acc, loss_sum + loss), None
+
+            keys = jax.random.split(rng, gas)
+            (grad_acc, loss_sum), _ = jax.lax.scan(
+                body, (state["grad_acc"], jnp.zeros((), jnp.float32)),
+                (batches, keys))
+            st = dict(state, grad_acc=grad_acc)
+            new_state, finite, grad_norm = apply_body(st, lr)
+            return new_state, loss_sum / gas, finite, grad_norm
+
+        return jax.jit(fused, donate_argnums=(0,))
+
+    return micro_fwd_bwd, make_apply, make_fused
+
+
+def init_onebit_state(engine, opt: OnebitOptimizer, master_or_params):
+    """Optimizer state with the worker-error stacked per device and
+    placed on the mesh. The factory's ACTUAL init values are used
+    (OnebitLamb's trust coefficients start at one, not zero); the error
+    is zeros by construction, so stacking it keeps the init semantics.
+    Non-error leaves shard over tensor/expert exactly like the plain
+    path's optimizer state (only ``data`` carries the stacked error)."""
+    n_data = engine.topology.data_size
+    mesh = engine.mesh
+    state = jax.jit(opt.init)(master_or_params)
+
+    # m/v (param-shaped) shard like the params on the non-data axes;
+    # per-leaf scalars (lamb's coeff) and step replicate; error stacks
+    # its per-device copies on data in front of the param sharding
+    param_spec_tree = engine.policy.param_specs(master_or_params)
+
+    def spec_for(field, leaf, param_spec):
+        if field == "error":
+            return PartitionSpec(DATA_AXIS, *param_spec)
+        if leaf.ndim == len(param_spec):
+            return param_spec
+        return PartitionSpec()
+
+    def place_field(field, sub):
+        if field == "step" or not isinstance(sub, dict):
+            return jax.device_put(sub, NamedSharding(mesh, PartitionSpec()))
+
+        def place(leaf, spec):
+            s = spec_for(field, leaf, spec)
+            if field == "error":
+                leaf = jnp.zeros((n_data,) + leaf.shape, leaf.dtype)
+            return jax.device_put(leaf, NamedSharding(mesh, s))
+
+        return jax.tree.map(
+            place, sub, param_spec_tree,
+            is_leaf=lambda x: not isinstance(x, dict))
+
+    placed = {f: place_field(f, getattr(state, f))
+              for f in state._fields}
+    return type(state)(**placed)

@@ -1,0 +1,152 @@
+"""1-bit optimizers through the engine config (optimizer.type).
+
+Reference analog: the reference selects OnebitAdam/OnebitLamb/
+ZeroOneAdam by name in ``_configure_optimizer`` and its onebit tests
+train through both stages; here additionally the warmup stage is pinned
+numerically against the plain Adam engine path (they must coincide
+until ``freeze_step``)."""
+
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+
+FREEZE = 4
+STEPS = 10
+
+
+def _batch(mcfg, rows=8):
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, mcfg.vocab_size, (rows, 16),
+                                      dtype=np.int32)}
+
+
+def _engine(opt_type, opt_params, **cfg_extra):
+    mcfg = gpt2_tiny()
+    batch = _batch(mcfg)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": opt_type, "params": opt_params},
+        "steps_per_print": 10 ** 9,
+        **cfg_extra,
+    }
+    engine, _, _, _ = hds.initialize(model=GPT2LMHeadModel(mcfg),
+                                     config=config, example_batch=batch)
+    return engine, batch
+
+
+class TestOnebitViaConfig:
+    def test_onebit_adam_trains_through_both_stages(self, eight_devices):
+        engine, batch = _engine("OnebitAdam",
+                                {"lr": 2e-3, "freeze_step": FREEZE})
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(STEPS)]
+        assert all(np.isfinite(l) for l in losses), losses
+        # both stages ran and kept converging
+        assert losses[FREEZE] < losses[0]
+        assert losses[-1] < losses[FREEZE], losses
+
+    def test_warmup_matches_plain_adam(self, eight_devices):
+        """Until freeze_step the 1-bit stage is exactly Adam with
+        full-precision gradient averaging — trajectories must agree."""
+        e1, batch = _engine("OnebitAdam",
+                            {"lr": 1e-3, "freeze_step": STEPS + 1,
+                             "weight_decay": 0.0})
+        e2, _ = _engine("Adam", {"lr": 1e-3, "weight_decay": 0.0})
+        l1 = [float(e1.train_batch(batch=batch)) for _ in range(4)]
+        l2 = [float(e2.train_batch(batch=batch)) for _ in range(4)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_onebit_adam_unfused_path(self, eight_devices):
+        engine, batch = _engine("OnebitAdam",
+                                {"lr": 2e-3, "freeze_step": 2})
+        for _ in range(4):
+            loss = engine.forward(batch)
+            engine.backward()
+            engine.step()
+        assert np.isfinite(float(loss))
+
+    def test_onebit_lamb_trains(self, eight_devices):
+        engine, batch = _engine("OnebitLamb",
+                                {"lr": 5e-3, "freeze_step": 3})
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(6)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], losses
+
+    def test_zero_one_adam_trains(self, eight_devices):
+        engine, batch = _engine("ZeroOneAdam",
+                                {"lr": 2e-3, "var_freeze_step": 3,
+                                 "local_step_scaler": 2})
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(6)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], losses
+
+    def test_onebit_lamb_state_uses_factory_init_values(self,
+                                                        eight_devices):
+        """The engine must keep the factory's init values — LAMB's trust
+        coefficients start at ONE (a zero-filled coeff would silently
+        freeze every parameter in the compressed stage)."""
+        import jax
+        engine, _ = _engine("OnebitLamb", {"lr": 5e-3, "freeze_step": 3})
+        coeffs = [float(c) for c in
+                  jax.tree.leaves(engine.state["opt"].coeff)]
+        assert coeffs and all(c == 1.0 for c in coeffs), coeffs[:5]
+
+    def test_onebit_on_tensor_parallel_mesh(self, eight_devices):
+        """data=4 x tensor=2: opt state shards over tensor like params
+        (memory parity with the plain path) and the compressed step
+        composes with TP collectives."""
+        import jax
+        from hcache_deepspeed_tpu.parallel import topology as topo_mod
+        topo = topo_mod.initialize_topology(
+            topo_mod.TopologySpec(data=4, tensor=2))
+        mcfg = gpt2_tiny()
+        batch = _batch(mcfg)
+        engine, _, _, _ = hds.initialize(
+            model=GPT2LMHeadModel(mcfg), topology=topo,
+            example_batch=batch,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "OnebitAdam",
+                                  "params": {"lr": 2e-3,
+                                             "freeze_step": 2}},
+                    "steps_per_print": 10 ** 9})
+        # at least one m leaf actually sharded over tensor
+        sharded = [x for x in jax.tree.leaves(engine.state["opt"].m)
+                   if any("tensor" in str(s)
+                          for s in x.sharding.spec)]
+        assert sharded, "opt state replicated over tensor"
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(4)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], losses
+
+    def test_user_constructed_adapter_routes_to_manual_step(
+            self, eight_devices):
+        from hcache_deepspeed_tpu.runtime.onebit_wiring import (
+            OnebitOptimizer)
+        mcfg = gpt2_tiny()
+        batch = _batch(mcfg)
+        opt = OnebitOptimizer("OnebitAdam", {"lr": 2e-3,
+                                             "freeze_step": 2})
+        engine, _, _, _ = hds.initialize(
+            model=GPT2LMHeadModel(mcfg), optimizer=opt,
+            example_batch=batch,
+            config={"train_batch_size": 8, "steps_per_print": 10 ** 9})
+        assert engine._onebit is opt
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(3)]
+        assert losses[-1] < losses[0], losses
+
+    @pytest.mark.parametrize("bad_cfg", [
+        {"fp16": {"enabled": True}},
+        {"zero_optimization": {"stage": 2}},
+        {"gradient_clipping": 1.0},
+    ], ids=["fp16", "zero2", "clip"])
+    def test_unsupported_combinations_rejected(self, eight_devices,
+                                               bad_cfg):
+        with pytest.raises(HDSConfigError):
+            _engine("OnebitAdam", {"lr": 1e-3}, **bad_cfg)
