@@ -412,7 +412,50 @@ class HDSConfig(HDSConfigModel):
                 config = json.load(fh)
         if not isinstance(config, dict):
             raise HDSConfigError(f"cannot parse config of type {type(config)}")
+        config = _lift_data_efficiency(config)
         return cls.model_validate(config)
+
+
+def _lift_data_efficiency(config: Dict) -> Dict:
+    """Accept the reference's NESTED curriculum location
+    (``data_efficiency.data_sampling.curriculum_learning`` with
+    per-metric ``curriculum_metrics`` —
+    ``runtime/data_pipeline/config.py``) by lifting the first metric
+    onto the legacy top-level ``curriculum_learning`` block this config
+    models. A top-level block always wins."""
+    de = config.get("data_efficiency")
+    if not isinstance(de, dict) or "curriculum_learning" in config:
+        return config
+    ds = de.get("data_sampling") or {}
+    # an explicitly-False outer switch disables the whole chain (the
+    # reference gates on data_efficiency.enabled and
+    # data_sampling.enabled); an absent switch does not veto a
+    # deliberately-written inner block
+    if de.get("enabled") is False or ds.get("enabled") is False:
+        return config
+    cl = ds.get("curriculum_learning") or {}
+    if not cl.get("enabled"):
+        return config
+    metrics = cl.get("curriculum_metrics") or {}
+    lifted = {"enabled": True}
+    if metrics:
+        name, m = sorted(metrics.items())[0]
+        if len(metrics) > 1:
+            from ..utils.logging import logger
+            logger.warning(
+                "data_efficiency defines %d curriculum metrics; only "
+                "%r is lifted (multi-metric clustering is not "
+                "implemented)", len(metrics), name)
+        lifted.update({
+            "curriculum_type": name,
+            "min_difficulty": m.get("min_difficulty", 8),
+            "max_difficulty": m.get("max_difficulty", 1024),
+            "schedule_type": m.get("schedule_type", "fixed_linear"),
+            "schedule_config": m.get("schedule_config", {}),
+        })
+    config = dict(config)
+    config["curriculum_learning"] = lifted
+    return config
 
 
 def load_config(config) -> HDSConfig:

@@ -1034,7 +1034,10 @@ class HDSEngine:
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
-        skipped = self.fp16_enabled and not bool(finite)
+        # the 1-bit path also masks out non-finite updates (no loss
+        # scaler to recover with — but the skip must not be silent)
+        skipped = (self.fp16_enabled or self._onebit is not None) \
+            and not bool(finite)
         if skipped:
             self.skipped_steps += 1
             log_dist(f"overflow: skipping step {self.global_steps}, "

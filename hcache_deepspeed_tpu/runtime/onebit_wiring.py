@@ -53,8 +53,13 @@ class OnebitOptimizer:
         if kind == "01adam":
             kind = "zerooneadam"
         kw = dict(params)
-        for drop in ("torch_adam", "cuda_aware", "comm_backend_name",
-                     "adam_w_mode"):
+        if kw.pop("adam_w_mode", None) is False:
+            # semantic, not cosmetic: the factories apply decoupled
+            # (AdamW-style) weight decay only
+            raise ValueError(
+                "1-bit optimizers implement decoupled (AdamW) weight "
+                "decay; adam_w_mode=false is not supported")
+        for drop in ("torch_adam", "cuda_aware", "comm_backend_name"):
             kw.pop(drop, None)
         if "betas" in kw:
             kw["betas"] = tuple(kw["betas"])
@@ -164,6 +169,10 @@ def build_onebit_step_fns(*, engine, opt: OnebitOptimizer):
 
         def inner(params_l, acc_l, batch_l, rng):
             n = jax.lax.axis_size(DATA_AXIS)
+            # distinct dropout masks per data shard (the GSPMD path's one
+            # global mask array spans the global batch; a replicated key
+            # here would correlate noise n_data-fold)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
 
             def raw_loss(p):
                 loss, _aux = adapter_loss(p, batch_l, rng, train=train)

@@ -64,6 +64,51 @@ class TestCurriculumScheduler:
                 "schedule_type": "fixed_linear"})
 
 
+class TestDataEfficiencyAlias:
+    def test_nested_reference_schema_lifts(self):
+        """The reference's data_efficiency.data_sampling nesting
+        (runtime/data_pipeline/config.py) maps onto the legacy
+        curriculum_learning block."""
+        from hcache_deepspeed_tpu.runtime.config import load_config
+        cfg = load_config({
+            "train_batch_size": 8,
+            "data_efficiency": {
+                "enabled": True,
+                "data_sampling": {
+                    "enabled": True,
+                    "curriculum_learning": {
+                        "enabled": True,
+                        "curriculum_metrics": {
+                            "seqlen": {
+                                "min_difficulty": 32,
+                                "max_difficulty": 512,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {
+                                    "total_curriculum_step": 100,
+                                    "difficulty_step": 8}}}}}}})
+        cl = cfg.curriculum_learning
+        assert cl.enabled and cl.curriculum_type == "seqlen"
+        assert (cl.min_difficulty, cl.max_difficulty) == (32, 512)
+        assert cl.schedule_config["difficulty_step"] == 8
+
+    def test_top_level_block_wins(self):
+        from hcache_deepspeed_tpu.runtime.config import load_config
+        cfg = load_config({
+            "train_batch_size": 8,
+            "curriculum_learning": {"enabled": False},
+            "data_efficiency": {"data_sampling": {
+                "curriculum_learning": {"enabled": True}}}})
+        assert not cfg.curriculum_learning.enabled
+
+    def test_disabled_nested_block_ignored(self):
+        from hcache_deepspeed_tpu.runtime.config import load_config
+        cfg = load_config({
+            "train_batch_size": 8,
+            "data_efficiency": {"data_sampling": {
+                "curriculum_learning": {"enabled": False}}}})
+        assert not cfg.curriculum_learning.enabled
+
+
 class TestCurriculumSampler:
     def test_admission_grows_with_difficulty(self):
         sched = CurriculumScheduler({
