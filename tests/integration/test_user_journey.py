@@ -71,6 +71,7 @@ def _infer_config():
                   "cache_dtype": "float32"})
 
 
+@pytest.mark.slow
 class TestUserJourney:
     def test_train_reshape_export_serve_restore(self, eight_devices,
                                                 tmp_path):

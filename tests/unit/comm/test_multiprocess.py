@@ -122,6 +122,7 @@ def _parse_losses(out):
     return losses
 
 
+@pytest.mark.slow
 class TestMultiProcess:
     @pytest.mark.parametrize("zero_stage", [0, 3], ids=["dp", "zero3"])
     def test_two_process_dp_training_matches_single_process(self,

@@ -36,6 +36,7 @@ class _Shadow:
                 np.concatenate([self.latents, new_latents], axis=1)
 
 
+@pytest.mark.slow
 class TestServingLifecycleFuzz:
 
     def _check_decode(self, model, params, sh, logits):

@@ -59,6 +59,7 @@ COMBOS = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name,overrides",
                          COMBOS, ids=[c[0] for c in COMBOS])
 def test_config_combo_initializes_and_steps(eight_devices, name,

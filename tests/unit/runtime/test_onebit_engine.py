@@ -37,6 +37,7 @@ def _engine(opt_type, opt_params, **cfg_extra):
     return engine, batch
 
 
+@pytest.mark.slow
 class TestOnebitViaConfig:
     def test_onebit_adam_trains_through_both_stages(self, eight_devices):
         engine, batch = _engine("OnebitAdam",
