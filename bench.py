@@ -67,6 +67,10 @@ CONFIGS = {
                           loss_chunk=0),
     "350m-hd128-lchunk-b8": dict(batch=8, n_head=8, vocab_size=50304,
                                  loss_chunk=256),
+    "350m-hd128-lchunk-b16": dict(batch=16, n_head=8, vocab_size=50304,
+                                  loss_chunk=256),
+    "350m-hd128-lchunk-b32": dict(batch=32, n_head=8, vocab_size=50304,
+                                  loss_chunk=256),
     "350m-hd128-b16": dict(batch=16, n_head=8, vocab_size=50304,
                            loss_chunk=0),
     "350m-vpad-b8": dict(batch=8, n_head=16, vocab_size=50304,
