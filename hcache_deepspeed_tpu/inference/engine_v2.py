@@ -532,8 +532,8 @@ class InferenceEngineV2:
         if seq.host_kv is not None:
             return   # already suspended
         idx = self._token_slots(seq, seq.seen_tokens)
-        seq.host_kv = (np.asarray(self.cache.k[:, idx]),
-                       np.asarray(self.cache.v[:, idx]))
+        seq.host_kv = (np.asarray(self.cache.k[:, :, idx]),
+                       np.asarray(self.cache.v[:, :, idx]))
         if seq.blocks:
             self.state.allocator.free(seq.blocks)
             seq.blocks = []
@@ -566,7 +566,7 @@ class InferenceEngineV2:
         allocating a second full-size pool copy (the pool is sized to
         nearly fill HBM in reserve mode — an eager .at[].set would OOM
         exactly at production sizes)."""
-        return k.at[:, idx].set(host_k), v.at[:, idx].set(host_v)
+        return k.at[:, :, idx].set(host_k), v.at[:, :, idx].set(host_v)
 
     def serialize(self) -> Dict:
         """Host-side engine state (reference serializes scheduling state)."""

@@ -303,19 +303,19 @@ class PagedInferenceModel:
             is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def cache_sharding(self):
-        """Sharding for the [L, P, KV, D] block pool: KV heads split over
+        """Sharding for the [L, KV, P, D] block pool: KV heads split over
         ``tensor``. None on single chip."""
         if self.tp == 1:
             return None
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.topology.mesh,
-                             P(None, None, TENSOR_AXIS, None))
+                             P(None, TENSOR_AXIS, None, None))
 
     def _wrap_tp(self, fwd, restore):
         from jax.sharding import PartitionSpec as P
         mesh = self.topology.mesh
         pspecs = self._param_spec_tree()
-        cache_spec = P(None, None, TENSOR_AXIS, None)  # [L, P, KV, D]
+        cache_spec = P(None, TENSOR_AXIS, None, None)  # [L, KV, P, D]
         rep = P()
 
         fwd_m = jax.shard_map(
@@ -360,17 +360,18 @@ class PagedInferenceModel:
         return q, k, v
 
     def _scatter_kv(self, ck, cv, k, v, flat_idx):
-        """ck/cv: [P, KV, D]; k/v: [B, T, KV, D]; flat_idx: [B, T] (OOB ⇒
+        """ck/cv: [KV, P, D]; k/v: [B, T, KV, D]; flat_idx: [B, T] (OOB ⇒
         dropped — padded lanes use an index past the pool end)."""
-        kv_shape = (-1,) + k.shape[2:]
-        ck = ck.at[flat_idx.reshape(-1)].set(
-            k.reshape(kv_shape).astype(ck.dtype), mode="drop")
-        cv = cv.at[flat_idx.reshape(-1)].set(
-            v.reshape(kv_shape).astype(cv.dtype), mode="drop")
+        KV = k.shape[2]
+        kt = k.reshape(-1, KV, k.shape[-1]).swapaxes(0, 1)   # [KV, N, D]
+        vt = v.reshape(-1, KV, v.shape[-1]).swapaxes(0, 1)
+        idx = flat_idx.reshape(-1)
+        ck = ck.at[:, idx].set(kt.astype(ck.dtype), mode="drop")
+        cv = cv.at[:, idx].set(vt.astype(cv.dtype), mode="drop")
         return ck, cv
 
     def _paged_attention(self, q, ck, cv, tables, q_positions, kv_len):
-        """q: [B, T, Hq, D]; ck/cv: [P, KV, D]; tables: [B, NB];
+        """q: [B, T, Hq, D]; ck/cv: [KV, P, D]; tables: [B, NB];
         q_positions: [B, T] absolute; kv_len: [B] valid cache length.
         Returns [B, T, Hq*D].
 
@@ -432,7 +433,7 @@ class PagedInferenceModel:
                   for k, v in params.items()}
         B, T = tokens.shape
         BS = self.block_size
-        P = cache_k.shape[1]
+        P = cache_k.shape[2]   # [L, KV, P, D]
         offs = jnp.arange(T)
         positions = start[:, None] + offs[None, :]              # [B, T]
         x = self._embed_lookup(params["embed"], tokens) + \
@@ -525,7 +526,7 @@ class PagedInferenceModel:
         lp = dequantize_tree(lp)
         B, T, _ = latent.shape
         BS = self.block_size
-        P = cache_k.shape[1]
+        P = cache_k.shape[2]   # [L, KV, P, D]
         offs = jnp.arange(T)
         positions = start[:, None] + offs[None, :]
         token_valid = offs[None, :] < t_len[:, None]
@@ -535,10 +536,13 @@ class PagedInferenceModel:
         flat_idx = jnp.where(token_valid, flat_idx, P).reshape(-1)
         _, k, v = self._qkv(lp, latent.astype(self.cfg.compute_dtype),
                             positions)
-        kv_shape = (-1,) + k.shape[2:]
-        cache_k = cache_k.at[layer, flat_idx].set(
+        # mixed indexing (int, :, array) puts the scattered dim FIRST, so
+        # the update values keep the natural [N, KV, D] token-major shape
+        KV = k.shape[2]
+        kv_shape = (-1, KV, k.shape[-1])
+        cache_k = cache_k.at[layer, :, flat_idx].set(
             k.reshape(kv_shape).astype(cache_k.dtype), mode="drop")
-        cache_v = cache_v.at[layer, flat_idx].set(
+        cache_v = cache_v.at[layer, :, flat_idx].set(
             v.reshape(kv_shape).astype(cache_v.dtype), mode="drop")
         return cache_k, cache_v
 

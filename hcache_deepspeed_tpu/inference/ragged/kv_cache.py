@@ -6,13 +6,17 @@ Reference analogs:
 * ``deepspeed/inference/v2/ragged/ragged_manager.py:19 DSStateManager`` —
   uid → sequence tracking plus allocator wiring.
 
-TPU-native layout: one pool per k/v of shape ``[L, P, KV, D]`` with
+TPU-native layout: one pool per k/v of shape ``[L, KV, P, D]`` with
 ``P = num_blocks * block_size`` token slots, kept as jnp arrays that flow
 *functionally* through the jitted forward (donated, so XLA updates them in
-place in HBM). Block granularity exists only in the host-side allocator
-and the flat gather/scatter indices built from block tables — the device
-never sees a block structure, which keeps every cache op a single fused
-gather/scatter instead of the reference's per-block copy kernels.
+place in HBM). Head-major (KV before P) so the paged-attention kernel's
+per-(head, block) DMA tile is ``[block_size, D]`` — a legal Mosaic tile
+whose last two dims match the array's minor dims; token-major would force
+an un-tileable ``[BS, 1, D]`` block. Block granularity exists only in the
+host-side allocator and the flat gather/scatter indices built from block
+tables — the device never sees a block structure, which keeps every cache
+op a single fused gather/scatter instead of the reference's per-block copy
+kernels.
 """
 
 from typing import Dict, List, Optional
@@ -37,7 +41,7 @@ class BlockedKVCache:
         self.n_kv_heads = n_kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
-        shape = (n_layers, num_blocks * block_size, n_kv_heads, head_dim)
+        shape = (n_layers, n_kv_heads, num_blocks * block_size, head_dim)
         k = jnp.zeros(shape, dtype)
         v = jnp.zeros(shape, dtype)
         if sharding is not None:

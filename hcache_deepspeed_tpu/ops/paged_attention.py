@@ -15,7 +15,10 @@ Ragged batching contract (matches ``inference/model.py``):
 * ``q``        [B, T, Hq, D] — T=1 rows for a ragged decode batch, or a
   prefill chunk (B=1, T=bucket); padded query rows are dropped by the
   caller.
-* ``k_pool``/``v_pool`` [P, KV, D] — the flat block pool, P = NBLK * BS.
+* ``k_pool``/``v_pool`` [KV, P, D] — the flat block pool, P = NBLK * BS.
+  Head-major: each grid step's DMA tile is then ``[BS, D]`` over the
+  pool's minor dims — the layout Mosaic can tile (token-major would put
+  the singleton kv-head pick in the sublane dim, which is unlowerable).
 * ``tables``   [B, NB] int32 — per-sequence block table (0-padded).
 * ``start``    [B] first absolute position of the chunk's queries.
 * ``kv_len``   [B] valid cache length (= start + t_len).
@@ -46,25 +49,25 @@ def reference_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
                               block_size):
     """Dense-gather oracle. [B,T,Hq,D] out, grouped GQA (no repeat)."""
     B, T, Hq, D = q.shape
-    KV = k_pool.shape[1]
+    KV = k_pool.shape[0]
     G = Hq // KV
     BS = block_size
     NB = tables.shape[1]
     S = NB * BS
     pos = jnp.arange(S)
     gather = tables[:, pos // BS] * BS + pos % BS            # [B, S]
-    k_seq = k_pool[gather]                                   # [B,S,KV,D]
-    v_seq = v_pool[gather]
+    k_seq = k_pool[:, gather]                                # [KV,B,S,D]
+    v_seq = v_pool[:, gather]
     qg = q.reshape(B, T, KV, G, D)
     scale = 1.0 / np.sqrt(D)
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k_seq) * scale
+    scores = jnp.einsum("btkgd,kbsd->bkgts", qg, k_seq) * scale
     q_pos = start[:, None] + jnp.arange(T)[None, :]          # [B, T]
     valid = (pos[None, None, :] <= q_pos[:, :, None]) & \
             (pos[None, None, :] < kv_len[:, None, None])     # [B,T,S]
     scores = jnp.where(valid[:, None, None], scores.astype(jnp.float32),
                        _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_seq)
+    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v_seq)
     return out.reshape(B, T, Hq, D)
 
 
@@ -72,7 +75,7 @@ def reference_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
 # Pallas kernel
 # ------------------------------------------------------------------ #
 def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
-            q_ref, k_ref, v_ref,                 # [1,1,TGp,D], [1,BS,1,D]
+            q_ref, k_ref, v_ref,                 # [1,1,TGp,D], [1,1,BS,D]
             o_ref,                               # [1,1,TGp,D]
             acc, m_s, l_s,                       # VMEM scratch
             *, scale, G, BS, TGp):
@@ -94,7 +97,7 @@ def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
         # matmuls stay in the input dtype (bf16 MXU rate) with fp32
         # accumulation — an fp32 upcast here runs at ~1/8 peak
         q = q_ref[0, 0]                                      # [TGp, D]
-        k = k_ref[0, :, 0].astype(q.dtype)                   # [BS, D]
+        k = k_ref[0, 0].astype(q.dtype)                      # [BS, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [TGp, BS]
@@ -109,7 +112,7 @@ def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
         corr = jnp.exp(m_prev - m_new)
         l_s[:, :1] = corr * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         m_s[:, :1] = m_new
-        v = v_ref[0, :, 0]                                   # [BS, D]
+        v = v_ref[0, 0]                                      # [BS, D]
         acc[:] = acc[:] * corr + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
@@ -126,11 +129,11 @@ def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
         from ..platform import get_platform
         interpret = not get_platform().supports_pallas()
     B, T, Hq, D = q.shape
-    KV = k_pool.shape[1]
+    KV = k_pool.shape[0]
     G = Hq // KV
     BS = block_size
     NB = tables.shape[1]
-    NBLK = k_pool.shape[0] // BS
+    NBLK = k_pool.shape[1] // BS
 
     # [B, KV, T*G, D] query layout: one contiguous row block per kv head
     qg = q.reshape(B, T, KV, G, D).transpose(0, 2, 1, 3, 4).reshape(
@@ -140,8 +143,8 @@ def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
     if TGp != TG:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, TGp - TG), (0, 0)))
 
-    kp = k_pool.reshape(NBLK, BS, KV, D)
-    vp = v_pool.reshape(NBLK, BS, KV, D)
+    kp = k_pool.reshape(KV, NBLK, BS, D)
+    vp = v_pool.reshape(KV, NBLK, BS, D)
     tables = jnp.asarray(tables, jnp.int32)
     kv_len = jnp.asarray(kv_len, jnp.int32)
     start = jnp.asarray(start, jnp.int32)
@@ -150,7 +153,7 @@ def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
         # clamp out-of-range slots to the last valid block: repeated block
         # index ⇒ Pallas skips the DMA, so dead slots cost nothing
         last = jnp.maximum(kvlen_ref[b] - 1, 0) // BS
-        return (tables_ref[b, jnp.minimum(nb, last)], 0, h, 0)
+        return (h, tables_ref[b, jnp.minimum(nb, last)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -158,8 +161,8 @@ def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
         in_specs=[
             pl.BlockSpec((1, 1, TGp, D),
                          lambda b, h, nb, *refs: (b, h, 0, 0)),
-            pl.BlockSpec((1, BS, 1, D), page_index),
-            pl.BlockSpec((1, BS, 1, D), page_index),
+            pl.BlockSpec((1, 1, BS, D), page_index),
+            pl.BlockSpec((1, 1, BS, D), page_index),
         ],
         out_specs=pl.BlockSpec((1, 1, TGp, D),
                                lambda b, h, nb, *refs: (b, h, 0, 0)),
@@ -184,12 +187,12 @@ def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
 def _dispatch_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
                               block_size):
     B, T, Hq, D = q.shape
-    KV = k_pool.shape[1]
+    KV = k_pool.shape[0]
     if Hq % KV:
         raise ValueError(
             f"query heads ({Hq}) must be a multiple of kv heads ({KV})")
     # alignment guards: the kernel needs whole, sublane-aligned blocks
-    if k_pool.shape[0] % block_size or block_size % 8:
+    if k_pool.shape[1] % block_size or block_size % 8:
         return reference_paged_attention(q, k_pool, v_pool, tables, start,
                                          kv_len, block_size)
     return pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,

@@ -13,8 +13,8 @@ from hcache_deepspeed_tpu.ops.paged_attention import (
 def _case(B, T, Hq, KV, D, BS, NBLK, NB, starts, lens, seed=0):
     rng = np.random.default_rng(seed)
     q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
-    kp = jnp.asarray(rng.standard_normal((NBLK * BS, KV, D)), jnp.float32)
-    vp = jnp.asarray(rng.standard_normal((NBLK * BS, KV, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)), jnp.float32)
     perm = rng.permutation(NBLK)
     tables = perm[:B * NB].reshape(B, NB).astype(np.int32)
     start = jnp.asarray(starts, jnp.int32)
@@ -48,9 +48,9 @@ class TestPagedAttentionParity:
         rng = np.random.default_rng(3)
         B, T, Hq, KV, D, BS, NBLK, NB = 2, 1, 4, 2, 64, 16, 16, 4
         q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.bfloat16)
-        kp = jnp.asarray(rng.standard_normal((NBLK * BS, KV, D)),
+        kp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)),
                          jnp.bfloat16)
-        vp = jnp.asarray(rng.standard_normal((NBLK * BS, KV, D)),
+        vp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)),
                          jnp.bfloat16)
         tables = rng.permutation(NBLK)[:B * NB].reshape(B, NB).astype(
             np.int32)
@@ -69,9 +69,9 @@ class TestPagedAttentionParity:
         rng = np.random.default_rng(4)
         B, T, Hq, KV, D, BS, NBLK, NB = 1, 1, 2, 2, 32, 8, 16, 8
         q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
-        kp = rng.standard_normal((NBLK * BS, KV, D)).astype(np.float32)
-        vp = rng.standard_normal((NBLK * BS, KV, D)).astype(np.float32)
-        kp[BS * 2:], vp[BS * 2:] = 1e9, 1e9  # poison all but blocks 0-1
+        kp = rng.standard_normal((KV, NBLK * BS, D)).astype(np.float32)
+        vp = rng.standard_normal((KV, NBLK * BS, D)).astype(np.float32)
+        kp[:, BS * 2:], vp[:, BS * 2:] = 1e9, 1e9  # poison all but blocks 0-1
         tables = np.zeros((B, NB), np.int32)
         tables[0, 0], tables[0, 1] = 0, 1
         tables[0, 2:] = 9  # dead slots point at poison
