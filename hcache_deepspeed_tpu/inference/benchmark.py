@@ -20,14 +20,18 @@ import time
 import numpy as np
 
 
-def _engine(model_size: str, max_context: int, batch: int,
-            quantize: str = "", prefill_chunk: int = 0,
-            latents: bool = False):
+_PARAM_CACHE = {}
+
+
+def _model_params(model_size: str, max_context: int):
+    """Config + params for one model size, built ONCE per process and on
+    the HOST backend — re-initializing 4 GB of fp32 weights on the chip
+    for every engine variant both wastes time and OOMs the pool (each
+    new engine's init spike lands while the previous engine's weights
+    are still resident)."""
     import jax
 
     from ..models.llama import LlamaConfig, LlamaForCausalLM
-    from .config import RaggedInferenceEngineConfig
-    from .engine_v2 import InferenceEngineV2
 
     sizes = {
         "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
@@ -39,12 +43,35 @@ def _engine(model_size: str, max_context: int, batch: int,
                    intermediate_size=11008, n_layer=32, n_head=32,
                    n_kv_head=32),
     }
-    cfg = LlamaConfig(max_positions=max_context, dtype="bfloat16",
-                      use_flash=False, **sizes[model_size])
-    model = LlamaForCausalLM(cfg)
-    batch_init = {"input_ids": np.zeros((1, 8), np.int32)}
-    params = model.init(jax.random.PRNGKey(0), batch_init,
-                        train=False)["params"]
+    key = (model_size, max_context)
+    if key not in _PARAM_CACHE:
+        cfg = LlamaConfig(max_positions=max_context, dtype="bfloat16",
+                          use_flash=False, **sizes[model_size])
+        model = LlamaForCausalLM(cfg)
+        batch_init = {"input_ids": np.zeros((1, 8), np.int32)}
+        try:
+            host = jax.devices("cpu")[0]
+        except RuntimeError:
+            host = None
+        import contextlib
+        ctx = jax.default_device(host) if host is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            params = jax.tree.map(
+                np.asarray,
+                model.init(jax.random.PRNGKey(0), batch_init,
+                           train=False)["params"])
+        _PARAM_CACHE[key] = (cfg, params)
+    return _PARAM_CACHE[key]
+
+
+def _engine(model_size: str, max_context: int, batch: int,
+            quantize: str = "", prefill_chunk: int = 0,
+            latents: bool = False):
+    from .config import RaggedInferenceEngineConfig
+    from .engine_v2 import InferenceEngineV2
+
+    cfg, params = _model_params(model_size, max_context)
     blocks_needed = batch * (-(-max_context // 64)) + 2
     quant = {}
     if quantize:
@@ -82,6 +109,11 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
     loop — that cost belongs to the *first* pass, not the re-prefill
     being compared against)."""
     results = []
+
+    def emit(row):
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
     rng = np.random.default_rng(0)
     for batch in batches:
         # harvest latents (same seed ⇒ identical weights as the timed
@@ -130,24 +162,33 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
             clear()
         restore_ms = (time.perf_counter() - t0) / reps * 1000
 
-        results.append({
+        emit({
             "phase": "hcache-restore", "batch": batch,
             "prompt_len": prompt_len,
             "prefill_recompute_ms": round(prefill_ms, 2),
             "restore_kv_ms": round(restore_ms, 2),
             "speedup": round(prefill_ms / restore_ms, 2)})
+        del eng
     return results
 
 
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
-        prefill_chunk=0):
+        prefill_chunk=0, fused=False):
+    """ONE engine (sized for the largest batch) serves every measurement:
+    engine-per-config both re-casts the weights each time and, at 1B+
+    sizes, OOMs the pool while two engines overlap. Rows print as they
+    are produced so a crash keeps partial results."""
     results = []
+
+    def emit(row):
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
     rng = np.random.default_rng(0)
+    cfg, eng = _engine(model_size, max_context, max(batches),
+                       quantize=quantize, prefill_chunk=prefill_chunk)
     for batch in batches:
-        cfg, eng = _engine(model_size, max_context, batch,
-                           quantize=quantize,
-                           prefill_chunk=prefill_chunk)
         prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
                    for _ in range(batch)]
         uids = list(range(batch))
@@ -155,27 +196,43 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
         t0 = time.perf_counter()
         logits, _ = eng.put(uids, prompts)
         prefill_s = time.perf_counter() - t0
-        results.append({"phase": "prefill", "batch": batch,
-                        "prompt_len": prompt_len,
-                        "tokens_per_sec": round(batch * prompt_len /
-                                                prefill_s, 1)})
+        emit({"phase": "prefill", "batch": batch,
+              "prompt_len": prompt_len,
+              "tokens_per_sec": round(batch * prompt_len / prefill_s, 1)})
 
-        # warm the decode dispatch, then steady-state loop
-        nxt = [int(np.argmax(l)) for l in logits]
-        logits, _ = eng.put(uids, [[t] for t in nxt])
         ctx0 = prompt_len + 1
-        t0 = time.perf_counter()
-        for _ in range(decode_steps):
+        if fused:
+            # on-device decode loop: one program for the whole stretch
+            for u in uids:
+                eng.flush(u)
+            # warm with the SAME length: n_steps is a static arg, a
+            # different value would recompile inside the timed region
+            eng.generate_fused(prompts, max_new_tokens=decode_steps + 1)
+            t0 = time.perf_counter()
+            eng.generate_fused(prompts,
+                               max_new_tokens=decode_steps + 1)
+            dt = time.perf_counter() - t0
+            emit({"phase": "decode-fused", "batch": batch,
+                  "context": [ctx0, ctx0 + decode_steps],
+                  "note": "includes one prefill",
+                  "tokens_per_sec": round(batch * decode_steps / dt, 1),
+                  "ms_per_step": round(dt / decode_steps * 1000, 2)})
+        else:
+            # warm the decode dispatch, then steady-state loop
             nxt = [int(np.argmax(l)) for l in logits]
             logits, _ = eng.put(uids, [[t] for t in nxt])
-        dt = time.perf_counter() - t0
-        results.append({"phase": "decode", "batch": batch,
-                        "context": [ctx0, ctx0 + decode_steps],
-                        "tokens_per_sec": round(batch * decode_steps / dt,
-                                                1),
-                        "ms_per_step": round(dt / decode_steps * 1000, 2)})
+            t0 = time.perf_counter()
+            for _ in range(decode_steps):
+                nxt = [int(np.argmax(l)) for l in logits]
+                logits, _ = eng.put(uids, [[t] for t in nxt])
+            dt = time.perf_counter() - t0
+            emit({"phase": "decode", "batch": batch,
+                  "context": [ctx0, ctx0 + decode_steps],
+                  "tokens_per_sec": round(batch * decode_steps / dt, 1),
+                  "ms_per_step": round(dt / decode_steps * 1000, 2)})
         for u in uids:
-            eng.flush(u)
+            if eng.state.get_sequence(u) is not None:
+                eng.flush(u)
 
     # context scaling: decode step latency must track tokens-in-cache
     # (the paged kernel reads valid blocks only), not max_context
@@ -184,7 +241,6 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
                 max_context - decode_steps - 1):
         if ctx < 8:
             continue
-        cfg, eng = _engine(model_size, max_context, batch)
         prompts = [list(rng.integers(0, cfg.vocab_size, (ctx,)))
                    for _ in range(batch)]
         uids = list(range(batch))
@@ -196,9 +252,9 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
             nxt = [int(np.argmax(l)) for l in logits]
             logits, _ = eng.put(uids, [[t] for t in nxt])
         dt = time.perf_counter() - t0
-        results.append({"phase": "decode-context-scaling", "batch": batch,
-                        "context": ctx,
-                        "ms_per_step": round(dt / decode_steps * 1000, 2)})
+        emit({"phase": "decode-context-scaling", "batch": batch,
+              "context": ctx,
+              "ms_per_step": round(dt / decode_steps * 1000, 2)})
         for u in uids:
             eng.flush(u)
     return results
@@ -219,16 +275,18 @@ def main(argv=None):
     p.add_argument("--restore", action="store_true",
                    help="HCache mode: restore_kv vs full-prefill "
                         "time-to-cache-ready")
+    p.add_argument("--fused-decode", action="store_true",
+                   help="measure the on-device generate_fused loop "
+                        "instead of host-driven per-step decode")
     args = p.parse_args(argv)
+    # rows print as produced (partial results survive an OOM/crash)
     if args.restore:
-        rows = run_restore(args.model, args.max_context, args.prompt_len,
-                           tuple(args.batches), quantize=args.quantize,
-                           prefill_chunk=args.prefill_chunk)
+        run_restore(args.model, args.max_context, args.prompt_len,
+                    tuple(args.batches), quantize=args.quantize,
+                    prefill_chunk=args.prefill_chunk)
     else:
-        rows = run(args.model, args.max_context, args.prompt_len,
-                   args.decode_steps, tuple(args.batches),
-                   quantize=args.quantize,
-                   prefill_chunk=args.prefill_chunk)
-    for r in rows:
-        print(json.dumps(r), flush=True)
+        run(args.model, args.max_context, args.prompt_len,
+            args.decode_steps, tuple(args.batches),
+            quantize=args.quantize, prefill_chunk=args.prefill_chunk,
+            fused=args.fused_decode)
     return 0

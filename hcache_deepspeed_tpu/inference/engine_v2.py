@@ -267,15 +267,21 @@ class InferenceEngineV2:
             self.state.block_table(self.state.get_sequence(uids[i]),
                                    self.max_blocks_per_seq) for i in idx])
 
-    def _run_decode(self, uids, tokens, idx, logits_out, latents_out):
-        B = _bucket(len(idx))
-        tok = np.zeros((B, 1), np.int32)
+    def _blank_lanes(self, B, T=1):
+        """Padded-lane scaffolding shared by every batched dispatch:
+        zeroed tokens/start/t_len plus tables whose padded lanes point at
+        the scratch block (their writes drop on t_len=0 anyway)."""
+        tok = np.zeros((B, T), np.int32)
         start = np.zeros((B,), np.int32)
         t_len = np.zeros((B,), np.int32)
         tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
-        tables[:, 0] = self._scratch_block  # padded lanes hit scratch
-        real = self._tables(idx, uids)
-        tables[:len(idx)] = real
+        tables[:, 0] = self._scratch_block
+        return tok, start, t_len, tables
+
+    def _run_decode(self, uids, tokens, idx, logits_out, latents_out):
+        B = _bucket(len(idx))
+        tok, start, t_len, tables = self._blank_lanes(B)
+        tables[:len(idx)] = self._tables(idx, uids)
         for j, i in enumerate(idx):
             tok[j, 0] = tokens[i][0]
             start[j] = self.state.get_sequence(uids[i]).seen_tokens
@@ -295,11 +301,7 @@ class InferenceEngineV2:
         padded rows (t_len=0) write to the scratch block like padded
         decode lanes."""
         B = _bucket(len(idx), minimum=1)
-        tok = np.zeros((B, T), np.int32)
-        start = np.zeros((B,), np.int32)
-        t_len = np.zeros((B,), np.int32)
-        tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
-        tables[:, 0] = self._scratch_block
+        tok, start, t_len, tables = self._blank_lanes(B, T)
         tables[:len(idx)] = self._tables(idx, uids)
         for j, i in enumerate(idx):
             seq = self.state.get_sequence(uids[i])
@@ -454,6 +456,86 @@ class InferenceEngineV2:
         if return_logits:
             return outs, [np.stack(t) if t else None for t in logit_trace]
         return outs
+
+    # -------------------------------------------------------------- #
+    # Fused decode: N greedy steps per device program (TPU-native — the
+    # host-driven generate() above pays a host round-trip per token; this
+    # compiles the whole decode stretch, reference has no analog because
+    # its engine must rebuild the ragged batch host-side each step)
+    # -------------------------------------------------------------- #
+    @_annotated("hds.serve.generate_fused")
+    def generate_fused(self, prompts, max_new_tokens: int = 32,
+                       eos_token_id: int = None):
+        """Greedy batched generation with on-device token feedback.
+
+        Prefill runs through :meth:`put` (capturing latents as usual);
+        the decode stretch then runs as ONE jitted ``lax.scan`` — the
+        argmax token feeds the next step on device, so the host syncs
+        once per *generation*, not once per token. KV blocks for the
+        whole stretch are reserved up front. Greedy only (sampling needs
+        the host-driven :meth:`generate`). Returns ``(outs, latents)``
+        where ``latents[i]`` covers prompt + fed tokens (None when
+        latent capture is off) — a returning sequence can be HCache-
+        restored from them after a flush."""
+        base = max(self.state._seqs.keys(), default=-1) + 1
+        uids = [base + i for i in range(len(prompts))]
+        n_feed = max_new_tokens - 1   # tokens fed (and cached) on device
+        # per-forward batch budget sees only the prompts (the fused loop
+        # runs 1 token/lane); context + KV-block budgets must cover the
+        # whole stretch
+        result = self.can_schedule(uids, [len(p) for p in prompts])
+        if result != SchedulingResult.Success:
+            raise SchedulingError(result)
+        blocks = 0
+        for p in prompts:
+            if len(p) + n_feed > self.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
+            blocks += -(-(len(p) + n_feed) // self.block_size)
+        if blocks > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+        try:
+            logits, latents = self.put(uids, prompts)
+            first = np.argmax(logits, axis=-1).astype(np.int32)   # [n]
+            outs = [[int(t)] for t in first]
+            if n_feed > 0:
+                n = len(uids)
+                tok, start, t_len, tables = self._blank_lanes(_bucket(n))
+                for j, uid in enumerate(uids):
+                    seq = self.state.get_sequence(uid)
+                    self.state.maybe_allocate_kv(seq, n_feed)
+                    seq.pre_forward(n_feed)
+                    tok[j, 0] = first[j]
+                    start[j] = seq.seen_tokens
+                    t_len[j] = 1
+                tables[:n] = self._tables(list(range(n)), uids)
+                toks, lats = self.model.decode_loop(
+                    self.cache, tok[:, 0], start, t_len, tables, n_feed)
+                for j, uid in enumerate(uids):
+                    self.state.get_sequence(uid).post_forward()
+                    outs[j].extend(int(t) for t in toks[:, j])
+                if self.config.hcache.enable_latents:
+                    # slice to live lanes on device: padded bucket lanes
+                    # would otherwise ride the D2H copy
+                    lats = np.asarray(lats[:, :, :n])  # [n_feed,L,n,1,H]
+                    for j in range(n):
+                        fed = lats[:, :, j, 0].transpose(1, 0, 2)
+                        latents[j] = np.concatenate([latents[j], fed],
+                                                    axis=1)
+        finally:
+            for uid in uids:
+                if self.state.get_sequence(uid) is not None:
+                    self.flush(uid)
+        if eos_token_id is not None:
+            for j, o in enumerate(outs):
+                if eos_token_id in o:
+                    outs[j] = o[:o.index(eos_token_id) + 1]
+                    if latents[j] is not None:
+                        # keep the restore contract: latents cover
+                        # prompt + fed tokens = prompt + len(outs)-1
+                        latents[j] = latents[j][
+                            :, :len(prompts[j]) + len(outs[j]) - 1]
+        return outs, latents
 
     # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)

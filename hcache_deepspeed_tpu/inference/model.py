@@ -128,8 +128,12 @@ class PagedInferenceModel:
         fwd, restore = self._forward_chunk, self._restore_layer
         if self.tp > 1:
             fwd, restore = self._wrap_tp(fwd, restore)
+        self._fwd_inner = fwd
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
+        self._decode_loop_jit = jax.jit(self._decode_loop,
+                                        static_argnums=(7,),
+                                        donate_argnums=(1, 2))
 
     def load_params(self, params):
         """(Re)load training-layout parameters into the serving layout —
@@ -545,6 +549,42 @@ class PagedInferenceModel:
         cache_v = cache_v.at[layer, :, flat_idx].set(
             v.reshape(kv_shape).astype(cache_v.dtype), mode="drop")
         return cache_k, cache_v
+
+    # -------------------------------------------------------------- #
+    # Fused decode loop: N greedy steps in ONE device program
+    # -------------------------------------------------------------- #
+    def _decode_loop(self, params, cache_k, cache_v, tokens, start, tables,
+                     t_len, n_steps):
+        """``lax.scan`` over ``n_steps`` single-token forwards with the
+        sampled (greedy argmax) token fed back on device — no host
+        round-trip per generated token. The reference's engine (like
+        every GPU serving stack) pays a host sync per step to route the
+        next batch; on TPU the idiomatic serving shape compiles the whole
+        decode stretch so the chip never waits on the host.
+
+        tokens: [B] the first token each lane feeds; start: [B] its
+        position; t_len: [B] 1 for live lanes, 0 for padded lanes (their
+        writes drop, their outputs are discarded). Returns
+        (cache_k', cache_v', tokens_out [n_steps, B],
+        latents [n_steps, L, B, 1, H])."""
+        def step(carry, _):
+            ck, cv, toks, pos = carry
+            ck, cv, logits, latents = self._fwd_inner(
+                params, ck, cv, toks[:, None], pos, tables, t_len)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (ck, cv, nxt, pos + t_len), (nxt, latents)
+
+        (cache_k, cache_v, _, _), (toks, lats) = jax.lax.scan(
+            step, (cache_k, cache_v, tokens, start), None, length=n_steps)
+        return cache_k, cache_v, toks, lats
+
+    def decode_loop(self, cache, tokens, start, t_len, tables, n_steps):
+        ck, cv, toks, lats = self._decode_loop_jit(
+            self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
+            jnp.asarray(t_len, jnp.int32), int(n_steps))
+        cache.replace(ck, cv)
+        return np.asarray(toks), lats
 
     def restore_kv(self, cache, latents, start, tables, t_len):
         """latents: host array [L, B, T, H] (numpy). Per-layer dispatch with

@@ -251,3 +251,80 @@ class TestFactory:
         cfg, _, params = tiny_model
         with pytest.raises(ValueError, match="unsupported model family"):
             build_hf_engine({"model_type": "rwkv"}, params)
+
+
+class TestGenerateFused:
+    """On-device decode loop vs the host-driven paths."""
+
+    def test_fused_matches_stepwise_greedy(self, tiny_model):
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(6)
+        prompts = [list(rng.integers(0, cfg.vocab_size, (n,)))
+                   for n in (5, 9, 3)]
+
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        outs, latents = engine.generate_fused(prompts, max_new_tokens=7)
+        assert latents == [None] * 3
+        assert all(engine.state.get_sequence(u) is None for u in range(3))
+
+        # oracle: greedy continuation through the training model
+        for prompt, out in zip(prompts, outs):
+            seq = list(prompt)
+            for tok in out:
+                ref = full_logits(model, params, seq)
+                assert tok == int(np.argmax(ref[-1]))
+                seq.append(tok)
+
+    def test_fused_single_token(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        prompt = [3, 1, 4, 1, 5]
+        outs, _ = engine.generate_fused([prompt], max_new_tokens=1)
+        ref = full_logits(model, params, prompt)
+        assert outs == [[int(np.argmax(ref[-1]))]]
+
+    def test_fused_eos_truncation(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(7)
+        prompt = list(rng.integers(0, cfg.vocab_size, (4,)))
+        full, _ = engine.generate_fused([prompt], max_new_tokens=6)
+        eos = full[0][2]
+        cut, lat = engine.generate_fused([prompt], max_new_tokens=6,
+                                         eos_token_id=eos)
+        assert cut[0] == full[0][:full[0].index(eos) + 1]
+        # the restore contract survives truncation: latents cover
+        # prompt + fed tokens only
+        assert lat[0].shape[1] == len(prompt) + len(cut[0]) - 1
+
+    def test_fused_does_not_disturb_live_sequences(self, tiny_model):
+        """uids must not collide with sequences the caller is serving."""
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        prompt0 = [5, 6, 7]
+        engine.put([0], [prompt0])              # live sequence at uid 0
+        engine.generate_fused([[9, 8]], max_new_tokens=3)
+        seq = engine.state.get_sequence(0)
+        assert seq is not None and seq.seen_tokens == 3
+        out, _ = engine.put([0], [[2]])         # still decodes correctly
+        ref = full_logits(model, params, prompt0 + [2])
+        np.testing.assert_allclose(out[0], ref[-1], atol=2e-2)
+
+    def test_fused_latents_restore(self, tiny_model):
+        """HCache composition: latents returned by the fused loop restore
+        a flushed sequence to the exact decode state."""
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(8)
+        prompt = list(rng.integers(0, cfg.vocab_size, (8,)))
+
+        engine = make_engine(cfg, params)
+        outs, latents = engine.generate_fused([prompt], max_new_tokens=5)
+        # latents cover prompt + the 4 fed tokens
+        assert latents[0].shape[1] == len(prompt) + 4
+
+        cached_tokens = prompt + outs[0][:-1]
+        engine.restore_kv([9], [cached_tokens], [latents[0]])
+        out, _ = engine.put([9], [[outs[0][-1]]])
+        ref = full_logits(model, params, cached_tokens + [outs[0][-1]])
+        np.testing.assert_allclose(out[0], ref[-1], atol=2e-2)
