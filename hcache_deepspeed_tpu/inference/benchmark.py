@@ -54,13 +54,22 @@ def _model_params(model_size: str, max_context: int):
         except RuntimeError:
             host = None
         import contextlib
+        import os
         ctx = jax.default_device(host) if host is not None \
             else contextlib.nullcontext()
-        with ctx:
-            params = jax.tree.map(
-                np.asarray,
-                model.init(jax.random.PRNGKey(0), batch_init,
-                           train=False)["params"])
+        prev = os.environ.get("HDS_DISABLE_PALLAS")
+        os.environ["HDS_DISABLE_PALLAS"] = "1"   # tracing on the host
+        try:
+            with ctx:
+                params = jax.tree.map(
+                    np.asarray,
+                    model.init(jax.random.PRNGKey(0), batch_init,
+                               train=False)["params"])
+        finally:
+            if prev is None:
+                os.environ.pop("HDS_DISABLE_PALLAS", None)
+            else:
+                os.environ["HDS_DISABLE_PALLAS"] = prev
         _PARAM_CACHE[key] = (cfg, params)
     return _PARAM_CACHE[key]
 

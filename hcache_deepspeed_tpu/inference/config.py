@@ -41,6 +41,14 @@ class HCacheConfig(HDSConfigModel):
     """The fork delta: latent capture + restore_kv (no reference config —
     the fork hard-enables it; here it is a switch)."""
     enable_latents: bool = True
+    #: layers replayed per restore dispatch. 0 = auto: group layers so
+    #: each chunk's latent slab is ~restore_chunk_bytes (per-layer
+    #: dispatch — the reference's literal dual-stream shape — is
+    #: latency-bound when the host link is slow; one giant dispatch
+    #: can't overlap H2D with compute and caps at available HBM for
+    #: million-token contexts; chunking interpolates)
+    restore_chunk_layers: int = Field(0, ge=0)
+    restore_chunk_bytes: int = 64 * 1024 * 1024
 
 
 class QuantizationConfig(HDSConfigModel):

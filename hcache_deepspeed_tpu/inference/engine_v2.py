@@ -107,6 +107,8 @@ class InferenceEngineV2:
             model_config, params, block_size=self.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,
             capture_latents=self.config.hcache.enable_latents,
+            restore_chunk_layers=self.config.hcache.restore_chunk_layers,
+            restore_chunk_bytes=self.config.hcache.restore_chunk_bytes,
             topology=topology, quantization=self.config.quantization)
         self.cache = BlockedKVCache(
             model_config.n_layer, num_blocks, self.block_size,
@@ -549,6 +551,10 @@ class InferenceEngineV2:
         double-buffered against compute."""
         batch_uids = list(batch_uids)
         self._reject_suspended(batch_uids)
+        # group sequences by length bucket: ONE batched restore dispatch
+        # chain per bucket (the per-sequence loop costs a full layer-chunk
+        # dispatch chain per uid — latency-bound on slow host links)
+        items = []
         for uid, tokens, latents in zip(batch_uids, batch_tokens,
                                         batch_latents):
             if latents is None:
@@ -559,20 +565,47 @@ class InferenceEngineV2:
                 raise ValueError(
                     f"uid {uid}: {len(tokens)} tokens but latents for "
                     f"{latents.shape[1]}")
-            seq = self.state.get_or_create_sequence(uid)
-            self.state.maybe_allocate_kv(seq, len(tokens))
-            seq.pre_forward(len(tokens))
-
-            T = _bucket(len(tokens))
-            lat = np.zeros(latents.shape[:1] + (1, T) + latents.shape[2:],
-                           latents.dtype)
-            lat[:, 0, :len(tokens)] = latents
-            start = np.asarray([seq.seen_tokens], np.int32)
-            t_len = np.asarray([len(tokens)], np.int32)
-            tables = self.state.block_table(
-                seq, self.max_blocks_per_seq)[None]
+            items.append((uid, tokens, latents))
+        uid_list = [it[0] for it in items]
+        if len(set(uid_list)) != len(uid_list):
+            # grouped lanes read seen_tokens before any post_forward — a
+            # duplicated uid would overwrite its own slots silently
+            raise ValueError(f"duplicate uids in restore_kv: {uid_list}")
+        # all-or-nothing admission: a mid-group allocation failure would
+        # strand earlier lanes with in-flight accounting and no KV
+        need = sum(self.state.blocks_needed(self.state.get_sequence(uid),
+                                            len(tokens))
+                   for uid, tokens, _ in items)
+        if need > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+        groups: Dict[int, List] = {}
+        for item in items:
+            groups.setdefault(_bucket(len(item[1])), []).append(item)
+        for T, group in sorted(groups.items()):
+            # lane count buckets too: each distinct n would otherwise
+            # shape-specialize (and recompile) the restore chain
+            n = _bucket(len(group), minimum=1)
+            L = group[0][2].shape[0]
+            H = group[0][2].shape[2]
+            lat = np.zeros((L, n, T, H), group[0][2].dtype)
+            start = np.zeros((n,), np.int32)
+            t_len = np.zeros((n,), np.int32)
+            tables = np.zeros((n, self.max_blocks_per_seq), np.int32)
+            tables[:, 0] = self._scratch_block   # padded lanes (t_len=0)
+            seqs = []
+            for j, (uid, tokens, latents) in enumerate(group):
+                seq = self.state.get_or_create_sequence(uid)
+                self.state.maybe_allocate_kv(seq, len(tokens))
+                seq.pre_forward(len(tokens))
+                lat[:, j, :len(tokens)] = latents
+                start[j] = seq.seen_tokens
+                t_len[j] = len(tokens)
+                tables[j] = self.state.block_table(
+                    seq, self.max_blocks_per_seq)
+                seqs.append(seq)
             self.model.restore_kv(self.cache, lat, start, tables, t_len)
-            seq.post_forward()
+            for seq in seqs:
+                seq.post_forward()
 
     # -------------------------------------------------------------- #
     # Lifecycle (reference: flush :275, serialize :284)

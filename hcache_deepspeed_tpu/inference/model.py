@@ -94,11 +94,15 @@ class PagedInferenceModel:
 
     def __init__(self, cfg: LlamaConfig, params, *, block_size: int,
                  max_blocks_per_seq: int, capture_latents: bool = True,
-                 topology=None, quantization=None):
+                 topology=None, quantization=None,
+                 restore_chunk_layers: int = 0,
+                 restore_chunk_bytes: int = 64 * 1024 * 1024):
         self.cfg = cfg
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.capture_latents = capture_latents
+        self.restore_chunk_layers = restore_chunk_layers
+        self.restore_chunk_bytes = restore_chunk_bytes
         self.n_layers = cfg.n_layer
         self.topology = topology
         self.tp = topology.tensor_size if topology is not None else 1
@@ -125,7 +129,7 @@ class PagedInferenceModel:
             self.cos, self.sin = rope_frequencies(cfg.head_dim,
                                                   cfg.max_positions,
                                                   theta)
-        fwd, restore = self._forward_chunk, self._restore_layer
+        fwd, restore = self._forward_chunk, self._restore_chunk
         if self.tp > 1:
             fwd, restore = self._wrap_tp(fwd, restore)
         self._fwd_inner = fwd
@@ -586,14 +590,33 @@ class PagedInferenceModel:
         cache.replace(ck, cv)
         return np.asarray(toks), lats
 
+    def _restore_chunk(self, params, cache_k, cache_v, layer0, lat_chunk,
+                       start, tables, t_len):
+        """Replay layers ``layer0 .. layer0+C`` from one latent slab
+        ``[C, B, T, H]`` in a single dispatch (C is static — set by the
+        engine's chunking policy)."""
+        def body(i, kv):
+            ck, cv = kv
+            return self._restore_layer(params, ck, cv, layer0 + i,
+                                       lat_chunk[i], start, tables, t_len)
+        return jax.lax.fori_loop(0, lat_chunk.shape[0], body,
+                                 (cache_k, cache_v))
+
     def restore_kv(self, cache, latents, start, tables, t_len):
-        """latents: host array [L, B, T, H] (numpy). Per-layer dispatch with
-        the next layer's host→HBM copy issued before this layer's compute —
-        JAX's async dispatch gives the reference's dual-stream overlap
-        (io_stream copy / compute wait-event chain, llama_v2/model.py:229)."""
+        """latents: host array [L, B, T, H] (numpy). Layer-CHUNKED
+        dispatches with the next chunk's host→HBM copy issued before this
+        chunk's compute — JAX's async dispatch gives the reference's
+        dual-stream overlap (io_stream copy / compute wait-event chain,
+        llama_v2/model.py:229) at chunk granularity. The reference's
+        literal one-dispatch-per-layer shape is latency-bound on a slow
+        host link, while one whole-stack dispatch can't overlap H2D with
+        compute and needs the full latent slab in HBM (million-token
+        contexts: tens of GB); the chunk size interpolates
+        (``hcache.restore_chunk_layers`` / ``restore_chunk_bytes``)."""
         start = jnp.asarray(start, jnp.int32)
         tables = jnp.asarray(tables, jnp.int32)
         t_len = jnp.asarray(t_len, jnp.int32)
+        latents = np.asarray(latents)
         ck, cv = cache.k, cache.v
         # Latents replicate over whatever mesh the cache actually lives
         # on (derived from the array, not self.tp: a hybrid engine hands
@@ -604,11 +627,24 @@ class PagedInferenceModel:
             dev = NamedSharding(ck.sharding.mesh, PartitionSpec())
         else:
             dev = list(ck.devices())[0]
-        buf = jax.device_put(np.asarray(latents[0]), dev)  # layer-0 H2D
-        for l in range(self.n_layers):
+        L = self.n_layers
+        C = self.restore_chunk_layers
+        if C <= 0:
+            per_layer = (int(np.prod(latents.shape[1:])) *
+                         latents.dtype.itemsize)
+            C = max(1, min(L, self.restore_chunk_bytes //
+                           max(per_layer, 1)))
+        bounds = list(range(0, L, C))
+
+        def ship(l0):
+            return jax.device_put(
+                np.ascontiguousarray(latents[l0:l0 + C]), dev)
+
+        buf = ship(0)
+        for i, l0 in enumerate(bounds):
             cur = buf
-            if l + 1 < self.n_layers:  # double buffer: prefetch next layer
-                buf = jax.device_put(np.asarray(latents[l + 1]), dev)
-            ck, cv = self._restore(self.params, ck, cv, jnp.int32(l), cur,
-                                   start, tables, t_len)
+            if i + 1 < len(bounds):   # double buffer: prefetch next chunk
+                buf = ship(bounds[i + 1])
+            ck, cv = self._restore(self.params, ck, cv, jnp.int32(l0),
+                                   cur, start, tables, t_len)
         cache.replace(ck, cv)
