@@ -76,7 +76,7 @@ def _model_params(model_size: str, max_context: int):
 
 def _engine(model_size: str, max_context: int, batch: int,
             quantize: str = "", prefill_chunk: int = 0,
-            latents: bool = False):
+            latents: bool = False, latent_dtype: str = "bfloat16"):
     from .config import RaggedInferenceEngineConfig
     from .engine_v2 import InferenceEngineV2
 
@@ -98,12 +98,14 @@ def _engine(model_size: str, max_context: int, batch: int,
             kv_cache={"block_size": 64, "num_blocks": blocks_needed,
                       "cache_dtype": "bfloat16"},
             quantization=quant,
-            hcache={"enable_latents": latents}))
+            hcache={"enable_latents": latents,
+                    "latent_dtype": latent_dtype}))
     return cfg, eng
 
 
 def run_restore(model_size="tiny", max_context=512, prompt_len=128,
-                batches=(1, 4), quantize="", prefill_chunk=0):
+                batches=(1, 4), quantize="", prefill_chunk=0,
+                latent_dtype="bfloat16"):
     """HCache headline: time-to-cache-ready for a returning sequence —
     ``restore_kv`` (QKV-only replay from saved latents) vs a full prefill
     recompute. This is the fork's distinctive capability
@@ -129,7 +131,8 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
         # engine), then drop this engine
         cfg, eng_lat = _engine(model_size, max_context, batch,
                                latents=True, quantize=quantize,
-                               prefill_chunk=prefill_chunk)
+                               prefill_chunk=prefill_chunk,
+                               latent_dtype=latent_dtype)
         prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
                    for _ in range(batch)]
         uids = list(range(batch))
@@ -137,7 +140,8 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
         del eng_lat
 
         cfg, eng = _engine(model_size, max_context, batch, latents=False,
-                           quantize=quantize, prefill_chunk=prefill_chunk)
+                           quantize=quantize, prefill_chunk=prefill_chunk,
+                           latent_dtype=latent_dtype)
 
         def sync():
             # through the axon tunnel block_until_ready may not drain the
@@ -174,6 +178,8 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
         emit({
             "phase": "hcache-restore", "batch": batch,
             "prompt_len": prompt_len,
+            "latent_dtype": latent_dtype,
+            "latent_mb": round(sum(l.nbytes for l in latents) / 2**20, 1),
             "prefill_recompute_ms": round(prefill_ms, 2),
             "restore_kv_ms": round(restore_ms, 2),
             "speedup": round(prefill_ms / restore_ms, 2)})
@@ -281,6 +287,9 @@ def main(argv=None):
                         "the int8-weight Pallas kernel")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="Dynamic-SplitFuse chunk size (0 = off)")
+    p.add_argument("--latent-dtype", default="bfloat16",
+                   help="HCache latent capture dtype (e.g. "
+                        "float8_e4m3fn halves host-link bytes)")
     p.add_argument("--restore", action="store_true",
                    help="HCache mode: restore_kv vs full-prefill "
                         "time-to-cache-ready")
@@ -292,7 +301,8 @@ def main(argv=None):
     if args.restore:
         run_restore(args.model, args.max_context, args.prompt_len,
                     tuple(args.batches), quantize=args.quantize,
-                    prefill_chunk=args.prefill_chunk)
+                    prefill_chunk=args.prefill_chunk,
+                    latent_dtype=args.latent_dtype)
     else:
         run(args.model, args.max_context, args.prompt_len,
             args.decode_steps, tuple(args.batches),

@@ -49,6 +49,14 @@ class HCacheConfig(HDSConfigModel):
     #: million-token contexts; chunking interpolates)
     restore_chunk_layers: int = Field(0, ge=0)
     restore_chunk_bytes: int = 64 * 1024 * 1024
+    #: dtype latents are captured/stored/shipped in; "" = the model's
+    #: compute dtype (bit-exact restore). Restore is host-link-
+    #: bandwidth-bound and latents live in host DRAM per evicted
+    #: sequence, so "float8_e4m3fn" halves both the wire time and the
+    #: storage bill for ~0.4% K/V error (latents are post-norm, O(1)
+    #: scale — comfortably inside e4m3 range); K/V projections replay
+    #: in the compute dtype either way
+    latent_dtype: str = ""
 
 
 class QuantizationConfig(HDSConfigModel):

@@ -109,6 +109,7 @@ class InferenceEngineV2:
             capture_latents=self.config.hcache.enable_latents,
             restore_chunk_layers=self.config.hcache.restore_chunk_layers,
             restore_chunk_bytes=self.config.hcache.restore_chunk_bytes,
+            latent_dtype=self.config.hcache.latent_dtype,
             topology=topology, quantization=self.config.quantization)
         self.cache = BlockedKVCache(
             model_config.n_layer, num_blocks, self.block_size,
@@ -571,11 +572,22 @@ class InferenceEngineV2:
             # grouped lanes read seen_tokens before any post_forward — a
             # duplicated uid would overwrite its own slots silently
             raise ValueError(f"duplicate uids in restore_kv: {uid_list}")
-        # all-or-nothing admission: a mid-group allocation failure would
-        # strand earlier lanes with in-flight accounting and no KV
-        need = sum(self.state.blocks_needed(self.state.get_sequence(uid),
-                                            len(tokens))
-                   for uid, tokens, _ in items)
+        # all-or-nothing admission: a mid-group failure would strand
+        # earlier lanes with in-flight accounting and no KV
+        new_seqs = sum(1 for uid in uid_list
+                       if self.state.get_sequence(uid) is None)
+        if self.state.n_tracked_sequences + new_seqs > \
+                self.config.state_manager.max_tracked_sequences:
+            raise SchedulingError(
+                SchedulingResult.EngineSequenceLimitExceeded)
+        need = 0
+        for uid, tokens, _ in items:
+            seq = self.state.get_sequence(uid)
+            seen = seq.seen_tokens if seq else 0
+            if seen + len(tokens) > self.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
+            need += self.state.blocks_needed(seq, len(tokens))
         if need > self.state.free_blocks:
             raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
         groups: Dict[int, List] = {}
@@ -588,10 +600,7 @@ class InferenceEngineV2:
             L = group[0][2].shape[0]
             H = group[0][2].shape[2]
             lat = np.zeros((L, n, T, H), group[0][2].dtype)
-            start = np.zeros((n,), np.int32)
-            t_len = np.zeros((n,), np.int32)
-            tables = np.zeros((n, self.max_blocks_per_seq), np.int32)
-            tables[:, 0] = self._scratch_block   # padded lanes (t_len=0)
+            _, start, t_len, tables = self._blank_lanes(n)
             seqs = []
             for j, (uid, tokens, latents) in enumerate(group):
                 seq = self.state.get_or_create_sequence(uid)

@@ -96,13 +96,17 @@ class PagedInferenceModel:
                  max_blocks_per_seq: int, capture_latents: bool = True,
                  topology=None, quantization=None,
                  restore_chunk_layers: int = 0,
-                 restore_chunk_bytes: int = 64 * 1024 * 1024):
+                 restore_chunk_bytes: int = 64 * 1024 * 1024,
+                 latent_dtype=""):
         self.cfg = cfg
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.capture_latents = capture_latents
         self.restore_chunk_layers = restore_chunk_layers
         self.restore_chunk_bytes = restore_chunk_bytes
+        # "" ⇒ capture in the compute dtype (bit-exact restore)
+        self.latent_dtype = jnp.dtype(latent_dtype) if latent_dtype \
+            else jnp.dtype(cfg.compute_dtype)
         self.n_layers = cfg.n_layer
         self.topology = topology
         self.tp = topology.tensor_size if topology is not None else 1
@@ -400,7 +404,8 @@ class PagedInferenceModel:
         # residual stream to the compute dtype
         h = rms_norm(x, lp["input_layernorm"]["weight"],
                      eps=cfg.rms_norm_eps).astype(cfg.compute_dtype)
-        latent = h if self.capture_latents else jnp.zeros(
+        latent = h.astype(self.latent_dtype) \
+            if self.capture_latents else jnp.zeros(
             (x.shape[0], x.shape[1], 0), h.dtype)
         q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)

@@ -73,7 +73,8 @@ class PagedFalconModel(PagedInferenceModel):
         shared input LayerNorm h."""
         cfg = self.cfg
         h = self._ln(x, lp["input_layernorm"], cfg.layer_norm_epsilon)
-        latent = h if self.capture_latents else jnp.zeros(
+        latent = h.astype(self.latent_dtype) \
+            if self.capture_latents else jnp.zeros(
             (x.shape[0], x.shape[1], 0), h.dtype)
         q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)

@@ -119,7 +119,8 @@ class PagedGPT2Model(PagedInferenceModel):
         cfg = self.cfg
         eps = cfg.layer_norm_epsilon
         h = self._ln(x, lp["ln_1"], eps)
-        latent = h if self.capture_latents else jnp.zeros(
+        latent = h.astype(self.latent_dtype) \
+            if self.capture_latents else jnp.zeros(
             (x.shape[0], x.shape[1], 0), h.dtype)
         q, k, v = self._qkv(lp, h, positions)
         ck, cv = self._scatter_kv(ck, cv, k, v, flat_idx)

@@ -369,3 +369,46 @@ class TestRestoreChunking:
             assert engine_b.state.get_sequence(u).seen_tokens == len(p)
         dec_b, _ = engine_b.put([0, 1, 2], [[t] for t in nxt])
         np.testing.assert_allclose(dec_b, dec_a, atol=2e-2)
+
+    def test_fp8_latents_restore(self, tiny_model):
+        """float8 latent capture: half the host-link bytes, restore
+        parity within quantization tolerance."""
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(13)
+        prompt = list(rng.integers(0, cfg.vocab_size, (9,)))
+
+        engine_a = make_engine(cfg, params)
+        logits_a, _ = engine_a.put([1], [prompt])
+        nxt = int(np.argmax(logits_a[0]))
+        dec_a, _ = engine_a.put([1], [[nxt]])
+
+        engine_b = make_engine(
+            cfg, params,
+            hcache={"enable_latents": True,
+                    "latent_dtype": "float8_e4m3fn"})
+        _, latents = engine_b.put([1], [prompt])
+        import ml_dtypes
+        assert latents[0].dtype == ml_dtypes.float8_e4m3fn
+        assert latents[0].nbytes == np.prod(latents[0].shape)  # 1 B/elt
+        engine_b.flush(1)
+        engine_b.restore_kv([1], [prompt], [latents[0]])
+        dec_b, _ = engine_b.put([1], [[nxt]])
+        np.testing.assert_allclose(
+            np.asarray(dec_b[0], np.float32),
+            np.asarray(dec_a[0], np.float32), atol=0.15)
+
+    def test_restore_admission_is_atomic(self, tiny_model):
+        """A restore that cannot fully fit must not touch any state."""
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(14)
+        prompts = [list(rng.integers(0, cfg.vocab_size, (8,)))
+                   for _ in range(9)]
+        engine = make_engine(cfg, params)          # limit: 8 tracked
+        _, latents = engine.put([0], [prompts[0]])
+        engine.flush(0)
+        free0 = engine.state.free_blocks
+        with pytest.raises(SchedulingError):
+            engine.restore_kv(list(range(9)), prompts,
+                              [latents[0]] * 9)
+        assert engine.state.n_tracked_sequences == 0
+        assert engine.state.free_blocks == free0
