@@ -49,6 +49,29 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def _sample_host(row, rng, temperature, top_k, top_p):
+    """Host-side token sampler (greedy / temperature / top-k / nucleus) —
+    shared by generate()'s step loop and generate_fused()'s first token."""
+    if temperature <= 0:
+        return int(np.argmax(row))
+    logits = row.astype(np.float64) / temperature
+    k = min(top_k, len(logits))
+    if k > 0:
+        kth = np.partition(logits, -k)[-k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    if top_p < 1.0:
+        # nucleus: smallest prob-sorted set with mass >= top_p
+        order = np.argsort(p)[::-1]
+        keep_sorted = np.cumsum(p[order]) - p[order] < top_p
+        keep = np.zeros_like(p, dtype=bool)
+        keep[order] = keep_sorted
+        p = np.where(keep, p, 0.0)
+        p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
 class InferenceEngineV2:
 
     def __init__(self, model_config, params,
@@ -346,24 +369,7 @@ class InferenceEngineV2:
         uids = [base + i for i in range(len(prompts))]
 
         def sample(row):
-            if temperature <= 0:
-                return int(np.argmax(row))
-            logits = row.astype(np.float64) / temperature
-            k = min(top_k, len(logits))
-            if k > 0:
-                kth = np.partition(logits, -k)[-k]
-                logits = np.where(logits < kth, -np.inf, logits)
-            p = np.exp(logits - logits.max())
-            p /= p.sum()
-            if top_p < 1.0:
-                # nucleus: smallest prob-sorted set with mass >= top_p
-                order = np.argsort(p)[::-1]
-                keep_sorted = np.cumsum(p[order]) - p[order] < top_p
-                keep = np.zeros_like(p, dtype=bool)
-                keep[order] = keep_sorted
-                p = np.where(keep, p, 0.0)
-                p /= p.sum()
-            return int(rng.choice(len(p), p=p))
+            return _sample_host(row, rng, temperature, top_k, top_p)
 
         outs = [[] for _ in prompts]
         logit_trace = [[] for _ in prompts]
@@ -468,18 +474,26 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     @_annotated("hds.serve.generate_fused")
     def generate_fused(self, prompts, max_new_tokens: int = 32,
-                       eos_token_id: int = None):
-        """Greedy batched generation with on-device token feedback.
+                       eos_token_id: int = None, temperature: float = 0.0,
+                       top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+        """Batched generation with on-device token feedback.
 
         Prefill runs through :meth:`put` (capturing latents as usual);
         the decode stretch then runs as ONE jitted ``lax.scan`` — the
-        argmax token feeds the next step on device, so the host syncs
-        once per *generation*, not once per token. KV blocks for the
-        whole stretch are reserved up front. Greedy only (sampling needs
-        the host-driven :meth:`generate`). Returns ``(outs, latents)``
-        where ``latents[i]`` covers prompt + fed tokens (None when
-        latent capture is off) — a returning sequence can be HCache-
-        restored from them after a flush."""
+        sampled token (greedy argmax when temperature<=0, else
+        temperature/top-k/top-p via a threaded PRNG key) feeds the next
+        step on device, so the host syncs once per *generation*, not
+        once per token. temperature/top_p are traced (per-request values
+        reuse the compiled program); only the sampling MODE, top_k and
+        n_steps recompile. KV blocks for the whole stretch are reserved
+        up front. Returns ``(outs, latents)`` where ``latents[i]``
+        covers prompt + fed tokens (None when latent capture is off) —
+        a returning sequence can be HCache-restored from them after a
+        flush."""
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         base = max(self.state._seqs.keys(), default=-1) + 1
         uids = [base + i for i in range(len(prompts))]
         n_feed = max_new_tokens - 1   # tokens fed (and cached) on device
@@ -499,7 +513,10 @@ class InferenceEngineV2:
             raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
         try:
             logits, latents = self.put(uids, prompts)
-            first = np.argmax(logits, axis=-1).astype(np.int32)   # [n]
+            host_rng = np.random.default_rng(seed)
+            first = np.asarray(
+                [_sample_host(row, host_rng, temperature, top_k, top_p)
+                 for row in logits], np.int32)                    # [n]
             outs = [[int(t)] for t in first]
             if n_feed > 0:
                 n = len(uids)
@@ -513,7 +530,9 @@ class InferenceEngineV2:
                     t_len[j] = 1
                 tables[:n] = self._tables(list(range(n)), uids)
                 toks, lats = self.model.decode_loop(
-                    self.cache, tok[:, 0], start, t_len, tables, n_feed)
+                    self.cache, tok[:, 0], start, t_len, tables, n_feed,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=seed)
                 for j, uid in enumerate(uids):
                     self.state.get_sequence(uid).post_forward()
                     outs[j].extend(int(t) for t in toks[:, j])

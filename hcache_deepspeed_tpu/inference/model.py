@@ -140,7 +140,7 @@ class PagedInferenceModel:
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
         self._decode_loop_jit = jax.jit(self._decode_loop,
-                                        static_argnums=(7,),
+                                        static_argnums=(10, 11, 12, 13),
                                         donate_argnums=(1, 2))
 
     def load_params(self, params):
@@ -562,36 +562,77 @@ class PagedInferenceModel:
     # -------------------------------------------------------------- #
     # Fused decode loop: N greedy steps in ONE device program
     # -------------------------------------------------------------- #
+    @staticmethod
+    def _sample_logits(logits, key, temperature, top_p, greedy, top_k,
+                       use_top_p):
+        """On-device sampling — the device-side mirror of the host
+        sampler (``engine_v2._sample_host``). ``greedy``/``top_k``/
+        ``use_top_p`` are static (they shape the program); ``temperature``
+        and ``top_p`` are traced scalars so per-request values don't
+        recompile the decode stretch."""
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        l = logits.astype(jnp.float32) / temperature
+        k = min(top_k, l.shape[-1])
+        if k > 0:
+            kth = jax.lax.top_k(l, k)[0][..., -1:]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        if use_top_p:
+            # nucleus: keep the smallest prob-sorted set with mass>=top_p
+            # (count-based keep scattered back through the sort order —
+            # a probability threshold would keep every boundary TIE and
+            # diverge from the host sampler)
+            p = jax.nn.softmax(l, axis=-1)
+            order = jnp.argsort(p, axis=-1,
+                                descending=True)            # [B, V]
+            sp = jnp.take_along_axis(p, order, axis=-1)
+            keep_sorted = (jnp.cumsum(sp, axis=-1) - sp) < top_p
+            rows = jnp.arange(l.shape[0])[:, None]
+            keep = jnp.zeros(l.shape, bool).at[rows, order].set(
+                keep_sorted)
+            l = jnp.where(keep, l, -jnp.inf)
+        return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
     def _decode_loop(self, params, cache_k, cache_v, tokens, start, tables,
-                     t_len, n_steps):
+                     t_len, rng_key, temperature, top_p, n_steps, greedy,
+                     top_k, use_top_p):
         """``lax.scan`` over ``n_steps`` single-token forwards with the
-        sampled (greedy argmax) token fed back on device — no host
-        round-trip per generated token. The reference's engine (like
-        every GPU serving stack) pays a host sync per step to route the
-        next batch; on TPU the idiomatic serving shape compiles the whole
-        decode stretch so the chip never waits on the host.
+        sampled token fed back on device — no host round-trip per
+        generated token. The reference's engine (like every GPU serving
+        stack) pays a host sync per step to route the next batch; on TPU
+        the idiomatic serving shape compiles the whole decode stretch so
+        the chip never waits on the host.
 
         tokens: [B] the first token each lane feeds; start: [B] its
         position; t_len: [B] 1 for live lanes, 0 for padded lanes (their
-        writes drop, their outputs are discarded). Returns
+        writes drop, their outputs are discarded). Sampling params are
+        static (greedy argmax when temperature<=0). Returns
         (cache_k', cache_v', tokens_out [n_steps, B],
         latents [n_steps, L, B, 1, H])."""
         def step(carry, _):
-            ck, cv, toks, pos = carry
+            ck, cv, toks, pos, key = carry
             ck, cv, logits, latents = self._fwd_inner(
                 params, ck, cv, toks[:, None], pos, tables, t_len)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (ck, cv, nxt, pos + t_len), (nxt, latents)
+            key, sub = jax.random.split(key)
+            nxt = self._sample_logits(logits, sub, temperature, top_p,
+                                      greedy, top_k, use_top_p)
+            return (ck, cv, nxt, pos + t_len, key), (nxt, latents)
 
-        (cache_k, cache_v, _, _), (toks, lats) = jax.lax.scan(
-            step, (cache_k, cache_v, tokens, start), None, length=n_steps)
+        (cache_k, cache_v, _, _, _), (toks, lats) = jax.lax.scan(
+            step, (cache_k, cache_v, tokens, start, rng_key), None,
+            length=n_steps)
         return cache_k, cache_v, toks, lats
 
-    def decode_loop(self, cache, tokens, start, t_len, tables, n_steps):
+    def decode_loop(self, cache, tokens, start, t_len, tables, n_steps,
+                    temperature=0.0, top_k=0, top_p=1.0, seed=0):
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         ck, cv, toks, lats = self._decode_loop_jit(
             self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
-            jnp.asarray(t_len, jnp.int32), int(n_steps))
+            jnp.asarray(t_len, jnp.int32), jax.random.PRNGKey(seed),
+            jnp.float32(max(temperature, 1e-6)), jnp.float32(top_p),
+            int(n_steps), temperature <= 0, int(top_k), top_p < 1.0)
         cache.replace(ck, cv)
         return np.asarray(toks), lats
 

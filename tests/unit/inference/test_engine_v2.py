@@ -412,3 +412,54 @@ class TestRestoreChunking:
                               [latents[0]] * 9)
         assert engine.state.n_tracked_sequences == 0
         assert engine.state.free_blocks == free0
+
+
+class TestFusedSampling:
+    """On-device sampling in the fused decode loop."""
+
+    def test_topk1_equals_greedy(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        rng = np.random.default_rng(15)
+        prompt = list(rng.integers(0, cfg.vocab_size, (5,)))
+        greedy, _ = engine.generate_fused([prompt], max_new_tokens=6)
+        topk1, _ = engine.generate_fused([prompt], max_new_tokens=6,
+                                         temperature=0.7, top_k=1)
+        assert topk1 == greedy
+
+    def test_seed_reproducible_and_varies(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        rng = np.random.default_rng(16)
+        prompt = list(rng.integers(0, cfg.vocab_size, (5,)))
+        kw = dict(max_new_tokens=8, temperature=1.5, top_p=0.9)
+        a, _ = engine.generate_fused([prompt], seed=1, **kw)
+        b, _ = engine.generate_fused([prompt], seed=1, **kw)
+        assert a == b
+        seeds = [engine.generate_fused([prompt], seed=s, **kw)[0]
+                 for s in range(2, 8)]
+        assert any(s != a for s in seeds)
+
+    def test_sampled_tokens_stay_in_nucleus(self, tiny_model):
+        """With tight top_p every sampled token must be in the nucleus
+        of the reference distribution at its step."""
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        rng = np.random.default_rng(17)
+        prompt = list(rng.integers(0, cfg.vocab_size, (6,)))
+        outs, _ = engine.generate_fused([prompt], max_new_tokens=5,
+                                        temperature=1.0, top_p=0.5,
+                                        seed=3)
+        seq = list(prompt)
+        for tok in outs[0]:
+            ref = full_logits(model, params, seq)[-1].astype(np.float64)
+            p = np.exp(ref - ref.max())
+            p /= p.sum()
+            order = np.argsort(p)[::-1]
+            keep = np.cumsum(p[order]) - p[order] < 0.5
+            nucleus = set(order[keep].tolist())
+            assert tok in nucleus
+            seq.append(tok)
