@@ -71,6 +71,15 @@ CONFIGS = {
                                   loss_chunk=256),
     "350m-hd128-lchunk-b32": dict(batch=32, n_head=8, vocab_size=50304,
                                   loss_chunk=256),
+    # long-context points (FPDT/Ulysses story: BASELINE row 2's 55% MFU
+    # bar), remat on; tokens/step = batch*seq (8k and 16k — NOT equal,
+    # compare MFU, not tokens/sec)
+    "350m-hd128-lchunk-seq4k-b2": dict(batch=2, seq=4096, n_head=8,
+                                       vocab_size=50304, loss_chunk=256,
+                                       remat=True),
+    "350m-hd128-lchunk-seq16k-b1": dict(batch=1, seq=16384, n_head=8,
+                                        vocab_size=50304, loss_chunk=256,
+                                        remat=True),
     "350m-hd128-b16": dict(batch=16, n_head=8, vocab_size=50304,
                            loss_chunk=0),
     "350m-vpad-b8": dict(batch=8, n_head=16, vocab_size=50304,
@@ -136,10 +145,10 @@ def run_config(name):
                           vocab_size=256, dtype="bfloat16", remat=False)
     else:
         spec = CONFIGS[name]
-        batch, seq = spec["batch"], 1024
+        batch, seq = spec["batch"], spec.get("seq", 1024)
         mcfg = GPT2Config(n_layer=24, n_embd=1024, n_head=spec["n_head"],
                           n_positions=seq, vocab_size=spec["vocab_size"],
-                          dtype="bfloat16", remat=False,
+                          dtype="bfloat16", remat=spec.get("remat", False),
                           loss_chunk=spec["loss_chunk"])
     model = GPT2LMHeadModel(mcfg)
     rng = np.random.default_rng(0)
@@ -194,6 +203,7 @@ def run_config(name):
         "extra": {
             "config": "tiny" if os.environ.get("HDS_BENCH_TINY") == "1"
                       else name,
+            "seq": seq,
             "mfu": round(mfu, 4),
             "achieved_tflops": round(achieved_tflops, 2),
             "peak_tflops": peak,

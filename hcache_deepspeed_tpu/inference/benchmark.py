@@ -30,6 +30,7 @@ def _model_params(model_size: str, max_context: int):
     new engine's init spike lands while the previous engine's weights
     are still resident)."""
     import jax
+    import jax.numpy as jnp
 
     from ..models.llama import LlamaConfig, LlamaForCausalLM
 
@@ -61,8 +62,13 @@ def _model_params(model_size: str, max_context: int):
         os.environ["HDS_DISABLE_PALLAS"] = "1"   # tracing on the host
         try:
             with ctx:
+                # cast to the serving dtype ON HOST: the engine casts
+                # anyway, and shipping fp32 doubles the host->device
+                # bytes (minutes of wall clock for 7B on a slow link)
                 params = jax.tree.map(
-                    np.asarray,
+                    lambda p: np.asarray(
+                        p.astype(cfg.compute_dtype)
+                        if jnp.issubdtype(p.dtype, jnp.floating) else p),
                     model.init(jax.random.PRNGKey(0), batch_init,
                                train=False)["params"])
         finally:
@@ -76,7 +82,7 @@ def _model_params(model_size: str, max_context: int):
 
 def _engine(model_size: str, max_context: int, batch: int,
             quantize: str = "", prefill_chunk: int = 0,
-            latents: bool = False, latent_dtype: str = "bfloat16"):
+            latents: bool = False, latent_dtype: str = ""):
     from .config import RaggedInferenceEngineConfig
     from .engine_v2 import InferenceEngineV2
 
@@ -105,7 +111,7 @@ def _engine(model_size: str, max_context: int, batch: int,
 
 def run_restore(model_size="tiny", max_context=512, prompt_len=128,
                 batches=(1, 4), quantize="", prefill_chunk=0,
-                latent_dtype="bfloat16"):
+                latent_dtype=""):
     """HCache headline: time-to-cache-ready for a returning sequence —
     ``restore_kv`` (QKV-only replay from saved latents) vs a full prefill
     recompute. This is the fork's distinctive capability
@@ -287,7 +293,7 @@ def main(argv=None):
                         "the int8-weight Pallas kernel")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="Dynamic-SplitFuse chunk size (0 = off)")
-    p.add_argument("--latent-dtype", default="bfloat16",
+    p.add_argument("--latent-dtype", default="",
                    help="HCache latent capture dtype (e.g. "
                         "float8_e4m3fn halves host-link bytes)")
     p.add_argument("--restore", action="store_true",

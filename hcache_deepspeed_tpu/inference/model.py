@@ -627,6 +627,8 @@ class PagedInferenceModel:
                     temperature=0.0, top_k=0, top_p=1.0, seed=0):
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         ck, cv, toks, lats = self._decode_loop_jit(
             self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
