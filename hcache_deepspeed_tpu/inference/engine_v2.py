@@ -49,6 +49,14 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def _logsumexp_rows(logits):
+    """Row-wise logsumexp, keepdims (fp64 host math for the first-token
+    logprob — the decode-loop tokens get theirs on device)."""
+    x = logits.astype(np.float64)
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
 def _sample_host(row, rng, temperature, top_k, top_p):
     """Host-side token sampler (greedy / temperature / top-k / nucleus) —
     shared by generate()'s step loop and generate_fused()'s first token."""
@@ -475,7 +483,8 @@ class InferenceEngineV2:
     @_annotated("hds.serve.generate_fused")
     def generate_fused(self, prompts, max_new_tokens: int = 32,
                        eos_token_id: int = None, temperature: float = 0.0,
-                       top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                       top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                       return_logprobs: bool = False):
         """Batched generation with on-device token feedback.
 
         Prefill runs through :meth:`put` (capturing latents as usual);
@@ -486,7 +495,9 @@ class InferenceEngineV2:
         once per token. temperature/top_p are traced (per-request values
         reuse the compiled program); only the sampling MODE, top_k and
         n_steps recompile. KV blocks for the whole stretch are reserved
-        up front. Returns ``(outs, latents)`` where ``latents[i]``
+        up front. Returns ``(outs, latents)`` — or ``(outs, latents,
+        logprobs)`` with per-generated-token raw-model logprobs (RLHF
+        consumers) when ``return_logprobs`` — where ``latents[i]``
         covers prompt + fed tokens (None when latent capture is off) —
         a returning sequence can be HCache-restored from them after a
         flush."""
@@ -518,6 +529,11 @@ class InferenceEngineV2:
                 [_sample_host(row, host_rng, temperature, top_k, top_p)
                  for row in logits], np.int32)                    # [n]
             outs = [[int(t)] for t in first]
+            logprobs = None
+            if return_logprobs:
+                lse = _logsumexp_rows(logits)
+                logprobs = [[float(logits[j, first[j]] - lse[j, 0])]
+                            for j in range(len(uids))]
             if n_feed > 0:
                 n = len(uids)
                 tok, start, t_len, tables = self._blank_lanes(_bucket(n))
@@ -529,13 +545,15 @@ class InferenceEngineV2:
                     start[j] = seq.seen_tokens
                     t_len[j] = 1
                 tables[:n] = self._tables(list(range(n)), uids)
-                toks, lats = self.model.decode_loop(
+                toks, lats, lps = self.model.decode_loop(
                     self.cache, tok[:, 0], start, t_len, tables, n_feed,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    seed=seed)
+                    seed=seed, want_logprobs=return_logprobs)
                 for j, uid in enumerate(uids):
                     self.state.get_sequence(uid).post_forward()
                     outs[j].extend(int(t) for t in toks[:, j])
+                    if return_logprobs:
+                        logprobs[j].extend(float(x) for x in lps[:, j])
                 if self.config.hcache.enable_latents:
                     # slice to live lanes on device: padded bucket lanes
                     # would otherwise ride the D2H copy
@@ -552,11 +570,16 @@ class InferenceEngineV2:
             for j, o in enumerate(outs):
                 if eos_token_id in o:
                     outs[j] = o[:o.index(eos_token_id) + 1]
+                    if return_logprobs:
+                        logprobs[j] = logprobs[j][:len(outs[j])]
                     if latents[j] is not None:
                         # keep the restore contract: latents cover
                         # prompt + fed tokens = prompt + len(outs)-1
                         latents[j] = latents[j][
                             :, :len(prompts[j]) + len(outs[j]) - 1]
+        if return_logprobs:
+            return outs, latents, [np.asarray(l, np.float32)
+                                   for l in logprobs]
         return outs, latents
 
     # -------------------------------------------------------------- #

@@ -140,7 +140,8 @@ class PagedInferenceModel:
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
         self._decode_loop_jit = jax.jit(self._decode_loop,
-                                        static_argnums=(10, 11, 12, 13),
+                                        static_argnums=(10, 11, 12, 13,
+                                                        14),
                                         donate_argnums=(1, 2))
 
     def load_params(self, params):
@@ -595,7 +596,7 @@ class PagedInferenceModel:
 
     def _decode_loop(self, params, cache_k, cache_v, tokens, start, tables,
                      t_len, rng_key, temperature, top_p, n_steps, greedy,
-                     top_k, use_top_p):
+                     top_k, use_top_p, want_logprobs):
         """``lax.scan`` over ``n_steps`` single-token forwards with the
         sampled token fed back on device — no host round-trip per
         generated token. The reference's engine (like every GPU serving
@@ -616,27 +617,39 @@ class PagedInferenceModel:
             key, sub = jax.random.split(key)
             nxt = self._sample_logits(logits, sub, temperature, top_p,
                                       greedy, top_k, use_top_p)
-            return (ck, cv, nxt, pos + t_len, key), (nxt, latents)
+            ys = (nxt, latents)
+            if want_logprobs:
+                # raw-model logprob of the chosen token (RLHF consumers)
+                lsm = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                         axis=-1)
+                ys += (jnp.take_along_axis(lsm, nxt[:, None],
+                                           axis=-1)[:, 0],)
+            return (ck, cv, nxt, pos + t_len, key), ys
 
-        (cache_k, cache_v, _, _, _), (toks, lats) = jax.lax.scan(
+        (cache_k, cache_v, _, _, _), ys = jax.lax.scan(
             step, (cache_k, cache_v, tokens, start, rng_key), None,
             length=n_steps)
-        return cache_k, cache_v, toks, lats
+        toks, lats = ys[0], ys[1]
+        lps = ys[2] if want_logprobs else None
+        return cache_k, cache_v, toks, lats, lps
 
     def decode_loop(self, cache, tokens, start, t_len, tables, n_steps,
-                    temperature=0.0, top_k=0, top_p=1.0, seed=0):
+                    temperature=0.0, top_k=0, top_p=1.0, seed=0,
+                    want_logprobs=False):
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        ck, cv, toks, lats = self._decode_loop_jit(
+        ck, cv, toks, lats, lps = self._decode_loop_jit(
             self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
             jnp.asarray(t_len, jnp.int32), jax.random.PRNGKey(seed),
             jnp.float32(max(temperature, 1e-6)), jnp.float32(top_p),
-            int(n_steps), temperature <= 0, int(top_k), top_p < 1.0)
+            int(n_steps), temperature <= 0, int(top_k), top_p < 1.0,
+            bool(want_logprobs))
         cache.replace(ck, cv)
-        return np.asarray(toks), lats
+        return (np.asarray(toks), lats,
+                np.asarray(lps) if lps is not None else None)
 
     def _restore_chunk(self, params, cache_k, cache_v, layer0, lat_chunk,
                        start, tables, t_len):

@@ -466,3 +466,22 @@ class TestFusedSampling:
             nucleus = set(order[keep].tolist())
             assert tok in nucleus
             seq.append(tok)
+
+    def test_fused_logprobs_match_reference(self, tiny_model):
+        """Per-token logprobs from the fused loop == log-softmax of the
+        reference forward at each position (greedy path)."""
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        rng = np.random.default_rng(18)
+        prompt = list(rng.integers(0, cfg.vocab_size, (7,)))
+        outs, _, lps = engine.generate_fused([prompt], max_new_tokens=5,
+                                             return_logprobs=True)
+        assert lps[0].shape == (5,)
+        seq = list(prompt)
+        for tok, lp in zip(outs[0], lps[0]):
+            ref = full_logits(model, params, seq)[-1].astype(np.float64)
+            ref_lp = ref[tok] - (np.log(np.exp(ref - ref.max()).sum())
+                                 + ref.max())
+            np.testing.assert_allclose(lp, ref_lp, atol=5e-2)
+            seq.append(tok)
