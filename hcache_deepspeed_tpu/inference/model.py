@@ -606,10 +606,11 @@ class PagedInferenceModel:
 
         tokens: [B] the first token each lane feeds; start: [B] its
         position; t_len: [B] 1 for live lanes, 0 for padded lanes (their
-        writes drop, their outputs are discarded). Sampling params are
-        static (greedy argmax when temperature<=0). Returns
-        (cache_k', cache_v', tokens_out [n_steps, B],
-        latents [n_steps, L, B, 1, H])."""
+        writes drop, their outputs are discarded). greedy/top_k/
+        use_top_p/want_logprobs are static; temperature/top_p traced.
+        Returns (cache_k', cache_v', tokens_out [n_steps, B],
+        latents [n_steps, L, B, 1, H], logprobs [n_steps, B] or None
+        when want_logprobs is off)."""
         def step(carry, _):
             ck, cv, toks, pos, key = carry
             ck, cv, logits, latents = self._fwd_inner(
