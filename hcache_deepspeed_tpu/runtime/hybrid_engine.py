@@ -107,6 +107,18 @@ class HybridEngine:
         (reference: hybrid_engine.generate — gather, generate, scatter)."""
         return self._ensure_infer().generate(prompts, **kw)
 
+    def generate_fused(self, prompts: List[List[int]], **kw):
+        """Rollout fast path: the whole decode stretch in one device
+        program (see ``InferenceEngineV2.generate_fused``). With
+        ``return_logprobs=True`` this is the PPO rollout primitive —
+        actions + per-token RAW-MODEL logprobs (log-softmax of the
+        unscaled logits; at temperature 1 with no top-k/top-p cuts this
+        equals the behavior policy, otherwise correct for the sampling
+        transform before using them as log π_old) against the current
+        training weights, with one host sync for the whole decode
+        stretch."""
+        return self._ensure_infer().generate_fused(prompts, **kw)
+
     def eval_batch(self, *a, **kw):
         return self.engine.eval_batch(*a, **kw)
 

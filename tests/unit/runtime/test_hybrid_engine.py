@@ -146,3 +146,27 @@ class TestHybridEngine:
         loss = float(hybrid.train_batch(batch=batch))
         assert np.isfinite(loss)
         assert hybrid.global_steps == 1  # __getattr__ delegation
+
+
+class TestFusedRollout:
+    def test_fused_rollout_with_logprobs(self, eight_devices):
+        """PPO rollout primitive: actions + behavior logprobs in one
+        device program against the current training weights; training a
+        step then rolling out again reflects the new weights."""
+        mcfg = llama_tiny(max_positions=128)
+        engine, batch = _train_engine(mcfg)
+        hybrid = HybridEngine(engine, mcfg,
+                              inference_config=_infer_config())
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+        outs, _, lps = hybrid.generate_fused(
+            prompts, max_new_tokens=4, return_logprobs=True)
+        assert len(outs) == 2 and all(len(o) == 4 for o in outs)
+        for lp in lps:
+            assert lp.shape == (4,) and np.all(lp <= 0)
+        # matches the host-driven greedy path on the same weights
+        host = hybrid.generate(prompts, max_new_tokens=4)
+        assert outs == host
+        for _ in range(4):
+            hybrid.train_batch(batch=batch)
+        outs2, _ = hybrid.generate_fused(prompts, max_new_tokens=4)
+        assert outs2 != outs   # weights moved
