@@ -71,6 +71,14 @@ CONFIGS = {
                                   loss_chunk=256),
     "350m-hd128-lchunk-b32": dict(batch=32, n_head=8, vocab_size=50304,
                                   loss_chunk=256),
+    # flash-kernel tiling variants of the winner (vet on chip)
+    "350m-hd128-lchunk-b8-blk256": dict(batch=8, n_head=8,
+                                        vocab_size=50304, loss_chunk=256,
+                                        block_q=256, block_k=256),
+    "350m-hd128-lchunk-b8-blk1024k": dict(batch=8, n_head=8,
+                                          vocab_size=50304,
+                                          loss_chunk=256, block_q=512,
+                                          block_k=1024),
     # long-context points (FPDT/Ulysses story: BASELINE row 2's 55% MFU
     # bar), remat on; tokens/step = batch*seq (8k and 16k — NOT equal,
     # compare MFU, not tokens/sec)
@@ -149,7 +157,9 @@ def run_config(name):
         mcfg = GPT2Config(n_layer=24, n_embd=1024, n_head=spec["n_head"],
                           n_positions=seq, vocab_size=spec["vocab_size"],
                           dtype="bfloat16", remat=spec.get("remat", False),
-                          loss_chunk=spec["loss_chunk"])
+                          loss_chunk=spec["loss_chunk"],
+                          flash_block_q=spec.get("block_q", 0),
+                          flash_block_k=spec.get("block_k", 0))
     model = GPT2LMHeadModel(mcfg)
     rng = np.random.default_rng(0)
     # clamp below every config's vocab so the sampled batch is identical

@@ -36,6 +36,10 @@ class GPT2Config:
     #: still bounding live activations); implies remat when set
     remat_policy: str = ""
     use_flash: bool = True
+    #: flash kernel tile sizes (0 = kernel default of 512); bench-vetted
+    #: per shape — exposed so configs can tune MXU occupancy vs VMEM
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     #: > 0: compute the LM loss in sequence chunks of this size without
     #: materializing the full [B, T, V] fp32 logits (FPDT chunked-loss
     #: trade: one extra head GEMM per chunk in backward)
@@ -91,7 +95,9 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, H, C // H)
         use_dropout = train and cfg.dropout > 0
         if cfg.use_flash and mask is None and not use_dropout:
-            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+            y = flash_attention(q, k, v, causal=True,
+                                block_q=cfg.flash_block_q,
+                                block_k=cfg.flash_block_k).reshape(B, T, C)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
                 C // H).astype(x.dtype)

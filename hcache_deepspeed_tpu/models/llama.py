@@ -53,6 +53,10 @@ class LlamaConfig:
     #: see GPT2Config.remat_policy)
     remat_policy: str = ""
     use_flash: bool = True
+    #: flash kernel tile sizes (0 = kernel default; see
+    #: GPT2Config.flash_block_q)
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     #: biases on q/k/v projections (qwen / qwen1.5-style; llama: False)
     attention_bias: bool = False
     #: > 0: chunked LM loss — no full [B, T, V] fp32 logits (see
@@ -136,7 +140,9 @@ class LlamaAttention(nn.Module):
         elif cfg.use_flash:
             # GQA-native: the kernel's index map shares kv blocks across
             # each query-head group — no repeat, KV HBM reads drop H/KV x
-            y = flash_attention(q, k, v, causal=True)
+            y = flash_attention(q, k, v, causal=True,
+                                block_q=cfg.flash_block_q,
+                                block_k=cfg.flash_block_k)
         else:
             from ..ops.flash_attention import reference_attention
             y = reference_attention(q, k, v, causal=True)

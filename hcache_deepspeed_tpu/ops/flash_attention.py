@@ -47,10 +47,12 @@ def _fit_block(block, seq_len):
 # ------------------------------------------------------------------ #
 # Reference implementation (always available; CPU/debug path)
 # ------------------------------------------------------------------ #
-def reference_attention(q, k, v, causal=True, scale=None):
+def reference_attention(q, k, v, causal=True, scale=None, **_tiling):
     """[B, T, H, D] in/out, plain jnp (XLA-fused) attention. GQA: k/v may
     carry fewer heads (KV divides H) — they broadcast to the query
-    heads."""
+    heads. Kernel-tiling kwargs (block_q/block_k) are accepted and
+    ignored — there are no blocks here, and the dispatcher forwards them
+    unconditionally."""
     B, T, H, D = q.shape
     if k.shape[2] != H:   # GQA/MQA: expand kv heads
         rep = H // k.shape[2]
@@ -358,10 +360,19 @@ def pallas_attention(q, k, v, causal=True, scale=None, block_q=512,
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
 
 
-def attention(q, k, v, causal=True, scale=None):
-    """Dispatching entry point: Pallas on TPU, reference elsewhere."""
+def attention(q, k, v, causal=True, scale=None, block_q=None,
+              block_k=None):
+    """Dispatching entry point: Pallas on TPU, reference elsewhere.
+    ``block_q``/``block_k`` tune the kernel tiling (ignored on the
+    reference path, which has no blocks)."""
     from . import get_op
-    return get_op("flash_attention")(q, k, v, causal=causal, scale=scale)
+    kw = {}
+    if block_q:
+        kw["block_q"] = block_q
+    if block_k:
+        kw["block_k"] = block_k
+    return get_op("flash_attention")(q, k, v, causal=causal, scale=scale,
+                                     **kw)
 
 
 # both paths accept compact GQA k/v (KV heads < q heads) natively —

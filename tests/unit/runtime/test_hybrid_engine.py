@@ -159,7 +159,8 @@ class TestFusedRollout:
                               inference_config=_infer_config())
         prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
         outs, _, lps = hybrid.generate_fused(
-            prompts, max_new_tokens=4, return_logprobs=True)
+            prompts, max_new_tokens=4, temperature=0.0,
+            return_logprobs=True)
         assert len(outs) == 2 and all(len(o) == 4 for o in outs)
         for lp in lps:
             assert lp.shape == (4,) and np.all(lp <= 0)
