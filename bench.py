@@ -72,10 +72,10 @@ CONFIGS = {
     "350m-hd128-lchunk-b32": dict(batch=32, n_head=8, vocab_size=50304,
                                   loss_chunk=256),
     # flash-kernel tiling variants of the winner (vet on chip)
-    "350m-hd128-lchunk-b8-blk256": dict(batch=8, n_head=8,
+    "350m-hd128-lchunk-b8-blk256x256": dict(batch=8, n_head=8,
                                         vocab_size=50304, loss_chunk=256,
                                         block_q=256, block_k=256),
-    "350m-hd128-lchunk-b8-blk1024k": dict(batch=8, n_head=8,
+    "350m-hd128-lchunk-b8-blk512x1024": dict(batch=8, n_head=8,
                                           vocab_size=50304,
                                           loss_chunk=256, block_q=512,
                                           block_k=1024),

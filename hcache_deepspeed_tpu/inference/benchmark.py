@@ -14,13 +14,21 @@ CLI: ``bin/hds_serve_bench`` (JSON lines, one per measurement).
 """
 
 import argparse
+import functools
 import json
 import time
 
 import numpy as np
 
+from .scheduling import SchedulingError, SchedulingResult
 
 _PARAM_CACHE = {}
+
+
+def _emit(results, row):
+    # append + stream one result row (partial results survive a crash)
+    results.append(row)
+    print(json.dumps(row), flush=True)
 
 
 def _model_params(model_size: str, max_context: int):
@@ -126,10 +134,7 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
     loop — that cost belongs to the *first* pass, not the re-prefill
     being compared against)."""
     results = []
-
-    def emit(row):
-        results.append(row)
-        print(json.dumps(row), flush=True)
+    emit = functools.partial(_emit, results)
 
     rng = np.random.default_rng(0)
     for batch in batches:
@@ -193,6 +198,120 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
     return results
 
 
+def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
+              max_new=32, rates=(1.0, 2.0, 4.0), n_requests=16,
+              max_batch=8, seed=0, quantize="", prefill_chunk=0):
+    """Throughput-latency curve under open-loop Poisson arrivals — the
+    FastGen headline benchmark shape (reference:
+    ``blogs/deepspeed-fastgen/README.md`` throughput vs latency at a
+    token-rate SLA). For each offered request rate: requests arrive on
+    a Poisson clock, are admitted into the continuous ragged batch as
+    KV blocks allow, and decode to ``max_new`` tokens; reports
+    effective rps, time-to-first-token and end-to-end latency
+    percentiles, and generated tokens/sec."""
+    results = []
+    emit = functools.partial(_emit, results)
+
+    cfg, eng = _engine(model_size, max_context, max_batch,
+                       quantize=quantize, prefill_chunk=prefill_chunk)
+    rng = np.random.default_rng(seed)
+    if prompt_len + max_new - 1 > min(max_context, cfg.max_positions):
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new {max_new} exceeds "
+            f"max_context {max_context}")
+
+    def percentile(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)), 3)
+
+    for rps in rates:
+        prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+                   for _ in range(n_requests)]
+        arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
+        # warm EVERY program shape off-clock: prefill lane buckets
+        # {1, 2, 4, ...} up to max_batch (admission can batch that many
+        # prefills into one dispatch) plus the ragged decode batch — a
+        # compile landing inside the timed loop would corrupt the
+        # latency percentiles for that rate (and flatter later rates)
+        b = 1
+        while b <= max_batch:
+            warm_uids = list(range(b))
+            eng.put(warm_uids, [prompts[0]] * b)
+            if b == 1:
+                eng.put([0], [[1]])           # decode shape
+            for u in warm_uids:
+                eng.flush(u)
+            b *= 2
+
+        state = {}      # i -> dict(start, first=None, end=None, left, tok)
+        pending = list(range(n_requests))
+        active = []
+        t0 = time.perf_counter()
+        while pending or active:
+            now = time.perf_counter() - t0
+            # admit arrived requests that fit (block budget, batch cap)
+            admit = []
+            for i in list(pending):
+                if arrive[i] > now or len(active) + len(admit) >= max_batch:
+                    break
+                cand = active + admit + [i]
+                # budget the WHOLE stretch (prompt + decode tokens) at
+                # admission, like generate(): a request admitted on
+                # prefill-only arithmetic could run out of blocks or
+                # context mid-decode and abort the sweep
+                lens = [1] * len(active) + \
+                    [len(prompts[j]) + max_new - 1 for j in admit + [i]]
+                if eng.can_schedule([100 + j for j in cand], lens) != \
+                        SchedulingResult.Success:
+                    break
+                admit.append(i)
+            if not active and not admit:
+                if arrive[pending[0]] <= now:
+                    # first arrived request can never fit — surface it
+                    raise SchedulingError(eng.can_schedule(
+                        [100 + pending[0]], [len(prompts[pending[0]])]))
+                # idle until the next arrival
+                time.sleep(max(0.0, arrive[pending[0]] -
+                               (time.perf_counter() - t0)))
+                continue
+            for i in admit:
+                pending.remove(i)
+                state[i] = {"start": arrive[i], "first": None,
+                            "end": None, "left": max_new, "tok": None}
+            step = active + admit
+            toks = [[state[i]["tok"]] if i in active else prompts[i]
+                    for i in step]
+            step_logits, _ = eng.put([100 + i for i in step], toks)
+            now = time.perf_counter() - t0
+            finished = []
+            for j, i in enumerate(step):
+                st = state[i]
+                if st["first"] is None:
+                    st["first"] = now - st["start"]   # TTFT
+                st["tok"] = int(np.argmax(step_logits[j]))
+                st["left"] -= 1
+                if st["left"] <= 0:
+                    st["end"] = now - st["start"]
+                    finished.append(i)
+            for i in finished:
+                eng.flush(100 + i)
+            active = [i for i in step if i not in finished]
+
+        makespan = max(s["end"] + s["start"] for s in state.values())
+        emit({"phase": "sweep", "offered_rps": rps,
+              "effective_rps": round(n_requests / makespan, 3),
+              "ttft_s": {"p50": percentile(
+                  [s["first"] for s in state.values()], 50),
+                  "p90": percentile(
+                      [s["first"] for s in state.values()], 90)},
+              "e2e_s": {"p50": percentile(
+                  [s["end"] for s in state.values()], 50),
+                  "p90": percentile(
+                      [s["end"] for s in state.values()], 90)},
+              "gen_tokens_per_sec": round(
+                  n_requests * max_new / makespan, 1)})
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False):
@@ -201,10 +320,7 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
     sizes, OOMs the pool while two engines overlap. Rows print as they
     are produced so a crash keeps partial results."""
     results = []
-
-    def emit(row):
-        results.append(row)
-        print(json.dumps(row), flush=True)
+    emit = functools.partial(_emit, results)
 
     rng = np.random.default_rng(0)
     cfg, eng = _engine(model_size, max_context, max(batches),
@@ -293,6 +409,16 @@ def main(argv=None):
                         "the int8-weight Pallas kernel")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="Dynamic-SplitFuse chunk size (0 = off)")
+    p.add_argument("--sweep", action="store_true",
+                   help="throughput-latency curve under Poisson "
+                        "arrivals (FastGen benchmark shape)")
+    p.add_argument("--rps", type=float, nargs="+",
+                   default=[1.0, 2.0, 4.0],
+                   help="offered request rates for --sweep")
+    p.add_argument("--max-new", type=int, default=32,
+                   help="tokens generated per request in --sweep")
+    p.add_argument("--n-requests", type=int, default=16)
+    p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--latent-dtype", default="",
                    help="HCache latent capture dtype (e.g. "
                         "float8_e4m3fn halves host-link bytes)")
@@ -304,7 +430,13 @@ def main(argv=None):
                         "instead of host-driven per-step decode")
     args = p.parse_args(argv)
     # rows print as produced (partial results survive an OOM/crash)
-    if args.restore:
+    if args.sweep:
+        run_sweep(args.model, args.max_context, args.prompt_len,
+                  max_new=args.max_new, rates=tuple(args.rps),
+                  n_requests=args.n_requests, max_batch=args.max_batch,
+                  quantize=args.quantize,
+                  prefill_chunk=args.prefill_chunk)
+    elif args.restore:
         run_restore(args.model, args.max_context, args.prompt_len,
                     tuple(args.batches), quantize=args.quantize,
                     prefill_chunk=args.prefill_chunk,

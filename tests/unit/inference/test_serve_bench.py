@@ -14,3 +14,30 @@ def test_serve_bench_all_modes():
         for r in results:
             if "tokens_per_sec" in r:
                 assert r["tokens_per_sec"] > 0
+
+
+def test_serve_bench_fused_mode():
+    results = run(model_size="tiny", max_context=128, prompt_len=32,
+                  decode_steps=4, batches=(1,), fused=True)
+    phases = {r["phase"] for r in results}
+    assert "decode-fused" in phases
+
+
+def test_serve_bench_sweep():
+    from hcache_deepspeed_tpu.inference.benchmark import run_sweep
+    rows = run_sweep(model_size="tiny", max_context=128, prompt_len=16,
+                     max_new=4, rates=(50.0,), n_requests=5, max_batch=4)
+    (row,) = rows
+    assert row["phase"] == "sweep"
+    assert row["effective_rps"] > 0
+    assert row["ttft_s"]["p50"] <= row["e2e_s"]["p50"]
+    assert row["gen_tokens_per_sec"] > 0
+
+
+def test_serve_bench_restore_mode():
+    from hcache_deepspeed_tpu.inference.benchmark import run_restore
+    rows = run_restore(model_size="tiny", max_context=128, prompt_len=16,
+                       batches=(1,))
+    (row,) = rows
+    assert row["phase"] == "hcache-restore"
+    assert row["restore_kv_ms"] > 0 and row["prefill_recompute_ms"] > 0
