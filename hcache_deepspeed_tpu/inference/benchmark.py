@@ -223,25 +223,33 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
     def percentile(xs, q):
         return round(float(np.percentile(np.asarray(xs), q)), 3)
 
+    # Warm EVERY program shape ONCE, off-clock (shapes depend on
+    # prompt_len/max_batch, not the rate): prefill lane counts covering
+    # each power-of-two bucket up to _bucket(max_batch) — admission can
+    # batch that many prefills into one dispatch — and the ragged
+    # decode dispatch at every decode bucket that can occur (bucket
+    # minimum is 8). A compile landing inside a timed loop would
+    # corrupt that rate's percentiles and flatter later rates.
+    warm_prompt = list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+    warm_counts = []
+    b = 1
+    while b < max_batch:
+        warm_counts.append(b)
+        b *= 2
+    warm_counts.append(max_batch)
+    for k in warm_counts:
+        warm_uids = list(range(k))
+        eng.put(warm_uids, [warm_prompt] * k)
+        if k in (min(8, max_batch), max_batch):
+            # decode buckets: _bucket(k, minimum=8)
+            eng.put(warm_uids, [[1]] * k)
+        for u in warm_uids:
+            eng.flush(u)
+
     for rps in rates:
         prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
                    for _ in range(n_requests)]
         arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
-        # warm EVERY program shape off-clock: prefill lane buckets
-        # {1, 2, 4, ...} up to max_batch (admission can batch that many
-        # prefills into one dispatch) plus the ragged decode batch — a
-        # compile landing inside the timed loop would corrupt the
-        # latency percentiles for that rate (and flatter later rates)
-        b = 1
-        while b <= max_batch:
-            warm_uids = list(range(b))
-            eng.put(warm_uids, [prompts[0]] * b)
-            if b == 1:
-                eng.put([0], [[1]])           # decode shape
-            for u in warm_uids:
-                eng.flush(u)
-            b *= 2
-
         state = {}      # i -> dict(start, first=None, end=None, left, tok)
         pending = list(range(n_requests))
         active = []
@@ -266,9 +274,12 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
                 admit.append(i)
             if not active and not admit:
                 if arrive[pending[0]] <= now:
-                    # first arrived request can never fit — surface it
+                    # first arrived request can never fit — surface the
+                    # verdict for the SAME whole-stretch length the
+                    # admission check used
                     raise SchedulingError(eng.can_schedule(
-                        [100 + pending[0]], [len(prompts[pending[0]])]))
+                        [100 + pending[0]],
+                        [len(prompts[pending[0]]) + max_new - 1]))
                 # idle until the next arrival
                 time.sleep(max(0.0, arrive[pending[0]] -
                                (time.perf_counter() - t0)))

@@ -545,10 +545,17 @@ class InferenceEngineV2:
                     start[j] = seq.seen_tokens
                     t_len[j] = 1
                 tables[:n] = self._tables(list(range(n)), uids)
+                # already-finished lanes (EOS on the first token) join
+                # as done so they neither feed nor block the early exit
+                if eos_token_id is not None:
+                    for j in range(n):
+                        if outs[j][0] == eos_token_id:
+                            t_len[j] = 0
                 toks, lats, lps = self.model.decode_loop(
                     self.cache, tok[:, 0], start, t_len, tables, n_feed,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    seed=seed, want_logprobs=return_logprobs)
+                    seed=seed, want_logprobs=return_logprobs,
+                    eos_token_id=eos_token_id)
                 for j, uid in enumerate(uids):
                     self.state.get_sequence(uid).post_forward()
                     outs[j].extend(int(t) for t in toks[:, j])

@@ -140,8 +140,8 @@ class PagedInferenceModel:
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
         self._decode_loop_jit = jax.jit(self._decode_loop,
-                                        static_argnums=(10, 11, 12, 13,
-                                                        14),
+                                        static_argnums=(11, 12, 13, 14,
+                                                        15, 16),
                                         donate_argnums=(1, 2))
 
     def load_params(self, params):
@@ -594,49 +594,106 @@ class PagedInferenceModel:
             l = jnp.where(keep, l, -jnp.inf)
         return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
 
+    def _step_sample(self, params, ck, cv, toks, pos, tables, t_step, key,
+                     temperature, top_p, greedy, top_k, use_top_p,
+                     want_logprobs):
+        """One decode forward + sample; shared by the scan and
+        while_loop bodies. Returns (ck, cv, nxt, latents, lp)."""
+        ck, cv, logits, latents = self._fwd_inner(
+            params, ck, cv, toks[:, None], pos, tables, t_step)
+        nxt = self._sample_logits(logits, key, temperature, top_p,
+                                  greedy, top_k, use_top_p)
+        lp = None
+        if want_logprobs:
+            # raw-model logprob of the chosen token (RLHF consumers)
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            lp = jnp.take_along_axis(lsm, nxt[:, None], axis=-1)[:, 0]
+        return ck, cv, nxt, latents, lp
+
     def _decode_loop(self, params, cache_k, cache_v, tokens, start, tables,
-                     t_len, rng_key, temperature, top_p, n_steps, greedy,
-                     top_k, use_top_p, want_logprobs):
-        """``lax.scan`` over ``n_steps`` single-token forwards with the
-        sampled token fed back on device — no host round-trip per
-        generated token. The reference's engine (like every GPU serving
-        stack) pays a host sync per step to route the next batch; on TPU
-        the idiomatic serving shape compiles the whole decode stretch so
-        the chip never waits on the host.
+                     t_len, rng_key, temperature, top_p, eos_id, n_steps,
+                     greedy, top_k, use_top_p, want_logprobs, has_eos):
+        """``n_steps`` single-token forwards with the sampled token fed
+        back on device — no host round-trip per generated token. The
+        reference's engine (like every GPU serving stack) pays a host
+        sync per step to route the next batch; on TPU the idiomatic
+        serving shape compiles the whole decode stretch so the chip
+        never waits on the host.
+
+        Without an EOS the stretch is a ``lax.scan`` (static trip count
+        — XLA pipelines it best). With ``has_eos`` it becomes a
+        ``lax.while_loop`` that exits once every live lane has sampled
+        ``eos_id``: lanes that finish stop feeding (their ``t_len``
+        drops to 0 — no cache writes) and a batch whose generations all
+        end early doesn't pay for the remaining steps.
 
         tokens: [B] the first token each lane feeds; start: [B] its
         position; t_len: [B] 1 for live lanes, 0 for padded lanes (their
         writes drop, their outputs are discarded). greedy/top_k/
-        use_top_p/want_logprobs are static; temperature/top_p traced.
-        Returns (cache_k', cache_v', tokens_out [n_steps, B],
-        latents [n_steps, L, B, 1, H], logprobs [n_steps, B] or None
-        when want_logprobs is off)."""
-        def step(carry, _):
-            ck, cv, toks, pos, key = carry
-            ck, cv, logits, latents = self._fwd_inner(
-                params, ck, cv, toks[:, None], pos, tables, t_len)
-            key, sub = jax.random.split(key)
-            nxt = self._sample_logits(logits, sub, temperature, top_p,
-                                      greedy, top_k, use_top_p)
-            ys = (nxt, latents)
-            if want_logprobs:
-                # raw-model logprob of the chosen token (RLHF consumers)
-                lsm = jax.nn.log_softmax(logits.astype(jnp.float32),
-                                         axis=-1)
-                ys += (jnp.take_along_axis(lsm, nxt[:, None],
-                                           axis=-1)[:, 0],)
-            return (ck, cv, nxt, pos + t_len, key), ys
+        use_top_p/want_logprobs/has_eos are static; temperature/top_p/
+        eos_id traced. Returns (cache_k', cache_v', tokens_out
+        [n_steps, B], latents [n_steps, L, B, 1, H], logprobs
+        [n_steps, B] or None when want_logprobs is off); with has_eos,
+        rows past a lane's EOS (and past the early exit) are zeros —
+        the engine truncates at EOS host-side."""
+        if not has_eos:
+            def step(carry, _):
+                ck, cv, toks, pos, key = carry
+                key, sub = jax.random.split(key)
+                ck, cv, nxt, latents, lp = self._step_sample(
+                    params, ck, cv, toks, pos, tables, t_len, sub,
+                    temperature, top_p, greedy, top_k, use_top_p,
+                    want_logprobs)
+                ys = (nxt, latents) + ((lp,) if want_logprobs else ())
+                return (ck, cv, nxt, pos + t_len, key), ys
 
-        (cache_k, cache_v, _, _, _), ys = jax.lax.scan(
-            step, (cache_k, cache_v, tokens, start, rng_key), None,
-            length=n_steps)
-        toks, lats = ys[0], ys[1]
-        lps = ys[2] if want_logprobs else None
-        return cache_k, cache_v, toks, lats, lps
+            (cache_k, cache_v, _, _, _), ys = jax.lax.scan(
+                step, (cache_k, cache_v, tokens, start, rng_key), None,
+                length=n_steps)
+            toks, lats = ys[0], ys[1]
+            lps = ys[2] if want_logprobs else None
+            return cache_k, cache_v, toks, lats, lps
+
+        B = tokens.shape[0]
+        lat_shape = jax.eval_shape(
+            lambda p, k, v: self._fwd_inner(p, k, v, tokens[:, None],
+                                            start, tables, t_len)[3],
+            params, cache_k, cache_v)
+        toks_buf = jnp.zeros((n_steps, B), jnp.int32)
+        lat_buf = jnp.zeros((n_steps,) + lat_shape.shape, lat_shape.dtype)
+        lp_buf = jnp.zeros((n_steps, B), jnp.float32) if want_logprobs \
+            else jnp.zeros((0,), jnp.float32)
+        done0 = t_len == 0   # padded lanes never block the early exit
+
+        def cond(st):
+            return (st[0] < n_steps) & jnp.logical_not(jnp.all(st[7]))
+
+        def body(st):
+            (i, ck, cv, toks, pos, key, t_buf, done, l_buf, p_buf) = st
+            t_step = jnp.where(done, 0, t_len)
+            key, sub = jax.random.split(key)
+            ck, cv, nxt, latents, lp = self._step_sample(
+                params, ck, cv, toks, pos, tables, t_step, sub,
+                temperature, top_p, greedy, top_k, use_top_p,
+                want_logprobs)
+            t_buf = t_buf.at[i].set(jnp.where(done, 0, nxt))
+            l_buf = l_buf.at[i].set(latents)
+            if want_logprobs:
+                p_buf = p_buf.at[i].set(jnp.where(done, 0.0, lp))
+            done = done | (nxt == eos_id)
+            return (i + 1, ck, cv, nxt, pos + t_step, key, t_buf, done,
+                    l_buf, p_buf)
+
+        st = (jnp.int32(0), cache_k, cache_v, tokens, start, rng_key,
+              toks_buf, done0, lat_buf, lp_buf)
+        st = jax.lax.while_loop(cond, body, st)
+        _, cache_k, cache_v, _, _, _, toks, _, lats, lps = st
+        return cache_k, cache_v, toks, lats, \
+            (lps if want_logprobs else None)
 
     def decode_loop(self, cache, tokens, start, t_len, tables, n_steps,
                     temperature=0.0, top_k=0, top_p=1.0, seed=0,
-                    want_logprobs=False):
+                    want_logprobs=False, eos_token_id=None):
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         if not 0.0 < top_p <= 1.0:
@@ -646,8 +703,9 @@ class PagedInferenceModel:
             jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
             jnp.asarray(t_len, jnp.int32), jax.random.PRNGKey(seed),
             jnp.float32(max(temperature, 1e-6)), jnp.float32(top_p),
+            jnp.int32(eos_token_id if eos_token_id is not None else -1),
             int(n_steps), temperature <= 0, int(top_k), top_p < 1.0,
-            bool(want_logprobs))
+            bool(want_logprobs), eos_token_id is not None)
         cache.replace(ck, cv)
         return (np.asarray(toks), lats,
                 np.asarray(lps) if lps is not None else None)

@@ -485,3 +485,52 @@ class TestFusedSampling:
                                  + ref.max())
             np.testing.assert_allclose(lp, ref_lp, atol=5e-2)
             seq.append(tok)
+
+
+class TestFusedEosEarlyExit:
+    """has_eos switches the fused loop to a while_loop that exits when
+    every lane is done; results must match the no-eos path up to the
+    EOS truncation."""
+
+    def test_eos_path_matches_scan_path(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        rng = np.random.default_rng(20)
+        prompts = [list(rng.integers(0, cfg.vocab_size, (n,)))
+                   for n in (6, 4)]
+        full, _ = engine.generate_fused(prompts, max_new_tokens=8)
+        # pick an eos that actually occurs mid-stream for lane 0
+        eos = full[0][3]
+        cut, _ = engine.generate_fused(prompts, max_new_tokens=8,
+                                       eos_token_id=eos)
+        assert cut[0] == full[0][:full[0].index(eos) + 1]
+        # lane 1: identical prefix up to ITS first eos (if any)
+        exp1 = full[1][:full[1].index(eos) + 1] if eos in full[1] \
+            else full[1]
+        assert cut[1] == exp1
+
+    def test_first_token_eos(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        prompt = [2, 7, 1]
+        base, _ = engine.generate_fused([prompt], max_new_tokens=1)
+        eos = base[0][0]
+        outs, _ = engine.generate_fused([prompt], max_new_tokens=10,
+                                        eos_token_id=eos)
+        assert outs[0] == [eos]
+
+    def test_eos_with_logprobs_and_latents(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        rng = np.random.default_rng(21)
+        prompt = list(rng.integers(0, cfg.vocab_size, (5,)))
+        full, _ = engine.generate_fused([prompt], max_new_tokens=8)
+        eos = full[0][4]
+        outs, lat, lps = engine.generate_fused(
+            [prompt], max_new_tokens=8, eos_token_id=eos,
+            return_logprobs=True)
+        assert outs[0] == full[0][:5]
+        assert len(lps[0]) == len(outs[0])
+        assert lat[0].shape[1] == len(prompt) + len(outs[0]) - 1
