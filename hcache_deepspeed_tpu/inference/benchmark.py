@@ -237,12 +237,18 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
         warm_counts.append(b)
         b *= 2
     warm_counts.append(max_batch)
+    from .engine_v2 import _bucket
+    warmed_decode = set()
     for k in warm_counts:
         warm_uids = list(range(k))
         eng.put(warm_uids, [warm_prompt] * k)
-        if k in (min(8, max_batch), max_batch):
-            # decode buckets: _bucket(k, minimum=8)
+        if _bucket(k) not in warmed_decode:
+            # decode lane buckets: _bucket(k, minimum=8) — warm each
+            # distinct bucket any in-flight count 1..max_batch can
+            # produce (warm_counts covers every power of two, so the
+            # bucket set is complete)
             eng.put(warm_uids, [[1]] * k)
+            warmed_decode.add(_bucket(k))
         for u in warm_uids:
             eng.flush(u)
 

@@ -91,3 +91,27 @@ class TestTPInference:
         bad = dataclasses.replace(cfg, n_head=3, n_kv_head=3)
         with pytest.raises(ValueError, match="divisible"):
             InferenceEngineV2(bad, params, topology=tp_topo)
+
+    def test_generate_fused_under_tp(self, tp_topo):
+        """The fused decode loop (scan and the EOS while_loop variant,
+        both wrapping the shard_map'd forward) must match single-chip
+        greedy generation."""
+        cfg, params = _setup()
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 256, (9,), dtype=np.int32).tolist(),
+                   rng.integers(0, 256, (5,), dtype=np.int32).tolist()]
+
+        ref = _engine(cfg, params)
+        tp = _engine(cfg, params, topology=tp_topo)
+        outs_ref, _ = ref.generate_fused(prompts, max_new_tokens=6)
+        outs_tp, _, lps = tp.generate_fused(prompts, max_new_tokens=6,
+                                            return_logprobs=True)
+        assert outs_tp == outs_ref
+        assert all(lp.shape == (6,) for lp in lps)
+
+        eos = outs_ref[0][2]
+        cut_ref, _ = ref.generate_fused(prompts, max_new_tokens=6,
+                                        eos_token_id=eos)
+        cut_tp, _ = tp.generate_fused(prompts, max_new_tokens=6,
+                                      eos_token_id=eos)
+        assert cut_tp == cut_ref
