@@ -35,6 +35,13 @@ class StateManagerConfig(HDSConfigModel):
     #: bounded by max_context, not by the per-forward token budget,
     #: and long prefills stop monopolizing a forward
     prefill_chunk: int = Field(0, ge=0)
+    #: share full KV blocks across sequences with identical prompt
+    #: prefixes (system prompts): a new sequence attaches the matching
+    #: blocks by reference and prefills only the tail. Requires
+    #: hcache.enable_latents=false (shared prefixes produce no latents,
+    #: which would break the restore contract). No reference analog —
+    #: FastGen lacks prefix caching.
+    prefix_caching: bool = False
 
 
 class HCacheConfig(HDSConfigModel):

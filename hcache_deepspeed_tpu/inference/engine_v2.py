@@ -113,6 +113,26 @@ class InferenceEngineV2:
         # block 0 is reserved scratch: padded decode lanes write there
         self._scratch_block = self.state.allocator.allocate(1)[0]
 
+        self.prefix_caching = sm_cfg.prefix_caching
+        if self.prefix_caching and self.config.hcache.enable_latents:
+            raise ValueError(
+                "prefix_caching requires hcache.enable_latents=false: a "
+                "shared prefix runs no forward, so its latents would be "
+                "missing from the HCache restore contract")
+        #: chained prefix index: (parent block id, this block's tokens)
+        #: -> block id. KV content depends on the ENTIRE context, so the
+        #: key must identify the full prefix — the parent block id does
+        #: that transitively (a block is registered under exactly one
+        #: chain, and a child entry keeps its parent alive through the
+        #: owning sequence's refs), giving O(P) lookups instead of
+        #: O(P^2) full-prefix tuples. _block_prefix is the reverse map
+        #: for purge.
+        self._prefix_index: Dict[tuple, int] = {}
+        self._block_prefix: Dict[int, tuple] = {}
+        #: parent block id -> chain keys registered under it (purge of a
+        #: parent must drop its now-unreachable subtree)
+        self._chain_children: Dict[int, set] = {}
+
         from ..models.falcon import FalconConfig
         from ..models.gpt2 import GPT2Config
         from ..models.mixtral import MixtralConfig
@@ -220,11 +240,38 @@ class InferenceEngineV2:
         batch_tokens = [np.asarray(t, np.int32).reshape(-1)
                         for t in batch_tokens]
         if do_checks:
+            # NOTE: with prefix caching the block budget is conservative
+            # (checked before any prefix attaches reduce the real need)
             result = self.can_schedule(batch_uids,
                                        [len(t) for t in batch_tokens])
             if result != SchedulingResult.Success:
                 raise SchedulingError(result)
         self._reject_suspended(batch_uids)
+        if self.prefix_caching:
+            # two-wave in-batch dedup: a new prompt that could share a
+            # prefix with an EARLIER new prompt in this same call defers
+            # to a second wave — wave 1 writes and registers the blocks,
+            # wave 2 then attaches them from the index (sharing within
+            # one dispatch is impossible: the blocks don't exist yet)
+            wave2 = self._defer_in_batch_duplicates(batch_uids,
+                                                    batch_tokens)
+            if wave2:
+                keep = [i for i in range(len(batch_uids))
+                        if i not in wave2]
+                l1, _ = self.put([batch_uids[i] for i in keep],
+                                 [batch_tokens[i] for i in keep],
+                                 do_checks=False)
+                l2, _ = self.put([batch_uids[i] for i in wave2],
+                                 [batch_tokens[i] for i in wave2],
+                                 do_checks=False)
+                logits = np.zeros((len(batch_uids),) + l1.shape[1:],
+                                  l1.dtype)
+                logits[keep] = l1
+                logits[list(wave2)] = l2
+                return logits, [None] * len(batch_uids)
+            batch_tokens = self._attach_shared_prefixes(batch_uids,
+                                                        batch_tokens)
+            processed = [list(t) for t in batch_tokens]
 
         # chunked prefill (Dynamic SplitFuse): run the leading chunks of
         # long prompts round by round — all sequences' chunk-k heads
@@ -287,6 +334,12 @@ class InferenceEngineV2:
 
         for uid in batch_uids:
             self.state.get_sequence(uid).post_forward()
+
+        if self.prefix_caching:
+            for uid, toks in zip(batch_uids, processed):
+                seq = self.state.get_sequence(uid)
+                seq.history.extend(int(t) for t in toks)
+                self._register_full_blocks(seq)
 
         if lead_latents:   # chunked prefill: stitch per-chunk latents
             for i, parts in lead_latents.items():
@@ -666,10 +719,133 @@ class InferenceEngineV2:
                 seq.post_forward()
 
     # -------------------------------------------------------------- #
+    # Prefix caching (no reference analog — FastGen lacks it): full KV
+    # blocks shared by refcount across sequences with identical prompt
+    # prefixes; a new sequence attaches the matched blocks and prefills
+    # only the tail (the same start>0 continuation path chunked prefill
+    # uses).
+    # -------------------------------------------------------------- #
+    @staticmethod
+    def _chain_key(parent_bid, block_tokens):
+        return (parent_bid, tuple(int(t) for t in block_tokens))
+
+    def _defer_in_batch_duplicates(self, uids, tokens_list):
+        """Indices of NEW long prompts whose first block token-matches
+        an earlier new prompt in the same batch AND whose prefix is not
+        already registered (cheap sufficient trigger: equal first
+        blocks ⇒ sharing is possible after wave 1 registers; unequal —
+        or already in the global index, where a single wave attaches
+        for everyone — ⇒ no reason to split the dispatch)."""
+        BS = self.block_size
+        seen_first = set()
+        wave2 = []
+        for i, (uid, tokens) in enumerate(zip(uids, tokens_list)):
+            seq = self.state.get_sequence(uid)
+            if (seq is not None and seq.seen_tokens > 0) or \
+                    len(tokens) <= BS:
+                continue
+            first = tuple(int(t) for t in tokens[:BS])
+            if first in seen_first and \
+                    (-1, first) not in self._prefix_index:
+                wave2.append(i)
+            else:
+                seen_first.add(first)
+        return wave2
+
+    def _attach_shared_prefixes(self, uids, tokens_list):
+        BS = self.block_size
+        out = []
+        for uid, tokens in zip(uids, tokens_list):
+            seq = self.state.get_sequence(uid)
+            if (seq is not None and seq.seen_tokens > 0) or \
+                    len(tokens) <= BS:
+                out.append(tokens)
+                continue
+            # new sequence: longest fully-indexed block-prefix match
+            # (walking the chain), capped so at least one token still
+            # runs the forward (the caller needs logits)
+            max_blocks = (len(tokens) - 1) // BS
+            blocks = []
+            parent = -1
+            for k in range(max_blocks):
+                key = self._chain_key(parent,
+                                      tokens[k * BS:(k + 1) * BS])
+                bid = self._prefix_index.get(key)
+                if bid is None:
+                    break
+                blocks.append(bid)
+                parent = bid
+            if not blocks:
+                out.append(tokens)
+                continue
+            matched = len(blocks) * BS
+            seq = self.state.get_or_create_sequence(uid)
+            for b in blocks:
+                self.state.allocator.acquire(b)
+            seq.extend_blocks(blocks)
+            seq.seen_tokens = matched
+            seq.history.extend(int(t) for t in tokens[:matched])
+            out.append(tokens[matched:])
+        return out
+
+    def _register_full_blocks(self, seq) -> None:
+        """Index this sequence's FULL blocks along the canonical prefix
+        chain. Walks from the root each time so the parent is always the
+        INDEXED block for that prefix (which may belong to another
+        sequence) — chaining on our own unshared duplicate would create
+        unreachable entries. Sequences whose history does not cover
+        every cached token (restore_kv-built ones) are skipped: their
+        block k holds KV for unknown tokens, and indexing it under
+        later-decoded history would share wrong KV. Partial tail blocks
+        are never shared (still being written)."""
+        BS = self.block_size
+        if len(seq.history) != seq.seen_tokens:
+            return
+        parent = -1
+        for k in range(seq.seen_tokens // BS):
+            key = self._chain_key(parent,
+                                  seq.history[k * BS:(k + 1) * BS])
+            bid = self._prefix_index.get(key)
+            if bid is None:
+                bid = seq.blocks[k]
+                self._prefix_index[key] = bid
+                self._block_prefix[bid] = key
+                if parent != -1:
+                    self._chain_children.setdefault(parent,
+                                                    set()).add(key)
+            parent = bid
+
+    def _unindex_subtree(self, block) -> None:
+        """Drop entries chained under ``block`` — unreachable once its
+        entry died. Their blocks may still be alive (other owners); if
+        those owners keep decoding, re-registration self-heals with a
+        fresh chain."""
+        for ckey in self._chain_children.pop(block, set()):
+            cbid = self._prefix_index.pop(ckey, None)
+            if cbid is not None:
+                if self._block_prefix.get(cbid) == ckey:
+                    del self._block_prefix[cbid]
+                self._unindex_subtree(cbid)
+
+    def _purge_freed_blocks(self, blocks) -> None:
+        for b in blocks:
+            if self.state.allocator.refcount(b) == 0:
+                key = self._block_prefix.pop(b, None)
+                if key is not None:
+                    self._prefix_index.pop(key, None)
+                    if key[0] != -1 and key[0] in self._chain_children:
+                        self._chain_children[key[0]].discard(key)
+                self._unindex_subtree(b)
+
+    # -------------------------------------------------------------- #
     # Lifecycle (reference: flush :275, serialize :284)
     # -------------------------------------------------------------- #
     def flush(self, uid: int) -> None:
+        seq = self.state.get_sequence(uid)
+        held = list(seq.blocks) if seq is not None else []
         self.state.flush_sequence(uid)
+        if self.prefix_caching and held:
+            self._purge_freed_blocks(held)
 
     # -------------------------------------------------------------- #
     # Host offload of a sequence's KV (reference: BlockedKVCache's
@@ -708,8 +884,11 @@ class InferenceEngineV2:
         seq.host_kv = (np.asarray(self.cache.k[:, :, idx]),
                        np.asarray(self.cache.v[:, :, idx]))
         if seq.blocks:
+            held = list(seq.blocks)
             self.state.allocator.free(seq.blocks)
             seq.blocks = []
+            if self.prefix_caching:
+                self._purge_freed_blocks(held)
 
     def resume_sequence(self, uid: int) -> None:
         seq = self.state.get_sequence(uid)

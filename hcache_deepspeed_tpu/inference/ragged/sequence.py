@@ -19,6 +19,11 @@ class SequenceDescriptor:
         #: host copy of the KV while suspended (engine.suspend_sequence;
         #: reference: BlockedKVCache's host-offloaded blocks)
         self.host_kv = None
+        #: token ids whose KV this sequence holds — maintained only when
+        #: prefix caching is on (feeds the chained block index; a
+        #: restore_kv-built sequence leaves it short of seen_tokens,
+        #: which excludes it from registration)
+        self.history: List[int] = []
 
     @property
     def cur_allocated_blocks(self) -> int:
