@@ -729,6 +729,21 @@ class InferenceEngineV2:
     def _chain_key(parent_bid, block_tokens):
         return (parent_bid, tuple(int(t) for t in block_tokens))
 
+    def _match_chain(self, tokens, max_blocks):
+        """Walk the index: block ids for the longest registered prefix
+        of ``tokens``, up to ``max_blocks``."""
+        BS = self.block_size
+        blocks = []
+        parent = -1
+        for k in range(max_blocks):
+            key = self._chain_key(parent, tokens[k * BS:(k + 1) * BS])
+            bid = self._prefix_index.get(key)
+            if bid is None:
+                break
+            blocks.append(bid)
+            parent = bid
+        return blocks
+
     def _defer_in_batch_duplicates(self, uids, tokens_list):
         """Indices of NEW long prompts whose first block token-matches
         an earlier new prompt in the same batch AND whose prefix is not
@@ -745,8 +760,11 @@ class InferenceEngineV2:
                     len(tokens) <= BS:
                 continue
             first = tuple(int(t) for t in tokens[:BS])
+            shareable = (len(tokens) - 1) // BS
             if first in seen_first and \
-                    (-1, first) not in self._prefix_index:
+                    len(self._match_chain(tokens, shareable)) < shareable:
+                # the index covers less than this duplicate could share
+                # — wave 1 (the first occurrence) will extend it
                 wave2.append(i)
             else:
                 seen_first.add(first)
@@ -764,17 +782,7 @@ class InferenceEngineV2:
             # new sequence: longest fully-indexed block-prefix match
             # (walking the chain), capped so at least one token still
             # runs the forward (the caller needs logits)
-            max_blocks = (len(tokens) - 1) // BS
-            blocks = []
-            parent = -1
-            for k in range(max_blocks):
-                key = self._chain_key(parent,
-                                      tokens[k * BS:(k + 1) * BS])
-                bid = self._prefix_index.get(key)
-                if bid is None:
-                    break
-                blocks.append(bid)
-                parent = bid
+            blocks = self._match_chain(tokens, (len(tokens) - 1) // BS)
             if not blocks:
                 out.append(tokens)
                 continue
@@ -790,19 +798,27 @@ class InferenceEngineV2:
 
     def _register_full_blocks(self, seq) -> None:
         """Index this sequence's FULL blocks along the canonical prefix
-        chain. Walks from the root each time so the parent is always the
+        chain. The walk runs from the root so the parent is always the
         INDEXED block for that prefix (which may belong to another
         sequence) — chaining on our own unshared duplicate would create
-        unreachable entries. Sequences whose history does not cover
-        every cached token (restore_kv-built ones) are skipped: their
-        block k holds KV for unknown tokens, and indexing it under
-        later-decoded history would share wrong KV. Partial tail blocks
-        are never shared (still being written)."""
+        unreachable entries — but only when a NEW full block completed
+        since the last walk (a per-decode-token full rewalk would put
+        O(context) host work on every step; the trade-off is that
+        entries dropped by a subtree purge re-heal at the next block
+        boundary, not the next token). Sequences whose history does not
+        cover every cached token (restore_kv-built ones) are skipped:
+        their block k holds KV for unknown tokens, and indexing it
+        under later-decoded history would share wrong KV. Partial tail
+        blocks are never shared (still being written)."""
         BS = self.block_size
         if len(seq.history) != seq.seen_tokens:
             return
+        n_full = seq.seen_tokens // BS
+        if n_full == seq.registered_full:
+            return
+        seq.registered_full = n_full
         parent = -1
-        for k in range(seq.seen_tokens // BS):
+        for k in range(n_full):
             key = self._chain_key(parent,
                                   seq.history[k * BS:(k + 1) * BS])
             bid = self._prefix_index.get(key)
@@ -889,6 +905,7 @@ class InferenceEngineV2:
             seq.blocks = []
             if self.prefix_caching:
                 self._purge_freed_blocks(held)
+                seq.registered_full = 0   # fresh blocks on resume
 
     def resume_sequence(self, uid: int) -> None:
         seq = self.state.get_sequence(uid)

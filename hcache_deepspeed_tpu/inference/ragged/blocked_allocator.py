@@ -57,10 +57,15 @@ class BlockedAllocator:
 
     def free(self, blocks: Iterable[int]) -> None:
         blocks = list(blocks)
+        drops: Dict[int, int] = {}
         for b in blocks:
             if not 0 <= b < self._num_blocks:
                 raise ValueError(f"invalid block id {b}")
-            if self._refs.get(b, 0) < 1:
+            drops[b] = drops.get(b, 0) + 1
+        for b, n in drops.items():
+            # count duplicates within THIS call too: free([b, b]) with
+            # one reference held is a double free, not two decrements
+            if self._refs.get(b, 0) < n:
                 raise ValueError(f"double free of block {b}")
         for b in blocks:
             self._refs[b] -= 1

@@ -24,6 +24,9 @@ class SequenceDescriptor:
         #: restore_kv-built sequence leaves it short of seen_tokens,
         #: which excludes it from registration)
         self.history: List[int] = []
+        #: full blocks counted at the last prefix-index walk (skip
+        #: rewalking on every decode token)
+        self.registered_full = 0
 
     @property
     def cur_allocated_blocks(self) -> int:

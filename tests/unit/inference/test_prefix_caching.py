@@ -207,3 +207,76 @@ class TestPrefixCaching:
             cur = int(np.argmax(out[0]))
         # nothing registered: history (decodes only) != seen_tokens
         assert not engine._prefix_index
+
+
+@pytest.mark.slow
+class TestPrefixCachingFuzz:
+    """Randomized interleavings of shared-prefix admissions, decodes,
+    flushes and suspend/resume under pool pressure; every decode's
+    logits check against a full-context recompute, so refcount bugs,
+    stale chain entries after purge, or cross-sequence block corruption
+    surface at the exact op that broke them."""
+
+    def test_random_interleavings(self, tiny):
+        cfg, model, params = tiny
+        engine = make_engine(cfg, params, blocks=30)
+        rng = np.random.default_rng(99)
+        bases = [list(rng.integers(0, cfg.vocab_size, (2 * BS,)))
+                 for _ in range(3)]
+        shadows = {}     # uid -> list of tokens whose KV is cached
+        suspended = set()
+        next_uid = 0
+
+        def check(uid, logits):
+            ref = full_logits(model, params, shadows[uid])
+            np.testing.assert_allclose(logits, ref[-1], atol=2e-2)
+
+        for _ in range(70):
+            op = rng.choice(["new", "new", "decode", "decode", "decode",
+                             "flush", "suspend", "resume"])
+            live = [u for u in shadows if u not in suspended]
+            if op == "new" and len(shadows) < 4:
+                base = bases[int(rng.integers(len(bases)))]
+                tail = list(rng.integers(0, cfg.vocab_size,
+                                         (int(rng.integers(1, 20)),)))
+                prompt = base + tail
+                from hcache_deepspeed_tpu.inference import SchedulingResult
+                if engine.can_schedule([next_uid], [len(prompt)]) != \
+                        SchedulingResult.Success:
+                    continue
+                logits, _ = engine.put([next_uid], [prompt])
+                shadows[next_uid] = list(prompt)
+                check(next_uid, logits[0])
+                next_uid += 1
+            elif op == "decode" and live:
+                uid = int(rng.choice(live))
+                if len(shadows[uid]) + 1 > 128:
+                    continue
+                tok = int(rng.integers(0, cfg.vocab_size))
+                shadows[uid].append(tok)
+                logits, _ = engine.put([uid], [[tok]])
+                check(uid, logits[0])
+            elif op == "flush" and shadows:
+                uid = int(rng.choice(list(shadows)))
+                engine.flush(uid)
+                del shadows[uid]
+                suspended.discard(uid)
+            elif op == "suspend" and live:
+                uid = int(rng.choice(live))
+                engine.suspend_sequence(uid)
+                suspended.add(uid)
+            elif op == "resume" and suspended:
+                from hcache_deepspeed_tpu.inference import SchedulingError
+                uid = int(rng.choice(list(suspended)))
+                try:
+                    engine.resume_sequence(uid)
+                except SchedulingError:
+                    continue    # pool too full right now — legal
+                suspended.remove(uid)
+
+        # teardown invariant: freeing everything empties the index
+        for uid in list(shadows):
+            engine.flush(uid)
+        assert not engine._prefix_index
+        assert not engine._block_prefix
+        assert all(not v for v in engine._chain_children.values())
