@@ -90,7 +90,8 @@ def _model_params(model_size: str, max_context: int):
 
 def _engine(model_size: str, max_context: int, batch: int,
             quantize: str = "", prefill_chunk: int = 0,
-            latents: bool = False, latent_dtype: str = ""):
+            latents: bool = False, latent_dtype: str = "",
+            prefix_caching: bool = False):
     from .config import RaggedInferenceEngineConfig
     from .engine_v2 import InferenceEngineV2
 
@@ -108,7 +109,8 @@ def _engine(model_size: str, max_context: int, batch: int,
                            "max_ragged_batch_size": 8192,
                            "max_ragged_sequence_count": max(batch, 8),
                            "max_context": max_context,
-                           "prefill_chunk": prefill_chunk},
+                           "prefill_chunk": prefill_chunk,
+                           "prefix_caching": prefix_caching},
             kv_cache={"block_size": 64, "num_blocks": blocks_needed,
                       "cache_dtype": "bfloat16"},
             quantization=quant,
@@ -200,7 +202,8 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
 
 def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
               max_new=32, rates=(1.0, 2.0, 4.0), n_requests=16,
-              max_batch=8, seed=0, quantize="", prefill_chunk=0):
+              max_batch=8, seed=0, quantize="", prefill_chunk=0,
+              prefix_caching=False):
     """Throughput-latency curve under open-loop Poisson arrivals — the
     FastGen headline benchmark shape (reference:
     ``blogs/deepspeed-fastgen/README.md`` throughput vs latency at a
@@ -213,8 +216,14 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
     emit = functools.partial(_emit, results)
 
     cfg, eng = _engine(model_size, max_context, max_batch,
-                       quantize=quantize, prefill_chunk=prefill_chunk)
+                       quantize=quantize, prefill_chunk=prefill_chunk,
+                       prefix_caching=prefix_caching)
     rng = np.random.default_rng(seed)
+    # with prefix caching, model the system-prompt workload: every
+    # request shares the same leading half of the prompt
+    shared_prefix = list(rng.integers(0, cfg.vocab_size,
+                                      (prompt_len // 2,))) \
+        if prefix_caching else []
     if prompt_len + max_new - 1 > min(max_context, cfg.max_positions):
         raise ValueError(
             f"prompt_len {prompt_len} + max_new {max_new} exceeds "
@@ -230,7 +239,17 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
     # decode dispatch at every decode bucket that can occur (bucket
     # minimum is 8). A compile landing inside a timed loop would
     # corrupt that rate's percentiles and flatter later rates.
-    warm_prompt = list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+    # under prefix caching the timed loop's prompts ATTACH the shared
+    # prefix and prefill only the tail — warm with the same shape, and
+    # keep one warm sequence alive so the registered chain survives the
+    # warmup flushes into the timed phase (steady-state behavior)
+    if prefix_caching:
+        warm_prompt = shared_prefix + list(
+            rng.integers(0, cfg.vocab_size,
+                         (prompt_len - len(shared_prefix),)))
+    else:
+        warm_prompt = list(rng.integers(0, cfg.vocab_size,
+                                        (prompt_len,)))
     warm_counts = []
     b = 1
     while b < max_batch:
@@ -238,6 +257,9 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
         b *= 2
     warm_counts.append(max_batch)
     from .engine_v2 import _bucket
+    keeper_uid = 10 ** 6
+    if prefix_caching:
+        eng.put([keeper_uid], [warm_prompt])   # owns the shared chain
     warmed_decode = set()
     for k in warm_counts:
         warm_uids = list(range(k))
@@ -253,7 +275,10 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
             eng.flush(u)
 
     for rps in rates:
-        prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+        stats0 = dict(eng.prefix_stats) if prefix_caching else None
+        prompts = [shared_prefix +
+                   list(rng.integers(0, cfg.vocab_size,
+                                     (prompt_len - len(shared_prefix),)))
                    for _ in range(n_requests)]
         arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
         state = {}      # i -> dict(start, first=None, end=None, left, tok)
@@ -314,7 +339,13 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
             active = [i for i in step if i not in finished]
 
         makespan = max(s["end"] + s["start"] for s in state.values())
-        emit({"phase": "sweep", "offered_rps": rps,
+        row_extra = {}
+        if prefix_caching:
+            # per-rate delta, not engine-lifetime cumulative counters
+            row_extra = {"prefix_stats": {
+                k: eng.prefix_stats[k] - stats0[k]
+                for k in eng.prefix_stats}}
+        emit({"phase": "sweep", "offered_rps": rps, **row_extra,
               "effective_rps": round(n_requests / makespan, 3),
               "ttft_s": {"p50": percentile(
                   [s["first"] for s in state.values()], 50),
@@ -426,6 +457,9 @@ def main(argv=None):
                         "the int8-weight Pallas kernel")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="Dynamic-SplitFuse chunk size (0 = off)")
+    p.add_argument("--prefix-caching", action="store_true",
+                   help="sweep with a shared system prefix and prefix "
+                        "caching on")
     p.add_argument("--sweep", action="store_true",
                    help="throughput-latency curve under Poisson "
                         "arrivals (FastGen benchmark shape)")
@@ -452,7 +486,8 @@ def main(argv=None):
                   max_new=args.max_new, rates=tuple(args.rps),
                   n_requests=args.n_requests, max_batch=args.max_batch,
                   quantize=args.quantize,
-                  prefill_chunk=args.prefill_chunk)
+                  prefill_chunk=args.prefill_chunk,
+                  prefix_caching=args.prefix_caching)
     elif args.restore:
         run_restore(args.model, args.max_context, args.prompt_len,
                     tuple(args.batches), quantize=args.quantize,

@@ -132,6 +132,13 @@ class InferenceEngineV2:
         #: parent block id -> chain keys registered under it (purge of a
         #: parent must drop its now-unreachable subtree)
         self._chain_children: Dict[int, set] = {}
+        #: observability: prompts that attached >= 1 shared block, and
+        #: prompt tokens whose prefill was skipped entirely
+        self.prefix_stats = {"hits": 0, "shared_tokens": 0}
+        #: bumped on every purge: sequences cache their chain-walk tip
+        #: keyed on this epoch, so registration is O(new blocks) in the
+        #: common case and only re-walks from the root after a purge
+        self._index_epoch = 1
 
         from ..models.falcon import FalconConfig
         from ..models.gpt2 import GPT2Config
@@ -793,6 +800,13 @@ class InferenceEngineV2:
             seq.extend_blocks(blocks)
             seq.seen_tokens = matched
             seq.history.extend(int(t) for t in tokens[:matched])
+            # prime the chain-walk cache: registration resumes after
+            # the attached blocks
+            seq.registered_full = len(blocks)
+            seq.chain_parent = blocks[-1]
+            seq.chain_epoch = self._index_epoch
+            self.prefix_stats["hits"] += 1
+            self.prefix_stats["shared_tokens"] += matched
             out.append(tokens[matched:])
         return out
 
@@ -814,11 +828,15 @@ class InferenceEngineV2:
         if len(seq.history) != seq.seen_tokens:
             return
         n_full = seq.seen_tokens // BS
-        if n_full == seq.registered_full:
+        if n_full == seq.registered_full and \
+                seq.chain_epoch == self._index_epoch:
             return
-        seq.registered_full = n_full
-        parent = -1
-        for k in range(n_full):
+        if seq.chain_epoch == self._index_epoch and \
+                seq.registered_full > 0:
+            start, parent = seq.registered_full, seq.chain_parent
+        else:
+            start, parent = 0, -1      # a purge invalidated cached tips
+        for k in range(start, n_full):
             key = self._chain_key(parent,
                                   seq.history[k * BS:(k + 1) * BS])
             bid = self._prefix_index.get(key)
@@ -830,6 +848,9 @@ class InferenceEngineV2:
                     self._chain_children.setdefault(parent,
                                                     set()).add(key)
             parent = bid
+        seq.registered_full = n_full
+        seq.chain_parent = parent
+        seq.chain_epoch = self._index_epoch
 
     def _unindex_subtree(self, block) -> None:
         """Drop entries chained under ``block`` — unreachable once its
@@ -844,6 +865,7 @@ class InferenceEngineV2:
                 self._unindex_subtree(cbid)
 
     def _purge_freed_blocks(self, blocks) -> None:
+        purged = False
         for b in blocks:
             if self.state.allocator.refcount(b) == 0:
                 key = self._block_prefix.pop(b, None)
@@ -851,7 +873,12 @@ class InferenceEngineV2:
                     self._prefix_index.pop(key, None)
                     if key[0] != -1 and key[0] in self._chain_children:
                         self._chain_children[key[0]].discard(key)
+                    purged = True
+                if self._chain_children.get(b):
+                    purged = True
                 self._unindex_subtree(b)
+        if purged:
+            self._index_epoch += 1    # cached chain tips are now stale
 
     # -------------------------------------------------------------- #
     # Lifecycle (reference: flush :275, serialize :284)
@@ -906,6 +933,7 @@ class InferenceEngineV2:
             if self.prefix_caching:
                 self._purge_freed_blocks(held)
                 seq.registered_full = 0   # fresh blocks on resume
+                seq.chain_parent = -1
 
     def resume_sequence(self, uid: int) -> None:
         seq = self.state.get_sequence(uid)

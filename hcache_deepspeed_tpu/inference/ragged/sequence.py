@@ -25,8 +25,12 @@ class SequenceDescriptor:
         #: which excludes it from registration)
         self.history: List[int] = []
         #: full blocks counted at the last prefix-index walk (skip
-        #: rewalking on every decode token)
+        #: rewalking on every decode token), plus the chain position the
+        #: walk ended at — valid only while the engine's index epoch
+        #: matches (purges invalidate cached chain tips)
         self.registered_full = 0
+        self.chain_parent = -1
+        self.chain_epoch = 0
 
     @property
     def cur_allocated_blocks(self) -> int:
