@@ -95,9 +95,10 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, H, C // H)
         use_dropout = train and cfg.dropout > 0
         if cfg.use_flash and mask is None and not use_dropout:
-            y = flash_attention(q, k, v, causal=True,
-                                block_q=cfg.flash_block_q,
-                                block_k=cfg.flash_block_k).reshape(B, T, C)
+            y = flash_attention(
+                q, k, v, causal=True,
+                block_q=getattr(cfg, "flash_block_q", 0),
+                block_k=getattr(cfg, "flash_block_k", 0)).reshape(B, T, C)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
                 C // H).astype(x.dtype)

@@ -140,9 +140,12 @@ class LlamaAttention(nn.Module):
         elif cfg.use_flash:
             # GQA-native: the kernel's index map shares kv blocks across
             # each query-head group — no repeat, KV HBM reads drop H/KV x
-            y = flash_attention(q, k, v, causal=True,
-                                block_q=cfg.flash_block_q,
-                                block_k=cfg.flash_block_k)
+            y = flash_attention(
+                q, k, v, causal=True,
+                # family configs reusing this block (falcon/phi/...)
+                # may not declare the tiling knobs
+                block_q=getattr(cfg, "flash_block_q", 0),
+                block_k=getattr(cfg, "flash_block_k", 0))
         else:
             from ..ops.flash_attention import reference_attention
             y = reference_attention(q, k, v, causal=True)
