@@ -101,6 +101,60 @@ def _metric_label():
             "gpt2-350m train tokens/sec/chip (bf16, seq1024)")
 
 
+# Every successful chip measurement is persisted here; error paths report
+# it as ``extra.last_measured`` so a round captured while the relay is
+# dead still transmits the last real number (distinguishing "never fast"
+# from "fast but unreachable" for whoever reads the artifact). The file
+# IS committed on purpose — a fresh clone must carry the last round's
+# measured {best,last} as its dead-relay fallback.
+_LAST_MEASURED_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_last_measured.json")
+
+
+def _record_last_measured(entry):
+    """Persist ``last`` (most recent chip measurement) and ``best``
+    (highest-MFU ever), so vetting runs of experimental configs can't
+    erase the winner's number from the dead-relay report."""
+    state = _load_last_measured() or {}
+    state["last"] = entry
+    best = state.get("best")
+    if best is None or entry.get("mfu", 0.0) >= best.get("mfu", 0.0):
+        state["best"] = entry
+    try:
+        tmp = _LAST_MEASURED_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, _LAST_MEASURED_PATH)
+    except OSError as e:   # never fail the measurement, but say so
+        print(f"[bench] could not persist last_measured: {e}",
+              file=sys.stderr)
+
+
+def _load_last_measured():
+    try:
+        with open(_LAST_MEASURED_PATH) as f:
+            state = json.load(f)
+        return state if isinstance(state, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _error_payload(message):
+    payload = {
+        "metric": _metric_label(),
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "error": message,
+    }
+    # smoke artifacts must not carry real chip numbers
+    state = (None if os.environ.get("HDS_BENCH_TINY") == "1"
+             else _load_last_measured())
+    if state is not None:
+        payload["extra"] = {"last_measured": state}
+    return payload
+
+
 def _arm_watchdog():
     def fire():
         if _DONE.is_set():
@@ -110,14 +164,9 @@ def _arm_watchdog():
                 _CHILD.kill()   # don't orphan a child wedged on the relay
             except Exception:
                 pass
-        print(json.dumps({
-            "metric": _metric_label(),
-            "value": 0.0,
-            "unit": "tokens/sec",
-            "vs_baseline": 0.0,
-            "error": f"watchdog: no result within {_WATCHDOG_SECS:.0f}s "
-                     "(TPU relay unreachable?)",
-        }), flush=True)
+        print(json.dumps(_error_payload(
+            f"watchdog: no result within {_WATCHDOG_SECS:.0f}s "
+            "(TPU relay unreachable?)")), flush=True)
         os._exit(2)
 
     t = threading.Timer(_WATCHDOG_SECS, fire)
@@ -205,6 +254,14 @@ def run_config(name):
     vs_baseline = (mfu / 0.54) if peak else 0.0
 
     _DONE.set()
+    if os.environ.get("HDS_BENCH_TINY") != "1":
+        _record_last_measured({
+            "value": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4),
+            "vs_baseline": round(vs_baseline, 4),
+            "config": name,
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
     print(json.dumps({
         "metric": _metric_label(),
         "value": round(tokens_per_sec, 1),
@@ -299,13 +356,8 @@ def main():
                                            r.get("value", 0.0)))
         print(json.dumps(best), flush=True)
         return 0
-    print(json.dumps({
-        "metric": _metric_label(),
-        "value": 0.0,
-        "unit": "tokens/sec",
-        "vs_baseline": 0.0,
-        "error": "no candidate produced a result (TPU relay down?)",
-    }), flush=True)
+    print(json.dumps(_error_payload(
+        "no candidate produced a result (TPU relay down?)")), flush=True)
     return 2
 
 
