@@ -275,6 +275,14 @@ class InferenceEngineV2:
                                   l1.dtype)
                 logits[keep] = l1
                 logits[list(wave2)] = l2
+                # dropping l1/l2 latents is only sound because the
+                # constructor forbids prefix_caching with latent capture
+                # — pin that invariant here so relaxing it elsewhere
+                # can't silently lose latents
+                assert not self.config.hcache.enable_latents, (
+                    "wave-split put() discards latents; prefix_caching "
+                    "with hcache.enable_latents must stay mutually "
+                    "exclusive")
                 return logits, [None] * len(batch_uids)
             batch_tokens = self._attach_shared_prefixes(batch_uids,
                                                         batch_tokens)
@@ -565,6 +573,9 @@ class InferenceEngineV2:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         base = max(self.state._seqs.keys(), default=-1) + 1
         uids = [base + i for i in range(len(prompts))]
         n_feed = max_new_tokens - 1   # tokens fed (and cached) on device
@@ -856,13 +867,18 @@ class InferenceEngineV2:
         """Drop entries chained under ``block`` — unreachable once its
         entry died. Their blocks may still be alive (other owners); if
         those owners keep decoding, re-registration self-heals with a
-        fresh chain."""
-        for ckey in self._chain_children.pop(block, set()):
-            cbid = self._prefix_index.pop(ckey, None)
-            if cbid is not None:
-                if self._block_prefix.get(cbid) == ckey:
-                    del self._block_prefix[cbid]
-                self._unindex_subtree(cbid)
+        fresh chain. Iterative: a chain is one level per block, so a
+        long shared prefix (64k tokens = 1000+ blocks) would blow the
+        recursion limit."""
+        stack = [block]
+        while stack:
+            b = stack.pop()
+            for ckey in self._chain_children.pop(b, set()):
+                cbid = self._prefix_index.pop(ckey, None)
+                if cbid is not None:
+                    if self._block_prefix.get(cbid) == ckey:
+                        del self._block_prefix[cbid]
+                    stack.append(cbid)
 
     def _purge_freed_blocks(self, blocks) -> None:
         purged = False

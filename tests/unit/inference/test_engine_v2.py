@@ -284,6 +284,13 @@ class TestGenerateFused:
         ref = full_logits(model, params, prompt)
         assert outs == [[int(np.argmax(ref[-1]))]]
 
+    def test_fused_rejects_nonpositive_max_new_tokens(self, tiny_model):
+        cfg, model, params = tiny_model
+        engine = make_engine(cfg, params)
+        for bad in (0, -3):
+            with pytest.raises(ValueError, match="max_new_tokens"):
+                engine.generate_fused([[1, 2, 3]], max_new_tokens=bad)
+
     def test_fused_eos_truncation(self, tiny_model):
         cfg, model, params = tiny_model
         engine = make_engine(cfg, params)

@@ -208,6 +208,29 @@ class TestPrefixCaching:
         # nothing registered: history (decodes only) != seen_tokens
         assert not engine._prefix_index
 
+    def test_unindex_survives_deep_chain(self, tiny):
+        """A ~64k-token shared prefix at block_size 16 is a 4000-level
+        chain; purging it must not hit the Python recursion limit
+        (advisor finding: the old recursive walk died at ~1000)."""
+        cfg, _, params = tiny
+        engine = make_engine(cfg, params)
+        depth = 4000          # >> default recursionlimit
+        parent = -1
+        for i in range(depth):
+            key = (parent, i)
+            bid = 10_000 + i   # synthetic ids, never touch the allocator
+            engine._prefix_index[key] = bid
+            engine._block_prefix[bid] = key
+            if parent != -1:
+                engine._chain_children.setdefault(parent, set()).add(key)
+            parent = bid
+        engine._unindex_subtree(10_000)
+        # everything below the root is gone; the root itself is the
+        # caller's (purge loop's) responsibility
+        assert len(engine._prefix_index) == 1
+        assert len(engine._block_prefix) == 1
+        assert not engine._chain_children
+
 
 @pytest.mark.slow
 class TestPrefixCachingFuzz:
