@@ -349,6 +349,59 @@ def gpt2_flat_to_pipeline(params, cfg: GPT2Config):
     }
 
 
+def gpt2_zeropp_layered_spec(cfg: GPT2Config):
+    """Layered loss decomposition for the ZeRO++ scan-over-layers gather
+    (``runtime/zero/zeropp.py``): outer leaves (tied embedding, position
+    embedding, final norm) gather once; block layers gather one at a
+    time inside the scan body. Numerics match ``GPT2LMHeadModel`` —
+    every piece reuses the flat model's own modules/loss functions.
+    Reference memory contract: stage-3 live params bounded per-module
+    (``partitioned_param_coordinator.py:285``)."""
+    dtype = cfg.compute_dtype
+
+    def embed(outer, batch, key, train):
+        x = TiedEmbed(cfg.vocab_size, cfg.n_embd, dtype=dtype,
+                      mode="embed").apply(
+            {"params": {"weight": outer["wte"]}}, batch)
+        rngs = {"dropout": key} if (train and cfg.dropout > 0) else None
+        return GPT2PosEmbed(cfg).apply({"params": {"wpe": outer["wpe"]}},
+                                       x, train, rngs=rngs)
+
+    def block(layer, x, batch, key, train):
+        mask = batch.get("attention_mask")
+        rngs = {"dropout": key} if (train and cfg.dropout > 0) else None
+        return Block(cfg).apply({"params": layer}, x, mask, train,
+                                rngs=rngs)
+
+    def head(outer, x, batch):
+        x = GPT2FinalNorm(cfg).apply({"params": {"ln_f": outer["ln_f"]}},
+                                     x)
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = default_lm_labels(ids)
+        T = ids.shape[1]
+        if cfg.loss_chunk and T % cfg.loss_chunk == 0:
+            from ..sequence.fpdt import chunked_lm_loss
+            head_kernel = outer["wte"]["embedding"].astype(dtype).T
+            return chunked_lm_loss(x, head_kernel, labels,
+                                   chunk=cfg.loss_chunk)
+        logits = TiedEmbed(cfg.vocab_size, cfg.n_embd, dtype=dtype,
+                           mode="attend").apply(
+            {"params": {"weight": outer["wte"]}}, x)
+        return causal_lm_loss(logits, labels)
+
+    return {
+        "model_name": "gpt2",
+        "layer_prefix": "h_",
+        "n_layer": cfg.n_layer,
+        "outer_keys": ("wte", "wpe", "ln_f"),
+        "embed": embed,
+        "block": block,
+        "head": head,
+    }
+
+
 def gpt2_pipeline_layers(cfg: GPT2Config):
     """(layers, loss_fn) for ``PipelineModule``: tied embed/head, positional
     embed, n_layer homogeneous blocks, final norm."""

@@ -1,0 +1,47 @@
+"""Registry of layered-loss specs for the ZeRO++ scan-over-layers gather.
+
+A layered spec decomposes a model's loss into
+``embed(outer, batch, key, train) -> x``,
+``block(layer_params, x, batch, key, train) -> x`` (one homogeneous
+transformer block, scanned), and ``head(outer, x, batch) -> loss``, plus
+the tree layout (``layer_prefix``/``n_layer``/``outer_keys``). The
+ZeRO++ micro step (``runtime/zero/zeropp.py``) uses it to gather one
+layer's parameters at a time inside a ``lax.scan`` body instead of the
+whole model up front — the reference's stage-3 live-parameter contract
+(``deepspeed/runtime/zero/partitioned_param_coordinator.py:285``,
+``max_live_parameters``).
+
+``zeropp_layered_spec`` returns None whenever the decomposition would
+change semantics (unknown model class, MoE/custom-attention llama, a
+param tree with keys outside the spec's layout — e.g. LoRA-merged
+trees); callers then fall back to the whole-tree gather.
+"""
+
+from typing import Any, Optional
+
+
+def zeropp_layered_spec(module: Any, params_struct: Any) -> Optional[dict]:
+    """Best-effort layered spec for ``module``, validated against the
+    top-level keys of ``params_struct`` (any pytree shaped like the
+    param tree — the engine passes its spec tree)."""
+    if module is None or not isinstance(params_struct, dict):
+        return None
+
+    spec = None
+    from .gpt2 import GPT2LMHeadModel, gpt2_zeropp_layered_spec
+    from .llama import LlamaForCausalLM, llama_zeropp_layered_spec
+    if isinstance(module, GPT2LMHeadModel):
+        spec = gpt2_zeropp_layered_spec(module.cfg)
+    elif isinstance(module, LlamaForCausalLM):
+        # custom attention (ulysses/ring) and MoE blocks are built into
+        # the flat forward; the dense decomposition would drop them
+        if module.attention_fn is None and module.mlp_cls is None:
+            spec = llama_zeropp_layered_spec(module.cfg)
+    if spec is None:
+        return None
+
+    expected = set(spec["outer_keys"]) | {
+        f"{spec['layer_prefix']}{i}" for i in range(spec["n_layer"])}
+    if set(params_struct.keys()) != expected:
+        return None
+    return spec

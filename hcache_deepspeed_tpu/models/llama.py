@@ -353,6 +353,54 @@ def llama_pipeline_layers(cfg: LlamaConfig):
     return layers, lm_loss_fn
 
 
+def llama_zeropp_layered_spec(cfg: LlamaConfig):
+    """Layered loss decomposition for the ZeRO++ scan-over-layers gather
+    (``runtime/zero/zeropp.py``); see ``gpt2.gpt2_zeropp_layered_spec``
+    for the contract. Dense blocks only — MoE/custom-attention models
+    fall back to the whole-tree gather (``models/layered.py`` gates)."""
+    dtype = cfg.compute_dtype
+    outer_keys = ("embed_tokens", "norm") if cfg.tie_word_embeddings \
+        else ("embed_tokens", "norm", "lm_head")
+
+    def embed(outer, batch, key, train):
+        # root module: params sit at the tree top (no name nesting)
+        return nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                        dtype=dtype).apply(
+            {"params": outer["embed_tokens"]}, batch["input_ids"])
+
+    def block(layer, x, batch, key, train):
+        out, _aux = LlamaBlock(cfg).apply({"params": layer}, x, train)
+        return out
+
+    def head(outer, x, batch):
+        x = RMSNorm(cfg.rms_norm_eps).apply({"params": outer["norm"]}, x)
+        if cfg.tie_word_embeddings:
+            head_kernel = outer["embed_tokens"]["embedding"].T \
+                .astype(dtype)
+        else:
+            head_kernel = outer["lm_head"]["kernel"].astype(dtype)
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = default_lm_labels(ids)
+        T = ids.shape[1]
+        if cfg.loss_chunk and T % cfg.loss_chunk == 0:
+            from ..sequence.fpdt import chunked_lm_loss
+            return chunked_lm_loss(x, head_kernel, labels,
+                                   chunk=cfg.loss_chunk)
+        return causal_lm_loss(x @ head_kernel, labels)
+
+    return {
+        "model_name": "llama",
+        "layer_prefix": "layers_",
+        "n_layer": cfg.n_layer,
+        "outer_keys": outer_keys,
+        "embed": embed,
+        "block": block,
+        "head": head,
+    }
+
+
 def llama_flat_to_pipeline(params, cfg: LlamaConfig):
     """Flat ``LlamaForCausalLM`` tree (training run or
     ``checkpoint.hf_loader``) → ``PipelineModule`` layout; see

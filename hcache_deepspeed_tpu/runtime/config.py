@@ -80,6 +80,11 @@ class ZeroConfig(HDSConfigModel):
     zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition size
     zero_quantized_weights: bool = False  # ZeRO++ qwZ
     zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    #: ZeRO++ stage-3 gather granularity: scan-over-layers (gather one
+    #: block at a time inside the micro step) when the model provides a
+    #: layered spec (models/layered.py). False forces the whole-tree
+    #: gather (peak param memory = full model).
+    layered_gather: bool = True
     ignore_unused_parameters: bool = True
     round_robin_gradients: bool = False
     min_shard_size: int = 2 ** 14  # params smaller than this stay replicated

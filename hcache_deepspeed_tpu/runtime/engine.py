@@ -679,6 +679,12 @@ class HDSEngine:
         prepare_secondary = None
         if self._zeropp:
             from .zero.zeropp import build_zeropp_micro_fn
+            zcfg = self.config.zero_optimization
+            layered = None
+            if zcfg.stage == 3 and zcfg.layered_gather:
+                from ..models.layered import zeropp_layered_spec
+                layered = zeropp_layered_spec(self.adapter.module,
+                                              self.param_specs)
             micro_fwd_bwd, prepare_secondary = build_zeropp_micro_fn(
                 adapter_loss=self.adapter.loss,
                 mesh=mesh,
@@ -688,7 +694,8 @@ class HDSEngine:
                 gas=gas,
                 grad_accum_dtype=self.grad_accum_dtype,
                 remat_policy=remat_policy,
-                zcfg=self.config.zero_optimization)
+                zcfg=zcfg,
+                layered=layered)
 
         self._micro_fwd_bwd = jax.jit(
             micro_fwd_bwd,

@@ -38,12 +38,20 @@ wraps the same function, so backward re-gathers (quantized, intra-group
 when hpZ) rather than keeping full parameters alive, matching the
 reference's re-gather-in-backward behavior.
 
-Memory caveat vs the reference: all leaves gather at the top of the
-micro-step rather than per-module, so peak parameter memory during a
-micro-step is the full model (the GSPMD path with remat keeps XLA's
-per-use gather/free). ZeRO++'s value — wire volume — is preserved and
-logged; prefer the GSPMD path when HBM, not interconnect, is the binding
-constraint.
+Gather granularity. With a model that exposes a *layered loss spec*
+(``models/layered.py``) the micro-step runs as a ``lax.scan`` over the
+transformer blocks, gathering layer *i*'s (quantized, hpZ-grouped)
+parameters INSIDE the remat'd scan body — so peak gathered parameter
+memory is one layer plus the embedding/head, not the full model. This is
+the reference's stage-3 memory contract (live params bounded per-module,
+``partitioned_param_coordinator.py:285`` ``max_live_parameters``), scan
+scoping standing in for the gather/release hooks; the backward pass
+re-gathers one layer at a time because the scan body is
+``jax.checkpoint``-ed. Models without a layered spec (or stages < 3)
+fall back to the whole-tree gather, whose peak parameter memory during a
+micro-step is the full model — fine for wire-volume experiments, wrong
+for 7B+ per-chip budgets; set ``zero_optimization.layered_gather``
+(default true) to control the choice explicitly.
 """
 
 import functools
@@ -243,6 +251,25 @@ def build_secondary(params, param_dims, hpz: int):
     return [leaf(p, d) for p, d in zip(flat, param_dims)]
 
 
+def make_layered_split(layered):
+    """Generic params split for a layered loss spec: the flat model tree
+    → ``(outer, stacked)`` where ``outer`` keeps the spec's
+    ``outer_keys`` subtrees and ``stacked`` stacks the n_layer block
+    subtrees into a leading layer dim (pure ``jnp.stack`` — its VJP
+    unstacks the scan's block cotangents back onto the flat tree)."""
+    from ...models._pipe_util import stack_flat_layers
+
+    def split(params):
+        stacked = stack_flat_layers(
+            params, layered["layer_prefix"], layered["n_layer"],
+            required=list(layered["outer_keys"]),
+            model_name=layered["model_name"])
+        outer = {k: params[k] for k in layered["outer_keys"]}
+        return outer, stacked
+
+    return split
+
+
 def validate_zeropp(zcfg, stage: int, data_size: int):
     """Config-time checks (reference: engine.py:994-1008 asserts)."""
     from ..config import HDSConfigError
@@ -265,7 +292,7 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
 
 def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
                           batch_spec_of, gas, grad_accum_dtype,
-                          remat_policy, zcfg):
+                          remat_policy, zcfg, layered=None):
     """The ZeRO++ micro fwd+bwd: a partial-manual shard_map over ``data``.
 
     Returns ``(micro_fwd_bwd, prepare_secondary)``. ``micro_fwd_bwd`` has
@@ -281,21 +308,31 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     ``secondary`` refreshes inline (the unfused forward() path).
     ``batch_spec_of(leaf) -> PartitionSpec`` gives each batch leaf's
     global spec (projected to the data axis here).
+
+    ``layered`` (``models/layered.py`` spec or None) selects the
+    scan-over-layers gather: the forward becomes
+    ``embed → lax.scan(checkpointed block body) → head`` with layer i's
+    gather inside the scan body, bounding peak gathered params to one
+    layer + the outer (embedding/head) leaves — the reference's
+    ``max_live_parameters`` contract. The whole-tree path below is the
+    fallback for models without a spec.
     """
     qw = zcfg.zero_quantized_weights
     qg = zcfg.zero_quantized_gradients
     hpz = zcfg.zero_hpz_partition_size
 
-    flat_pspecs, _ = jax.tree.flatten(
-        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
-    flat_gspecs, _ = jax.tree.flatten(
-        grad_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
-    param_dims = [_axis_dim(s, DATA_AXIS) for s in flat_pspecs]
-    grad_dims = [_axis_dim(s, DATA_AXIS) for s in flat_gspecs]
+    def _flat_specs(tree):
+        return jax.tree.flatten(
+            tree, is_leaf=lambda x: isinstance(x, PartitionSpec))[0]
+
+    def _dims(tree):
+        return [_axis_dim(s, DATA_AXIS) for s in _flat_specs(tree)]
+
+    param_dims = _dims(param_specs)
+    grad_dims = _dims(grad_specs)
     params_proj = project_spec_tree(param_specs, DATA_AXIS)
     grads_proj = project_spec_tree(grad_specs, DATA_AXIS)
-    flat_pproj, _ = jax.tree.flatten(
-        params_proj, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    flat_pproj = _flat_specs(params_proj)
     # secondary leaves stay sharded on the same dim as their primary
     # (local size 1/hpz ⇒ the logical global dim is n/hpz times the
     # parameter's, which only ever lives inside the fused step)
@@ -303,6 +340,14 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
 
     gather, reduce_grads = make_param_gather(
         param_dims, grad_dims, qw=qw, qg=qg, hpz=hpz)
+
+    if layered is not None:
+        return _build_layered(
+            layered=layered, mesh=mesh, param_specs=param_specs,
+            batch_spec_of=batch_spec_of, gas=gas,
+            grad_accum_dtype=grad_accum_dtype, remat_policy=remat_policy,
+            qw=qw, qg=qg, hpz=hpz, reduce_grads=reduce_grads,
+            params_proj=params_proj, grads_proj=grads_proj)
 
     prepare_secondary = None
     if hpz > 1:
@@ -352,6 +397,142 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
         args = [params, grad_acc, loss_scale, batch, rng]
         if with_sec:
             in_specs.append(secondary_proj)
+            args.append(secondary)
+        shmapped = jax.shard_map(
+            inner, mesh=mesh, axis_names={DATA_AXIS},
+            in_specs=tuple(in_specs), out_specs=(PartitionSpec(),
+                                                 grads_proj),
+            check_vma=False)
+        return shmapped(*args)
+
+    return micro_fwd_bwd, prepare_secondary
+
+
+def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
+                   grad_accum_dtype, remat_policy, qw, qg, hpz,
+                   reduce_grads, params_proj, grads_proj):
+    """Scan-over-layers ZeRO++ micro step (see build_zeropp_micro_fn)."""
+    split = make_layered_split(layered)
+    prefix, n_layer = layered["layer_prefix"], layered["n_layer"]
+    outer_keys = list(layered["outer_keys"])
+    embed_fn = layered["embed"]
+    block_fn = layered["block"]
+    head_fn = layered["head"]
+
+    def _subtree_dims(spec_tree):
+        flat = jax.tree.flatten(
+            spec_tree, is_leaf=lambda x: isinstance(x, PartitionSpec))[0]
+        return [_axis_dim(s, DATA_AXIS) for s in flat]
+
+    block0 = param_specs[f"{prefix}0"]
+    for i in range(1, n_layer):
+        if _subtree_dims(param_specs[f"{prefix}{i}"]) \
+                != _subtree_dims(block0):
+            raise ValueError(
+                f"layered ZeRO++ gather needs identical shard specs "
+                f"across layers; {prefix}{i} differs from {prefix}0")
+    block_pdims = _subtree_dims(block0)
+    outer_pdims = _subtree_dims({k: param_specs[k] for k in outer_keys})
+    # stacked leaves carry the data axis one dim later (leading L dim)
+    stacked_pdims = [None if d is None else d + 1 for d in block_pdims]
+
+    # grad dims only matter for reduce_grads, which runs on the FULL
+    # flat tree after the VJP — the per-layer/outer gathers reduce their
+    # own sharded leaves in bwd, so pass param dims as grad dims here.
+    gather_outer, _ = make_param_gather(
+        outer_pdims, outer_pdims, qw=qw, qg=qg, hpz=hpz)
+    gather_block, _ = make_param_gather(
+        block_pdims, block_pdims, qw=qw, qg=qg, hpz=hpz)
+
+    def build_layered_secondary(params_local):
+        outer_local, stacked_local = split(params_local)
+        sec_outer = build_secondary(outer_local, outer_pdims, hpz)
+        sec_stacked = build_secondary(
+            jax.tree.flatten(stacked_local)[0], stacked_pdims, hpz)
+        return sec_outer, sec_stacked
+
+    def _sec_specs():
+        outer_proj = [project_spec(s, DATA_AXIS) for s in _flat_specs_of(
+            {k: param_specs[k] for k in outer_keys})]
+        sec_outer_specs = [
+            None if d is None else outer_proj[i]
+            for i, d in enumerate(outer_pdims)]
+        sec_stacked_specs = [
+            None if d is None else PartitionSpec(*([None] * d), DATA_AXIS)
+            for d in stacked_pdims]
+        return sec_outer_specs, sec_stacked_specs
+
+    def _flat_specs_of(tree):
+        return jax.tree.flatten(
+            tree, is_leaf=lambda x: isinstance(x, PartitionSpec))[0]
+
+    prepare_secondary = None
+    if hpz > 1:
+        def prepare_secondary(params):
+            return jax.shard_map(
+                build_layered_secondary,
+                mesh=mesh, axis_names={DATA_AXIS},
+                in_specs=(params_proj,), out_specs=_sec_specs(),
+                check_vma=False)(params)
+
+    def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train,
+                      secondary=None):
+        batch_proj = jax.tree.map(
+            lambda leaf: project_spec(batch_spec_of(leaf), DATA_AXIS), batch)
+        with_sec = secondary is not None
+
+        def inner(params_local, grad_acc_local, loss_scale, batch_local,
+                  rng, *maybe_sec):
+            n = jax.lax.axis_size(DATA_AXIS)
+            if with_sec:
+                sec_outer, sec_stacked = maybe_sec[0]
+            else:
+                sec_outer, sec_stacked = build_layered_secondary(
+                    params_local)
+
+            def raw_loss(p_local):
+                outer_local, stacked_local = split(p_local)
+                outer_full = gather_outer(outer_local, list(sec_outer))
+                keys = jax.random.split(rng, n_layer + 1)
+                x = embed_fn(outer_full, batch_local, keys[n_layer],
+                             train)
+                stacked_flat, block_def = jax.tree.flatten(stacked_local)
+
+                def body(carry, xs):
+                    layer_flat, sec_flat, key = xs
+                    layer_full = gather_block(
+                        jax.tree.unflatten(block_def, layer_flat),
+                        list(sec_flat))
+                    return block_fn(layer_full, carry, batch_local, key,
+                                    train), None
+
+                # checkpoint the body: backward re-runs (and re-gathers)
+                # one layer at a time instead of stashing L gathered
+                # layers — this IS the memory contract
+                x, _ = jax.lax.scan(
+                    jax.checkpoint(body), x,
+                    (stacked_flat, list(sec_stacked), keys[:n_layer]))
+                return head_fn(outer_full, x, batch_local)
+
+            loss_fn = jax.checkpoint(raw_loss, policy=remat_policy) \
+                if remat_policy is not None else raw_loss
+
+            def scaled_loss(p):
+                return loss_fn(p) * loss_scale / gas
+
+            loss_s, grads = jax.value_and_grad(scaled_loss)(params_local)
+            grads = reduce_grads(grads)
+            grads = jax.tree.map(
+                lambda g: g.astype(grad_accum_dtype), grads)
+            new_acc = jax.tree.map(jnp.add, grad_acc_local, grads)
+            loss_avg = jax.lax.psum(loss_s, DATA_AXIS) / n
+            return loss_avg * gas / loss_scale, new_acc
+
+        in_specs = [params_proj, grads_proj, PartitionSpec(), batch_proj,
+                    PartitionSpec()]
+        args = [params, grad_acc, loss_scale, batch, rng]
+        if with_sec:
+            in_specs.append(_sec_specs())
             args.append(secondary)
         shmapped = jax.shard_map(
             inner, mesh=mesh, axis_names={DATA_AXIS},
