@@ -15,6 +15,20 @@ that way: x → [x0, x1]; attention(x0); attention(x1) (x0's psum now
 overlaps x1's attention math); MLP likewise, carrying the halves through
 the residual stream and re-concatenating at the end. Numerically
 identical to the unsplit layer for any batch-pointwise layer function.
+
+Evidence (``tests/unit/runtime/test_domino_hlo.py``), not assertion:
+
+* The split program compiles to two all-reduces with NO dependence path
+  between them, and each has other-half dot ops that are neither its
+  ancestors nor descendants — the scheduler is legally free to overlap
+  (verified on the optimized HLO's def-use graph).
+* Caveat, pinned by test: a backend's all-reduce *combiner* may merge
+  the two half collectives (the CPU backend does at default flags),
+  degenerating Domino to the unsplit schedule — same math and wire, no
+  overlap, no regression. On TPU the combiner is size-thresholded and
+  the latency-hiding scheduler emits async start/done pairs; the
+  ``tpu``-marked test asserts other-half dots are scheduled inside the
+  start..done window on real hardware.
 """
 
 import jax.numpy as jnp
