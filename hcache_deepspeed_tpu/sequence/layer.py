@@ -44,6 +44,28 @@ def _maybe_expand_kv(q, k, v, sp, force_dense=False):
     return k, v
 
 
+def _pad_dim(x, mult, axis):
+    """Zero-pad ``axis`` up to the next multiple of ``mult``."""
+    pad = (-x.shape[axis]) % mult
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _prep_uneven_heads(q, k, v, sp, axis=2):
+    """Head counts not divisible by sp (reference: the uneven head
+    distribution of ``deepspeed/sequence/layer.py:111``): dense-expand
+    GQA k/v, then zero-pad the head dim to the next sp multiple. The
+    padded heads ride the all-to-alls and compute garbage that the
+    caller slices off after the inverse a2a — shapes stay static (XLA-
+    friendly) at < sp/H extra head compute, vs the reference's ragged
+    per-rank head counts."""
+    k, v = _maybe_expand_kv(q, k, v, sp, force_dense=True)
+    return tuple(_pad_dim(x, sp, axis) for x in (q, k, v))
+
+
 def seq_all_to_all(x, axis_name=SEQ_AXIS, scatter_dim=2, gather_dim=1):
     """Explicit all-to-all: split ``scatter_dim`` across the axis, gather
     ``gather_dim``. Equivalent to the reference's ``_SeqAllToAll.forward``
@@ -76,16 +98,27 @@ class DistributedAttention:
             if supports_gqa is None else supports_gqa
 
     def __call__(self, q, k, v, *args, **kwargs):
-        k, v = _maybe_expand_kv(q, k, v,
-                                jax.lax.axis_size(self.axis_name),
-                                force_dense=not self.supports_gqa)
+        sp = jax.lax.axis_size(self.axis_name)
+        H = q.shape[self.scatter_idx]
+        uneven = H % sp != 0
+        if uneven:
+            if self.scatter_idx != 2:
+                raise NotImplementedError(
+                    "uneven head padding assumes heads at dim 2")
+            q, k, v = _prep_uneven_heads(q, k, v, sp)
+        else:
+            k, v = _maybe_expand_kv(q, k, v, sp,
+                                    force_dense=not self.supports_gqa)
         a2a = lambda x: seq_all_to_all(x, self.axis_name, self.scatter_idx,
                                        self.gather_idx)
         out = self.local_attn(a2a(q), a2a(k), a2a(v), *args, **kwargs)
         # inverse: scatter sequence back, gather heads
-        return seq_all_to_all(out, self.axis_name,
-                              scatter_dim=self.gather_idx,
-                              gather_dim=self.scatter_idx)
+        out = seq_all_to_all(out, self.axis_name,
+                             scatter_dim=self.gather_idx,
+                             gather_dim=self.scatter_idx)
+        if uneven:
+            out = jax.lax.slice_in_dim(out, 0, H, axis=self.scatter_idx)
+        return out
 
 
 def ulysses_attention(q, k, v, causal=True, scale=None, topology=None,
@@ -108,7 +141,12 @@ def ulysses_attention(q, k, v, causal=True, scale=None, topology=None,
         k, v = _maybe_expand_kv(q, k, v, 1, force_dense=dense)
         return (local_attn or flash)(q, k, v, causal=causal, scale=scale)
 
-    k, v = _maybe_expand_kv(q, k, v, topo.seq_size, force_dense=dense)
+    H = q.shape[2]
+    uneven = H % topo.seq_size != 0
+    if uneven:
+        q, k, v = _prep_uneven_heads(q, k, v, topo.seq_size)
+    else:
+        k, v = _maybe_expand_kv(q, k, v, topo.seq_size, force_dense=dense)
 
     mesh = topo.mesh
     batch_axes = topo.batch_shard_axes() or None
@@ -122,7 +160,10 @@ def ulysses_attention(q, k, v, causal=True, scale=None, topology=None,
     from ..ops.flash_attention import attention as flash
     out = (local_attn or flash)(qh, kh, vh, causal=causal, scale=scale)
     out = wsc(out, heads)
-    return wsc(out, seqs)
+    out = wsc(out, seqs)
+    if uneven:
+        out = jax.lax.slice_in_dim(out, 0, H, axis=2)
+    return out
 
 
 def make_ulysses_attention_fn(topology=None, local_attn=None):

@@ -223,6 +223,89 @@ class TestUlyssesGQA:
         assert np.isfinite(l1) and l1 < l0
 
 
+class TestUnevenHeads:
+    """H % sp != 0 (reference: deepspeed/sequence/layer.py:111 uneven
+    head distribution): pad-and-mask keeps shapes static; outputs must
+    match dense attention exactly where it counts — the real heads."""
+
+    def test_sharded_form_h6_sp4(self, eight_devices):
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=4,
+                                                                  data=2))
+        q, k, v = _qkv(T=64, H=6)
+        ref = reference_attention(q, k, v, causal=True)
+        seq_sharding = NamedSharding(topo.mesh,
+                                     PartitionSpec(None, "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, seq_sharding) for x in (q, k, v))
+        fn = jax.jit(functools.partial(ulysses_attention, causal=True,
+                                       topology=topo))
+        out = fn(qs, ks, vs)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_shard_map_form_h6_sp4(self, eight_devices):
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=4,
+                                                                  data=2))
+        q, k, v = _qkv(T=64, H=6, seed=3)
+        ref = reference_attention(q, k, v, causal=True)
+        from jax import shard_map
+        dist_attn = DistributedAttention(
+            functools.partial(reference_attention, causal=True))
+        spec = PartitionSpec(None, "seq", None, None)
+        out = shard_map(dist_attn, mesh=topo.mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_uneven_gqa_forces_dense_then_pads(self, eight_devices):
+        """H=6, KV=3, sp=4: compact kv can't split over sp either —
+        expand + pad, still exact."""
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=4,
+                                                                  data=2))
+        rng = np.random.default_rng(7)
+        B, T, H, KV, D = 2, 32, 6, 3, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, KV, D)), jnp.float32)
+        ref = reference_attention(q, k, v, causal=True)
+        from jax import shard_map
+        dist_attn = DistributedAttention(
+            functools.partial(reference_attention, causal=True),
+            supports_gqa=True)
+        spec = PartitionSpec(None, "seq", None, None)
+        out = shard_map(dist_attn, mesh=topo.mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_llama_trains_with_uneven_heads(self, eight_devices):
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM,
+                                                       llama_tiny)
+        topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=4,
+                                                                  data=2))
+        cfg = llama_tiny(hidden_size=96, intermediate_size=192,
+                         n_head=6, n_kv_head=6)   # 6 heads, sp=4
+        attention_fn = make_ulysses_attention_fn(topology=topo)
+        model = LlamaForCausalLM(cfg, attention_fn=attention_fn)
+        rng = np.random.default_rng(11)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, (4, 64),
+                                           dtype=np.int32)}
+        engine, _, _, _ = hds.initialize(
+            model=model, example_batch=batch, topology=topo,
+            config={"train_batch_size": 4,
+                    "train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                    "zero_optimization": {"stage": 2,
+                                          "min_shard_size": 1}})
+        l0 = float(engine.train_batch(batch=batch))
+        for _ in range(4):
+            l1 = float(engine.train_batch(batch=batch))
+        assert np.isfinite(l1) and l1 < l0
+
+
 class TestSPCrossEntropy:
     def test_matches_dense(self, eight_devices):
         topo = topo_mod.initialize_topology(topo_mod.TopologySpec(seq=8))
