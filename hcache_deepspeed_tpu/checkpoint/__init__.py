@@ -1,5 +1,7 @@
 """Checkpoint tooling (reference: ``deepspeed/checkpoint/``)."""
 
+from .ds_import import (ds_to_universal,  # noqa: F401
+                        load_ds_fp32_state_dict)
 from .universal import (checkpoint_info,  # noqa: F401
                         convert_zero_checkpoint_to_fp32_state_dict,
                         get_fp32_state_dict_from_zero_checkpoint,

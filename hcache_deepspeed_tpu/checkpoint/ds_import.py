@@ -1,0 +1,269 @@
+"""Importer for reference-format (DeepSpeed) ZeRO checkpoints.
+
+Reference analogs:
+* ``deepspeed/utils/zero_to_fp32.py`` — the shard-merging protocol this
+  module re-implements in numpy (``parse_model_states`` :102,
+  ``parse_optim_states`` :148, zero-2 merge :255 with the
+  ``2*world_size`` group alignment :300, zero-3 merge :437 with
+  per-param ``ceil(numel/world)`` partitions :348, frozen fragments
+  :355, shared-param recovery :340),
+* ``deepspeed/checkpoint/ds_to_universal.py:469`` — the offline
+  zero-shards→universal conversion whose capability this provides for
+  *foreign* checkpoints (our own checkpoints are already universal —
+  see ``universal.py``).
+
+Purpose: the "drop-in replacement" story. A team with existing
+reference-format training checkpoints can consolidate them to fp32 host
+arrays and/or write them into this repo's universal (orbax) layout,
+then map names into a model tree (``checkpoint/hf_loader`` for HF-style
+module names) and resume under any topology.
+
+Torch is used only to unpickle ``.pt`` shard files (torch-cpu is a
+baked-in dependency); all merging is numpy. Tensor-parallel reference
+checkpoints (``mp_rank_01+``) are out of scope — convert those with the
+reference's own tooling first; this importer handles the dominant
+``mp_rank_00`` (pure ZeRO-DP) layout and raises otherwise.
+"""
+
+import glob
+import math
+import os
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MODEL_FILE_PATTERN = "*_model_states.pt"
+OPTIM_FILE_PATTERN = "*_optim_states.pt"
+
+# shard-file keys (names fixed by the reference format,
+# deepspeed/checkpoint/constants.py)
+_OPTIMIZER_STATE_DICT = "optimizer_state_dict"
+_ZERO_STAGE = "zero_stage"
+_PARTITION_COUNT = "partition_count"
+_SINGLE_PARTITION = "single_partition_of_fp32_groups"
+_FLAT_GROUPS = "fp32_flat_groups"
+_PARAM_SHAPES = "param_shapes"
+_BUFFER_NAMES = "buffer_names"
+_FROZEN_SHAPES = "frozen_param_shapes"
+_FROZEN_FRAGMENTS = "frozen_param_fragments"
+
+
+def _natural_sorted(files: List[str]) -> List[str]:
+    def key(path):
+        return [int(t) if t.isdigit() else t
+                for t in re.split(r"(\d+)", os.path.basename(path))]
+    return sorted(files, key=key)
+
+
+def _torch_load(path):
+    import torch
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_np(t) -> np.ndarray:
+    return np.asarray(t.detach().float().numpy()
+                      if hasattr(t, "detach") else t, np.float32)
+
+
+def _numel(shape) -> int:
+    return int(shape.numel() if hasattr(shape, "numel")
+               else math.prod(tuple(shape)))
+
+
+def _shape_tuple(shape):
+    return tuple(int(d) for d in shape)
+
+
+def _find_files(ds_dir: str, pattern: str) -> List[str]:
+    files = _natural_sorted(glob.glob(os.path.join(ds_dir, pattern)))
+    if not files:
+        raise FileNotFoundError(
+            f"no {pattern} files under {ds_dir} — not a reference-format "
+            "zero checkpoint dir (expected mp_rank_*_model_states.pt + "
+            "zero_pp_rank_*_optim_states.pt)")
+    return files
+
+
+def _check_single_mp(files: List[str]):
+    mp_ranks = {re.search(r"mp_rank_(\d+)", os.path.basename(f)).group(1)
+                for f in files if "mp_rank_" in os.path.basename(f)}
+    if mp_ranks - {"00"}:
+        raise NotImplementedError(
+            f"tensor-parallel reference checkpoint (mp ranks {sorted(mp_ranks)}); "
+            "consolidate TP with the reference tooling first — this "
+            "importer handles the pure ZeRO-DP mp_rank_00 layout")
+
+
+def load_ds_fp32_state_dict(ds_dir: str,
+                            exclude_frozen: bool = False
+                            ) -> Dict[str, np.ndarray]:
+    """Reference zero-shard checkpoint dir → ``{dotted_name: fp32 array}``
+    (the reference's ``get_fp32_state_dict_from_zero_checkpoint``, for
+    import instead of export)."""
+    model_files = _find_files(ds_dir, MODEL_FILE_PATTERN)
+    optim_files = _find_files(ds_dir, OPTIM_FILE_PATTERN)
+    _check_single_mp(model_files + optim_files)
+
+    model_state = _torch_load(model_files[0])
+    if _BUFFER_NAMES not in model_state:
+        raise ValueError(f"{model_files[0]} is not a reference model-state "
+                         f"shard (missing '{_BUFFER_NAMES}')")
+    optim_states = [_torch_load(f) for f in optim_files]
+    osd0 = optim_states[0][_OPTIMIZER_STATE_DICT]
+    if _ZERO_STAGE not in osd0:
+        raise ValueError(f"{optim_files[0]} is not a zero checkpoint")
+    stage = int(osd0[_ZERO_STAGE])
+    world = osd0[_PARTITION_COUNT]
+    if isinstance(world, list):
+        world = max(world)
+    world = int(world)
+    if world != len(optim_files):
+        raise ValueError(f"checkpoint says partition_count={world} but "
+                         f"{len(optim_files)} optim shards found")
+
+    param_shapes = model_state[_PARAM_SHAPES]
+    out: Dict[str, np.ndarray] = {}
+
+    # buffers are stored whole in the module state dict
+    for name in model_state[_BUFFER_NAMES]:
+        out[name] = _to_np(model_state["module"][name])
+
+    frozen_shapes = model_state.get(_FROZEN_SHAPES) or {}
+    if frozen_shapes and not exclude_frozen:
+        _merge_frozen(out, stage,
+                      [model_state] + [_torch_load(f)
+                                       for f in model_files[1:]],
+                      frozen_shapes, world)
+
+    if stage <= 2:
+        groups = [[_to_np(g) for g in s[_OPTIMIZER_STATE_DICT][_SINGLE_PARTITION]]
+                  for s in optim_states]
+        _merge_zero2(out, param_shapes, groups, world)
+    elif stage == 3:
+        flat = [s[_OPTIMIZER_STATE_DICT][_FLAT_GROUPS]
+                for s in optim_states]
+        flat = [[_to_np(g) for g in (fg if isinstance(fg, (list, tuple))
+                                     else [fg])] for fg in flat]
+        _merge_zero3(out, param_shapes, flat, world)
+    else:
+        raise ValueError(f"unknown zero stage {stage}")
+
+    # shared (tied) parameters point at their source param
+    shared = model_state.get("shared_params") or {}
+    pairs = shared.items() if isinstance(shared, dict) else shared
+    for name, src in pairs:
+        if src in out:
+            out[name] = out[src]
+    return out
+
+
+def _merge_frozen(out, stage, model_states, frozen_shapes, world):
+    """Frozen params live in the model-state shards, not the optimizer
+    (zero_to_fp32.py:225 / :355). Stage<=2 stores them whole; stage 3
+    stores per-rank fragments — but with a single mp rank all fragments
+    sit in the one model file only for stage<=2, so a stage-3 frozen
+    import needs every zero_pp model shard (callers pass what exists)."""
+    fragments = [ms.get(_FROZEN_FRAGMENTS) or {} for ms in model_states]
+    for name, shape in frozen_shapes.items():
+        if stage <= 2:
+            out[name] = _to_np(fragments[0][name]).reshape(
+                _shape_tuple(shape))
+        else:
+            parts = [_to_np(f[name]).reshape(-1) for f in fragments]
+            merged = np.concatenate(parts)[:_numel(shape)]
+            out[name] = merged.reshape(_shape_tuple(shape))
+
+
+def _merge_zero2(out, param_shapes, groups, world):
+    """Stage 1/2: per param group, concat each rank's single fp32
+    partition, then slice params in declaration order; group totals
+    align to 2*world_size (zero_to_fp32.py:300)."""
+    align = 2 * world
+    n_groups = len(groups[0])
+    for g in range(n_groups):
+        flat = np.concatenate([groups[r][g] for r in range(len(groups))])
+        offset = 0
+        for name, shape in param_shapes[g].items():
+            n = _numel(shape)
+            out[name] = flat[offset:offset + n].reshape(
+                _shape_tuple(shape)).copy()
+            offset += n
+        aligned = align * math.ceil(offset / align)
+        avail = align * math.ceil(flat.size / align)
+        if aligned != avail:
+            raise ValueError(
+                f"group {g}: consumed {offset} of {flat.size} numels — "
+                "corrupt or mismatched checkpoint")
+
+
+def _merge_zero3(out, param_shapes, flat_groups, world):
+    """Stage 3: each param is partitioned ceil(numel/world) per rank
+    (zero_to_fp32.py:348); rank-local flat groups concatenate params'
+    partitions in declaration order, possibly spanning sub-group
+    boundaries (the GatheredTensor walk, :390)."""
+    merged_shapes = {k: v for d in param_shapes for k, v in d.items()}
+    # per-rank concatenation flattens the sub-group structure
+    rank_flat = [np.concatenate([g.reshape(-1) for g in flat_groups[r]])
+                 for r in range(world)]
+    offset = 0
+    for name, shape in merged_shapes.items():
+        n = _numel(shape)
+        part = math.ceil(n / world)
+        parts = [rank_flat[r][offset:offset + part] for r in range(world)]
+        merged = np.concatenate(parts)[:n]
+        out[name] = merged.reshape(_shape_tuple(shape)).copy()
+        offset += part
+    avail = rank_flat[0].size
+    if offset != avail:
+        raise ValueError(f"consumed {offset} of {avail} per-rank numels — "
+                         "corrupt or mismatched checkpoint")
+
+
+def _nest(flat: Dict[str, np.ndarray]) -> Dict:
+    tree: Dict = {}
+    for name, arr in flat.items():
+        node = tree
+        parts = name.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def ds_to_universal(ds_dir: str, out_dir: str, tag: str = "ds_import",
+                    exclude_frozen: bool = False) -> str:
+    """Convert a reference zero checkpoint into this repo's universal
+    (orbax) layout: ``out_dir/<tag>/state`` + ``latest`` tag file —
+    readable by ``universal.load_state_tree`` and restorable under any
+    mesh (reference: ``ds_to_universal.py:469``). Returns ``out_dir``."""
+    import orbax.checkpoint as ocp
+    state = load_ds_fp32_state_dict(ds_dir, exclude_frozen=exclude_frozen)
+    tree = _nest(state)
+    path = os.path.abspath(os.path.join(out_dir, tag, "state"))
+    ocp.PyTreeCheckpointer().save(path, tree)
+    with open(os.path.join(out_dir, "latest"), "w") as fh:
+        fh.write(tag)
+    return out_dir
+
+
+def main(argv: Optional[List[str]] = None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Convert a reference (DeepSpeed) zero-shard "
+                    "checkpoint to the universal orbax layout")
+    ap.add_argument("ds_dir", help="reference checkpoint tag dir "
+                                   "(contains *_model_states.pt)")
+    ap.add_argument("out_dir")
+    ap.add_argument("--tag", default="ds_import")
+    ap.add_argument("--exclude-frozen", action="store_true")
+    args = ap.parse_args(argv)
+    ds_to_universal(args.ds_dir, args.out_dir, tag=args.tag,
+                    exclude_frozen=args.exclude_frozen)
+    print(f"wrote universal checkpoint {args.out_dir}/{args.tag}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

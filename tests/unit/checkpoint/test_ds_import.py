@@ -1,0 +1,162 @@
+"""Reference-format (DeepSpeed) zero checkpoint importer
+(reference: ``deepspeed/utils/zero_to_fp32.py`` merge protocol,
+``deepspeed/checkpoint/ds_to_universal.py:469``).
+
+Fixtures are written in the reference's exact on-disk layout (file
+names, dict keys, flat-group partitioning incl. the stage-2
+``2*world_size`` alignment and stage-3 ``ceil(numel/world)`` padding)
+using torch, then imported and checked against the known param values.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from hcache_deepspeed_tpu.checkpoint import (ds_to_universal,
+                                             load_ds_fp32_state_dict,
+                                             load_state_tree)
+
+WORLD = 2
+
+# two param groups, shapes chosen so nothing divides evenly: group0 has
+# 12 + 5 = 17 numels (aligns to 20 at 2*world=4), group1 has 6 (pads to 8)
+PARAMS = {
+    "transformer.w1": np.arange(12, dtype=np.float32).reshape(3, 4),
+    "transformer.b1": np.arange(12, 17, dtype=np.float32),
+    "head.w2": np.arange(20, 26, dtype=np.float32).reshape(2, 3),
+}
+GROUPS = [["transformer.w1", "transformer.b1"], ["head.w2"]]
+BUFFER = np.float32([7.0, 8.0])
+
+
+def _model_state_file(tmp, shared=None, module_extra=None):
+    module = {k: torch.tensor(v) for k, v in PARAMS.items()}
+    module["pos.buf"] = torch.tensor(BUFFER)
+    module.update(module_extra or {})
+    state = {
+        "module": module,
+        "buffer_names": ["pos.buf"],
+        "param_shapes": [
+            {name: torch.Size(PARAMS[name].shape) for name in g}
+            for g in GROUPS],
+        "shared_params": shared or {},
+        "ds_version": "0.16.8",
+    }
+    torch.save(state, os.path.join(tmp, "mp_rank_00_model_states.pt"))
+
+
+def _optim_file(tmp, rank, osd):
+    torch.save({"optimizer_state_dict": osd}, os.path.join(
+        tmp, f"zero_pp_rank_{rank}_mp_rank_00_optim_states.pt"))
+
+
+def _write_stage2(tmp, shared=None):
+    """Each group: flat params padded to 2*world alignment, split into
+    equal per-rank partitions (zero_to_fp32.py:300)."""
+    _model_state_file(tmp, shared=shared)
+    align = 2 * WORLD
+    partitions = {r: [] for r in range(WORLD)}
+    for g in GROUPS:
+        flat = np.concatenate([PARAMS[n].reshape(-1) for n in g])
+        padded = np.zeros(align * math.ceil(flat.size / align), np.float32)
+        padded[:flat.size] = flat
+        per = padded.size // WORLD
+        for r in range(WORLD):
+            partitions[r].append(torch.tensor(padded[r * per:(r + 1) * per]))
+    for r in range(WORLD):
+        _optim_file(tmp, r, {
+            "zero_stage": 2,
+            "partition_count": WORLD,
+            "single_partition_of_fp32_groups": partitions[r],
+        })
+
+
+def _write_stage3(tmp, n_subgroups=1):
+    """Each param partitioned ceil(numel/world) per rank; rank-local
+    flat groups concatenate the partitions in declaration order
+    (zero_to_fp32.py:348,:437), optionally split into sub-groups."""
+    _model_state_file(tmp)
+    order = [n for g in GROUPS for n in g]
+    rank_flat = {r: [] for r in range(WORLD)}
+    for name in order:
+        flat = PARAMS[name].reshape(-1)
+        part = math.ceil(flat.size / WORLD)
+        padded = np.zeros(part * WORLD, np.float32)
+        padded[:flat.size] = flat
+        for r in range(WORLD):
+            rank_flat[r].append(padded[r * part:(r + 1) * part])
+    for r in range(WORLD):
+        whole = np.concatenate(rank_flat[r])
+        pieces = np.array_split(whole, n_subgroups)
+        _optim_file(tmp, r, {
+            "zero_stage": 3,
+            "partition_count": WORLD,
+            "fp32_flat_groups": [torch.tensor(p) for p in pieces],
+        })
+
+
+def _check_params(state):
+    for name, want in PARAMS.items():
+        np.testing.assert_array_equal(state[name], want, err_msg=name)
+    np.testing.assert_array_equal(state["pos.buf"], BUFFER)
+
+
+class TestDsImport:
+
+    def test_stage2_roundtrip(self, tmp_path):
+        _write_stage2(str(tmp_path))
+        _check_params(load_ds_fp32_state_dict(str(tmp_path)))
+
+    def test_stage3_roundtrip(self, tmp_path):
+        _write_stage3(str(tmp_path))
+        _check_params(load_ds_fp32_state_dict(str(tmp_path)))
+
+    def test_stage3_subgroup_boundaries(self, tmp_path):
+        """A param partition spanning rank-local sub-group boundaries
+        (the GatheredTensor walk, zero_to_fp32.py:390)."""
+        _write_stage3(str(tmp_path), n_subgroups=3)
+        _check_params(load_ds_fp32_state_dict(str(tmp_path)))
+
+    def test_shared_params_recovered(self, tmp_path):
+        _write_stage2(str(tmp_path),
+                      shared={"lm_head.tied": "transformer.w1"})
+        state = load_ds_fp32_state_dict(str(tmp_path))
+        np.testing.assert_array_equal(state["lm_head.tied"],
+                                      PARAMS["transformer.w1"])
+
+    def test_world_size_mismatch_rejected(self, tmp_path):
+        _write_stage2(str(tmp_path))
+        os.remove(os.path.join(
+            str(tmp_path), "zero_pp_rank_1_mp_rank_00_optim_states.pt"))
+        with pytest.raises(ValueError, match="partition_count"):
+            load_ds_fp32_state_dict(str(tmp_path))
+
+    def test_tp_checkpoint_rejected(self, tmp_path):
+        _write_stage2(str(tmp_path))
+        open(os.path.join(str(tmp_path),
+                          "mp_rank_01_model_states.pt"), "w").close()
+        with pytest.raises(NotImplementedError, match="mp_rank_00"):
+            load_ds_fp32_state_dict(str(tmp_path))
+
+    def test_not_a_checkpoint(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="zero checkpoint"):
+            load_ds_fp32_state_dict(str(tmp_path))
+
+    def test_to_universal_layout(self, tmp_path):
+        """Converted checkpoint reads back through the repo's own
+        universal loader with dotted names nested into a tree."""
+        ds = tmp_path / "ds"
+        out = tmp_path / "uni"
+        ds.mkdir()
+        _write_stage3(str(ds))
+        ds_to_universal(str(ds), str(out))
+        tree = load_state_tree(str(out))
+        np.testing.assert_array_equal(tree["transformer"]["w1"],
+                                      PARAMS["transformer.w1"])
+        np.testing.assert_array_equal(tree["head"]["w2"],
+                                      PARAMS["head.w2"])
+        np.testing.assert_array_equal(tree["pos"]["buf"], BUFFER)
