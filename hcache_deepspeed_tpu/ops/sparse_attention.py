@@ -68,6 +68,56 @@ def make_bigbird_layout(n_blocks: int, local_window: int = 1,
     return lay
 
 
+def make_variable_layout(n_blocks: int,
+                         local_window_blocks=(4,),
+                         global_block_indices=(0,),
+                         global_block_end_indices=None,
+                         num_random: int = 0,
+                         causal: bool = True,
+                         horizontal_global: bool = False,
+                         seed: int = 0) -> np.ndarray:
+    """The reference's 'variable' pattern
+    (``sparsity_config.py VariableSparsityConfig``): consecutive local
+    windows of per-window sizes (the last size repeats for the rest of
+    the sequence), explicit global blocks (single indices, or
+    [start, end) ranges when ``global_block_end_indices`` is given),
+    optional random blocks per block row, and — bidirectional only —
+    ``horizontal_global`` making global blocks attend to everything."""
+    lay = np.zeros((n_blocks, n_blocks), bool)
+    # local windows: blocks inside one window attend within the window
+    sizes = list(local_window_blocks) or [1]
+    start = 0
+    w = 0
+    while start < n_blocks:
+        size = sizes[min(w, len(sizes) - 1)]
+        end = min(start + size, n_blocks)
+        lay[start:end, start:end] = True
+        start = end
+        w += 1
+    # global columns (and rows when horizontal+bidirectional)
+    if global_block_end_indices is None:
+        spans = [(g, g + 1) for g in global_block_indices]
+    else:
+        if len(global_block_end_indices) != len(global_block_indices):
+            raise ValueError(
+                "global_block_end_indices must pair 1:1 with "
+                "global_block_indices")
+        spans = list(zip(global_block_indices, global_block_end_indices))
+    for lo, hi in spans:
+        lay[:, lo:hi] = True
+        if horizontal_global and not causal:
+            lay[lo:hi, :] = True
+    if num_random:
+        rng = np.random.default_rng(seed)
+        for i in range(n_blocks):
+            hi = i + 1 if causal else n_blocks
+            if hi > 0:
+                lay[i, rng.integers(0, hi, size=num_random)] = True
+    if causal:
+        lay &= np.tril(np.ones((n_blocks, n_blocks), bool))
+    return lay
+
+
 # ------------------------------------------------------------------ #
 # Attention
 # ------------------------------------------------------------------ #

@@ -172,6 +172,14 @@ class TestSparseAttention:
         ("make_fixed_layout", {"local_window": 1, "global_stride": 3}),
         ("make_bigbird_layout", {"local_window": 1, "num_global": 1,
                                  "num_random": 1}),
+        ("make_variable_layout", {"local_window_blocks": (2, 3),
+                                  "global_block_indices": (0, 5),
+                                  "num_random": 1}),
+        ("make_variable_layout", {"local_window_blocks": (2,),
+                                  "global_block_indices": (0, 4),
+                                  "global_block_end_indices": (2, 6),
+                                  "causal": False,
+                                  "horizontal_global": True}),
     ])
     def test_matches_dense_oracle(self, builder, kw):
         from hcache_deepspeed_tpu.ops import sparse_attention as sa
