@@ -92,6 +92,20 @@ CONFIGS = {
                            loss_chunk=0),
     "350m-vpad-b8": dict(batch=8, n_head=16, vocab_size=50304,
                          loss_chunk=0),
+    # Llama-7B layer microbench (BASELINE north star = ZeRO-3
+    # Llama-2-7B): real 7B block shapes (h=4096, ffn=11008, 32 heads x
+    # head_dim 128) at 2 layers + tiny vocab, the closest single-chip
+    # proxy for per-layer training MFU + HBM headroom at 7B widths.
+    # fp32 master+Adam for 2 blocks ~ 4.9 GB + bf16 params + remat'd
+    # activations fits a 16 GB chip; vet via HDS_BENCH_CHILD.
+    "7b-layer-seq2k-b2": dict(model="llama", batch=2, seq=2048,
+                              hidden=4096, ffn=11008, n_head=32,
+                              n_layer=2, vocab_size=4096,
+                              loss_chunk=256, remat=True),
+    "7b-layer-seq4k-b1": dict(model="llama", batch=1, seq=4096,
+                              hidden=4096, ffn=11008, n_head=32,
+                              n_layer=2, vocab_size=4096,
+                              loss_chunk=256, remat=True),
 }
 
 
@@ -200,6 +214,22 @@ def run_config(name):
         batch, seq = 2, 128
         mcfg = GPT2Config(n_layer=2, n_embd=64, n_head=4, n_positions=seq,
                           vocab_size=256, dtype="bfloat16", remat=False)
+        model = GPT2LMHeadModel(mcfg)
+    elif CONFIGS[name].get("model") == "llama":
+        from hcache_deepspeed_tpu.models.llama import (LlamaConfig,
+                                                       LlamaForCausalLM)
+        spec = CONFIGS[name]
+        batch, seq = spec["batch"], spec["seq"]
+        mcfg = LlamaConfig(vocab_size=spec["vocab_size"],
+                           hidden_size=spec["hidden"],
+                           intermediate_size=spec["ffn"],
+                           n_layer=spec["n_layer"],
+                           n_head=spec["n_head"],
+                           n_kv_head=spec["n_head"],
+                           max_positions=seq, dtype="bfloat16",
+                           remat=spec.get("remat", False),
+                           loss_chunk=spec["loss_chunk"])
+        model = LlamaForCausalLM(mcfg)
     else:
         spec = CONFIGS[name]
         batch, seq = spec["batch"], spec.get("seq", 1024)
@@ -209,7 +239,7 @@ def run_config(name):
                           loss_chunk=spec["loss_chunk"],
                           flash_block_q=spec.get("block_q", 0),
                           flash_block_k=spec.get("block_k", 0))
-    model = GPT2LMHeadModel(mcfg)
+        model = GPT2LMHeadModel(mcfg)
     rng = np.random.default_rng(0)
     # clamp below every config's vocab so the sampled batch is identical
     # across padded-vocab variants
@@ -247,7 +277,8 @@ def run_config(name):
     tokens_per_sec = steps * batch * seq / dt
     n_params = sum(x.size for x in jax.tree.leaves(engine.state["params"]))
     # 6N (fwd+bwd) weight FLOPs + 12*L*S*d attention FLOPs per token
-    flops_per_token = 6 * n_params + 12 * mcfg.n_layer * seq * mcfg.n_embd
+    width = getattr(mcfg, "n_embd", 0) or mcfg.hidden_size
+    flops_per_token = 6 * n_params + 12 * mcfg.n_layer * seq * width
     achieved_tflops = tokens_per_sec * flops_per_token / 1e12
     peak = get_platform().peak_tflops("bfloat16")
     mfu = achieved_tflops / peak if peak else 0.0
