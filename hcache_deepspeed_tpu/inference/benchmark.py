@@ -345,7 +345,8 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
             row_extra = {"prefix_stats": {
                 k: eng.prefix_stats[k] - stats0[k]
                 for k in eng.prefix_stats}}
-        emit({"phase": "sweep", "offered_rps": rps, **row_extra,
+        emit({"phase": "sweep", "decode_path": "host-driven",
+              "offered_rps": rps, **row_extra,
               "effective_rps": round(n_requests / makespan, 3),
               "ttft_s": {"p50": percentile(
                   [s["first"] for s in state.values()], 50),
@@ -355,6 +356,81 @@ def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
                   [s["end"] for s in state.values()], 50),
                   "p90": percentile(
                       [s["end"] for s in state.values()], 90)},
+              "gen_tokens_per_sec": round(
+                  n_requests * max_new / makespan, 1)})
+    return results
+
+
+def run_sweep_fused(model_size="tiny", max_context=512, prompt_len=128,
+                    max_new=32, rates=(1.0, 2.0, 4.0), n_requests=16,
+                    max_batch=8, seed=0, quantize="", prefill_chunk=0):
+    """Throughput-latency curve on the on-device ``generate_fused``
+    loop, batch-synchronous: arrived requests form a wave (up to
+    max_batch), the whole wave decodes on device in ONE program, and
+    arrivals during a wave queue for the next one.
+
+    Honesty notes vs :func:`run_sweep` (rows carry ``decode_path`` so
+    artifacts can't be conflated): no mid-stretch admission — this is a
+    different scheduling discipline than continuous batching, traded
+    for one host sync per wave instead of per token. Through a
+    high-RTT tunnel this is the path whose absolute numbers mean
+    anything; TTFT is not separable on-device, so rows report
+    end-to-end latency (queue wait + wave) only."""
+    results = []
+    emit = functools.partial(_emit, results)
+    cfg, eng = _engine(model_size, max_context, max_batch,
+                       quantize=quantize, prefill_chunk=prefill_chunk)
+    rng = np.random.default_rng(seed)
+    if prompt_len + max_new - 1 > min(max_context, cfg.max_positions):
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new {max_new} exceeds "
+            f"max_context {max_context}")
+
+    def percentile(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)), 3)
+
+    # warm every decode-lane bucket a wave can produce (n_steps and the
+    # lane bucket are the static args; a compile inside the timed loop
+    # would corrupt that rate's percentiles)
+    warm_prompt = list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+    k = 1
+    warm_counts = []
+    while k < max_batch:
+        warm_counts.append(k)
+        k *= 2
+    warm_counts.append(max_batch)
+    for k in warm_counts:
+        eng.generate_fused([warm_prompt] * k, max_new_tokens=max_new)
+
+    for rps in rates:
+        prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+                   for _ in range(n_requests)]
+        arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
+        pending = list(range(n_requests))
+        e2e = {}
+        waves = 0
+        t0 = time.perf_counter()
+        while pending:
+            now = time.perf_counter() - t0
+            ready = [i for i in pending if arrive[i] <= now]
+            if not ready:
+                time.sleep(max(0.0, arrive[pending[0]] -
+                               (time.perf_counter() - t0)))
+                continue
+            wave = ready[:max_batch]
+            eng.generate_fused([prompts[i] for i in wave],
+                               max_new_tokens=max_new)
+            done_at = time.perf_counter() - t0
+            for i in wave:
+                e2e[i] = done_at - arrive[i]
+                pending.remove(i)
+            waves += 1
+        makespan = max(e2e[i] + arrive[i] for i in e2e)
+        emit({"phase": "sweep-fused", "decode_path": "fused",
+              "offered_rps": rps, "waves": waves,
+              "effective_rps": round(n_requests / makespan, 3),
+              "e2e_s": {"p50": percentile(list(e2e.values()), 50),
+                        "p90": percentile(list(e2e.values()), 90)},
               "gen_tokens_per_sec": round(
                   n_requests * max_new / makespan, 1)})
     return results
@@ -481,7 +557,16 @@ def main(argv=None):
                         "instead of host-driven per-step decode")
     args = p.parse_args(argv)
     # rows print as produced (partial results survive an OOM/crash)
-    if args.sweep:
+    if args.sweep and args.fused_decode:
+        if args.prefix_caching:
+            raise SystemExit("--prefix-caching requires the host-driven "
+                             "sweep (fused waves reserve whole stretches)")
+        run_sweep_fused(args.model, args.max_context, args.prompt_len,
+                        max_new=args.max_new, rates=tuple(args.rps),
+                        n_requests=args.n_requests,
+                        max_batch=args.max_batch, quantize=args.quantize,
+                        prefill_chunk=args.prefill_chunk)
+    elif args.sweep:
         run_sweep(args.model, args.max_context, args.prompt_len,
                   max_new=args.max_new, rates=tuple(args.rps),
                   n_requests=args.n_requests, max_batch=args.max_batch,

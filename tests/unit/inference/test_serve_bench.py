@@ -34,6 +34,19 @@ def test_serve_bench_sweep():
     assert row["gen_tokens_per_sec"] > 0
 
 
+def test_serve_bench_sweep_fused():
+    from hcache_deepspeed_tpu.inference.benchmark import run_sweep_fused
+    rows = run_sweep_fused(model_size="tiny", max_context=128,
+                           prompt_len=16, max_new=4, rates=(50.0,),
+                           n_requests=5, max_batch=4)
+    (row,) = rows
+    assert row["phase"] == "sweep-fused"
+    assert row["decode_path"] == "fused"
+    assert row["effective_rps"] > 0
+    assert row["waves"] >= 2   # 5 requests, max_batch 4
+    assert row["gen_tokens_per_sec"] > 0
+
+
 def test_serve_bench_restore_mode():
     from hcache_deepspeed_tpu.inference.benchmark import run_restore
     rows = run_restore(model_size="tiny", max_context=128, prompt_len=16,
