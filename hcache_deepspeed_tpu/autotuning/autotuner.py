@@ -47,12 +47,23 @@ class Autotuner:
                  micro_batch_sizes: List[int],
                  zero_stages: List[int] = (0,),
                  remat: List[bool] = (False,),
+                 extra_space: Optional[Dict[str, List]] = None,
                  warmup_steps: int = 2, measure_steps: int = 4):
+        """``extra_space`` adds arbitrary axes to the sweep product —
+        e.g. ``{"flash_block_q": [256, 512], "flash_block_k": [512,
+        1024]}`` to tune the flash kernel's MXU tiling per shape (the
+        bench winner's ``blk*`` variants, vetted in one sweep instead
+        of one chip session each)."""
         self.run_fn = run_fn
+        extra = dict(extra_space or {})
+        extra_keys = list(extra)
         self.space = [
-            {"micro_batch": mb, "zero_stage": z, "remat": r}
+            dict({"micro_batch": mb, "zero_stage": z, "remat": r},
+                 **dict(zip(extra_keys, vals)))
             for mb, z, r in itertools.product(micro_batch_sizes,
                                               zero_stages, remat)
+            for vals in itertools.product(
+                *[extra[k] for k in extra_keys])
         ]
         self.warmup_steps = warmup_steps
         self.measure_steps = measure_steps
@@ -87,10 +98,16 @@ class Autotuner:
         return best
 
     def summary(self) -> str:
-        lines = [f"{'micro':>6} {'zero':>5} {'remat':>6} {'samples/s':>10}"]
+        extra_keys = [k for k in (self.space[0] if self.space else {})
+                      if k not in ("micro_batch", "zero_stage", "remat")]
+        head = f"{'micro':>6} {'zero':>5} {'remat':>6}" + "".join(
+            f" {k:>14}" for k in extra_keys) + f" {'samples/s':>10}"
+        lines = [head]
         for r in self.results:
             tput = f"{r.throughput:.1f}" if r.ok else r.error
-            lines.append(
-                f"{r.config['micro_batch']:>6} {r.config['zero_stage']:>5} "
-                f"{str(r.config['remat']):>6} {tput:>10}")
+            row = (f"{r.config['micro_batch']:>6} "
+                   f"{r.config['zero_stage']:>5} "
+                   f"{str(r.config['remat']):>6}")
+            row += "".join(f" {str(r.config[k]):>14}" for k in extra_keys)
+            lines.append(row + f" {tput:>10}")
         return "\n".join(lines)

@@ -80,3 +80,26 @@ class TestAutotuner:
         tuner = Autotuner(run_fn, micro_batch_sizes=[4])
         with pytest.raises(RuntimeError, match="no viable config"):
             tuner.tune()
+
+    def test_extra_space_axes(self):
+        """Arbitrary sweep axes (e.g. flash tiling) join the product
+        and the winner carries them."""
+        import time
+
+        def run_fn(cand):
+            def step():
+                fast = cand["flash_block_q"] == 512 and \
+                    cand["flash_block_k"] == 1024
+                time.sleep(0.001 if fast else 0.004)
+            return step
+
+        tuner = Autotuner(
+            run_fn, micro_batch_sizes=[8],
+            extra_space={"flash_block_q": [256, 512],
+                         "flash_block_k": [512, 1024]},
+            warmup_steps=1, measure_steps=2)
+        assert len(tuner.space) == 4
+        best = tuner.tune()
+        assert (best.config["flash_block_q"],
+                best.config["flash_block_k"]) == (512, 1024)
+        assert "flash_block_q" in tuner.summary()
