@@ -660,6 +660,149 @@ class InferenceEngineV2:
                                    for l in logprobs]
         return outs, latents
 
+    @staticmethod
+    def _lookup_draft(history, ngram: int, k: int):
+        """Prompt-lookup drafting: find the most recent PRIOR occurrence
+        of the trailing ``ngram`` tokens and propose the ``k`` tokens
+        that followed it (PLD/"prompt lookup decoding" — no draft
+        model; the sequence's own history is the proposer)."""
+        n = len(history)
+        if n < ngram + 1:
+            return []
+        arr = np.asarray(history, np.int64)
+        key = arr[-ngram:]
+        # windows ending strictly before the trailing ngram itself
+        limit = n - ngram
+        if limit <= 0:
+            return []
+        windows = np.lib.stride_tricks.sliding_window_view(
+            arr[:n - 1], ngram)[:limit]
+        hits = np.flatnonzero((windows == key).all(axis=1))
+        if hits.size == 0:
+            return []
+        i = int(hits[-1]) + ngram      # first token after the match
+        return [int(t) for t in arr[i:i + k]]
+
+    def generate_lookup(self, prompts, max_new_tokens: int = 32,
+                        ngram: int = 2, max_draft: int = 8,
+                        eos_token_id: int = None):
+        """Greedy generation with prompt-lookup speculative decoding.
+
+        Beyond-reference feature (FastGen has no speculative path): each
+        step drafts up to ``max_draft`` tokens from the sequence's own
+        history (:meth:`_lookup_draft`), verifies the whole stretch in
+        ONE batched dispatch via the tail-logits forward
+        (``model.forward_chunk_tail``), accepts the matching prefix plus
+        the bonus token, and rolls rejected draft KV back
+        (``SequenceDescriptor.rollback`` — slots past ``seen_tokens``
+        are never read and get overwritten by the next dispatch). Every
+        dispatch has the same static shape (lane bucket × (1+max_draft)),
+        so the whole generation reuses one compiled program. Exact:
+        output is identical to token-by-token greedy decode; on
+        repetitive text each dispatch yields up to ``max_draft+1``
+        tokens instead of 1.
+
+        Returns ``(outs, stats)`` with
+        ``stats = {drafted, accepted, dispatches, tokens}``.
+        """
+        if self.prefix_caching:
+            raise ValueError(
+                "generate_lookup with prefix_caching is unsupported: "
+                "rolled-back draft KV must never be registered as a "
+                "sharable prefix")
+        if self.config.hcache.enable_latents:
+            raise ValueError(
+                "generate_lookup does not capture latents (rejected "
+                "drafts would poison them); disable "
+                "hcache.enable_latents")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ngram < 1 or max_draft < 1:
+            raise ValueError("ngram and max_draft must be >= 1")
+        n = len(prompts)
+        base = max(self.state._seqs.keys(), default=-1) + 1
+        uids = [base + i for i in range(n)]
+        result = self.can_schedule(uids, [len(p) for p in prompts])
+        if result != SchedulingResult.Success:
+            raise SchedulingError(result)
+        # budget the whole stretch incl. a rejected draft tail beyond
+        # the final accepted token (its KV transiently occupies slots)
+        blocks = 0
+        for p in prompts:
+            span = len(p) + max_new_tokens - 1 + max_draft
+            if span > self.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
+            blocks += -(-span // self.block_size)
+        if blocks > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+
+        stats = {"drafted": 0, "accepted": 0, "dispatches": 0,
+                 "tokens": 0}
+        T = 1 + max_draft
+        try:
+            logits, _ = self.put(uids, prompts)
+            outs = [[int(np.argmax(l))] for l in logits]
+            hist = [list(p) + outs[i] for i, p in enumerate(prompts)]
+            done = {i for i in range(n)
+                    if eos_token_id is not None
+                    and outs[i][0] == eos_token_id}
+            while True:
+                live = [i for i in range(n)
+                        if i not in done and len(outs[i]) < max_new_tokens]
+                if not live:
+                    break
+                B = _bucket(len(live))
+                tok, start, t_len, tables = self._blank_lanes(B, T)
+                feeds = []
+                for j, i in enumerate(live):
+                    draft = self._lookup_draft(hist[i], ngram, max_draft)
+                    draft = draft[:max_new_tokens - len(outs[i]) - 1]
+                    feed = [outs[i][-1]] + draft
+                    feeds.append(feed)
+                    seq = self.state.get_sequence(uids[i])
+                    self.state.maybe_allocate_kv(seq, len(feed))
+                    seq.pre_forward(len(feed))
+                    tok[j, :len(feed)] = feed
+                    start[j] = seq.seen_tokens
+                    t_len[j] = len(feed)
+                    stats["drafted"] += len(draft)
+                tables[:len(live)] = self._tables(live, uids)
+                tail_logits = np.asarray(self.model.forward_chunk_tail(
+                    self.cache, tok, start, tables, t_len, T))
+                stats["dispatches"] += 1
+                for j, i in enumerate(live):
+                    seq = self.state.get_sequence(uids[i])
+                    seq.post_forward()
+                    feed = feeds[j]
+                    m = len(feed) - 1            # drafted count
+                    # logits for the last t_len positions sit at the END
+                    # of the tail window
+                    lane = tail_logits[j, T - len(feed):]
+                    greedy = [int(np.argmax(lane[t]))
+                              for t in range(len(feed))]
+                    acc = 0
+                    while acc < m and feed[1 + acc] == greedy[acc]:
+                        acc += 1
+                    new = greedy[:acc + 1]       # accepted + bonus
+                    stats["accepted"] += acc
+                    seq.rollback(m - acc)        # rejected draft KV
+                    if eos_token_id is not None and eos_token_id in new:
+                        new = new[:new.index(eos_token_id) + 1]
+                        done.add(i)
+                    outs[i].extend(new)
+                    hist[i].extend(new)
+                    stats["tokens"] += len(new)
+                    if len(outs[i]) >= max_new_tokens:
+                        done.add(i)
+        finally:
+            for uid in uids:
+                if self.state.get_sequence(uid) is not None:
+                    self.flush(uid)
+        stats["tokens"] += n   # the first token from prefill
+        return [o[:max_new_tokens] for o in outs], stats
+
     # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)
     # -------------------------------------------------------------- #

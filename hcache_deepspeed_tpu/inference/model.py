@@ -139,6 +139,7 @@ class PagedInferenceModel:
         self._fwd_inner = fwd
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
+        self._fwd_tail_cache = {}
         self._decode_loop_jit = jax.jit(self._decode_loop,
                                         static_argnums=(11, 12, 13, 14,
                                                         15, 16),
@@ -434,11 +435,11 @@ class PagedInferenceModel:
     # -------------------------------------------------------------- #
     # forward_chunk: the one compiled family (prefill & ragged decode)
     # -------------------------------------------------------------- #
-    def _forward_chunk(self, params, cache_k, cache_v, tokens, start,
-                       tables, t_len):
-        """tokens: [B, T] int32; start: [B] first absolute position;
-        tables: [B, NB]; t_len: [B] valid new tokens (≤ T).
-        Returns (cache_k', cache_v', logits [B, V], latents [L, B, T, H])."""
+    def _trunk(self, params, cache_k, cache_v, tokens, start, tables,
+               t_len):
+        """Embed → layer scan → final norm: the shared body of the
+        chunk forwards. Returns (params', cache_k', cache_v',
+        x [B, T, H] normed hidden states, latents)."""
         from ..ops.quantizer import dequantize_tree
         # non-layer leaves (head) dequantize here; the stacked layers stay
         # int8 and dequantize ONE layer at a time inside the scan step —
@@ -470,6 +471,15 @@ class PagedInferenceModel:
             step, x, (params["layers"], cache_k, cache_v))
 
         x = self._final_norm(params, x)
+        return params, cache_k, cache_v, x, latents
+
+    def _forward_chunk(self, params, cache_k, cache_v, tokens, start,
+                       tables, t_len):
+        """tokens: [B, T] int32; start: [B] first absolute position;
+        tables: [B, NB]; t_len: [B] valid new tokens (≤ T).
+        Returns (cache_k', cache_v', logits [B, V], latents [L, B, T, H])."""
+        params, cache_k, cache_v, x, latents = self._trunk(
+            params, cache_k, cache_v, tokens, start, tables, t_len)
         last = jnp.take_along_axis(
             x, jnp.maximum(t_len - 1, 0)[:, None, None], axis=1)[:, 0]
         logits = self._head_logits(params, last)
@@ -480,6 +490,26 @@ class PagedInferenceModel:
             logits = jax.lax.all_gather(logits, TENSOR_AXIS, axis=1,
                                         tiled=True)
         return cache_k, cache_v, logits, latents
+
+    def _forward_chunk_tail(self, params, cache_k, cache_v, tokens,
+                            start, tables, t_len, tail):
+        """Like ``_forward_chunk`` but projects the LAST ``tail`` valid
+        positions through the LM head — the verification forward of
+        speculative decoding (a drafted stretch needs target logits at
+        every drafted position, not just the final one). Returns
+        (cache_k', cache_v', logits [B, tail, V]); positions before a
+        short sequence's first valid slot clamp to 0 and the caller
+        masks by its own accept arithmetic."""
+        params, cache_k, cache_v, x, _latents = self._trunk(
+            params, cache_k, cache_v, tokens, start, tables, t_len)
+        idx = jnp.maximum(
+            t_len[:, None] - tail + jnp.arange(tail)[None, :], 0)  # [B,tail]
+        xt = jnp.take_along_axis(x, idx[..., None], axis=1)   # [B,tail,H]
+        logits = self._head_logits(params, xt)                # [B,tail,V]
+        if self.tp > 1:
+            logits = jax.lax.all_gather(logits, TENSOR_AXIS, axis=2,
+                                        tiled=True)
+        return cache_k, cache_v, logits
 
     def _final_norm(self, params, x):
         """Final RMSNorm; LayerNorm families (falcon) override."""
@@ -519,6 +549,41 @@ class PagedInferenceModel:
             jnp.asarray(t_len, jnp.int32))
         cache.replace(ck, cv)
         return logits, latents
+
+    def _fwd_tail_for(self, tail: int):
+        """Per-``tail`` compiled verification forward (tail is a trace
+        constant: one program per (tail, batch-bucket, T-pad) triple,
+        all reused across a generation)."""
+        fn = self._fwd_tail_cache.get(tail)
+        if fn is None:
+            def fwd_tail(params, ck, cv, tokens, start, tables, t_len):
+                return self._forward_chunk_tail(
+                    params, ck, cv, tokens, start, tables, t_len, tail)
+            if self.tp > 1:
+                from jax.sharding import PartitionSpec as P
+                cache_spec = P(None, TENSOR_AXIS, None, None)
+                rep = P()
+                fwd_tail = jax.shard_map(
+                    fwd_tail, mesh=self.topology.mesh,
+                    axis_names={TENSOR_AXIS},
+                    in_specs=(self._param_spec_tree(), cache_spec,
+                              cache_spec, rep, rep, rep, rep),
+                    out_specs=(cache_spec, cache_spec, rep),
+                    check_vma=False)
+            fn = jax.jit(fwd_tail, donate_argnums=(1, 2))
+            self._fwd_tail_cache[tail] = fn
+        return fn
+
+    def forward_chunk_tail(self, cache, tokens, start, tables, t_len,
+                           tail: int):
+        """Verification forward: head logits for the last ``tail``
+        positions of each lane (speculative decoding)."""
+        ck, cv, logits = self._fwd_tail_for(tail)(
+            self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
+            jnp.asarray(t_len, jnp.int32))
+        cache.replace(ck, cv)
+        return logits
 
     # -------------------------------------------------------------- #
     # HCache restore (the fork's flagship delta)

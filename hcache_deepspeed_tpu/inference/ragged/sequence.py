@@ -46,6 +46,19 @@ class SequenceDescriptor:
         self.seen_tokens += self.in_flight_tokens
         self.in_flight_tokens = 0
 
+    def rollback(self, num_tokens: int) -> None:
+        """Un-count the last ``num_tokens`` cached tokens (speculative
+        decoding: rejected draft KV). The physical slots keep their
+        stale values but sit past ``seen_tokens`` so no attention reads
+        them, and the next dispatch overwrites the same positions;
+        blocks stay allocated (they are about to be refilled)."""
+        if self.in_flight_tokens:
+            raise RuntimeError("rollback during an in-flight forward")
+        if not 0 <= num_tokens <= self.seen_tokens:
+            raise ValueError(
+                f"rollback({num_tokens}) with seen={self.seen_tokens}")
+        self.seen_tokens -= num_tokens
+
     def __repr__(self):
         return (f"SequenceDescriptor(uid={self.uid}, "
                 f"seen={self.seen_tokens}, blocks={len(self.blocks)})")

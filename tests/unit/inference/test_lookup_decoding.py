@@ -1,0 +1,141 @@
+"""Prompt-lookup speculative decoding (beyond-reference: FastGen has no
+speculative path). Greedy-exact by construction — every test's ground
+truth is the engine's own token-by-token greedy decode."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+from hcache_deepspeed_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama_tiny(max_positions=256, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    return cfg, model, params
+
+
+def make_engine(cfg, params, blocks=48, latents=False):
+    return InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 512,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 256},
+            kv_cache={"block_size": 16, "num_blocks": blocks,
+                      "cache_dtype": "float32"},
+            hcache={"enable_latents": latents}))
+
+
+def greedy_reference(engine, prompt, n):
+    """Token-by-token greedy via the public generate()."""
+    [out] = engine.generate([prompt], max_new_tokens=n)
+    return out
+
+
+class TestLookupDraft:
+
+    def test_draft_from_repeat(self):
+        hist = [1, 2, 3, 9, 1, 2, 3]
+        d = InferenceEngineV2._lookup_draft(hist, ngram=2, k=4)
+        # trailing [2, 3] matched at positions 1-2; following tokens
+        assert d == [9, 1, 2, 3]
+
+    def test_no_match(self):
+        assert InferenceEngineV2._lookup_draft(
+            [1, 2, 3, 4, 5], ngram=2, k=4) == []
+
+    def test_most_recent_match_wins(self):
+        hist = [7, 8, 1, 7, 8, 2, 7, 8]
+        d = InferenceEngineV2._lookup_draft(hist, ngram=2, k=1)
+        assert d == [2]
+
+    def test_short_history(self):
+        assert InferenceEngineV2._lookup_draft([5], ngram=2, k=4) == []
+
+
+class TestLookupDecoding:
+
+    def test_matches_greedy_exactly(self, tiny_model):
+        cfg, _, params = tiny_model
+        rng = np.random.default_rng(0)
+        prompt = list(rng.integers(0, cfg.vocab_size, (24,)))
+        ref_engine = make_engine(cfg, params)
+        ref = greedy_reference(ref_engine, prompt, 20)
+        engine = make_engine(cfg, params)
+        [out], stats = engine.generate_lookup([prompt], max_new_tokens=20,
+                                              ngram=2, max_draft=4)
+        assert out == ref
+        assert stats["tokens"] == 20
+        # one prefill token + >=1 token per dispatch
+        assert stats["dispatches"] <= 19
+
+    def test_batched_matches_greedy(self, tiny_model):
+        cfg, _, params = tiny_model
+        rng = np.random.default_rng(3)
+        prompts = [list(rng.integers(0, cfg.vocab_size, (n,)))
+                   for n in (16, 24, 31)]
+        refs = []
+        for p in prompts:
+            e = make_engine(cfg, params)
+            refs.append(greedy_reference(e, p, 12))
+        engine = make_engine(cfg, params)
+        outs, _ = engine.generate_lookup(prompts, max_new_tokens=12,
+                                         ngram=2, max_draft=4)
+        assert outs == refs
+
+    def test_accepts_on_repetitive_prompt(self, tiny_model):
+        """A strongly periodic prompt makes the model's greedy
+        continuation periodic too, so lookup drafts must land."""
+        cfg, _, params = tiny_model
+        cycle = [5, 11, 23, 7]
+        prompt = (cycle * 12)[:44]
+        engine = make_engine(cfg, params)
+        [out], stats = engine.generate_lookup([prompt],
+                                              max_new_tokens=24,
+                                              ngram=2, max_draft=6)
+        ref_engine = make_engine(cfg, params)
+        assert out == greedy_reference(ref_engine, prompt, 24)
+        assert stats["accepted"] > 0
+        # speculative win: strictly fewer dispatches than tokens
+        assert stats["dispatches"] < 23
+
+    def test_eos_truncation_matches_greedy(self, tiny_model):
+        cfg, _, params = tiny_model
+        rng = np.random.default_rng(5)
+        prompt = list(rng.integers(0, cfg.vocab_size, (20,)))
+        ref_engine = make_engine(cfg, params)
+        full = greedy_reference(ref_engine, prompt, 16)
+        eos = full[4]   # force a truncation mid-stream
+        engine = make_engine(cfg, params)
+        [out], _ = engine.generate_lookup([prompt], max_new_tokens=16,
+                                          ngram=2, max_draft=4,
+                                          eos_token_id=eos)
+        want = full[:full.index(eos) + 1]
+        assert out == want
+
+    def test_blocks_freed_after(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        free0 = engine.state.free_blocks
+        rng = np.random.default_rng(7)
+        prompt = list(rng.integers(0, cfg.vocab_size, (24,)))
+        engine.generate_lookup([prompt], max_new_tokens=8)
+        assert engine.state.free_blocks == free0
+
+    def test_gates(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params, latents=True)
+        with pytest.raises(ValueError, match="enable_latents"):
+            engine.generate_lookup([[1, 2, 3]])
+        engine = make_engine(cfg, params)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.generate_lookup([[1, 2, 3]], max_new_tokens=0)
+        with pytest.raises(ValueError, match="ngram"):
+            engine.generate_lookup([[1, 2, 3]], ngram=0)
