@@ -438,7 +438,7 @@ def run_sweep_fused(model_size="tiny", max_context=512, prompt_len=128,
 
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
-        prefill_chunk=0, fused=False):
+        prefill_chunk=0, fused=False, lookup=False):
     """ONE engine (sized for the largest batch) serves every measurement:
     engine-per-config both re-casts the weights each time and, at 1B+
     sizes, OOMs the pool while two engines overlap. Rows print as they
@@ -462,7 +462,33 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
               "tokens_per_sec": round(batch * prompt_len / prefill_s, 1)})
 
         ctx0 = prompt_len + 1
-        if fused:
+        if lookup:
+            # speculative decoding: same greedy stream, fewer dispatches.
+            # A repetitive prompt half models the system-prompt/code
+            # workloads PLD targets; the random half keeps it honest.
+            for u in uids:
+                eng.flush(u)
+            cyc = [int(x) for x in rng.integers(0, cfg.vocab_size, (4,))]
+            spec_prompts = [(cyc * prompt_len)[:prompt_len // 2] +
+                            p[:prompt_len - prompt_len // 2]
+                            for p in prompts]
+            eng.generate_lookup(spec_prompts,
+                                max_new_tokens=decode_steps + 1)  # warm
+            t0 = time.perf_counter()
+            _, stats = eng.generate_lookup(
+                spec_prompts, max_new_tokens=decode_steps + 1)
+            dt = time.perf_counter() - t0
+            emit({"phase": "decode-lookup", "batch": batch,
+                  "context": [ctx0, ctx0 + decode_steps],
+                  "note": "includes one prefill; repetitive-half prompts",
+                  "tokens_per_sec": round(batch * decode_steps / dt, 1),
+                  "dispatches": stats["dispatches"],
+                  "drafted": stats["drafted"],
+                  "accepted": stats["accepted"],
+                  "tokens_per_dispatch": round(
+                      batch * decode_steps / max(stats["dispatches"], 1),
+                      2)})
+        elif fused:
             # on-device decode loop: one program for the whole stretch
             for u in uids:
                 eng.flush(u)
@@ -555,6 +581,10 @@ def main(argv=None):
     p.add_argument("--fused-decode", action="store_true",
                    help="measure the on-device generate_fused loop "
                         "instead of host-driven per-step decode")
+    p.add_argument("--lookup-decode", action="store_true",
+                   help="measure prompt-lookup speculative decoding "
+                        "(greedy-exact; reports acceptance + "
+                        "tokens/dispatch)")
     args = p.parse_args(argv)
     # rows print as produced (partial results survive an OOM/crash)
     if args.sweep and args.fused_decode:
@@ -582,5 +612,5 @@ def main(argv=None):
         run(args.model, args.max_context, args.prompt_len,
             args.decode_steps, tuple(args.batches),
             quantize=args.quantize, prefill_chunk=args.prefill_chunk,
-            fused=args.fused_decode)
+            fused=args.fused_decode, lookup=args.lookup_decode)
     return 0

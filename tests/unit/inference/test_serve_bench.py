@@ -34,6 +34,15 @@ def test_serve_bench_sweep():
     assert row["gen_tokens_per_sec"] > 0
 
 
+def test_serve_bench_lookup_mode():
+    results = run(model_size="tiny", max_context=128, prompt_len=32,
+                  decode_steps=8, batches=(2,), lookup=True)
+    rows = [r for r in results if r["phase"] == "decode-lookup"]
+    (row,) = rows
+    assert row["dispatches"] >= 1
+    assert row["tokens_per_dispatch"] >= 1.0
+
+
 def test_serve_bench_sweep_fused():
     from hcache_deepspeed_tpu.inference.benchmark import run_sweep_fused
     rows = run_sweep_fused(model_size="tiny", max_context=128,
