@@ -803,6 +803,100 @@ class InferenceEngineV2:
         stats["tokens"] += n   # the first token from prefill
         return [o[:max_new_tokens] for o in outs], stats
 
+    def generate_lookup_fused(self, prompts, max_new_tokens: int = 32,
+                              ngram: int = 2, max_draft: int = 8,
+                              window: int = 128,
+                              eos_token_id: int = None):
+        """Fully fused prompt-lookup speculative decoding: drafting,
+        verification, acceptance and KV rollback all run inside ONE
+        on-device ``lax.while_loop`` (``model.lookup_decode_loop``), so
+        the host syncs once per generation AND each device step can
+        emit up to ``max_draft+1`` tokens — the two serving wins
+        (:meth:`generate_fused`, :meth:`generate_lookup`) composed.
+        Greedy-exact like both. ``window`` caps the on-device n-gram
+        search to each lane's most recent tokens (static shape).
+
+        Returns ``(outs, stats)`` like :meth:`generate_lookup`
+        (``drafted`` is the upper bound iters*max_draft — per-lane
+        draft counts don't leave the device)."""
+        if self.prefix_caching:
+            raise ValueError(
+                "generate_lookup_fused with prefix_caching is "
+                "unsupported: rolled-back draft KV must never be "
+                "registered as a sharable prefix")
+        if self.config.hcache.enable_latents:
+            raise ValueError(
+                "generate_lookup_fused does not capture latents; "
+                "disable hcache.enable_latents")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ngram < 1 or max_draft < 1 or window <= ngram:
+            raise ValueError("need ngram>=1, max_draft>=1, window>ngram")
+        n = len(prompts)
+        base = max(self.state._seqs.keys(), default=-1) + 1
+        uids = [base + i for i in range(n)]
+        result = self.can_schedule(uids, [len(p) for p in prompts])
+        if result != SchedulingResult.Success:
+            raise SchedulingError(result)
+        blocks = 0
+        for p in prompts:
+            span = len(p) + max_new_tokens - 1 + max_draft
+            if span > self.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
+            blocks += -(-span // self.block_size)
+        if blocks > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+
+        try:
+            logits, _ = self.put(uids, prompts)
+            first = [int(np.argmax(l)) for l in logits]
+            outs = [[t] for t in first]
+            if max_new_tokens == 1 or (
+                    eos_token_id is not None
+                    and all(t == eos_token_id for t in first)):
+                return outs, {"drafted": 0, "accepted": 0,
+                              "dispatches": 0, "tokens": n}
+            B = _bucket(n)
+            first_tok, pos, t_blank, tables = self._blank_lanes(B)
+            del t_blank
+            live = np.zeros((B,), bool)
+            hist = np.zeros((B, window), np.int32)
+            hist_len = np.zeros((B,), np.int32)
+            for j, uid in enumerate(uids):
+                seq = self.state.get_sequence(uid)
+                # reserve the whole stretch incl. transient rejected
+                # tails (generate_fused-style up-front reservation)
+                self.state.maybe_allocate_kv(
+                    seq, max_new_tokens - 1 + max_draft)
+                full = list(prompts[j]) + [first[j]]
+                w = min(len(full), window)
+                hist[j, window - w:] = full[-w:]
+                hist_len[j] = w
+                pos[j] = seq.seen_tokens
+                first_tok[j, 0] = first[j]
+                live[j] = not (eos_token_id is not None
+                               and first[j] == eos_token_id)
+            tables[:n] = self._tables(list(range(n)), uids)
+            out_buf, out_len, iters, accepted = \
+                self.model.lookup_decode_loop(
+                    self.cache, first_tok[:, 0], pos, tables, live,
+                    hist, hist_len, max_new=max_new_tokens - 1,
+                    ngram=ngram, max_draft=max_draft, window=window,
+                    eos_token_id=eos_token_id)
+            for j in range(n):
+                outs[j].extend(int(t) for t in out_buf[j, :out_len[j]])
+            stats = {"drafted": int(iters) * max_draft,
+                     "accepted": int(accepted),
+                     "dispatches": int(iters),
+                     "tokens": n + int(out_len[:n].sum())}
+        finally:
+            for uid in uids:
+                if self.state.get_sequence(uid) is not None:
+                    self.flush(uid)
+        return [o[:max_new_tokens] for o in outs], stats
+
     # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)
     # -------------------------------------------------------------- #

@@ -140,6 +140,11 @@ class PagedInferenceModel:
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
         self._fwd_tail_cache = {}
+        self._fwd_tail_inner_cache = {}
+        self._lookup_loop_jit = jax.jit(
+            self._lookup_decode_loop,
+            static_argnums=(10, 11, 12, 13, 14),
+            donate_argnums=(1, 2))
         self._decode_loop_jit = jax.jit(self._decode_loop,
                                         static_argnums=(11, 12, 13, 14,
                                                         15, 16),
@@ -755,6 +760,169 @@ class PagedInferenceModel:
         _, cache_k, cache_v, _, _, _, toks, _, lats, lps = st
         return cache_k, cache_v, toks, lats, \
             (lps if want_logprobs else None)
+
+    def _fwd_tail_inner_for(self, tail: int):
+        """Un-jitted (TP-wrapped when tp>1) tail forward for use INSIDE
+        other compiled loops (the fused speculative decoder)."""
+        fn = self._fwd_tail_inner_cache.get(tail)
+        if fn is None:
+            def fwd_tail(params, ck, cv, tokens, start, tables, t_len):
+                return self._forward_chunk_tail(
+                    params, ck, cv, tokens, start, tables, t_len, tail)
+            if self.tp > 1:
+                from jax.sharding import PartitionSpec as P
+                cache_spec = P(None, TENSOR_AXIS, None, None)
+                rep = P()
+                fwd_tail = jax.shard_map(
+                    fwd_tail, mesh=self.topology.mesh,
+                    axis_names={TENSOR_AXIS},
+                    in_specs=(self._param_spec_tree(), cache_spec,
+                              cache_spec, rep, rep, rep, rep),
+                    out_specs=(cache_spec, cache_spec, rep),
+                    check_vma=False)
+            self._fwd_tail_inner_cache[tail] = fn = fwd_tail
+        return fn
+
+    def _lookup_decode_loop(self, params, cache_k, cache_v, first_tok,
+                            pos0, tables, live, hist0, hist_len0,
+                            eos_id, max_new, ngram, max_draft, window,
+                            has_eos):
+        """Fused prompt-lookup speculative decoding: draft, verify,
+        accept and roll back entirely on device inside one
+        ``lax.while_loop`` — the host syncs once per *generation*, and
+        each loop iteration can emit up to ``max_draft + 1`` tokens.
+
+        Drafting is a vectorized n-gram match over a right-aligned
+        rolling window of each lane's recent tokens; a bad draft only
+        costs speed — acceptance compares drafts against the verified
+        greedy targets, so output is bit-identical to token-by-token
+        greedy decode regardless of what the draft proposes. Rejected
+        draft KV stays past the lane's position cursor and is
+        overwritten by the next iteration's writes (the same rollback
+        arithmetic as the host-driven :meth:`generate_lookup` path,
+        moved into the carry).
+
+        first_tok/pos0/live: [B]; hist0: [B, window] right-aligned
+        recent tokens; hist_len0: [B] valid counts. eos_id traced;
+        max_new/ngram/max_draft/window/has_eos static. Returns
+        (cache_k', cache_v', outs [B, max_new], out_len [B], iters,
+        accepted_total)."""
+        B = first_tok.shape[0]
+        T = 1 + max_draft
+        W = window
+        fwd_tail = self._fwd_tail_inner_for(T)
+        win_idx = jnp.arange(W - ngram)[:, None] + \
+            jnp.arange(ngram)[None, :]              # [W-ngram, ngram]
+        rows = jnp.arange(B)
+
+        def draft(hist, hist_len, last_tok):
+            key = hist[:, W - ngram:]                        # [B, ngram]
+            wins = hist[:, win_idx]                  # [B, W-ngram, ngram]
+            starts = jnp.arange(W - ngram)[None, :]
+            valid = starts >= (W - hist_len)[:, None]        # in-window
+            hit = (wins == key[:, None, :]).all(-1) & valid  # [B, W-ngram]
+            any_hit = hit.any(axis=1)
+            # most recent match wins
+            i_star = jnp.max(jnp.where(hit, starts, -1), axis=1)
+            src = jnp.clip(i_star + ngram, 0, W - 1)
+            cols = jnp.clip(src[:, None] + jnp.arange(max_draft)[None, :],
+                            0, W - 1)
+            cand = hist[rows[:, None], cols]              # [B, max_draft]
+            # no match: propose repeats of the last token (cheap; only
+            # accepted if it IS the greedy continuation)
+            return jnp.where(any_hit[:, None], cand,
+                             last_tok[:, None].astype(hist.dtype))
+
+        # +1 trash column: masked-out scatter lanes write there instead
+        # of clipping onto a real slot (duplicate scatter indices have
+        # last-write-wins semantics and would clobber the real token)
+        outs0 = jnp.zeros((B, max_new + 1), jnp.int32)
+        done0 = jnp.logical_not(live)
+
+        def cond(st):
+            i, done = st[0], st[7]
+            return (i < max_new) & jnp.logical_not(jnp.all(done))
+
+        def body(st):
+            (i, ck, cv, last_tok, pos, hist, hist_len, done, outs,
+             out_len, accepted) = st
+            d = draft(hist, hist_len, last_tok)              # [B, k]
+            toks = jnp.concatenate([last_tok[:, None], d], axis=1)
+            t_step = jnp.where(done, 0, T)
+            ck, cv, logits = fwd_tail(params, ck, cv, toks, pos, tables,
+                                      t_step)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # leading drafts matching their verified targets
+            match = d == greedy[:, :max_draft]
+            acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                          axis=1)                             # [B]
+            remaining = jnp.maximum(max_new - out_len, 0)
+            c = jnp.minimum(acc + 1, remaining)               # emit count
+            if has_eos:
+                emit_mask = jnp.arange(T)[None, :] < c[:, None]
+                is_eos = (greedy == eos_id) & emit_mask
+                eos_pos = jnp.argmax(is_eos, axis=1)
+                c = jnp.where(is_eos.any(axis=1),
+                              jnp.minimum(c, eos_pos + 1), c)
+            c = jnp.where(done, 0, c)
+            # scatter greedy[:, :c] into outs at out_len; masked lanes
+            # target the trash column (in-range cols are unique: off < c
+            # implies out_len + off <= max_new - 1)
+            mask = jnp.arange(T)[None, :] < c[:, None]
+            col = jnp.where(mask,
+                            out_len[:, None] + jnp.arange(T)[None, :],
+                            max_new)
+            outs = outs.at[rows[:, None], col].set(greedy)
+            # roll the history window left by c and append the emitted
+            ext = jnp.concatenate([hist, greedy], axis=1)   # [B, W+T]
+            idx = jnp.arange(W)[None, :] + c[:, None]
+            hist = ext[rows[:, None], idx]
+            hist_len = jnp.minimum(hist_len + c, W)
+            out_len = out_len + c
+            new_done = done | (out_len >= max_new)
+            if has_eos:
+                new_done = new_done | (
+                    (c > 0) & (jnp.take_along_axis(
+                        outs, jnp.maximum(out_len - 1, 0)[:, None],
+                        axis=1)[:, 0] == eos_id))
+            # cached-valid tokens this round = c (fed token + c-1
+            # accepted drafts); the last emitted token is the uncached
+            # bonus fed next round
+            pos = pos + jnp.where(done, 0, c)
+            last_tok = jnp.take_along_axis(
+                outs, jnp.maximum(out_len - 1, 0)[:, None], axis=1)[:, 0]
+            accepted = accepted + jnp.sum(
+                jnp.where(done, 0, jnp.maximum(c - 1, 0)))
+            return (i + 1, ck, cv, last_tok, pos, hist, hist_len,
+                    new_done, outs, out_len, accepted)
+
+        st = (jnp.int32(0), cache_k, cache_v, first_tok, pos0, hist0,
+              hist_len0, done0, outs0, jnp.zeros((B,), jnp.int32),
+              jnp.int32(0))
+        st = jax.lax.while_loop(cond, body, st)
+        (iters, cache_k, cache_v, _, _, _, _, _, outs, out_len,
+         accepted) = st
+        return cache_k, cache_v, outs[:, :max_new], out_len, iters, \
+            accepted
+
+    def lookup_decode_loop(self, cache, first_tok, pos, tables, live,
+                           hist, hist_len, *, max_new, ngram, max_draft,
+                           window, eos_token_id=None):
+        """Public fused speculative decoder (see _lookup_decode_loop)."""
+        has_eos = eos_token_id is not None
+        eos = jnp.int32(eos_token_id if has_eos else -1)
+        ck, cv, outs, out_len, iters, accepted = self._lookup_loop_jit(
+            self.params, cache.k, cache.v,
+            jnp.asarray(first_tok, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(live, bool),
+            jnp.asarray(hist, jnp.int32),
+            jnp.asarray(hist_len, jnp.int32),
+            eos, max_new, ngram, max_draft, window, has_eos)
+        cache.replace(ck, cv)
+        return (np.asarray(outs), np.asarray(out_len), int(iters),
+                int(accepted))
 
     def decode_loop(self, cache, tokens, start, t_len, tables, n_steps,
                     temperature=0.0, top_k=0, top_p=1.0, seed=0,

@@ -139,3 +139,79 @@ class TestLookupDecoding:
             engine.generate_lookup([[1, 2, 3]], max_new_tokens=0)
         with pytest.raises(ValueError, match="ngram"):
             engine.generate_lookup([[1, 2, 3]], ngram=0)
+
+
+class TestLookupFused:
+    """The fully-on-device speculative loop must be bit-identical to
+    both the host-driven lookup path and plain greedy decode."""
+
+    def test_matches_greedy_and_host_lookup(self, tiny_model):
+        cfg, _, params = tiny_model
+        rng = np.random.default_rng(11)
+        prompt = list(rng.integers(0, cfg.vocab_size, (24,)))
+        ref = greedy_reference(make_engine(cfg, params), prompt, 20)
+        host, _ = make_engine(cfg, params).generate_lookup(
+            [prompt], max_new_tokens=20, ngram=2, max_draft=4)
+        engine = make_engine(cfg, params)
+        fused, stats = engine.generate_lookup_fused(
+            [prompt], max_new_tokens=20, ngram=2, max_draft=4)
+        assert fused[0] == ref == host[0]
+        assert stats["tokens"] == 20
+        assert stats["dispatches"] <= 19
+
+    def test_batched_and_periodic(self, tiny_model):
+        cfg, _, params = tiny_model
+        rng = np.random.default_rng(13)
+        cycle = [5, 11, 23, 7]
+        prompts = [list(rng.integers(0, cfg.vocab_size, (20,))),
+                   (cycle * 12)[:44],
+                   list(rng.integers(0, cfg.vocab_size, (31,)))]
+        refs = [greedy_reference(make_engine(cfg, params), p, 16)
+                for p in prompts]
+        engine = make_engine(cfg, params)
+        outs, stats = engine.generate_lookup_fused(
+            prompts, max_new_tokens=16, ngram=2, max_draft=6)
+        assert outs == refs
+        assert stats["accepted"] > 0       # the periodic lane lands
+        # iteration count is batch-max: the non-accepting random lanes
+        # still bound it by max_new-1
+        assert stats["dispatches"] <= 15
+
+    def test_periodic_alone_needs_fewer_dispatches(self, tiny_model):
+        cfg, _, params = tiny_model
+        cycle = [5, 11, 23, 7]
+        prompt = (cycle * 12)[:44]
+        ref = greedy_reference(make_engine(cfg, params), prompt, 24)
+        engine = make_engine(cfg, params)
+        [out], stats = engine.generate_lookup_fused(
+            [prompt], max_new_tokens=24, ngram=2, max_draft=6)
+        assert out == ref
+        assert stats["accepted"] > 0
+        assert stats["dispatches"] < 23    # strictly beats 1 token/step
+
+    def test_eos_matches_host_lookup(self, tiny_model):
+        cfg, _, params = tiny_model
+        rng = np.random.default_rng(17)
+        prompt = list(rng.integers(0, cfg.vocab_size, (20,)))
+        full = greedy_reference(make_engine(cfg, params), prompt, 16)
+        eos = full[5]
+        host, _ = make_engine(cfg, params).generate_lookup(
+            [prompt], max_new_tokens=16, ngram=2, max_draft=4,
+            eos_token_id=eos)
+        engine = make_engine(cfg, params)
+        fused, _ = engine.generate_lookup_fused(
+            [prompt], max_new_tokens=16, ngram=2, max_draft=4,
+            eos_token_id=eos)
+        assert fused == host
+
+    def test_blocks_freed_and_reusable(self, tiny_model):
+        cfg, _, params = tiny_model
+        engine = make_engine(cfg, params)
+        free0 = engine.state.free_blocks
+        rng = np.random.default_rng(19)
+        prompt = list(rng.integers(0, cfg.vocab_size, (24,)))
+        engine.generate_lookup_fused([prompt], max_new_tokens=8)
+        assert engine.state.free_blocks == free0
+        # engine still serves normally afterwards
+        [out] = engine.generate([prompt], max_new_tokens=4)
+        assert len(out) == 4
