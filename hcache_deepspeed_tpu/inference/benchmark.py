@@ -488,6 +488,22 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
                   "tokens_per_dispatch": round(
                       batch * decode_steps / max(stats["dispatches"], 1),
                       2)})
+            # fully fused variant: same workload, one host sync total
+            eng.generate_lookup_fused(spec_prompts,
+                                      max_new_tokens=decode_steps + 1)
+            t0 = time.perf_counter()
+            _, fstats = eng.generate_lookup_fused(
+                spec_prompts, max_new_tokens=decode_steps + 1)
+            dt = time.perf_counter() - t0
+            emit({"phase": "decode-lookup-fused", "batch": batch,
+                  "context": [ctx0, ctx0 + decode_steps],
+                  "note": "includes one prefill; repetitive-half prompts",
+                  "tokens_per_sec": round(batch * decode_steps / dt, 1),
+                  "device_steps": fstats["dispatches"],
+                  "accepted": fstats["accepted"],
+                  "tokens_per_device_step": round(
+                      batch * decode_steps /
+                      max(fstats["dispatches"], 1), 2)})
         elif fused:
             # on-device decode loop: one program for the whole stretch
             for u in uids:

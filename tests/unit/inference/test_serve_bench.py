@@ -37,10 +37,11 @@ def test_serve_bench_sweep():
 def test_serve_bench_lookup_mode():
     results = run(model_size="tiny", max_context=128, prompt_len=32,
                   decode_steps=8, batches=(2,), lookup=True)
-    rows = [r for r in results if r["phase"] == "decode-lookup"]
-    (row,) = rows
-    assert row["dispatches"] >= 1
-    assert row["tokens_per_dispatch"] >= 1.0
+    rows = {r["phase"]: r for r in results}
+    assert rows["decode-lookup"]["dispatches"] >= 1
+    assert rows["decode-lookup"]["tokens_per_dispatch"] >= 1.0
+    assert rows["decode-lookup-fused"]["device_steps"] >= 1
+    assert rows["decode-lookup-fused"]["tokens_per_device_step"] >= 1.0
 
 
 def test_serve_bench_sweep_fused():
