@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Chip-session runbook: run the queued TPU measurements in priority
+# order the moment the axon relay is up. Artifacts land in the repo
+# root. Safe to re-run; every stage has its own timeout so a relay
+# death mid-session still leaves earlier artifacts on disk.
+#
+#   bin/chip_session.sh            # everything, priority order
+#   bin/chip_session.sh bench      # just the BENCH capture
+#
+# Stages: bench | serve7b | sweep1b | vet | curve | domino
+set -u
+cd "$(dirname "$0")/.."
+STAGES=${1:-all}
+
+probe() {
+  timeout 75 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+run_stage() {  # name, timeout, cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== [$name] $*" >&2
+  timeout "$tmo" "$@"
+  local rc=$?
+  echo "=== [$name] rc=$rc" >&2
+  return $rc
+}
+
+if ! probe; then
+  echo "relay DOWN (probe timed out); aborting" >&2
+  exit 3
+fi
+echo "relay UP" >&2
+
+want() { [ "$STAGES" = all ] || [ "$STAGES" = "$1" ]; }
+
+# 1. the round's official perf artifact (winner config first,
+#    cache-proven last; error JSON carries last_measured either way)
+if want bench; then
+  run_stage bench 2000 python bench.py | tee BENCH_LOCAL.json
+fi
+
+# 2. 7B serving measurement (FastGen-at-size story)
+if want serve7b; then
+  run_stage serve7b 3300 python bin/hds_serve_bench --model 7b \
+    --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+    | tee SERVE_7B.jsonl
+fi
+
+# 3. 1B throughput-latency sweeps: host-driven (continuous batching)
+#    and fused (tunnel-valid absolute numbers), plus speculative rows
+if want sweep1b; then
+  run_stage sweep-host 1800 python bin/hds_serve_bench --model 1b \
+    --sweep --rps 0.5 1 2 4 --max-new 32 --n-requests 16 \
+    | tee SWEEP_1B_HOST.jsonl
+  run_stage sweep-fused 1800 python bin/hds_serve_bench --model 1b \
+    --sweep --fused-decode --rps 0.5 1 2 4 --max-new 32 \
+    --n-requests 16 | tee SWEEP_1B_FUSED.jsonl
+  run_stage lookup 1500 python bin/hds_serve_bench --model 1b \
+    --lookup-decode --prompt-len 128 --decode-steps 64 --batches 1 4 \
+    | tee LOOKUP_1B.jsonl
+fi
+
+# 4. vet queued training configs (long-context FPDT story + 7B-layer
+#    proxy + tiling variants) — one JSON artifact
+if want vet || want curve; then
+  run_stage curve 5400 python bin/hds_train_curve --out TRAIN_CURVE.json
+fi
+
+# 5. Domino scheduled-HLO overlap evidence on real hardware
+if want domino; then
+  HDS_TPU_TESTS=1 run_stage domino 1200 python -m pytest \
+    tests/unit/runtime/test_domino_hlo.py -k TPU -q
+fi
+
+echo "chip session done; artifacts: BENCH_LOCAL.json SERVE_7B.jsonl" \
+     "SWEEP_1B_{HOST,FUSED}.jsonl LOOKUP_1B.jsonl TRAIN_CURVE.json" >&2
