@@ -809,6 +809,14 @@ class HDSEngine:
             secondary = prepare_secondary(state["params"]) \
                 if prepare_secondary is not None else None
 
+            if gas == 1:
+                # single micro-step: seed the accumulator with TRACED
+                # zeros instead of the carried (argument) buffer — XLA
+                # folds add(0, g) -> g, saving a full grad-buffer
+                # read+write per step that an argument input can't fold
+                state = dict(state, grad_acc=jax.tree.map(
+                    jnp.zeros_like, state["grad_acc"]))
+
             def body(acc, xs):
                 grad_acc, loss_sum = acc
                 batch, key = xs
