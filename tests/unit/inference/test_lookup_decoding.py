@@ -204,6 +204,39 @@ class TestLookupFused:
             eos_token_id=eos)
         assert fused == host
 
+    def test_gpt2_trunk_family(self):
+        """The tail-logits forward lives in the shared trunk — verify
+        the gpt2-trunk family (learned positions, LayerNorm, tied head
+        via embed.T) decodes speculative-exact too."""
+        from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,
+                                                      gpt2_tiny)
+        gcfg = gpt2_tiny(n_positions=256, use_flash=False)
+        gmodel = GPT2LMHeadModel(gcfg)
+        rng = np.random.default_rng(23)
+        batch = {"input_ids": np.zeros((1, 8), np.int32)}
+        gparams = gmodel.init(jax.random.PRNGKey(0), batch)["params"]
+        prompt = list(rng.integers(0, gcfg.vocab_size, (24,)))
+
+        def engine():
+            return InferenceEngineV2(
+                gcfg, gparams,
+                config=RaggedInferenceEngineConfig(
+                    state_manager={"max_tracked_sequences": 8,
+                                   "max_ragged_batch_size": 512,
+                                   "max_ragged_sequence_count": 4,
+                                   "max_context": 256},
+                    kv_cache={"block_size": 16, "num_blocks": 48,
+                              "cache_dtype": "float32"},
+                    hcache={"enable_latents": False}))
+
+        ref = greedy_reference(engine(), prompt, 14)
+        host, _ = engine().generate_lookup([prompt], max_new_tokens=14,
+                                           ngram=2, max_draft=4)
+        fused, _ = engine().generate_lookup_fused(
+            [prompt], max_new_tokens=14, ngram=2, max_draft=4)
+        assert host[0] == ref
+        assert fused[0] == ref
+
     def test_blocks_freed_and_reusable(self, tiny_model):
         cfg, _, params = tiny_model
         engine = make_engine(cfg, params)
