@@ -115,3 +115,32 @@ class TestTPInference:
         cut_tp, _ = tp.generate_fused(prompts, max_new_tokens=6,
                                       eos_token_id=eos)
         assert cut_tp == cut_ref
+
+    def test_speculative_lookup_under_tp(self, tp_topo):
+        """Both speculative paths (host verify dispatch and the fused
+        on-device loop) wrap the TP tail-logits forward (vocab
+        all-gather on the tail axis) — outputs must match single-chip
+        greedy exactly."""
+        cfg, params = _setup()
+
+        def spec_engine(topology=None):
+            return InferenceEngineV2(
+                cfg, params, topology=topology,
+                config=RaggedInferenceEngineConfig(
+                    state_manager={"max_tracked_sequences": 8,
+                                   "max_ragged_batch_size": 128,
+                                   "max_ragged_sequence_count": 4,
+                                   "max_context": 128},
+                    kv_cache={"block_size": 16, "num_blocks": 24,
+                              "cache_dtype": "float32"},
+                    hcache={"enable_latents": False}))
+
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, 256, (20,), dtype=np.int32).tolist()
+        [want] = spec_engine().generate([prompt], max_new_tokens=10)
+        host, _ = spec_engine(tp_topo).generate_lookup(
+            [prompt], max_new_tokens=10, ngram=2, max_draft=3)
+        assert host[0] == want
+        fused, _ = spec_engine(tp_topo).generate_lookup_fused(
+            [prompt], max_new_tokens=10, ngram=2, max_draft=3)
+        assert fused[0] == want
