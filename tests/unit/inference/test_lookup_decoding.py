@@ -237,6 +237,37 @@ class TestLookupFused:
         assert host[0] == ref
         assert fused[0] == ref
 
+    def test_int8_weights_compose(self, tiny_model):
+        """Weight-only int8 serving + speculative decoding: the trunk
+        dequantizes per layer inside the scan either way, so both
+        lookup paths must match the quantized engine's own greedy
+        decode exactly."""
+        cfg, _, params = tiny_model
+
+        def q_engine():
+            return InferenceEngineV2(
+                cfg, params,
+                config=RaggedInferenceEngineConfig(
+                    state_manager={"max_tracked_sequences": 8,
+                                   "max_ragged_batch_size": 512,
+                                   "max_ragged_sequence_count": 4,
+                                   "max_context": 256},
+                    kv_cache={"block_size": 16, "num_blocks": 48,
+                              "cache_dtype": "float32"},
+                    quantization={"enabled": True, "bits": 8,
+                                  "group_size": 64, "min_size": 1024},
+                    hcache={"enable_latents": False}))
+
+        rng = np.random.default_rng(29)
+        prompt = list(rng.integers(0, cfg.vocab_size, (24,)))
+        want = greedy_reference(q_engine(), prompt, 12)
+        host, _ = q_engine().generate_lookup([prompt], max_new_tokens=12,
+                                             ngram=2, max_draft=4)
+        fused, _ = q_engine().generate_lookup_fused(
+            [prompt], max_new_tokens=12, ngram=2, max_draft=4)
+        assert host[0] == want
+        assert fused[0] == want
+
     def test_blocks_freed_and_reusable(self, tiny_model):
         cfg, _, params = tiny_model
         engine = make_engine(cfg, params)

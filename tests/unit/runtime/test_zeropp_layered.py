@@ -145,6 +145,39 @@ class TestLayeredParity:
         np.testing.assert_allclose(a, b, rtol=1e-4)
 
 
+class TestLayeredUnfusedPath:
+
+    def test_forward_backward_step_matches_fused(self, eight_devices):
+        """The unfused API (forward/backward/step) hits the layered
+        micro WITHOUT a prepared secondary (inline refresh); its loss
+        trajectory must match train_batch's fused path."""
+        def build():
+            model = GPT2LMHeadModel(gpt2_tiny(use_flash=False))
+            cfg = {
+                "train_batch_size": 16,
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3, "min_shard_size": 1,
+                                      "zero_hpz_partition_size": 2},
+                "steps_per_print": 10 ** 9,
+            }
+            engine, _, _, _ = hds.initialize(model=model, config=cfg,
+                                             example_batch=_batch())
+            return engine
+
+        batch = _batch(seed=2)
+        fused = build()
+        a = [float(fused.train_batch(batch=batch)) for _ in range(3)]
+        unfused = build()
+        b = []
+        for _ in range(3):
+            loss = unfused.forward(batch)
+            unfused.backward(loss)
+            unfused.step()
+            b.append(float(loss))
+        np.testing.assert_allclose(a, b, rtol=1e-4)
+
+
 class TestLayeredRegistry:
 
     def _specs_for(self, model):
