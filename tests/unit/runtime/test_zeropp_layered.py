@@ -144,6 +144,21 @@ class TestLayeredParity:
         assert a[-1] < a[0]
         np.testing.assert_allclose(a, b, rtol=1e-4)
 
+    def test_chunked_loss_heads_match(self, eight_devices):
+        """The layered head's chunked-LM-loss branch (what the bench
+        winner config runs) must agree with the whole-tree gather for
+        both families."""
+        gpt2_fn = lambda: GPT2LMHeadModel(
+            gpt2_tiny(use_flash=False, loss_chunk=16))
+        np.testing.assert_allclose(self._train(True, gpt2_fn, steps=3),
+                                   self._train(False, gpt2_fn, steps=3),
+                                   rtol=1e-4)
+        llama_fn = lambda: LlamaForCausalLM(
+            llama_tiny(use_flash=False, loss_chunk=16))
+        np.testing.assert_allclose(self._train(True, llama_fn, steps=3),
+                                   self._train(False, llama_fn, steps=3),
+                                   rtol=1e-4)
+
 
 class TestLayeredUnfusedPath:
 
