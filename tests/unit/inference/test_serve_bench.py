@@ -1,6 +1,8 @@
 """Serving benchmark smoke (reference: the FastGen bench harness) —
 keeps the measurement tool itself green across engine changes."""
 
+import numpy as np
+
 from hcache_deepspeed_tpu.inference.benchmark import run
 
 
@@ -55,6 +57,43 @@ def test_serve_bench_sweep_fused():
     assert row["effective_rps"] > 0
     assert row["waves"] >= 2   # 5 requests, max_batch 4
     assert row["gen_tokens_per_sec"] > 0
+
+
+def test_bench_model_sizes_trace():
+    """The 1b/7b bench configs must build and trace (eval_shape — no
+    weights materialized) with sane parameter counts, so a live-relay
+    7B session can't die on a config bug."""
+    import jax
+    from hcache_deepspeed_tpu.models.llama import (LlamaConfig,
+                                                   LlamaForCausalLM)
+    from hcache_deepspeed_tpu.inference.benchmark import _model_params
+    import inspect
+    # exact arithmetic: per-layer 4h^2 + 3*h*ffn, plus two vocab
+    # matrices (untied embed + head)
+    sizes = {"1b": 1.35e9, "7b": 6.74e9}
+    src = inspect.getsource(_model_params)
+    for name, expect in sizes.items():
+        assert f'"{name}"' in src
+    specs = {
+        "1b": dict(vocab_size=32000, hidden_size=2048,
+                   intermediate_size=5504, n_layer=24, n_head=16,
+                   n_kv_head=16),
+        "7b": dict(vocab_size=32000, hidden_size=4096,
+                   intermediate_size=11008, n_layer=32, n_head=32,
+                   n_kv_head=32),
+    }
+    for name, spec in specs.items():
+        cfg = LlamaConfig(max_positions=512, dtype="bfloat16",
+                          use_flash=False, **spec)
+        model = LlamaForCausalLM(cfg)
+        shapes = jax.eval_shape(
+            lambda k: model.init(k, {"input_ids": np.zeros((1, 8),
+                                                           np.int32)},
+                                 train=False),
+            jax.random.PRNGKey(0))
+        n = sum(int(np.prod(x.shape))
+                for x in jax.tree.leaves(shapes["params"]))
+        assert abs(n - sizes[name]) / sizes[name] < 0.15, (name, n)
 
 
 def test_serve_bench_restore_mode():
