@@ -39,11 +39,17 @@ if want bench; then
   run_stage bench 2000 python bench.py | tee BENCH_LOCAL.json
 fi
 
-# 2. 7B serving measurement (FastGen-at-size story)
+# 2. 7B serving measurement (FastGen-at-size story). bf16 7B weights
+#    are ~13.5 GB — tight on a 16 GB v5e; fall back to int8 weight-only
+#    (~7 GB) if the bf16 run dies so the round still gets a 7B number.
 if want serve7b; then
-  run_stage serve7b 3300 python bin/hds_serve_bench --model 7b \
-    --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
-    | tee SERVE_7B.jsonl
+  if ! run_stage serve7b 3300 python bin/hds_serve_bench --model 7b \
+      --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+      | tee SERVE_7B.jsonl; then
+    run_stage serve7b-int8 3300 python bin/hds_serve_bench --model 7b \
+      --quantize int8 --max-context 512 --prompt-len 128 \
+      --decode-steps 8 --batches 1 | tee SERVE_7B_INT8.jsonl
+  fi
 fi
 
 # 3. 1B throughput-latency sweeps: host-driven (continuous batching)
