@@ -8,7 +8,7 @@
 #   bin/chip_session.sh bench      # just the BENCH capture
 #
 # Stages: bench | serve7b | sweep1b | vet | curve | domino
-set -u
+set -u -o pipefail   # pipefail: `stage | tee` must report the stage's rc
 cd "$(dirname "$0")/.."
 STAGES=${1:-all}
 
