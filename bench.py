@@ -106,6 +106,11 @@ CONFIGS = {
                               hidden=4096, ffn=11008, n_head=32,
                               n_layer=2, vocab_size=4096,
                               loss_chunk=256, remat=True),
+    # never in CANDIDATES: a seconds-cheap config for exercising the
+    # measured (non-tiny) path off-chip, e.g. the CPU-fallback guard
+    "tiny-cpu-guard": dict(batch=2, seq=128, n_layer=2, n_embd=64,
+                           n_head=4, vocab_size=256, loss_chunk=0,
+                           record=False),
 }
 
 
@@ -208,6 +213,20 @@ def run_config(name):
     from hcache_deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
     from hcache_deepspeed_tpu.platform import get_platform
 
+    tiny = os.environ.get("HDS_BENCH_TINY") == "1"
+    if not tiny and get_platform().name == "cpu":
+        # CPU fallback (mis-set env / relay plugin failing fast): refuse
+        # BEFORE the 33-step measurement — a 350M config takes minutes
+        # per step on CPU and would be misdiagnosed as a wedged compile
+        # service by the child timeout. A real TPU whose device_kind has
+        # no peak-TFLOPs entry is NOT refused (tokens/sec is still real;
+        # mfu just reads 0).
+        print(json.dumps(_error_payload(
+            "backend is 'cpu', not TPU; refusing to measure/record a "
+            "CPU-measured result as a chip metric")), flush=True)
+        _DONE.set()
+        return
+
     if os.environ.get("HDS_BENCH_TINY") == "1":
         # smoke config: exercises the identical code path in seconds on
         # a CPU backend (numbers are meaningless there)
@@ -233,7 +252,9 @@ def run_config(name):
     else:
         spec = CONFIGS[name]
         batch, seq = spec["batch"], spec.get("seq", 1024)
-        mcfg = GPT2Config(n_layer=24, n_embd=1024, n_head=spec["n_head"],
+        mcfg = GPT2Config(n_layer=spec.get("n_layer", 24),
+                          n_embd=spec.get("n_embd", 1024),
+                          n_head=spec["n_head"],
                           n_positions=seq, vocab_size=spec["vocab_size"],
                           dtype="bfloat16", remat=spec.get("remat", False),
                           loss_chunk=spec["loss_chunk"],
@@ -285,7 +306,9 @@ def run_config(name):
     vs_baseline = (mfu / 0.54) if peak else 0.0
 
     _DONE.set()
-    if os.environ.get("HDS_BENCH_TINY") != "1":
+    # configs marked record=False (dev-only shapes like tiny-cpu-guard)
+    # must never overwrite the committed chip 'last' record
+    if not tiny and CONFIGS.get(name, {}).get("record", True):
         _record_last_measured({
             "value": round(tokens_per_sec, 1),
             "mfu": round(mfu, 4),
@@ -299,8 +322,7 @@ def run_config(name):
         "unit": "tokens/sec",
         "vs_baseline": round(vs_baseline, 4),
         "extra": {
-            "config": "tiny" if os.environ.get("HDS_BENCH_TINY") == "1"
-                      else name,
+            "config": "tiny" if tiny else name,
             "seq": seq,
             "mfu": round(mfu, 4),
             "achieved_tflops": round(achieved_tflops, 2),

@@ -13,7 +13,10 @@ cd "$(dirname "$0")/.."
 STAGES=${1:-all}
 
 probe() {
-  timeout 75 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+  # jax.devices("tpu") raises on CPU fallback, so a dead relay that
+  # silently falls back to CPU still reports DOWN
+  timeout 75 python -c "import jax; d=jax.devices('tpu'); assert d, d" \
+    >/dev/null 2>&1
 }
 
 run_stage() {  # name, timeout, cmd...
@@ -26,7 +29,8 @@ run_stage() {  # name, timeout, cmd...
 }
 
 if ! probe; then
-  echo "relay DOWN (probe timed out); aborting" >&2
+  echo "relay DOWN or CPU fallback (no TPU devices / probe timed out);" \
+       "aborting" >&2
   exit 3
 fi
 echo "relay UP" >&2

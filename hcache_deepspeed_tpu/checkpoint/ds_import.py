@@ -66,6 +66,19 @@ def _to_np(t) -> np.ndarray:
                       if hasattr(t, "detach") else t, np.float32)
 
 
+def _to_np_keep_dtype(t) -> np.ndarray:
+    """Buffers (step counters, masks, position ids) keep their stored
+    dtype — the reference's zero_to_fp32 only float-casts the fp32
+    partition merges, never buffers. numpy has no bfloat16, so bf16
+    buffers (module buffers under a bf16 engine) widen to float32."""
+    if hasattr(t, "detach"):
+        import torch
+        if t.dtype == torch.bfloat16:
+            t = t.float()
+        return np.asarray(t.detach().numpy())
+    return np.asarray(t)
+
+
 def _numel(shape) -> int:
     return int(shape.numel() if hasattr(shape, "numel")
                else math.prod(tuple(shape)))
@@ -127,7 +140,7 @@ def load_ds_fp32_state_dict(ds_dir: str,
 
     # buffers are stored whole in the module state dict
     for name in model_state[_BUFFER_NAMES]:
-        out[name] = _to_np(model_state["module"][name])
+        out[name] = _to_np_keep_dtype(model_state["module"][name])
 
     frozen_shapes = model_state.get(_FROZEN_SHAPES) or {}
     if frozen_shapes and not exclude_frozen:
@@ -165,11 +178,21 @@ def _merge_frozen(out, stage, model_states, frozen_shapes, world):
     sit in the one model file only for stage<=2, so a stage-3 frozen
     import needs every zero_pp model shard (callers pass what exists)."""
     fragments = [ms.get(_FROZEN_FRAGMENTS) or {} for ms in model_states]
+    if stage == 3 and len(model_states) != world:
+        raise ValueError(
+            f"stage-3 frozen-param import needs all {world} "
+            f"zero_pp model shards (one fragment per rank) but found "
+            f"{len(model_states)} — incomplete checkpoint dir?")
     for name, shape in frozen_shapes.items():
         if stage <= 2:
             out[name] = _to_np(fragments[0][name]).reshape(
                 _shape_tuple(shape))
         else:
+            missing = [i for i, f in enumerate(fragments) if name not in f]
+            if missing:
+                raise ValueError(
+                    f"frozen param '{name}' missing from model shards "
+                    f"{missing} — corrupt or mismatched checkpoint")
             parts = [_to_np(f[name]).reshape(-1) for f in fragments]
             merged = np.concatenate(parts)[:_numel(shape)]
             out[name] = merged.reshape(_shape_tuple(shape))

@@ -33,20 +33,30 @@ GROUPS = [["transformer.w1", "transformer.b1"], ["head.w2"]]
 BUFFER = np.float32([7.0, 8.0])
 
 
-def _model_state_file(tmp, shared=None, module_extra=None):
+def _model_state_file(tmp, shared=None, module_extra=None,
+                      extra_buffers=None, fname="mp_rank_00_model_states.pt",
+                      frozen=None):
     module = {k: torch.tensor(v) for k, v in PARAMS.items()}
     module["pos.buf"] = torch.tensor(BUFFER)
+    for k, v in (extra_buffers or {}).items():
+        module[k] = torch.as_tensor(v)
     module.update(module_extra or {})
     state = {
         "module": module,
-        "buffer_names": ["pos.buf"],
+        "buffer_names": ["pos.buf"] + sorted(extra_buffers or {}),
         "param_shapes": [
             {name: torch.Size(PARAMS[name].shape) for name in g}
             for g in GROUPS],
         "shared_params": shared or {},
         "ds_version": "0.16.8",
     }
-    torch.save(state, os.path.join(tmp, "mp_rank_00_model_states.pt"))
+    if frozen is not None:
+        shapes, fragments = frozen
+        state["frozen_param_shapes"] = {
+            n: torch.Size(s) for n, s in shapes.items()}
+        state["frozen_param_fragments"] = {
+            n: torch.tensor(f) for n, f in fragments.items()}
+    torch.save(state, os.path.join(tmp, fname))
 
 
 def _optim_file(tmp, rank, osd):
@@ -99,6 +109,42 @@ def _write_stage3(tmp, n_subgroups=1):
         })
 
 
+FROZEN = {"frozen.emb": np.arange(30, 36, dtype=np.float32).reshape(2, 3)}
+
+
+def _write_stage3_frozen(tmp):
+    """Stage 3 with frozen params: per-rank model shards each carry a
+    ceil(numel/world) fragment in frozen_param_fragments
+    (zero_to_fp32.py:355); trainables merge from the optim shards as
+    usual."""
+    order = [n for g in GROUPS for n in g]
+    rank_flat = {r: [] for r in range(WORLD)}
+    for name in order:
+        flat = PARAMS[name].reshape(-1)
+        part = math.ceil(flat.size / WORLD)
+        padded = np.zeros(part * WORLD, np.float32)
+        padded[:flat.size] = flat
+        for r in range(WORLD):
+            rank_flat[r].append(padded[r * part:(r + 1) * part])
+    shapes = {n: v.shape for n, v in FROZEN.items()}
+    for r in range(WORLD):
+        frags = {}
+        for n, v in FROZEN.items():
+            flat = v.reshape(-1)
+            part = math.ceil(flat.size / WORLD)
+            padded = np.zeros(part * WORLD, np.float32)
+            padded[:flat.size] = flat
+            frags[n] = padded[r * part:(r + 1) * part]
+        _model_state_file(
+            tmp, frozen=(shapes, frags),
+            fname=f"zero_pp_rank_{r}_mp_rank_00_model_states.pt")
+        _optim_file(tmp, r, {
+            "zero_stage": 3,
+            "partition_count": WORLD,
+            "fp32_flat_groups": [torch.tensor(np.concatenate(rank_flat[r]))],
+        })
+
+
 def _check_params(state):
     for name, want in PARAMS.items():
         np.testing.assert_array_equal(state[name], want, err_msg=name)
@@ -120,6 +166,56 @@ class TestDsImport:
         (the GatheredTensor walk, zero_to_fp32.py:390)."""
         _write_stage3(str(tmp_path), n_subgroups=3)
         _check_params(load_ds_fp32_state_dict(str(tmp_path)))
+
+    def test_stage3_frozen_fragments(self, tmp_path):
+        """Frozen params merge from per-rank model-shard fragments
+        (zero_to_fp32.py:355)."""
+        _write_stage3_frozen(str(tmp_path))
+        state = load_ds_fp32_state_dict(str(tmp_path))
+        _check_params(state)
+        np.testing.assert_array_equal(state["frozen.emb"],
+                                      FROZEN["frozen.emb"])
+
+    def test_stage3_frozen_missing_shard_rejected(self, tmp_path):
+        _write_stage3_frozen(str(tmp_path))
+        os.remove(os.path.join(
+            str(tmp_path), "zero_pp_rank_1_mp_rank_00_model_states.pt"))
+        with pytest.raises(ValueError, match="model shards"):
+            load_ds_fp32_state_dict(str(tmp_path))
+
+    def test_buffer_dtype_preserved(self, tmp_path):
+        """Integer buffers (step counters) keep their stored dtype —
+        only fp32 partition merges are float-cast."""
+        _model_state_file(
+            str(tmp_path),
+            extra_buffers={"step.buf": np.int64([3, 4]),
+                           "mask.buf": np.array([True, False]),
+                           "bf16.buf": torch.tensor(
+                               [1.5, 2.5], dtype=torch.bfloat16)})
+        # reuse stage-2 optim shards against the richer model file
+        align = 2 * WORLD
+        partitions = {r: [] for r in range(WORLD)}
+        for g in GROUPS:
+            flat = np.concatenate([PARAMS[n].reshape(-1) for n in g])
+            padded = np.zeros(align * math.ceil(flat.size / align),
+                              np.float32)
+            padded[:flat.size] = flat
+            per = padded.size // WORLD
+            for r in range(WORLD):
+                partitions[r].append(
+                    torch.tensor(padded[r * per:(r + 1) * per]))
+        for r in range(WORLD):
+            _optim_file(str(tmp_path), r, {
+                "zero_stage": 2, "partition_count": WORLD,
+                "single_partition_of_fp32_groups": partitions[r]})
+        state = load_ds_fp32_state_dict(str(tmp_path))
+        assert state["step.buf"].dtype == np.int64
+        assert state["mask.buf"].dtype == np.bool_
+        np.testing.assert_array_equal(state["step.buf"], [3, 4])
+        # bf16 buffers (module buffers under a bf16 engine) widen to
+        # fp32 — numpy has no bfloat16 — instead of crashing on .numpy()
+        assert state["bf16.buf"].dtype == np.float32
+        np.testing.assert_array_equal(state["bf16.buf"], [1.5, 2.5])
 
     def test_shared_params_recovered(self, tmp_path):
         _write_stage2(str(tmp_path),
