@@ -49,10 +49,11 @@ fi
 if want serve7b; then
   if ! run_stage serve7b 3300 python bin/hds_serve_bench --model 7b \
       --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
-      | tee SERVE_7B.jsonl; then
+      --prefill-chunk 64 | tee SERVE_7B.jsonl; then
     run_stage serve7b-int8 3300 python bin/hds_serve_bench --model 7b \
       --quantize int8 --max-context 512 --prompt-len 128 \
-      --decode-steps 8 --batches 1 | tee SERVE_7B_INT8.jsonl
+      --decode-steps 8 --batches 1 --prefill-chunk 64 \
+      | tee SERVE_7B_INT8.jsonl
   fi
 fi
 

@@ -1,3 +1,4 @@
 """Autotuning (reference: ``deepspeed/autotuning/``)."""
 
 from .autotuner import Autotuner, ExperimentResult  # noqa: F401
+from .model_based import (ModelBasedAutotuner, aot_estimate)  # noqa: F401

@@ -8,8 +8,19 @@ from .progressive_layer_drop import ProgressiveLayerDrop, pld_layer
 from .quantize import (QuantizeScheduler, fake_quantize,
                        fake_quantize_traced, quantize_param_tree,
                        quantize_param_tree_traced)
+from .structured import (CompressionError, CompressionScheduler,
+                         CompressionState, activation_interceptor,
+                         apply_compression, fix_compression,
+                         get_compression_config, init_compression,
+                         quantize_activation, redundancy_clean,
+                         student_initialization)
 
 __all__ = ["fake_quantize", "fake_quantize_traced", "QuantizeScheduler",
            "quantize_param_tree", "quantize_param_tree_traced",
            "ProgressiveLayerDrop", "pld_layer", "hessian_eigenvalue",
-           "layer_eigenvalues", "moq_bit_assignment"]
+           "layer_eigenvalues", "moq_bit_assignment",
+           "CompressionError", "CompressionScheduler", "CompressionState",
+           "activation_interceptor", "apply_compression",
+           "fix_compression", "get_compression_config", "init_compression",
+           "quantize_activation", "redundancy_clean",
+           "student_initialization"]

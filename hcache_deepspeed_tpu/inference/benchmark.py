@@ -99,7 +99,9 @@ def _engine(model_size: str, max_context: int, batch: int,
     blocks_needed = batch * (-(-max_context // 64)) + 2
     quant = {}
     if quantize:
-        quant = {"enabled": True, "bits": 8, "group_size": 64,
+        # group 128 = one TPU lane row: sub-lane groups (e.g. 64) pad
+        # the stored int8 q and every quantization temp 2x
+        quant = {"enabled": True, "bits": 8, "group_size": 128,
                  "min_size": 1024,
                  "use_fused_kernel": quantize == "fused"}
     eng = InferenceEngineV2(

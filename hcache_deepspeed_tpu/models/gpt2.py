@@ -26,6 +26,10 @@ class GPT2Config:
     n_embd: int = 768
     n_layer: int = 12
     n_head: int = 12
+    #: MLP hidden width (0 = the GPT-2 default of 4*n_embd); settable so
+    #: a row-pruned + dimension-reduced export (compression/structured
+    #: redundancy_clean) can be rebuilt as a genuinely smaller model
+    n_inner: int = 0
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
     dtype: str = "float32"
@@ -121,7 +125,8 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool):
         C = x.shape[-1]
-        h = nn.Dense(4 * C, dtype=x.dtype, name="c_fc")(x)
+        h = nn.Dense(self.cfg.n_inner or 4 * C, dtype=x.dtype,
+                     name="c_fc")(x)
         h = nn.gelu(h, approximate=True)
         h = nn.Dense(C, dtype=x.dtype, name="c_proj")(h)
         if train and self.cfg.dropout > 0:

@@ -142,7 +142,16 @@ class QuantizedTensor:
     def make_batched(cls, x, group_size=256, num_bits=8):
         """Quantize a stacked ``[L, ...]`` weight with groups that never
         straddle layer boundaries. Returns None when the per-layer size
-        is not a group multiple (caller keeps the leaf unquantized)."""
+        is not a group multiple (caller keeps the leaf unquantized).
+
+        Quantizes LAYER BY LAYER: the fp32 cast + group reshape inside
+        ``quantize`` is transient per layer instead of for the whole
+        stack — a 7B model's stacked MLP leaf is ~1.4e9 elements, whose
+        one-shot fp32 group view needs >10 GB of HBM (with sub-lane
+        group sizes XLA pads the trailing dim to 128, doubling it
+        again); per-layer it is ~180 MB. One compile serves all layers
+        (identical shapes), and host (numpy) inputs stream one layer at
+        a time instead of landing on device whole."""
         L = x.shape[0]
         per_shape = x.shape[1:]
         n = 1
@@ -150,13 +159,14 @@ class QuantizedTensor:
             n *= d
         if n % group_size:
             return None
-        # per-layer sizes are group multiples, so flat groups align with
-        # layers and a plain quantize produces layer-pure groups
-        q, scale, _, _ = quantize(x, group_size=group_size,
-                                  num_bits=num_bits)
-        G = n // group_size
-        return cls(q.reshape(L, G, group_size), scale.reshape(L, G, 1),
-                   per_shape, n, x.dtype)
+        qs, scales = [], []
+        for layer in range(L):
+            q, scale, _, _ = quantize(x[layer], group_size=group_size,
+                                      num_bits=num_bits)
+            qs.append(q)
+            scales.append(scale)
+        return cls(jnp.stack(qs), jnp.stack(scales), per_shape, n,
+                   x.dtype)
 
 
 def quantize_tree(tree, *, group_size=256, num_bits=8, min_size=4096,

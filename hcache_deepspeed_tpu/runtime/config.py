@@ -11,7 +11,7 @@ over; TPU-specific knobs live under ``mesh`` and new subsections.
 import json
 from typing import Any, Dict, List, Literal, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ..linear.config import DEFAULT_TARGET_MODS as _DEFAULT_TARGET_MODS
 from ..utils.logging import logger
@@ -248,9 +248,63 @@ class PLDConfig(HDSConfigModel):
 
 
 class CompressionConfig(HDSConfigModel):
+    """``compression_training`` block. Two families share it:
+
+    * MoQ + PLD (flat keys, reference runtime/quantize.py) — typed
+      fields below.
+    * The structured library (reference deepspeed/compression/config.py:
+      nested ``shared_parameters``/``different_groups`` per technique) —
+      kept as raw dicts and parsed by ``compression.structured``.
+
+    A nested ``weight_quantization`` block (it contains
+    ``shared_parameters``) is routed to the structured library; the flat
+    spelling keeps driving MoQ."""
     weight_quantization: WeightQuantizationConfig = Field(
         default_factory=WeightQuantizationConfig)
     progressive_layer_drop: PLDConfig = Field(default_factory=PLDConfig)
+    weight_quantization_structured: Dict[str, Any] = Field(
+        default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+    @model_validator(mode="before")
+    @classmethod
+    def _route_nested_weight_quantization(cls, values):
+        if isinstance(values, dict):
+            wq = values.get("weight_quantization")
+            if isinstance(wq, dict) and "shared_parameters" in wq:
+                values = dict(values)
+                values["weight_quantization_structured"] = \
+                    values.pop("weight_quantization")
+        return values
+
+    def structured_block(self):
+        """The raw ``compression_training`` sub-dict for
+        ``compression.structured.get_compression_config`` — or ``None``
+        when no structured technique is configured as enabled."""
+        block = {}
+        if self.weight_quantization_structured:
+            block["weight_quantization"] = self.weight_quantization_structured
+        for key in ("sparse_pruning", "row_pruning", "head_pruning",
+                    "channel_pruning", "activation_quantization"):
+            v = getattr(self, key)
+            if v:
+                block[key] = v
+        def on(d):
+            return bool((d.get("shared_parameters") or {}).get("enabled"))
+
+        # layer_reduction is an init/export-time transform
+        # (student_initialization), never applied in the train step —
+        # it alone must not activate the engine's structured path
+        if not any(on(v) for v in block.values()):
+            return None
+        if self.layer_reduction:
+            block["layer_reduction"] = self.layer_reduction
+        return {"compression_training": block}
 
 
 class CurriculumLearningConfig(HDSConfigModel):

@@ -397,6 +397,58 @@ class HDSEngine:
     # ------------------------------------------------------------------ #
     # State init
     # ------------------------------------------------------------------ #
+    def _init_structured_compression(self, params, param_shardings):
+        """Wire the structured compression library (sparse/row/head/
+        channel pruning, staged weight quant, activation quant) into the
+        engine when the config carries reference-style technique blocks
+        (reference: compress.py init_compression + scheduler.py; repo:
+        compression/structured.py). Masks are computed from the initial
+        weights host-side once; ``topk`` scores join the params pytree
+        so every downstream structure (optimizer, grads, checkpoints)
+        carries them automatically."""
+        self._structured = None
+        self._structured_masks = None
+        self._structured_sched = None
+        sblock = self.config.compression_training.structured_block()
+        if sblock is None:
+            return params, param_shardings
+        from .config import HDSConfigError
+        if self._zeropp:
+            raise HDSConfigError(
+                "structured compression is not supported on the manual "
+                "ZeRO++ step; disable one of the two")
+        if self._onebit is not None:
+            raise HDSConfigError(
+                "structured compression is not supported with 1-bit "
+                "optimizers")
+        if self.topology.pipe_size > 1:
+            raise HDSConfigError(
+                "structured compression is not supported with pipeline "
+                "parallelism yet")
+        from ..compression import CompressionScheduler, init_compression
+        from ..compression.structured import SCORES_KEY
+        host = jax.device_get(params)
+        new_params, comp = init_compression(host, sblock)
+        if not any(comp.enabled(t) for t in comp.spec):
+            return params, param_shardings
+        if self._lora is not None and SCORES_KEY in new_params:
+            raise HDSConfigError(
+                "topk pruning scores cannot be trained under LoRA (the "
+                "trainable tree is the adapters); use the l1 methods")
+        self._structured = comp
+        # masks ride the step as device constants (replicated: they are
+        # either tiny per-axis vectors or — for sparse — full kernel
+        # shapes, which stage-3 setups should prefer l1-on-export for)
+        self._structured_masks = {k: jnp.asarray(v)
+                                  for k, v in comp.masks.items()}
+        self._structured_sched = CompressionScheduler(comp)
+        if SCORES_KEY in new_params:
+            # re-place: the tree gained the scores subtree
+            param_shardings = self.policy.named(
+                self.policy.param_specs(new_params))
+            params = jax.device_put(new_params, param_shardings)
+        return params, param_shardings
+
     def _init_state(self, init_params, example_batch):
         policy = self.policy
         mesh = self.mesh
@@ -424,6 +476,15 @@ class HDSEngine:
                 policy.tp_spec_fn = auto_tp_spec_fn(params)
             param_shardings = policy.named(policy.param_specs(params))
             params = jax.device_put(params, param_shardings)
+
+        # ---- structured compression (reference: compress.py:102
+        # init_compression — there module surgery before the engine
+        # wraps the model; here a pytree pass over the freshly
+        # materialised params: l1 masks from the initial weights, topk
+        # scores injected as a params subtree so the optimizer below
+        # trains them) ----
+        params, param_shardings = self._init_structured_compression(
+            params, param_shardings)
 
         # ---- LoRA: the trainable tree becomes the adapter factors; the
         # full (optionally quantized) tree is frozen engine state. Every
@@ -647,8 +708,12 @@ class HDSEngine:
 
         lora_cfg = getattr(self, "_lora_cfg", None)
 
+        structured = self._structured
+        structured_masks = self._structured_masks
+
         def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train,
-                          frozen=None, moq_bits=None, pld_theta=None):
+                          frozen=None, moq_bits=None, pld_theta=None,
+                          comp_step=None):
             def raw_loss(p):
                 if lora_cfg is not None:
                     from ..linear import merge_lora
@@ -657,8 +722,29 @@ class HDSEngine:
                     from ..compression import quantize_param_tree_traced
                     p = quantize_param_tree_traced(p, moq_bits,
                                                    groups=moq_groups)
-                loss, _aux = self.adapter.loss(p, batch, rng, train=train,
-                                               pld_theta=pld_theta)
+                act_ctx = None
+                if structured is not None and comp_step is not None:
+                    from ..compression import (activation_interceptor,
+                                               apply_compression)
+                    from ..compression.structured import (
+                        ACTIVATION_QUANTIZATION, SCORES_KEY)
+                    p = apply_compression(p, structured, comp_step,
+                                          masks=structured_masks)
+                    # scores already contributed via the masks; the
+                    # model itself never sees the reserved subtree
+                    p = {k: v for k, v in p.items() if k != SCORES_KEY}
+                    if structured.enabled(ACTIVATION_QUANTIZATION):
+                        import flax.linen as fnn
+                        act_ctx = fnn.intercept_methods(
+                            activation_interceptor(structured, comp_step))
+                if act_ctx is not None:
+                    with act_ctx:
+                        loss, _aux = self.adapter.loss(
+                            p, batch, rng, train=train,
+                            pld_theta=pld_theta)
+                else:
+                    loss, _aux = self.adapter.loss(
+                        p, batch, rng, train=train, pld_theta=pld_theta)
                 return loss
 
             if remat_policy is not None:
@@ -702,11 +788,35 @@ class HDSEngine:
             donate_argnums=(1,),
             static_argnums=(5,))
 
-        def eval_loss(params, batch, frozen=None):
+        def eval_loss(params, batch, frozen=None, comp_step=None):
             if lora_cfg is not None:
                 from ..linear import merge_lora
                 params = merge_lora(frozen, params, lora_cfg)
-            loss, aux = self.adapter.loss(params, batch, None, train=False)
+            act_ctx = None
+            if structured is not None and comp_step is not None:
+                # eval must see the same compressed model training sees
+                # (the reference's module surgery compresses every
+                # forward), or monitored eval metrics describe a model
+                # that no longer exists
+                from ..compression import (activation_interceptor,
+                                           apply_compression)
+                from ..compression.structured import (
+                    ACTIVATION_QUANTIZATION, SCORES_KEY)
+                params = apply_compression(params, structured, comp_step,
+                                           masks=structured_masks)
+                params = {k: v for k, v in params.items()
+                          if k != SCORES_KEY}
+                if structured.enabled(ACTIVATION_QUANTIZATION):
+                    import flax.linen as fnn
+                    act_ctx = fnn.intercept_methods(
+                        activation_interceptor(structured, comp_step))
+            if act_ctx is not None:
+                with act_ctx:
+                    loss, aux = self.adapter.loss(params, batch, None,
+                                                  train=False)
+            else:
+                loss, aux = self.adapter.loss(params, batch, None,
+                                              train=False)
             return loss
 
         self._eval_loss = jax.jit(eval_loss)
@@ -803,7 +913,7 @@ class HDSEngine:
 
         # fully fused train_batch: scan microbatches then apply
         def fused_train_batch(state, batches, lr, rng, moq_bits=None,
-                              pld_theta=None):
+                              pld_theta=None, comp_step=None):
             # hpZ: refresh the secondary partition once, reuse across the
             # whole gradient-accumulation scan
             secondary = prepare_secondary(state["params"]) \
@@ -832,6 +942,8 @@ class HDSEngine:
                         kw["moq_bits"] = moq_bits
                     if pld_theta is not None:
                         kw["pld_theta"] = pld_theta
+                    if comp_step is not None:
+                        kw["comp_step"] = comp_step
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
                         batch, key, True, **kw)
@@ -873,7 +985,7 @@ class HDSEngine:
             return apply_cache[key](state, lr)
 
         def fused_dispatch(state, batches, lr, rng, moq_bits=None,
-                           pld_theta=None):
+                           pld_theta=None, comp_step=None):
             flags, key = _flags_key()
             if key not in fused_cache:
                 fused_cache[key] = make_fused(flags)
@@ -954,6 +1066,9 @@ class HDSEngine:
         if self.progressive_layer_drop is not None:
             extra_kw["pld_theta"] = jnp.asarray(
                 self.progressive_layer_drop.get_theta(), jnp.float32)
+        if self._structured is not None:
+            extra_kw["comp_step"] = jnp.asarray(self.global_steps,
+                                                jnp.int32)
         with self.platform.annotate("hds.fwd_bwd"):
             loss, new_acc = self._micro_fwd_bwd(
                 self.state["params"], self.state["grad_acc"],
@@ -1049,6 +1164,8 @@ class HDSEngine:
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if self._structured_sched is not None:
+            self._structured_sched.step()
         # the 1-bit path also masks out non-finite updates (no loss
         # scaler to recover with — but the skip must not be silent)
         skipped = (self.fp16_enabled or self._onebit is not None) \
@@ -1150,6 +1267,9 @@ class HDSEngine:
         if self.progressive_layer_drop is not None:
             pld_theta = jnp.asarray(
                 self.progressive_layer_drop.get_theta(), jnp.float32)
+        comp_step = None
+        if self._structured is not None:
+            comp_step = jnp.asarray(self.global_steps, jnp.int32)
         fp_cfg = self.config.flops_profiler
         profiling = (fp_cfg.enabled
                      and self.global_steps == fp_cfg.profile_step)
@@ -1162,11 +1282,12 @@ class HDSEngine:
         with self.platform.annotate("hds.train_batch"):
             self.state, loss, finite, grad_norm = self._fused_train_batch(
                 self.state, batch, lr, self._next_rng(), moq_bits,
-                pld_theta)
+                pld_theta, comp_step)
         if profiling:
             loss.block_until_ready()
             self._print_flops_profile(batch, lr, moq_bits, pld_theta,
-                                      time.perf_counter() - t0)
+                                      time.perf_counter() - t0,
+                                      comp_step=comp_step)
         self._last_grad_norm = grad_norm
         self.micro_steps += gas
         self._after_step(finite)
@@ -1180,7 +1301,7 @@ class HDSEngine:
         return loss
 
     def _print_flops_profile(self, shaped_batch, lr, moq_bits, pld_theta,
-                             step_seconds):
+                             step_seconds, comp_step=None):
         """``flops_profiler`` config block (reference: the engine calls
         the profiler at ``profile_step``, engine.py:301,1985). The cost
         comes from XLA's analysis of the ACTUAL fused train program —
@@ -1198,7 +1319,7 @@ class HDSEngine:
                      ranks=[0])
             cost = extract_cost(self._fused_train_batch.lower(
                 self.state, shaped_batch, lr, jax.random.PRNGKey(0),
-                moq_bits, pld_theta).compile())
+                moq_bits, pld_theta, comp_step).compile())
             prof.flops = cost["flops"]
             prof.bytes_accessed = cost["bytes_accessed"]
             prof.duration = step_seconds
@@ -1248,10 +1369,12 @@ class HDSEngine:
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)
+        kw = {}
         if self._lora is not None:
-            return self._eval_loss(self.state["params"], batch,
-                                   frozen=self.state["frozen"])
-        return self._eval_loss(self.state["params"], batch)
+            kw["frozen"] = self.state["frozen"]
+        if getattr(self, "_structured", None) is not None:
+            kw["comp_step"] = jnp.asarray(self.global_steps, jnp.int32)
+        return self._eval_loss(self.state["params"], batch, **kw)
 
     # ------------------------------------------------------------------ #
     # Introspection (reference: get_lr, get_global_grad_norm, ...)

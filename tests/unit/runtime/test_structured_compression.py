@@ -1,0 +1,554 @@
+"""Structured compression library (reference:
+``deepspeed/compression/compress.py`` init_compression /
+redundancy_clean, ``basic_layer.py`` LinearLayer_Compress,
+``scheduler.py``; repo: ``compression/structured.py``).
+
+Strategy mirrors the reference's compression unit tests: small models,
+known configs with the reference's JSON keys, checks on mask ratios,
+schedule gating, the masked-vs-sliced equivalence that makes dimension
+reduction sound, and a prune -> train -> fix -> export round trip."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from hcache_deepspeed_tpu.compression import (
+    CompressionError, CompressionScheduler, activation_interceptor,
+    apply_compression, fix_compression, get_compression_config,
+    init_compression, redundancy_clean, student_initialization)
+from hcache_deepspeed_tpu.compression.structured import SCORES_KEY
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                              gpt2_tiny)
+
+
+def _mlp_params(rng=0, d_in=8, d_h=16, d_out=8):
+    r = np.random.default_rng(rng)
+    return {
+        "mlp": {
+            "c_fc": {"kernel": jnp.asarray(
+                r.standard_normal((d_in, d_h)), jnp.float32),
+                "bias": jnp.asarray(r.standard_normal(d_h), jnp.float32)},
+            "c_proj": {"kernel": jnp.asarray(
+                r.standard_normal((d_h, d_out)), jnp.float32),
+                "bias": jnp.asarray(r.standard_normal(d_out), jnp.float32)},
+        }
+    }
+
+
+def _mlp_forward(params, x):
+    h = x @ params["mlp"]["c_fc"]["kernel"] + params["mlp"]["c_fc"]["bias"]
+    h = nn.gelu(h, approximate=True)
+    return h @ params["mlp"]["c_proj"]["kernel"] \
+        + params["mlp"]["c_proj"]["bias"]
+
+
+class TestConfig:
+    def test_reference_keys_and_defaults(self):
+        cfg = get_compression_config({"compression_training": {
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 5,
+                                      "method": "l1"},
+                "different_groups": {
+                    "sp1": {"params": {"dense_ratio": 0.5},
+                            "modules": ["mlp\\.c_fc"]}}}}})
+        sp = cfg["sparse_pruning"]
+        assert sp["shared_parameters"]["enabled"] is True
+        assert sp["shared_parameters"]["schedule_offset"] == 5
+        assert sp["different_groups"]["sp1"]["params"]["dense_ratio"] == 0.5
+        # untouched techniques default to disabled
+        assert cfg["row_pruning"]["shared_parameters"]["enabled"] is False
+        assert cfg["layer_reduction"]["enabled"] is False
+
+    def test_bad_regex_rejected(self):
+        with pytest.raises(CompressionError, match="regex"):
+            init_compression(_mlp_params(), {"compression_training": {
+                "sparse_pruning": {
+                    "shared_parameters": {"enabled": True},
+                    "different_groups": {
+                        "g": {"params": {"dense_ratio": 0.5},
+                              "modules": ["[unclosed"]}}}}})
+
+    def test_double_claim_rejected(self):
+        with pytest.raises(CompressionError, match="matched by both"):
+            init_compression(_mlp_params(), {"compression_training": {
+                "sparse_pruning": {
+                    "shared_parameters": {"enabled": True},
+                    "different_groups": {
+                        "a": {"params": {"dense_ratio": 0.5},
+                              "modules": ["c_fc"]},
+                        "b": {"params": {"dense_ratio": 0.2},
+                              "modules": ["mlp"]}}}}})
+
+
+SPARSE_CFG = {"compression_training": {"sparse_pruning": {
+    "shared_parameters": {"enabled": True, "schedule_offset": 3,
+                          "method": "l1"},
+    "different_groups": {"sp1": {"params": {"dense_ratio": 0.25},
+                                 "modules": ["c_fc"]}}}}}
+
+
+class TestSparsePruning:
+    def test_l1_mask_ratio_and_gating(self):
+        params, comp = init_compression(_mlp_params(), SPARSE_CFG)
+        m = comp.masks["sparse::mlp/c_fc"]
+        assert float(m.mean()) == pytest.approx(0.25, abs=0.02)
+        w0 = params["mlp"]["c_fc"]["kernel"]
+        before = apply_compression(params, comp, step=0)
+        after = apply_compression(params, comp, step=3)
+        np.testing.assert_array_equal(before["mlp"]["c_fc"]["kernel"], w0)
+        np.testing.assert_array_equal(
+            after["mlp"]["c_fc"]["kernel"], w0 * m)
+        # l1 keeps the largest-magnitude quartile
+        kept = np.abs(np.asarray(w0))[np.asarray(m) > 0]
+        dropped = np.abs(np.asarray(w0))[np.asarray(m) == 0]
+        assert kept.min() >= dropped.max()
+
+    def test_gating_is_jit_safe(self):
+        params, comp = init_compression(_mlp_params(), SPARSE_CFG)
+
+        @jax.jit
+        def f(p, step):
+            return apply_compression(p, comp, step)["mlp"]["c_fc"]["kernel"]
+
+        np.testing.assert_array_equal(f(params, 0),
+                                      params["mlp"]["c_fc"]["kernel"])
+        np.testing.assert_array_equal(
+            f(params, 7),
+            params["mlp"]["c_fc"]["kernel"] * comp.masks["sparse::mlp/c_fc"])
+
+    def test_topk_scores_learnable(self):
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True, "method": "topk"},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["c_fc"]}}}}}
+        params, comp = init_compression(_mlp_params(), cfg)
+        assert "sparse::mlp/c_fc" in params[SCORES_KEY]
+        x = jnp.ones((2, 8))
+
+        def loss(p):
+            return (_mlp_forward(apply_compression(p, comp, step=10), x)
+                    ** 2).sum()
+
+        g = jax.grad(loss)(params)
+        # straight-through: gradients reach the mask scores
+        assert float(jnp.abs(g[SCORES_KEY]["sparse::mlp/c_fc"]).sum()) > 0
+
+
+ROW_CFG = {"compression_training": {"row_pruning": {
+    "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                          "method": "l1"},
+    "different_groups": {"rp1": {"params": {"dense_ratio": 0.5},
+                                 "modules": ["c_fc"],
+                                 "related_modules": [["c_proj"]]}}}}}
+
+
+class TestRowPruning:
+    def test_masked_equals_sliced(self):
+        """The soundness contract of dimension reduction (reference
+        fix_row_col_pruning_helper): slicing pruned output neurons out
+        of F1 and the matching input columns out of F2 computes exactly
+        the masked forward — gelu(0) == 0 kills each pruned unit."""
+        params, comp = init_compression(_mlp_params(), ROW_CFG)
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((4, 8)),
+                        jnp.float32)
+        masked = _mlp_forward(apply_compression(params, comp, step=1), x)
+        fixed, dims = fix_compression(params, comp, dim_reduction=True)
+        assert fixed["mlp"]["c_fc"]["kernel"].shape == (8, 8)
+        assert fixed["mlp"]["c_fc"]["bias"].shape == (8,)
+        assert fixed["mlp"]["c_proj"]["kernel"].shape == (8, 8)
+        assert dims["mlp/c_fc"]["keep"] == 8
+        assert dims["mlp/c_proj"] == {"axis": 0, "keep": 8}
+        sliced = _mlp_forward(jax.tree.map(jnp.asarray, fixed), x)
+        np.testing.assert_allclose(np.asarray(masked), np.asarray(sliced),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mask_only_without_related(self):
+        cfg = {"compression_training": {"row_pruning": {
+            "shared_parameters": {"enabled": True, "method": "l1"},
+            "different_groups": {"rp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["c_fc"]}}}}}
+        params, comp = init_compression(_mlp_params(), cfg)
+        fixed, dims = redundancy_clean(params, cfg, comp)
+        # no related_modules -> masked to zero, no dim change
+        assert fixed["mlp"]["c_fc"]["kernel"].shape == (8, 16)
+        assert dims == {}
+        cols = np.abs(fixed["mlp"]["c_fc"]["kernel"]).sum(0)
+        assert (cols == 0).sum() == 8
+
+
+class _Attn(nn.Module):
+    """Minimal MHA with the repo's fused-QKV layout (c_attn (C, 3*H*hd),
+    c_proj (H*hd, C)) for masked-vs-sliced head equivalence; head_dim is
+    explicit so a head-reduced rebuild keeps the residual stream."""
+    heads: int
+    hd: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        qkv = nn.Dense(3 * self.heads * self.hd, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def split(t):
+            return t.reshape(*t.shape[:-1], self.heads, self.hd)
+
+        q, k, v = split(q), split(k), split(v)
+        att = jax.nn.softmax(
+            jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(self.hd),
+            axis=-1)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(*x.shape[:-1], self.heads * self.hd)
+        return nn.Dense(C, name="c_proj")(y)
+
+
+HEAD_CFG = {"compression_training": {"head_pruning": {
+    "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                          "method": "topk", "num_heads": 4},
+    "different_groups": {"hp1": {"params": {"dense_ratio": 0.5},
+                                 "modules": ["c_proj"],
+                                 "related_modules": [["c_attn"]]}}}}}
+
+
+class TestHeadPruning:
+    def _setup(self):
+        model = _Attn(heads=4)
+        x = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (2, 5, 16)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        return model, dict(params), x
+
+    def test_masked_equals_sliced(self):
+        model, params, x = self._setup()
+        params, comp = init_compression(params, HEAD_CFG)
+        masked = model.apply(
+            {"params": apply_compression(params, comp, step=1)}, x)
+        fixed, dims = fix_compression(params, comp, dim_reduction=True)
+        kept = dims["c_proj"]["heads"]
+        assert kept == 2
+        assert fixed["c_proj"]["kernel"].shape == (8, 16)   # 2 heads * 4
+        assert fixed["c_attn"]["kernel"].shape == (16, 24)  # 3 * 2 * 4
+        assert fixed["c_attn"]["bias"].shape == (24,)
+        small = _Attn(heads=kept)
+        # the reduced model's C comes from the residual stream; head_dim
+        # stays 4, so rebuild with heads=2 over the same stream
+        sliced = small.apply({"params": jax.tree.map(jnp.asarray, fixed)}, x)
+        np.testing.assert_allclose(np.asarray(masked), np.asarray(sliced),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_num_heads_required(self):
+        _, params, _ = self._setup()
+        bad = {"compression_training": {"head_pruning": {
+            "shared_parameters": {"enabled": True, "method": "topk"},
+            "different_groups": {"hp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["c_proj"]}}}}}
+        with pytest.raises(CompressionError, match="num_heads"):
+            init_compression(params, bad)
+
+
+class TestChannelPruning:
+    def test_related_upstream_sliced(self):
+        """Channel pruning removes input channels of F2; the upstream F1
+        must lose the matching OUTPUT slices or the export is
+        shape-inconsistent."""
+        cfg = {"compression_training": {"channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "method": "l1"},
+            "different_groups": {"cp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["c_proj"],
+                                         "related_modules": [["c_fc"]]}}}}}
+        params, comp = init_compression(_mlp_params(), cfg)
+        x = jnp.asarray(np.random.default_rng(4).standard_normal((4, 8)),
+                        jnp.float32)
+        fixed, dims = fix_compression(params, comp, dim_reduction=True)
+        assert fixed["mlp"]["c_proj"]["kernel"].shape == (8, 8)
+        assert fixed["mlp"]["c_fc"]["kernel"].shape == (8, 8)
+        assert fixed["mlp"]["c_fc"]["bias"].shape == (8,)
+        assert dims["mlp/c_fc"] == {"axis": 1, "keep": 8}
+        # forward runs at the reduced width (consistency is the point;
+        # unlike row pruning the masked c_proj-input equivalence is not
+        # exact because c_fc bias and gelu(0) != masked channel output)
+        _mlp_forward(jax.tree.map(jnp.asarray, fixed), x)
+
+    def test_head_group_without_related_masks_not_slices(self):
+        """A head group WITHOUT related_modules must mask even when
+        another technique triggers dimension reduction globally —
+        slicing only one side would break the QKV/O shape contract."""
+        model = _Attn(heads=4)
+        x = jnp.zeros((1, 3, 16), jnp.float32)
+        params = dict(model.init(jax.random.PRNGKey(0), x)["params"])
+        cfg = {"compression_training": {
+            "head_pruning": {
+                "shared_parameters": {"enabled": True, "method": "topk",
+                                      "num_heads": 4},
+                "different_groups": {"hp": {
+                    "params": {"dense_ratio": 0.5},
+                    "modules": ["c_proj"]}}}}}
+        params, comp = init_compression(params, cfg)
+        fixed, dims = fix_compression(params, comp, dim_reduction=True)
+        assert fixed["c_proj"]["kernel"].shape == (16, 16)  # unsliced
+        assert "c_proj" not in dims
+
+
+class TestWeightQuantization:
+    def test_staircase_and_error(self):
+        cfg = {"compression_training": {"weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"wq1": {
+                "params": {"start_bits": 16, "target_bits": 4,
+                           "quantization_period": 2},
+                "modules": ["c_fc"]}}}}}
+        params, comp = init_compression(_mlp_params(), cfg)
+        stair = comp.wq_bits_path["mlp/c_fc"]
+        assert stair[0] == 16 and stair[-1] == 4
+        assert all(a >= b for a, b in zip(stair, stair[1:]))
+        w = params["mlp"]["c_fc"]["kernel"]
+        errs = []
+        for step in (0, 2, 4, 20):
+            q = apply_compression(params, comp, step)["mlp"]["c_fc"][
+                "kernel"]
+            errs.append(float(jnp.abs(q - w).mean()))
+        assert errs[-1] >= errs[0]   # coarser bits, larger error
+        assert errs[-1] > 0
+
+
+class TestActivationQuantization:
+    def test_interceptor_gates_on_offset(self):
+        cfg = {"compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "quantization_type": "symmetric",
+                                  "range_calibration": "dynamic"},
+            "different_groups": {"aq1": {"params": {"bits": 4},
+                                         "modules": ["c_fc"]}}}}}
+        model = _Attn(heads=4)  # unrelated module: no match, no change
+        mlp = _MLPModule()
+        x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8)),
+                        jnp.float32)
+        params = mlp.init(jax.random.PRNGKey(0), x)["params"]
+        _, comp = init_compression(dict(params), cfg)
+        plain = mlp.apply({"params": params}, x)
+        with nn.intercept_methods(activation_interceptor(comp, step=0)):
+            pre = mlp.apply({"params": params}, x)
+        with nn.intercept_methods(activation_interceptor(comp, step=5)):
+            post = mlp.apply({"params": params}, x)
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(pre))
+        assert not np.allclose(np.asarray(plain), np.asarray(post))
+
+
+class _MLPModule(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(16, name="c_fc")(x)
+        return nn.Dense(8, name="c_proj")(nn.gelu(h))
+
+
+class TestLayerReduction:
+    def test_per_layer_subtrees(self):
+        cfg = gpt2_tiny(n_layer=4)
+        scfg = gpt2_tiny(n_layer=2)
+        batch = {"input_ids": np.zeros((1, 8), np.int32)}
+        teacher = GPT2LMHeadModel(cfg).init(
+            jax.random.PRNGKey(0), batch)["params"]
+        student = GPT2LMHeadModel(scfg).init(
+            jax.random.PRNGKey(1), batch)["params"]
+        ds = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "module_name_prefix": "h",
+            "teacher_layer": [1, 3],
+            "other_module_name": ["wte", "wpe", "ln_f"]}}}
+        out = student_initialization(student, teacher, ds)
+        for s_i, t_i in ((0, 1), (1, 3)):
+            np.testing.assert_array_equal(
+                out[f"h_{s_i}"]["mlp"]["c_fc"]["kernel"],
+                teacher[f"h_{t_i}"]["mlp"]["c_fc"]["kernel"])
+        np.testing.assert_array_equal(out["wte"]["embedding"],
+                                      teacher["wte"]["embedding"])
+
+    def test_stacked_layer_axis_gather(self):
+        r = np.random.default_rng(0)
+        teacher = {"h": {"w": jnp.asarray(r.standard_normal((4, 3, 3)),
+                                          jnp.float32)},
+                   "emb": {"embedding": jnp.ones((5, 3))}}
+        student = {"h": {"w": jnp.zeros((2, 3, 3))},
+                   "emb": {"embedding": jnp.zeros((5, 3))}}
+        ds = {"compression_training": {"layer_reduction": {
+            "enabled": True, "module_name_prefix": "h",
+            "teacher_layer": [0, 2], "other_module_name": ["emb"]}}}
+        out = student_initialization(student, teacher, ds)
+        np.testing.assert_array_equal(out["h"]["w"],
+                                      teacher["h"]["w"][jnp.asarray([0, 2])])
+        np.testing.assert_array_equal(out["emb"]["embedding"],
+                                      teacher["emb"]["embedding"])
+
+    def test_disabled_is_identity(self):
+        student = {"h_0": {"kernel": jnp.ones((2, 2))}}
+        out = student_initialization(student, {}, {})
+        assert out is student
+
+    def test_dict_of_layers_not_misread_as_stacked(self):
+        """A dotted per-layer layout ({'h': {'0': ..., '1': ...}}) must
+        copy layer subtrees, never row-gather kernels."""
+        r = np.random.default_rng(1)
+        layers = {str(i): {"kernel": jnp.asarray(
+            r.standard_normal((6, 5)), jnp.float32)} for i in range(4)}
+        teacher = {"h": layers}
+        student = {"h": {"0": {"kernel": jnp.zeros((6, 5))},
+                         "1": {"kernel": jnp.zeros((6, 5))}}}
+        ds = {"compression_training": {"layer_reduction": {
+            "enabled": True, "module_name_prefix": "h",
+            "teacher_layer": [1, 3]}}}
+        out = student_initialization(student, teacher, ds)
+        np.testing.assert_array_equal(out["h"]["0"]["kernel"],
+                                      teacher["h"]["1"]["kernel"])
+        np.testing.assert_array_equal(out["h"]["1"]["kernel"],
+                                      teacher["h"]["3"]["kernel"])
+
+
+class TestScheduler:
+    def test_live_windows(self):
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "schedule_offset_end": 4,
+                                  "method": "l1"},
+            "different_groups": {"g": {"params": {"dense_ratio": 0.5},
+                                       "modules": ["c_fc"]}}}}}
+        _, comp = init_compression(_mlp_params(), cfg)
+        sched = CompressionScheduler(comp)
+        live = []
+        for _ in range(6):
+            sched.step()
+            live.append(sched.live("sparse_pruning"))
+        assert live == [False, True, True, True, False, False]
+
+
+class TestEngineIntegration:
+    def test_config_driven_prune_train_export(self):
+        """Reference user flow: technique blocks in the engine config
+        (compression_training with the reference's nested keys) drive
+        pruning inside engine.train_batch; topk scores train with the
+        model; export reduces dims."""
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.compression.structured import SCORES_KEY
+
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 256, (8, 32), np.int32)}
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "compression_training": {
+                "sparse_pruning": {
+                    "shared_parameters": {"enabled": True,
+                                          "schedule_offset": 1,
+                                          "method": "topk"},
+                    "different_groups": {"sp1": {
+                        "params": {"dense_ratio": 0.5},
+                        "modules": [r"mlp/c_fc"]}}},
+                "row_pruning": {
+                    "shared_parameters": {"enabled": True,
+                                          "schedule_offset": 1,
+                                          "method": "l1"},
+                    "different_groups": {"rp1": {
+                        "params": {"dense_ratio": 0.5},
+                        "modules": [r"mlp/c_proj$"],
+                        "related_modules": [[r"attn/c_attn__nomatch"]]}}},
+            },
+        }
+        engine, _, _, _ = hds.initialize(
+            model=GPT2LMHeadModel(gpt2_tiny()), config=cfg,
+            example_batch=batch)
+        assert engine._structured is not None
+        assert SCORES_KEY in engine.state["params"]
+        s0 = np.asarray(jax.device_get(
+            engine.state["params"][SCORES_KEY]["sparse::h_0/mlp/c_fc"]))
+        losses = [float(engine.train_batch(batch=batch))
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+        s1 = np.asarray(jax.device_get(
+            engine.state["params"][SCORES_KEY]["sparse::h_0/mlp/c_fc"]))
+        # scores are trainable through the straight-through mask
+        assert not np.array_equal(s0, s1)
+        # export through the library against the engine's final params
+        from hcache_deepspeed_tpu.compression import fix_compression
+        host = jax.device_get(engine.state["params"])
+        fixed, _ = fix_compression(host, engine._structured)
+        assert SCORES_KEY not in fixed
+        # row-pruned c_proj columns masked to zero in the export
+        cols = np.abs(fixed["h_0"]["mlp"]["c_proj"]["kernel"]).sum(0)
+        assert (cols == 0).sum() == 32   # 64 * 0.5
+
+    def test_structured_rejected_with_zeropp(self):
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+        batch = {"input_ids": np.zeros((8, 16), np.int32)}
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "zero_quantized_weights":
+                                  True},
+            "compression_training": {"sparse_pruning": {
+                "shared_parameters": {"enabled": True, "method": "l1"},
+                "different_groups": {"g": {
+                    "params": {"dense_ratio": 0.5},
+                    "modules": ["c_fc"]}}}},
+        }
+        with pytest.raises(HDSConfigError, match="structured"):
+            hds.initialize(model=GPT2LMHeadModel(gpt2_tiny()),
+                           config=cfg, example_batch=batch)
+
+
+class TestRoundTrip:
+    def test_prune_train_fix_export(self):
+        """The verdict's 'Done' bar: prune -> train -> fix -> export at
+        GPT-2-tiny shows reduced dimensions and loss continuity."""
+        cfg = gpt2_tiny()
+        model = GPT2LMHeadModel(cfg)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 256, (4, 32), np.int32)}
+        params = model.init(jax.random.PRNGKey(0), batch)["params"]
+        ds = {"compression_training": {"row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "method": "l1"},
+            "different_groups": {"rp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": [r"mlp/c_fc"],
+                "related_modules": [[r"mlp/c_proj"]]}}}}}
+        params, comp = init_compression(dict(params), ds)
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step_fn(p, o, step):
+            def loss_fn(p):
+                eff = apply_compression(p, comp, step)
+                out = model.apply({"params": eff}, batch)
+                return out[0] if isinstance(out, tuple) else out
+
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            up, o = opt.update(g, o)
+            return optax.apply_updates(p, up), o, loss
+
+        losses = []
+        for s in range(10):
+            params, opt_state, loss = step_fn(params, opt_state, s)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+        # export: dims genuinely reduced, and the sliced model's loss
+        # continues from the masked model's (identical forward)
+        fixed, dims = redundancy_clean(params, ds, comp)
+        assert dims["h_0/mlp/c_fc"]["keep"] == 128   # 256 * 0.5
+        small = GPT2LMHeadModel(gpt2_tiny(n_inner=128))
+        masked_eff = apply_compression(params, comp, step=10)
+        masked_loss = model.apply({"params": masked_eff}, batch)
+        masked_loss = masked_loss[0] if isinstance(masked_loss, tuple) \
+            else masked_loss
+        sliced_loss = small.apply(
+            {"params": jax.tree.map(jnp.asarray, fixed)}, batch)
+        sliced_loss = sliced_loss[0] if isinstance(sliced_loss, tuple) \
+            else sliced_loss
+        np.testing.assert_allclose(float(masked_loss), float(sliced_loss),
+                                   rtol=2e-4)

@@ -1,0 +1,190 @@
+"""Model-based autotuner (reference:
+``deepspeed/autotuning/tuner/model_based_tuner.py`` + the memory-
+estimate pruning in ``autotuner.py``; repo:
+``autotuning/model_based.py``).
+
+The verdict's bar: on a 20+-candidate space the tuner times at most
+half of it and still picks the measured-best config — proven here with
+a fake runner whose true throughput the tuner cannot see, only sample.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.autotuning import (ModelBasedAutotuner,
+                                             aot_estimate)
+
+
+def _space(n=24):
+    """Micro-batch x remat grid with a monotone-ish truth: throughput
+    grows with micro_batch until a memory cliff; remat halves memory
+    but costs 20% speed."""
+    out = []
+    for mb in (1, 2, 4, 8, 16, 32):
+        for remat in (False, True):
+            for zero in (1, 3):
+                out.append({"micro_batch": mb, "remat": remat,
+                            "zero_stage": zero})
+    return out[:n]
+
+
+def _true_time(cfg):
+    base = 0.001 + 0.0001 * cfg["micro_batch"]
+    if cfg["remat"]:
+        base *= 1.2
+    if cfg["zero_stage"] == 3:
+        base *= 1.05
+    return base
+
+
+def _peak_bytes(cfg):
+    per = 100 * cfg["micro_batch"]
+    return per // 2 if cfg["remat"] else per
+
+
+class _FakeRunner:
+    calls = {"estimate": 0, "step": 0}
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def estimate(self):
+        type(self).calls["estimate"] += 1
+        return {"peak_bytes": _peak_bytes(self.cfg),
+                "flops": 1e9 * self.cfg["micro_batch"],
+                "time_est": _true_time(self.cfg) * 0.9}
+
+    def step(self):
+        type(self).calls["step"] += 1
+        # deterministic "work": the tuner times wall clock, so sleep
+        import time
+        time.sleep(_true_time(self.cfg))
+
+
+class TestModelBasedAutotuner:
+    def setup_method(self, _):
+        _FakeRunner.calls = {"estimate": 0, "step": 0}
+
+    def test_prunes_oom_and_times_at_most_half(self, tmp_path):
+        space = _space(24)
+        budget = 1700   # mb=32 un-remat (3200) and mb=32 remat ok (1600)
+        tuner = ModelBasedAutotuner(
+            _FakeRunner, space, hbm_budget_bytes=budget,
+            init_num=2, warmup_steps=0, measure_steps=1,
+            state_path=str(tmp_path / "state.json"))
+        best = tuner.tune()
+        # every candidate estimated once, but timed trials <= half
+        assert _FakeRunner.calls["estimate"] == len(space)
+        assert len(tuner.results) <= len(space) // 2
+        # all un-remat mb=32 candidates were pruned, never timed
+        for r in tuner.results:
+            assert _peak_bytes(r.config) <= budget
+        # the measured best must be the true best among viable configs:
+        # mb=16 un-remat (peak 1600 <= budget) beats remat'd mb=32
+        viable = [c for c in space if _peak_bytes(c) <= budget]
+        true_best = max(
+            viable, key=lambda c: c["micro_batch"] / _true_time(c))
+        assert best.config["micro_batch"] == true_best["micro_batch"]
+        assert best.config["remat"] == true_best["remat"]
+
+    def test_resume_skips_measured(self, tmp_path):
+        space = _space(12)
+        state = str(tmp_path / "state.json")
+        t1 = ModelBasedAutotuner(_FakeRunner, space, init_num=2,
+                                 warmup_steps=0, measure_steps=1,
+                                 max_trials=3, early_stop=99,
+                                 state_path=state)
+        t1.tune()
+        steps_first = _FakeRunner.calls["step"]
+        assert steps_first == 3
+        # resume: previously measured trials are replayed from state
+        t2 = ModelBasedAutotuner(_FakeRunner, space, init_num=2,
+                                 warmup_steps=0, measure_steps=1,
+                                 max_trials=3, early_stop=99,
+                                 state_path=state)
+        t2.tune()
+        # the same 2 init picks (roofline order is deterministic) come
+        # from the ledger; only genuinely new picks re-measure
+        assert _FakeRunner.calls["step"] < 2 * steps_first
+
+    def test_all_pruned_raises(self):
+        with pytest.raises(RuntimeError, match="pruned"):
+            ModelBasedAutotuner(_FakeRunner, _space(6),
+                                hbm_budget_bytes=1).tune()
+
+    def test_failed_measurement_is_recorded_not_fatal(self):
+        class Boom(_FakeRunner):
+            def step(self):
+                if self.cfg["micro_batch"] == 1:
+                    raise MemoryError("oom")
+                super().step()
+
+        space = [{"micro_batch": 1, "remat": False, "zero_stage": 1},
+                 {"micro_batch": 2, "remat": False, "zero_stage": 1},
+                 {"micro_batch": 4, "remat": False, "zero_stage": 1},
+                 {"micro_batch": 8, "remat": False, "zero_stage": 1}]
+        tuner = ModelBasedAutotuner(Boom, space, init_num=4,
+                                    warmup_steps=0, measure_steps=1,
+                                    max_trials=4, early_stop=99)
+        best = tuner.tune()
+        assert best.ok and best.config["micro_batch"] >= 2
+        errs = [r for r in tuner.results if not r.ok]
+        assert len(errs) == 1 and errs[0].error == "MemoryError"
+
+    def test_failed_trial_stays_failed_across_resume(self, tmp_path):
+        class Boom(_FakeRunner):
+            def step(self):
+                raise MemoryError("oom")
+
+        space = [{"micro_batch": 1, "remat": False, "zero_stage": 1},
+                 {"micro_batch": 2, "remat": False, "zero_stage": 1}]
+        state = str(tmp_path / "state.json")
+        t1 = ModelBasedAutotuner(Boom, space, init_num=2, warmup_steps=0,
+                                 measure_steps=1, max_trials=2,
+                                 early_stop=99, state_path=state)
+        with pytest.raises(RuntimeError, match="no measured candidate"):
+            t1.tune()
+        # resume: failures replay as failures, never 0.0 "successes"
+        t2 = ModelBasedAutotuner(Boom, space, init_num=2, warmup_steps=0,
+                                 measure_steps=1, max_trials=2,
+                                 early_stop=99, state_path=state)
+        with pytest.raises(RuntimeError, match="no measured candidate"):
+            t2.tune()
+        assert all(not r.ok for r in t2.results)
+
+    def test_artifact(self, tmp_path):
+        tuner = ModelBasedAutotuner(_FakeRunner, _space(8), init_num=2,
+                                    warmup_steps=0, measure_steps=1,
+                                    max_trials=4, early_stop=99)
+        tuner.tune()
+        out = tuner.write_results(str(tmp_path / "atr"))
+        with open(os.path.join(out, "ds_config_optimal.json")) as fh:
+            best_cfg = json.load(fh)
+        assert "micro_batch" in best_cfg
+        with open(os.path.join(out, "autotuning_results.json")) as fh:
+            ledger = json.load(fh)
+        assert ledger["space_size"] == 8
+        assert ledger["trials"] == len(tuner.results)
+
+
+class TestAotEstimate:
+    def test_real_program_memory_and_flops(self):
+        """The estimate hook against a real lowered program: a [256,256]
+        matmul's flops and peak bytes are in the right ballpark, with no
+        execution."""
+        @jax.jit
+        def f(a, b):
+            return a @ b
+
+        a = jnp.zeros((256, 256), jnp.float32)
+        est = aot_estimate(f, a, a, peak_flops=1e12,
+                           hbm_bytes_per_s=1e11)
+        assert est["peak_bytes"] >= 3 * 256 * 256 * 4 * 0.9
+        if est["flops"]:   # CPU backend reports flops; guard anyway
+            assert est["flops"] == pytest.approx(2 * 256 ** 3, rel=0.2)
+        assert est["time_est"] > 0
