@@ -198,7 +198,8 @@ def run_config(name):
     """Measure one candidate; prints the result JSON line."""
     import jax
 
-    if os.environ.get("HDS_BENCH_TINY") == "1":
+    tiny = os.environ.get("HDS_BENCH_TINY") == "1"
+    if tiny:
         # The smoke config must never touch the TPU relay: the axon
         # plugin initialises alongside cpu even under JAX_PLATFORMS=cpu
         # (its register() runs from sitecustomize), and a wedged relay
@@ -213,7 +214,6 @@ def run_config(name):
     from hcache_deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
     from hcache_deepspeed_tpu.platform import get_platform
 
-    tiny = os.environ.get("HDS_BENCH_TINY") == "1"
     if not tiny and get_platform().name == "cpu":
         # CPU fallback (mis-set env / relay plugin failing fast): refuse
         # BEFORE the 33-step measurement — a 350M config takes minutes
@@ -227,7 +227,7 @@ def run_config(name):
         _DONE.set()
         return
 
-    if os.environ.get("HDS_BENCH_TINY") == "1":
+    if tiny:
         # smoke config: exercises the identical code path in seconds on
         # a CPU backend (numbers are meaningless there)
         batch, seq = 2, 128

@@ -184,6 +184,7 @@ class ModelBasedAutotuner:
             return ExperimentResult(cfg, error=self.failed[key])
         if key in self.measured:
             return ExperimentResult(cfg, throughput=self.measured[key])
+        runner = None
         try:
             runner = self.build_fn(cfg)
             for _ in range(self.warmup_steps):
@@ -192,9 +193,6 @@ class ModelBasedAutotuner:
             for _ in range(self.measure_steps):
                 runner.step()
             dt = (time.perf_counter() - t0) / self.measure_steps
-            close = getattr(runner, "close", None)
-            if close:
-                close()
             tput = float(cfg.get("micro_batch", 1)) / dt
             self.measured[key] = tput
             self._save_state()
@@ -203,6 +201,14 @@ class ModelBasedAutotuner:
             self.failed[key] = type(e).__name__
             self._save_state()
             return ExperimentResult(cfg, error=type(e).__name__)
+        finally:
+            # a failed runner's buffers must not haunt the next trial
+            close = getattr(runner, "close", None)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
 
     # ---------------- tuning loop ------------------------------------ #
     def tune(self) -> ExperimentResult:
@@ -211,14 +217,19 @@ class ModelBasedAutotuner:
         for cfg in self.space:
             key = _config_key(cfg)
             if key not in self.estimates:
+                runner = None
                 try:
                     runner = self.build_fn(cfg)
                     self.estimates[key] = dict(runner.estimate())
-                    close = getattr(runner, "close", None)
-                    if close:
-                        close()
                 except Exception as e:
                     self.estimates[key] = {"error": type(e).__name__}
+                finally:
+                    close = getattr(runner, "close", None)
+                    if close:
+                        try:
+                            close()
+                        except Exception:
+                            pass
             est = self.estimates[key]
             if "error" in est:
                 self.pruned.append(cfg)

@@ -206,12 +206,15 @@ def _resolve_groups(cfg_tech: Dict[str, Any], method_key: str,
         for pat in g["modules"]:
             hits = [p for p in paths if _match(pat, p)]
             for p in hits:
-                if p in claimed:
+                if claimed.get(p, gname) != gname:
                     raise CompressionError(
                         f"{p} matched by both {claimed[p]!r} and "
                         f"{gname!r} for {tech} — check the config scopes")
                 claimed[p] = gname
-            mods.extend(hits)
+                # overlapping patterns WITHIN a group are fine, but the
+                # technique must apply once
+                if p not in mods:
+                    mods.append(p)
         for rel_pats in g["related_modules"]:
             rel_hits: List[str] = []
             for rp in rel_pats:
@@ -601,8 +604,8 @@ def fix_compression(params, comp: CompressionState,
                 if m is None:
                     continue
                 node = dict(_get_path(params, path))
-                node["kernel"] = node["kernel"] * m.astype(
-                    node["kernel"].dtype)
+                wname = "kernel" if "kernel" in node else "embedding"
+                node[wname] = node[wname] * m.astype(node[wname].dtype)
                 params = _set_path(params, path, node)
 
     # 3/4. row + head pruning (fix_row_col_pruning_helper /
@@ -619,16 +622,17 @@ def fix_compression(params, comp: CompressionState,
                     continue
                 keep = np.flatnonzero(m > 0.5)
                 node = dict(_get_path(params, path))
-                w = node["kernel"]
+                wname = "kernel" if "kernel" in node else "embedding"
+                w = node[wname]
                 if method == "row":
                     if dim_reduction and g.related:
-                        node["kernel"] = w[:, keep]
+                        node[wname] = w[:, keep]
                         if "bias" in node:
                             node["bias"] = node["bias"][keep]
                         dims[path] = {"axis": w.ndim - 1,
                                       "keep": int(keep.size)}
                     else:
-                        node["kernel"] = w * m.astype(w.dtype)
+                        node[wname] = w * m.astype(w.dtype)
                         if "bias" in node:
                             node["bias"] = node["bias"] * m.astype(
                                 node["bias"].dtype)
@@ -641,21 +645,21 @@ def fix_compression(params, comp: CompressionState,
                     if dim_reduction and g.related:
                         wk = w.reshape(heads, hd, -1)[keep].reshape(
                             -1, w.shape[-1])
-                        node["kernel"] = wk
+                        node[wname] = wk
                         dims[path] = {"axis": 0, "keep": int(keep.size * hd),
                                       "heads": int(keep.size)}
                     else:
-                        node["kernel"] = np.asarray(_apply_head_mask(
+                        node[wname] = np.asarray(_apply_head_mask(
                             jnp.asarray(w), jnp.asarray(m)))
                 else:  # channel
                     axis = 2 if w.ndim == 4 else 0
                     if dim_reduction and g.related:
-                        node["kernel"] = np.take(w, keep, axis=axis)
+                        node[wname] = np.take(w, keep, axis=axis)
                         dims[path] = {"axis": axis, "keep": int(keep.size)}
                     else:
                         shape = [1] * w.ndim
                         shape[axis] = m.shape[0]
-                        node["kernel"] = w * m.reshape(shape).astype(w.dtype)
+                        node[wname] = w * m.reshape(shape).astype(w.dtype)
                 params = _set_path(params, path, node)
                 # related modules lose the matching input/output slice;
                 # pair each pruned module with the related paths that
@@ -671,10 +675,12 @@ def fix_compression(params, comp: CompressionState,
                            if r.rsplit("/", 1)[0] == parent] or rel_all
                     for rpath in rel:
                         rnode = dict(_get_path(params, rpath))
-                        rw = rnode["kernel"]
+                        rwname = ("kernel" if "kernel" in rnode
+                                  else "embedding")
+                        rw = rnode[rwname]
                         if method == "row":
                             # F1 out-slice -> F2 in-slice (axis 0)
-                            rnode["kernel"] = rw[keep, :]
+                            rnode[rwname] = rw[keep, :]
                             dims[rpath] = {"axis": 0,
                                            "keep": int(keep.size)}
                         elif method == "head":
@@ -693,8 +699,8 @@ def fix_compression(params, comp: CompressionState,
                                            "keep": int(keep.size * hd * 3),
                                            "heads": int(keep.size)}
                         else:   # channel: upstream loses output slices
-                            rnode["kernel"] = np.take(rw, keep,
-                                                      axis=rw.ndim - 1)
+                            rnode[rwname] = np.take(rw, keep,
+                                                    axis=rw.ndim - 1)
                             if "bias" in rnode:
                                 rnode["bias"] = rnode["bias"][keep]
                             dims[rpath] = {"axis": rw.ndim - 1,

@@ -81,9 +81,19 @@ def maybe_quantize_serving_params(tree, quantization):
 
 def stack_layer_params(params: Dict[str, Any], n_layers: int,
                        prefix: str = "layers_"):
-    """[per-layer dicts] -> one pytree with leading layer dim (scan xs)."""
+    """[per-layer dicts] -> one pytree with leading layer dim (scan xs).
+
+    Host (numpy) inputs stack on HOST: a 7B model's stacked leaves are
+    ~13.5 GB bf16 — jnp.stack would enqueue that as device compute
+    before quantization/cast can shrink it (the serving OOM mode)."""
     layers = [params[f"{prefix}{i}"] for i in range(n_layers)]
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+    def stack(*xs):
+        if all(not isinstance(x, jax.Array) for x in xs):
+            return np.stack([np.asarray(x) for x in xs])
+        return jnp.stack(xs)
+
+    return jax.tree.map(stack, *layers)
 
 
 class PagedInferenceModel:
@@ -169,18 +179,40 @@ class PagedInferenceModel:
     def _finalize_params(self, new):
         """Shared load_params tail for every family: dtype cast (with
         the `_keep_fp32` exemptions), optional weight quantization, TP
-        placement."""
+        placement.
+
+        When the incoming tree is host-resident (numpy — checkpoint
+        loads, the serving bench) the cast runs on HOST and only the
+        FINAL representation is shipped: for an int8-quantized 7B that
+        is ~7 GB instead of 13.5 GB bf16 (or 27 GB fp32) of deferred
+        device compute whose materialization OOMs a 16 GB chip. Device
+        inputs (hybrid-engine refresh from live training params) keep
+        the all-device path — no D2H round trip."""
+        on_host = all(not isinstance(x, jax.Array)
+                      for x in jax.tree.leaves(new))
+
         def cast(path, p):
+            if on_host:
+                p = np.asarray(p)
+                if not jnp.issubdtype(p.dtype, jnp.floating):
+                    return p
+                target = (jnp.float32 if self._keep_fp32(path)
+                          else self.cfg.compute_dtype)
+                return p.astype(jnp.dtype(target))   # ml_dtypes bf16 ok
             p = jnp.asarray(p)
             if not jnp.issubdtype(p.dtype, jnp.floating):
                 return p
             if self._keep_fp32(path):
                 return p.astype(jnp.float32)
             return p.astype(self.cfg.compute_dtype)
+
         new = jax.tree_util.tree_map_with_path(cast, new)
         new = self._maybe_quantize(new)
         if self.tp > 1:
             new = jax.device_put(new, self._param_shardings_for(new))
+        elif on_host:
+            # one explicit transfer of the final (possibly int8) tree
+            new = jax.device_put(new)
         return new
 
     def _maybe_quantize(self, tree):

@@ -1,7 +1,8 @@
 """Launcher (reference: ``deepspeed/launcher/`` + the `deepspeed` CLI)."""
 
-from .runner import (LaunchSpec, OpenMPIRunner, SlurmRunner,  # noqa: F401
-                     SSHRunner, build_launch_commands,
-                     build_rank_agnostic_command, decode_world_info,
-                     encode_world_info, main, parse_hostfile,
-                     parse_inclusion_exclusion)
+from .runner import (RUNNERS, IMPIRunner, LaunchSpec,  # noqa: F401
+                     MPICHRunner, MVAPICHRunner, OpenMPIRunner,
+                     PDSHRunner, SlurmRunner, SSHRunner,
+                     build_launch_commands, build_rank_agnostic_command,
+                     decode_world_info, encode_world_info, main,
+                     parse_hostfile, parse_inclusion_exclusion)

@@ -18,12 +18,14 @@ def infer_process_env(env=None):
     HDS_* (reference: the env discovery in comm.py:705-808 + launch.py)."""
     env = dict(env if env is not None else os.environ)
     if "HDS_PROCESS_ID" not in env:
-        for key in ("OMPI_COMM_WORLD_RANK", "SLURM_PROCID", "RANK"):
+        for key in ("OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                    "MV2_COMM_WORLD_RANK", "SLURM_PROCID", "RANK"):
             if key in env:
                 env["HDS_PROCESS_ID"] = env[key]
                 break
     if "HDS_NUM_PROCESSES" not in env:
-        for key in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS", "WORLD_SIZE"):
+        for key in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                    "MV2_COMM_WORLD_SIZE", "SLURM_NTASKS", "WORLD_SIZE"):
             if key in env:
                 env["HDS_NUM_PROCESSES"] = env[key]
                 break

@@ -247,7 +247,95 @@ class SlurmRunner(MultiNodeRunner):
                  "bash", "-c", launch.rank_agnostic_cmd()]]
 
 
-RUNNERS = {"ssh": SSHRunner, "openmpi": OpenMPIRunner, "slurm": SlurmRunner}
+class PDSHRunner(MultiNodeRunner):
+    """Reference: ``multinode_runner.py:55`` PDSHRunner — one pdsh
+    command fans a single line to every host; pdsh's ``%n`` expands to
+    the 0-based rank of the host in the ``-w`` list, which becomes
+    HDS_PROCESS_ID (the reference passes it as ``--node_rank=%n``)."""
+
+    name = "pdsh"
+    max_fan_out = 1024   # reference PDSH_MAX_FAN_OUT
+
+    def backend_exists(self):
+        import shutil
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, launch):
+        hosts = ",".join(launch.resources)
+        cmd = launch.rank_agnostic_cmd()
+        return [["pdsh", "-S", "-f", str(self.max_fan_out), "-w", hosts,
+                 f"HDS_PROCESS_ID=%n {cmd}"]]
+
+
+class MPICHRunner(MultiNodeRunner):
+    """Reference: ``multinode_runner.py:204`` — hydra mpirun with
+    ``-genv`` exports and ``-hosts``; rank reaches the worker as
+    PMI_RANK, which ``launcher.launch`` maps onto HDS_PROCESS_ID."""
+
+    name = "mpich"
+
+    def backend_exists(self):
+        import shutil
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, launch):
+        hosts = ",".join(launch.resources)
+        n = len(launch.resources)
+        return [["mpirun", "-n", str(n), "-ppn", "1", "-hosts", hosts,
+                 "bash", "-c", launch.rank_agnostic_cmd()]]
+
+
+class IMPIRunner(MultiNodeRunner):
+    """Reference: ``multinode_runner.py:276`` — Intel MPI: same hydra
+    surface as MPICH plus an explicit ssh bootstrap."""
+
+    name = "impi"
+
+    def backend_exists(self):
+        import shutil
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, launch):
+        hosts = ",".join(launch.resources)
+        n = len(launch.resources)
+        return [["mpirun", "-bootstrap", "ssh", "-n", str(n), "-ppn", "1",
+                 "-hosts", hosts, "bash", "-c",
+                 launch.rank_agnostic_cmd()]]
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """Reference: ``multinode_runner.py:409`` — ``mpirun_rsh`` with a
+    written hostfile; rank reaches the worker as MV2_COMM_WORLD_RANK."""
+
+    name = "mvapich"
+    hostfile_path = None   # set per invocation (tempfile) unless pinned
+
+    def backend_exists(self):
+        import shutil
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, launch):
+        if self.hostfile_path is None:
+            # per-invocation tempfile: a fixed /tmp name races between
+            # concurrent launches and is symlink-attackable on shared
+            # login nodes
+            import tempfile
+            fd, self.hostfile_path = tempfile.mkstemp(
+                prefix="hds_mvapich_hostfile_")
+            os.close(fd)
+        with open(self.hostfile_path, "w") as fh:
+            for host in launch.resources:
+                fh.write(f"{host}\n")
+        n = len(launch.resources)
+        return [["mpirun_rsh", "-np", str(n),
+                 "-hostfile", self.hostfile_path,
+                 "bash", "-c", launch.rank_agnostic_cmd()]]
+
+
+RUNNERS = {"ssh": SSHRunner, "openmpi": OpenMPIRunner,
+           "slurm": SlurmRunner, "pdsh": PDSHRunner,
+           "mpich": MPICHRunner, "impi": IMPIRunner,
+           "mvapich": MVAPICHRunner}
 
 
 def main(argv=None):

@@ -181,7 +181,9 @@ def quantize_tree(tree, *, group_size=256, num_bits=8, min_size=4096,
     def one(path, leaf):
         if isinstance(leaf, (QuantizedTensor, MatmulQuantizedTensor)):
             return leaf   # already quantized (e.g. fused-kernel layout)
-        leaf = jnp.asarray(leaf)
+        # do NOT device-put here: host (numpy) leaves stream to the
+        # device layer-by-layer inside make_batched — a 7B stacked
+        # weight shipped whole would defeat that
         if (leaf.ndim < 2 or leaf.size < min_size
                 or not jnp.issubdtype(leaf.dtype, jnp.floating)
                 or skip(path)):

@@ -7,7 +7,14 @@ from .data_analyzer import DataAnalyzer, load_metric
 from .data_sampler import CurriculumSampler
 from .random_ltd import (RandomLTDScheduler, random_ltd_layer,
                          sample_tokens, scatter_back)
+from .variable_batch import (VariableBatchLoader, VariableBatchSizeLR,
+                             batch_by_seqlens,
+                             dataloader_and_lr_for_variable_batch_size,
+                             scale_lr, seqlen_buckets)
 
 __all__ = ["CurriculumScheduler", "CurriculumSampler", "DataAnalyzer",
            "load_metric", "RandomLTDScheduler", "random_ltd_layer",
-           "sample_tokens", "scatter_back"]
+           "sample_tokens", "scatter_back", "VariableBatchLoader",
+           "VariableBatchSizeLR", "batch_by_seqlens",
+           "dataloader_and_lr_for_variable_batch_size", "scale_lr",
+           "seqlen_buckets"]

@@ -1,0 +1,273 @@
+"""Variable batch size + LR scaling (dynamic batching).
+
+Reference analog:
+``deepspeed/runtime/data_pipeline/data_sampling/variable_batch_size_and_lr.py``
+— ``batch_by_seqlens`` packs sequences into token-budgeted microbatches
+(the "Attention is all you need" §5.1 bucketing), ``scale_lr`` rescales
+the LR per batch by the linear/sqrt rule, and
+``lr_scheduler_for_variable_batch_size`` wraps the engine scheduler so
+every batch trains at the LR its true size warrants. Config keys are the
+reference's ``data_efficiency.data_sampling.dynamic_batching`` block
+(``constants.py:70-83``).
+
+TPU re-design: variable shapes are hostile to XLA — every distinct
+padded seqlen is a recompile. So packing here quantizes each batch's pad
+target onto a small ladder of **seqlen buckets** (powers of two by
+default): the number of compiled programs is bounded by the ladder
+length, padding waste is bounded by the bucket ratio, and within a
+bucket every batch reuses one executable. The LR scale uses the TRUE
+sequence count per batch, not the padded one, so optimization follows
+the reference exactly while the shapes stay compiler-friendly.
+"""
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+def seqlen_buckets(max_seqlen: int, min_bucket: int = 16,
+                   growth: int = 2) -> Tuple[int, ...]:
+    """The pad-target ladder: min_bucket, min_bucket*growth, ... up to
+    max_seqlen (always included). Bounds distinct compiled shapes."""
+    if growth < 2 or min_bucket < 1 or max_seqlen < 1:
+        raise ValueError(
+            f"seqlen_buckets needs growth >= 2, min_bucket >= 1, "
+            f"max_seqlen >= 1 (got {growth}, {min_bucket}, {max_seqlen})")
+    out = []
+    b = min_bucket
+    while b < max_seqlen:
+        out.append(b)
+        b *= growth
+    out.append(max_seqlen)
+    return tuple(out)
+
+
+def bucket_of(seqlen: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if seqlen <= b:
+            return b
+    raise ValueError(f"seqlen {seqlen} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+def batch_by_seqlens(seqlens: Sequence[int], max_tokens: int,
+                     sample_ids: Optional[Sequence[int]] = None,
+                     min_batch_size: int = 1,
+                     max_batch_size: Optional[int] = None,
+                     sequence_picking_order: str = "dataloader",
+                     effective_batch_size: int = 1,
+                     required_microbatches_of_same_size: bool = False,
+                     seed: Optional[int] = None,
+                     buckets: Optional[Sequence[int]] = None):
+    """Pack samples into microbatches whose total seqlen stays under
+    ``max_tokens`` (reference ``batch_by_seqlens``; same argument
+    surface, ``sample_ids`` plays ``sequence_ids_per_mb``'s role of
+    restricting to a pool — e.g. a curriculum sampler's admitted set).
+
+    Returns ``(microbatch_ids, batch_sizes, batch_max_seqlens)``:
+    ``microbatch_ids`` is a list of ``(batch_id, [sample ids])`` per
+    microbatch; each group of ``effective_batch_size`` consecutive
+    microbatches forms one optimizer batch whose true sequence count is
+    ``batch_sizes[batch_id]`` (feeds LR scaling) and whose pad target is
+    ``batch_max_seqlens[batch_id]`` (bucket-quantized when ``buckets``
+    is given)."""
+    if sequence_picking_order not in ("random", "seqlen", "dataloader"):
+        raise ValueError(f"unknown sequence_picking_order "
+                         f"{sequence_picking_order!r}")
+    seqlens = np.asarray(seqlens)
+    ids = (np.arange(len(seqlens)) if sample_ids is None
+           else np.asarray(sample_ids))
+    metrics = [(int(seqlens[i]), int(i)) for i in ids]
+    if sequence_picking_order == "random":
+        np.random.default_rng(seed).shuffle(metrics)
+    elif sequence_picking_order == "seqlen":
+        metrics.sort()
+
+    too_long = [i for v, i in metrics if v > max_tokens]
+    if too_long:
+        logger.warning(f"dynamic batching: {len(too_long)} samples "
+                       f"exceed max_tokens={max_tokens}; ignored")
+        metrics = [m for m in metrics if m[0] <= max_tokens]
+
+    # greedy token-budget packing
+    microbatches: List[List[Tuple[int, int]]] = []
+    cur: List[Tuple[int, int]] = []
+    cur_tokens = 0
+    for v, i in metrics:
+        over_tokens = cur_tokens + v > max_tokens
+        over_count = max_batch_size and len(cur) >= max_batch_size
+        if cur and (over_tokens or over_count):
+            if len(cur) >= min_batch_size:
+                microbatches.append(cur)
+            cur, cur_tokens = [], 0
+        cur.append((v, i))
+        cur_tokens += v
+    if cur and len(cur) >= min_batch_size:
+        microbatches.append(cur)
+
+    if required_microbatches_of_same_size:
+        # equal sequence counts across each batch's microbatches (the
+        # pipeline-engine constraint): regroup by count
+        by_n: Dict[int, List[List[Tuple[int, int]]]] = {}
+        for mb in microbatches:
+            by_n.setdefault(len(mb), []).append(mb)
+        microbatches = []
+        for n in sorted(by_n):
+            group = by_n[n]
+            keep = len(group) - len(group) % effective_batch_size
+            microbatches.extend(group[:keep])
+    else:
+        keep = len(microbatches) - len(microbatches) \
+            % effective_batch_size
+        microbatches = microbatches[:keep]
+    if not microbatches:
+        raise ValueError(
+            "dynamic batching produced no full batch: max_tokens="
+            f"{max_tokens}, effective_batch_size={effective_batch_size}, "
+            f"{len(metrics)} usable samples")
+
+    microbatch_ids = []
+    batch_sizes, batch_max_seqlens = [], []
+    for start in range(0, len(microbatches), effective_batch_size):
+        bid = start // effective_batch_size
+        mbs = microbatches[start:start + effective_batch_size]
+        n_sequences = sum(len(mb) for mb in mbs)
+        max_len = max(v for mb in mbs for v, _ in mb)
+        if buckets is not None:
+            max_len = bucket_of(max_len, buckets)
+        batch_sizes.append(n_sequences)
+        batch_max_seqlens.append(max_len)
+        for mb in mbs:
+            microbatch_ids.append((bid, [i for _, i in mb]))
+    return microbatch_ids, batch_sizes, batch_max_seqlens
+
+
+def scale_lr(base_batch_size: int, batch_size: int, base_lr: float = 1.0,
+             method: str = "linear") -> float:
+    """Reference ``scale_lr``: the Goyal linear rule, the Krizhevsky
+    sqrt rule, or none."""
+    if method == "linear":
+        return base_lr * batch_size / base_batch_size
+    if method == "sqrt":
+        return base_lr * math.sqrt(batch_size / base_batch_size)
+    if method is None or str(method).upper() == "NONE":
+        return base_lr
+    raise ValueError(f"unknown lr scaling method {method!r}")
+
+
+class VariableBatchSizeLR:
+    """Wraps any repo LR scheduler (the engine's ``step() -> lr``
+    contract) so each optimizer step's LR is rescaled by that batch's
+    true sequence count (reference ``VariableBatchSizeLR``). Walk order
+    follows ``batch_sizes``; ``state_dict``/``load_state_dict`` carry
+    the walk position for checkpoint resume."""
+
+    def __init__(self, inner, base_batch_size: int,
+                 batch_sizes: Sequence[int], method: str = "linear"):
+        self.inner = inner
+        self.base_batch_size = int(base_batch_size)
+        self.batch_sizes = list(batch_sizes)
+        self.method = method
+        self.batch_step = 0
+        self._last_lr = None
+
+    def step(self) -> float:
+        base = float(self.inner.step())
+        size = self.batch_sizes[self.batch_step % len(self.batch_sizes)]
+        self.batch_step += 1
+        self._last_lr = scale_lr(self.base_batch_size, size, base,
+                                 self.method)
+        return self._last_lr
+
+    def get_last_lr(self):
+        return self._last_lr
+
+    def state_dict(self):
+        inner_sd = getattr(self.inner, "state_dict", dict)()
+        return {"batch_step": self.batch_step, "inner": inner_sd}
+
+    def load_state_dict(self, sd):
+        self.batch_step = int(sd.get("batch_step", 0))
+        load = getattr(self.inner, "load_state_dict", None)
+        if load and sd.get("inner"):
+            load(sd["inner"])
+
+
+class VariableBatchLoader:
+    """Iterate packed microbatches as padded host arrays.
+
+    ``dataset[i]`` must yield a dict of 1-D arrays (e.g.
+    ``{"input_ids": ...}``); each microbatch pads every sample to the
+    batch's (bucketed) max seqlen with ``pad_value`` and stacks. Yields
+    ``(batch_id, batch_dict)`` so the train loop can consult the LR
+    scheduler / seqlen per batch."""
+
+    def __init__(self, dataset, microbatch_ids, batch_max_seqlens,
+                 pad_value: int = 0,
+                 pad_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.microbatch_ids = list(microbatch_ids)
+        self.batch_max_seqlens = list(batch_max_seqlens)
+        self.pad_value = pad_value
+        self.pad_fn = pad_fn
+
+    def __len__(self):
+        return len(self.microbatch_ids)
+
+    def __iter__(self):
+        for bid, ids in self.microbatch_ids:
+            target = self.batch_max_seqlens[bid]
+            samples = [self.dataset[i] for i in ids]
+            out = {}
+            for key in samples[0]:
+                rows = []
+                for s in samples:
+                    row = np.asarray(s[key])
+                    if self.pad_fn is not None:
+                        row = self.pad_fn(key, row, target)
+                    elif row.ndim >= 1 and row.shape[0] < target:
+                        pad = [(0, target - row.shape[0])] + \
+                            [(0, 0)] * (row.ndim - 1)
+                        row = np.pad(row, pad,
+                                     constant_values=self.pad_value)
+                    rows.append(row)
+                out[key] = np.stack(rows)
+            yield bid, out
+
+
+def dataloader_and_lr_for_variable_batch_size(
+        dataset, seqlens: Sequence[int], config: Dict,
+        base_batch_size: int, lr_scheduler,
+        sample_ids: Optional[Sequence[int]] = None,
+        effective_batch_size: int = 1,
+        required_microbatches_of_same_size: bool = False,
+        seed: Optional[int] = None,
+        buckets: Optional[Sequence[int]] = None,
+        pad_value: int = 0):
+    """Reference
+    ``get_dataloader_and_lr_scheduler_for_variable_batch_size``: reads
+    the ``dynamic_batching`` config block (reference key names), packs,
+    and returns ``(loader, wrapped_lr_scheduler, batch_max_seqlens)``."""
+    if not config.get("enabled", False):
+        raise ValueError("dynamic_batching.enabled is false")
+    if "max_tokens" not in config:
+        raise ValueError("dynamic_batching requires max_tokens")
+    mb_ids, batch_sizes, max_lens = batch_by_seqlens(
+        seqlens, int(config["max_tokens"]), sample_ids=sample_ids,
+        min_batch_size=int(config.get("min_batch_size", 1)),
+        max_batch_size=config.get("max_batch_size"),
+        sequence_picking_order=config.get("sequence_picking_order",
+                                          "dataloader"),
+        effective_batch_size=effective_batch_size,
+        required_microbatches_of_same_size=(
+            required_microbatches_of_same_size),
+        seed=seed, buckets=buckets)
+    loader = VariableBatchLoader(dataset, mb_ids, max_lens,
+                                 pad_value=pad_value)
+    wrapped = VariableBatchSizeLR(
+        lr_scheduler, base_batch_size, batch_sizes,
+        method=config.get("lr_scaling_method", "linear"))
+    return loader, wrapped, max_lens

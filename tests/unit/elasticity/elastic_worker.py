@@ -1,0 +1,94 @@
+"""Worker script for the elastic end-to-end integration test.
+
+Launched through ``hcache_deepspeed_tpu.launcher.launch`` (the per-host
+bootstrap) by an ``ElasticAgent``. Worker 0 is the real trainer — it
+drives a virtual CPU mesh of ``world`` devices (the test-harness stand-in
+for one process per host); the other workers are liveness shims standing
+in for the remaining hosts.
+
+Generation 0: train, save a (universal/orbax) checkpoint, record the
+loss on a probe batch; the LAST worker then exits nonzero (the induced
+failure) while the rest keep "running". Generation 1+: worker 0 resumes
+from the checkpoint at the SHRUNKEN world size, records the probe loss
+after restore (continuity evidence), trains on, and exits clean.
+"""
+
+import json
+import os
+import sys
+import time
+
+WORLD, RESTART, IDX = (int(a) for a in sys.argv[1:4])
+RUN_DIR = os.environ["HDS_ELASTIC_TEST_DIR"]
+CKPT = os.path.join(RUN_DIR, "ckpt")
+MARKER = os.path.join(RUN_DIR, "gen0_saved")
+DONE = os.path.join(RUN_DIR, "done")
+
+
+def wait_for(path, timeout=300):
+    t0 = time.time()
+    while not os.path.exists(path):
+        if time.time() - t0 > timeout:
+            raise SystemExit(f"timeout waiting for {path}")
+        time.sleep(0.1)
+
+
+if IDX != 0:
+    if RESTART == 0 and IDX == WORLD - 1:
+        # the induced failure: die once the checkpoint exists
+        wait_for(MARKER)
+        raise SystemExit(1)
+    # liveness shim for a surviving host
+    wait_for(DONE)
+    raise SystemExit(0)
+
+# ---- worker 0: the real trainer over a world-sized virtual mesh ----
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + f" --xla_force_host_platform_device_count"
+                             f"={WORLD}")
+import numpy as np  # noqa: E402
+
+import hcache_deepspeed_tpu as hds  # noqa: E402
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,  # noqa: E402
+                                              gpt2_tiny)
+
+cfg = {
+    "train_batch_size": 8,
+    "train_micro_batch_size_per_gpu": 8 // WORLD,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 1},
+}
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(0, 256, (8, 32), np.int32)}
+probe = {"input_ids": rng.integers(0, 256, (8, 32), np.int32)}
+engine, _, _, _ = hds.initialize(model=GPT2LMHeadModel(gpt2_tiny()),
+                                 config=cfg, example_batch=batch)
+
+if RESTART == 0:
+    train_losses = [float(engine.train_batch(batch=batch))
+                    for _ in range(3)]
+    pre = float(engine.eval_batch(probe))
+    engine.save_checkpoint(CKPT, tag="elastic")
+    with open(os.path.join(RUN_DIR, "loss_pre.json"), "w") as fh:
+        json.dump({"loss": pre, "world": WORLD,
+                   "steps": engine.global_steps,
+                   "train_last": train_losses[-1]}, fh)
+    open(MARKER, "w").close()
+    # keep "training" until the agent tears the group down
+    time.sleep(600)
+    raise SystemExit(0)
+
+# restarted generation: resume at the shrunken world size
+engine.load_checkpoint(CKPT, tag="elastic")
+restored_steps = engine.global_steps
+post = float(engine.eval_batch(probe))
+losses = [float(engine.train_batch(batch=batch)) for _ in range(2)]
+probe_after = float(engine.eval_batch(probe))
+with open(os.path.join(RUN_DIR, "loss_post.json"), "w") as fh:
+    json.dump({"loss": post, "world": WORLD,
+               "steps": restored_steps,
+               "continued": losses,
+               "probe_after": probe_after}, fh)
+open(DONE, "w").close()
+raise SystemExit(0)

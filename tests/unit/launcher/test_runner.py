@@ -110,12 +110,66 @@ class TestLaunchCommands:
         slurm = SlurmRunner(None).get_cmd(launch)
         assert slurm[0][0] == "srun" and "--nodes=2" in slurm[0]
 
+    def test_pdsh_mpich_impi_mvapich_cmds(self, tmp_path):
+        """Reference runner breadth (multinode_runner.py:55-409): the
+        four extra backends build the documented command lines for a
+        2-host hostfile."""
+        from hcache_deepspeed_tpu.launcher import (IMPIRunner,
+                                                   MPICHRunner,
+                                                   MVAPICHRunner,
+                                                   PDSHRunner)
+
+        res = parse_hostfile(["a slots=1", "b slots=1"])
+        launch = LaunchSpec(res, "t.py", ["--x", "1"])
+
+        pdsh = PDSHRunner(None).get_cmd(launch)
+        assert pdsh[0][:2] == ["pdsh", "-S"]
+        assert "-w" in pdsh[0] and pdsh[0][pdsh[0].index("-w") + 1] \
+            == "a,b"
+        # pdsh %n becomes the per-host rank
+        assert pdsh[0][-1].startswith("HDS_PROCESS_ID=%n ")
+        assert "launcher.launch" in pdsh[0][-1]
+
+        mpich = MPICHRunner(None).get_cmd(launch)
+        assert mpich[0][:5] == ["mpirun", "-n", "2", "-ppn", "1"]
+        assert mpich[0][mpich[0].index("-hosts") + 1] == "a,b"
+
+        impi = IMPIRunner(None).get_cmd(launch)
+        assert impi[0][:3] == ["mpirun", "-bootstrap", "ssh"]
+        assert "-hosts" in impi[0]
+
+        mv = MVAPICHRunner(None)
+        mv.hostfile_path = str(tmp_path / "hf")
+        cmd = mv.get_cmd(launch)
+        assert cmd[0][:3] == ["mpirun_rsh", "-np", "2"]
+        with open(mv.hostfile_path) as fh:
+            assert fh.read().splitlines() == ["a", "b"]
+
+    def test_mock_multi_host_dry_run(self, tmp_path, capsys):
+        """Mock multi-host launch: `hds --dry-run` over a 2-host
+        hostfile prints one command per host without executing."""
+        from hcache_deepspeed_tpu.launcher import main
+        hf = tmp_path / "hostfile"
+        hf.write_text("hostA slots=4\nhostB slots=4\n")
+        rc = main(["-H", str(hf), "--launcher", "ssh", "--dry-run",
+                   "train.py", "--lr", "1e-4"])
+        assert rc in (0, None)
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2
+        assert "hostA" in out[0] and "hostB" in out[1]
+        assert "HDS_PROCESS_ID=0" in out[0]
+        assert "HDS_PROCESS_ID=1" in out[1]
+        assert "train.py" in out[0]
+
     def test_replicated_runners_are_rank_agnostic(self):
         """mpirun/srun replicate ONE command — it must NOT pin a process
         id; the rank comes from the scheduler env via launcher.launch."""
+        from hcache_deepspeed_tpu.launcher import (IMPIRunner,
+                                                   MPICHRunner)
         res = parse_hostfile(["a slots=1", "b slots=1"])
         launch = LaunchSpec(res, "t.py", [])
-        for runner in (OpenMPIRunner(None), SlurmRunner(None)):
+        for runner in (OpenMPIRunner(None), SlurmRunner(None),
+                       MPICHRunner(None), IMPIRunner(None)):
             cmd = runner.get_cmd(launch)[0][-1]
             assert "HDS_PROCESS_ID" not in cmd
             assert "HDS_COORDINATOR_ADDRESS=a:7777" in cmd
@@ -147,6 +201,15 @@ class TestLaunchEnv:
         assert env["HDS_PROCESS_ID"] == "3"
         assert env["HDS_NUM_PROCESSES"] == "8"
         assert env["HDS_COORDINATOR_ADDRESS"] == "h0:7777"
+
+    def test_pmi_and_mvapich_env_mapping(self):
+        env = infer_process_env({"PMI_RANK": "2", "PMI_SIZE": "4"})
+        assert env["HDS_PROCESS_ID"] == "2"
+        assert env["HDS_NUM_PROCESSES"] == "4"
+        env = infer_process_env({"MV2_COMM_WORLD_RANK": "1",
+                                 "MV2_COMM_WORLD_SIZE": "2"})
+        assert env["HDS_PROCESS_ID"] == "1"
+        assert env["HDS_NUM_PROCESSES"] == "2"
 
     def test_slurm_env_mapping(self):
         env = infer_process_env({"SLURM_PROCID": "1", "SLURM_NTASKS": "4"})

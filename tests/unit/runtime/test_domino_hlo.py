@@ -182,6 +182,8 @@ class TestDominoTPUSchedule:
             capture_output=True, text=True, timeout=900)
         assert out.returncode == 0, out.stderr[-2000:]
         facts = json.loads(out.stdout.strip().splitlines()[-1])
+        if "skip" in facts:
+            pytest.skip(f"needs >=2 live devices: {facts['skip']}")
         assert facts["async_pairs"] >= 1, facts
         assert facts["dots_inside_async_window"] >= 1, facts
 
@@ -195,6 +197,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 n = len(jax.devices())
+if n < 2:
+    # a 1-chip relay has no tensor axis to reduce over — the psum is
+    # compiled away and there is nothing to schedule asynchronously
+    print(json.dumps({"skip": f"single-device backend (n={n})"}))
+    raise SystemExit(0)
 mesh = Mesh(np.array(jax.devices()), ("tensor",))
 
 def tp_mlp(x, w1, w2):

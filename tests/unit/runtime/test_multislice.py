@@ -1,0 +1,140 @@
+"""Multislice / DCN evidence for hpZ (ZeRO++ secondary partition).
+
+Model: a 2-slice v5e system as an 8-device mesh whose device order puts
+slice 0 at ranks 0-3 and slice 1 at ranks 4-7 (the topology module's
+contract: the slowest-varying axis is the one that crosses DCN). With
+``zero_hpz_partition_size=4`` each hpZ subgroup is exactly one slice, so
+
+* every per-layer parameter all-gather must carry ``replica_groups``
+  that stay WITHIN a slice (ICI traffic only), and
+* the only cross-slice parameter movement is the secondary-partition
+  refresh, which the engine hoists OUTSIDE the gradient-accumulation
+  scan — once per optimizer step, not once per gather.
+
+Reference analog: ``deepspeed/utils/groups.py:650-705`` (the hpZ
+secondary process groups are built within a node for exactly this
+wire-locality); repo: ``runtime/zero/zeropp.py`` ``make_param_gather``
+(axis_index_groups) + ``build_secondary`` and ``runtime/engine.py``
+(``prepare_secondary`` before the scan).
+
+The evidence is structural, from the compiled HLO of the real fused
+train step: replica-group classification of every all-gather, and
+while-body containment for the once-per-step claim.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+
+SLICE = 4   # devices per modeled slice; mesh = 2 slices x 4
+
+
+def _hpz_engine(gas=4, hpz=SLICE):
+    cfg = {
+        "train_batch_size": 8 * gas,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "zero_hpz_partition_size": hpz},
+    }
+    batch = {"input_ids": np.zeros((8 * gas, 32), np.int32)}
+    engine, _, _, _ = hds.initialize(model=GPT2LMHeadModel(gpt2_tiny()),
+                                     config=cfg, example_batch=batch)
+    return engine, batch
+
+
+def _lower_hlo(engine, batch):
+    import jax
+    import jax.numpy as jnp
+    shaped = engine._shard_batch(
+        jax.tree.map(lambda x: np.asarray(x).reshape(
+            (engine.gradient_accumulation_steps, -1)
+            + np.asarray(x).shape[1:]), batch), extra_leading=True)
+    return engine._fused_train_batch.lower(
+        engine.state, shaped, jnp.float32(1e-3),
+        jax.random.PRNGKey(0)).compile().as_text()
+
+
+def _gather_groups(hlo):
+    """[(computation_name, [[ranks...], ...])] for every all-gather."""
+    out = []
+    comp = "?"
+    for line in hlo.splitlines():
+        m = re.match(r"\s*%?([\w.\-]+)\s*(?:\([^)]*\))?\s*->?.*{\s*$", line)
+        if line.rstrip().endswith("{") and ("(" in line or "%" in line):
+            cm = re.match(r"\s*(?:ENTRY\s+)?%?([\w.\-]+)", line)
+            if cm:
+                comp = cm.group(1)
+        if "all-gather(" in line or "all-gather-start(" in line:
+            gm = re.search(r"replica_groups=\{(\{[^=]*\})\}", line)
+            if gm:
+                groups = [[int(x) for x in g.split(",") if x.strip()]
+                          for g in re.findall(r"\{([\d,]+)\}",
+                                              gm.group(1))]
+            else:
+                # cross_replica with iota/default groups = all replicas
+                groups = [list(range(8))]
+            out.append((comp, groups))
+    return out
+
+
+def _in_slice(groups):
+    return all(len({r // SLICE for r in g}) == 1 for g in groups)
+
+
+@pytest.mark.usefixtures("eight_devices")
+class TestHpzTwoSlice:
+
+    def test_gathers_in_slice_refresh_cross_once_per_step(self):
+        engine, batch = _hpz_engine(gas=4)
+        hlo = _lower_hlo(engine, batch)
+        gathers = _gather_groups(hlo)
+        assert gathers, "no all-gathers found in the hpZ train step"
+        in_slice = [(c, g) for c, g in gathers if _in_slice(g)]
+        cross = [(c, g) for c, g in gathers if not _in_slice(g)]
+        # the per-layer param gathers exist and stay inside a slice
+        assert in_slice, hlo[:2000]
+        # cross-slice movement exists only as the secondary refresh
+        assert cross, "expected the once-per-step secondary refresh"
+
+        # once-per-step evidence: every cross-slice all-gather sits in
+        # the entry computation (outside the gradient-accumulation
+        # loop), while in-slice gathers run inside loop-body
+        # computations (XLA names them region_*) — per microbatch, ICI
+        # only
+        for c, g in cross:
+            assert c.startswith("main"), \
+                f"cross-slice gather inside a loop body: {c}"
+        assert any(not c.startswith("main") for c, _ in in_slice), \
+            "no in-slice gather inside the scan body — did the gas " \
+            "scan disappear?"
+
+    def test_hpz_off_gathers_cross_slices(self):
+        """Control: without hpZ the same step's param gathers span all
+        8 ranks — the traffic hpZ keeps on ICI."""
+        engine, batch = _hpz_engine(gas=2, hpz=1)
+        # hpz=1 disables the subgroup path; force the manual zeropp step
+        # via qwZ? No — without any zero++ flag the engine uses plain
+        # sharding. Assert on the standard stage-3 step instead.
+        hlo = _lower_hlo(engine, batch)
+        gathers = _gather_groups(hlo)
+        assert gathers
+        assert any(not _in_slice(g) for _, g in gathers), \
+            "stage-3 without hpZ should gather across all ranks"
+
+    def test_secondary_refresh_count_tracks_leaves_not_microbatches(self):
+        """The refresh count must not scale with gas (once per step):
+        doubling microbatches leaves the cross-slice gather count
+        unchanged."""
+        e2, b2 = _hpz_engine(gas=2)
+        e4, b4 = _hpz_engine(gas=4)
+        cross2 = [g for c, g in _gather_groups(_lower_hlo(e2, b2))
+                  if not _in_slice(g)]
+        cross4 = [g for c, g in _gather_groups(_lower_hlo(e4, b4))
+                  if not _in_slice(g)]
+        assert len(cross2) == len(cross4)

@@ -1,0 +1,30 @@
+"""Engine smoke for the fast tier: one REAL end-to-end
+init → train (loss falls) → checkpoint round trip → post-restore step,
+on a single-device mesh so the compile stays in smoke-tier budget. The
+multi-device/ZeRO/parallelism engine coverage lives in the `slow`
+tier (runtime/test_engine.py and friends)."""
+
+import jax
+import numpy as np
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+
+def test_train_checkpoint_resume_single_device(tmp_path):
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(data=1), devices=jax.devices()[:1])
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (4, 32), np.int32)}
+    engine, _, _, _ = hds.initialize(
+        model=GPT2LMHeadModel(gpt2_tiny()), topology=topo,
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+        example_batch=batch)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    engine.save_checkpoint(str(tmp_path), tag="smoke")
+    engine.load_checkpoint(str(tmp_path), tag="smoke")
+    post = float(engine.train_batch(batch=batch))
+    assert np.isfinite(post) and post < losses[0]
