@@ -1,0 +1,72 @@
+"""Structured compression: config-driven prune -> train -> export
+(reference: ``deepspeed/compression`` — the ``init_compression`` /
+``redundancy_clean`` user flow).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/compress_prune_export.py
+
+Row-prunes the MLP up-projections of GPT-2-tiny to half width with
+learnable topk scores while weight-quantizing attention, trains a few
+steps, then exports a dimension-reduced model that reproduces the
+masked model's loss.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import hcache_deepspeed_tpu as hds  # noqa: E402
+from hcache_deepspeed_tpu.compression import redundancy_clean  # noqa: E402
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,  # noqa: E402
+                                              gpt2_tiny)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32), np.int32)}
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "compression_training": {
+            "row_pruning": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 2,
+                                      "method": "l1"},
+                "different_groups": {"rp1": {
+                    "params": {"dense_ratio": 0.5},
+                    "modules": [r"mlp/c_fc"],
+                    "related_modules": [[r"mlp/c_proj"]]}}},
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 0},
+                "different_groups": {"wq1": {
+                    "params": {"start_bits": 12, "target_bits": 8,
+                               "quantization_period": 2},
+                    "modules": [r"attn/c_attn"]}}},
+        },
+    }
+    engine, _, _, _ = hds.initialize(model=GPT2LMHeadModel(gpt2_tiny()),
+                                     config=config, example_batch=batch)
+    for step in range(8):
+        loss = float(engine.train_batch(batch=batch))
+        print(f"step {step}: loss {loss:.4f}")
+
+    host = jax.device_get(engine.state["params"])
+    fixed, dims = redundancy_clean(host, config, engine._structured)
+    print("dimension-reduced exports:", {k: v for k, v in dims.items()
+                                         if "c_fc" in k})
+    small = GPT2LMHeadModel(gpt2_tiny(n_inner=128))
+    out = small.apply({"params": jax.tree.map(jnp.asarray, fixed)}, batch)
+    loss = float(out[0] if isinstance(out, tuple) else out)
+    print(f"exported n_inner=128 model loss: {loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

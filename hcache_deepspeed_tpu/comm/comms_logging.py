@@ -74,6 +74,29 @@ class CommsLogger:
                              f"{convert_size(total):>14}")
         log_dist("\n".join(lines), ranks=[0])
 
+    def axis_summary(self):
+        """Per-axis-group traffic breakdown
+        ``{op_name: {axis_group: (count, total_bytes)}}`` — the
+        partitioned-parameter profiler analog (reference:
+        ``runtime/zero/partitioned_param_profiler.py`` EventCounter
+        count/numel per event): how much gather/reduce volume each mesh
+        axis carries, for the monitor and for hpZ-style wire-locality
+        checks."""
+        out = {}
+        for key, sizes in self.comms_dict.items():
+            op, _, axes = key.partition("@")
+            count = sum(c for c, _ in sizes.values())
+            total = sum(t for _, t in sizes.values())
+            out.setdefault(op, {})[axes] = (count, total)
+        return out
+
+    def monitor_events(self, step: int):
+        """``(tag, value, step)`` triples for ``monitor.write_events``:
+        total bytes per collective per axis group."""
+        return [(f"Comms/{op}@{axes}", float(total), step)
+                for op, by_axis in sorted(self.axis_summary().items())
+                for axes, (_, total) in sorted(by_axis.items())]
+
     def reset(self):
         self.comms_dict.clear()
 

@@ -1296,8 +1296,15 @@ class HDSEngine:
         self.tput_timer.stop(report_speed=True)
         if self.monitor.enabled and \
                 self.global_steps % self.config.steps_per_print == 0:
-            self.monitor.write_events([
-                ("Train/loss", float(loss), self.global_steps)])
+            events = [("Train/loss", float(loss), self.global_steps)]
+            # per-axis collective volume breakdown (the partitioned-
+            # parameter profiler analog: reference
+            # runtime/zero/partitioned_param_profiler.py)
+            from ..comm.comms_logging import get_comms_logger
+            clog = get_comms_logger()
+            if clog.enabled:
+                events += clog.monitor_events(self.global_steps)
+            self.monitor.write_events(events)
         return loss
 
     def _print_flops_profile(self, shaped_batch, lr, moq_bits, pld_theta,

@@ -87,3 +87,30 @@ class TestCommsLogger:
         f(x)
         assert any("all_reduce" in k for k in logger.comms_dict)
         comm.configure(enabled=False)
+
+    def test_axis_summary_and_monitor_events(self, eight_devices):
+        """Per-axis volume breakdown — the partitioned-parameter
+        profiler analog (reference:
+        runtime/zero/partitioned_param_profiler.py count/numel per
+        event, surfaced to the monitor)."""
+        comm.configure(enabled=True)
+        logger = comm.get_comms_logger()
+        logger.reset()
+        topo = initialize_topology(TopologySpec(data=4, tensor=2))
+        x = jnp.arange(8.0)
+        f = jax.shard_map(
+            lambda v: comm.all_gather(
+                comm.all_reduce(v, group="tensor"), group="data"),
+            mesh=topo.mesh, in_specs=P(("data", "tensor")),
+            out_specs=P(None), check_vma=False)
+        f(x)
+        summary = logger.axis_summary()
+        assert "all_reduce" in summary and "all_gather" in summary
+        assert "tensor" in summary["all_reduce"]
+        assert "data" in summary["all_gather"]
+        count, total = summary["all_gather"]["data"]
+        assert count >= 1 and total > 0
+        events = logger.monitor_events(step=7)
+        assert any(tag == "Comms/all_gather@data" and step == 7
+                   for tag, _, step in events)
+        comm.configure(enabled=False)
