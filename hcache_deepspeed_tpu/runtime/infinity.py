@@ -133,6 +133,30 @@ class NVMeParamBank:
                 self.aio.wait(rid)
 
 
+def trainer_from_config(module, params, config: Dict[str, Any],
+                        host_budget_bytes: Optional[int] = None
+                        ) -> "ZeroInfinityTrainer":
+    """Build a :class:`ZeroInfinityTrainer` from a reference-style
+    config dict: ``optimizer.params`` drives the CPUAdam,
+    ``zero_optimization.offload_param.nvme_path`` the bank directory
+    (reference: ``offload_config.py`` OffloadParamConfig)."""
+    opt = (config.get("optimizer") or {}).get("params") or {}
+    zcfg = config.get("zero_optimization") or {}
+    op = zcfg.get("offload_param") or {}
+    if op.get("device") != "nvme":
+        raise ValueError("trainer_from_config expects "
+                         "zero_optimization.offload_param.device='nvme'")
+    return ZeroInfinityTrainer(
+        module, params,
+        swap_dir=op.get("nvme_path", "/tmp/hds_nvme"),
+        optimizer_cfg={"lr": opt.get("lr", 1e-3),
+                       "betas": tuple(opt.get("betas", (0.9, 0.999))),
+                       "eps": opt.get("eps", 1e-8),
+                       "weight_decay": opt.get("weight_decay", 0.0)},
+        host_budget_bytes=host_budget_bytes,
+        num_threads=int(op.get("buffer_count", 4)))
+
+
 class ZeroInfinityTrainer:
     """Layer-streamed training loop over a layered model spec
     (``models/layered.zeropp_layered_spec``): parameters larger than

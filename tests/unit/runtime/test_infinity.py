@@ -131,3 +131,32 @@ class TestTrainer:
         with pytest.raises(ValueError, match="layered"):
             ZeroInfinityTrainer(object(), {"x": np.zeros(3)},
                                 swap_dir=str(tmp_path))
+
+    def test_trainer_from_config_and_engine_rejection(self, tmp_path):
+        """The reference config spelling routes to the streamed trainer;
+        the fused engine refuses offload_param with a pointer."""
+        from hcache_deepspeed_tpu.runtime.infinity import \
+            trainer_from_config
+
+        model, params, batch = _model_and_params(n_layer=2)
+        cfg = {"optimizer": {"type": "AdamW",
+                             "params": {"lr": 5e-4}},
+               "zero_optimization": {"offload_param": {
+                   "device": "nvme",
+                   "nvme_path": str(tmp_path / "nvme")}}}
+        tr = trainer_from_config(model, dict(params), cfg)
+        assert tr.adam.lr == 5e-4
+        assert float(tr.train_step(batch)) > 0
+
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+        with pytest.raises(HDSConfigError, match="infinity"):
+            hds.initialize(
+                model=model,
+                config={"train_batch_size": 8,
+                        "optimizer": {"type": "AdamW",
+                                      "params": {"lr": 1e-3}},
+                        "zero_optimization": {"stage": 3,
+                                              "offload_param": {
+                                                  "device": "nvme"}}},
+                example_batch={"input_ids": np.zeros((8, 16), np.int32)})
