@@ -323,24 +323,19 @@ class HDSEngine:
             raise ValueError(
                 f"offload_optimizer.device must be none|cpu|nvme, got "
                 f"{self.offload_device!r}")
-        if zcfg.offload_param.device == "nvme":
-            from .config import HDSConfigError
-            raise HDSConfigError(
-                "offload_param.device='nvme' (ZeRO-Infinity parameter "
-                "swap) runs on the layer-streamed trainer — host IO "
-                "cannot live inside the fused engine step. Use "
-                "runtime.infinity.trainer_from_config(model, params, "
-                "config); see docs/training.md")
-        if zcfg.offload_param.device == "cpu":
-            # host-RAM param residence is the same streamed execution
-            # model; do not pretend the fused step honors it
-            from .config import HDSConfigError
-            raise HDSConfigError(
-                "offload_param.device='cpu' is not supported by the "
-                "fused engine step; use the layer-streamed "
-                "runtime.infinity trainer (its bank directory can sit "
-                "on tmpfs for a host-RAM window)")
         if zcfg.offload_param.device != "none":
+            from .config import HDSConfigError
+            if zcfg.offload_param.device in ("nvme", "cpu"):
+                # ZeRO-Infinity param residence is a streamed execution
+                # model — host IO cannot live inside the fused step; do
+                # not pretend this engine honors it ('cpu' = the same
+                # trainer with its bank directory on tmpfs)
+                raise HDSConfigError(
+                    f"offload_param.device={zcfg.offload_param.device!r} "
+                    "runs on the layer-streamed trainer, not the fused "
+                    "engine step: use runtime.infinity."
+                    "trainer_from_config(model, params, config); see "
+                    "docs/training.md")
             raise ValueError(
                 f"offload_param.device must be none|cpu|nvme, got "
                 f"{zcfg.offload_param.device!r}")

@@ -140,15 +140,22 @@ def trainer_from_config(module, params, config: Dict[str, Any],
     config dict: ``optimizer.params`` drives the CPUAdam,
     ``zero_optimization.offload_param.nvme_path`` the bank directory
     (reference: ``offload_config.py`` OffloadParamConfig)."""
-    opt = (config.get("optimizer") or {}).get("params") or {}
+    opt_block = config.get("optimizer") or {}
+    opt_type = str(opt_block.get("type", "AdamW"))
+    if opt_type.lower() not in ("adam", "adamw"):
+        raise ValueError(
+            f"the layer-streamed trainer steps with the SIMD CPUAdam; "
+            f"optimizer.type {opt_type!r} is not supported (Adam/AdamW)")
+    opt = opt_block.get("params") or {}
     zcfg = config.get("zero_optimization") or {}
     op = zcfg.get("offload_param") or {}
     if op.get("device") != "nvme":
         raise ValueError("trainer_from_config expects "
                          "zero_optimization.offload_param.device='nvme'")
+    from .config import OffloadConfig
     return ZeroInfinityTrainer(
         module, params,
-        swap_dir=op.get("nvme_path", "/tmp/hds_nvme"),
+        swap_dir=op.get("nvme_path", OffloadConfig().nvme_path),
         optimizer_cfg={"lr": opt.get("lr", 1e-3),
                        "betas": tuple(opt.get("betas", (0.9, 0.999))),
                        "eps": opt.get("eps", 1e-8),

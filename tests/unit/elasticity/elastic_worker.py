@@ -25,7 +25,11 @@ MARKER = os.path.join(RUN_DIR, "gen0_saved")
 DONE = os.path.join(RUN_DIR, "done")
 
 
-def wait_for(path, timeout=300):
+def wait_for(path, timeout=None):
+    # generous default: gen-0 engine compiles on a loaded 1-core CI
+    # host can take many minutes; tune down via env for fast hosts
+    if timeout is None:
+        timeout = float(os.environ.get("HDS_ELASTIC_WAIT_SECS", 1200))
     t0 = time.time()
     while not os.path.exists(path):
         if time.time() - t0 > timeout:

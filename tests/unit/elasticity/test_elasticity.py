@@ -79,10 +79,15 @@ class TestElasticEndToEnd:
             os.path.dirname(os.path.abspath(__file__)))))
         os.environ["HDS_ELASTIC_TEST_DIR"] = run_dir
         # the bootstrap execs the worker by PATH, so sys.path[0] is the
-        # worker's dir — the repo root must come from PYTHONPATH
+        # worker's dir — the repo root must come from PYTHONPATH. The
+        # axon sitecustomize dir is FILTERED OUT: it would register the
+        # TPU relay plugin in every worker, and a wedged relay hangs
+        # their jax backend init (the verify-skill recipe). Other
+        # inherited entries are kept (deps may ride PYTHONPATH).
         prev_pp = os.environ.get("PYTHONPATH")
-        os.environ["PYTHONPATH"] = (repo + (":" + prev_pp
-                                            if prev_pp else ""))
+        kept = [p for p in (prev_pp or "").split(":")
+                if p and "axon_site" not in p]
+        os.environ["PYTHONPATH"] = ":".join([repo] + kept)
         try:
             def cmd_fn(world, restart, idx):
                 return [sys.executable, "-m",
