@@ -61,6 +61,15 @@ def initialize(args=None,
                        cfg.compile.cache_min_compile_time_secs)
     _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     comm.init_distributed()
+    # apply an EXPLICIT comms_logger config block to the global logger
+    # (reference: comms_config.py wired through deepspeed.initialize);
+    # a config without the block must not clobber programmatic
+    # comm.configure() state with defaults
+    if "comms_logger" in cfg.model_fields_set:
+        cl = cfg.comms_logger
+        comm.configure(enabled=cl.enabled, verbose=cl.verbose,
+                       prof_all=cl.prof_all, prof_ops=list(cl.prof_ops),
+                       debug=cl.debug)
 
     from .runtime.pipe.module import PipelineModule
     engine_cls = HDSEngine

@@ -288,5 +288,7 @@ def log_summary():
     get_comms_logger().log_all()
 
 
-def configure(enabled=None, verbose=None, prof_all=None, prof_ops=None):
-    get_comms_logger().configure(enabled, verbose, prof_all, prof_ops)
+def configure(enabled=None, verbose=None, prof_all=None, prof_ops=None,
+              debug=None):
+    get_comms_logger().configure(enabled, verbose, prof_all, prof_ops,
+                                 debug)

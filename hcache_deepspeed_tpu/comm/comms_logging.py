@@ -36,7 +36,8 @@ class CommsLogger:
         # op_name -> msg_size -> [count, total_bytes]
         self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, 0]))
 
-    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None):
+    def configure(self, enabled=None, verbose=None, prof_all=None,
+                  prof_ops=None, debug=None):
         if enabled is not None:
             self.enabled = enabled
         if verbose is not None:
@@ -45,6 +46,8 @@ class CommsLogger:
             self.prof_all = prof_all
         if prof_ops is not None:
             self.prof_ops = prof_ops
+        if debug is not None:
+            self.debug = debug
 
     def should_log(self, op_name):
         if not self.enabled:

@@ -88,6 +88,31 @@ class TestCommsLogger:
         assert any("all_reduce" in k for k in logger.comms_dict)
         comm.configure(enabled=False)
 
+    def test_config_block_wires_the_logger(self, eight_devices):
+        """The reference's ``comms_logger`` config block configures the
+        global logger through initialize (comms_config.py)."""
+        import hcache_deepspeed_tpu as hds
+        import numpy as np
+        from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,
+                                                      gpt2_tiny)
+        logger = comm.get_comms_logger()
+        logger.reset()
+        batch = {"input_ids": np.zeros((8, 16), np.int32)}
+        try:
+            hds.initialize(
+                model=GPT2LMHeadModel(gpt2_tiny()), example_batch=batch,
+                config={"train_batch_size": 8,
+                        "optimizer": {"type": "AdamW",
+                                      "params": {"lr": 1e-3}},
+                        "comms_logger": {"enabled": True,
+                                         "prof_ops": ["all_gather"],
+                                         "prof_all": False}})
+            assert logger.enabled
+            assert logger.prof_ops == ["all_gather"]
+            assert logger.prof_all is False
+        finally:
+            comm.configure(enabled=False, prof_all=True, prof_ops=[])
+
     def test_axis_summary_and_monitor_events(self, eight_devices):
         """Per-axis volume breakdown — the partitioned-parameter
         profiler analog (reference:
