@@ -10,7 +10,8 @@ from .quantize import (QuantizeScheduler, fake_quantize,
                        quantize_param_tree_traced)
 from .structured import (CompressionError, CompressionScheduler,
                          CompressionState, activation_interceptor,
-                         apply_compression, fix_compression,
+                         apply_compression, calibrate_activation_ranges,
+                         fix_compression,
                          get_compression_config, init_compression,
                          quantize_activation, redundancy_clean,
                          student_initialization)
@@ -21,6 +22,7 @@ __all__ = ["fake_quantize", "fake_quantize_traced", "QuantizeScheduler",
            "layer_eigenvalues", "moq_bit_assignment",
            "CompressionError", "CompressionScheduler", "CompressionState",
            "activation_interceptor", "apply_compression",
+           "calibrate_activation_ranges",
            "fix_compression", "get_compression_config", "init_compression",
            "quantize_activation", "redundancy_clean",
            "student_initialization"]

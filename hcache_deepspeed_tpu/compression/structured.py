@@ -242,13 +242,17 @@ class CompressionState:
     from the learnable scores the ``init`` injected into
     ``params[_compression_scores]``. The whole object is host-side
     static except ``masks``, which the engine threads through the jitted
-    step like any other array argument."""
+    step like any other array argument. ``act_ranges`` holds calibrated
+    (lo, hi) activation ranges per module for the ``static``
+    range-calibration mode (reference ``QuantAct`` running min/max)."""
     spec: Dict[str, TechniqueSpec]
     masks: Dict[str, jnp.ndarray]
     num_heads: Dict[str, int]      # head-pruned path -> head count
     wq_bits_path: Dict[str, Tuple[int, ...]]  # path -> bit staircase
     wq_groups_path: Dict[str, int]
     wq_offset: int = 0
+    act_ranges: Dict[str, Tuple[float, float]] = field(
+        default_factory=dict)
 
     def enabled(self, tech: str) -> bool:
         t = self.spec.get(tech)
@@ -511,12 +515,22 @@ def quantize_activation(x, bits: int, symmetric: bool = True,
                         static_range: Optional[Tuple[float, float]] = None):
     """Fake-quantize activations (reference basic_layer.py:355
     ``QuantAct`` / Sym/AsymQuantizer on the input). Dynamic range uses
-    per-token groups like the reference (num_groups = numel // last)."""
+    per-token groups like the reference (num_groups = numel // last);
+    a static range quantizes symmetrically over ±max(|lo|,|hi|) or —
+    asymmetric — over [lo, hi] with a zero offset (post-ReLU ranges
+    would otherwise waste half the code space)."""
     if static_range is not None:
-        lo, hi = static_range
-        qmax = 2.0 ** (bits - 1) - 1
-        scale = max(abs(lo), abs(hi)) / qmax
-        return jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+        lo, hi = float(static_range[0]), float(static_range[1])
+        if hi <= lo or (lo == 0.0 and hi == 0.0):
+            return x   # degenerate calibration: pass through, no /0
+        if symmetric:
+            qmax = 2.0 ** (bits - 1) - 1
+            scale = max(abs(lo), abs(hi)) / qmax
+            return jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+        levels = 2.0 ** bits - 1
+        scale = (hi - lo) / levels
+        q = jnp.clip(jnp.round((x - lo) / scale), 0.0, levels)
+        return q * scale + lo
     groups = max(x.size // x.shape[-1], 1) if x.ndim > 1 else 1
     return fake_quantize(x, bits, symmetric=symmetric, groups=groups)
 
@@ -544,13 +558,82 @@ def activation_interceptor(comp: CompressionState, step):
         sym = g.params.get("quantization_type", "symmetric") == "symmetric"
         cal = g.params.get("range_calibration",
                            t.shared.get("range_calibration", "dynamic"))
-        rng = ((-1.0, 1.0) if cal == "static" else None)
+        rng = None
+        if cal == "static":
+            # calibrated range (reference QuantAct running min/max —
+            # run calibrate_activation_ranges BEFORE the first compiled
+            # step: the range is a trace-time constant inside jit, so
+            # later calibration cannot take effect without a retrace);
+            # an explicit group-level static_range overrides
+            explicit = g.params.get("static_range")
+            calibrated = comp.act_ranges.get(path)
+            if explicit is not None:
+                rng = tuple(explicit)
+            elif calibrated is not None:
+                rng = tuple(calibrated)
+            else:
+                from ..utils.logging import warning_once
+                warning_once(
+                    f"activation_quantization: static range for {path} "
+                    "was never calibrated (run "
+                    "calibrate_activation_ranges) — falling back to "
+                    "(-1, 1), which clips anything larger")
+                rng = (-1.0, 1.0)
         qx = quantize_activation(args[0], bits, symmetric=sym,
                                  static_range=rng)
         x = jnp.where(jnp.asarray(step) >= t.schedule_offset, qx, args[0])
         return next_fun(x, *args[1:], **kwargs)
 
     return interceptor
+
+
+def calibrate_activation_ranges(apply_fn, comp: CompressionState,
+                                batches, momentum: float = 0.95
+                                ) -> CompressionState:
+    """Run ``apply_fn(batch)`` (a model forward under
+    ``flax.linen.intercept_methods`` supplied here) over calibration
+    ``batches``, tracking a momentum-smoothed min/max of each
+    STATIC-calibrated module's input — the reference ``QuantAct``
+    calibration (basic_layer.py:355) done as an offline pass. Fills
+    ``comp.act_ranges`` in place and returns ``comp``.
+
+    Run this BEFORE the first compiled train/eval step: the interceptor
+    reads the ranges at trace time, so mutations after the first jit
+    compile do not take effect (build a fresh engine to re-calibrate)."""
+    import flax.linen as fnn
+
+    t = comp.spec.get(ACTIVATION_QUANTIZATION)
+    targets = set()
+    if t and t.enabled:
+        for g in t.groups:
+            cal = g.params.get("range_calibration",
+                               t.shared.get("range_calibration",
+                                            "dynamic"))
+            if cal == "static":
+                targets.update(g.modules)
+    if not targets:
+        return comp
+
+    def recorder(next_fun, args, kwargs, context):
+        if context.method_name == "__call__" and args:
+            path = "/".join(context.module.path)
+            if path in targets:
+                x = np.asarray(jax.device_get(args[0]), np.float32)
+                lo, hi = float(x.min()), float(x.max())
+                prev = comp.act_ranges.get(path)
+                if prev is None:
+                    comp.act_ranges[path] = (lo, hi)
+                else:
+                    m = momentum
+                    comp.act_ranges[path] = (
+                        m * prev[0] + (1 - m) * lo,
+                        m * prev[1] + (1 - m) * hi)
+        return next_fun(*args, **kwargs)
+
+    for batch in batches:
+        with fnn.intercept_methods(recorder):
+            apply_fn(batch)
+    return comp
 
 
 # ------------------------------------------------------------------ #

@@ -338,6 +338,60 @@ class TestActivationQuantization:
         assert not np.allclose(np.asarray(plain), np.asarray(post))
 
 
+    def test_asymmetric_static_range_and_degenerate(self):
+        from hcache_deepspeed_tpu.compression import quantize_activation
+
+        x = jnp.asarray(np.linspace(0.0, 6.0, 64), jnp.float32)
+        # post-ReLU-like range: asymmetric must not waste the negative
+        # half of the code space
+        q_asym = quantize_activation(x, 8, symmetric=False,
+                                     static_range=(0.0, 6.0))
+        q_sym = quantize_activation(x, 8, symmetric=True,
+                                    static_range=(0.0, 6.0))
+        err_asym = float(jnp.abs(q_asym - x).mean())
+        err_sym = float(jnp.abs(q_sym - x).mean())
+        assert err_asym < err_sym
+        # degenerate calibration passes through instead of dividing by 0
+        out = quantize_activation(x, 8, static_range=(0.0, 0.0))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_static_calibration(self):
+        """range_calibration=static uses calibrated running min/max
+        (reference QuantAct) instead of a guessed range."""
+        from hcache_deepspeed_tpu.compression import \
+            calibrate_activation_ranges
+
+        cfg = {"compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "quantization_type": "symmetric",
+                                  "range_calibration": "static"},
+            "different_groups": {"aq1": {"params": {"bits": 8},
+                                         "modules": ["c_fc"]}}}}}
+        mlp = _MLPModule()
+        r = np.random.default_rng(5)
+        x = jnp.asarray(10.0 * r.standard_normal((4, 8)), jnp.float32)
+        params = mlp.init(jax.random.PRNGKey(0), x)["params"]
+        _, comp = init_compression(dict(params), cfg)
+        batches = [jnp.asarray(10.0 * r.standard_normal((4, 8)),
+                               jnp.float32) for _ in range(3)]
+        calibrate_activation_ranges(
+            lambda b: mlp.apply({"params": params}, b), comp, batches)
+        lo, hi = comp.act_ranges["c_fc"]
+        assert lo < -5 and hi > 5     # saw the real ±10-ish scale
+        # calibrated quantization keeps output close; the (-1, 1)
+        # fallback would clip the ±10-scale inputs to garbage
+        plain = mlp.apply({"params": params}, x)
+        with nn.intercept_methods(activation_interceptor(comp, step=1)):
+            cal = mlp.apply({"params": params}, x)
+        bad = comp.act_ranges.pop("c_fc")   # force the (-1,1) fallback
+        with nn.intercept_methods(activation_interceptor(comp, step=1)):
+            clipped = mlp.apply({"params": params}, x)
+        comp.act_ranges["c_fc"] = bad
+        err_cal = float(jnp.abs(cal - plain).mean())
+        err_clip = float(jnp.abs(clipped - plain).mean())
+        assert err_cal < err_clip / 4
+
+
 class _MLPModule(nn.Module):
     @nn.compact
     def __call__(self, x):
