@@ -1390,6 +1390,43 @@ class HDSEngine:
 
         return jax.tree.map(trunc, batch)
 
+    def calibrate_compression(self, batches):
+        """Offline activation-range calibration for static-calibrated
+        activation quantization (reference QuantAct running min/max).
+        Must run BEFORE the first train/eval step — the compiled step
+        bakes the ranges in at trace time, so late calibration could
+        never take effect (rejected rather than silently ignored)."""
+        if self._structured is None:
+            raise RuntimeError("no structured compression configured")
+        if self.global_steps > 0 or self.micro_steps > 0:
+            raise RuntimeError(
+                "calibrate_compression must run before the first "
+                "train/eval step: the compiled step reads the ranges "
+                "at trace time (build a fresh engine to re-calibrate)")
+        from ..compression import (apply_compression,
+                                   calibrate_activation_ranges)
+        from ..compression.structured import SCORES_KEY
+
+        def fwd(batch):
+            placed = self._shard_batch(batch)
+            # uncompiled forward — interception happens eagerly — over
+            # the SAME effective params the compiled step will see:
+            # LoRA-merged, compression-applied at the current step
+            with jax.disable_jit():
+                p = self.state["params"]
+                if self._lora is not None:
+                    from ..linear import merge_lora
+                    p = merge_lora(self.state["frozen"], p,
+                                   self._lora_cfg)
+                p = apply_compression(
+                    p, self._structured,
+                    jnp.asarray(self.global_steps, jnp.int32),
+                    masks=self._structured_masks)
+                p = {k: v for k, v in p.items() if k != SCORES_KEY}
+                self.adapter.loss(p, placed, None, train=False)
+
+        return calibrate_activation_ranges(fwd, self._structured, batches)
+
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)
         kw = {}

@@ -534,6 +534,50 @@ class TestEngineIntegration:
         cols = np.abs(fixed["h_0"]["mlp"]["c_proj"]["kernel"]).sum(0)
         assert (cols == 0).sum() == 32   # 64 * 0.5
 
+    def test_engine_calibration_flow(self):
+        """engine.calibrate_compression fills the static ranges before
+        the first compiled step; training then runs with them."""
+        import hcache_deepspeed_tpu as hds
+
+        rng = np.random.default_rng(1)
+        batch = {"input_ids": rng.integers(0, 256, (8, 32), np.int32)}
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "compression_training": {"activation_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 0,
+                                      "range_calibration": "static"},
+                "different_groups": {"aq": {
+                    "params": {"bits": 8},
+                    "modules": [r"mlp/c_fc"]}}}},
+        }
+        engine, _, _, _ = hds.initialize(
+            model=GPT2LMHeadModel(gpt2_tiny()), config=cfg,
+            example_batch=batch)
+        engine.calibrate_compression([batch])
+        ranges = engine._structured.act_ranges
+        assert any("mlp/c_fc" in k for k in ranges), ranges
+        lo, hi = next(iter(ranges.values()))
+        assert lo < hi
+        import logging
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        logging.getLogger("hds_tpu").addHandler(handler)
+        try:
+            losses = [float(engine.train_batch(batch=batch))
+                      for _ in range(3)]
+        finally:
+            logging.getLogger("hds_tpu").removeHandler(handler)
+        assert losses[-1] < losses[0]
+        # the compiled step used the calibrated ranges: the
+        # uncalibrated-fallback warning must not have fired
+        assert not any("never calibrated" in m for m in records), records
+        # late calibration is rejected, not silently ignored
+        with pytest.raises(RuntimeError, match="before the first"):
+            engine.calibrate_compression([batch])
+
     def test_structured_rejected_with_zeropp(self):
         import hcache_deepspeed_tpu as hds
         from hcache_deepspeed_tpu.runtime.config import HDSConfigError
