@@ -19,10 +19,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
       --prefill-chunk 64 | tee SERVE_7B_INT8.jsonl
     echo "int8 rc=$?" >&2
-    HDS_BENCH_CHILD=350m-hd128-lchunk-b8-blk256x256 timeout 1300 \
-      python bench.py | tail -1 | tee VET_BLK256.json
-    HDS_BENCH_CHILD=350m-hd128-lchunk-b8-blk512x1024 timeout 1300 \
-      python bench.py | tail -1 | tee VET_BLK512.json
+    bash bin/chip_session.sh vet
     echo "watch queue done" >&2
     exit 0
   fi
