@@ -88,6 +88,26 @@ CONFIGS = {
     "350m-hd128-lchunk-seq16k-b1": dict(batch=1, seq=16384, n_head=8,
                                         vocab_size=50304, loss_chunk=256,
                                         remat=True),
+    # remat-policy variants: plain remat=True recomputes every matmul in
+    # backward (~8N FLOPs/token vs 6N), capping measured MFU near 75% of
+    # hardware util. dots_saveable keeps matmul outputs (bf16 residuals)
+    # and recomputes only elementwise — the memory must fit, hence vet.
+    "350m-hd128-lchunk-seq4k-b2-rpdots": dict(
+        batch=2, seq=4096, n_head=8, vocab_size=50304, loss_chunk=256,
+        remat=True, remat_policy="dots_saveable"),
+    "350m-hd128-lchunk-seq16k-b1-rpdots": dict(
+        batch=1, seq=16384, n_head=8, vocab_size=50304, loss_chunk=256,
+        remat=True, remat_policy="dots_saveable"),
+    "7b-layer-seq2k-b2-rpdots": dict(model="llama", batch=2, seq=2048,
+                                     hidden=4096, ffn=11008, n_head=32,
+                                     n_layer=2, vocab_size=4096,
+                                     loss_chunk=256, remat=True,
+                                     remat_policy="dots_saveable"),
+    "7b-layer-seq4k-b1-rpdots": dict(model="llama", batch=1, seq=4096,
+                                     hidden=4096, ffn=11008, n_head=32,
+                                     n_layer=2, vocab_size=4096,
+                                     loss_chunk=256, remat=True,
+                                     remat_policy="dots_saveable"),
     "350m-hd128-b16": dict(batch=16, n_head=8, vocab_size=50304,
                            loss_chunk=0),
     "350m-vpad-b8": dict(batch=8, n_head=16, vocab_size=50304,
@@ -112,6 +132,44 @@ CONFIGS = {
                            n_head=4, vocab_size=256, loss_chunk=0,
                            record=False),
 }
+
+
+def build_model(name):
+    """(model, model_config, batch, seq) for one CONFIGS entry. Shared
+    with tests/unit/test_bench_configs.py so the pre-vetting trace test
+    builds exactly the model the bench measures (a private copy there
+    drifted once: it hardcoded n_layer=24 and missed tiny-cpu-guard's
+    2-layer shape)."""
+    spec = CONFIGS[name]
+    if spec.get("model") == "llama":
+        from hcache_deepspeed_tpu.models.llama import (LlamaConfig,
+                                                       LlamaForCausalLM)
+        batch, seq = spec["batch"], spec["seq"]
+        mcfg = LlamaConfig(vocab_size=spec["vocab_size"],
+                           hidden_size=spec["hidden"],
+                           intermediate_size=spec["ffn"],
+                           n_layer=spec["n_layer"],
+                           n_head=spec["n_head"],
+                           n_kv_head=spec["n_head"],
+                           max_positions=seq, dtype="bfloat16",
+                           remat=spec.get("remat", False),
+                           remat_policy=spec.get("remat_policy", ""),
+                           loss_chunk=spec["loss_chunk"],
+                           flash_block_q=spec.get("block_q", 0),
+                           flash_block_k=spec.get("block_k", 0))
+        return LlamaForCausalLM(mcfg), mcfg, batch, seq
+    from hcache_deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    batch, seq = spec["batch"], spec.get("seq", 1024)
+    mcfg = GPT2Config(n_layer=spec.get("n_layer", 24),
+                      n_embd=spec.get("n_embd", 1024),
+                      n_head=spec["n_head"],
+                      n_positions=seq, vocab_size=spec["vocab_size"],
+                      dtype="bfloat16", remat=spec.get("remat", False),
+                      remat_policy=spec.get("remat_policy", ""),
+                      loss_chunk=spec["loss_chunk"],
+                      flash_block_q=spec.get("block_q", 0),
+                      flash_block_k=spec.get("block_k", 0))
+    return GPT2LMHeadModel(mcfg), mcfg, batch, seq
 
 
 def _metric_label():
@@ -234,33 +292,8 @@ def run_config(name):
         mcfg = GPT2Config(n_layer=2, n_embd=64, n_head=4, n_positions=seq,
                           vocab_size=256, dtype="bfloat16", remat=False)
         model = GPT2LMHeadModel(mcfg)
-    elif CONFIGS[name].get("model") == "llama":
-        from hcache_deepspeed_tpu.models.llama import (LlamaConfig,
-                                                       LlamaForCausalLM)
-        spec = CONFIGS[name]
-        batch, seq = spec["batch"], spec["seq"]
-        mcfg = LlamaConfig(vocab_size=spec["vocab_size"],
-                           hidden_size=spec["hidden"],
-                           intermediate_size=spec["ffn"],
-                           n_layer=spec["n_layer"],
-                           n_head=spec["n_head"],
-                           n_kv_head=spec["n_head"],
-                           max_positions=seq, dtype="bfloat16",
-                           remat=spec.get("remat", False),
-                           loss_chunk=spec["loss_chunk"])
-        model = LlamaForCausalLM(mcfg)
     else:
-        spec = CONFIGS[name]
-        batch, seq = spec["batch"], spec.get("seq", 1024)
-        mcfg = GPT2Config(n_layer=spec.get("n_layer", 24),
-                          n_embd=spec.get("n_embd", 1024),
-                          n_head=spec["n_head"],
-                          n_positions=seq, vocab_size=spec["vocab_size"],
-                          dtype="bfloat16", remat=spec.get("remat", False),
-                          loss_chunk=spec["loss_chunk"],
-                          flash_block_q=spec.get("block_q", 0),
-                          flash_block_k=spec.get("block_k", 0))
-        model = GPT2LMHeadModel(mcfg)
+        model, mcfg, batch, seq = build_model(name)
     rng = np.random.default_rng(0)
     # clamp below every config's vocab so the sampled batch is identical
     # across padded-vocab variants

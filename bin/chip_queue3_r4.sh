@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Round-4 continuation chip queue: remat-policy vets (the 8N->6N
+# backward-FLOPs lever for the remat'd 7B-layer and long-context
+# configs), 7B fused-decode serving (the 117 ms/step host-driven number
+# is mostly tunnel RTT), and the Domino scheduled-HLO overlap test.
+# Same artifact-safety rules as chip_session.sh's vet_one.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python -c "import jax; d=jax.devices('tpu'); assert d, d" \
+    >/dev/null 2>&1
+}
+probe || { echo "relay DOWN; aborting" >&2; exit 3; }
+echo "relay UP" >&2
+
+# rpdots vets now live in the canonical runbook's vet stage (shared
+# vet_one with its artifact-safety rules; no duplicated copy here)
+bash bin/chip_session.sh vet
+
+echo "=== serve7b-fused" >&2
+timeout 3300 python bin/hds_serve_bench --model 7b --max-context 512 \
+  --prompt-len 128 --decode-steps 8 --batches 1 --prefill-chunk 64 \
+  --fused-decode | tee SERVE_7B_FUSED.jsonl
+echo "=== serve7b-fused rc=$?" >&2
+
+echo "=== domino-tpu" >&2
+HDS_TPU_TESTS=1 timeout 1800 python -m pytest \
+  tests/unit/runtime/test_domino_hlo.py -k TPU -q 2>&1 \
+  | tee DOMINO_TPU_r4.log | tail -5
+echo "=== domino rc=$?" >&2
+
+echo "chip_queue3 done" >&2

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Round-4 continuation queue 2: consolidated train curve (plain +
+# rpdots rows in one artifact), HCache restore-vs-prefill at 1B (the
+# fork's headline capability, bf16 and fp8 latents), and 7B int8
+# fused-decode serving (weight HBM traffic halved vs bf16).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python -c "import jax; d=jax.devices('tpu'); assert d, d" \
+    >/dev/null 2>&1
+}
+probe || { echo "relay DOWN; aborting" >&2; exit 3; }
+echo "relay UP" >&2
+
+echo "=== train-curve (consolidated)" >&2
+timeout 7200 python bin/hds_train_curve --out TRAIN_CURVE.json
+echo "=== curve rc=$?" >&2
+
+echo "=== restore-1b (bf16 latents)" >&2
+timeout 2400 python bin/hds_serve_bench --model 1b --restore \
+  --prompt-len 128 --batches 1 4 | tee RESTORE_1B.jsonl
+echo "=== restore-1b rc=$?" >&2
+
+echo "=== restore-1b (fp8 latents)" >&2
+timeout 2400 python bin/hds_serve_bench --model 1b --restore \
+  --latent-dtype float8_e4m3fn --prompt-len 128 --batches 1 4 \
+  | tee RESTORE_1B_FP8.jsonl
+echo "=== restore-1b-fp8 rc=$?" >&2
+
+echo "=== serve7b-int8-fused" >&2
+timeout 3300 python bin/hds_serve_bench --model 7b --quantize int8 \
+  --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+  --prefill-chunk 64 --fused-decode | tee SERVE_7B_INT8_FUSED.jsonl
+echo "=== serve7b-int8-fused rc=$?" >&2
+
+echo "chip_queue4 done" >&2

@@ -71,10 +71,17 @@ if want sweep1b; then
     | tee LOOKUP_1B.jsonl
 fi
 
-# 4. vet queued training configs (long-context FPDT story + 7B-layer
-#    proxy + tiling variants) — one JSON artifact
-if want vet || want curve; then
-  run_stage curve 5400 python bin/hds_train_curve --out TRAIN_CURVE.json
+# 4. the training MFU curve (11 configs; cold 7B-width compiles can
+#    run 700-900s each through the tunnel, so budget for a cold cache —
+#    the tool now writes TRAIN_CURVE.json incrementally and never
+#    clobbers a good artifact with an all-error run)
+if want curve; then
+  # inner per-config budget 1500s covers a cold 7B-width compile
+  # (700-900s) + 30 timed steps; the outer budget intentionally does
+  # NOT cover 11 all-cold configs (16.5ks) — incremental writes keep
+  # every completed row if the stage dies first
+  run_stage curve 10800 python bin/hds_train_curve --timeout 1500 \
+    --out TRAIN_CURVE.json
 fi
 
 # 4b. flash-tiling + batch vets of the bench winner. 1300s each: fresh
@@ -103,6 +110,12 @@ if want vet; then
   vet_one BLK256 350m-hd128-lchunk-b8-blk256x256
   vet_one BLK512 350m-hd128-lchunk-b8-blk512x1024
   vet_one B16 350m-hd128-b16
+  # remat-policy variants (docs/training.md's measured table; first
+  # vetted 2026-08-01 18:40-18:47Z — re-runnable from this runbook)
+  vet_one RP2K 7b-layer-seq2k-b2-rpdots
+  vet_one RP4K 7b-layer-seq4k-b1-rpdots
+  vet_one RPS4K 350m-hd128-lchunk-seq4k-b2-rpdots
+  vet_one RPS16K 350m-hd128-lchunk-seq16k-b1-rpdots
 fi
 
 # 5. Domino scheduled-HLO overlap evidence on real hardware

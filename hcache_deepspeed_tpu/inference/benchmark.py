@@ -456,8 +456,15 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
                    for _ in range(batch)]
         uids = list(range(batch))
 
+        # warm the prefill program off-clock (at 7B through the tunnel
+        # the compile alone is ~20 min; timing it as "prefill" reported
+        # 0.4 tok/s for what is a ~ms dispatch), then time the real rate
+        warm_uids = [10 ** 7 + u for u in uids]
+        eng.put(warm_uids, prompts)
+        for u in warm_uids:
+            eng.flush(u)
         t0 = time.perf_counter()
-        logits, _ = eng.put(uids, prompts)
+        logits, _ = eng.put(uids, prompts)   # returns host arrays (sync)
         prefill_s = time.perf_counter() - t0
         emit({"phase": "prefill", "batch": batch,
               "prompt_len": prompt_len,

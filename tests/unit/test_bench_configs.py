@@ -15,37 +15,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 import bench  # noqa: E402
 
 
-def _model_for(name):
-    spec = bench.CONFIGS[name]
-    if spec.get("model") == "llama":
-        from hcache_deepspeed_tpu.models.llama import (LlamaConfig,
-                                                       LlamaForCausalLM)
-        cfg = LlamaConfig(vocab_size=spec["vocab_size"],
-                          hidden_size=spec["hidden"],
-                          intermediate_size=spec["ffn"],
-                          n_layer=spec["n_layer"], n_head=spec["n_head"],
-                          n_kv_head=spec["n_head"],
-                          max_positions=spec["seq"], dtype="bfloat16",
-                          remat=spec.get("remat", False),
-                          loss_chunk=spec["loss_chunk"])
-        return LlamaForCausalLM(cfg), cfg, spec
-    from hcache_deepspeed_tpu.models.gpt2 import (GPT2Config,
-                                                  GPT2LMHeadModel)
-    cfg = GPT2Config(n_layer=24, n_embd=1024, n_head=spec["n_head"],
-                     n_positions=spec.get("seq", 1024),
-                     vocab_size=spec["vocab_size"], dtype="bfloat16",
-                     remat=spec.get("remat", False),
-                     loss_chunk=spec["loss_chunk"],
-                     flash_block_q=spec.get("block_q", 0),
-                     flash_block_k=spec.get("block_k", 0))
-    return GPT2LMHeadModel(cfg), cfg, spec
-
-
 @pytest.mark.parametrize("name", sorted(bench.CONFIGS))
 def test_config_traces(name):
-    model, cfg, spec = _model_for(name)
-    seq = spec.get("seq", 1024)
-    batch = {"input_ids": jax.ShapeDtypeStruct((spec["batch"], seq),
+    # bench.build_model is the SAME builder run_config measures with —
+    # a private copy here once drifted (hardcoded n_layer=24) and
+    # silently traced the wrong model for tiny-cpu-guard
+    model, cfg, batch_size, seq = bench.build_model(name)
+    batch = {"input_ids": jax.ShapeDtypeStruct((batch_size, seq),
                                                np.int32)}
 
     def init_and_loss(rng, batch):
