@@ -55,7 +55,10 @@ _CHILD = None               # current candidate subprocess, for the watchdog
 #            empty during QK^T / AV)
 #   vpad   — vocab 50304 (128-multiple): lane-aligned LM-head matmul
 #   lchunk — chunked LM loss: no [B, T, V] fp32 logits materialization
-CANDIDATES = ["350m-hd128-lchunk-b8", "350m-hd128-b8", "350m-b8"]
+# 350m-hd128-b8 measured best (62.66% MFU, 2026-08-01) — first, so a
+# budget-truncated run still measures the winner; lchunk variant second
+# (59.76%); the cache-proven fallback stays last (workflow contract)
+CANDIDATES = ["350m-hd128-b8", "350m-hd128-lchunk-b8", "350m-b8"]
 
 # Configs beyond CANDIDATES stay reachable for manual measurement via
 # HDS_BENCH_CHILD=<name> (how new candidates get vetted on the chip

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Relay watcher (round-4 continuation): probe every ~5 min; on revival
+# run the remaining measurement queue — HCache restore-vs-prefill at 1B
+# (bf16 + fp8 latents) and 7B int8 fused-decode serving — then exit.
+set -u -o pipefail   # `stage | tee` must report the stage's rc
+cd "$(dirname "$0")/.."
+DEADLINE=$(( $(date +%s) + ${1:-30000} ))
+
+probe() {
+  # fresh-shape compile: the compile service is a separate failure
+  # domain from execution; a cached-program probe would report UP while
+  # every new program hangs
+  timeout 180 python -c "
+import jax, jax.numpy as jnp, random
+n = random.randrange(130, 510)
+x = jnp.ones((n, 257))
+assert jax.devices('tpu')
+float(jax.jit(lambda a: (a @ a.T).sum())(x))" >/dev/null 2>&1
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    echo "relay (incl compile service) UP at $(date -u +%H:%M:%S)" >&2
+    timeout 2400 python bin/hds_serve_bench --model 1b --restore \
+      --prompt-len 128 --batches 1 4 | tee RESTORE_1B.jsonl
+    echo "restore-1b rc=$?" >&2
+    timeout 2400 python bin/hds_serve_bench --model 1b --restore \
+      --latent-dtype float8_e4m3fn --prompt-len 128 --batches 1 4 \
+      | tee RESTORE_1B_FP8.jsonl
+    echo "restore-1b-fp8 rc=$?" >&2
+    timeout 3300 python bin/hds_serve_bench --model 7b --quantize int8 \
+      --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+      --prefill-chunk 64 --fused-decode | tee SERVE_7B_INT8_FUSED.jsonl
+    echo "serve7b-int8-fused rc=$?" >&2
+    echo "watch2 queue done" >&2
+    exit 0
+  fi
+  sleep 280
+done
+echo "relay never revived before deadline" >&2
+exit 3

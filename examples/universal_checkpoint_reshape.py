@@ -1,0 +1,70 @@
+"""Universal checkpoint: train at one topology, resume at another
+(reference: ``deepspeed/checkpoint/ds_to_universal.py`` + the
+``--universal-checkpoint`` engine flag; here reshape-on-load is the
+default save format — param-name-keyed fp32 fragments reshard to
+whatever mesh the restoring engine runs).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/universal_checkpoint_reshape.py
+
+Trains ZeRO-3 data-parallel over 8 devices, checkpoints, then resumes
+on a different mesh (4-way data x 2-way tensor) and keeps training —
+the dp/tp-resize flow the reference needs an offline conversion step
+for.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import hcache_deepspeed_tpu as hds  # noqa: E402
+from hcache_deepspeed_tpu.models.gpt2 import (GPT2LMHeadModel,  # noqa: E402
+                                              gpt2_tiny)
+from hcache_deepspeed_tpu.parallel import topology as topo_mod  # noqa: E402
+
+
+def make_engine(cfg, data, tensor, batch):
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(data=data, tensor=tensor))
+    engine, _, _, _ = hds.initialize(
+        model=GPT2LMHeadModel(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+            "zero_optimization": {"stage": 3, "min_shard_size": 1},
+            "bf16": {"enabled": True},
+        },
+        example_batch=batch, topology=topo)
+    return engine
+
+
+def main():
+    cfg = gpt2_tiny()
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 16),
+                                       dtype=np.int32)}
+    ckpt = tempfile.mkdtemp(prefix="hds_universal_")
+
+    # --- phase 1: ZeRO-3 over a pure data mesh (dp=8)
+    e1 = make_engine(cfg, data=8, tensor=1, batch=batch)
+    for step in range(4):
+        loss = float(e1.train_batch(batch=batch))
+        print(f"dp=8    step {step}: loss {loss:.4f}")
+    e1.save_checkpoint(ckpt, tag="reshape")
+
+    # --- phase 2: resume on a RESHAPED mesh (dp=4 x tp=2)
+    topo_mod.reset_topology()
+    e2 = make_engine(cfg, data=4, tensor=2, batch=batch)
+    e2.load_checkpoint(ckpt, tag="reshape")
+    for step in range(4, 8):
+        loss = float(e2.train_batch(batch=batch))
+        print(f"dp4xtp2 step {step}: loss {loss:.4f}")
+    print("resumed across topologies; final loss", f"{loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()
