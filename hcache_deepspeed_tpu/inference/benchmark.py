@@ -519,16 +519,46 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
                 eng.flush(u)
             # warm with the SAME length: n_steps is a static arg, a
             # different value would recompile inside the timed region
-            eng.generate_fused(prompts, max_new_tokens=decode_steps + 1)
-            t0 = time.perf_counter()
-            eng.generate_fused(prompts,
-                               max_new_tokens=decode_steps + 1)
-            dt = time.perf_counter() - t0
-            emit({"phase": "decode-fused", "batch": batch,
-                  "context": [ctx0, ctx0 + decode_steps],
-                  "note": "includes one prefill",
-                  "tokens_per_sec": round(batch * decode_steps / dt, 1),
-                  "ms_per_step": round(dt / decode_steps * 1000, 2)})
+            try:
+                eng.generate_fused(prompts, max_new_tokens=decode_steps + 1)
+            except Exception as e:  # noqa: BLE001 — XLA OOM surfaces as
+                # a backend-specific RuntimeError subclass; at 7B bf16
+                # the fused program's stacked-QKV layout copies exceed a
+                # 16 GB chip (docs/inference.md). A dead stage loses the
+                # whole chip-session slot — fall back to the host-driven
+                # loop and say so in the artifact instead.
+                if "RESOURCE_EXHAUSTED" not in str(e) \
+                        and "Resource" not in type(e).__name__:
+                    raise
+                emit({"phase": "decode-fused", "batch": batch,
+                      "error": "fused decode program OOM; falling back "
+                               "to host-driven decode",
+                      "detail": str(e).splitlines()[0][:300]})
+                # generate_fused flushes its own uids in a finally, so
+                # the engine is clean: re-prefill and host-step
+                logits, _ = eng.put(uids, prompts)
+                nxt = [int(np.argmax(l)) for l in logits]
+                logits, _ = eng.put(uids, [[t] for t in nxt])
+                t0 = time.perf_counter()
+                for _ in range(decode_steps):
+                    nxt = [int(np.argmax(l)) for l in logits]
+                    logits, _ = eng.put(uids, [[t] for t in nxt])
+                dt = time.perf_counter() - t0
+                emit({"phase": "decode", "batch": batch,
+                      "note": "host-driven fallback after fused OOM",
+                      "context": [ctx0, ctx0 + decode_steps],
+                      "tokens_per_sec": round(batch * decode_steps / dt, 1),
+                      "ms_per_step": round(dt / decode_steps * 1000, 2)})
+            else:
+                t0 = time.perf_counter()
+                eng.generate_fused(prompts,
+                                   max_new_tokens=decode_steps + 1)
+                dt = time.perf_counter() - t0
+                emit({"phase": "decode-fused", "batch": batch,
+                      "context": [ctx0, ctx0 + decode_steps],
+                      "note": "includes one prefill",
+                      "tokens_per_sec": round(batch * decode_steps / dt, 1),
+                      "ms_per_step": round(dt / decode_steps * 1000, 2)})
         else:
             # warm the decode dispatch, then steady-state loop
             nxt = [int(np.argmax(l)) for l in logits]

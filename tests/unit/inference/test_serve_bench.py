@@ -25,6 +25,30 @@ def test_serve_bench_fused_mode():
     assert "decode-fused" in phases
 
 
+def test_serve_bench_fused_oom_falls_back_to_host_decode(monkeypatch):
+    """A fused-decode compile OOM (seen at 7B bf16 on a 16 GB chip:
+    stacked-QKV layout copies) must not kill the measurement — the tool
+    emits an error row and still produces host-driven decode numbers."""
+    from hcache_deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+
+    def boom(self, prompts, **kw):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm")
+
+    monkeypatch.setattr(InferenceEngineV2, "generate_fused", boom)
+    results = run(model_size="tiny", max_context=128, prompt_len=32,
+                  decode_steps=4, batches=(1,), fused=True)
+    phases = [r["phase"] for r in results]
+    oom_rows = [r for r in results
+                if r["phase"] == "decode-fused" and "error" in r]
+    host_rows = [r for r in results
+                 if r["phase"] == "decode" and "note" in r]
+    assert oom_rows and host_rows
+    assert host_rows[0]["tokens_per_sec"] > 0
+    # context-scaling phase still runs after the fallback
+    assert "decode-context-scaling" in phases
+
+
 def test_serve_bench_sweep():
     from hcache_deepspeed_tpu.inference.benchmark import run_sweep
     rows = run_sweep(model_size="tiny", max_context=128, prompt_len=16,
