@@ -313,6 +313,14 @@ def run_config(name):
         "zero_optimization": {"stage": 0},
         "steps_per_print": 10 ** 9,
     }
+    if not tiny:
+        # persistent local compilation cache: the relay's REMOTE compile
+        # service wedges independently of execution (the round-4 failure
+        # mode); a locally cached executable skips it entirely, so a
+        # config measured once stays measurable across wedges/restarts.
+        # If the axon PJRT client can't serialize executables, JAX logs
+        # a warning and runs uncached — strictly no worse.
+        cfg["compile"] = {"cache_dir": hds.default_compile_cache_dir()}
     engine, _, _, _ = hds.initialize(model=model, config=cfg,
                                      example_batch=data)
 

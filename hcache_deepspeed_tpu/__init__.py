@@ -16,6 +16,20 @@ from .runtime.hybrid_engine import HybridEngine  # noqa: F401
 from .utils.logging import log_dist, logger  # noqa: F401
 
 
+def default_compile_cache_dir():
+    """Shared location for the persistent XLA compilation cache used by
+    the measurement tools (bench.py, hds_serve_bench, hds_decode_diag):
+    ``HDS_COMPILE_CACHE_DIR`` if set, else ``.jax_cache`` next to the
+    package (the repo root in a checkout). One helper so the three
+    entry points cannot drift to different directories."""
+    import os
+    env = os.environ.get("HDS_COMPILE_CACHE_DIR")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+
+
 def initialize(args=None,
                model=None,
                optimizer=None,

@@ -16,6 +16,7 @@ CLI: ``bin/hds_serve_bench`` (JSON lines, one per measurement).
 import argparse
 import functools
 import json
+import os
 import time
 
 import numpy as np
@@ -530,25 +531,35 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
                 if "RESOURCE_EXHAUSTED" not in str(e) \
                         and "Resource" not in type(e).__name__:
                     raise
+                detail = (str(e) or type(e).__name__).splitlines()[0]
                 emit({"phase": "decode-fused", "batch": batch,
                       "error": "fused decode program OOM; falling back "
                                "to host-driven decode",
-                      "detail": str(e).splitlines()[0][:300]})
+                      "detail": detail[:300]})
                 # generate_fused flushes its own uids in a finally, so
-                # the engine is clean: re-prefill and host-step
+                # the engine is clean: re-prefill and host-step. The
+                # host path spends one extra token on its warm step, so
+                # clamp to the context budget (the fused call accepts
+                # prompt+steps == max_context exactly).
+                fb_steps = min(decode_steps,
+                               max_context - prompt_len - 1)
+                if fb_steps < 1:
+                    # prompt fills the context minus the fused budget's
+                    # last token; nothing left for warm + timed steps
+                    continue
                 logits, _ = eng.put(uids, prompts)
                 nxt = [int(np.argmax(l)) for l in logits]
                 logits, _ = eng.put(uids, [[t] for t in nxt])
                 t0 = time.perf_counter()
-                for _ in range(decode_steps):
+                for _ in range(fb_steps):
                     nxt = [int(np.argmax(l)) for l in logits]
                     logits, _ = eng.put(uids, [[t] for t in nxt])
                 dt = time.perf_counter() - t0
                 emit({"phase": "decode", "batch": batch,
                       "note": "host-driven fallback after fused OOM",
-                      "context": [ctx0, ctx0 + decode_steps],
-                      "tokens_per_sec": round(batch * decode_steps / dt, 1),
-                      "ms_per_step": round(dt / decode_steps * 1000, 2)})
+                      "context": [ctx0, ctx0 + fb_steps],
+                      "tokens_per_sec": round(batch * fb_steps / dt, 1),
+                      "ms_per_step": round(dt / fb_steps * 1000, 2)})
             else:
                 t0 = time.perf_counter()
                 eng.generate_fused(prompts,
@@ -641,6 +652,15 @@ def main(argv=None):
                         "(greedy-exact; reports acceptance + "
                         "tokens/dispatch)")
     args = p.parse_args(argv)
+    # persistent local compilation cache: a program compiled once on the
+    # chip stays runnable across remote-compile-service wedges and
+    # process restarts (harmless no-op if the PJRT client can't
+    # serialize executables)
+    import jax
+
+    from .. import default_compile_cache_dir
+    jax.config.update("jax_compilation_cache_dir",
+                      default_compile_cache_dir())
     # rows print as produced (partial results survive an OOM/crash)
     if args.sweep and args.fused_decode:
         if args.prefix_caching:

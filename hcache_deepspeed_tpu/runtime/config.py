@@ -217,6 +217,23 @@ class ElasticityConfig(HDSConfigModel):
 # Data types
 # ------------------------------------------------------------------ #
 class DataTypesConfig(HDSConfigModel):
+    """Reference: ``data_types`` block (runtime/config.py
+    get_data_types). ``grad_accum_dtype`` sets the dtype of the
+    gradient ACCUMULATOR buffers (memory + accumulation precision
+    across micro-steps; default fp32).
+
+    The reference's separate top-level ``communication_data_type`` has
+    no equivalent knob here, measured deliberately (see
+    tests/unit/runtime/test_comm_dtype.py): XLA's SPMD partitioner
+    flows the un-reduced partial gradients through the elementwise
+    unscale/cast chain and materializes ONE combined all-reduce at the
+    gradient-norm consumer — i.e. the reduction happens once per step
+    at the gas boundary (the IPG-boundary behavior the reference
+    hand-builds) in fp32, regardless of the accumulator dtype.
+    Forcing a bf16 wire would need an explicit shard_map reduction and
+    silently halve gradient-sum precision; exactness wins by default.
+    The 1-bit/compressed path (``runtime/onebit.py``) is the opt-in
+    lossy-wire story."""
     grad_accum_dtype: Optional[str] = None
 
 

@@ -1,0 +1,86 @@
+"""Gradient-reduction wire behavior (reference: the top-level
+``communication_data_type`` key + IPG-boundary reduction in
+``stage_1_and_2.py``).
+
+Measured design facts pinned here (see DataTypesConfig docstring):
+
+* XLA materializes the cross-dp gradient reduction as ONE combined
+  all-reduce per train step — partial (un-reduced) grads flow through
+  the elementwise unscale/cast chain and through the gas scan, so the
+  wire cost is per-boundary, not per-micro-step. This is the behavior
+  the reference hand-builds with IPG buckets + "reduce at gradient
+  accumulation boundary".
+* The reduction runs in fp32 regardless of ``grad_accum_dtype`` —
+  exact gradient summation; a lossy wire is the 1-bit path's job.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+from hcache_deepspeed_tpu.parallel import topology as topo_mod
+
+
+def _hlo(gas, grad_accum_dtype=None):
+    topo_mod.reset_topology()
+    cfg = {
+        "train_batch_size": 8 * gas,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2, "min_shard_size": 1},
+        "bf16": {"enabled": True},
+    }
+    if grad_accum_dtype:
+        cfg["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    batch = {"input_ids": np.zeros((8 * gas, 32), np.int32)}
+    engine, _, _, _ = hds.initialize(model=GPT2LMHeadModel(gpt2_tiny()),
+                                     config=cfg, example_batch=batch)
+    import jax
+    import jax.numpy as jnp
+    shaped = engine._shard_batch(
+        jax.tree.map(lambda x: np.asarray(x).reshape(
+            (gas, -1) + np.asarray(x).shape[1:]), batch),
+        extra_leading=True)
+    return engine._fused_train_batch.lower(
+        engine.state, shaped, jnp.float32(1e-3),
+        jax.random.PRNGKey(0)).compile().as_text()
+
+
+def _grad_reduces(hlo):
+    """(dtypes, count) over non-scalar reduction collectives. The
+    collective combiner emits tuple all-reduces (observed:
+    ``%all-reduce.90 = (f32[192]{0}, f32[192,64]{1,0}, ...)``); scalar
+    elements (norm partials, token counters) are not gradient
+    traffic and are excluded."""
+    dts, count = set(), 0
+    for line in hlo.splitlines():
+        for op in (" all-reduce(", " reduce-scatter(",
+                   " all-reduce-start(", " reduce-scatter-start("):
+            if op in line and "get-tuple-element" not in line:
+                found = re.findall(r"([a-z0-9]+)\[\d", line.split(op)[0])
+                if found:
+                    dts.update(found)
+                    count += 1
+    return dts, count
+
+
+@pytest.mark.parametrize("accum", [None, "bfloat16"])
+def test_grad_reduce_is_fp32_wire(eight_devices, accum):
+    """Exact fp32 reduction regardless of accumulator dtype."""
+    dts, count = _grad_reduces(_hlo(gas=1, grad_accum_dtype=accum))
+    assert count >= 1
+    assert dts == {"f32"}, (accum, dts)
+
+
+@pytest.mark.parametrize("gas", [1, 4])
+def test_grad_reduce_once_per_step_not_per_micro(eight_devices, gas):
+    """The combined gradient all-reduce count must not scale with gas:
+    partial grads accumulate locally through the scan and reduce once
+    at the boundary (the reference's is_gradient_accumulation_boundary
+    contract, runtime/engine.py:2104)."""
+    _, count = _grad_reduces(_hlo(gas=gas))
+    assert count == 1, (gas, count)
