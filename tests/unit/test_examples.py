@@ -27,6 +27,7 @@ def test_every_example_is_covered():
 
 # example -> max seconds (CPU mesh; generous 3x headroom over measured)
 RUNNABLE = {
+    "autotune_train_config.py": 600,
     "compress_prune_export.py": 120,
     "lora_finetune.py": 180,
     "moe_pipeline_3d.py": 300,
