@@ -1,0 +1,80 @@
+"""Long-context training with Ulysses sequence parallelism (reference:
+``deepspeed/sequence/layer.py`` DistributedAttention + the
+deepspeed-ulysses blog recipe) and the ring-attention alternative.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context_ulysses.py
+
+Trains a Llama block stack with the sequence dimension sharded over a
+4-way ``seq`` mesh axis (x 2-way data): attention runs through the
+head<->sequence all-to-all pair, so each device holds 1/4 of every
+sequence while attention still sees full context. Then checks the
+ring-attention path (ppermute ring over the same axis — the
+capability DeepSpeed points at FPDT for) against dense attention.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import hcache_deepspeed_tpu as hds  # noqa: E402
+from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM,  # noqa: E402
+                                               llama_tiny)
+from hcache_deepspeed_tpu.ops.flash_attention import (  # noqa: E402
+    reference_attention)
+from hcache_deepspeed_tpu.parallel import topology as topo_mod  # noqa: E402
+from hcache_deepspeed_tpu.sequence.layer import (  # noqa: E402
+    make_ulysses_attention_fn)
+from hcache_deepspeed_tpu.sequence.ring import ring_attention  # noqa: E402
+
+
+def main():
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(seq=4, data=2))
+    print("mesh:", topo.mesh)
+
+    # --- Ulysses: engine training with the seq axis live
+    cfg = llama_tiny(n_head=4, n_kv_head=4, max_positions=256)
+    model = LlamaForCausalLM(
+        cfg, attention_fn=make_ulysses_attention_fn(topology=topo))
+    rng = np.random.default_rng(0)
+    seq_len = 256   # 4x a single device's 64-token share
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (4, seq_len),
+                                       dtype=np.int32)}
+    engine, _, _, _ = hds.initialize(
+        model=model,
+        config={
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 2, "min_shard_size": 1},
+        },
+        example_batch=batch, topology=topo)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    print("ulysses seq=4 losses:", [round(l, 4) for l in losses])
+    assert losses[-1] < losses[0]
+
+    # --- Ring attention over the same axis: ppermute ring, full-context
+    # math, O(T/sp) resident keys — parity vs dense attention
+    B, T, H, D = 2, 128, 4, 32
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    dense = reference_attention(q, k, v, causal=True)
+
+    ring = jax.jit(lambda *a: ring_attention(
+        *a, causal=True, topology=topo))(q, k, v)
+    err = float(jnp.max(jnp.abs(ring - dense)))
+    print(f"ring-attention max |err| vs dense: {err:.2e}")
+    assert err < 2e-4
+    print("long-context paths verified")
+
+
+if __name__ == "__main__":
+    main()

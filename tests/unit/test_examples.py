@@ -29,6 +29,7 @@ def test_every_example_is_covered():
 RUNNABLE = {
     "autotune_train_config.py": 600,
     "compress_prune_export.py": 120,
+    "long_context_ulysses.py": 300,
     "lora_finetune.py": 180,
     "moe_pipeline_3d.py": 300,
     "pretrain_indexed_gpt2.py": 180,
