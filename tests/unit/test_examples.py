@@ -33,6 +33,7 @@ RUNNABLE = {
     "lora_finetune.py": 180,
     "moe_pipeline_3d.py": 300,
     "pretrain_indexed_gpt2.py": 180,
+    "rlhf_raft_loop.py": 600,
     "serve_fused_decode.py": 180,
     "serve_hcache.py": 180,
     "serve_hf_checkpoint.py": 300,
