@@ -91,6 +91,7 @@ _SLOW_PATH_PARTS = (
     "runtime/test_data_pipeline.py",
     "runtime/test_sparse_domino_elastic.py",
     "runtime/test_indexed_dataset.py",
+    "runtime/test_comm_dtype.py",
     "tests/unit/pipe/",
     "tests/unit/moe/",
     "tests/unit/sequence_parallelism/",
