@@ -10,5 +10,5 @@ from .layer import (DistributedAttention, seq_all_to_all,  # noqa: F401
                     ulysses_attention)
 from .cross_entropy import vocab_sequence_parallel_cross_entropy  # noqa: F401
 from .fpdt import (HostOffloadKV, chunked_attention,  # noqa: F401
-                   chunked_lm_loss)
+                   chunked_lm_loss, make_fpdt_attention_fn)
 from .ring import make_ring_attention_fn, ring_attention  # noqa: F401

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel.topology import get_topology
 from .ring import _block_attention, _merge
 
 
@@ -146,6 +147,36 @@ def offloaded_chunked_attention(q, k, v, causal=True, scale=None,
     v = checkpoint_name(v, "fpdt_kv")
     return chunked_attention(q, k, v, causal=causal, scale=scale,
                              q_chunk=q_chunk, k_chunk=k_chunk, remat=True)
+
+
+def make_fpdt_attention_fn(q_chunk=512, k_chunk=None, remat=True,
+                           topology=None):
+    """``attention_fn`` hook for the model zoo: memory-O(chunk) exact
+    attention, composed with Ulysses over the ``seq`` axis when the
+    topology has one — the FPDT composition (reference:
+    ``sequence/fpdt_layer.py`` = chunked schedule inside the Ulysses
+    all-to-alls). Symmetric with ``make_ulysses_attention_fn`` /
+    ``make_ring_attention_fn``.
+
+    Not GQA-native (the chunk kernel wants dense heads); the model hook
+    and the Ulysses wrapper both consult ``supports_gqa`` and expand
+    compact k/v before calling in."""
+    local = functools.partial(chunked_attention, q_chunk=q_chunk,
+                              k_chunk=k_chunk, remat=remat)
+
+    def attention_fn(q, k, v, causal=True, scale=None):
+        # resolve at CALL time like the sibling factories (layer.py:133,
+        # ring.py:79): a factory built before initialize_topology must
+        # still engage the Ulysses composition on a seq mesh
+        topo = topology or get_topology()
+        if topo is not None and topo.seq_size > 1:
+            from .layer import ulysses_attention
+            return ulysses_attention(q, k, v, causal=causal, scale=scale,
+                                     topology=topo, local_attn=local)
+        return local(q, k, v, causal=causal, scale=scale)
+
+    attention_fn.supports_gqa = False
+    return attention_fn
 
 
 def fpdt_offload_policy(extra_save_names=()):

@@ -112,6 +112,47 @@ class TestChunkedAttention:
                               jnp.asarray(v), q_chunk=16)
 
 
+class TestFpdtAttentionFn:
+    """make_fpdt_attention_fn: the chunked kernel behind the model-zoo
+    attention hook, composed with Ulysses when the mesh has a seq axis
+    (the FPDT composition, reference sequence/fpdt_layer.py)."""
+
+    def test_single_axis_matches_reference(self, eight_devices):
+        from hcache_deepspeed_tpu.sequence import make_fpdt_attention_fn
+        q, k, v = _qkv(T=32)
+        fn = make_fpdt_attention_fn(q_chunk=8)
+        assert not fn.supports_gqa
+        out = jax.jit(lambda *a: fn(*a, causal=True))(q, k, v)
+        ref = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-5)
+
+    def test_engine_trains_with_seq_axis(self, eight_devices):
+        import hcache_deepspeed_tpu as hds
+        from hcache_deepspeed_tpu.models.llama import (LlamaForCausalLM,
+                                                       llama_tiny)
+        from hcache_deepspeed_tpu.sequence import make_fpdt_attention_fn
+        topo = topo_mod.initialize_topology(
+            topo_mod.TopologySpec(data=2, seq=4))
+        cfg = llama_tiny(n_kv_head=4)  # hook expands GQA before the kernel
+        # no topology kwarg: resolution happens at call time via
+        # get_topology(), like the sibling factories
+        model = LlamaForCausalLM(
+            cfg, attention_fn=make_fpdt_attention_fn(q_chunk=8))
+        batch = {"input_ids": np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 32), dtype=np.int32)}
+        engine, _, _, _ = hds.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                    "zero_optimization": {"stage": 1, "min_shard_size": 1}},
+            example_batch=batch, topology=topo)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+
 class TestChunkedLoss:
 
     def test_matches_dense_loss(self):
