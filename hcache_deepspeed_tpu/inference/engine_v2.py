@@ -238,11 +238,21 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     @_annotated("hds.serve.put")
     def put(self, batch_uids: Iterable[int],
-            batch_tokens: Iterable, do_checks: bool = True):
+            batch_tokens: Iterable, do_checks: bool = True,
+            defer_fetch: bool = False):
         """One forward over a ragged batch. Returns
         ``(logits [n_seqs, vocab], latents)`` where ``latents[i]`` is the
         per-sequence host array [L, new_tokens, H] (None when HCache latent
-        capture is disabled)."""
+        capture is disabled).
+
+        ``defer_fetch=True`` skips every device→host fetch: calls then
+        chain on-device without a host sync per dispatch (the
+        marginal-cost measurement mode; plain path only — incompatible
+        with latent capture, prefix caching and chunked prefill). The
+        logits return is then a per-uid list of ``(device_array, lane)``
+        pairs — ``np.asarray(device_array)[lane]`` is that uid's row;
+        sequences dispatched in one group share the same padded device
+        array."""
         batch_uids = list(batch_uids)
         batch_tokens = [np.asarray(t, np.int32).reshape(-1)
                         for t in batch_tokens]
@@ -254,6 +264,12 @@ class InferenceEngineV2:
             if result != SchedulingResult.Success:
                 raise SchedulingError(result)
         self._reject_suspended(batch_uids)
+        if defer_fetch and (self.prefix_caching or
+                            self.config.hcache.enable_latents or
+                            self.config.state_manager.prefill_chunk):
+            raise ValueError(
+                "defer_fetch supports only the plain put() path (no "
+                "prefix caching, latent capture, or chunked prefill)")
         if self.prefix_caching:
             # two-wave in-batch dedup: a new prompt that could share a
             # prefix with an EARLIER new prompt in this same call defers
@@ -336,7 +352,7 @@ class InferenceEngineV2:
 
         if decode_idx:
             self._run_decode(batch_uids, batch_tokens, decode_idx,
-                             logits_out, latents_out)
+                             logits_out, latents_out, defer=defer_fetch)
         # prefills batch per length bucket: one dispatch per (B, T)
         # bucket instead of one jit call per sequence (round-1 latency
         # hygiene finding; reference batches prefills in one ragged pass)
@@ -345,7 +361,7 @@ class InferenceEngineV2:
             groups.setdefault(_bucket(len(batch_tokens[i])), []).append(i)
         for T, idx in sorted(groups.items()):
             self._run_prefill(batch_uids, batch_tokens, idx, T,
-                              logits_out, latents_out)
+                              logits_out, latents_out, defer=defer_fetch)
 
         for uid in batch_uids:
             self.state.get_sequence(uid).post_forward()
@@ -362,6 +378,8 @@ class InferenceEngineV2:
                     else []
                 latents_out[i] = np.concatenate(parts + tail, axis=1)
 
+        if defer_fetch:
+            return logits_out, latents_out
         return np.stack(logits_out), latents_out
 
     def _tables(self, idx, uids):
@@ -380,7 +398,8 @@ class InferenceEngineV2:
         tables[:, 0] = self._scratch_block
         return tok, start, t_len, tables
 
-    def _run_decode(self, uids, tokens, idx, logits_out, latents_out):
+    def _run_decode(self, uids, tokens, idx, logits_out, latents_out,
+                    defer=False):
         B = _bucket(len(idx))
         tok, start, t_len, tables = self._blank_lanes(B)
         tables[:len(idx)] = self._tables(idx, uids)
@@ -390,6 +409,10 @@ class InferenceEngineV2:
             t_len[j] = 1
         logits, latents = self.model.forward_chunk(self.cache, tok, start,
                                                    tables, t_len)
+        if defer:   # keep the device array whole (row slicing here would
+            for j, i in enumerate(idx):   # dispatch an op per lane) —
+                logits_out[i] = (logits, j)   # every uid gets its lane
+            return
         logits = np.asarray(logits)
         if self.config.hcache.enable_latents:
             latents = np.asarray(latents)      # [L, B, 1, H] -> host
@@ -398,7 +421,8 @@ class InferenceEngineV2:
             if self.config.hcache.enable_latents:
                 latents_out[i] = latents[:, j]
 
-    def _run_prefill(self, uids, tokens, idx, T, logits_out, latents_out):
+    def _run_prefill(self, uids, tokens, idx, T, logits_out, latents_out,
+                     defer=False):
         """One batched dispatch for all prefills in a length bucket;
         padded rows (t_len=0) write to the scratch block like padded
         decode lanes."""
@@ -412,6 +436,10 @@ class InferenceEngineV2:
             t_len[j] = len(tokens[i])
         logits, latents = self.model.forward_chunk(self.cache, tok, start,
                                                    tables, t_len)
+        if defer:
+            for j, i in enumerate(idx):
+                logits_out[i] = (logits, j)
+            return
         logits = np.asarray(logits)
         if self.config.hcache.enable_latents:
             latents = np.asarray(latents)      # [L, B, T, H]
@@ -951,27 +979,41 @@ class InferenceEngineV2:
         for item in items:
             groups.setdefault(_bucket(len(item[1])), []).append(item)
         for T, group in sorted(groups.items()):
-            # lane count buckets too: each distinct n would otherwise
-            # shape-specialize (and recompile) the restore chain
-            n = _bucket(len(group), minimum=1)
-            L = group[0][2].shape[0]
-            H = group[0][2].shape[2]
-            lat = np.zeros((L, n, T, H), group[0][2].dtype)
-            _, start, t_len, tables = self._blank_lanes(n)
-            seqs = []
-            for j, (uid, tokens, latents) in enumerate(group):
-                seq = self.state.get_or_create_sequence(uid)
-                self.state.maybe_allocate_kv(seq, len(tokens))
-                seq.pre_forward(len(tokens))
-                lat[:, j, :len(tokens)] = latents
-                start[j] = seq.seen_tokens
-                t_len[j] = len(tokens)
-                tables[j] = self.state.block_table(
-                    seq, self.max_blocks_per_seq)
-                seqs.append(seq)
+            lat, start, t_len, tables, seqs = \
+                self._stage_restore_group(group, T)
             self.model.restore_kv(self.cache, lat, start, tables, t_len)
             for seq in seqs:
                 seq.post_forward()
+
+    def _stage_restore_group(self, group, T=None):
+        """State ops + lane slab for ONE bucket group of
+        ``(uid, tokens, latents)`` items: allocates KV, marks the
+        sequences in-flight (caller must ``post_forward()`` each returned
+        seq after the cache write lands) and builds the padded latent
+        slab [L, n, T, H] with its lane metadata. Shared by
+        ``restore_kv`` and the marginal-cost benchmark so both time the
+        same compiled program."""
+        if T is None:
+            T = _bucket(max(len(it[1]) for it in group))
+        # lane count buckets too: each distinct n would otherwise
+        # shape-specialize (and recompile) the restore chain
+        n = _bucket(len(group), minimum=1)
+        L = group[0][2].shape[0]
+        H = group[0][2].shape[2]
+        lat = np.zeros((L, n, T, H), group[0][2].dtype)
+        _, start, t_len, tables = self._blank_lanes(n)
+        seqs = []
+        for j, (uid, tokens, latents) in enumerate(group):
+            seq = self.state.get_or_create_sequence(uid)
+            self.state.maybe_allocate_kv(seq, len(tokens))
+            seq.pre_forward(len(tokens))
+            lat[:, j, :len(tokens)] = latents
+            start[j] = seq.seen_tokens
+            t_len[j] = len(tokens)
+            tables[j] = self.state.block_table(
+                seq, self.max_blocks_per_seq)
+            seqs.append(seq)
+        return lat, start, t_len, tables, seqs
 
     # -------------------------------------------------------------- #
     # Prefix caching (no reference analog — FastGen lacks it): full KV

@@ -404,6 +404,61 @@ class TestRestoreChunking:
             np.asarray(dec_b[0], np.float32),
             np.asarray(dec_a[0], np.float32), atol=0.15)
 
+    def test_staged_device_latents_restore(self, tiny_model):
+        """model.restore_kv on an HBM-resident ``jax.Array`` slab (no
+        H2D ship — the hybrid-engine handoff / marginal-bench path)
+        matches the host-latents path."""
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(15)
+        prompt = list(rng.integers(0, cfg.vocab_size, (11,)))
+
+        engine_a = make_engine(cfg, params)
+        logits_a, latents = engine_a.put([1], [prompt])
+        nxt = int(np.argmax(logits_a[0]))
+        dec_a, _ = engine_a.put([1], [[nxt]])
+
+        engine_b = make_engine(cfg, params)
+        # the engine's own group staging, then the model-level call on
+        # an HBM-resident slab (exactly the marginal-bench sequence)
+        items = [(1, np.asarray(prompt, np.int32),
+                  np.asarray(latents[0]))]
+        lat, start, t_len, tables, seqs = \
+            engine_b._stage_restore_group(items)
+        engine_b.model.restore_kv(engine_b.cache, jax.device_put(lat),
+                                  start, tables, t_len)
+        for seq in seqs:
+            seq.post_forward()
+        assert engine_b.state.get_sequence(1).seen_tokens == len(prompt)
+        dec_b, _ = engine_b.put([1], [[nxt]])
+        np.testing.assert_allclose(dec_b[0], dec_a[0], atol=2e-2)
+
+    def test_defer_fetch_put(self, tiny_model):
+        """put(defer_fetch=True) returns raw device logits (no host
+        sync) that match the normal path; incompatible modes reject."""
+        cfg, model, params = tiny_model
+        rng = np.random.default_rng(16)
+        prompt = list(rng.integers(0, cfg.vocab_size, (9,)))
+
+        prompt2 = list(rng.integers(0, cfg.vocab_size, (9,)))
+        engine = make_engine(cfg, params,
+                             hcache={"enable_latents": False})
+        ref, _ = engine.put([1, 2], [prompt, prompt2])
+        engine.flush(1)
+        engine.flush(2)
+        logits_out, _ = engine.put([1, 2], [prompt, prompt2],
+                                   defer_fetch=True)
+        assert all(x is not None for x in logits_out)
+        for i in range(2):   # every uid resolves to its own lane
+            arr, lane = logits_out[i]
+            assert isinstance(arr, jax.Array)
+            np.testing.assert_allclose(np.asarray(arr)[lane], ref[i],
+                                       atol=2e-2)
+
+        # latent capture on -> the plain-path guard rejects
+        engine_lat = make_engine(cfg, params)
+        with pytest.raises(ValueError, match="defer_fetch"):
+            engine_lat.put([2], [prompt], defer_fetch=True)
+
     def test_restore_admission_is_atomic(self, tiny_model):
         """A restore that cannot fully fit must not touch any state."""
         cfg, model, params = tiny_model

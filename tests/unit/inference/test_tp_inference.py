@@ -85,6 +85,29 @@ class TestTPInference:
         np.testing.assert_allclose(np.asarray(l_direct),
                                    np.asarray(l_restored), atol=2e-4)
 
+    def test_staged_latents_reshard_onto_cache_mesh(self, tp_topo):
+        """A staged (jax.Array) latent slab committed to a SINGLE device
+        must be resharded onto the sharded cache's mesh, not handed to
+        the jitted restore as-is (incompatible committed devices)."""
+        cfg, params = _setup()
+        tp = _engine(cfg, params, topology=tp_topo)
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, 256, (20,), dtype=np.int32).tolist()
+        logits, latents = tp.put([5], [prompt])
+        tok = int(np.argmax(np.asarray(logits)[0]))
+        l_direct, _ = tp.put([5], [[tok]])
+        tp.flush(5)
+        items = [(5, np.asarray(prompt, np.int32),
+                  np.asarray(latents[0]))]
+        lat, start, t_len, tables, seqs = tp._stage_restore_group(items)
+        slab = jax.device_put(lat, jax.devices()[0])   # one device only
+        tp.model.restore_kv(tp.cache, slab, start, tables, t_len)
+        for seq in seqs:
+            seq.post_forward()
+        l_restored, _ = tp.put([5], [[tok]])
+        np.testing.assert_allclose(np.asarray(l_direct),
+                                   np.asarray(l_restored), atol=2e-4)
+
     def test_indivisible_heads_rejected(self, tp_topo):
         cfg, params = _setup()
         import dataclasses
