@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Round-4 continuation queue 3: decode-cost decomposition (why is fused
+# decode ~40x above the HBM floor?), the marginal-cost HCache restore
+# story (device replay vs link ship), and a fresh BENCH point.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 180 python -c "
+import jax, jax.numpy as jnp, random
+n = random.randrange(130, 510)
+x = jnp.ones((n, 257))
+assert jax.devices('tpu')
+float(jax.jit(lambda a: (a @ a.T).sum())(x))" >/dev/null 2>&1
+}
+probe || { echo "relay DOWN; aborting" >&2; exit 3; }
+echo "relay UP at $(date -u +%H:%M:%S)" >&2
+
+echo "=== decode-diag 1b" >&2
+timeout 2400 python bin/hds_decode_diag --model 1b | tee DECODE_DIAG_1B.jsonl
+echo "=== decode-diag rc=$?" >&2
+
+echo "=== restore-marginal 1b (bf16)" >&2
+timeout 2400 python bin/hds_serve_bench --model 1b --restore-marginal \
+  --prompt-len 128 --batches 1 4 | tee RESTORE_1B_MARGINAL.jsonl
+echo "=== restore-marginal rc=$?" >&2
+
+echo "=== restore-marginal 1b (fp8 latents)" >&2
+timeout 2400 python bin/hds_serve_bench --model 1b --restore-marginal \
+  --latent-dtype float8_e4m3fn --prompt-len 128 --batches 1 4 \
+  | tee RESTORE_1B_MARGINAL_FP8.jsonl
+echo "=== restore-marginal-fp8 rc=$?" >&2
+
+echo "=== fresh bench" >&2
+timeout 3000 python bench.py | tee BENCH_FRESH.json
+echo "=== bench rc=$?" >&2
+
+echo "chip_queue5 done" >&2
