@@ -75,10 +75,10 @@ def reference_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
 # Pallas kernel
 # ------------------------------------------------------------------ #
 def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
-            q_ref, k_ref, v_ref,                 # [1,1,TGp,D], [1,1,BS,D]
-            o_ref,                               # [1,1,TGp,D]
+            q_ref, k_ref, v_ref,                 # [1,KVT,TGp,D], [KVT,1,BS,D]
+            o_ref,                               # [1,KVT,TGp,D]
             acc, m_s, l_s,                       # VMEM scratch
-            *, scale, G, BS, TGp):
+            *, scale, G, BS, TGp, KVT):
     b, nb = pl.program_id(0), pl.program_id(2)
     nblocks = pl.num_programs(2)
 
@@ -94,37 +94,54 @@ def _kernel(tables_ref, kvlen_ref, start_ref,    # scalar prefetch
 
     @pl.when(run)
     def _body():
+        # KVT kv heads per grid step: one batched MXU call and one
+        # [KVT*BS, D]-sized DMA instead of KVT tiny steps — the grid
+        # count (not FLOPs) is what dominates decode-shape cost
+        q = q_ref[0]                                         # [KVT,TGp,D]
+        k = k_ref[:, 0].astype(q.dtype)                      # [KVT,BS,D]
         # matmuls stay in the input dtype (bf16 MXU rate) with fp32
         # accumulation — an fp32 upcast here runs at ~1/8 peak
-        q = q_ref[0, 0]                                      # [TGp, D]
-        k = k_ref[0, 0].astype(q.dtype)                      # [BS, D]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [TGp, BS]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale      # [KVT,TGp,BS]
         rows = jax.lax.broadcasted_iota(jnp.int32, (TGp, BS), 0)
         cols = nb * BS + jax.lax.broadcasted_iota(jnp.int32, (TGp, BS), 1)
         row_pos = start + rows // G
         ok = (cols <= row_pos) & (cols < kvlen)
-        s = jnp.where(ok, s, _NEG_INF)
-        m_prev = m_s[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        s = jnp.where(ok[None], s, _NEG_INF)
+        m_prev = m_s[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
-        l_s[:, :1] = corr * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        m_s[:, :1] = m_new
-        v = v_ref[0, 0]                                      # [BS, D]
-        acc[:] = acc[:] * corr + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        l_s[:, :, :1] = corr * l_s[:, :, :1] + \
+            jnp.sum(p, axis=2, keepdims=True)
+        m_s[:, :, :1] = m_new
+        v = v_ref[:, 0]                                      # [KVT,BS,D]
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
 
     @pl.when(nb == nblocks - 1)
     def _out():
-        l = l_s[:, :1]
+        l = l_s[:, :, :1]
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+
+
+def _pick_head_tile(KV, TGp, D, BS, itemsize, budget=6 * 2**20):
+    """Largest divisor of KV whose per-step VMEM footprint (q/o tiles,
+    double-buffered k/v tiles, fp32 scratch) stays under ``budget``."""
+    per_head = (2 * TGp * D * itemsize          # q + o
+                + 2 * 2 * BS * D * itemsize     # k, v double-buffered
+                + TGp * D * 4                   # acc
+                + 2 * TGp * 128 * 4)            # m, l
+    cap = max(budget // per_head, 1)
+    return max(kvt for kvt in range(1, KV + 1)
+               if KV % kvt == 0 and kvt <= cap)
 
 
 def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
-                           block_size, interpret=None):
+                           block_size, interpret=None, head_tile=0):
     if interpret is None:
         from ..platform import get_platform
         interpret = not get_platform().supports_pallas()
@@ -143,37 +160,43 @@ def pallas_paged_attention(q, k_pool, v_pool, tables, start, kv_len,
     if TGp != TG:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, TGp - TG), (0, 0)))
 
+    KVT = head_tile or _pick_head_tile(KV, TGp, D, BS, q.dtype.itemsize)
+    if KV % KVT:
+        # a non-divisor tile would floor-divide the grid and silently
+        # leave the uncovered heads' output blocks unwritten
+        raise ValueError(f"head_tile={KVT} must divide kv heads ({KV})")
+
     kp = k_pool.reshape(KV, NBLK, BS, D)
     vp = v_pool.reshape(KV, NBLK, BS, D)
     tables = jnp.asarray(tables, jnp.int32)
     kv_len = jnp.asarray(kv_len, jnp.int32)
     start = jnp.asarray(start, jnp.int32)
 
-    def page_index(b, h, nb, tables_ref, kvlen_ref, start_ref):
+    def page_index(b, kh, nb, tables_ref, kvlen_ref, start_ref):
         # clamp out-of-range slots to the last valid block: repeated block
         # index ⇒ Pallas skips the DMA, so dead slots cost nothing
         last = jnp.maximum(kvlen_ref[b] - 1, 0) // BS
-        return (h, tables_ref[b, jnp.minimum(nb, last)], 0, 0)
+        return (kh, tables_ref[b, jnp.minimum(nb, last)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, KV, NB),
+        grid=(B, KV // KVT, NB),
         in_specs=[
-            pl.BlockSpec((1, 1, TGp, D),
-                         lambda b, h, nb, *refs: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, BS, D), page_index),
-            pl.BlockSpec((1, 1, BS, D), page_index),
+            pl.BlockSpec((1, KVT, TGp, D),
+                         lambda b, kh, nb, *refs: (b, kh, 0, 0)),
+            pl.BlockSpec((KVT, 1, BS, D), page_index),
+            pl.BlockSpec((KVT, 1, BS, D), page_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, TGp, D),
-                               lambda b, h, nb, *refs: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, KVT, TGp, D),
+                               lambda b, kh, nb, *refs: (b, kh, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((TGp, D), jnp.float32),
-            pltpu.VMEM((TGp, 128), jnp.float32),
-            pltpu.VMEM((TGp, 128), jnp.float32),
+            pltpu.VMEM((KVT, TGp, D), jnp.float32),
+            pltpu.VMEM((KVT, TGp, 128), jnp.float32),
+            pltpu.VMEM((KVT, TGp, 128), jnp.float32),
         ],
     )
     kern = functools.partial(_kernel, scale=1.0 / np.sqrt(D), G=G, BS=BS,
-                             TGp=TGp)
+                             TGp=TGp, KVT=KVT)
     out = pl.pallas_call(
         kern,
         grid_spec=grid_spec,

@@ -82,3 +82,56 @@ class TestPagedAttentionParity:
             start, kvl, BS, interpret=True)
         assert np.all(np.isfinite(np.asarray(pal)))
         assert np.max(np.abs(np.asarray(pal))) < 1e3
+
+
+class TestHeadTiling:
+    """KVT kv heads per grid step (the decode-shape grid-count fix) must
+    be invisible to results for every tile size."""
+
+    @pytest.mark.parametrize("head_tile", [1, 2, 4, 0])   # 0 = adaptive
+    def test_tile_sizes_agree(self, head_tile):
+        rng = np.random.default_rng(5)
+        B, T, Hq, KV, D, BS, NBLK, NB = 3, 1, 8, 4, 64, 16, 32, 8
+        q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)),
+                         jnp.float32)
+        tables = rng.permutation(NBLK)[:B * NB].reshape(B, NB).astype(
+            np.int32)
+        start = jnp.asarray([0, 40, 99], jnp.int32)
+        kvl = jnp.asarray([1, 41, 100], jnp.int32)
+        ref = reference_paged_attention(q, kp, vp, tables, start, kvl, BS)
+        pal = pallas_paged_attention(q, kp, vp, tables, start, kvl, BS,
+                                     interpret=True, head_tile=head_tile)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   atol=3e-5)
+
+    def test_pick_head_tile(self):
+        from hcache_deepspeed_tpu.ops.paged_attention import \
+            _pick_head_tile
+        # decode shapes fit every head in one step
+        assert _pick_head_tile(32, 8, 64, 64, 2) == 32
+        # must divide KV
+        assert 24 % _pick_head_tile(24, 8, 64, 64, 2) == 0
+        # large prefill tiles shrink under the budget but stay >= 1
+        kvt = _pick_head_tile(32, 512, 128, 64, 2)
+        assert 1 <= kvt <= 32 and 32 % kvt == 0
+        per_head = (2 * 512 * 128 * 2 + 2 * 2 * 64 * 128 * 2
+                    + 512 * 128 * 4 + 2 * 512 * 128 * 4)
+        assert kvt * per_head <= 6 * 2**20
+
+    def test_non_divisor_head_tile_rejected(self):
+        rng = np.random.default_rng(6)
+        B, T, Hq, KV, D, BS, NBLK, NB = 1, 1, 4, 4, 32, 8, 8, 2
+        q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+        kp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.standard_normal((KV, NBLK * BS, D)),
+                         jnp.float32)
+        tables = np.zeros((B, NB), np.int32)
+        with pytest.raises(ValueError, match="head_tile"):
+            pallas_paged_attention(q, kp, vp, tables,
+                                   jnp.asarray([0], jnp.int32),
+                                   jnp.asarray([1], jnp.int32), BS,
+                                   interpret=True, head_tile=3)
