@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Round-4 continuation queue 4: end-to-end serving with the head-tiled
+# paged-attention kernel (1B fused decode + throughput-latency sweep,
+# 7B int8 fused), and slope-based decode diagnostics at 1B and 7B-int8
+# (decomposing the 347 ms/step 7B decode).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 180 python -c "
+import jax, jax.numpy as jnp, random
+n = random.randrange(130, 510)
+x = jnp.ones((n, 257))
+assert jax.devices('tpu')
+float(jax.jit(lambda a: (a @ a.T).sum())(x))" >/dev/null 2>&1
+}
+probe || { echo "relay DOWN; aborting" >&2; exit 3; }
+echo "relay UP at $(date -u +%H:%M:%S)" >&2
+
+echo "=== serve 1b fused (new kernel)" >&2
+timeout 2400 python bin/hds_serve_bench --model 1b --max-context 512 \
+  --prompt-len 128 --decode-steps 32 --batches 1 8 --fused-decode \
+  | tee SERVE_1B_FUSED_V2.jsonl
+echo "=== serve-1b rc=$?" >&2
+
+echo "=== decode-diag 1b (slope)" >&2
+timeout 2400 python bin/hds_decode_diag --model 1b \
+  | tee DECODE_DIAG_1B.jsonl
+echo "=== diag-1b rc=$?" >&2
+
+echo "=== sweep 1b fused (new kernel)" >&2
+timeout 3000 python bin/hds_serve_bench --model 1b --sweep --fused-decode \
+  --max-context 512 --prompt-len 128 --max-new 32 --rps 2 4 8 \
+  --n-requests 16 --max-batch 8 | tee SWEEP_1B_FUSED_V2.jsonl
+echo "=== sweep-1b rc=$?" >&2
+
+echo "=== serve 7b int8 fused (new kernel)" >&2
+timeout 3300 python bin/hds_serve_bench --model 7b --quantize int8 \
+  --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+  --prefill-chunk 64 --fused-decode | tee SERVE_7B_INT8_FUSED_V2.jsonl
+echo "=== serve-7b rc=$?" >&2
+
+echo "=== decode-diag 7b int8 (slope)" >&2
+timeout 3300 python bin/hds_decode_diag --model 7b --quantize int8 \
+  | tee DECODE_DIAG_7B_INT8.jsonl
+echo "=== diag-7b rc=$?" >&2
+
+echo "chip_queue6 done" >&2
