@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Round-4 continuation queue 5: the 7B int8 decode fix attempt — route
+# decode matvecs through the fused int8-weight Pallas kernel
+# (--quantize fused) instead of dequant-then-matmul, whose measured
+# marginal is 253 ms/token; plus the decomposition diags (int8 floors
+# now run before the OOM-prone dense floor) and the 1B floor rerun.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 180 python -c "
+import jax, jax.numpy as jnp, random
+n = random.randrange(130, 510)
+x = jnp.ones((n, 257))
+assert jax.devices('tpu')
+float(jax.jit(lambda a: (a @ a.T).sum())(x))" >/dev/null 2>&1
+}
+probe || { echo "relay DOWN; aborting" >&2; exit 3; }
+echo "relay UP at $(date -u +%H:%M:%S)" >&2
+
+echo "=== serve 7b FUSED-int8 fused-decode" >&2
+timeout 3300 python bin/hds_serve_bench --model 7b --quantize fused \
+  --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+  --prefill-chunk 64 --fused-decode | tee SERVE_7B_QFUSED.jsonl
+echo "=== serve-7b-qfused rc=$?" >&2
+
+echo "=== decode-diag 1b (fixed floors)" >&2
+timeout 2400 python bin/hds_decode_diag --model 1b --quantize int8 \
+  | tee DECODE_DIAG_1B_INT8.jsonl
+echo "=== diag-1b rc=$?" >&2
+
+echo "=== decode-diag 7b fused-int8" >&2
+timeout 3300 python bin/hds_decode_diag --model 7b --quantize fused \
+  | tee DECODE_DIAG_7B_QFUSED.jsonl
+echo "=== diag-7b-qfused rc=$?" >&2
+
+echo "chip_queue7 done" >&2
