@@ -122,16 +122,10 @@ class PagedInferenceModel:
         self.tp = topology.tensor_size if topology is not None else 1
         self.quantization = quantization if (
             quantization is not None and quantization.enabled) else None
-        if self.quantization and self.tp > 1 \
-                and not self.quantization.use_fused_kernel:
-            # the dequant-mode batched layout quantizes each layer's FLAT
-            # stream — groups cross the would-be shard boundary. The
-            # fused layout's k-groups run down K per column, so col/row
-            # shards stay group-pure.
-            raise NotImplementedError(
-                "tensor-parallel quantized serving requires "
-                "quantization.use_fused_kernel=true (the dequant-mode "
-                "flat groups straddle shard boundaries)")
+        # TP + quantization works in both int8 modes: trunk kernels use
+        # the k-major MatmulQuantizedTensor layout whose groups run down
+        # K per column, so col/row shards stay group-pure (the former
+        # flat-layout TP rejection no longer applies).
 
         self.tied = cfg.tie_word_embeddings
         if self.tp > 1:
@@ -217,40 +211,47 @@ class PagedInferenceModel:
 
     def _maybe_quantize(self, tree):
         qc = self.quantization
-        if not (qc and qc.use_fused_kernel):
+        if not qc:
             return maybe_quantize_serving_params(tree, qc)
-        # fused mode: stacked [L, K, N] projection kernels become
-        # MatmulQuantizedTensor (consumed in-place by the fused kernel
-        # via _mm; NOT dequantized by the scan step); everything else
-        # follows the dequant-on-use path
+        # Stacked [L, K, N] projection kernels become
+        # MatmulQuantizedTensor in BOTH int8 modes (consumed by _mm:
+        # the fused Pallas kernel, or a k-major grouped-view dequant
+        # XLA fuses into the dot; NOT dequantized by the scan step).
+        # The flat-layout QuantizedTensor dequant lowers to a
+        # reshape/slice chain that materializes full-precision copies —
+        # measured 41.7 vs 3.1 ms/token at 1B decode. Non-trunk leaves
+        # (embed/head) follow the flat dequant-on-use path.
         from ..ops.quantized_matmul import MatmulQuantizedTensor
 
         names = self._COL_NAMES + self._ROW_NAMES
 
         def fused(path, leaf):
+            # shape checks on the leaf as-is: a host (numpy) leaf must
+            # NOT be shipped whole — make_batched streams it to the
+            # device one layer at a time (a 7B stacked leaf's one-shot
+            # fp32 group view OOMs a 16 GB chip)
             joined = join_path(path)
-            leaf_a = jnp.asarray(leaf)
             if not (path and str(getattr(path[0], "key",
                                          path[0])) == "layers"
-                    and leaf_a.ndim == 3
+                    and getattr(leaf, "ndim", 0) == 3
                     and any(n in joined for n in names)
                     and joined.endswith("kernel")
-                    and leaf_a.shape[-2] % qc.group_size == 0
-                    and leaf_a.size >= qc.min_size):
+                    and leaf.shape[-2] % qc.group_size == 0
+                    and leaf.size >= qc.min_size):
                 return leaf
             if self.tp > 1:
                 # shard-alignment: col shards split N (scales follow);
                 # row shards split K and its group dim, so the local K
                 # must stay a group multiple. Misaligned leaves stay
                 # full precision (sharded by the name rules as usual).
-                K, N = leaf_a.shape[-2], leaf_a.shape[-1]
+                K, N = leaf.shape[-2], leaf.shape[-1]
                 if any(n in joined for n in self._ROW_NAMES):
                     if K % self.tp or (K // self.tp) % qc.group_size:
                         return leaf
                 elif N % self.tp:
                     return leaf
-            return MatmulQuantizedTensor.make(
-                leaf_a, group_k=qc.group_size, num_bits=qc.bits)
+            return MatmulQuantizedTensor.make_batched(
+                leaf, group_k=qc.group_size, num_bits=qc.bits)
         tree = jax.tree_util.tree_map_with_path(fused, tree)
         if self.tp > 1:
             # non-layer leaves (untied head) would quantize in the FLAT
@@ -259,13 +260,22 @@ class PagedInferenceModel:
             return tree
         return maybe_quantize_serving_params(tree, qc)
 
-    @staticmethod
-    def _mm(x, w):
-        """Matmul that transparently routes fused-quantized weights
-        through the int8 Pallas kernel."""
-        from ..ops.quantized_matmul import MatmulQuantizedTensor
+    def _mm(self, x, w):
+        """Matmul that transparently routes k-major-quantized weights:
+        through the int8 Pallas kernel (``use_fused_kernel``), or the
+        grouped-view dequant that XLA fuses into the dot (plain int8 —
+        measured at the int8 bandwidth floor, unlike the flat-layout
+        reshape chain ``QuantizedTensor.dequantize`` lowers to)."""
+        from ..ops.quantized_matmul import (MatmulQuantizedTensor,
+                                            reference_quantized_matmul)
         if isinstance(w, MatmulQuantizedTensor):
-            return w.matmul(x)
+            if self.quantization and self.quantization.use_fused_kernel:
+                return w.matmul(x)
+            lead = x.shape[:-1]
+            out = reference_quantized_matmul(
+                x.reshape(-1, x.shape[-1]), w.q, w.scale,
+                group_k=w.group_k)
+            return out.reshape(*lead, w.q.shape[-1])
         return x @ w
 
     @staticmethod

@@ -47,8 +47,9 @@ class PagedMoEModel(PagedInferenceModel):
         quant = kw.get("quantization")
         if topo is not None and topo.tensor_size > 1 and quant is not None \
                 and quant.enabled:
-            # raise the accurate family-level message BEFORE the base
-            # class suggests use_fused_kernel (which would not help here)
+            # the ONLY rejection of TP+quantization (the base class
+            # supports both int8 modes under TP via the k-major trunk
+            # layout; expert stacks have no shard-aligned grouping)
             raise NotImplementedError(
                 "tensor-parallel quantized serving is not available for "
                 "the MoE family (expert-stack quantization groups are "

@@ -67,6 +67,26 @@ class MatmulQuantizedTensor:
                                        num_bits=num_bits)
         return cls(q, scale, group_k)
 
+    @classmethod
+    def make_batched(cls, w, group_k=256, num_bits=8):
+        """Quantize a stacked ``[L, K, N]`` weight LAYER BY LAYER: the
+        fp32 group view inside ``quantize_for_matmul`` is transient per
+        layer instead of for the whole stack — a 7B stacked MLP leaf's
+        one-shot view needs >10 GB of HBM (observed OOM on a 16 GB
+        v5e). Host (numpy) inputs additionally stream one ~200 MB layer
+        at a time instead of landing on device whole (mirrors
+        ``QuantizedTensor.make_batched``)."""
+        qs, scales = [], []
+        for layer in range(w.shape[0]):
+            # one explicit H2D per layer: quantize_for_matmul on a host
+            # slice would transfer its fp32 view twice (max, then round)
+            q, s = quantize_for_matmul(jnp.asarray(w[layer]),
+                                       group_k=group_k,
+                                       num_bits=num_bits)
+            qs.append(q)
+            scales.append(s)
+        return cls(jnp.stack(qs), jnp.stack(scales), group_k)
+
     def matmul(self, x):
         """x: [..., K] -> [..., N] through the fused kernel (per-layer
         2D q only — slice the stack first)."""
@@ -96,7 +116,11 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, block_k, group_k):
 
     x = x_ref[0]                        # [block_m, block_k]
     qt = q_ref[0]                       # [block_k, block_n] int8
-    s = s_ref[0]                        # [block_k//group_k, block_n]
+    # s_ref carries ALL G group rows (a [block_k//group_k, block_n]
+    # tile has a sublane dim of 1 when block_k == group_k, which Mosaic
+    # refuses to lower); slice this k-block's rows in VMEM
+    sg = block_k // group_k
+    s = jax.lax.dynamic_slice_in_dim(s_ref[0], ki * sg, sg, 0)
     # dequantize the weight tile in VMEM, then one MXU dot
     w = qt.astype(x.dtype) * jnp.repeat(
         s, group_k, axis=0, total_repeat_length=qt.shape[0]).astype(x.dtype)
@@ -128,7 +152,7 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
         # crashes Mosaic; see the same guard in flash_attention.py)
         return reference_quantized_matmul(x, q, scale, group_k=group_k)
     grid = (M // block_m, N // block_n, K // block_k)
-    sg = block_k // group_k
+    G = K // group_k
     kern = functools.partial(_qmm_kernel, block_k=block_k, group_k=group_k)
     return pl.pallas_call(
         kern,
@@ -138,8 +162,12 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
                          lambda mi, ni, ki: (0, mi, ki)),
             pl.BlockSpec((1, block_k, block_n),
                          lambda mi, ni, ki: (0, ki, ni)),
-            pl.BlockSpec((1, sg, block_n),
-                         lambda mi, ni, ki: (0, ki, ni)),
+            # whole group dim per step (G x block_n x 4B — tens of KB):
+            # a per-k-block scale tile has sublane dim block_k//group_k,
+            # which is 1 in the common block_k == group_k case and
+            # unlowerable; the kernel slices its rows in VMEM
+            pl.BlockSpec((1, G, block_n),
+                         lambda mi, ni, ki: (0, 0, ni)),
         ],
         out_specs=pl.BlockSpec((1, block_m, block_n),
                                lambda mi, ni, ki: (0, mi, ni)),

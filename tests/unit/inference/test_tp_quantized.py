@@ -79,12 +79,26 @@ def test_tp_fused_matches_single_chip_fused(tp_topo, family):
         tok = int(np.argmax(np.asarray(lr)[0]))
 
 
-def test_dequant_mode_tp_rejected(tp_topo):
+def test_dequant_mode_tp_matches_single_chip(tp_topo):
+    """Plain-int8 TP serving (formerly rejected): trunk kernels use the
+    k-major MatmulQuantizedTensor layout in both modes now, so col/row
+    shards stay group-pure and dequant-mode TP logits match the
+    single-chip dequant engine."""
     cfg = llama_tiny(hidden_size=128, intermediate_size=256,
-                     max_positions=128, use_flash=False)
+                     max_positions=128, use_flash=False,
+                     tie_word_embeddings=True)
     params = _init(LlamaForCausalLM(cfg))
-    with pytest.raises(NotImplementedError, match="use_fused_kernel"):
-        _engine(cfg, params, topology=tp_topo, fused=False)
+    ref = _engine(cfg, params, fused=False)
+    tp = _engine(cfg, params, topology=tp_topo, fused=False)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, (12,)).tolist()
+    lr, _ = ref.put([1], [prompt])
+    lt, _ = tp.put([1], [prompt])
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lt), atol=2e-4)
+    tok = int(np.argmax(np.asarray(lr)[0]))
+    lr, _ = ref.put([1], [[tok]])
+    lt, _ = tp.put([1], [[tok]])
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lt), atol=2e-4)
 
 
 def test_moe_tp_quantized_rejected(tp_topo):

@@ -109,16 +109,23 @@ class TestQuantizedServing:
         assert wg.dtype == jnp.float32
 
     def test_weights_stored_int8(self, family):
+        # trunk kernels land in the k-major MatmulQuantizedTensor
+        # layout (both int8 modes); embed/head in the flat
+        # QuantizedTensor layout — all storage must be int8
+        from hcache_deepspeed_tpu.ops.quantized_matmul import \
+            MatmulQuantizedTensor
         cfg, params = self._setup(family)
         engine = _engine(cfg, params, quantized=True)
+        containers = (QuantizedTensor, MatmulQuantizedTensor)
         leaves = jax.tree.leaves(
             engine.model.params,
-            is_leaf=lambda x: isinstance(x, QuantizedTensor))
-        n_q = sum(isinstance(l, QuantizedTensor) for l in leaves)
-        assert n_q > 0
-        for l in leaves:
-            if isinstance(l, QuantizedTensor):
-                assert l.q.dtype == jnp.int8
+            is_leaf=lambda x: isinstance(x, containers))
+        quantized = [l for l in leaves if isinstance(l, containers)]
+        assert len(quantized) > 0
+        assert any(isinstance(l, MatmulQuantizedTensor)
+                   for l in quantized)   # the trunk layout
+        for l in quantized:
+            assert l.q.dtype == jnp.int8
 
     def test_logits_close_to_fp(self, family):
         cfg, params = self._setup(family)

@@ -62,3 +62,23 @@ def test_shape_fallback():
     ref2 = reference_quantized_matmul(x2, q2, scale2, group_k=64)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                atol=1e-5)
+
+
+def test_make_batched_matches_one_shot():
+    """Per-layer streaming quantization (the 7B OOM fix) must produce
+    exactly the one-shot stacked result — including from a host numpy
+    leaf, which streams one layer at a time."""
+    import numpy as onp
+
+    from hcache_deepspeed_tpu.ops.quantized_matmul import \
+        MatmulQuantizedTensor
+    rng = onp.random.default_rng(0)
+    w = rng.standard_normal((3, 64, 48)).astype(onp.float32)
+    one = MatmulQuantizedTensor.make(jnp.asarray(w), group_k=32)
+    for leaf in (jnp.asarray(w), w):          # device and host inputs
+        bat = MatmulQuantizedTensor.make_batched(leaf, group_k=32)
+        onp.testing.assert_array_equal(onp.asarray(bat.q),
+                                       onp.asarray(one.q))
+        onp.testing.assert_allclose(onp.asarray(bat.scale),
+                                    onp.asarray(one.scale), rtol=1e-6)
+        assert bat.group_k == one.group_k
