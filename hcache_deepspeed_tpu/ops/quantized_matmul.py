@@ -101,12 +101,16 @@ class MatmulQuantizedTensor:
 def reference_quantized_matmul(x, q, scale, group_k=256):
     """Numerics oracle: dequantize fully, then matmul."""
     K, N = q.shape
-    w = q.astype(jnp.float32).reshape(K // group_k, group_k, N) \
-        * scale[:, None, :]
-    return x @ w.reshape(K, N).astype(x.dtype)
+    # dequantize straight in the compute dtype: when XLA materializes
+    # the dequantized weight (it does at 7B scale) an fp32 intermediate
+    # would double the HBM bill; int8 * bf16-scale keeps full int8
+    # fidelity (|q| <= 127 is exact in bf16's 8-bit mantissa)
+    w = q.astype(x.dtype).reshape(K // group_k, group_k, N) \
+        * scale[:, None, :].astype(x.dtype)
+    return x @ w.reshape(K, N)
 
 
-def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, block_k, group_k):
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, group_k):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -114,17 +118,20 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, block_k, group_k):
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    x = x_ref[0]                        # [block_m, block_k]
-    qt = q_ref[0]                       # [block_k, block_n] int8
-    # s_ref carries ALL G group rows (a [block_k//group_k, block_n]
-    # tile has a sublane dim of 1 when block_k == group_k, which Mosaic
-    # refuses to lower); slice this k-block's rows in VMEM
-    sg = block_k // group_k
-    s = jax.lax.dynamic_slice_in_dim(s_ref[0], ki * sg, sg, 0)
-    # dequantize the weight tile in VMEM, then one MXU dot
-    w = qt.astype(x.dtype) * jnp.repeat(
-        s, group_k, axis=0, total_repeat_length=qt.shape[0]).astype(x.dtype)
-    acc[:] += jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+    x = x_ref[0]                        # [block_m, group_k]
+    qt = q_ref[0]                       # [group_k, block_n] int8
+    # block_k == group_k, so the whole k-block shares ONE scale row per
+    # column: run the int8 dot raw and scale the OUTPUT. The row is
+    # selected from the full [G, block_n] scale tile by mask-sum —
+    # dynamic_slice does not lower in Mosaic TC kernels, and a
+    # per-k-block scale tile would have an unlowerable sublane dim of 1.
+    G, bn = s_ref.shape[1], s_ref.shape[2]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (G, bn), 0)
+    s_row = jnp.sum(jnp.where(rows == ki, s_ref[0], 0.0), axis=0,
+                    keepdims=True)      # [1, block_n] f32
+    p = jax.lax.dot(x, qt.astype(x.dtype),
+                    preferred_element_type=jnp.float32)
+    acc[:] += p * s_row
 
     @pl.when(ki == nk - 1)
     def _out():
@@ -142,9 +149,8 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
         interpret = not get_platform().supports_pallas()
     block_m = min(block_m, M)
     block_n = min(block_n, N)
-    block_k = min(block_k, K)
+    block_k = group_k   # one scale row per k-block (see _qmm_kernel)
     if (M % block_m or N % block_n or K % block_k
-            or block_k % group_k
             or (not interpret and (block_m % 8 or block_n % 128
                                    or block_k % 128))):
         # block_k is x's lane dim and q's sublane dim — it needs 128
@@ -153,7 +159,7 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
         return reference_quantized_matmul(x, q, scale, group_k=group_k)
     grid = (M // block_m, N // block_n, K // block_k)
     G = K // group_k
-    kern = functools.partial(_qmm_kernel, block_k=block_k, group_k=group_k)
+    kern = functools.partial(_qmm_kernel, group_k=group_k)
     return pl.pallas_call(
         kern,
         grid=grid,
