@@ -148,8 +148,17 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
         from ..platform import get_platform
         interpret = not get_platform().supports_pallas()
     block_m = min(block_m, M)
-    block_n = min(block_n, N)
     block_k = group_k   # one scale row per k-block (see _qmm_kernel)
+    # matvec regime (decode: tiny M): grid count, not FLOPs, dominates —
+    # widen block_n toward whole-N under a VMEM budget (int8 q tile +
+    # full-G scale tile + f32 acc, double-buffered) so a [K, N] matmul
+    # runs in ~K/group_k steps instead of (K/group_k) x (N/256)
+    if M <= 32:
+        per_n = (2 * block_k                   # q tile (int8), x2 buf
+                 + (K // group_k) * 4          # scale rows f32
+                 + 2 * block_m * 4)            # acc + out
+        block_n = max(block_n, min(N, (4 * 2**20 // per_n) // 128 * 128))
+    block_n = min(block_n, N)
     if (M % block_m or N % block_n or K % block_k
             or (not interpret and (block_m % 8 or block_n % 128
                                    or block_k % 128))):
