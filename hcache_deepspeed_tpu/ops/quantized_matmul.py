@@ -110,6 +110,28 @@ def reference_quantized_matmul(x, q, scale, group_k=256):
     return x @ w.reshape(K, N)
 
 
+def _matvec_block_n(K, N, group_k, block_m, block_n):
+    """Matvec-regime (M<=32) n-tile: the largest 128-multiple DIVISOR
+    of N under an 8 MB VMEM budget (q tile double-buffered + scale rows
+    + acc/out; ~16 MB VMEM/core leaves room for x and Mosaic scratch).
+    Must divide N — a budget-rounded non-divisor silently dropped the
+    two largest 7B matmuls (qkv 4096x12288, gate_up 4096x22016 — 74% of
+    the weight bytes) onto the dequant fallback."""
+    per_n = (2 * group_k                   # q tile (int8), x2 buf
+             + (K // group_k) * 4          # scale rows f32
+             + 2 * block_m * 4)            # acc + out
+    budget_n = (8 * 2**20 // per_n) // 128 * 128
+    d = min(N, budget_n) // 128 * 128
+    while d >= 128:
+        if N % d == 0:
+            # return d even when it is below the caller's block_n: a
+            # small dividing tile still runs fused; max() with a
+            # non-divisor block_n would re-trip the dequant fallback
+            return d
+        d -= 128
+    return block_n
+
+
 def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, group_k):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -150,14 +172,10 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
     block_m = min(block_m, M)
     block_k = group_k   # one scale row per k-block (see _qmm_kernel)
     # matvec regime (decode: tiny M): grid count, not FLOPs, dominates —
-    # widen block_n toward whole-N under a VMEM budget (int8 q tile +
-    # full-G scale tile + f32 acc, double-buffered) so a [K, N] matmul
-    # runs in ~K/group_k steps instead of (K/group_k) x (N/256)
+    # widen block_n toward whole-N so a [K, N] matmul runs in
+    # ~K/group_k steps instead of (K/group_k) x (N/256)
     if M <= 32:
-        per_n = (2 * block_k                   # q tile (int8), x2 buf
-                 + (K // group_k) * 4          # scale rows f32
-                 + 2 * block_m * 4)            # acc + out
-        block_n = max(block_n, min(N, (4 * 2**20 // per_n) // 128 * 128))
+        block_n = _matvec_block_n(K, N, group_k, block_m, block_n)
     block_n = min(block_n, N)
     if (M % block_m or N % block_n or K % block_k
             or (not interpret and (block_m % 8 or block_n % 128

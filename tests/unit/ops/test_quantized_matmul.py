@@ -64,6 +64,27 @@ def test_shape_fallback():
                                atol=1e-5)
 
 
+def test_matvec_tile_divides_7b_shapes():
+    """The decode-regime n-tile must DIVIDE N for every Llama-7B matmul
+    at both serving group sizes, or the grid guard silently drops the
+    shape onto the dequant fallback (observed on chip: qkv and gate_up
+    — 74% of the weight bytes — ran dequantized)."""
+    from hcache_deepspeed_tpu.ops.quantized_matmul import _matvec_block_n
+    h, ffn = 4096, 11008
+    shapes = {"qkv": (h, 3 * h), "o": (h, h),
+              "gate_up": (h, 2 * ffn), "down": (ffn, h)}
+    for gk in (128, 256):
+        for name, (K, N) in shapes.items():
+            if K % gk:
+                continue
+            bn = _matvec_block_n(K, N, gk, block_m=8, block_n=256)
+            assert N % bn == 0, (name, gk, bn)
+            assert bn % 128 == 0
+            # and the budget actually widened the tile: one or two
+            # n-steps for every 7B shape, not N/256
+            assert N // bn <= 2, (name, gk, bn)
+
+
 def test_make_batched_matches_one_shot():
     """Per-layer streaming quantization (the 7B OOM fix) must produce
     exactly the one-shot stacked result — including from a host numpy
