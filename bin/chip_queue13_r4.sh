@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Round-4 continuation queue 13: re-measure the 7B int8 decode story
+# after the block_n-divisor fix (qkv + gate_up — 74% of weight bytes —
+# had silently fallen back to dequant). Floors run FIRST in a pristine
+# process (--floors-only: after a 7B engine the pool never reliably
+# returns to a state that fits the 13.5 GB dense floor), then the
+# engine stretch, then serving e2e, then the 1B diag (1B gate_up was
+# also fallback-bound).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 180 python -c "
+import jax, jax.numpy as jnp, random
+n = random.randrange(130, 510)
+x = jnp.ones((n, 257))
+assert jax.devices('tpu')
+float(jax.jit(lambda a: (a @ a.T).sum())(x))" >/dev/null 2>&1
+}
+probe || { echo "relay DOWN; aborting" >&2; exit 3; }
+echo "relay UP at $(date -u +%H:%M:%S)" >&2
+
+echo "=== 7b int8 floors (fixed kernel, pristine process)" >&2
+timeout 2400 python bin/hds_decode_diag --model 7b --quantize fused \
+  --floors-only | tee DECODE_DIAG_7B_FLOORS_V2.jsonl
+echo "=== floors rc=$?" >&2
+
+echo "=== 7b fused stretch decomposition" >&2
+timeout 2400 python bin/hds_decode_diag --model 7b --quantize fused \
+  --stretch-only | tee DECODE_DIAG_7B_QFUSED_V2.jsonl
+echo "=== stretch rc=$?" >&2
+
+echo "=== serve 7b int8 fused decode e2e" >&2
+timeout 3300 python bin/hds_serve_bench --model 7b --quantize fused \
+  --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+  --prefill-chunk 64 --fused-decode | tee SERVE_7B_INT8_FUSED_V3.jsonl
+echo "=== serve rc=$?" >&2
+
+echo "=== 1b fused diag (gate_up no longer fallback)" >&2
+timeout 2400 python bin/hds_decode_diag --model 1b --quantize fused \
+  | tee DECODE_DIAG_1B_QFUSED_V2.jsonl
+echo "=== diag-1b rc=$?" >&2

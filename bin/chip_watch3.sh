@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Relay watcher 3 (round-4 continuation): the multi-group-block_k
+# kernel rewrite needs fresh compiles, and the remote compile service
+# wedged mid-queue13 (floors landed; stretch/serve hung). Probe with a
+# fresh shape every ~5 min; on revival run the rewritten-kernel
+# measurement queue, then exit.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+DEADLINE=$(( $(date +%s) + ${1:-30000} ))
+
+probe() {
+  # fresh-shape compile: the compile service is a separate failure
+  # domain from execution; a cached-program probe would report UP while
+  # every new program hangs
+  timeout 180 python -c "
+import jax, jax.numpy as jnp, random
+n = random.randrange(130, 510)
+x = jnp.ones((n, 257))
+assert jax.devices('tpu')
+float(jax.jit(lambda a: (a @ a.T).sum())(x))" >/dev/null 2>&1
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    echo "relay (incl compile service) UP at $(date -u +%H:%M:%S)" >&2
+
+    echo "=== 7b int8 floors, multi-group kernel" >&2
+    timeout 2400 python bin/hds_decode_diag --model 7b --quantize fused \
+      --floors-only | tee DECODE_DIAG_7B_FLOORS_V3.jsonl
+    echo "floors-v3 rc=$?" >&2
+
+    echo "=== 7b fused stretch decomposition" >&2
+    timeout 2700 python bin/hds_decode_diag --model 7b --quantize fused \
+      --stretch-only | tee DECODE_DIAG_7B_QFUSED_V3.jsonl
+    echo "stretch-v3 rc=$?" >&2
+
+    echo "=== serve 7b int8 fused decode e2e" >&2
+    timeout 3300 python bin/hds_serve_bench --model 7b --quantize fused \
+      --max-context 512 --prompt-len 128 --decode-steps 8 --batches 1 \
+      --prefill-chunk 64 --fused-decode | tee SERVE_7B_INT8_FUSED_V3.jsonl
+    echo "serve-v3 rc=$?" >&2
+
+    echo "=== 1b fused diag (gate_up no longer fallback)" >&2
+    timeout 2400 python bin/hds_decode_diag --model 1b --quantize fused \
+      | tee DECODE_DIAG_1B_QFUSED_V2.jsonl
+    echo "diag-1b-v2 rc=$?" >&2
+
+    echo "watch3 queue done" >&2
+    exit 0
+  fi
+  sleep 280
+done
+echo "relay never revived before deadline" >&2
+exit 3

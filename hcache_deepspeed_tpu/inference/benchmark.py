@@ -32,6 +32,26 @@ def _emit(results, row):
     print(json.dumps(row), flush=True)
 
 
+_MODEL_SIZES = {
+    "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 n_layer=2, n_head=4, n_kv_head=2),
+    "1b": dict(vocab_size=32000, hidden_size=2048,
+               intermediate_size=5504, n_layer=24, n_head=16,
+               n_kv_head=16),
+    "7b": dict(vocab_size=32000, hidden_size=4096,
+               intermediate_size=11008, n_layer=32, n_head=32,
+               n_kv_head=32),
+}
+
+
+def _model_config(model_size: str, max_context: int):
+    """Config alone (shape math, no weights — the decode diag's
+    floors-only mode must not pay a 7B host init for four tuples)."""
+    from ..models.llama import LlamaConfig
+    return LlamaConfig(max_positions=max_context, dtype="bfloat16",
+                       use_flash=False, **_MODEL_SIZES[model_size])
+
+
 def _model_params(model_size: str, max_context: int):
     """Config + params for one model size, built ONCE per process and on
     the HOST backend — re-initializing 4 GB of fp32 weights on the chip
@@ -41,22 +61,11 @@ def _model_params(model_size: str, max_context: int):
     import jax
     import jax.numpy as jnp
 
-    from ..models.llama import LlamaConfig, LlamaForCausalLM
+    from ..models.llama import LlamaForCausalLM
 
-    sizes = {
-        "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
-                     n_layer=2, n_head=4, n_kv_head=2),
-        "1b": dict(vocab_size=32000, hidden_size=2048,
-                   intermediate_size=5504, n_layer=24, n_head=16,
-                   n_kv_head=16),
-        "7b": dict(vocab_size=32000, hidden_size=4096,
-                   intermediate_size=11008, n_layer=32, n_head=32,
-                   n_kv_head=32),
-    }
     key = (model_size, max_context)
     if key not in _PARAM_CACHE:
-        cfg = LlamaConfig(max_positions=max_context, dtype="bfloat16",
-                          use_flash=False, **sizes[model_size])
+        cfg = _model_config(model_size, max_context)
         model = LlamaForCausalLM(cfg)
         batch_init = {"input_ids": np.zeros((1, 8), np.int32)}
         try:
@@ -101,8 +110,11 @@ def _engine(model_size: str, max_context: int, batch: int,
     quant = {}
     if quantize:
         # group 128 = one TPU lane row: sub-lane groups (e.g. 64) pad
-        # the stored int8 q and every quantization temp 2x
-        quant = {"enabled": True, "bits": 8, "group_size": 128,
+        # the stored int8 q and every quantization temp 2x. For the
+        # k-major fused layout a LARGER group halves scale rows and
+        # kernel grid steps — overridable for measurement sweeps.
+        group = int(os.environ.get("HDS_QUANT_GROUP", "128"))
+        quant = {"enabled": True, "bits": 8, "group_size": group,
                  "min_size": 1024,
                  "use_fused_kernel": quantize == "fused"}
     eng = InferenceEngineV2(

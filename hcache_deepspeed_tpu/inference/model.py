@@ -224,6 +224,7 @@ class PagedInferenceModel:
         from ..ops.quantized_matmul import MatmulQuantizedTensor
 
         names = self._COL_NAMES + self._ROW_NAMES
+        skipped = []   # trunk leaves that LOOK quantizable but are not
 
         def fused(path, leaf):
             # shape checks on the leaf as-is: a host (numpy) leaf must
@@ -231,13 +232,20 @@ class PagedInferenceModel:
             # device one layer at a time (a 7B stacked leaf's one-shot
             # fp32 group view OOMs a 16 GB chip)
             joined = join_path(path)
-            if not (path and str(getattr(path[0], "key",
-                                         path[0])) == "layers"
-                    and getattr(leaf, "ndim", 0) == 3
-                    and any(n in joined for n in names)
-                    and joined.endswith("kernel")
-                    and leaf.shape[-2] % qc.group_size == 0
-                    and leaf.size >= qc.min_size):
+            is_trunk = (path and str(getattr(path[0], "key",
+                                             path[0])) == "layers"
+                        and getattr(leaf, "ndim", 0) == 3
+                        and any(n in joined for n in names)
+                        and joined.endswith("kernel")
+                        and leaf.size >= qc.min_size)
+            if is_trunk and leaf.shape[-2] % qc.group_size:
+                # K not a group multiple: the leaf stays full precision.
+                # Record it — a silently-dense trunk matmul skews any
+                # quantized measurement (e.g. group_size 512 leaves the
+                # 7B down projection, 25% of weight bytes, bf16).
+                skipped.append((joined, tuple(leaf.shape)))
+                return leaf
+            if not is_trunk:
                 return leaf
             if self.tp > 1:
                 # shard-alignment: col shards split N (scales follow);
@@ -253,6 +261,14 @@ class PagedInferenceModel:
             return MatmulQuantizedTensor.make_batched(
                 leaf, group_k=qc.group_size, num_bits=qc.bits)
         tree = jax.tree_util.tree_map_with_path(fused, tree)
+        if skipped:
+            from ..utils.logging import log_dist
+            log_dist(
+                "quantization: %d trunk leaves stay full precision "
+                "(K %% group_size=%d != 0): %s"
+                % (len(skipped), qc.group_size,
+                   ", ".join(f"{p}{s}" for p, s in skipped[:4])),
+                level=30)   # WARNING — measurements must not read dense
         if self.tp > 1:
             # non-layer leaves (untied head) would quantize in the FLAT
             # layout whose groups straddle the vocab shard — they stay

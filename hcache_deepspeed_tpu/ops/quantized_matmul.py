@@ -110,29 +110,63 @@ def reference_quantized_matmul(x, q, scale, group_k=256):
     return x @ w.reshape(K, N)
 
 
-def _matvec_block_n(K, N, group_k, block_m, block_n):
-    """Matvec-regime (M<=32) n-tile: the largest 128-multiple DIVISOR
-    of N under an 8 MB VMEM budget (q tile double-buffered + scale rows
-    + acc/out; ~16 MB VMEM/core leaves room for x and Mosaic scratch).
-    Must divide N — a budget-rounded non-divisor silently dropped the
-    two largest 7B matmuls (qkv 4096x12288, gate_up 4096x22016 — 74% of
-    the weight bytes) onto the dequant fallback."""
-    per_n = (2 * group_k                   # q tile (int8), x2 buf
-             + (K // group_k) * 4          # scale rows f32
-             + 2 * block_m * 4)            # acc + out
-    budget_n = (8 * 2**20 // per_n) // 128 * 128
-    d = min(N, budget_n) // 128 * 128
+def _divisors_128(N, cap):
+    """128-multiple divisors of N, descending, <= cap."""
+    out = []
+    d = min(N, cap) // 128 * 128
     while d >= 128:
         if N % d == 0:
-            # return d even when it is below the caller's block_n: a
-            # small dividing tile still runs fused; max() with a
-            # non-divisor block_n would re-trip the dequant fallback
-            return d
+            out.append(d)
         d -= 128
-    return block_n
+    return out
 
 
-def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, group_k):
+def _choose_tiles(M, K, N, group_k, block_m):
+    """(block_n, groups_per_block) minimizing grid steps under a ~10 MB
+    VMEM budget. Grid-step overhead (~1-2 us Mosaic dispatch per step)
+    is THE cost driver in both kernel regimes on a v5e:
+
+    - matvec (decode, M<=32): HBM-bound; tiles must be multi-MB or the
+      per-step overhead halves effective bandwidth (measured 478 GB/s
+      at 32 one-group steps vs 681 GB/s for XLA's dense bf16 matvec).
+    - compute (prefill/training M>32): a [256, 256, group_k] blocking
+      runs the 7B qkv matmul in 1536 steps of ~43 ns MXU work each —
+      pure dispatch overhead (prefill measured 15x off the weight-
+      streaming ceiling).
+
+    groups_per_block (gpb) must divide G so every k-block covers whole
+    scale groups; when gpb is a multiple of 8 the scale BlockSpec can
+    deliver exactly the block's rows ([gpb, bn] — sublane dim >= 8
+    lowers fine) and the kernel slices rows STATICALLY; smaller gpb
+    falls back to the whole-G tile + mask-sum row select."""
+    G = K // group_k
+    budget = 10 * 2**20
+    best = None
+    for gpb in (8, 4, 2, 1):
+        if G % gpb:
+            continue
+        bk = gpb * group_k
+        for bn in _divisors_128(N, 8 * 2**20 // (2 * bk) // 128 * 128):
+            scale_rows = gpb if gpb % 8 == 0 else G
+            vmem = (2 * bk * bn               # q tile int8, x2 buf
+                    + 2 * block_m * bk * 2    # x tile bf16, x2
+                    + 2 * scale_rows * bn * 4
+                    + block_m * bn * 4        # acc scratch
+                    + 2 * block_m * bn * 2)   # out
+            if vmem > budget:
+                continue
+            steps = (M // block_m) * (N // bn) * (K // bk)
+            cand = (steps, -bk * bn, bn, gpb)
+            if best is None or cand < best:
+                best = cand
+            break   # divisors descend: first fitting bn is the best bn
+    if best is None:
+        return None
+    return best[2], best[3]
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, group_k, gpb,
+                sliced_scale):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -140,44 +174,65 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, group_k):
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    x = x_ref[0]                        # [block_m, group_k]
-    qt = q_ref[0]                       # [group_k, block_n] int8
-    # block_k == group_k, so the whole k-block shares ONE scale row per
-    # column: run the int8 dot raw and scale the OUTPUT. The row is
-    # selected from the full [G, block_n] scale tile by mask-sum —
-    # dynamic_slice does not lower in Mosaic TC kernels, and a
-    # per-k-block scale tile would have an unlowerable sublane dim of 1.
-    G, bn = s_ref.shape[1], s_ref.shape[2]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (G, bn), 0)
-    s_row = jnp.sum(jnp.where(rows == ki, s_ref[0], 0.0), axis=0,
-                    keepdims=True)      # [1, block_n] f32
-    p = jax.lax.dot(x, qt.astype(x.dtype),
-                    preferred_element_type=jnp.float32)
-    acc[:] += p * s_row
+    x = x_ref[0]                        # [block_m, gpb*group_k]
+    qt = q_ref[0]                       # [gpb*group_k, block_n] int8
+    s = s_ref[0]                        # [gpb | G, block_n] f32
+    if not sliced_scale:
+        # whole-G scale tile: the block's rows are selected by mask-sum
+        # (dynamic_slice does not lower in Mosaic TC kernels, and a
+        # sub-8 sublane scale tile is unlowerable)
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, (s.shape[0], s.shape[1]), 0)
+    # one raw int8 dot per scale group, scaling the OUTPUT row-block:
+    # scales vary per (group, n), so they cannot fold into x, and
+    # scaling the [group_k, bn] weight slice would cost group_k/block_m
+    # times more VPU work than scaling the [block_m, bn] partial product
+    for j in range(gpb):
+        if sliced_scale:
+            s_row = s[j:j + 1]                       # static row
+        else:
+            s_row = jnp.sum(
+                jnp.where(rows == ki * gpb + j, s, 0.0), axis=0,
+                keepdims=True)
+        p = jax.lax.dot(x[:, j * group_k:(j + 1) * group_k],
+                        qt[j * group_k:(j + 1) * group_k].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+        acc[:] += p * s_row
 
     @pl.when(ki == nk - 1)
     def _out():
         o_ref[0] = acc[:].astype(o_ref.dtype)
 
 
-def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
-                            block_n=256, block_k=256, interpret=None):
-    """x: [M, K] (bf16/f32); q: [K, N] int8; scale: [K//group_k, N]."""
+def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=None,
+                            block_n=None, block_k=None, interpret=None):
+    """x: [M, K] (bf16/f32); q: [K, N] int8; scale: [K//group_k, N].
+
+    block_* default to the grid-overhead-minimizing tiles from
+    ``_choose_tiles``; explicit values override (tests exercise fixed
+    blockings). ``block_k`` must be a whole number of scale groups."""
     M, K = x.shape
     K2, N = q.shape
     assert K == K2
     if interpret is None:
         from ..platform import get_platform
         interpret = not get_platform().supports_pallas()
+    if block_m is None:
+        block_m = M if M <= 32 else next(
+            (bm for bm in (256, 128, 64, 32, 16, 8) if M % bm == 0), M)
     block_m = min(block_m, M)
-    block_k = group_k   # one scale row per k-block (see _qmm_kernel)
-    # matvec regime (decode: tiny M): grid count, not FLOPs, dominates —
-    # widen block_n toward whole-N so a [K, N] matmul runs in
-    # ~K/group_k steps instead of (K/group_k) x (N/256)
-    if M <= 32:
-        block_n = _matvec_block_n(K, N, group_k, block_m, block_n)
-    block_n = min(block_n, N)
+    if block_n is None and block_k is None and M % block_m == 0:
+        chosen = _choose_tiles(M, K, N, group_k, block_m)
+        if chosen is None:
+            return reference_quantized_matmul(x, q, scale,
+                                              group_k=group_k)
+        block_n, gpb = chosen
+        block_k = gpb * group_k
+    else:
+        block_n = min(block_n or 256, N)
+        block_k = block_k or group_k
     if (M % block_m or N % block_n or K % block_k
+            or block_k % group_k
             or (not interpret and (block_m % 8 or block_n % 128
                                    or block_k % 128))):
         # block_k is x's lane dim and q's sublane dim — it needs 128
@@ -186,7 +241,19 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
         return reference_quantized_matmul(x, q, scale, group_k=group_k)
     grid = (M // block_m, N // block_n, K // block_k)
     G = K // group_k
-    kern = functools.partial(_qmm_kernel, group_k=group_k)
+    gpb = block_k // group_k
+    # scale tile: exactly the block's rows when the sublane dim (gpb)
+    # lowers (>= 8); otherwise the whole group dim with in-kernel
+    # mask-sum row selection
+    sliced_scale = gpb % 8 == 0
+    if sliced_scale:
+        s_spec = pl.BlockSpec((1, gpb, block_n),
+                              lambda mi, ni, ki: (0, ki, ni))
+    else:
+        s_spec = pl.BlockSpec((1, G, block_n),
+                              lambda mi, ni, ki: (0, 0, ni))
+    kern = functools.partial(_qmm_kernel, group_k=group_k, gpb=gpb,
+                             sliced_scale=sliced_scale)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -195,12 +262,7 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=256,
                          lambda mi, ni, ki: (0, mi, ki)),
             pl.BlockSpec((1, block_k, block_n),
                          lambda mi, ni, ki: (0, ki, ni)),
-            # whole group dim per step (G x block_n x 4B — tens of KB):
-            # a per-k-block scale tile has sublane dim block_k//group_k,
-            # which is 1 in the common block_k == group_k case and
-            # unlowerable; the kernel slices its rows in VMEM
-            pl.BlockSpec((1, G, block_n),
-                         lambda mi, ni, ki: (0, 0, ni)),
+            s_spec,
         ],
         out_specs=pl.BlockSpec((1, block_m, block_n),
                                lambda mi, ni, ki: (0, mi, ni)),
