@@ -226,3 +226,33 @@ class TestInt4Serving:
         scale = np.abs(lf).max() + 1e-6
         # int4 is coarser than int8: wider but still bounded drift
         assert np.abs(lf - lq).max() / scale < 0.45
+
+
+def test_group_misaligned_trunk_leaf_stays_dense():
+    """A trunk leaf whose K is not a group multiple must stay FULL
+    precision — not fall through to the flat QuantizedTensor layout,
+    whose dequant path is slower than dense at decode (81 vs 18
+    ms/token measured at 7B). Serving must still work."""
+    from hcache_deepspeed_tpu.ops.quantized_matmul import \
+        MatmulQuantizedTensor
+    cfg = llama_tiny(hidden_size=128, intermediate_size=160,
+                     max_positions=128, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch,
+                        train=False)["params"]
+    engine = _engine(cfg, params, quantized=True)   # group 64
+    containers = (QuantizedTensor, MatmulQuantizedTensor)
+    flat = jax.tree_util.tree_flatten_with_path(
+        engine.model.params,
+        is_leaf=lambda x: isinstance(x, containers))[0]
+    down = [(p, l) for p, l in flat
+            if "down" in "/".join(str(getattr(k, "key", k)) for k in p)]
+    assert down, "down-proj leaf not found"
+    for _, leaf in down:   # K=160 % 64 != 0 -> dense
+        assert not isinstance(leaf, containers)
+        assert jnp.issubdtype(leaf.dtype, jnp.floating)
+    assert any(isinstance(l, MatmulQuantizedTensor)
+               for _, l in flat)   # aligned trunk still quantized
+    out = engine.generate([list(range(10))], max_new_tokens=4)
+    assert len(out[0]) == 4
