@@ -242,6 +242,19 @@ class PagedInferenceModel:
                         and any(n in joined for n in names)
                         and joined.endswith("kernel")
                         and leaf.size >= qc.min_size)
+            # untied LM head [H, V]: k-major too (tp==1; under TP the
+            # early return keeps it full precision). The flat layout
+            # dequantizes the WHOLE head every step inside _trunk —
+            # ~0.4 GB of bf16 materialized per decoded token at 7B;
+            # k-major streams it int8 through _mm like the trunk.
+            is_head = (not self.tied and self.tp == 1
+                       and joined in ("lm_head", "lm_head/kernel")
+                       and getattr(leaf, "ndim", 0) == 2
+                       and leaf.size >= qc.min_size)
+            if is_head and leaf.shape[-2] % qc.group_size == 0:
+                return MatmulQuantizedTensor.make(
+                    jnp.asarray(leaf), group_k=qc.group_size,
+                    num_bits=qc.bits)
             if is_trunk and leaf.shape[-2] % qc.group_size:
                 # K not a group multiple: the leaf stays full precision.
                 # Record it — a silently-dense trunk matmul skews any
@@ -585,9 +598,11 @@ class PagedInferenceModel:
 
     def _head_logits(self, params, last):
         """LM head on the last valid position; biased-head families
-        (phi) override."""
-        head = params["embed"].T if self.tied else params["lm_head"]
-        return (last @ head).astype(jnp.float32)
+        (phi) override. ``_mm`` routes a k-major-quantized untied head
+        through the fused int8 kernel."""
+        if self.tied:
+            return (last @ params["embed"].T).astype(jnp.float32)
+        return self._mm(last, params["lm_head"]).astype(jnp.float32)
 
     def _embed_extra(self, params, positions):
         """Additive embedding term (learned positions in the gpt2/opt

@@ -97,4 +97,5 @@ class PagedPhiModel(PagedFalconModel):
 
     def _head_logits(self, params, last):
         head = params["lm_head"]
-        return (last @ head["kernel"] + head["bias"]).astype(jnp.float32)
+        return (self._mm(last, head["kernel"])
+                + head["bias"]).astype(jnp.float32)
