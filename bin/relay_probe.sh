@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Round-5 relay watcher: probe the axon relay (incl. the remote-compile
+# service) every ~4 min and append state to relay_state_r5.log.
+# Consumers grep the log tail for "UP". The probe itself is
+# bench._probe_relay — ONE implementation, so a probe fix (e.g. the
+# cache-collision shape-space fix) applies to watcher and bench alike.
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE=$(( $(date +%s) + ${1:-43200} ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  state=$(python -c "import bench; print(bench._probe_relay())" 2>/dev/null)
+  if [ "$state" = "up" ]; then
+    echo "UP $(date -u +%F_%H:%M:%S)"
+  else
+    echo "DOWN($state) $(date -u +%F_%H:%M:%S)"
+  fi
+  sleep 240
+done

@@ -90,23 +90,14 @@ def test_bench_model_sizes_trace():
     import jax
     from hcache_deepspeed_tpu.models.llama import (LlamaConfig,
                                                    LlamaForCausalLM)
-    from hcache_deepspeed_tpu.inference.benchmark import _model_params
-    import inspect
+    from hcache_deepspeed_tpu.inference.benchmark import _MODEL_SIZES
     # exact arithmetic: per-layer 4h^2 + 3*h*ffn, plus two vocab
     # matrices (untied embed + head)
     sizes = {"1b": 1.35e9, "7b": 6.74e9}
-    src = inspect.getsource(_model_params)
-    for name, expect in sizes.items():
-        assert f'"{name}"' in src
-    specs = {
-        "1b": dict(vocab_size=32000, hidden_size=2048,
-                   intermediate_size=5504, n_layer=24, n_head=16,
-                   n_kv_head=16),
-        "7b": dict(vocab_size=32000, hidden_size=4096,
-                   intermediate_size=11008, n_layer=32, n_head=32,
-                   n_kv_head=32),
-    }
-    for name, spec in specs.items():
+    for name in sizes:
+        assert name in _MODEL_SIZES, name
+    for name in sizes:
+        spec = _MODEL_SIZES[name]
         cfg = LlamaConfig(max_positions=512, dtype="bfloat16",
                           use_flash=False, **spec)
         model = LlamaForCausalLM(cfg)
