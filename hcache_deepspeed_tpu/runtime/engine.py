@@ -1070,6 +1070,7 @@ class HDSEngine:
         donated, so state is updated immediately to never hold a deleted
         array); ``backward()`` then only advances the micro-step counter.
         """
+        self._assert_not_offloaded()
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._shard_batch(batch)
@@ -1208,6 +1209,7 @@ class HDSEngine:
         alternatively pull gas batches from ``data_iter``.
         """
         self.tput_timer.start()
+        self._assert_not_offloaded()
         if self.wall_clock_breakdown:
             self.timers(BATCH_TIMER).start()
         cur_d = None
@@ -1428,6 +1430,7 @@ class HDSEngine:
         return calibrate_activation_ranges(fwd, self._structured, batches)
 
     def eval_batch(self, batch):
+        self._assert_not_offloaded()
         batch = self._shard_batch(batch)
         kw = {}
         if self._lora is not None:
@@ -1457,6 +1460,108 @@ class HDSEngine:
         if self._last_grad_norm is None:
             return None
         return float(self._last_grad_norm)
+
+    # ------------------------------------------------------------------ #
+    # Explicit between-phase state offload (reference: engine.py:3943
+    # offload_states / :3977 reload_states — there, ZeRO-3-only moves of
+    # the optimizer's flat buffers to pinned CPU memory; here a pytree
+    # device_get/device_put of any engine state group, valid at every
+    # ZeRO stage because state placement is declarative NamedShardings,
+    # not stage-specific flat buffers. The RLHF generate phase uses it
+    # to reclaim HBM for KV cache / serving params.)
+    # ------------------------------------------------------------------ #
+    # reference OffloadStateTypeEnum -> engine state keys
+    _OFFLOAD_STATE_ALIASES = {
+        "optim_states": "opt", "opt": "opt",
+        "hp_params": "master", "master": "master",
+        "lp_params": "params", "params": "params",
+        "lp_grads": "grad_acc", "contiguous_grad_buffer": "grad_acc",
+        "grad_acc": "grad_acc",
+        "frozen": "frozen",
+    }
+
+    def offload_states(self, include=None, device="cpu", pin_memory=True,
+                       non_blocking=False):
+        """Move engine state groups to host RAM, freeing HBM between
+        phases. ``include``: iterable of state names (reference enum
+        names ``optim_states``/``hp_params``/``lp_params``/``lp_grads``
+        or native ``opt``/``master``/``params``/``grad_acc``/``frozen``);
+        ``None`` offloads all of them. ``pin_memory`` is accepted for
+        API parity (host arrays are plain numpy; the PJRT transfer path
+        stages regardless). With ``non_blocking`` the device->host
+        copies of all leaves are started before any is awaited.
+
+        Training/eval entry points raise until :meth:`reload_states`
+        restores the device placement."""
+        if device not in ("cpu", "none"):
+            raise ValueError(
+                f"offload_states supports device='cpu', got {device!r}")
+        if device == "none":
+            log_dist("offload_states: device='none', nothing offloaded",
+                     ranks=[0])
+            return
+        if include is None:
+            keys = ["opt", "master", "params", "grad_acc", "frozen"]
+        else:
+            keys = []
+            for name in include:
+                key = self._OFFLOAD_STATE_ALIASES.get(str(name))
+                if key is None:
+                    raise ValueError(
+                        f"unknown state {name!r}; expected one of "
+                        f"{sorted(set(self._OFFLOAD_STATE_ALIASES))}")
+                if key not in keys:
+                    keys.append(key)
+        if not hasattr(self, "_offloaded_shardings"):
+            self._offloaded_shardings = {}
+        moved = 0
+        for key in keys:
+            tree = self.state.get(key)
+            if tree is None or key in self._offloaded_shardings:
+                continue
+            leaves = [x for x in jax.tree.leaves(tree)
+                      if isinstance(x, jax.Array)]
+            if non_blocking:
+                for x in leaves:
+                    x.copy_to_host_async()
+            self._offloaded_shardings[key] = jax.tree.map(
+                lambda x: x.sharding if isinstance(x, jax.Array) else None,
+                tree)
+            self.state[key] = jax.tree.map(
+                lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+                tree)
+            moved += sum(x.nbytes for x in leaves)
+        log_dist(f"offload_states: moved {sorted(keys)} "
+                 f"({moved / 2**20:.1f} MiB) to host", ranks=[0])
+
+    def reload_states(self, non_blocking=False):
+        """Restore every offloaded state group to its original device
+        sharding (reference: engine.py:3977). Transfers for all groups
+        are issued before any is awaited; with ``non_blocking`` the
+        arrays are returned still in flight (XLA blocks consumers
+        automatically)."""
+        shardings = getattr(self, "_offloaded_shardings", None)
+        if not shardings:
+            return
+        for key, sh_tree in shardings.items():
+            self.state[key] = jax.tree.map(
+                lambda x, s: jax.device_put(x, s)
+                if s is not None else x,
+                self.state[key], sh_tree)
+        if not non_blocking:
+            for key in shardings:
+                for x in jax.tree.leaves(self.state[key]):
+                    if isinstance(x, jax.Array):
+                        x.block_until_ready()
+        self._offloaded_shardings = {}
+        log_dist("reload_states: device placement restored", ranks=[0])
+
+    def _assert_not_offloaded(self):
+        off = getattr(self, "_offloaded_shardings", None)
+        if off:
+            raise RuntimeError(
+                f"engine states {sorted(off)} are offloaded to host; "
+                "call engine.reload_states() before training/eval")
 
     def deepspeed_io(self, dataset, batch_size=None, **kw):
         from .dataloader import HDSDataLoader

@@ -47,8 +47,23 @@ class HybridEngine:
         self._infer: Optional[InferenceEngineV2] = None
         self._params_step = -1  # train step the serving params reflect
 
+    # ------------------ generate-phase memory reclaim --------------- #
+    def offload_train_states(self, non_blocking=False):
+        """Reclaim HBM for the generate phase: optimizer state, master
+        weights and grad buffers move to host; the lp params stay — the
+        serving engine reads them (reference: engine.offload_states
+        before the RLHF rollout, engine.py:3943)."""
+        self.engine.offload_states(
+            include=("opt", "master", "grad_acc"),
+            non_blocking=non_blocking)
+
+    def reload_train_states(self, non_blocking=False):
+        self.engine.reload_states(non_blocking=non_blocking)
+
     # ------------------------ training side ------------------------ #
     def train_batch(self, *a, **kw):
+        # a rollout phase may have left the optimizer states on host
+        self.engine.reload_states()
         return self.engine.train_batch(*a, **kw)
 
     def forward(self, *a, **kw):
