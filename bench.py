@@ -221,12 +221,14 @@ def _load_last_measured():
 
 def _error_payload(message):
     """Dead-relay payload. The driver's scoreboard records ``value``
-    verbatim, so a 0.0 here erases three rounds of real measurements
-    (the round-2..4 failure mode). Instead report the best chip number
-    ever measured as ``value`` with an explicit top-level
-    ``"stale": true`` — fresh runs never set the key, so the two are
-    unambiguous to any reader — and keep the error string saying why no
-    fresh point exists."""
+    verbatim and any consumer may key on the exit code, so a round with
+    ZERO fresh measurement must never masquerade as a successful
+    best-ever result (that masks regressions introduced since the last
+    real run). ``value`` therefore stays 0.0 with the error string
+    saying why, and the historical best/last chip numbers ride along
+    only under ``extra.last_measured`` — with a top-level
+    ``"stale": true`` marker when such history exists — for readers who
+    want to distinguish "never fast" from "fast but unreachable"."""
     payload = {
         "metric": _metric_label(),
         "value": 0.0,
@@ -240,12 +242,18 @@ def _error_payload(message):
     if state is not None:
         best = state.get("best") or state.get("last")
         if best and best.get("value"):
-            payload["value"] = best["value"]
-            payload["vs_baseline"] = best.get("vs_baseline", 0.0)
             payload["stale"] = True
             payload["stale_utc"] = best.get("utc", "")
         payload["extra"] = {"last_measured": state}
     return payload
+
+
+def _error_exit_code(payload):
+    """No-fresh-measurement exit codes, both non-zero so exit-code
+    consumers can never mistake a dead-relay round for a real run:
+    3 = stale history available under extra.last_measured, 2 = nothing
+    at all."""
+    return 3 if payload.get("stale") else 2
 
 
 def _arm_watchdog():
@@ -261,7 +269,7 @@ def _arm_watchdog():
             f"watchdog: no result within {_WATCHDOG_SECS:.0f}s "
             "(TPU relay unreachable?)")
         print(json.dumps(payload), flush=True)
-        os._exit(0 if payload.get("stale") else 2)
+        os._exit(_error_exit_code(payload))
 
     t = threading.Timer(_WATCHDOG_SECS, fire)
     t.daemon = True
@@ -506,7 +514,7 @@ def main():
                   "TPU relay unresponsive and cached-winner rescue failed")
         payload = _error_payload(f"no fresh measurement: {reason}")
         print(json.dumps(payload), flush=True)
-        return 0 if payload.get("stale") else 2
+        return _error_exit_code(payload)
     while names:
         name = names.pop(0)
         last = not names
@@ -538,8 +546,7 @@ def main():
     payload = _error_payload(
         "no candidate produced a result (TPU relay down?)")
     print(json.dumps(payload), flush=True)
-    # a stale-but-real number is a successful report, not a failure
-    return 0 if payload.get("stale") else 2
+    return _error_exit_code(payload)
 
 
 if __name__ == "__main__":

@@ -4,15 +4,34 @@
 # Consumers grep the log tail for "UP". The probe itself is
 # bench._probe_relay — ONE implementation, so a probe fix (e.g. the
 # cache-collision shape-space fix) applies to watcher and bench alike.
+#
+# ${PYTHON:-python3}: bare "python" is missing (or is python2) on some
+# boxes — bench.py itself runs under sys.executable, so the watcher must
+# not silently log DOWN() forever on a healthy relay just because the
+# interpreter name differs. Probe-script stderr is logged ONCE (first
+# failure) so "probe script failed" is distinguishable from "relay
+# down".
 set -u
 cd "$(dirname "$0")/.."
+PY="${PYTHON:-python3}"
 DEADLINE=$(( $(date +%s) + ${1:-43200} ))
+probe_err_logged=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  state=$(python -c "import bench; print(bench._probe_relay())" 2>/dev/null)
+  err=$(mktemp)
+  state=$("$PY" -c "import bench; print(bench._probe_relay())" 2>"$err")
   if [ "$state" = "up" ]; then
     echo "UP $(date -u +%F_%H:%M:%S)"
+  elif [ -z "$state" ]; then
+    # the probe script itself failed (bad interpreter, import error):
+    # a health signal about US, not about the relay
+    echo "PROBE-FAILED $(date -u +%F_%H:%M:%S)"
+    if [ "$probe_err_logged" -eq 0 ] && [ -s "$err" ]; then
+      sed 's/^/  probe-stderr: /' "$err"
+      probe_err_logged=1
+    fi
   else
     echo "DOWN($state) $(date -u +%F_%H:%M:%S)"
   fi
+  rm -f "$err"
   sleep 240
 done

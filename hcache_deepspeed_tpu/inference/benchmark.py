@@ -584,6 +584,168 @@ def run_sweep_fused(model_size="tiny", max_context=512, prompt_len=128,
     return results
 
 
+def run_serve_loop(model_size="tiny", max_context=128, prompt_len=48,
+                   max_new=24, rps=50.0, n_requests=64, seed=0,
+                   num_blocks=10, block_size=16, max_lanes=4,
+                   virtual_clock=False, parity_checks=3,
+                   out="SERVE_LOOP.jsonl"):
+    """Continuous-batching serving loop over a Poisson arrival trace.
+
+    Drives the ``serving/`` subsystem end-to-end against a real engine:
+    requests arrive open-loop at ``rps``, the scheduler admits them into
+    the ragged batch, and the deliberately small KV pool (``num_blocks``)
+    plus mixed priority classes force preempt→suspend-to-latents→
+    ``restore_kv`` cycles mid-trace — the restore dispatch overlapped
+    with resident decode. After the trace, every preempted request's
+    token stream is re-derived with an uninterrupted ``generate`` run on
+    the (now empty) engine and compared exactly: restore correctness is
+    part of the artifact, not a side claim.
+
+    Emits one jsonl row per request plus a summary row with TTFT/TPOT/
+    queue-wait percentiles, preemption/restore counters, the restore
+    overlap ratio and the parity verdict; rows also append to ``out``
+    (set ``out=""`` to skip the file).
+
+    ``virtual_clock=True`` replays the same trace on the deterministic
+    simulated timeline instead of wall time (policy debugging; the
+    acceptance path runs with it off).
+    """
+    from ..serving import (Request, ServerConfig, ServingServer,
+                           VirtualClock)
+    from .config import RaggedInferenceEngineConfig
+    from .engine_v2 import InferenceEngineV2
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    if prompt_len + max_new > max_context:
+        raise ValueError(f"prompt_len {prompt_len} + max_new {max_new} "
+                         f"exceeds max_context {max_context}")
+    cfg, params = _model_params(model_size, max_context)
+
+    def build_engine():
+        return InferenceEngineV2(
+            cfg, params,
+            config=RaggedInferenceEngineConfig(
+                state_manager={"max_tracked_sequences": 2 * max_lanes,
+                               "max_ragged_batch_size": 4096,
+                               "max_ragged_sequence_count": max_lanes,
+                               "max_context": max_context},
+                kv_cache={"block_size": block_size,
+                          "num_blocks": num_blocks,
+                          "cache_dtype": "bfloat16"},
+                hcache={"enable_latents": True}))
+
+    eng = build_engine()
+    rng = np.random.default_rng(seed)
+
+    # warm every program the trace can hit, off-clock: each prefill
+    # lane bucket the pool can hold concurrently, the ragged decode
+    # bucket, and the restore chain at both token buckets a mid-trace
+    # restore can land in (a compile inside the trace would corrupt
+    # the percentiles)
+    warm_prompt = list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+    per_req = -(-prompt_len // block_size)
+    fit = max(1, min(max_lanes, (num_blocks - 1) // per_req))
+    for k in range(1, fit + 1):
+        uids = list(range(k))
+        eng.put(uids, [warm_prompt] * k)
+        if k == 1:
+            # decode lanes bucket to 8 regardless of count, so one
+            # decode warms the dispatch for every in-flight size
+            eng.put(uids, [[1]])
+        for u in uids:
+            eng.flush(u)
+    for t in sorted({prompt_len,
+                     min(prompt_len + max_new - 1, max_context - 1)}):
+        toks = list(rng.integers(0, cfg.vocab_size, (t,)))
+        _, lat = eng.put([0], [toks])
+        eng.flush(0)
+        eng.restore_kv([0], [toks], [lat[0]])
+        eng.flush(0)
+
+    arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
+    clock = VirtualClock() if virtual_clock else None
+    server = ServingServer(
+        eng, clock=clock,
+        config=ServerConfig(max_queue_depth=n_requests + 1,
+                            kv_demand_fraction=float("inf")))
+    # arrival times are trace-relative; rebase onto the server's clock
+    # (VirtualClock starts at 0, MonotonicClock wherever it is now)
+    base = server.clock.now()
+    reqs = []
+    for i in range(n_requests):
+        prompt = list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+        # mixed priority classes: the high-priority minority arrives
+        # into a loaded pool and evicts low-priority residents
+        reqs.append(Request(uid=i, prompt=prompt, max_new_tokens=max_new,
+                            arrival_time=base + float(arrive[i]),
+                            priority=5 if i % 5 == 4 else 0))
+    t0 = time.perf_counter()
+    metrics = server.run_trace(reqs)
+    wall_s = time.perf_counter() - t0
+
+    dropped = [r for r in reqs if r.state.name != "DONE"]
+    for r in reqs:
+        emit({"phase": "serve-loop", "request": r.uid,
+              "priority": r.priority, "state": r.state.name,
+              "tokens": len(r.tokens_out),
+              "ttft_s": None if r.ttft() is None
+              else round(r.ttft(), 4),
+              "tpot_s": None if r.tpot() is None
+              else round(r.tpot(), 5),
+              "queue_wait_s": None if r.queue_wait() is None
+              else round(r.queue_wait(), 4),
+              "preemptions": r.n_preemptions,
+              "restores": r.n_restores})
+
+    # restore correctness: preempted streams must equal uninterrupted
+    # greedy decode of the same prompt (the engine is empty post-trace)
+    preempted = sorted((r for r in reqs if r.n_preemptions > 0),
+                       key=lambda r: r.uid)
+    parity = {"checked": 0, "ok": 0}
+    for r in preempted[:parity_checks]:
+        ref = eng.generate([r.prompt], max_new_tokens=r.max_new_tokens)
+        parity["checked"] += 1
+        parity["ok"] += int(ref[0] == r.tokens_out)
+
+    s = metrics.summary()
+    emit({"phase": "serve-loop-summary", "model": model_size,
+          "n_requests": n_requests, "rps": rps,
+          "prompt_len": prompt_len, "max_new": max_new,
+          "kv_blocks": num_blocks, "block_size": block_size,
+          "virtual_clock": bool(virtual_clock),
+          "dropped": len(dropped),
+          "wall_s": round(wall_s, 3),
+          "ttft_s": s["ttft_s"], "tpot_s": s["tpot_s"],
+          "queue_wait_s": s["queue_wait_s"],
+          "preemptions": s["counters"]["preemptions"],
+          "restores": s["counters"]["restores"],
+          "restore_overlap_ratio":
+              s["gauges"]["restore_overlap_ratio"],
+          "restore_stats": dict(eng.restore_stats),
+          "parity": parity,
+          "gen_tokens_per_sec": round(
+              s["counters"]["tokens_out"] / max(wall_s, 1e-9), 1)})
+    if fh is not None:
+        fh.close()
+    if dropped:
+        raise RuntimeError(
+            f"serve_loop dropped {len(dropped)} requests: "
+            f"{[(r.uid, r.state.name, r.reject_reason) for r in dropped]}")
+    if parity["checked"] and parity["ok"] != parity["checked"]:
+        raise RuntimeError(f"restore parity failed: {parity}")
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False, lookup=False):
@@ -758,7 +920,48 @@ def run(model_size="tiny", max_context=512, prompt_len=128,
     return results
 
 
+def _main_serve_loop(argv):
+    p = argparse.ArgumentParser(
+        "hds_serve_bench serve_loop",
+        description="continuous-batching serving loop over a Poisson "
+                    "trace (the serving/ subsystem end-to-end)")
+    p.add_argument("--model", default="tiny",
+                   choices=("tiny", "1b", "7b"))
+    p.add_argument("--max-context", type=int, default=128)
+    p.add_argument("--prompt-len", type=int, default=48)
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--rps", type=float, default=50.0)
+    p.add_argument("--n-requests", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--num-blocks", type=int, default=10,
+                   help="KV pool size; small on purpose so preemption "
+                        "cycles occur mid-trace")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-lanes", type=int, default=4,
+                   help="max sequences per ragged forward")
+    p.add_argument("--virtual-clock", action="store_true",
+                   help="replay on the deterministic simulated "
+                        "timeline instead of wall time")
+    p.add_argument("--out", default="SERVE_LOOP.jsonl",
+                   help="also append rows to this jsonl file "
+                        "('' = stdout only)")
+    args = p.parse_args(argv)
+    run_serve_loop(args.model, args.max_context, args.prompt_len,
+                   max_new=args.max_new, rps=args.rps,
+                   n_requests=args.n_requests, seed=args.seed,
+                   num_blocks=args.num_blocks,
+                   block_size=args.block_size,
+                   max_lanes=args.max_lanes,
+                   virtual_clock=args.virtual_clock, out=args.out)
+    return 0
+
+
 def main(argv=None):
+    if argv is None:
+        import sys
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve_loop":
+        return _main_serve_loop(argv[1:])
     p = argparse.ArgumentParser("hds_serve_bench")
     p.add_argument("--model", default="tiny", choices=("tiny", "1b", "7b"))
     p.add_argument("--max-context", type=int, default=512)
@@ -843,3 +1046,8 @@ def main(argv=None):
             quantize=args.quantize, prefill_chunk=args.prefill_chunk,
             fused=args.fused_decode, lookup=args.lookup_decode)
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -174,6 +174,13 @@ class InferenceEngineV2:
             model_config.n_kv_head, model_config.head_dim,
             dtype=jnp.dtype(kv_cfg.cache_dtype),
             sharding=self.model.cache_sharding())
+        #: restore staging progress for the serving layer: cumulative
+        #: counts of restore groups, sequences, per-chunk dispatches
+        #: issued and latent bytes shipped host->device (a dispatch is
+        #: counted when ISSUED, not when it lands — the serving
+        #: scheduler overlaps the in-flight ship with resident decode)
+        self.restore_stats = {"restores": 0, "sequences": 0,
+                              "chunks_issued": 0, "bytes_shipped": 0}
         log_dist(f"InferenceEngineV2: {num_blocks} KV blocks x "
                  f"{self.block_size} tokens, max_context="
                  f"{self.max_context}", ranks=[0])
@@ -205,6 +212,12 @@ class InferenceEngineV2:
         max_tokens = min(max_request_tokens, self.max_context - seen)
         blocks = self.state.blocks_needed(seq, max_tokens)
         return max_tokens, min(blocks, max_request_blocks)
+
+    @property
+    def free_blocks(self) -> int:
+        """Free KV-pool blocks right now (serving-layer admission and
+        preemption decisions read this between steps)."""
+        return self.state.free_blocks
 
     def can_schedule(self, uids: Iterable[int],
                      lengths: Iterable[int]) -> SchedulingResult:
@@ -978,10 +991,18 @@ class InferenceEngineV2:
         groups: Dict[int, List] = {}
         for item in items:
             groups.setdefault(_bucket(len(item[1])), []).append(item)
+        self.restore_stats["restores"] += 1
+        self.restore_stats["sequences"] += len(items)
+
+        def _progress(layer0, nbytes):
+            self.restore_stats["chunks_issued"] += 1
+            self.restore_stats["bytes_shipped"] += int(nbytes)
+
         for T, group in sorted(groups.items()):
             lat, start, t_len, tables, seqs = \
                 self._stage_restore_group(group, T)
-            self.model.restore_kv(self.cache, lat, start, tables, t_len)
+            self.model.restore_kv(self.cache, lat, start, tables, t_len,
+                                  progress_cb=_progress)
             for seq in seqs:
                 seq.post_forward()
 

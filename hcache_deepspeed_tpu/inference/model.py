@@ -1033,7 +1033,8 @@ class PagedInferenceModel:
         return jax.lax.fori_loop(0, lat_chunk.shape[0], body,
                                  (cache_k, cache_v))
 
-    def restore_kv(self, cache, latents, start, tables, t_len):
+    def restore_kv(self, cache, latents, start, tables, t_len,
+                   progress_cb=None):
         """latents: host array [L, B, T, H] (numpy). Layer-CHUNKED
         dispatches with the next chunk's host→HBM copy issued before this
         chunk's compute — JAX's async dispatch gives the reference's
@@ -1043,7 +1044,12 @@ class PagedInferenceModel:
         host link, while one whole-stack dispatch can't overlap H2D with
         compute and needs the full latent slab in HBM (million-token
         contexts: tens of GB); the chunk size interpolates
-        (``hcache.restore_chunk_layers`` / ``restore_chunk_bytes``)."""
+        (``hcache.restore_chunk_layers`` / ``restore_chunk_bytes``).
+
+        ``progress_cb(layer0, shipped_bytes)`` fires as each chunk's
+        dispatch is ISSUED (still in flight) — the serving scheduler's
+        staging-progress hook; ``shipped_bytes`` is 0 on the
+        already-staged (HBM-resident) path."""
         start = jnp.asarray(start, jnp.int32)
         tables = jnp.asarray(tables, jnp.int32)
         t_len = jnp.asarray(t_len, jnp.int32)
@@ -1079,6 +1085,8 @@ class PagedInferenceModel:
                 ck, cv = self._restore(self.params, ck, cv,
                                        jnp.int32(l0), latents[l0:l0 + C],
                                        start, tables, t_len)
+                if progress_cb is not None:
+                    progress_cb(l0, 0)
             cache.replace(ck, cv)
             return
 
@@ -1103,4 +1111,6 @@ class PagedInferenceModel:
                 buf = ship(bounds[i + 1])
             ck, cv = self._restore(self.params, ck, cv, jnp.int32(l0),
                                    cur, start, tables, t_len)
+            if progress_cb is not None:
+                progress_cb(l0, cur.nbytes)
         cache.replace(ck, cv)

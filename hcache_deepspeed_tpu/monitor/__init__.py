@@ -1,3 +1,3 @@
-from .monitor import MonitorMaster
+from .monitor import InMemoryMonitor, MonitorMaster
 
-__all__ = ["MonitorMaster"]
+__all__ = ["InMemoryMonitor", "MonitorMaster"]

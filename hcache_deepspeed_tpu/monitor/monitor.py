@@ -130,6 +130,29 @@ class CometMonitor(Monitor):
             self._exp.log_metric(label, value, step=step)
 
 
+class InMemoryMonitor(Monitor):
+    """Bounded in-process event buffer (no reference analog).
+
+    The serving frontend emits gauges/histograms continuously; a live
+    operator surface (or a test) often wants the latest values without
+    standing up TensorBoard/W&B. Keeps the last ``capacity`` events and
+    the most recent value per label."""
+
+    def __init__(self, capacity: int = 4096):
+        super().__init__(None)
+        self.enabled = True
+        self.capacity = capacity
+        self.events = []
+        self.latest = {}
+
+    def write_events(self, event_list):
+        for label, value, step in event_list:
+            self.events.append((label, value, step))
+            self.latest[label] = (value, step)
+        if len(self.events) > self.capacity:
+            self.events = self.events[-self.capacity:]
+
+
 class MonitorMaster(Monitor):
     """Reference: monitor/monitor.py:30 — rank-0 fan-out to all writers."""
 

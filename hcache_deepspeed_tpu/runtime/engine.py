@@ -1514,23 +1514,33 @@ class HDSEngine:
                     keys.append(key)
         if not hasattr(self, "_offloaded_shardings"):
             self._offloaded_shardings = {}
+        todo = [k for k in keys
+                if self.state.get(k) is not None
+                and k not in self._offloaded_shardings]
+        # first pass: start the device->host copies of EVERY requested
+        # group before any is awaited — np.asarray on group N must not
+        # serialize behind group N+1's un-issued copies
+        if non_blocking:
+            for key in todo:
+                for x in jax.tree.leaves(self.state[key]):
+                    if isinstance(x, jax.Array):
+                        x.copy_to_host_async()
         moved = 0
-        for key in keys:
-            tree = self.state.get(key)
-            if tree is None or key in self._offloaded_shardings:
-                continue
-            leaves = [x for x in jax.tree.leaves(tree)
-                      if isinstance(x, jax.Array)]
-            if non_blocking:
-                for x in leaves:
-                    x.copy_to_host_async()
+        # None is an empty pytree node; treating it as a leaf here (and
+        # in reload_states, which maps the same two trees together)
+        # keeps tree structures aligned for state groups whose leaves
+        # are not all jax.Arrays
+        _is_none = (lambda x: x is None)
+        for key in todo:
+            tree = self.state[key]
             self._offloaded_shardings[key] = jax.tree.map(
                 lambda x: x.sharding if isinstance(x, jax.Array) else None,
-                tree)
+                tree, is_leaf=_is_none)
             self.state[key] = jax.tree.map(
                 lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
-                tree)
-            moved += sum(x.nbytes for x in leaves)
+                tree, is_leaf=_is_none)
+            moved += sum(x.nbytes for x in jax.tree.leaves(tree)
+                         if isinstance(x, jax.Array))
         log_dist(f"offload_states: moved {sorted(keys)} "
                  f"({moved / 2**20:.1f} MiB) to host", ranks=[0])
 
@@ -1544,10 +1554,15 @@ class HDSEngine:
         if not shardings:
             return
         for key, sh_tree in shardings.items():
+            # is_leaf matches the sharding-tree build in offload_states:
+            # non-array positions hold None (an empty pytree node), which
+            # would otherwise raise a tree-structure mismatch against a
+            # state tree whose leaf there is a real (non-jax.Array) value
             self.state[key] = jax.tree.map(
                 lambda x, s: jax.device_put(x, s)
-                if s is not None else x,
-                self.state[key], sh_tree)
+                if s is not None and x is not None else x,
+                self.state[key], sh_tree,
+                is_leaf=lambda x: x is None)
         if not non_blocking:
             for key in shardings:
                 for x in jax.tree.leaves(self.state[key]):

@@ -1,0 +1,20 @@
+"""Continuous-batching serving subsystem over ``InferenceEngineV2``.
+
+No reference analog inside DeepSpeed itself — the reference delegates
+this layer to MII's serving loop. Here it is built in: a request
+lifecycle (``request.py``), a continuous-batching scheduler with
+HCache-aware preemption and restore/decode overlap (``scheduler.py``),
+a thread-based frontend with admission control and a deterministic
+virtual-clock simulation mode (``server.py``), and serving metrics
+emitted through the ``monitor.MonitorMaster`` event path
+(``metrics.py``). ``sim.py`` provides a model-free engine double with
+the real block-budget arithmetic so the whole policy is CPU-testable.
+"""
+
+from .clock import MonotonicClock, VirtualClock  # noqa: F401
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .request import Request, RequestState  # noqa: F401
+from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
+                        StepReport)
+from .server import ServerConfig, ServingServer  # noqa: F401
+from .sim import SimulatedEngine  # noqa: F401

@@ -1,0 +1,40 @@
+"""Clock abstraction for the serving loop.
+
+The scheduler and server never call ``time`` directly — they read a
+``Clock``. ``MonotonicClock`` serves production; ``VirtualClock`` makes
+the whole scheduling policy a deterministic function of (trace, seed):
+time advances only when the simulation says so, so two runs of the same
+trace produce identical admission/preemption event logs (asserted in
+``tests/unit/serving/``).
+"""
+
+import time
+
+
+class MonotonicClock:
+    """Wall clock (monotonic): real serving and on-hardware benches."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, dt: float) -> None:
+        if dt > 0:
+            time.sleep(dt)
+
+
+class VirtualClock:
+    """Deterministic simulated clock; ``sleep`` advances it instantly."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def sleep(self, dt: float) -> None:
+        if dt > 0:
+            self._t += dt
+
+    def advance_to(self, t: float) -> None:
+        """Jump forward (never backward) to absolute time ``t``."""
+        self._t = max(self._t, float(t))

@@ -1,0 +1,159 @@
+"""Serving metrics: per-request histograms + scheduler gauges.
+
+Emission rides the existing monitor event path: :meth:`ServingMetrics.
+emit` produces the same ``(label, value, step)`` tuples
+``monitor.MonitorMaster.write_events`` fans out to
+TensorBoard/W&B/Comet/CSV, so serving telemetry lands wherever training
+telemetry already does — no new sink plumbing.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Histogram:
+    """Streaming histogram over fixed buckets + exact percentiles.
+
+    Keeps every observation (serving traces are bounded — 1e6 floats is
+    8 MB) so percentile queries are exact; bucket counts come along for
+    sinks that want a distribution rather than quantiles.
+    """
+
+    def __init__(self, buckets: Tuple[float, ...] = ()):
+        self.buckets = tuple(sorted(buckets))
+        self.bucket_counts = [0] * (len(self.buckets) + 1)
+        self._values: List[float] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self._values.append(value)
+        for i, edge in enumerate(self.buckets):
+            if value <= edge:
+                self.bucket_counts[i] += 1
+                return
+        self.bucket_counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+    @property
+    def sum(self) -> float:
+        return float(np.sum(self._values)) if self._values else 0.0
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self._values else None
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self._values:
+            return None
+        return float(np.percentile(np.asarray(self._values), q))
+
+    def summary(self) -> Dict:
+        if not self._values:
+            return {"count": 0}
+        return {"count": self.count,
+                "mean": round(self.mean(), 6),
+                "p50": round(self.percentile(50), 6),
+                "p90": round(self.percentile(90), 6),
+                "p99": round(self.percentile(99), 6)}
+
+
+class ServingMetrics:
+    """Aggregates the scheduler's StepReports + finished requests."""
+
+    def __init__(self):
+        self.ttft = Histogram()
+        self.tpot = Histogram()
+        self.queue_wait = Histogram()
+        self.preemptions_per_request = Histogram()
+        self.counters = {"admitted": 0, "finished": 0, "cancelled": 0,
+                         "preemptions": 0, "restores": 0,
+                         "overlapped_restores": 0, "tokens_out": 0,
+                         "steps": 0, "idle_steps": 0}
+        self.rejected: Dict[str, int] = {}
+        # last-step gauges
+        self.gauges = {"batch_occupancy": 0.0, "kv_utilization": 0.0,
+                       "queue_depth": 0.0, "suspended": 0.0,
+                       "restore_overlap_ratio": 0.0}
+
+    # ------------------------------------------------------------- #
+    # scheduler hooks
+    # ------------------------------------------------------------- #
+    def on_step(self, report, scheduler) -> None:
+        c = self.counters
+        c["steps"] += 1
+        if not report.work_done:
+            c["idle_steps"] += 1
+        c["admitted"] += len(report.admitted)
+        c["preemptions"] += len(report.preempted)
+        c["restores"] += len(report.restored)
+        c["overlapped_restores"] += report.overlapped_restores
+        for _, reason in report.rejected:
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        engine = scheduler.engine
+        sm = engine.config.state_manager
+        lanes = report.decode_lanes + len(report.admitted)
+        self.gauges["batch_occupancy"] = \
+            lanes / max(sm.max_ragged_sequence_count, 1)
+        alloc = engine.state.allocator
+        self.gauges["kv_utilization"] = \
+            1.0 - alloc.free_blocks / max(alloc.num_blocks, 1)
+        self.gauges["queue_depth"] = float(len(scheduler.queue))
+        self.gauges["suspended"] = float(len(scheduler.suspended))
+        if scheduler.total_restores:
+            self.gauges["restore_overlap_ratio"] = \
+                scheduler.overlapped_restores / scheduler.total_restores
+
+    def on_finish(self, req) -> None:
+        if req.reject_reason and req.reject_reason != "cancelled":
+            return                      # rejections counted via reports
+        key = "cancelled" if req.cancelled else "finished"
+        self.counters[key] += 1
+        self.counters["tokens_out"] += len(req.tokens_out)
+        if req.ttft() is not None:
+            self.ttft.observe(req.ttft())
+        if req.tpot() is not None:
+            self.tpot.observe(req.tpot())
+        if req.queue_wait() is not None:
+            self.queue_wait.observe(req.queue_wait())
+        self.preemptions_per_request.observe(req.n_preemptions)
+
+    # ------------------------------------------------------------- #
+    # sinks
+    # ------------------------------------------------------------- #
+    def events(self, step: int) -> List[Tuple[str, float, int]]:
+        """The monitor event-tuple list for one emission step."""
+        out = []
+        for name, hist in (("ttft_s", self.ttft), ("tpot_s", self.tpot),
+                           ("queue_wait_s", self.queue_wait)):
+            for q in (50, 90, 99):
+                v = hist.percentile(q)
+                if v is not None:
+                    out.append((f"serving/{name}/p{q}", v, step))
+        for name, value in self.gauges.items():
+            out.append((f"serving/{name}", float(value), step))
+        for name, value in self.counters.items():
+            out.append((f"serving/{name}", float(value), step))
+        for reason, n in sorted(self.rejected.items()):
+            out.append((f"serving/rejected/{reason}", float(n), step))
+        return out
+
+    def emit(self, monitor, step: int) -> None:
+        """Write through the MonitorMaster fan-out (rank-0 gated there)."""
+        if monitor is None or not getattr(monitor, "enabled", True):
+            return
+        monitor.write_events(self.events(step))
+
+    def summary(self) -> Dict:
+        return {
+            "ttft_s": self.ttft.summary(),
+            "tpot_s": self.tpot.summary(),
+            "queue_wait_s": self.queue_wait.summary(),
+            "preemptions_per_request":
+                self.preemptions_per_request.summary(),
+            "counters": dict(self.counters),
+            "rejected": dict(self.rejected),
+            "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
+        }

@@ -1,0 +1,142 @@
+"""Per-request lifecycle for the serving subsystem.
+
+State machine::
+
+    QUEUED -> PREFILL -> DECODE -> DONE
+                 |          ^  \\
+                 v          |   -> SUSPENDED -> RESTORING -> DECODE
+              (SUSPENDED)   +------------------------------------+
+    QUEUED -> REJECTED          (cancel: any live state -> DONE)
+
+``SUSPENDED`` means the request's KV left the device — either as exact
+host KV (``suspend_sequence``) or as HCache latents after a flush —
+and ``RESTORING`` covers the step in which the restore dispatch is in
+flight, overlapped with resident decode. Illegal transitions raise, so
+scheduler bugs surface at the exact transition rather than as silently
+wrong accounting.
+"""
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import List, Optional
+
+import numpy as np
+
+
+class RequestState(Enum):
+    QUEUED = 0
+    PREFILL = 1
+    DECODE = 2
+    SUSPENDED = 3
+    RESTORING = 4
+    DONE = 5
+    REJECTED = 6
+
+
+#: legal transitions; DONE/REJECTED are terminal. Cancellation is the
+#: one cross-cutting edge: any live state may close out to DONE.
+_TRANSITIONS = {
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.REJECTED,
+                          RequestState.DONE},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.SUSPENDED,
+                           RequestState.DONE},
+    RequestState.DECODE: {RequestState.SUSPENDED, RequestState.DONE},
+    RequestState.SUSPENDED: {RequestState.RESTORING, RequestState.DONE},
+    RequestState.RESTORING: {RequestState.DECODE, RequestState.DONE},
+    RequestState.DONE: set(),
+    RequestState.REJECTED: set(),
+}
+
+
+@dataclass
+class Request:
+    """One serving request plus its lifecycle bookkeeping.
+
+    ``priority``: larger = more important; preemption victims are
+    picked lowest-priority-first. ``deadline`` is an absolute clock
+    time (same clock as the scheduler's); among equal priorities the
+    latest deadline is evicted first.
+    """
+
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int = 32
+    arrival_time: float = 0.0
+    deadline: Optional[float] = None
+    priority: int = 0
+    eos_token_id: Optional[int] = None
+
+    state: RequestState = RequestState.QUEUED
+    tokens_out: List[int] = field(default_factory=list)
+    #: accumulated HCache latents [L, T, H] covering prompt + all fed
+    #: tokens (i.e. every token whose KV is cached) — the restore
+    #: payload when this request is preempted in latent mode.
+    latents: Optional[np.ndarray] = None
+    #: exact-KV preempt mode: engine keeps host KV under this uid.
+    reject_reason: str = ""
+    cancelled: bool = False
+
+    # timeline (clock units of the owning scheduler)
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    #: scheduler step index of the most recent suspend (anti-thrash:
+    #: never restored in the same step it was evicted)
+    suspended_in_step: int = -1
+    n_preemptions: int = 0
+    n_restores: int = 0
+
+    def transition(self, new_state: RequestState) -> None:
+        if new_state not in _TRANSITIONS[self.state]:
+            raise ValueError(
+                f"request {self.uid}: illegal transition "
+                f"{self.state.name} -> {new_state.name}")
+        self.state = new_state
+
+    # ------------------------------------------------------------- #
+    # derived quantities the scheduler/budgeter reads
+    # ------------------------------------------------------------- #
+    @property
+    def total_tokens(self) -> int:
+        """Worst-case context footprint: prompt + whole generation."""
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def cached_tokens(self) -> int:
+        """Tokens whose KV is (or must be restored to be) on device:
+        the prompt plus every generated token already fed back."""
+        return len(self.prompt) + max(len(self.tokens_out) - 1, 0)
+
+    @property
+    def remaining_tokens(self) -> int:
+        return max(self.max_new_tokens - len(self.tokens_out), 0)
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.DONE, RequestState.REJECTED)
+
+    def absorb_latents(self, new_latents) -> None:
+        if new_latents is None:
+            return
+        new_latents = np.asarray(new_latents)
+        self.latents = new_latents if self.latents is None else \
+            np.concatenate([self.latents, new_latents], axis=1)
+
+    # timing summaries (None until the respective edge happened)
+    def ttft(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.arrival_time
+
+    def tpot(self) -> Optional[float]:
+        """Mean time per output token after the first."""
+        if self.finished_at is None or self.first_token_at is None or \
+                len(self.tokens_out) < 2:
+            return None
+        return (self.finished_at - self.first_token_at) / \
+            (len(self.tokens_out) - 1)
+
+    def queue_wait(self) -> Optional[float]:
+        if self.admitted_at is None:
+            return None
+        return self.admitted_at - self.arrival_time

@@ -1,0 +1,445 @@
+"""Continuous-batching scheduler with HCache-aware preemption.
+
+Each :meth:`ContinuousBatchingScheduler.step` builds ONE ragged
+``put()`` mixing the resident sequences' decode tokens with newly
+admitted prompts (the FastGen continuous-batching discipline the
+engine's ``generate()`` loop uses), but adds what a production frontend
+needs on top:
+
+* **admission by verdict** — every ``can_schedule`` rejection routes
+  through :data:`..inference.scheduling.BACKPRESSURE_ACTION`, so each
+  failure mode gets its own corrective action (wait / skip / preempt /
+  reject) instead of a blanket retry;
+* **preemption under KV pressure** — victims are chosen lowest
+  priority first (then latest deadline, then youngest) and suspended to
+  HOST: in latent mode the sequence is flushed outright and its HCache
+  latents (already accumulated on host by ``put``'s capture path) become
+  the restore payload; in exact-KV mode ``suspend_sequence`` copies the
+  cache blocks out;
+* **restore overlapped with decode** — a suspended request re-enters
+  through ``restore_kv``, issued in the same host step as (and with no
+  host sync before) the residents' decode dispatch: the latent host→HBM
+  ships run on the transfer stream while the previous dispatches
+  compute, the same independent-resources overlap (host link vs MXU) as
+  T3's NIC-vs-SM fine-grained overlap (arXiv:2401.16677).
+
+The scheduler is clock- and engine-agnostic: with a ``VirtualClock``
+and a :class:`.sim.SimulatedEngine` the whole policy is a deterministic
+pure function of (trace, seed) — ``events`` is the replayable log the
+determinism tests assert on.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..inference.scheduling import (BACKPRESSURE_ACTION, BackpressureAction,
+                                    SchedulingResult)
+from .clock import MonotonicClock
+from .request import Request, RequestState
+
+
+def greedy_sample(req: Request, logits_row) -> int:
+    return int(np.argmax(logits_row))
+
+
+@dataclass
+class StepReport:
+    """What one scheduler step did (the server's cost model and the
+    metrics layer both consume this)."""
+    step: int
+    t: float
+    admitted: List[int] = field(default_factory=list)
+    rejected: List[Tuple[int, str]] = field(default_factory=list)
+    preempted: List[int] = field(default_factory=list)
+    restored: List[int] = field(default_factory=list)
+    finished: List[int] = field(default_factory=list)
+    cancelled: List[int] = field(default_factory=list)
+    decode_lanes: int = 0
+    prefill_tokens: int = 0
+    restored_tokens: int = 0
+    #: restore dispatches issued concurrently with resident decode
+    #: (the overlap the HCache story is about)
+    overlapped_restores: int = 0
+
+    @property
+    def work_done(self) -> bool:
+        return bool(self.admitted or self.restored or self.finished or
+                    self.decode_lanes or self.prefill_tokens or
+                    self.rejected or self.preempted or self.cancelled)
+
+
+class ContinuousBatchingScheduler:
+    """Single-threaded scheduling core (the server serializes access).
+
+    ``engine`` needs the ``InferenceEngineV2`` serving surface:
+    ``can_schedule``/``put``/``flush``/``restore_kv``/
+    ``suspend_sequence``/``resume_sequence``, ``state``, ``block_size``,
+    ``max_context`` and ``config`` — :class:`.sim.SimulatedEngine`
+    provides the same surface without a model.
+    """
+
+    def __init__(self, engine, clock=None,
+                 sample_fn: Callable[[Request, np.ndarray], int] = None,
+                 metrics=None):
+        self.engine = engine
+        self.clock = clock or MonotonicClock()
+        self.sample_fn = sample_fn or greedy_sample
+        self.metrics = metrics
+        #: latent-preempt mode: evict = flush + keep host latents,
+        #: restore = restore_kv (frees the tracked slot too). Without
+        #: latent capture the exact-KV suspend/resume path is used.
+        self.latent_preemption = bool(engine.config.hcache.enable_latents)
+
+        self.queue: List[Request] = []           # QUEUED, submit order
+        self.running: Dict[int, Request] = {}    # DECODE residents
+        self.suspended: Dict[int, Request] = {}  # SUSPENDED (KV on host)
+        self.done: Dict[int, Request] = {}       # DONE / REJECTED
+        #: replayable (step, event, uid, detail) log; identical across
+        #: runs of the same trace under a virtual clock
+        self.events: List[Tuple[int, str, int, str]] = []
+        self.step_idx = 0
+        self.total_restores = 0
+        self.overlapped_restores = 0
+
+    # ------------------------------------------------------------- #
+    # intake
+    # ------------------------------------------------------------- #
+    def submit(self, req: Request) -> None:
+        self._event("queued", req.uid, f"prio={req.priority}")
+        self.queue.append(req)
+
+    def cancel(self, uid: int) -> None:
+        """Mark a request for cancellation; honored at the next step."""
+        for pool in (self.queue, self.running.values(),
+                     self.suspended.values()):
+            for req in pool:
+                if req.uid == uid:
+                    req.cancelled = True
+                    return
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue or self.running or self.suspended)
+
+    def request(self, uid: int) -> Optional[Request]:
+        if uid in self.done:
+            return self.done[uid]
+        if uid in self.running:
+            return self.running[uid]
+        if uid in self.suspended:
+            return self.suspended[uid]
+        for req in self.queue:
+            if req.uid == uid:
+                return req
+        return None
+
+    # ------------------------------------------------------------- #
+    # one continuous-batching step
+    # ------------------------------------------------------------- #
+    def step(self) -> StepReport:
+        self.step_idx += 1
+        now = self.clock.now()
+        report = StepReport(step=self.step_idx, t=now)
+        self._cancellation_pass(report)
+        self._restore_pass(report)
+        admits = self._admission_pass(report, now)
+        admits = self._pressure_pass(admits, report)
+        self._dispatch(admits, report, now)
+        if self.metrics is not None:
+            self.metrics.on_step(report, self)
+        return report
+
+    # ------------------------------------------------------------- #
+    def _event(self, event: str, uid: int, detail: str = "") -> None:
+        self.events.append((self.step_idx, event, uid, detail))
+
+    def _close(self, req: Request, report: StepReport, now: float,
+               cancelled: bool = False) -> None:
+        req.finished_at = now
+        req.transition(RequestState.DONE)
+        self.done[req.uid] = req
+        (report.cancelled if cancelled else report.finished).append(req.uid)
+        self._event("cancel" if cancelled else "finish", req.uid,
+                    f"tokens={len(req.tokens_out)}")
+        if self.metrics is not None:
+            self.metrics.on_finish(req)
+
+    def _reject(self, req: Request, reason: str,
+                report: StepReport) -> None:
+        req.reject_reason = reason
+        req.transition(RequestState.REJECTED)
+        req.finished_at = self.clock.now()
+        self.done[req.uid] = req
+        report.rejected.append((req.uid, reason))
+        self._event("reject", req.uid, reason)
+        if self.metrics is not None:
+            self.metrics.on_finish(req)
+
+    def _cancellation_pass(self, report: StepReport) -> None:
+        now = self.clock.now()
+        for req in [r for r in self.queue if r.cancelled]:
+            self.queue.remove(req)
+            self._reject(req, "cancelled", report)
+        for uid in [u for u, r in self.running.items() if r.cancelled]:
+            req = self.running.pop(uid)
+            self.engine.flush(uid)
+            self._close(req, report, now, cancelled=True)
+        for uid in [u for u, r in self.suspended.items() if r.cancelled]:
+            req = self.suspended.pop(uid)
+            if not self.latent_preemption:
+                # exact-KV mode keeps the sequence tracked (host copy
+                # attached) while suspended; release the slot
+                self.engine.flush(uid)
+            self._close(req, report, now, cancelled=True)
+
+    # ------------------------------------------------------------- #
+    # restore (suspended -> RESTORING, dispatch overlapped with decode)
+    # ------------------------------------------------------------- #
+    def _restore_candidates(self) -> List[Request]:
+        """Suspended requests that fit back right now, best-first.
+
+        Budget checks mirror the engine's so ``restore_kv`` cannot
+        raise mid-step: a tracked slot (latent mode re-creates the
+        sequence), KV blocks for the full cached span plus a decode
+        headroom of one block per resident (residents crossing a block
+        boundary next step must not be starved by the restore — the
+        anti-thrash guard), and a free decode lane next step.
+        """
+        sm = self.engine.config.state_manager
+        free = self.engine.state.free_blocks
+        headroom = len(self.running)
+        lanes = len(self.running)
+        tracked = self.engine.state.n_tracked_sequences
+        out = []
+        order = sorted(self.suspended.values(),
+                       key=lambda r: (-r.priority, r.arrival_time, r.uid))
+        for req in order:
+            if req.suspended_in_step >= self.step_idx:
+                continue      # never restore in the eviction step
+            if lanes + 1 > sm.max_ragged_sequence_count:
+                break
+            if self.latent_preemption:
+                need = -(-req.cached_tokens // self.engine.block_size)
+                if tracked + 1 > sm.max_tracked_sequences:
+                    break
+            else:
+                seq = self.engine.state.get_sequence(req.uid)
+                need = self.engine.state.blocks_needed(seq, 0)
+            if need > free - headroom:
+                continue      # smaller suspendees may still fit
+            free -= need
+            lanes += 1
+            tracked += 1
+            out.append(req)
+        return out
+
+    def _restore_pass(self, report: StepReport) -> None:
+        for req in self._restore_candidates():
+            del self.suspended[req.uid]
+            req.transition(RequestState.RESTORING)
+            if self.latent_preemption:
+                tokens = list(req.prompt) + req.tokens_out[:-1]
+                self.engine.restore_kv([req.uid], [tokens],
+                                       [req.latents])
+                mode = "latents"
+            else:
+                self.engine.resume_sequence(req.uid)
+                mode = "kv"
+            req.n_restores += 1
+            self.total_restores += 1
+            report.restored.append(req.uid)
+            report.restored_tokens += req.cached_tokens
+            self._event("restore", req.uid,
+                        f"mode={mode} tokens={req.cached_tokens}")
+            # back into the decode set: the restore dispatches are in
+            # flight, un-synced; the residents' decode put() issued
+            # below ships/computes behind them on independent streams.
+            # The sequence decodes again from the NEXT step's batch
+            # (its next fed token is tokens_out[-1]).
+            req.transition(RequestState.DECODE)
+            self.running[req.uid] = req
+
+    # ------------------------------------------------------------- #
+    # admission (queue -> this step's prefill set)
+    # ------------------------------------------------------------- #
+    def _admission_order(self) -> List[Request]:
+        return sorted(self.queue,
+                      key=lambda r: (-r.priority, r.arrival_time, r.uid))
+
+    def _victims(self, exclude=()) -> List[Request]:
+        """Preemption victims, best-victim-first: lowest priority, then
+        latest deadline (no deadline = least urgent), youngest last-in
+        first-evicted, uid as the deterministic tiebreak."""
+        cand = [r for r in self.running.values()
+                if r.uid not in exclude and
+                r.state == RequestState.DECODE]
+        return sorted(
+            cand,
+            key=lambda r: (r.priority,
+                           -(r.deadline if r.deadline is not None
+                             else float("inf")),
+                           -r.arrival_time, -r.uid))
+
+    def _preempt(self, req: Request, report: StepReport) -> None:
+        del self.running[req.uid]
+        if self.latent_preemption:
+            # HCache eviction: the accumulated latents ARE the host
+            # copy; drop the device KV and the tracked slot entirely
+            assert req.latents is not None and \
+                req.latents.shape[1] == req.cached_tokens, \
+                f"latent cover mismatch for uid {req.uid}"
+            self.engine.flush(req.uid)
+            mode = "latents"
+        else:
+            self.engine.suspend_sequence(req.uid)
+            mode = "kv"
+        req.transition(RequestState.SUSPENDED)
+        req.n_preemptions += 1
+        req.suspended_in_step = self.step_idx
+        self.suspended[req.uid] = req
+        report.preempted.append(req.uid)
+        self._event("preempt", req.uid, f"mode={mode}")
+
+    def _trial_verdict(self, admits: List[Request],
+                       cand: Optional[Request]) -> SchedulingResult:
+        reqs = admits + ([cand] if cand is not None else [])
+        uids = list(self.running) + [r.uid for r in reqs]
+        lens = [1] * len(self.running) + [len(r.prompt) for r in reqs]
+        if not uids:
+            return SchedulingResult.Success
+        return self.engine.can_schedule(uids, lens)
+
+    def _admission_pass(self, report: StepReport,
+                        now: float) -> List[Request]:
+        admits: List[Request] = []
+        for req in self._admission_order():
+            if req.arrival_time > now:
+                continue
+            if req.total_tokens > self.engine.max_context:
+                # permanent: no schedule can ever fit this request
+                self.queue.remove(req)
+                self._reject(req, "SequenceTokenLimitExceeded", report)
+                continue
+            sm = self.engine.config.state_manager
+            per_fwd = min(len(req.prompt), sm.prefill_chunk) \
+                if sm.prefill_chunk else len(req.prompt)
+            if per_fwd > sm.max_ragged_batch_size:
+                # also permanent: the prompt alone overflows every
+                # forward's token budget and nothing will chunk it
+                self.queue.remove(req)
+                self._reject(req, "BatchTokenLimitExceeded", report)
+                continue
+            while True:
+                verdict = self._trial_verdict(admits, req)
+                action = BACKPRESSURE_ACTION[verdict]
+                if action != BackpressureAction.PREEMPT:
+                    break
+                victims = [v for v in self._victims()
+                           if v.priority < req.priority]
+                if not victims:
+                    if not self.running and not self.suspended and \
+                            not admits:
+                        # alone on an empty engine and still over the
+                        # pool: permanent
+                        action = BackpressureAction.REJECT
+                        verdict = SchedulingResult.KVCacheLimitExceeded
+                    break
+                self._preempt(victims[0], report)
+            if action == BackpressureAction.ADMIT:
+                admits.append(req)
+            elif action == BackpressureAction.SKIP_CANDIDATE:
+                self._event("skip", req.uid, verdict.name)
+                continue
+            elif action == BackpressureAction.REJECT:
+                self.queue.remove(req)
+                self._reject(req, verdict.name, report)
+            elif action in (BackpressureAction.NEXT_STEP,
+                            BackpressureAction.WAIT_TRACKED_SLOT,
+                            BackpressureAction.PREEMPT):
+                # batch full / waiting on a slot or on blocks nobody
+                # preemptible holds: stop scanning this step
+                self._event("wait", req.uid, verdict.name)
+                break
+        return admits
+
+    # ------------------------------------------------------------- #
+    # KV pressure on the composed step (residents' decode growth)
+    # ------------------------------------------------------------- #
+    def _pressure_pass(self, admits: List[Request],
+                       report: StepReport) -> List[Request]:
+        while True:
+            verdict = self._trial_verdict(admits, None)
+            if verdict == SchedulingResult.Success:
+                return admits
+            if verdict == SchedulingResult.KVCacheLimitExceeded:
+                exclude = {r.uid for r in admits}
+                victims = self._victims(exclude=exclude)
+                if victims:
+                    self._preempt(victims[0], report)
+                    continue
+            if admits:
+                # shed the newest admission back to the queue (it was
+                # never transitioned, so it simply stays QUEUED)
+                self._event("shed", admits[-1].uid, verdict.name)
+                admits.pop()
+                continue
+            # residents alone still over budget and nothing to shed:
+            # suspend the worst victim (it is in the batch itself)
+            victims = self._victims()
+            if not victims:
+                raise RuntimeError(
+                    f"scheduler wedged: verdict {verdict} with no "
+                    "admissions and no preemptible residents")
+            self._preempt(victims[0], report)
+
+    # ------------------------------------------------------------- #
+    # dispatch: ONE ragged put for decodes + admitted prefills
+    # ------------------------------------------------------------- #
+    def _dispatch(self, admits: List[Request], report: StepReport,
+                  now: float) -> None:
+        # overlap accounting: restores issued this step share the
+        # device queue with this decode dispatch — no host sync between
+        # them, so the latent H2D ships hide under replay/decode compute
+        if report.restored:
+            residents = [u for u in self.running
+                         if u not in set(report.restored)]
+            if residents:
+                report.overlapped_restores = len(report.restored)
+                self.overlapped_restores += len(report.restored)
+
+        decodes = [r for u, r in self.running.items()
+                   if u not in set(report.restored)]
+        for req in admits:
+            self.queue.remove(req)
+            req.transition(RequestState.PREFILL)
+            req.admitted_at = now
+            report.admitted.append(req.uid)
+            self._event("admit", req.uid,
+                        f"prompt={len(req.prompt)}")
+        step_reqs = decodes + admits
+        if not step_reqs:
+            return
+        toks = [[r.tokens_out[-1]] for r in decodes] + \
+            [r.prompt for r in admits]
+        report.decode_lanes = len(decodes)
+        report.prefill_tokens = sum(len(r.prompt) for r in admits)
+        logits, latents = self.engine.put([r.uid for r in step_reqs],
+                                          toks)
+        for j, req in enumerate(step_reqs):
+            if self.latent_preemption:
+                req.absorb_latents(latents[j])
+            tok = self.sample_fn(req, logits[j])
+            req.tokens_out.append(tok)
+            if req.first_token_at is None:
+                req.first_token_at = now
+            if req.state == RequestState.PREFILL:
+                req.transition(RequestState.DECODE)
+                self.running[req.uid] = req
+            if len(req.tokens_out) >= req.max_new_tokens or (
+                    req.eos_token_id is not None and
+                    tok == req.eos_token_id):
+                del self.running[req.uid]
+                self.engine.flush(req.uid)
+                self._close(req, report, now)

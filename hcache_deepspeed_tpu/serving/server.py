@@ -1,0 +1,231 @@
+"""Thread-based serving frontend with admission control.
+
+Two operating modes over one scheduler:
+
+* **thread mode** (production shape): callers ``submit()`` from any
+  thread into a bounded ingress queue; a single scheduler thread drains
+  it and runs continuous-batching steps against the engine. One thread
+  owns the engine — the ragged engine is not thread-safe, and a single
+  dispatch loop is the TPU-native discipline anyway.
+* **virtual-clock simulation** (``run_trace`` with a
+  :class:`.clock.VirtualClock`): the same scheduler steps over a
+  simulated timeline whose step costs come from a deterministic cost
+  model, so the entire policy — admissions, preemptions, restores,
+  token streams — replays identically for the same trace. This is what
+  makes the subsystem CPU-testable without a TPU.
+
+Admission control happens at ingress, before the scheduler sees the
+request: a full queue or an estimated-KV-demand overload rejects
+immediately with a distinct reason (the caller can shed load upstream),
+while schedulable-but-not-yet requests queue normally.
+"""
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .clock import MonotonicClock, VirtualClock
+from .metrics import ServingMetrics
+from .request import Request, RequestState
+from .scheduler import ContinuousBatchingScheduler
+
+
+@dataclass
+class ServerConfig:
+    #: ingress bound: queued-but-not-admitted requests beyond this are
+    #: rejected with reason "queue_full"
+    max_queue_depth: int = 64
+    #: reject when the estimated whole-stretch KV demand of every live
+    #: request exceeds this multiple of the usable block pool (demand
+    #: beyond 1.0 is served by queueing + preemption; this caps how far
+    #: the backlog may run ahead of the hardware)
+    kv_demand_fraction: float = 8.0
+    #: thread mode: sleep when a step had nothing to do
+    idle_sleep_s: float = 0.002
+    # -- virtual-clock cost model (seconds) -------------------------- #
+    step_overhead_s: float = 1e-3
+    prefill_token_s: float = 1e-4
+    decode_lane_s: float = 5e-4
+    restore_token_s: float = 2e-5
+
+
+class ServingServer:
+
+    def __init__(self, engine, config: ServerConfig = None, clock=None,
+                 metrics: ServingMetrics = None, sample_fn=None,
+                 monitor=None, emit_every_steps: int = 50):
+        self.config = config or ServerConfig()
+        self.clock = clock or MonotonicClock()
+        self.virtual = isinstance(self.clock, VirtualClock)
+        self.metrics = metrics or ServingMetrics()
+        self.scheduler = ContinuousBatchingScheduler(
+            engine, clock=self.clock, sample_fn=sample_fn,
+            metrics=self.metrics)
+        self.monitor = monitor
+        self.emit_every_steps = emit_every_steps
+        self._lock = threading.Lock()
+        self._ingress: List[Request] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_uid = 0
+
+    # ------------------------------------------------------------- #
+    # ingress
+    # ------------------------------------------------------------- #
+    def _estimated_demand_blocks(self) -> int:
+        bs = self.scheduler.engine.block_size
+        live = (self._ingress + self.scheduler.queue +
+                list(self.scheduler.running.values()) +
+                list(self.scheduler.suspended.values()))
+        return sum(-(-r.total_tokens // bs) for r in live)
+
+    def _usable_blocks(self) -> int:
+        return self.scheduler.engine.state.allocator.num_blocks - 1
+
+    def submit(self, prompt=None, request: Request = None,
+               **kw) -> Request:
+        """Enqueue a request (or build one from ``prompt`` + kwargs).
+
+        Returns the request; a rejected one comes back already in
+        ``REJECTED`` state with ``reject_reason`` set ("queue_full" or
+        "kv_overload") — the caller is expected to check.
+        """
+        with self._lock:
+            if request is None:
+                request = Request(uid=self._next_uid, prompt=list(prompt),
+                                  arrival_time=self.clock.now(), **kw)
+            self._next_uid = max(self._next_uid, request.uid) + 1
+            depth = len(self._ingress) + len(self.scheduler.queue)
+            reason = ""
+            if depth >= self.config.max_queue_depth:
+                reason = "queue_full"
+            else:
+                bs = self.scheduler.engine.block_size
+                demand = self._estimated_demand_blocks() + \
+                    -(-request.total_tokens // bs)
+                if demand > self.config.kv_demand_fraction * \
+                        self._usable_blocks():
+                    reason = "kv_overload"
+            if reason:
+                request.reject_reason = reason
+                request.transition(RequestState.REJECTED)
+                request.finished_at = self.clock.now()
+                self.scheduler.done[request.uid] = request
+                self.scheduler.events.append(
+                    (self.scheduler.step_idx, "reject_ingress",
+                     request.uid, reason))
+                self.metrics.rejected[reason] = \
+                    self.metrics.rejected.get(reason, 0) + 1
+                return request
+            self._ingress.append(request)
+            return request
+
+    def cancel(self, uid: int) -> None:
+        with self._lock:
+            for req in self._ingress:
+                if req.uid == uid:
+                    req.cancelled = True
+                    return
+            self.scheduler.cancel(uid)
+
+    # ------------------------------------------------------------- #
+    # stepping
+    # ------------------------------------------------------------- #
+    def _virtual_cost(self, report) -> float:
+        c = self.config
+        return (c.step_overhead_s +
+                c.prefill_token_s * report.prefill_tokens +
+                c.decode_lane_s * (report.decode_lanes +
+                                   len(report.admitted)) +
+                c.restore_token_s * report.restored_tokens)
+
+    def step(self):
+        """Drain ingress + one scheduler step (thread mode calls this
+        in a loop; simulation calls it from ``run_trace``)."""
+        with self._lock:
+            for req in self._ingress:
+                self.scheduler.submit(req)
+            self._ingress.clear()
+            report = self.scheduler.step()
+            if self.virtual:
+                self.clock.sleep(self._virtual_cost(report))
+            if self.monitor is not None and \
+                    report.step % self.emit_every_steps == 0:
+                self.metrics.emit(self.monitor, report.step)
+        return report
+
+    # ------------------------------------------------------------- #
+    # deterministic trace replay (simulation AND single-thread bench)
+    # ------------------------------------------------------------- #
+    def run_trace(self, requests: List[Request],
+                  max_steps: int = 1_000_000):
+        """Feed ``requests`` at their ``arrival_time``s and step until
+        everything finished. Under a VirtualClock this is a pure
+        function of the trace; under a real clock it is the
+        single-threaded open-loop replay the serve_loop bench uses."""
+        pending = sorted(requests,
+                         key=lambda r: (r.arrival_time, r.uid))
+        steps = 0
+        while pending or self.scheduler.has_work or self._ingress:
+            now = self.clock.now()
+            while pending and pending[0].arrival_time <= now:
+                self.submit(request=pending.pop(0))
+            if not self.scheduler.has_work and not self._ingress \
+                    and pending:
+                # idle until the next arrival
+                if self.virtual:
+                    self.clock.advance_to(pending[0].arrival_time)
+                else:
+                    self.clock.sleep(pending[0].arrival_time - now)
+                continue
+            report = self.step()
+            if not report.work_done and not self.virtual:
+                self.clock.sleep(self.config.idle_sleep_s)
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"run_trace exceeded {max_steps} steps — "
+                    "scheduling livelock?")
+        if self.monitor is not None:
+            self.metrics.emit(self.monitor, self.scheduler.step_idx)
+        return self.metrics
+
+    # ------------------------------------------------------------- #
+    # thread mode
+    # ------------------------------------------------------------- #
+    def start(self) -> None:
+        if self.virtual:
+            raise RuntimeError(
+                "thread mode needs a real clock; use run_trace for "
+                "virtual-clock simulation")
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hds-serving", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            report = self.step()
+            if not report.work_done:
+                self._stop.wait(self.config.idle_sleep_s)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        if self._thread is None:
+            return
+        if drain:
+            deadline = self.clock.now() + timeout
+            while (self.scheduler.has_work or self._ingress) and \
+                    self.clock.now() < deadline:
+                self.clock.sleep(self.config.idle_sleep_s)
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        self._thread = None
+
+    def wait(self, req: Request, timeout: float = 60.0) -> Request:
+        """Block until ``req`` finishes (thread mode helper)."""
+        deadline = self.clock.now() + timeout
+        while not req.finished and self.clock.now() < deadline:
+            self.clock.sleep(self.config.idle_sleep_s)
+        return req

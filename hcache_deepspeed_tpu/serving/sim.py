@@ -1,0 +1,217 @@
+"""Model-free engine double for CPU-deterministic serving simulation.
+
+Exposes the exact ``InferenceEngineV2`` serving surface the scheduler
+consumes — ``can_schedule`` (same verdict arithmetic, same order), the
+ragged ``put``, ``restore_kv``, ``suspend_sequence``/
+``resume_sequence``, ``flush`` — over the REAL ``StateManager`` /
+``BlockedAllocator``, so block budgets, tracked-slot limits and
+scratch-block reservation behave bit-identically to the real engine.
+What it fakes is only the transformer: ``put`` returns one-hot logits
+whose argmax is a deterministic hash of ``(uid, seen_tokens)``, and
+token-thin latents that honor the restore shape contract. That makes
+every scheduling policy decision — and the token streams themselves —
+a pure function of the trace, with zero model compute.
+"""
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+from ..inference.config import RaggedInferenceEngineConfig
+from ..inference.ragged.kv_cache import StateManager
+from ..inference.scheduling import SchedulingError, SchedulingResult
+
+
+class SimulatedEngine:
+
+    #: latent stack shape stand-ins (restore contract: [L, T, H])
+    N_LAYER = 2
+    HIDDEN = 4
+
+    def __init__(self, config: RaggedInferenceEngineConfig = None,
+                 vocab_size: int = 64):
+        self.config = config or RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": 8,
+                           "max_context": 256},
+            kv_cache={"block_size": 16, "num_blocks": 32})
+        sm = self.config.state_manager
+        kv = self.config.kv_cache
+        self.vocab_size = vocab_size
+        self.block_size = kv.block_size
+        self.max_context = sm.max_context
+        num_blocks = kv.num_blocks or 32
+        self.state = StateManager(sm.max_tracked_sequences, num_blocks,
+                                  self.block_size, self.max_context)
+        # mirror the real engine's reserved scratch block so block
+        # budgets match it exactly
+        self._scratch_block = self.state.allocator.allocate(1)[0]
+        # op counters the tests/cost models read
+        self.counts = {"put": 0, "restore": 0, "suspend": 0,
+                       "resume": 0, "flush": 0}
+        self.restore_stats = {"restores": 0, "sequences": 0,
+                              "chunks_issued": 0, "bytes_shipped": 0}
+
+    # ------------------------------------------------------------- #
+    @property
+    def free_blocks(self) -> int:
+        return self.state.free_blocks
+
+    def _token(self, uid: int, position: int) -> int:
+        """Deterministic next token: depends only on (uid, position),
+        like a greedy model's output depends only on the context — so a
+        preempt/restore cycle reproduces the uninterrupted stream iff
+        the scheduler's bookkeeping is exact."""
+        return (uid * 7919 + position * 131 + 17) % self.vocab_size
+
+    # ------------------------------------------------------------- #
+    # scheduling surface (verbatim verdict order of the real engine)
+    # ------------------------------------------------------------- #
+    def can_schedule(self, uids: Iterable[int],
+                     lengths: Iterable[int]) -> SchedulingResult:
+        uids, lengths = list(uids), list(lengths)
+        sm = self.config.state_manager
+        new_seqs = sum(1 for u in uids
+                       if self.state.get_sequence(u) is None)
+        if self.state.n_tracked_sequences + new_seqs > \
+                sm.max_tracked_sequences:
+            return SchedulingResult.EngineSequenceLimitExceeded
+        if len(uids) > sm.max_ragged_sequence_count:
+            return SchedulingResult.BatchSequenceLimitExceeded
+        per_fwd = [min(n, sm.prefill_chunk) if sm.prefill_chunk else n
+                   for n in lengths]
+        if sum(per_fwd) > sm.max_ragged_batch_size:
+            return SchedulingResult.BatchTokenLimitExceeded
+        blocks = 0
+        for uid, n in zip(uids, lengths):
+            seq = self.state.get_sequence(uid)
+            seen = seq.seen_tokens if seq else 0
+            if seen + n > self.max_context:
+                return SchedulingResult.SequenceTokenLimitExceeded
+            blocks += self.state.blocks_needed(seq, n)
+        if blocks > self.state.free_blocks:
+            return SchedulingResult.KVCacheLimitExceeded
+        return SchedulingResult.Success
+
+    # ------------------------------------------------------------- #
+    def _reject_suspended(self, uids) -> None:
+        for uid in uids:
+            seq = self.state.get_sequence(uid)
+            if seq is not None and seq.host_kv is not None:
+                raise RuntimeError(
+                    f"sequence {uid} is suspended (KV on host); call "
+                    "resume_sequence first")
+
+    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable,
+            do_checks: bool = True):
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1)
+                        for t in batch_tokens]
+        if do_checks:
+            result = self.can_schedule(batch_uids,
+                                       [len(t) for t in batch_tokens])
+            if result != SchedulingResult.Success:
+                raise SchedulingError(result)
+        self._reject_suspended(batch_uids)
+        self.counts["put"] += 1
+        logits = np.zeros((len(batch_uids), self.vocab_size), np.float32)
+        latents: List = []
+        for j, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
+            seq = self.state.get_or_create_sequence(uid)
+            self.state.maybe_allocate_kv(seq, len(tokens))
+            seq.pre_forward(len(tokens))
+            seq.post_forward()
+            logits[j, self._token(uid, seq.seen_tokens)] = 1.0
+            if self.config.hcache.enable_latents:
+                latents.append(np.full(
+                    (self.N_LAYER, len(tokens), self.HIDDEN),
+                    float(seq.seen_tokens), np.float32))
+            else:
+                latents.append(None)
+        return logits, latents
+
+    # ------------------------------------------------------------- #
+    def restore_kv(self, batch_uids: Iterable[int], batch_tokens,
+                   batch_latents) -> None:
+        batch_uids = list(batch_uids)
+        self._reject_suspended(batch_uids)
+        items = []
+        for uid, tokens, latents in zip(batch_uids, batch_tokens,
+                                        batch_latents):
+            if latents is None:
+                continue
+            tokens = np.asarray(tokens, np.int32).reshape(-1)
+            latents = np.asarray(latents)
+            if latents.shape[1] != len(tokens):
+                raise ValueError(
+                    f"uid {uid}: {len(tokens)} tokens but latents for "
+                    f"{latents.shape[1]}")
+            items.append((uid, tokens, latents))
+        new_seqs = sum(1 for uid, _, _ in items
+                       if self.state.get_sequence(uid) is None)
+        if self.state.n_tracked_sequences + new_seqs > \
+                self.config.state_manager.max_tracked_sequences:
+            raise SchedulingError(
+                SchedulingResult.EngineSequenceLimitExceeded)
+        need = 0
+        for uid, tokens, _ in items:
+            seq = self.state.get_sequence(uid)
+            seen = seq.seen_tokens if seq else 0
+            if seen + len(tokens) > self.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
+            need += self.state.blocks_needed(seq, len(tokens))
+        if need > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+        for uid, tokens, latents in items:
+            seq = self.state.get_or_create_sequence(uid)
+            self.state.maybe_allocate_kv(seq, len(tokens))
+            seq.pre_forward(len(tokens))
+            seq.post_forward()
+            self.restore_stats["sequences"] += 1
+            self.restore_stats["bytes_shipped"] += latents.nbytes
+        self.counts["restore"] += 1
+        self.restore_stats["restores"] += 1
+        self.restore_stats["chunks_issued"] += max(len(items), 1)
+
+    # ------------------------------------------------------------- #
+    def suspend_sequence(self, uid: int) -> None:
+        seq = self.state.get_sequence(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if seq.host_kv is not None:
+            return
+        seq.host_kv = ("sim", seq.seen_tokens)
+        if seq.blocks:
+            self.state.allocator.free(seq.blocks)
+            seq.blocks = []
+        self.counts["suspend"] += 1
+
+    def resume_sequence(self, uid: int) -> None:
+        seq = self.state.get_sequence(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if seq.host_kv is None:
+            return
+        need = self.state.blocks_needed(seq, 0)
+        if need > self.state.free_blocks:
+            raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+        self.state.maybe_allocate_kv(seq, 0)
+        seq.host_kv = None
+        self.counts["resume"] += 1
+
+    def flush(self, uid: int) -> None:
+        self.state.flush_sequence(uid)
+        self.counts["flush"] += 1
+
+    # observability parity with the engine
+    def serialize(self) -> Dict:
+        return {
+            "sequences": {
+                uid: {"seen_tokens": s.seen_tokens,
+                      "blocks": list(s.blocks)}
+                for uid, s in self.state._seqs.items()
+            },
+            "free_blocks": self.state.free_blocks,
+        }

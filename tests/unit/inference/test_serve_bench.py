@@ -136,3 +136,32 @@ def test_serve_bench_restore_marginal_mode():
                     "ship_ms"):
             assert row[key] >= 0, (key, row)
         assert row["link_gbps"] > 0
+
+
+def test_serve_loop_mode(tmp_path):
+    """serve_loop: the serving subsystem end-to-end over a Poisson
+    trace — zero drops, percentile rows, and at least one
+    preempt→suspend→restore_kv cycle with exact token parity (the
+    runner raises on drops or parity failure). Virtual clock keeps the
+    test deterministic and fast; the acceptance command runs the same
+    path with the wall clock."""
+    from hcache_deepspeed_tpu.inference.benchmark import run_serve_loop
+    out = tmp_path / "serve_loop.jsonl"
+    rows = run_serve_loop(model_size="tiny", n_requests=16, rps=100.0,
+                          virtual_clock=True, out=str(out))
+    summary = rows[-1]
+    assert summary["phase"] == "serve-loop-summary"
+    assert summary["dropped"] == 0
+    assert summary["preemptions"] >= 1 and summary["restores"] >= 1
+    assert summary["parity"]["checked"] >= 1
+    assert summary["parity"]["ok"] == summary["parity"]["checked"]
+    assert summary["ttft_s"]["count"] == 16
+    assert summary["ttft_s"]["p90"] >= summary["ttft_s"]["p50"]
+    assert summary["tpot_s"]["p50"] > 0
+    per_req = [r for r in rows if r["phase"] == "serve-loop"]
+    assert len(per_req) == 16
+    assert all(r["state"] == "DONE" for r in per_req)
+    # the artifact file mirrors the emitted rows
+    import json as _json
+    lines = [_json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == len(rows)

@@ -1,0 +1,124 @@
+"""Between-phase state offload (reference: engine.py:3943
+offload_states / :3977 reload_states).
+
+The mechanics tests drive the methods on a bare engine instance so the
+tree-map behavior is pinned precisely — in particular the non-jax.Array
+leaf case: the sharding tree holds ``None`` at those positions, and
+``None`` is an empty pytree node, so without the ``is_leaf`` handling
+the reload map raises a tree-structure mismatch (ADVICE.md round 5).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import hcache_deepspeed_tpu as hds
+from hcache_deepspeed_tpu.models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+from hcache_deepspeed_tpu.runtime.engine import HDSEngine
+
+
+def bare_engine(state):
+    eng = HDSEngine.__new__(HDSEngine)
+    eng.state = state
+    return eng
+
+
+def make_state():
+    return {
+        "opt": {"mu": jax.numpy.ones((4, 4)),
+                "count": 7,                      # non-array leaf
+                "empty": None},                  # empty-node leaf
+        "params": {"w": jax.numpy.arange(8.0)},
+    }
+
+
+class TestOffloadMechanics:
+
+    @pytest.mark.parametrize("non_blocking", [False, True])
+    def test_round_trip_with_non_array_leaves(self, non_blocking):
+        eng = bare_engine(make_state())
+        eng.offload_states(include=["opt", "params"],
+                           non_blocking=non_blocking)
+        assert isinstance(eng.state["opt"]["mu"], np.ndarray)
+        assert not isinstance(eng.state["opt"]["mu"], jax.Array)
+        assert eng.state["opt"]["count"] == 7
+        assert eng.state["opt"]["empty"] is None
+        # the regression: reload must map state tree x sharding tree
+        # even though the sharding tree holds None at the non-array
+        # (and None) positions
+        eng.reload_states(non_blocking=non_blocking)
+        assert isinstance(eng.state["opt"]["mu"], jax.Array)
+        assert isinstance(eng.state["params"]["w"], jax.Array)
+        assert eng.state["opt"]["count"] == 7
+        assert eng.state["opt"]["empty"] is None
+        assert eng._offloaded_shardings == {}
+        np.testing.assert_array_equal(np.asarray(eng.state["opt"]["mu"]),
+                                      np.ones((4, 4)))
+
+    def test_offload_is_idempotent_and_selective(self):
+        eng = bare_engine(make_state())
+        eng.offload_states(include=["opt"])
+        assert isinstance(eng.state["params"]["w"], jax.Array)
+        eng.offload_states(include=["opt"])          # no double entry
+        assert list(eng._offloaded_shardings) == ["opt"]
+        eng.reload_states()
+        assert isinstance(eng.state["opt"]["mu"], jax.Array)
+
+    def test_unknown_state_name_rejected(self):
+        eng = bare_engine(make_state())
+        with pytest.raises(ValueError, match="unknown state"):
+            eng.offload_states(include=["bogus"])
+
+    def test_all_copies_issued_before_any_asarray(self, monkeypatch):
+        """non_blocking: every group's copy_to_host_async fires before
+        the first np.asarray conversion (cross-GROUP overlap, which the
+        docstring promises — previously group N's asarray blocked
+        before group N+1's copies were issued)."""
+        state = make_state()
+        order = []
+        arr_cls = type(state["opt"]["mu"])       # concrete jax array type
+        orig_async = arr_cls.copy_to_host_async
+
+        def spy_async(self):
+            order.append("issue")
+            return orig_async(self)
+
+        monkeypatch.setattr(arr_cls, "copy_to_host_async", spy_async)
+        orig_asarray = np.asarray
+
+        def spy_asarray(x, *a, **kw):
+            if isinstance(x, jax.Array):
+                order.append("convert")
+            return orig_asarray(x, *a, **kw)
+
+        monkeypatch.setattr(np, "asarray", spy_asarray)
+        eng = bare_engine(state)
+        eng.offload_states(include=["opt", "params"], non_blocking=True)
+        issues = [i for i, o in enumerate(order) if o == "issue"]
+        converts = [i for i, o in enumerate(order) if o == "convert"]
+        assert len(issues) == 2          # mu + w, one group each
+        assert len(converts) == 2
+        assert max(issues) < min(converts)
+
+
+class TestOffloadEndToEnd:
+
+    def test_train_offload_reload_train(self, eight_devices):
+        model = GPT2LMHeadModel(gpt2_tiny())
+        rng = np.random.default_rng(0)
+        data = {"input_ids": rng.integers(0, 256, (8, 16),
+                                          dtype=np.int32)}
+        engine, _, _, _ = hds.initialize(
+            model=model,
+            config={"train_batch_size": 8,
+                    "train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10 ** 9},
+            example_batch=data)
+        l0 = float(engine.train_batch(batch=data))
+        engine.offload_states(non_blocking=True)
+        with pytest.raises(RuntimeError, match="offloaded"):
+            engine.train_batch(batch=data)
+        engine.reload_states()
+        l1 = float(engine.train_batch(batch=data))
+        assert np.isfinite(l0) and np.isfinite(l1)

@@ -1,0 +1,57 @@
+"""Request lifecycle state machine + derived timing quantities."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.serving import Request, RequestState
+
+
+def test_happy_path_transitions():
+    req = Request(uid=0, prompt=[1, 2, 3], max_new_tokens=4,
+                  arrival_time=1.0)
+    for s in (RequestState.PREFILL, RequestState.DECODE,
+              RequestState.SUSPENDED, RequestState.RESTORING,
+              RequestState.DECODE, RequestState.DONE):
+        req.transition(s)
+    assert req.finished
+
+
+def test_illegal_transitions_raise():
+    req = Request(uid=0, prompt=[1], max_new_tokens=1)
+    with pytest.raises(ValueError, match="illegal transition"):
+        req.transition(RequestState.DECODE)       # QUEUED -> DECODE
+    req.transition(RequestState.REJECTED)
+    with pytest.raises(ValueError, match="illegal transition"):
+        req.transition(RequestState.PREFILL)      # terminal
+
+
+def test_token_accounting():
+    req = Request(uid=3, prompt=list(range(10)), max_new_tokens=8)
+    assert req.total_tokens == 18
+    assert req.cached_tokens == 10          # nothing generated yet
+    req.tokens_out = [5, 6, 7]
+    # cache covers prompt + fed tokens (last sampled token not yet fed)
+    assert req.cached_tokens == 12
+    assert req.remaining_tokens == 5
+
+
+def test_latent_accumulation_matches_cached_tokens():
+    req = Request(uid=1, prompt=list(range(6)), max_new_tokens=4)
+    req.absorb_latents(np.zeros((2, 6, 4)))    # prefill latents
+    req.tokens_out = [1]
+    assert req.latents.shape[1] == req.cached_tokens
+    req.absorb_latents(np.zeros((2, 1, 4)))    # decode latents
+    req.tokens_out = [1, 2]
+    assert req.latents.shape[1] == req.cached_tokens
+
+
+def test_timing_summaries():
+    req = Request(uid=0, prompt=[1], max_new_tokens=3, arrival_time=10.0)
+    assert req.ttft() is None and req.tpot() is None
+    req.admitted_at = 11.0
+    req.first_token_at = 12.0
+    req.tokens_out = [4, 5, 6]
+    req.finished_at = 14.0
+    assert req.ttft() == 2.0
+    assert req.queue_wait() == 1.0
+    assert req.tpot() == pytest.approx(1.0)    # 2 s / 2 later tokens
