@@ -354,12 +354,24 @@ def run_config(name):
     # Steps chain through engine.state on device, so enqueueing them all and
     # fetching one scalar at the end costs a single host round-trip; fetching
     # per step would add the tunnel RTT (tens of ms) to every step.
+    # The measured window runs under the span tracer so the JSONL
+    # artifact carries a per-step breakdown — the next regression is
+    # attributable from the artifact alone (host-issue spans here; the
+    # device truth needs an XLA profile).
+    from hcache_deepspeed_tpu.telemetry import bench_extra
+    from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+    tracer = get_tracer()
+    tracer_was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
     steps = 30
     t0 = time.perf_counter()
     for _ in range(steps):
         loss_dev = engine.train_batch(batch=data)
     loss = float(loss_dev)
     dt = time.perf_counter() - t0
+    tracer.configure(enabled=tracer_was)
+    step_breakdown = bench_extra(tracer.events())
 
     tokens_per_sec = steps * batch * seq / dt
     n_params = sum(x.size for x in jax.tree.leaves(engine.state["params"]))
@@ -396,6 +408,7 @@ def run_config(name):
             "loss": float(loss),
             "n_params": int(n_params),
             "step_time_ms": round(dt / steps * 1000, 2),
+            "step_breakdown": step_breakdown,
         },
     }), flush=True)
 

@@ -8,7 +8,11 @@ Reference analog of this module: ``deepspeed/__init__.py`` —
 
 from .version import __version__
 
-from . import comm  # noqa: F401
+from .utils.compat import ensure_jax_compat
+
+ensure_jax_compat()
+
+from . import comm  # noqa: F401, E402
 from .platform import get_platform  # noqa: F401
 from .runtime.config import HDSConfig, load_config  # noqa: F401
 from .runtime.engine import HDSEngine

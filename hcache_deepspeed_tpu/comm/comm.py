@@ -284,8 +284,12 @@ def get_group_size(group):
         [topo.axis_size(a) for a in _normalize_axes(group)])))
 
 
-def log_summary():
-    get_comms_logger().log_all()
+def log_summary(monitor=None, step=0):
+    """Reference: ``dist.log_summary()`` (comm/comm.py:428) — prints the
+    aggregate op → count/volume table; with ``monitor`` the same
+    aggregate also rides ``MonitorMaster.write_events`` so comm volume
+    lands beside the step metrics."""
+    get_comms_logger().log_summary(monitor=monitor, step=step)
 
 
 def configure(enabled=None, verbose=None, prof_all=None, prof_ops=None,

@@ -57,10 +57,18 @@ class CommsLogger:
     def append(self, op_name, axes, msg_size):
         if not self.should_log(op_name):
             return
-        key = f"{op_name}@{','.join(axes) if axes else 'world'}"
+        axis_group = ",".join(axes) if axes else "world"
+        key = f"{op_name}@{axis_group}"
         rec = self.comms_dict[key][msg_size]
         rec[0] += 1
         rec[1] += msg_size
+        # trace-time collective record -> telemetry span stream (the
+        # nvtx-range analog; lazy import keeps comm importable first)
+        from ..telemetry.tracer import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant(f"comm.{op_name}", bytes=int(msg_size),
+                           axes=axis_group)
         if self.verbose:
             log_dist(f"comm op: {key} | msg size: {convert_size(msg_size)}",
                      ranks=[0])
@@ -99,6 +107,28 @@ class CommsLogger:
         return [(f"Comms/{op}@{axes}", float(total), step)
                 for op, by_axis in sorted(self.axis_summary().items())
                 for axes, (_, total) in sorted(by_axis.items())]
+
+    def summary_events(self, step: int = 0):
+        """The ``log_summary`` aggregate (op → count / total bytes) as
+        monitor event triples, so comm volume lands in the same sink as
+        step metrics instead of only the ``log_dist`` text table."""
+        out = []
+        for op, by_axis in sorted(self.axis_summary().items()):
+            for axes, (count, total) in sorted(by_axis.items()):
+                out.append((f"CommsSummary/{op}@{axes}/count",
+                            float(count), step))
+                out.append((f"CommsSummary/{op}@{axes}/bytes",
+                            float(total), step))
+        return out
+
+    def log_summary(self, monitor=None, step: int = 0):
+        """Print the aggregate table AND, when a monitor is given,
+        route it through ``MonitorMaster.write_events``."""
+        self.log_all()
+        if monitor is not None and getattr(monitor, "enabled", True):
+            events = self.summary_events(step)
+            if events:
+                monitor.write_events(events)
 
     def reset(self):
         self.comms_dict.clear()

@@ -196,12 +196,23 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
             clear()
         prefill_ms = (time.perf_counter() - t0) / reps * 1000
 
+        # the timed restore window runs under the span tracer so the
+        # JSONL row carries the per-chunk staging breakdown (where the
+        # restore time goes: chunks, shipped bytes, host staging ms)
+        from ..telemetry import bench_extra
+        from ..telemetry.tracer import get_tracer
+        tracer = get_tracer()
+        tracer_was = tracer.enabled
+        tracer.configure(enabled=True)
+        tracer.clear()
         t0 = time.perf_counter()
         for _ in range(reps):
             eng.restore_kv(uids, prompts, latents)
             sync()
             clear()
         restore_ms = (time.perf_counter() - t0) / reps * 1000
+        tracer.configure(enabled=tracer_was)
+        breakdown = bench_extra(tracer.events())
 
         emit({
             "phase": "hcache-restore", "batch": batch,
@@ -210,7 +221,8 @@ def run_restore(model_size="tiny", max_context=512, prompt_len=128,
             "latent_mb": round(sum(l.nbytes for l in latents) / 2**20, 1),
             "prefill_recompute_ms": round(prefill_ms, 2),
             "restore_kv_ms": round(restore_ms, 2),
-            "speedup": round(prefill_ms / restore_ms, 2)})
+            "speedup": round(prefill_ms / restore_ms, 2),
+            "extra": {"step_breakdown": breakdown}})
         del eng
     return results
 
@@ -689,9 +701,21 @@ def run_serve_loop(model_size="tiny", max_context=128, prompt_len=48,
         reqs.append(Request(uid=i, prompt=prompt, max_new_tokens=max_new,
                             arrival_time=base + float(arrive[i]),
                             priority=5 if i % 5 == 4 else 0))
+    # the traced window covers the whole served trace: the summary row
+    # then carries the span-derived breakdown (restore staging chunks,
+    # bytes, the pair-computed overlap ratio) beside the counters it
+    # must agree with
+    from ..telemetry import bench_extra
+    from ..telemetry.tracer import get_tracer
+    tracer = get_tracer()
+    tracer_was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
     t0 = time.perf_counter()
     metrics = server.run_trace(reqs)
     wall_s = time.perf_counter() - t0
+    tracer.configure(enabled=tracer_was)
+    step_breakdown = bench_extra(tracer.events())
 
     dropped = [r for r in reqs if r.state.name != "DONE"]
     for r in reqs:
@@ -734,7 +758,8 @@ def run_serve_loop(model_size="tiny", max_context=128, prompt_len=48,
           "restore_stats": dict(eng.restore_stats),
           "parity": parity,
           "gen_tokens_per_sec": round(
-              s["counters"]["tokens_out"] / max(wall_s, 1e-9), 1)})
+              s["counters"]["tokens_out"] / max(wall_s, 1e-9), 1),
+          "extra": {"step_breakdown": step_breakdown}})
     if fh is not None:
         fh.close()
     if dropped:

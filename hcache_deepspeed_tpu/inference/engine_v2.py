@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..platform import get_platform
+from ..telemetry.tracer import get_tracer
 from ..utils.logging import log_dist
 from .config import RaggedInferenceEngineConfig
 from .model import PagedInferenceModel
@@ -269,6 +270,10 @@ class InferenceEngineV2:
         batch_uids = list(batch_uids)
         batch_tokens = [np.asarray(t, np.int32).reshape(-1)
                         for t in batch_tokens]
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant("serve.put", n_seqs=len(batch_uids),
+                           tokens=int(sum(len(t) for t in batch_tokens)))
         if do_checks:
             # NOTE: with prefix caching the block budget is conservative
             # (checked before any prefix attaches reduce the real need)
@@ -420,8 +425,10 @@ class InferenceEngineV2:
             tok[j, 0] = tokens[i][0]
             start[j] = self.state.get_sequence(uids[i]).seen_tokens
             t_len[j] = 1
-        logits, latents = self.model.forward_chunk(self.cache, tok, start,
-                                                   tables, t_len)
+        with get_tracer().span("serve.decode_dispatch",
+                               lanes=len(idx), bucket=B):
+            logits, latents = self.model.forward_chunk(
+                self.cache, tok, start, tables, t_len)
         if defer:   # keep the device array whole (row slicing here would
             for j, i in enumerate(idx):   # dispatch an op per lane) —
                 logits_out[i] = (logits, j)   # every uid gets its lane
@@ -447,8 +454,12 @@ class InferenceEngineV2:
             tok[j, :len(tokens[i])] = tokens[i]
             start[j] = seq.seen_tokens
             t_len[j] = len(tokens[i])
-        logits, latents = self.model.forward_chunk(self.cache, tok, start,
-                                                   tables, t_len)
+        with get_tracer().span("serve.prefill_dispatch",
+                               lanes=len(idx), bucket=B, bucket_T=T,
+                               tokens=int(sum(len(tokens[i])
+                                              for i in idx))):
+            logits, latents = self.model.forward_chunk(
+                self.cache, tok, start, tables, t_len)
         if defer:
             for j, i in enumerate(idx):
                 logits_out[i] = (logits, j)
@@ -663,11 +674,14 @@ class InferenceEngineV2:
                     for j in range(n):
                         if outs[j][0] == eos_token_id:
                             t_len[j] = 0
-                toks, lats, lps = self.model.decode_loop(
-                    self.cache, tok[:, 0], start, t_len, tables, n_feed,
-                    temperature=temperature, top_k=top_k, top_p=top_p,
-                    seed=seed, want_logprobs=return_logprobs,
-                    eos_token_id=eos_token_id)
+                with get_tracer().span("serve.fused_decode",
+                                       lanes=n, n_feed=n_feed):
+                    toks, lats, lps = self.model.decode_loop(
+                        self.cache, tok[:, 0], start, t_len, tables,
+                        n_feed, temperature=temperature, top_k=top_k,
+                        top_p=top_p, seed=seed,
+                        want_logprobs=return_logprobs,
+                        eos_token_id=eos_token_id)
                 for j, uid in enumerate(uids):
                     self.state.get_sequence(uid).post_forward()
                     outs[j].extend(int(t) for t in toks[:, j])
@@ -998,13 +1012,17 @@ class InferenceEngineV2:
             self.restore_stats["chunks_issued"] += 1
             self.restore_stats["bytes_shipped"] += int(nbytes)
 
-        for T, group in sorted(groups.items()):
-            lat, start, t_len, tables, seqs = \
-                self._stage_restore_group(group, T)
-            self.model.restore_kv(self.cache, lat, start, tables, t_len,
-                                  progress_cb=_progress)
-            for seq in seqs:
-                seq.post_forward()
+        with get_tracer().span(
+                "serve.restore_kv", sequences=len(items),
+                tokens=int(sum(len(it[1]) for it in items)),
+                latent_bytes=int(sum(it[2].nbytes for it in items))):
+            for T, group in sorted(groups.items()):
+                lat, start, t_len, tables, seqs = \
+                    self._stage_restore_group(group, T)
+                self.model.restore_kv(self.cache, lat, start, tables,
+                                      t_len, progress_cb=_progress)
+                for seq in seqs:
+                    seq.post_forward()
 
     def _stage_restore_group(self, group, T=None):
         """State ops + lane slab for ONE bucket group of
@@ -1202,6 +1220,8 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         seq = self.state.get_sequence(uid)
         held = list(seq.blocks) if seq is not None else []
+        get_tracer().instant("serve.flush", uid=uid,
+                             blocks=len(held))
         self.state.flush_sequence(uid)
         if self.prefix_caching and held:
             self._purge_freed_blocks(held)

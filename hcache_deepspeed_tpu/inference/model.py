@@ -1081,10 +1081,15 @@ class PagedInferenceModel:
                     latents = jax.device_put(latents, dev)
             elif latents.devices() != ck.devices():
                 latents = jax.device_put(latents, list(ck.devices())[0])
+            from ..telemetry.tracer import get_tracer
+            tracer = get_tracer()
             for l0 in bounds:
-                ck, cv = self._restore(self.params, ck, cv,
-                                       jnp.int32(l0), latents[l0:l0 + C],
-                                       start, tables, t_len)
+                with tracer.span("serve.restore.stage", layer0=l0,
+                                 layers=min(C, L - l0), bytes=0):
+                    ck, cv = self._restore(self.params, ck, cv,
+                                           jnp.int32(l0),
+                                           latents[l0:l0 + C],
+                                           start, tables, t_len)
                 if progress_cb is not None:
                     progress_cb(l0, 0)
             cache.replace(ck, cv)
@@ -1104,13 +1109,22 @@ class PagedInferenceModel:
             return jax.device_put(
                 np.ascontiguousarray(latents[l0:l0 + C]), dev)
 
+        from ..telemetry.tracer import get_tracer
+        tracer = get_tracer()
         buf = ship(0)
         for i, l0 in enumerate(bounds):
             cur = buf
-            if i + 1 < len(bounds):   # double buffer: prefetch next chunk
-                buf = ship(bounds[i + 1])
-            ck, cv = self._restore(self.params, ck, cv, jnp.int32(l0),
-                                   cur, start, tables, t_len)
+            # span covers prefetch-issue + dispatch-issue for this chunk
+            # (both async — the host-side staging cost HCache's restore
+            # latency story needs attributed per layer chunk)
+            with tracer.span("serve.restore.stage", layer0=l0,
+                             layers=min(C, L - l0),
+                             bytes=int(cur.nbytes)):
+                if i + 1 < len(bounds):   # double buffer: prefetch next
+                    buf = ship(bounds[i + 1])
+                ck, cv = self._restore(self.params, ck, cv,
+                                       jnp.int32(l0), cur, start,
+                                       tables, t_len)
             if progress_cb is not None:
                 progress_cb(l0, cur.nbytes)
         cache.replace(ck, cv)

@@ -8,6 +8,7 @@ the JSON config. Events are ``(label, value, step)`` tuples written from rank
 
 import csv
 import os
+from collections import deque
 
 from ..utils.logging import logger
 
@@ -60,18 +61,48 @@ class CSVMonitor(Monitor):
                                         cfg.job_name)
             os.makedirs(self.log_dir, exist_ok=True)
 
-    def write_events(self, event_list):
-        if not self.enabled:
-            return
-        for label, value, step in event_list:
+    def _writer(self, label):
+        """Cached (file handle, csv writer) per label — reopening the
+        file for every event costs an open/close syscall pair per
+        metric per step."""
+        entry = self._files.get(label)
+        if entry is None:
             fname = os.path.join(self.log_dir,
                                  label.replace("/", "_") + ".csv")
             new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as fh:
-                w = csv.writer(fh)
-                if new:
-                    w.writerow(["step", label])
-                w.writerow([step, value])
+            fh = open(fname, "a", newline="")
+            w = csv.writer(fh)
+            if new:
+                w.writerow(["step", label])
+            entry = self._files[label] = (fh, w)
+        return entry
+
+    def write_events(self, event_list, flush=False):
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            fh, w = self._writer(label)
+            w.writerow([step, value])
+        if flush:
+            self.flush()
+
+    def flush(self):
+        for fh, _ in self._files.values():
+            try:
+                fh.flush()
+            except ValueError:   # already closed
+                pass
+
+    def close(self):
+        for fh, _ in self._files.values():
+            try:
+                fh.close()
+            except Exception:
+                pass
+        self._files.clear()
+
+    def __del__(self):
+        self.close()
 
 
 class WandbMonitor(Monitor):
@@ -142,15 +173,15 @@ class InMemoryMonitor(Monitor):
         super().__init__(None)
         self.enabled = True
         self.capacity = capacity
-        self.events = []
+        # deque(maxlen): O(1) eviction instead of the old O(n) list
+        # re-slice on every overflowing write
+        self.events = deque(maxlen=capacity)
         self.latest = {}
 
     def write_events(self, event_list):
         for label, value, step in event_list:
             self.events.append((label, value, step))
             self.latest[label] = (value, step)
-        if len(self.events) > self.capacity:
-            self.events = self.events[-self.capacity:]
 
 
 class MonitorMaster(Monitor):

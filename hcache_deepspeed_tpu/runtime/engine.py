@@ -43,6 +43,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..parallel.topology import (MeshTopology, TopologySpec,
                                  initialize_topology)
 from ..platform import get_platform
+from ..telemetry import StepMetrics
+from ..telemetry.tracer import get_tracer
 from ..utils.logging import log_dist
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BATCH_TIMER,
                            FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
@@ -380,19 +382,33 @@ class HDSEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        self._last_batch_tokens = 0
         self._pending = None  # loss between forward() and backward()
         self._data_iter = None  # persistent train_batch iterator
         self._last_grad_norm = None  # device scalar from the latest step
 
-        # ---- timers / monitor ----
+        # ---- timers / monitor / telemetry ----
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer(
             synchronize=self.wall_clock_breakdown)
-        self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size,
-            steps_per_output=config.steps_per_print)
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config)
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size,
+            steps_per_output=config.steps_per_print,
+            monitor=self.monitor,
+            emit_events=self.wall_clock_breakdown)
+        # step-metrics pipeline: tokens/sec + phase breakdown + MFU
+        # through the monitor fan-out. flops/token is the portable 6N
+        # estimate (bench.py's yardstick); an exact figure from the
+        # flops profiler overrides it when a profile runs.
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree.leaves(self.state["params"]))
+        self.step_metrics = StepMetrics(
+            monitor=self.monitor,
+            peak_tflops=self.platform.peak_tflops("bfloat16") *
+            self.mesh.size,       # tokens are global -> global peak
+            flops_per_token=6.0 * n_params)
 
         # ---- dataloader ----
         self.training_dataloader = None
@@ -1055,6 +1071,21 @@ class HDSEngine:
         return jax.random.fold_in(jax.random.PRNGKey(self._rng_seed),
                                   self.micro_steps + 1)
 
+    @staticmethod
+    def _count_tokens(batch):
+        """Token count of a host batch (shape metadata only): the
+        ``input_ids`` leaf's size, else the first rank>=2 leaf's."""
+        try:
+            if isinstance(batch, dict) and "input_ids" in batch:
+                return int(np.asarray(batch["input_ids"]).size)
+            for x in jax.tree.leaves(batch):
+                a = np.asarray(x)
+                if a.ndim >= 2:
+                    return int(a.size)
+        except Exception:
+            pass
+        return 0
+
     # ------------------------------------------------------------------ #
     # Public API (reference: engine.forward :2041 / backward :2204 /
     # step :2338 / train_batch pipe/engine.py:338)
@@ -1071,64 +1102,89 @@ class HDSEngine:
         array); ``backward()`` then only advances the micro-step counter.
         """
         self._assert_not_offloaded()
-        if self.wall_clock_breakdown:
-            self.timers(FORWARD_GLOBAL_TIMER).start()
-        batch = self._shard_batch(batch)
-        extra_kw = {}
-        if self._lora is not None:
-            extra_kw["frozen"] = self.state["frozen"]
-        if self._moq is not None:
-            extra_kw["moq_bits"] = jnp.asarray(
-                self._moq.bits_at(self.global_steps), jnp.int32)
-        if self.progressive_layer_drop is not None:
-            extra_kw["pld_theta"] = jnp.asarray(
-                self.progressive_layer_drop.get_theta(), jnp.float32)
-        if self._structured is not None:
-            extra_kw["comp_step"] = jnp.asarray(self.global_steps,
-                                                jnp.int32)
-        with self.platform.annotate("hds.fwd_bwd"):
-            loss, new_acc = self._micro_fwd_bwd(
-                self.state["params"], self.state["grad_acc"],
-                self.state["loss_scale"], batch, self._next_rng(), True,
-                **extra_kw)
-        self.state["grad_acc"] = new_acc
-        self._pending = loss
-        if self.wall_clock_breakdown:
-            self.timers(FORWARD_GLOBAL_TIMER).stop()
+        tracer = get_tracer()
+        with tracer.span("train.fwd", step=self.global_steps + 1,
+                         micro_step=self.micro_steps + 1,
+                         tokens=self._count_tokens(batch)
+                         if tracer.enabled else 0):
+            if self.wall_clock_breakdown:
+                self.timers(FORWARD_GLOBAL_TIMER).start()
+            batch = self._shard_batch(batch)
+            extra_kw = {}
+            if self._lora is not None:
+                extra_kw["frozen"] = self.state["frozen"]
+            if self._moq is not None:
+                extra_kw["moq_bits"] = jnp.asarray(
+                    self._moq.bits_at(self.global_steps), jnp.int32)
+            if self.progressive_layer_drop is not None:
+                extra_kw["pld_theta"] = jnp.asarray(
+                    self.progressive_layer_drop.get_theta(), jnp.float32)
+            if self._structured is not None:
+                extra_kw["comp_step"] = jnp.asarray(self.global_steps,
+                                                    jnp.int32)
+            with self.platform.annotate("hds.fwd_bwd"):
+                loss, new_acc = self._micro_fwd_bwd(
+                    self.state["params"], self.state["grad_acc"],
+                    self.state["loss_scale"], batch, self._next_rng(),
+                    True, **extra_kw)
+            self.state["grad_acc"] = new_acc
+            self._pending = loss
+            if self.wall_clock_breakdown:
+                self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
     def backward(self, loss=None):
         """Book-keeping half of the fused fwd+bwd (see ``forward``)."""
         if self._pending is None:
             raise RuntimeError("backward() called without forward()")
-        if self.wall_clock_breakdown:
-            self.timers(BACKWARD_GLOBAL_TIMER).start()
-        self._pending = None
-        self.micro_steps += 1
-        if self.wall_clock_breakdown:
-            self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        with get_tracer().span("train.bwd", step=self.global_steps + 1,
+                               micro_step=self.micro_steps + 1):
+            if self.wall_clock_breakdown:
+                self.timers(BACKWARD_GLOBAL_TIMER).start()
+            self._pending = None
+            self.micro_steps += 1
+            if self.wall_clock_breakdown:
+                self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
 
     def step(self):
         """Apply the optimizer at gradient-accumulation boundaries."""
         if self.micro_steps % self.gradient_accumulation_steps != 0:
             return
-        if self.wall_clock_breakdown:
-            self.timers(STEP_GLOBAL_TIMER).start()
-        if self._offload is not None:
-            with self.platform.annotate("hds.optimizer_step"):
-                finite = self._offload_step()
-        else:
-            lr = jnp.asarray(self._current_lr, jnp.float32)
-            with self.platform.annotate("hds.optimizer_step"):
-                self.state, finite, grad_norm = self._apply_step(
-                    self.state, lr)
-            self._last_grad_norm = grad_norm
-        self._after_step(finite)
-        if self.wall_clock_breakdown:
-            self.timers(STEP_GLOBAL_TIMER).stop()
-            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
-                             STEP_GLOBAL_TIMER])
+        with get_tracer().span("train.step", step=self.global_steps + 1):
+            if self.wall_clock_breakdown:
+                self.timers(STEP_GLOBAL_TIMER).start()
+            if self._offload is not None:
+                with self.platform.annotate("hds.optimizer_step"):
+                    finite = self._offload_step()
+            else:
+                lr = jnp.asarray(self._current_lr, jnp.float32)
+                with self.platform.annotate("hds.optimizer_step"):
+                    self.state, finite, grad_norm = self._apply_step(
+                        self.state, lr)
+                self._last_grad_norm = grad_norm
+            self._after_step(finite)
+            if self.wall_clock_breakdown:
+                self.timers(STEP_GLOBAL_TIMER).stop()
+                self._emit_phase_metrics()
+                self.timers.log([FORWARD_GLOBAL_TIMER,
+                                 BACKWARD_GLOBAL_TIMER,
+                                 STEP_GLOBAL_TIMER])
+
+    def _emit_phase_metrics(self):
+        """Per-phase step-time breakdown through the monitor (read
+        BEFORE ``timers.log`` resets the accumulators)."""
+        if not self.monitor.enabled:
+            return
+        phase_s = {}
+        for name in (FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                     STEP_GLOBAL_TIMER):
+            if name in self.timers.timers:
+                phase_s[name] = self.timers.timers[name].elapsed(
+                    reset=False)
+        self.step_metrics.emit(self.global_steps,
+                               wall_s=sum(phase_s.values()),
+                               phase_s=phase_s)
 
     def _offload_step(self) -> bool:
         """ZeRO-Offload step: grads D2H, SIMD host update of fp32 master +
@@ -1208,6 +1264,27 @@ class HDSEngine:
         ``gas * micro_batch`` (or exactly the micro shape when gas==1);
         alternatively pull gas batches from ``data_iter``.
         """
+        tracer = get_tracer()
+        if not tracer.enabled and not self.wall_clock_breakdown:
+            return self._train_batch_impl(data_iter, batch)
+        bt = self.timers(BATCH_TIMER)
+        wall_before = bt.elapsed_
+        with tracer.span("train.train_batch",
+                         step=self.global_steps + 1) as sp:
+            loss = self._train_batch_impl(data_iter, batch)
+            sp.set(tokens=self._last_batch_tokens,
+                   gas=self.gradient_accumulation_steps)
+        if self.wall_clock_breakdown and self._offload is None:
+            # fused-path step metrics (the micro-step/offload path
+            # emits from step() instead); BATCH_TIMER accumulates, so
+            # the step's wall is the delta
+            self.step_metrics.emit(
+                self.global_steps, wall_s=bt.elapsed_ - wall_before,
+                tokens=self._last_batch_tokens,
+                samples=self.train_batch_size)
+        return loss
+
+    def _train_batch_impl(self, data_iter=None, batch=None):
         self.tput_timer.start()
         self._assert_not_offloaded()
         if self.wall_clock_breakdown:
@@ -1237,6 +1314,7 @@ class HDSEngine:
                         RepeatingLoader(self.training_dataloader))
                 data_iter = self._data_iter
             losses = []
+            tokens = 0
             for i in range(gas):
                 if batch is not None:
                     micro = jax.tree.map(
@@ -1246,11 +1324,13 @@ class HDSEngine:
                     micro = next(data_iter)
                     if cur_d is not None:
                         micro = self._truncate_seq(micro, cur_d)
+                tokens += self._count_tokens(micro)
                 losses.append(self.forward(micro))
                 self.backward()
+            self._last_batch_tokens = tokens
             self.step()
             loss = float(np.mean([float(l) for l in losses]))
-            self.tput_timer.stop(report_speed=True)
+            self.tput_timer.stop(report_speed=True, tokens=tokens)
             if self.wall_clock_breakdown:
                 self.timers(BATCH_TIMER).stop()
             return jnp.asarray(loss)
@@ -1275,6 +1355,8 @@ class HDSEngine:
             batch = jax.tree.map(
                 lambda x: np.asarray(x).reshape(
                     (gas, -1) + np.asarray(x).shape[1:]), batch)
+        self._last_batch_tokens = self._count_tokens(batch) \
+            if (get_tracer().enabled or self.wall_clock_breakdown) else 0
         batch = self._shard_batch(batch, extra_leading=True)
         lr = jnp.asarray(self._current_lr, jnp.float32)
         moq_bits = None
@@ -1297,7 +1379,9 @@ class HDSEngine:
             jax.block_until_ready(self.state)
             t0 = time.perf_counter()
         # trace annotation (reference: instrument_w_nvtx on hot paths)
-        with self.platform.annotate("hds.train_batch"):
+        with get_tracer().span("train.fused_dispatch",
+                               step=self.global_steps + 1, gas=gas), \
+                self.platform.annotate("hds.train_batch"):
             self.state, loss, finite, grad_norm = self._fused_train_batch(
                 self.state, batch, lr, self._next_rng(), moq_bits,
                 pld_theta, comp_step)
@@ -1311,7 +1395,8 @@ class HDSEngine:
         self._after_step(finite)
         if self.wall_clock_breakdown:
             self.timers(BATCH_TIMER).stop()
-        self.tput_timer.stop(report_speed=True)
+        self.tput_timer.stop(report_speed=True,
+                             tokens=self._last_batch_tokens)
         if self.monitor.enabled and \
                 self.global_steps % self.config.steps_per_print == 0:
             events = [("Train/loss", float(loss), self.global_steps)]
@@ -1348,6 +1433,13 @@ class HDSEngine:
             prof.flops = cost["flops"]
             prof.bytes_accessed = cost["bytes_accessed"]
             prof.duration = step_seconds
+            if self._last_batch_tokens:
+                # exact fusion-aware cost replaces the 6N estimate for
+                # subsequent MFU emission (cost is per device; tokens
+                # are global)
+                self.step_metrics.flops_per_token = (
+                    cost["flops"] * self.mesh.size /
+                    self._last_batch_tokens)
             lines = []
             prof.print_model_profile(out=lines.append)
             text = "\n".join(lines)
@@ -1531,16 +1623,22 @@ class HDSEngine:
         # keeps tree structures aligned for state groups whose leaves
         # are not all jax.Arrays
         _is_none = (lambda x: x is None)
-        for key in todo:
-            tree = self.state[key]
-            self._offloaded_shardings[key] = jax.tree.map(
-                lambda x: x.sharding if isinstance(x, jax.Array) else None,
-                tree, is_leaf=_is_none)
-            self.state[key] = jax.tree.map(
-                lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
-                tree, is_leaf=_is_none)
-            moved += sum(x.nbytes for x in jax.tree.leaves(tree)
-                         if isinstance(x, jax.Array))
+        # getattr: offload/reload are usable on a bare engine shell
+        # (tests construct one via __new__ with only .state)
+        with get_tracer().span("train.offload_states",
+                               step=getattr(self, "global_steps", 0),
+                               groups=",".join(sorted(todo))) as sp:
+            for key in todo:
+                tree = self.state[key]
+                self._offloaded_shardings[key] = jax.tree.map(
+                    lambda x: x.sharding if isinstance(x, jax.Array)
+                    else None, tree, is_leaf=_is_none)
+                self.state[key] = jax.tree.map(
+                    lambda x: np.asarray(x) if isinstance(x, jax.Array)
+                    else x, tree, is_leaf=_is_none)
+                moved += sum(x.nbytes for x in jax.tree.leaves(tree)
+                             if isinstance(x, jax.Array))
+            sp.set(bytes=moved)
         log_dist(f"offload_states: moved {sorted(keys)} "
                  f"({moved / 2**20:.1f} MiB) to host", ranks=[0])
 
@@ -1553,21 +1651,25 @@ class HDSEngine:
         shardings = getattr(self, "_offloaded_shardings", None)
         if not shardings:
             return
-        for key, sh_tree in shardings.items():
-            # is_leaf matches the sharding-tree build in offload_states:
-            # non-array positions hold None (an empty pytree node), which
-            # would otherwise raise a tree-structure mismatch against a
-            # state tree whose leaf there is a real (non-jax.Array) value
-            self.state[key] = jax.tree.map(
-                lambda x, s: jax.device_put(x, s)
-                if s is not None and x is not None else x,
-                self.state[key], sh_tree,
-                is_leaf=lambda x: x is None)
-        if not non_blocking:
-            for key in shardings:
-                for x in jax.tree.leaves(self.state[key]):
-                    if isinstance(x, jax.Array):
-                        x.block_until_ready()
+        with get_tracer().span("train.reload_states",
+                               step=getattr(self, "global_steps", 0),
+                               groups=",".join(sorted(shardings))):
+            for key, sh_tree in shardings.items():
+                # is_leaf matches the sharding-tree build in
+                # offload_states: non-array positions hold None (an empty
+                # pytree node), which would otherwise raise a
+                # tree-structure mismatch against a state tree whose leaf
+                # there is a real (non-jax.Array) value
+                self.state[key] = jax.tree.map(
+                    lambda x, s: jax.device_put(x, s)
+                    if s is not None and x is not None else x,
+                    self.state[key], sh_tree,
+                    is_leaf=lambda x: x is None)
+            if not non_blocking:
+                for key in shardings:
+                    for x in jax.tree.leaves(self.state[key]):
+                        if isinstance(x, jax.Array):
+                            x.block_until_ready()
         self._offloaded_shardings = {}
         log_dist("reload_states: device placement restored", ranks=[0])
 

@@ -91,3 +91,34 @@ class PipelineEngine(HDSEngine):
     @property
     def micro_batches(self):
         return self._pipe_micro_batches
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Inherited fused pipelined step, wrapped in a span carrying
+        the schedule attribution (stage/microbatch counts, schedule
+        kind, bubble fraction) — what is host-observable when the whole
+        1F1B executor is one compiled scan."""
+        from ...telemetry.tracer import get_tracer
+        from .schedule import bubble_fraction
+        with get_tracer().span(
+                "pipe.train_batch",
+                step=self.global_steps + 1,
+                stages=self.module.num_stages,
+                micro_batches=self._pipe_micro_batches,
+                schedule=self.module.schedule,
+                bubble_fraction=round(bubble_fraction(
+                    self._pipe_micro_batches,
+                    self.module.num_stages), 4)):
+            return super().train_batch(data_iter=data_iter, batch=batch)
+
+    def export_schedule_trace(self, path):
+        """Write the stage×tick work table of this engine's schedule as
+        a Perfetto-loadable trace (synthetic ticks; see
+        ``schedule.schedule_trace_events``)."""
+        from ...telemetry.export import write_trace
+        from .schedule import schedule_trace_events
+        events = schedule_trace_events(self._pipe_micro_batches,
+                                       self.module.num_stages,
+                                       self.module.schedule)
+        names = {s: f"stage {s}"
+                 for s in range(self.module.num_stages)}
+        return write_trace(events, path, thread_names=names)

@@ -36,6 +36,7 @@ import numpy as np
 
 from ..inference.scheduling import (BACKPRESSURE_ACTION, BackpressureAction,
                                     SchedulingResult)
+from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock
 from .request import Request, RequestState
 
@@ -107,6 +108,13 @@ class ContinuousBatchingScheduler:
     # intake
     # ------------------------------------------------------------- #
     def submit(self, req: Request) -> None:
+        # request-lifetime async interval: QUEUED here, closed at
+        # DONE/REJECTED in _close/_reject — the per-request lane in the
+        # exported trace; state edges ride the sched.* instants _event
+        # emits
+        get_tracer().async_begin("request", req.uid,
+                                 prio=req.priority,
+                                 prompt=len(req.prompt))
         self._event("queued", req.uid, f"prio={req.priority}")
         self.queue.append(req)
 
@@ -142,11 +150,12 @@ class ContinuousBatchingScheduler:
         self.step_idx += 1
         now = self.clock.now()
         report = StepReport(step=self.step_idx, t=now)
-        self._cancellation_pass(report)
-        self._restore_pass(report)
-        admits = self._admission_pass(report, now)
-        admits = self._pressure_pass(admits, report)
-        self._dispatch(admits, report, now)
+        with get_tracer().span("sched.step", sched_step=self.step_idx):
+            self._cancellation_pass(report)
+            self._restore_pass(report)
+            admits = self._admission_pass(report, now)
+            admits = self._pressure_pass(admits, report)
+            self._dispatch(admits, report, now)
         if self.metrics is not None:
             self.metrics.on_step(report, self)
         return report
@@ -154,6 +163,10 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------- #
     def _event(self, event: str, uid: int, detail: str = "") -> None:
         self.events.append((self.step_idx, event, uid, detail))
+        # every lifecycle edge doubles as a trace instant (preempt /
+        # restore / admit / finish ... on the request's timeline)
+        get_tracer().instant(f"sched.{event}", uid=uid,
+                             sched_step=self.step_idx, detail=detail)
 
     def _close(self, req: Request, report: StepReport, now: float,
                cancelled: bool = False) -> None:
@@ -163,6 +176,10 @@ class ContinuousBatchingScheduler:
         (report.cancelled if cancelled else report.finished).append(req.uid)
         self._event("cancel" if cancelled else "finish", req.uid,
                     f"tokens={len(req.tokens_out)}")
+        get_tracer().async_end("request", req.uid,
+                               tokens=len(req.tokens_out),
+                               preemptions=req.n_preemptions,
+                               restores=req.n_restores)
         if self.metrics is not None:
             self.metrics.on_finish(req)
 
@@ -174,6 +191,7 @@ class ContinuousBatchingScheduler:
         self.done[req.uid] = req
         report.rejected.append((req.uid, reason))
         self._event("reject", req.uid, reason)
+        get_tracer().async_end("request", req.uid, reject=reason)
         if self.metrics is not None:
             self.metrics.on_finish(req)
 
@@ -239,14 +257,23 @@ class ContinuousBatchingScheduler:
         for req in self._restore_candidates():
             del self.suspended[req.uid]
             req.transition(RequestState.RESTORING)
-            if self.latent_preemption:
-                tokens = list(req.prompt) + req.tokens_out[:-1]
-                self.engine.restore_kv([req.uid], [tokens],
-                                       [req.latents])
-                mode = "latents"
-            else:
-                self.engine.resume_sequence(req.uid)
-                mode = "kv"
+            # half of the explicit restore/decode overlap span pair:
+            # this span covers the restore ISSUE; the decode dispatch
+            # issued later this step (sched.decode_dispatch, which
+            # carries overlapped_restores) is the other half — the
+            # overlap ratio is computed from the pair, never inferred
+            # from wall-clock adjacency
+            with get_tracer().span("sched.restore_issue", uid=req.uid,
+                                   sched_step=self.step_idx,
+                                   tokens=req.cached_tokens):
+                if self.latent_preemption:
+                    tokens = list(req.prompt) + req.tokens_out[:-1]
+                    self.engine.restore_kv([req.uid], [tokens],
+                                           [req.latents])
+                    mode = "latents"
+                else:
+                    self.engine.resume_sequence(req.uid)
+                    mode = "kv"
             req.n_restores += 1
             self.total_restores += 1
             report.restored.append(req.uid)
@@ -425,8 +452,16 @@ class ContinuousBatchingScheduler:
             [r.prompt for r in admits]
         report.decode_lanes = len(decodes)
         report.prefill_tokens = sum(len(r.prompt) for r in admits)
-        logits, latents = self.engine.put([r.uid for r in step_reqs],
-                                          toks)
+        # the decode half of the restore-overlap span pair (see
+        # _restore_pass): overlapped_restores is already decided, so
+        # the ratio is read straight off the pair's attributes
+        with get_tracer().span(
+                "sched.decode_dispatch", sched_step=self.step_idx,
+                lanes=report.decode_lanes,
+                prefill_tokens=report.prefill_tokens,
+                overlapped_restores=report.overlapped_restores):
+            logits, latents = self.engine.put(
+                [r.uid for r in step_reqs], toks)
         for j, req in enumerate(step_reqs):
             if self.latent_preemption:
                 req.absorb_latents(latents[j])

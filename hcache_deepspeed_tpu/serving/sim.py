@@ -164,13 +164,18 @@ class SimulatedEngine:
             need += self.state.blocks_needed(seq, len(tokens))
         if need > self.state.free_blocks:
             raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
-        for uid, tokens, latents in items:
-            seq = self.state.get_or_create_sequence(uid)
-            self.state.maybe_allocate_kv(seq, len(tokens))
-            seq.pre_forward(len(tokens))
-            seq.post_forward()
-            self.restore_stats["sequences"] += 1
-            self.restore_stats["bytes_shipped"] += latents.nbytes
+        from ..telemetry.tracer import get_tracer
+        with get_tracer().span(
+                "serve.restore_kv", sequences=len(items),
+                tokens=int(sum(len(it[1]) for it in items)),
+                latent_bytes=int(sum(it[2].nbytes for it in items))):
+            for uid, tokens, latents in items:
+                seq = self.state.get_or_create_sequence(uid)
+                self.state.maybe_allocate_kv(seq, len(tokens))
+                seq.pre_forward(len(tokens))
+                seq.post_forward()
+                self.restore_stats["sequences"] += 1
+                self.restore_stats["bytes_shipped"] += latents.nbytes
         self.counts["restore"] += 1
         self.restore_stats["restores"] += 1
         self.restore_stats["chunks_issued"] += max(len(items), 1)

@@ -1,0 +1,28 @@
+"""Unified observability layer: span tracing + derived step metrics.
+
+* :mod:`.tracer` — thread-safe ring-buffer span tracer
+  (``get_tracer().span("fwd", step=n)``), ~zero-cost when disabled, XLA
+  trace-annotation alignment on TPU.
+* :mod:`.export` — Chrome/Perfetto ``trace_event`` JSON export +
+  schema validation.
+* :mod:`.metrics` — per-step breakdown / tokens-sec / MFU pipeline
+  emitted through ``monitor.MonitorMaster``, and the offline
+  ``summarize``/``render_table`` reduction the CLI uses.
+* :mod:`.demo` — the CPU acceptance workload (train loop + logged
+  collective + serving preempt→restore cycle).
+
+CLI: ``python -m hcache_deepspeed_tpu.telemetry dump|summarize``.
+See ``docs/observability.md``.
+"""
+
+from .export import (load_trace, to_trace_events, validate_trace,  # noqa: F401
+                     write_trace)
+from .metrics import (StepMetrics, bench_extra, render_table,  # noqa: F401
+                      step_breakdown, summarize)
+from .tracer import Tracer, get_tracer  # noqa: F401
+
+__all__ = [
+    "Tracer", "get_tracer", "write_trace", "load_trace",
+    "to_trace_events", "validate_trace", "StepMetrics", "summarize",
+    "step_breakdown", "bench_extra", "render_table",
+]

@@ -1,0 +1,84 @@
+"""Telemetry CLI.
+
+``python -m hcache_deepspeed_tpu.telemetry dump [--out trace.json]``
+    Run the CPU reference workload (3-step train loop + logged
+    collective + serving preempt→restore cycle) with tracing on, write
+    a Perfetto-loadable ``trace.json`` and print the per-step
+    breakdown table. Load the file at https://ui.perfetto.dev.
+
+``python -m hcache_deepspeed_tpu.telemetry summarize trace.json``
+    Validate a previously exported trace and print its per-step
+    breakdown, restore-overlap and comm-volume attribution.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _cmd_dump(args):
+    # host-only by construction: the reference workload is the tier-1
+    # acceptance path and must not touch a TPU relay
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from . import render_table, summarize, validate_trace, write_trace
+    from .demo import run_demo
+    from .tracer import get_tracer
+
+    events, ctx = run_demo(steps=args.steps)
+    tracer = get_tracer()
+    trace = write_trace(events, args.out,
+                        thread_names=tracer.thread_names())
+    stats = validate_trace(trace)
+    summary = summarize(events)
+    print(render_table(summary))
+    sched = ctx["scheduler"]
+    print(f"scheduler counters: restores={sched.total_restores} "
+          f"overlapped={sched.overlapped_restores}")
+    print(f"engine restore_stats: {ctx['serve_engine'].restore_stats}")
+    print(f"wrote {args.out} ({stats['events']} events, "
+          f"{stats['spans']} spans) — load at https://ui.perfetto.dev")
+    return 0
+
+
+def _cmd_summarize(args):
+    from . import load_trace, render_table, summarize, validate_trace
+
+    events = load_trace(args.trace)
+    stats = validate_trace(events)
+    summary = summarize(events)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_table(summary))
+        print(f"({stats['events']} events, {stats['spans']} spans, "
+              f"{stats['pairs']} async pairs)")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m hcache_deepspeed_tpu.telemetry",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_dump = sub.add_parser(
+        "dump", help="run the CPU reference workload and export a trace")
+    p_dump.add_argument("--out", default="trace.json")
+    p_dump.add_argument("--steps", type=int, default=3)
+    p_dump.set_defaults(fn=_cmd_dump)
+
+    p_sum = sub.add_parser(
+        "summarize", help="validate + summarize an exported trace")
+    p_sum.add_argument("trace", nargs="?", default="trace.json")
+    p_sum.add_argument("--json", action="store_true",
+                       help="print the summary as JSON")
+    p_sum.set_defaults(fn=_cmd_summarize)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

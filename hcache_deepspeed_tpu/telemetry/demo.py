@@ -1,0 +1,144 @@
+"""CPU-runnable reference workload for the telemetry layer.
+
+One function drives everything the acceptance path needs: a short
+train loop (fwd/bwd/step spans, offload/reload spans), one logged
+collective (comm spans + the ``log_summary`` monitor route), and a
+serving preempt→restore cycle on the REAL ragged engine
+(request-lifecycle edges, restore staging spans, the restore/decode
+overlap span pair). Consumed by the ``python -m
+hcache_deepspeed_tpu.telemetry dump`` CLI and by the tier-1 trace
+schema test — the CLI and CI validate the *same* span stream.
+
+Everything runs on CPU (``JAX_PLATFORMS=cpu``); on a real TPU the same
+spans additionally open XLA trace annotations.
+"""
+
+import numpy as np
+
+from .tracer import get_tracer
+
+
+def run_train_demo(steps: int = 3, monitor=None):
+    """Tiny single-device GPT-2 loop: ``steps`` optimizer steps through
+    the micro-step API (forward/backward/step → per-phase spans), one
+    fused ``train_batch`` step (fused-dispatch span + throughput
+    emission) and one offload/reload round trip. Returns the engine."""
+    import jax
+
+    import hcache_deepspeed_tpu as hds
+    from ..models.gpt2 import GPT2LMHeadModel, gpt2_tiny
+    from ..parallel import topology as topo_mod
+
+    topo = topo_mod.initialize_topology(
+        topo_mod.TopologySpec(data=1), devices=jax.devices()[:1])
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (4, 32), np.int32)}
+    engine, _, _, _ = hds.initialize(
+        model=GPT2LMHeadModel(gpt2_tiny()), topology=topo,
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "wall_clock_breakdown": True,
+                "steps_per_print": 1},
+        example_batch=batch)
+    if monitor is not None:
+        engine.monitor.writers.append(monitor)
+    for _ in range(steps):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        jax.block_until_ready(loss)
+    # one fused-path step: train.train_batch / fused_dispatch spans +
+    # the ThroughputTimer samples-per-sec emission
+    # (start_step=0 counts it despite being the only fused step)
+    engine.tput_timer.start_step = 0
+    jax.block_until_ready(engine.train_batch(batch=batch))
+    # explicit between-phase offload round trip (the RLHF reclaim path)
+    engine.offload_states(include=["opt"])
+    engine.reload_states()
+    return engine
+
+
+def run_comm_demo(engine, monitor=None):
+    """One logged facade collective on the engine's mesh → trace-time
+    comm spans + the aggregate table through the monitor sink."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .. import comm
+
+    comm.configure(enabled=True)
+    mesh = engine.mesh
+    x = jnp.arange(8.0)
+
+    f = jax.jit(jax.shard_map(
+        lambda a: comm.all_reduce(a, group=("data",)),
+        mesh=mesh, in_specs=P(), out_specs=P()))
+    jax.block_until_ready(f(x))
+    comm.log_summary(monitor=monitor or engine.monitor, step=0)
+
+
+def run_serving_demo(metrics=None, monitor=None):
+    """Preempt→restore cycle on the real tiny-Llama ragged engine
+    behind the continuous-batching server (virtual clock, so the trace
+    is deterministic). Returns ``(engine, scheduler)``."""
+    import jax
+
+    from ..inference import InferenceEngineV2, RaggedInferenceEngineConfig
+    from ..models.llama import LlamaForCausalLM, llama_tiny
+    from ..serving import (Request, ServerConfig, ServingMetrics,
+                           ServingServer, VirtualClock)
+
+    cfg = llama_tiny(max_positions=128, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)},
+                        train=False)["params"]
+    # 9 KV blocks: tight enough that the high-priority late arrival
+    # forces a preemption, whose restore then overlaps resident decode
+    engine = InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 128,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 128},
+            kv_cache={"block_size": 8, "num_blocks": 9,
+                      "cache_dtype": "float32"}))
+    rng = np.random.default_rng(0)
+    prompts = [list(map(int, rng.integers(0, cfg.vocab_size, 20)))
+               for _ in range(3)]
+    reqs = [Request(uid=i, prompt=p,
+                    max_new_tokens=(8 if i == 2 else 14),
+                    arrival_time=0.01 * i,
+                    priority=(5 if i == 2 else 0))
+            for i, p in enumerate(prompts)]
+    srv = ServingServer(engine, clock=VirtualClock(),
+                        metrics=metrics or ServingMetrics(),
+                        monitor=monitor, emit_every_steps=1,
+                        config=ServerConfig(
+                            kv_demand_fraction=float("inf")))
+    srv.run_trace(reqs)
+    return engine, srv.scheduler
+
+
+def run_demo(steps: int = 3, monitor=None):
+    """Full acceptance workload. Enables the tracer, runs train + comm
+    + serving phases and returns ``(events, context)`` where context
+    carries the live objects assertions cross-check spans against."""
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    try:
+        train_engine = run_train_demo(steps=steps, monitor=monitor)
+        run_comm_demo(train_engine, monitor=monitor)
+        serve_engine, scheduler = run_serving_demo(monitor=monitor)
+    finally:
+        tracer.configure(enabled=was_enabled)
+    events = tracer.events()
+    return events, {
+        "train_engine": train_engine,
+        "serve_engine": serve_engine,
+        "scheduler": scheduler,
+    }

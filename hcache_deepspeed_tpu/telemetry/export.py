@@ -1,0 +1,130 @@
+"""Chrome/Perfetto ``trace_event`` export + schema validation.
+
+The on-disk format is the Trace Event JSON object form
+(``{"traceEvents": [...], "displayTimeUnit": "ms"}``) that
+https://ui.perfetto.dev and ``chrome://tracing`` both load directly.
+Every emitter in the repo goes through :func:`write_trace`, and the
+tier-1 schema test drives :func:`validate_trace` over a real exported
+trace, so a malformed emitter can never ship silently.
+"""
+
+import json
+
+#: phases that must carry a timestamp
+_TIMED_PHASES = ("X", "B", "E", "b", "e", "n", "i", "C", "s", "f")
+
+
+def to_trace_events(events, thread_names=None, pid=0):
+    """Events (tracer record dicts) -> a sorted trace_event list with
+    thread-name metadata prepended. Sorting by ``ts`` restores
+    per-thread monotonicity (spans are recorded at exit time, so a
+    nested span lands in the buffer before its parent)."""
+    out = []
+    for tid, name in sorted((thread_names or {}).items()):
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": name}})
+    out.extend(sorted(events, key=lambda e: e.get("ts", 0.0)))
+    return out
+
+
+def write_trace(events, path, thread_names=None, pid=0):
+    """Write a Perfetto-loadable ``trace.json``; returns the trace dict."""
+    trace = {
+        "traceEvents": to_trace_events(events, thread_names, pid),
+        "displayTimeUnit": "ms",
+    }
+    with open(path, "w") as fh:
+        json.dump(trace, fh)
+    return trace
+
+
+def load_trace(path):
+    """Read a trace file back into its event list."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    if isinstance(obj, dict):
+        return obj.get("traceEvents", [])
+    return obj
+
+
+def validate_trace(trace):
+    """Validate trace_event structure; raises ``ValueError`` on the
+    first violation, returns ``{"events", "spans", "pairs"}`` counts.
+
+    Checks: top-level shape, required keys per phase (``X`` needs
+    ``ts``/``dur``/``pid``/``tid`` with ``dur >= 0``), ``B``/``E``
+    stack pairing per ``(pid, tid)``, async ``b``/``e`` pairing per
+    ``(cat, id, name)``, and non-decreasing ``ts`` per ``(pid, tid)``.
+    """
+    if isinstance(trace, dict):
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError("trace dict must carry a 'traceEvents' list")
+    elif isinstance(trace, list):
+        events = trace
+    else:
+        raise ValueError(f"trace must be a dict or list, got {type(trace)}")
+
+    spans = pairs = 0
+    be_stack = {}           # (pid, tid) -> open B count
+    async_open = {}         # (cat, id, name) -> open b count
+    last_ts = {}            # (pid, tid) -> last seen ts
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i} is not an object")
+        ph = ev.get("ph")
+        name = ev.get("name")
+        if not ph or name is None:
+            raise ValueError(f"event {i} missing 'ph'/'name': {ev}")
+        if ph == "M":
+            continue
+        if ph in _TIMED_PHASES and "ts" not in ev:
+            raise ValueError(f"event {i} ({ph} {name!r}) missing 'ts'")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph in ("X", "B", "E", "i", "C"):
+            if ev.get("pid") is None or ev.get("tid") is None:
+                raise ValueError(
+                    f"event {i} ({ph} {name!r}) missing pid/tid")
+            ts = float(ev["ts"])
+            if ts < last_ts.get(key, float("-inf")):
+                raise ValueError(
+                    f"event {i} ({ph} {name!r}): ts {ts} not monotone "
+                    f"on tid {key}")
+            last_ts[key] = ts
+        if ph == "X":
+            if "dur" not in ev:
+                raise ValueError(f"event {i} (X {name!r}) missing 'dur'")
+            if float(ev["dur"]) < 0:
+                raise ValueError(f"event {i} (X {name!r}) negative dur")
+            spans += 1
+        elif ph == "B":
+            be_stack[key] = be_stack.get(key, 0) + 1
+        elif ph == "E":
+            open_ = be_stack.get(key, 0)
+            if open_ <= 0:
+                raise ValueError(
+                    f"event {i} (E {name!r}): no open B on tid {key}")
+            be_stack[key] = open_ - 1
+            spans += 1
+        elif ph in ("b", "e"):
+            if "id" not in ev or "cat" not in ev:
+                raise ValueError(
+                    f"event {i} ({ph} {name!r}) missing 'id'/'cat'")
+            akey = (ev["cat"], ev["id"], name)
+            if ph == "b":
+                async_open[akey] = async_open.get(akey, 0) + 1
+            else:
+                open_ = async_open.get(akey, 0)
+                if open_ <= 0:
+                    raise ValueError(
+                        f"event {i} (e {name!r}): unmatched async end "
+                        f"for {akey}")
+                async_open[akey] = open_ - 1
+                pairs += 1
+    dangling = {k: v for k, v in be_stack.items() if v}
+    if dangling:
+        raise ValueError(f"unclosed B events on tids {dangling}")
+    dangling = {k: v for k, v in async_open.items() if v}
+    if dangling:
+        raise ValueError(f"unclosed async intervals: {sorted(dangling)}")
+    return {"events": len(events), "spans": spans, "pairs": pairs}

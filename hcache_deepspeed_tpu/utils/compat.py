@@ -1,0 +1,46 @@
+"""JAX API compatibility shims.
+
+The codebase targets the promoted ``jax.shard_map`` API (``axis_names``
+partial-manual selection, ``check_vma``). On jax versions where
+``shard_map`` still lives under ``jax.experimental`` (≤ 0.4.x) the
+public symbol is missing and every manual-collective path (ZeRO++,
+1-bit, ring attention, pipeline executor, TP inference) raises
+``AttributeError`` at call time. :func:`ensure_jax_compat` installs a
+translating wrapper once, at package import, so both API generations
+run the same source.
+"""
+
+
+def ensure_jax_compat():
+    import jax
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of a literal over a named axis binds to the static
+            # axis size at trace time — the pre-promotion idiom
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, check_rep=None, **kwargs):
+        # promoted-API ``axis_names`` (axes that are MANUAL) maps onto
+        # the experimental API's ``auto`` complement
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if check_rep is None:
+            # check_vma is the promoted spelling of check_rep; default
+            # lenient — the old checker rejects partial-manual programs
+            # the new one accepts
+            check_rep = bool(check_vma) if check_vma is not None else False
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_rep,
+                          auto=auto, **kwargs)
+
+    jax.shard_map = shard_map

@@ -90,9 +90,16 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Reference: ThroughputTimer — tracks samples/sec after warmup."""
+    """Reference: ThroughputTimer — tracks samples/sec after warmup.
 
-    def __init__(self, batch_size, start_step=2, steps_per_output=50):
+    With a ``monitor`` and ``emit_events=True`` (the engine wires this
+    when ``wall_clock_breakdown`` is on) every counted global step also
+    emits ``Train/samples_per_sec`` — and, when the caller passes the
+    step's token count to :meth:`stop`, ``Train/tokens_per_sec`` —
+    through the ``MonitorMaster`` event path."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50,
+                 monitor=None, emit_events=False):
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
@@ -101,11 +108,13 @@ class ThroughputTimer:
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
         self._start = None
+        self.monitor = monitor
+        self.emit_events = emit_events
 
     def start(self):
         self._start = time.perf_counter()
 
-    def stop(self, global_step=True, report_speed=True):
+    def stop(self, global_step=True, report_speed=True, tokens=0):
         if self._start is None:
             return
         duration = time.perf_counter() - self._start
@@ -115,6 +124,17 @@ class ThroughputTimer:
             if self.global_step_count >= self.start_step:
                 self.total_elapsed_time += duration
                 self.step_elapsed_time += duration
+                if self.emit_events and self.monitor is not None and \
+                        getattr(self.monitor, "enabled", True) and \
+                        duration > 0:
+                    events = [("Train/samples_per_sec",
+                               self.batch_size / duration,
+                               self.global_step_count)]
+                    if tokens:
+                        events.append(("Train/tokens_per_sec",
+                                       tokens / duration,
+                                       self.global_step_count))
+                    self.monitor.write_events(events)
                 if report_speed and self.steps_per_output and \
                         self.global_step_count % self.steps_per_output == 0:
                     log_dist(

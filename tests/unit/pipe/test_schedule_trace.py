@@ -1,0 +1,33 @@
+"""Synthetic schedule trace: the (tick, stage) work table rendered as
+trace_event spans must validate and reproduce the 1F1B/GPipe tick
+arithmetic the executor tests pin down."""
+
+import pytest
+
+from hcache_deepspeed_tpu.runtime.pipe.schedule import (
+    bwd_tick, fwd_tick, gpipe_tick_work, schedule_trace_events)
+from hcache_deepspeed_tpu.telemetry import validate_trace
+
+
+def test_1f1b_trace_spans_match_tick_arithmetic():
+    M, S = 4, 2
+    events = schedule_trace_events(M, S, "1f1b", tick_us=100.0)
+    assert validate_trace(events)["spans"] == 2 * M * S
+    for ev in events:
+        mb, s = ev["args"]["micro_batch"], ev["args"]["stage"]
+        tick = (fwd_tick(s, mb, S) if ev["name"].startswith("pipe.fwd")
+                else bwd_tick(s, mb, S))
+        assert ev["ts"] == tick * 100.0 and ev["tid"] == s
+
+
+def test_gpipe_trace_matches_work_table():
+    M, S = 3, 3
+    events = schedule_trace_events(M, S, "gpipe")
+    table = gpipe_tick_work(M, S)
+    expected = sum(1 for row in table for mb in row if mb is not None)
+    assert validate_trace(events)["spans"] == expected == M * S
+
+
+def test_unknown_schedule_rejected():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        schedule_trace_events(2, 2, "interleaved")

@@ -1,0 +1,143 @@
+"""Satellite regressions for the observability PR: CSVMonitor handle
+caching, InMemoryMonitor bounded deque, comms log_summary through the
+monitor sink, ThroughputTimer event emission, and _Timer.elapsed
+semantics."""
+
+import csv
+import gc
+from collections import deque
+
+from hcache_deepspeed_tpu.comm.comms_logging import CommsLogger
+from hcache_deepspeed_tpu.monitor.monitor import CSVMonitor, InMemoryMonitor
+from hcache_deepspeed_tpu.utils.timer import ThroughputTimer, _Timer
+
+
+class _CSVCfg:
+    enabled = True
+    output_path = None
+    job_name = "job"
+
+
+# ------------------------------------------------------------------ #
+# CSVMonitor: cached handles instead of reopen-per-event
+# ------------------------------------------------------------------ #
+def test_csv_monitor_caches_file_handles(tmp_path):
+    cfg = _CSVCfg()
+    cfg.output_path = str(tmp_path)
+    mon = CSVMonitor(cfg)
+    for step in range(5):
+        mon.write_events([("Train/loss", 0.5 - step * 0.01, step),
+                          ("Train/lr", 1e-3, step)])
+    # one cached handle per label, not one open() per event
+    assert set(mon._files) == {"Train/loss", "Train/lr"}
+    mon.flush()
+    path = tmp_path / "job" / "Train_loss.csv"
+    rows = list(csv.reader(path.open()))
+    assert rows[0] == ["step", "Train/loss"]
+    assert len(rows) == 6 and rows[1][0] == "0" and rows[5][0] == "4"
+    mon.close()
+    assert mon._files == {}
+    # close is idempotent and __del__-safe
+    mon.close()
+    del mon
+    gc.collect()
+
+
+def test_csv_monitor_append_resumes_without_second_header(tmp_path):
+    cfg = _CSVCfg()
+    cfg.output_path = str(tmp_path)
+    mon = CSVMonitor(cfg)
+    mon.write_events([("m", 1.0, 0)], flush=True)
+    mon.close()
+    mon2 = CSVMonitor(cfg)
+    mon2.write_events([("m", 2.0, 1)], flush=True)
+    rows = list(csv.reader((tmp_path / "job" / "m.csv").open()))
+    assert rows == [["step", "m"], ["0", "1.0"], ["1", "2.0"]]
+    mon2.close()
+
+
+# ------------------------------------------------------------------ #
+# InMemoryMonitor: bounded deque, O(1) eviction
+# ------------------------------------------------------------------ #
+def test_in_memory_monitor_bounded_deque():
+    mon = InMemoryMonitor(capacity=4)
+    assert isinstance(mon.events, deque)
+    mon.write_events([("a", float(i), i) for i in range(10)])
+    assert len(mon.events) == 4
+    assert [step for _, _, step in mon.events] == [6, 7, 8, 9]
+    assert mon.latest["a"] == (9.0, 9)
+
+
+# ------------------------------------------------------------------ #
+# comms log_summary -> monitor sink
+# ------------------------------------------------------------------ #
+def test_comms_log_summary_routes_through_monitor():
+    logger = CommsLogger(enabled=True)
+    logger.append("all_reduce", ("data",), 1024)
+    logger.append("all_reduce", ("data",), 1024)
+    logger.append("all_gather", (), 256)
+    mon = InMemoryMonitor()
+    logger.log_summary(monitor=mon, step=7)
+    got = {label: (value, step) for label, value, step in mon.events}
+    assert got["CommsSummary/all_reduce@data/count"] == (2.0, 7)
+    assert got["CommsSummary/all_reduce@data/bytes"] == (2048.0, 7)
+    assert got["CommsSummary/all_gather@world/bytes"] == (256.0, 7)
+
+
+def test_comms_append_emits_trace_instants():
+    from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True, xla=False)
+    tracer.clear()
+    try:
+        logger = CommsLogger(enabled=True)
+        logger.append("reduce_scatter", ("data", "tensor"), 4096)
+    finally:
+        tracer.configure(enabled=was)
+    (ev,) = [e for e in tracer.events()
+             if e["name"] == "comm.reduce_scatter"]
+    assert ev["ph"] == "i"
+    assert ev["args"] == {"bytes": 4096, "axes": "data,tensor"}
+
+
+# ------------------------------------------------------------------ #
+# timers
+# ------------------------------------------------------------------ #
+def test_timer_elapsed_no_reset_keeps_running_count():
+    t = _Timer("t")
+    for _ in range(3):
+        t.start()
+        t.stop()
+    count_before = t.count
+    total = t.elapsed(reset=False)
+    # regression: reset=False must clear NEITHER the accumulator NOR
+    # the running count
+    assert t.count == count_before == 3
+    assert t.elapsed(reset=False) == total
+    assert t.mean() == total / 3
+    t.elapsed(reset=True)
+    assert t.count == 0 and t.elapsed_ == 0.0
+
+
+def test_throughput_timer_emits_tokens_and_samples_per_sec():
+    mon = InMemoryMonitor()
+    tt = ThroughputTimer(batch_size=4, start_step=1, steps_per_output=0,
+                         monitor=mon, emit_events=True)
+    for _ in range(3):
+        tt.start()
+        tt.stop(report_speed=False, tokens=128)
+    labels = [label for label, _, _ in mon.events]
+    assert labels.count("Train/samples_per_sec") == 3
+    assert labels.count("Train/tokens_per_sec") == 3
+    steps = [step for label, _, step in mon.events
+             if label == "Train/tokens_per_sec"]
+    assert steps == [1, 2, 3]
+    assert all(v > 0 for _, v, _ in mon.events)
+
+
+def test_throughput_timer_silent_without_monitor():
+    tt = ThroughputTimer(batch_size=4, start_step=1)
+    tt.start()
+    tt.stop(tokens=128)          # must not raise without a monitor
+    assert tt.global_step_count == 1
