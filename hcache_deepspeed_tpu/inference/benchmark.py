@@ -360,6 +360,143 @@ def run_restore_marginal(model_size="tiny", max_context=512,
     return results
 
 
+def run_restore_crossover(model_size="tiny", max_context=512,
+                          prompt_lens=(32, 64, 128, 256), batch=1,
+                          quantize="", latent_dtype="", chain=8,
+                          out="RESTORE_CROSSOVER.jsonl"):
+    """Crossover curve: marginal restore cost vs full prefill replay
+    across prompt lengths, plus the analytic model's verdicts.
+
+    For each prompt length the marginal device cost of a full-stack
+    prefill and of the end-to-end restore (ship + QKV replay) are
+    measured with the chained-dispatch slope method
+    (:func:`run_restore_marginal`), the measured link bandwidth and
+    prefill rate are fed into a :class:`~..serving.crossover.
+    RestoreCrossoverModel` through its ``observe_*`` calibration hooks,
+    and one JSONL row per length records both the measurement and the
+    model's prediction — so the artifact shows where the measured
+    curves cross AND whether the scheduler's analytic model would pick
+    the cheaper side there. A summary row carries the calibrated rates
+    and the first measured crossover length.
+
+    Rows append to ``out`` (``out=""`` for stdout only)."""
+    import jax
+
+    from ..serving.crossover import CrossoverConfig, RestoreCrossoverModel
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    rng = np.random.default_rng(0)
+    cfg, eng_lat = _engine(model_size, max_context, batch, latents=True,
+                           quantize=quantize, latent_dtype=latent_dtype)
+    cfg, eng = _engine(model_size, max_context, batch, latents=False,
+                       quantize=quantize, latent_dtype=latent_dtype)
+    model = RestoreCrossoverModel(eng_lat.restore_profile(),
+                                  CrossoverConfig(min_samples=1))
+
+    def sync():
+        np.asarray(eng.cache.k[0, 0, 0, 0])
+
+    def clear(engine, uids):
+        for u in uids:
+            if engine.state.get_sequence(u) is not None:
+                engine.flush(u)
+
+    def timed(fn, k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        sync()
+        return time.perf_counter() - t0
+
+    curve = []
+    for prompt_len in prompt_lens:
+        if prompt_len >= max_context:
+            continue
+        prompts = [list(rng.integers(0, cfg.vocab_size, (prompt_len,)))
+                   for _ in range(batch)]
+        uids = list(range(batch))
+        _, latents = eng_lat.put(uids, prompts)
+        clear(eng_lat, uids)
+
+        # marginal full-stack prefill (deferred fetch: device cost only)
+        eng.put(uids, prompts, defer_fetch=True)   # warm
+        sync()
+
+        def prefill_once():
+            clear(eng, uids)
+            eng.put(uids, prompts, defer_fetch=True)
+
+        t1 = timed(prefill_once, 1)
+        tk = timed(prefill_once, 1 + chain)
+        prefill_ms = max(tk - t1, 1e-9) / chain * 1000
+
+        # measured link bandwidth for THIS length's latent slab
+        clear(eng, uids)
+        items = [(uid, np.asarray(p, np.int32), np.asarray(latents[j]))
+                 for j, (uid, p) in enumerate(zip(uids, prompts))]
+        lat, start, t_len, tables, seqs = eng._stage_restore_group(items)
+        jax.device_put(lat[:1]).block_until_ready()
+        t0 = time.perf_counter()
+        jax.device_put(lat).block_until_ready()
+        ship_s = time.perf_counter() - t0
+        for seq in seqs:   # undo the staging state ops
+            seq.post_forward()
+        clear(eng, uids)
+
+        # marginal end-to-end restore (ship + replay, double-buffered)
+        def restore_once():
+            clear(eng, uids)
+            eng.restore_kv(uids, prompts, latents)
+
+        restore_once()   # warm the restore chain at this bucket
+        t1 = timed(restore_once, 1)
+        tk = timed(restore_once, 1 + chain)
+        restore_ms = max(tk - t1, 1e-9) / chain * 1000
+        clear(eng, uids)
+
+        tokens = batch * prompt_len
+        model.observe_ship(lat.nbytes, ship_s)
+        model.observe_prefill(tokens, prefill_ms / 1000)
+        model.observe_replay(tokens, restore_ms / 1000)
+        curve.append((prompt_len, prefill_ms, restore_ms))
+
+        emit({
+            "phase": "restore-crossover", "model": model_size,
+            "batch": batch, "prompt_len": prompt_len,
+            "latent_dtype": latent_dtype,
+            "latent_mb": round(lat.nbytes / 2**20, 3),
+            "link_gbps": round(lat.nbytes / max(ship_s, 1e-9) / 1e9, 3),
+            "prefill_ms": round(prefill_ms, 3),
+            "restore_ms": round(restore_ms, 3),
+            "measured_winner": "restore" if restore_ms <= prefill_ms
+            else "recompute",
+            "model_choice": model.decide(prompt_len),
+            "restore_pred_ms": round(
+                model.restore_cost_s(prompt_len) * 1000, 3),
+            "recompute_pred_ms": round(
+                model.recompute_cost_s(prompt_len) * 1000, 3)})
+
+    # first measured crossover: the shortest length where restore wins
+    cross_at = next((pl for pl, pre, res in curve if res <= pre), None)
+    emit({"phase": "restore-crossover-summary", "model": model_size,
+          "batch": batch, "prompt_lens": [c[0] for c in curve],
+          "crossover_prompt_len": cross_at,
+          "calibration": model.summary()})
+    if fh is not None:
+        fh.close()
+    return results
+
+
 def run_sweep(model_size="tiny", max_context=512, prompt_len=128,
               max_new=32, rates=(1.0, 2.0, 4.0), n_requests=16,
               max_batch=8, seed=0, quantize="", prefill_chunk=0,
@@ -1021,6 +1158,16 @@ def main(argv=None):
                    help="HCache marginal-cost mode: chained dispatches "
                         "split device replay cost from host-link ship "
                         "cost (for high-latency relays)")
+    p.add_argument("--restore-crossover", action="store_true",
+                   help="restore-vs-recompute crossover curve across "
+                        "prompt lengths + the analytic model's verdicts "
+                        "(JSONL artifact)")
+    p.add_argument("--prompt-lens", type=int, nargs="+",
+                   default=[32, 64, 128, 256],
+                   help="prompt lengths for --restore-crossover")
+    p.add_argument("--crossover-out", default="RESTORE_CROSSOVER.jsonl",
+                   help="JSONL file for --restore-crossover rows "
+                        "('' = stdout only)")
     p.add_argument("--fused-decode", action="store_true",
                    help="measure the on-device generate_fused loop "
                         "instead of host-driven per-step decode")
@@ -1055,6 +1202,13 @@ def main(argv=None):
                   quantize=args.quantize,
                   prefill_chunk=args.prefill_chunk,
                   prefix_caching=args.prefix_caching)
+    elif args.restore_crossover:
+        run_restore_crossover(args.model, args.max_context,
+                              tuple(args.prompt_lens),
+                              batch=min(args.batches),
+                              quantize=args.quantize,
+                              latent_dtype=args.latent_dtype,
+                              out=args.crossover_out)
     elif args.restore_marginal:
         run_restore_marginal(args.model, args.max_context,
                              args.prompt_len, tuple(args.batches),

@@ -14,6 +14,7 @@ buckets.
 """
 
 import functools
+from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Tuple
 
 import jax
@@ -41,6 +42,26 @@ def _annotated(name):
                 return fn(*args, **kwargs)
         return wrapper
     return deco
+
+
+@dataclass
+class RestoreTicket:
+    """Handle for one ``begin_restore`` batch: ``done`` flips when
+    every lane the batch staged has issued its last replay chunk (the
+    sequences are then decodable)."""
+    uids: List[int] = field(default_factory=list)
+    pending: int = 0          # lanes still open
+    done: bool = False
+
+
+@dataclass
+class _RestoreLane:
+    """One bucket group's open restore pipeline + the state ops owed
+    at completion."""
+    pipe: object
+    seqs: List[object]
+    uids: List[int]
+    ticket: RestoreTicket
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -182,6 +203,9 @@ class InferenceEngineV2:
         #: scheduler overlaps the in-flight ship with resident decode)
         self.restore_stats = {"restores": 0, "sequences": 0,
                               "chunks_issued": 0, "bytes_shipped": 0}
+        #: open decode-interleaved restore lanes (FIFO), advanced by
+        #: advance_restores between the scheduler's decode dispatches
+        self._restore_lanes: List[_RestoreLane] = []
         log_dist(f"InferenceEngineV2: {num_blocks} KV blocks x "
                  f"{self.block_size} tokens, max_context="
                  f"{self.max_context}", ranks=[0])
@@ -961,7 +985,27 @@ class InferenceEngineV2:
         """Rebuild the blocked KV cache for ``batch_uids`` from saved
         latents without a full forward: allocate blocks, then per layer
         replay the K/V projection + RoPE + cache write with host→HBM copies
-        double-buffered against compute."""
+        double-buffered against compute.
+
+        Run-to-completion driver over the restore lane
+        (:meth:`begin_restore` + :meth:`advance_restores`); the serving
+        scheduler holds the lane open instead and trickles chunks
+        between resident decode dispatches."""
+        self.begin_restore(batch_uids, batch_tokens, batch_latents)
+        self.advance_restores()
+
+    def begin_restore(self, batch_uids: Iterable[int],
+                      batch_tokens: Iterable,
+                      batch_latents: Iterable) -> "RestoreTicket":
+        """Open a restore lane: validate + admit the batch
+        all-or-nothing, allocate KV blocks, build the padded lane slabs
+        and issue the FIRST layer-chunks' host→device ships — but
+        dispatch no replay yet. The returned ticket completes as
+        :meth:`advance_restores` drains the lane; until then the
+        sequences are tracked and in-flight (their blocks are held, and
+        they must not be decoded). The ship of chunk 0 is already on
+        the link when this returns, so whatever the engine dispatches
+        next (typically the residents' decode) computes under it."""
         batch_uids = list(batch_uids)
         self._reject_suspended(batch_uids)
         # group sequences by length bucket: ONE batched restore dispatch
@@ -1012,6 +1056,10 @@ class InferenceEngineV2:
             self.restore_stats["chunks_issued"] += 1
             self.restore_stats["bytes_shipped"] += int(nbytes)
 
+        ticket = RestoreTicket(uids=list(uid_list))
+        # the umbrella span covers STAGING (state ops + slab build +
+        # first ships); the replay chunks get their own
+        # serve.restore.stage spans as advance_restores issues them
         with get_tracer().span(
                 "serve.restore_kv", sequences=len(items),
                 tokens=int(sum(len(it[1]) for it in items)),
@@ -1019,10 +1067,85 @@ class InferenceEngineV2:
             for T, group in sorted(groups.items()):
                 lat, start, t_len, tables, seqs = \
                     self._stage_restore_group(group, T)
-                self.model.restore_kv(self.cache, lat, start, tables,
-                                      t_len, progress_cb=_progress)
-                for seq in seqs:
-                    seq.post_forward()
+                pipe = self.model.restore_pipeline(
+                    self.cache, lat, start, tables, t_len,
+                    progress_cb=_progress)
+                pipe.prefetch()   # chunk 0's H2D rides the link now
+                ticket.pending += 1
+                self._restore_lanes.append(
+                    _RestoreLane(pipe=pipe, seqs=seqs,
+                                 uids=[it[0] for it in group],
+                                 ticket=ticket))
+        if ticket.pending == 0:
+            ticket.done = True
+        return ticket
+
+    def advance_restores(self, max_chunks: int = 0):
+        """Issue up to ``max_chunks`` replay-chunk dispatches across
+        the open restore lanes, oldest lane first (0 = drain
+        everything). Entirely async — the caller may dispatch decode
+        forwards between calls and the pending chunks' H2D ships hide
+        under that compute. Returns ``(chunks_issued, completed_uids,
+        touched_uids)`` — ``touched`` are the lanes that issued >= 1
+        chunk this call (the scheduler's overlap accounting);
+        a lane's sequences become decodable (their ``post_forward``
+        runs) exactly when the lane's last chunk has been issued."""
+        issued = 0
+        completed: List[int] = []
+        touched: List[int] = []
+        while self._restore_lanes and (max_chunks <= 0 or
+                                       issued < max_chunks):
+            lane = self._restore_lanes[0]
+            budget = 0 if max_chunks <= 0 else max_chunks - issued
+            n = lane.pipe.advance(budget)
+            issued += n
+            if n:
+                touched.extend(lane.uids)
+            if not lane.pipe.done:
+                break
+            for seq in lane.seqs:
+                seq.post_forward()
+            completed.extend(lane.uids)
+            lane.ticket.pending -= 1
+            if lane.ticket.pending <= 0:
+                lane.ticket.done = True
+            self._restore_lanes.pop(0)
+        return issued, completed, touched
+
+    @property
+    def pending_restore_chunks(self) -> int:
+        """Replay chunks not yet issued across all open lanes."""
+        return sum(l.pipe.chunks_total - l.pipe.chunks_issued
+                   for l in self._restore_lanes)
+
+    @property
+    def restoring_uids(self) -> List[int]:
+        return [u for l in self._restore_lanes for u in l.uids]
+
+    def restore_profile(self) -> Dict:
+        """Static shape facts the restore-vs-recompute crossover model
+        (``serving/crossover.py``) seeds itself from: latent bytes per
+        token, the replay/prefill FLOPs split, and how many replay
+        chunks a restore costs (each chunk is one dispatch — the fixed
+        overhead that makes recompute win at short prompts)."""
+        cfg = self._model_config
+        H = cfg.hidden_size
+        kvd = cfg.n_kv_head * cfg.head_dim
+        qd = cfg.n_head * cfg.head_dim
+        # matmul flops per token per layer (factor 2 folded out — only
+        # the ratio matters): replay runs the q/k/v projections; a full
+        # forward adds the o-projection and the 3 SwiGLU matmuls
+        replay = H * (qd + 2 * kvd)
+        full = replay + H * qd + 3 * H * cfg.intermediate_size
+        latent_itemsize = jnp.dtype(self.model.latent_dtype).itemsize
+        return {
+            "n_layer": cfg.n_layer,
+            "latent_bytes_per_token": cfg.hidden_size * latent_itemsize
+            * cfg.n_layer,
+            "replay_flops_frac": replay / full,
+            "restore_chunk_layers": self.model.restore_chunk_layers,
+            "restore_chunk_bytes": self.model.restore_chunk_bytes,
+        }
 
     def _stage_restore_group(self, group, T=None):
         """State ops + lane slab for ONE bucket group of
@@ -1218,6 +1341,10 @@ class InferenceEngineV2:
     # Lifecycle (reference: flush :275, serialize :284)
     # -------------------------------------------------------------- #
     def flush(self, uid: int) -> None:
+        if self._restore_lanes and uid in self.restoring_uids:
+            raise RuntimeError(
+                f"sequence {uid} has an open restore lane; its blocks "
+                "cannot be freed while replay chunks are in flight")
         seq = self.state.get_sequence(uid)
         held = list(seq.blocks) if seq is not None else []
         get_tracer().instant("serve.flush", uid=uid,
@@ -1235,8 +1362,17 @@ class InferenceEngineV2:
     def _reject_suspended(self, uids):
         """Both cache write paths (put, restore_kv) must refuse suspended
         sequences BEFORE any allocation/bookkeeping — writing against the
-        stale seen_tokens would corrupt the host copy's accounting."""
+        stale seen_tokens would corrupt the host copy's accounting.
+        Likewise sequences whose restore lane is still open: their
+        ``seen_tokens`` only advances when the lane completes, so a
+        forward now would write over the restoring slots."""
+        restoring = set(self.restoring_uids) if self._restore_lanes \
+            else ()
         for uid in uids:
+            if uid in restoring:
+                raise RuntimeError(
+                    f"sequence {uid} has an open restore lane; drain "
+                    "advance_restores before forwarding it")
             seq = self.state.get_sequence(uid)
             if seq is not None and seq.host_kv is not None:
                 raise RuntimeError(

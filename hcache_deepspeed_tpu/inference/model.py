@@ -251,7 +251,16 @@ class PagedInferenceModel:
                        and joined in ("lm_head", "lm_head/kernel")
                        and getattr(leaf, "ndim", 0) == 2
                        and leaf.size >= qc.min_size)
-            if is_head and leaf.shape[-2] % qc.group_size == 0:
+            if is_head:
+                if leaf.shape[-2] % qc.group_size:
+                    # same misalignment as the trunk case below: the
+                    # head silently staying dense would skew quantized
+                    # decode measurements (the head is the single
+                    # largest matmul per decoded token) — record it so
+                    # the warning fires and the flat-layout fallback
+                    # can't quietly re-quantize it either
+                    skipped.append((joined, tuple(leaf.shape)))
+                    return leaf
                 return MatmulQuantizedTensor.make(
                     jnp.asarray(leaf), group_k=qc.group_size,
                     num_bits=qc.bits)
@@ -281,7 +290,7 @@ class PagedInferenceModel:
         if skipped:
             from ..utils.logging import log_dist
             log_dist(
-                "quantization: %d trunk leaves stay full precision "
+                "quantization: %d trunk/head leaves stay full precision "
                 "(K %% group_size=%d != 0): %s"
                 % (len(skipped), qc.group_size,
                    ", ".join(f"{p}{s}" for p, s in skipped[:4])),
@@ -1033,6 +1042,14 @@ class PagedInferenceModel:
         return jax.lax.fori_loop(0, lat_chunk.shape[0], body,
                                  (cache_k, cache_v))
 
+    def restore_pipeline(self, cache, latents, start, tables, t_len,
+                         progress_cb=None) -> "RestorePipeline":
+        """Incremental chunk-at-a-time restore of one staged lane group
+        — the unit the serving scheduler interleaves with resident
+        decode (see :class:`RestorePipeline`)."""
+        return RestorePipeline(self, cache, latents, start, tables,
+                               t_len, progress_cb=progress_cb)
+
     def restore_kv(self, cache, latents, start, tables, t_len,
                    progress_cb=None):
         """latents: host array [L, B, T, H] (numpy). Layer-CHUNKED
@@ -1049,82 +1066,158 @@ class PagedInferenceModel:
         ``progress_cb(layer0, shipped_bytes)`` fires as each chunk's
         dispatch is ISSUED (still in flight) — the serving scheduler's
         staging-progress hook; ``shipped_bytes`` is 0 on the
-        already-staged (HBM-resident) path."""
-        start = jnp.asarray(start, jnp.int32)
-        tables = jnp.asarray(tables, jnp.int32)
-        t_len = jnp.asarray(t_len, jnp.int32)
-        staged = isinstance(latents, jax.Array)
-        if not staged:
-            latents = np.asarray(latents)
-        ck, cv = cache.k, cache.v
-        L = self.n_layers
-        C = self.restore_chunk_layers
+        already-staged (HBM-resident) path.
+
+        This is the run-to-completion driver over
+        :class:`RestorePipeline`; the serving scheduler instead holds
+        the pipeline open and advances it chunk by chunk between decode
+        dispatches (``engine.begin_restore``/``advance_restores``)."""
+        pipe = self.restore_pipeline(cache, latents, start, tables,
+                                     t_len, progress_cb=progress_cb)
+        while not pipe.done:
+            pipe.advance()
+
+
+class RestorePipeline:
+    """One lane group's restore as a chunk pipeline with two lanes:
+
+    * **ship lane** — ``jax.device_put`` of the next layer-chunk's
+      latent slab, dispatched (async) ahead of the replay that will
+      consume it, at most ``depth`` chunks in flight (bounds staging
+      HBM; depth 2 is the classic double buffer);
+    * **replay lane** — the jitted QKV-replay dispatch consuming the
+      previously shipped chunk.
+
+    ``advance(max_chunks)`` issues up to ``max_chunks`` replay
+    dispatches (shipping ahead as it goes) and returns immediately —
+    nothing here ever blocks on the device, so the caller can issue a
+    resident-decode dispatch between advances and the link ship hides
+    under that decode's compute (the reference's dedicated
+    ``io_stream`` vs compute-stream overlap, ``engine_v2.py:108-129``,
+    expressed through JAX async dispatch). The cache object is re-read
+    at every advance and replaced after, so interleaved forwards
+    (which donate and replace the same buffers) compose with an open
+    pipeline; interleaved dispatches only read OTHER sequences' blocks,
+    so results are bit-identical to a sequential restore-then-decode.
+    """
+
+    def __init__(self, model, cache, latents, start, tables, t_len,
+                 progress_cb=None, depth: int = 2):
+        self.model = model
+        self.cache = cache
+        self.progress_cb = progress_cb
+        self.depth = max(1, depth)
+        self._start = jnp.asarray(start, jnp.int32)
+        self._tables = jnp.asarray(tables, jnp.int32)
+        self._t_len = jnp.asarray(t_len, jnp.int32)
+        self.staged = isinstance(latents, jax.Array)
+        L = model.n_layers
+        C = model.restore_chunk_layers
         if C <= 0:
             per_layer = (int(np.prod(latents.shape[1:])) *
                          np.dtype(latents.dtype).itemsize)
-            C = max(1, min(L, self.restore_chunk_bytes //
+            C = max(1, min(L, model.restore_chunk_bytes //
                            max(per_layer, 1)))
-        bounds = list(range(0, L, C))
-
-        if staged:
-            # Latents already resident in HBM (hybrid-engine handoff on
-            # the training mesh, or a marginal-cost benchmark): no H2D
-            # ship — chunked dispatches slice the slab on device. The
-            # slab must still land on the CACHE's device assembly (a
-            # sharded cache with a single-device slab would fail the
-            # jitted call with incompatible committed devices), so
-            # reshard when placements differ — a same-assembly no-op.
-            from jax.sharding import NamedSharding, PartitionSpec
+        self.chunk_layers = C
+        self.bounds = list(range(0, L, C))
+        self._next_replay = 0
+        self._bufs = {}                 # chunk index -> shipped buffer
+        # target placement: latents replicate over whatever mesh the
+        # cache actually lives on (derived from the array, not the TP
+        # degree: a hybrid engine hands over caches resident on the
+        # TRAINING mesh, which can be multi-device even when the
+        # serving tensor axis is 1)
+        from jax.sharding import NamedSharding, PartitionSpec
+        ck = cache.k
+        if isinstance(ck.sharding, NamedSharding):
+            self._dev = NamedSharding(ck.sharding.mesh, PartitionSpec())
+        else:
+            self._dev = list(ck.devices())[0]
+        if self.staged:
+            # already HBM-resident (hybrid-engine handoff, marginal
+            # bench): chunked dispatches slice the slab on device. It
+            # must still land on the CACHE's device assembly (a sharded
+            # cache with a single-device slab fails the jitted call)
             if isinstance(ck.sharding, NamedSharding):
-                dev = NamedSharding(ck.sharding.mesh, PartitionSpec())
-                if latents.sharding != dev:
-                    latents = jax.device_put(latents, dev)
+                if latents.sharding != self._dev:
+                    latents = jax.device_put(latents, self._dev)
             elif latents.devices() != ck.devices():
                 latents = jax.device_put(latents, list(ck.devices())[0])
-            from ..telemetry.tracer import get_tracer
-            tracer = get_tracer()
-            for l0 in bounds:
-                with tracer.span("serve.restore.stage", layer0=l0,
-                                 layers=min(C, L - l0), bytes=0):
-                    ck, cv = self._restore(self.params, ck, cv,
-                                           jnp.int32(l0),
-                                           latents[l0:l0 + C],
-                                           start, tables, t_len)
-                if progress_cb is not None:
-                    progress_cb(l0, 0)
-            cache.replace(ck, cv)
-            return
-
-        # Latents replicate over whatever mesh the cache actually lives
-        # on (derived from the array, not self.tp: a hybrid engine hands
-        # over caches/params resident on the TRAINING mesh, which can be
-        # multi-device even when the serving tensor axis is 1).
-        from jax.sharding import NamedSharding, PartitionSpec
-        if isinstance(ck.sharding, NamedSharding):
-            dev = NamedSharding(ck.sharding.mesh, PartitionSpec())
+            self.latents = latents
         else:
-            dev = list(ck.devices())[0]
+            self.latents = np.asarray(latents)
 
-        def ship(l0):
-            return jax.device_put(
-                np.ascontiguousarray(latents[l0:l0 + C]), dev)
+    # ------------------------------------------------------------- #
+    @property
+    def chunks_total(self) -> int:
+        return len(self.bounds)
 
+    @property
+    def chunks_issued(self) -> int:
+        return self._next_replay
+
+    @property
+    def done(self) -> bool:
+        return self._next_replay >= len(self.bounds)
+
+    # ------------------------------------------------------------- #
+    def _ship(self, i):
+        l0 = self.bounds[i]
+        sl = self.latents[l0:l0 + self.chunk_layers]
+        if self.staged:
+            return sl                     # device slice, no transfer
+        # the lane slab is layer-major contiguous (built by
+        # _stage_restore_group / HostLatentStore), so this is a
+        # straight block copy, not a gather
+        return jax.device_put(np.ascontiguousarray(sl), self._dev)
+
+    def prefetch(self) -> int:
+        """Ship ahead: issue H2D for the next unshipped chunks up to
+        the in-flight ``depth``. Returns chunks whose ship was issued.
+        Call this as soon as the lane opens so the first chunk's link
+        time hides under whatever the engine dispatches next."""
+        issued = 0
+        i = self._next_replay
+        while i < len(self.bounds) and \
+                len(self._bufs) < self.depth:
+            if i not in self._bufs:
+                self._bufs[i] = self._ship(i)
+                issued += 1
+            i += 1
+        return issued
+
+    def advance(self, max_chunks: int = 0) -> int:
+        """Issue up to ``max_chunks`` replay dispatches (0 = all
+        remaining), shipping the following chunk ahead of each replay.
+        Async end to end — returns the number of replays issued."""
         from ..telemetry.tracer import get_tracer
         tracer = get_tracer()
-        buf = ship(0)
-        for i, l0 in enumerate(bounds):
-            cur = buf
-            # span covers prefetch-issue + dispatch-issue for this chunk
-            # (both async — the host-side staging cost HCache's restore
-            # latency story needs attributed per layer chunk)
+        issued = 0
+        L = self.model.n_layers
+        while not self.done and (max_chunks <= 0 or
+                                 issued < max_chunks):
+            i = self._next_replay
+            l0 = self.bounds[i]
+            cur = self._bufs.pop(i, None)
+            nbytes = 0 if self.staged else int(
+                np.prod(self.latents[l0:l0 + self.chunk_layers].shape)
+                * np.dtype(self.latents.dtype).itemsize)
+            # span covers ship-issue + dispatch-issue for this chunk
+            # (both async — the host-side staging cost the restore
+            # latency story attributes per layer chunk)
             with tracer.span("serve.restore.stage", layer0=l0,
-                             layers=min(C, L - l0),
-                             bytes=int(cur.nbytes)):
-                if i + 1 < len(bounds):   # double buffer: prefetch next
-                    buf = ship(bounds[i + 1])
-                ck, cv = self._restore(self.params, ck, cv,
-                                       jnp.int32(l0), cur, start,
-                                       tables, t_len)
-            if progress_cb is not None:
-                progress_cb(l0, cur.nbytes)
-        cache.replace(ck, cv)
+                             layers=min(self.chunk_layers, L - l0),
+                             bytes=nbytes):
+                if cur is None:
+                    cur = self._ship(i)
+                self._next_replay = i + 1
+                self.prefetch()           # dual-lane: next ship first
+                ck, cv = self.model._restore(
+                    self.model.params, self.cache.k, self.cache.v,
+                    jnp.int32(l0), cur, self._start, self._tables,
+                    self._t_len)
+                self.cache.replace(ck, cv)
+            if self.progress_cb is not None:
+                self.progress_cb(l0, nbytes)
+            issued += 1
+        return issued

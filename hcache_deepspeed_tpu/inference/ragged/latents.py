@@ -1,0 +1,100 @@
+"""Coalesced layer-major host storage for HCache latent payloads.
+
+A preempted-to-latents sequence accumulates one ``[L, t, H]`` latent
+chunk per forward (prefill once, then one token per decode step). The
+naive accumulation — ``np.concatenate`` per step — reallocates and
+copies the whole history on every decoded token (O(T^2) bytes copied
+over a generation) and leaves the payload wherever the last concat put
+it. :class:`HostLatentStore` keeps ONE growable layer-major
+(C-contiguous ``[L, capacity, H]``) host buffer with amortized-doubling
+growth along the token axis, so:
+
+* absorbing a decode step is an O(L*H) copy into place (amortized);
+* the restore payload is a zero-copy view whose per-layer-chunk slices
+  ``[l0:l0+C, :T]`` walk memory in layer-major order — the same order
+  the restore pipeline ships them host→device, so staging a chunk is a
+  straight block copy instead of a gather;
+* the dtype is whatever the engine captured (``hcache.latent_dtype``,
+  e.g. ``float8_e4m3fn`` to halve the wire/storage bytes) — the store
+  never up-casts.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class HostLatentStore:
+    """Growable ``[L, T, H]`` host latent buffer (layer-major).
+
+    Quacks like the ndarray the restore contract expects: ``.shape`` /
+    ``.nbytes`` cover the VALID tokens, and ``np.asarray(store)``
+    yields the ``[L, T, H]`` view — so it drops into
+    ``engine.restore_kv`` / ``begin_restore`` payload lists unchanged.
+    """
+
+    __slots__ = ("_buf", "_len")
+
+    def __init__(self, first_chunk=None):
+        self._buf: Optional[np.ndarray] = None
+        self._len = 0
+        if first_chunk is not None:
+            self.append(first_chunk)
+
+    def append(self, chunk) -> None:
+        """Absorb one ``[L, t, H]`` latent chunk (t >= 1)."""
+        chunk = np.asarray(chunk)
+        if chunk.ndim != 3:
+            raise ValueError(
+                f"latent chunk must be [L, t, H], got {chunk.shape}")
+        L, t, H = chunk.shape
+        if self._buf is None:
+            cap = max(t, 16)
+            self._buf = np.empty((L, cap, H), chunk.dtype)
+        elif (L, H) != (self._buf.shape[0], self._buf.shape[2]):
+            raise ValueError(
+                f"latent chunk {chunk.shape} does not match store "
+                f"layout [L={self._buf.shape[0]}, H={self._buf.shape[2]}]")
+        if self._len + t > self._buf.shape[1]:
+            cap = self._buf.shape[1]
+            while cap < self._len + t:
+                cap *= 2
+            grown = np.empty((L, cap, H), self._buf.dtype)
+            grown[:, :self._len] = self._buf[:, :self._len]
+            self._buf = grown
+        self._buf[:, self._len:self._len + t] = chunk
+        self._len += t
+
+    # ------------------------------------------------------------- #
+    # ndarray-compatible surface (the restore payload contract)
+    # ------------------------------------------------------------- #
+    @property
+    def shape(self) -> Tuple[int, int, int]:
+        if self._buf is None:
+            return (0, 0, 0)
+        return (self._buf.shape[0], self._len, self._buf.shape[2])
+
+    @property
+    def dtype(self):
+        return self._buf.dtype if self._buf is not None else None
+
+    @property
+    def nbytes(self) -> int:
+        if self._buf is None:
+            return 0
+        return self._len * self._buf.shape[0] * self._buf.shape[2] * \
+            self._buf.dtype.itemsize
+
+    def view(self) -> np.ndarray:
+        """Zero-copy ``[L, T, H]`` view of the valid tokens."""
+        if self._buf is None:
+            raise ValueError("empty HostLatentStore has no view")
+        return self._buf[:, :self._len]
+
+    def __array__(self, dtype=None, copy=None):
+        v = self.view()
+        return v.astype(dtype) if dtype is not None and \
+            dtype != v.dtype else v
+
+    def __len__(self) -> int:
+        return self._len

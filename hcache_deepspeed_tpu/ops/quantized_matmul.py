@@ -121,7 +121,7 @@ def _divisors_128(N, cap):
     return out
 
 
-def _choose_tiles(M, K, N, group_k, block_m):
+def _choose_tiles(M, K, N, group_k, block_m, x_bytes=2):
     """(block_n, groups_per_block) minimizing grid steps under a ~10 MB
     VMEM budget. Grid-step overhead (~1-2 us Mosaic dispatch per step)
     is THE cost driver in both kernel regimes on a v5e:
@@ -138,7 +138,12 @@ def _choose_tiles(M, K, N, group_k, block_m):
     scale groups; when gpb is a multiple of 8 the scale BlockSpec can
     deliver exactly the block's rows ([gpb, bn] — sublane dim >= 8
     lowers fine) and the kernel slices rows STATICALLY; smaller gpb
-    falls back to the whole-G tile + mask-sum row select."""
+    falls back to the whole-G tile + mask-sum row select.
+
+    ``x_bytes`` is the activation itemsize: the x and out tiles scale
+    with it, so fp32 inputs (4 B) get smaller-but-fitting tiles instead
+    of a blocking whose true VMEM footprint is 2x the estimate (and
+    fp8 inputs get the larger tiles they can afford)."""
     G = K // group_k
     budget = 10 * 2**20
     best = None
@@ -148,11 +153,11 @@ def _choose_tiles(M, K, N, group_k, block_m):
         bk = gpb * group_k
         for bn in _divisors_128(N, 8 * 2**20 // (2 * bk) // 128 * 128):
             scale_rows = gpb if gpb % 8 == 0 else G
-            vmem = (2 * bk * bn               # q tile int8, x2 buf
-                    + 2 * block_m * bk * 2    # x tile bf16, x2
+            vmem = (2 * bk * bn                  # q tile int8, x2 buf
+                    + 2 * block_m * bk * x_bytes  # x tile, x2
                     + 2 * scale_rows * bn * 4
-                    + block_m * bn * 4        # acc scratch
-                    + 2 * block_m * bn * 2)   # out
+                    + block_m * bn * 4           # acc scratch
+                    + 2 * block_m * bn * x_bytes)  # out
             if vmem > budget:
                 continue
             steps = (M // block_m) * (N // bn) * (K // bk)
@@ -204,13 +209,50 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, group_k, gpb,
         o_ref[0] = acc[:].astype(o_ref.dtype)
 
 
+#: observability for the silent-until-now reference-path fallbacks: a
+#: perf run that thinks it measured the Pallas kernel but actually ran
+#: the dequantize-then-matmul reference path reports numbers for the
+#: wrong code. Counters per fallback reason + the last shape, exposed
+#: via :func:`fallback_debug_info`; the first fallback also warns.
+_FALLBACK_DEBUG = {"count": 0, "by_reason": {}, "last": None,
+                   "warned": False}
+
+
+def fallback_debug_info():
+    """Copy of the reference-path fallback record:
+    ``{count, by_reason: {reason: n}, last: (reason, M, K, N, block)}``."""
+    out = dict(_FALLBACK_DEBUG)
+    out["by_reason"] = dict(out["by_reason"])
+    return out
+
+
+def _reference_fallback(reason, x, q, scale, group_k, block=None):
+    d = _FALLBACK_DEBUG
+    d["count"] += 1
+    d["by_reason"][reason] = d["by_reason"].get(reason, 0) + 1
+    d["last"] = (reason, x.shape[0], x.shape[1], q.shape[1], block)
+    if not d["warned"]:
+        d["warned"] = True
+        from ..utils.logging import logger
+        logger.warning(
+            "quantized_matmul: falling back to the reference "
+            "dequantize-then-matmul path (%s; M=%d K=%d N=%d "
+            "block=%s). Subsequent fallbacks are silent — check "
+            "fallback_debug_info() before trusting a perf number.",
+            reason, x.shape[0], x.shape[1], q.shape[1], block)
+    return reference_quantized_matmul(x, q, scale, group_k=group_k)
+
+
 def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=None,
                             block_n=None, block_k=None, interpret=None):
     """x: [M, K] (bf16/f32); q: [K, N] int8; scale: [K//group_k, N].
 
     block_* default to the grid-overhead-minimizing tiles from
-    ``_choose_tiles``; explicit values override (tests exercise fixed
-    blockings). ``block_k`` must be a whole number of scale groups."""
+    ``_choose_tiles`` (sized for x's actual itemsize); explicit values
+    override (tests exercise fixed blockings). ``block_k`` must be a
+    whole number of scale groups. Shapes the tiles cannot cover fall
+    back to the reference path — recorded in
+    :func:`fallback_debug_info` and warned once."""
     M, K = x.shape
     K2, N = q.shape
     assert K == K2
@@ -222,10 +264,11 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=None,
             (bm for bm in (256, 128, 64, 32, 16, 8) if M % bm == 0), M)
     block_m = min(block_m, M)
     if block_n is None and block_k is None and M % block_m == 0:
-        chosen = _choose_tiles(M, K, N, group_k, block_m)
+        chosen = _choose_tiles(M, K, N, group_k, block_m,
+                               x_bytes=x.dtype.itemsize)
         if chosen is None:
-            return reference_quantized_matmul(x, q, scale,
-                                              group_k=group_k)
+            return _reference_fallback("no_tile_fits_vmem", x, q,
+                                       scale, group_k)
         block_n, gpb = chosen
         block_k = gpb * group_k
     else:
@@ -238,7 +281,9 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=None,
         # block_k is x's lane dim and q's sublane dim — it needs 128
         # alignment on hardware just like the others (a 96-wide tile
         # crashes Mosaic; see the same guard in flash_attention.py)
-        return reference_quantized_matmul(x, q, scale, group_k=group_k)
+        return _reference_fallback(
+            "tile_misaligned", x, q, scale, group_k,
+            block=(block_m, block_n, block_k))
     grid = (M // block_m, N // block_n, K // block_k)
     G = K // group_k
     gpb = block_k // group_k

@@ -9,9 +9,13 @@ virtual-clock simulation mode (``server.py``), and serving metrics
 emitted through the ``monitor.MonitorMaster`` event path
 (``metrics.py``). ``sim.py`` provides a model-free engine double with
 the real block-budget arithmetic so the whole policy is CPU-testable.
+``crossover.py`` prices restore vs recompute per preempted sequence —
+the analytic model the scheduler consults at re-entry.
 """
 
 from .clock import MonotonicClock, VirtualClock  # noqa: F401
+from .crossover import (CrossoverConfig,  # noqa: F401
+                        RestoreCrossoverModel)
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401

@@ -70,6 +70,7 @@ class ServingMetrics:
         self.preemptions_per_request = Histogram()
         self.counters = {"admitted": 0, "finished": 0, "cancelled": 0,
                          "preemptions": 0, "restores": 0,
+                         "recompute_reentries": 0, "restore_chunks": 0,
                          "overlapped_restores": 0, "tokens_out": 0,
                          "steps": 0, "idle_steps": 0}
         self.rejected: Dict[str, int] = {}
@@ -89,6 +90,8 @@ class ServingMetrics:
         c["admitted"] += len(report.admitted)
         c["preemptions"] += len(report.preempted)
         c["restores"] += len(report.restored)
+        c["recompute_reentries"] += len(report.recomputed)
+        c["restore_chunks"] += report.restore_chunks
         c["overlapped_restores"] += report.overlapped_restores
         for _, reason in report.rejected:
             self.rejected[reason] = self.rejected.get(reason, 0) + 1

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import List, Optional
 
-import numpy as np
+from ..inference.ragged.latents import HostLatentStore
 
 
 class RequestState(Enum):
@@ -70,8 +70,11 @@ class Request:
     tokens_out: List[int] = field(default_factory=list)
     #: accumulated HCache latents [L, T, H] covering prompt + all fed
     #: tokens (i.e. every token whose KV is cached) — the restore
-    #: payload when this request is preempted in latent mode.
-    latents: Optional[np.ndarray] = None
+    #: payload when this request is preempted in latent mode. Held as a
+    #: :class:`~...inference.ragged.latents.HostLatentStore` (coalesced
+    #: layer-major buffer, O(1) amortized per-token absorption; quacks
+    #: like the ndarray the restore contract expects).
+    latents: Optional["HostLatentStore"] = None
     #: exact-KV preempt mode: engine keeps host KV under this uid.
     reject_reason: str = ""
     cancelled: bool = False
@@ -85,6 +88,9 @@ class Request:
     suspended_in_step: int = -1
     n_preemptions: int = 0
     n_restores: int = 0
+    #: crossover-policy re-entries that re-prefilled instead of
+    #: restoring (the recompute side of the analytic model)
+    n_recomputes: int = 0
 
     def transition(self, new_state: RequestState) -> None:
         if new_state not in _TRANSITIONS[self.state]:
@@ -118,9 +124,9 @@ class Request:
     def absorb_latents(self, new_latents) -> None:
         if new_latents is None:
             return
-        new_latents = np.asarray(new_latents)
-        self.latents = new_latents if self.latents is None else \
-            np.concatenate([self.latents, new_latents], axis=1)
+        if self.latents is None:
+            self.latents = HostLatentStore()
+        self.latents.append(new_latents)
 
     # timing summaries (None until the respective edge happened)
     def ttft(self) -> Optional[float]:

@@ -38,6 +38,7 @@ from ..inference.scheduling import (BACKPRESSURE_ACTION, BackpressureAction,
                                     SchedulingResult)
 from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock
+from .crossover import RestoreCrossoverModel
 from .request import Request, RequestState
 
 
@@ -55,20 +56,27 @@ class StepReport:
     rejected: List[Tuple[int, str]] = field(default_factory=list)
     preempted: List[int] = field(default_factory=list)
     restored: List[int] = field(default_factory=list)
+    #: crossover-policy re-entries that re-prefilled instead of
+    #: restoring (cheaper side of the analytic model)
+    recomputed: List[int] = field(default_factory=list)
     finished: List[int] = field(default_factory=list)
     cancelled: List[int] = field(default_factory=list)
     decode_lanes: int = 0
     prefill_tokens: int = 0
     restored_tokens: int = 0
-    #: restore dispatches issued concurrently with resident decode
-    #: (the overlap the HCache story is about)
+    #: restore replay chunks issued this step (lane progress)
+    restore_chunks: int = 0
+    #: restores whose lane overlapped resident decode (each restore
+    #: counted once, in the step its overlap is first observed — the
+    #: overlap the HCache story is about)
     overlapped_restores: int = 0
 
     @property
     def work_done(self) -> bool:
         return bool(self.admitted or self.restored or self.finished or
                     self.decode_lanes or self.prefill_tokens or
-                    self.rejected or self.preempted or self.cancelled)
+                    self.rejected or self.preempted or self.cancelled or
+                    self.recomputed or self.restore_chunks)
 
 
 class ContinuousBatchingScheduler:
@@ -83,7 +91,9 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, clock=None,
                  sample_fn: Callable[[Request, np.ndarray], int] = None,
-                 metrics=None):
+                 metrics=None, crossover: RestoreCrossoverModel = None,
+                 restore_chunks_per_step: int = 1,
+                 calibrate_every: int = 25):
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.sample_fn = sample_fn or greedy_sample
@@ -92,17 +102,37 @@ class ContinuousBatchingScheduler:
         #: restore = restore_kv (frees the tracked slot too). Without
         #: latent capture the exact-KV suspend/resume path is used.
         self.latent_preemption = bool(engine.config.hcache.enable_latents)
+        #: restore-vs-recompute crossover model consulted per preempted
+        #: sequence at re-entry (latent mode only; None = always
+        #: restore, the pre-policy behavior). Built lazily from the
+        #: engine's profile so an uncalibrated model still exists to
+        #: absorb telemetry samples.
+        self.crossover = crossover
+        if self.crossover is None and self.latent_preemption and \
+                hasattr(engine, "restore_profile"):
+            self.crossover = RestoreCrossoverModel(
+                engine.restore_profile())
+        #: replay chunks issued per step while a restore lane is open
+        #: (the decode-interleave grain: smaller = more decode steps
+        #: hide under one restore; 0 = drain a lane in one step)
+        self.restore_chunks_per_step = restore_chunks_per_step
+        self.calibrate_every = max(1, calibrate_every)
 
         self.queue: List[Request] = []           # QUEUED, submit order
         self.running: Dict[int, Request] = {}    # DECODE residents
         self.suspended: Dict[int, Request] = {}  # SUSPENDED (KV on host)
+        self.restoring: Dict[int, Request] = {}  # RESTORING (lane open)
         self.done: Dict[int, Request] = {}       # DONE / REJECTED
         #: replayable (step, event, uid, detail) log; identical across
         #: runs of the same trace under a virtual clock
         self.events: List[Tuple[int, str, int, str]] = []
         self.step_idx = 0
         self.total_restores = 0
+        self.total_recomputes = 0
         self.overlapped_restores = 0
+        #: uids whose open lane already earned its (single) overlap
+        #: credit — a multi-step lane must not count once per step
+        self._overlap_credited = set()
 
     # ------------------------------------------------------------- #
     # intake
@@ -119,9 +149,11 @@ class ContinuousBatchingScheduler:
         self.queue.append(req)
 
     def cancel(self, uid: int) -> None:
-        """Mark a request for cancellation; honored at the next step."""
+        """Mark a request for cancellation; honored at the next step.
+        A request mid-restore cancels after its lane drains (freeing
+        blocks under in-flight replay writes would corrupt the pool)."""
         for pool in (self.queue, self.running.values(),
-                     self.suspended.values()):
+                     self.suspended.values(), self.restoring.values()):
             for req in pool:
                 if req.uid == uid:
                     req.cancelled = True
@@ -129,7 +161,8 @@ class ContinuousBatchingScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.queue or self.running or self.suspended)
+        return bool(self.queue or self.running or self.suspended or
+                    self.restoring)
 
     def request(self, uid: int) -> Optional[Request]:
         if uid in self.done:
@@ -138,6 +171,8 @@ class ContinuousBatchingScheduler:
             return self.running[uid]
         if uid in self.suspended:
             return self.suspended[uid]
+        if uid in self.restoring:
+            return self.restoring[uid]
         for req in self.queue:
             if req.uid == uid:
                 return req
@@ -156,6 +191,15 @@ class ContinuousBatchingScheduler:
             admits = self._admission_pass(report, now)
             admits = self._pressure_pass(admits, report)
             self._dispatch(admits, report, now)
+        if self.crossover is not None and \
+                self.step_idx % self.calibrate_every == 0:
+            tracer = get_tracer()
+            if tracer.enabled:
+                # runtime calibration: mine the span buffer for link-
+                # bandwidth and prefill-rate samples (no-op when the
+                # tracer is off; the bench feeds synced measurements
+                # through observe_* instead)
+                self.crossover.calibrate_from_events(tracer.events())
         if self.metrics is not None:
             self.metrics.on_step(report, self)
         return report
@@ -228,7 +272,9 @@ class ContinuousBatchingScheduler:
         sm = self.engine.config.state_manager
         free = self.engine.state.free_blocks
         headroom = len(self.running)
-        lanes = len(self.running)
+        # open lanes become decode lanes when they complete — budget
+        # them now so completions can't overflow the ragged batch
+        lanes = len(self.running) + len(self.restoring)
         tracked = self.engine.state.n_tracked_sequences
         out = []
         order = sorted(self.suspended.values(),
@@ -253,40 +299,135 @@ class ContinuousBatchingScheduler:
             out.append(req)
         return out
 
+    def _occupancy(self) -> float:
+        sm = self.engine.config.state_manager
+        return (len(self.running) + len(self.restoring)) / \
+            max(sm.max_ragged_sequence_count, 1)
+
+    def _recompute_feasible(self, req: Request) -> bool:
+        """A recompute re-entry re-prefills the full cached prefix plus
+        the pending fed token in ONE standalone forward — it must fit
+        the per-forward token budget and the engine's verdict."""
+        tokens = req.cached_tokens + 1
+        sm = self.engine.config.state_manager
+        per_fwd = min(tokens, sm.prefill_chunk) if sm.prefill_chunk \
+            else tokens
+        if per_fwd > sm.max_ragged_batch_size:
+            return False
+        return self.engine.can_schedule([req.uid], [tokens]) == \
+            SchedulingResult.Success
+
+    def _recompute_reentry(self, req: Request, report: StepReport,
+                           now: float) -> None:
+        """Crossover said recompute: rebuild the KV by re-prefilling
+        prompt + every generated token in one forward (full stack, no
+        link bytes), sampling the next token from its logits — the
+        request rejoins the decode set one token ahead, with its latent
+        payload re-captured by the prefill itself."""
+        del self.suspended[req.uid]
+        req.transition(RequestState.RESTORING)
+        tokens = list(req.prompt) + req.tokens_out
+        with get_tracer().span("sched.recompute_issue", uid=req.uid,
+                               sched_step=self.step_idx,
+                               tokens=len(tokens)):
+            req.latents = None          # the prefill re-captures them
+            logits, latents = self.engine.put([req.uid], [tokens])
+        req.absorb_latents(latents[0])
+        req.n_recomputes += 1
+        self.total_recomputes += 1
+        report.recomputed.append(req.uid)
+        self._event("restore", req.uid,
+                    f"mode=recompute tokens={len(tokens)}")
+        tok = self.sample_fn(req, logits[0])
+        req.tokens_out.append(tok)
+        if len(req.tokens_out) >= req.max_new_tokens or (
+                req.eos_token_id is not None and
+                tok == req.eos_token_id):
+            self.engine.flush(req.uid)
+            self._close(req, report, now)
+            return
+        req.transition(RequestState.DECODE)
+        self.running[req.uid] = req
+
     def _restore_pass(self, report: StepReport) -> None:
+        now = self.clock.now()
         for req in self._restore_candidates():
+            if self.latent_preemption and self.crossover is not None \
+                    and self.crossover.decide(
+                        req.cached_tokens, self._occupancy()) == \
+                    "recompute" and self._recompute_feasible(req):
+                self._recompute_reentry(req, report, now)
+                continue
             del self.suspended[req.uid]
             req.transition(RequestState.RESTORING)
             # half of the explicit restore/decode overlap span pair:
-            # this span covers the restore ISSUE; the decode dispatch
-            # issued later this step (sched.decode_dispatch, which
-            # carries overlapped_restores) is the other half — the
-            # overlap ratio is computed from the pair, never inferred
-            # from wall-clock adjacency
+            # this span covers the restore lane OPEN (staging + the
+            # first chunk ships); the decode dispatches issued while
+            # the lane drains (sched.decode_dispatch, which carries
+            # overlapped_restores) are the other half — the overlap
+            # ratio is computed from the pair, never inferred from
+            # wall-clock adjacency
             with get_tracer().span("sched.restore_issue", uid=req.uid,
                                    sched_step=self.step_idx,
                                    tokens=req.cached_tokens):
                 if self.latent_preemption:
                     tokens = list(req.prompt) + req.tokens_out[:-1]
-                    self.engine.restore_kv([req.uid], [tokens],
-                                           [req.latents])
-                    mode = "latents"
-                else:
-                    self.engine.resume_sequence(req.uid)
-                    mode = "kv"
+                    self.engine.begin_restore([req.uid], [tokens],
+                                              [req.latents])
+                    self.total_restores += 1
+                    self.restoring[req.uid] = req
+                    self._event("restore_begin", req.uid,
+                                f"tokens={req.cached_tokens}")
+                    # the lane drains chunk by chunk between this
+                    # step's (and the next steps') decode dispatches;
+                    # the request re-enters the decode set when its
+                    # last replay chunk has issued
+                    continue
+                self.engine.resume_sequence(req.uid)
+            # exact-KV resume is synchronous: back into the decode set
+            # now, decoding again from the NEXT step's batch (its next
+            # fed token is tokens_out[-1])
             req.n_restores += 1
             self.total_restores += 1
             report.restored.append(req.uid)
             report.restored_tokens += req.cached_tokens
             self._event("restore", req.uid,
-                        f"mode={mode} tokens={req.cached_tokens}")
-            # back into the decode set: the restore dispatches are in
-            # flight, un-synced; the residents' decode put() issued
-            # below ships/computes behind them on independent streams.
-            # The sequence decodes again from the NEXT step's batch
-            # (its next fed token is tokens_out[-1]).
+                        f"mode=kv tokens={req.cached_tokens}")
             req.transition(RequestState.DECODE)
             self.running[req.uid] = req
+
+    # ------------------------------------------------------------- #
+    # restore lanes (decode-interleaved chunk progress)
+    # ------------------------------------------------------------- #
+    def _advance_restore_lanes(self, report: StepReport,
+                               had_decode: bool) -> int:
+        """Issue up to ``restore_chunks_per_step`` replay chunks across
+        the open lanes; lanes advancing while resident decode was
+        dispatched this step earn their (one-time) overlap credit.
+        Completed lanes re-enter the decode set."""
+        if not self.restoring:
+            return 0
+        chunks, completed, touched = self.engine.advance_restores(
+            self.restore_chunks_per_step)
+        report.restore_chunks += chunks
+        if had_decode:
+            for uid in touched:
+                if uid in self._overlap_credited:
+                    continue
+                self._overlap_credited.add(uid)
+                self.overlapped_restores += 1
+                report.overlapped_restores += 1
+        for uid in completed:
+            req = self.restoring.pop(uid)
+            self._overlap_credited.discard(uid)
+            req.n_restores += 1
+            report.restored.append(uid)
+            report.restored_tokens += req.cached_tokens
+            self._event("restore", uid,
+                        f"mode=latents tokens={req.cached_tokens}")
+            req.transition(RequestState.DECODE)
+            self.running[uid] = req
+        return chunks
 
     # ------------------------------------------------------------- #
     # admission (queue -> this step's prefill set)
@@ -367,9 +508,10 @@ class ContinuousBatchingScheduler:
                            if v.priority < req.priority]
                 if not victims:
                     if not self.running and not self.suspended and \
-                            not admits:
+                            not self.restoring and not admits:
                         # alone on an empty engine and still over the
-                        # pool: permanent
+                        # pool: permanent (an open restore lane holds
+                        # blocks that WILL free — not permanent)
                         action = BackpressureAction.REJECT
                         verdict = SchedulingResult.KVCacheLimitExceeded
                     break
@@ -426,10 +568,12 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------- #
     def _dispatch(self, admits: List[Request], report: StepReport,
                   now: float) -> None:
-        # overlap accounting: restores issued this step share the
-        # device queue with this decode dispatch — no host sync between
-        # them, so the latent H2D ships hide under replay/decode compute
-        if report.restored:
+        # exact-KV overlap accounting: resumes issued this step share
+        # the device queue with this decode dispatch — no host sync
+        # between them, so the host→HBM swap-in hides under decode
+        # compute (latent-mode lanes earn their credit per chunk in
+        # _advance_restore_lanes instead)
+        if report.restored and not self.latent_preemption:
             residents = [u for u in self.running
                          if u not in set(report.restored)]
             if residents:
@@ -447,21 +591,33 @@ class ContinuousBatchingScheduler:
                         f"prompt={len(req.prompt)}")
         step_reqs = decodes + admits
         if not step_reqs:
+            # restore-only step: the lanes still trickle (no overlap
+            # credit — nothing computed under the ships)
+            self._advance_restore_lanes(report, had_decode=False)
             return
         toks = [[r.tokens_out[-1]] for r in decodes] + \
             [r.prompt for r in admits]
         report.decode_lanes = len(decodes)
         report.prefill_tokens = sum(len(r.prompt) for r in admits)
         # the decode half of the restore-overlap span pair (see
-        # _restore_pass): overlapped_restores is already decided, so
-        # the ratio is read straight off the pair's attributes
+        # _restore_pass): the decode dispatch computes while the open
+        # lanes' latent ships ride the link; the replay chunks issued
+        # right after it (inside the same span) consume buffers that
+        # shipped under THIS dispatch's compute. overlapped_restores
+        # lands on the span via set() once the lane advance decides it,
+        # so the ratio is read straight off the pair's attributes.
         with get_tracer().span(
                 "sched.decode_dispatch", sched_step=self.step_idx,
                 lanes=report.decode_lanes,
                 prefill_tokens=report.prefill_tokens,
-                overlapped_restores=report.overlapped_restores):
+                overlapped_restores=report.overlapped_restores) as sp:
             logits, latents = self.engine.put(
                 [r.uid for r in step_reqs], toks)
+            if self.latent_preemption and self.restoring:
+                self._advance_restore_lanes(
+                    report, had_decode=bool(decodes))
+                sp.set(overlapped_restores=report.overlapped_restores,
+                       restore_chunks=report.restore_chunks)
         for j, req in enumerate(step_reqs):
             if self.latent_preemption:
                 req.absorb_latents(latents[j])

@@ -42,25 +42,31 @@ class ServerConfig:
     kv_demand_fraction: float = 8.0
     #: thread mode: sleep when a step had nothing to do
     idle_sleep_s: float = 0.002
+    #: replay chunks issued per scheduler step while a restore lane is
+    #: open (the decode-interleave grain; 0 drains a lane in one step)
+    restore_chunks_per_step: int = 1
     # -- virtual-clock cost model (seconds) -------------------------- #
     step_overhead_s: float = 1e-3
     prefill_token_s: float = 1e-4
     decode_lane_s: float = 5e-4
     restore_token_s: float = 2e-5
+    restore_chunk_s: float = 1e-4
 
 
 class ServingServer:
 
     def __init__(self, engine, config: ServerConfig = None, clock=None,
                  metrics: ServingMetrics = None, sample_fn=None,
-                 monitor=None, emit_every_steps: int = 50):
+                 monitor=None, emit_every_steps: int = 50,
+                 crossover=None):
         self.config = config or ServerConfig()
         self.clock = clock or MonotonicClock()
         self.virtual = isinstance(self.clock, VirtualClock)
         self.metrics = metrics or ServingMetrics()
         self.scheduler = ContinuousBatchingScheduler(
             engine, clock=self.clock, sample_fn=sample_fn,
-            metrics=self.metrics)
+            metrics=self.metrics, crossover=crossover,
+            restore_chunks_per_step=self.config.restore_chunks_per_step)
         self.monitor = monitor
         self.emit_every_steps = emit_every_steps
         self._lock = threading.Lock()
@@ -137,7 +143,8 @@ class ServingServer:
                 c.prefill_token_s * report.prefill_tokens +
                 c.decode_lane_s * (report.decode_lanes +
                                    len(report.admitted)) +
-                c.restore_token_s * report.restored_tokens)
+                c.restore_token_s * report.restored_tokens +
+                c.restore_chunk_s * report.restore_chunks)
 
     def step(self):
         """Drain ingress + one scheduler step (thread mode calls this

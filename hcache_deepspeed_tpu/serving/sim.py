@@ -52,6 +52,10 @@ class SimulatedEngine:
                        "resume": 0, "flush": 0}
         self.restore_stats = {"restores": 0, "sequences": 0,
                               "chunks_issued": 0, "bytes_shipped": 0}
+        #: open restore lanes, mirroring the real engine's decode-
+        #: interleaved surface: each lane is a dict with the staged
+        #: items, a chunk cursor and the owed post_forward state ops
+        self._restore_lanes: List[Dict] = []
 
     # ------------------------------------------------------------- #
     @property
@@ -96,7 +100,13 @@ class SimulatedEngine:
 
     # ------------------------------------------------------------- #
     def _reject_suspended(self, uids) -> None:
+        restoring = set(self.restoring_uids) if self._restore_lanes \
+            else ()
         for uid in uids:
+            if uid in restoring:
+                raise RuntimeError(
+                    f"sequence {uid} has an open restore lane; drain "
+                    "advance_restores before forwarding it")
             seq = self.state.get_sequence(uid)
             if seq is not None and seq.host_kv is not None:
                 raise RuntimeError(
@@ -134,6 +144,19 @@ class SimulatedEngine:
     # ------------------------------------------------------------- #
     def restore_kv(self, batch_uids: Iterable[int], batch_tokens,
                    batch_latents) -> None:
+        """Run-to-completion restore (begin + drain), mirroring the
+        real engine's driver over its decode-interleaved lane."""
+        self.begin_restore(batch_uids, batch_tokens, batch_latents)
+        self.advance_restores()
+
+    def begin_restore(self, batch_uids: Iterable[int], batch_tokens,
+                      batch_latents) -> Dict:
+        """Open a restore lane: the same all-or-nothing admission
+        arithmetic as the real engine, with KV allocated and the
+        sequences marked in-flight at begin; ``advance_restores`` then
+        issues one synthetic layer-chunk per call per lane (N_LAYER
+        chunks per restore) and runs the owed ``post_forward`` state
+        ops at lane completion."""
         batch_uids = list(batch_uids)
         self._reject_suspended(batch_uids)
         items = []
@@ -165,6 +188,7 @@ class SimulatedEngine:
         if need > self.state.free_blocks:
             raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
         from ..telemetry.tracer import get_tracer
+        seqs = []
         with get_tracer().span(
                 "serve.restore_kv", sequences=len(items),
                 tokens=int(sum(len(it[1]) for it in items)),
@@ -173,12 +197,76 @@ class SimulatedEngine:
                 seq = self.state.get_or_create_sequence(uid)
                 self.state.maybe_allocate_kv(seq, len(tokens))
                 seq.pre_forward(len(tokens))
-                seq.post_forward()
+                seqs.append(seq)
                 self.restore_stats["sequences"] += 1
-                self.restore_stats["bytes_shipped"] += latents.nbytes
         self.counts["restore"] += 1
         self.restore_stats["restores"] += 1
-        self.restore_stats["chunks_issued"] += max(len(items), 1)
+        ticket = {"uids": [it[0] for it in items], "done": not items}
+        if items:
+            self._restore_lanes.append({
+                "uids": ticket["uids"], "seqs": seqs,
+                "nbytes": int(sum(it[2].nbytes for it in items)),
+                "next_chunk": 0, "chunks": self.N_LAYER,
+                "ticket": ticket})
+        return ticket
+
+    def advance_restores(self, max_chunks: int = 0):
+        """(chunks_issued, completed_uids, touched_uids) — the same
+        contract as ``InferenceEngineV2.advance_restores``."""
+        from ..telemetry.tracer import get_tracer
+        tracer = get_tracer()
+        issued = 0
+        completed: List[int] = []
+        touched: List[int] = []
+        while self._restore_lanes and (max_chunks <= 0 or
+                                       issued < max_chunks):
+            lane = self._restore_lanes[0]
+            base = lane["nbytes"] // lane["chunks"]
+            n0 = lane["next_chunk"]
+            while lane["next_chunk"] < lane["chunks"] and \
+                    (max_chunks <= 0 or issued < max_chunks):
+                last = lane["next_chunk"] == lane["chunks"] - 1
+                per_chunk = lane["nbytes"] - base * \
+                    (lane["chunks"] - 1) if last else base
+                with tracer.span("serve.restore.stage",
+                                 layer0=lane["next_chunk"], layers=1,
+                                 bytes=per_chunk):
+                    pass
+                lane["next_chunk"] += 1
+                issued += 1
+                self.restore_stats["chunks_issued"] += 1
+                self.restore_stats["bytes_shipped"] += per_chunk
+            if lane["next_chunk"] > n0:
+                touched.extend(lane["uids"])
+            if lane["next_chunk"] < lane["chunks"]:
+                break
+            for seq in lane["seqs"]:
+                seq.post_forward()
+            completed.extend(lane["uids"])
+            lane["ticket"]["done"] = True
+            self._restore_lanes.pop(0)
+        return issued, completed, touched
+
+    @property
+    def pending_restore_chunks(self) -> int:
+        return sum(l["chunks"] - l["next_chunk"]
+                   for l in self._restore_lanes)
+
+    @property
+    def restoring_uids(self) -> List[int]:
+        return [u for l in self._restore_lanes for u in l["uids"]]
+
+    def restore_profile(self) -> Dict:
+        """Synthetic profile for the crossover model: float32 latents
+        of shape [N_LAYER, T, HIDDEN], one chunk per layer, and a 50%
+        replay FLOPs share."""
+        return {
+            "n_layer": self.N_LAYER,
+            "latent_bytes_per_token": self.N_LAYER * self.HIDDEN * 4,
+            "replay_flops_frac": 0.5,
+            "restore_chunk_layers": 1,
+            "restore_chunk_bytes": 0,
+        }
 
     # ------------------------------------------------------------- #
     def suspend_sequence(self, uid: int) -> None:
@@ -207,6 +295,10 @@ class SimulatedEngine:
         self.counts["resume"] += 1
 
     def flush(self, uid: int) -> None:
+        if self._restore_lanes and uid in self.restoring_uids:
+            raise RuntimeError(
+                f"sequence {uid} has an open restore lane; its blocks "
+                "cannot be freed while replay chunks are in flight")
         self.state.flush_sequence(uid)
         self.counts["flush"] += 1
 

@@ -1,0 +1,76 @@
+"""Coalesced layer-major host latent store (the restore payload
+buffer): ndarray-contract parity with the np.concatenate accumulation
+it replaces, amortized growth, dtype preservation (fp8 capture), and
+drop-in use as a ``restore_kv`` payload."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference.ragged.latents import HostLatentStore
+
+
+def chunks(rng, n, L=2, H=4, dtype=np.float32):
+    return [rng.standard_normal((L, t, H)).astype(dtype)
+            for t in [5] + [1] * (n - 1)]       # prefill then decodes
+
+
+def test_matches_concatenate_accumulation():
+    rng = np.random.default_rng(0)
+    parts = chunks(rng, 40)
+    store = HostLatentStore()
+    for p in parts:
+        store.append(p)
+    ref = np.concatenate(parts, axis=1)
+    assert store.shape == ref.shape
+    assert len(store) == ref.shape[1]
+    np.testing.assert_array_equal(np.asarray(store), ref)
+    np.testing.assert_array_equal(store.view(), ref)
+    assert store.nbytes == ref.nbytes
+
+
+def test_layer_major_contiguous_buffer():
+    """The backing buffer is ONE C-contiguous [L, cap, H] array — a
+    per-layer-chunk slice walks memory in shipping order."""
+    store = HostLatentStore(np.ones((3, 4, 8), np.float32))
+    store.append(np.ones((3, 1, 8), np.float32))
+    assert store._buf.flags["C_CONTIGUOUS"]
+    v = store.view()
+    assert v.base is store._buf and v.shape == (3, 5, 8)
+
+
+def test_growth_is_amortized_doubling():
+    store = HostLatentStore()
+    store.append(np.zeros((2, 3, 4), np.float32))
+    caps = {store._buf.shape[1]}
+    for _ in range(200):
+        store.append(np.zeros((2, 1, 4), np.float32))
+        caps.add(store._buf.shape[1])
+    # 203 tokens via doubling from 16: few distinct capacities, not 200
+    assert len(caps) <= 6 and len(store) == 203
+
+
+def test_dtype_preserved_and_mismatch_rejected():
+    import jax.numpy as jnp
+    dt = np.dtype(jnp.float8_e4m3fn)
+    store = HostLatentStore(np.zeros((2, 2, 4), dt))
+    store.append(np.zeros((2, 1, 4), dt))
+    assert store.dtype == dt and store.shape == (2, 3, 4)
+    with pytest.raises(ValueError, match="does not match"):
+        store.append(np.zeros((3, 1, 4), dt))      # wrong L
+    with pytest.raises(ValueError, match="L, t, H"):
+        store.append(np.zeros((4,), dt))
+    with pytest.raises(ValueError, match="no view"):
+        HostLatentStore().view()
+
+
+def test_restore_payload_contract_with_sim_engine():
+    """np.asarray(store) satisfies the [L, T, H] restore contract the
+    engines check (shape[1] vs token count)."""
+    from hcache_deepspeed_tpu.serving import SimulatedEngine
+    eng = SimulatedEngine()
+    tokens = list(range(10))
+    _, lat = eng.put([7], [tokens])
+    store = HostLatentStore(lat[0])
+    eng.flush(7)
+    eng.restore_kv([7], [tokens], [store])
+    assert eng.state.get_sequence(7).seen_tokens == len(tokens)

@@ -1,0 +1,198 @@
+"""Decode-interleaved HCache restore: the dual-lane restore pipeline.
+
+The engine-side lane surface (``begin_restore`` / ``advance_restores``)
+must (a) keep exact bookkeeping — tickets, chunk counts, in-flight
+guards — and (b) be *invisible to results*: interleaving a restore's
+replay chunks with resident decode dispatches yields bitwise-identical
+logits to the sequential restore-then-decode path on the CPU backend
+(interleaved dispatches only read OTHER sequences' blocks)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+from hcache_deepspeed_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama_tiny(max_positions=128, use_flash=False)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)},
+                        train=False)["params"]
+    return cfg, params
+
+
+def build_engine(cfg, params, chunk_layers=1):
+    return InferenceEngineV2(
+        cfg, params,
+        config=RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 128,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 128},
+            kv_cache={"block_size": 8, "num_blocks": 17,
+                      "cache_dtype": "float32"},
+            # one layer per chunk: the tiny model's 2 layers become 2
+            # replay chunks, so the lane genuinely spans advances
+            hcache={"enable_latents": True,
+                    "restore_chunk_layers": chunk_layers}))
+
+
+def _harvest(cfg, engine, rng):
+    """Prefill a resident (uid 0) and a victim (uid 1); flush the
+    victim keeping its latents — the standard preempt-to-latents
+    setup. Returns (p0, p1, latents_1)."""
+    p0 = list(map(int, rng.integers(0, cfg.vocab_size, 12)))
+    p1 = list(map(int, rng.integers(0, cfg.vocab_size, 20)))
+    _, lat = engine.put([0, 1], [p0, p1])
+    engine.flush(1)
+    return p0, p1, lat[1]
+
+
+class TestLaneBookkeeping:
+    def test_ticket_and_chunk_accounting(self, tiny_model):
+        cfg, params = tiny_model
+        eng = build_engine(cfg, params)
+        rng = np.random.default_rng(0)
+        _, p1, lat1 = _harvest(cfg, eng, rng)
+        stats0 = dict(eng.restore_stats)
+        ticket = eng.begin_restore([1], [p1], [lat1])
+        assert not ticket.done and ticket.uids == [1]
+        assert eng.restoring_uids == [1]
+        assert eng.pending_restore_chunks == cfg.n_layer
+        # restores/sequences count at begin; chunks as they issue
+        assert eng.restore_stats["restores"] == stats0["restores"] + 1
+        assert eng.restore_stats["chunks_issued"] == \
+            stats0["chunks_issued"]
+        chunks, completed, touched = eng.advance_restores(1)
+        assert (chunks, completed, touched) == (1, [], [1])
+        assert not ticket.done and eng.pending_restore_chunks == \
+            cfg.n_layer - 1
+        chunks, completed, touched = eng.advance_restores()
+        assert chunks == cfg.n_layer - 1 and completed == [1]
+        assert ticket.done and eng.restoring_uids == []
+        assert eng.restore_stats["chunks_issued"] == \
+            stats0["chunks_issued"] + cfg.n_layer
+        # restored sequence is live and decodable
+        assert eng.state.get_sequence(1).seen_tokens == len(p1)
+        eng.flush(0)
+        eng.flush(1)
+
+    def test_open_lane_guards_put_and_flush(self, tiny_model):
+        cfg, params = tiny_model
+        eng = build_engine(cfg, params)
+        rng = np.random.default_rng(1)
+        _, p1, lat1 = _harvest(cfg, eng, rng)
+        eng.begin_restore([1], [p1], [lat1])
+        with pytest.raises(RuntimeError, match="open restore lane"):
+            eng.put([1], [[3]])
+        with pytest.raises(RuntimeError, match="open restore lane"):
+            eng.flush(1)
+        with pytest.raises(RuntimeError, match="open restore lane"):
+            eng.begin_restore([1], [p1], [lat1])
+        eng.advance_restores()
+        eng.put([1], [[3]])          # lane drained: decodable again
+        eng.flush(0)
+        eng.flush(1)
+
+    def test_restore_kv_drains_through_the_lane(self, tiny_model):
+        """The synchronous API is the lane run to completion — no lane
+        may remain open after it returns."""
+        cfg, params = tiny_model
+        eng = build_engine(cfg, params)
+        rng = np.random.default_rng(2)
+        _, p1, lat1 = _harvest(cfg, eng, rng)
+        eng.restore_kv([1], [p1], [lat1])
+        assert eng.pending_restore_chunks == 0
+        assert eng.restoring_uids == []
+        assert eng.state.get_sequence(1).seen_tokens == len(p1)
+        eng.flush(0)
+        eng.flush(1)
+
+
+class TestInterleavedParity:
+    def test_interleaved_restore_bitwise_matches_sequential(
+            self, tiny_model):
+        """The acceptance parity gate: restore chunks interleaved with
+        a resident's decode steps produce logits identical to the
+        sequential restore-then-decode path — for the resident AND the
+        restored sequence."""
+        cfg, params = tiny_model
+        rng = np.random.default_rng(3)
+        feed0 = [int(t) for t in rng.integers(0, cfg.vocab_size, 3)]
+        feed1 = int(rng.integers(0, cfg.vocab_size))
+
+        # path A: interleaved — one decode dispatch between every
+        # replay chunk
+        eng_a = build_engine(cfg, params)
+        p0, p1, lat1 = _harvest(cfg, eng_a, rng)
+        logits_a = []
+        ticket = eng_a.begin_restore([1], [p1], [lat1])
+        i = 0
+        while not ticket.done:
+            la, _ = eng_a.put([0], [[feed0[i]]])
+            logits_a.append(np.asarray(la[0]))
+            i += 1
+            eng_a.advance_restores(1)
+        # drain the remaining resident feeds + the restored sequence
+        for t in feed0[i:]:
+            la, _ = eng_a.put([0], [[t]])
+            logits_a.append(np.asarray(la[0]))
+        l1a, _ = eng_a.put([1], [[feed1]])
+
+        # path B: sequential — full restore, then the same decodes
+        # (fresh rng at the same point in the stream ⇒ same prompts)
+        eng_b = build_engine(cfg, params)
+        rng_b = np.random.default_rng(3)
+        rng_b.integers(0, cfg.vocab_size, 3)
+        rng_b.integers(0, cfg.vocab_size)
+        p0b, p1b, lat1b = _harvest(cfg, eng_b, rng_b)
+        assert p0b == p0 and p1b == p1
+        eng_b.restore_kv([1], [p1b], [lat1b])
+        logits_b = []
+        for t in feed0:
+            lb, _ = eng_b.put([0], [[t]])
+            logits_b.append(np.asarray(lb[0]))
+        l1b, _ = eng_b.put([1], [[feed1]])
+
+        assert len(logits_a) == len(logits_b)
+        for a, b in zip(logits_a, logits_b):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(np.asarray(l1a[0]),
+                                      np.asarray(l1b[0]))
+
+    def test_interleaved_restore_multi_sequence_group(self, tiny_model):
+        """A grouped (two-uid) lane restored chunk-by-chunk under
+        decode traffic equals the one-shot grouped restore."""
+        cfg, params = tiny_model
+        rng = np.random.default_rng(4)
+        p0 = list(map(int, rng.integers(0, cfg.vocab_size, 8)))
+        pr = [list(map(int, rng.integers(0, cfg.vocab_size, n)))
+              for n in (10, 14)]
+
+        def harvest(eng):
+            _, lat = eng.put([0, 1, 2], [p0] + pr)
+            eng.flush(1)
+            eng.flush(2)
+            return lat
+
+        eng_a = build_engine(cfg, params)
+        lat = harvest(eng_a)
+        ticket = eng_a.begin_restore([1, 2], pr, [lat[1], lat[2]])
+        while not ticket.done:
+            eng_a.put([0], [[5]])
+            eng_a.advance_restores(1)
+        l_a, _ = eng_a.put([1, 2], [[7], [9]])
+
+        eng_b = build_engine(cfg, params)
+        lat = harvest(eng_b)
+        eng_b.restore_kv([1, 2], pr, [lat[1], lat[2]])
+        eng_b.put([0], [[5]])
+        eng_b.put([0], [[5]])
+        l_b, _ = eng_b.put([1, 2], [[7], [9]])
+        np.testing.assert_array_equal(np.asarray(l_a),
+                                      np.asarray(l_b))

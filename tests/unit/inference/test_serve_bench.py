@@ -2,6 +2,7 @@
 keeps the measurement tool itself green across engine changes."""
 
 import numpy as np
+import pytest
 
 from hcache_deepspeed_tpu.inference.benchmark import run
 
@@ -162,6 +163,52 @@ def test_serve_loop_mode(tmp_path):
     assert len(per_req) == 16
     assert all(r["state"] == "DONE" for r in per_req)
     # the artifact file mirrors the emitted rows
+    import json as _json
+    lines = [_json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == len(rows)
+
+
+def test_serve_loop_overlap_ratio_positive(tmp_path):
+    """The acceptance gate: the span-derived restore-overlap ratio in
+    the serve_loop artifact is > 0 (restore lanes genuinely advance
+    under resident decode) and agrees with the scheduler counters."""
+    from hcache_deepspeed_tpu.inference.benchmark import run_serve_loop
+    rows = run_serve_loop(model_size="tiny", n_requests=16, rps=100.0,
+                          virtual_clock=True,
+                          out=str(tmp_path / "sl.jsonl"))
+    summary = rows[-1]
+    assert summary["restore_overlap_ratio"] > 0
+    span_rs = summary["extra"]["step_breakdown"]["restore"]
+    assert span_rs["overlap_ratio"] == pytest.approx(
+        summary["restore_overlap_ratio"])
+    assert span_rs["overlap_ratio"] > 0
+    assert span_rs["chunks_issued"] >= span_rs["scheduler_restores"]
+
+
+def test_serve_bench_restore_crossover_mode(tmp_path):
+    """restore_crossover: one JSONL row per prompt length carrying the
+    measured marginal costs AND the analytic model's verdict, plus a
+    summary row with the calibrated rates — and the model's choice
+    always matches its own cheaper analytic side."""
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_restore_crossover
+    out = tmp_path / "crossover.jsonl"
+    rows = run_restore_crossover(model_size="tiny", max_context=128,
+                                 prompt_lens=(16, 48), chain=2,
+                                 out=str(out))
+    curve = [r for r in rows if r["phase"] == "restore-crossover"]
+    assert [r["prompt_len"] for r in curve] == [16, 48]
+    for row in curve:
+        assert row["prefill_ms"] >= 0 and row["restore_ms"] >= 0
+        assert row["model_choice"] in ("restore", "recompute")
+        assert row["measured_winner"] in ("restore", "recompute")
+        cheaper = "restore" if row["restore_pred_ms"] <= \
+            row["recompute_pred_ms"] else "recompute"
+        assert row["model_choice"] == cheaper
+    summary = rows[-1]
+    assert summary["phase"] == "restore-crossover-summary"
+    assert summary["calibration"]["calibrated"]
+    assert summary["calibration"]["samples"]["prefill"] >= 2
     import json as _json
     lines = [_json.loads(l) for l in out.read_text().splitlines()]
     assert len(lines) == len(rows)

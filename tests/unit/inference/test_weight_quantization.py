@@ -282,3 +282,39 @@ def test_untied_head_quantizes_k_major():
     lf, lq = np.asarray(lf[0]), np.asarray(lq[0])
     scale = np.abs(lf).max() + 1e-6
     assert np.abs(lf - lq).max() / scale < 0.15
+
+
+def test_untied_head_misaligned_group_recorded_not_silently_dense():
+    """An untied LM head whose K (= hidden) is not a group multiple
+    must be RECORDED in the quantization skip list — staying full
+    precision with the same warning the trunk path gets — instead of
+    silently falling through (and then being re-quantized by the flat
+    dequant-on-use fallback, which is slower than dense at decode)."""
+    from hcache_deepspeed_tpu.ops.quantized_matmul import \
+        MatmulQuantizedTensor
+    # hidden 96 % group 64 != 0: head (and trunk) misaligned
+    cfg = llama_tiny(hidden_size=96, intermediate_size=128,
+                     max_positions=128, use_flash=False)
+    assert not cfg.tie_word_embeddings
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch,
+                        train=False)["params"]
+    q8 = _engine(cfg, params, quantized=True, fused=True)
+    head = q8.model.params["lm_head"]
+    assert not isinstance(head, (QuantizedTensor,
+                                 MatmulQuantizedTensor))
+    assert jnp.issubdtype(head.dtype, jnp.floating)
+    # aligned hidden on the same vocab still quantizes k-major — the
+    # new skip is the misalignment record, not a blanket head opt-out
+    cfg2 = llama_tiny(hidden_size=128, intermediate_size=256,
+                      max_positions=128, use_flash=False)
+    model2 = LlamaForCausalLM(cfg2)
+    params2 = model2.init(jax.random.PRNGKey(0), batch,
+                          train=False)["params"]
+    q82 = _engine(cfg2, params2, quantized=True, fused=True)
+    assert isinstance(q82.model.params["lm_head"],
+                      MatmulQuantizedTensor)
+    # and the misaligned engine still serves
+    out = q8.generate([list(range(10))], max_new_tokens=3)
+    assert len(out[0]) == 3

@@ -135,3 +135,62 @@ def test_make_batched_matches_one_shot():
         onp.testing.assert_allclose(onp.asarray(bat.scale),
                                     onp.asarray(one.scale), rtol=1e-6)
         assert bat.group_k == one.group_k
+
+
+def test_choose_tiles_scale_with_activation_bytes():
+    """The VMEM estimate must price the x/out tiles at the ACTUAL
+    activation itemsize: a 4-byte (fp32) input picks a smaller tile
+    than the 2-byte (bf16) default — the bf16 blocking would overflow
+    the budget once the tiles are really fp32."""
+    from hcache_deepspeed_tpu.ops.quantized_matmul import _choose_tiles
+    M, K, N, G, BM = 256, 4096, 4096, 256, 256
+    bn2, gpb2 = _choose_tiles(M, K, N, G, BM, x_bytes=2)
+    bn4, gpb4 = _choose_tiles(M, K, N, G, BM, x_bytes=4)
+    assert (bn4, gpb4) != (bn2, gpb2)
+
+    def vmem(bn, gpb, xb):
+        bk = gpb * G
+        rows = gpb if gpb % 8 == 0 else K // G
+        return (2 * bk * bn + 2 * BM * bk * xb + 2 * rows * bn * 4
+                + BM * bn * 4 + 2 * BM * bn * xb)
+
+    budget = 10 * 2**20
+    assert vmem(bn4, gpb4, 4) <= budget
+    # the bf16 choice priced at fp32 bytes overflows — exactly the
+    # miscount the dtype-derived estimate fixes
+    assert vmem(bn2, gpb2, 4) > budget
+
+
+def test_reference_fallback_recorded_and_warned_once():
+    """The silent reference-path fallback must leave a trail: counters
+    by reason + the last shape in fallback_debug_info(), and ONE
+    warning for the first fallback (a perf run can then check it
+    measured the kernel, not the dequant path). The repo logger does
+    not propagate, so warn-once is asserted via the debug record's
+    ``warned`` latch rather than captured records."""
+    from hcache_deepspeed_tpu.ops import quantized_matmul as qmm
+    x, w, q, scale = _mk(M=32, K=192, N=256, group_k=64, seed=3)
+    saved = dict(qmm._FALLBACK_DEBUG)
+    saved["by_reason"] = dict(saved["by_reason"])
+    try:
+        qmm._FALLBACK_DEBUG.update(count=0, by_reason={}, last=None,
+                                   warned=False)
+        # ragged M against an explicit block_m: 17 % 8 != 0
+        out = qmm.pallas_quantized_matmul(
+            x[:17], q, scale, group_k=64, block_m=8, interpret=True)
+        assert qmm._FALLBACK_DEBUG["warned"]      # first fallback warns
+        out2 = qmm.pallas_quantized_matmul(
+            x[:17], q, scale, group_k=64, block_m=8, interpret=True)
+        ref = qmm.reference_quantized_matmul(x[:17], q, scale,
+                                             group_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                                   atol=1e-4)
+        info = qmm.fallback_debug_info()
+        assert info["count"] == 2
+        assert info["by_reason"] == {"tile_misaligned": 2}
+        reason, M, K, N, block = info["last"]
+        assert (reason, M, K, N) == ("tile_misaligned", 17, 192, 256)
+    finally:
+        qmm._FALLBACK_DEBUG.update(saved)
