@@ -908,6 +908,101 @@ def run_serve_loop(model_size="tiny", max_context=128, prompt_len=48,
     return results
 
 
+def run_chaos_serve(seed=0, n_requests=32, runs=2,
+                    out="CHAOS_SERVE.jsonl", **chaos_kw):
+    """Chaos serving mode: seeded fault plans replayed over the
+    virtual-clock simulation (``resilience.chaos.run_chaos``), with
+    the robustness invariants asserted and the determinism gate run
+    inline (``runs`` identical-seed replays must produce identical
+    event digests). Emits one jsonl row per request, one per fault
+    site, a checkpoint-hardening phase (save retry under an injected
+    ``ckpt.write`` fault + corrupt-manifest fallback), and a summary
+    row. Exits nonzero (raises) on any invariant violation — the
+    artifact IS the acceptance evidence."""
+    import shutil
+    import tempfile
+
+    from ..resilience import run_chaos
+    from ..resilience.faults import FaultPlan, FaultRule, injected
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    chaos = [run_chaos(seed=seed, n_requests=n_requests, **chaos_kw)
+             for _ in range(max(1, runs))]
+    r = chaos[0]
+    digests = [c.event_digest for c in chaos]
+    deterministic = len(set(digests)) == 1
+    emit({"phase": "chaos-plan", "seed": seed, "plan": r.plan})
+    for req in r.requests:
+        emit({"phase": "chaos-request", **req})
+    for site, n in sorted(r.fault_summary["by_site"].items()):
+        emit({"phase": "chaos-fault-site", "site": site, "fired": n})
+
+    # checkpoint-hardening phase: a transient ckpt.write fault is
+    # absorbed by the bounded save retry; a corrupted manifest on the
+    # newest checkpoint falls back to the previous one on restore
+    from ..runtime.checkpoint_engine import SyncCheckpointEngine
+    from ..runtime.checkpointing import load_checkpoint, save_checkpoint
+    tmp = tempfile.mkdtemp(prefix="hds_chaos_ckpt_")
+    try:
+        state_v1 = {"params": np.arange(8, dtype=np.float32)}
+        state_v2 = {"params": np.arange(8, dtype=np.float32) * 2}
+        save_checkpoint(tmp, "step1", state_v1, {"step": 1},
+                        checkpoint_engine=SyncCheckpointEngine())
+        with injected(FaultPlan(seed=seed, rules=[
+                FaultRule("ckpt.write", at_hits=(1,))])):
+            save_checkpoint(tmp, "step2", state_v2, {"step": 2},
+                            checkpoint_engine=SyncCheckpointEngine())
+        retried_ok = True
+        manifest = os.path.join(tmp, "step2", "hds_manifest.json")
+        with open(manifest, "w") as mf:
+            mf.write("{corrupt json")
+        template = {"params": np.zeros(8, np.float32)}
+        restored, meta = load_checkpoint(tmp, None, template)
+        fallback_ok = (restored is not None and
+                       meta.get("fallback_from") == "step2" and
+                       np.array_equal(restored["params"],
+                                      state_v1["params"]))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    emit({"phase": "chaos-ckpt", "save_retry_ok": retried_ok,
+          "fallback_ok": bool(fallback_ok),
+          "sites": ["ckpt.write", "ckpt.read"]})
+
+    emit({"phase": "chaos-summary", "seed": seed,
+          "n_requests": n_requests, "runs": len(chaos),
+          "deterministic": deterministic,
+          "event_digest": digests[0],
+          "invariants_ok": all(c.ok for c in chaos),
+          "violations": sum((c.violations for c in chaos), []),
+          "invariants": r.invariants,
+          "fault_summary": r.fault_summary,
+          "counters": r.metrics["counters"],
+          "failures": r.metrics["failures"],
+          "rejected": r.metrics["rejected"]})
+    if fh is not None:
+        fh.close()
+    if not all(c.ok for c in chaos):
+        raise RuntimeError(
+            f"chaos invariants violated: "
+            f"{sum((c.violations for c in chaos), [])}")
+    if not deterministic:
+        raise RuntimeError(
+            f"chaos determinism gate failed: digests {digests}")
+    if not fallback_ok:
+        raise RuntimeError("checkpoint fallback-to-previous failed")
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False, lookup=False):
@@ -1104,10 +1199,23 @@ def _main_serve_loop(argv):
     p.add_argument("--virtual-clock", action="store_true",
                    help="replay on the deterministic simulated "
                         "timeline instead of wall time")
+    p.add_argument("--chaos", action="store_true",
+                   help="chaos mode: seeded fault injection over the "
+                        "virtual-clock simulation, invariant + "
+                        "determinism gates, CHAOS_SERVE.jsonl artifact")
+    p.add_argument("--chaos-runs", type=int, default=2,
+                   help="identical-seed replays for the determinism "
+                        "gate (chaos mode)")
     p.add_argument("--out", default="SERVE_LOOP.jsonl",
                    help="also append rows to this jsonl file "
                         "('' = stdout only)")
     args = p.parse_args(argv)
+    if args.chaos:
+        out = args.out if args.out != "SERVE_LOOP.jsonl" \
+            else "CHAOS_SERVE.jsonl"
+        run_chaos_serve(seed=args.seed, n_requests=args.n_requests,
+                        runs=args.chaos_runs, out=out)
+        return 0
     run_serve_loop(args.model, args.max_context, args.prompt_len,
                    max_new=args.max_new, rps=args.rps,
                    n_requests=args.n_requests, seed=args.seed,

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..platform import get_platform
+from ..resilience.faults import get_injector
 from ..telemetry.tracer import get_tracer
 from ..utils.logging import log_dist
 from .config import RaggedInferenceEngineConfig
@@ -306,6 +307,14 @@ class InferenceEngineV2:
             if result != SchedulingResult.Success:
                 raise SchedulingError(result)
         self._reject_suspended(batch_uids)
+        _inj = get_injector()
+        if _inj.enabled and batch_uids:
+            # resilience fault site: before any state mutation, so a
+            # faulted dispatch is retryable / its batch quarantinable
+            _inj.fire("engine.prefill"
+                      if any(len(t) > 1 for t in batch_tokens)
+                      else "engine.decode",
+                      uid=batch_uids[-1], uids=tuple(batch_uids))
         if defer_fetch and (self.prefix_caching or
                             self.config.hcache.enable_latents or
                             self.config.state_manager.prefill_chunk):
@@ -1111,6 +1120,29 @@ class InferenceEngineV2:
                 lane.ticket.done = True
             self._restore_lanes.pop(0)
         return issued, completed, touched
+
+    def abort_restore(self, uid: int) -> List[int]:
+        """Abort the open restore lane holding ``uid`` (resilience
+        path: retry exhaustion or the scheduler's stuck-lane watchdog).
+        Every sequence the lane staged is flushed — its blocks and
+        tracked slot free immediately; chunks already replayed into the
+        cache are unreachable once the block table is gone, so a
+        partially-restored lane leaves no visible state. Returns the
+        aborted uids ([] when no lane holds ``uid``). The host latent
+        payload belongs to the caller and survives for a later re-begin
+        or recompute re-entry."""
+        for i, lane in enumerate(self._restore_lanes):
+            if uid in lane.uids:
+                self._restore_lanes.pop(i)
+                for u in lane.uids:
+                    self.state.flush_sequence(u)
+                lane.ticket.pending -= 1
+                if lane.ticket.pending <= 0:
+                    lane.ticket.done = True
+                get_tracer().instant("serve.restore_abort",
+                                     uids=list(lane.uids))
+                return list(lane.uids)
+        return []
 
     @property
     def pending_restore_chunks(self) -> int:

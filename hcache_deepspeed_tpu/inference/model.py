@@ -1162,6 +1162,11 @@ class RestorePipeline:
 
     # ------------------------------------------------------------- #
     def _ship(self, i):
+        from ..resilience.faults import get_injector
+        _inj = get_injector()
+        if _inj.enabled:
+            # before the H2D issue: a faulted ship is re-issuable
+            _inj.fire("restore.ship", chunk=i)
         l0 = self.bounds[i]
         sl = self.latents[l0:l0 + self.chunk_layers]
         if self.staged:
@@ -1190,14 +1195,20 @@ class RestorePipeline:
         """Issue up to ``max_chunks`` replay dispatches (0 = all
         remaining), shipping the following chunk ahead of each replay.
         Async end to end — returns the number of replays issued."""
+        from ..resilience.faults import get_injector
         from ..telemetry.tracer import get_tracer
         tracer = get_tracer()
+        _inj = get_injector()
         issued = 0
         L = self.model.n_layers
         while not self.done and (max_chunks <= 0 or
                                  issued < max_chunks):
             i = self._next_replay
             l0 = self.bounds[i]
+            if _inj.enabled:
+                # before the cursor moves or the buffer is consumed —
+                # a faulted replay retries from the same chunk
+                _inj.fire("restore.replay", chunk=i, layer0=l0)
             cur = self._bufs.pop(i, None)
             nbytes = 0 if self.staged else int(
                 np.prod(self.latents[l0:l0 + self.chunk_layers].shape)
@@ -1211,12 +1222,18 @@ class RestorePipeline:
                 if cur is None:
                     cur = self._ship(i)
                 self._next_replay = i + 1
-                self.prefetch()           # dual-lane: next ship first
                 ck, cv = self.model._restore(
                     self.model.params, self.cache.k, self.cache.v,
                     jnp.int32(l0), cur, self._start, self._tables,
                     self._t_len)
                 self.cache.replace(ck, cv)
+                # dual-lane: the NEXT chunks' H2D ships issue right
+                # behind this (async) replay dispatch and ride the link
+                # under it. Ordered after the replay so a faulted ship
+                # can never strand a half-advanced cursor — every
+                # injected fault lands either before this chunk mutated
+                # anything or after it fully replayed (retry-safe).
+                self.prefetch()
             if self.progress_cb is not None:
                 self.progress_cb(l0, nbytes)
             issued += 1

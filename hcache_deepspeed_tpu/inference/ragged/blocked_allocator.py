@@ -38,6 +38,13 @@ class BlockedAllocator:
     def allocate(self, num_blocks: int) -> List[int]:
         if num_blocks < 1:
             raise ValueError(f"invalid allocation size {num_blocks}")
+        from ...resilience.faults import get_injector
+        _inj = get_injector()
+        if _inj.enabled:
+            # fires before the free list mutates: a faulted allocation
+            # is retryable and leaks nothing
+            _inj.fire("alloc.blocks", n=num_blocks,
+                      free=len(self._free))
         if num_blocks > len(self._free):
             raise ValueError(
                 f"cannot allocate {num_blocks} blocks, only "

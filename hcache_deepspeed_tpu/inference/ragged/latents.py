@@ -43,6 +43,12 @@ class HostLatentStore:
 
     def append(self, chunk) -> None:
         """Absorb one ``[L, t, H]`` latent chunk (t >= 1)."""
+        from ...resilience.faults import get_injector
+        _inj = get_injector()
+        if _inj.enabled:
+            # before any buffer growth/write: a faulted absorb leaves
+            # the store's valid span untouched
+            _inj.fire("host.latents", tokens=self._len)
         chunk = np.asarray(chunk)
         if chunk.ndim != 3:
             raise ValueError(

@@ -1,0 +1,24 @@
+"""Resilience layer: deterministic fault injection + recovery policies.
+
+No reference analog in DeepSpeed — its failure story is elasticity
+(restart the job world). A serving stack needs per-request failure
+semantics instead: inject any failure deterministically
+(``faults``), retry/bound/trip around it (``retry``), degrade
+gracefully under a storm (``degradation``), and prove the whole thing
+with seeded chaos runs over the virtual-clock simulation (``chaos``).
+``policy.ResiliencePolicy`` is the knob bundle the serving scheduler
+consumes; the fault-site hooks live in the engine, restore pipeline,
+block allocator, host latent store and checkpoint engine.
+"""
+
+from .degradation import (DegradationLadder,  # noqa: F401
+                          DegradationLevel, LadderConfig)
+from .faults import (SITES, FaultInjector, FaultPlan,  # noqa: F401
+                     FaultRule, InjectedFault, get_injector, injected,
+                     install, uninstall)
+from .policy import ResiliencePolicy  # noqa: F401
+from .retry import (BreakerState, CircuitBreaker,  # noqa: F401
+                    RetryPolicy, Watchdog, call_with_retry)
+
+from .chaos import (ChaosResult, build_chaos_trace,  # noqa: F401
+                    default_fault_plan, run_chaos)

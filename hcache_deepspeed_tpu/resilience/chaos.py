@@ -1,0 +1,206 @@
+"""Chaos harness: seeded fault plans over the virtual-clock simulation.
+
+One call = one fully deterministic serving run with faults injected at
+every named site, returning the replayable event log plus an invariant
+report. The invariants are the robustness contract this subsystem
+ships:
+
+1. **terminal-state completeness** — every submitted request ends in
+   exactly one terminal state (DONE / REJECTED / FAILED), exactly once
+   in the scheduler's ``done`` map;
+2. **zero KV leaks** — the block allocator returns to its pre-trace
+   free count (quarantines, lane aborts and deadline kills all freed
+   their blocks);
+3. **restore accounting** — engine ``restore_stats`` agree with the
+   scheduler's counters;
+4. **determinism** — two runs of the same seed produce byte-identical
+   event logs (compare ``ChaosResult.event_digest``).
+
+The harness is pure CPU (SimulatedEngine + VirtualClock), so all of
+this is tier-1-testable; ``inference/benchmark.py``'s ``serve_loop
+--chaos`` mode wraps it into the CHAOS_SERVE.jsonl artifact.
+"""
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .faults import FaultPlan, FaultRule, injected
+from .policy import ResiliencePolicy
+
+
+def default_fault_plan(seed: int = 0) -> FaultPlan:
+    """Faults at every named serving-path site. ``max_faults`` bounds
+    every rule so the storm eventually heals — retries and breaker
+    probes can succeed and the trace always drains.
+
+    The ``restore.ship`` rule fires a deterministic 9-hit burst: with
+    the default retry budget (3 attempts) that is exactly three
+    consecutive retry-exhausted lane aborts — enough to trip the
+    breaker (threshold 3) and force the crossover recompute re-entry
+    path, which the chaos acceptance gate asserts on.
+    """
+    return FaultPlan(seed=seed, rules=[
+        FaultRule("engine.decode", probability=0.02, max_faults=3),
+        FaultRule("engine.prefill", probability=0.03, max_faults=3),
+        FaultRule("restore.ship", at_hits=tuple(range(1, 10)),
+                  probability=0.05, max_faults=12),
+        FaultRule("restore.replay", at_hits=(2,), probability=0.08,
+                  max_faults=3),
+        FaultRule("alloc.blocks", at_hits=(7,), probability=0.01,
+                  max_faults=2),
+        FaultRule("host.latents", at_hits=(11,), probability=0.005,
+                  max_faults=2),
+    ])
+
+
+@dataclass
+class ChaosResult:
+    seed: int
+    plan: Dict
+    requests: List[Dict]
+    events: List
+    event_digest: str
+    metrics: Dict
+    fault_summary: Dict
+    invariants: Dict
+    ok: bool = False
+    violations: List[str] = field(default_factory=list)
+
+
+def _digest(events) -> str:
+    payload = json.dumps(events, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+def build_chaos_trace(seed: int, n_requests: int, vocab: int,
+                      prompt_lo: int = 8, prompt_hi: int = 24,
+                      max_new: int = 8, rps: float = 40.0,
+                      deadline_frac: float = 0.25,
+                      deadline_slack_s: float = 0.25):
+    """Seeded request trace: mixed priorities, a deadline-carrying
+    minority, Poisson arrivals. Returns a list of Requests."""
+    from ..serving import Request
+    rng = np.random.default_rng([seed, 0x7A0])
+    arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
+    reqs = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_lo, prompt_hi + 1))
+        prompt = [int(t) for t in rng.integers(0, vocab, (plen,))]
+        deadline = None
+        if rng.random() < deadline_frac:
+            deadline = float(arrive[i]) + deadline_slack_s
+        reqs.append(Request(
+            uid=i, prompt=prompt, max_new_tokens=max_new,
+            arrival_time=float(arrive[i]),
+            priority=int(rng.integers(0, 3)),
+            deadline=deadline))
+    return reqs
+
+
+def run_chaos(seed: int = 0, n_requests: int = 32,
+              fault_plan: Optional[FaultPlan] = None,
+              policy: Optional[ResiliencePolicy] = None,
+              num_blocks: int = 12, block_size: int = 8,
+              max_lanes: int = 4, max_tracked: int = 8,
+              max_context: int = 64, max_new: int = 10,
+              rps: float = 60.0,
+              restore_chunks_per_step: int = 1) -> ChaosResult:
+    """One deterministic chaos run. Everything — trace, faults, retry
+    jitter, token streams — is a pure function of ``seed``."""
+    from ..inference.config import RaggedInferenceEngineConfig
+    from ..serving import (ServerConfig, ServingServer, SimulatedEngine,
+                           VirtualClock)
+
+    plan = fault_plan if fault_plan is not None \
+        else default_fault_plan(seed)
+    policy = policy or ResiliencePolicy(seed=seed)
+    engine = SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": max_tracked,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": max_lanes,
+                       "max_context": max_context},
+        kv_cache={"block_size": block_size, "num_blocks": num_blocks},
+        hcache={"enable_latents": True}))
+    initial_free = engine.state.free_blocks
+    server = ServingServer(
+        engine, clock=VirtualClock(),
+        config=ServerConfig(max_queue_depth=n_requests + 1,
+                            kv_demand_fraction=float("inf"),
+                            restore_chunks_per_step=
+                            restore_chunks_per_step),
+        resilience=policy)
+    reqs = build_chaos_trace(seed, n_requests, engine.vocab_size,
+                             max_new=max_new, rps=rps,
+                             prompt_hi=min(24, max_context - max_new - 1))
+    with injected(plan):
+        server.run_trace(reqs)
+
+    sched = server.scheduler
+    violations: List[str] = []
+    # 1. terminal-state completeness
+    terminal = {"DONE", "REJECTED", "FAILED"}
+    for r in reqs:
+        if r.state.name not in terminal:
+            violations.append(
+                f"request {r.uid} ended non-terminal: {r.state.name}")
+        if r.uid not in sched.done:
+            violations.append(f"request {r.uid} missing from done map")
+    if len(sched.done) != len(reqs):
+        violations.append(
+            f"done map holds {len(sched.done)} entries for "
+            f"{len(reqs)} requests")
+    # 2. zero KV leaks
+    final_free = engine.state.free_blocks
+    if final_free != initial_free:
+        violations.append(
+            f"block leak: {initial_free} free before, {final_free} "
+            "after")
+    if engine.state.n_tracked_sequences != 0:
+        violations.append(
+            f"{engine.state.n_tracked_sequences} sequences still "
+            "tracked post-trace")
+    # 3. restore accounting
+    rs = engine.restore_stats
+    if rs["restores"] != sched.total_restores:
+        violations.append(
+            f"restore_stats.restores {rs['restores']} != scheduler "
+            f"total_restores {sched.total_restores}")
+    if rs["chunks_issued"] > rs["restores"] * engine.N_LAYER:
+        violations.append("more chunks issued than lanes could hold")
+
+    events = [list(e) for e in sched.events]
+    m = server.metrics.summary()
+    result = ChaosResult(
+        seed=seed, plan=plan.to_dict(),
+        requests=[{
+            "uid": r.uid, "state": r.state.name, "error": r.error,
+            "reject_reason": r.reject_reason,
+            "priority": r.priority,
+            "deadline": r.deadline,
+            "tokens": len(r.tokens_out),
+            "preemptions": r.n_preemptions,
+            "restores": r.n_restores,
+            "recomputes": r.n_recomputes,
+            "restore_failures": r.n_restore_failures,
+        } for r in reqs],
+        events=events,
+        event_digest=_digest(events),
+        metrics=m,
+        fault_summary=server.scheduler.fault_summary(),
+        invariants={
+            "terminal_states": sorted({r.state.name for r in reqs}),
+            "initial_free_blocks": initial_free,
+            "final_free_blocks": final_free,
+            "tracked_after": engine.state.n_tracked_sequences,
+            "restore_stats": dict(rs),
+            "breaker_trips": sched.breaker.trips,
+            "degraded_steps": sched.ladder.degraded_steps,
+        },
+        violations=violations,
+        ok=not violations)
+    return result

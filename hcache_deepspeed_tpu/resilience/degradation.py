@@ -1,0 +1,102 @@
+"""Graceful-degradation ladder for the serving scheduler.
+
+Under a fault storm the right move is rarely "keep admitting at full
+rate": every admitted request deepens the recovery debt (more KV to
+restore, more retries contending for the link). The ladder maps two
+signals — recent fault rate and KV pressure — onto escalating,
+*reversible* actions:
+
+====================  ==============================================
+level                 action (each level includes the ones below)
+====================  ==============================================
+NORMAL                nothing
+SHED                  reject the lowest-priority queued request per
+                      step (typed reason ``"shed_degraded"``)
+CAP_TOKENS            cap ``max_new_tokens`` of new admissions
+PAUSE_ADMISSIONS      admit nothing; serve only what is resident
+====================  ==============================================
+
+Escalation is **fault-gated**: with zero faults in the window the
+ladder stays at NORMAL regardless of KV pressure — ordinary overload
+is the scheduler's preemption machinery's job, and a fault-free run
+behaves exactly as before this layer existed. KV pressure *amplifies*
+escalation during a fault storm (a storm while the pool is saturated
+is the dangerous quadrant). De-escalation requires ``calm_steps``
+consecutive steps below the level's threshold (hysteresis — no
+flapping).
+"""
+
+from collections import deque
+from dataclasses import dataclass
+from enum import IntEnum
+
+
+class DegradationLevel(IntEnum):
+    NORMAL = 0
+    SHED = 1
+    CAP_TOKENS = 2
+    PAUSE_ADMISSIONS = 3
+
+
+@dataclass(frozen=True)
+class LadderConfig:
+    #: sliding window (scheduler steps) the fault rate is computed over
+    window: int = 16
+    #: faults-per-step thresholds for each escalation level
+    shed_rate: float = 0.25
+    cap_rate: float = 0.50
+    pause_rate: float = 0.75
+    #: KV utilization above which thresholds are scaled down (pressure
+    #: amplifies a storm); 1.0 disables the amplification
+    kv_pressure: float = 0.90
+    kv_amplify: float = 0.5
+    #: new-admission token cap at CAP_TOKENS and above
+    cap_max_new_tokens: int = 8
+    #: consecutive calm steps required to step one level down
+    calm_steps: int = 4
+
+
+class DegradationLadder:
+    def __init__(self, config: LadderConfig = None):
+        self.config = config or LadderConfig()
+        self.level = DegradationLevel.NORMAL
+        self._faults = deque()
+        self._calm = 0
+        self.degraded_steps = 0
+
+    def observe(self, step: int, faults: int, kv_utilization: float,
+                queue_depth: int) -> DegradationLevel:
+        """Feed one step's signals; returns the level to apply to the
+        *next* scheduling decisions."""
+        cfg = self.config
+        if faults:
+            self._faults.append((step, faults))
+        while self._faults and step - self._faults[0][0] >= cfg.window:
+            self._faults.popleft()
+        rate = sum(n for _, n in self._faults) / cfg.window
+        scale = 1.0
+        if kv_utilization >= cfg.kv_pressure and queue_depth > 0:
+            scale = cfg.kv_amplify
+        if rate <= 0.0:
+            target = DegradationLevel.NORMAL
+        elif rate >= cfg.pause_rate * scale:
+            target = DegradationLevel.PAUSE_ADMISSIONS
+        elif rate >= cfg.cap_rate * scale:
+            target = DegradationLevel.CAP_TOKENS
+        elif rate >= cfg.shed_rate * scale:
+            target = DegradationLevel.SHED
+        else:
+            target = DegradationLevel.NORMAL
+        if target > self.level:
+            self.level = target
+            self._calm = 0
+        elif target < self.level:
+            self._calm += 1
+            if self._calm >= cfg.calm_steps:
+                self.level = DegradationLevel(self.level - 1)
+                self._calm = 0
+        else:
+            self._calm = 0
+        if self.level > DegradationLevel.NORMAL:
+            self.degraded_steps += 1
+        return self.level

@@ -1,0 +1,234 @@
+"""Deterministic, seeded fault injection.
+
+Reference analog: none inside DeepSpeed — the reference's failure story
+is "elasticity restarts the job". Production serving needs the opposite
+discipline: every failure mode must be *injectable* (so recovery code
+is exercised, not hoped for), *deterministic* (so a chaos run replays
+bit-identically from its seed — the same property the virtual-clock
+simulation gives the scheduler), and *free when off* (the hooks ride
+hot paths: the ragged ``put``, the restore chunk lane, the block
+allocator).
+
+Design:
+
+* **Named sites.** Each hook names the operation it guards
+  (:data:`SITES`). A :class:`FaultPlan` binds rules to sites; sites
+  without rules cost one dict lookup and nothing else, and with no
+  plan installed the hook is a single attribute check
+  (``injector.enabled``) — the same zero-cost-when-disabled contract
+  as the telemetry tracer.
+* **Deterministic streams.** Every site owns its own
+  ``numpy.random.Generator`` seeded from ``(plan.seed, crc32(site))``,
+  and fires are decided per *hit* (the site's own call counter). The
+  firing sequence is therefore a pure function of (plan, per-site call
+  sequence) — independent of wall clock, thread timing, and of what
+  any *other* site did. Two runs of the same seeded trace produce the
+  same faults at the same hits: the chaos determinism gate asserts
+  exactly this.
+* **Typed errors.** A fired rule raises :class:`InjectedFault`
+  carrying the site, the hit index and the call context (notably the
+  offending ``uid`` when the caller knows it) — the recovery layers
+  key their policies off this type and attribute blame from it.
+"""
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+from zlib import crc32
+
+import numpy as np
+
+#: the named fault sites wired through the stack. Hooks may fire other
+#: (dotted) site names — a plan simply never matches them — but these
+#: are the ones the chaos harness covers by default.
+SITES = (
+    "engine.prefill",   # ragged put containing prompt tokens
+    "engine.decode",    # ragged put of decode lanes only
+    "restore.ship",     # host->device latent chunk ship (restore lane)
+    "restore.replay",   # QKV replay dispatch consuming a shipped chunk
+    "alloc.blocks",     # KV block allocation
+    "host.latents",     # host latent store absorption
+    "ckpt.write",       # checkpoint state persistence
+    "ckpt.read",        # checkpoint state restoration
+)
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the injector. ``uid`` (when the call context
+    carried one) attributes blame to a single request so the scheduler
+    can quarantine it instead of failing the whole batch."""
+
+    def __init__(self, site: str, kind: str = "injected", hit: int = 0,
+                 ctx: Optional[Dict] = None):
+        self.site = site
+        self.kind = kind
+        self.hit = hit
+        self.ctx = dict(ctx or {})
+        self.uid = self.ctx.get("uid")
+        super().__init__(
+            f"injected fault at {site} (hit #{hit}, kind={kind}, "
+            f"ctx={self.ctx})")
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """When a site fires.
+
+    ``at_hits`` fires deterministically at those 1-based call indices;
+    ``probability`` fires per hit from the site's seeded stream. Both
+    may combine; ``max_faults`` bounds the total fires of this rule
+    (the knob that turns "flaky" into "flaky then heals" — what the
+    retry/backoff path needs to be able to succeed).
+    """
+
+    site: str
+    probability: float = 0.0
+    at_hits: Tuple[int, ...] = ()
+    max_faults: Optional[int] = None
+    kind: str = "injected"
+
+    def to_dict(self) -> Dict:
+        return {"site": self.site, "probability": self.probability,
+                "at_hits": list(self.at_hits),
+                "max_faults": self.max_faults, "kind": self.kind}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FaultRule":
+        return cls(site=d["site"],
+                   probability=float(d.get("probability", 0.0)),
+                   at_hits=tuple(d.get("at_hits", ())),
+                   max_faults=d.get("max_faults"),
+                   kind=d.get("kind", "injected"))
+
+
+@dataclass
+class FaultPlan:
+    """A seeded set of fault rules — the replayable chaos scenario.
+    Serializes to/from plain dicts so a chaos artifact can embed the
+    exact plan it ran."""
+
+    rules: List[FaultRule] = field(default_factory=list)
+    seed: int = 0
+
+    def to_dict(self) -> Dict:
+        return {"seed": self.seed,
+                "rules": [r.to_dict() for r in self.rules]}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FaultPlan":
+        return cls(seed=int(d.get("seed", 0)),
+                   rules=[FaultRule.from_dict(r)
+                          for r in d.get("rules", ())])
+
+
+class FaultInjector:
+    """Evaluates a :class:`FaultPlan` at named sites.
+
+    ``fire(site, **ctx)`` raises :class:`InjectedFault` when a rule
+    decides this hit fails; otherwise it returns (and costs one dict
+    lookup for un-ruled sites). ``enabled`` is False for the planless
+    injector, so hot-path hooks guard with one attribute check.
+    """
+
+    def __init__(self, plan: Optional[FaultPlan] = None):
+        self.plan = plan
+        self._rules: Dict[str, List[FaultRule]] = {}
+        self._rng: Dict[str, np.random.Generator] = {}
+        self.hits: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}
+        self._rule_fired: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        #: optional observer called with the fault *before* it raises
+        #: (the scheduler/metrics layer counts faults through this)
+        self.on_fault = None
+        if plan is not None:
+            for rule in plan.rules:
+                self._rules.setdefault(rule.site, []).append(rule)
+            for site in self._rules:
+                self._rng[site] = np.random.default_rng(
+                    [plan.seed & 0x7FFFFFFF, crc32(site.encode())])
+        self.enabled = bool(self._rules)
+
+    @property
+    def total_fired(self) -> int:
+        return sum(self.fired.values())
+
+    def fire(self, site: str, **ctx) -> None:
+        """Count a hit at ``site``; raise if the plan says it fails."""
+        if not self.enabled:
+            return
+        rules = self._rules.get(site)
+        if not rules:
+            return
+        with self._lock:
+            hit = self.hits.get(site, 0) + 1
+            self.hits[site] = hit
+            fault = None
+            for i, rule in enumerate(rules):
+                key = id(rule)
+                fired = self._rule_fired.get(key, 0)
+                decide = hit in rule.at_hits
+                if not decide and rule.probability > 0.0:
+                    # the draw happens on every hit so the stream stays
+                    # aligned with the hit counter (determinism)
+                    decide = bool(self._rng[site].random() <
+                                  rule.probability)
+                if decide and (rule.max_faults is None or
+                               fired < rule.max_faults):
+                    self._rule_fired[key] = fired + 1
+                    self.fired[site] = self.fired.get(site, 0) + 1
+                    fault = InjectedFault(site, kind=rule.kind, hit=hit,
+                                          ctx=ctx)
+                    break
+        if fault is not None:
+            try:
+                from ..telemetry.tracer import get_tracer
+                get_tracer().instant("resilience.fault", site=site,
+                                     hit=fault.hit, kind=fault.kind,
+                                     uid=fault.uid)
+            except Exception:
+                pass
+            if self.on_fault is not None:
+                self.on_fault(fault)
+            raise fault
+
+    def summary(self) -> Dict:
+        return {"hits": dict(self.hits), "fired": dict(self.fired),
+                "total_fired": self.total_fired}
+
+
+#: planless, permanently-disabled injector — the default the hooks see
+_NULL_INJECTOR = FaultInjector(None)
+_current = _NULL_INJECTOR
+
+
+def get_injector() -> FaultInjector:
+    return _current
+
+
+def install(plan_or_injector) -> FaultInjector:
+    """Install a plan (or prebuilt injector) as the process-wide
+    injector the site hooks consult. Returns the injector."""
+    global _current
+    inj = (plan_or_injector
+           if isinstance(plan_or_injector, FaultInjector)
+           else FaultInjector(plan_or_injector))
+    _current = inj
+    return inj
+
+
+def uninstall() -> None:
+    global _current
+    _current = _NULL_INJECTOR
+
+
+@contextmanager
+def injected(plan_or_injector):
+    """``with injected(plan) as inj:`` — scoped installation; always
+    uninstalls, even when the body raises."""
+    inj = install(plan_or_injector)
+    try:
+        yield inj
+    finally:
+        uninstall()

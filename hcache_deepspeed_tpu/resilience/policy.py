@@ -1,0 +1,35 @@
+"""The one knob bundle the serving scheduler consumes.
+
+Defaults are chosen so a fault-free run is *bit-identical* to the
+pre-resilience scheduler: retries/breaker/watchdog only ever engage on
+a fault or a stalled lane, the ladder is fault-gated, and deadline
+enforcement only affects requests that actually set a deadline.
+"""
+
+from dataclasses import dataclass, field
+
+from .degradation import LadderConfig
+from .retry import RetryPolicy
+
+
+@dataclass
+class ResiliencePolicy:
+    #: restore-lane chunk-ship retry (exponential backoff, seeded jitter)
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    #: restore-path circuit breaker (counts scheduler steps)
+    breaker_threshold: int = 3
+    breaker_window: int = 32
+    breaker_cooldown: int = 12
+    #: steps without chunk progress before an open lane is aborted
+    watchdog_steps: int = 12
+    #: per-request restore failures (retry exhaustion / lane aborts /
+    #: recompute faults) before the request fails typed
+    max_restore_failures: int = 3
+    #: graceful-degradation ladder config
+    ladder: LadderConfig = field(default_factory=LadderConfig)
+    #: fail requests whose absolute deadline has passed (typed
+    #: ``"deadline_exceeded"``); requests without a deadline never fail
+    enforce_deadlines: bool = True
+    #: seed for the retry-jitter stream (kept separate from the fault
+    #: plan's seed so recovery timing and fault timing decorrelate)
+    seed: int = 0

@@ -1,0 +1,159 @@
+"""Recovery policies: bounded retry, circuit breaker, progress watchdog.
+
+All three are *clock-agnostic and deterministic*: backoff delays come
+from a policy + a caller-owned seeded RNG (so a virtual-clock chaos run
+replays bit-identically), the breaker and the watchdog count scheduler
+steps (ticks), not wall seconds — the same discipline that makes the
+serving simulation a pure function of its trace.
+"""
+
+from collections import deque
+from dataclasses import dataclass
+from enum import Enum
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff + seeded jitter.
+
+    ``delay(attempt, rng)`` prices the sleep before retry ``attempt``
+    (1-based): ``base * mult**(attempt-1)`` capped at ``max_s``, plus
+    up to ``jitter_frac`` of that drawn from the caller's RNG — jitter
+    decorrelates retry storms across lanes while staying replayable.
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.005
+    backoff_mult: float = 2.0
+    backoff_max_s: float = 0.25
+    jitter_frac: float = 0.25
+
+    def delay(self, attempt: int,
+              rng: Optional[np.random.Generator] = None) -> float:
+        base = min(self.backoff_base_s *
+                   self.backoff_mult ** max(attempt - 1, 0),
+                   self.backoff_max_s)
+        if rng is not None and self.jitter_frac > 0.0:
+            base *= 1.0 + self.jitter_frac * float(rng.random())
+        return base
+
+
+def call_with_retry(fn, policy: RetryPolicy, clock=None, rng=None,
+                    retryable=(Exception,), on_retry=None):
+    """Run ``fn()`` under ``policy``. Between attempts sleeps
+    ``clock.sleep(delay)`` (no-op without a clock). ``on_retry(exc,
+    attempt, delay)`` observes each retry; the final failure re-raises.
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as exc:
+            attempt += 1
+            if attempt >= policy.max_attempts:
+                raise
+            delay = policy.delay(attempt, rng)
+            if on_retry is not None:
+                on_retry(exc, attempt, delay)
+            if clock is not None:
+                clock.sleep(delay)
+
+
+class BreakerState(Enum):
+    CLOSED = 0      # normal: calls flow
+    OPEN = 1        # tripped: calls blocked until cooldown elapses
+    HALF_OPEN = 2   # cooldown over: one probe allowed through
+
+
+class CircuitBreaker:
+    """Step-counted circuit breaker.
+
+    ``threshold`` failures inside a sliding ``window`` of ticks trip it
+    OPEN; after ``cooldown`` ticks it goes HALF_OPEN and ``allow``
+    admits a single probe — a probe success closes the breaker, a probe
+    failure re-opens it for another cooldown. The serving scheduler
+    keys restore-vs-recompute routing off ``allow``.
+    """
+
+    def __init__(self, threshold: int = 3, window: int = 32,
+                 cooldown: int = 16):
+        self.threshold = max(1, threshold)
+        self.window = max(1, window)
+        self.cooldown = max(1, cooldown)
+        self.state = BreakerState.CLOSED
+        self.trips = 0
+        self._failures = deque()
+        self._opened_at = 0
+        self._probe_out = False
+
+    def allow(self, tick: int) -> bool:
+        if self.state == BreakerState.CLOSED:
+            return True
+        if self.state == BreakerState.OPEN:
+            if tick - self._opened_at >= self.cooldown:
+                self.state = BreakerState.HALF_OPEN
+                self._probe_out = False
+            else:
+                return False
+        # HALF_OPEN: exactly one probe until its verdict arrives
+        if self._probe_out:
+            return False
+        self._probe_out = True
+        return True
+
+    def record_failure(self, tick: int) -> bool:
+        """Returns True when this failure *trips* the breaker."""
+        if self.state == BreakerState.HALF_OPEN:
+            self.state = BreakerState.OPEN
+            self._opened_at = tick
+            self.trips += 1
+            self._failures.clear()
+            return True
+        self._failures.append(tick)
+        while self._failures and tick - self._failures[0] > self.window:
+            self._failures.popleft()
+        if self.state == BreakerState.CLOSED and \
+                len(self._failures) >= self.threshold:
+            self.state = BreakerState.OPEN
+            self._opened_at = tick
+            self.trips += 1
+            self._failures.clear()
+            return True
+        return False
+
+    def record_success(self, tick: int) -> None:
+        if self.state == BreakerState.HALF_OPEN:
+            self.state = BreakerState.CLOSED
+        self._probe_out = False
+        self._failures.clear()
+
+
+class Watchdog:
+    """Stuck-progress detector over keyed work items (restore lanes).
+
+    ``note(key, tick)`` records progress; ``stuck(key, tick)`` is True
+    once ``limit`` ticks pass with no note — the scheduler then aborts
+    the lane and re-enters via recompute (or fails typed).
+    """
+
+    def __init__(self, limit: int = 8):
+        self.limit = max(1, limit)
+        self._last: Dict = {}
+        self.aborts = 0
+
+    def note(self, key, tick: int) -> None:
+        self._last[key] = tick
+
+    def drop(self, key) -> None:
+        self._last.pop(key, None)
+
+    def stuck(self, key, tick: int) -> bool:
+        last = self._last.get(key)
+        if last is None:
+            # first sighting counts as progress (arming the timer)
+            self._last[key] = tick
+            return False
+        return tick - last > self.limit

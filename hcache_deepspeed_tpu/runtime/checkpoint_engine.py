@@ -7,9 +7,20 @@ save). TPU-native: orbax is the serializer; the async engine maps to
 ``AsyncCheckpointer`` (background write threads + a commit barrier),
 giving Nebula's "training continues while the snapshot persists" without a
 service dependency.
+
+Resilience: both engines carry the ``ckpt.write`` / ``ckpt.read`` fault
+sites (fired *before* any bytes move, so a faulted save leaves the
+previous checkpoint untouched and a faulted restore can fall back).
+The commit-barrier ordering makes the write site safe by construction:
+commit actions (meta file, 'latest' pointer) are registered only after
+``save`` returns, so a save that raises can never flip 'latest' at an
+unfinished checkpoint — ``tests/unit/checkpoint/test_ckpt_resilience``
+asserts this under injected faults.
 """
 
 import jax
+
+from ..resilience.faults import get_injector
 
 
 class CheckpointEngine:
@@ -41,6 +52,9 @@ class SyncCheckpointEngine(CheckpointEngine):
 
     def save(self, path, tree):
         import orbax.checkpoint as ocp
+        _inj = get_injector()
+        if _inj.enabled:
+            _inj.fire("ckpt.write", path=str(path))
         ocp.PyTreeCheckpointer().save(path, tree, force=True)
 
     def on_saved(self, fn):
@@ -48,6 +62,9 @@ class SyncCheckpointEngine(CheckpointEngine):
 
     def restore(self, path, template, restore_args):
         import orbax.checkpoint as ocp
+        _inj = get_injector()
+        if _inj.enabled:
+            _inj.fire("ckpt.read", path=str(path))
         return ocp.PyTreeCheckpointer().restore(
             path, item=template, restore_args=restore_args)
 
@@ -67,6 +84,12 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def save(self, path, tree):
         import orbax.checkpoint as ocp
         self.wait()  # previous save + its commit actions first
+        _inj = get_injector()
+        if _inj.enabled:
+            # fired after the barrier (the previous save's commit is
+            # legitimate) but before this save dispatches: a faulted
+            # save registers no commit actions, so 'latest' cannot move
+            _inj.fire("ckpt.write", path=str(path))
         args = jax.tree.map(lambda _: ocp.SaveArgs(), tree)
         self._ckptr.save(path, tree, save_args=args, force=True)
 
@@ -75,6 +98,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
     def restore(self, path, template, restore_args):
         self.wait()
+        _inj = get_injector()
+        if _inj.enabled:
+            _inj.fire("ckpt.read", path=str(path))
         return self._ckptr.restore(path, item=template,
                                    restore_args=restore_args)
 

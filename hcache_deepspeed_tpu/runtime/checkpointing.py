@@ -12,39 +12,106 @@ different mesh/ZeRO-stage/world-size reshards automatically. That single
 property subsumes the reference's 760-line ``zero_to_fp32.py`` merge script
 and most of the universal-checkpoint machinery: the on-disk format is
 already "universal" (param-name-keyed, topology-free).
+
+Hardening (resilience layer):
+
+* **bounded save retry** — transient write failures (the ``ckpt.write``
+  fault site, a flaky filesystem) back off and re-issue up to
+  ``retries`` times before surfacing;
+* **checksum manifest** — per-leaf CRC32s are computed from the live
+  tree at save time and written (``hds_manifest.json``) by the *commit*
+  action, i.e. only once the state is durable — a checkpoint with a
+  manifest is by construction a fully-committed one;
+* **verify-on-restore + fallback** — restored leaves are re-hashed
+  against the manifest; a mismatch (or an unreadable/corrupt manifest,
+  or a restore-time exception) marks the checkpoint corrupt and
+  ``load_checkpoint`` falls back to the next most recent committed
+  checkpoint in the directory instead of crashing the resume.
 """
 
 import json
 import os
+import time
+from typing import Dict, List, Optional
+from zlib import crc32
 
 import jax
+import numpy as np
 
 from ..utils.logging import logger
 
 _META_NAME = "hds_meta.json"
+_MANIFEST_NAME = "hds_manifest.json"
 _STATE_DIR = "state"
 _LATEST = "latest"
+
+
+class CheckpointWriteError(RuntimeError):
+    """Save failed after exhausting the bounded retry budget."""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Restore-side verification failed (checksum/manifest mismatch)."""
 
 
 def _ckpt_path(save_dir, tag):
     return os.path.join(save_dir, str(tag))
 
 
+def _leaf_checksums(tree) -> Dict[str, int]:
+    """Per-leaf CRC32 over the raw bytes, keyed by jax keypath. Leaves
+    that cannot be materialized host-side (non-addressable shards on a
+    multi-host mesh) are skipped — partial coverage still catches the
+    torn-file / bit-rot cases verification exists for."""
+    out: Dict[str, int] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            continue
+        out[key] = crc32(arr.tobytes())
+    return out
+
+
 def save_checkpoint(save_dir, tag, state, meta, save_latest=True,
-                    checkpoint_engine=None):
+                    checkpoint_engine=None, retries: int = 2,
+                    retry_backoff_s: float = 0.05):
     from .checkpoint_engine import SyncCheckpointEngine
     path = os.path.abspath(_ckpt_path(save_dir, tag))
     os.makedirs(path, exist_ok=True)
     # drop None leaves (e.g. master=None in fp32 mode): orbax can't store None
     to_save = {k: v for k, v in state.items() if v is not None}
     engine = checkpoint_engine or SyncCheckpointEngine()
-    engine.save(os.path.join(path, _STATE_DIR), to_save)
+    # checksums come from the live tree BEFORE the save dispatches: an
+    # async engine's source arrays may be updated by training while the
+    # persist runs, but orbax snapshots device->host at save() time, so
+    # this is the value set that lands on disk
+    checksums = _leaf_checksums(to_save)
+    attempt = 0
+    while True:
+        try:
+            engine.save(os.path.join(path, _STATE_DIR), to_save)
+            break
+        except Exception as exc:
+            attempt += 1
+            if attempt > retries:
+                raise CheckpointWriteError(
+                    f"checkpoint save {path} failed after "
+                    f"{attempt} attempts: {exc!r}") from exc
+            logger.warning(
+                f"checkpoint save {path} attempt {attempt} failed "
+                f"({exc!r}); retrying")
+            time.sleep(retry_backoff_s * (2 ** (attempt - 1)))
 
     def commit():
         # only after the state is durable (async: deferred to wait()) may
-        # the meta file and the 'latest' pointer appear — the load-side
-        # missing-meta guard depends on this ordering
+        # the manifest, the meta file and the 'latest' pointer appear —
+        # the load-side corrupt/missing guards depend on this ordering
         if jax.process_index() == 0:
+            with open(os.path.join(path, _MANIFEST_NAME), "w") as fh:
+                json.dump({"algo": "crc32", "leaves": checksums}, fh)
             with open(os.path.join(path, _META_NAME), "w") as fh:
                 json.dump({**meta, "state_keys": sorted(to_save)}, fh)
             if save_latest:
@@ -54,17 +121,58 @@ def save_checkpoint(save_dir, tag, state, meta, save_latest=True,
     engine.on_saved(commit)
 
 
-def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True,
-                    checkpoint_engine=None):
+def verify_restored(path, restored) -> None:
+    """Check ``restored`` against the checkpoint's checksum manifest.
+    Raises :class:`CheckpointCorruptError` on a corrupt/unreadable
+    manifest or any leaf mismatch; a missing manifest (pre-hardening
+    checkpoint) passes with a warning."""
+    manifest_path = os.path.join(path, _MANIFEST_NAME)
+    if not os.path.exists(manifest_path):
+        logger.warning(f"checkpoint {path} has no checksum manifest "
+                       "(pre-hardening save?); skipping verification")
+        return
+    try:
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+        leaves = manifest["leaves"]
+        assert manifest.get("algo") == "crc32"
+    except Exception as exc:
+        raise CheckpointCorruptError(
+            f"unreadable checksum manifest {manifest_path}: "
+            f"{exc!r}") from exc
+    got = _leaf_checksums(restored)
+    bad = [k for k, v in leaves.items() if k in got and got[k] != v]
+    if bad:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed checksum verification for "
+            f"{len(bad)} leaves (first: {bad[0]})")
+
+
+def _candidate_tags(load_dir, primary: Optional[str]) -> List[str]:
+    """Restore candidates: the primary tag first, then every other
+    *committed* checkpoint (meta present) newest-first — the fallback
+    order when verification rejects the primary."""
+    tags = []
+    if primary is not None:
+        tags.append(primary)
+    try:
+        entries = []
+        for name in os.listdir(load_dir):
+            if name == primary:
+                continue
+            meta = os.path.join(load_dir, name, _META_NAME)
+            if os.path.isfile(meta):
+                entries.append((os.path.getmtime(meta), name))
+        for _, name in sorted(entries, reverse=True):
+            tags.append(name)
+    except OSError:
+        pass
+    return tags
+
+
+def _load_one(load_dir, tag, template_state, load_optimizer_states,
+              engine, verify):
     import orbax.checkpoint as ocp
-    from .checkpoint_engine import SyncCheckpointEngine
-    if tag is None:
-        latest = os.path.join(load_dir, _LATEST)
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file in {load_dir}")
-            return None, {}
-        with open(latest) as fh:
-            tag = fh.read().strip()
     path = os.path.abspath(_ckpt_path(load_dir, tag))
     if not os.path.isdir(path):
         logger.warning(f"checkpoint {path} not found")
@@ -84,7 +192,6 @@ def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True,
     saved_keys = set(meta.get("state_keys", template_state.keys()))
     template = {k: v for k, v in template_state.items()
                 if v is not None and k in saved_keys}
-    engine = checkpoint_engine or SyncCheckpointEngine()
     # Restore with the *current* shardings: resharding-on-load gives
     # topology-change resume (the universal checkpoint capability).
     restore_args = jax.tree.map(
@@ -92,8 +199,45 @@ def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True,
         if isinstance(x, jax.Array) else ocp.RestoreArgs(), template)
     restored = engine.restore(
         os.path.join(path, _STATE_DIR), template, restore_args)
+    if verify:
+        verify_restored(path, restored)
     if not load_optimizer_states and "opt" in template_state:
         restored["opt"] = template_state["opt"]
     out = dict(template_state)
     out.update(restored)
     return out, meta
+
+
+def load_checkpoint(load_dir, tag, template_state, load_optimizer_states=True,
+                    checkpoint_engine=None, verify: bool = True,
+                    fallback: bool = True):
+    from .checkpoint_engine import SyncCheckpointEngine
+    if tag is None:
+        latest = os.path.join(load_dir, _LATEST)
+        if not os.path.exists(latest):
+            logger.warning(f"no 'latest' file in {load_dir}")
+            return None, {}
+        with open(latest) as fh:
+            tag = fh.read().strip()
+    engine = checkpoint_engine or SyncCheckpointEngine()
+    tags = _candidate_tags(load_dir, str(tag)) if fallback else [str(tag)]
+    for i, candidate in enumerate(tags):
+        try:
+            out, meta = _load_one(load_dir, candidate, template_state,
+                                  load_optimizer_states, engine, verify)
+        except Exception as exc:
+            logger.warning(
+                f"checkpoint {candidate} failed to restore "
+                f"({exc!r}); "
+                + ("falling back to the previous checkpoint"
+                   if i + 1 < len(tags) else "no fallback left"))
+            continue
+        if out is None:
+            continue
+        if i > 0:
+            logger.warning(
+                f"restored FALLBACK checkpoint {candidate} (primary "
+                f"{tags[0]} was corrupt or unreadable)")
+            meta = dict(meta, fallback_from=tags[0])
+        return out, meta
+    return None, {}

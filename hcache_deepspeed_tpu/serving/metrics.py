@@ -72,12 +72,23 @@ class ServingMetrics:
                          "preemptions": 0, "restores": 0,
                          "recompute_reentries": 0, "restore_chunks": 0,
                          "overlapped_restores": 0, "tokens_out": 0,
-                         "steps": 0, "idle_steps": 0}
+                         "steps": 0, "idle_steps": 0,
+                         # resilience counters (chaos harness asserts
+                         # these against the scheduler's own totals)
+                         "failed": 0, "quarantined": 0,
+                         "faults_injected": 0, "retries": 0,
+                         "breaker_trips": 0, "restore_aborts": 0,
+                         "watchdog_aborts": 0, "shed": 0,
+                         "degraded_steps": 0, "deadline_failures": 0}
         self.rejected: Dict[str, int] = {}
+        #: typed failure causes -> counts (the FAILED-state analog of
+        #: ``rejected``)
+        self.failures: Dict[str, int] = {}
         # last-step gauges
         self.gauges = {"batch_occupancy": 0.0, "kv_utilization": 0.0,
                        "queue_depth": 0.0, "suspended": 0.0,
-                       "restore_overlap_ratio": 0.0}
+                       "restore_overlap_ratio": 0.0,
+                       "degradation_level": 0.0}
 
     # ------------------------------------------------------------- #
     # scheduler hooks
@@ -93,6 +104,20 @@ class ServingMetrics:
         c["recompute_reentries"] += len(report.recomputed)
         c["restore_chunks"] += report.restore_chunks
         c["overlapped_restores"] += report.overlapped_restores
+        c["failed"] += len(report.failed)
+        c["quarantined"] += len(report.quarantined)
+        c["faults_injected"] += report.faults
+        c["retries"] += report.retries
+        c["breaker_trips"] += report.breaker_trips
+        c["restore_aborts"] += report.restore_aborts
+        c["watchdog_aborts"] += report.watchdog_aborts
+        c["shed"] += report.shed
+        if report.degradation_level > 0:
+            c["degraded_steps"] += 1
+        for _, error in report.failed:
+            self.failures[error] = self.failures.get(error, 0) + 1
+            if error == "deadline_exceeded":
+                c["deadline_failures"] += 1
         for _, reason in report.rejected:
             self.rejected[reason] = self.rejected.get(reason, 0) + 1
         engine = scheduler.engine
@@ -105,11 +130,15 @@ class ServingMetrics:
             1.0 - alloc.free_blocks / max(alloc.num_blocks, 1)
         self.gauges["queue_depth"] = float(len(scheduler.queue))
         self.gauges["suspended"] = float(len(scheduler.suspended))
+        self.gauges["degradation_level"] = \
+            float(report.degradation_level)
         if scheduler.total_restores:
             self.gauges["restore_overlap_ratio"] = \
                 scheduler.overlapped_restores / scheduler.total_restores
 
     def on_finish(self, req) -> None:
+        if req.state.name == "FAILED":
+            return           # typed failures counted via report.failed
         if req.reject_reason and req.reject_reason != "cancelled":
             return                      # rejections counted via reports
         key = "cancelled" if req.cancelled else "finished"
@@ -141,6 +170,8 @@ class ServingMetrics:
             out.append((f"serving/{name}", float(value), step))
         for reason, n in sorted(self.rejected.items()):
             out.append((f"serving/rejected/{reason}", float(n), step))
+        for error, n in sorted(self.failures.items()):
+            out.append((f"serving/failed/{error}", float(n), step))
         return out
 
     def emit(self, monitor, step: int) -> None:
@@ -158,5 +189,6 @@ class ServingMetrics:
                 self.preemptions_per_request.summary(),
             "counters": dict(self.counters),
             "rejected": dict(self.rejected),
+            "failures": dict(self.failures),
             "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
         }

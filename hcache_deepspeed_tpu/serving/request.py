@@ -7,6 +7,7 @@ State machine::
                  v          |   -> SUSPENDED -> RESTORING -> DECODE
               (SUSPENDED)   +------------------------------------+
     QUEUED -> REJECTED          (cancel: any live state -> DONE)
+    any live state -> FAILED    (typed hard failure, ``error`` set)
 
 ``SUSPENDED`` means the request's KV left the device — either as exact
 host KV (``suspend_sequence``) or as HCache latents after a flush —
@@ -14,6 +15,15 @@ and ``RESTORING`` covers the step in which the restore dispatch is in
 flight, overlapped with resident decode. Illegal transitions raise, so
 scheduler bugs surface at the exact transition rather than as silently
 wrong accounting.
+
+Two resilience-layer edges exist beyond the happy path: ``PREFILL ->
+QUEUED`` (an engine fault quarantined another request mid-dispatch;
+the untouched admits rewind to the queue) and ``RESTORING ->
+SUSPENDED`` (retry exhaustion / watchdog aborted the restore lane; the
+host payload is still intact, so the request waits for the next
+re-entry). ``FAILED`` is the typed hard-failure terminal: ``error``
+names the cause (``deadline_exceeded``, ``engine_fault:<site>``,
+``restore_failed``, ``server_down``...).
 """
 
 from dataclasses import dataclass, field
@@ -31,20 +41,31 @@ class RequestState(Enum):
     RESTORING = 4
     DONE = 5
     REJECTED = 6
+    FAILED = 7
 
 
-#: legal transitions; DONE/REJECTED are terminal. Cancellation is the
-#: one cross-cutting edge: any live state may close out to DONE.
+#: legal transitions; DONE/REJECTED/FAILED are terminal. Two
+#: cross-cutting edges: cancellation closes any live state to DONE,
+#: and any live state may hard-fail to FAILED (deadline, engine fault,
+#: restore exhaustion, server death).
 _TRANSITIONS = {
     RequestState.QUEUED: {RequestState.PREFILL, RequestState.REJECTED,
-                          RequestState.DONE},
+                          RequestState.DONE, RequestState.FAILED},
+    # PREFILL -> QUEUED: dispatch quarantine rewound an untouched admit
     RequestState.PREFILL: {RequestState.DECODE, RequestState.SUSPENDED,
-                           RequestState.DONE},
-    RequestState.DECODE: {RequestState.SUSPENDED, RequestState.DONE},
-    RequestState.SUSPENDED: {RequestState.RESTORING, RequestState.DONE},
-    RequestState.RESTORING: {RequestState.DECODE, RequestState.DONE},
+                           RequestState.QUEUED, RequestState.DONE,
+                           RequestState.FAILED},
+    RequestState.DECODE: {RequestState.SUSPENDED, RequestState.DONE,
+                          RequestState.FAILED},
+    RequestState.SUSPENDED: {RequestState.RESTORING, RequestState.DONE,
+                             RequestState.FAILED},
+    # RESTORING -> SUSPENDED: lane aborted (retry exhaustion/watchdog)
+    RequestState.RESTORING: {RequestState.DECODE,
+                             RequestState.SUSPENDED, RequestState.DONE,
+                             RequestState.FAILED},
     RequestState.DONE: set(),
     RequestState.REJECTED: set(),
+    RequestState.FAILED: set(),
 }
 
 
@@ -77,6 +98,8 @@ class Request:
     latents: Optional["HostLatentStore"] = None
     #: exact-KV preempt mode: engine keeps host KV under this uid.
     reject_reason: str = ""
+    #: typed hard-failure cause; set exactly when state is FAILED
+    error: str = ""
     cancelled: bool = False
 
     # timeline (clock units of the owning scheduler)
@@ -91,6 +114,10 @@ class Request:
     #: crossover-policy re-entries that re-prefilled instead of
     #: restoring (the recompute side of the analytic model)
     n_recomputes: int = 0
+    #: restore-path failures charged to this request (retry
+    #: exhaustion, lane aborts, faulted recompute re-entries); at the
+    #: policy cap the request hard-fails with ``restore_failed``
+    n_restore_failures: int = 0
 
     def transition(self, new_state: RequestState) -> None:
         if new_state not in _TRANSITIONS[self.state]:
@@ -119,7 +146,8 @@ class Request:
 
     @property
     def finished(self) -> bool:
-        return self.state in (RequestState.DONE, RequestState.REJECTED)
+        return self.state in (RequestState.DONE, RequestState.REJECTED,
+                              RequestState.FAILED)
 
     def absorb_latents(self, new_latents) -> None:
         if new_latents is None:

@@ -35,7 +35,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..inference.scheduling import (BACKPRESSURE_ACTION, BackpressureAction,
-                                    SchedulingResult)
+                                    SchedulingError, SchedulingResult)
+from ..resilience.degradation import DegradationLadder, DegradationLevel
+from ..resilience.policy import ResiliencePolicy
+from ..resilience.retry import CircuitBreaker, Watchdog
 from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock
 from .crossover import RestoreCrossoverModel
@@ -61,6 +64,10 @@ class StepReport:
     recomputed: List[int] = field(default_factory=list)
     finished: List[int] = field(default_factory=list)
     cancelled: List[int] = field(default_factory=list)
+    #: typed hard failures closed this step: (uid, error)
+    failed: List[Tuple[int, str]] = field(default_factory=list)
+    #: subset of ``failed`` closed by the dispatch quarantine
+    quarantined: List[int] = field(default_factory=list)
     decode_lanes: int = 0
     prefill_tokens: int = 0
     restored_tokens: int = 0
@@ -70,13 +77,29 @@ class StepReport:
     #: counted once, in the step its overlap is first observed — the
     #: overlap the HCache story is about)
     overlapped_restores: int = 0
+    # -- resilience accounting --------------------------------------- #
+    #: faults observed this step (injected or real engine exceptions)
+    faults: int = 0
+    #: restore-lane chunk retries issued this step (backoff slept)
+    retries: int = 0
+    #: circuit-breaker trips this step
+    breaker_trips: int = 0
+    #: restore lanes aborted (retry exhaustion or watchdog)
+    restore_aborts: int = 0
+    #: lanes aborted specifically by the stuck-lane watchdog
+    watchdog_aborts: int = 0
+    #: queued requests shed by the degradation ladder
+    shed: int = 0
+    #: degradation ladder level applied to this step's decisions
+    degradation_level: int = 0
 
     @property
     def work_done(self) -> bool:
         return bool(self.admitted or self.restored or self.finished or
                     self.decode_lanes or self.prefill_tokens or
                     self.rejected or self.preempted or self.cancelled or
-                    self.recomputed or self.restore_chunks)
+                    self.recomputed or self.restore_chunks or
+                    self.failed or self.faults or self.restore_aborts)
 
 
 class ContinuousBatchingScheduler:
@@ -93,7 +116,8 @@ class ContinuousBatchingScheduler:
                  sample_fn: Callable[[Request, np.ndarray], int] = None,
                  metrics=None, crossover: RestoreCrossoverModel = None,
                  restore_chunks_per_step: int = 1,
-                 calibrate_every: int = 25):
+                 calibrate_every: int = 25,
+                 resilience: ResiliencePolicy = None):
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.sample_fn = sample_fn or greedy_sample
@@ -133,6 +157,28 @@ class ContinuousBatchingScheduler:
         #: uids whose open lane already earned its (single) overlap
         #: credit — a multi-step lane must not count once per step
         self._overlap_credited = set()
+        # -- resilience machinery ------------------------------------ #
+        #: recovery knobs; defaults are inert on a fault-free trace
+        self.resilience = resilience or ResiliencePolicy()
+        r = self.resilience
+        #: restore-path circuit breaker: repeated restore faults trip
+        #: re-entry over to the crossover recompute path until cooldown
+        self.breaker = CircuitBreaker(threshold=r.breaker_threshold,
+                                      window=r.breaker_window,
+                                      cooldown=r.breaker_cooldown)
+        #: stuck-lane watchdog (no chunk progress in N steps -> abort)
+        self.watchdog = Watchdog(limit=r.watchdog_steps)
+        #: graceful-degradation ladder (shed -> cap -> pause)
+        self.ladder = DegradationLadder(r.ladder)
+        self.degradation = DegradationLevel.NORMAL
+        #: seeded jitter stream for restore-retry backoff
+        self._retry_rng = np.random.default_rng([r.seed & 0x7FFFFFFF,
+                                                 0x5E71])
+        self.total_faults = 0
+        self.total_retries = 0
+        self._fault_sites: Dict[str, int] = {}
+        #: faults since the ladder last observed (consumed per step)
+        self._fault_events = 0
 
     # ------------------------------------------------------------- #
     # intake
@@ -187,10 +233,13 @@ class ContinuousBatchingScheduler:
         report = StepReport(step=self.step_idx, t=now)
         with get_tracer().span("sched.step", sched_step=self.step_idx):
             self._cancellation_pass(report)
+            self._deadline_pass(report, now)
+            self._degradation_pass(report)
             self._restore_pass(report)
             admits = self._admission_pass(report, now)
             admits = self._pressure_pass(admits, report)
             self._dispatch(admits, report, now)
+            self._watchdog_pass(report)
         if self.crossover is not None and \
                 self.step_idx % self.calibrate_every == 0:
             tracer = get_tracer()
@@ -238,6 +287,124 @@ class ContinuousBatchingScheduler:
         get_tracer().async_end("request", req.uid, reject=reason)
         if self.metrics is not None:
             self.metrics.on_finish(req)
+
+    # ------------------------------------------------------------- #
+    # resilience: typed failures, fault accounting, degradation
+    # ------------------------------------------------------------- #
+    def _fail(self, req: Request, error: str, report: StepReport,
+              now: float = None, quarantined: bool = False) -> None:
+        """Close ``req`` in the typed FAILED terminal state."""
+        now = self.clock.now() if now is None else now
+        req.error = error
+        req.transition(RequestState.FAILED)
+        req.finished_at = now
+        self.done[req.uid] = req
+        report.failed.append((req.uid, error))
+        if quarantined:
+            report.quarantined.append(req.uid)
+        self._event("fail", req.uid, error)
+        get_tracer().async_end("request", req.uid, error=error)
+        if self.metrics is not None:
+            self.metrics.on_finish(req)
+
+    def _note_fault(self, exc: BaseException,
+                    report: StepReport) -> None:
+        """Account one fault (injected or a real engine exception)."""
+        self.total_faults += 1
+        self._fault_events += 1
+        report.faults += 1
+        site = getattr(exc, "site", None) or type(exc).__name__
+        self._fault_sites[site] = self._fault_sites.get(site, 0) + 1
+        uid = getattr(exc, "uid", None)
+        self._event("fault", -1 if uid is None else uid, f"site={site}")
+
+    def _safe_flush(self, uid: int) -> None:
+        """Free ``uid``'s engine state if it exists and has no open
+        restore lane — the idempotent cleanup every failure path uses
+        so quarantined/expired requests can never leak KV blocks."""
+        try:
+            if self.engine.state.get_sequence(uid) is None:
+                return
+            if uid in getattr(self.engine, "restoring_uids", ()):
+                return        # lane abort owns that path
+            self.engine.flush(uid)
+        except Exception:
+            pass              # the engine may be the thing that broke
+
+    def fault_summary(self) -> Dict:
+        return {"total_faults": self.total_faults,
+                "by_site": dict(self._fault_sites),
+                "retries": self.total_retries,
+                "breaker_trips": self.breaker.trips,
+                "breaker_state": self.breaker.state.name,
+                "watchdog_aborts": self.watchdog.aborts,
+                "degraded_steps": self.ladder.degraded_steps,
+                "degradation_level": int(self.degradation)}
+
+    def fail_all_live(self, error: str) -> List[int]:
+        """Hard-fail every non-terminal request (server death path).
+        Engine state is NOT touched — the engine is presumed broken;
+        the caller owns whatever cleanup is still possible."""
+        now = self.clock.now()
+        failed = []
+        for req in list(self.queue):
+            self.queue.remove(req)
+            self._fail(req, error, StepReport(self.step_idx, now), now)
+            failed.append(req.uid)
+        for pool in (self.running, self.suspended, self.restoring):
+            for uid in list(pool):
+                req = pool.pop(uid)
+                self._fail(req, error, StepReport(self.step_idx, now),
+                           now)
+                failed.append(uid)
+        return failed
+
+    def _deadline_pass(self, report: StepReport, now: float) -> None:
+        """Enforce per-request absolute deadlines: an expired request
+        hard-fails typed instead of burning capacity. Requests with an
+        open restore lane are skipped (freeing blocks under in-flight
+        replay writes would corrupt the pool) and caught on a later
+        pass once the lane has drained or aborted."""
+        if not self.resilience.enforce_deadlines:
+            return
+
+        def expired(r):
+            return r.deadline is not None and now > r.deadline
+
+        for req in [r for r in self.queue if expired(r)]:
+            self.queue.remove(req)
+            self._fail(req, "deadline_exceeded", report, now)
+        for uid in [u for u, r in self.running.items() if expired(r)]:
+            req = self.running.pop(uid)
+            self._safe_flush(uid)
+            self._fail(req, "deadline_exceeded", report, now)
+        for uid in [u for u, r in self.suspended.items() if expired(r)]:
+            req = self.suspended.pop(uid)
+            if not self.latent_preemption:
+                self._safe_flush(uid)
+            self._fail(req, "deadline_exceeded", report, now)
+
+    def _degradation_pass(self, report: StepReport) -> None:
+        """Feed the ladder last step's fault count + current pressure;
+        apply the SHED action here (CAP/PAUSE apply at admission)."""
+        faults_since = self._fault_events
+        self._fault_events = 0
+        alloc = self.engine.state.allocator
+        kv_util = 1.0 - alloc.free_blocks / max(alloc.num_blocks, 1)
+        self.degradation = self.ladder.observe(
+            self.step_idx, faults_since, kv_util, len(self.queue))
+        report.degradation_level = int(self.degradation)
+        # shed only a real backlog: a queue the batch could absorb next
+        # step is not load worth refusing, even mid-storm
+        backlog = len(self.queue) > \
+            self.engine.config.state_manager.max_ragged_sequence_count
+        if self.degradation >= DegradationLevel.SHED and backlog:
+            victim = min(self.queue,
+                         key=lambda r: (r.priority, -r.arrival_time,
+                                        -r.uid))
+            self.queue.remove(victim)
+            self._reject(victim, "shed_degraded", report)
+            report.shed += 1
 
     def _cancellation_pass(self, report: StepReport) -> None:
         now = self.clock.now()
@@ -330,8 +497,16 @@ class ContinuousBatchingScheduler:
         with get_tracer().span("sched.recompute_issue", uid=req.uid,
                                sched_step=self.step_idx,
                                tokens=len(tokens)):
-            req.latents = None          # the prefill re-captures them
-            logits, latents = self.engine.put([req.uid], [tokens])
+            # the prefill re-captures the latents — but hold the old
+            # payload until the put succeeds: a faulted re-prefill must
+            # not cost the request its only restore payload
+            saved = req.latents
+            req.latents = None
+            try:
+                logits, latents = self.engine.put([req.uid], [tokens])
+            except BaseException:
+                req.latents = saved
+                raise
         req.absorb_latents(latents[0])
         req.n_recomputes += 1
         self.total_recomputes += 1
@@ -349,14 +524,65 @@ class ContinuousBatchingScheduler:
         req.transition(RequestState.DECODE)
         self.running[req.uid] = req
 
+    def _try_recompute(self, req: Request, report: StepReport,
+                       now: float) -> None:
+        """Recompute re-entry with fault containment: a faulted
+        re-prefill sends the request back to SUSPENDED (payload intact)
+        and charges a restore failure, instead of wedging the step."""
+        try:
+            self._recompute_reentry(req, report, now)
+        except SchedulingError:
+            raise
+        except Exception as exc:
+            self._note_fault(exc, report)
+            self._safe_flush(req.uid)
+            self._restore_failure(req, report, now,
+                                  f"recompute_fault:"
+                                  f"{getattr(exc, 'site', 'engine')}")
+        else:
+            self.breaker.record_success(self.step_idx)
+
+    def _restore_failure(self, req: Request, report: StepReport,
+                         now: float, reason: str,
+                         count_breaker: bool = True) -> None:
+        """Common tail of every failed re-entry attempt: breaker
+        accounting, bounded per-request failure budget, then back to
+        SUSPENDED (payload intact) or typed FAILED at the cap. The
+        request is in RESTORING state and in no pool when called."""
+        if count_breaker:
+            if self.breaker.record_failure(self.step_idx):
+                report.breaker_trips += 1
+                self._event("breaker_trip", req.uid, reason)
+        req.n_restore_failures += 1
+        req.suspended_in_step = self.step_idx
+        report.restore_aborts += 1
+        if req.n_restore_failures >= \
+                self.resilience.max_restore_failures:
+            self._fail(req, "restore_failed", report, now)
+            return
+        req.transition(RequestState.SUSPENDED)
+        self.suspended[req.uid] = req
+        self._event("restore_fail", req.uid, reason)
+
     def _restore_pass(self, report: StepReport) -> None:
         now = self.clock.now()
         for req in self._restore_candidates():
+            if not self.breaker.allow(self.step_idx):
+                # breaker OPEN: the restore path is considered broken —
+                # cross over to the recompute re-entry (full re-prefill,
+                # no link bytes) when it fits; otherwise the request
+                # waits out the cooldown suspended
+                if self.latent_preemption and \
+                        self._recompute_feasible(req):
+                    self._event("breaker_recompute", req.uid,
+                                self.breaker.state.name)
+                    self._try_recompute(req, report, now)
+                continue
             if self.latent_preemption and self.crossover is not None \
                     and self.crossover.decide(
                         req.cached_tokens, self._occupancy()) == \
                     "recompute" and self._recompute_feasible(req):
-                self._recompute_reentry(req, report, now)
+                self._try_recompute(req, report, now)
                 continue
             del self.suspended[req.uid]
             req.transition(RequestState.RESTORING)
@@ -372,8 +598,19 @@ class ContinuousBatchingScheduler:
                                    tokens=req.cached_tokens):
                 if self.latent_preemption:
                     tokens = list(req.prompt) + req.tokens_out[:-1]
-                    self.engine.begin_restore([req.uid], [tokens],
-                                              [req.latents])
+                    try:
+                        self.engine.begin_restore([req.uid], [tokens],
+                                                  [req.latents])
+                    except SchedulingError:
+                        raise
+                    except Exception as exc:
+                        self._note_fault(exc, report)
+                        self._safe_flush(req.uid)
+                        self._restore_failure(
+                            req, report, now,
+                            f"begin_fault:"
+                            f"{getattr(exc, 'site', 'engine')}")
+                        continue
                     self.total_restores += 1
                     self.restoring[req.uid] = req
                     self._event("restore_begin", req.uid,
@@ -399,17 +636,95 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------- #
     # restore lanes (decode-interleaved chunk progress)
     # ------------------------------------------------------------- #
+    def _advance_with_retry(self, max_chunks: int,
+                            report: StepReport):
+        """``engine.advance_restores`` under the bounded-retry policy:
+        a faulted chunk ship backs off (exponential + seeded jitter,
+        the clock sleeps so virtual time advances deterministically)
+        and re-issues; exhaustion re-raises to the lane-abort path."""
+        policy = self.resilience.retry
+        attempt = 0
+        while True:
+            try:
+                return self.engine.advance_restores(max_chunks)
+            except SchedulingError:
+                raise
+            except Exception as exc:
+                self._note_fault(exc, report)
+                attempt += 1
+                if attempt >= policy.max_attempts:
+                    raise
+                delay = policy.delay(attempt, self._retry_rng)
+                self.total_retries += 1
+                report.retries += 1
+                uid = getattr(exc, "uid", None)
+                self._event(
+                    "retry", -1 if uid is None else uid,
+                    f"site={getattr(exc, 'site', 'engine')} "
+                    f"attempt={attempt} delay={delay:.5f}")
+                self.clock.sleep(delay)
+
+    def _abort_lane(self, uid: Optional[int], report: StepReport,
+                    reason: str) -> None:
+        """Abort the open restore lane holding ``uid`` (or the oldest
+        lane when blame is unattributable): the engine frees the lane's
+        blocks, its requests go back to SUSPENDED with their host
+        payload intact — or typed FAILED at the failure cap."""
+        now = self.clock.now()
+        if uid is None or uid not in self.restoring:
+            open_uids = [u for u in
+                         getattr(self.engine, "restoring_uids", ())
+                         if u in self.restoring]
+            if not open_uids:
+                return
+            uid = open_uids[0]
+        aborted = self.engine.abort_restore(uid)
+        for u in aborted:
+            req = self.restoring.pop(u, None)
+            self._overlap_credited.discard(u)
+            self.watchdog.drop(u)
+            if req is None:
+                continue
+            self._event("restore_abort", u, reason)
+            self._restore_failure(req, report, now, reason)
+
+    def _watchdog_pass(self, report: StepReport) -> None:
+        """Abort lanes that made no chunk progress in N steps — a
+        stuck ship/replay must not pin KV blocks forever."""
+        if not self.restoring:
+            return
+        for u in list(self.restoring):
+            if u in self.restoring and \
+                    self.watchdog.stuck(u, self.step_idx):
+                self.watchdog.aborts += 1
+                report.watchdog_aborts += 1
+                self._event("watchdog_abort", u,
+                            f"no_progress>{self.watchdog.limit}")
+                self._abort_lane(u, report, "watchdog")
+
     def _advance_restore_lanes(self, report: StepReport,
                                had_decode: bool) -> int:
         """Issue up to ``restore_chunks_per_step`` replay chunks across
         the open lanes; lanes advancing while resident decode was
         dispatched this step earn their (one-time) overlap credit.
-        Completed lanes re-enter the decode set."""
+        Completed lanes re-enter the decode set. Chunk faults retry
+        with backoff; retry exhaustion aborts the lane (breaker
+        accounting included) instead of wedging the step."""
         if not self.restoring:
             return 0
-        chunks, completed, touched = self.engine.advance_restores(
-            self.restore_chunks_per_step)
+        try:
+            chunks, completed, touched = self._advance_with_retry(
+                self.restore_chunks_per_step, report)
+        except SchedulingError:
+            raise
+        except Exception as exc:
+            self._abort_lane(getattr(exc, "uid", None), report,
+                             f"retry_exhausted:"
+                             f"{getattr(exc, 'site', 'engine')}")
+            return 0
         report.restore_chunks += chunks
+        for uid in touched:
+            self.watchdog.note(uid, self.step_idx)
         if had_decode:
             for uid in touched:
                 if uid in self._overlap_credited:
@@ -420,6 +735,8 @@ class ContinuousBatchingScheduler:
         for uid in completed:
             req = self.restoring.pop(uid)
             self._overlap_credited.discard(uid)
+            self.watchdog.drop(uid)
+            self.breaker.record_success(self.step_idx)
             req.n_restores += 1
             report.restored.append(uid)
             report.restored_tokens += req.cached_tokens
@@ -482,6 +799,11 @@ class ContinuousBatchingScheduler:
     def _admission_pass(self, report: StepReport,
                         now: float) -> List[Request]:
         admits: List[Request] = []
+        if self.degradation >= DegradationLevel.PAUSE_ADMISSIONS:
+            if self.queue:
+                self._event("admissions_paused", -1,
+                            f"level={int(self.degradation)}")
+            return admits
         for req in self._admission_order():
             if req.arrival_time > now:
                 continue
@@ -517,6 +839,13 @@ class ContinuousBatchingScheduler:
                     break
                 self._preempt(victims[0], report)
             if action == BackpressureAction.ADMIT:
+                if self.degradation >= DegradationLevel.CAP_TOKENS:
+                    cap = max(1,
+                              self.resilience.ladder.cap_max_new_tokens)
+                    if req.max_new_tokens > cap:
+                        req.max_new_tokens = cap
+                        self._event("degrade_cap", req.uid,
+                                    f"max_new={cap}")
                 admits.append(req)
             elif action == BackpressureAction.SKIP_CANDIDATE:
                 self._event("skip", req.uid, verdict.name)
@@ -611,8 +940,24 @@ class ContinuousBatchingScheduler:
                 lanes=report.decode_lanes,
                 prefill_tokens=report.prefill_tokens,
                 overlapped_restores=report.overlapped_restores) as sp:
-            logits, latents = self.engine.put(
-                [r.uid for r in step_reqs], toks)
+            try:
+                logits, latents = self.engine.put(
+                    [r.uid for r in step_reqs], toks)
+            except SchedulingError:
+                raise           # admission arithmetic bug — surface it
+            except Exception as exc:
+                # engine fault mid-step: quarantine the offender (or,
+                # unattributable, the whole batch), rewind untouched
+                # admits, and keep the loop alive — the step simply did
+                # no token work
+                self._quarantine_dispatch(exc, decodes, admits, report,
+                                          now)
+                report.decode_lanes = 0
+                report.prefill_tokens = 0
+                if self.latent_preemption and self.restoring:
+                    self._advance_restore_lanes(report,
+                                                had_decode=False)
+                return
             if self.latent_preemption and self.restoring:
                 self._advance_restore_lanes(
                     report, had_decode=bool(decodes))
@@ -620,7 +965,21 @@ class ContinuousBatchingScheduler:
                        restore_chunks=report.restore_chunks)
         for j, req in enumerate(step_reqs):
             if self.latent_preemption:
-                req.absorb_latents(latents[j])
+                try:
+                    req.absorb_latents(latents[j])
+                except Exception as exc:
+                    # host latent store fault: without an intact
+                    # payload the request can no longer be preempted
+                    # safely — quarantine it, keep the rest of the
+                    # batch's results
+                    self._note_fault(exc, report)
+                    self.running.pop(req.uid, None)
+                    self._safe_flush(req.uid)
+                    self._fail(req,
+                               f"latent_fault:"
+                               f"{getattr(exc, 'site', 'host')}",
+                               report, now, quarantined=True)
+                    continue
             tok = self.sample_fn(req, logits[j])
             req.tokens_out.append(tok)
             if req.first_token_at is None:
@@ -634,3 +993,37 @@ class ContinuousBatchingScheduler:
                 del self.running[req.uid]
                 self.engine.flush(req.uid)
                 self._close(req, report, now)
+
+    def _quarantine_dispatch(self, exc: BaseException,
+                             decodes: List[Request],
+                             admits: List[Request],
+                             report: StepReport, now: float) -> None:
+        """An engine exception killed this step's ragged put. Blame
+        rides ``exc.uid`` when the engine (or injector) attributed it:
+        that one request hard-fails with its blocks freed; everyone
+        else retries next step. Unattributable exceptions fail the
+        whole dispatched batch — the conservative floor that still
+        keeps the server loop alive for future requests."""
+        self._note_fault(exc, report)
+        uid = getattr(exc, "uid", None)
+        in_batch = {r.uid for r in decodes} | {r.uid for r in admits}
+        offenders = {uid} if uid in in_batch else set(in_batch)
+        site = getattr(exc, "site", None) or type(exc).__name__
+        # rewind untouched admits to the queue head (original order)
+        for req in reversed(admits):
+            if req.uid in report.admitted:
+                report.admitted.remove(req.uid)
+            if req.uid in offenders:
+                continue
+            req.transition(RequestState.QUEUED)
+            req.admitted_at = None
+            self._safe_flush(req.uid)   # alloc pre-pass may have run
+            self.queue.insert(0, req)
+            self._event("rewind", req.uid, f"quarantine site={site}")
+        for req in decodes + admits:
+            if req.uid not in offenders:
+                continue
+            self.running.pop(req.uid, None)
+            self._safe_flush(req.uid)
+            self._fail(req, f"engine_fault:{site}", report, now,
+                       quarantined=True)

@@ -58,7 +58,7 @@ class ServingServer:
     def __init__(self, engine, config: ServerConfig = None, clock=None,
                  metrics: ServingMetrics = None, sample_fn=None,
                  monitor=None, emit_every_steps: int = 50,
-                 crossover=None):
+                 crossover=None, resilience=None):
         self.config = config or ServerConfig()
         self.clock = clock or MonotonicClock()
         self.virtual = isinstance(self.clock, VirtualClock)
@@ -66,7 +66,8 @@ class ServingServer:
         self.scheduler = ContinuousBatchingScheduler(
             engine, clock=self.clock, sample_fn=sample_fn,
             metrics=self.metrics, crossover=crossover,
-            restore_chunks_per_step=self.config.restore_chunks_per_step)
+            restore_chunks_per_step=self.config.restore_chunks_per_step,
+            resilience=resilience)
         self.monitor = monitor
         self.emit_every_steps = emit_every_steps
         self._lock = threading.Lock()
@@ -74,6 +75,13 @@ class ServingServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._next_uid = 0
+        #: the exception that killed the scheduler thread, if any;
+        #: ``wait()`` re-raises it and ``submit()`` rejects while set
+        self.error: Optional[BaseException] = None
+
+    @property
+    def healthy(self) -> bool:
+        return self.error is None
 
     # ------------------------------------------------------------- #
     # ingress
@@ -103,7 +111,9 @@ class ServingServer:
             self._next_uid = max(self._next_uid, request.uid) + 1
             depth = len(self._ingress) + len(self.scheduler.queue)
             reason = ""
-            if depth >= self.config.max_queue_depth:
+            if self.error is not None:
+                reason = "server_down"
+            elif depth >= self.config.max_queue_depth:
                 reason = "queue_full"
             else:
                 bs = self.scheduler.engine.block_size
@@ -192,10 +202,30 @@ class ServingServer:
             if steps > max_steps:
                 raise RuntimeError(
                     f"run_trace exceeded {max_steps} steps — "
-                    "scheduling livelock?")
+                    "scheduling livelock?\n" + self._snapshot())
         if self.monitor is not None:
             self.metrics.emit(self.monitor, self.scheduler.step_idx)
         return self.metrics
+
+    def _snapshot(self, last_events: int = 20) -> str:
+        """Diagnostic scheduler snapshot attached to livelock/crash
+        errors — the state one actually needs to debug a wedge."""
+        s = self.scheduler
+        lanes = list(getattr(s.engine, "restoring_uids", ()))
+        lines = [
+            "scheduler snapshot:",
+            f"  step={s.step_idx} degradation={int(s.degradation)} "
+            f"breaker={s.breaker.state.name}",
+            f"  queue={[r.uid for r in s.queue]}",
+            f"  running={sorted(s.running)}",
+            f"  suspended={sorted(s.suspended)}",
+            f"  restoring={sorted(s.restoring)} open_lanes={lanes}",
+            f"  ingress={[r.uid for r in self._ingress]}",
+            f"  free_blocks={s.engine.state.free_blocks}",
+            f"  last {min(last_events, len(s.events))} events: "
+            f"{s.events[-last_events:]}",
+        ]
+        return "\n".join(lines)
 
     # ------------------------------------------------------------- #
     # thread mode
@@ -213,10 +243,36 @@ class ServingServer:
         self._thread.start()
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            report = self.step()
-            if not report.work_done:
-                self._stop.wait(self.config.idle_sleep_s)
+        try:
+            while not self._stop.is_set():
+                report = self.step()
+                if not report.work_done:
+                    self._stop.wait(self.config.idle_sleep_s)
+        except BaseException as exc:          # noqa: BLE001
+            self._on_loop_error(exc)
+
+    def _on_loop_error(self, exc: BaseException) -> None:
+        """The scheduler thread died: capture the error, fail every
+        in-flight request typed, and flip the server unhealthy so
+        ``submit`` rejects and ``wait`` raises instead of timing out.
+        The engine is presumed broken — no engine calls here."""
+        with self._lock:
+            self.error = exc
+            error = f"server_down: {exc!r}"
+            for req in self._ingress:
+                req.error = error
+                req.transition(RequestState.FAILED)
+                req.finished_at = self.clock.now()
+                self.scheduler.done[req.uid] = req
+            self._ingress.clear()
+            self.scheduler.fail_all_live(error)
+            self.scheduler.events.append(
+                (self.scheduler.step_idx, "server_error", -1,
+                 repr(exc)))
+        from ..telemetry.tracer import get_tracer
+        get_tracer().instant("server.error", error=repr(exc))
+        from ..utils.logging import logger
+        logger.error(f"serving loop died: {exc!r}\n{self._snapshot()}")
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         if self._thread is None:
@@ -225,14 +281,21 @@ class ServingServer:
             deadline = self.clock.now() + timeout
             while (self.scheduler.has_work or self._ingress) and \
                     self.clock.now() < deadline:
+                if not self._thread.is_alive():
+                    break       # nobody is draining; don't spin it out
                 self.clock.sleep(self.config.idle_sleep_s)
         self._stop.set()
         self._thread.join(timeout=timeout)
         self._thread = None
 
     def wait(self, req: Request, timeout: float = 60.0) -> Request:
-        """Block until ``req`` finishes (thread mode helper)."""
+        """Block until ``req`` finishes (thread mode helper). Raises
+        the captured loop error if the server died while waiting."""
         deadline = self.clock.now() + timeout
         while not req.finished and self.clock.now() < deadline:
+            if self.error is not None:
+                raise self.error
             self.clock.sleep(self.config.idle_sleep_s)
+        if not req.finished and self.error is not None:
+            raise self.error
         return req

@@ -20,6 +20,7 @@ import numpy as np
 from ..inference.config import RaggedInferenceEngineConfig
 from ..inference.ragged.kv_cache import StateManager
 from ..inference.scheduling import SchedulingError, SchedulingResult
+from ..resilience.faults import InjectedFault, get_injector
 
 
 class SimulatedEngine:
@@ -124,12 +125,34 @@ class SimulatedEngine:
             if result != SchedulingResult.Success:
                 raise SchedulingError(result)
         self._reject_suspended(batch_uids)
+        inj = get_injector()
+        if inj.enabled and batch_uids:
+            # fire BEFORE any state mutates, so a faulted dispatch can
+            # be retried (or its batch quarantined) without divergence;
+            # blame is deterministically pinned on the newest lane
+            site = ("engine.prefill"
+                    if any(len(t) > 1 for t in batch_tokens)
+                    else "engine.decode")
+            inj.fire(site, uid=batch_uids[-1],
+                     uids=tuple(batch_uids))
+        # allocation pre-pass: every sequence's blocks are claimed
+        # before any forward state advances, so an alloc.blocks fault
+        # leaves seen_tokens untouched everywhere (claimed-but-unused
+        # blocks are reused by the retry — blocks_needed sees them)
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            seq = self.state.get_or_create_sequence(uid)
+            try:
+                self.state.maybe_allocate_kv(seq, len(tokens))
+            except InjectedFault as f:
+                if f.uid is None:
+                    f.uid = uid
+                    f.ctx["uid"] = uid
+                raise
         self.counts["put"] += 1
         logits = np.zeros((len(batch_uids), self.vocab_size), np.float32)
         latents: List = []
         for j, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
-            seq = self.state.get_or_create_sequence(uid)
-            self.state.maybe_allocate_kv(seq, len(tokens))
+            seq = self.state.get_sequence(uid)
             seq.pre_forward(len(tokens))
             seq.post_forward()
             logits[j, self._token(uid, seq.seen_tokens)] = 1.0
@@ -223,11 +246,21 @@ class SimulatedEngine:
             lane = self._restore_lanes[0]
             base = lane["nbytes"] // lane["chunks"]
             n0 = lane["next_chunk"]
+            inj = get_injector()
             while lane["next_chunk"] < lane["chunks"] and \
                     (max_chunks <= 0 or issued < max_chunks):
                 last = lane["next_chunk"] == lane["chunks"] - 1
                 per_chunk = lane["nbytes"] - base * \
                     (lane["chunks"] - 1) if last else base
+                if inj.enabled:
+                    # both lane sites fire before the chunk is counted
+                    # or any state advances: a faulted ship/replay is
+                    # cleanly re-issuable by the retry policy
+                    ctx = dict(uid=lane["uids"][0],
+                               uids=tuple(lane["uids"]),
+                               chunk=lane["next_chunk"])
+                    inj.fire("restore.ship", **ctx)
+                    inj.fire("restore.replay", **ctx)
                 with tracer.span("serve.restore.stage",
                                  layer0=lane["next_chunk"], layers=1,
                                  bytes=per_chunk):
@@ -246,6 +279,23 @@ class SimulatedEngine:
             lane["ticket"]["done"] = True
             self._restore_lanes.pop(0)
         return issued, completed, touched
+
+    def abort_restore(self, uid: int) -> List[int]:
+        """Abort the open lane holding ``uid``: flush every sequence
+        it staged (frees their blocks + tracked slots) and drop the
+        lane. Returns the aborted uids; [] when no lane holds ``uid``.
+        The host latent payload lives with the caller's Request, so an
+        aborted restore can be re-begun or recomputed later."""
+        for i, lane in enumerate(self._restore_lanes):
+            if uid in lane["uids"]:
+                self._restore_lanes.pop(i)
+                for u in lane["uids"]:
+                    self.state.flush_sequence(u)
+                lane["ticket"]["done"] = True
+                lane["ticket"]["aborted"] = True
+                self.counts["abort"] = self.counts.get("abort", 0) + 1
+                return list(lane["uids"])
+        return []
 
     @property
     def pending_restore_chunks(self) -> int:

@@ -1,0 +1,148 @@
+"""Checkpoint hardening: bounded save retry, checksum manifest,
+corrupt-manifest detection, fallback-to-previous restore — for BOTH
+checkpoint engines, including commit-barrier ordering under an
+injected ``ckpt.write`` fault."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.resilience import (FaultPlan, FaultRule,
+                                             injected)
+from hcache_deepspeed_tpu.runtime.checkpoint_engine import (
+    AsyncCheckpointEngine, SyncCheckpointEngine)
+from hcache_deepspeed_tpu.runtime.checkpointing import (
+    CheckpointCorruptError, CheckpointWriteError, load_checkpoint,
+    save_checkpoint, verify_restored)
+
+
+def engines():
+    return [("sync", SyncCheckpointEngine),
+            ("async", AsyncCheckpointEngine)]
+
+
+def make_state(scale=1.0):
+    return {"params": np.arange(16, dtype=np.float32) * scale,
+            "opt": {"mu": np.ones(4, np.float32) * scale}}
+
+
+def template():
+    return {"params": np.zeros(16, np.float32),
+            "opt": {"mu": np.zeros(4, np.float32)}}
+
+
+def save(tmp, tag, state, engine, **kw):
+    save_checkpoint(str(tmp), tag, state, {"tag": tag},
+                    checkpoint_engine=engine, **kw)
+    engine.wait()       # commit barrier (no-op for sync)
+
+
+@pytest.mark.parametrize("name,cls", engines())
+def test_roundtrip_writes_and_verifies_manifest(tmp_path, name, cls):
+    eng = cls()
+    save(tmp_path, "step1", make_state(), eng)
+    manifest = tmp_path / "step1" / "hds_manifest.json"
+    assert manifest.exists()
+    data = json.loads(manifest.read_text())
+    assert data["algo"] == "crc32" and len(data["leaves"]) == 2
+    out, meta = load_checkpoint(str(tmp_path), None, template(),
+                                checkpoint_engine=cls())
+    assert out is not None and meta["tag"] == "step1"
+    assert np.array_equal(out["params"], make_state()["params"])
+    eng.close()
+
+
+@pytest.mark.parametrize("name,cls", engines())
+def test_transient_write_fault_absorbed_by_retry(tmp_path, name, cls):
+    eng = cls()
+    with injected(FaultPlan(rules=[
+            FaultRule("ckpt.write", at_hits=(1,))])):
+        save(tmp_path, "step1", make_state(), eng,
+             retry_backoff_s=0.001)
+    out, _ = load_checkpoint(str(tmp_path), None, template(),
+                             checkpoint_engine=cls())
+    assert out is not None
+    assert np.array_equal(out["params"], make_state()["params"])
+    eng.close()
+
+
+@pytest.mark.parametrize("name,cls", engines())
+def test_write_exhaustion_is_typed_and_commits_nothing(tmp_path, name,
+                                                       cls):
+    eng = cls()
+    save(tmp_path, "step1", make_state(), eng)
+    with injected(FaultPlan(rules=[
+            FaultRule("ckpt.write", at_hits=(1, 2, 3, 4))])):
+        with pytest.raises(CheckpointWriteError):
+            save(tmp_path, "step2", make_state(2.0), eng,
+                 retries=2, retry_backoff_s=0.001)
+    eng.wait()
+    # commit-barrier ordering: the failed save registered no commit
+    # action, so 'latest' still points at step1 and step2 has no meta
+    assert (tmp_path / "latest").read_text() == "step1"
+    assert not (tmp_path / "step2" / "hds_meta.json").exists()
+    out, meta = load_checkpoint(str(tmp_path), None, template(),
+                                checkpoint_engine=cls())
+    assert meta["tag"] == "step1"
+    eng.close()
+
+
+@pytest.mark.parametrize("name,cls", engines())
+def test_corrupt_manifest_falls_back_to_previous(tmp_path, name, cls):
+    eng = cls()
+    save(tmp_path, "step1", make_state(1.0), eng)
+    time.sleep(0.02)     # distinct meta mtimes order the fallback scan
+    save(tmp_path, "step2", make_state(2.0), eng)
+    (tmp_path / "step2" / "hds_manifest.json").write_text("{nope")
+    out, meta = load_checkpoint(str(tmp_path), None, template(),
+                                checkpoint_engine=cls())
+    assert out is not None
+    assert meta["tag"] == "step1" and meta["fallback_from"] == "step2"
+    assert np.array_equal(out["params"], make_state(1.0)["params"])
+    eng.close()
+
+
+def test_checksum_mismatch_detected_and_falls_back(tmp_path):
+    eng = SyncCheckpointEngine()
+    save(tmp_path, "step1", make_state(1.0), eng)
+    time.sleep(0.02)
+    save(tmp_path, "step2", make_state(2.0), eng)
+    # bit-rot: tamper one leaf's recorded checksum
+    manifest = tmp_path / "step2" / "hds_manifest.json"
+    data = json.loads(manifest.read_text())
+    key = sorted(data["leaves"])[0]
+    data["leaves"][key] ^= 0xFFFF
+    manifest.write_text(json.dumps(data))
+    with pytest.raises(CheckpointCorruptError):
+        verify_restored(str(tmp_path / "step2"), make_state(2.0))
+    out, meta = load_checkpoint(str(tmp_path), None, template())
+    assert meta["tag"] == "step1" and meta["fallback_from"] == "step2"
+    # fallback disabled: corrupt primary means no checkpoint at all
+    out2, meta2 = load_checkpoint(str(tmp_path), None, template(),
+                                  fallback=False)
+    assert out2 is None and meta2 == {}
+
+
+def test_read_fault_falls_back_to_previous(tmp_path):
+    eng = SyncCheckpointEngine()
+    save(tmp_path, "step1", make_state(1.0), eng)
+    time.sleep(0.02)
+    save(tmp_path, "step2", make_state(2.0), eng)
+    # first restore attempt (step2) dies at the ckpt.read site; the
+    # fallback (step1) read goes through
+    with injected(FaultPlan(rules=[
+            FaultRule("ckpt.read", at_hits=(1,))])):
+        out, meta = load_checkpoint(str(tmp_path), None, template())
+    assert out is not None and meta["tag"] == "step1"
+    assert np.array_equal(out["params"], make_state(1.0)["params"])
+
+
+def test_missing_manifest_is_legacy_compatible(tmp_path):
+    eng = SyncCheckpointEngine()
+    save(tmp_path, "step1", make_state(), eng)
+    os.remove(tmp_path / "step1" / "hds_manifest.json")
+    out, meta = load_checkpoint(str(tmp_path), None, template())
+    assert out is not None and meta["tag"] == "step1"

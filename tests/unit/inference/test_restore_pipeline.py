@@ -196,3 +196,89 @@ class TestInterleavedParity:
         l_b, _ = eng_b.put([1, 2], [[7], [9]])
         np.testing.assert_array_equal(np.asarray(l_a),
                                       np.asarray(l_b))
+
+
+class TestResilienceHooks:
+    """Real-engine fault sites + abort_restore (the resilience layer's
+    engine surface; the scheduler-level recovery paths are covered on
+    the sim in tests/unit/serving/)."""
+
+    def test_abort_restore_frees_lane_state(self, tiny_model):
+        cfg, params = tiny_model
+        eng = build_engine(cfg, params)
+        rng = np.random.default_rng(11)
+        p0, p1, lat1 = _harvest(cfg, eng, rng)
+        free_before_lane = eng.state.free_blocks
+        eng.begin_restore([1], [p1], [lat1])
+        eng.advance_restores(1)          # partially advanced lane
+        assert eng.restoring_uids == [1]
+        aborted = eng.abort_restore(1)
+        assert aborted == [1]
+        assert eng.restoring_uids == []
+        assert eng.pending_restore_chunks == 0
+        assert eng.state.free_blocks == free_before_lane
+        assert eng.state.get_sequence(1) is None
+        # unknown uid is a no-op
+        assert eng.abort_restore(99) == []
+        # the lane can be re-begun from the same payload and completes
+        eng.restore_kv([1], [p1], [lat1])
+        logits, _ = eng.put([1], [[3]])
+        assert np.asarray(logits).shape[0] == 1
+
+    def test_injected_ship_fault_is_retry_safe(self, tiny_model):
+        """A faulted chunk ship surfaces from advance_restores; simply
+        calling it again resumes from the same chunk and the restored
+        logits equal the fault-free run's (no skipped/doubled chunk)."""
+        from hcache_deepspeed_tpu.resilience import (FaultPlan,
+                                                     FaultRule,
+                                                     InjectedFault,
+                                                     injected)
+        cfg, params = tiny_model
+        rng = np.random.default_rng(12)
+
+        def run(plan):
+            eng = build_engine(cfg, params)
+            p0, p1, lat1 = _harvest(cfg, eng, np.random.default_rng(12))
+            ctx = plan and injected(plan)
+            faults = 0
+            ticket = eng.begin_restore([1], [p1], [lat1])
+            if ctx:
+                ctx.__enter__()
+            try:
+                while not ticket.done:
+                    try:
+                        eng.advance_restores(1)
+                    except InjectedFault:
+                        faults += 1
+            finally:
+                if ctx:
+                    ctx.__exit__(None, None, None)
+            logits, _ = eng.put([1], [[3]])
+            return np.asarray(logits), faults
+
+        clean, n0 = run(None)
+        plan = FaultPlan(rules=[FaultRule("restore.replay",
+                                          at_hits=(2,))])
+        faulted, n1 = run(plan)
+        assert n0 == 0 and n1 == 1
+        np.testing.assert_array_equal(clean, faulted)
+
+    def test_put_fault_site_blames_last_uid(self, tiny_model):
+        from hcache_deepspeed_tpu.resilience import (FaultPlan,
+                                                     FaultRule,
+                                                     InjectedFault,
+                                                     injected)
+        cfg, params = tiny_model
+        eng = build_engine(cfg, params)
+        rng = np.random.default_rng(13)
+        p = list(map(int, rng.integers(0, cfg.vocab_size, 8)))
+        with injected(FaultPlan(rules=[
+                FaultRule("engine.prefill", at_hits=(1,))])):
+            with pytest.raises(InjectedFault) as ei:
+                eng.put([4, 5], [p, p])
+        assert ei.value.uid == 5
+        # the fault fired before any state mutated: both uids untracked
+        assert eng.state.get_sequence(4) is None
+        assert eng.state.get_sequence(5) is None
+        logits, _ = eng.put([4, 5], [p, p])   # clean retry succeeds
+        assert np.asarray(logits).shape[0] == 2

@@ -1,0 +1,61 @@
+"""DegradationLadder: fault-gated escalation with hysteresis."""
+
+from hcache_deepspeed_tpu.resilience.degradation import (
+    DegradationLadder, DegradationLevel, LadderConfig)
+
+
+def cfg(**kw):
+    base = dict(window=10, shed_rate=0.2, cap_rate=0.4, pause_rate=0.8,
+                kv_pressure=0.9, kv_amplify=0.5, calm_steps=2)
+    base.update(kw)
+    return LadderConfig(**base)
+
+
+def test_fault_free_stays_normal_under_any_pressure():
+    lad = DegradationLadder(cfg())
+    for step in range(1, 50):
+        level = lad.observe(step, faults=0, kv_utilization=1.0,
+                            queue_depth=100)
+    assert level == DegradationLevel.NORMAL
+    assert lad.degraded_steps == 0
+
+
+def test_escalation_tracks_fault_rate():
+    lad = DegradationLadder(cfg())
+    # 3 faults in a 10-step window = 0.3 >= shed_rate
+    assert lad.observe(1, 3, 0.0, 0) == DegradationLevel.SHED
+    # another 2 -> 0.5 >= cap_rate
+    assert lad.observe(2, 2, 0.0, 0) == DegradationLevel.CAP_TOKENS
+    # storm -> 0.9 >= pause_rate
+    assert lad.observe(3, 4, 0.0, 0) == \
+        DegradationLevel.PAUSE_ADMISSIONS
+    assert lad.degraded_steps == 3
+
+
+def test_kv_pressure_amplifies_during_storm():
+    # 1 fault / 10 = 0.1 < shed_rate normally...
+    lad = DegradationLadder(cfg())
+    assert lad.observe(1, 1, 0.5, 5) == DegradationLevel.NORMAL
+    # ...but >= shed_rate * 0.5 when the pool is saturated AND backed up
+    lad2 = DegradationLadder(cfg())
+    assert lad2.observe(1, 1, 0.95, 5) == DegradationLevel.SHED
+    # saturation without a queue does not amplify
+    lad3 = DegradationLadder(cfg())
+    assert lad3.observe(1, 1, 0.95, 0) == DegradationLevel.NORMAL
+
+
+def test_deescalation_needs_calm_hysteresis():
+    lad = DegradationLadder(cfg(calm_steps=3))
+    lad.observe(1, 5, 0.0, 0)
+    assert lad.level == DegradationLevel.CAP_TOKENS
+    # faults age out of the window; level steps down one per 3 calm obs
+    step = 1
+    seen = [lad.level]
+    for _ in range(40):
+        step += 1
+        lad.observe(step, 0, 0.0, 0)
+        seen.append(lad.level)
+    assert lad.level == DegradationLevel.NORMAL
+    # monotone non-increasing descent, one level at a time
+    for a, b in zip(seen, seen[1:]):
+        assert b <= a and a - b <= 1

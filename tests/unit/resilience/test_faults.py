@@ -1,0 +1,122 @@
+"""FaultInjector: deterministic seeded firing, zero-cost disabled,
+plan round-trip, scoped installation."""
+
+import pytest
+
+from hcache_deepspeed_tpu.resilience.faults import (
+    SITES, FaultInjector, FaultPlan, FaultRule, InjectedFault,
+    get_injector, injected, install, uninstall)
+
+
+def collect_fires(plan, site, hits):
+    """Drive ``site`` for ``hits`` hits; return the hit indices that
+    fired."""
+    inj = FaultInjector(plan)
+    fired = []
+    for h in range(1, hits + 1):
+        try:
+            inj.fire(site, uid=h)
+        except InjectedFault as f:
+            assert f.site == site and f.hit == h and f.uid == h
+            fired.append(h)
+    return fired
+
+
+def test_disabled_injector_is_noop():
+    inj = FaultInjector(None)
+    assert not inj.enabled
+    for site in SITES:
+        inj.fire(site, uid=1)          # never raises
+    assert inj.hits == {} and inj.fired == {}
+
+
+def test_unruled_site_never_fires():
+    plan = FaultPlan(rules=[FaultRule("engine.decode", at_hits=(1,))])
+    inj = FaultInjector(plan)
+    inj.fire("restore.ship")           # ruled site list excludes this
+    with pytest.raises(InjectedFault):
+        inj.fire("engine.decode")
+
+
+def test_at_hits_fire_exactly_there():
+    plan = FaultPlan(rules=[
+        FaultRule("restore.ship", at_hits=(2, 5))])
+    assert collect_fires(plan, "restore.ship", 8) == [2, 5]
+
+
+def test_max_faults_bounds_firing():
+    plan = FaultPlan(rules=[
+        FaultRule("restore.ship", at_hits=(1, 2, 3, 4), max_faults=2)])
+    assert collect_fires(plan, "restore.ship", 6) == [1, 2]
+
+
+def test_probability_stream_is_seed_deterministic():
+    plan = FaultPlan(seed=42, rules=[
+        FaultRule("engine.decode", probability=0.3)])
+    a = collect_fires(plan, "engine.decode", 200)
+    b = collect_fires(plan, "engine.decode", 200)
+    assert a == b and len(a) > 10      # ~60 expected
+    other = collect_fires(
+        FaultPlan(seed=43, rules=[FaultRule("engine.decode",
+                                            probability=0.3)]),
+        "engine.decode", 200)
+    assert a != other                  # seed actually matters
+
+
+def test_per_site_streams_are_independent():
+    """Interleaving calls to another site must not shift a site's
+    firing pattern — each site owns its own RNG + hit counter."""
+    rules = [FaultRule("engine.decode", probability=0.25),
+             FaultRule("alloc.blocks", probability=0.25)]
+    solo = collect_fires(FaultPlan(seed=7, rules=rules),
+                         "engine.decode", 100)
+    inj = FaultInjector(FaultPlan(seed=7, rules=rules))
+    fired = []
+    for h in range(1, 101):
+        try:                           # noise on the other site
+            inj.fire("alloc.blocks")
+        except InjectedFault:
+            pass
+        try:
+            inj.fire("engine.decode", uid=h)
+        except InjectedFault:
+            fired.append(h)
+    assert fired == solo
+
+
+def test_plan_dict_round_trip():
+    plan = FaultPlan(seed=5, rules=[
+        FaultRule("ckpt.write", at_hits=(1,), max_faults=1,
+                  kind="io"),
+        FaultRule("engine.prefill", probability=0.5)])
+    clone = FaultPlan.from_dict(plan.to_dict())
+    assert clone == plan
+
+
+def test_install_uninstall_and_scoped_context():
+    assert not get_injector().enabled
+    plan = FaultPlan(rules=[FaultRule("engine.decode", at_hits=(1,))])
+    inj = install(plan)
+    try:
+        assert get_injector() is inj and inj.enabled
+    finally:
+        uninstall()
+    assert not get_injector().enabled
+    with pytest.raises(InjectedFault):
+        with injected(plan):
+            get_injector().fire("engine.decode")
+    assert not get_injector().enabled  # uninstalled despite the raise
+
+
+def test_on_fault_observer_and_summary():
+    plan = FaultPlan(rules=[FaultRule("engine.decode", at_hits=(2,))])
+    inj = FaultInjector(plan)
+    seen = []
+    inj.on_fault = seen.append
+    inj.fire("engine.decode")
+    with pytest.raises(InjectedFault):
+        inj.fire("engine.decode")
+    assert len(seen) == 1 and seen[0].hit == 2
+    assert inj.summary() == {"hits": {"engine.decode": 2},
+                             "fired": {"engine.decode": 1},
+                             "total_fired": 1}

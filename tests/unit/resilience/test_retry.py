@@ -1,0 +1,97 @@
+"""RetryPolicy / CircuitBreaker / Watchdog unit behavior."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.resilience.retry import (
+    BreakerState, CircuitBreaker, RetryPolicy, Watchdog,
+    call_with_retry)
+from hcache_deepspeed_tpu.serving import VirtualClock
+
+
+def test_backoff_is_exponential_capped_and_seeded():
+    p = RetryPolicy(max_attempts=5, backoff_base_s=0.01,
+                    backoff_mult=2.0, backoff_max_s=0.03,
+                    jitter_frac=0.0)
+    assert [p.delay(a) for a in (1, 2, 3, 4)] == \
+        [0.01, 0.02, 0.03, 0.03]
+    pj = RetryPolicy(jitter_frac=0.5)
+    a = [pj.delay(1, np.random.default_rng(3)) for _ in range(3)]
+    b = [pj.delay(1, np.random.default_rng(3)) for _ in range(3)]
+    assert a == b                       # same seed, same jitter
+    base = pj.delay(1)
+    assert all(base <= d <= base * 1.5 for d in a)
+
+
+def test_call_with_retry_recovers_and_sleeps():
+    clock = VirtualClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    retries = []
+    out = call_with_retry(
+        flaky, RetryPolicy(max_attempts=4, jitter_frac=0.0),
+        clock=clock, on_retry=lambda e, a, d: retries.append((a, d)))
+    assert out == "ok" and calls["n"] == 3
+    assert [a for a, _ in retries] == [1, 2]
+    assert clock.now() == pytest.approx(sum(d for _, d in retries))
+
+
+def test_call_with_retry_exhaustion_reraises():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        call_with_retry(always, RetryPolicy(max_attempts=3),
+                        clock=VirtualClock())
+
+
+def test_breaker_trip_cooldown_halfopen_cycle():
+    b = CircuitBreaker(threshold=3, window=10, cooldown=5)
+    assert b.allow(1)
+    assert not b.record_failure(1)
+    assert not b.record_failure(2)
+    assert b.record_failure(3)          # third in window trips
+    assert b.state == BreakerState.OPEN and b.trips == 1
+    assert not b.allow(4)               # open: blocked
+    assert b.allow(8)                   # cooldown elapsed: HALF_OPEN
+    assert b.state == BreakerState.HALF_OPEN
+    assert not b.allow(8)               # only one probe outstanding
+    b.record_success(9)
+    assert b.state == BreakerState.CLOSED
+    assert b.allow(10)
+
+
+def test_breaker_probe_failure_reopens():
+    b = CircuitBreaker(threshold=1, window=10, cooldown=3)
+    b.record_failure(1)
+    assert b.state == BreakerState.OPEN
+    assert b.allow(4)                   # probe
+    assert b.record_failure(4)          # probe failed -> re-open
+    assert b.state == BreakerState.OPEN and b.trips == 2
+    assert not b.allow(5)
+
+
+def test_breaker_window_prunes_old_failures():
+    b = CircuitBreaker(threshold=3, window=5, cooldown=5)
+    b.record_failure(1)
+    b.record_failure(2)
+    # ticks 1-2 age out of the 5-tick window by tick 10
+    assert not b.record_failure(10)
+    assert b.state == BreakerState.CLOSED
+
+
+def test_watchdog_stuck_and_progress():
+    w = Watchdog(limit=3)
+    assert not w.stuck("lane", 5)       # first sighting arms it
+    assert not w.stuck("lane", 8)       # == limit: not yet stuck
+    assert w.stuck("lane", 9)           # > limit
+    w.note("lane", 9)
+    assert not w.stuck("lane", 11)
+    w.drop("lane")
+    assert not w.stuck("lane", 100)     # re-armed, not stuck

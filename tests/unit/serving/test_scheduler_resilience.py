@@ -1,0 +1,327 @@
+"""Scheduler recovery paths under injected faults: deadline
+enforcement, dispatch quarantine, restore retry/abort, breaker
+crossover, watchdog, degradation ladder."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.resilience import (DegradationLevel,
+                                             FaultPlan, FaultRule,
+                                             ResiliencePolicy, injected)
+from hcache_deepspeed_tpu.resilience.retry import RetryPolicy
+from hcache_deepspeed_tpu.serving import (Request, RequestState,
+                                          ServerConfig, ServingServer,
+                                          SimulatedEngine, VirtualClock)
+
+
+def sim_engine(num_blocks=32, latents=True, max_seqs=4):
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": max_seqs,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": num_blocks},
+        hcache={"enable_latents": latents}))
+
+
+def make_server(engine=None, **kw):
+    engine = engine or sim_engine()
+    return ServingServer(
+        engine, clock=VirtualClock(),
+        config=ServerConfig(max_queue_depth=256,
+                            kv_demand_fraction=float("inf")), **kw)
+
+
+def drain(srv, max_steps=3000):
+    steps = 0
+    while srv.scheduler.has_work or srv._ingress:
+        srv.step()
+        steps += 1
+        assert steps < max_steps, "drain did not converge"
+
+
+# ------------------------------------------------------------------ #
+# deadline enforcement
+# ------------------------------------------------------------------ #
+def test_queued_request_past_deadline_fails_typed():
+    srv = make_server()
+    late = srv.submit(prompt=list(range(8)), max_new_tokens=4,
+                      deadline=-1.0)       # already expired at t=0
+    ok = srv.submit(prompt=list(range(8)), max_new_tokens=4)
+    drain(srv)
+    assert late.state == RequestState.FAILED
+    assert late.error == "deadline_exceeded"
+    assert ok.state == RequestState.DONE
+    assert srv.metrics.counters["deadline_failures"] == 1
+    assert srv.metrics.failures == {"deadline_exceeded": 1}
+
+
+def test_running_request_deadline_frees_blocks():
+    eng = sim_engine()
+    srv = make_server(eng)
+    free0 = eng.state.free_blocks
+    # long generation whose deadline lands mid-decode
+    r = srv.submit(prompt=list(range(8)), max_new_tokens=64,
+                   deadline=0.01)
+    drain(srv)
+    assert r.state == RequestState.FAILED
+    assert r.error == "deadline_exceeded"
+    assert 0 < len(r.tokens_out) < 64    # it actually ran, then died
+    assert eng.state.free_blocks == free0
+    assert eng.state.n_tracked_sequences == 0
+
+
+def test_no_deadline_means_no_enforcement():
+    srv = make_server()
+    r = srv.submit(prompt=list(range(8)), max_new_tokens=4)
+    drain(srv)
+    assert r.state == RequestState.DONE and r.error == ""
+
+
+# ------------------------------------------------------------------ #
+# dispatch quarantine
+# ------------------------------------------------------------------ #
+def test_engine_fault_quarantines_offender_only():
+    eng = sim_engine()
+    srv = make_server(eng)
+    free0 = eng.state.free_blocks
+    a = srv.submit(prompt=list(range(8)), max_new_tokens=4)
+    srv.step()                           # a resident and decoding
+    # the sim blames the LAST uid in the batch: b's prefill faults
+    plan = FaultPlan(rules=[FaultRule("engine.prefill", at_hits=(1,))])
+    with injected(plan):
+        b = srv.submit(prompt=list(range(8)), max_new_tokens=4)
+        drain(srv)
+    assert b.state == RequestState.FAILED
+    assert b.error.startswith("engine_fault:engine.prefill")
+    assert a.state == RequestState.DONE  # survivor decoded to the end
+    assert len(a.tokens_out) == 4
+    assert eng.state.free_blocks == free0
+    assert srv.metrics.counters["quarantined"] == 1
+    assert srv.metrics.counters["faults_injected"] == 1
+
+
+def test_quarantine_rewinds_untouched_admits():
+    eng = sim_engine()
+    srv = make_server(eng)
+    plan = FaultPlan(rules=[FaultRule("engine.prefill", at_hits=(1,))])
+    with injected(plan):
+        a = srv.submit(prompt=list(range(8)), max_new_tokens=2)
+        b = srv.submit(prompt=list(range(8)), max_new_tokens=2)
+        # both admit into one faulted dispatch; blame lands on b (last
+        # uid), a rewinds to QUEUED and must still complete
+        drain(srv)
+    assert b.state == RequestState.FAILED
+    assert a.state == RequestState.DONE
+    events = [e for e in srv.scheduler.events if e[1] == "rewind"]
+    assert [e[2] for e in events] == [a.uid]
+    assert eng.state.n_tracked_sequences == 0
+
+
+def test_unattributable_engine_error_fails_batch_not_server():
+    eng = sim_engine()
+    srv = make_server(eng)
+    a = srv.submit(prompt=list(range(8)), max_new_tokens=8)
+    srv.step()
+
+    orig = eng.put
+    calls = {"n": 0}
+
+    def flaky_put(*args, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("XlaRuntimeError: device halted")
+        return orig(*args, **kw)
+
+    eng.put = flaky_put
+    srv.step()                           # the faulted decode step
+    assert a.state == RequestState.FAILED
+    assert a.error == "engine_fault:RuntimeError"
+    # the server keeps serving new requests afterwards
+    c = srv.submit(prompt=list(range(8)), max_new_tokens=2)
+    drain(srv)
+    assert c.state == RequestState.DONE
+
+
+# ------------------------------------------------------------------ #
+# restore retry / abort / breaker / watchdog
+# ------------------------------------------------------------------ #
+def preempt_one(srv, eng):
+    """Fill the pool so the next high-priority arrival evicts the
+    low-priority resident; returns (victim, evictor)."""
+    victim = srv.submit(prompt=list(range(32)), max_new_tokens=24,
+                        priority=0)
+    srv.step()
+    assert victim.state == RequestState.DECODE
+    evictor = srv.submit(prompt=list(range(32)), max_new_tokens=4,
+                         priority=5)
+    return victim, evictor
+
+
+def test_restore_chunk_fault_is_retried_with_backoff():
+    eng = sim_engine(num_blocks=9, max_seqs=2)
+    srv = make_server(eng)
+    victim, evictor = preempt_one(srv, eng)
+    plan = FaultPlan(rules=[FaultRule("restore.ship", at_hits=(1,))])
+    with injected(plan):
+        drain(srv)
+    assert victim.state == RequestState.DONE
+    assert evictor.state == RequestState.DONE
+    assert victim.n_preemptions >= 1 and victim.n_restores >= 1
+    c = srv.metrics.counters
+    assert c["retries"] == 1 and c["faults_injected"] == 1
+    assert c["restore_aborts"] == 0
+    retry_events = [e for e in srv.scheduler.events if e[1] == "retry"]
+    assert len(retry_events) == 1
+    # the deterministic token stream survived the faulted restore
+    assert victim.tokens_out == \
+        [eng._token(victim.uid, 32 + i) for i in
+         range(len(victim.tokens_out))]
+
+
+def test_retry_exhaustion_aborts_lane_then_recovers():
+    eng = sim_engine(num_blocks=9, max_seqs=2)
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=2, jitter_frac=0.0),
+        breaker_threshold=10)
+    srv = make_server(eng, resilience=policy)
+    free0 = eng.state.free_blocks
+    victim, evictor = preempt_one(srv, eng)
+    # one exhaustion (2 consecutive ship faults), then healthy
+    plan = FaultPlan(rules=[FaultRule("restore.ship",
+                                      at_hits=(1, 2))])
+    with injected(plan):
+        drain(srv)
+    assert victim.state == RequestState.DONE
+    assert victim.n_restore_failures == 1
+    c = srv.metrics.counters
+    assert c["restore_aborts"] == 1 and c["retries"] == 1
+    aborts = [e for e in srv.scheduler.events
+              if e[1] == "restore_abort"]
+    assert [e[2] for e in aborts] == [victim.uid]
+    assert eng.state.free_blocks == free0
+
+
+def test_persistent_restore_faults_fail_typed_and_leak_nothing():
+    eng = sim_engine(num_blocks=9, max_seqs=2)
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=2, jitter_frac=0.0),
+        max_restore_failures=2, breaker_threshold=100)
+    srv = make_server(eng, resilience=policy)
+    free0 = eng.state.free_blocks
+    victim, evictor = preempt_one(srv, eng)
+    plan = FaultPlan(rules=[FaultRule("restore.ship",
+                                      at_hits=tuple(range(1, 100)))])
+    with injected(plan):
+        drain(srv)
+    assert victim.state == RequestState.FAILED
+    assert victim.error == "restore_failed"
+    assert victim.n_restore_failures == 2
+    assert evictor.state == RequestState.DONE
+    assert eng.state.free_blocks == free0
+    assert eng.state.n_tracked_sequences == 0
+
+
+def test_breaker_trips_to_recompute_reentry():
+    eng = sim_engine(num_blocks=9, max_seqs=2)
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=2, jitter_frac=0.0),
+        breaker_threshold=1, breaker_cooldown=1000,
+        max_restore_failures=100)
+    srv = make_server(eng, resilience=policy)
+    victim, evictor = preempt_one(srv, eng)
+    # first re-entry exhausts retries -> breaker trips -> every later
+    # re-entry must go through the recompute path
+    plan = FaultPlan(rules=[FaultRule("restore.ship",
+                                      at_hits=(1, 2))])
+    with injected(plan):
+        drain(srv)
+    assert victim.state == RequestState.DONE
+    assert srv.scheduler.breaker.trips == 1
+    assert victim.n_recomputes >= 1
+    assert srv.metrics.counters["breaker_trips"] == 1
+    assert srv.metrics.counters["recompute_reentries"] >= 1
+    assert any(e[1] == "breaker_recompute" for e in
+               srv.scheduler.events)
+    # recompute re-entry reproduces the uninterrupted greedy stream
+    assert victim.tokens_out == \
+        [eng._token(victim.uid, 32 + i) for i in
+         range(len(victim.tokens_out))]
+
+
+def test_watchdog_aborts_stalled_lane():
+    eng = sim_engine(num_blocks=9, max_seqs=2)
+    policy = ResiliencePolicy(watchdog_steps=3,
+                              max_restore_failures=100)
+    srv = make_server(eng, resilience=policy)
+    victim, evictor = preempt_one(srv, eng)
+    # wedge the lane: advance_restores reports no progress at all
+    stalled = {"on": True}
+    orig_advance = eng.advance_restores
+
+    def advance(max_chunks=0):
+        if stalled["on"] and eng._restore_lanes:
+            return 0, [], []
+        return orig_advance(max_chunks)
+
+    eng.advance_restores = advance
+    for _ in range(40):
+        srv.step()
+        if srv.metrics.counters["watchdog_aborts"]:
+            break
+    assert srv.metrics.counters["watchdog_aborts"] == 1
+    assert any(e[1] == "watchdog_abort" for e in srv.scheduler.events)
+    assert victim.state == RequestState.SUSPENDED
+    stalled["on"] = False                # lane heals; drain to done
+    drain(srv)
+    assert victim.state == RequestState.DONE
+    assert eng.state.n_tracked_sequences == 0
+
+
+# ------------------------------------------------------------------ #
+# degradation ladder in the scheduler
+# ------------------------------------------------------------------ #
+def test_fault_storm_escalates_and_sheds_backlog():
+    eng = sim_engine(num_blocks=32, max_seqs=2)
+    srv = make_server(eng)
+    # storm: every decode dispatch faults for a while
+    plan = FaultPlan(rules=[
+        FaultRule("engine.decode", at_hits=tuple(range(1, 9))),
+        FaultRule("engine.prefill", at_hits=tuple(range(1, 9)))])
+    rs = []
+    with injected(plan):
+        for i in range(12):
+            rs.append(srv.submit(prompt=list(range(8)),
+                                 max_new_tokens=16, priority=i % 3))
+        for _ in range(30):
+            srv.step()
+    c = srv.metrics.counters
+    assert c["degraded_steps"] > 0
+    assert c["shed"] > 0
+    assert srv.metrics.rejected.get("shed_degraded", 0) == c["shed"]
+    drain(srv)
+    # every request still reached exactly one terminal state
+    assert all(r.finished for r in rs)
+    assert eng.state.n_tracked_sequences == 0
+
+
+def test_fault_free_run_has_inert_resilience():
+    """The whole layer must be invisible without faults/deadlines: the
+    event log of a resilience-default run equals the baseline."""
+    def run():
+        srv = make_server(sim_engine(num_blocks=9, max_seqs=2))
+        rng = np.random.default_rng(0)
+        for i in range(10):
+            srv.submit(prompt=list(rng.integers(0, 64, (16,))),
+                       max_new_tokens=8, priority=int(i % 2) * 5)
+        drain(srv)
+        return srv.scheduler.events, srv.metrics.summary()
+
+    ev1, m1 = run()
+    ev2, m2 = run()
+    assert ev1 == ev2
+    assert m1 == m2
+    assert m1["counters"]["faults_injected"] == 0
+    assert m1["counters"]["failed"] == 0
+    assert m1["counters"]["degraded_steps"] == 0

@@ -1,0 +1,105 @@
+"""Server frontend resilience: thread crash safety, drain-on-dead
+break, livelock diagnostics."""
+
+import time
+
+import pytest
+
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.serving import (Request, RequestState,
+                                          ServerConfig, ServingServer,
+                                          SimulatedEngine, VirtualClock)
+
+
+def sim_engine():
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 128,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": 16}))
+
+
+def thread_server(engine=None):
+    return ServingServer(
+        engine or sim_engine(),
+        config=ServerConfig(idle_sleep_s=0.001,
+                            kv_demand_fraction=float("inf")))
+
+
+def crash_scheduler_after(srv, n_steps, exc):
+    orig = srv.scheduler.step
+    calls = {"n": 0}
+
+    def crashing():
+        calls["n"] += 1
+        if calls["n"] > n_steps:
+            raise exc
+        return orig()
+
+    srv.scheduler.step = crashing
+
+
+def test_loop_crash_fails_inflight_and_flips_unhealthy():
+    srv = thread_server()
+    boom = RuntimeError("scheduler exploded")
+    crash_scheduler_after(srv, 2, boom)
+    srv.start()
+    r = srv.submit(prompt=list(range(64)), max_new_tokens=60)
+    deadline = time.monotonic() + 5.0
+    while srv.healthy and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert not srv.healthy and srv.error is boom
+    # in-flight request failed typed, not hung
+    assert r.state == RequestState.FAILED
+    assert r.error.startswith("server_down:")
+    assert "scheduler exploded" in r.error
+    assert any(e[1] == "server_error" for e in srv.scheduler.events)
+    # wait() surfaces the captured error instead of timing out
+    r2 = Request(uid=999, prompt=[1], arrival_time=0.0)
+    with pytest.raises(RuntimeError, match="scheduler exploded"):
+        srv.wait(r2, timeout=5.0)
+    srv.stop(drain=False)
+
+
+def test_submit_after_death_rejects_server_down():
+    srv = thread_server()
+    crash_scheduler_after(srv, 0, RuntimeError("dead on arrival"))
+    srv.start()
+    deadline = time.monotonic() + 5.0
+    while srv.healthy and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert not srv.healthy
+    r = srv.submit(prompt=list(range(8)), max_new_tokens=2)
+    assert r.state == RequestState.REJECTED
+    assert r.reject_reason == "server_down"
+    srv.stop(drain=False)
+
+
+def test_stop_drain_breaks_out_when_thread_dead():
+    srv = thread_server()
+    crash_scheduler_after(srv, 1, RuntimeError("mid-drain death"))
+    srv.start()
+    srv.submit(prompt=list(range(64)), max_new_tokens=60)
+    deadline = time.monotonic() + 5.0
+    while srv.healthy and time.monotonic() < deadline:
+        time.sleep(0.002)
+    # the dead thread can never drain: stop() must return promptly
+    # instead of spinning the full drain timeout
+    t0 = time.monotonic()
+    srv.stop(drain=True, timeout=30.0)
+    assert time.monotonic() - t0 < 5.0
+    assert srv._thread is None
+
+
+def test_livelock_error_carries_scheduler_snapshot():
+    srv = ServingServer(sim_engine(), clock=VirtualClock())
+    reqs = [Request(uid=0, prompt=list(range(8)), max_new_tokens=50,
+                    arrival_time=0.0)]
+    with pytest.raises(RuntimeError) as ei:
+        srv.run_trace(reqs, max_steps=3)
+    msg = str(ei.value)
+    assert "scheduler snapshot" in msg
+    assert "running=[0]" in msg
+    assert "free_blocks=" in msg
+    assert "events:" in msg
