@@ -54,6 +54,19 @@ class CommsLogger:
             return False
         return self.prof_all or op_name in self.prof_ops
 
+    def log_collective(self, op_name, n_bytes, axes=()):
+        """Byte attribution for a collective issued OUTSIDE the comm
+        facade — the explicit ZeRO reduce-scatter and all-gather bucket
+        sites (``runtime/zero/zeropp.py``: ``zero_reduce_scatter``,
+        ``zero_bucket_reduce_scatter``, ``zero_bucket_all_gather``).
+        Before these sites logged, only the gather/all-reduce paths
+        were fully attributed and ``log_summary`` under-reported the
+        reduce lane's wire volume. Convention: ``n_bytes`` is the
+        per-device collective INPUT buffer (the same convention the
+        facade's ``reduce_scatter``/``all_gather`` wrappers use), so
+        bucketed and per-leaf programs report identical totals."""
+        self.append(op_name, tuple(axes), int(n_bytes))
+
     def append(self, op_name, axes, msg_size):
         if not self.should_log(op_name):
             return

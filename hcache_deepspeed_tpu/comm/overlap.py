@@ -1,0 +1,90 @@
+"""Explicit collective issue/wait helper: structural async on XLA.
+
+Reference analog: the NoOper/HANDLE_DIC event machinery of
+``deepspeed/runtime/domino/transformer.py`` and the
+``dist.all_gather(..., async_op=True)`` handles the stage-3 prefetch
+coordinator waits on.
+
+XLA has no user-facing async collective handle — what a program CAN
+control is *dependence structure*: a collective whose result nothing on
+the critical path consumes yet is legally overlappable by any scheduler,
+and one tied into the chain with ``optimization_barrier`` is forced to
+complete first. This helper makes that choice explicit and auditable:
+
+* ``issue(fn, *args)`` runs the collective-producing ``fn`` NOW (in
+  issue order) and returns a :class:`Ticket`; nothing downstream
+  depends on it until ``wait``.
+* ``wait(ticket)`` hands back the value. With ``overlap=False`` it
+  first fences the value against ``after`` anchors — a real
+  serialization, visible in the HLO def-use graph (the auditor's
+  "sequential collective"), not a no-op flag.
+* ``fence(value, *after)`` ties ``value`` to the completion of
+  ``after`` via ``optimization_barrier`` (the serialization primitive).
+
+``profiling/hlo_audit.py`` is the proof side: issued-and-not-yet-waited
+collectives audit as derived async pairs; fenced ones audit as
+sequential.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+
+from .comms_logging import get_comms_logger
+
+
+@dataclass
+class Ticket:
+    """An issued collective: the (traced) value plus its issue index."""
+    value: Any
+    op_name: str
+    index: int
+
+
+class CollectiveIssue:
+    """Explicit issue/wait scheduler for collectives inside one traced
+    step. ``overlap=False`` turns every ``wait`` into a fence — the
+    ``overlap_comm=False`` serialization fallback."""
+
+    def __init__(self, overlap: bool = True, op_name: str = "collective"):
+        self.overlap = overlap
+        self.op_name = op_name
+        self._issued = 0
+
+    def issue(self, fn: Callable, *args, op_name: str = "") -> Ticket:
+        name = op_name or self.op_name
+        logger = get_comms_logger()
+        if logger.should_log("issue." + name):
+            # trace-time issue marker: records the ISSUE ORDER of
+            # collectives relative to compute, the thing the HLO audit
+            # verifies structurally
+            logger.append("issue." + name, (), 0)
+        ticket = Ticket(value=fn(*args), op_name=name, index=self._issued)
+        self._issued += 1
+        return ticket
+
+    def wait(self, ticket: Ticket, *after):
+        if self.overlap or not after:
+            return ticket.value
+        return self.fence(ticket.value, *after)
+
+    @staticmethod
+    def fence(value, *after):
+        """Make ``value`` depend on the completion of every ``after``
+        (leaves or pytrees) DURING optimization: XLA will not fuse,
+        reorder or CSE across the barrier while compiling. Caveat,
+        measured (see docs/zero_overlap.md): ``optimization_barrier``
+        is ERASED from the final optimized module, so this edge does
+        not survive into the compiled program's def-use graph — a
+        serialization that must be visible to the HLO audit (or to a
+        post-optimization scheduler) has to be STRUCTURAL instead:
+        make the ops that must wait actually consume the collective's
+        result (zeropp's depth-0 in-body consumption, domino's
+        unsplit ``overlap=False`` chain)."""
+        anchors = [x for a in after for x in jax.tree.leaves(a)]
+        if not anchors:
+            return value
+        flat, treedef = jax.tree.flatten(value)
+        fenced = jax.lax.optimization_barrier(tuple(flat) + tuple(anchors))
+        return jax.tree.unflatten(treedef, list(fenced[:len(flat)]))

@@ -1,20 +1,27 @@
-"""Registry of layered-loss specs for the ZeRO++ scan-over-layers gather.
+"""Registry of layered-loss specs for the ZeRO++ scan-over-layers step.
 
 A layered spec decomposes a model's loss into
 ``embed(outer, batch, key, train) -> x``,
 ``block(layer_params, x, batch, key, train) -> x`` (one homogeneous
 transformer block, scanned), and ``head(outer, x, batch) -> loss``, plus
 the tree layout (``layer_prefix``/``n_layer``/``outer_keys``). The
-ZeRO++ micro step (``runtime/zero/zeropp.py``) uses it to gather one
-layer's parameters at a time inside a ``lax.scan`` body instead of the
-whole model up front — the reference's stage-3 live-parameter contract
+ZeRO++ micro step (``runtime/zero/zeropp.py`` ``_build_layered``) builds
+a software-pipelined fwd+bwd from it: layer *i*'s parameters gather as
+one flat bucket at a time — prefetched one layer ahead of the block
+compute when ``overlap_comm`` is on — and the backward re-gathers and
+reduces layer by layer with the same one-ahead lag, so peak gathered
+parameters stay bounded to depth+1 layers + the outer leaves — the
+reference's stage-3 live-parameter contract
 (``deepspeed/runtime/zero/partitioned_param_coordinator.py:285``,
-``max_live_parameters``).
+``max_live_parameters``). See docs/zero_overlap.md.
 
-``zeropp_layered_spec`` returns None whenever the decomposition would
-change semantics (unknown model class, MoE/custom-attention llama, a
-param tree with keys outside the spec's layout — e.g. LoRA-merged
-trees); callers then fall back to the whole-tree gather.
+The decomposition must be exact: the manual backward differentiates
+``block`` per layer, so any cross-layer coupling outside the residual
+stream would silently change gradients. ``zeropp_layered_spec``
+therefore returns None whenever the decomposition would change
+semantics (unknown model class, MoE/custom-attention llama, a param
+tree with keys outside the spec's layout — e.g. LoRA-merged trees);
+callers then fall back to the whole-tree gather.
 """
 
 from typing import Any, Optional

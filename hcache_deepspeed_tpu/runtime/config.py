@@ -58,23 +58,49 @@ class OffloadConfig(HDSConfigModel):
 class ZeroConfig(HDSConfigModel):
     """Reference: runtime/zero/config.py (361 LoC).
 
-    TPU mapping: stage 1/2/3 become sharding choices over the ``data`` mesh
-    axis (optimizer state / +gradients / +params). Bucket sizes map to XLA
-    collective-combining thresholds; overlap_comm is the latency-hiding
-    scheduler (always on); prefetch maps to XLA's async collective start.
+    TPU mapping: stage 1/2/3 become sharding choices over the ``data``
+    mesh axis (optimizer state / +gradients / +params).
+
+    On the explicit ZeRO++ step (any of qwZ/qgZ/hpZ on, layered
+    gather), the overlap knobs control a REAL software pipeline
+    (``runtime/zero/zeropp.py`` + ``runtime/zero/overlap.py`` — see
+    docs/zero_overlap.md), not a compiler hint:
+
+    * ``overlap_comm`` — True: double-buffered gather prefetch + lagged
+      bucketed reduce-scatter (collectives legally overlap block
+      compute, verified on compiled HLO by ``profiling/hlo_audit.py``).
+      False: a fenced, genuinely sequential gather→compute→reduce
+      schedule — the serialization fallback, not a no-op.
+    * ``stage3_prefetch_bucket_size`` — parameters of gather lookahead;
+      0 disables prefetch. The pipeline's prefetch quantum is one
+      layer, so any value >= 1 requests depth 1, subject to the
+      ``stage3_max_live_parameters`` cap (depth+1 layers + the
+      embedding/head leaves must fit; too small to fit ONE layer is
+      rejected at engine build).
+    * ``reduce_bucket_size`` / ``allgather_bucket_size`` — ELEMENTS per
+      flat collective bucket: block cotangents (gradients) coalesce
+      into one reduce-scatter per bucket, parameter shards into one
+      all-gather payload per bucket per dtype. A bucket smaller than
+      the largest sharded leaf is rejected at engine build with an
+      HDSConfigError (no silent clamping).
+
+    On the GSPMD path (no ZeRO++ flags) XLA inserts and schedules the
+    collectives itself and these knobs are accepted for config
+    compatibility only.
     """
     stage: int = 0
-    reduce_bucket_size: int = Field(500_000_000, alias="reduce_bucket_size")
-    allgather_bucket_size: int = 500_000_000
+    reduce_bucket_size: int = Field(500_000_000, gt=0,
+                                    alias="reduce_bucket_size")
+    allgather_bucket_size: int = Field(500_000_000, gt=0)
     overlap_comm: bool = True
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
     offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
     sub_group_size: int = 1_000_000_000
-    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_live_parameters: int = Field(1_000_000_000, gt=0)
     stage3_max_reuse_distance: int = 1_000_000_000
-    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_prefetch_bucket_size: int = Field(50_000_000, ge=0)
     stage3_param_persistence_threshold: int = 100_000
     stage3_gather_16bit_weights_on_model_save: bool = False
     zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition size

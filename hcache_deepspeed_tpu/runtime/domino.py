@@ -28,10 +28,22 @@ Evidence (``tests/unit/runtime/test_domino_hlo.py``), not assertion:
   overlap, no regression. On TPU the combiner is size-thresholded and
   the latency-hiding scheduler emits async start/done pairs; the
   ``tpu``-marked test asserts other-half dots are scheduled inside the
-  start..done window on real hardware.
+  start..done window on real hardware — which ``DOMINO_TPU_r4.log``
+  showed it did NOT (``async_pairs 0``): the r4 relay compiled zero
+  async pairs, the finding that motivated the explicit issue helper.
+
+:func:`domino_split_async` is the explicit form: the layer is given as
+``compute_fn`` + ``collective_fn`` and the half-batch all-reduces are
+routed through :class:`comm.overlap.CollectiveIssue` — issued in
+program order between the halves' compute, auditable with
+``profiling/hlo_audit.py`` (``bench.py --zero-overlap`` re-runs that
+audit and records the numbers in ``ZERO_OVERLAP.jsonl``), and honoring
+``overlap=False`` as a fenced serialization instead of a no-op.
 """
 
 import jax.numpy as jnp
+
+from ..comm.overlap import CollectiveIssue
 
 
 def domino_split(layer_fn, x, *args, **kwargs):
@@ -52,13 +64,67 @@ def domino_split(layer_fn, x, *args, **kwargs):
     return jnp.concatenate([y0, y1], axis=0)
 
 
+def domino_split_async(compute_fn, collective_fn, x, *args,
+                       overlap=True, **kwargs):
+    """Half-batch split with the collective EXPLICITLY issued through
+    :class:`comm.overlap.CollectiveIssue` instead of buried inside an
+    opaque layer function — the reference's hand-scheduled form
+    (``async_linear.py``: matmul, async allreduce handle, other half's
+    matmul, wait).
+
+    ``compute_fn(half, *args, **kwargs)`` is the pre-collective math;
+    ``collective_fn(partial)`` the tensor-axis reduction (e.g.
+    ``lambda t: jax.lax.psum(t, "tensor")``). Issue order is explicit:
+
+        t0 = compute(x0); ISSUE ar0; t1 = compute(x1); ISSUE ar1;
+        WAIT ar0; WAIT ar1
+
+    so ar0 is legally overlappable by x1's compute — which
+    ``profiling/hlo_audit.py`` can verify on the compiled program.
+    With ``overlap=False`` the layer runs UNSPLIT (one full-batch
+    chain, the collective on the critical path) — for a batch-pointwise
+    ``compute_fn`` that is value-identical to split-and-concat, and it
+    is a REAL serialization the audit sees in the final module
+    (``optimization_barrier`` fences are erased by XLA after
+    optimization, so a fenced split would still audit as overlappable).
+    """
+    B = x.shape[0]
+    if B < 2 or not overlap:
+        return collective_fn(compute_fn(x, *args, **kwargs))
+    h = (B + 1) // 2
+    issue = CollectiveIssue(overlap=True,
+                            op_name="domino_half_allreduce")
+    t0 = compute_fn(x[:h], *args, **kwargs)
+    k0 = issue.issue(collective_fn, t0)
+    t1 = compute_fn(x[h:], *args, **kwargs)
+    k1 = issue.issue(collective_fn, t1)
+    return jnp.concatenate([issue.wait(k0), issue.wait(k1)], axis=0)
+
+
 class DominoTransformer:
     """Layer wrapper applying :func:`domino_split` to every call
     (reference: ``DominoTransformerLayer`` — same layer, comm-hiding
-    execution shape)."""
+    execution shape). When the layer is given in split form
+    (``compute_fn`` + ``collective_fn``), the collective is routed
+    through the explicit async-issue helper
+    (:func:`domino_split_async`)."""
 
-    def __init__(self, layer_fn):
+    def __init__(self, layer_fn=None, *, compute_fn=None,
+                 collective_fn=None, overlap=True):
+        if (layer_fn is None) == (compute_fn is None):
+            raise ValueError(
+                "pass either layer_fn (opaque form) or compute_fn + "
+                "collective_fn (explicit async-issue form)")
+        if compute_fn is not None and collective_fn is None:
+            raise ValueError("compute_fn requires collective_fn")
         self.layer_fn = layer_fn
+        self.compute_fn = compute_fn
+        self.collective_fn = collective_fn
+        self.overlap = overlap
 
     def __call__(self, x, *args, **kwargs):
-        return domino_split(self.layer_fn, x, *args, **kwargs)
+        if self.layer_fn is not None:
+            return domino_split(self.layer_fn, x, *args, **kwargs)
+        return domino_split_async(self.compute_fn, self.collective_fn,
+                                  x, *args, overlap=self.overlap,
+                                  **kwargs)

@@ -720,6 +720,7 @@ class HDSEngine:
         return pol
 
     def _build_step_functions(self):
+        self._zero_overlap_plan = None
         if self._onebit is not None:
             return self._build_onebit_step_functions()
         policy = self.policy
@@ -803,17 +804,27 @@ class HDSEngine:
                 from ..models.layered import zeropp_layered_spec
                 layered = zeropp_layered_spec(self.adapter.module,
                                               self.param_specs)
-            micro_fwd_bwd, prepare_secondary = build_zeropp_micro_fn(
-                adapter_loss=self.adapter.loss,
-                mesh=mesh,
-                param_specs=self.param_specs,
-                grad_specs=self.grad_specs,
-                batch_spec_of=lambda leaf: self._batch_sharding(leaf).spec,
-                gas=gas,
-                grad_accum_dtype=self.grad_accum_dtype,
-                remat_policy=remat_policy,
-                zcfg=zcfg,
-                layered=layered)
+            micro_fwd_bwd, prepare_secondary, plan_info = \
+                build_zeropp_micro_fn(
+                    adapter_loss=self.adapter.loss,
+                    mesh=mesh,
+                    param_specs=self.param_specs,
+                    grad_specs=self.grad_specs,
+                    batch_spec_of=lambda leaf:
+                        self._batch_sharding(leaf).spec,
+                    gas=gas,
+                    grad_accum_dtype=self.grad_accum_dtype,
+                    remat_policy=remat_policy,
+                    zcfg=zcfg,
+                    layered=layered,
+                    param_shapes=self.state["params"])
+            self._zero_overlap_plan = plan_info
+            tracer = get_tracer()
+            if tracer.enabled:
+                # structural plan marker: which overlap program this
+                # engine compiled (see docs/zero_overlap.md)
+                tracer.instant("zero.overlap.plan", **{
+                    k: v for k, v in plan_info.items() if v is not None})
 
         self._micro_fwd_bwd = jax.jit(
             micro_fwd_bwd,
@@ -1274,6 +1285,10 @@ class HDSEngine:
             loss = self._train_batch_impl(data_iter, batch)
             sp.set(tokens=self._last_batch_tokens,
                    gas=self.gradient_accumulation_steps)
+            if self._zero_overlap_plan is not None:
+                sp.set(zero_mode=self._zero_overlap_plan["mode"],
+                       zero_prefetch_depth=self._zero_overlap_plan.get(
+                           "depth"))
         if self.wall_clock_breakdown and self._offload is None:
             # fused-path step metrics (the micro-step/offload path
             # emits from step() instead); BATCH_TIMER accumulates, so
@@ -1552,6 +1567,44 @@ class HDSEngine:
         if self._last_grad_norm is None:
             return None
         return float(self._last_grad_norm)
+
+    @property
+    def zero_overlap_plan(self):
+        """The comm/compute overlap plan the ZeRO++ micro step was built
+        against (gather pipeline depth, reduce bucket size), or None on
+        the GSPMD path. See docs/zero_overlap.md."""
+        return self._zero_overlap_plan
+
+    def zero_overlap_report(self, batch):
+        """Compile the ZeRO++ micro fwd+bwd for ``batch`` and audit the
+        optimized HLO for comm/compute overlap structure
+        (``profiling/hlo_audit.py``): native async start/done pairs and
+        the derived (dependence-legal) schedule. Returns
+        ``(AuditReport, row)`` where ``row`` is the JSON-safe summary
+        merged with :attr:`zero_overlap_plan` — the ``ZERO_OVERLAP.jsonl``
+        payload. None on the GSPMD path (no explicit program to audit).
+        Emits a ``zero.overlap.audit`` tracer instant with the span-level
+        gather/reduce overlap ratios."""
+        if not self._zeropp:
+            return None
+        from ..profiling.hlo_audit import audit_compiled
+        shaped = self._shard_batch(batch)
+        compiled = self._micro_fwd_bwd.lower(
+            self.state["params"], self.state["grad_acc"],
+            self.state["loss_scale"], shaped, jax.random.PRNGKey(0),
+            True).compile()
+        report = audit_compiled(compiled)
+        row = dict(self._zero_overlap_plan or {})
+        row.update(report.to_row())
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant(
+                "zero.overlap.audit",
+                native_async_pairs=row["native_async_pairs"],
+                derived_async_pairs=row["derived_async_pairs"],
+                gather_overlap_ratio=row["gather_overlap_ratio"],
+                reduce_overlap_ratio=row["reduce_overlap_ratio"])
+        return report, row
 
     # ------------------------------------------------------------------ #
     # Explicit between-phase state offload (reference: engine.py:3943

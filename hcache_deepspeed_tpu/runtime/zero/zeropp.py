@@ -32,29 +32,33 @@ parameter gather and gradient reduction written explicitly:
   Gradient reduction still spans the full axis (exactly the reference's
   semantics: hpZ trades memory for inter-node gather traffic).
 
-The gather sits *inside* the differentiated function, so its VJP IS the
-gradient reduce-scatter — one mechanism, both directions. A remat policy
-wraps the same function, so backward re-gathers (quantized, intra-group
-when hpZ) rather than keeping full parameters alive, matching the
-reference's re-gather-in-backward behavior.
+On the whole-tree path the gather sits *inside* the differentiated
+function, so its VJP IS the gradient reduce-scatter — one mechanism,
+both directions — with the sharded cotangents coalesced into flat
+IPG-style buckets (``reduce_bucket_size``) instead of one collective
+per leaf.
 
-Gather granularity. With a model that exposes a *layered loss spec*
-(``models/layered.py``) the micro-step runs as a ``lax.scan`` over the
-transformer blocks, gathering layer *i*'s (quantized, hpZ-grouped)
-parameters INSIDE the remat'd scan body — so peak gathered parameter
-memory is one layer plus the embedding/head, not the full model. This is
-the reference's stage-3 memory contract (live params bounded per-module,
-``partitioned_param_coordinator.py:285`` ``max_live_parameters``), scan
-scoping standing in for the gather/release hooks; the backward pass
-re-gathers one layer at a time because the scan body is
-``jax.checkpoint``-ed. Models without a layered spec (or stages < 3)
-fall back to the whole-tree gather, whose peak parameter memory during a
-micro-step is the full model — fine for wire-volume experiments, wrong
-for 7B+ per-chip budgets; set ``zero_optimization.layered_gather``
-(default true) to control the choice explicitly.
+Gather granularity and overlap. With a model that exposes a *layered
+loss spec* (``models/layered.py``) the micro-step is a hand-written
+**software-pipelined** fwd+bwd over the transformer blocks
+(:func:`_build_layered`, docs/zero_overlap.md): layer *i*'s (quantized,
+hpZ-grouped) parameters gather as one flat bucket per dtype
+(``allgather_bucket_size``), prefetched one layer ahead of the block
+compute when ``overlap_comm`` is on, and the backward re-gathers and
+bucket-reduces layer by layer with the same one-ahead lag — so ICI time
+is legally overlappable with compute (verified on the compiled HLO by
+``profiling/hlo_audit.py``) and peak gathered parameter memory is
+depth+1 layers plus the embedding/head, not the full model. This is the
+reference's stage-3 memory contract (live params bounded per-module,
+``partitioned_param_coordinator.py:285`` ``max_live_parameters``) plus
+its prefetch coordinator, as one loop. Models without a layered spec
+(or stages < 3) fall back to the whole-tree gather, whose peak
+parameter memory during a micro-step is the full model — fine for
+wire-volume experiments, wrong for 7B+ per-chip budgets; set
+``zero_optimization.layered_gather`` (default true) to control the
+choice explicitly.
 """
 
-import functools
 from typing import Optional
 
 import jax
@@ -147,29 +151,251 @@ def _quant_reduce_mean_dim(g, dim, *, group_size):
 
 def _psum_scatter_mean_dim(g, dim):
     n = jax.lax.axis_size(DATA_AXIS)
+    _log_plain("zero_reduce_scatter", g.size * g.dtype.itemsize)
     out = jax.lax.psum_scatter(jnp.moveaxis(g, dim, 0), DATA_AXIS,
                                scatter_dimension=0, tiled=True)
     return jnp.moveaxis(out, 0, dim) / n
 
 
-def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
-                      group_size: int = 2048):
-    """Build ``gather(primary, secondary) -> full params`` with a custom
-    VJP that performs the (optionally quantized) gradient reduce-scatter.
+def _log_plain(op, n_bytes):
+    """Byte attribution for the unquantized reduce-scatter / bucketed
+    collective sites (the gather/all-reduce sites were already
+    attributed; see ``CommsLogger.log_collective``)."""
+    logger = get_comms_logger()
+    if logger.should_log(op):
+        logger.log_collective(op, n_bytes, (DATA_AXIS,))
 
-    ``param_dims``: flat list (in ``jax.tree.flatten`` order of the param
-    tree) of the dim index the ``data`` axis shards, or None for
-    replicated leaves. ``secondary`` is a same-order flat list whose
-    entries are None unless hpZ (then: the per-device 1/hpz partition,
-    refreshed by :func:`build_secondary`). Must be called INSIDE the
-    shard_map region.
+
+def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
+                                 group_size):
+    """Reduce-mean the sharded leaves of ``flat`` (full cotangents) onto
+    their data-axis shards — coalesced into flat reduce-scatter buckets
+    of at most ``bucket_elements`` elements (the stage-1/2 IPG-bucket
+    analog: ``deepspeed/runtime/zero/stage3.py``
+    ``__add_grad_to_ipg_bucket``), ONE ``psum_scatter`` per bucket
+    instead of one per leaf.
+
+    Leaves with ``dim`` None (replicated wrt data) pass through
+    untouched; under qgZ every sharded leaf keeps the per-leaf quantized
+    all-to-all (quantization groups are per-leaf — coalescing would
+    change the wire format and the math). Buckets are packed in flat
+    order, per dtype (a flat buffer cannot mix dtypes), so the layout —
+    and therefore the arithmetic — is deterministic: the bucketed
+    reduce is bitwise-identical to the per-leaf reduce, element for
+    element.
     """
+    from .overlap import plan_reduce_buckets
+    n = jax.lax.axis_size(DATA_AXIS)
+    out = list(flat)
+    if qg:
+        for i, (g, d) in enumerate(zip(flat, dims)):
+            if d is not None:
+                out[i] = _quant_reduce_mean_dim(g, d,
+                                                group_size=group_size)
+        return out
+    by_dtype = {}
+    for i, (g, d) in enumerate(zip(flat, dims)):
+        if d is not None:
+            by_dtype.setdefault(jnp.dtype(g.dtype), []).append(i)
+    for dtype, indices in sorted(by_dtype.items(), key=lambda kv: kv[0].name):
+        marks = set(indices)
+        sizes = [int(flat[i].size) if i in marks else None
+                 for i in range(len(flat))]
+        for bucket in plan_reduce_buckets(sizes, bucket_elements):
+            parts, metas = [], []
+            for idx in bucket.leaf_indices:
+                g, d = flat[idx], dims[idx]
+                gm = jnp.moveaxis(g, d, 0)
+                lead = gm.shape[0] // n
+                parts.append(gm.reshape(n, -1))
+                metas.append((idx, (lead,) + gm.shape[1:]))
+            wide = parts[0] if len(parts) == 1 \
+                else jnp.concatenate(parts, axis=1)
+            _log_plain("zero_bucket_reduce_scatter",
+                       wide.size * wide.dtype.itemsize)
+            red = jax.lax.psum_scatter(wide, DATA_AXIS,
+                                       scatter_dimension=0,
+                                       tiled=True)
+            red = red.reshape(-1) / n
+            off = 0
+            for idx, shard_shape in metas:
+                k = int(np.prod(shard_shape))
+                seg = red[off:off + k].reshape(shard_shape)
+                out[idx] = jnp.moveaxis(seg, 0, dims[idx])
+                off += k
+    return out
+
+
+def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
+                              bucket_elements):
+    """ISSUE half of the layer-granular gather: coalesce the sharded
+    leaves of ``flat`` (local shards; the hpZ ``sec`` partition when
+    hpz > 1) into flat all-gather payloads of at most
+    ``bucket_elements`` elements (the ``allgather_bucket_size`` analog)
+    — ONE collective per bucket per dtype (two families under qwZ:
+    int8 payloads + fp32 scales) instead of one per leaf.
+
+    Returns ``(payloads, meta)``: ``payloads`` is a flat list of 1-D
+    arrays — the gathered wire data, exactly what a prefetch pipeline
+    should carry across loop iterations (compressed under qwZ, and 1-D
+    so the loop-carry layout is canonical: consuming a carried payload
+    compiles to the same kernels as consuming a fresh one, which keeps
+    the prefetched and sequential schedules bitwise-identical).
+    ``meta`` is the static unpack plan for
+    :func:`bucketed_all_gather_finish`.
+
+    Besides amortizing collective launch overhead, coalescing makes
+    the overlap audit decidable: a single fused gather either feeds
+    this iteration's compute (sequential) or only the carry
+    (prefetched); per-leaf gathers always leave intra-layer slack (the
+    MLP weights' gather can overlap the attention dots) that would
+    make even the serialized fallback audit as partially overlappable.
+    Replicated leaves (``dim`` None) ride along unmodified."""
+    from .overlap import plan_reduce_buckets
+    n = jax.lax.axis_size(DATA_AXIS)
+    if hpz > 1:
+        groups = [list(range(g * hpz, (g + 1) * hpz))
+                  for g in range(n // hpz)]
+        n_g = hpz
+        # hpZ reads the intra-group secondary partition, not the
+        # primary 1/n shard (wire stays on intra-group links)
+        src = [p if d is None else s
+               for p, s, d in zip(flat, sec, dims)]
+    else:
+        groups, n_g = None, n
+        src = list(flat)
+
+    def pack(items, log_op):
+        # items: [(leaf index, 1-D payload)]; one all-gather per
+        # dtype-bucket; payloads flattened to 1-D for the carry
+        by_dtype = {}
+        for it in items:
+            by_dtype.setdefault(jnp.dtype(it[1].dtype), []).append(it)
+        payloads, plan = [], []
+        for dtype, group in sorted(by_dtype.items(),
+                                   key=lambda kv: kv[0].name):
+            sizes = [int(it[1].size) for it in group]
+            for bucket in plan_reduce_buckets(sizes, bucket_elements):
+                sel = [group[j] for j in bucket.leaf_indices]
+                payload = sel[0][1] if len(sel) == 1 else jnp.concatenate(
+                    [it[1] for it in sel])
+                if log_op:
+                    _log_plain(log_op,
+                               payload.size * payload.dtype.itemsize)
+                wide = jax.lax.all_gather(payload, DATA_AXIS,
+                                          axis_index_groups=groups)
+                payloads.append(wide.reshape(-1))
+                plan.append([(it[0], int(it[1].size)) for it in sel])
+        return payloads, plan
+
+    meta = {"n_g": n_g, "qw": qw, "n_leaves": len(flat),
+            "dims": list(dims),
+            "passthrough": [i for i, d in enumerate(dims) if d is None]}
+    if qw:
+        qitems, sitems, qmeta = [], [], {}
+        for i, (p, d) in enumerate(zip(src, dims)):
+            if d is None:
+                continue
+            gsz = min(group_size, p.size)
+            q, scale, shape, count = quantize(p, group_size=gsz,
+                                              num_bits=8)
+            qmeta[i] = (q.shape, scale.shape, shape, count, d)
+            qitems.append((i, q.reshape(-1)))
+            sitems.append((i, scale.reshape(-1)))
+        if qitems:
+            _log_wire("qwZ_all_gather",
+                      sum(int(q.size) for _, q in qitems),
+                      sum(int(s.size) for _, s in sitems),
+                      jnp.bfloat16,
+                      sum(int(flat[i].size) for i in qmeta))
+        pq, plan_q = pack(qitems, None)
+        ps, plan_s = pack(sitems, None)
+        meta.update(plan_q=plan_q, plan_s=plan_s, qmeta=qmeta,
+                    n_q=len(pq), n_s=len(ps))
+        payloads = pq + ps
+    else:
+        items = [(i, p.reshape(-1))
+                 for i, (p, d) in enumerate(zip(src, dims))
+                 if d is not None]
+        pr, plan_r = pack(items, "zero_bucket_all_gather")
+        meta.update(plan_r=plan_r, n_r=len(pr),
+                    shapes={i: tuple(src[i].shape) for i, _ in items})
+        payloads = pr
+    # replicated leaves ride the payload list unchanged (the consumer
+    # needs the whole layer, not only its sharded leaves)
+    payloads = payloads + [flat[i] for i in meta["passthrough"]]
+    return payloads, meta
+
+
+def bucketed_all_gather_finish(payloads, meta):
+    """CONSUME half of the layer-granular gather: unpack the 1-D wire
+    payloads from :func:`bucketed_all_gather_start` back into full
+    (dequantized under qwZ) leaves. This is where the qwZ dequantize
+    runs — at consumption, so a prefetch pipeline carries int8 wire
+    data, not fp weights."""
+    n_g = meta["n_g"]
+    out = [None] * meta["n_leaves"]
+
+    def unpack(pl, plan):
+        got = {}
+        for wide_flat, entries in zip(pl, plan):
+            wide = wide_flat.reshape(n_g, -1)
+            off = 0
+            for key, size in entries:
+                got[key] = wide[:, off:off + size]
+                off += size
+        return got
+
+    def assemble(per_dev, local_shape, dim):
+        # [n_g, *local] -> concatenate the device axis into ``dim``
+        parts = jnp.moveaxis(per_dev.reshape((n_g,) + tuple(local_shape)),
+                             0, dim)
+        new_shape = (tuple(local_shape[:dim]) + (-1,)
+                     + tuple(local_shape[dim + 1:]))
+        return parts.reshape(new_shape)
+
+    if meta["qw"]:
+        q_all = unpack(payloads[:meta["n_q"]], meta["plan_q"])
+        s_all = unpack(payloads[meta["n_q"]:meta["n_q"] + meta["n_s"]],
+                       meta["plan_s"])
+        n_buckets = meta["n_q"] + meta["n_s"]
+        for i, (qshape, sshape, shape, count, d) in meta["qmeta"].items():
+            qa = q_all[i].reshape((n_g,) + tuple(qshape))
+            sa = s_all[i].reshape((n_g,) + tuple(sshape))
+            deq = jax.vmap(lambda qi, si: dequantize(
+                qi, si, shape, count))(qa, sa)
+            out[i] = assemble(deq.reshape(n_g, -1), shape, d)
+    else:
+        r_all = unpack(payloads[:meta["n_r"]], meta["plan_r"])
+        n_buckets = meta["n_r"]
+        for i, wide in r_all.items():
+            out[i] = assemble(wide, meta["shapes"][i], meta["dims"][i])
+    for j, i in enumerate(meta["passthrough"]):
+        out[i] = payloads[n_buckets + j]
+    return out
+
+
+def bucketed_all_gather(flat, sec, dims, *, qw, hpz, group_size,
+                        bucket_elements):
+    """One-shot layer-granular gather: start + finish back to back
+    (the sequential form). Values are bitwise-identical to the
+    per-leaf gathers — buckets only batch the data movement."""
+    payloads, meta = bucketed_all_gather_start(
+        flat, sec, dims, qw=qw, hpz=hpz, group_size=group_size,
+        bucket_elements=bucket_elements)
+    return bucketed_all_gather_finish(payloads, meta)
+
+
+def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
+    """Per-leaf ``(primary, secondary, dim) -> full`` gather: quantized
+    wire under qwZ, intra-group (ICI-only) under hpZ, identity for
+    replicated leaves. Must run inside the shard_map region."""
 
     def _hpz_groups():
         n = jax.lax.axis_size(DATA_AXIS)
         return [list(range(g * hpz, (g + 1) * hpz)) for g in range(n // hpz)]
 
-    def _gather_leaf(primary, secondary, dim):
+    def gather_leaf(primary, secondary, dim):
         if dim is None:
             return primary  # replicated wrt data
         if hpz > 1:
@@ -181,6 +407,25 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
                                              axis_index_groups=groups)
         return jax.lax.all_gather(src, DATA_AXIS, axis=dim, tiled=True,
                                   axis_index_groups=groups)
+
+    return gather_leaf
+
+
+def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
+                      group_size: int = 2048,
+                      reduce_bucket_elements: int = 500_000_000):
+    """Build ``gather(primary, secondary) -> full params`` with a custom
+    VJP that performs the (optionally quantized) gradient reduce-scatter.
+
+    ``param_dims``: flat list (in ``jax.tree.flatten`` order of the param
+    tree) of the dim index the ``data`` axis shards, or None for
+    replicated leaves. ``secondary`` is a same-order flat list whose
+    entries are None unless hpZ (then: the per-device 1/hpz partition,
+    refreshed by :func:`build_secondary`). Must be called INSIDE the
+    shard_map region.
+    """
+
+    _gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size)
 
     def _reduce_leaf(g, dim):
         n = jax.lax.axis_size(DATA_AXIS)
@@ -204,11 +449,14 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
         # Only leaves whose *parameter* is data-sharded can take the
         # reduce-scatter inside the VJP (the cotangent must match the
         # primal's local-shard shape). Replicated-param leaves pass
-        # through unreduced; reduce_grads() finishes them.
+        # through unreduced; reduce_grads() finishes them. Sharded
+        # leaves coalesce into flat IPG-style buckets — one
+        # reduce-scatter per bucket, not per leaf.
         flat, treedef = jax.tree.flatten(g_full)
         g_primary = jax.tree.unflatten(
-            treedef, [g if d is None else _reduce_leaf(g, d)
-                      for g, d in zip(flat, param_dims)])
+            treedef, bucketed_reduce_scatter_mean(
+                flat, param_dims, bucket_elements=reduce_bucket_elements,
+                qg=qg, group_size=group_size))
         # secondary is a value-copy of primary; its cotangent is defined
         # to be zero (all gradient flows to the primary partition).
         return g_primary, [None] * len(param_dims)
@@ -292,14 +540,21 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
 
 def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
                           batch_spec_of, gas, grad_accum_dtype,
-                          remat_policy, zcfg, layered=None):
+                          remat_policy, zcfg, layered=None,
+                          param_shapes=None):
     """The ZeRO++ micro fwd+bwd: a partial-manual shard_map over ``data``.
 
-    Returns ``(micro_fwd_bwd, prepare_secondary)``. ``micro_fwd_bwd`` has
-    the engine's GSPMD signature plus an optional trailing ``secondary``:
+    Returns ``(micro_fwd_bwd, prepare_secondary, plan_info)``.
+    ``micro_fwd_bwd`` has the engine's GSPMD signature plus an optional
+    trailing ``secondary``:
     ``(params, grad_acc, loss_scale, batch, rng, train, secondary=None) ->
     (unscaled loss, new grad_acc)``, with the parameter gather and
     gradient reduction performed explicitly (quantized per the config).
+    ``plan_info`` describes the comm/compute overlap plan the program was
+    built against (gather pipeline depth, reduce bucket size) for
+    telemetry and the HLO audit. ``param_shapes`` (pytree of shaped
+    leaves congruent with ``param_specs``) enables build-time rejection
+    of nonsensical overlap knobs and the prefetch-depth derivation.
     ``prepare_secondary(params)`` (None unless hpZ) refreshes the hpZ
     secondary partition — call it ONCE per optimizer step and pass the
     result to every micro so the full-axis gather amortizes over the
@@ -310,12 +565,13 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     global spec (projected to the data axis here).
 
     ``layered`` (``models/layered.py`` spec or None) selects the
-    scan-over-layers gather: the forward becomes
-    ``embed → lax.scan(checkpointed block body) → head`` with layer i's
-    gather inside the scan body, bounding peak gathered params to one
-    layer + the outer (embedding/head) leaves — the reference's
-    ``max_live_parameters`` contract. The whole-tree path below is the
-    fallback for models without a spec.
+    software-pipelined scan-over-layers engine (:func:`_build_layered`):
+    ``embed → scan(gather-prefetched block body) → head`` with a
+    hand-written backward whose gather and reduce lanes are explicitly
+    issued against the compute, peak gathered params bounded to
+    depth+1 layers + the outer (embedding/head) leaves — the
+    reference's ``max_live_parameters`` contract. The whole-tree path
+    below is the fallback for models without a spec.
     """
     qw = zcfg.zero_quantized_weights
     qg = zcfg.zero_quantized_gradients
@@ -330,6 +586,22 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
 
     param_dims = _dims(param_specs)
     grad_dims = _dims(grad_specs)
+
+    if param_shapes is not None:
+        # build-time knob sanity against real shapes (no silent clamps)
+        from .overlap import validate_overlap_config
+        paths_sizes = [
+            (jax.tree_util.keystr(path), int(np.prod(leaf.shape)))
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                param_shapes)[0]]
+        sharded = [(name, size)
+                   for (name, size), d in zip(paths_sizes, param_dims)
+                   if d is not None]
+        if sharded:
+            largest_name, largest = max(sharded, key=lambda t: t[1])
+            validate_overlap_config(
+                reduce_bucket_elements=zcfg.reduce_bucket_size,
+                largest_leaf=largest, largest_leaf_name=largest_name)
     params_proj = project_spec_tree(param_specs, DATA_AXIS)
     grads_proj = project_spec_tree(grad_specs, DATA_AXIS)
     flat_pproj = _flat_specs(params_proj)
@@ -339,7 +611,8 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     secondary_proj = [s for s in flat_pproj]
 
     gather, reduce_grads = make_param_gather(
-        param_dims, grad_dims, qw=qw, qg=qg, hpz=hpz)
+        param_dims, grad_dims, qw=qw, qg=qg, hpz=hpz,
+        reduce_bucket_elements=zcfg.reduce_bucket_size)
 
     if layered is not None:
         return _build_layered(
@@ -347,7 +620,8 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
             batch_spec_of=batch_spec_of, gas=gas,
             grad_accum_dtype=grad_accum_dtype, remat_policy=remat_policy,
             qw=qw, qg=qg, hpz=hpz, reduce_grads=reduce_grads,
-            params_proj=params_proj, grads_proj=grads_proj)
+            params_proj=params_proj, grads_proj=grads_proj,
+            zcfg=zcfg, param_shapes=param_shapes)
 
     prepare_secondary = None
     if hpz > 1:
@@ -405,19 +679,76 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
             check_vma=False)
         return shmapped(*args)
 
-    return micro_fwd_bwd, prepare_secondary
+    plan_info = {
+        "mode": "whole-tree", "depth": None,
+        "bucket_elements": zcfg.reduce_bucket_size,
+        "overlap_comm": zcfg.overlap_comm,
+    }
+    return micro_fwd_bwd, prepare_secondary, plan_info
+
+
+#: Diagnostic taps for the layered pipeline: when flipped on (module
+#: level, before the engine builds its step functions), the layered
+#: micro additionally returns {y, y_cot, xs_stack, gfirst, loss} so
+#: bitwise divergences between the prefetched and sequential schedules
+#: can be localized stage by stage (this is how the loop-carry layout
+#: sensitivity of the qwZ gather was found). Never on in production;
+#: the extra outputs change micro_fwd_bwd's signature.
+_ZO_DEBUG = False
 
 
 def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                    grad_accum_dtype, remat_policy, qw, qg, hpz,
-                   reduce_grads, params_proj, grads_proj):
-    """Scan-over-layers ZeRO++ micro step (see build_zeropp_micro_fn)."""
+                   reduce_grads, params_proj, grads_proj, zcfg,
+                   param_shapes=None):
+    """Software-pipelined scan-over-layers ZeRO-3 micro step.
+
+    The fwd+bwd over transformer blocks is written by hand (no
+    ``jax.value_and_grad`` through the layer loop) so the gather and
+    reduce lanes can be *explicitly* scheduled against the compute,
+    instead of trusting the compiler's latency-hiding scheduler —
+    ``DOMINO_TPU_r4.log`` proved XLA may compile ZERO async collective
+    pairs when left to its own devices. Structure, per
+    ``derive_prefetch_depth``:
+
+    * **depth 1** (``overlap_comm=True`` and the knobs admit it):
+      double-buffered. The forward scan carry holds layer *i*'s gathered
+      (qwZ-dequantized, hpZ-grouped) parameters while layer *i+1*'s
+      all-gather is issued BEFORE layer *i*'s block compute consumes the
+      carry. The backward scan mirrors it with TWO lanes: layer *i+1*'s
+      cotangent reduce-scatter buckets and layer *i-1*'s re-gather are
+      both issued before layer *i*'s recompute+VJP — neither is an
+      ancestor nor a descendant of the block compute, so any scheduler
+      may overlap them (and ``profiling/hlo_audit.py`` verifies the
+      compiled program keeps that freedom).
+    * **depth 0** (``overlap_comm=False`` or vetoed): sequential
+      gather→compute→reduce, with the reduce fenced
+      (``optimization_barrier``) into the upstream cotangent chain — a
+      REAL serialization fallback, not a no-op flag.
+
+    Both depths run identical per-layer math in identical order, so they
+    are bitwise-equal on a deterministic backend (asserted in tier-1).
+    Peak gathered parameters stay bounded: depth+1 layers + the outer
+    (embedding/head) leaves — the ``max_live_parameters`` contract.
+    Block cotangents are reduced through
+    :func:`bucketed_reduce_scatter_mean` (``reduce_bucket_size``
+    elements per flat bucket). ``remat_policy`` does not apply here: the
+    manual backward re-gathers and recomputes one block at a time by
+    construction.
+    """
+    from ...comm.overlap import CollectiveIssue
+    from ...utils.logging import log_dist
+    from .overlap import derive_prefetch_depth, validate_overlap_config
+
     split = make_layered_split(layered)
     prefix, n_layer = layered["layer_prefix"], layered["n_layer"]
     outer_keys = list(layered["outer_keys"])
     embed_fn = layered["embed"]
     block_fn = layered["block"]
     head_fn = layered["head"]
+    bucket_elems = zcfg.reduce_bucket_size
+    ag_bucket = zcfg.allgather_bucket_size
+    group_size = 2048
 
     def _subtree_dims(spec_tree):
         flat = jax.tree.flatten(
@@ -436,13 +767,41 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
     # stacked leaves carry the data axis one dim later (leading L dim)
     stacked_pdims = [None if d is None else d + 1 for d in block_pdims]
 
-    # grad dims only matter for reduce_grads, which runs on the FULL
-    # flat tree after the VJP — the per-layer/outer gathers reduce their
-    # own sharded leaves in bwd, so pass param dims as grad dims here.
-    gather_outer, _ = make_param_gather(
-        outer_pdims, outer_pdims, qw=qw, qg=qg, hpz=hpz)
-    gather_block, _ = make_param_gather(
-        block_pdims, block_pdims, qw=qw, qg=qg, hpz=hpz)
+    # ---- overlap plan (depth from the stage-3 knobs + real shapes) ----
+    layer_params = outer_params = 0
+    if param_shapes is not None:
+        layer_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(
+            param_shapes[f"{prefix}0"]))
+        outer_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(
+            {k: param_shapes[k] for k in outer_keys}))
+        largest = max(
+            (int(np.prod(l.shape)) for l, d in zip(
+                jax.tree.leaves(param_shapes), _subtree_dims(
+                    project_spec_tree(param_specs, DATA_AXIS)))
+             if d is not None), default=0)
+        validate_overlap_config(
+            reduce_bucket_elements=bucket_elems,
+            largest_leaf=largest,
+            max_live_parameters=zcfg.stage3_max_live_parameters,
+            layer_params=layer_params, outer_params=outer_params)
+        largest_block = max(
+            (int(np.prod(l.shape)) for l, d in zip(
+                jax.tree.leaves(param_shapes[f"{prefix}0"]),
+                block_pdims) if d is not None), default=0)
+        validate_overlap_config(
+            reduce_bucket_elements=ag_bucket, largest_leaf=largest_block,
+            knob="allgather_bucket_size")
+    plan = derive_prefetch_depth(
+        overlap_comm=zcfg.overlap_comm,
+        prefetch_bucket_size=zcfg.stage3_prefetch_bucket_size,
+        max_live_parameters=zcfg.stage3_max_live_parameters,
+        layer_params=layer_params or 1, outer_params=outer_params)
+    depth = plan.depth if n_layer >= 2 else 0
+    log_dist(f"zero-overlap: layered gather pipeline depth={depth} "
+             f"({plan.reason}); reduce bucket={bucket_elems} elements",
+             ranks=[0])
+
+    gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size)
 
     def build_layered_secondary(params_local):
         outer_local, stacked_local = split(params_local)
@@ -489,43 +848,241 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
             else:
                 sec_outer, sec_stacked = build_layered_secondary(
                     params_local)
+            sec_outer, sec_stacked = list(sec_outer), list(sec_stacked)
 
-            def raw_loss(p_local):
-                outer_local, stacked_local = split(p_local)
-                outer_full = gather_outer(outer_local, list(sec_outer))
-                keys = jax.random.split(rng, n_layer + 1)
-                x = embed_fn(outer_full, batch_local, keys[n_layer],
-                             train)
-                stacked_flat, block_def = jax.tree.flatten(stacked_local)
+            outer_local, stacked_local = split(params_local)
+            outer_flat, outer_def = jax.tree.flatten(outer_local)
+            stacked_flat, block_def = jax.tree.flatten(stacked_local)
+            keys = jax.random.split(rng, n_layer + 1)
 
-                def body(carry, xs):
-                    layer_flat, sec_flat, key = xs
-                    layer_full = gather_block(
-                        jax.tree.unflatten(block_def, layer_flat),
-                        list(sec_flat))
-                    return block_fn(layer_full, carry, batch_local, key,
-                                    train), None
+            # Kernel isolation: every lane (gather, reduce, block
+            # compute, block VJP) is fenced with optimization_barrier
+            # at its boundaries. The barriers are erased after XLA's
+            # optimization passes (zero runtime ops in the final
+            # module) but stop cross-lane fusion DURING them — so the
+            # pipelined and sequential programs compile the same
+            # per-layer kernels with the same accumulation order,
+            # which is what makes depth-1 vs depth-0 bitwise-equal
+            # (the tier-1 parity gate) instead of merely close.
+            iso = jax.lax.optimization_barrier
 
-                # checkpoint the body: backward re-runs (and re-gathers)
-                # one layer at a time instead of stashing L gathered
-                # layers — this IS the memory contract
-                x, _ = jax.lax.scan(
-                    jax.checkpoint(body), x,
-                    (stacked_flat, list(sec_stacked), keys[:n_layer]))
-                return head_fn(outer_full, x, batch_local)
+            def gather_outer_flat(flat, sec):
+                return list(iso(tuple(
+                    gather_leaf(p, s, d)
+                    for p, s, d in zip(flat, sec, outer_pdims))))
 
-            loss_fn = jax.checkpoint(raw_loss, policy=remat_policy) \
-                if remat_policy is not None else raw_loss
+            # Layer gather, split in two: g_start ISSUES the fused
+            # all-gather(s) and returns 1-D wire payloads (int8 +
+            # scales under qwZ) — the unit the pipeline carries across
+            # loop iterations; g_finish unpacks/dequantizes at the
+            # consumption site. Carrying 1-D wire payloads instead of
+            # dequantized weights keeps the loop-carry layout canonical
+            # (carried-vs-fresh operands compile to the same block
+            # kernels -> depth-1 is bitwise-equal to depth-0) and
+            # shrinks the carry 4x under qwZ. Lane boundaries are
+            # fenced with optimization_barrier so both schedules
+            # compile identical per-layer kernels.
+            gmeta = {}
 
-            def scaled_loss(p):
-                return loss_fn(p) * loss_scale / gas
+            def g_start(flat, sec):
+                flat = list(iso(tuple(flat)))
+                live = [s for s in sec if s is not None]
+                if live:
+                    it = iter(iso(tuple(live)))
+                    sec = [None if s is None else next(it) for s in sec]
+                payloads, meta = bucketed_all_gather_start(
+                    flat, sec, block_pdims, qw=qw, hpz=hpz,
+                    group_size=group_size, bucket_elements=ag_bucket)
+                gmeta.setdefault("m", meta)
+                return list(iso(tuple(payloads)))
 
-            loss_s, grads = jax.value_and_grad(scaled_loss)(params_local)
+            def g_finish(payloads):
+                return list(iso(tuple(bucketed_all_gather_finish(
+                    list(payloads), gmeta["m"]))))
+
+            def reduce_cots(flat_cots):
+                return list(iso(tuple(bucketed_reduce_scatter_mean(
+                    flat_cots, block_pdims, bucket_elements=bucket_elems,
+                    qg=qg, group_size=group_size))))
+
+            def take(idx):
+                return ([leaf[idx] for leaf in stacked_flat],
+                        [None if s is None else s[idx]
+                         for s in sec_stacked])
+
+            def blk(full_flat, x, key):
+                full_flat, x = iso((tuple(full_flat), x))
+                return iso(block_fn(
+                    jax.tree.unflatten(block_def, list(full_flat)),
+                    x, batch_local, key, train))
+
+            def blk_vjp(full_flat, x_in, x_cot, key):
+                full_flat, x_in, x_cot = iso(
+                    (tuple(full_flat), x_in, x_cot))
+                _, vjp_t = jax.vjp(
+                    lambda f, xx: block_fn(
+                        jax.tree.unflatten(block_def, list(f)),
+                        xx, batch_local, key, train),
+                    full_flat, x_in)
+                cot, x_cot_out = vjp_t(x_cot)
+                cot, x_cot_out = iso((cot, x_cot_out))
+                return list(cot), x_cot_out
+
+            # ---------------- forward ----------------
+            outer_full = jax.tree.unflatten(
+                outer_def, gather_outer_flat(outer_flat, sec_outer))
+            x = iso(embed_fn(outer_full, batch_local, keys[n_layer],
+                             train))
+
+            _dbg_gfirst = None
+            if depth >= 1:
+                # trip-L rolled pipeline: iteration t computes layer t
+                # from the carry while issuing layer (t+1) mod L's
+                # gather into the carry. The final iteration re-gathers
+                # layer 0 (discarded) — one redundant gather per micro
+                # buys a uniform loop body that never degenerates to
+                # the unrolled form (XLA deletes trip-1 loops, and the
+                # prefetch structure only exists inside a loop body).
+                cur0 = g_start(*take(0))
+                if _ZO_DEBUG:
+                    _dbg_gfirst = g_finish(cur0)
+                xs_f = ([jnp.roll(leaf, -1, axis=0)
+                         for leaf in stacked_flat],
+                        [None if s is None else jnp.roll(s, -1, axis=0)
+                         for s in sec_stacked],
+                        keys[:n_layer])
+
+                def fwd_body(carry, xs_t):
+                    x_t, cur = carry
+                    nxt_flat, nxt_sec, key = xs_t
+                    # gather lane: issue layer t+1's all-gather; nothing
+                    # in this iteration consumes it (goes to the carry)
+                    nxt = g_start(nxt_flat, nxt_sec)
+                    y = blk(g_finish(cur), x_t, key)
+                    return (y, nxt), x_t
+
+                (y, _), xs_stack = jax.lax.scan(
+                    fwd_body, (x, cur0), xs_f)
+            else:
+                if _ZO_DEBUG:
+                    _dbg_gfirst = g_finish(g_start(*take(0)))
+
+                def fwd_body0(x_t, xs_t):
+                    flat_t, sec_t, key = xs_t
+                    full = g_finish(g_start(flat_t, sec_t))
+                    return blk(full, x_t, key), x_t
+
+                y, xs_stack = jax.lax.scan(
+                    fwd_body0, x,
+                    (stacked_flat, sec_stacked, keys[:n_layer]))
+
+            outer_full_i, y_i = iso((outer_full, y))
+            loss, head_vjp = jax.vjp(
+                lambda of, yy: head_fn(of, yy, batch_local),
+                outer_full_i, y_i)
+            seed = (loss_scale / gas).astype(loss.dtype)
+            outer_cot_h, y_cot = iso(head_vjp(seed))
+
+            # ---------------- backward ----------------
+            if depth >= 1:
+                # trip-L rolled dual-lane pipeline, mirror of the
+                # forward: iteration t recomputes+VJPs layer t from the
+                # carried gathered params while issuing (a) the
+                # reduce-scatter buckets of layer t+1's cotangents
+                # (carried as ``pending``) and (b) layer t-1's
+                # re-gather. Pipeline fill: gather layer L-1 before the
+                # loop; ``pending`` seeds with zero cotangents, so the
+                # first iteration reduces zeros (discarded) and the
+                # last re-gathers layer L-1 (discarded) — one junk
+                # reduce + one junk gather per micro-step keep the body
+                # uniform (a trip-1 loop would be deleted by XLA and
+                # the overlap structure with it).
+                g_init = g_start(*take(n_layer - 1))
+                # zero cotangent seed, full-leaf shaped (the finish
+                # below is consumed only by zeros_like -> DCE'd)
+                zero_cot = [jnp.zeros_like(g)
+                            for g in g_finish(g_init)]
+
+                xs_b = (xs_stack,
+                        [jnp.roll(leaf, 1, axis=0)
+                         for leaf in stacked_flat],
+                        [None if s is None else jnp.roll(s, 1, axis=0)
+                         for s in sec_stacked],
+                        keys[:n_layer])
+
+                def bwd_body(carry, xs_t):
+                    x_cot_t, pending, cur = carry
+                    x_in, prev_f, prev_s, key = xs_t
+                    # reduce lane: layer t+1's cotangent buckets (from
+                    # the carry — independent of this body's compute)
+                    reduced = reduce_cots(pending)
+                    # gather lane: layer t-1's params for next iteration
+                    nxt = g_start(prev_f, prev_s)
+                    cot, x_cot_out = blk_vjp(g_finish(cur), x_in,
+                                             x_cot_t, key)
+                    return (x_cot_out, cot, nxt), reduced
+
+                (x_cot, pending0, _), red_stack = jax.lax.scan(
+                    bwd_body, (y_cot, zero_cot, g_init), xs_b,
+                    reverse=True)
+                red0 = reduce_cots(pending0)
+                # red_stack[t] = reduced layer t+1 for t <= L-2;
+                # red_stack[L-1] is the zero-seed junk — dropped
+                stacked_grads = [
+                    jnp.concatenate([r0[None], rs[:n_layer - 1]], axis=0)
+                    for r0, rs in zip(red0, red_stack)]
+            else:
+                def bwd_body0(x_cot_t, xs_t):
+                    x_in, flat_t, sec_t, key = xs_t
+                    full = g_finish(g_start(flat_t, sec_t))
+                    cot, x_cot_out = blk_vjp(full, x_in, x_cot_t, key)
+                    reduced = reduce_cots(cot)
+                    # The REAL serialization here is structural: the
+                    # gather is consumed by this body's recompute and
+                    # the reduce consumes this body's cotangents, so
+                    # both sit on the dependence chain in the final
+                    # module (what the audit asserts). The fence only
+                    # adds an optimization-time ordering on top (it is
+                    # erased after optimization — see
+                    # CollectiveIssue.fence).
+                    anchors = [r for r, d in zip(reduced, block_pdims)
+                               if d is not None]
+                    x_cot_out = CollectiveIssue.fence(x_cot_out, *anchors)
+                    return x_cot_out, reduced
+
+                x_cot, red_stack = jax.lax.scan(
+                    bwd_body0, y_cot,
+                    (xs_stack, stacked_flat, sec_stacked,
+                     keys[:n_layer]),
+                    reverse=True)
+                stacked_grads = list(red_stack)
+
+            _, embed_vjp = jax.vjp(
+                lambda of: embed_fn(of, batch_local, keys[n_layer], train),
+                outer_full_i)
+            (outer_cot_e,) = embed_vjp(iso(x_cot))
+            outer_cot_e = iso(outer_cot_e)
+            outer_cot = jax.tree.map(jnp.add, outer_cot_h, outer_cot_e)
+            outer_red = bucketed_reduce_scatter_mean(
+                jax.tree.flatten(outer_cot)[0], outer_pdims,
+                bucket_elements=bucket_elems, qg=qg,
+                group_size=group_size)
+
+            grads = dict(jax.tree.unflatten(outer_def, outer_red))
+            for i in range(n_layer):
+                grads[f"{prefix}{i}"] = jax.tree.unflatten(
+                    block_def, [g[i] for g in stacked_grads])
+
             grads = reduce_grads(grads)
             grads = jax.tree.map(
                 lambda g: g.astype(grad_accum_dtype), grads)
             new_acc = jax.tree.map(jnp.add, grad_acc_local, grads)
+            loss_s = loss * loss_scale / gas
             loss_avg = jax.lax.psum(loss_s, DATA_AXIS) / n
+            if _ZO_DEBUG:
+                taps = {"y": y, "y_cot": y_cot, "xs_stack": xs_stack,
+                        "gfirst": _dbg_gfirst, "loss": loss}
+                return loss_avg * gas / loss_scale, new_acc, taps
             return loss_avg * gas / loss_scale, new_acc
 
         in_specs = [params_proj, grads_proj, PartitionSpec(), batch_proj,
@@ -534,11 +1091,22 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         if with_sec:
             in_specs.append(_sec_specs())
             args.append(secondary)
+        out_specs = (PartitionSpec(), grads_proj)
+        if _ZO_DEBUG:
+            P = PartitionSpec
+            out_specs = out_specs + ({"y": P(DATA_AXIS), "y_cot": P(DATA_AXIS),
+                                      "xs_stack": P(None, DATA_AXIS),
+                                      "gfirst": [P() for _ in block_pdims],
+                                      "loss": P()},)
         shmapped = jax.shard_map(
             inner, mesh=mesh, axis_names={DATA_AXIS},
-            in_specs=tuple(in_specs), out_specs=(PartitionSpec(),
-                                                 grads_proj),
+            in_specs=tuple(in_specs), out_specs=out_specs,
             check_vma=False)
         return shmapped(*args)
 
-    return micro_fwd_bwd, prepare_secondary
+    plan_info = {
+        "mode": "layered", "depth": depth, "reason": plan.reason,
+        "n_layer": n_layer, "bucket_elements": bucket_elems,
+        "overlap_comm": zcfg.overlap_comm,
+    }
+    return micro_fwd_bwd, prepare_secondary, plan_info
