@@ -409,8 +409,29 @@ def run_config(name):
             "n_params": int(n_params),
             "step_time_ms": round(dt / steps * 1000, 2),
             "step_breakdown": step_breakdown,
+            # reference-path fallback counters: a perf number measured
+            # while the quantized matmul silently ran the
+            # dequantize-then-matmul path describes the wrong kernel
+            "qmm_fallbacks": _qmm_fallback_row(),
         },
     }), flush=True)
+
+
+def _qmm_fallback_row():
+    """JSON-safe snapshot of the quantized-matmul reference-path
+    fallback counters, also emitted as a telemetry instant so the
+    record lands in the trace stream, not just a one-shot warning."""
+    from hcache_deepspeed_tpu.ops.quantized_matmul import \
+        fallback_debug_info
+    from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+    info = fallback_debug_info()
+    row = {"count": info["count"], "by_reason": info["by_reason"],
+           "last": list(info["last"]) if info["last"] else None}
+    tracer = get_tracer()
+    if tracer.enabled and info["count"]:
+        tracer.instant("qmm.fallback", count=info["count"],
+                       reasons=",".join(sorted(info["by_reason"])))
+    return row
 
 
 _PROBE_SECS = float(os.environ.get("HDS_BENCH_PROBE_SECS", 150))
@@ -491,12 +512,16 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
     Builds the 2-layer toy ZeRO-3 (qwZ) step on an 8-virtual-device
     CPU mesh, audits the compiled HLO with ``profiling/hlo_audit.py``
     for prefetch on vs ``overlap_comm=False``, checks bitwise parity
-    between the two schedules over 3 steps, re-runs the Domino
-    half-batch all-reduce audit through the explicit async-issue
-    helper, and emits one JSONL row per measurement plus a summary
-    line. Runs entirely on CPU — never touches the TPU relay — so the
-    artifact is reproducible anywhere (native async pairs are expected
-    to be 0 here; the derived tier is the CPU-decidable evidence)."""
+    between the two schedules over 3 steps, repeats both audits on the
+    QUANTIZED-WIRE config (bucketed int8 reduce-scatter + error
+    feedback + fused qwZ matmul consumption) with wire-bytes-saved per
+    collective op recorded from the comms logger AND the compiled
+    module, re-runs the Domino half-batch all-reduce audit (full-width
+    + int8-wire) through the explicit async-issue helper, and emits one
+    JSONL row per measurement plus a summary line. Runs entirely on
+    CPU — never touches the TPU relay — so the artifact is reproducible
+    anywhere (native async pairs are expected to be 0 here; the derived
+    tier is the CPU-decidable evidence)."""
     # must run before jax initializes its backends
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -524,16 +549,18 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
     rng = np.random.default_rng(0)
     data = {"input_ids": rng.integers(0, 256, (8, 32), dtype=np.int32)}
 
-    def build(overlap):
+    def build(overlap, **zero_extra):
         model = GPT2LMHeadModel(gpt2_tiny(
             n_layer=2, n_embd=64, n_head=4, use_flash=False))
+        zero = {"stage": 3, "min_shard_size": 1,
+                "zero_quantized_weights": True,
+                "overlap_comm": overlap}
+        zero.update(zero_extra)
         cfg = {
             "train_batch_size": 8,
             "train_micro_batch_size_per_gpu": 1,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-            "zero_optimization": {"stage": 3, "min_shard_size": 1,
-                                  "zero_quantized_weights": True,
-                                  "overlap_comm": overlap},
+            "zero_optimization": zero,
             "comms_logger": {"enabled": True},
             "steps_per_print": 10 ** 9,
         }
@@ -553,13 +580,58 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
             "phase": "zero3-audit", "overlap_comm": overlap,
             "comm_bytes": {op: {ax: tot for ax, (_, tot) in by.items()}
                            for op, by in comms.axis_summary().items()
-                           if op.startswith(("zero_", "qwZ", "issue."))},
+                           if op.startswith(("zero_", "qwZ", "qgZ",
+                                             "domino", "issue."))},
+            "wire_savings": comms.wire_savings_summary(),
         })
         rows.append(row)
 
     bitwise = (losses[True] == losses[False] and all(
         np.array_equal(np.asarray(x), np.asarray(y))
         for x, y in zip(params[True], params[False])))
+
+    # ---- quantized wire: bucketed int8 reduce-scatter + error
+    # feedback + fused qwZ matmul consumption, prefetch on. Gates:
+    # wire <= ~35% of the fp32 full-width bytes, loss trajectory
+    # within tolerance of the full-width run, depth-1-vs-0 bitwise
+    # parity preserved UNDER quantization.
+    q_losses, q_params = {}, {}
+    qrs_row = None
+    for overlap in (True, False):
+        comms.reset()
+        engine = build(overlap,
+                       zero_quantized_reduce_scatter=True,
+                       zero_reduce_scatter_error_feedback=True,
+                       zero_quantized_weights_fused_matmul=True)
+        report, row = engine.zero_overlap_report(data)
+        q_losses[overlap] = [float(engine.train_batch(batch=data))
+                             for _ in range(3)]
+        q_params[overlap] = jax.tree.leaves(engine.state["params"])
+        row.update({
+            "phase": "zero3-audit-quantized-wire",
+            "overlap_comm": overlap,
+            "alltoall_overlap_ratio": round(
+                report.overlap_ratio("all-to-all"), 4),
+            "wire_savings": comms.wire_savings_summary(),
+        })
+        if overlap:
+            qrs_row = row
+        rows.append(row)
+    q_bitwise = (q_losses[True] == q_losses[False] and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(q_params[True], q_params[False])))
+    qrs_frac = qrs_row["wire_savings"].get(
+        "zero_qrs_all_to_all", {}).get("fraction")
+    traj_ok = bool(np.allclose(q_losses[True], losses[True], rtol=5e-2))
+    rows.append({
+        "phase": "quantized-wire-parity", "steps": 3,
+        "bitwise_depth_parity": q_bitwise,
+        "losses": q_losses[True],
+        "fp_wire_losses": losses[True],
+        "trajectory_within_tol": traj_ok,
+        "qrs_wire_fraction_of_fp32": qrs_frac,
+        "qmm_fallbacks": _qmm_fallback_row(),
+    })
     on = next(r for r in rows if r["overlap_comm"])
     off = next(r for r in rows if not r["overlap_comm"])
     on_pairs = [p for p in on["pairs"]
@@ -597,6 +669,28 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
                      "helper": "domino_split_async"})
         rows.append(drow)
 
+    # opt-in int8 wire for the Domino half-batch all-reduces: the
+    # compiled module's collective buffers go s8/u8 (wire_bytes shows
+    # the quantized portion) while the program stays overlappable
+    def domino_q(x, a, b):
+        y, _ = domino_split_async(
+            lambda h: jax.nn.gelu(h @ a) @ b,
+            lambda t: jax.lax.psum(t, "tensor"),
+            x, overlap=True, wire_bits=8, axis="tensor")
+        return y
+
+    comms.reset()
+    compiled = jax.jit(jax.shard_map(
+        domino_q, mesh=mesh,
+        in_specs=(P(), P(None, "tensor"), P("tensor",)),
+        out_specs=P(), check_vma=False)).lower(xd, w1, w2).compile()
+    drep = audit_compiled(compiled)
+    drow = drep.to_row()
+    drow.update({"phase": "domino-audit-int8", "overlap": True,
+                 "helper": "domino_split_async",
+                 "wire_savings": comms.wire_savings_summary()})
+    rows.append(drow)
+
     summary = {
         "phase": "summary",
         "metric": "zero3 2-layer toy: overlappable all-gather pairs "
@@ -611,6 +705,12 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
         "reduce_overlap_ratio_off": off["reduce_overlap_ratio"],
         "native_async_pairs": on["native_async_pairs"],
         "bitwise_parity": bitwise,
+        "qrs_wire_fraction_of_fp32": qrs_frac,
+        "qrs_bitwise_depth_parity": q_bitwise,
+        "qrs_trajectory_within_tol": traj_ok,
+        "wire_saved_bytes_per_op": {
+            op: rec["saved_bytes"]
+            for op, rec in qrs_row["wire_savings"].items()},
         "backend": jax.default_backend(),
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
@@ -626,7 +726,9 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
         "extra": {k: v for k, v in summary.items()
                   if k not in ("phase", "metric", "value", "unit")},
     }), flush=True)
-    ok = (len(on_pairs) >= 1 and len(off_pairs) == 0 and bitwise)
+    ok = (len(on_pairs) >= 1 and len(off_pairs) == 0 and bitwise
+          and q_bitwise and traj_ok
+          and qrs_frac is not None and qrs_frac <= 0.35)
     return 0 if ok else 4
 
 

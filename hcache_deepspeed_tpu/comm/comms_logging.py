@@ -67,6 +67,46 @@ class CommsLogger:
         bucketed and per-leaf programs report identical totals."""
         self.append(op_name, tuple(axes), int(n_bytes))
 
+    def log_quantized(self, op_name, wire_bytes, unquantized_equiv_bytes,
+                      axes=()):
+        """Byte attribution for a QUANTIZED collective: record the
+        actual wire volume under ``op_name`` and the volume the same
+        collective would have carried full-width under
+        ``op_name + "_unquantized_equiv"``. Every quantized wire site
+        (qwZ gather, qgZ all-to-all, the bucketed quantized
+        reduce-scatter, Domino's int8 all-reduce) reports through this
+        single convention so ``wire_savings_summary`` — and the tests
+        that gate attribution — can pair them mechanically."""
+        if not self.should_log(op_name):
+            return
+        self.append(op_name, tuple(axes), int(wire_bytes))
+        self.append(op_name + "_unquantized_equiv", tuple(axes),
+                    int(unquantized_equiv_bytes))
+
+    def wire_savings_summary(self):
+        """Pair each quantized op with its ``_unquantized_equiv``
+        record: ``{op: {"wire_bytes", "unquantized_equiv_bytes",
+        "saved_bytes", "fraction"}}`` — the per-collective wire-bytes
+        evidence ``bench.py --zero-overlap`` emits alongside the
+        overlap ratios."""
+        totals = {}
+        for op, by_axis in self.axis_summary().items():
+            totals[op] = sum(t for _, t in by_axis.values())
+        out = {}
+        for op, total in sorted(totals.items()):
+            if op.endswith("_unquantized_equiv"):
+                continue
+            equiv = totals.get(op + "_unquantized_equiv")
+            if equiv is None:
+                continue
+            out[op] = {
+                "wire_bytes": total,
+                "unquantized_equiv_bytes": equiv,
+                "saved_bytes": equiv - total,
+                "fraction": round(total / equiv, 4) if equiv else None,
+            }
+        return out
+
     def append(self, op_name, axes, msg_size):
         if not self.should_log(op_name):
             return

@@ -106,6 +106,67 @@ def all_to_all_quant_reduce(x, axis=DATA_AXIS, group_size=256, num_bits=8,
     return _shmap(a2a_reduce, topo.mesh, axis, (P(axis),), P(axis))(x)
 
 
+def quantized_allreduce_body(x, error, axis, group_size=2048, num_bits=8):
+    """Error-feedback INT8-wire allreduce body for use INSIDE a manual
+    (shard_map) region — Domino's opt-in compressed half-batch
+    all-reduce (``runtime/domino.py``, full-width remains the default).
+
+    Topology: reduce-scatter phase (quantize each destination chunk,
+    ``all_to_all`` int8 + fp32 group scales, dequant-SUM locally) then
+    all-gather phase (re-quantize the local chunk sum, ``all_gather``
+    int8 + scales, dequant) — both legs ride a ~4x narrower wire than a
+    fp32 ``psum``. Keeps SUM semantics (what ``jax.lax.psum`` gives the
+    tensor-parallel layer). Error feedback covers the first (send-side)
+    quantization through the shared ``error_feedback_step`` machinery;
+    the broadcast leg's error is identical on every device and does not
+    accumulate into state.
+
+    ``x``: any-shaped local partial; ``error``: same-shape fp32
+    residual (pass zeros on the first call). Returns
+    ``(sum_approx, new_error)``.
+    """
+    from ..runtime.onebit import error_feedback_step
+    from .comms_logging import get_comms_logger
+
+    n = jax.lax.axis_size(axis)
+    shape, size = x.shape, x.size
+    pad = (-size) % n
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, pad))
+    err = jnp.pad(error.reshape(-1).astype(jnp.float32), (0, pad))
+    chunk = flat.shape[0] // n
+    gsz = max(1, min(group_size, chunk))
+
+    def quant_rows(c):
+        return jax.vmap(
+            lambda r: quantize(r, gsz, num_bits)[:2])(c)
+
+    def deq_rows(q, s):
+        return jax.vmap(
+            lambda qi, si: dequantize(qi, si, (chunk,), chunk))(q, s)
+
+    def compress(c):
+        rows = c.reshape(n, chunk)
+        q, s = quant_rows(rows)
+        return (q, s), deq_rows(q, s).reshape(-1)
+
+    (q, scale), _, new_err = error_feedback_step(flat, err, compress)
+    q_t = jax.lax.all_to_all(q, axis, 0, 0)          # int8 on the wire
+    s_t = jax.lax.all_to_all(scale, axis, 0, 0)
+    part = jnp.sum(deq_rows(q_t, s_t), axis=0)       # local chunk SUM
+    q2, s2, pshape, pcount = quantize(part, gsz, num_bits)
+    q2_a = jax.lax.all_gather(q2, axis)              # int8 on the wire
+    s2_a = jax.lax.all_gather(s2, axis)
+    get_comms_logger().log_quantized(
+        "domino_half_allreduce_int8",
+        q.size + 4 * scale.size + q2.size + 4 * s2.size,
+        flat.size * jnp.dtype(x.dtype).itemsize * 2,
+        (axis,))
+    full = jax.vmap(lambda qi, si: dequantize(
+        qi, si, pshape, pcount))(q2_a, s2_a).reshape(-1)
+    out = full[:size].reshape(shape).astype(x.dtype)
+    return out, new_err.reshape(-1)[:size].reshape(shape)
+
+
 def compressed_allreduce(x, error, axis=DATA_AXIS, topology=None):
     """Error-feedback 1-bit allreduce (reference:
     runtime/comm/compressed.py compressed_allreduce): compensate with the

@@ -97,6 +97,17 @@ class MatmulQuantizedTensor:
                                self.scale, group_k=self.group_k)
         return out.reshape(*lead, self.q.shape[-1])
 
+    def dequantize(self, dtype=jnp.float32):
+        """Materialize the fp weight ``[(L,) K, N]`` — the comparison
+        oracle for the fused path and the backward-recompute form of
+        the ZeRO++ fused gather (the VJP needs cotangents against the
+        fp weight, not against (q, scale))."""
+        *lead, K, N = self.q.shape
+        g = self.q.astype(dtype).reshape(
+            *lead, K // self.group_k, self.group_k, N)
+        w = g * self.scale[..., :, None, :].astype(dtype)
+        return w.reshape(*lead, K, N)
+
 
 def reference_quantized_matmul(x, q, scale, group_k=256):
     """Numerics oracle: dequantize fully, then matmul."""
@@ -320,6 +331,36 @@ def pallas_quantized_matmul(x, q, scale, group_k=256, block_m=None,
 def quantized_matmul(x, q, scale, group_k=256):
     from . import get_op
     return get_op("quantized_matmul")(x, q, scale, group_k=group_k)
+
+
+def fused_dense_interceptor():
+    """``flax.linen.intercept_methods`` interceptor: an ``nn.Dense``
+    whose bound kernel is a :class:`MatmulQuantizedTensor` computes
+    ``x @ dequant(q, scale) + b`` through the fused kernel instead of
+    tripping over a non-array param — the consumption half of the
+    ZeRO++ fused qwZ gather (``runtime/zero/zeropp.py``): the gathered
+    int8 payload feeds the MXU directly and the fp weight never
+    materializes in HBM. Output dtype follows ``x`` (the kernel's
+    contract); anything that is not a Dense with a quantized kernel
+    passes through untouched."""
+    import flax.linen as nn
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if context.method_name != "__call__" \
+                or not isinstance(mod, nn.Dense) or not args:
+            return next_fun(*args, **kwargs)
+        kernel = mod.get_variable("params", "kernel")
+        if not isinstance(kernel, MatmulQuantizedTensor):
+            return next_fun(*args, **kwargs)
+        x = args[0]
+        y = kernel.matmul(x)
+        if mod.use_bias:
+            bias = mod.get_variable("params", "bias")
+            y = y + jnp.asarray(bias, y.dtype)
+        return y
+
+    return interceptor
 
 
 register_op("quantized_matmul", reference_quantized_matmul,

@@ -61,10 +61,41 @@ DERIVED_COMPUTE_OPS = ("dot", "convolution")
 
 _HEADER_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*(\(.*\))?\s*"
                         r"(?:->\s*.*?)?\s*{\s*$")
-_INSTR_RE = re.compile(r"^(ROOT\s+)?(%?[\w.\-]+)\s+=\s+.*?"
+_INSTR_RE = re.compile(r"^(ROOT\s+)?(%?[\w.\-]+)\s+=\s+(.*?)"
                        r"([a-z][a-z0-9\-]*)\((.*)$")
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
 _CALLS_RE = re.compile(r"(?:calls|to_apply|called_computation)=%?([\w.\-]+)")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+#: HLO element-type byte widths (sub-byte types fractional)
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+#: element types that count as a QUANTIZED wire (int8/int4/fp8 payloads)
+_QUANT_DTYPES = ("s8", "u8", "s4", "u4")
+
+
+def _type_bytes(type_str: str):
+    """(total_bytes, quantized_bytes) of an HLO result type string —
+    sums every ``dtype[dims]`` token (tuple types included)."""
+    total = quant = 0.0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        width = _DTYPE_BYTES.get(
+            dtype, 1 if dtype.startswith("f8") else None)
+        if width is None:
+            continue
+        elems = 1
+        if dims:
+            for d in dims.split(","):
+                elems *= int(d)
+        total += elems * width
+        if dtype in _QUANT_DTYPES or dtype.startswith("f8"):
+            quant += elems * width
+    return int(total), int(quant)
 
 
 @dataclass
@@ -75,6 +106,8 @@ class Instr:
     index: int
     is_root: bool
     raw: str
+    result_bytes: int = 0        # bytes of the result type (wire buffer)
+    quantized_bytes: int = 0     # int8/int4/fp8 portion of the result
 
     @property
     def is_collective(self) -> bool:
@@ -143,11 +176,13 @@ def parse_hlo_computations(text: str) -> List[Computation]:
         m = _INSTR_RE.match(stripped)
         if not m:
             continue
-        is_root, name, opcode, rest = m.groups()
+        is_root, name, type_str, opcode, rest = m.groups()
+        total_b, quant_b = _type_bytes(type_str)
         cur.instrs.append(Instr(
             name=name.lstrip("%"), opcode=opcode,
             operands=[o for o in _OPERAND_RE.findall(rest)],
-            index=len(cur.instrs), is_root=bool(is_root), raw=stripped))
+            index=len(cur.instrs), is_root=bool(is_root), raw=stripped,
+            result_bytes=total_b, quantized_bytes=quant_b))
     if cur is not None:  # unterminated tail block
         comps.append(cur)
     return comps
@@ -241,6 +276,11 @@ class AuditReport:
     derived_pairs: List[Pair]         # sync collectives with >=1 free op
     sequential_collectives: List[Pair]  # sync collectives with 0 free
     computations: int
+    #: per collective opcode: result-buffer bytes in the COMPILED
+    #: module ``{kind: {bytes, quantized_bytes, count}}`` — the
+    #: HLO-measured wire evidence (an int8 wire shows up as s8/u8
+    #: buffers here, independent of the trace-time comms attribution)
+    wire_bytes: Dict[str, Dict] = field(default_factory=dict)
 
     def pairs(self, kind: Optional[str] = None,
               min_interleaved: int = 1) -> List[Pair]:
@@ -286,6 +326,7 @@ class AuditReport:
             "allreduce_overlap_ratio": round(
                 self.overlap_ratio("all-reduce"), 4),
             "collective_counts": self.counts(),
+            "wire_bytes": self.wire_bytes,
             "pairs": [p.to_dict() for p in
                       (self.native_pairs + self.derived_pairs)],
         }
@@ -297,15 +338,27 @@ class AuditReport:
 def audit_hlo_text(text: str) -> AuditReport:
     """Audit one optimized-HLO module's async-overlap structure."""
     native, derived, sequential = [], [], []
+    wire: Dict[str, Dict] = {}
     comps = parse_hlo_computations(text)
     for comp in comps:
         native.extend(_native_pairs(comp))
         over, seq = _derived_pairs(comp)
         derived.extend(over)
         sequential.extend(seq)
+        for i in comp.instrs:
+            if not (i.is_collective or i.opcode.endswith("-start")):
+                continue
+            kind = i.opcode[:-6] if i.opcode.endswith("-start") \
+                else i.opcode
+            rec = wire.setdefault(kind, {"bytes": 0,
+                                         "quantized_bytes": 0,
+                                         "count": 0})
+            rec["bytes"] += i.result_bytes
+            rec["quantized_bytes"] += i.quantized_bytes
+            rec["count"] += 1
     return AuditReport(native_pairs=native, derived_pairs=derived,
                        sequential_collectives=sequential,
-                       computations=len(comps))
+                       computations=len(comps), wire_bytes=wire)
 
 
 def audit_compiled(compiled) -> AuditReport:

@@ -106,6 +106,27 @@ class ZeroConfig(HDSConfigModel):
     zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition size
     zero_quantized_weights: bool = False  # ZeRO++ qwZ
     zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    #: Quantized WIRE for the gradient reduce lane of the layered
+    #: ZeRO-3 step (``runtime/zero/qwire.py``): cotangent buckets are
+    #: int8-quantized (+fp32 group scales), all-to-all'd, and
+    #: dequant-accumulate-meaned locally in fp32 — the qgZ topology at
+    #: IPG-bucket granularity. Requires stage 3 + a layered model spec;
+    #: mutually exclusive with per-leaf qgZ.
+    zero_quantized_reduce_scatter: bool = False
+    #: Carry the per-device quantization error of the bucketed
+    #: quantized reduce-scatter as residual state (1-bit worker-error
+    #: machinery) and re-inject it next micro-step. Requires
+    #: ``zero_quantized_reduce_scatter``.
+    zero_reduce_scatter_error_feedback: bool = False
+    #: Wire width of the quantized reduce-scatter payload: 8 (int8) or
+    #: 4 (two values nibble-packed per byte). Scales stay fp32.
+    zero_quantized_reduce_scatter_bits: int = 8
+    #: qwZ forward fusion: block matmuls consume the gathered
+    #: ``(int8, scales)`` payload directly through
+    #: ``ops/quantized_matmul`` — the fp weight tensor never
+    #: materializes for eligible (Dense-kernel) qwZ leaves. Requires
+    #: ``zero_quantized_weights``.
+    zero_quantized_weights_fused_matmul: bool = False
     #: ZeRO++ stage-3 gather granularity: scan-over-layers (gather one
     #: block at a time inside the micro step) when the model provides a
     #: layered spec (models/layered.py). False forces the whole-tree
@@ -115,6 +136,21 @@ class ZeroConfig(HDSConfigModel):
     round_robin_gradients: bool = False
     min_shard_size: int = 2 ** 14  # params smaller than this stay replicated
     shard_min_dim: bool = False
+
+    @model_validator(mode="after")
+    def _check_quantized_wire(self):
+        # typed, parse-time rejection of nonsensical quantized-wire
+        # combinations (stage interplay re-checked at engine build,
+        # where the topology is known)
+        from .zero.overlap import validate_quantized_wire
+        validate_quantized_wire(
+            quantized_reduce_scatter=self.zero_quantized_reduce_scatter,
+            error_feedback=self.zero_reduce_scatter_error_feedback,
+            bits=self.zero_quantized_reduce_scatter_bits,
+            quantized_gradients=self.zero_quantized_gradients,
+            fused_matmul=self.zero_quantized_weights_fused_matmul,
+            quantized_weights=self.zero_quantized_weights)
+        return self
 
 
 # ------------------------------------------------------------------ #

@@ -65,7 +65,8 @@ def domino_split(layer_fn, x, *args, **kwargs):
 
 
 def domino_split_async(compute_fn, collective_fn, x, *args,
-                       overlap=True, **kwargs):
+                       overlap=True, wire_bits=None, axis=None,
+                       wire_error=None, group_size=2048, **kwargs):
     """Half-batch split with the collective EXPLICITLY issued through
     :class:`comm.overlap.CollectiveIssue` instead of buried inside an
     opaque layer function — the reference's hand-scheduled form
@@ -87,8 +88,51 @@ def domino_split_async(compute_fn, collective_fn, x, *args,
     is a REAL serialization the audit sees in the final module
     (``optimization_barrier`` fences are erased by XLA after
     optimization, so a fenced split would still audit as overlappable).
+
+    ``wire_bits`` (opt-in; full-width remains the default): quantize
+    each half's all-reduce to an int8 wire with error feedback
+    (``comm/quantized.py quantized_allreduce_body`` — the same shared
+    residual machinery as the 1-bit optimizers and the ZeRO quantized
+    reduce-scatter). ``collective_fn`` is replaced by the quantized
+    body, so ``axis`` (the mesh axis the layer reduces over) becomes
+    required. ``wire_error`` carries the per-half residual state —
+    a ``(e0, e1)`` tuple shaped like the halves' partials (``None``
+    seeds zeros) — and the return becomes
+    ``(y, (e0_new, e1_new))`` for the caller to thread. Must run
+    inside the shard_map region, like the plain collective.
     """
     B = x.shape[0]
+    if wire_bits is not None:
+        if axis is None:
+            raise ValueError(
+                "domino_split_async(wire_bits=...) needs the mesh "
+                "axis the layer reduces over (axis=...)")
+        from ..comm.quantized import quantized_allreduce_body
+
+        def q_collective(t, e):
+            return quantized_allreduce_body(
+                t, e, axis, group_size=group_size, num_bits=wire_bits)
+
+        if B < 2 or not overlap:
+            t = compute_fn(x, *args, **kwargs)
+            e = wire_error[0] if wire_error is not None \
+                else jnp.zeros(t.shape, jnp.float32)
+            y, e_new = q_collective(t, e)
+            return y, (e_new,)
+        h = (B + 1) // 2
+        issue = CollectiveIssue(overlap=True,
+                                op_name="domino_half_allreduce_int8")
+        t0 = compute_fn(x[:h], *args, **kwargs)
+        e0 = wire_error[0] if wire_error is not None \
+            else jnp.zeros(t0.shape, jnp.float32)
+        k0 = issue.issue(q_collective, t0, e0)
+        t1 = compute_fn(x[h:], *args, **kwargs)
+        e1 = wire_error[1] if wire_error is not None \
+            else jnp.zeros(t1.shape, jnp.float32)
+        k1 = issue.issue(q_collective, t1, e1)
+        y0, e0_new = issue.wait(k0)
+        y1, e1_new = issue.wait(k1)
+        return jnp.concatenate([y0, y1], axis=0), (e0_new, e1_new)
     if B < 2 or not overlap:
         return collective_fn(compute_fn(x, *args, **kwargs))
     h = (B + 1) // 2
@@ -110,21 +154,28 @@ class DominoTransformer:
     (:func:`domino_split_async`)."""
 
     def __init__(self, layer_fn=None, *, compute_fn=None,
-                 collective_fn=None, overlap=True):
+                 collective_fn=None, overlap=True, wire_bits=None,
+                 axis=None):
         if (layer_fn is None) == (compute_fn is None):
             raise ValueError(
                 "pass either layer_fn (opaque form) or compute_fn + "
                 "collective_fn (explicit async-issue form)")
         if compute_fn is not None and collective_fn is None:
             raise ValueError("compute_fn requires collective_fn")
+        if wire_bits is not None and compute_fn is None:
+            raise ValueError("wire_bits needs the explicit "
+                             "compute_fn + collective_fn form")
         self.layer_fn = layer_fn
         self.compute_fn = compute_fn
         self.collective_fn = collective_fn
         self.overlap = overlap
+        self.wire_bits = wire_bits
+        self.axis = axis
 
     def __call__(self, x, *args, **kwargs):
         if self.layer_fn is not None:
             return domino_split(self.layer_fn, x, *args, **kwargs)
         return domino_split_async(self.compute_fn, self.collective_fn,
                                   x, *args, overlap=self.overlap,
-                                  **kwargs)
+                                  wire_bits=self.wire_bits,
+                                  axis=self.axis, **kwargs)

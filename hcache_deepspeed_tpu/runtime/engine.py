@@ -266,10 +266,11 @@ class HDSEngine:
             topology.tensor_size > 1 or topology.expert_size > 1)
         self._batch_spec_fn = batch_spec_fn
 
-        # ---- ZeRO++ (qwZ / qgZ / hpZ) ----
+        # ---- ZeRO++ (qwZ / qgZ / hpZ / quantized reduce-scatter) ----
         self._zeropp = (zcfg.zero_quantized_weights
                         or zcfg.zero_quantized_gradients
-                        or zcfg.zero_hpz_partition_size > 1)
+                        or zcfg.zero_hpz_partition_size > 1
+                        or zcfg.zero_quantized_reduce_scatter)
         if self._zeropp:
             from .config import HDSConfigError
             from .zero.zeropp import validate_zeropp
@@ -721,6 +722,7 @@ class HDSEngine:
 
     def _build_step_functions(self):
         self._zero_overlap_plan = None
+        self._qrs_error_feedback = False
         if self._onebit is not None:
             return self._build_onebit_step_functions()
         policy = self.policy
@@ -818,6 +820,14 @@ class HDSEngine:
                     zcfg=zcfg,
                     layered=layered,
                     param_shapes=self.state["params"])
+            # error-feedback residual state for the quantized
+            # reduce-scatter: allocated once, threaded through every
+            # micro step and carried in engine state (checkpointed with
+            # the rest — the residual IS optimizer-adjacent state)
+            wire_error_init = plan_info.pop("wire_error_init", None)
+            self._qrs_error_feedback = wire_error_init is not None
+            if self._qrs_error_feedback:
+                self.state["wire_error"] = wire_error_init()
             self._zero_overlap_plan = plan_info
             tracer = get_tracer()
             if tracer.enabled:
@@ -945,6 +955,11 @@ class HDSEngine:
                 "good_steps": new_good,
                 "hysteresis": new_hyst,
             }
+            if "wire_error" in state:
+                # quantized-wire error-feedback residuals persist across
+                # optimizer steps (they compensate the NEXT micro's
+                # quantization, exactly like the 1-bit worker error)
+                new_state["wire_error"] = state["wire_error"]
             return new_state, finite, grad_norm
 
         self._apply_step = jax.jit(apply_step, donate_argnums=(0,))
@@ -970,10 +985,16 @@ class HDSEngine:
                 state = dict(state, grad_acc=jax.tree.map(
                     jnp.zeros_like, state["grad_acc"]))
 
+            qrs_ef = self._qrs_error_feedback
+
             def body(acc, xs):
-                grad_acc, loss_sum = acc
+                grad_acc, loss_sum, werr = acc
                 batch, key = xs
-                if secondary is not None:
+                if qrs_ef:
+                    loss, grad_acc, werr = micro_fwd_bwd(
+                        state["params"], grad_acc, state["loss_scale"],
+                        batch, key, True, secondary, werr)
+                elif secondary is not None:
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
                         batch, key, True, secondary)
@@ -990,13 +1011,17 @@ class HDSEngine:
                     loss, grad_acc = micro_fwd_bwd(
                         state["params"], grad_acc, state["loss_scale"],
                         batch, key, True, **kw)
-                return (grad_acc, loss_sum + loss), None
+                return (grad_acc, loss_sum + loss, werr), None
 
             keys = jax.random.split(rng, gas)
-            (grad_acc, loss_sum), _ = jax.lax.scan(
-                body, (state["grad_acc"], jnp.zeros((), jnp.float32)),
+            (grad_acc, loss_sum, werr), _ = jax.lax.scan(
+                body,
+                (state["grad_acc"], jnp.zeros((), jnp.float32),
+                 state.get("wire_error") if qrs_ef else None),
                 (batches, keys))
             state = dict(state, grad_acc=grad_acc)
+            if qrs_ef:
+                state["wire_error"] = werr
             new_state, finite, grad_norm = apply_step(state, lr)
             return new_state, loss_sum / gas, finite, grad_norm
 
@@ -1134,10 +1159,18 @@ class HDSEngine:
                 extra_kw["comp_step"] = jnp.asarray(self.global_steps,
                                                     jnp.int32)
             with self.platform.annotate("hds.fwd_bwd"):
-                loss, new_acc = self._micro_fwd_bwd(
-                    self.state["params"], self.state["grad_acc"],
-                    self.state["loss_scale"], batch, self._next_rng(),
-                    True, **extra_kw)
+                if getattr(self, "_qrs_error_feedback", False):
+                    loss, new_acc, new_werr = self._micro_fwd_bwd(
+                        self.state["params"], self.state["grad_acc"],
+                        self.state["loss_scale"], batch,
+                        self._next_rng(), True, None,
+                        self.state["wire_error"])
+                    self.state["wire_error"] = new_werr
+                else:
+                    loss, new_acc = self._micro_fwd_bwd(
+                        self.state["params"], self.state["grad_acc"],
+                        self.state["loss_scale"], batch,
+                        self._next_rng(), True, **extra_kw)
             self.state["grad_acc"] = new_acc
             self._pending = loss
             if self.wall_clock_breakdown:

@@ -107,13 +107,34 @@ def onebit_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
     return init, update
 
 
+def error_feedback_step(x, error, compress):
+    """Generic error-feedback residual update (the machinery every
+    compressed-wire path shares — 1-bit here, the int8 bucketed
+    reduce-scatter in ``runtime/zero/qwire.py``, Domino's opt-in int8
+    all-reduce in ``comm/quantized.py``): compensate the input with the
+    carried residual, compress, and carry the compression error forward
+    so it is re-injected (not accumulated) next step.
+
+    ``compress(compensated) -> (wire, decompressed)`` where ``wire`` is
+    whatever goes on the network and ``decompressed`` is the value the
+    receivers will reconstruct from it. Returns
+    ``(wire, decompressed, new_error)``.
+    """
+    compensated = x + error
+    wire, decompressed = compress(compensated)
+    return wire, decompressed, compensated - decompressed
+
+
 def _compressed_allreduce_body(x, error, axis):
     """Error-feedback 1-bit allreduce body for use inside shard_map."""
     n = jax.lax.psum(jnp.ones(()), axis)
-    compensated = x + error
-    scale = jnp.mean(jnp.abs(compensated))
-    sign = jnp.sign(compensated)
-    new_error = compensated - sign * scale
+
+    def compress(c):
+        scale = jnp.mean(jnp.abs(c))
+        sign = jnp.sign(c)
+        return (sign, scale), sign * scale
+
+    (sign, scale), _, new_error = error_feedback_step(x, error, compress)
     avg = jax.lax.psum(sign * scale, axis) / n
     return avg, new_error
 
@@ -284,4 +305,4 @@ def zero_one_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
 
 __all__ = ["onebit_adam", "OnebitAdamState", "onebit_lamb",
            "OnebitLambState", "zero_one_adam", "ZeroOneAdamState",
-           "compressed_allreduce"]
+           "compressed_allreduce", "error_feedback_step"]

@@ -115,6 +115,52 @@ def plan_reduce_buckets(leaf_sizes: Sequence[Optional[int]],
     return buckets
 
 
+def validate_quantized_wire(*, quantized_reduce_scatter: bool,
+                            error_feedback: bool, bits: int,
+                            quantized_gradients: bool,
+                            fused_matmul: bool = False,
+                            quantized_weights: bool = False,
+                            stage: Optional[int] = None) -> None:
+    """Typed rejection of nonsensical quantized-wire knob combinations
+    (no silent clamps — the same contract as
+    :func:`validate_overlap_config`). Called both at config parse
+    (``ZeroConfig``) and at engine build (``validate_zeropp``, where
+    ``stage`` is known)."""
+    from ..config import HDSConfigError
+    if bits not in (4, 8):
+        raise HDSConfigError(
+            f"zero_quantized_reduce_scatter_bits={bits}: the quantized "
+            f"wire ships int8 or nibble-packed int4 payloads — use 8 "
+            f"or 4")
+    if error_feedback and not quantized_reduce_scatter:
+        raise HDSConfigError(
+            "zero_reduce_scatter_error_feedback=true without "
+            "zero_quantized_reduce_scatter: there is no quantization "
+            "error to compensate on the full-width wire — enable "
+            "zero_quantized_reduce_scatter or drop the error-feedback "
+            "flag")
+    if bits != 8 and not quantized_reduce_scatter:
+        raise HDSConfigError(
+            f"zero_quantized_reduce_scatter_bits={bits} has no effect "
+            f"without zero_quantized_reduce_scatter; enable it or "
+            f"leave bits at the default")
+    if quantized_reduce_scatter and quantized_gradients:
+        raise HDSConfigError(
+            "zero_quantized_reduce_scatter and zero_quantized_gradients "
+            "(qgZ) both define the gradient wire format — per-leaf qgZ "
+            "and the bucketed quantized reduce-scatter are mutually "
+            "exclusive; pick one")
+    if fused_matmul and not quantized_weights:
+        raise HDSConfigError(
+            "zero_quantized_weights_fused_matmul=true without "
+            "zero_quantized_weights (qwZ): there is no int8 gather "
+            "payload for the block matmuls to consume")
+    if stage is not None and quantized_reduce_scatter and stage != 3:
+        raise HDSConfigError(
+            "zero_quantized_reduce_scatter requires zero stage 3 (it "
+            "rides the explicit layered reduce lane)")
+
+
 def validate_overlap_config(*, reduce_bucket_elements: int,
                             largest_leaf: int,
                             largest_leaf_name: str = "",

@@ -97,14 +97,15 @@ def project_spec_tree(spec_tree, axis):
         is_leaf=lambda x: isinstance(x, PartitionSpec))
 
 
-def _log_wire(op, n_int8, n_scale_f32, would_be_dtype, n_elems):
-    """Record quantized wire volume (and the volume it replaced)."""
-    logger = get_comms_logger()
-    if not logger.should_log(op):
-        return
-    logger.append(op, (DATA_AXIS,), int(n_int8) + 4 * int(n_scale_f32))
-    logger.append(op + "_unquantized_equiv", (DATA_AXIS,),
-                  int(n_elems) * jnp.dtype(would_be_dtype).itemsize)
+def _log_wire(op, n_int8, n_scale_f32, equiv_bytes):
+    """Record quantized wire volume (and the volume it replaced).
+    ``equiv_bytes`` is the full-width byte count of the SAME payload in
+    the leaf's actual dtype — computed by the caller from the real
+    leaves, never assumed (a hard-coded bf16 equivalent under-reported
+    fp32 runs 2x)."""
+    get_comms_logger().log_quantized(
+        op, int(n_int8) + 4 * int(n_scale_f32), int(equiv_bytes),
+        (DATA_AXIS,))
 
 
 def _quantized_all_gather_dim(x, dim, *, group_size, axis_index_groups=None):
@@ -115,7 +116,8 @@ def _quantized_all_gather_dim(x, dim, *, group_size, axis_index_groups=None):
                                axis_index_groups=axis_index_groups)
     s_all = jax.lax.all_gather(scale, DATA_AXIS,
                                axis_index_groups=axis_index_groups)
-    _log_wire("qwZ_all_gather", q.size, scale.size, jnp.bfloat16, x.size)
+    _log_wire("qwZ_all_gather", q.size, scale.size,
+              x.size * x.dtype.itemsize)
     deq = jax.vmap(lambda qi, si: dequantize(qi, si, shape, count))(
         q_all, s_all)
     # [n, ...] -> concatenate along the sharded dim
@@ -141,7 +143,8 @@ def _quant_reduce_mean_dim(g, dim, *, group_size):
     qs, scales = jax.vmap(quant_part)(parts)
     qs = jax.lax.all_to_all(qs, DATA_AXIS, 0, 0)
     scales = jax.lax.all_to_all(scales, DATA_AXIS, 0, 0)
-    _log_wire("qgZ_all_to_all", qs.size, scales.size, jnp.float32, g.size)
+    _log_wire("qgZ_all_to_all", qs.size, scales.size,
+              g.size * g.dtype.itemsize)
     part_shape = parts.shape[1:]
     part_count = int(np.prod(part_shape))
     deq = jax.vmap(lambda qi, si: dequantize(qi, si, part_shape,
@@ -227,7 +230,7 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
 
 
 def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
-                              bucket_elements):
+                              bucket_elements, matmul_plan=None):
     """ISSUE half of the layer-granular gather: coalesce the sharded
     leaves of ``flat`` (local shards; the hpZ ``sec`` partition when
     hpz > 1) into flat all-gather payloads of at most
@@ -250,7 +253,18 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
     (prefetched); per-leaf gathers always leave intra-layer slack (the
     MLP weights' gather can overlap the attention dots) that would
     make even the serialized fallback audit as partially overlappable.
-    Replicated leaves (``dim`` None) ride along unmodified."""
+    Replicated leaves (``dim`` None) ride along unmodified.
+
+    ``matmul_plan`` (qwZ only): ``{leaf index: group_k}`` for 2-D
+    matmul-weight leaves that should be quantized in the FUSED-KERNEL
+    layout (``quantize_for_matmul``: per-(k-group, n) scales) instead
+    of the flat groupwise layout — per-shard quantization tiles the
+    contraction dim evenly, so the gathered shards concatenate into a
+    valid full-weight ``(q [K, N], scale [G, N])`` pair that
+    ``ops/quantized_matmul`` consumes directly
+    (:func:`bucketed_all_gather_finish` ``fused=True``). Wire volume
+    is identical to the flat layout for the same group size; only the
+    scale GEOMETRY changes."""
     from .overlap import plan_reduce_buckets
     n = jax.lax.axis_size(DATA_AXIS)
     if hpz > 1:
@@ -292,22 +306,29 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
             "dims": list(dims),
             "passthrough": [i for i, d in enumerate(dims) if d is None]}
     if qw:
+        from ...ops.quantized_matmul import quantize_for_matmul
+        matmul_plan = matmul_plan or {}
         qitems, sitems, qmeta = [], [], {}
         for i, (p, d) in enumerate(zip(src, dims)):
             if d is None:
                 continue
-            gsz = min(group_size, p.size)
-            q, scale, shape, count = quantize(p, group_size=gsz,
-                                              num_bits=8)
-            qmeta[i] = (q.shape, scale.shape, shape, count, d)
+            if i in matmul_plan:
+                group_k = matmul_plan[i]
+                q, scale = quantize_for_matmul(p, group_k=group_k)
+                qmeta[i] = ("mm", q.shape, scale.shape, group_k, d)
+            else:
+                gsz = min(group_size, p.size)
+                q, scale, shape, count = quantize(p, group_size=gsz,
+                                                  num_bits=8)
+                qmeta[i] = ("flat", q.shape, scale.shape, shape, count, d)
             qitems.append((i, q.reshape(-1)))
             sitems.append((i, scale.reshape(-1)))
         if qitems:
             _log_wire("qwZ_all_gather",
                       sum(int(q.size) for _, q in qitems),
                       sum(int(s.size) for _, s in sitems),
-                      jnp.bfloat16,
-                      sum(int(flat[i].size) for i in qmeta))
+                      sum(int(flat[i].size) * flat[i].dtype.itemsize
+                          for i in qmeta))
         pq, plan_q = pack(qitems, None)
         ps, plan_s = pack(sitems, None)
         meta.update(plan_q=plan_q, plan_s=plan_s, qmeta=qmeta,
@@ -327,12 +348,21 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
     return payloads, meta
 
 
-def bucketed_all_gather_finish(payloads, meta):
+def bucketed_all_gather_finish(payloads, meta, fused=False):
     """CONSUME half of the layer-granular gather: unpack the 1-D wire
     payloads from :func:`bucketed_all_gather_start` back into full
     (dequantized under qwZ) leaves. This is where the qwZ dequantize
     runs — at consumption, so a prefetch pipeline carries int8 wire
-    data, not fp weights."""
+    data, not fp weights.
+
+    ``fused=True`` (matmul-layout leaves only): hand the assembled
+    ``(int8, scales)`` pair back as a ``MatmulQuantizedTensor`` instead
+    of dequantizing — the consuming block matmul runs
+    ``ops/quantized_matmul`` on it and the fp weight never
+    materializes. The backward re-gather calls this with
+    ``fused=False``: the block VJP needs cotangents against the fp
+    weight, so the recompute consumes the dequantized form (same
+    linearization point, the dequant value)."""
     n_g = meta["n_g"]
     out = [None] * meta["n_leaves"]
 
@@ -355,16 +385,30 @@ def bucketed_all_gather_finish(payloads, meta):
         return parts.reshape(new_shape)
 
     if meta["qw"]:
+        from ...ops.quantized_matmul import MatmulQuantizedTensor
         q_all = unpack(payloads[:meta["n_q"]], meta["plan_q"])
         s_all = unpack(payloads[meta["n_q"]:meta["n_q"] + meta["n_s"]],
                        meta["plan_s"])
         n_buckets = meta["n_q"] + meta["n_s"]
-        for i, (qshape, sshape, shape, count, d) in meta["qmeta"].items():
-            qa = q_all[i].reshape((n_g,) + tuple(qshape))
-            sa = s_all[i].reshape((n_g,) + tuple(sshape))
-            deq = jax.vmap(lambda qi, si: dequantize(
-                qi, si, shape, count))(qa, sa)
-            out[i] = assemble(deq.reshape(n_g, -1), shape, d)
+        for i, ent in meta["qmeta"].items():
+            if ent[0] == "mm":
+                _, qshape, sshape, group_k, d = ent
+                qa = q_all[i].reshape((n_g,) + tuple(qshape))
+                sa = s_all[i].reshape((n_g,) + tuple(sshape))
+                # shards tile the contraction (or n) dim evenly, so
+                # concatenating q and scale along the SAME dim yields a
+                # consistent full-weight fused-layout pair
+                mqt = MatmulQuantizedTensor(
+                    assemble(qa.reshape(n_g, -1), qshape, d),
+                    assemble(sa.reshape(n_g, -1), sshape, d), group_k)
+                out[i] = mqt if fused else mqt.dequantize()
+            else:
+                _, qshape, sshape, shape, count, d = ent
+                qa = q_all[i].reshape((n_g,) + tuple(qshape))
+                sa = s_all[i].reshape((n_g,) + tuple(sshape))
+                deq = jax.vmap(lambda qi, si: dequantize(
+                    qi, si, shape, count))(qa, sa)
+                out[i] = assemble(deq.reshape(n_g, -1), shape, d)
     else:
         r_all = unpack(payloads[:meta["n_r"]], meta["plan_r"])
         n_buckets = meta["n_r"]
@@ -376,14 +420,14 @@ def bucketed_all_gather_finish(payloads, meta):
 
 
 def bucketed_all_gather(flat, sec, dims, *, qw, hpz, group_size,
-                        bucket_elements):
+                        bucket_elements, matmul_plan=None, fused=False):
     """One-shot layer-granular gather: start + finish back to back
     (the sequential form). Values are bitwise-identical to the
     per-leaf gathers — buckets only batch the data movement."""
     payloads, meta = bucketed_all_gather_start(
         flat, sec, dims, qw=qw, hpz=hpz, group_size=group_size,
-        bucket_elements=bucket_elements)
-    return bucketed_all_gather_finish(payloads, meta)
+        bucket_elements=bucket_elements, matmul_plan=matmul_plan)
+    return bucketed_all_gather_finish(payloads, meta, fused=fused)
 
 
 def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
@@ -536,6 +580,15 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
     if zcfg.zero_quantized_gradients and stage < 2:
         raise HDSConfigError("zero_quantized_gradients (qgZ) requires "
                              "zero stage >= 2 (sharded gradients)")
+    from .overlap import validate_quantized_wire
+    validate_quantized_wire(
+        quantized_reduce_scatter=zcfg.zero_quantized_reduce_scatter,
+        error_feedback=zcfg.zero_reduce_scatter_error_feedback,
+        bits=zcfg.zero_quantized_reduce_scatter_bits,
+        quantized_gradients=zcfg.zero_quantized_gradients,
+        fused_matmul=zcfg.zero_quantized_weights_fused_matmul,
+        quantized_weights=zcfg.zero_quantized_weights,
+        stage=stage)
 
 
 def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
@@ -576,6 +629,21 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     qw = zcfg.zero_quantized_weights
     qg = zcfg.zero_quantized_gradients
     hpz = zcfg.zero_hpz_partition_size
+
+    if (zcfg.zero_quantized_reduce_scatter
+            or zcfg.zero_quantized_weights_fused_matmul) \
+            and layered is None:
+        # both features live inside the layered pipeline's explicit
+        # gather/reduce lanes — the whole-tree fallback's AD-generated
+        # reduce cannot thread residual state through a custom_vjp, and
+        # its gathered tree feeds an opaque loss with no interception
+        # point. Reject loudly instead of silently running full-width.
+        from ..config import HDSConfigError
+        raise HDSConfigError(
+            "zero_quantized_reduce_scatter / "
+            "zero_quantized_weights_fused_matmul require the layered "
+            "ZeRO-3 step: keep zero_optimization.layered_gather=true "
+            "and use a model with a layered spec (models/layered.py)")
 
     def _flat_specs(tree):
         return jax.tree.flatten(
@@ -683,6 +751,7 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
         "mode": "whole-tree", "depth": None,
         "bucket_elements": zcfg.reduce_bucket_size,
         "overlap_comm": zcfg.overlap_comm,
+        "quantized_reduce_scatter": False,
     }
     return micro_fwd_bwd, prepare_secondary, plan_info
 
@@ -739,6 +808,8 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
     from ...comm.overlap import CollectiveIssue
     from ...utils.logging import log_dist
     from .overlap import derive_prefetch_depth, validate_overlap_config
+    from .qwire import (plan_wire_residual_widths,
+                        quantized_bucket_reduce_scatter_mean)
 
     split = make_layered_split(layered)
     prefix, n_layer = layered["layer_prefix"], layered["n_layer"]
@@ -749,6 +820,20 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
     bucket_elems = zcfg.reduce_bucket_size
     ag_bucket = zcfg.allgather_bucket_size
     group_size = 2048
+    # quantized gradient wire (bucketed int8 reduce-scatter + error
+    # feedback) and fused qwZ weight consumption
+    qrs = zcfg.zero_quantized_reduce_scatter
+    qrs_ef = zcfg.zero_reduce_scatter_error_feedback
+    qrs_bits = zcfg.zero_quantized_reduce_scatter_bits
+    fused_mm = zcfg.zero_quantized_weights_fused_matmul
+    if (qrs or fused_mm) and param_shapes is None:
+        from ..config import HDSConfigError
+        raise HDSConfigError(
+            "zero_quantized_reduce_scatter / "
+            "zero_quantized_weights_fused_matmul need the parameter "
+            "shapes at build time (engine passes them; pass "
+            "param_shapes to build_zeropp_micro_fn)")
+    n_data = int(mesh.shape[DATA_AXIS])
 
     def _subtree_dims(spec_tree):
         flat = jax.tree.flatten(
@@ -803,6 +888,70 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
 
     gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size)
 
+    # ---- fused qwZ consumption plan: which block leaves gather in the
+    # matmul (per-(k-group, n) scale) layout. Dense kernels only — the
+    # interceptor consumes exactly those; everything else keeps the
+    # flat layout and dequantizes as before.
+    matmul_plan = None
+    if fused_mm:
+        matmul_plan = {}
+        n_src = hpz if hpz > 1 else n_data
+        block_leaves = jax.tree_util.tree_flatten_with_path(
+            param_shapes[f"{prefix}0"])[0]
+        for j, ((path, leaf), d) in enumerate(zip(block_leaves,
+                                                  block_pdims)):
+            if d not in (0, 1) or leaf.ndim != 2:
+                continue
+            if getattr(path[-1], "key", None) != "kernel":
+                continue
+            # the per-shard contraction length the group size must tile
+            kdim = leaf.shape[0] // n_src if d == 0 else leaf.shape[0]
+            group_k = next((gk for gk in (256, 128, 64, 32, 16, 8, 4, 2,
+                                          1) if gk <= kdim
+                            and kdim % gk == 0), None)
+            if group_k is not None:
+                matmul_plan[j] = group_k
+        log_dist(f"zero-overlap: fused qwZ matmul consumption for "
+                 f"{len(matmul_plan)}/{len(block_leaves)} block leaves",
+                 ranks=[0])
+
+    # ---- quantized reduce-scatter residual plan (error feedback) ----
+    block_res_widths = outer_res_widths = ()
+    if qrs:
+        block_sizes = [int(np.prod(l.shape)) for l in jax.tree.leaves(
+            param_shapes[f"{prefix}0"])]
+        outer_sizes = [int(np.prod(l.shape)) for l in jax.tree.leaves(
+            {k: param_shapes[k] for k in outer_keys})]
+        block_res_widths = plan_wire_residual_widths(
+            block_sizes, block_pdims, bucket_elements=bucket_elems,
+            n=n_data)
+        outer_res_widths = plan_wire_residual_widths(
+            outer_sizes, outer_pdims, bucket_elements=bucket_elems,
+            n=n_data)
+
+    def wire_error_init():
+        """Zero error-feedback residual state, engine-state shaped:
+        per bucket, ``[L, n, n, W]`` (block) / ``[n, n, W]`` (outer)
+        with the leading stack dim sharded on data — each device
+        carries only its own (unsynchronized) ``[n, W]`` residual, the
+        1-bit worker-error layout."""
+        from jax.sharding import NamedSharding
+        block = [jax.device_put(
+            jnp.zeros((n_layer, n_data, n_data, w), jnp.float32),
+            NamedSharding(mesh, PartitionSpec(None, DATA_AXIS)))
+            for w in block_res_widths]
+        outer = [jax.device_put(
+            jnp.zeros((n_data, n_data, w), jnp.float32),
+            NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+            for w in outer_res_widths]
+        return {"block": block, "outer": outer}
+
+    def _wire_error_specs():
+        return {"block": [PartitionSpec(None, DATA_AXIS)
+                          for _ in block_res_widths],
+                "outer": [PartitionSpec(DATA_AXIS)
+                          for _ in outer_res_widths]}
+
     def build_layered_secondary(params_local):
         outer_local, stacked_local = split(params_local)
         sec_outer = build_secondary(outer_local, outer_pdims, hpz)
@@ -835,20 +984,37 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                 check_vma=False)(params)
 
     def micro_fwd_bwd(params, grad_acc, loss_scale, batch, rng, train,
-                      secondary=None):
+                      secondary=None, wire_error=None):
         batch_proj = jax.tree.map(
             lambda leaf: project_spec(batch_spec_of(leaf), DATA_AXIS), batch)
         with_sec = secondary is not None
+        if qrs_ef and wire_error is None:
+            # unfused forward()/report path: seed zero residuals inline
+            wire_error = {
+                "block": [jnp.zeros((n_layer, n_data, n_data, w),
+                                    jnp.float32)
+                          for w in block_res_widths],
+                "outer": [jnp.zeros((n_data, n_data, w), jnp.float32)
+                          for w in outer_res_widths]}
 
         def inner(params_local, grad_acc_local, loss_scale, batch_local,
-                  rng, *maybe_sec):
+                  rng, *extra):
             n = jax.lax.axis_size(DATA_AXIS)
+            extra = list(extra)
             if with_sec:
-                sec_outer, sec_stacked = maybe_sec[0]
+                sec_outer, sec_stacked = extra.pop(0)
             else:
                 sec_outer, sec_stacked = build_layered_secondary(
                     params_local)
             sec_outer, sec_stacked = list(sec_outer), list(sec_stacked)
+            if qrs_ef:
+                werr = extra.pop(0)
+                # engine-state stacked layout -> this device's local
+                # [n, W] residuals (leading data-stacked dim is 1 here)
+                res_block = [r[:, 0] for r in werr["block"]]
+                res_outer = [r[0] for r in werr["outer"]]
+            else:
+                res_block = res_outer = None
 
             outer_local, stacked_local = split(params_local)
             outer_flat, outer_def = jax.tree.flatten(outer_local)
@@ -892,18 +1058,40 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                     sec = [None if s is None else next(it) for s in sec]
                 payloads, meta = bucketed_all_gather_start(
                     flat, sec, block_pdims, qw=qw, hpz=hpz,
-                    group_size=group_size, bucket_elements=ag_bucket)
+                    group_size=group_size, bucket_elements=ag_bucket,
+                    matmul_plan=matmul_plan)
                 gmeta.setdefault("m", meta)
                 return list(iso(tuple(payloads)))
 
-            def g_finish(payloads):
+            def g_finish(payloads, fused=False):
                 return list(iso(tuple(bucketed_all_gather_finish(
-                    list(payloads), gmeta["m"]))))
+                    list(payloads), gmeta["m"], fused=fused))))
 
-            def reduce_cots(flat_cots):
-                return list(iso(tuple(bucketed_reduce_scatter_mean(
-                    flat_cots, block_pdims, bucket_elements=bucket_elems,
-                    qg=qg, group_size=group_size))))
+            def g_finish_fwd(payloads):
+                # the forward consumer: fused-layout leaves stay
+                # (int8, scales) and feed quantized_matmul directly
+                return g_finish(payloads, fused=fused_mm)
+
+            def reduce_cots(flat_cots, res=None):
+                """Reduce lane: returns ``(reduced leaves, new
+                residuals)`` — residuals empty unless the quantized
+                reduce-scatter carries error feedback."""
+                if qrs:
+                    out, nres = quantized_bucket_reduce_scatter_mean(
+                        flat_cots, block_pdims,
+                        bucket_elements=bucket_elems,
+                        group_size=group_size, bits=qrs_bits,
+                        residuals=res, error_feedback=qrs_ef)
+                else:
+                    out = bucketed_reduce_scatter_mean(
+                        flat_cots, block_pdims,
+                        bucket_elements=bucket_elems,
+                        qg=qg, group_size=group_size)
+                    nres = []
+                out = list(iso(tuple(out)))
+                if nres:
+                    nres = list(iso(tuple(nres)))
+                return out, nres
 
             def take(idx):
                 return ([leaf[idx] for leaf in stacked_flat],
@@ -912,9 +1100,20 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
 
             def blk(full_flat, x, key):
                 full_flat, x = iso((tuple(full_flat), x))
-                return iso(block_fn(
-                    jax.tree.unflatten(block_def, list(full_flat)),
-                    x, batch_local, key, train))
+                layer_tree = jax.tree.unflatten(block_def,
+                                                list(full_flat))
+                if fused_mm:
+                    # Dense kernels arrive as (int8, scales); the
+                    # interceptor routes them through quantized_matmul
+                    # so the fp weight never materializes
+                    import flax.linen as fnn
+                    from ...ops.quantized_matmul import \
+                        fused_dense_interceptor
+                    with fnn.intercept_methods(fused_dense_interceptor()):
+                        return iso(block_fn(layer_tree, x, batch_local,
+                                            key, train))
+                return iso(block_fn(layer_tree, x, batch_local, key,
+                                    train))
 
             def blk_vjp(full_flat, x_in, x_cot, key):
                 full_flat, x_in, x_cot = iso(
@@ -958,7 +1157,7 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                     # gather lane: issue layer t+1's all-gather; nothing
                     # in this iteration consumes it (goes to the carry)
                     nxt = g_start(nxt_flat, nxt_sec)
-                    y = blk(g_finish(cur), x_t, key)
+                    y = blk(g_finish_fwd(cur), x_t, key)
                     return (y, nxt), x_t
 
                 (y, _), xs_stack = jax.lax.scan(
@@ -969,7 +1168,7 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
 
                 def fwd_body0(x_t, xs_t):
                     flat_t, sec_t, key = xs_t
-                    full = g_finish(g_start(flat_t, sec_t))
+                    full = g_finish_fwd(g_start(flat_t, sec_t))
                     return blk(full, x_t, key), x_t
 
                 y, xs_stack = jax.lax.scan(
@@ -1003,40 +1202,60 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                 zero_cot = [jnp.zeros_like(g)
                             for g in g_finish(g_init)]
 
+                # error-feedback residual xs: iteration t reduces layer
+                # t+1's cotangents, so it consumes res[t+1]; the junk
+                # zero-seed reduce at t=L-1 gets a zero residual (its
+                # real res[0] is consumed by the layer-0 reduce below)
+                if qrs_ef:
+                    res_x = [jnp.concatenate(
+                        [r[1:], jnp.zeros_like(r[:1])], axis=0)
+                        for r in res_block]
+                else:
+                    res_x = []
                 xs_b = (xs_stack,
                         [jnp.roll(leaf, 1, axis=0)
                          for leaf in stacked_flat],
                         [None if s is None else jnp.roll(s, 1, axis=0)
                          for s in sec_stacked],
-                        keys[:n_layer])
+                        keys[:n_layer],
+                        res_x)
 
                 def bwd_body(carry, xs_t):
                     x_cot_t, pending, cur = carry
-                    x_in, prev_f, prev_s, key = xs_t
+                    x_in, prev_f, prev_s, key, res_t = xs_t
                     # reduce lane: layer t+1's cotangent buckets (from
                     # the carry — independent of this body's compute)
-                    reduced = reduce_cots(pending)
+                    reduced, res_out = reduce_cots(
+                        pending, res_t if qrs_ef else None)
                     # gather lane: layer t-1's params for next iteration
                     nxt = g_start(prev_f, prev_s)
                     cot, x_cot_out = blk_vjp(g_finish(cur), x_in,
                                              x_cot_t, key)
-                    return (x_cot_out, cot, nxt), reduced
+                    return (x_cot_out, cot, nxt), (reduced, res_out)
 
-                (x_cot, pending0, _), red_stack = jax.lax.scan(
-                    bwd_body, (y_cot, zero_cot, g_init), xs_b,
-                    reverse=True)
-                red0 = reduce_cots(pending0)
+                (x_cot, pending0, _), (red_stack, res_stack) = \
+                    jax.lax.scan(
+                        bwd_body, (y_cot, zero_cot, g_init), xs_b,
+                        reverse=True)
+                red0, res0_out = reduce_cots(
+                    pending0,
+                    [r[0] for r in res_block] if qrs_ef else None)
                 # red_stack[t] = reduced layer t+1 for t <= L-2;
                 # red_stack[L-1] is the zero-seed junk — dropped
                 stacked_grads = [
                     jnp.concatenate([r0[None], rs[:n_layer - 1]], axis=0)
                     for r0, rs in zip(red0, red_stack)]
+                new_res_block = [
+                    jnp.concatenate([r0[None], rs[:n_layer - 1]], axis=0)
+                    for r0, rs in zip(res0_out, res_stack)] \
+                    if qrs_ef else []
             else:
                 def bwd_body0(x_cot_t, xs_t):
-                    x_in, flat_t, sec_t, key = xs_t
+                    x_in, flat_t, sec_t, key, res_t = xs_t
                     full = g_finish(g_start(flat_t, sec_t))
                     cot, x_cot_out = blk_vjp(full, x_in, x_cot_t, key)
-                    reduced = reduce_cots(cot)
+                    reduced, res_out = reduce_cots(
+                        cot, res_t if qrs_ef else None)
                     # The REAL serialization here is structural: the
                     # gather is consumed by this body's recompute and
                     # the reduce consumes this body's cotangents, so
@@ -1048,14 +1267,16 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                     anchors = [r for r, d in zip(reduced, block_pdims)
                                if d is not None]
                     x_cot_out = CollectiveIssue.fence(x_cot_out, *anchors)
-                    return x_cot_out, reduced
+                    return x_cot_out, (reduced, res_out)
 
-                x_cot, red_stack = jax.lax.scan(
+                x_cot, (red_stack, res_stack) = jax.lax.scan(
                     bwd_body0, y_cot,
                     (xs_stack, stacked_flat, sec_stacked,
-                     keys[:n_layer]),
+                     keys[:n_layer],
+                     res_block if qrs_ef else []),
                     reverse=True)
                 stacked_grads = list(red_stack)
+                new_res_block = list(res_stack) if qrs_ef else []
 
             _, embed_vjp = jax.vjp(
                 lambda of: embed_fn(of, batch_local, keys[n_layer], train),
@@ -1063,10 +1284,19 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
             (outer_cot_e,) = embed_vjp(iso(x_cot))
             outer_cot_e = iso(outer_cot_e)
             outer_cot = jax.tree.map(jnp.add, outer_cot_h, outer_cot_e)
-            outer_red = bucketed_reduce_scatter_mean(
-                jax.tree.flatten(outer_cot)[0], outer_pdims,
-                bucket_elements=bucket_elems, qg=qg,
-                group_size=group_size)
+            new_res_outer = []
+            if qrs:
+                outer_red, new_res_outer = \
+                    quantized_bucket_reduce_scatter_mean(
+                        jax.tree.flatten(outer_cot)[0], outer_pdims,
+                        bucket_elements=bucket_elems,
+                        group_size=group_size, bits=qrs_bits,
+                        residuals=res_outer, error_feedback=qrs_ef)
+            else:
+                outer_red = bucketed_reduce_scatter_mean(
+                    jax.tree.flatten(outer_cot)[0], outer_pdims,
+                    bucket_elements=bucket_elems, qg=qg,
+                    group_size=group_size)
 
             grads = dict(jax.tree.unflatten(outer_def, outer_red))
             for i in range(n_layer):
@@ -1079,11 +1309,19 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
             new_acc = jax.tree.map(jnp.add, grad_acc_local, grads)
             loss_s = loss * loss_scale / gas
             loss_avg = jax.lax.psum(loss_s, DATA_AXIS) / n
+            outs = (loss_avg * gas / loss_scale, new_acc)
+            if qrs_ef:
+                # back to the engine-state stacked layout ([.., 1, n, W]
+                # locally; the jit boundary sees the data-stacked dim)
+                outs = outs + ({"block": [r[:, None]
+                                          for r in new_res_block],
+                                "outer": [r[None]
+                                          for r in new_res_outer]},)
             if _ZO_DEBUG:
                 taps = {"y": y, "y_cot": y_cot, "xs_stack": xs_stack,
                         "gfirst": _dbg_gfirst, "loss": loss}
-                return loss_avg * gas / loss_scale, new_acc, taps
-            return loss_avg * gas / loss_scale, new_acc
+                outs = outs + (taps,)
+            return outs
 
         in_specs = [params_proj, grads_proj, PartitionSpec(), batch_proj,
                     PartitionSpec()]
@@ -1091,7 +1329,12 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         if with_sec:
             in_specs.append(_sec_specs())
             args.append(secondary)
+        if qrs_ef:
+            in_specs.append(_wire_error_specs())
+            args.append(wire_error)
         out_specs = (PartitionSpec(), grads_proj)
+        if qrs_ef:
+            out_specs = out_specs + (_wire_error_specs(),)
         if _ZO_DEBUG:
             P = PartitionSpec
             out_specs = out_specs + ({"y": P(DATA_AXIS), "y_cot": P(DATA_AXIS),
@@ -1108,5 +1351,15 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         "mode": "layered", "depth": depth, "reason": plan.reason,
         "n_layer": n_layer, "bucket_elements": bucket_elems,
         "overlap_comm": zcfg.overlap_comm,
+        "quantized_reduce_scatter": qrs,
+        "error_feedback": qrs_ef,
+        "wire_bits": qrs_bits if qrs else None,
+        "fused_matmul_leaves": len(matmul_plan) if matmul_plan else 0,
+        "wire_error_buckets": len(block_res_widths)
+        + len(outer_res_widths),
     }
+    if qrs_ef:
+        # non-JSON engine hook: allocates the error-feedback state
+        # (the engine pops it off before logging the plan)
+        plan_info["wire_error_init"] = wire_error_init
     return micro_fwd_bwd, prepare_secondary, plan_info

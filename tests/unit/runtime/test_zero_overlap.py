@@ -163,6 +163,118 @@ class TestKnobValidation:
             ZeroConfig(stage3_prefetch_bucket_size=-1)
 
 
+class TestQuantizedWireConfig:
+    """Typed rejection of nonsensical quantized-wire knob combinations
+    — parse-time (ZeroConfig validator) and engine-build
+    (validate_zeropp), no silent clamps."""
+
+    def test_error_feedback_without_quantized_wire_rejected(self):
+        with pytest.raises(HDSConfigError, match="error_feedback"):
+            from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+            ZeroConfig(zero_reduce_scatter_error_feedback=True)
+
+    def test_bad_bits_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="bits"):
+            ZeroConfig(zero_quantized_reduce_scatter=True,
+                       zero_quantized_reduce_scatter_bits=16)
+
+    def test_bits_without_quantized_wire_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="no effect"):
+            ZeroConfig(zero_quantized_reduce_scatter_bits=4)
+
+    def test_qrs_and_qgz_mutually_exclusive(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="mutually exclusive"):
+            ZeroConfig(stage=3, zero_quantized_reduce_scatter=True,
+                       zero_quantized_gradients=True)
+
+    def test_fused_matmul_without_qwz_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="fused_matmul"):
+            ZeroConfig(zero_quantized_weights_fused_matmul=True)
+
+    def test_qrs_requires_stage3(self):
+        from hcache_deepspeed_tpu.runtime.zero.overlap import \
+            validate_quantized_wire
+        with pytest.raises(HDSConfigError, match="stage 3"):
+            validate_quantized_wire(
+                quantized_reduce_scatter=True, error_feedback=False,
+                bits=8, quantized_gradients=False, stage=2)
+
+    def test_valid_combination_accepted(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        z = ZeroConfig(stage=3, zero_quantized_weights=True,
+                       zero_quantized_reduce_scatter=True,
+                       zero_reduce_scatter_error_feedback=True,
+                       zero_quantized_reduce_scatter_bits=4,
+                       zero_quantized_weights_fused_matmul=True)
+        assert z.zero_quantized_reduce_scatter
+
+
+class TestDominoInt8Wire:
+
+    def test_int8_wire_parity_and_error_feedback(self, eight_devices):
+        """Opt-in int8 wire for the half-batch all-reduces: tolerance-
+        gated parity against the full-width psum, and the carried
+        residual actually compensates (two-step EF average beats the
+        one-shot error). Full-width remains the default path."""
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        from hcache_deepspeed_tpu.runtime.domino import domino_split_async
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("tensor",))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 16, 64)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+        W = (P(), P(None, "tensor"), P("tensor",))
+
+        def shm(f, ins, outs):
+            return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=ins,
+                                         out_specs=outs, check_vma=False))
+
+        def full_fn(xx, a, b):
+            return domino_split_async(
+                lambda h: jax.nn.gelu(h @ a) @ b,
+                lambda t: jax.lax.psum(t, "tensor"), xx)
+
+        def q_fn(xx, a, b):
+            return domino_split_async(
+                lambda h: jax.nn.gelu(h @ a) @ b,
+                lambda t: jax.lax.psum(t, "tensor"), xx,
+                wire_bits=8, axis="tensor")
+
+        def q_fn2(xx, a, b, e0, e1):
+            return domino_split_async(
+                lambda h: jax.nn.gelu(h @ a) @ b,
+                lambda t: jax.lax.psum(t, "tensor"), xx,
+                wire_bits=8, axis="tensor", wire_error=(e0, e1))
+
+        y_full = shm(full_fn, W, P())(x, w1, w2)
+        y_q, errs = shm(q_fn, W, (P(), (P(), P())))(x, w1, w2)
+        rel = float(jnp.max(jnp.abs(y_q - y_full))
+                    / jnp.max(jnp.abs(y_full)))
+        assert rel < 0.02, rel
+        y_q2, _ = shm(q_fn2, W + (P(), P()), (P(), (P(), P())))(
+            x, w1, w2, errs[0], errs[1])
+        avg = np.asarray((y_q + y_q2) / 2)
+        one_shot = float(np.max(np.abs(np.asarray(y_q - y_full))))
+        ef_avg = float(np.max(np.abs(avg - np.asarray(y_full))))
+        assert ef_avg < one_shot, (ef_avg, one_shot)
+
+    def test_wire_bits_requires_axis(self):
+        import jax.numpy as jnp
+
+        from hcache_deepspeed_tpu.runtime.domino import domino_split_async
+        with pytest.raises(ValueError, match="axis"):
+            domino_split_async(lambda h: h, lambda t: t,
+                               jnp.ones((4, 2)), wire_bits=8)
+
+
 class TestPlanUnits:
 
     def test_depth_derivation(self):

@@ -108,6 +108,89 @@ class TestPrefetchVsWholeTree:
         np.testing.assert_allclose(la, lw, rtol=1e-4)
 
 
+class TestQuantizedWire:
+    """The bucketed int8 reduce-scatter with error feedback and the
+    fused qwZ matmul consumption: (a) depth-1 vs depth-0 stays BITWISE
+    under quantization — the quantized wire changes the math vs
+    full-width, never between the two schedules; (b) the error-feedback
+    loss trajectory tracks the full-width run within tolerance over
+    multiple steps (fp32 and bf16 — the acceptance gate)."""
+
+    QRS = dict(zero_quantized_reduce_scatter=True,
+               zero_reduce_scatter_error_feedback=True)
+
+    def test_qrs_bitwise_depth_parity_fp32(self, eight_devices):
+        _assert_bitwise(_gpt2, zero_quantized_weights=True, **self.QRS)
+
+    def test_qrs_bitwise_depth_parity_bf16(self, eight_devices):
+        _assert_bitwise(_gpt2, bf16=True, zero_quantized_weights=True,
+                        **self.QRS)
+
+    @pytest.mark.parametrize("bf16", [False, True],
+                             ids=["fp32", "bf16"])
+    def test_qrs_error_feedback_loss_trajectory(self, eight_devices,
+                                                bf16):
+        """Multi-step loss-trajectory parity gate: quantized wire +
+        error feedback vs the full-width wire, same schedule."""
+        q = _build(_gpt2, True, bf16=bf16, zero_quantized_weights=True,
+                   **self.QRS)
+        f = _build(_gpt2, True, bf16=bf16, zero_quantized_weights=True)
+        batch = _batch()
+        lq = [float(q.train_batch(batch=batch)) for _ in range(5)]
+        lf = [float(f.train_batch(batch=batch)) for _ in range(5)]
+        assert lq[-1] < lq[0]           # still training
+        np.testing.assert_allclose(lq, lf, rtol=5e-2)
+
+    def test_qrs_without_error_feedback_also_trains(self, eight_devices):
+        """EF off is a legal (comparison) mode: quantization error is
+        dropped, the trajectory drifts further but must stay sane."""
+        q = _build(_gpt2, True, zero_quantized_weights=True,
+                   zero_quantized_reduce_scatter=True)
+        batch = _batch()
+        lq = [float(q.train_batch(batch=batch)) for _ in range(4)]
+        assert lq[-1] < lq[0]
+
+    def test_qrs_int4_wire_trajectory(self, eight_devices):
+        q = _build(_gpt2, True, zero_quantized_weights=True,
+                   zero_quantized_reduce_scatter_bits=4, **self.QRS)
+        f = _build(_gpt2, True, zero_quantized_weights=True)
+        batch = _batch()
+        lq = [float(q.train_batch(batch=batch)) for _ in range(4)]
+        lf = [float(f.train_batch(batch=batch)) for _ in range(4)]
+        assert lq[-1] < lq[0]
+        np.testing.assert_allclose(lq, lf, rtol=1e-1)
+
+    def test_fused_matmul_bitwise_depth_parity(self, eight_devices):
+        _assert_bitwise(_gpt2, zero_quantized_weights=True,
+                        zero_quantized_weights_fused_matmul=True)
+
+    def test_fused_matmul_matches_dequant_path(self, eight_devices):
+        """Fused (int8, scales) consumption vs dequant-then-matmul:
+        same quantized weights, different consumption — losses agree
+        within the kernel's documented tile tolerance."""
+        fz = _build(_gpt2, True, zero_quantized_weights=True,
+                    zero_quantized_weights_fused_matmul=True)
+        dq = _build(_gpt2, True, zero_quantized_weights=True)
+        batch = _batch()
+        lfz = [float(fz.train_batch(batch=batch)) for _ in range(4)]
+        ldq = [float(dq.train_batch(batch=batch)) for _ in range(4)]
+        np.testing.assert_allclose(lfz, ldq, rtol=2e-2)
+
+    def test_wire_error_state_persists_and_moves(self, eight_devices):
+        """The residual state is engine state: allocated at build,
+        updated every step, carried through the optimizer boundary."""
+        q = _build(_gpt2, True, zero_quantized_weights=True, **self.QRS)
+        assert q.state["wire_error"] is not None
+        before = [np.asarray(r).copy()
+                  for r in q.state["wire_error"]["block"]]
+        batch = _batch()
+        q.train_batch(batch=batch)
+        after = [np.asarray(r) for r in q.state["wire_error"]["block"]]
+        assert any(not np.array_equal(b, a)
+                   for b, a in zip(before, after))
+        assert all(np.isfinite(a).all() for a in after)
+
+
 class TestGradAccumulation:
 
     def test_gas2_bitwise(self, eight_devices):
