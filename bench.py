@@ -245,7 +245,26 @@ def _error_payload(message):
             payload["stale"] = True
             payload["stale_utc"] = best.get("utc", "")
         payload["extra"] = {"last_measured": state}
+        # surface the wedge age as a number (queryable gauge via the
+        # perf registry — ROADMAP item 5's condition stops being a
+        # log-archaeology exercise)
+        age = _staleness_days(payload.get("stale_utc", ""))
+        if age is not None:
+            payload["extra"]["staleness_days"] = round(age, 2)
     return payload
+
+
+def _staleness_days(stale_utc):
+    """Age in days of a ``%Y-%m-%dT%H:%M:%SZ`` timestamp (None when
+    absent/unparseable)."""
+    if not stale_utc:
+        return None
+    try:
+        then = time.mktime(time.strptime(
+            stale_utc, "%Y-%m-%dT%H:%M:%SZ")) - time.timezone
+    except ValueError:
+        return None
+    return max(0.0, (time.time() - then) / 86400.0)
 
 
 def _error_exit_code(payload):
@@ -715,6 +734,18 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     rows.append(summary)
+    # regression sentinel: self-compare against the committed
+    # trajectory BEFORE writing — the verdicts ride in the artifact
+    # (non-fatal here; `perf check` is the gate with an exit code)
+    from hcache_deepspeed_tpu.perf import self_check_rows
+    check_row = self_check_rows(out_path, rows)
+    rows.append(check_row)
+    if check_row.get("regressions"):
+        print(f"[bench] perf-check: {len(check_row['regressions'])} "
+              f"headline regression(s) vs committed trajectory: "
+              + "; ".join(r["metric"]
+                          for r in check_row["regressions"]),
+              file=sys.stderr)
     with open(out_path, "w") as fh:
         for row in rows:
             fh.write(json.dumps(row) + "\n")

@@ -897,8 +897,35 @@ def run_serve_loop(model_size="tiny", max_context=128, prompt_len=48,
           "gen_tokens_per_sec": round(
               s["counters"]["tokens_out"] / max(wall_s, 1e-9), 1),
           "extra": {"step_breakdown": step_breakdown}})
+
+    # SLO burn rates + a format-validated Prometheus snapshot: the
+    # exposition payload itself is operator surface, the artifact
+    # records that it validated and what the burn gauges read at
+    # trace end (ROADMAP item 4's future degradation input signal)
+    from ..telemetry.prometheus import validate_prometheus_text
+    snap = server.metrics_snapshot()
+    prom_errors = validate_prometheus_text(snap["prometheus"])
+    emit({"phase": "serve-loop-slo",
+          "burn_rates": {o["name"]: o["burn_rate"]
+                         for o in s.get("slo", {}).get("objectives",
+                                                       [])},
+          "objectives": s.get("slo", {}).get("objectives", []),
+          "degraded_fraction":
+              s.get("slo", {}).get("degraded_fraction", 0.0),
+          "prometheus_bytes": len(snap["prometheus"]),
+          "prometheus_valid": not prom_errors,
+          "prometheus_errors": prom_errors[:5]})
+
+    # regression sentinel self-compare vs the committed trajectory
+    # (non-fatal: the artifact records the verdicts, `perf check`
+    # gates with an exit code)
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "SERVE_LOOP.jsonl", results))
     if fh is not None:
         fh.close()
+    if prom_errors:
+        raise RuntimeError(
+            f"prometheus snapshot failed validation: {prom_errors}")
     if dropped:
         raise RuntimeError(
             f"serve_loop dropped {len(dropped)} requests: "

@@ -14,11 +14,32 @@ from ..utils.logging import logger
 
 
 class Monitor:
+    """Base sink contract.
+
+    ``write_events(event_list)`` consumes ``(label, value, step)``
+    tuples. **Durability is sink-specific**: a writer MAY buffer
+    internally and is not required to make events durable per call
+    (``CSVMonitor`` buffers through the csv file handles;
+    ``TensorBoardMonitor`` happens to flush each call). Callers that
+    need events on disk at a known point — end of a serving trace, a
+    checkpoint boundary — call :meth:`flush`, which every sink
+    supports: the default is an explicit no-op (nothing buffered),
+    buffering sinks override it. Subclasses must NOT add a ``flush=``
+    keyword to ``write_events`` with divergent defaults — that was the
+    old contract drift (TensorBoard flushed per write, CSV didn't),
+    and fan-out callers can't honor per-sink keywords.
+    """
+
     def __init__(self, config):
         self.config = config
 
     def write_events(self, event_list):
         raise NotImplementedError
+
+    def flush(self):
+        """Make previously written events durable. No-op by default;
+        sinks that buffer override."""
+        return None
 
 
 class TensorBoardMonitor(Monitor):
@@ -48,6 +69,10 @@ class TensorBoardMonitor(Monitor):
         for label, value, step in event_list:
             self.summary_writer.add_scalar(label, value, step)
         if flush:
+            self.flush()
+
+    def flush(self):
+        if self.summary_writer is not None:
             self.summary_writer.flush()
 
 
@@ -216,3 +241,7 @@ class MonitorMaster(Monitor):
     def write_events(self, event_list):
         for w in self.writers:
             w.write_events(event_list)
+
+    def flush(self):
+        for w in self.writers:
+            w.flush()

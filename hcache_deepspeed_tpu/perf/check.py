@@ -1,0 +1,339 @@
+"""Regression sentinel: compare fresh perf points against the
+committed trajectory.
+
+The repo's perf story is "claims computed from committed evidence"
+(the hlo_audit / wire-bytes precedent): every number a PR committed as
+evidence is a number a later PR can regress without noticing — unless
+something diffs. This module is that diff:
+
+* :data:`TOLERANCES` declares the **headline metrics** (the ones whose
+  regression fails a check) with per-metric direction + tolerance;
+* :func:`check_points` compares a list of fresh points against a
+  baseline index's ``headline`` block;
+* :func:`check_artifact` parses any file the registry understands and
+  checks it — ``perf check --against PERF_TRAJECTORY.json FILE...``;
+* :func:`self_check_rows` is the in-process hook ``bench.py
+  --zero-overlap`` and ``serve_loop`` call before writing their
+  artifact: the run self-compares and records the verdicts in the
+  artifact itself (non-fatal there — the CLI gate is where failure
+  has an exit code);
+* :func:`self_test` synthesizes a baseline + a regressed point and
+  proves the gate trips — ``perf check --self-test`` runs inside
+  tier-1 (pure CPU, no chip).
+
+A regression verdict compares against the baseline's **best** value
+(per direction). Stale baselines still gate: "the relay is wedged" is
+not a license to regress the last real measurement.
+"""
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .schemas import MetricPoint
+
+
+@dataclass(frozen=True)
+class Tolerance:
+    #: "higher" = bigger is better (throughput), "lower" = smaller is
+    #: better (latency, wire fraction)
+    direction: str = "higher"
+    #: allowed relative slack vs the baseline headline
+    rel: float = 0.05
+    #: absolute slack floor (rescues near-zero baselines)
+    abs: float = 0.0
+
+
+#: the headline metrics the sentinel gates on. Everything else in the
+#: index is informational trajectory.
+TOLERANCES: Dict[str, Tolerance] = {
+    # chip training throughput (stale-guarded history included)
+    "train.tokens_per_sec_per_chip": Tolerance("higher", rel=0.10),
+    "train.mfu": Tolerance("higher", rel=0.10),
+    "train.best_measured_tokens_per_sec": Tolerance("higher", rel=0.05),
+    "chip.best_tokens_per_sec": Tolerance("higher", rel=0.05),
+    "chip.best_mfu": Tolerance("higher", rel=0.05),
+    # ZeRO-3 overlap structure (CPU-deterministic: tight tolerances)
+    "zero_overlap.gather_overlap_ratio": Tolerance("higher", rel=0.02),
+    "zero_overlap.reduce_overlap_ratio": Tolerance("higher", rel=0.02),
+    "zero_overlap.gather_pairs": Tolerance("higher", rel=0.0),
+    "zero_overlap.qrs_wire_fraction_of_fp32":
+        Tolerance("lower", rel=0.05),
+    "zero_overlap.bitwise_parity": Tolerance("higher", rel=0.0),
+    "zero_overlap.qrs_bitwise_depth_parity":
+        Tolerance("higher", rel=0.0),
+    "zero_overlap.qrs_trajectory_within_tol":
+        Tolerance("higher", rel=0.0),
+    # serve-loop percentiles (wall-clock on shared CI hosts: loose)
+    "serve_loop.ttft_s_p50": Tolerance("lower", rel=0.50, abs=0.5),
+    "serve_loop.ttft_s_p99": Tolerance("lower", rel=0.50, abs=0.5),
+    "serve_loop.tpot_s_p50": Tolerance("lower", rel=0.50, abs=0.05),
+    "serve_loop.tpot_s_p99": Tolerance("lower", rel=0.50, abs=0.05),
+    "serve_loop.gen_tokens_per_sec": Tolerance("higher", rel=0.50),
+    "serve_loop.restore_overlap_ratio": Tolerance("higher", rel=0.05),
+    "serve_loop.restore_parity_ok": Tolerance("higher", rel=0.0),
+    "serve_loop.dropped": Tolerance("lower", rel=0.0),
+    # chaos invariants are booleans: any drop from 1.0 fails
+    "chaos.deterministic": Tolerance("higher", rel=0.0),
+    "chaos.invariants_ok": Tolerance("higher", rel=0.0),
+    "chaos.ckpt_fallback_ok": Tolerance("higher", rel=0.0),
+    # freshness alarm (ROADMAP item 5): informational headline — the
+    # gate never fails on it (direction "lower" but compared via the
+    # freshness block, not check_points)
+}
+
+
+@dataclass
+class Verdict:
+    metric: str
+    status: str                  # "ok" | "regression" | "improved" | \
+    #                              "no-baseline"
+    new_value: float
+    baseline: Optional[float] = None
+    baseline_file: str = ""
+    limit: Optional[float] = None
+    detail: str = ""
+
+    def to_json(self) -> Dict:
+        out = {"metric": self.metric, "status": self.status,
+               "new_value": self.new_value}
+        if self.baseline is not None:
+            out["baseline"] = self.baseline
+            out["baseline_file"] = self.baseline_file
+        if self.limit is not None:
+            out["limit"] = round(self.limit, 6)
+        if self.detail:
+            out["detail"] = self.detail
+        return out
+
+
+def _limit(baseline: float, tol: Tolerance) -> float:
+    slack = abs(baseline) * tol.rel + tol.abs
+    return baseline - slack if tol.direction == "higher" \
+        else baseline + slack
+
+
+def check_points(points: List[MetricPoint],
+                 baseline_index: Dict) -> List[Verdict]:
+    """Compare fresh points against the baseline index headline. Only
+    headline metrics produce verdicts; multiple fresh points for one
+    metric are each checked (worst wins the summary)."""
+    headline = baseline_index.get("headline", {})
+    verdicts: List[Verdict] = []
+    for p in points:
+        tol = TOLERANCES.get(p.metric)
+        if tol is None:
+            continue
+        base = headline.get(p.metric)
+        if base is None:
+            verdicts.append(Verdict(p.metric, "no-baseline", p.value))
+            continue
+        # like-for-like only: a point measured on a different config /
+        # workload than the headline is a different program, not a
+        # regression candidate (vet runs of 7B-layer shapes must not
+        # "regress" the 350m headline)
+        bcfg = (base.get("tags") or {}).get("config")
+        pcfg = p.tags.get("config")
+        if bcfg and pcfg and bcfg != pcfg:
+            continue
+        limit = _limit(base["value"], tol)
+        if tol.direction == "higher":
+            bad = p.value < limit
+            better = p.value > base["value"]
+        else:
+            bad = p.value > limit
+            better = p.value < base["value"]
+        status = "regression" if bad else (
+            "improved" if better else "ok")
+        detail = ""
+        if bad:
+            detail = (f"{p.value} vs baseline {base['value']} "
+                      f"({base['file']}), limit {round(limit, 6)} "
+                      f"[{tol.direction} is better]")
+        verdicts.append(Verdict(p.metric, status, p.value,
+                                baseline=base["value"],
+                                baseline_file=base["file"],
+                                limit=limit, detail=detail))
+    return verdicts
+
+
+def regressions(verdicts: List[Verdict]) -> List[Verdict]:
+    return [v for v in verdicts if v.status == "regression"]
+
+
+def check_headline(fresh_index: Dict,
+                   baseline_index: Dict) -> List[Verdict]:
+    """The repo-level gate: rebuild the index from the working tree
+    and require every gated headline metric to still reach the
+    committed baseline's headline (within tolerance). History is not
+    re-judged — old rounds stay old rounds; what must not happen is
+    the *best committed evidence* for a metric getting worse (an
+    artifact regenerated with a worse number, or deleted so a worse
+    one becomes the best)."""
+    base_head = baseline_index.get("headline", {})
+    fresh_head = fresh_index.get("headline", {})
+    verdicts: List[Verdict] = []
+    for metric, base in base_head.items():
+        tol = TOLERANCES.get(metric)
+        if tol is None:
+            continue
+        fresh = fresh_head.get(metric)
+        if fresh is None:
+            verdicts.append(Verdict(
+                metric, "regression", float("nan"),
+                baseline=base["value"], baseline_file=base["file"],
+                detail=f"headline metric vanished from the tree "
+                       f"(was {base['value']} in {base['file']})"))
+            continue
+        limit = _limit(base["value"], tol)
+        if tol.direction == "higher":
+            bad = fresh["value"] < limit
+            better = fresh["value"] > base["value"]
+        else:
+            bad = fresh["value"] > limit
+            better = fresh["value"] < base["value"]
+        status = "regression" if bad else (
+            "improved" if better else "ok")
+        detail = ""
+        if bad:
+            detail = (f"tree headline {fresh['value']} "
+                      f"({fresh['file']}) vs committed "
+                      f"{base['value']} ({base['file']}), limit "
+                      f"{round(limit, 6)} [{tol.direction} is better]")
+        verdicts.append(Verdict(metric, status, fresh["value"],
+                                baseline=base["value"],
+                                baseline_file=base["file"],
+                                limit=limit, detail=detail))
+    return verdicts
+
+
+def check_artifact(path: str,
+                   baseline_index: Dict) -> List[Verdict]:
+    """Parse ``path`` with its registry schema and gate it."""
+    from .schemas import parse_artifact
+    parsed = parse_artifact(path, os.path.basename(path))
+    return check_points(parsed.points, baseline_index)
+
+
+def self_check_rows(filename: str, rows: List[Dict],
+                    root: Optional[str] = None) -> Dict:
+    """The bench hook: parse ``rows`` (the artifact about to be
+    written) through ``filename``'s family schema and compare against
+    the committed index. Returns a JSON-safe summary row the bench
+    appends to its artifact; never raises and never blocks the write —
+    a bench run's job is to record evidence, the CLI gate's job is to
+    fail on it."""
+    from .registry import INDEX_NAME, load_index, repo_root
+    from .schemas import classify
+    try:
+        root = root or repo_root()
+    except FileNotFoundError:
+        return {"phase": "perf-check", "skipped": "no repo root"}
+    fam = classify(os.path.basename(filename))
+    if fam is None:
+        return {"phase": "perf-check",
+                "skipped": f"no schema for {filename}"}
+    try:
+        baseline = load_index(root=root)
+    except (OSError, json.JSONDecodeError) as exc:
+        return {"phase": "perf-check",
+                "skipped": f"no committed {INDEX_NAME}: {exc}"}
+    text = "\n".join(json.dumps(r) for r in rows)
+    try:
+        points = fam.parser(text, os.path.basename(filename))
+        verdicts = check_points(points, baseline)
+    except Exception as exc:   # noqa: BLE001 — evidence first
+        return {"phase": "perf-check", "skipped": f"parse: {exc!r}"}
+    regs = regressions(verdicts)
+    return {
+        "phase": "perf-check",
+        "against": INDEX_NAME,
+        "baseline_generated_utc": baseline.get("generated_utc"),
+        "checked": len(verdicts),
+        "regressions": [v.to_json() for v in regs],
+        "ok": not regs,
+    }
+
+
+# ----------------------------------------------------------------- #
+def self_test(verbose: bool = False) -> bool:
+    """Prove the gate trips: build a synthetic baseline index, a
+    matching fresh artifact, then regress one headline metric per
+    direction and assert the verdicts flip. Pure CPU, no chip, no
+    repo state — runs inside tier-1."""
+    baseline = {
+        "headline": {
+            "train.tokens_per_sec_per_chip": {
+                "value": 50000.0, "file": "BENCH_FRESH.json",
+                "direction": "higher", "rel_tolerance": 0.10,
+                "abs_tolerance": 0.0},
+            "zero_overlap.qrs_wire_fraction_of_fp32": {
+                "value": 0.33, "file": "ZERO_OVERLAP.jsonl",
+                "direction": "lower", "rel_tolerance": 0.05,
+                "abs_tolerance": 0.0},
+            "chaos.deterministic": {
+                "value": 1.0, "file": "CHAOS_SERVE.jsonl",
+                "direction": "higher", "rel_tolerance": 0.0,
+                "abs_tolerance": 0.0},
+        }
+    }
+    ok_points = [
+        MetricPoint("train.tokens_per_sec_per_chip", 49000.0, "new"),
+        MetricPoint("zero_overlap.qrs_wire_fraction_of_fp32", 0.32,
+                    "new"),
+        MetricPoint("chaos.deterministic", 1.0, "new"),
+    ]
+    bad_points = [
+        MetricPoint("train.tokens_per_sec_per_chip", 40000.0, "new"),
+        MetricPoint("zero_overlap.qrs_wire_fraction_of_fp32", 0.50,
+                    "new"),
+        MetricPoint("chaos.deterministic", 0.0, "new"),
+    ]
+    ok_verdicts = check_points(ok_points, baseline)
+    bad_verdicts = check_points(bad_points, baseline)
+    checks = [
+        (not regressions(ok_verdicts),
+         "within-tolerance points must pass"),
+        (len(regressions(bad_verdicts)) == 3,
+         "all three synthetic regressions must trip"),
+        (all(v.status == "regression" for v in bad_verdicts),
+         "every regressed point gets a regression verdict"),
+    ]
+    # round-trip through a real file + the artifact path
+    with tempfile.TemporaryDirectory() as tmp:
+        art = os.path.join(tmp, "CHAOS_SERVE.jsonl")
+        with open(art, "w") as fh:
+            fh.write(json.dumps(
+                {"phase": "chaos-summary", "deterministic": False,
+                 "invariants_ok": True, "violations": []}) + "\n")
+        file_verdicts = check_artifact(art, baseline)
+        checks.append(
+            (any(v.status == "regression" and
+                 v.metric == "chaos.deterministic"
+                 for v in file_verdicts),
+             "file-based check must catch the regressed boolean"))
+    passed = all(ok for ok, _ in checks)
+    if verbose or not passed:
+        for ok, what in checks:
+            print(f"[perf self-test] {'PASS' if ok else 'FAIL'}: "
+                  f"{what}")
+    return passed
+
+
+def freshness_alarm(index: Dict, max_age_days: float = 2.0) -> Optional[str]:
+    """The wedged-relay gauge as a check: returns a message when the
+    last real chip measurement is older than ``max_age_days`` (never a
+    hard failure — the relay being down is an environment fact, not a
+    code regression)."""
+    fr = index.get("freshness", {})
+    age = fr.get("staleness_days")
+    if age is None:
+        return "no timestamped chip measurement indexed"
+    if age > max_age_days:
+        return (f"last chip measurement "
+                f"{fr.get('last_chip_measurement_utc')} is "
+                f"{age:.1f} days old (> {max_age_days:g}d): relay "
+                "wedged? (ROADMAP item 5)")
+    return None

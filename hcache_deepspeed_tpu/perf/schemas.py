@@ -1,0 +1,653 @@
+"""Artifact-family schemas for the committed perf evidence.
+
+Every perf artifact this repo commits at its root (bench JSON
+wrappers, ``*_JSONL`` phase streams, chip logs, the dead-relay state
+file) belongs to exactly one **family** declared here: a filename
+pattern plus a parser that turns the file into typed
+:class:`MetricPoint` rows. The registry (``perf.registry``) walks the
+root through :func:`classify`; the golden-schema tier-1 test walks the
+same way and fails when a committed artifact matches no family and is
+not allowlisted in ``perf/KNOWN_UNINDEXED`` — so future PRs cannot
+silently add unindexed evidence files, and ``perf lint`` applies the
+same rule to artifact names written by source code.
+
+Parsers are deliberately tolerant of the artifacts' real-world warts
+(log lines interleaved into JSONL streams, rows embedded in a captured
+``tail`` field, zero-byte files from interrupted chip sessions) but
+STRICT about classification: an unknown name is an error, a known name
+that fails to parse is an error, an empty file is recorded as
+``status="empty"`` — visible, never silently skipped.
+"""
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+#: the bench dead-relay convention (bench.py ``_error_payload``): a
+#: payload carrying ``stale: true`` has no fresh measurement and its
+#: ``stale_utc`` timestamps the last real one
+UTC_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def parse_utc(s: str) -> Optional[float]:
+    try:
+        return time.mktime(time.strptime(s, UTC_FMT)) - time.timezone
+    except (ValueError, TypeError):
+        return None
+
+
+def staleness_days(utc: Optional[str], now: float) -> Optional[float]:
+    t = parse_utc(utc) if utc else None
+    if t is None:
+        return None
+    return max(0.0, (now - t) / 86400.0)
+
+
+@dataclass
+class MetricPoint:
+    """One indexed measurement."""
+    metric: str                  # e.g. "train.tokens_per_sec_per_chip"
+    value: float
+    file: str
+    unit: str = ""
+    phase: str = ""
+    #: measurement timestamp when the artifact carries one
+    utc: Optional[str] = None
+    #: the bench dead-relay stale marker (True = the producing round
+    #: had no fresh chip measurement; value is carried history)
+    stale: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> Dict:
+        out = {"metric": self.metric, "value": self.value,
+               "file": self.file}
+        if self.unit:
+            out["unit"] = self.unit
+        if self.phase:
+            out["phase"] = self.phase
+        if self.utc:
+            out["utc"] = self.utc
+        if self.stale:
+            out["stale"] = True
+        if self.tags:
+            out["tags"] = dict(self.tags)
+        return out
+
+
+@dataclass
+class ParsedArtifact:
+    file: str
+    family: str
+    status: str                      # "ok" | "empty" | "meta"
+    points: List[MetricPoint] = field(default_factory=list)
+    #: artifact-level note (e.g. why it yields no points)
+    note: str = ""
+
+
+# ----------------------------------------------------------------- #
+# raw readers
+# ----------------------------------------------------------------- #
+def read_json(text: str):
+    return json.loads(text)
+
+
+def read_jsonl_rows(text: str) -> List[Dict]:
+    """Every parseable JSON object line; the committed streams carry
+    interleaved engine log lines (``[2026-08-01 ...] [INFO] ...``) that
+    a strict reader would choke on."""
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def json_lines_from_tail(tail: str) -> List[Dict]:
+    """Result lines embedded in a captured subprocess ``tail`` blob
+    (the BENCH_rNN / MULTICHIP_rNN wrapper format)."""
+    return read_jsonl_rows(tail or "")
+
+
+# ----------------------------------------------------------------- #
+# family parsers — each returns a list of MetricPoint
+# ----------------------------------------------------------------- #
+def _bench_payload_points(payload: Dict, file: str) -> List[MetricPoint]:
+    """Points from one bench.py result line (fresh or dead-relay)."""
+    pts: List[MetricPoint] = []
+    if not isinstance(payload, dict):
+        return pts
+    stale = bool(payload.get("stale"))
+    utc = payload.get("stale_utc") or \
+        (payload.get("extra") or {}).get("utc")
+    extra = payload.get("extra") or {}
+    value = payload.get("value")
+    if "metric" in payload and isinstance(value, (int, float)):
+        cfg = str(extra.get("config", ""))
+        tags = {"config": cfg} if cfg else {}
+        if value:
+            pts.append(MetricPoint(
+                "train.tokens_per_sec_per_chip", float(value), file,
+                unit=payload.get("unit", "tokens/sec"),
+                phase="train-bench", utc=utc, stale=stale, tags=tags))
+        if isinstance(extra.get("mfu"), (int, float)) and extra["mfu"]:
+            pts.append(MetricPoint(
+                "train.mfu", float(extra["mfu"]), file,
+                phase="train-bench", utc=utc, stale=stale, tags=tags))
+        if isinstance(payload.get("vs_baseline"), (int, float)) and \
+                payload["vs_baseline"]:
+            pts.append(MetricPoint(
+                "train.vs_baseline", float(payload["vs_baseline"]),
+                file, phase="train-bench", utc=utc, stale=stale,
+                tags=tags))
+        sd = extra.get("staleness_days")
+        if isinstance(sd, (int, float)):
+            pts.append(MetricPoint("bench.staleness_days", float(sd),
+                                   file, unit="days",
+                                   phase="dead-relay", utc=utc,
+                                   stale=True))
+    # dead-relay history rides under extra.last_measured {best,last}
+    lm = extra.get("last_measured") or {}
+    for which in ("best", "last"):
+        rec = lm.get(which)
+        if isinstance(rec, dict) and rec.get("value"):
+            pts.append(MetricPoint(
+                f"train.{which}_measured_tokens_per_sec",
+                float(rec["value"]), file, unit="tokens/sec",
+                phase="chip-history", utc=rec.get("utc"), stale=stale,
+                tags={"config": str(rec.get("config", ""))}))
+            if rec.get("mfu"):
+                pts.append(MetricPoint(
+                    f"train.{which}_measured_mfu", float(rec["mfu"]),
+                    file, phase="chip-history", utc=rec.get("utc"),
+                    stale=stale))
+    return pts
+
+
+def parse_bench_wrapper(text: str, file: str) -> List[MetricPoint]:
+    """BENCH_rNN.json: {n, cmd, rc, tail} with the result line inside
+    ``tail``."""
+    doc = read_json(text)
+    pts: List[MetricPoint] = []
+    fresh = 0
+    for row in json_lines_from_tail(doc.get("tail", "")):
+        pts.extend(_bench_payload_points(row, file))
+        if row.get("value") and "error" not in row:
+            fresh += 1
+    # every round is indexable even when the relay was dead and the
+    # payload carried nothing (value 0.0, no history): the outcome
+    # gauge is the record
+    pts.append(MetricPoint("bench.round_had_fresh_measurement",
+                           1.0 if fresh else 0.0, file,
+                           phase="bench-round"))
+    rnd = doc.get("n")
+    if isinstance(rnd, int):
+        for p in pts:
+            p.tags.setdefault("round", str(rnd))
+    return pts
+
+
+def parse_bench_result(text: str, file: str) -> List[MetricPoint]:
+    """Single bench payload (BENCH_FRESH/BENCH_LOCAL/VET_*): either a
+    result line or a vet-error record ({config, error, mfu: null})."""
+    doc = read_json(text)
+    if "metric" not in doc and "error" in doc:
+        # vet error: indexed as a zero-valued outcome gauge so the
+        # failed-config evidence is queryable, not just archived
+        return [MetricPoint("vet.ok", 0.0, file, phase="config-vet",
+                            tags={"config": str(doc.get("config", ""))})]
+    pts = _bench_payload_points(doc, file)
+    if doc.get("metric") and not doc.get("error"):
+        cfg = str((doc.get("extra") or {}).get("config", ""))
+        pts.append(MetricPoint("vet.ok", 1.0, file, phase="config-vet",
+                               tags={"config": cfg} if cfg else {}))
+    return pts
+
+
+def parse_train_curve(text: str, file: str) -> List[MetricPoint]:
+    doc = read_json(text)
+    utc = doc.get("utc")
+    pts = []
+    for rec in doc.get("results", []):
+        cfg = str(rec.get("config", ""))
+        if rec.get("tokens_per_sec"):
+            pts.append(MetricPoint(
+                "train.curve_tokens_per_sec",
+                float(rec["tokens_per_sec"]), file, unit="tokens/sec",
+                phase="train-curve", utc=utc, tags={"config": cfg}))
+        if rec.get("mfu"):
+            pts.append(MetricPoint(
+                "train.curve_mfu", float(rec["mfu"]), file,
+                phase="train-curve", utc=utc, tags={"config": cfg}))
+    return pts
+
+
+def parse_multichip(text: str, file: str) -> List[MetricPoint]:
+    doc = read_json(text)
+    ok = bool(doc.get("ok")) and not doc.get("skipped")
+    return [MetricPoint("multichip.dryrun_ok", 1.0 if ok else 0.0,
+                        file, phase="multichip-dryrun",
+                        tags={"n_devices":
+                              str(doc.get("n_devices", ""))})]
+
+
+def parse_baseline_meta(text: str, file: str) -> List[MetricPoint]:
+    read_json(text)          # must parse; carries no metric points
+    return []
+
+
+def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        phase = row.get("phase", "")
+        if phase == "summary":
+            utc = row.get("utc")
+            for key, metric in (
+                    ("gather_overlap_ratio_on",
+                     "zero_overlap.gather_overlap_ratio"),
+                    ("reduce_overlap_ratio_on",
+                     "zero_overlap.reduce_overlap_ratio"),
+                    ("prefetch_on_gather_pairs",
+                     "zero_overlap.gather_pairs"),
+                    ("native_async_pairs",
+                     "zero_overlap.native_async_pairs"),
+                    ("qrs_wire_fraction_of_fp32",
+                     "zero_overlap.qrs_wire_fraction_of_fp32")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase, utc=utc))
+            for key, metric in (
+                    ("bitwise_parity", "zero_overlap.bitwise_parity"),
+                    ("qrs_bitwise_depth_parity",
+                     "zero_overlap.qrs_bitwise_depth_parity"),
+                    ("qrs_trajectory_within_tol",
+                     "zero_overlap.qrs_trajectory_within_tol")):
+                if key in row:
+                    pts.append(MetricPoint(metric,
+                                           1.0 if row[key] else 0.0,
+                                           file, phase=phase, utc=utc))
+        elif phase in ("domino-audit", "domino-audit-int8") and \
+                row.get("overlap"):
+            suffix = "int8" if phase.endswith("int8") else "fp"
+            if "derived_async_pairs" in row:
+                pts.append(MetricPoint(
+                    f"domino.derived_async_pairs_{suffix}",
+                    float(row["derived_async_pairs"]), file,
+                    phase=phase))
+    return pts
+
+
+def parse_serve_loop(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        if row.get("phase") == "serve-loop-summary":
+            # the workload identity rides as the config tag so a
+            # differently-shaped trace (smoke run, other rps) is never
+            # gated against the committed acceptance trace
+            tags = {"model": str(row.get("model", "")),
+                    "config": (
+                        f"{row.get('model', '')}"
+                        f"-n{row.get('n_requests', '')}"
+                        f"-rps{row.get('rps', '')}"
+                        f"-p{row.get('prompt_len', '')}"
+                        f"-new{row.get('max_new', '')}"
+                        f"-kv{row.get('kv_blocks', '')}"
+                        f"x{row.get('block_size', '')}"
+                        f"-vc{int(bool(row.get('virtual_clock')))}")}
+            for fam, lower in (("ttft_s", True), ("tpot_s", True),
+                               ("queue_wait_s", True)):
+                block = row.get(fam) or {}
+                for q in ("p50", "p99"):
+                    if isinstance(block.get(q), (int, float)):
+                        pts.append(MetricPoint(
+                            f"serve_loop.{fam}_{q}", float(block[q]),
+                            file, unit="s", phase="serve-loop",
+                            tags=tags))
+            for key, metric in (
+                    ("gen_tokens_per_sec",
+                     "serve_loop.gen_tokens_per_sec"),
+                    ("restore_overlap_ratio",
+                     "serve_loop.restore_overlap_ratio"),
+                    ("preemptions", "serve_loop.preemptions"),
+                    ("restores", "serve_loop.restores"),
+                    ("dropped", "serve_loop.dropped")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase="serve-loop",
+                                           tags=tags))
+            parity = row.get("parity") or {}
+            if parity.get("checked"):
+                pts.append(MetricPoint(
+                    "serve_loop.restore_parity_ok",
+                    1.0 if parity["ok"] == parity["checked"] else 0.0,
+                    file, phase="serve-loop", tags=tags))
+        elif row.get("phase") == "serve-loop-slo":
+            for name, v in (row.get("burn_rates") or {}).items():
+                pts.append(MetricPoint(
+                    f"serve_loop.slo_{name}_burn_rate", float(v),
+                    file, phase="serve-loop-slo"))
+            if "prometheus_valid" in row:
+                pts.append(MetricPoint(
+                    "serve_loop.prometheus_snapshot_valid",
+                    1.0 if row["prometheus_valid"] else 0.0, file,
+                    phase="serve-loop-slo"))
+    return pts
+
+
+def parse_chaos_serve(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        if row.get("phase") == "chaos-summary":
+            pts.append(MetricPoint(
+                "chaos.deterministic",
+                1.0 if row.get("deterministic") else 0.0, file,
+                phase="chaos-summary"))
+            pts.append(MetricPoint(
+                "chaos.invariants_ok",
+                1.0 if row.get("invariants_ok") else 0.0, file,
+                phase="chaos-summary"))
+            pts.append(MetricPoint(
+                "chaos.violations", float(len(row.get("violations",
+                                                      []))),
+                file, phase="chaos-summary"))
+        elif row.get("phase") == "chaos-ckpt":
+            pts.append(MetricPoint(
+                "chaos.ckpt_fallback_ok",
+                1.0 if row.get("fallback_ok") else 0.0, file,
+                phase="chaos-ckpt"))
+    return pts
+
+
+def _workload_tag(file: str) -> Dict[str, str]:
+    """The workload identity is the filename stem — SERVE_7B_INT8 and
+    SERVE_7B measure different programs and must never be compared as
+    one series."""
+    return {"workload": file.rsplit(".", 1)[0]}
+
+
+def parse_serve_bench(text: str, file: str) -> List[MetricPoint]:
+    """SERVE_* / DECODE_DIAG_* / LOOKUP_* / SWEEP_* phase rows from
+    ``inference/benchmark.py``."""
+    rows = read_jsonl_rows(text)
+    tags = _workload_tag(file)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        phase = row.get("phase", "")
+        if "error" in row:
+            continue                     # OOM/fallback notes, not data
+        rtags = dict(tags)
+        if "batch" in row:
+            rtags["batch"] = str(row["batch"])
+        if "offered_rps" in row:
+            rtags["offered_rps"] = str(row["offered_rps"])
+        if "lanes" in row:
+            rtags["lanes"] = str(row["lanes"])
+        if "variant" in row:
+            rtags["variant"] = str(row["variant"])
+        if isinstance(row.get("tokens_per_sec"), (int, float)):
+            pts.append(MetricPoint(
+                f"serve.{phase}.tokens_per_sec",
+                float(row["tokens_per_sec"]), file,
+                unit="tokens/sec", phase=phase, tags=rtags))
+        if isinstance(row.get("ms_per_step"), (int, float)):
+            pts.append(MetricPoint(
+                f"serve.{phase}.ms_per_step",
+                float(row["ms_per_step"]), file, unit="ms",
+                phase=phase, tags=rtags))
+        if isinstance(row.get("ms_per_token"), (int, float)):
+            pts.append(MetricPoint(
+                f"serve.{phase}.ms_per_token",
+                float(row["ms_per_token"]), file, unit="ms",
+                phase=phase, tags=rtags))
+        if isinstance(row.get("gen_tokens_per_sec"), (int, float)):
+            pts.append(MetricPoint(
+                f"serve.{phase}.gen_tokens_per_sec",
+                float(row["gen_tokens_per_sec"]), file,
+                unit="tokens/sec", phase=phase, tags=rtags))
+        if isinstance(row.get("effective_rps"), (int, float)):
+            pts.append(MetricPoint(
+                f"serve.{phase}.effective_rps",
+                float(row["effective_rps"]), file, unit="req/s",
+                phase=phase, tags=rtags))
+        # decode-diag stretch decomposition (hds_decode_diag rows)
+        for key in ("marginal_ms_per_token", "fixed_ms_per_stretch",
+                    "implied_gbps"):
+            if isinstance(row.get(key), (int, float)):
+                pts.append(MetricPoint(
+                    f"serve.{phase}.{key}", float(row[key]), file,
+                    phase=phase, tags=rtags))
+    return pts
+
+
+def parse_restore_bench(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    tags = _workload_tag(file)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        phase = row.get("phase", "")
+        rtags = dict(tags)
+        if "batch" in row:
+            rtags["batch"] = str(row["batch"])
+        if "prompt_len" in row:
+            rtags["prompt_len"] = str(row["prompt_len"])
+        if phase == "hcache-restore" and \
+                isinstance(row.get("speedup"), (int, float)):
+            pts.append(MetricPoint("restore.speedup_e2e",
+                                   float(row["speedup"]), file,
+                                   phase=phase, tags=rtags))
+        elif phase == "hcache-restore-marginal":
+            for key, metric in (
+                    ("speedup_replay", "restore.speedup_replay"),
+                    ("speedup_e2e", "restore.speedup_e2e_marginal"),
+                    ("link_gbps", "restore.link_gbps")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase,
+                                           tags=rtags))
+        elif phase == "restore-crossover-summary":
+            cl = row.get("crossover_prompt_len")
+            if isinstance(cl, (int, float)):
+                pts.append(MetricPoint(
+                    "restore.crossover_prompt_len", float(cl), file,
+                    phase=phase,
+                    tags={"model": str(row.get("model", ""))}))
+    return pts
+
+
+def parse_paged_vet(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    pts = []
+    for row in rows:
+        if row.get("phase") != "paged-vet":
+            continue
+        tags = {"head_tile": str(row.get("head_tile", ""))}
+        pts.append(MetricPoint("paged_vet.ok",
+                               1.0 if row.get("ok") else 0.0, file,
+                               phase="paged-vet", tags=tags))
+        if isinstance(row.get("max_abs_err"), (int, float)):
+            pts.append(MetricPoint("paged_vet.max_abs_err",
+                                   float(row["max_abs_err"]), file,
+                                   phase="paged-vet", tags=tags))
+    return pts
+
+
+def parse_last_measured(text: str, file: str) -> List[MetricPoint]:
+    """.bench_last_measured.json: the chip-truth best/last record the
+    dead-relay path reports from — the canonical freshness source."""
+    doc = read_json(text)
+    pts = []
+    for which in ("best", "last"):
+        rec = doc.get(which)
+        if isinstance(rec, dict) and rec.get("value"):
+            pts.append(MetricPoint(
+                f"chip.{which}_tokens_per_sec", float(rec["value"]),
+                file, unit="tokens/sec", phase="chip-truth",
+                utc=rec.get("utc"),
+                tags={"config": str(rec.get("config", ""))}))
+            if rec.get("mfu"):
+                pts.append(MetricPoint(
+                    f"chip.{which}_mfu", float(rec["mfu"]), file,
+                    phase="chip-truth", utc=rec.get("utc")))
+    return pts
+
+
+_DOMINO_PAIRS_RE = re.compile(
+    r"(\d+)\s+native async pair|native[_ ]async[_ ]pairs\D*(\d+)",
+    re.IGNORECASE)
+_RELAY_LINE_RE = re.compile(r"^(UP|DOWN)(\(\w+\))?\s", re.MULTILINE)
+
+
+def parse_chip_log(text: str, file: str) -> List[MetricPoint]:
+    """Best-effort mining of free-form chip session logs: embedded
+    bench result lines, Domino native-pair verdicts, relay up/down
+    probes. Logs with none of those still index (presence is the
+    point — the file is classified, not ignored)."""
+    pts: List[MetricPoint] = []
+    for row in read_jsonl_rows(text):
+        if isinstance(row, dict) and "metric" in row:
+            for p in _bench_payload_points(row, file):
+                p.phase = p.phase or "chip-log"
+                pts.append(p)
+    if "DOMINO" in file.upper():
+        m = _DOMINO_PAIRS_RE.search(text)
+        if m:
+            n = next(g for g in m.groups() if g is not None)
+            pts.append(MetricPoint("domino.native_async_pairs_on_chip",
+                                   float(n), file, phase="chip-log"))
+    probes = _RELAY_LINE_RE.findall(text)
+    if probes:
+        down = sum(1 for state, _ in probes if state == "DOWN")
+        pts.append(MetricPoint("relay.down_probe_fraction",
+                               down / len(probes), file,
+                               phase="relay-watch",
+                               tags={"probes": str(len(probes))}))
+    return pts
+
+
+def parse_index_meta(text: str, file: str) -> List[MetricPoint]:
+    """PERF_TRAJECTORY.json itself — parses, carries no points (it IS
+    the index)."""
+    read_json(text)
+    return []
+
+
+# ----------------------------------------------------------------- #
+# the family table
+# ----------------------------------------------------------------- #
+@dataclass(frozen=True)
+class ArtifactFamily:
+    name: str
+    pattern: str                           # regex over the basename
+    parser: Callable[[str, str], List[MetricPoint]]
+    description: str
+
+    def matches(self, filename: str) -> bool:
+        return re.match(self.pattern, filename) is not None
+
+
+FAMILIES: List[ArtifactFamily] = [
+    ArtifactFamily(
+        "perf-index", r"^PERF_TRAJECTORY\.json$", parse_index_meta,
+        "the committed perf index itself (meta, not an artifact)"),
+    ArtifactFamily(
+        "bench-wrapper", r"^BENCH_r\d+\.json$", parse_bench_wrapper,
+        "driver-captured bench rounds: {n, cmd, rc, tail} with the "
+        "result line inside tail"),
+    ArtifactFamily(
+        "bench-result", r"^(BENCH_FRESH|BENCH_LOCAL)\.json$",
+        parse_bench_result,
+        "single bench.py result line (fresh chip measurement)"),
+    ArtifactFamily(
+        "config-vet", r"^VET_[A-Z0-9_]+\.json$", parse_bench_result,
+        "per-config chip vetting record (result line or typed error)"),
+    ArtifactFamily(
+        "baseline-meta", r"^BASELINE\.json$", parse_baseline_meta,
+        "reference-target metadata (no metric points)"),
+    ArtifactFamily(
+        "train-curve", r"^TRAIN_CURVE\.json$", parse_train_curve,
+        "multi-config chip campaign: per-config tokens/sec + MFU"),
+    ArtifactFamily(
+        "multichip-dryrun", r"^MULTICHIP_r\d+\.json$", parse_multichip,
+        "8-device dryrun gate: ok/skipped per round"),
+    ArtifactFamily(
+        "zero-overlap", r"^ZERO_OVERLAP\.jsonl$", parse_zero_overlap,
+        "ZeRO-3 overlap + quantized-wire audit stream "
+        "(bench.py --zero-overlap; hlo_audit rows)"),
+    ArtifactFamily(
+        "serve-loop", r"^SERVE_LOOP\.jsonl$", parse_serve_loop,
+        "continuous-batching serve-loop trace: per-request rows + "
+        "summary percentiles + SLO row"),
+    ArtifactFamily(
+        "chaos-serve", r"^CHAOS_SERVE\.jsonl$", parse_chaos_serve,
+        "chaos harness: fault plan, invariants, determinism gate"),
+    ArtifactFamily(
+        "restore-bench",
+        r"^RESTORE_[A-Z0-9_]+\.jsonl$", parse_restore_bench,
+        "HCache restore benchmarks: e2e/marginal speedups + "
+        "crossover curve"),
+    ArtifactFamily(
+        "serve-bench",
+        r"^(SERVE|DECODE_DIAG|LOOKUP|SWEEP)_[A-Z0-9_]+\.jsonl$",
+        parse_serve_bench,
+        "serving benchmark phase streams (prefill/decode/sweep/"
+        "lookup/floors)"),
+    ArtifactFamily(
+        "paged-vet", r"^PAGED_VET\.jsonl$", parse_paged_vet,
+        "paged-attention kernel numeric vetting rows"),
+    ArtifactFamily(
+        "last-measured", r"^\.bench_last_measured\.json$",
+        parse_last_measured,
+        "chip-truth best/last record (the dead-relay freshness "
+        "source)"),
+    ArtifactFamily(
+        "chip-log",
+        r"^(chip_[a-z0-9_]+|DOMINO_TPU_r\d+|relay_state_r\d+|"
+        r"fullsuite_[a-z0-9]+|smoke_[a-z0-9]+)\.log$",
+        parse_chip_log,
+        "free-form chip/relay session logs (best-effort mining)"),
+]
+
+
+def classify(filename: str) -> Optional[ArtifactFamily]:
+    for fam in FAMILIES:
+        if fam.matches(filename):
+            return fam
+    return None
+
+
+def parse_artifact(path, filename: str) -> ParsedArtifact:
+    """Classify + parse one committed artifact. Raises ``KeyError`` on
+    an unknown name and re-raises parser errors (a known family that
+    stopped parsing is a broken artifact, not a skippable one)."""
+    fam = classify(filename)
+    if fam is None:
+        raise KeyError(f"no artifact family matches {filename!r} "
+                       "(declare one in perf/schemas.py or allowlist "
+                       "it in perf/KNOWN_UNINDEXED)")
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        text = fh.read()
+    if not text.strip():
+        return ParsedArtifact(filename, fam.name, "empty",
+                              note="zero-byte artifact (interrupted "
+                                   "chip session)")
+    if filename.endswith(".jsonl") and not read_jsonl_rows(text):
+        # log-prefix lines only: the run died before its first row —
+        # visible as empty, same as a zero-byte session
+        return ParsedArtifact(filename, fam.name, "empty",
+                              note="no data rows (interrupted before "
+                                   "first JSON row)")
+    points = fam.parser(text, filename)
+    status = "meta" if fam.name in ("baseline-meta", "perf-index") \
+        else "ok"
+    return ParsedArtifact(filename, fam.name, status, points)

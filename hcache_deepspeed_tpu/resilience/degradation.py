@@ -100,3 +100,13 @@ class DegradationLadder:
         if self.level > DegradationLevel.NORMAL:
             self.degraded_steps += 1
         return self.level
+
+    def gauges(self) -> dict:
+        """Read-only exposition/SLO context: the current ladder level
+        and the cumulative degraded-step count. The SLO tracker
+        (``telemetry.slo``) consumes the level per step (via
+        ``ServingMetrics.on_step`` → ``note_degradation``) so burn-rate
+        dashboards can tell "budget burning under overload" from
+        "budget burning because we are shedding on purpose"."""
+        return {"degradation_level": float(int(self.level)),
+                "degraded_steps": float(self.degraded_steps)}

@@ -4,54 +4,88 @@ Emission rides the existing monitor event path: :meth:`ServingMetrics.
 emit` produces the same ``(label, value, step)`` tuples
 ``monitor.MonitorMaster.write_events`` fans out to
 TensorBoard/W&B/Comet/CSV, so serving telemetry lands wherever training
-telemetry already does — no new sink plumbing.
+telemetry already does — no new sink plumbing. On top of that, the
+whole metric set renders into a ``telemetry.prometheus.MetricRegistry``
+(:meth:`ServingMetrics.to_registry` / :meth:`prometheus_text`) for
+scrape-style exposition, and an attached
+:class:`~..telemetry.slo.SLOTracker` turns the terminal-request stream
+into TTFT/TPOT/availability burn-rate gauges the scheduler re-emits on
+its ``sched.step`` spans.
 """
 
+from bisect import bisect_left
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry.sketch import QuantileSketch
+from ..telemetry.slo import SLOTracker
+
 
 class Histogram:
-    """Streaming histogram over fixed buckets + exact percentiles.
+    """Streaming histogram over fixed buckets + bounded percentiles.
 
-    Keeps every observation (serving traces are bounded — 1e6 floats is
-    8 MB) so percentile queries are exact; bucket counts come along for
-    sinks that want a distribution rather than quantiles.
+    Percentiles are **exact** (bit-identical to ``np.percentile`` over
+    the raw stream) while the trace holds at most ``max_exact``
+    observations; past that the raw values collapse into a
+    :class:`~..telemetry.sketch.QuantileSketch` and memory stays O(1)
+    in trace length (the north-star serving process runs for weeks —
+    keep-everything percentiles don't). ``exact=True`` retains the old
+    keep-everything behavior for parity tests and offline analysis.
+
+    Bucket counts are exact in both modes; bucket search is a
+    ``bisect`` over the sorted edges instead of the old linear scan.
     """
 
-    def __init__(self, buckets: Tuple[float, ...] = ()):
+    def __init__(self, buckets: Tuple[float, ...] = (),
+                 max_exact: int = 65536, exact: bool = False):
         self.buckets = tuple(sorted(buckets))
         self.bucket_counts = [0] * (len(self.buckets) + 1)
-        self._values: List[float] = []
+        self.max_exact = int(max_exact)
+        self.exact = bool(exact)
+        self._values: Optional[List[float]] = []
+        self._sketch: Optional[QuantileSketch] = None
 
     def observe(self, value: float) -> None:
         value = float(value)
-        self._values.append(value)
-        for i, edge in enumerate(self.buckets):
-            if value <= edge:
-                self.bucket_counts[i] += 1
-                return
-        self.bucket_counts[-1] += 1
+        if self._sketch is not None:
+            self._sketch.add(value)
+        else:
+            self._values.append(value)
+            if not self.exact and len(self._values) > self.max_exact:
+                # exact -> sketch handoff: bulk-load every value seen
+                # so far, then stop retaining raw observations
+                self._sketch = QuantileSketch()
+                self._sketch.extend(self._values)
+                self._values = None
+        if self.buckets:
+            self.bucket_counts[
+                bisect_left(self.buckets, value)] += 1
 
     @property
     def count(self) -> int:
+        if self._sketch is not None:
+            return self._sketch.n
         return len(self._values)
 
     @property
     def sum(self) -> float:
+        if self._sketch is not None:
+            return self._sketch.sum
         return float(np.sum(self._values)) if self._values else 0.0
 
     def mean(self) -> Optional[float]:
-        return self.sum / self.count if self._values else None
+        return self.sum / self.count if self.count else None
 
     def percentile(self, q: float) -> Optional[float]:
+        if self._sketch is not None:
+            return self._sketch.quantile(q)
         if not self._values:
             return None
         return float(np.percentile(np.asarray(self._values), q))
 
     def summary(self) -> Dict:
-        if not self._values:
+        if not self.count:
             return {"count": 0}
         return {"count": self.count,
                 "mean": round(self.mean(), 6),
@@ -60,14 +94,28 @@ class Histogram:
                 "p99": round(self.percentile(99), 6)}
 
 
+#: default latency bucket edges (seconds) for Prometheus exposition —
+#: 1 ms to ~2 min in roughly-doubling steps; bucket *counts* are what
+#: scrapers aggregate, quantile queries stay sketch-side
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
 class ServingMetrics:
     """Aggregates the scheduler's StepReports + finished requests."""
 
-    def __init__(self):
-        self.ttft = Histogram()
-        self.tpot = Histogram()
-        self.queue_wait = Histogram()
-        self.preemptions_per_request = Histogram()
+    def __init__(self, slo: Optional[SLOTracker] = None,
+                 exact_histograms: bool = False):
+        kw = dict(exact=exact_histograms)
+        self.ttft = Histogram(LATENCY_BUCKETS_S, **kw)
+        self.tpot = Histogram(LATENCY_BUCKETS_S, **kw)
+        self.queue_wait = Histogram(LATENCY_BUCKETS_S, **kw)
+        self.preemptions_per_request = Histogram(**kw)
+        #: burn-rate tracker; pass ``slo=False`` to disable entirely
+        self.slo = SLOTracker() if slo is None else (slo or None)
+        #: last-computed burn-rate gauge dict (refreshed per step; the
+        #: scheduler copies these onto its ``sched.step`` span)
+        self.slo_gauges: Dict[str, float] = {}
         self.counters = {"admitted": 0, "finished": 0, "cancelled": 0,
                          "preemptions": 0, "restores": 0,
                          "recompute_reentries": 0, "restore_chunks": 0,
@@ -135,8 +183,24 @@ class ServingMetrics:
         if scheduler.total_restores:
             self.gauges["restore_overlap_ratio"] = \
                 scheduler.overlapped_restores / scheduler.total_restores
+        if self.slo is not None:
+            # degradation level is SLO *context* (read-only), and the
+            # burn-rate gauges are refreshed on this step's clock so
+            # the sched.step span carries current values
+            self.slo.note_degradation(report.t,
+                                      report.degradation_level)
+            self.slo_gauges = self.slo.gauges(report.t)
 
     def on_finish(self, req) -> None:
+        if self.slo is not None and req.finished_at is not None:
+            # every terminal request feeds availability; latency SLIs
+            # only see requests that measured them (a FAILED request
+            # with no first token is an availability miss, not a TTFT
+            # miss). Cancellations are the caller's choice — neutral.
+            if not req.cancelled:
+                self.slo.observe_request(
+                    req.finished_at, ok=req.state.name == "DONE",
+                    ttft_s=req.ttft(), tpot_s=req.tpot())
         if req.state.name == "FAILED":
             return           # typed failures counted via report.failed
         if req.reject_reason and req.reject_reason != "cancelled":
@@ -166,6 +230,8 @@ class ServingMetrics:
                     out.append((f"serving/{name}/p{q}", v, step))
         for name, value in self.gauges.items():
             out.append((f"serving/{name}", float(value), step))
+        for name, value in sorted(self.slo_gauges.items()):
+            out.append((f"serving/{name}", float(value), step))
         for name, value in self.counters.items():
             out.append((f"serving/{name}", float(value), step))
         for reason, n in sorted(self.rejected.items()):
@@ -174,14 +240,61 @@ class ServingMetrics:
             out.append((f"serving/failed/{error}", float(n), step))
         return out
 
-    def emit(self, monitor, step: int) -> None:
-        """Write through the MonitorMaster fan-out (rank-0 gated there)."""
+    def emit(self, monitor, step: int, flush: bool = False) -> None:
+        """Write through the MonitorMaster fan-out (rank-0 gated there).
+        ``flush=True`` additionally flushes buffered sinks — the
+        deterministic end-of-trace hook (see ``monitor.Monitor.flush``
+        for the contract)."""
         if monitor is None or not getattr(monitor, "enabled", True):
             return
         monitor.write_events(self.events(step))
+        if flush:
+            monitor.flush()
+
+    # ------------------------------------------------------------- #
+    # Prometheus exposition
+    # ------------------------------------------------------------- #
+    def to_registry(self, registry=None):
+        """Render the full metric set into a ``MetricRegistry``
+        (created on demand) — counters as counters, gauges as gauges,
+        latency histograms with their bucket counts + sketch-derived
+        quantile gauges."""
+        from ..telemetry.prometheus import MetricRegistry
+        reg = registry if registry is not None else \
+            MetricRegistry(namespace="hds_serving")
+        for name, value in self.counters.items():
+            reg.set_counter(name, value,
+                            help=f"serving counter {name}")
+        for reason, n in self.rejected.items():
+            reg.set_counter("rejected", n, labels={"reason": reason},
+                            help="rejected requests by reason")
+        for error, n in self.failures.items():
+            reg.set_counter("failed_typed", n, labels={"error": error},
+                            help="typed request failures by cause")
+        for name, value in self.gauges.items():
+            reg.set_gauge(name, value, help=f"serving gauge {name}")
+        for name, value in self.slo_gauges.items():
+            reg.set_gauge(name, value,
+                          help="SLO burn-rate gauge (see telemetry.slo)")
+        for name, hist in (("ttft_seconds", self.ttft),
+                           ("tpot_seconds", self.tpot),
+                           ("queue_wait_seconds", self.queue_wait)):
+            if hist.buckets:
+                reg.set_histogram(name, hist.bucket_counts,
+                                  hist.buckets, hist.count, hist.sum,
+                                  help=f"serving latency {name}")
+            for q in (50, 90, 99):
+                v = hist.percentile(q)
+                if v is not None:
+                    reg.set_gauge(f"{name}_p{q}", v,
+                                  help=f"{name} p{q} (sketch)")
+        return reg
+
+    def prometheus_text(self) -> str:
+        return self.to_registry().render()
 
     def summary(self) -> Dict:
-        return {
+        out = {
             "ttft_s": self.ttft.summary(),
             "tpot_s": self.tpot.summary(),
             "queue_wait_s": self.queue_wait.summary(),
@@ -192,3 +305,6 @@ class ServingMetrics:
             "failures": dict(self.failures),
             "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
         }
+        if self.slo is not None:
+            out["slo"] = self.slo.summary()
+        return out

@@ -231,7 +231,8 @@ class ContinuousBatchingScheduler:
         self.step_idx += 1
         now = self.clock.now()
         report = StepReport(step=self.step_idx, t=now)
-        with get_tracer().span("sched.step", sched_step=self.step_idx):
+        with get_tracer().span("sched.step",
+                               sched_step=self.step_idx) as sp:
             self._cancellation_pass(report)
             self._deadline_pass(report, now)
             self._degradation_pass(report)
@@ -240,6 +241,15 @@ class ContinuousBatchingScheduler:
             admits = self._pressure_pass(admits, report)
             self._dispatch(admits, report, now)
             self._watchdog_pass(report)
+            if self.metrics is not None:
+                self.metrics.on_step(report, self)
+                if self.metrics.slo_gauges:
+                    # SLO burn rates ride the sched.step span, read-only
+                    # context for whoever drives the degradation ladder
+                    # from them later (ROADMAP item 4) — the span is the
+                    # contract, the tracker never steers the scheduler
+                    sp.set(**{k: round(float(v), 6) for k, v in
+                              self.metrics.slo_gauges.items()})
         if self.crossover is not None and \
                 self.step_idx % self.calibrate_every == 0:
             tracer = get_tracer()
@@ -249,8 +259,6 @@ class ContinuousBatchingScheduler:
                 # tracer is off; the bench feeds synced measurements
                 # through observe_* instead)
                 self.crossover.calibrate_from_events(tracer.events())
-        if self.metrics is not None:
-            self.metrics.on_step(report, self)
         return report
 
     # ------------------------------------------------------------- #

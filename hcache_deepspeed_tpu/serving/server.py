@@ -78,6 +78,8 @@ class ServingServer:
         #: the exception that killed the scheduler thread, if any;
         #: ``wait()`` re-raises it and ``submit()`` rejects while set
         self.error: Optional[BaseException] = None
+        self._metrics_httpd = None
+        self._metrics_http_thread = None
 
     @property
     def healthy(self) -> bool:
@@ -204,7 +206,11 @@ class ServingServer:
                     f"run_trace exceeded {max_steps} steps — "
                     "scheduling livelock?\n" + self._snapshot())
         if self.monitor is not None:
-            self.metrics.emit(self.monitor, self.scheduler.step_idx)
+            # trace end: flush buffered sinks deterministically (the
+            # Monitor.flush contract — CSV buffers, TB flushes per
+            # write; both are safe to flush here)
+            self.metrics.emit(self.monitor, self.scheduler.step_idx,
+                              flush=True)
         return self.metrics
 
     def _snapshot(self, last_events: int = 20) -> str:
@@ -226,6 +232,85 @@ class ServingServer:
             f"{s.events[-last_events:]}",
         ]
         return "\n".join(lines)
+
+    # ------------------------------------------------------------- #
+    # observability surface
+    # ------------------------------------------------------------- #
+    def metrics_snapshot(self) -> dict:
+        """Point-in-time introspection dict: the full metrics summary
+        (histograms, counters, gauges, SLO burn rates), scheduler pool
+        depths, health, and the Prometheus text rendering — everything
+        an operator probe or test needs in one locked read."""
+        with self._lock:
+            s = self.scheduler
+            return {
+                "healthy": self.healthy,
+                "error": None if self.error is None
+                else repr(self.error),
+                "step": s.step_idx,
+                "pools": {"ingress": len(self._ingress),
+                          "queue": len(s.queue),
+                          "running": len(s.running),
+                          "suspended": len(s.suspended),
+                          "restoring": len(s.restoring),
+                          "done": len(s.done)},
+                "metrics": self.metrics.summary(),
+                "slo_gauges": dict(self.metrics.slo_gauges),
+                "prometheus": self.metrics.prometheus_text(),
+            }
+
+    def start_metrics_http(self, host: str = "127.0.0.1",
+                           port: int = 0) -> int:
+        """Optional stdlib exposition endpoint: serves the Prometheus
+        text at ``/metrics`` (and a JSON-ish health line at
+        ``/healthz``) from a daemon thread. Returns the bound port
+        (``port=0`` picks a free one). The endpoint only *reads*
+        snapshots — it can never steer the scheduler."""
+        if self._metrics_httpd is not None:
+            return self._metrics_httpd.server_address[1]
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] == "/metrics":
+                    body = server.metrics_snapshot()[
+                        "prometheus"].encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/healthz":
+                    body = _json.dumps(
+                        {"healthy": server.healthy}).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # no stderr chatter
+                pass
+
+        self._metrics_httpd = ThreadingHTTPServer((host, port),
+                                                  _Handler)
+        self._metrics_http_thread = threading.Thread(
+            target=self._metrics_httpd.serve_forever,
+            name="hds-metrics-http", daemon=True)
+        self._metrics_http_thread.start()
+        return self._metrics_httpd.server_address[1]
+
+    def stop_metrics_http(self) -> None:
+        if self._metrics_httpd is None:
+            return
+        self._metrics_httpd.shutdown()
+        self._metrics_httpd.server_close()
+        self._metrics_http_thread.join(timeout=5.0)
+        self._metrics_httpd = None
+        self._metrics_http_thread = None
 
     # ------------------------------------------------------------- #
     # thread mode
@@ -287,6 +372,7 @@ class ServingServer:
         self._stop.set()
         self._thread.join(timeout=timeout)
         self._thread = None
+        self.stop_metrics_http()
 
     def wait(self, req: Request, timeout: float = 60.0) -> Request:
         """Block until ``req`` finishes (thread mode helper). Raises

@@ -10,6 +10,12 @@
   ``summarize``/``render_table`` reduction the CLI uses.
 * :mod:`.demo` — the CPU acceptance workload (train loop + logged
   collective + serving preempt→restore cycle).
+* :mod:`.sketch` — bounded-memory streaming quantile sketch (the
+  keep-everything percentile path's O(1)-memory replacement).
+* :mod:`.prometheus` — labeled :class:`MetricRegistry` + Prometheus
+  text exposition with a strict validator/parser pair.
+* :mod:`.slo` — declared TTFT/TPOT/availability objectives evaluated
+  over sliding windows into burn-rate gauges.
 
 CLI: ``python -m hcache_deepspeed_tpu.telemetry dump|summarize``.
 See ``docs/observability.md``.
@@ -19,10 +25,17 @@ from .export import (load_trace, to_trace_events, validate_trace,  # noqa: F401
                      write_trace)
 from .metrics import (StepMetrics, bench_extra, render_table,  # noqa: F401
                       step_breakdown, summarize)
+from .prometheus import (MetricRegistry, parse_prometheus_text,  # noqa: F401
+                         validate_prometheus_text)
+from .sketch import QuantileSketch  # noqa: F401
+from .slo import SLOObjective, SLOTracker, default_objectives  # noqa: F401
 from .tracer import Tracer, get_tracer  # noqa: F401
 
 __all__ = [
     "Tracer", "get_tracer", "write_trace", "load_trace",
     "to_trace_events", "validate_trace", "StepMetrics", "summarize",
     "step_breakdown", "bench_extra", "render_table",
+    "QuantileSketch", "MetricRegistry", "validate_prometheus_text",
+    "parse_prometheus_text", "SLOObjective", "SLOTracker",
+    "default_objectives",
 ]

@@ -43,11 +43,17 @@ class StepMetrics:
     """
 
     def __init__(self, monitor=None, peak_tflops: float = 0.0,
-                 flops_per_token: float = 0.0, prefix: str = "Train"):
+                 flops_per_token: float = 0.0, prefix: str = "Train",
+                 registry=None):
         self.monitor = monitor
         self.peak_tflops = float(peak_tflops)
         self.flops_per_token = float(flops_per_token)
         self.prefix = prefix
+        #: optional ``telemetry.prometheus.MetricRegistry``: every
+        #: emitted event also lands as a gauge (last value wins), so a
+        #: scrape endpoint can expose training step metrics without a
+        #: second emission path
+        self.registry = registry
 
     def events(self, step: int, wall_s: float, tokens: int = 0,
                samples: int = 0, phase_s: Optional[Dict] = None):
@@ -69,11 +75,17 @@ class StepMetrics:
 
     def emit(self, step: int, wall_s: float, tokens: int = 0,
              samples: int = 0, phase_s: Optional[Dict] = None):
+        events = self.events(step, wall_s, tokens, samples, phase_s)
+        if self.registry is not None:
+            from .prometheus import sanitize_name
+            for label, value, _ in events:
+                self.registry.set_gauge(sanitize_name(label), value,
+                                        help=label)
+            self.registry.set_gauge("train_last_step", float(step))
         if self.monitor is None or not getattr(self.monitor, "enabled",
                                                True):
             return
-        self.monitor.write_events(
-            self.events(step, wall_s, tokens, samples, phase_s))
+        self.monitor.write_events(events)
 
 
 # ------------------------------------------------------------------ #

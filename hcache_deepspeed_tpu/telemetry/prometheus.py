@@ -1,0 +1,288 @@
+"""Labeled metric registry + Prometheus text exposition.
+
+The monitor path (``monitor.MonitorMaster``) speaks ``(label, value,
+step)`` tuples — the right shape for training curves, the wrong shape
+for a serving fleet scraped by an external collector. This module adds
+the production half: a :class:`MetricRegistry` of typed samples
+(counter / gauge / histogram, with labels) rendered in the Prometheus
+text exposition format (version 0.0.4 — the format every scraper
+accepts), plus a strict :func:`validate_prometheus_text` /
+:func:`parse_prometheus_text` pair so artifacts and tests can prove a
+snapshot round-trips rather than assert it "looks right".
+
+Nothing here imports outside the stdlib + numpy; the registry is a
+plain value container rendered on demand (no background threads — the
+optional HTTP endpoint lives in ``serving.server``).
+"""
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+#: sample line: name{labels} value  (labels optional)
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(.*)\})?"
+    r"\s+([+-]?(?:[0-9.eE+-]+|[Ii]nf(?:inity)?|NaN))\s*$")
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(labelstr: str):
+    """Contiguous ``k="v"`` pairs (comma-separated). Returns
+    (labels, error-or-None) — a tokenizer, not a findall: skipping an
+    illegal prefix to find an embedded legal pair would wave bad label
+    syntax through."""
+    labels: Dict[str, str] = {}
+    pos = 0
+    while pos < len(labelstr):
+        m = _LABEL_PAIR_RE.match(labelstr, pos)
+        if not m:
+            return labels, f"bad label syntax at {labelstr[pos:]!r}"
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(labelstr):
+            if labelstr[pos] != ",":
+                return labels, \
+                    f"bad label separator at {labelstr[pos:]!r}"
+            pos += 1
+    return labels, None
+
+
+def sanitize_name(name: str) -> str:
+    """Fold an internal metric label (``serving/ttft_s/p50``) into a
+    legal Prometheus metric name."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not _NAME_RE.match(out):
+        out = "_" + out
+    return out
+
+
+def _escape_label_value(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _format_value(v: float) -> str:
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+class MetricRegistry:
+    """Ordered collection of metric families with labeled samples.
+
+    ``set_*`` calls are idempotent per (name, labels) — re-registering
+    overwrites the sample, so a registry can be long-lived and
+    re-rendered per scrape.
+    """
+
+    def __init__(self, namespace: str = ""):
+        self.namespace = namespace
+        #: name -> {"type", "help", "samples": {labelkey: (labels, v)}}
+        self._families: Dict[str, Dict] = {}
+
+    # ------------------------------------------------------------- #
+    def _family(self, name: str, mtype: str, help_: str) -> Dict:
+        name = sanitize_name(
+            f"{self.namespace}_{name}" if self.namespace else name)
+        fam = self._families.get(name)
+        if fam is None:
+            fam = self._families[name] = {
+                "type": mtype, "help": help_ or name, "samples": {}}
+        elif fam["type"] != mtype:
+            raise ValueError(
+                f"metric {name} re-registered as {mtype}, "
+                f"was {fam['type']}")
+        return fam
+
+    @staticmethod
+    def _labelkey(labels: Optional[Dict]) -> Tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict] = None, help: str = ""):
+        fam = self._family(name, "gauge", help)
+        fam["samples"][self._labelkey(labels)] = (labels or {},
+                                                  float(value))
+
+    def set_counter(self, name: str, value: float,
+                    labels: Optional[Dict] = None, help: str = ""):
+        """Counters expose a cumulative total; by convention the name
+        gets a ``_total`` suffix at render time if missing."""
+        fam = self._family(name, "counter", help)
+        fam["samples"][self._labelkey(labels)] = (labels or {},
+                                                  float(value))
+
+    def set_histogram(self, name: str, bucket_counts, buckets,
+                      count: int, sum_: float,
+                      labels: Optional[Dict] = None, help: str = ""):
+        """``bucket_counts`` are per-bucket (non-cumulative) counts for
+        the ``buckets`` upper edges plus one overflow count; rendered
+        cumulative with the mandatory ``+Inf`` bucket."""
+        fam = self._family(name, "histogram", help)
+        fam["samples"][self._labelkey(labels)] = (
+            labels or {},
+            {"buckets": tuple(float(b) for b in buckets),
+             "bucket_counts": tuple(int(c) for c in bucket_counts),
+             "count": int(count), "sum": float(sum_)})
+
+    # ------------------------------------------------------------- #
+    @staticmethod
+    def _render_labels(labels: Dict) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(
+            f'{k}="{_escape_label_value(v)}"'
+            for k, v in sorted(labels.items()))
+        return "{" + inner + "}"
+
+    def render(self) -> str:
+        """Prometheus text exposition (0.0.4)."""
+        lines: List[str] = []
+        for name in sorted(self._families):
+            fam = self._families[name]
+            mtype = fam["type"]
+            out_name = name
+            if mtype == "counter" and not name.endswith("_total"):
+                out_name = name + "_total"
+            help_ = fam["help"].replace("\\", r"\\").replace("\n", r"\n")
+            lines.append(f"# HELP {out_name} {help_}")
+            lines.append(f"# TYPE {out_name} {mtype}")
+            for _, (labels, value) in sorted(fam["samples"].items()):
+                if mtype == "histogram":
+                    cum = 0
+                    edges = list(value["buckets"]) + [float("inf")]
+                    for edge, c in zip(edges, value["bucket_counts"]):
+                        cum += c
+                        le = "+Inf" if math.isinf(edge) \
+                            else _format_value(edge)
+                        bl = dict(labels, le=le)
+                        lines.append(
+                            f"{out_name}_bucket"
+                            f"{self._render_labels(bl)} {cum}")
+                    lines.append(
+                        f"{out_name}_sum{self._render_labels(labels)} "
+                        f"{_format_value(value['sum'])}")
+                    lines.append(
+                        f"{out_name}_count{self._render_labels(labels)} "
+                        f"{value['count']}")
+                else:
+                    lines.append(
+                        f"{out_name}{self._render_labels(labels)} "
+                        f"{_format_value(value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ----------------------------------------------------------------- #
+# validation / parsing (the round-trip half)
+# ----------------------------------------------------------------- #
+def validate_prometheus_text(text: str) -> List[str]:
+    """Strict structural validation of a text exposition. Returns the
+    list of violations (empty = valid):
+
+    * every non-comment line parses as ``name{labels} value``;
+    * every sample's base family was declared by a ``# TYPE`` line
+      above it, and histogram suffixes match the declared type;
+    * metric and label names are legal; values parse as floats;
+    * histogram ``_bucket`` series are cumulative in ``le`` order and
+      end with ``le="+Inf"`` equal to ``_count``.
+    """
+    errors: List[str] = []
+    typed: Dict[str, str] = {}
+    hist: Dict[Tuple, List[Tuple[float, float]]] = {}
+    hist_count: Dict[Tuple, float] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary",
+                    "untyped"):
+                errors.append(f"line {i}: malformed TYPE line")
+                continue
+            typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            if not line.startswith("# HELP "):
+                errors.append(f"line {i}: unknown comment {line[:30]!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {i}: unparseable sample {line[:60]!r}")
+            continue
+        name, _, labelstr, valuestr = m.groups()
+        try:
+            value = float(valuestr.replace("Inf", "inf"))
+        except ValueError:
+            errors.append(f"line {i}: bad value {valuestr!r}")
+            continue
+        labels = {}
+        if labelstr:
+            labels, label_err = _parse_labels(labelstr)
+            if label_err:
+                errors.append(f"line {i}: {label_err}")
+            for k in labels:
+                if not _LABEL_RE.match(k):
+                    errors.append(f"line {i}: bad label name {k!r}")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    name[: -len(suffix)] in typed and \
+                    typed[name[: -len(suffix)]] in ("histogram",
+                                                    "summary"):
+                base = name[: -len(suffix)]
+                break
+        if base not in typed:
+            errors.append(f"line {i}: sample {name} has no TYPE")
+            continue
+        if typed[base] == "histogram":
+            key = (base, tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le")))
+            if name.endswith("_bucket"):
+                le = labels.get("le")
+                if le is None:
+                    errors.append(f"line {i}: bucket without le")
+                    continue
+                edge = float("inf") if le == "+Inf" else float(le)
+                hist.setdefault(key, []).append((edge, value))
+            elif name.endswith("_count"):
+                hist_count[key] = value
+    for key, rows in hist.items():
+        edges = [e for e, _ in rows]
+        counts = [c for _, c in rows]
+        if edges != sorted(edges):
+            errors.append(f"{key[0]}: bucket le edges not sorted")
+        if counts != sorted(counts):
+            errors.append(f"{key[0]}: bucket counts not cumulative")
+        if not edges or not math.isinf(edges[-1]):
+            errors.append(f"{key[0]}: missing le=\"+Inf\" bucket")
+        elif key in hist_count and counts[-1] != hist_count[key]:
+            errors.append(
+                f"{key[0]}: +Inf bucket {counts[-1]} != _count "
+                f"{hist_count[key]}")
+    return errors
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple, float]:
+    """(name, sorted-label-tuple) -> value, for round-trip asserts."""
+    out: Dict[Tuple, float] = {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, _, labelstr, valuestr = m.groups()
+        labels, label_err = _parse_labels(labelstr or "")
+        if label_err:
+            raise ValueError(f"{label_err} in line {line!r}")
+        out[(name, tuple(sorted(labels.items())))] = \
+            float(valuestr.replace("Inf", "inf"))
+    return out

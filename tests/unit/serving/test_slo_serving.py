@@ -1,0 +1,204 @@
+"""SLO + exposition through the serving stack (SimulatedEngine sim):
+burn-rate gauges must flow monitor-ward and onto ``sched.step`` spans,
+``metrics_snapshot()`` must round-trip through the Prometheus
+validator, and the bounded histogram must keep serving percentiles
+O(1) in trace length."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.monitor import InMemoryMonitor
+from hcache_deepspeed_tpu.serving import (Request, ServerConfig,
+                                          ServingServer,
+                                          SimulatedEngine,
+                                          VirtualClock)
+from hcache_deepspeed_tpu.serving.metrics import Histogram
+from hcache_deepspeed_tpu.telemetry import (get_tracer,
+                                            parse_prometheus_text,
+                                            validate_prometheus_text)
+
+
+def run_sim(n=6, monitor=None, trace=False):
+    eng = SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 128,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": 9},
+        hcache={"enable_latents": True}))
+    srv = ServingServer(eng, clock=VirtualClock(), monitor=monitor,
+                        emit_every_steps=1,
+                        config=ServerConfig(
+                            kv_demand_fraction=float("inf")))
+    reqs = [Request(uid=i, prompt=list(range(20)),
+                    max_new_tokens=(8 if i == 2 else 14),
+                    arrival_time=0.01 * i,
+                    priority=(5 if i == 2 else 0))
+            for i in range(min(n, 3))]
+    reqs += [Request(uid=10 + i, prompt=list(range(10)),
+                     max_new_tokens=4, arrival_time=0.5 + 0.01 * i)
+             for i in range(max(0, n - 3))]
+    tracer = get_tracer()
+    if trace:
+        tracer.configure(enabled=True)
+        tracer.clear()
+    try:
+        srv.run_trace(reqs)
+    finally:
+        if trace:
+            tracer.configure(enabled=False)
+    return srv, reqs
+
+
+def test_burn_rate_gauges_flow_through_monitor_path():
+    monitor = InMemoryMonitor()
+    srv, _ = run_sim(monitor=monitor)
+    labels = {label for label, _, _ in monitor.events}
+    assert "serving/slo_ttft_burn_rate" in labels
+    assert "serving/slo_tpot_burn_rate" in labels
+    assert "serving/slo_availability_burn_rate" in labels
+    assert "serving/slo_degraded_fraction" in labels
+    # the virtual-clock sim decodes in ~ms steps: every SLI is inside
+    # its objective, burn rates finite and >= 0
+    for label, value, _ in monitor.events:
+        if label.startswith("serving/slo_"):
+            assert np.isfinite(value) and value >= 0.0
+
+
+def test_burn_rates_ride_sched_step_spans():
+    """The read-only contract for ROADMAP item 4: sched.step spans
+    carry the burn-rate attrs once requests have finished."""
+    srv, _ = run_sim(trace=True)
+    spans = [ev for ev in get_tracer().events()
+             if ev.get("ph") == "X" and ev["name"] == "sched.step"]
+    assert spans, "no sched.step spans traced"
+    carrying = [ev for ev in spans
+                if "slo_ttft_burn_rate" in (ev.get("args") or {})]
+    assert carrying, "no sched.step span carried SLO burn rates"
+    args = carrying[-1]["args"]
+    for key in ("slo_ttft_burn_rate", "slo_tpot_burn_rate",
+                "slo_availability_burn_rate",
+                "slo_degraded_fraction"):
+        assert key in args and args[key] >= 0.0
+
+
+def test_metrics_snapshot_prometheus_roundtrips():
+    srv, reqs = run_sim()
+    snap = srv.metrics_snapshot()
+    assert snap["healthy"] is True
+    assert snap["pools"]["done"] == len(reqs)
+    errors = validate_prometheus_text(snap["prometheus"])
+    assert errors == [], errors
+    samples = parse_prometheus_text(snap["prometheus"])
+    finished = [v for (name, labels), v in samples.items()
+                if name == "hds_serving_finished_total"]
+    assert finished == [float(len(reqs))]
+    # latency histogram exposition present with +Inf closure
+    assert any(name == "hds_serving_ttft_seconds_bucket" and
+               dict(labels).get("le") == "+Inf"
+               for (name, labels) in samples)
+    # burn-rate gauges exported
+    assert any(name == "hds_serving_slo_ttft_burn_rate"
+               for (name, _) in samples)
+
+
+def test_http_exposition_endpoint():
+    srv, _ = run_sim()
+    port = srv.start_metrics_http()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert validate_prometheus_text(body) == []
+        assert body == srv.metrics_snapshot()["prometheus"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            assert json.load(r)["healthy"] is True
+    finally:
+        srv.stop_metrics_http()
+
+
+def test_slo_counts_failures_as_availability_misses():
+    from hcache_deepspeed_tpu.serving.metrics import ServingMetrics
+
+    class _Req:
+        cancelled = False
+        finished_at = 1.0
+
+        class state:
+            name = "FAILED"
+        reject_reason = ""
+        tokens_out = []
+        n_preemptions = 0
+
+        @staticmethod
+        def ttft():
+            return None
+
+        @staticmethod
+        def tpot():
+            return None
+
+        @staticmethod
+        def queue_wait():
+            return None
+
+    m = ServingMetrics()
+    m.on_finish(_Req())
+    rates = m.slo.burn_rates(1.0)
+    assert rates["availability"] > 0.0
+    assert rates["ttft"] == 0.0         # no first token: not a TTFT sample
+
+
+# ------------------------------------------------------------------ #
+# bounded histogram (the satellite: bisect buckets + sketch cap)
+# ------------------------------------------------------------------ #
+def test_histogram_exact_parity_below_cap():
+    """Existing parity contract: under the cap, percentiles are
+    bitwise np.percentile of the raw stream (old behavior)."""
+    rng = np.random.default_rng(0)
+    xs = rng.exponential(0.1, 2000)
+    h = Histogram()
+    for x in xs:
+        h.observe(x)
+    for q in (50, 90, 99):
+        assert h.percentile(q) == float(np.percentile(xs, q))
+    assert h.count == len(xs)
+
+
+def test_histogram_caps_memory_past_max_exact():
+    rng = np.random.default_rng(1)
+    h = Histogram(max_exact=1000)
+    xs = rng.exponential(0.1, 50_000)
+    for x in xs:
+        h.observe(x)
+    assert h._values is None and h._sketch is not None
+    assert h._sketch.stored_points <= \
+        h._sketch.max_bins + h._sketch.buffer_size
+    assert h.count == len(xs)
+    for q in (50, 90, 99):
+        exact = float(np.percentile(xs, q))
+        assert abs(h.percentile(q) - exact) <= 0.01 * exact
+
+
+def test_histogram_exact_flag_never_compresses():
+    h = Histogram(max_exact=100, exact=True)
+    for i in range(10_000):
+        h.observe(float(i))
+    assert h._sketch is None
+    assert h.percentile(50) == float(np.percentile(
+        np.arange(10_000, dtype=float), 50))
+
+
+def test_histogram_bucket_counts_match_linear_scan_semantics():
+    """bisect bucket search preserves the old `value <= edge`
+    assignment, including exact-edge hits."""
+    h = Histogram(buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.1, 0.3, 0.5, 0.9, 1.0, 2.0):
+        h.observe(v)
+    assert h.bucket_counts == [2, 2, 2, 1]

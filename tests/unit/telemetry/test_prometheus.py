@@ -1,0 +1,96 @@
+"""MetricRegistry + Prometheus text exposition: render, validate,
+round-trip parse."""
+
+import math
+
+import pytest
+
+from hcache_deepspeed_tpu.telemetry.prometheus import (
+    MetricRegistry, parse_prometheus_text, sanitize_name,
+    validate_prometheus_text)
+
+
+def test_render_validate_roundtrip():
+    reg = MetricRegistry(namespace="hds")
+    reg.set_counter("requests", 42, labels={"route": "decode"})
+    reg.set_counter("requests", 7, labels={"route": "prefill"})
+    reg.set_gauge("kv_utilization", 0.83)
+    reg.set_gauge("burn_rate", 2.5, labels={"objective": "ttft"})
+    reg.set_histogram("ttft_seconds", [3, 2, 1], (0.1, 0.5),
+                      count=6, sum_=1.23)
+    text = reg.render()
+    assert validate_prometheus_text(text) == []
+    samples = parse_prometheus_text(text)
+    assert samples[("hds_requests_total",
+                    (("route", "decode"),))] == 42.0
+    assert samples[("hds_kv_utilization", ())] == 0.83
+    # histogram renders cumulative with the mandatory +Inf bucket
+    assert samples[("hds_ttft_seconds_bucket",
+                    (("le", "0.1"),))] == 3.0
+    assert samples[("hds_ttft_seconds_bucket",
+                    (("le", "0.5"),))] == 5.0
+    assert samples[("hds_ttft_seconds_bucket",
+                    (("le", "+Inf"),))] == 6.0
+    assert samples[("hds_ttft_seconds_count", ())] == 6.0
+    assert samples[("hds_ttft_seconds_sum", ())] == 1.23
+
+
+def test_label_escaping_survives_roundtrip():
+    reg = MetricRegistry()
+    reg.set_gauge("g", 1.0, labels={"reason": 'a"b\\c\nd'})
+    text = reg.render()
+    assert validate_prometheus_text(text) == []
+    ((name, labels),) = [k for k in parse_prometheus_text(text)]
+    assert name == "g"
+
+
+def test_name_sanitization():
+    assert sanitize_name("serving/ttft_s/p50") == "serving_ttft_s_p50"
+    reg = MetricRegistry()
+    reg.set_gauge("serving/ttft_s/p50", 0.1)
+    assert validate_prometheus_text(reg.render()) == []
+
+
+def test_validator_catches_malformed_text():
+    assert validate_prometheus_text("metric_without_type 1\n")
+    assert validate_prometheus_text(
+        "# TYPE m gauge\nm{bad-label=\"x\"} 1\n")
+    assert validate_prometheus_text("# TYPE m gauge\nm 1 2 3 4\n")
+    # non-cumulative histogram buckets
+    bad_hist = ("# TYPE h histogram\n"
+                'h_bucket{le="0.1"} 5\n'
+                'h_bucket{le="0.5"} 3\n'
+                'h_bucket{le="+Inf"} 6\n'
+                "h_sum 1\nh_count 6\n")
+    assert any("cumulative" in e
+               for e in validate_prometheus_text(bad_hist))
+    # missing +Inf
+    no_inf = ("# TYPE h histogram\n"
+              'h_bucket{le="0.1"} 5\n'
+              "h_sum 1\nh_count 5\n")
+    assert any("+Inf" in e for e in validate_prometheus_text(no_inf))
+
+
+def test_type_conflict_rejected():
+    reg = MetricRegistry()
+    reg.set_gauge("x", 1.0)
+    with pytest.raises(ValueError):
+        reg.set_counter("x", 2.0)
+
+
+def test_special_float_values():
+    reg = MetricRegistry()
+    reg.set_gauge("inf_gauge", math.inf)
+    reg.set_gauge("nan_gauge", math.nan)
+    text = reg.render()
+    assert validate_prometheus_text(text) == []
+    samples = parse_prometheus_text(text)
+    assert math.isinf(samples[("inf_gauge", ())])
+    assert math.isnan(samples[("nan_gauge", ())])
+
+
+def test_idempotent_sample_overwrite():
+    reg = MetricRegistry()
+    reg.set_gauge("g", 1.0)
+    reg.set_gauge("g", 2.0)
+    assert parse_prometheus_text(reg.render())[("g", ())] == 2.0

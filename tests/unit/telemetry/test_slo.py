@@ -1,0 +1,130 @@
+"""SLO burn-rate window unit tests (telemetry.slo)."""
+
+import pytest
+
+from hcache_deepspeed_tpu.telemetry.slo import (SLOObjective,
+                                                SLOTracker,
+                                                default_objectives)
+
+
+def tracker(**kw):
+    return SLOTracker([
+        SLOObjective("ttft", target=0.9, threshold_s=1.0,
+                     window_s=10.0),
+        SLOObjective("tpot", target=0.9, threshold_s=0.1,
+                     window_s=10.0),
+        SLOObjective("availability", target=0.99, threshold_s=None,
+                     window_s=10.0),
+    ], **kw)
+
+
+def test_burn_rate_zero_on_empty_and_all_good():
+    t = tracker()
+    assert t.burn_rates(0.0) == {"ttft": 0.0, "tpot": 0.0,
+                                 "availability": 0.0}
+    for i in range(10):
+        t.observe_request(float(i) / 10, ok=True, ttft_s=0.5,
+                          tpot_s=0.05)
+    assert all(v == 0.0 for v in t.burn_rates().values())
+
+
+def test_burn_rate_arithmetic():
+    """bad_fraction / error_budget: 20% TTFT misses against a 10%
+    budget burns at 2x."""
+    t = tracker()
+    for i in range(10):
+        ttft = 2.0 if i < 2 else 0.5          # 2 of 10 miss 1.0s
+        t.observe_request(float(i) * 0.1, ok=True, ttft_s=ttft,
+                          tpot_s=0.05)
+    rates = t.burn_rates(1.0)
+    assert rates["ttft"] == pytest.approx(0.2 / 0.1)
+    assert rates["tpot"] == 0.0
+    assert rates["availability"] == 0.0
+
+
+def test_burn_rate_100pct_bad_saturates_at_inverse_budget():
+    t = tracker()
+    for i in range(5):
+        t.observe_request(float(i), ok=False)
+    # availability budget 1%: all-bad burns at 1/0.01 = 100x
+    assert t.burn_rates(4.0)["availability"] == pytest.approx(100.0)
+
+
+def test_sliding_window_evicts_old_misses():
+    t = tracker()
+    # 5 misses at t=0..4, then quiet; window is 10s
+    for i in range(5):
+        t.observe_request(float(i), ok=True, ttft_s=5.0)
+    assert t.burn_rates(5.0)["ttft"] > 0
+    # at t=30 every miss is >10s old: budget stops burning. The
+    # window sees no traffic -> burn 0 (no traffic burns no budget)
+    assert t.burn_rates(30.0)["ttft"] == 0.0
+
+
+def test_window_mixes_eviction_and_fresh_goods():
+    t = tracker()
+    for i in range(4):
+        t.observe_request(float(i), ok=True, ttft_s=5.0)    # misses
+    for i in range(4, 12):
+        t.observe_request(float(i), ok=True, ttft_s=0.1)    # good
+    # at t=12, window [2..12] holds misses at t=2,3 + 8 goods
+    assert t.burn_rates(12.0)["ttft"] == \
+        pytest.approx((2 / 10) / 0.1)
+
+
+def test_latency_slis_only_see_measured_requests():
+    """A failed request with no first token is an availability miss,
+    never a TTFT sample."""
+    t = tracker()
+    t.observe_request(0.0, ok=False, ttft_s=None, tpot_s=None)
+    rates = t.burn_rates(0.0)
+    assert rates["availability"] == pytest.approx(100.0)
+    assert rates["ttft"] == 0.0 and rates["tpot"] == 0.0
+
+
+def test_memory_bounded_by_max_events():
+    t = tracker(max_events=100)
+    for i in range(10_000):
+        t.observe_request(0.001 * i, ok=True, ttft_s=0.5, tpot_s=0.05)
+    for w in t._windows.values():
+        assert len(w.events) <= 100
+        assert w.total == 10_000         # totals still exact
+
+
+def test_degradation_context_gauge():
+    t = tracker()
+    for i in range(4):
+        t.note_degradation(float(i), level=0)
+    for i in range(4, 8):
+        t.note_degradation(float(i), level=2)
+    assert t.degraded_fraction(7.0) == pytest.approx(0.5)
+    g = t.gauges(7.0)
+    assert g["slo_degraded_fraction"] == pytest.approx(0.5)
+    assert set(g) == {"slo_ttft_burn_rate", "slo_tpot_burn_rate",
+                      "slo_availability_burn_rate",
+                      "slo_degraded_fraction"}
+
+
+def test_summary_shape():
+    t = tracker()
+    t.observe_request(0.0, ok=True, ttft_s=0.2, tpot_s=0.01)
+    s = t.summary()
+    assert {o["name"] for o in s["objectives"]} == \
+        {"ttft", "tpot", "availability"}
+    for o in s["objectives"]:
+        assert 0 <= o["bad_fraction"] <= 1
+        assert o["burn_rate"] >= 0
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        SLOObjective("x", target=1.0)
+    with pytest.raises(ValueError):
+        SLOObjective("x", target=0.9, window_s=0)
+    with pytest.raises(ValueError):
+        SLOTracker([SLOObjective("a", 0.9), SLOObjective("a", 0.8)])
+
+
+def test_default_objectives_cover_the_three_slis():
+    names = {o.name for o in default_objectives()}
+    assert names == {"ttft", "tpot", "availability"}

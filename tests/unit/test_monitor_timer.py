@@ -8,7 +8,9 @@ import gc
 from collections import deque
 
 from hcache_deepspeed_tpu.comm.comms_logging import CommsLogger
-from hcache_deepspeed_tpu.monitor.monitor import CSVMonitor, InMemoryMonitor
+from hcache_deepspeed_tpu.monitor.monitor import (CSVMonitor,
+                                                  InMemoryMonitor,
+                                                  Monitor)
 from hcache_deepspeed_tpu.utils.timer import ThroughputTimer, _Timer
 
 
@@ -141,3 +143,46 @@ def test_throughput_timer_silent_without_monitor():
     tt.start()
     tt.stop(tokens=128)          # must not raise without a monitor
     assert tt.global_step_count == 1
+
+
+# ------------------------------------------------------------------ #
+# Monitor.flush contract: explicit no-op default on the base class,
+# buffering sinks override, fan-out callers can flush deterministically
+# ------------------------------------------------------------------ #
+def test_base_monitor_flush_is_explicit_noop():
+    mon = Monitor(config=None)
+    assert mon.flush() is None          # present and safe on the base
+    assert InMemoryMonitor().flush() is None
+
+
+def test_csv_monitor_flush_makes_events_durable(tmp_path):
+    cfg = _CSVCfg()
+    cfg.output_path = str(tmp_path)
+    mon = CSVMonitor(cfg)
+    mon.write_events([("serving/ttft_s/p50", 0.2, 1)])
+    mon.flush()
+    path = tmp_path / "job" / "serving_ttft_s_p50.csv"
+    rows = list(csv.reader(path.open()))
+    assert rows[-1] == ["1", "0.2"]
+    mon.close()
+
+
+def test_serving_metrics_emit_flush_reaches_sink(tmp_path):
+    """ServingMetrics.emit(..., flush=True) drives the contract end to
+    end — the deterministic end-of-trace flush run_trace performs."""
+    from hcache_deepspeed_tpu.serving.metrics import ServingMetrics
+
+    class FlushSpy(InMemoryMonitor):
+        def __init__(self):
+            super().__init__()
+            self.flushes = 0
+
+        def flush(self):
+            self.flushes += 1
+
+    spy = FlushSpy()
+    m = ServingMetrics()
+    m.emit(spy, step=1)
+    assert spy.flushes == 0
+    m.emit(spy, step=2, flush=True)
+    assert spy.flushes == 1
