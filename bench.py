@@ -763,9 +763,52 @@ def run_zero_overlap(out_path="ZERO_OVERLAP.jsonl"):
     return 0 if ok else 4
 
 
+def run_fleet(out_path="FLEET_SERVE.jsonl"):
+    """``--fleet``: CPU-deterministic fleet-serving audit — the
+    N-replica router + latent-based KV migration stack under seeded
+    replica crash/hang/partition chaos on the shared virtual clock
+    (docs/serving.md / docs/resilience.md). Emits per-replica
+    occupancy, per-migration rows and a summary with the span-derived
+    migration/decode overlap ratio; self-compares against the
+    committed perf trajectory before writing, like the zero-overlap
+    and serve_loop phases. Never touches the TPU relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_fleet_serve
+    try:
+        results = run_fleet_serve(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(f"fleet gate failed: {exc}")),
+              flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "fleet-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "fleet chaos: latent migrations landed "
+                  "(crash/hang/partition survived)",
+        "value": summary["landings"] + summary["recompute_landings"],
+        "unit": "migrations",
+        "vs_baseline": 1.0 if summary["invariants_ok"] and
+        summary["deterministic"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("deterministic", "invariants_ok",
+                   "migration_balance_ok", "evictions",
+                   "migration_overlap_ratio", "span_overlap_ratio",
+                   "replica_crashes", "replica_states")},
+    }), flush=True)
+    ok = (summary["invariants_ok"] and summary["deterministic"] and
+          summary["migration_balance_ok"] and
+          summary["span_counter_agreement"])
+    return 0 if ok else 4
+
+
 def main():
     if "--zero-overlap" in sys.argv[1:]:
         return run_zero_overlap()
+    if "--fleet" in sys.argv[1:]:
+        return run_fleet()
     child = os.environ.get("HDS_BENCH_CHILD")
     if child or os.environ.get("HDS_BENCH_TINY") == "1":
         # child / smoke mode: measure exactly one config in-process

@@ -1030,6 +1030,128 @@ def run_chaos_serve(seed=0, n_requests=32, runs=2,
     return results
 
 
+def run_fleet_serve(seed=0, n_replicas=3, n_requests=48, runs=2,
+                    out="FLEET_SERVE.jsonl", **chaos_kw):
+    """Fleet serving mode: the N-replica router + latent-migration
+    stack under seeded replica crash/hang/partition faults on the
+    shared virtual clock (``resilience.chaos.run_fleet_chaos``). The
+    first run is traced so the migration/decode overlap ratio in the
+    artifact is SPAN-derived (``fleet.step`` spans carry both sides of
+    the pair) and must agree with the fleet's counters; ``runs``
+    identical-seed replays gate byte-identical event digests. Emits
+    per-replica occupancy rows, per-migration rows, and a summary the
+    perf registry indexes. Raises on any invariant violation — the
+    artifact IS the acceptance evidence."""
+    from ..resilience import run_fleet_chaos
+    from ..telemetry.tracer import get_tracer
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    # every run traced: the crossover model mines the span buffer when
+    # the tracer is on, so mixing traced/untraced runs would change
+    # calibration (and the digest) between them
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    chaos = []
+    span_events = None
+    try:
+        for _ in range(max(1, runs)):
+            tracer.clear()
+            chaos.append(run_fleet_chaos(
+                seed=seed, n_replicas=n_replicas,
+                n_requests=n_requests, **chaos_kw))
+            if span_events is None:
+                span_events = tracer.events()
+    finally:
+        tracer.configure(enabled=was)
+    r = chaos[0]
+    digests = [c.event_digest for c in chaos]
+    deterministic = len(set(digests)) == 1
+
+    # span-derived migration/decode overlap: each fleet.step span
+    # carries (in_transit, decode_lanes); the ratio read off the spans
+    # must equal the counter-derived one in the summary
+    steps = [e for e in span_events
+             if e.get("ph") == "X" and e.get("name") == "fleet.step"]
+    transit = [e for e in steps
+               if (e.get("args") or {}).get("in_transit", 0) > 0]
+    overlapped = [e for e in transit
+                  if (e.get("args") or {}).get("decode_lanes", 0) > 0]
+    span_ratio = len(overlapped) / len(transit) if transit else 0.0
+    counter_ratio = r.invariants["migration_overlap_ratio"]
+    spans_agree = abs(span_ratio - counter_ratio) < 1e-9
+
+    emit({"phase": "fleet-plan", "seed": seed,
+          "n_replicas": n_replicas, "n_requests": n_requests,
+          "plan": r.plan})
+    for rid, rep in sorted(r.fleet_summary["replicas"].items()):
+        emit({"phase": "fleet-replica", "replica": int(rid),
+              "state": rep["state"], "steps": rep["steps"],
+              "mean_occupancy": rep["mean_occupancy"],
+              "kv_util_peak": rep["kv_util_peak"],
+              "free_blocks": rep["free_blocks"],
+              "initial_free_blocks": rep["initial_free_blocks"],
+              "done": rep["done"],
+              "preemptions": rep["counters"]["preemptions"],
+              "restores": rep["counters"]["restores"],
+              "recompute_reentries":
+                  rep["counters"]["recompute_reentries"]})
+    for m in r.migrations:
+        emit({"phase": "fleet-migration", **m})
+    for req in r.requests:
+        emit({"phase": "fleet-request", **req})
+    c = r.invariants["counters"]
+    emit({"phase": "fleet-summary", "seed": seed,
+          "n_replicas": n_replicas, "n_requests": n_requests,
+          "runs": len(chaos),
+          "deterministic": deterministic,
+          "event_digest": digests[0],
+          "invariants_ok": all(x.ok for x in chaos),
+          "violations": sum((x.violations for x in chaos), []),
+          "migration_balance_ok":
+              r.invariants["migration_balance_ok"],
+          "evictions": c["evictions"], "landings": c["landings"],
+          "recompute_landings": c["recompute_landings"],
+          "expired_in_transit": c["expired_in_transit"],
+          "replica_crashes": c["replica_crashes"],
+          "replica_hangs": c["replica_hangs"],
+          "replica_partitions": c["replica_partitions"],
+          "migration_overlap_ratio": counter_ratio,
+          "span_overlap_ratio": round(span_ratio, 6),
+          "span_counter_agreement": spans_agree,
+          "replica_states": r.invariants["replica_states"],
+          "router": r.fleet_summary["router"]})
+
+    # regression sentinel self-compare vs the committed trajectory
+    # (non-fatal: the artifact records verdicts; `perf check` gates)
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "FLEET_SERVE.jsonl", results))
+    if fh is not None:
+        fh.close()
+    if not all(x.ok for x in chaos):
+        raise RuntimeError(
+            f"fleet chaos invariants violated: "
+            f"{sum((x.violations for x in chaos), [])}")
+    if not deterministic:
+        raise RuntimeError(
+            f"fleet determinism gate failed: digests {digests}")
+    if not spans_agree:
+        raise RuntimeError(
+            f"span-derived overlap {span_ratio} != counter ratio "
+            f"{counter_ratio}")
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False, lookup=False):
@@ -1232,11 +1354,25 @@ def _main_serve_loop(argv):
                         "determinism gates, CHAOS_SERVE.jsonl artifact")
     p.add_argument("--chaos-runs", type=int, default=2,
                    help="identical-seed replays for the determinism "
-                        "gate (chaos mode)")
+                        "gate (chaos/fleet modes)")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet mode: N-replica router + latent "
+                        "migration under replica crash/hang/partition "
+                        "chaos on the shared virtual clock, "
+                        "FLEET_SERVE.jsonl artifact")
+    p.add_argument("--n-replicas", type=int, default=3,
+                   help="engine replicas in fleet mode")
     p.add_argument("--out", default="SERVE_LOOP.jsonl",
                    help="also append rows to this jsonl file "
                         "('' = stdout only)")
     args = p.parse_args(argv)
+    if args.fleet:
+        out = args.out if args.out != "SERVE_LOOP.jsonl" \
+            else "FLEET_SERVE.jsonl"
+        run_fleet_serve(seed=args.seed, n_replicas=args.n_replicas,
+                        n_requests=args.n_requests,
+                        runs=args.chaos_runs, out=out)
+        return 0
     if args.chaos:
         out = args.out if args.out != "SERVE_LOOP.jsonl" \
             else "CHAOS_SERVE.jsonl"

@@ -79,6 +79,14 @@ TOLERANCES: Dict[str, Tolerance] = {
     "chaos.deterministic": Tolerance("higher", rel=0.0),
     "chaos.invariants_ok": Tolerance("higher", rel=0.0),
     "chaos.ckpt_fallback_ok": Tolerance("higher", rel=0.0),
+    # fleet chaos gates (CPU-deterministic; booleans are hard gates,
+    # the overlap ratio tolerates router-policy evolution)
+    "fleet.deterministic": Tolerance("higher", rel=0.0),
+    "fleet.invariants_ok": Tolerance("higher", rel=0.0),
+    "fleet.migration_balance_ok": Tolerance("higher", rel=0.0),
+    "fleet.span_counter_agreement": Tolerance("higher", rel=0.0),
+    "fleet.migration_overlap_ratio": Tolerance("higher", rel=0.25),
+    "fleet.violations": Tolerance("lower", rel=0.0),
     # freshness alarm (ROADMAP item 5): informational headline — the
     # gate never fails on it (direction "lower" but compared via the
     # freshness block, not check_points)

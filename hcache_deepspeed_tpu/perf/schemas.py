@@ -369,6 +369,56 @@ def parse_chaos_serve(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_fleet_serve(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        phase = row.get("phase", "")
+        if phase == "fleet-summary":
+            for key, metric in (
+                    ("deterministic", "fleet.deterministic"),
+                    ("invariants_ok", "fleet.invariants_ok"),
+                    ("migration_balance_ok",
+                     "fleet.migration_balance_ok"),
+                    ("span_counter_agreement",
+                     "fleet.span_counter_agreement")):
+                if key in row:
+                    pts.append(MetricPoint(metric,
+                                           1.0 if row[key] else 0.0,
+                                           file, phase=phase))
+            for key, metric in (
+                    ("migration_overlap_ratio",
+                     "fleet.migration_overlap_ratio"),
+                    ("span_overlap_ratio",
+                     "fleet.span_overlap_ratio"),
+                    ("evictions", "fleet.evictions"),
+                    ("landings", "fleet.landings"),
+                    ("recompute_landings", "fleet.recompute_landings"),
+                    ("expired_in_transit",
+                     "fleet.expired_in_transit"),
+                    ("replica_crashes", "fleet.replica_crashes")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase))
+            pts.append(MetricPoint(
+                "fleet.violations",
+                float(len(row.get("violations", []))), file,
+                phase=phase))
+        elif phase == "fleet-replica":
+            tags = {"replica": str(row.get("replica", "")),
+                    "state": str(row.get("state", ""))}
+            for key, metric in (
+                    ("mean_occupancy", "fleet.replica_mean_occupancy"),
+                    ("kv_util_peak", "fleet.replica_kv_util_peak"),
+                    ("restores", "fleet.replica_restores"),
+                    ("preemptions", "fleet.replica_preemptions")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase,
+                                           tags=tags))
+    return pts
+
+
 def _workload_tag(file: str) -> Dict[str, str]:
     """The workload identity is the filename stem — SERVE_7B_INT8 and
     SERVE_7B measure different programs and must never be compared as
@@ -591,6 +641,11 @@ FAMILIES: List[ArtifactFamily] = [
     ArtifactFamily(
         "chaos-serve", r"^CHAOS_SERVE\.jsonl$", parse_chaos_serve,
         "chaos harness: fault plan, invariants, determinism gate"),
+    ArtifactFamily(
+        "fleet-serve", r"^FLEET_SERVE\.jsonl$", parse_fleet_serve,
+        "fleet serving: N-replica router + latent migration under "
+        "replica chaos (per-replica occupancy, migration accounting, "
+        "span-derived overlap, determinism gate)"),
     ArtifactFamily(
         "restore-bench",
         r"^RESTORE_[A-Z0-9_]+\.jsonl$", parse_restore_bench,

@@ -5,7 +5,11 @@ No reference analog in DeepSpeed — its failure story is elasticity
 semantics instead: inject any failure deterministically
 (``faults``), retry/bound/trip around it (``retry``), degrade
 gracefully under a storm (``degradation``), and prove the whole thing
-with seeded chaos runs over the virtual-clock simulation (``chaos``).
+with seeded chaos runs over the virtual-clock simulation (``chaos``) —
+at engine scope (``run_chaos``) and at fleet scope
+(``run_fleet_chaos``: replica crash/hang/partition failure domains
+over the N-replica serving fleet, with migration accounting and
+fleet-wide terminal-state invariants).
 ``policy.ResiliencePolicy`` is the knob bundle the serving scheduler
 consumes; the fault-site hooks live in the engine, restore pipeline,
 block allocator, host latent store and checkpoint engine.
@@ -20,5 +24,7 @@ from .policy import ResiliencePolicy  # noqa: F401
 from .retry import (BreakerState, CircuitBreaker,  # noqa: F401
                     RetryPolicy, Watchdog, call_with_retry)
 
-from .chaos import (ChaosResult, build_chaos_trace,  # noqa: F401
-                    default_fault_plan, run_chaos)
+from .chaos import (ChaosResult, FleetChaosResult,  # noqa: F401
+                    build_chaos_trace, default_fault_plan,
+                    default_fleet_fault_plan, run_chaos,
+                    run_fleet_chaos)

@@ -102,6 +102,219 @@ def build_chaos_trace(seed: int, n_requests: int, vocab: int,
     return reqs
 
 
+def default_fleet_fault_plan(seed: int = 0) -> FaultPlan:
+    """Replica-level failure domains on top of a thinned engine-level
+    storm. Sites fire once per live replica per fleet step (hit
+    counters are per-site, fleet-global), so ``at_hits`` pins faults
+    to deterministic (step, replica) coordinates for a fixed fleet
+    size. One crash, a hang and a partition per run by default —
+    enough to exercise evacuation, breaker trip/heal and re-routing
+    while the trace still drains."""
+    return FaultPlan(seed=seed, rules=[
+        FaultRule("replica.crash", at_hits=(90,), max_faults=1),
+        FaultRule("replica.hang", at_hits=(40,), probability=0.002,
+                  max_faults=2),
+        FaultRule("replica.net_partition", at_hits=(150,),
+                  probability=0.002, max_faults=2),
+        FaultRule("engine.decode", probability=0.01, max_faults=2),
+        FaultRule("restore.ship", probability=0.02, max_faults=4),
+    ])
+
+
+@dataclass
+class FleetChaosResult:
+    seed: int
+    n_replicas: int
+    plan: Dict
+    requests: List[Dict]
+    event_digest: str
+    fleet_summary: Dict
+    migrations: List[Dict]
+    invariants: Dict
+    ok: bool = False
+    violations: List[str] = field(default_factory=list)
+
+
+def run_fleet_chaos(seed: int = 0, n_replicas: int = 3,
+                    n_requests: int = 48,
+                    fault_plan: Optional[FaultPlan] = None,
+                    policy: Optional[ResiliencePolicy] = None,
+                    num_blocks: int = 12, block_size: int = 8,
+                    max_lanes: int = 4, max_tracked: int = 8,
+                    max_context: int = 64, max_new: int = 10,
+                    rps: float = 400.0,
+                    drain_replica: Optional[int] = None,
+                    drain_at_step: int = 60) -> FleetChaosResult:
+    """One deterministic fleet chaos run: a seeded multi-tenant trace
+    spread over ``n_replicas`` virtual-clock ``SimulatedEngine``
+    replicas, with replica crash/hang/partition faults (plus a thinned
+    engine-level storm) injected from the plan. Optionally starts a
+    graceful drain of ``drain_replica`` once ``drain_at_step`` fleet
+    steps have run.
+
+    Invariants checked (the fleet robustness contract):
+
+    1. exactly-one-terminal-state per request *across the whole
+       fleet* — terminal everywhere-counted exactly once (replica done
+       maps + the fleet's own terminal map);
+    2. zero KV-block leaks and zero tracked sequences on every
+       *surviving* (non-DEAD) replica;
+    3. migration accounting balance — every eviction reached exactly
+       one terminal mode (landed / recompute-landed / expired /
+       cancelled / failed), nothing left in transit;
+    4. per-replica restore accounting (engine restore_stats vs
+       scheduler counters) on surviving replicas;
+    5. determinism — the digest over the fleet event log + every
+       replica's scheduler event log is a pure function of the seed
+       (the caller runs twice and compares digests).
+    """
+    from ..inference.config import RaggedInferenceEngineConfig
+    from ..serving import (FleetConfig, ReplicaState, RouterConfig,
+                           ServerConfig, ServingFleet, SimulatedEngine,
+                           VirtualClock)
+
+    plan = fault_plan if fault_plan is not None \
+        else default_fleet_fault_plan(seed)
+    policy = policy or ResiliencePolicy(seed=seed)
+
+    def make_engine():
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": max_tracked,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": max_lanes,
+                           "max_context": max_context},
+            kv_cache={"block_size": block_size,
+                      "num_blocks": num_blocks},
+            hcache={"enable_latents": True}))
+
+    fleet = ServingFleet(
+        engines=[make_engine() for _ in range(n_replicas)],
+        clock=VirtualClock(),
+        config=FleetConfig(
+            n_replicas=n_replicas,
+            server=ServerConfig(max_queue_depth=n_requests + 1,
+                                kv_demand_fraction=float("inf")),
+            router=RouterConfig()),
+        resilience=policy)
+    reqs = build_chaos_trace(seed, n_requests,
+                             fleet.replicas[0].engine.vocab_size,
+                             max_new=max_new, rps=rps,
+                             prompt_hi=min(24,
+                                           max_context - max_new - 1))
+    with injected(plan) as inj:
+        if drain_replica is None:
+            fleet.run_trace(reqs)
+        else:
+            # drive arrivals manually so the drain starts mid-trace
+            arrivals = sorted(reqs, key=lambda r: (r.arrival_time,
+                                                   r.uid))
+            drained = False
+            steps = 0
+            while arrivals or fleet.has_work:
+                now = fleet.clock.now()
+                while arrivals and arrivals[0].arrival_time <= now:
+                    fleet.submit(request=arrivals.pop(0))
+                if not fleet.has_work and arrivals:
+                    fleet.clock.advance_to(arrivals[0].arrival_time)
+                    continue
+                if not drained and fleet.step_idx >= drain_at_step \
+                        and fleet.replicas[drain_replica].state \
+                        is ReplicaState.UP:
+                    fleet.drain(drain_replica)
+                    drained = True
+                fleet.step()
+                steps += 1
+                if steps > 1_000_000:
+                    raise RuntimeError("fleet chaos livelock:\n"
+                                       + fleet.snapshot())
+        fault_fired = dict(inj.fired)
+
+    violations: List[str] = []
+    # 1. exactly-one-terminal-state across the whole fleet
+    terminal = {"DONE", "REJECTED", "FAILED"}
+    for r in reqs:
+        if r.state.name not in terminal:
+            violations.append(
+                f"request {r.uid} ended non-terminal: {r.state.name}")
+        holders = sum(1 for rep in fleet.replicas
+                      if r.uid in rep.scheduler.done)
+        holders += 1 if r.uid in fleet.done else 0
+        if holders != 1:
+            violations.append(
+                f"request {r.uid} terminal in {holders} places "
+                "(must be exactly 1)")
+    # 2. zero leaks on every surviving replica
+    for rep in fleet.replicas:
+        if rep.state is ReplicaState.DEAD:
+            continue
+        free = rep.engine.state.free_blocks
+        if free != rep.initial_free_blocks:
+            violations.append(
+                f"replica {rep.id}: block leak "
+                f"({rep.initial_free_blocks} free before, {free} "
+                "after)")
+        tracked = rep.engine.state.n_tracked_sequences
+        if tracked != 0:
+            violations.append(
+                f"replica {rep.id}: {tracked} sequences still "
+                "tracked post-trace")
+    # 3. migration accounting balance
+    if fleet.in_transit:
+        violations.append(
+            f"{len(fleet.in_transit)} migrations still in transit "
+            "post-trace")
+    c = fleet.counters
+    landed = (c["landings"] + c["recompute_landings"] +
+              c["expired_in_transit"] + c["cancelled_in_transit"] +
+              c["failed_in_transit"])
+    if c["evictions"] != landed:
+        violations.append(
+            f"migration imbalance: {c['evictions']} evictions vs "
+            f"{landed} terminal migrations ({dict(c)})")
+    # 4. per-replica restore accounting (surviving replicas)
+    for rep in fleet.replicas:
+        if rep.state is ReplicaState.DEAD:
+            continue
+        rs = rep.engine.restore_stats
+        sched = rep.scheduler
+        if rs["restores"] != sched.total_restores:
+            violations.append(
+                f"replica {rep.id}: restore_stats.restores "
+                f"{rs['restores']} != scheduler total_restores "
+                f"{sched.total_restores}")
+
+    digest = _digest(fleet.event_log())
+    result = FleetChaosResult(
+        seed=seed, n_replicas=n_replicas, plan=plan.to_dict(),
+        requests=[{
+            "uid": r.uid, "state": r.state.name, "error": r.error,
+            "reject_reason": r.reject_reason,
+            "priority": r.priority, "deadline": r.deadline,
+            "tokens": len(r.tokens_out),
+            "replica": r.replica,
+            "preemptions": r.n_preemptions,
+            "restores": r.n_restores,
+            "recomputes": r.n_recomputes,
+            "migrations": r.n_migrations,
+        } for r in reqs],
+        event_digest=digest,
+        fleet_summary=fleet.summary(),
+        migrations=[m.to_row() for m in fleet.migrations],
+        invariants={
+            "terminal_states": sorted({r.state.name for r in reqs}),
+            "replica_states": {str(rep.id): rep.state.name
+                               for rep in fleet.replicas},
+            "fault_fired": fault_fired,
+            "counters": dict(fleet.counters),
+            "migration_balance_ok": fleet.migration_balance_ok,
+            "migration_overlap_ratio":
+                round(fleet.migration_overlap_ratio, 6),
+        },
+        violations=violations,
+        ok=not violations)
+    return result
+
+
 def run_chaos(seed: int = 0, n_requests: int = 32,
               fault_plan: Optional[FaultPlan] = None,
               policy: Optional[ResiliencePolicy] = None,

@@ -51,6 +51,12 @@ SITES = (
     "host.latents",     # host latent store absorption
     "ckpt.write",       # checkpoint state persistence
     "ckpt.read",        # checkpoint state restoration
+    # replica failure domains (fired by the serving fleet, once per
+    # live replica per fleet step, ctx carries the replica id)
+    "replica.crash",          # replica dies: engine + KV lost
+    "replica.hang",           # replica stops stepping (heals later)
+    "replica.net_partition",  # router cannot reach it (it keeps
+                              # serving residents; heals later)
 )
 
 

@@ -10,14 +10,24 @@ emitted through the ``monitor.MonitorMaster`` event path
 (``metrics.py``). ``sim.py`` provides a model-free engine double with
 the real block-budget arithmetic so the whole policy is CPU-testable.
 ``crossover.py`` prices restore vs recompute per preempted sequence —
-the analytic model the scheduler consults at re-entry.
+the analytic model the scheduler consults at re-entry. Above all of
+that sits the fleet layer: ``router.py`` (KV-pressure- and
+prefix-aware placement, per-replica health breakers, migration
+planning priced by the crossover's per-link transfer term) and
+``fleet.py`` (N replicas sharing one clock, cross-replica migration
+with HCache latents as the transfer payload, replica failure domains:
+crash/hang/partition, graceful drain, crash recovery).
 """
 
 from .clock import MonotonicClock, VirtualClock  # noqa: F401
 from .crossover import (CrossoverConfig,  # noqa: F401
                         RestoreCrossoverModel)
+from .fleet import (FleetConfig, FleetReplica,  # noqa: F401
+                    Migration, ReplicaState, ServingFleet)
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
+from .router import (FleetRouter, ReplicaSnapshot,  # noqa: F401
+                     RouterConfig)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         StepReport)
 from .server import ServerConfig, ServingServer  # noqa: F401

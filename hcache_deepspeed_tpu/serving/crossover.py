@@ -64,6 +64,11 @@ class CrossoverConfig:
     link_bytes_per_s: float = 0.0
     prefill_tokens_per_s: float = 0.0
     replay_tokens_per_s: float = 0.0
+    #: cross-replica migration hysteresis: migrating must beat staying
+    #: by this factor before the router moves a request (1.0 = any
+    #: saving justifies a move; >1 demands a margin so near-ties do not
+    #: bounce payloads between replicas)
+    migrate_hysteresis: float = 1.0
 
 
 class RestoreCrossoverModel:
@@ -206,6 +211,40 @@ class RestoreCrossoverModel:
     def calibrated(self) -> bool:
         return self.samples["prefill"] >= self.config.min_samples and \
             self.prefill_tokens_per_s > 0
+
+    # ------------------------------------------------------------- #
+    # cross-replica migration (the per-link transfer-cost extension)
+    # ------------------------------------------------------------- #
+    def migrate_cost_s(self, tokens: int, dst_occupancy: float,
+                       link_bytes_per_s: float) -> float:
+        """Price a cross-replica migration of a ``tokens``-long cached
+        prefix: ship ``latent_bytes(T)`` over the *inter-replica* link
+        (``link_bytes_per_s`` — a fleet property, distinct from the
+        host→HBM link the restore term prices), then restore on the
+        destination at *its* occupancy."""
+        xfer = 0.0
+        if link_bytes_per_s > 0:
+            xfer = tokens * self.profile["latent_bytes_per_token"] \
+                / link_bytes_per_s
+        return xfer + self.restore_cost_s(tokens, dst_occupancy)
+
+    def decide_migration(self, tokens: int, src_occupancy: float,
+                         dst_occupancy: float,
+                         link_bytes_per_s: float) -> str:
+        """``"migrate"`` or ``"stay"`` — move the request iff transfer
+        + destination restore beats restoring in place at the source's
+        occupancy by the configured hysteresis margin. Uncalibrated ⇒
+        ``"migrate"``: the caller only asks after a pressure gap
+        triggered, and refusing on an uncalibrated model would disable
+        rebalancing exactly when no telemetry exists yet."""
+        if not self.calibrated:
+            return "migrate"
+        stay = self.restore_cost_s(tokens, src_occupancy)
+        move = self.migrate_cost_s(tokens, dst_occupancy,
+                                   link_bytes_per_s)
+        if move * self.config.migrate_hysteresis <= stay:
+            return "migrate"
+        return "stay"
 
     def decide(self, tokens: int, occupancy: float = 0.0) -> str:
         """``"restore"`` or ``"recompute"`` — whichever the model

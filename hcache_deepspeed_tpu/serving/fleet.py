@@ -1,0 +1,938 @@
+"""N-replica serving fleet with latent-based cross-replica migration.
+
+The serving stack below this file is single-engine: one scheduler, one
+``ServingServer``, one failure domain. This file builds the fleet layer
+above it — the production shape for the north-star multi-tenant load —
+out of the two primitives the repo already proved:
+
+* **HCache latents as the transfer payload.** A preempted request's
+  host latents are a compact, replayable substitute for its raw KV
+  (PR 3). Migration is therefore just: preempt-to-latents on the hot
+  replica (the existing scheduler path), ship the latent payload over
+  the inter-replica link (virtual time = bytes/link + fixed overhead),
+  and re-enter through the destination's ordinary restore pass — the
+  ``RestorePipeline`` lanes replay QKV chunk-by-chunk overlapped with
+  the destination's resident decode, priced by the crossover policy
+  extended with the per-link transfer term
+  (:meth:`~.crossover.RestoreCrossoverModel.decide_migration`).
+* **The deterministic virtual-clock simulation.** All N replicas share
+  ONE clock; each fleet step fires fault sites, processes transits,
+  routes, rebalances, then steps every live replica at the same
+  simulated instant and advances the clock once by the parallel-max
+  step cost. Everything — placement, migrations, failures, token
+  streams — is a pure function of (trace, seed), which is what lets
+  the fleet chaos gate (``resilience.chaos.run_fleet_chaos``) assert
+  byte-identical event streams in tier-1.
+
+Replica failure domains (the robustness headline):
+
+* ``replica.crash`` — the engine and its KV die. Every non-terminal
+  request is evacuated WITHOUT touching the dead engine: queued work
+  re-routes as-is; live requests leave as latent payloads in transit
+  (restore on landing) or, when their payload was incomplete, land
+  payload-less and re-enter via the recompute re-prefill path. Never
+  dropped: the fleet chaos invariant is exactly-one-terminal-state
+  per request across the whole fleet.
+* ``replica.hang`` — the replica stops stepping. Health probes fail,
+  its router breaker trips, no new work lands; it heals after a
+  deterministic number of fleet steps and the HALF_OPEN probe
+  re-admits it.
+* ``replica.net_partition`` — the router cannot reach the replica but
+  it keeps serving its residents; no routes or migrations in/out
+  until the partition heals.
+
+Graceful drain (:meth:`ServingFleet.drain`) composes the same pieces:
+a DRAINING replica takes no new work and migrates everything out via
+latents — running requests preempted first — until it is empty, then
+stops with its block pool intact.
+
+Thread mode exists for real-clock operation (each replica's
+``ServingServer`` runs its own loop thread; a fleet pump thread runs
+probes/transit/rebalance), but the deterministic virtual-clock path is
+the contract tier-1 gates.
+"""
+
+import threading
+from contextlib import nullcontext
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..resilience.faults import InjectedFault, get_injector
+from ..resilience.policy import ResiliencePolicy
+from ..telemetry.tracer import get_tracer
+from .clock import MonotonicClock, VirtualClock
+from .crossover import RestoreCrossoverModel
+from .request import Request, RequestState
+from .router import FleetRouter, ReplicaSnapshot, RouterConfig
+from .server import ServerConfig, ServingServer
+
+
+class ReplicaState(Enum):
+    UP = 0            # serving + routable
+    DRAINING = 1      # serving, not routable, migrating everything out
+    HANGING = 2       # not stepping (heals after hang_steps)
+    PARTITIONED = 3   # stepping but unreachable by the router
+    DEAD = 4          # crashed: engine + KV lost
+    STOPPED = 5       # drained clean
+
+
+#: states in which the replica's scheduler takes steps
+_STEPPING = (ReplicaState.UP, ReplicaState.DRAINING,
+             ReplicaState.PARTITIONED)
+
+
+@dataclass
+class FleetConfig:
+    n_replicas: int = 3
+    server: ServerConfig = field(default_factory=ServerConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
+    #: inter-replica latent link (bytes/s) pricing migration transit
+    link_bytes_per_s: float = 256e6
+    #: fixed per-migration overhead (connection + lane setup), the
+    #: virtual-clock floor of any transit
+    migration_overhead_s: float = 2e-3
+    #: fleet-step bookkeeping overhead added to the parallel-max
+    #: replica cost (also the clock floor when no replica stepped,
+    #: so a fully hung fleet still makes virtual-time progress)
+    step_overhead_s: float = 1e-4
+    #: deterministic failure-domain durations (fleet steps)
+    hang_steps: int = 6
+    partition_steps: int = 8
+    #: health-probe cadence (fleet steps)
+    probe_every: int = 1
+    #: thread mode: pump-thread cadence (seconds)
+    pump_interval_s: float = 0.005
+
+
+@dataclass
+class Migration:
+    """One cross-replica move, from eviction to its terminal mode."""
+    uid: int
+    src: int
+    dst: int                   # -1 until (re)routed at landing
+    nbytes: int
+    tokens: int
+    reason: str                # "rebalance" | "drain" | "crash"
+    depart_t: float
+    land_t: float
+    #: terminal mode: "restore" | "recompute" | "expired" |
+    #: "cancelled" | "failed"; "" while in transit
+    mode: str = ""
+    request: Optional[Request] = None
+
+    def to_row(self) -> Dict:
+        return {"uid": self.uid, "src": self.src, "dst": self.dst,
+                "bytes": self.nbytes, "tokens": self.tokens,
+                "reason": self.reason, "mode": self.mode,
+                "depart_t": round(self.depart_t, 6),
+                "land_t": round(self.land_t, 6)}
+
+
+class FleetReplica:
+    """One engine replica: a ``ServingServer`` plus failure-domain
+    state the fleet manages."""
+
+    def __init__(self, replica_id: int, engine, clock,
+                 config: FleetConfig,
+                 resilience: Optional[ResiliencePolicy] = None,
+                 sample_fn=None):
+        self.id = replica_id
+        self.server = ServingServer(
+            engine, config=config.server, clock=clock,
+            resilience=resilience, sample_fn=sample_fn,
+            replica_id=replica_id)
+        self.state = ReplicaState.UP
+        self.prev_state = ReplicaState.UP
+        self.initial_free_blocks = engine.state.free_blocks
+        self.hang_until = 0
+        self.partition_until = 0
+        self.steps = 0
+        self.last_probe_steps = 0
+        self.last_report = None
+        #: trace-level occupancy/KV accounting (mean batch occupancy
+        #: and peak KV utilization over the replica's stepped life)
+        self.occupancy_sum = 0.0
+        self.kv_util_peak = 0.0
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.occupancy_sum / self.steps if self.steps else 0.0
+
+    @property
+    def engine(self):
+        return self.server.scheduler.engine
+
+    @property
+    def scheduler(self):
+        return self.server.scheduler
+
+    @property
+    def kv_utilization(self) -> float:
+        alloc = self.engine.state.allocator
+        return 1.0 - alloc.free_blocks / max(alloc.num_blocks, 1)
+
+    @property
+    def live_requests(self) -> int:
+        s = self.scheduler
+        return (len(s.queue) + len(s.running) + len(s.suspended) +
+                len(s.restoring) + len(self.server._ingress))
+
+
+class ServingFleet:
+    """Fleet frontend over N engine replicas sharing one clock.
+
+    ``engines`` is a list of N engines (each with the
+    ``InferenceEngineV2`` serving surface; ``SimulatedEngine`` for the
+    deterministic tier-1 simulation) or a zero-arg factory called
+    ``config.n_replicas`` times.
+    """
+
+    def __init__(self, engines=None, config: FleetConfig = None,
+                 clock=None, resilience: ResiliencePolicy = None,
+                 sample_fn=None,
+                 engine_factory: Callable = None):
+        self.config = config or FleetConfig()
+        self.clock = clock or MonotonicClock()
+        self.virtual = isinstance(self.clock, VirtualClock)
+        if engines is None:
+            if engine_factory is None:
+                raise ValueError("need engines or engine_factory")
+            engines = [engine_factory()
+                       for _ in range(self.config.n_replicas)]
+        engines = list(engines)
+        self.config.n_replicas = len(engines)
+        self.replicas = [
+            FleetReplica(i, eng, self.clock, self.config,
+                         resilience=resilience, sample_fn=sample_fn)
+            for i, eng in enumerate(engines)]
+        crossover = None
+        if getattr(engines[0].config.hcache, "enable_latents", False) \
+                and hasattr(engines[0], "restore_profile"):
+            crossover = RestoreCrossoverModel(
+                engines[0].restore_profile())
+        #: the migrate-vs-stay pricing model the router consults (its
+        #: calibration rides the replica schedulers' crossover models;
+        #: feed ``observe_*`` samples here for router-side pricing)
+        self.crossover = crossover
+        self.router = FleetRouter(
+            self.config.router, crossover=crossover,
+            link_bytes_per_s=self.config.link_bytes_per_s)
+        self._lock = threading.Lock()
+        #: not-yet-placed requests (unroutable ones wait here)
+        self.pending: List[Request] = []
+        self.in_transit: List[Migration] = []
+        #: complete migration history (terminal modes filled in)
+        self.migrations: List[Migration] = []
+        #: requests the FLEET terminated (transit expiry, fleet down);
+        #: everything else terminates inside exactly one replica's
+        #: scheduler.done
+        self.done: Dict[int, Request] = {}
+        #: fleet-level replayable event log [step, event, uid, detail]
+        self.events: List[Tuple[int, str, int, str]] = []
+        self.step_idx = 0
+        self._next_uid = 0
+        self.counters = {
+            "evictions": 0, "landings": 0, "recompute_landings": 0,
+            "expired_in_transit": 0, "cancelled_in_transit": 0,
+            "failed_in_transit": 0, "requeued": 0, "reroutes": 0,
+            "replica_crashes": 0, "replica_hangs": 0,
+            "replica_partitions": 0, "drains_completed": 0,
+        }
+        #: migration/decode overlap accounting: fleet steps with >=1
+        #: migration in flight, and the subset where some replica also
+        #: dispatched decode lanes (transit hides under decode)
+        self.transit_steps = 0
+        self.overlapped_transit_steps = 0
+        self._routable: set = {r.id for r in self.replicas}
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- #
+    # intake
+    # ------------------------------------------------------------- #
+    def submit(self, prompt=None, request: Request = None,
+               **kw) -> Request:
+        """Enqueue a request for placement (built from ``prompt`` +
+        kwargs when no ``request`` is given). Placement happens in the
+        next fleet step's route pass (or the pump thread's, in thread
+        mode); per-replica admission control still applies at the
+        chosen replica's ingress."""
+        with self._lock:
+            if request is None:
+                request = Request(uid=self._next_uid,
+                                  prompt=list(prompt),
+                                  arrival_time=self.clock.now(), **kw)
+            self._next_uid = max(self._next_uid, request.uid) + 1
+            self.pending.append(request)
+            return request
+
+    def cancel(self, uid: int) -> None:
+        with self._lock:
+            for req in self.pending:
+                if req.uid == uid:
+                    req.cancelled = True
+                    return
+            for m in self.in_transit:
+                if m.uid == uid:
+                    m.request.cancelled = True
+                    return
+        for r in self.replicas:
+            r.server.cancel(uid)
+
+    def request(self, uid: int) -> Optional[Request]:
+        if uid in self.done:
+            return self.done[uid]
+        for req in self.pending:
+            if req.uid == uid:
+                return req
+        for m in self.in_transit:
+            if m.uid == uid:
+                return m.request
+        for r in self.replicas:
+            req = r.scheduler.request(uid)
+            if req is not None:
+                return req
+        return None
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.pending or self.in_transit or
+                    any(r.scheduler.has_work or r.server._ingress
+                        for r in self.replicas
+                        if r.state is not ReplicaState.DEAD))
+
+    # ------------------------------------------------------------- #
+    # events / accounting
+    # ------------------------------------------------------------- #
+    def _event(self, event: str, uid: int, detail: str = "") -> None:
+        self.events.append((self.step_idx, event, uid, detail))
+        get_tracer().instant(f"fleet.{event}", uid=uid,
+                             fleet_step=self.step_idx, detail=detail)
+
+    def event_log(self) -> Dict:
+        """The replayable fleet-wide event structure the chaos digest
+        hashes: the fleet's own log plus every replica scheduler's."""
+        return {
+            "fleet": [list(e) for e in self.events],
+            "replicas": {str(r.id): [list(e)
+                                     for e in r.scheduler.events]
+                         for r in self.replicas},
+        }
+
+    @property
+    def migration_balance_ok(self) -> bool:
+        """Every eviction reached exactly one terminal migration mode:
+        landed with payload, landed for recompute, expired in transit,
+        cancelled in transit, or failed (fleet down)."""
+        c = self.counters
+        terminal = (c["landings"] + c["recompute_landings"] +
+                    c["expired_in_transit"] +
+                    c["cancelled_in_transit"] + c["failed_in_transit"])
+        return c["evictions"] == terminal + len(self.in_transit)
+
+    @property
+    def migration_overlap_ratio(self) -> float:
+        if not self.transit_steps:
+            return 0.0
+        return self.overlapped_transit_steps / self.transit_steps
+
+    def _fail_fleet(self, req: Request, error: str,
+                    now: float) -> None:
+        req.error = error
+        req.transition(RequestState.FAILED)
+        req.finished_at = now
+        req.replica = None
+        self.done[req.uid] = req
+        self._event("fail", req.uid, error)
+        get_tracer().async_end("request", req.uid, error=error)
+
+    def _all_dead(self) -> bool:
+        return all(r.state in (ReplicaState.DEAD, ReplicaState.STOPPED)
+                   for r in self.replicas)
+
+    def _locked(self, replica: FleetReplica):
+        """Scheduler mutations from the fleet need the owning server's
+        lock in thread mode; the virtual-clock sim is single-threaded."""
+        return nullcontext() if self.virtual else replica.server._lock
+
+    # ------------------------------------------------------------- #
+    # failure domains
+    # ------------------------------------------------------------- #
+    def _fault_pass(self) -> None:
+        inj = get_injector()
+        if not inj.enabled:
+            return
+        for r in self.replicas:
+            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                continue
+            try:
+                inj.fire("replica.crash", replica=r.id)
+            except InjectedFault as f:
+                self._crash(r, f)
+                continue
+            try:
+                inj.fire("replica.hang", replica=r.id)
+            except InjectedFault:
+                self._hang(r)
+            try:
+                inj.fire("replica.net_partition", replica=r.id)
+            except InjectedFault:
+                self._partition(r)
+
+    def _crash(self, r: FleetReplica, fault: BaseException) -> None:
+        """Replica died: engine + KV are gone. Evacuate every
+        non-terminal request WITHOUT engine calls — queued work
+        re-routes, live work leaves as (possibly payload-less) latent
+        migrations — and mark the server down so stray submits reject
+        typed."""
+        r.state = ReplicaState.DEAD
+        self.counters["replica_crashes"] += 1
+        self._event("replica_crash", -1,
+                    f"replica={r.id} hit={getattr(fault, 'hit', 0)}")
+        with self._locked(r):
+            r.server.error = fault
+            ingress = list(r.server._ingress)
+            r.server._ingress.clear()
+            queued, live = r.scheduler.evacuate_live()
+        for req in ingress + queued:
+            req.replica = None
+            self.counters["requeued"] += 1
+            self._event("requeue", req.uid, f"crash replica={r.id}")
+            self.pending.append(req)
+        for req in live:
+            self._begin_migration(req, r.id, -1, "crash")
+
+    def _hang(self, r: FleetReplica) -> None:
+        if r.state not in (ReplicaState.UP, ReplicaState.DRAINING,
+                           ReplicaState.PARTITIONED,
+                           ReplicaState.HANGING):
+            return
+        if r.state is not ReplicaState.HANGING:
+            r.prev_state = r.state
+            self.counters["replica_hangs"] += 1
+            self._event("replica_hang", -1, f"replica={r.id}")
+        r.state = ReplicaState.HANGING
+        r.hang_until = self.step_idx + self.config.hang_steps
+
+    def _partition(self, r: FleetReplica) -> None:
+        if r.state not in (ReplicaState.UP, ReplicaState.DRAINING,
+                           ReplicaState.PARTITIONED):
+            return
+        if r.state is not ReplicaState.PARTITIONED:
+            r.prev_state = r.state
+            self.counters["replica_partitions"] += 1
+            self._event("replica_partition", -1, f"replica={r.id}")
+        r.state = ReplicaState.PARTITIONED
+        r.partition_until = self.step_idx + self.config.partition_steps
+
+    def _heal_pass(self) -> None:
+        for r in self.replicas:
+            if r.state is ReplicaState.HANGING and \
+                    self.step_idx >= r.hang_until:
+                r.state = r.prev_state
+                self._event("replica_heal", -1,
+                            f"replica={r.id} from=hang")
+            if r.state is ReplicaState.PARTITIONED and \
+                    self.step_idx >= r.partition_until:
+                r.state = r.prev_state \
+                    if r.prev_state is not ReplicaState.PARTITIONED \
+                    else ReplicaState.UP
+                self._event("replica_heal", -1,
+                            f"replica={r.id} from=partition")
+
+    # ------------------------------------------------------------- #
+    # health probes -> router breakers -> routable set
+    # ------------------------------------------------------------- #
+    def _probe_pass(self) -> set:
+        routable = set()
+        for r in self.replicas:
+            if self.step_idx % max(self.config.probe_every, 1) == 0:
+                ok = (r.state is ReplicaState.UP and
+                      (self.step_idx == 1 or
+                       r.steps > r.last_probe_steps))
+                self.router.note_probe(r.id, ok, self.step_idx)
+                r.last_probe_steps = r.steps
+            if r.state is ReplicaState.UP and \
+                    self.router.available(r.id, self.step_idx):
+                routable.add(r.id)
+        self._routable = routable
+        return routable
+
+    # ------------------------------------------------------------- #
+    # snapshots
+    # ------------------------------------------------------------- #
+    def _snapshots(self, routable,
+                   with_migratable: bool = False
+                   ) -> List[ReplicaSnapshot]:
+        snaps = []
+        for r in self.replicas:
+            if r.id not in routable:
+                continue
+            s = r.scheduler
+            migratable: Tuple = ()
+            if with_migratable:
+                cands = sorted(
+                    ((req.cached_tokens, uid)
+                     for uid, req in s.suspended.items()
+                     if not req.cancelled and req.latents is not None
+                     and req.latents.shape[1] == req.cached_tokens),
+                    key=lambda t: (-t[0], t[1]))
+                migratable = tuple((uid, cached)
+                                   for cached, uid in cands)
+            snaps.append(ReplicaSnapshot(
+                id=r.id, kv_utilization=r.kv_utilization,
+                queue_depth=len(s.queue) + len(r.server._ingress),
+                suspended=len(s.suspended),
+                occupancy=s._occupancy(),
+                degradation=int(s.degradation),
+                migratable=migratable))
+        return snaps
+
+    @property
+    def degradation_level(self) -> int:
+        """Fleet-level degradation: the worst ladder level among
+        stepping replicas — the fleet-scope escalation signal (routing
+        already shifts load away from degraded replicas per snapshot;
+        this gauge is the operator surface)."""
+        levels = [int(r.scheduler.degradation)
+                  for r in self.replicas if r.state in _STEPPING]
+        return max(levels) if levels else 0
+
+    # ------------------------------------------------------------- #
+    # migration machinery
+    # ------------------------------------------------------------- #
+    def _begin_migration(self, req: Request, src: int, dst: int,
+                         reason: str) -> Migration:
+        now = self.clock.now()
+        nbytes = int(req.latents.nbytes) \
+            if req.latents is not None else 0
+        transfer_s = self.config.migration_overhead_s
+        if self.config.link_bytes_per_s > 0:
+            transfer_s += nbytes / self.config.link_bytes_per_s
+        m = Migration(uid=req.uid, src=src, dst=dst, nbytes=nbytes,
+                      tokens=req.cached_tokens, reason=reason,
+                      depart_t=now, land_t=now + transfer_s,
+                      request=req)
+        req.replica = None
+        self.in_transit.append(m)
+        self.migrations.append(m)
+        self.counters["evictions"] += 1
+        self._event("migrate_depart", req.uid,
+                    f"src={src} dst={dst} reason={reason} "
+                    f"bytes={nbytes}")
+        get_tracer().async_begin("fleet.migrate", req.uid, cat="fleet",
+                                 src=src, dst=dst, reason=reason,
+                                 bytes=nbytes, tokens=m.tokens)
+        return m
+
+    def _finish_migration(self, m: Migration, mode: str) -> None:
+        m.mode = mode
+        get_tracer().async_end("fleet.migrate", m.uid, cat="fleet",
+                               mode=mode, dst=m.dst)
+
+    def _transit_pass(self, now: float, routable) -> None:
+        if not self.in_transit:
+            return
+        survivors: List[Migration] = []
+        for m in sorted(self.in_transit,
+                        key=lambda m: (m.land_t, m.uid)):
+            req = m.request
+            if req.cancelled:
+                self.counters["cancelled_in_transit"] += 1
+                self._finish_migration(m, "cancelled")
+                req.latents = None
+                req.finished_at = now
+                req.transition(RequestState.DONE)
+                self.done[req.uid] = req
+                self._event("cancel", req.uid, "in_transit")
+                get_tracer().async_end("request", req.uid,
+                                       cancelled=True)
+                continue
+            if req.deadline is not None and now > req.deadline:
+                # transit time counts against the deadline; nothing is
+                # held on either side (source freed at detach, the
+                # destination never allocated), so expiring here leaks
+                # nothing — asserted by the fleet chaos invariants
+                self.counters["expired_in_transit"] += 1
+                self._finish_migration(m, "expired")
+                self._fail_fleet(req, "deadline_exceeded", now)
+                continue
+            if now < m.land_t:
+                survivors.append(m)
+                continue
+            if m.dst < 0 or m.dst not in routable:
+                new_dst = self.router.route(
+                    req, self._snapshots(routable))
+                if new_dst is None:
+                    if self._all_dead():
+                        self.counters["failed_in_transit"] += 1
+                        self._finish_migration(m, "failed")
+                        self._fail_fleet(req, "fleet_down", now)
+                        continue
+                    survivors.append(m)   # wait for a healthy landing
+                    continue
+                if m.dst >= 0:
+                    self.counters["reroutes"] += 1
+                    self._event("migrate_reroute", m.uid,
+                                f"{m.dst}->{new_dst}")
+                m.dst = new_dst
+            dst = self.replicas[m.dst]
+            with self._locked(dst):
+                dst.scheduler.adopt_suspended(req)
+            req.replica = m.dst
+            req.n_migrations += 1
+            mode = "restore" if req.latents is not None \
+                else "recompute"
+            key = "landings" if mode == "restore" \
+                else "recompute_landings"
+            self.counters[key] += 1
+            self._finish_migration(m, mode)
+            self._event("migrate_land", m.uid,
+                        f"dst={m.dst} mode={mode}")
+        self.in_transit = survivors
+
+    def _route_pass(self, now: float, routable) -> None:
+        if not self.pending:
+            return
+        due = [req for req in
+               sorted(self.pending,
+                      key=lambda r: (r.arrival_time, r.uid))
+               if req.arrival_time <= now]
+        for req in due:
+            if req.cancelled:
+                self.pending.remove(req)
+                req.transition(RequestState.REJECTED)
+                req.reject_reason = "cancelled"
+                req.finished_at = now
+                self.done[req.uid] = req
+                self._event("cancel", req.uid, "pending")
+                continue
+            if self._all_dead():
+                self.pending.remove(req)
+                self._fail_fleet(req, "fleet_down", now)
+                continue
+            snaps = self._snapshots(routable)
+            if not snaps:
+                break                 # nobody routable; wait
+            dst = self.router.route(req, snaps)
+            self.pending.remove(req)
+            req.replica = dst
+            self._event("route", req.uid, f"dst={dst}")
+            self.replicas[dst].server.submit(request=req)
+
+    def _rebalance_pass(self, routable) -> None:
+        plans = self.router.plan_migrations(
+            self._snapshots(routable, with_migratable=True))
+        for uid, src, dst in plans:
+            r = self.replicas[src]
+            with self._locked(r):
+                req = r.scheduler.detach_for_migration(uid)
+            if req is None:
+                continue
+            self._begin_migration(req, src, dst, "rebalance")
+
+    def migrate(self, uid: int, dst: int = -1,
+                reason: str = "manual") -> Optional[Migration]:
+        """Operator-forced migration: detach ``uid`` from whichever
+        replica holds it (running requests are preempted to latents
+        first) and put it in transit to ``dst`` (-1 = router picks at
+        landing). Returns the Migration, or None when no replica holds
+        a live ``uid``."""
+        for r in self.replicas:
+            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                continue
+            if r.scheduler.request(uid) is None or \
+                    uid in r.scheduler.done:
+                continue
+            with self._locked(r):
+                req = r.scheduler.detach_for_migration(uid)
+            if req is None:
+                return None
+            if req.state is RequestState.QUEUED:
+                # nothing cached to ship — just re-route the queue slot
+                req.replica = None
+                self.counters["requeued"] += 1
+                self.pending.append(req)
+                return None
+            return self._begin_migration(req, r.id, dst, reason)
+        return None
+
+    # ------------------------------------------------------------- #
+    # graceful drain
+    # ------------------------------------------------------------- #
+    def drain(self, replica_id: int) -> None:
+        """Start a graceful drain: the replica takes no new work and
+        the next fleet steps migrate every in-flight request out via
+        latents (running ones preempted first) until it is empty, then
+        it stops with its block pool intact."""
+        r = self.replicas[replica_id]
+        if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+            raise ValueError(
+                f"replica {replica_id} is {r.state.name}")
+        if r.state is ReplicaState.UP:
+            r.state = ReplicaState.DRAINING
+        else:
+            r.prev_state = ReplicaState.DRAINING
+        self._event("drain_begin", -1, f"replica={replica_id}")
+
+    def _drain_pass(self, routable) -> None:
+        for r in self.replicas:
+            if r.state is not ReplicaState.DRAINING:
+                continue
+            s = r.scheduler
+            with self._locked(r):
+                queued = list(r.server._ingress) + list(s.queue)
+                r.server._ingress.clear()
+                s.queue.clear()
+                live_uids = (list(s.suspended) + list(s.restoring) +
+                             list(s.running))
+            for req in queued:
+                req.replica = None
+                self.counters["requeued"] += 1
+                self._event("requeue", req.uid,
+                            f"drain replica={r.id}")
+                self.pending.append(req)
+            for uid in live_uids:
+                with self._locked(r):
+                    req = s.detach_for_migration(uid)
+                if req is not None:
+                    self._begin_migration(req, r.id, -1, "drain")
+            if r.live_requests == 0:
+                r.state = ReplicaState.STOPPED
+                self.counters["drains_completed"] += 1
+                self._event("drain_complete", -1,
+                            f"replica={r.id} "
+                            f"free={r.engine.state.free_blocks}")
+
+    # ------------------------------------------------------------- #
+    # one fleet step (virtual-clock deterministic core)
+    # ------------------------------------------------------------- #
+    def step(self) -> Dict[int, object]:
+        """One fleet step: fault sites -> heals -> probes -> transit
+        landings -> routing -> rebalance -> drain -> every live
+        replica takes one scheduler step at the same virtual instant;
+        the shared clock then advances once by the parallel-max step
+        cost."""
+        if self._pump_thread is not None:
+            raise RuntimeError("step() is the simulation driver; "
+                               "thread mode runs its own pump")
+        self.step_idx += 1
+        now = self.clock.now()
+        with get_tracer().span("fleet.step",
+                               fleet_step=self.step_idx) as sp:
+            self._fault_pass()
+            self._heal_pass()
+            routable = self._probe_pass()
+            self._transit_pass(now, routable)
+            self._route_pass(now, routable)
+            self._rebalance_pass(routable)
+            self._drain_pass(routable)
+            had_transit = bool(self.in_transit)
+            reports: Dict[int, object] = {}
+            max_cost = 0.0
+            decode_lanes = 0
+            for r in self.replicas:
+                if r.state not in _STEPPING:
+                    continue
+                report = r.server.step(advance_clock=False)
+                r.steps += 1
+                r.last_report = report
+                reports[r.id] = report
+                decode_lanes += report.decode_lanes
+                r.occupancy_sum += r.scheduler._occupancy()
+                r.kv_util_peak = max(r.kv_util_peak,
+                                     r.kv_utilization)
+                if self.virtual:
+                    max_cost = max(max_cost,
+                                   r.server._virtual_cost(report))
+            if had_transit:
+                # the migration/decode overlap the latent transport is
+                # for: transits ride the inter-replica link while the
+                # fleet keeps decoding — the span attrs carry both
+                # sides so the ratio is span-derivable, and the
+                # counters must agree (asserted in tier-1)
+                self.transit_steps += 1
+                if decode_lanes:
+                    self.overlapped_transit_steps += 1
+            if self.virtual:
+                self.clock.sleep(max_cost + self.config.step_overhead_s)
+            sp.set(in_transit=len(self.in_transit),
+                   decode_lanes=decode_lanes,
+                   routable=len(routable),
+                   pending=len(self.pending))
+        return reports
+
+    # ------------------------------------------------------------- #
+    # deterministic trace replay
+    # ------------------------------------------------------------- #
+    def run_trace(self, requests: List[Request],
+                  max_steps: int = 1_000_000) -> Dict:
+        """Feed ``requests`` at their ``arrival_time``s and step until
+        every one reached a terminal state somewhere in the fleet.
+        Under a VirtualClock this is a pure function of the trace (and
+        any installed fault plan)."""
+        arrivals = sorted(requests,
+                          key=lambda r: (r.arrival_time, r.uid))
+        steps = 0
+        while arrivals or self.has_work:
+            now = self.clock.now()
+            while arrivals and arrivals[0].arrival_time <= now:
+                self.submit(request=arrivals.pop(0))
+            if not self.has_work and arrivals:
+                if self.virtual:
+                    self.clock.advance_to(arrivals[0].arrival_time)
+                else:
+                    self.clock.sleep(
+                        arrivals[0].arrival_time - now)
+                continue
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"fleet run_trace exceeded {max_steps} steps — "
+                    "scheduling livelock?\n" + self.snapshot())
+        return self.summary()
+
+    # ------------------------------------------------------------- #
+    # thread mode (real clock)
+    # ------------------------------------------------------------- #
+    def start(self) -> None:
+        if self.virtual:
+            raise RuntimeError("thread mode needs a real clock; use "
+                               "run_trace for virtual-clock simulation")
+        if self._pump_thread is not None:
+            return
+        for r in self.replicas:
+            r.server.start()
+        self._stop.clear()
+        self._pump_thread = threading.Thread(
+            target=self._pump, name="hds-fleet-pump", daemon=True)
+        self._pump_thread.start()
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            self.step_idx += 1
+            now = self.clock.now()
+            try:
+                self._fault_pass()
+                self._heal_pass()
+                routable = self._probe_pass()
+                with self._lock:
+                    self._transit_pass(now, routable)
+                    self._route_pass(now, routable)
+                self._rebalance_pass(routable)
+                self._drain_pass(routable)
+                for r in self.replicas:
+                    if r.state in _STEPPING and \
+                            r.server._thread is not None and \
+                            r.server._thread.is_alive():
+                        r.steps += 1
+            except Exception as exc:    # noqa: BLE001 — keep pumping
+                self._event("pump_error", -1, repr(exc))
+            self._stop.wait(self.config.pump_interval_s)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        if self._pump_thread is None:
+            return
+        if drain:
+            deadline = self.clock.now() + timeout
+            while self.has_work and self.clock.now() < deadline:
+                self.clock.sleep(self.config.pump_interval_s)
+        self._stop.set()
+        self._pump_thread.join(timeout=timeout)
+        self._pump_thread = None
+        for r in self.replicas:
+            r.server.stop(drain=False, timeout=timeout)
+
+    # ------------------------------------------------------------- #
+    # observability
+    # ------------------------------------------------------------- #
+    def summary(self) -> Dict:
+        per_replica = {}
+        for r in self.replicas:
+            per_replica[str(r.id)] = {
+                "state": r.state.name,
+                "steps": r.steps,
+                "kv_utilization": round(r.kv_utilization, 6),
+                "kv_util_peak": round(r.kv_util_peak, 6),
+                "mean_occupancy": round(r.mean_occupancy, 6),
+                "free_blocks": r.engine.state.free_blocks,
+                "initial_free_blocks": r.initial_free_blocks,
+                "live_requests": r.live_requests,
+                "done": len(r.scheduler.done),
+                "counters": dict(r.server.metrics.counters),
+            }
+        return {
+            "replicas": per_replica,
+            "counters": dict(self.counters),
+            "router": self.router.summary(),
+            "in_transit": len(self.in_transit),
+            "pending": len(self.pending),
+            "fleet_done": len(self.done),
+            "migration_balance_ok": self.migration_balance_ok,
+            "transit_steps": self.transit_steps,
+            "overlapped_transit_steps": self.overlapped_transit_steps,
+            "migration_overlap_ratio":
+                round(self.migration_overlap_ratio, 6),
+            "degradation_level": self.degradation_level,
+        }
+
+    def metrics_registry(self):
+        """Render the whole fleet into ONE ``MetricRegistry``: every
+        replica's full serving metric set labeled
+        ``{"replica": "<id>"}`` plus fleet-scope migration counters
+        and per-replica state/occupancy gauges."""
+        from ..telemetry.prometheus import MetricRegistry
+        reg = MetricRegistry(namespace="hds_fleet")
+        for r in self.replicas:
+            labels = {"replica": str(r.id)}
+            r.server.metrics.to_registry(reg, labels=labels)
+            reg.set_gauge("replica_state", float(r.state.value),
+                          labels=labels,
+                          help="replica failure-domain state "
+                               "(ReplicaState value)")
+            reg.set_gauge("replica_kv_utilization",
+                          r.kv_utilization, labels=labels,
+                          help="per-replica KV pool utilization")
+            reg.set_gauge("replica_live_requests",
+                          float(r.live_requests), labels=labels,
+                          help="non-terminal requests on the replica")
+        for name, value in self.counters.items():
+            reg.set_counter(name, value,
+                            help=f"fleet counter {name}")
+        reg.set_gauge("migration_overlap_ratio",
+                      self.migration_overlap_ratio,
+                      help="fleet steps with transit hidden under "
+                           "decode / steps with transit")
+        reg.set_gauge("in_transit", float(len(self.in_transit)),
+                      help="migrations currently on the wire")
+        reg.set_gauge("degradation_level",
+                      float(self.degradation_level),
+                      help="worst degradation-ladder level among "
+                           "stepping replicas (fleet escalation)")
+        return reg
+
+    def prometheus_text(self) -> str:
+        return self.metrics_registry().render()
+
+    def snapshot(self, last_events: int = 20) -> str:
+        lines = [
+            "fleet snapshot:",
+            f"  step={self.step_idx} pending={len(self.pending)} "
+            f"in_transit={[m.uid for m in self.in_transit]} "
+            f"routable={sorted(self._routable)}",
+            f"  counters={self.counters}",
+        ]
+        for r in self.replicas:
+            s = r.scheduler
+            lines.append(
+                f"  replica {r.id}: {r.state.name} "
+                f"queue={[q.uid for q in s.queue]} "
+                f"running={sorted(s.running)} "
+                f"suspended={sorted(s.suspended)} "
+                f"restoring={sorted(s.restoring)} "
+                f"free={r.engine.state.free_blocks}")
+        lines.append(f"  last fleet events: "
+                     f"{self.events[-last_events:]}")
+        return "\n".join(lines)

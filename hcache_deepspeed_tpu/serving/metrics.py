@@ -254,27 +254,42 @@ class ServingMetrics:
     # ------------------------------------------------------------- #
     # Prometheus exposition
     # ------------------------------------------------------------- #
-    def to_registry(self, registry=None):
+    def to_registry(self, registry=None, labels=None):
         """Render the full metric set into a ``MetricRegistry``
         (created on demand) — counters as counters, gauges as gauges,
         latency histograms with their bucket counts + sketch-derived
-        quantile gauges."""
+        quantile gauges. ``labels`` are merged into every sample: the
+        fleet renders N replicas' metric sets into ONE registry with
+        ``labels={"replica": "<id>"}`` so scrapers see one labeled
+        family per metric instead of N name-mangled ones."""
         from ..telemetry.prometheus import MetricRegistry
         reg = registry if registry is not None else \
             MetricRegistry(namespace="hds_serving")
+        base = dict(labels or {})
+
+        def lbl(extra=None):
+            if not extra:
+                return dict(base) or None
+            merged = dict(base)
+            merged.update(extra)
+            return merged
+
         for name, value in self.counters.items():
-            reg.set_counter(name, value,
+            reg.set_counter(name, value, labels=lbl(),
                             help=f"serving counter {name}")
         for reason, n in self.rejected.items():
-            reg.set_counter("rejected", n, labels={"reason": reason},
+            reg.set_counter("rejected", n,
+                            labels=lbl({"reason": reason}),
                             help="rejected requests by reason")
         for error, n in self.failures.items():
-            reg.set_counter("failed_typed", n, labels={"error": error},
+            reg.set_counter("failed_typed", n,
+                            labels=lbl({"error": error}),
                             help="typed request failures by cause")
         for name, value in self.gauges.items():
-            reg.set_gauge(name, value, help=f"serving gauge {name}")
+            reg.set_gauge(name, value, labels=lbl(),
+                          help=f"serving gauge {name}")
         for name, value in self.slo_gauges.items():
-            reg.set_gauge(name, value,
+            reg.set_gauge(name, value, labels=lbl(),
                           help="SLO burn-rate gauge (see telemetry.slo)")
         for name, hist in (("ttft_seconds", self.ttft),
                            ("tpot_seconds", self.tpot),
@@ -282,11 +297,12 @@ class ServingMetrics:
             if hist.buckets:
                 reg.set_histogram(name, hist.bucket_counts,
                                   hist.buckets, hist.count, hist.sum,
+                                  labels=lbl(),
                                   help=f"serving latency {name}")
             for q in (50, 90, 99):
                 v = hist.percentile(q)
                 if v is not None:
-                    reg.set_gauge(f"{name}_p{q}", v,
+                    reg.set_gauge(f"{name}_p{q}", v, labels=lbl(),
                                   help=f"{name} p{q} (sketch)")
         return reg
 

@@ -118,6 +118,14 @@ class Request:
     #: exhaustion, lane aborts, faulted recompute re-entries); at the
     #: policy cap the request hard-fails with ``restore_failed``
     n_restore_failures: int = 0
+    # -- fleet bookkeeping ------------------------------------------ #
+    #: replica currently (or last) responsible for this request; None
+    #: until the fleet router places it (standalone servers never set
+    #: it)
+    replica: Optional[int] = None
+    #: completed cross-replica migrations (landings, including
+    #: recompute landings — transit expiry is not a migration)
+    n_migrations: int = 0
 
     def transition(self, new_state: RequestState) -> None:
         if new_state not in _TRANSITIONS[self.state]:

@@ -1,0 +1,232 @@
+"""Fleet router: replica selection, health gating, migration planning.
+
+The router is the policy half of the serving fleet (``fleet.py`` is the
+mechanism half). It owns three decisions, all deterministic functions
+of the snapshots it is shown:
+
+* **placement** (:meth:`FleetRouter.route`) — score every routable
+  replica by KV pressure + backlog and subtract a prefix-affinity
+  bonus when the request's prompt prefix was last served by that
+  replica (the prefix map is the fleet analog of the engine's prefix
+  cache: landing a shared-prefix request where its blocks already
+  live is worth a small pressure premium);
+* **health** (:meth:`note_probe` / :meth:`available`) — one
+  :class:`~..resilience.retry.CircuitBreaker` per replica, fed by the
+  fleet's per-step probes. A crashed/hanging/partitioned replica fails
+  probes, trips its breaker, and drops out of the routable set; after
+  the cooldown the HALF_OPEN probe re-admits it exactly once — the
+  same trip/cooldown/probe discipline the restore path uses, applied
+  per failure domain;
+* **rebalancing** (:meth:`plan_migrations`) — when the hottest and
+  coldest routable replicas diverge by more than
+  ``migrate_pressure_gap`` KV utilization, pick the hot replica's
+  best suspended request (largest cached prefix first — the payload
+  whose eviction relieves the most pressure) and propose moving it,
+  priced by the crossover model's per-link transfer term
+  (:meth:`~.crossover.RestoreCrossoverModel.decide_migration`): a
+  migration that costs more than restoring in place is refused even
+  under a pressure gap.
+
+The router never touches an engine; it reads
+:class:`ReplicaSnapshot` rows the fleet builds and returns ids. That
+keeps it pure enough to fuzz in isolation and keeps every fleet-level
+mutation in one file.
+"""
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+from zlib import crc32
+
+import numpy as np
+
+from ..resilience.retry import BreakerState, CircuitBreaker
+from .crossover import RestoreCrossoverModel
+from .request import Request
+
+
+@dataclass
+class RouterConfig:
+    """Knobs for :class:`FleetRouter` (documented in docs/serving.md)."""
+    #: placement score weights: KV utilization dominates, queue depth
+    #: and suspended backlog break near-ties
+    kv_weight: float = 1.0
+    queue_weight: float = 0.05
+    suspended_weight: float = 0.10
+    #: penalty per degradation-ladder level (fleet-level escalation:
+    #: a replica riding out a fault storm sheds load to its peers
+    #: BEFORE its own ladder starts rejecting)
+    degradation_weight: float = 0.50
+    #: prefix-affinity bonus subtracted from the score of the replica
+    #: that last served this prompt prefix; 0 disables prefix routing
+    prefix_weight: float = 0.30
+    #: prompt tokens hashed into the prefix key
+    prefix_len: int = 16
+    #: LRU capacity of the prefix map
+    prefix_map_size: int = 1024
+    #: KV-utilization gap (hottest - coldest) that triggers a
+    #: rebalance migration proposal
+    migrate_pressure_gap: float = 0.25
+    #: migrations proposed per fleet step (rebalance only; drain and
+    #: crash recovery are not throttled)
+    max_migrations_per_step: int = 1
+    #: per-replica health breaker (counts fleet steps)
+    breaker_threshold: int = 2
+    breaker_window: int = 8
+    breaker_cooldown: int = 6
+
+
+@dataclass(frozen=True)
+class ReplicaSnapshot:
+    """One replica's routing-relevant state at a fleet step (built by
+    the fleet; the router never reads live schedulers)."""
+    id: int
+    kv_utilization: float
+    queue_depth: int
+    suspended: int
+    occupancy: float
+    #: the replica's degradation-ladder level (0 = NORMAL); routed
+    #: load shifts away from degraded replicas
+    degradation: int = 0
+    #: uids of migratable suspended requests, with their cached-token
+    #: counts, in deterministic (cached desc, uid) order
+    migratable: Tuple[Tuple[int, int], ...] = ()
+
+
+class FleetRouter:
+
+    def __init__(self, config: RouterConfig = None,
+                 crossover: Optional[RestoreCrossoverModel] = None,
+                 link_bytes_per_s: float = 0.0):
+        self.config = config or RouterConfig()
+        #: crossover model pricing migrate-vs-stay (None/uncalibrated
+        #: = pressure gap alone decides, the pre-policy behavior)
+        self.crossover = crossover
+        self.link_bytes_per_s = float(link_bytes_per_s)
+        self.breakers: Dict[int, CircuitBreaker] = {}
+        self._prefix_map: "OrderedDict[int, int]" = OrderedDict()
+        # counters the fleet metrics surface
+        self.routed = 0
+        self.affinity_hits = 0
+        self.migrations_proposed = 0
+        self.migrations_refused_by_cost = 0
+
+    # ------------------------------------------------------------- #
+    # health
+    # ------------------------------------------------------------- #
+    def _breaker(self, replica_id: int) -> CircuitBreaker:
+        br = self.breakers.get(replica_id)
+        if br is None:
+            c = self.config
+            br = self.breakers[replica_id] = CircuitBreaker(
+                threshold=c.breaker_threshold, window=c.breaker_window,
+                cooldown=c.breaker_cooldown)
+        return br
+
+    def note_probe(self, replica_id: int, ok: bool, tick: int) -> None:
+        """Feed one health-probe verdict into the replica's breaker."""
+        br = self._breaker(replica_id)
+        if ok:
+            br.record_success(tick)
+        else:
+            br.record_failure(tick)
+
+    def available(self, replica_id: int, tick: int) -> bool:
+        """Breaker-gated availability. Call exactly once per replica
+        per fleet step (the HALF_OPEN state admits one probe per
+        verdict — extra calls would consume it)."""
+        return self._breaker(replica_id).allow(tick)
+
+    def breaker_states(self) -> Dict[int, str]:
+        return {rid: br.state.name
+                for rid, br in sorted(self.breakers.items())}
+
+    # ------------------------------------------------------------- #
+    # placement
+    # ------------------------------------------------------------- #
+    def prefix_key(self, prompt: Sequence[int]) -> int:
+        head = tuple(prompt[:self.config.prefix_len])
+        return crc32(np.asarray(head, np.int64).tobytes())
+
+    def _score(self, snap: ReplicaSnapshot, affinity: bool) -> float:
+        c = self.config
+        score = (c.kv_weight * snap.kv_utilization +
+                 c.queue_weight * snap.queue_depth +
+                 c.suspended_weight * snap.suspended +
+                 c.degradation_weight * snap.degradation)
+        if affinity:
+            score -= c.prefix_weight
+        return score
+
+    def route(self, req: Request,
+              snapshots: Sequence[ReplicaSnapshot]) -> Optional[int]:
+        """Pick the destination replica for ``req`` among
+        ``snapshots`` (the fleet passes only routable replicas).
+        Returns None when no replica is routable. Lowest
+        (score, id) wins — deterministic under ties."""
+        if not snapshots:
+            return None
+        key = self.prefix_key(req.prompt)
+        preferred = self._prefix_map.get(key)
+        best = min(snapshots,
+                   key=lambda s: (self._score(s, s.id == preferred),
+                                  s.id))
+        self.routed += 1
+        if preferred == best.id:
+            self.affinity_hits += 1
+        self._prefix_map[key] = best.id
+        self._prefix_map.move_to_end(key)
+        while len(self._prefix_map) > self.config.prefix_map_size:
+            self._prefix_map.popitem(last=False)
+        return best.id
+
+    # ------------------------------------------------------------- #
+    # rebalancing
+    # ------------------------------------------------------------- #
+    def plan_migrations(
+            self, snapshots: Sequence[ReplicaSnapshot],
+    ) -> List[Tuple[int, int, int]]:
+        """Propose up to ``max_migrations_per_step`` rebalance moves
+        ``(uid, src_id, dst_id)`` from the hottest to the coldest
+        routable replica. Only suspended requests with an intact
+        latent payload are candidates (``ReplicaSnapshot.migratable``);
+        each proposal is priced through the crossover model's
+        migration term when one is calibrated."""
+        if len(snapshots) < 2:
+            return []
+        c = self.config
+        hot = max(snapshots, key=lambda s: (s.kv_utilization, -s.id))
+        cold = min(snapshots, key=lambda s: (s.kv_utilization, s.id))
+        if hot.id == cold.id or not hot.migratable:
+            return []
+        if hot.kv_utilization - cold.kv_utilization < \
+                c.migrate_pressure_gap:
+            return []
+        out: List[Tuple[int, int, int]] = []
+        for uid, cached in hot.migratable:
+            if len(out) >= c.max_migrations_per_step:
+                break
+            if self.crossover is not None and \
+                    self.crossover.decide_migration(
+                        cached, hot.occupancy, cold.occupancy,
+                        self.link_bytes_per_s) == "stay":
+                self.migrations_refused_by_cost += 1
+                continue
+            out.append((uid, hot.id, cold.id))
+            self.migrations_proposed += 1
+        return out
+
+    # ------------------------------------------------------------- #
+    def summary(self) -> Dict:
+        return {
+            "routed": self.routed,
+            "affinity_hits": self.affinity_hits,
+            "migrations_proposed": self.migrations_proposed,
+            "migrations_refused_by_cost":
+                self.migrations_refused_by_cost,
+            "prefix_map_size": len(self._prefix_map),
+            "breakers": self.breaker_states(),
+            "open_breakers": sum(
+                1 for br in self.breakers.values()
+                if br.state != BreakerState.CLOSED),
+        }

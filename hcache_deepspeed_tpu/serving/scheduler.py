@@ -117,8 +117,13 @@ class ContinuousBatchingScheduler:
                  metrics=None, crossover: RestoreCrossoverModel = None,
                  restore_chunks_per_step: int = 1,
                  calibrate_every: int = 25,
-                 resilience: ResiliencePolicy = None):
+                 resilience: ResiliencePolicy = None,
+                 replica_id: int = 0):
         self.engine = engine
+        #: fleet position of this scheduler (0 = standalone/replica 0);
+        #: folded into the retry-jitter RNG key so N replicas retrying
+        #: concurrently draw from independent per-site streams
+        self.replica_id = int(replica_id)
         self.clock = clock or MonotonicClock()
         self.sample_fn = sample_fn or greedy_sample
         self.metrics = metrics
@@ -171,9 +176,15 @@ class ContinuousBatchingScheduler:
         #: graceful-degradation ladder (shed -> cap -> pause)
         self.ladder = DegradationLadder(r.ladder)
         self.degradation = DegradationLevel.NORMAL
-        #: seeded jitter stream for restore-retry backoff
-        self._retry_rng = np.random.default_rng([r.seed & 0x7FFFFFFF,
-                                                 0x5E71])
+        #: seeded jitter stream for restore-retry backoff. Replica 0
+        #: keeps the historical 2-word key so committed single-engine
+        #: chaos digests replay unchanged; other replicas append their
+        #: id, giving every fleet member an independent stream (the
+        #: fleet determinism gate depends on streams never aliasing)
+        rng_key = [r.seed & 0x7FFFFFFF, 0x5E71]
+        if self.replica_id:
+            rng_key.append(self.replica_id)
+        self._retry_rng = np.random.default_rng(rng_key)
         self.total_faults = 0
         self.total_retries = 0
         self._fault_sites: Dict[str, int] = {}
@@ -196,8 +207,10 @@ class ContinuousBatchingScheduler:
 
     def cancel(self, uid: int) -> None:
         """Mark a request for cancellation; honored at the next step.
-        A request mid-restore cancels after its lane drains (freeing
-        blocks under in-flight replay writes would corrupt the pool)."""
+        A request mid-restore has its open lane aborted at that point
+        (``engine.abort_restore`` — the abort owns the in-flight replay
+        chunks, so the lane's blocks free without corrupting the pool)
+        and its host latents dropped."""
         for pool in (self.queue, self.running.values(),
                      self.suspended.values(), self.restoring.values()):
             for req in pool:
@@ -367,6 +380,115 @@ class ContinuousBatchingScheduler:
                 failed.append(uid)
         return failed
 
+    # ------------------------------------------------------------- #
+    # fleet hooks: cross-replica migration + drain + crash evacuation
+    # ------------------------------------------------------------- #
+    def detach_for_migration(self, uid: int) -> Optional[Request]:
+        """Detach ``uid`` for cross-replica migration (fleet rebalance
+        or graceful drain). The request leaves in ``SUSPENDED`` state
+        with its host latent payload as the transfer body: running
+        requests are preempted to latents first (their engine state is
+        flushed), restoring requests get their open lane aborted
+        (payload untouched — a replay consumes latents, it does not
+        move them), queued requests detach as-is in ``QUEUED``. Engine
+        state for ``uid`` is fully freed on this replica. Returns None
+        for unknown/terminal uids."""
+        for req in self.queue:
+            if req.uid == uid:
+                self.queue.remove(req)
+                self._event("migrate_out", uid, "from=queued")
+                return req
+        if uid in self.suspended:
+            req = self.suspended.pop(uid)
+            if not self.latent_preemption:
+                # exact-KV host copy lives in THIS engine and cannot
+                # travel; drop it — the destination recomputes
+                self._safe_flush(uid)
+                req.latents = None
+            self._event("migrate_out", uid, "from=suspended")
+            return req
+        if uid in self.restoring:
+            self.engine.abort_restore(uid)
+            req = self.restoring.pop(uid)
+            self._overlap_credited.discard(uid)
+            self.watchdog.drop(uid)
+            req.transition(RequestState.SUSPENDED)
+            req.suspended_in_step = self.step_idx
+            self._event("migrate_out", uid, "from=restoring")
+            return req
+        if uid in self.running:
+            req = self.running.pop(uid)
+            if self.latent_preemption and req.latents is not None and \
+                    req.latents.shape[1] == req.cached_tokens:
+                self.engine.flush(uid)
+            else:
+                # incomplete/no payload: free the device state anyway;
+                # the destination re-enters via recompute
+                self._safe_flush(uid)
+                req.latents = None
+            req.transition(RequestState.SUSPENDED)
+            req.n_preemptions += 1
+            req.suspended_in_step = self.step_idx
+            self._event("migrate_out", uid, "from=running")
+            return req
+        return None
+
+    def adopt_suspended(self, req: Request) -> None:
+        """Adopt a migrated-in request. It arrives ``SUSPENDED`` with
+        (when intact) its latent payload; the normal restore pass —
+        crossover policy, breaker, recompute fallback — re-enters it.
+        The anti-thrash step stamp is re-armed on THIS scheduler's
+        step counter (the source's counter is meaningless here)."""
+        if req.state != RequestState.SUSPENDED:
+            raise ValueError(
+                f"adopt_suspended: request {req.uid} is "
+                f"{req.state.name}, not SUSPENDED")
+        if self.request(req.uid) is not None:
+            raise ValueError(f"uid {req.uid} already known here")
+        req.suspended_in_step = self.step_idx
+        self.suspended[req.uid] = req
+        self._event("migrate_in",
+                    req.uid, f"tokens={req.cached_tokens} "
+                    f"payload={'latents' if req.latents is not None else 'none'}")
+
+    def adopt_queued(self, req: Request) -> None:
+        """Adopt a re-routed queued request (crash recovery / drain of
+        not-yet-admitted work)."""
+        if req.state != RequestState.QUEUED:
+            raise ValueError(
+                f"adopt_queued: request {req.uid} is {req.state.name}")
+        if self.request(req.uid) is not None:
+            raise ValueError(f"uid {req.uid} already known here")
+        self.queue.append(req)
+        self._event("migrate_in", req.uid, "from=queued")
+
+    def evacuate_live(self) -> Tuple[List[Request], List[Request]]:
+        """Crash-recovery hook: detach every non-terminal request
+        WITHOUT touching the engine (it is presumed dead — its blocks
+        died with it and are excluded from the fleet leak invariant).
+        Returns ``(queued, live)``: queued requests re-route as-is;
+        live ones leave ``SUSPENDED``, replayable from whatever latent
+        payload they carried when the replica died (requests without a
+        full payload re-enter via recompute on their new replica)."""
+        queued = list(self.queue)
+        self.queue.clear()
+        live: List[Request] = []
+        for pool in (self.running, self.restoring, self.suspended):
+            for uid in list(pool):
+                req = pool.pop(uid)
+                self._overlap_credited.discard(uid)
+                self.watchdog.drop(uid)
+                origin = req.state.name
+                if req.latents is None or \
+                        req.latents.shape[1] != req.cached_tokens:
+                    req.latents = None      # partial payload: recompute
+                if req.state != RequestState.SUSPENDED:
+                    req.transition(RequestState.SUSPENDED)
+                req.suspended_in_step = self.step_idx
+                self._event("evacuate", uid, f"from={origin}")
+                live.append(req)
+        return queued, live
+
     def _deadline_pass(self, report: StepReport, now: float) -> None:
         """Enforce per-request absolute deadlines: an expired request
         hard-fails typed instead of burning capacity. Requests with an
@@ -429,6 +551,34 @@ class ContinuousBatchingScheduler:
                 # exact-KV mode keeps the sequence tracked (host copy
                 # attached) while suspended; release the slot
                 self.engine.flush(uid)
+            self._close(req, report, now, cancelled=True)
+        for uid in [u for u, r in self.restoring.items() if r.cancelled]:
+            # cancel racing an open restore lane: abort the lane (the
+            # engine frees its blocks + tracked slots; in-flight replay
+            # chunks are owned by the abort), drop the host latents —
+            # nothing will ever replay them — and close cancelled. Lane
+            # mates (multi-uid lanes; the scheduler itself only opens
+            # single-uid ones) go back to SUSPENDED uncharged: they lost
+            # their lane through no fault of their own.
+            req = self.restoring.pop(uid)
+            aborted = self.engine.abort_restore(uid)
+            self._overlap_credited.discard(uid)
+            self.watchdog.drop(uid)
+            for mate_uid in aborted:
+                if mate_uid == uid:
+                    continue
+                mate = self.restoring.pop(mate_uid, None)
+                if mate is None:
+                    continue
+                self._overlap_credited.discard(mate_uid)
+                self.watchdog.drop(mate_uid)
+                mate.transition(RequestState.SUSPENDED)
+                mate.suspended_in_step = self.step_idx
+                self.suspended[mate_uid] = mate
+                self._event("restore_abort", mate_uid,
+                            "lane_mate_cancelled")
+            req.latents = None
+            self._event("restore_abort", uid, "cancelled")
             self._close(req, report, now, cancelled=True)
 
     # ------------------------------------------------------------- #
@@ -575,6 +725,28 @@ class ContinuousBatchingScheduler:
     def _restore_pass(self, report: StepReport) -> None:
         now = self.clock.now()
         for req in self._restore_candidates():
+            if self.latent_preemption and req.latents is None:
+                # no restorable payload (crash-recovered from a dead
+                # replica, or migrated out of exact-KV suspension):
+                # recompute re-entry is the only road back — re-prefill
+                # prompt + generated tokens when it fits, else wait
+                sm = self.engine.config.state_manager
+                tokens = req.cached_tokens + 1
+                per_fwd = min(tokens, sm.prefill_chunk) \
+                    if sm.prefill_chunk else tokens
+                if per_fwd > sm.max_ragged_batch_size:
+                    # no forward will EVER fit this re-prefill and no
+                    # payload exists to restore from: fail typed
+                    # instead of parking it suspended forever
+                    del self.suspended[req.uid]
+                    self._fail(req, "recompute_infeasible", report,
+                               now)
+                    continue
+                if self._recompute_feasible(req):
+                    self._event("recompute_forced", req.uid,
+                                "no_latents")
+                    self._try_recompute(req, report, now)
+                continue
             if not self.breaker.allow(self.step_idx):
                 # breaker OPEN: the restore path is considered broken —
                 # cross over to the recompute re-entry (full re-prefill,

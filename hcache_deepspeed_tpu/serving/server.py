@@ -58,16 +58,19 @@ class ServingServer:
     def __init__(self, engine, config: ServerConfig = None, clock=None,
                  metrics: ServingMetrics = None, sample_fn=None,
                  monitor=None, emit_every_steps: int = 50,
-                 crossover=None, resilience=None):
+                 crossover=None, resilience=None, replica_id: int = 0):
         self.config = config or ServerConfig()
         self.clock = clock or MonotonicClock()
         self.virtual = isinstance(self.clock, VirtualClock)
         self.metrics = metrics or ServingMetrics()
+        #: fleet position (0 = standalone); threaded to the scheduler
+        #: so per-replica retry jitter streams stay independent
+        self.replica_id = int(replica_id)
         self.scheduler = ContinuousBatchingScheduler(
             engine, clock=self.clock, sample_fn=sample_fn,
             metrics=self.metrics, crossover=crossover,
             restore_chunks_per_step=self.config.restore_chunks_per_step,
-            resilience=resilience)
+            resilience=resilience, replica_id=self.replica_id)
         self.monitor = monitor
         self.emit_every_steps = emit_every_steps
         self._lock = threading.Lock()
@@ -158,15 +161,18 @@ class ServingServer:
                 c.restore_token_s * report.restored_tokens +
                 c.restore_chunk_s * report.restore_chunks)
 
-    def step(self):
+    def step(self, advance_clock: bool = True):
         """Drain ingress + one scheduler step (thread mode calls this
-        in a loop; simulation calls it from ``run_trace``)."""
+        in a loop; simulation calls it from ``run_trace``).
+        ``advance_clock=False`` leaves the virtual clock to the caller
+        — the fleet steps N replicas at one simulated instant and
+        advances the shared clock once by the parallel-max cost."""
         with self._lock:
             for req in self._ingress:
                 self.scheduler.submit(req)
             self._ingress.clear()
             report = self.scheduler.step()
-            if self.virtual:
+            if self.virtual and advance_clock:
                 self.clock.sleep(self._virtual_cost(report))
             if self.monitor is not None and \
                     report.step % self.emit_every_steps == 0:

@@ -352,13 +352,85 @@ class SimulatedEngine:
         self.state.flush_sequence(uid)
         self.counts["flush"] += 1
 
-    # observability parity with the engine
+    # ------------------------------------------------------------- #
+    # snapshot round-trip (migration + crash-replay substrate)
+    # ------------------------------------------------------------- #
     def serialize(self) -> Dict:
+        """Full JSON-safe snapshot of the engine's host state: tracked
+        sequences (seen/in-flight tokens, block ids, suspension
+        marker), the allocator's exact free-list ORDER and refcounts
+        (block ids are identity — a faithful replay must hand out the
+        same ids), op counters, restore stats, and open restore lanes.
+        ``deserialize`` rebuilds a bitwise-identical engine:
+        ``SimulatedEngine.deserialize(e.serialize()).serialize() ==
+        e.serialize()``, and both engines produce identical logits /
+        block assignments for identical subsequent calls — the
+        round-trip contract migration and crash replay lean on."""
+        alloc = self.state.allocator
         return {
+            "config": self.config.model_dump(),
+            "vocab_size": self.vocab_size,
             "sequences": {
-                uid: {"seen_tokens": s.seen_tokens,
-                      "blocks": list(s.blocks)}
+                str(uid): {"seen_tokens": s.seen_tokens,
+                           "in_flight_tokens": s.in_flight_tokens,
+                           "blocks": list(s.blocks),
+                           "host_kv": (list(s.host_kv)
+                                       if s.host_kv is not None
+                                       else None)}
                 for uid, s in self.state._seqs.items()
             },
             "free_blocks": self.state.free_blocks,
+            "free_list": list(alloc._free),
+            "refcounts": {str(b): n for b, n in alloc._refs.items()},
+            "scratch_block": self._scratch_block,
+            "counts": dict(self.counts),
+            "restore_stats": dict(self.restore_stats),
+            "restore_lanes": [
+                {"uids": list(l["uids"]), "nbytes": l["nbytes"],
+                 "next_chunk": l["next_chunk"], "chunks": l["chunks"]}
+                for l in self._restore_lanes
+            ],
         }
+
+    @classmethod
+    def deserialize(cls, snapshot: Dict) -> "SimulatedEngine":
+        """Rebuild an engine from :meth:`serialize` output (accepts the
+        dict directly or its JSON round-trip). See ``serialize`` for
+        the fidelity contract."""
+        from ..inference.config import RaggedInferenceEngineConfig
+        from .request import Request  # noqa: F401 (doc cross-ref)
+        eng = cls(RaggedInferenceEngineConfig(**snapshot["config"]),
+                  vocab_size=int(snapshot["vocab_size"]))
+        alloc = eng.state.allocator
+        # the constructor grabbed a scratch block; replace the whole
+        # allocator state with the snapshot's exact free order + refs
+        alloc._free = [int(b) for b in snapshot["free_list"]]
+        alloc._refs = {int(b): int(n)
+                       for b, n in snapshot["refcounts"].items()}
+        eng._scratch_block = int(snapshot["scratch_block"])
+        eng.state._seqs = {}
+        from ..inference.ragged.sequence import SequenceDescriptor
+        for uid_s, d in snapshot["sequences"].items():
+            seq = SequenceDescriptor(int(uid_s))
+            seq.seen_tokens = int(d["seen_tokens"])
+            seq.in_flight_tokens = int(d["in_flight_tokens"])
+            seq.blocks = [int(b) for b in d["blocks"]]
+            hkv = d.get("host_kv")
+            seq.host_kv = (hkv[0], int(hkv[1])) \
+                if hkv is not None else None
+            eng.state._seqs[seq.uid] = seq
+        eng.counts = {k: int(v) for k, v in snapshot["counts"].items()}
+        eng.restore_stats = {k: int(v) for k, v
+                             in snapshot["restore_stats"].items()}
+        eng._restore_lanes = []
+        for lane in snapshot["restore_lanes"]:
+            uids = [int(u) for u in lane["uids"]]
+            ticket = {"uids": list(uids), "done": False}
+            eng._restore_lanes.append({
+                "uids": uids,
+                "seqs": [eng.state.get_sequence(u) for u in uids],
+                "nbytes": int(lane["nbytes"]),
+                "next_chunk": int(lane["next_chunk"]),
+                "chunks": int(lane["chunks"]),
+                "ticket": ticket})
+        return eng

@@ -82,10 +82,22 @@ class MetricRegistry:
     re-rendered per scrape.
     """
 
-    def __init__(self, namespace: str = ""):
+    def __init__(self, namespace: str = "",
+                 const_labels: Optional[Dict[str, str]] = None):
         self.namespace = namespace
+        #: labels stamped onto EVERY sample (e.g. ``{"fleet": "0"}``
+        #: for a fleet-wide registry, ``{"replica": "2"}`` for a
+        #: per-replica one); per-sample labels win on collision
+        self.const_labels = dict(const_labels or {})
         #: name -> {"type", "help", "samples": {labelkey: (labels, v)}}
         self._families: Dict[str, Dict] = {}
+
+    def _merged(self, labels: Optional[Dict]) -> Dict:
+        if not self.const_labels:
+            return dict(labels or {})
+        merged = dict(self.const_labels)
+        merged.update(labels or {})
+        return merged
 
     # ------------------------------------------------------------- #
     def _family(self, name: str, mtype: str, help_: str) -> Dict:
@@ -107,16 +119,18 @@ class MetricRegistry:
 
     def set_gauge(self, name: str, value: float,
                   labels: Optional[Dict] = None, help: str = ""):
+        labels = self._merged(labels)
         fam = self._family(name, "gauge", help)
-        fam["samples"][self._labelkey(labels)] = (labels or {},
+        fam["samples"][self._labelkey(labels)] = (labels,
                                                   float(value))
 
     def set_counter(self, name: str, value: float,
                     labels: Optional[Dict] = None, help: str = ""):
         """Counters expose a cumulative total; by convention the name
         gets a ``_total`` suffix at render time if missing."""
+        labels = self._merged(labels)
         fam = self._family(name, "counter", help)
-        fam["samples"][self._labelkey(labels)] = (labels or {},
+        fam["samples"][self._labelkey(labels)] = (labels,
                                                   float(value))
 
     def set_histogram(self, name: str, bucket_counts, buckets,
@@ -125,9 +139,10 @@ class MetricRegistry:
         """``bucket_counts`` are per-bucket (non-cumulative) counts for
         the ``buckets`` upper edges plus one overflow count; rendered
         cumulative with the mandatory ``+Inf`` bucket."""
+        labels = self._merged(labels)
         fam = self._family(name, "histogram", help)
         fam["samples"][self._labelkey(labels)] = (
-            labels or {},
+            labels,
             {"buckets": tuple(float(b) for b in buckets),
              "bucket_counts": tuple(int(c) for c in bucket_counts),
              "count": int(count), "sum": float(sum_)})

@@ -95,3 +95,54 @@ def test_watchdog_stuck_and_progress():
     assert not w.stuck("lane", 11)
     w.drop("lane")
     assert not w.stuck("lane", 100)     # re-armed, not stuck
+
+
+# ------------------------------------------------------------------ #
+# per-replica retry-jitter stream independence (fleet determinism)
+# ------------------------------------------------------------------ #
+def _jitter_stream(replica_id, seed=7, n=8):
+    from hcache_deepspeed_tpu.inference import \
+        RaggedInferenceEngineConfig
+    from hcache_deepspeed_tpu.resilience import ResiliencePolicy
+    from hcache_deepspeed_tpu.serving import (
+        ContinuousBatchingScheduler, SimulatedEngine, VirtualClock)
+    eng = SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 4,
+                       "max_ragged_batch_size": 64,
+                       "max_ragged_sequence_count": 2,
+                       "max_context": 64},
+        kv_cache={"block_size": 8, "num_blocks": 8},
+        hcache={"enable_latents": True}))
+    sched = ContinuousBatchingScheduler(
+        eng, clock=VirtualClock(),
+        resilience=ResiliencePolicy(seed=seed),
+        replica_id=replica_id)
+    policy = sched.resilience.retry
+    return [policy.delay(1, sched._retry_rng) for _ in range(n)]
+
+
+def test_replica_retry_jitter_streams_are_independent():
+    """N replicas retrying concurrently must draw from independent
+    per-replica RNG streams — identical streams would correlate
+    backoff across the fleet and alias the chaos digest."""
+    streams = {rid: _jitter_stream(rid) for rid in range(4)}
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert streams[a] != streams[b], (a, b)
+
+
+def test_replica_retry_jitter_is_reproducible_per_replica():
+    for rid in (0, 1, 3):
+        assert _jitter_stream(rid) == _jitter_stream(rid)
+    # different policy seeds shift every replica's stream
+    assert _jitter_stream(1, seed=7) != _jitter_stream(1, seed=8)
+
+
+def test_replica_zero_keeps_the_historical_stream():
+    """Replica 0 must keep the pre-fleet RNG key so committed chaos
+    artifacts (CHAOS_SERVE.jsonl) replay byte-identically."""
+    expected_rng = np.random.default_rng([7 & 0x7FFFFFFF, 0x5E71])
+    from hcache_deepspeed_tpu.resilience.retry import RetryPolicy
+    policy = RetryPolicy()
+    expected = [policy.delay(1, expected_rng) for _ in range(8)]
+    assert _jitter_stream(0, seed=7) == expected

@@ -1,0 +1,421 @@
+"""Serving fleet: latent-based cross-replica migration, replica
+failure domains (crash/hang/partition), graceful drain, migration
+deadline semantics, and the per-replica observability surface."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.resilience import (FaultPlan, FaultRule,
+                                             injected)
+from hcache_deepspeed_tpu.serving import (FleetConfig, ReplicaState,
+                                          Request, RequestState,
+                                          RouterConfig, ServerConfig,
+                                          ServingFleet, ServingServer,
+                                          SimulatedEngine,
+                                          VirtualClock)
+from hcache_deepspeed_tpu.telemetry.prometheus import \
+    validate_prometheus_text
+from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+
+
+def sim_engine(num_blocks=16, max_seqs=4, latents=True):
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": max_seqs,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": num_blocks},
+        hcache={"enable_latents": latents}))
+
+
+def make_fleet(n=3, num_blocks=16, **cfg_kw):
+    cfg_kw.setdefault("server",
+                      ServerConfig(max_queue_depth=256,
+                                   kv_demand_fraction=float("inf")))
+    return ServingFleet(
+        engines=[sim_engine(num_blocks=num_blocks) for _ in range(n)],
+        clock=VirtualClock(), config=FleetConfig(**cfg_kw))
+
+
+def drive(fleet, max_steps=5000):
+    steps = 0
+    while fleet.has_work:
+        fleet.step()
+        steps += 1
+        assert steps < max_steps, \
+            "fleet did not converge\n" + fleet.snapshot()
+
+
+def reference_stream(prompt, max_new, uid):
+    """Uninterrupted token stream for (uid, prompt) on a fresh sim
+    engine — the ground truth any migrated run must reproduce."""
+    srv = ServingServer(
+        sim_engine(), clock=VirtualClock(),
+        config=ServerConfig(kv_demand_fraction=float("inf")))
+    req = Request(uid=uid, prompt=list(prompt), max_new_tokens=max_new)
+    srv.submit(request=req)
+    while srv.scheduler.has_work or srv._ingress:
+        srv.step()
+    assert req.state == RequestState.DONE
+    return list(req.tokens_out)
+
+
+# ------------------------------------------------------------------ #
+# migration parity (acceptance: latent replay fidelity)
+# ------------------------------------------------------------------ #
+def test_migration_mid_decode_preserves_token_stream():
+    fleet = make_fleet(n=2)
+    prompt = list(range(10))
+    req = fleet.submit(prompt=prompt, max_new_tokens=12)
+    fleet.step()                     # routed + admitted
+    fleet.step()                     # decoding
+    assert req.state == RequestState.DECODE
+    src = req.replica
+    mid_tokens = len(req.tokens_out)
+    assert 0 < mid_tokens < 12
+    m = fleet.migrate(req.uid, dst=1 - src)
+    assert m is not None and m.nbytes > 0
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.replica == 1 - src
+    assert req.n_migrations == 1 and req.n_restores >= 1
+    assert m.mode == "restore"
+    # the fidelity claim: the migrated stream equals the stream the
+    # request would have produced had it never moved
+    assert req.tokens_out == reference_stream(prompt, 12, req.uid)
+
+
+def test_migration_balance_and_leaks_after_forced_moves():
+    fleet = make_fleet(n=3)
+    reqs = [fleet.submit(prompt=list(range(8 + i)), max_new_tokens=8)
+            for i in range(6)]
+    fleet.step()
+    fleet.step()
+    moved = 0
+    for r in reqs:
+        if r.state == RequestState.DECODE and moved < 3:
+            fleet.migrate(r.uid)
+            moved += 1
+    drive(fleet)
+    assert moved == 3
+    assert all(r.state == RequestState.DONE for r in reqs)
+    c = fleet.counters
+    assert c["evictions"] == 3
+    assert c["landings"] + c["recompute_landings"] == 3
+    assert fleet.migration_balance_ok
+    for rep in fleet.replicas:
+        assert rep.engine.state.free_blocks == \
+            rep.initial_free_blocks
+        assert rep.engine.state.n_tracked_sequences == 0
+
+
+def test_pressure_rebalance_migrates_suspended_payload():
+    # load replica 0 directly (bypassing the router) until it preempts
+    # one request to host latents, then let the fleet's rebalance pass
+    # notice the pressure gap and move the suspended payload away
+    fleet = make_fleet(
+        n=2, num_blocks=8,
+        router=RouterConfig(migrate_pressure_gap=0.2,
+                            max_migrations_per_step=1))
+    r0 = fleet.replicas[0]
+    reqs = [Request(uid=100 + i, prompt=list(range(14)),
+                    max_new_tokens=10, priority=i)
+            for i in range(3)]
+    for q in reqs:
+        r0.server.submit(request=q)
+    for _ in range(6):
+        fleet.step()
+        if fleet.counters["evictions"]:
+            break
+    assert fleet.counters["evictions"] >= 1
+    drive(fleet)
+    assert all(q.state == RequestState.DONE for q in reqs)
+    migrated = [q for q in reqs if q.n_migrations]
+    assert migrated, "rebalance never landed a migration"
+    assert any(q.replica == 1 for q in migrated)
+    for q in migrated:
+        assert q.tokens_out == reference_stream(q.prompt, 10, q.uid)
+    assert fleet.migration_balance_ok
+
+
+# ------------------------------------------------------------------ #
+# deadline semantics for migrating requests (satellite)
+# ------------------------------------------------------------------ #
+def test_transit_time_counts_against_deadline():
+    # a 1-byte/s link makes any latent payload take forever: the
+    # deadline expires mid-transit and must free both replicas
+    fleet = make_fleet(n=2, link_bytes_per_s=1.0)
+    req = fleet.submit(prompt=list(range(10)), max_new_tokens=16,
+                       deadline=5.0)
+    fleet.step()
+    fleet.step()
+    assert req.state == RequestState.DECODE
+    src = req.replica
+    m = fleet.migrate(req.uid, dst=1 - src)
+    assert m is not None
+    drive(fleet)
+    assert req.state == RequestState.FAILED
+    assert req.error == "deadline_exceeded"
+    assert m.mode == "expired"
+    assert fleet.counters["expired_in_transit"] == 1
+    assert fleet.migration_balance_ok
+    # both replicas fully clean: source freed at detach, destination
+    # never allocated
+    for rep in fleet.replicas:
+        assert rep.engine.state.free_blocks == \
+            rep.initial_free_blocks
+        assert rep.engine.state.n_tracked_sequences == 0
+    # exactly one terminal holder: the fleet's own done map
+    assert req.uid in fleet.done
+    assert all(req.uid not in rep.scheduler.done
+               for rep in fleet.replicas)
+
+
+def test_deadline_survives_migration_when_time_allows():
+    fleet = make_fleet(n=2)
+    req = fleet.submit(prompt=list(range(8)), max_new_tokens=6,
+                       deadline=10.0)
+    fleet.step()
+    fleet.step()
+    fleet.migrate(req.uid)
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.n_migrations == 1
+
+
+# ------------------------------------------------------------------ #
+# replica crash recovery
+# ------------------------------------------------------------------ #
+def test_crash_migrates_live_requests_and_preserves_streams():
+    fleet = make_fleet(n=2)
+    reqs = [fleet.submit(prompt=list(range(8 + i)), max_new_tokens=10)
+            for i in range(4)]
+    fleet.step()
+    fleet.step()
+    victims = [q for q in reqs if q.replica == 0 and
+               q.state == RequestState.DECODE]
+    assert victims, "replica 0 got no work routed"
+    # first replica.crash fire hits replica 0
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.crash", at_hits=(1,))])):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.DEAD
+    drive(fleet)
+    for q in reqs:
+        assert q.state == RequestState.DONE, (q.uid, q.state, q.error)
+        assert q.tokens_out == reference_stream(
+            q.prompt, 10, q.uid)
+    assert all(q.replica == 1 for q in victims)
+    assert fleet.counters["replica_crashes"] == 1
+    assert fleet.counters["evictions"] >= len(victims)
+    assert fleet.migration_balance_ok
+    # the survivor leaks nothing (the dead engine is excluded)
+    rep = fleet.replicas[1]
+    assert rep.engine.state.free_blocks == rep.initial_free_blocks
+
+
+def test_crash_without_latents_recovers_via_recompute():
+    fleet = make_fleet(n=2)
+    req = fleet.submit(prompt=list(range(9)), max_new_tokens=10)
+    fleet.step()
+    fleet.step()
+    assert req.state == RequestState.DECODE and req.replica == 0
+    req.latents = None      # simulate a lost/partial payload
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.crash", at_hits=(1,))])):
+        fleet.step()
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.n_recomputes >= 1 and req.replica == 1
+    assert fleet.counters["recompute_landings"] == 1
+    assert fleet.counters["landings"] == 0
+    # recompute re-prefill reproduces the uninterrupted stream too
+    assert req.tokens_out == reference_stream(req.prompt, 10, req.uid)
+
+
+def test_all_replicas_dead_fails_typed_never_drops():
+    fleet = make_fleet(n=2)
+    reqs = [fleet.submit(prompt=list(range(8)), max_new_tokens=8)
+            for _ in range(3)]
+    fleet.step()
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.crash", at_hits=(1, 2))])):
+        fleet.step()
+    assert all(r.state is ReplicaState.DEAD for r in fleet.replicas)
+    drive(fleet)
+    for q in reqs:
+        assert q.state == RequestState.FAILED
+        assert q.error == "fleet_down"
+        assert q.uid in fleet.done
+    assert fleet.migration_balance_ok
+
+
+# ------------------------------------------------------------------ #
+# hang / partition failure domains
+# ------------------------------------------------------------------ #
+def test_hang_trips_breaker_and_heals():
+    fleet = make_fleet(n=2, hang_steps=3)
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.hang", at_hits=(1,))])):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.HANGING
+    # probes fail while hanging -> replica 0 leaves the routable set
+    fleet.step()
+    fleet.step()
+    assert 0 not in fleet._routable
+    req = fleet.submit(prompt=list(range(8)), max_new_tokens=4)
+    fleet.step()
+    assert req.replica == 1
+    for _ in range(20):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.UP
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    # after heal + breaker cooldown the replica serves again
+    late = fleet.submit(prompt=list(range(8)), max_new_tokens=2)
+    drive(fleet)
+    assert late.state == RequestState.DONE
+
+
+def test_partitioned_replica_keeps_serving_residents():
+    fleet = make_fleet(n=2, partition_steps=4)
+    req = fleet.submit(prompt=list(range(8)), max_new_tokens=6)
+    fleet.step()
+    fleet.step()
+    src = req.replica
+    # partition fires for replica 0 first; make sure it is the host
+    assert src == 0
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.net_partition", at_hits=(1,))])):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.PARTITIONED
+    assert 0 not in fleet._routable
+    drive(fleet)
+    # the partitioned replica finished its resident by itself
+    assert req.state == RequestState.DONE and req.replica == 0
+    assert fleet.counters["evictions"] == 0
+    for _ in range(6):                  # idle steps past the horizon
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.UP   # healed
+
+
+# ------------------------------------------------------------------ #
+# graceful drain
+# ------------------------------------------------------------------ #
+def test_drain_migrates_everything_out_and_stops_clean():
+    fleet = make_fleet(n=2)
+    reqs = [fleet.submit(prompt=list(range(8 + i)), max_new_tokens=10)
+            for i in range(4)]
+    fleet.step()
+    fleet.step()
+    on0 = [q for q in reqs if q.replica == 0]
+    assert on0, "replica 0 got nothing to drain"
+    fleet.drain(0)
+    drive(fleet)
+    assert fleet.replicas[0].state is ReplicaState.STOPPED
+    assert fleet.counters["drains_completed"] == 1
+    r0 = fleet.replicas[0]
+    assert r0.engine.state.free_blocks == r0.initial_free_blocks
+    assert r0.engine.state.n_tracked_sequences == 0
+    for q in reqs:
+        assert q.state == RequestState.DONE
+        assert q.tokens_out == reference_stream(
+            q.prompt, 10, q.uid)
+    for q in on0:
+        if q.n_migrations:          # drained mid-flight
+            assert q.replica == 1
+    assert fleet.migration_balance_ok
+
+
+# ------------------------------------------------------------------ #
+# observability: spans, per-replica labels, overlap agreement
+# ------------------------------------------------------------------ #
+def test_fleet_step_spans_derive_the_overlap_ratio():
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    try:
+        fleet = make_fleet(n=2)
+        reqs = [fleet.submit(prompt=list(range(8)), max_new_tokens=10)
+                for _ in range(4)]
+        fleet.step()
+        fleet.step()
+        for q in reqs[:2]:
+            if q.state == RequestState.DECODE:
+                fleet.migrate(q.uid)
+        drive(fleet)
+        events = tracer.events()
+    finally:
+        tracer.configure(enabled=was)
+    steps = [e for e in events
+             if e.get("ph") == "X" and e["name"] == "fleet.step"]
+    transit = [e for e in steps if e["args"].get("in_transit", 0) > 0]
+    overlapped = [e for e in transit
+                  if e["args"].get("decode_lanes", 0) > 0]
+    assert transit, "no fleet.step span saw a transit"
+    span_ratio = len(overlapped) / len(transit)
+    assert span_ratio == pytest.approx(fleet.migration_overlap_ratio)
+    assert fleet.transit_steps == len(transit)
+    # migration async lanes exported too
+    migrate_spans = [e for e in events
+                     if e.get("name") == "fleet.migrate"]
+    assert any(e.get("ph") == "b" for e in migrate_spans)
+    assert any(e.get("ph") == "e" for e in migrate_spans)
+
+
+def test_metrics_registry_carries_per_replica_labels():
+    fleet = make_fleet(n=2)
+    reqs = [fleet.submit(prompt=list(range(8)), max_new_tokens=4)
+            for _ in range(3)]
+    drive(fleet)
+    assert all(q.state == RequestState.DONE for q in reqs)
+    text = fleet.prometheus_text()
+    assert validate_prometheus_text(text) == []
+    assert 'replica="0"' in text and 'replica="1"' in text
+    assert "hds_fleet_finished_total" in text
+    assert "hds_fleet_evictions_total" in text
+    assert "hds_fleet_replica_state" in text
+    assert "hds_fleet_migration_overlap_ratio" in text
+    summary = fleet.summary()
+    assert summary["migration_balance_ok"] is True
+    assert set(summary["replicas"]) == {"0", "1"}
+
+
+def test_prefix_affinity_routes_shared_prefixes_together():
+    fleet = make_fleet(n=3)
+    shared = list(range(16))
+    first = fleet.submit(prompt=shared + [91], max_new_tokens=2)
+    fleet.step()
+    home = first.replica
+    followers = [fleet.submit(prompt=shared + [92 + i],
+                              max_new_tokens=2) for i in range(3)]
+    fleet.step()
+    assert all(q.replica == home for q in followers)
+    assert fleet.router.affinity_hits >= 3
+    drive(fleet)
+
+
+# ------------------------------------------------------------------ #
+# thread mode smoke (real clock)
+# ------------------------------------------------------------------ #
+def test_thread_mode_serves_and_stops():
+    fleet = ServingFleet(
+        engines=[sim_engine() for _ in range(2)],
+        config=FleetConfig(
+            server=ServerConfig(max_queue_depth=64,
+                                kv_demand_fraction=float("inf")),
+            pump_interval_s=0.001))
+    fleet.start()
+    try:
+        reqs = [fleet.submit(prompt=list(range(8)), max_new_tokens=4)
+                for _ in range(4)]
+        deadline = fleet.clock.now() + 20.0
+        while not all(q.finished for q in reqs) and \
+                fleet.clock.now() < deadline:
+            fleet.clock.sleep(0.002)
+        assert all(q.state == RequestState.DONE for q in reqs)
+    finally:
+        fleet.stop(drain=False)
+    assert fleet._pump_thread is None

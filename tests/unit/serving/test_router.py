@@ -1,0 +1,137 @@
+"""FleetRouter in isolation: placement scoring, prefix affinity,
+breaker-gated health, and migration planning with crossover pricing."""
+
+from hcache_deepspeed_tpu.serving import (ReplicaSnapshot, Request,
+                                          RestoreCrossoverModel,
+                                          RouterConfig, FleetRouter)
+
+
+def snap(id, kv=0.0, queue=0, susp=0, occ=0.0, migratable=()):
+    return ReplicaSnapshot(id=id, kv_utilization=kv, queue_depth=queue,
+                           suspended=susp, occupancy=occ,
+                           migratable=migratable)
+
+
+def req(uid=0, prompt=None):
+    return Request(uid=uid, prompt=prompt or list(range(8)))
+
+
+def test_route_prefers_least_loaded_then_lowest_id():
+    router = FleetRouter(RouterConfig(prefix_weight=0.0))
+    assert router.route(req(), [snap(0, kv=0.8), snap(1, kv=0.1),
+                                snap(2, kv=0.5)]) == 1
+    # exact tie -> lowest id (determinism)
+    assert router.route(req(1), [snap(2, kv=0.3),
+                                 snap(1, kv=0.3)]) == 1
+    assert router.route(req(2), []) is None
+
+
+def test_degraded_replica_sheds_load_to_peers():
+    # fleet-level degradation escalation: a replica riding out a fault
+    # storm (ladder level > 0) loses routes to healthy peers even at
+    # slightly lower KV pressure
+    router = FleetRouter(RouterConfig(prefix_weight=0.0,
+                                      degradation_weight=0.5))
+    degraded = ReplicaSnapshot(id=0, kv_utilization=0.2, queue_depth=0,
+                               suspended=0, occupancy=0.0,
+                               degradation=2)
+    healthy = ReplicaSnapshot(id=1, kv_utilization=0.5, queue_depth=0,
+                              suspended=0, occupancy=0.0)
+    assert router.route(req(), [degraded, healthy]) == 1
+
+
+def test_queue_and_suspended_backlog_break_ties():
+    router = FleetRouter(RouterConfig(prefix_weight=0.0))
+    assert router.route(req(), [snap(0, kv=0.2, queue=10),
+                                snap(1, kv=0.2, queue=0)]) == 1
+    assert router.route(req(1), [snap(0, kv=0.2, susp=5),
+                                 snap(1, kv=0.2, susp=0)]) == 1
+
+
+def test_prefix_affinity_sticks_until_overloaded():
+    router = FleetRouter(RouterConfig(prefix_weight=0.3,
+                                      prefix_len=8))
+    shared = list(range(8))
+    first = router.route(req(0, shared + [50]),
+                         [snap(0, kv=0.1), snap(1, kv=0.1)])
+    assert first == 0
+    # mild imbalance: affinity keeps the shared prefix together
+    assert router.route(req(1, shared + [51]),
+                        [snap(0, kv=0.3), snap(1, kv=0.1)]) == 0
+    assert router.affinity_hits == 1
+    # heavy imbalance: pressure outweighs the affinity bonus
+    assert router.route(req(2, shared + [52]),
+                        [snap(0, kv=0.9), snap(1, kv=0.1)]) == 1
+    # ... and the prefix map now points at the new home
+    assert router.route(req(3, shared + [53]),
+                        [snap(0, kv=0.2), snap(1, kv=0.2)]) == 1
+
+
+def test_prefix_map_is_lru_bounded():
+    router = FleetRouter(RouterConfig(prefix_map_size=4))
+    for i in range(10):
+        router.route(req(i, [i] * 8), [snap(0), snap(1)])
+    assert len(router._prefix_map) == 4
+
+
+def test_probe_failures_trip_breaker_then_halfopen_readmits():
+    router = FleetRouter(RouterConfig(breaker_threshold=2,
+                                      breaker_cooldown=3))
+    assert router.available(0, 1)
+    router.note_probe(0, False, 2)
+    router.note_probe(0, False, 3)
+    assert not router.available(0, 3)          # tripped
+    assert router.breaker_states()[0] == "OPEN"
+    assert not router.available(0, 4)
+    assert router.available(0, 6)              # cooldown -> HALF_OPEN
+    router.note_probe(0, True, 7)              # probe succeeded
+    assert router.available(0, 7)
+    assert router.breaker_states()[0] == "CLOSED"
+
+
+def test_plan_migrations_needs_gap_and_candidates():
+    router = FleetRouter(RouterConfig(migrate_pressure_gap=0.25))
+    # gap too small
+    assert router.plan_migrations(
+        [snap(0, kv=0.5, migratable=((7, 32),)),
+         snap(1, kv=0.4)]) == []
+    # no candidates on the hot replica
+    assert router.plan_migrations(
+        [snap(0, kv=0.9), snap(1, kv=0.1)]) == []
+    # gap + candidate: biggest cached payload moves hot -> cold
+    plans = router.plan_migrations(
+        [snap(0, kv=0.9, migratable=((7, 32), (9, 16))),
+         snap(1, kv=0.1), snap(2, kv=0.5)])
+    assert plans == [(7, 0, 1)]
+    assert router.migrations_proposed == 1
+
+
+def test_plan_migrations_respects_crossover_pricing():
+    model = RestoreCrossoverModel(
+        {"n_layer": 2, "latent_bytes_per_token": 1024,
+         "replay_flops_frac": 0.5, "restore_chunk_layers": 1,
+         "restore_chunk_bytes": 0})
+    # calibrate: fast prefill + fast host link
+    model.observe_prefill(4096, 0.01)
+    model.observe_ship(1 << 20, 0.001)
+    # a glacial inter-replica link makes every move cost more than
+    # restoring in place -> the router refuses despite the gap
+    router = FleetRouter(RouterConfig(migrate_pressure_gap=0.25),
+                         crossover=model, link_bytes_per_s=10.0)
+    assert router.plan_migrations(
+        [snap(0, kv=0.9, occ=0.5, migratable=((7, 64),)),
+         snap(1, kv=0.1, occ=0.0)]) == []
+    assert router.migrations_refused_by_cost == 1
+    # a fat link flips the verdict
+    router2 = FleetRouter(RouterConfig(migrate_pressure_gap=0.25),
+                          crossover=model, link_bytes_per_s=1e12)
+    assert router2.plan_migrations(
+        [snap(0, kv=0.9, occ=1.0, migratable=((7, 64),)),
+         snap(1, kv=0.1, occ=0.0)]) == [(7, 0, 1)]
+
+
+def test_decide_migration_uncalibrated_defaults_to_migrate():
+    model = RestoreCrossoverModel(
+        {"n_layer": 2, "latent_bytes_per_token": 64,
+         "replay_flops_frac": 0.5})
+    assert model.decide_migration(32, 0.9, 0.0, 1e9) == "migrate"

@@ -250,6 +250,40 @@ def test_breaker_trips_to_recompute_reentry():
          range(len(victim.tokens_out))]
 
 
+def test_cancel_racing_open_restore_lane_aborts_and_frees():
+    """Cancelling a RESTORING request must abort its open lane, free
+    the lane's blocks + tracked slot, and drop the host latents —
+    previously only deadline/watchdog paths exercised lane aborts."""
+    eng = sim_engine(num_blocks=9, max_seqs=2)
+    srv = make_server(eng)
+    free0 = eng.state.free_blocks
+    victim, evictor = preempt_one(srv, eng)
+    steps = 0
+    while victim.uid not in srv.scheduler.restoring:
+        srv.step()
+        steps += 1
+        assert steps < 300, f"never reached RESTORING: {victim.state}"
+    assert victim.uid in eng.restoring_uids      # lane genuinely open
+    srv.cancel(victim.uid)
+    srv.step()                                   # cancellation pass
+    assert victim.state == RequestState.DONE and victim.cancelled
+    assert victim.latents is None                # host payload dropped
+    assert victim.uid not in eng.restoring_uids  # lane aborted
+    assert eng.counts.get("abort", 0) == 1
+    drain(srv)
+    assert evictor.state == RequestState.DONE
+    assert eng.state.free_blocks == free0        # lane blocks freed
+    assert eng.state.n_tracked_sequences == 0
+    aborts = [e for e in srv.scheduler.events
+              if e[1] == "restore_abort"]
+    assert any(e[2] == victim.uid and e[3] == "cancelled"
+               for e in aborts)
+    # a cancel is not a fault: no restore failure charged
+    assert victim.n_restore_failures == 0
+    assert srv.metrics.counters["cancelled"] == 1
+    assert srv.metrics.counters["restore_aborts"] == 0
+
+
 def test_watchdog_aborts_stalled_lane():
     eng = sim_engine(num_blocks=9, max_seqs=2)
     policy = ResiliencePolicy(watchdog_steps=3,
