@@ -5,6 +5,9 @@ from .comm import (ReduceOp, all_gather, all_reduce, all_to_all, axis_index,
                    reduce_scatter)
 from .comms_logging import CommsLogger, get_comms_logger
 from .overlap import CollectiveIssue, Ticket
+from .ring import (COLLECTIVE_IMPLS, decomposed_all_to_all_rows,
+                   decomposed_reduce_scatter_sum, ring_all_gather,
+                   ring_all_reduce_sum)
 
 __all__ = [
     "CollectiveIssue", "Ticket",
@@ -13,4 +16,6 @@ __all__ = [
     "get_local_device_count", "get_rank", "get_world_size",
     "init_distributed", "is_initialized", "log_summary", "ppermute",
     "reduce_scatter", "CommsLogger", "get_comms_logger",
+    "COLLECTIVE_IMPLS", "ring_all_gather", "ring_all_reduce_sum",
+    "decomposed_all_to_all_rows", "decomposed_reduce_scatter_sum",
 ]

@@ -35,6 +35,12 @@ class CommsLogger:
         self.debug = debug
         # op_name -> msg_size -> [count, total_bytes]
         self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, 0]))
+        # op_name -> op kind ("collective" | "collective_permute"):
+        # which transport carried the bytes. Ring-decomposed sites
+        # (comm/ring.py) record per-chunk permute sends under their own
+        # kind so the decomposed wire is attributable, not silently
+        # folded into (or missing from) the monolithic-collective rows.
+        self.op_kinds = {}
 
     def configure(self, enabled=None, verbose=None, prof_all=None,
                   prof_ops=None, debug=None):
@@ -54,7 +60,8 @@ class CommsLogger:
             return False
         return self.prof_all or op_name in self.prof_ops
 
-    def log_collective(self, op_name, n_bytes, axes=()):
+    def log_collective(self, op_name, n_bytes, axes=(),
+                       op_kind="collective"):
         """Byte attribution for a collective issued OUTSIDE the comm
         facade — the explicit ZeRO reduce-scatter and all-gather bucket
         sites (``runtime/zero/zeropp.py``: ``zero_reduce_scatter``,
@@ -64,11 +71,18 @@ class CommsLogger:
         reduce lane's wire volume. Convention: ``n_bytes`` is the
         per-device collective INPUT buffer (the same convention the
         facade's ``reduce_scatter``/``all_gather`` wrappers use), so
-        bucketed and per-leaf programs report identical totals."""
+        bucketed and per-leaf programs report identical totals.
+
+        ``op_kind="collective_permute"`` marks decomposed ring-chunk
+        sends (``comm/ring.py``): one record per permute step, so the
+        ring transport's bytes land in the accounting —
+        ``wire_savings_summary`` / ``axis_summary`` rows carry the kind
+        — instead of being silently unattributed."""
+        self.op_kinds[op_name] = op_kind
         self.append(op_name, tuple(axes), int(n_bytes))
 
     def log_quantized(self, op_name, wire_bytes, unquantized_equiv_bytes,
-                      axes=()):
+                      axes=(), op_kind="collective"):
         """Byte attribution for a QUANTIZED collective: record the
         actual wire volume under ``op_name`` and the volume the same
         collective would have carried full-width under
@@ -79,6 +93,7 @@ class CommsLogger:
         that gate attribution — can pair them mechanically."""
         if not self.should_log(op_name):
             return
+        self.op_kinds[op_name] = op_kind
         self.append(op_name, tuple(axes), int(wire_bytes))
         self.append(op_name + "_unquantized_equiv", tuple(axes),
                     int(unquantized_equiv_bytes))
@@ -104,7 +119,19 @@ class CommsLogger:
                 "unquantized_equiv_bytes": equiv,
                 "saved_bytes": equiv - total,
                 "fraction": round(total / equiv, 4) if equiv else None,
+                "op_kind": self.op_kinds.get(op, "collective"),
             }
+        return out
+
+    def permute_bytes_summary(self):
+        """Total bytes per op carried by decomposed ring permutes
+        (``op_kind == "collective_permute"``): ``{op: total_bytes}``.
+        The matched-pair complement of :meth:`wire_savings_summary` for
+        the ring transport — proves ring-chunk traffic is attributed."""
+        out = {}
+        for op, by_axis in self.axis_summary().items():
+            if self.op_kinds.get(op) == "collective_permute":
+                out[op] = sum(t for _, t in by_axis.values())
         return out
 
     def append(self, op_name, axes, msg_size):
@@ -185,6 +212,7 @@ class CommsLogger:
 
     def reset(self):
         self.comms_dict.clear()
+        self.op_kinds.clear()
 
 
 _comms_logger = CommsLogger()

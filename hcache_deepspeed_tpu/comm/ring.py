@@ -1,0 +1,252 @@
+"""Decomposed ring collectives: chunked ``ppermute`` step chains.
+
+Reference analogs:
+* The Big Send-off / T3 (PAPERS.md) — decomposed, software-pipelined
+  collectives built from point-to-point sends so comm/compute overlap
+  is *structural* (dataflow) rather than scheduler-dependent,
+* ``DOMINO_TPU_r4.log`` — the motivating finding: XLA's latency-hiding
+  scheduler compiled ZERO native async collective pairs on chip, so a
+  whole-bucket ``all-gather``/``reduce-scatter`` left every byte of
+  wire time on the critical path.
+
+A monolithic collective is one opaque HLO op: the scheduler either
+splits it into an async start/done pair or it does not, and r4 proved
+"does not" happens. These functions re-express the same collectives as
+chains of ``jax.lax.ppermute`` steps where each step depends only on
+the previous chunk (all-gather) or on the local input rows
+(reduce-scatter / all-to-all delivery) — so compute that consumes
+already-landed chunks is dependence-free of the in-flight permutes *by
+construction*, and any scheduler (or none) overlaps them. The HLO
+auditor scores exactly this as the *structural* overlap ratio
+(``profiling/hlo_audit.py structural_overlap_ratio``).
+
+Bitwise contract (the tier-1 parity gate): every function here is
+bitwise-equal to the native collective it replaces on a deterministic
+backend —
+
+* **all-gather** moves bytes without arithmetic: trivially bitwise.
+* **reduce-scatter** delivers raw per-destination chunk contributions
+  point-to-point (one distance-``s`` permute per step, ``n-1`` chunk
+  sends per device — the same per-device wire volume as an in-network
+  ring, because delivery is direct rather than hop-by-hop) and folds
+  them locally in *source-index order*, accumulating sub-fp32 inputs
+  in fp32 with a single cast back. Measured (and pinned by
+  ``tests/unit/comm/test_ring.py``): XLA's CPU ``psum_scatter`` is
+  exactly that fold — index-order, fp32-accumulated — so decomposed
+  and native agree bit for bit for fp32/bf16/integer payloads. A
+  classic accumulate-in-transit ring would fold each chunk in cyclic
+  order ``(c+1, ..., c)`` and could never match.
+* **all-to-all row delivery** reorders the received chunks back to
+  source order before handing them over, so downstream math (the
+  quantized-wire dequant-accumulate) is the *same local computation
+  graph* as the native ``all_to_all`` path.
+
+Chunking: ``chunks > 1`` splits every payload into that many sub-chunk
+chains (uneven splits allowed — ``numpy.array_split`` boundaries), each
+an independent permute chain. Pure data movement plus elementwise
+folds, so chunking never changes a single bit; it only makes the
+pipeline finer-grained.
+
+Everything here must run INSIDE a ``shard_map`` region (manual axis).
+Wire bytes are attributed per permute step through
+``CommsLogger.log_collective(op_kind="collective_permute")`` so ring
+traffic lands in the comm accounting instead of vanishing.
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .comms_logging import get_comms_logger
+
+#: config values for the ZeRO collective transport knob
+#: (``zero_optimization.zero_collective_impl``)
+COLLECTIVE_IMPLS = ("native", "decomposed")
+
+
+def _log_permute(op_name, n_bytes, axis_name):
+    logger = get_comms_logger()
+    if op_name and logger.should_log(op_name):
+        logger.log_collective(op_name, int(n_bytes), (axis_name,),
+                              op_kind="collective_permute")
+
+
+def _chunk_bounds(width: int, chunks: int) -> List[Tuple[int, int]]:
+    """``numpy.array_split``-style static (start, stop) bounds: uneven
+    chunk counts are legal, empty chunks are dropped."""
+    chunks = max(1, min(int(chunks), max(1, width)))
+    splits = np.array_split(np.arange(width), chunks)
+    return [(int(s[0]), int(s[-1]) + 1) for s in splits if len(s)]
+
+
+def _group_layout(axis_name, axis_index_groups):
+    """(group size, my rank within my group, ring permute builder).
+
+    ``axis_index_groups`` must be equal-size disjoint groups (the hpZ
+    layout). The permute builder maps a rank-space permutation ``k ->
+    (k+s) % m`` onto device ids group by group."""
+    n = jax.lax.axis_size(axis_name)
+    if axis_index_groups is None:
+        groups = [list(range(n))]
+    else:
+        groups = [list(g) for g in axis_index_groups]
+        sizes = {len(g) for g in groups}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"ring collectives need equal-size axis_index_groups; "
+                f"got sizes {sorted(sizes)}")
+    m = len(groups[0])
+    rank_of = np.zeros(n, dtype=np.int32)
+    for g in groups:
+        for k, dev in enumerate(g):
+            rank_of[dev] = k
+    my_rank = jnp.asarray(rank_of)[jax.lax.axis_index(axis_name)]
+
+    def perm_at(step):
+        # rank k sends to rank (k + step) % m, within every group
+        return [(g[k], g[(k + step) % m]) for g in groups for k in range(m)]
+
+    return m, my_rank, perm_at
+
+
+def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
+                    op_name: str = "ring_all_gather"):
+    """Chunked ring all-gather: ``[n_g, *x.shape]`` stacked result, row
+    ``j`` = group-rank ``j``'s ``x`` — the same layout (and bits) as
+    ``jax.lax.all_gather(x, axis_name, axis_index_groups=...)``.
+
+    Each sub-chunk rides its own chain of ``n_g - 1`` neighbor permutes
+    (send to the previous rank, so arrivals come in increasing
+    rank-offset order); step ``s``'s permute consumes only step
+    ``s-1``'s output, never any compute — the chain is dependence-free
+    of everything except the wire."""
+    m, my_rank, perm_at = _group_layout(axis_name, axis_index_groups)
+    if m == 1:
+        return x[None]
+    flat = x.reshape(-1)
+    neighbor = perm_at(m - 1)          # rank k -> rank (k - 1) % m
+    rows = []
+    for lo, hi in _chunk_bounds(flat.shape[0], chunks):
+        piece = flat[lo:hi]
+        arrived = [piece]              # pos s holds rank (my_rank + s)'s
+        cur = piece
+        for _ in range(m - 1):
+            _log_permute(op_name, piece.size * piece.dtype.itemsize,
+                         axis_name)
+            cur = jax.lax.ppermute(cur, axis_name, neighbor)
+            arrived.append(cur)
+        stacked = jnp.stack(arrived)               # [m, w]
+        rows.append(jnp.roll(stacked, my_rank, axis=0))  # row j = rank j
+    wide = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+    return wide.reshape((m,) + x.shape)
+
+
+def decomposed_all_to_all_rows(rows, axis_name, *, chunks: int = 1,
+                               op_name: str = "ring_all_to_all"):
+    """Decomposed row exchange: ``rows`` is ``[n, ...]`` with row ``d``
+    destined for device ``d``; returns ``[n, ...]`` received rows in
+    SOURCE order — the same layout (and bits) as
+    ``jax.lax.all_to_all(rows, axis_name, 0, 0)``.
+
+    Step ``s`` is one distance-``s`` permute delivering row
+    ``(i+s) % n`` directly to its destination: ``n-1`` chunk sends per
+    device (the in-network-ring wire volume, reached by direct delivery
+    instead of accumulate-and-forward), every step dependent only on
+    the local input rows."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return rows
+    if rows.shape[0] != n:
+        raise ValueError(f"decomposed_all_to_all_rows needs leading dim "
+                         f"== axis size {n}; got {rows.shape}")
+    idx = jax.lax.axis_index(axis_name)
+    row_shape = rows.shape[1:]
+    flat = rows.reshape(n, -1)
+    bounds = _chunk_bounds(flat.shape[1], chunks)
+    received = [jnp.take(flat, idx, axis=0)]       # own row (source = me)
+    for s in range(1, n):
+        perm = [(j, (j + s) % n) for j in range(n)]
+        sent = jnp.take(flat, (idx + s) % n, axis=0)
+        pieces = []
+        for lo, hi in bounds:
+            _log_permute(op_name, (hi - lo) * flat.dtype.itemsize,
+                         axis_name)
+            pieces.append(jax.lax.ppermute(sent[lo:hi], axis_name, perm))
+        received.append(pieces[0] if len(pieces) == 1
+                        else jnp.concatenate(pieces))
+    stacked = jnp.stack(received)          # pos s = source (idx - s) % n
+    ordered = jnp.roll(stacked[::-1], idx + 1, axis=0)  # row j = source j
+    return ordered.reshape((n,) + row_shape)
+
+
+def _index_order_fold(ordered):
+    """Left fold of ``ordered`` ``[n, ...]`` in source-index order —
+    XLA's cross-replica reduction order. Sub-fp32 floats accumulate in
+    fp32 with one cast back (what the native reduction does for bf16);
+    fp32/f64/integers fold in their own dtype."""
+    dtype = ordered.dtype
+    acc_dtype = dtype
+    if jnp.issubdtype(dtype, jnp.floating) and dtype.itemsize < 4:
+        acc_dtype = jnp.float32
+    acc = ordered[0].astype(acc_dtype)
+    for s in range(1, ordered.shape[0]):
+        acc = acc + ordered[s].astype(acc_dtype)
+    return acc.astype(dtype)
+
+
+def decomposed_reduce_scatter_sum(x, axis_name, *, chunks: int = 1,
+                                  op_name: str = "ring_reduce_scatter"):
+    """Decomposed reduce-scatter SUM over leading dim: ``x`` is
+    ``[n * m, ...]``, returns ``[m, ...]`` — device ``i`` ends with the
+    cross-device sum of slice ``[i*m:(i+1)*m]``, bitwise-equal to
+    ``jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+    tiled=True)`` on a deterministic backend (index-order fold, fp32
+    accumulation for sub-fp32 floats — pinned by test_ring.py).
+
+    Transport is :func:`decomposed_all_to_all_rows` (direct chunk
+    delivery, ``n-1`` sends per device); the reduction happens at the
+    destination, in a fixed order, instead of in-network — which is the
+    only way a decomposed reduce can match the native fold order."""
+    n = jax.lax.axis_size(axis_name)
+    if x.shape[0] % n:
+        raise ValueError(f"decomposed_reduce_scatter_sum needs leading "
+                         f"dim divisible by axis size {n}; got {x.shape}")
+    m = x.shape[0] // n
+    if n == 1:
+        return x
+    chunk_shape = (m,) + x.shape[1:]
+    rows = x.reshape(n, -1)                       # row d -> device d
+    ordered = decomposed_all_to_all_rows(rows, axis_name, chunks=chunks,
+                                         op_name=op_name)
+    return _index_order_fold(ordered).reshape(chunk_shape)
+
+
+def ring_all_reduce_sum(x, axis_name, *, chunks: int = 1,
+                        op_name: str = "ring_all_reduce"):
+    """Decomposed all-reduce SUM = reduce-scatter + ring all-gather
+    (value-equivalent to ``jax.lax.psum(x, axis_name)``; both legs are
+    permute chains, so independent compute overlaps either leg by
+    dataflow). Arbitrary shapes: flattened and zero-padded to a
+    multiple of the axis size."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    shape, size = x.shape, x.size
+    pad = (-size) % n
+    flat = jnp.pad(x.reshape(-1), (0, pad))
+    mine = decomposed_reduce_scatter_sum(flat, axis_name, chunks=chunks,
+                                         op_name=op_name)
+    full = ring_all_gather(mine, axis_name, chunks=chunks,
+                           op_name=op_name)
+    return full.reshape(-1)[:size].reshape(shape)
+
+
+def validate_collective_impl(impl: str) -> str:
+    """Literal check for the transport knob; returns the value."""
+    if impl not in COLLECTIVE_IMPLS:
+        raise ValueError(
+            f"zero_collective_impl={impl!r}: expected one of "
+            f"{COLLECTIVE_IMPLS}")
+    return impl

@@ -261,7 +261,11 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("native_async_pairs",
                      "zero_overlap.native_async_pairs"),
                     ("qrs_wire_fraction_of_fp32",
-                     "zero_overlap.qrs_wire_fraction_of_fp32")):
+                     "zero_overlap.qrs_wire_fraction_of_fp32"),
+                    ("structural_overlap_ratio_decomposed",
+                     "zero_overlap.structural_overlap_ratio"),
+                    ("domino_decomposed_overlapped_pairs",
+                     "domino.decomposed_overlapped_pairs")):
                 if isinstance(row.get(key), (int, float)):
                     pts.append(MetricPoint(metric, float(row[key]),
                                            file, phase=phase, utc=utc))
@@ -270,7 +274,13 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("qrs_bitwise_depth_parity",
                      "zero_overlap.qrs_bitwise_depth_parity"),
                     ("qrs_trajectory_within_tol",
-                     "zero_overlap.qrs_trajectory_within_tol")):
+                     "zero_overlap.qrs_trajectory_within_tol"),
+                    ("decomposed_bitwise_vs_native",
+                     "zero_overlap.decomposed_bitwise_vs_native"),
+                    ("decomposed_qwire_bitwise",
+                     "zero_overlap.decomposed_qwire_bitwise"),
+                    ("domino_decomposed_value_parity",
+                     "domino.decomposed_value_parity")):
                 if key in row:
                     pts.append(MetricPoint(metric,
                                            1.0 if row[key] else 0.0,
@@ -631,9 +641,11 @@ FAMILIES: List[ArtifactFamily] = [
         "multichip-dryrun", r"^MULTICHIP_r\d+\.json$", parse_multichip,
         "8-device dryrun gate: ok/skipped per round"),
     ArtifactFamily(
-        "zero-overlap", r"^ZERO_OVERLAP\.jsonl$", parse_zero_overlap,
-        "ZeRO-3 overlap + quantized-wire audit stream "
-        "(bench.py --zero-overlap; hlo_audit rows)"),
+        "zero-overlap", r"^ZERO_OVERLAP(_TPU)?\.jsonl$",
+        parse_zero_overlap,
+        "ZeRO-3 overlap + quantized-wire + decomposed-ring audit "
+        "stream (bench.py --zero-overlap; hlo_audit rows; _TPU = the "
+        "chip-truth capture from bin/chip_overlap_campaign.sh)"),
     ArtifactFamily(
         "serve-loop", r"^SERVE_LOOP\.jsonl$", parse_serve_loop,
         "continuous-batching serve-loop trace: per-request rows + "

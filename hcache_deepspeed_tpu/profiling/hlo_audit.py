@@ -143,6 +143,12 @@ class Pair:
     done: str           # native: the -done op; derived: == start
     interleaved: int    # dot/fusion ops inside the window / legally free
     provenance: str     # "native" | "derived"
+    #: derived tier only: dependence-free fusions whose called
+    #: computation contains real math (a dot/convolution). Excluded
+    #: from ``interleaved`` (elementwise fusions are free next to
+    #: anything) but counted by the STRUCTURAL tier — a dot-bearing
+    #: fusion really can hide an in-flight permute chunk's wire time.
+    free_fused: int = 0
 
     def to_dict(self):
         return {
@@ -247,10 +253,13 @@ def _native_pairs(comp: Computation) -> List[Pair]:
     return pairs
 
 
-def _derived_pairs(comp: Computation):
+def _derived_pairs(comp: Computation, dot_fusions=frozenset()):
     """(overlappable, sequential) sync collectives, from def-use
     independence: a dot/fusion that is neither ancestor nor descendant
-    of a collective is legally schedulable inside its window."""
+    of a collective is legally schedulable inside its window.
+    ``dot_fusions`` is the set of fusion instruction names (in this
+    computation) whose called computation contains a dot/convolution —
+    counted separately as ``free_fused`` for the structural tier."""
     graph = _graph(comp)
     rev = _reverse(graph)
     overlappable, sequential = [], []
@@ -263,11 +272,88 @@ def _derived_pairs(comp: Computation):
                 if i.opcode in DERIVED_COMPUTE_OPS
                 and i.name != c.name
                 and i.name not in anc and i.name not in desc]
+        n_fused = sum(
+            1 for i in comp.instrs
+            if i.name in dot_fusions
+            and i.name not in anc and i.name not in desc)
         pair = Pair(kind=c.opcode, computation=comp.name,
                     start=c.name, done=c.name,
-                    interleaved=len(free), provenance="derived")
+                    interleaved=len(free), provenance="derived",
+                    free_fused=n_fused)
         (overlappable if free else sequential).append(pair)
     return overlappable, sequential
+
+
+def _dot_fusion_names(comps: List[Computation]) -> Dict[str, set]:
+    """Per computation: names of fusion instructions whose called
+    computation (transitively) contains a dot/convolution. A one-pass
+    fixpoint over the ``calls=`` edges — fused computations are flat in
+    practice, but nested calls cost nothing to honor."""
+    has_math: Dict[str, bool] = {
+        c.name: any(i.opcode in DERIVED_COMPUTE_OPS for i in c.instrs)
+        for c in comps}
+    calls: Dict[str, List[str]] = {}
+    for c in comps:
+        calls[c.name] = []
+        for i in c.instrs:
+            m = _CALLS_RE.search(i.raw)
+            if m:
+                calls[c.name].append(m.group(1))
+    changed = True
+    while changed:
+        changed = False
+        for name, targets in calls.items():
+            if not has_math.get(name) and any(
+                    has_math.get(t) for t in targets):
+                has_math[name] = True
+                changed = True
+    out: Dict[str, set] = {}
+    for c in comps:
+        names = set()
+        for i in c.instrs:
+            if i.opcode != "fusion":
+                continue
+            m = _CALLS_RE.search(i.raw)
+            if m and has_math.get(m.group(1)):
+                names.add(i.name)
+        out[c.name] = names
+    return out
+
+
+def _permute_chains(comp: Computation) -> List[Dict]:
+    """Group this computation's ``collective-permute`` ops into CHAINS:
+    permutes connected by a def-use path (step ``s`` consumes step
+    ``s-1``'s chunk — the decomposed ring all-gather). Point-to-point
+    delivery permutes that share no path (the decomposed
+    reduce-scatter's distance-``s`` sends) report as length-1 chains.
+    The chain structure is the evidence that a decomposed collective
+    exists in the compiled program, not just in the Python."""
+    permutes = [i for i in comp.instrs
+                if i.opcode in ("collective-permute",
+                                "collective-permute-start")]
+    if not permutes:
+        return []
+    graph = _graph(comp)
+    anc = {p.name: _ancestors(graph, p.name) for p in permutes}
+    parent = {p.name: p.name for p in permutes}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a in permutes:
+        for b in permutes:
+            if a.name != b.name and a.name in anc[b.name]:
+                ra, rb = find(a.name), find(b.name)
+                if ra != rb:
+                    parent[ra] = rb
+    chains: Dict[str, List[str]] = {}
+    for p in permutes:
+        chains.setdefault(find(p.name), []).append(p.name)
+    return [{"computation": comp.name, "length": len(members)}
+            for members in chains.values()]
 
 
 @dataclass
@@ -279,8 +365,13 @@ class AuditReport:
     #: per collective opcode: result-buffer bytes in the COMPILED
     #: module ``{kind: {bytes, quantized_bytes, count}}`` — the
     #: HLO-measured wire evidence (an int8 wire shows up as s8/u8
-    #: buffers here, independent of the trace-time comms attribution)
+    #: buffers here, independent of the trace-time comms attribution).
+    #: ``collective-permute`` rows price the decomposed ring chunks.
     wire_bytes: Dict[str, Dict] = field(default_factory=dict)
+    #: decomposed-ring evidence: every collective-permute CHAIN in the
+    #: module (``[{computation, length}]``; length >= 2 = a ppermute
+    #: step chain, length 1 = a point-to-point delivery send)
+    permute_chains: List[Dict] = field(default_factory=list)
 
     def pairs(self, kind: Optional[str] = None,
               min_interleaved: int = 1) -> List[Pair]:
@@ -313,6 +404,24 @@ class AuditReport:
             out[p.kind] = out.get(p.kind, 0) + 1
         return out
 
+    def structural_overlap_ratio(self,
+                                 kind: str = "collective-permute") -> float:
+        """STRUCTURAL overlap: the fraction of ``kind`` collectives
+        (the decomposed ring's permute steps) with >= 1 dependence-free
+        dot OR dot-bearing fusion — compute that can hide the in-flight
+        chunk's wire time by dataflow construction, no async scheduler
+        required. Distinct from :meth:`overlap_ratio`'s derived tier in
+        two ways: dot-bearing fusions count (the block math of an
+        already-landed layer often compiles into one), and the name
+        says what the decomposed transport guarantees — the overlap is
+        a property of the program's dependence structure, not of
+        scheduler goodwill. 1.0 on an empty set."""
+        every = self._all(kind)
+        if not every:
+            return 1.0
+        return sum(1 for p in every
+                   if p.interleaved + p.free_fused >= 1) / len(every)
+
     def to_row(self) -> Dict:
         """JSON-safe summary row (the ZERO_OVERLAP.jsonl payload)."""
         return {
@@ -325,6 +434,11 @@ class AuditReport:
                 self.overlap_ratio("reduce-scatter"), 4),
             "allreduce_overlap_ratio": round(
                 self.overlap_ratio("all-reduce"), 4),
+            "permute_overlap_ratio": round(
+                self.overlap_ratio("collective-permute"), 4),
+            "structural_overlap_ratio": round(
+                self.structural_overlap_ratio(), 4),
+            "permute_chains": list(self.permute_chains),
             "collective_counts": self.counts(),
             "wire_bytes": self.wire_bytes,
             "pairs": [p.to_dict() for p in
@@ -338,13 +452,17 @@ class AuditReport:
 def audit_hlo_text(text: str) -> AuditReport:
     """Audit one optimized-HLO module's async-overlap structure."""
     native, derived, sequential = [], [], []
+    chains: List[Dict] = []
     wire: Dict[str, Dict] = {}
     comps = parse_hlo_computations(text)
+    dot_fusions = _dot_fusion_names(comps)
     for comp in comps:
         native.extend(_native_pairs(comp))
-        over, seq = _derived_pairs(comp)
+        over, seq = _derived_pairs(comp,
+                                   dot_fusions.get(comp.name, frozenset()))
         derived.extend(over)
         sequential.extend(seq)
+        chains.extend(_permute_chains(comp))
         for i in comp.instrs:
             if not (i.is_collective or i.opcode.endswith("-start")):
                 continue
@@ -358,7 +476,8 @@ def audit_hlo_text(text: str) -> AuditReport:
             rec["count"] += 1
     return AuditReport(native_pairs=native, derived_pairs=derived,
                        sequential_collectives=sequential,
-                       computations=len(comps), wire_bytes=wire)
+                       computations=len(comps), wire_bytes=wire,
+                       permute_chains=chains)
 
 
 def audit_compiled(compiled) -> AuditReport:

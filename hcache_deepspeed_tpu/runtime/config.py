@@ -127,6 +127,19 @@ class ZeroConfig(HDSConfigModel):
     #: materializes for eligible (Dense-kernel) qwZ leaves. Requires
     #: ``zero_quantized_weights``.
     zero_quantized_weights_fused_matmul: bool = False
+    #: Collective TRANSPORT of the layered ZeRO-3 lanes (and of
+    #: ``domino_split_async`` when asked): ``"native"`` issues
+    #: monolithic ``all_gather``/``psum_scatter``/``all_to_all`` ops
+    #: and relies on the backend's latency-hiding scheduler to overlap
+    #: them (which ``DOMINO_TPU_r4.log`` proved can silently not
+    #: happen); ``"decomposed"`` re-expresses them as chunked
+    #: ``ppermute`` ring chains (``comm/ring.py``) whose steps are
+    #: dependence-free of block compute by dataflow construction —
+    #: bitwise-equal to native, structural overlap scored by
+    #: ``hlo_audit.structural_overlap_ratio``. Decomposed requires the
+    #: layered step, a data axis > 1, and ``overlap_comm=true``
+    #: (validated with typed errors, no silent fallthrough).
+    zero_collective_impl: str = "native"
     #: ZeRO++ stage-3 gather granularity: scan-over-layers (gather one
     #: block at a time inside the micro step) when the model provides a
     #: layered spec (models/layered.py). False forces the whole-tree
@@ -143,6 +156,22 @@ class ZeroConfig(HDSConfigModel):
         # combinations (stage interplay re-checked at engine build,
         # where the topology is known)
         from .zero.overlap import validate_quantized_wire
+        if self.zero_collective_impl not in ("native", "decomposed"):
+            raise HDSConfigError(
+                f"zero_collective_impl="
+                f"{self.zero_collective_impl!r}: expected 'native' "
+                f"(monolithic collectives) or 'decomposed' (chunked "
+                f"ppermute ring transport, comm/ring.py)")
+        if self.zero_collective_impl == "decomposed" \
+                and not self.overlap_comm:
+            # world-size interplay is re-checked at engine build
+            # (validate_overlap_config), where the topology is known;
+            # the overlap_comm contradiction is knowable right here
+            raise HDSConfigError(
+                "zero_collective_impl=decomposed with "
+                "overlap_comm=false: the decomposed ring transport "
+                "exists to make overlap structural — enable "
+                "overlap_comm or use zero_collective_impl=native")
         validate_quantized_wire(
             quantized_reduce_scatter=self.zero_quantized_reduce_scatter,
             error_feedback=self.zero_reduce_scatter_error_feedback,

@@ -66,7 +66,8 @@ def domino_split(layer_fn, x, *args, **kwargs):
 
 def domino_split_async(compute_fn, collective_fn, x, *args,
                        overlap=True, wire_bits=None, axis=None,
-                       wire_error=None, group_size=2048, **kwargs):
+                       wire_error=None, group_size=2048,
+                       collective_impl="native", **kwargs):
     """Half-batch split with the collective EXPLICITLY issued through
     :class:`comm.overlap.CollectiveIssue` instead of buried inside an
     opaque layer function — the reference's hand-scheduled form
@@ -100,8 +101,36 @@ def domino_split_async(compute_fn, collective_fn, x, *args,
     seeds zeros) — and the return becomes
     ``(y, (e0_new, e1_new))`` for the caller to thread. Must run
     inside the shard_map region, like the plain collective.
+
+    ``collective_impl="decomposed"`` replaces each half's all-reduce
+    with a decomposed reduce-scatter + ring all-gather built from
+    chunked ``ppermute`` chains (``comm/ring.py ring_all_reduce_sum``)
+    — the two derived-legal pairs overlap *without* native async
+    support: every permute step of half 0's ring is dependence-free of
+    half 1's dots by dataflow, the structure ``DOMINO_TPU_r4.log``
+    showed XLA would not synthesize on its own. Requires ``axis`` (the
+    mesh axis the layer reduces over); ``collective_fn`` is ignored in
+    favor of the ring. Value-equivalent to the native ``psum``
+    (index-order fold, fp32-accumulated); composed with ``wire_bits``
+    the int8 body's two collectives ride rings instead — bit-identical
+    to the native int8 body (quantization happens before the transport
+    choice).
     """
     B = x.shape[0]
+    if collective_impl not in ("native", "decomposed"):
+        raise ValueError(f"collective_impl={collective_impl!r}: "
+                         f"expected 'native' or 'decomposed'")
+    if collective_impl == "decomposed":
+        if axis is None:
+            raise ValueError(
+                "domino_split_async(collective_impl='decomposed') "
+                "needs the mesh axis the layer reduces over (axis=...)")
+        if wire_bits is None:
+            from ..comm.ring import ring_all_reduce_sum
+
+            def collective_fn(t):
+                return ring_all_reduce_sum(
+                    t, axis, op_name="domino_ring_allreduce")
     if wire_bits is not None:
         if axis is None:
             raise ValueError(
@@ -111,7 +140,8 @@ def domino_split_async(compute_fn, collective_fn, x, *args,
 
         def q_collective(t, e):
             return quantized_allreduce_body(
-                t, e, axis, group_size=group_size, num_bits=wire_bits)
+                t, e, axis, group_size=group_size, num_bits=wire_bits,
+                collective_impl=collective_impl)
 
         if B < 2 or not overlap:
             t = compute_fn(x, *args, **kwargs)
@@ -155,22 +185,28 @@ class DominoTransformer:
 
     def __init__(self, layer_fn=None, *, compute_fn=None,
                  collective_fn=None, overlap=True, wire_bits=None,
-                 axis=None):
+                 axis=None, collective_impl="native"):
         if (layer_fn is None) == (compute_fn is None):
             raise ValueError(
                 "pass either layer_fn (opaque form) or compute_fn + "
                 "collective_fn (explicit async-issue form)")
-        if compute_fn is not None and collective_fn is None:
+        if compute_fn is not None and collective_fn is None \
+                and collective_impl != "decomposed":
             raise ValueError("compute_fn requires collective_fn")
         if wire_bits is not None and compute_fn is None:
             raise ValueError("wire_bits needs the explicit "
                              "compute_fn + collective_fn form")
+        if collective_impl == "decomposed" and compute_fn is None:
+            raise ValueError("collective_impl='decomposed' needs the "
+                             "explicit compute_fn form (the collective "
+                             "must be ours to decompose)")
         self.layer_fn = layer_fn
         self.compute_fn = compute_fn
         self.collective_fn = collective_fn
         self.overlap = overlap
         self.wire_bits = wire_bits
         self.axis = axis
+        self.collective_impl = collective_impl
 
     def __call__(self, x, *args, **kwargs):
         if self.layer_fn is not None:
@@ -178,4 +214,6 @@ class DominoTransformer:
         return domino_split_async(self.compute_fn, self.collective_fn,
                                   x, *args, overlap=self.overlap,
                                   wire_bits=self.wire_bits,
-                                  axis=self.axis, **kwargs)
+                                  axis=self.axis,
+                                  collective_impl=self.collective_impl,
+                                  **kwargs)

@@ -161,13 +161,16 @@ def validate_quantized_wire(*, quantized_reduce_scatter: bool,
             "rides the explicit layered reduce lane)")
 
 
-def validate_overlap_config(*, reduce_bucket_elements: int,
-                            largest_leaf: int,
+def validate_overlap_config(*, reduce_bucket_elements: int = 0,
+                            largest_leaf: int = 0,
                             largest_leaf_name: str = "",
                             max_live_parameters: int = 0,
                             layer_params: int = 0,
                             outer_params: int = 0,
-                            knob: str = "reduce_bucket_size") -> None:
+                            knob: str = "reduce_bucket_size",
+                            collective_impl: Optional[str] = None,
+                            world_size: int = 0,
+                            overlap_comm: bool = True) -> None:
     """Build-time rejection of nonsensical overlap knobs — a clear
     error instead of the silent clamping the knobs used to get.
 
@@ -178,8 +181,31 @@ def validate_overlap_config(*, reduce_bucket_elements: int,
     * ``stage3_max_live_parameters`` smaller than one layer + the
       outer (embedding/head) leaves cannot run the layered step at all
       (depth 0 already keeps that much alive). Reject.
+    * ``zero_collective_impl="decomposed"`` (the chunked-ppermute ring
+      transport, ``comm/ring.py``) with a data world size of 1 has no
+      ring to decompose — every "permute" would be a self-send — and
+      with ``overlap_comm=False`` it contradicts itself: the point of
+      the decomposition is structural overlap, and the serialization
+      fallback deliberately puts every collective on the critical
+      path. Both are rejected with a typed error, no silent
+      fallthrough to the native transport.
     """
     from ..config import HDSConfigError
+    if collective_impl is not None and collective_impl == "decomposed":
+        if world_size == 1:
+            raise HDSConfigError(
+                "zero_collective_impl=decomposed with data world size "
+                "1: a one-device ring has no permutes to decompose "
+                "into — use zero_collective_impl=native (or a data "
+                "axis > 1)")
+        if not overlap_comm:
+            raise HDSConfigError(
+                "zero_collective_impl=decomposed with "
+                "overlap_comm=false: the decomposed ring transport "
+                "exists to make comm/compute overlap structural, and "
+                "overlap_comm=false is the explicit serialization "
+                "fallback — enable overlap_comm or use "
+                "zero_collective_impl=native")
     if largest_leaf > reduce_bucket_elements:
         name = f" ({largest_leaf_name})" if largest_leaf_name else ""
         raise HDSConfigError(

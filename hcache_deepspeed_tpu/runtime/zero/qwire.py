@@ -91,11 +91,19 @@ def plan_wire_residual_widths(sizes, dims, *, bucket_elements,
 
 
 def _quantized_wide_reduce(wide, residual, *, group_size, bits,
-                           equiv_bytes):
-    """One bucket: ``wide`` is the full ``[n, W]`` fp32 cotangent
-    buffer (row j -> device j). Returns ``(mean [W] fp32,
+                           equiv_bytes, collective_impl="native"):
+    """One bucket: ``wide`` is the full ``[n, W]`` cotangent buffer
+    (row j -> device j). Returns ``(mean [W] fp32,
     new_residual [n, W] fp32)``. ``residual`` None means error
-    feedback off (the quantization error is dropped, not carried)."""
+    feedback off (the quantization error is dropped, not carried).
+
+    ``collective_impl="decomposed"`` replaces the two ``all_to_all``s
+    with per-row ``ppermute`` delivery (``comm/ring.py``): rows are
+    quantized per ring chunk exactly as before (same group layout,
+    same EF residual semantics — quantization happens BEFORE the
+    transport choice), shipped point-to-point, and reordered to source
+    order on arrival, so the dequant-accumulate is the same local
+    computation graph as the native path — bitwise-equal."""
     n, W = wide.shape
     gsz = max(1, min(group_size, W))
     num_bits = 4 if bits == 4 else 8
@@ -124,8 +132,15 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
         QRS_OP,
         payload.size * payload.dtype.itemsize + 4 * scale.size,
         equiv_bytes, (DATA_AXIS,))
-    payload_t = jax.lax.all_to_all(payload, DATA_AXIS, 0, 0)
-    scale_t = jax.lax.all_to_all(scale, DATA_AXIS, 0, 0)
+    if collective_impl == "decomposed":
+        from ...comm.ring import decomposed_all_to_all_rows
+        payload_t = decomposed_all_to_all_rows(
+            payload, DATA_AXIS, op_name="zero_ring_qrs")
+        scale_t = decomposed_all_to_all_rows(
+            scale, DATA_AXIS, op_name="zero_ring_qrs")
+    else:
+        payload_t = jax.lax.all_to_all(payload, DATA_AXIS, 0, 0)
+        scale_t = jax.lax.all_to_all(scale, DATA_AXIS, 0, 0)
     q_t = unpack_int4(payload_t, q.shape[-1]) if bits == 4 else payload_t
     red = jnp.mean(deq_rows(q_t, scale_t), axis=0)      # [W] fp32
     return red, new_residual
@@ -134,7 +149,8 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
 def quantized_bucket_reduce_scatter_mean(flat, dims, *, bucket_elements,
                                          group_size, bits=8,
                                          residuals: Optional[list] = None,
-                                         error_feedback=True):
+                                         error_feedback=True,
+                                         collective_impl="native"):
     """Bucketed QUANTIZED reduce-mean of the sharded leaves of ``flat``
     (full cotangents) onto their data-axis shards — the qgZ all-to-all
     topology at IPG-bucket granularity, one collective pair (payload +
@@ -177,7 +193,7 @@ def quantized_bucket_reduce_scatter_mean(flat, dims, *, bucket_elements,
                 else jnp.zeros(wide.shape, jnp.float32)
         red, nr = _quantized_wide_reduce(
             wide, res, group_size=group_size, bits=bits,
-            equiv_bytes=equiv_bytes)
+            equiv_bytes=equiv_bytes, collective_impl=collective_impl)
         if error_feedback:
             new_res.append(nr)
         off = 0

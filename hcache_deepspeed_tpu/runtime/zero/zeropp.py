@@ -152,11 +152,17 @@ def _quant_reduce_mean_dim(g, dim, *, group_size):
     return jnp.moveaxis(jnp.mean(deq, axis=0), 0, dim)
 
 
-def _psum_scatter_mean_dim(g, dim):
+def _psum_scatter_mean_dim(g, dim, collective_impl="native"):
     n = jax.lax.axis_size(DATA_AXIS)
     _log_plain("zero_reduce_scatter", g.size * g.dtype.itemsize)
-    out = jax.lax.psum_scatter(jnp.moveaxis(g, dim, 0), DATA_AXIS,
-                               scatter_dimension=0, tiled=True)
+    gm = jnp.moveaxis(g, dim, 0)
+    if collective_impl == "decomposed":
+        from ...comm.ring import decomposed_reduce_scatter_sum
+        out = decomposed_reduce_scatter_sum(
+            gm, DATA_AXIS, op_name="zero_ring_reduce_scatter")
+    else:
+        out = jax.lax.psum_scatter(gm, DATA_AXIS,
+                                   scatter_dimension=0, tiled=True)
     return jnp.moveaxis(out, 0, dim) / n
 
 
@@ -170,7 +176,7 @@ def _log_plain(op, n_bytes):
 
 
 def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
-                                 group_size):
+                                 group_size, collective_impl="native"):
     """Reduce-mean the sharded leaves of ``flat`` (full cotangents) onto
     their data-axis shards — coalesced into flat reduce-scatter buckets
     of at most ``bucket_elements`` elements (the stage-1/2 IPG-bucket
@@ -216,9 +222,17 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
                 else jnp.concatenate(parts, axis=1)
             _log_plain("zero_bucket_reduce_scatter",
                        wide.size * wide.dtype.itemsize)
-            red = jax.lax.psum_scatter(wide, DATA_AXIS,
-                                       scatter_dimension=0,
-                                       tiled=True)
+            if collective_impl == "decomposed":
+                # chunked-ppermute delivery + index-order fold:
+                # bitwise-equal to psum_scatter (comm/ring.py contract)
+                from ...comm.ring import decomposed_reduce_scatter_sum
+                red = decomposed_reduce_scatter_sum(
+                    wide, DATA_AXIS,
+                    op_name="zero_ring_reduce_scatter")
+            else:
+                red = jax.lax.psum_scatter(wide, DATA_AXIS,
+                                           scatter_dimension=0,
+                                           tiled=True)
             red = red.reshape(-1) / n
             off = 0
             for idx, shard_shape in metas:
@@ -230,7 +244,8 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
 
 
 def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
-                              bucket_elements, matmul_plan=None):
+                              bucket_elements, matmul_plan=None,
+                              collective_impl="native"):
     """ISSUE half of the layer-granular gather: coalesce the sharded
     leaves of ``flat`` (local shards; the hpZ ``sec`` partition when
     hpz > 1) into flat all-gather payloads of at most
@@ -296,8 +311,16 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                 if log_op:
                     _log_plain(log_op,
                                payload.size * payload.dtype.itemsize)
-                wide = jax.lax.all_gather(payload, DATA_AXIS,
-                                          axis_index_groups=groups)
+                if collective_impl == "decomposed":
+                    # neighbor-ring ppermute chain: identical bytes,
+                    # identical [n_g, W] row order (comm/ring.py)
+                    from ...comm.ring import ring_all_gather
+                    wide = ring_all_gather(
+                        payload, DATA_AXIS, axis_index_groups=groups,
+                        op_name="zero_ring_all_gather")
+                else:
+                    wide = jax.lax.all_gather(payload, DATA_AXIS,
+                                              axis_index_groups=groups)
                 payloads.append(wide.reshape(-1))
                 plan.append([(it[0], int(it[1].size)) for it in sel])
         return payloads, plan
@@ -420,13 +443,15 @@ def bucketed_all_gather_finish(payloads, meta, fused=False):
 
 
 def bucketed_all_gather(flat, sec, dims, *, qw, hpz, group_size,
-                        bucket_elements, matmul_plan=None, fused=False):
+                        bucket_elements, matmul_plan=None, fused=False,
+                        collective_impl="native"):
     """One-shot layer-granular gather: start + finish back to back
     (the sequential form). Values are bitwise-identical to the
     per-leaf gathers — buckets only batch the data movement."""
     payloads, meta = bucketed_all_gather_start(
         flat, sec, dims, qw=qw, hpz=hpz, group_size=group_size,
-        bucket_elements=bucket_elements, matmul_plan=matmul_plan)
+        bucket_elements=bucket_elements, matmul_plan=matmul_plan,
+        collective_impl=collective_impl)
     return bucketed_all_gather_finish(payloads, meta, fused=fused)
 
 
@@ -457,7 +482,8 @@ def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
 
 def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
                       group_size: int = 2048,
-                      reduce_bucket_elements: int = 500_000_000):
+                      reduce_bucket_elements: int = 500_000_000,
+                      collective_impl: str = "native"):
     """Build ``gather(primary, secondary) -> full params`` with a custom
     VJP that performs the (optionally quantized) gradient reduce-scatter.
 
@@ -477,7 +503,8 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
             return jax.lax.psum(g, DATA_AXIS) / n
         if qg:
             return _quant_reduce_mean_dim(g, dim, group_size=group_size)
-        return _psum_scatter_mean_dim(g, dim)
+        return _psum_scatter_mean_dim(g, dim,
+                                      collective_impl=collective_impl)
 
     @jax.custom_vjp
     def gather(primary, secondary):
@@ -500,7 +527,8 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
         g_primary = jax.tree.unflatten(
             treedef, bucketed_reduce_scatter_mean(
                 flat, param_dims, bucket_elements=reduce_bucket_elements,
-                qg=qg, group_size=group_size))
+                qg=qg, group_size=group_size,
+                collective_impl=collective_impl))
         # secondary is a value-copy of primary; its cotangent is defined
         # to be zero (all gradient flows to the primary partition).
         return g_primary, [None] * len(param_dims)
@@ -580,7 +608,7 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
     if zcfg.zero_quantized_gradients and stage < 2:
         raise HDSConfigError("zero_quantized_gradients (qgZ) requires "
                              "zero stage >= 2 (sharded gradients)")
-    from .overlap import validate_quantized_wire
+    from .overlap import validate_overlap_config, validate_quantized_wire
     validate_quantized_wire(
         quantized_reduce_scatter=zcfg.zero_quantized_reduce_scatter,
         error_feedback=zcfg.zero_reduce_scatter_error_feedback,
@@ -589,6 +617,12 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
         fused_matmul=zcfg.zero_quantized_weights_fused_matmul,
         quantized_weights=zcfg.zero_quantized_weights,
         stage=stage)
+    # decomposed ring transport: world-size/overlap interplay is only
+    # knowable here (topology in hand) — typed rejection, no silent
+    # fallthrough to the native transport
+    validate_overlap_config(
+        collective_impl=getattr(zcfg, "zero_collective_impl", "native"),
+        world_size=data_size, overlap_comm=zcfg.overlap_comm)
 
 
 def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
@@ -629,6 +663,25 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     qw = zcfg.zero_quantized_weights
     qg = zcfg.zero_quantized_gradients
     hpz = zcfg.zero_hpz_partition_size
+    collective_impl = getattr(zcfg, "zero_collective_impl", "native")
+
+    if collective_impl == "decomposed":
+        # the ring transport rides the layered step's explicit lanes;
+        # the whole-tree fallback's gathers are AD-generated per-leaf
+        # ops with no bucket site to decompose. Reject loudly instead
+        # of silently running a half-native hybrid.
+        from .overlap import validate_overlap_config
+        validate_overlap_config(
+            collective_impl=collective_impl,
+            world_size=int(mesh.shape[DATA_AXIS]),
+            overlap_comm=zcfg.overlap_comm)
+        if layered is None:
+            from ..config import HDSConfigError
+            raise HDSConfigError(
+                "zero_collective_impl=decomposed requires the layered "
+                "ZeRO-3 step: keep zero_optimization.layered_gather="
+                "true and use a model with a layered spec "
+                "(models/layered.py)")
 
     if (zcfg.zero_quantized_reduce_scatter
             or zcfg.zero_quantized_weights_fused_matmul) \
@@ -680,7 +733,8 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
 
     gather, reduce_grads = make_param_gather(
         param_dims, grad_dims, qw=qw, qg=qg, hpz=hpz,
-        reduce_bucket_elements=zcfg.reduce_bucket_size)
+        reduce_bucket_elements=zcfg.reduce_bucket_size,
+        collective_impl=collective_impl)
 
     if layered is not None:
         return _build_layered(
@@ -751,6 +805,7 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
         "mode": "whole-tree", "depth": None,
         "bucket_elements": zcfg.reduce_bucket_size,
         "overlap_comm": zcfg.overlap_comm,
+        "collective_impl": collective_impl,
         "quantized_reduce_scatter": False,
     }
     return micro_fwd_bwd, prepare_secondary, plan_info
@@ -826,6 +881,11 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
     qrs_ef = zcfg.zero_reduce_scatter_error_feedback
     qrs_bits = zcfg.zero_quantized_reduce_scatter_bits
     fused_mm = zcfg.zero_quantized_weights_fused_matmul
+    # collective transport of the gather/reduce lanes: "native" =
+    # monolithic all-gather / psum_scatter / all-to-all; "decomposed"
+    # = chunked ppermute ring chains (comm/ring.py) — bitwise-equal,
+    # structurally overlappable by dataflow construction
+    impl = getattr(zcfg, "zero_collective_impl", "native")
     if (qrs or fused_mm) and param_shapes is None:
         from ..config import HDSConfigError
         raise HDSConfigError(
@@ -1059,7 +1119,7 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                 payloads, meta = bucketed_all_gather_start(
                     flat, sec, block_pdims, qw=qw, hpz=hpz,
                     group_size=group_size, bucket_elements=ag_bucket,
-                    matmul_plan=matmul_plan)
+                    matmul_plan=matmul_plan, collective_impl=impl)
                 gmeta.setdefault("m", meta)
                 return list(iso(tuple(payloads)))
 
@@ -1081,12 +1141,14 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                         flat_cots, block_pdims,
                         bucket_elements=bucket_elems,
                         group_size=group_size, bits=qrs_bits,
-                        residuals=res, error_feedback=qrs_ef)
+                        residuals=res, error_feedback=qrs_ef,
+                        collective_impl=impl)
                 else:
                     out = bucketed_reduce_scatter_mean(
                         flat_cots, block_pdims,
                         bucket_elements=bucket_elems,
-                        qg=qg, group_size=group_size)
+                        qg=qg, group_size=group_size,
+                        collective_impl=impl)
                     nres = []
                 out = list(iso(tuple(out)))
                 if nres:
@@ -1291,12 +1353,13 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                         jax.tree.flatten(outer_cot)[0], outer_pdims,
                         bucket_elements=bucket_elems,
                         group_size=group_size, bits=qrs_bits,
-                        residuals=res_outer, error_feedback=qrs_ef)
+                        residuals=res_outer, error_feedback=qrs_ef,
+                        collective_impl=impl)
             else:
                 outer_red = bucketed_reduce_scatter_mean(
                     jax.tree.flatten(outer_cot)[0], outer_pdims,
                     bucket_elements=bucket_elems, qg=qg,
-                    group_size=group_size)
+                    group_size=group_size, collective_impl=impl)
 
             grads = dict(jax.tree.unflatten(outer_def, outer_red))
             for i in range(n_layer):
@@ -1351,6 +1414,7 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         "mode": "layered", "depth": depth, "reason": plan.reason,
         "n_layer": n_layer, "bucket_elements": bucket_elems,
         "overlap_comm": zcfg.overlap_comm,
+        "collective_impl": impl,
         "quantized_reduce_scatter": qrs,
         "error_feedback": qrs_ef,
         "wire_bits": qrs_bits if qrs else None,

@@ -129,6 +129,62 @@ class TestWireByteAttribution:
         assert wire < equiv
 
 
+class TestDecomposedTransportAttribution:
+    """The ring transport must keep the quantized matched pairs intact
+    (quantization logs before the transport choice) AND attribute its
+    per-chunk permute sends under the ``collective_permute`` op kind —
+    ring bytes never go missing from the accounting."""
+
+    def test_qrs_decomposed_keeps_pair_and_logs_permutes(
+            self, eight_devices, comms):
+        from hcache_deepspeed_tpu.runtime.zero.qwire import (
+            QRS_OP, quantized_bucket_reduce_scatter_mean)
+        leaf = jnp.ones((8 * 256,), jnp.float32)
+
+        def reduce(a):
+            out, _ = quantized_bucket_reduce_scatter_mean(
+                [a], [0], bucket_elements=10 ** 9, group_size=2048,
+                error_feedback=False, collective_impl="decomposed")
+            return out[0]
+
+        _shmap(reduce, (P(),), P(DATA_AXIS))(leaf)
+        # the quantized matched pair survives the transport swap
+        wire, equiv = _pair(comms, QRS_OP)
+        assert equiv == leaf.size * 4
+        assert wire < equiv
+        # and the ring chunks are attributed with their kind
+        permutes = comms.permute_bytes_summary()
+        assert "zero_ring_qrs" in permutes, permutes
+        assert permutes["zero_ring_qrs"] > 0
+        assert comms.op_kinds["zero_ring_qrs"] == "collective_permute"
+        rec = comms.wire_savings_summary()[QRS_OP]
+        assert rec["op_kind"] == "collective"
+
+    def test_domino_decomposed_int8_same_totals(self, eight_devices,
+                                                comms):
+        """Transport swap must not change the quantized pair totals —
+        same rows quantized, same bytes claimed."""
+        from hcache_deepspeed_tpu.comm.quantized import \
+            quantized_allreduce_body
+        x = jnp.ones((16, 64), jnp.float32)
+
+        def ar(impl):
+            def f(x_local):
+                return quantized_allreduce_body(
+                    x_local, jnp.zeros_like(x_local), DATA_AXIS,
+                    group_size=128, collective_impl=impl)
+            return f
+
+        _shmap(ar("native"), (P(),), (P(), P()))(x)
+        native_pair = _pair(comms, "domino_half_allreduce_int8")
+        comms.reset()
+        _shmap(ar("decomposed"), (P(),), (P(), P()))(x)
+        dec_pair = _pair(comms, "domino_half_allreduce_int8")
+        assert native_pair == dec_pair
+        assert comms.permute_bytes_summary().get(
+            "domino_ring_allreduce_int8", 0) > 0
+
+
 class TestInt4Pack:
 
     def test_roundtrip(self):

@@ -73,6 +73,47 @@ ENTRY %main (p: f32[8,64]) -> (f32[64,64], f32[8,64]) {
 }
 """
 
+# A decomposed ring: a 3-step collective-permute CHAIN (each permute
+# consumes the previous chunk) plus one point-to-point delivery
+# permute, with an independent dot and a dot-bearing fusion alongside
+# — the structural-overlap shape the decomposed transport compiles to.
+RING_BODY = """
+HloModule ring
+
+%mathy (a: f32[8,8]) -> f32[8,8] {
+  %a = f32[8,8] parameter(0)
+  %dm = f32[8,8] dot(f32[8,8] %a, f32[8,8] %a), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+}
+
+ENTRY %main (p: (f32[8,16], f32[8,8])) -> (f32[8,16], f32[8,8]) {
+  %p = (f32[8,16], f32[8,8]) parameter(0)
+  %shard = f32[8,16] get-tuple-element(%p), index=0
+  %x = f32[8,8] get-tuple-element(%p), index=1
+  %cp1 = f32[8,16] collective-permute(f32[8,16] %shard), source_target_pairs={{0,1},{1,0}}
+  %cp2 = f32[8,16] collective-permute(f32[8,16] %cp1), source_target_pairs={{0,1},{1,0}}
+  %cp3 = f32[8,16] collective-permute(f32[8,16] %cp2), source_target_pairs={{0,1},{1,0}}
+  %cp4 = f32[8,16] collective-permute(f32[8,16] %shard), source_target_pairs={{0,1},{1,0}}
+  %d1 = f32[8,8] dot(f32[8,8] %x, f32[8,8] %x), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+  %f1 = f32[8,8] fusion(f32[8,8] %x), kind=kOutput, calls=%mathy
+  ROOT %out = (f32[8,16], f32[8,8]) tuple(%cp3, %d1)
+}
+"""
+
+# A sequential ring: every permute feeds the dot — zero structural
+# overlap, and a NATIVE collective-permute-start/done window for the
+# scheduled (TPU) tier.
+RING_NATIVE = """
+HloModule ringsched, is_scheduled=true
+
+ENTRY %main (p: f32[8,16]) -> (f32[8,16], f32[8,8]) {
+  %p = f32[8,16] parameter(0)
+  %cps = (f32[8,16], f32[8,16]) collective-permute-start(f32[8,16] %p), source_target_pairs={{0,1},{1,0}}
+  %d1 = f32[8,8] dot(f32[8,16] %p, f32[8,16] %p), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+  %cpd = f32[8,16] collective-permute-done((f32[8,16], f32[8,16]) %cps)
+  ROOT %out = (f32[8,16], f32[8,8]) tuple(%cpd, %d1)
+}
+"""
+
 
 class TestParser:
 
@@ -140,6 +181,76 @@ class TestDerivedPairs:
         # the reduce-scatter's only compute ops are its ancestors
         assert rep.pairs("reduce-scatter") == []
         assert rep.overlap_ratio("reduce-scatter") == 0.0
+
+
+class TestPermuteChains:
+    """The decomposed-ring evidence tier: chain detection, the
+    structural overlap ratio, and collective-permute wire pricing."""
+
+    def test_chain_detection(self):
+        rep = audit_hlo_text(RING_BODY)
+        lengths = sorted(c["length"] for c in rep.permute_chains)
+        # one 3-step chain + one point-to-point delivery send
+        assert lengths == [1, 3], rep.permute_chains
+
+    def test_structural_ratio_counts_dot_bearing_fusions(self):
+        rep = audit_hlo_text(RING_BODY)
+        # every permute is dependence-free of both the dot and the
+        # dot-bearing fusion
+        assert rep.structural_overlap_ratio() == 1.0
+        pairs = rep.pairs("collective-permute", min_interleaved=1)
+        assert len(pairs) == 4
+        assert all(p.free_fused == 1 for p in pairs)
+
+    def test_sequential_permute_scores_zero(self):
+        """A chain whose landed result every dot/fusion consumes has
+        nothing to hide behind — fully sequential ring."""
+        text = """
+HloModule seqring
+
+%mathy (a: f32[8,16]) -> f32[8,16] {
+  %a = f32[8,16] parameter(0)
+  %dm = f32[8,16] dot(f32[8,16] %a, f32[8,16] %a), lhs_contracting_dims={0}, rhs_contracting_dims={0}
+}
+
+ENTRY %main (p: f32[8,16]) -> f32[8,16] {
+  %p = f32[8,16] parameter(0)
+  %cp1 = f32[8,16] collective-permute(f32[8,16] %p), source_target_pairs={{0,1},{1,0}}
+  %cp2 = f32[8,16] collective-permute(f32[8,16] %cp1), source_target_pairs={{0,1},{1,0}}
+  %d1 = f32[16,16] dot(f32[8,16] %cp2, f32[8,16] %cp2), lhs_contracting_dims={0}, rhs_contracting_dims={0}
+  ROOT %f1 = f32[8,16] fusion(f32[8,16] %cp2), kind=kOutput, calls=%mathy
+}
+"""
+        rep = audit_hlo_text(text)
+        assert rep.structural_overlap_ratio() == 0.0
+        assert rep.pairs("collective-permute", min_interleaved=1) == []
+
+    def test_permute_wire_bytes_priced(self):
+        """Satellite gate: collective-permute result buffers must show
+        up in per-collective wire_bytes like ag/rs/ar do."""
+        rep = audit_hlo_text(RING_BODY)
+        cp = rep.wire_bytes.get("collective-permute")
+        assert cp is not None, rep.wire_bytes
+        assert cp["count"] == 4
+        assert cp["bytes"] == 4 * 8 * 16 * 4  # four f32[8,16] buffers
+
+    def test_native_permute_window(self):
+        rep = audit_hlo_text(RING_NATIVE)
+        assert len(rep.native_pairs) == 1
+        pair = rep.native_pairs[0]
+        assert pair.kind == "collective-permute"
+        assert pair.interleaved == 1      # the dot inside the window
+        # -start tuple result priced once, under the base kind
+        assert "collective-permute" in rep.wire_bytes
+
+    def test_row_carries_structural_fields(self):
+        import json
+        row = audit_hlo_text(RING_BODY).to_row()
+        json.dumps(row)
+        assert row["structural_overlap_ratio"] == 1.0
+        assert row["permute_overlap_ratio"] == 1.0
+        assert sorted(c["length"] for c in row["permute_chains"]) \
+            == [1, 3]
 
 
 class TestReport:

@@ -38,6 +38,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 # subprocess because XLA_FLAGS is parsed once per process.
 _CHILD = r"""
 import json, re
+import hcache_deepspeed_tpu.utils.compat  # jax.shard_map shim (jax 0.4.x)
 import jax, jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
@@ -151,15 +152,18 @@ class TestDominoHLOStructure:
         assert facts["n_dots"] >= 4, facts
         assert facts["parity"], facts
 
-    def test_cpu_default_combines_the_halves(self):
-        """Pin the known limitation: the CPU backend's all-reduce
-        combiner merges the two half chains at default flags (Domino
-        degenerates to the unsplit schedule there — same math, same
-        wire, no overlap). If this ever starts failing, the backend
-        stopped combining and the structural test above is the active
-        guarantee."""
+    def test_cpu_default_combiner_fact(self):
+        """Pin the backend's combiner behavior at default flags. Older
+        CPU backends merged the two half all-reduces into one (Domino
+        degenerated to the unsplit schedule — same math, same wire, no
+        overlap); jax 0.4.37's no longer does. Either way the facts
+        must stay coherent: one combined collective, OR two with the
+        independence the structural test above guarantees — and parity
+        always."""
         facts = _run_child("")
-        assert facts["n_ar"] == 1, facts
+        assert facts["n_ar"] in (1, 2), facts
+        if facts["n_ar"] == 2:
+            assert facts["independent"], facts
         assert facts["parity"], facts
 
 
@@ -205,6 +209,7 @@ class TestDominoTPUSchedule:
 # all-reduce-start..done window, how many dot ops are scheduled inside.
 _SCHED_CHILD = r"""
 import json, re
+import hcache_deepspeed_tpu.utils.compat  # jax.shard_map shim (jax 0.4.x)
 import jax, jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P

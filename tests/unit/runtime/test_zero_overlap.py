@@ -138,6 +138,156 @@ class TestDominoAsyncIssue:
         np.testing.assert_allclose(y_on, y_off, rtol=1e-5, atol=1e-5)
 
 
+class TestDecomposedRingCollectives:
+    """``zero_collective_impl=decomposed``: the layered step's gather
+    and reduce lanes ride chunked-ppermute ring chains (comm/ring.py).
+    Gates: (a) the compiled program contains permute CHAINS with
+    dependence-free block dots — structural overlap, no scheduler
+    goodwill involved; (b) the decomposed transport is BITWISE-equal to
+    native at prefetch depth 1 and 0; (c) the structural overlap ratio
+    is at least the native derived ratios for both lanes."""
+
+    @pytest.fixture(scope="class")
+    def trio(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        nat = _build(True)
+        dec1 = _build(True, zero_collective_impl="decomposed")
+        dec0 = _build(True, zero_collective_impl="decomposed",
+                      stage3_prefetch_bucket_size=0)
+        return nat, dec1, dec0
+
+    def test_plan_records_transport(self, trio):
+        nat, dec1, dec0 = trio
+        assert nat.zero_overlap_plan["collective_impl"] == "native"
+        assert dec1.zero_overlap_plan["collective_impl"] == "decomposed"
+        assert dec1.zero_overlap_plan["depth"] == 1
+        assert dec0.zero_overlap_plan["depth"] == 0
+
+    def test_structural_audit(self, trio):
+        nat, dec1, _ = trio
+        _, nrow = nat.zero_overlap_report(_batch())
+        report, row = dec1.zero_overlap_report(_batch())
+        # the decomposed program really contains permute chains
+        # (length >= 2 = a ppermute step chain, not a lone send)
+        chains = row["permute_chains"]
+        assert any(c["length"] >= 2 for c in chains), chains
+        assert row["collective_counts"].get("collective-permute", 0) \
+            >= 8, row["collective_counts"]
+        # permutes with dependence-free dots exist in the loop bodies
+        assert len(report.pairs("collective-permute",
+                                min_interleaved=1)) >= 4
+        # structural ratio >= the native derived ratio, BOTH lanes
+        assert row["structural_overlap_ratio"] \
+            >= nrow["gather_overlap_ratio"], (row, nrow)
+        assert row["structural_overlap_ratio"] \
+            >= nrow["reduce_overlap_ratio"], (row, nrow)
+        # ring wire is priced in the compiled module
+        assert row["wire_bytes"]["collective-permute"]["bytes"] > 0
+
+    def test_bitwise_parity_decomposed_vs_native(self, trio):
+        """Native depth-1, decomposed depth-1 and decomposed depth-0
+        produce identical losses AND parameters across 3 steps — the
+        transport swap never changes a bit."""
+        nat, dec1, dec0 = trio
+        batch = _batch(seed=7)
+        losses = [[float(e.train_batch(batch=batch)) for _ in range(3)]
+                  for e in (nat, dec1, dec0)]
+        assert losses[0] == losses[1] == losses[2], losses
+        leaves = [jax.tree.leaves(e.state["params"])
+                  for e in (nat, dec1, dec0)]
+        for xa, xb, xc in zip(*leaves):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xc))
+
+    def test_domino_decomposed_rings(self, eight_devices):
+        """Domino's half-batch all-reduces as decomposed RS+AG rings:
+        >= 2 overlapped pairs without native async support, values
+        matching the native psum."""
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        from hcache_deepspeed_tpu.profiling.hlo_audit import audit_compiled
+        from hcache_deepspeed_tpu.runtime.domino import domino_split_async
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("tensor",))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 16, 64)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+
+        def fn(impl):
+            def f(xx, a, b):
+                return domino_split_async(
+                    lambda h: jax.nn.gelu(h @ a) @ b,
+                    lambda t: jax.lax.psum(t, "tensor"),
+                    xx, overlap=True, collective_impl=impl,
+                    axis="tensor")
+            return f
+
+        outs = {}
+        for impl in ("native", "decomposed"):
+            compiled = jax.jit(jax.shard_map(
+                fn(impl), mesh=mesh,
+                in_specs=(P(), P(None, "tensor"), P("tensor",)),
+                out_specs=P(), check_vma=False)).lower(x, w1, w2).compile()
+            outs[impl] = (audit_compiled(compiled),
+                          np.asarray(compiled(x, w1, w2)[0]))
+        rep, y_dec = outs["decomposed"]
+        assert rep.counts().get("collective-permute", 0) >= 2
+        assert len(rep.pairs("collective-permute",
+                             min_interleaved=1)) >= 2
+        assert rep.structural_overlap_ratio() == 1.0
+        np.testing.assert_allclose(y_dec, outs["native"][1],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_domino_decomposed_requires_axis(self):
+        import jax.numpy as jnp
+
+        from hcache_deepspeed_tpu.runtime.domino import domino_split_async
+        with pytest.raises(ValueError, match="axis"):
+            domino_split_async(lambda h: h, lambda t: t,
+                               jnp.ones((4, 2)),
+                               collective_impl="decomposed")
+
+
+class TestDecomposedKnobValidation:
+    """Typed rejection: decomposed with world size 1, with
+    overlap_comm=False, with the whole-tree fallback, or with a junk
+    literal — no silent fallthrough to the native transport."""
+
+    def test_world_size_one_rejected(self):
+        with pytest.raises(HDSConfigError, match="world size"):
+            validate_overlap_config(collective_impl="decomposed",
+                                    world_size=1)
+
+    def test_overlap_comm_false_rejected_at_validate(self):
+        with pytest.raises(HDSConfigError, match="overlap_comm"):
+            validate_overlap_config(collective_impl="decomposed",
+                                    world_size=8, overlap_comm=False)
+
+    def test_overlap_comm_false_rejected_at_parse(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="overlap_comm"):
+            ZeroConfig(zero_collective_impl="decomposed",
+                       overlap_comm=False)
+
+    def test_junk_literal_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="zero_collective_impl"):
+            ZeroConfig(zero_collective_impl="rings-of-power")
+
+    def test_whole_tree_fallback_rejected(self, eight_devices):
+        with pytest.raises(HDSConfigError, match="layered"):
+            _build(True, zero_collective_impl="decomposed",
+                   layered_gather=False)
+
+    def test_native_with_world_size_one_fine(self):
+        validate_overlap_config(collective_impl="native", world_size=1,
+                                overlap_comm=False)
+
+
 class TestKnobValidation:
 
     def test_reduce_bucket_smaller_than_leaf_rejected(self, eight_devices):
