@@ -942,11 +942,60 @@ def run_fleet(out_path="FLEET_SERVE.jsonl"):
     return 0 if ok else 4
 
 
+def run_disagg(out_path="DISAGG_SERVE.jsonl"):
+    """``--disagg``: CPU-deterministic disaggregated-serving audit —
+    the N-prefill + M-decode tier coordinator with latent-wire handoff
+    vs an equal-replica colocated fleet on the shared virtual clock
+    (docs/serving.md). Gates inline: decode-tier TPOT p99 strictly
+    better than the colocated baseline, bitwise stream parity,
+    span-derived handoff/decode overlap agreeing with the counters,
+    byte-identical same-seed digests, int8-wire parity, chunked
+    prefill accounting, and tier-scoped chaos invariants. Self-
+    compares against the committed perf trajectory before writing.
+    Never touches the TPU relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_disagg_serve
+    try:
+        results = run_disagg_serve(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(f"disagg gate failed: {exc}")),
+              flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "disagg-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "disagg serving: decode-tier TPOT p99 vs "
+                  "colocated baseline (equal replicas)",
+        "value": round(summary["colocated_tpot_p99"] /
+                       max(summary["decode_tier_tpot_p99"], 1e-12),
+                       4),
+        "unit": "x better",
+        "vs_baseline": 1.0 if summary["invariants_ok"] and
+        summary["deterministic"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("deterministic", "stream_parity", "invariants_ok",
+                   "handoffs", "colocated_decodes",
+                   "handoff_overlap_ratio", "span_counter_agreement",
+                   "decode_tier_tpot_p99", "colocated_tpot_p99")},
+    }), flush=True)
+    ok = (summary["invariants_ok"] and summary["deterministic"] and
+          summary["stream_parity"] and
+          summary["span_counter_agreement"] and
+          summary["decode_tier_tpot_p99"] <
+          summary["colocated_tpot_p99"])
+    return 0 if ok else 4
+
+
 def main():
     if "--zero-overlap" in sys.argv[1:]:
         return run_zero_overlap()
     if "--fleet" in sys.argv[1:]:
         return run_fleet()
+    if "--disagg" in sys.argv[1:]:
+        return run_disagg()
     child = os.environ.get("HDS_BENCH_CHILD")
     if child or os.environ.get("HDS_BENCH_TINY") == "1":
         # child / smoke mode: measure exactly one config in-process

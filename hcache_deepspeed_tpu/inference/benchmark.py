@@ -1152,6 +1152,167 @@ def run_fleet_serve(seed=0, n_replicas=3, n_requests=48, runs=2,
     return results
 
 
+def run_disagg_serve(seed=0, n_prefill=1, n_decode=3, runs=2,
+                     out="DISAGG_SERVE.jsonl", **compare_kw):
+    """Disaggregated prefill/decode serving mode: the tier coordinator
+    (``serving/disagg.py``) vs an equal-replica colocated fleet on one
+    seeded mixed long-prompt + chatty trace, on the shared virtual
+    clock. The acceptance gates run inline and the artifact records
+    them: decode-tier TPOT p99 strictly better than the colocated
+    baseline, bitwise disagg-vs-colocated token-stream parity, a
+    span-derived handoff/decode overlap ratio (> 0, counter-agreeing),
+    and byte-identical event digests across ``runs`` same-seed runs.
+    Also emits an int8-latent-wire phase (wire-bytes attribution +
+    stream parity vs the full-width wire), a chunked-prefill phase
+    (chunk accounting on the prefill tier), and a tier-chaos phase
+    (``resilience.chaos.run_disagg_chaos`` invariants + two-run
+    determinism). Raises on any gate failure — the artifact IS the
+    acceptance evidence."""
+    from ..comm.comms_logging import get_comms_logger
+    from ..resilience import run_disagg_chaos
+    from ..serving import DisaggConfig, compare_disagg_vs_colocated
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    r = compare_disagg_vs_colocated(seed=seed, n_prefill=n_prefill,
+                                    n_decode=n_decode, runs=runs,
+                                    **compare_kw)
+    emit({"phase": "disagg-plan", "seed": seed,
+          "n_prefill": n_prefill, "n_decode": n_decode,
+          "runs": runs, "trace": r.trace_kw})
+    for tier, t in sorted(r.tier_summary.items()):
+        emit({"phase": "disagg-tier", "tier": tier, **t})
+    for row in r.requests:
+        emit({"phase": "disagg-request", **row})
+    for h in r.handoffs:
+        emit({"phase": "disagg-handoff", **h})
+
+    m = r.metrics
+    c = r.summary["counters"]
+    emit({"phase": "disagg-summary", "seed": seed,
+          "n_prefill": n_prefill, "n_decode": n_decode,
+          "runs": runs,
+          "deterministic": r.deterministic,
+          "event_digest": r.disagg_digests[0],
+          "colocated_digest": r.colocated_digest,
+          "stream_parity": r.stream_parity,
+          "invariants_ok": r.ok,
+          "violations": r.violations,
+          "handoffs": c["handoffs"],
+          "handoff_landings": c["handoff_landings"],
+          "colocated_decodes": c["colocated_decodes"],
+          "handoff_overlap_ratio":
+              r.summary["handoff_overlap_ratio"],
+          "span_handoff_ratio": round(r.span_handoff_ratio, 6),
+          "span_counter_agreement": r.span_counter_agreement,
+          "decode_tier_tpot_p95":
+              m["disagg"]["decode_tier_tpot_p95"],
+          "decode_tier_tpot_p99":
+              m["disagg"]["decode_tier_tpot_p99"],
+          "colocated_tpot_p95": m["colocated"]["tpot_p95"],
+          "colocated_tpot_p99": m["colocated"]["tpot_p99"],
+          "disagg_tpot_p99": m["disagg"]["tpot_p99"],
+          "disagg_ttft_p99": m["disagg"]["ttft_p99"],
+          "colocated_ttft_p99": m["colocated"]["ttft_p99"],
+          "handoff_transit_p99":
+              m["disagg"]["handoff_transit_p99"],
+          "metrics": m})
+
+    # int8 latent wire: same comparison with the quantized handoff
+    # payload; the streams must stay bitwise-equal to the full-width
+    # run and the wire bytes must be attributed as a matched pair
+    logger = get_comms_logger()
+    logger_was = logger.enabled
+    logger.configure(enabled=True)
+    logger.reset()
+    try:
+        r8 = compare_disagg_vs_colocated(
+            seed=seed, n_prefill=n_prefill, n_decode=n_decode,
+            runs=runs,
+            disagg=DisaggConfig(n_prefill=n_prefill,
+                                n_decode=n_decode,
+                                handoff_amortization=2.0,
+                                handoff_wire_bits=8),
+            **compare_kw)
+        wire = logger.wire_savings_summary().get("latent_handoff", {})
+    finally:
+        logger.reset()
+        logger.configure(enabled=logger_was)
+    int8_parity = all(a["tokens"] == b["tokens"]
+                      for a, b in zip(r.requests, r8.requests))
+    emit({"phase": "disagg-int8-wire", "seed": seed,
+          "invariants_ok": r8.ok, "violations": r8.violations,
+          "deterministic": r8.deterministic,
+          "stream_parity_vs_fullwidth": int8_parity,
+          "wire_bytes": wire.get("wire_bytes"),
+          "unquantized_equiv_bytes":
+              wire.get("unquantized_equiv_bytes"),
+          "wire_fraction": wire.get("fraction"),
+          "op_kind": wire.get("op_kind")})
+
+    # chunked prefill on the prefill tier (ROADMAP item 4, first
+    # slice): same comparison with scheduler-grain chunking — chunk
+    # accounting must be non-zero and every gate must still hold
+    rc = compare_disagg_vs_colocated(
+        seed=seed, n_prefill=n_prefill, n_decode=n_decode, runs=runs,
+        prefill_chunk=16, **compare_kw)
+    chunks = sum(
+        rep["counters"]["prefill_chunks"]
+        for rep in rc.summary["replicas"].values())
+    emit({"phase": "disagg-chunked-prefill", "seed": seed,
+          "prefill_chunk": 16,
+          "invariants_ok": rc.ok, "violations": rc.violations,
+          "deterministic": rc.deterministic,
+          "stream_parity": rc.stream_parity,
+          "prefill_chunks": chunks,
+          "decode_tier_tpot_p99":
+              rc.metrics["disagg"]["decode_tier_tpot_p99"],
+          "colocated_tpot_p99":
+              rc.metrics["colocated"]["tpot_p99"]})
+
+    # tier-scoped chaos: prefill + decode replica crashes mid-trace,
+    # never-dropped semantics, two-run digest determinism
+    chaos = [run_disagg_chaos(seed=seed) for _ in range(max(1, runs))]
+    cdigests = [x.event_digest for x in chaos]
+    emit({"phase": "disagg-chaos", "seed": seed,
+          "runs": len(chaos),
+          "deterministic": len(set(cdigests)) == 1,
+          "event_digest": cdigests[0],
+          "invariants_ok": all(x.ok for x in chaos),
+          "violations": sum((x.violations for x in chaos), []),
+          "crashed_tiers": chaos[0].invariants["crashed_tiers"],
+          "replica_states": chaos[0].invariants["replica_states"],
+          "counters": chaos[0].invariants["counters"]})
+
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "DISAGG_SERVE.jsonl", results))
+    if fh is not None:
+        fh.close()
+    failures = []
+    if not r.ok:
+        failures.append(f"disagg gates: {r.violations}")
+    if not r8.ok or not int8_parity:
+        failures.append(f"int8 wire: {r8.violations} "
+                        f"parity={int8_parity}")
+    if not rc.ok or not chunks:
+        failures.append(f"chunked prefill: {rc.violations} "
+                        f"chunks={chunks}")
+    if not all(x.ok for x in chaos) or len(set(cdigests)) != 1:
+        failures.append("tier chaos invariants/determinism")
+    if failures:
+        raise RuntimeError(f"disagg-serve gates failed: {failures}")
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False, lookup=False):
@@ -1362,10 +1523,27 @@ def _main_serve_loop(argv):
                         "FLEET_SERVE.jsonl artifact")
     p.add_argument("--n-replicas", type=int, default=3,
                    help="engine replicas in fleet mode")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated mode: N-prefill + M-decode "
+                        "tiers with latent-wire handoff vs an "
+                        "equal-replica colocated baseline on the "
+                        "shared virtual clock, DISAGG_SERVE.jsonl "
+                        "artifact")
+    p.add_argument("--n-prefill", type=int, default=1,
+                   help="prefill-tier replicas in disagg mode")
+    p.add_argument("--n-decode", type=int, default=3,
+                   help="decode-tier replicas in disagg mode")
     p.add_argument("--out", default="SERVE_LOOP.jsonl",
                    help="also append rows to this jsonl file "
                         "('' = stdout only)")
     args = p.parse_args(argv)
+    if args.disagg:
+        out = args.out if args.out != "SERVE_LOOP.jsonl" \
+            else "DISAGG_SERVE.jsonl"
+        run_disagg_serve(seed=args.seed, n_prefill=args.n_prefill,
+                         n_decode=args.n_decode,
+                         runs=args.chaos_runs, out=out)
+        return 0
     if args.fleet:
         out = args.out if args.out != "SERVE_LOOP.jsonl" \
             else "FLEET_SERVE.jsonl"

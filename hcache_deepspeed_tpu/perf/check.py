@@ -96,6 +96,23 @@ TOLERANCES: Dict[str, Tolerance] = {
     "fleet.span_counter_agreement": Tolerance("higher", rel=0.0),
     "fleet.migration_overlap_ratio": Tolerance("higher", rel=0.25),
     "fleet.violations": Tolerance("lower", rel=0.0),
+    # disaggregated serving gates (CPU-deterministic; booleans are
+    # hard gates, the ratios tolerate scheduler-policy evolution)
+    "disagg.deterministic": Tolerance("higher", rel=0.0),
+    "disagg.stream_parity": Tolerance("higher", rel=0.0),
+    "disagg.invariants_ok": Tolerance("higher", rel=0.0),
+    "disagg.span_counter_agreement": Tolerance("higher", rel=0.0),
+    "disagg.chaos_deterministic": Tolerance("higher", rel=0.0),
+    "disagg.chaos_invariants_ok": Tolerance("higher", rel=0.0),
+    "disagg.int8_wire_stream_parity": Tolerance("higher", rel=0.0),
+    "disagg.chunked_invariants_ok": Tolerance("higher", rel=0.0),
+    "disagg.violations": Tolerance("lower", rel=0.0),
+    #: the headline ratio must stay above 1.0 (decode tier beats the
+    #: colocated baseline); 25% slack absorbs policy evolution but a
+    #: drop under ~1.0 regresses the architecture's reason to exist
+    "disagg.decode_tpot_p99_speedup": Tolerance("higher", rel=0.25),
+    "disagg.handoff_overlap_ratio": Tolerance("higher", rel=0.25),
+    "disagg.int8_wire_fraction": Tolerance("lower", rel=0.10),
     # freshness alarm (ROADMAP item 5): informational headline — the
     # gate never fails on it (direction "lower" but compared via the
     # freshness block, not check_points)

@@ -429,6 +429,93 @@ def parse_fleet_serve(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_disagg_serve(text: str, file: str) -> List[MetricPoint]:
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        phase = row.get("phase", "")
+        if phase == "disagg-summary":
+            for key, metric in (
+                    ("deterministic", "disagg.deterministic"),
+                    ("stream_parity", "disagg.stream_parity"),
+                    ("invariants_ok", "disagg.invariants_ok"),
+                    ("span_counter_agreement",
+                     "disagg.span_counter_agreement")):
+                if key in row:
+                    pts.append(MetricPoint(metric,
+                                           1.0 if row[key] else 0.0,
+                                           file, phase=phase))
+            for key, metric in (
+                    ("handoff_overlap_ratio",
+                     "disagg.handoff_overlap_ratio"),
+                    ("handoffs", "disagg.handoffs"),
+                    ("colocated_decodes", "disagg.colocated_decodes"),
+                    ("decode_tier_tpot_p95",
+                     "disagg.decode_tier_tpot_p95"),
+                    ("decode_tier_tpot_p99",
+                     "disagg.decode_tier_tpot_p99"),
+                    ("colocated_tpot_p99",
+                     "disagg.colocated_tpot_p99")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase))
+            d99 = row.get("decode_tier_tpot_p99")
+            c99 = row.get("colocated_tpot_p99")
+            if isinstance(d99, (int, float)) and \
+                    isinstance(c99, (int, float)) and d99 > 0:
+                # the headline: how much better the decode tier's
+                # tail is than the equal-replica colocated baseline
+                # (> 1.0 = disagg wins; the bench hard-gates it)
+                pts.append(MetricPoint(
+                    "disagg.decode_tpot_p99_speedup",
+                    round(c99 / d99, 6), file, unit="x",
+                    phase=phase))
+            pts.append(MetricPoint(
+                "disagg.violations",
+                float(len(row.get("violations", []))), file,
+                phase=phase))
+        elif phase == "disagg-int8-wire":
+            if "stream_parity_vs_fullwidth" in row:
+                pts.append(MetricPoint(
+                    "disagg.int8_wire_stream_parity",
+                    1.0 if row["stream_parity_vs_fullwidth"]
+                    else 0.0, file, phase=phase))
+            if isinstance(row.get("wire_fraction"), (int, float)):
+                pts.append(MetricPoint(
+                    "disagg.int8_wire_fraction",
+                    float(row["wire_fraction"]), file, phase=phase))
+        elif phase == "disagg-chunked-prefill":
+            if isinstance(row.get("prefill_chunks"), (int, float)):
+                pts.append(MetricPoint(
+                    "disagg.prefill_chunks",
+                    float(row["prefill_chunks"]), file, phase=phase))
+            if "invariants_ok" in row:
+                pts.append(MetricPoint(
+                    "disagg.chunked_invariants_ok",
+                    1.0 if row["invariants_ok"] else 0.0, file,
+                    phase=phase))
+        elif phase == "disagg-chaos":
+            for key, metric in (
+                    ("deterministic", "disagg.chaos_deterministic"),
+                    ("invariants_ok", "disagg.chaos_invariants_ok")):
+                if key in row:
+                    pts.append(MetricPoint(metric,
+                                           1.0 if row[key] else 0.0,
+                                           file, phase=phase))
+        elif phase == "disagg-tier":
+            tags = {"tier": str(row.get("tier", ""))}
+            for key, metric in (
+                    ("preemptions", "disagg.tier_preemptions"),
+                    ("restores", "disagg.tier_restores"),
+                    ("mean_occupancy",
+                     "disagg.tier_mean_occupancy")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase,
+                                           tags=tags))
+    return pts
+
+
 def _workload_tag(file: str) -> Dict[str, str]:
     """The workload identity is the filename stem — SERVE_7B_INT8 and
     SERVE_7B measure different programs and must never be compared as
@@ -658,6 +745,12 @@ FAMILIES: List[ArtifactFamily] = [
         "fleet serving: N-replica router + latent migration under "
         "replica chaos (per-replica occupancy, migration accounting, "
         "span-derived overlap, determinism gate)"),
+    ArtifactFamily(
+        "disagg-serve", r"^DISAGG_SERVE\.jsonl$", parse_disagg_serve,
+        "disaggregated prefill/decode serving: tier coordinator vs "
+        "equal-replica colocated baseline (decode-tail win, stream "
+        "parity, span-derived handoff overlap, int8 latent wire, "
+        "chunked prefill, tier chaos, determinism gates)"),
     ArtifactFamily(
         "restore-bench",
         r"^RESTORE_[A-Z0-9_]+\.jsonl$", parse_restore_bench,

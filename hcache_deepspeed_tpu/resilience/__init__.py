@@ -24,7 +24,9 @@ from .policy import ResiliencePolicy  # noqa: F401
 from .retry import (BreakerState, CircuitBreaker,  # noqa: F401
                     RetryPolicy, Watchdog, call_with_retry)
 
-from .chaos import (ChaosResult, FleetChaosResult,  # noqa: F401
+from .chaos import (ChaosResult, DisaggChaosResult,  # noqa: F401
+                    FleetChaosResult,
                     build_chaos_trace, default_fault_plan,
+                    default_disagg_fault_plan,
                     default_fleet_fault_plan, run_chaos,
-                    run_fleet_chaos)
+                    run_disagg_chaos, run_fleet_chaos)

@@ -315,6 +315,207 @@ def run_fleet_chaos(seed: int = 0, n_replicas: int = 3,
     return result
 
 
+def default_disagg_fault_plan(seed: int = 0) -> FaultPlan:
+    """Tier-scoped failure domains for the disaggregated fleet.
+
+    ``replica.crash`` fires once per live replica per fleet step in
+    replica order, so for a 2-prefill + 2-decode fleet the ``at_hits``
+    below deterministically kill one PREFILL replica mid-storm (hit
+    141 ≡ replica 0 while 4 are alive, landing in the trace window
+    where it holds queued AND mid-prompt chunked work — the requeue
+    path, not an empty-replica death) and later one DECODE replica
+    (hit 200 lands on replica 2 among the 3 survivors) — the two
+    tier failure modes the disagg invariants gate: mid-prompt work
+    requeues to the surviving prefill replica, decode state re-ships
+    its surviving latents (or recomputes) onto the rest of the decode
+    tier. A thinned engine/restore storm rides along."""
+    return FaultPlan(seed=seed, rules=[
+        FaultRule("replica.crash", at_hits=(141, 200), max_faults=2),
+        FaultRule("engine.decode", probability=0.008, max_faults=2),
+        FaultRule("restore.ship", probability=0.015, max_faults=4),
+    ])
+
+
+@dataclass
+class DisaggChaosResult:
+    seed: int
+    n_prefill: int
+    n_decode: int
+    plan: Dict
+    requests: List[Dict]
+    event_digest: str
+    fleet_summary: Dict
+    tier_summary: Dict
+    handoffs: List[Dict]
+    invariants: Dict
+    ok: bool = False
+    violations: List[str] = field(default_factory=list)
+
+
+def run_disagg_chaos(seed: int = 0, n_prefill: int = 2,
+                     n_decode: int = 2, n_requests: int = 48,
+                     fault_plan: Optional[FaultPlan] = None,
+                     policy: Optional[ResiliencePolicy] = None,
+                     num_blocks: int = 14, block_size: int = 8,
+                     max_lanes: int = 4, max_tracked: int = 10,
+                     max_context: int = 64, max_new: int = 10,
+                     rps: float = 400.0,
+                     prefill_chunk: int = 8) -> DisaggChaosResult:
+    """One deterministic disaggregated-fleet chaos run: the seeded
+    trace from :func:`build_chaos_trace` over an N-prefill + M-decode
+    :class:`~..serving.DisaggregatedFleet` with chunked prefill on
+    (so mid-prompt crash windows exist) and tier-scoped replica
+    faults. Invariants are the fleet set plus the tier contract:
+
+    1. every base fleet-chaos invariant (exactly-one-terminal-state
+       across the fleet, zero leaks on survivors, migration
+       accounting balance, per-replica restore accounting);
+    2. every handoff reached a terminal migration mode — the tier
+       link never strands a request;
+    3. post-trace, no live PREFILL replica holds decode state (the
+       disaggregation contract survived the storm);
+    4. determinism — the event digest is a pure function of the seed
+       (callers run twice and compare).
+    """
+    from ..inference.config import RaggedInferenceEngineConfig
+    from ..serving import (DisaggConfig, DisaggregatedFleet,
+                           FleetConfig, ReplicaRole, ReplicaState,
+                           RequestState, RouterConfig, ServerConfig,
+                           SimulatedEngine, VirtualClock)
+
+    plan = fault_plan if fault_plan is not None \
+        else default_disagg_fault_plan(seed)
+    policy = policy or ResiliencePolicy(seed=seed)
+
+    def make_engine():
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": max_tracked,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": max_lanes,
+                           "max_context": max_context,
+                           "prefill_chunk": prefill_chunk},
+            kv_cache={"block_size": block_size,
+                      "num_blocks": num_blocks},
+            hcache={"enable_latents": True}))
+
+    n = n_prefill + n_decode
+    fleet = DisaggregatedFleet(
+        engines=[make_engine() for _ in range(n)],
+        clock=VirtualClock(),
+        config=FleetConfig(
+            n_replicas=n,
+            server=ServerConfig(max_queue_depth=n_requests + 1,
+                                kv_demand_fraction=float("inf"),
+                                prefill_chunk=prefill_chunk,
+                                preempt_restore_grace=1),
+            router=RouterConfig()),
+        disagg=DisaggConfig(n_prefill=n_prefill, n_decode=n_decode),
+        resilience=policy)
+    reqs = build_chaos_trace(seed, n_requests,
+                             fleet.replicas[0].engine.vocab_size,
+                             max_new=max_new, rps=rps,
+                             prompt_hi=min(24,
+                                           max_context - max_new - 1))
+    with injected(plan) as inj:
+        fleet.run_trace(reqs)
+        fault_fired = dict(inj.fired)
+
+    violations: List[str] = []
+    terminal = {"DONE", "REJECTED", "FAILED"}
+    for r in reqs:
+        if r.state.name not in terminal:
+            violations.append(
+                f"request {r.uid} ended non-terminal: {r.state.name}")
+        holders = sum(1 for rep in fleet.replicas
+                      if r.uid in rep.scheduler.done)
+        holders += 1 if r.uid in fleet.done else 0
+        if holders != 1:
+            violations.append(
+                f"request {r.uid} terminal in {holders} places")
+    for rep in fleet.replicas:
+        if rep.state is ReplicaState.DEAD:
+            continue
+        if rep.engine.state.free_blocks != rep.initial_free_blocks:
+            violations.append(
+                f"replica {rep.id}: block leak "
+                f"({rep.initial_free_blocks} -> "
+                f"{rep.engine.state.free_blocks})")
+        if rep.engine.state.n_tracked_sequences != 0:
+            violations.append(
+                f"replica {rep.id}: sequences still tracked")
+        rs = rep.engine.restore_stats
+        if rs["restores"] != rep.scheduler.total_restores:
+            violations.append(
+                f"replica {rep.id}: restore accounting mismatch")
+    if fleet.in_transit:
+        violations.append(
+            f"{len(fleet.in_transit)} migrations still in transit")
+    if not fleet.migration_balance_ok:
+        violations.append(
+            f"migration imbalance: {dict(fleet.counters)}")
+    # tier contract: every handoff terminal; no decode state stranded
+    # on a live prefill replica
+    handoffs = [m for m in fleet.migrations if m.reason == "handoff"]
+    for m in handoffs:
+        if not m.mode:
+            violations.append(f"handoff {m.uid} never terminal")
+    for rep in fleet.replicas:
+        if rep.role is not ReplicaRole.PREFILL or \
+                rep.state is ReplicaState.DEAD:
+            continue
+        s = rep.scheduler
+        stranded = [u for u, q in list(s.running.items()) +
+                    list(s.suspended.items())
+                    if q.state in (RequestState.DECODE,
+                                   RequestState.SUSPENDED)]
+        if stranded:
+            violations.append(
+                f"prefill replica {rep.id} still holds decode "
+                f"state: {stranded}")
+
+    digest = _digest(fleet.event_log())
+    crashed_tiers = sorted({rep.role.name for rep in fleet.replicas
+                            if rep.state is ReplicaState.DEAD})
+    result = DisaggChaosResult(
+        seed=seed, n_prefill=n_prefill, n_decode=n_decode,
+        plan=plan.to_dict(),
+        requests=[{
+            "uid": r.uid, "state": r.state.name, "error": r.error,
+            "reject_reason": r.reject_reason,
+            "priority": r.priority, "deadline": r.deadline,
+            "tokens": len(r.tokens_out), "replica": r.replica,
+            "handoffs": r.n_handoffs,
+            "colocated_fallback": r.colocated_fallback,
+            "preemptions": r.n_preemptions,
+            "restores": r.n_restores,
+            "recomputes": r.n_recomputes,
+            "migrations": r.n_migrations,
+        } for r in reqs],
+        event_digest=digest,
+        fleet_summary=fleet.summary(),
+        tier_summary=fleet.tier_summary(),
+        handoffs=[m.to_row() for m in handoffs],
+        invariants={
+            "terminal_states": sorted({r.state.name for r in reqs}),
+            "replica_states": {str(rep.id): rep.state.name
+                               for rep in fleet.replicas},
+            "replica_roles": {str(rep.id): rep.role.name
+                              for rep in fleet.replicas},
+            "crashed_tiers": crashed_tiers,
+            "fault_fired": fault_fired,
+            "counters": dict(fleet.counters),
+            "migration_balance_ok": fleet.migration_balance_ok,
+            "handoff_overlap_ratio":
+                round(fleet.handoff_overlap_ratio, 6),
+            "prefill_chunks": sum(
+                rep.server.metrics.counters["prefill_chunks"]
+                for rep in fleet.replicas),
+        },
+        violations=violations,
+        ok=not violations)
+    return result
+
+
 def run_chaos(seed: int = 0, n_requests: int = 32,
               fault_plan: Optional[FaultPlan] = None,
               policy: Optional[ResiliencePolicy] = None,

@@ -22,8 +22,11 @@ crash/hang/partition, graceful drain, crash recovery).
 from .clock import MonotonicClock, VirtualClock  # noqa: F401
 from .crossover import (CrossoverConfig,  # noqa: F401
                         RestoreCrossoverModel)
+from .disagg import (DisaggConfig, DisaggregatedFleet,  # noqa: F401
+                     build_mixed_trace, compare_disagg_vs_colocated)
 from .fleet import (FleetConfig, FleetReplica,  # noqa: F401
-                    Migration, ReplicaState, ServingFleet)
+                    Migration, ReplicaRole, ReplicaState,
+                    ServingFleet)
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .router import (FleetRouter, ReplicaSnapshot,  # noqa: F401

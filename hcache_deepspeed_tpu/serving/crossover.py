@@ -228,6 +228,17 @@ class RestoreCrossoverModel:
                 / link_bytes_per_s
         return xfer + self.restore_cost_s(tokens, dst_occupancy)
 
+    def handoff_cost_s(self, tokens: int, dst_occupancy: float,
+                       tier_link_bytes_per_s: float) -> float:
+        """Price a prefill→decode tier handoff: the same transfer +
+        destination-restore form as :meth:`migrate_cost_s`, but over
+        the **tier link** — the dedicated prefill→decode interconnect
+        a disaggregated deployment provisions, priced separately from
+        the general inter-replica rebalance link so the two transports
+        stay individually attributable."""
+        return self.migrate_cost_s(tokens, dst_occupancy,
+                                   tier_link_bytes_per_s)
+
     def decide_migration(self, tokens: int, src_occupancy: float,
                          dst_occupancy: float,
                          link_bytes_per_s: float) -> str:

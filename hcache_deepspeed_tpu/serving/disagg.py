@@ -1,0 +1,672 @@
+"""Disaggregated prefill/decode serving with latent-wire handoff.
+
+Prefill/decode interference is the dominant tail-latency cost in
+continuous-batching serving: a long prompt admitted into a replica's
+ragged batch competes with its resident decodes for the KV pool and
+the per-forward token budget — and under pressure it *preempts* them,
+which is exactly the p99-TPOT spike interactive traffic cannot absorb.
+DistServe/Mooncake-style deployments split the loop into a prefill
+tier and a decode tier so the interference cannot happen; the part
+those systems build bespoke is the transport that moves a finished
+prompt's KV between tiers.
+
+This repo already has that transport: **HCache latents**. A prompt
+prefilled with latent capture holds a host-side ``[L, T, H]`` payload
+that is ~half the KV bytes (halved again under fp8 capture, and again
+under the opt-in int8 wire below), and the decode side rebuilds the KV
+with the existing QKV-only ``RestorePipeline`` — overlapped with the
+destination's resident decode by construction (PR 3's lanes). So the
+tier handoff here is the fleet migration machinery (PR 8) pointed at a
+role split:
+
+* :class:`~.fleet.ReplicaRole.PREFILL` replicas take new requests,
+  run their (optionally chunked) prefill with latent capture, sample
+  the first token — and **never hold decode state**: the tier pass
+  detaches each finished prompt before its first decode step and
+  ships (latents + first token) over the priced tier link into a
+  decode replica chosen by the KV-pressure/backlog router.
+* :class:`~.fleet.ReplicaRole.DECODE` replicas never see a new
+  request; handoffs land ``SUSPENDED`` and re-enter through the
+  normal restore lanes (or the crossover recompute re-prefill when
+  the payload is lost) — arrivals therefore *wait for blocks* instead
+  of preempting residents, which is the decode-tail win.
+* **Colocation fallback**: when every routable decode replica is
+  saturated (KV utilization or backlog over the configured bars), the
+  prefill replica keeps the request and decodes it locally — the
+  fleet stays live under skewed traces instead of queueing the world
+  behind a full tier.
+
+Failure domains are tier-scoped but ride the fleet's existing
+machinery: a prefill-replica crash mid-prompt requeues the prompt to
+a surviving prefill replica (chunked prefills rewind to ``QUEUED``);
+a decode-replica crash re-ships surviving latents — or recomputes —
+onto the rest of the decode tier; a whole tier dying degrades into
+the other tier rather than dropping work (never-dropped semantics,
+gated by :func:`~..resilience.chaos.run_disagg_chaos`).
+
+Everything is deterministic on the shared virtual clock: the
+``compare_disagg_vs_colocated`` harness below replays one mixed
+long-prompt + chatty trace through a disaggregated fleet and an
+equal-replica colocated fleet, gates bitwise token-stream parity,
+byte-identical same-seed digests, the span-derived handoff/decode
+overlap, and the decode-tier TPOT p99 win — the committed
+``DISAGG_SERVE.jsonl`` evidence.
+"""
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..comm.comms_logging import get_comms_logger
+from ..inference.ragged.latents import HostLatentStore
+from ..telemetry.tracer import get_tracer
+from .clock import VirtualClock
+from .fleet import (_DECODE_ROLES, _INTAKE_ROLES, FleetConfig,
+                    Migration, ReplicaRole, ReplicaState, ServingFleet)
+from .request import Request, RequestState
+from .router import ReplicaSnapshot
+
+#: comms-logger op name of the cross-tier latent wire (matched-pair
+#: attribution: quantized handoffs report wire + unquantized-equiv
+#: bytes under this name, full-width handoffs report wire only)
+HANDOFF_OP = "latent_handoff"
+
+#: replica states a tier can still come back from — when every
+#: replica of a tier is in neither of these, the tier is gone for
+#: good and the other tier absorbs its role (never-dropped semantics)
+_DEAD_STATES = (ReplicaState.DEAD, ReplicaState.STOPPED)
+
+
+@dataclass
+class DisaggConfig:
+    """Knobs for :class:`DisaggregatedFleet` (docs/serving.md)."""
+    #: tier sizes; replicas [0, n_prefill) are PREFILL, the rest DECODE
+    n_prefill: int = 1
+    n_decode: int = 2
+    #: the prefill→decode tier link (bytes/s): a *distinct* bandwidth
+    #: term from the general inter-replica rebalance link — disagg
+    #: deployments provision this interconnect separately, and the
+    #: crossover prices it separately (``handoff_cost_s``)
+    tier_link_bytes_per_s: float = 512e6
+    #: fixed per-handoff overhead (connection + lane setup)
+    handoff_overhead_s: float = 1e-3
+    #: colocation fallback: the decode tier is saturated when EVERY
+    #: routable decode replica is at/over either bar
+    saturation_kv_utilization: float = 0.8
+    saturation_backlog: int = 4
+    #: payload-amortization bar: ship a request to the decode tier
+    #: only when ``cached_tokens <= handoff_amortization *
+    #: remaining_tokens`` — the crossover-pricing philosophy applied
+    #: at the tier boundary (a huge prefix with a short remaining
+    #: decode cannot amortize its transfer + destination restore; it
+    #: decodes where its KV already lives). 0 = always hand off
+    #: (pure DistServe semantics). Refusals count as
+    #: ``colocated_decodes`` with a ``payload`` detail.
+    handoff_amortization: float = 0.0
+    #: opt-in int8 latent wire: 0 = ship the captured dtype full-width,
+    #: 8 = group-scaled int8 (PR 6 quantizer) — wire bytes attributed
+    #: via ``comms_logging.log_quantized(op_kind="latent_handoff")``
+    handoff_wire_bits: int = 0
+    #: quantization group size along the flattened payload
+    handoff_quant_group: int = 64
+
+    def __post_init__(self):
+        if self.n_prefill < 1 or self.n_decode < 1:
+            raise ValueError(
+                f"need >=1 replica per tier, got n_prefill="
+                f"{self.n_prefill} n_decode={self.n_decode}")
+        if self.handoff_wire_bits not in (0, 8):
+            raise ValueError(
+                f"handoff_wire_bits must be 0 (full width) or 8 "
+                f"(int8), got {self.handoff_wire_bits}")
+
+
+class DisaggregatedFleet(ServingFleet):
+    """N-prefill + M-decode tier coordinator over the serving fleet.
+
+    The base fleet provides the clock sharing, migration transits,
+    failure domains and chaos invariants; this subclass adds the role
+    split and the tier pass that keeps decode state off the prefill
+    tier. Handoffs are ordinary :class:`~.fleet.Migration` objects
+    with ``reason="handoff"`` — they inherit the migration accounting
+    balance, the never-dropped landing semantics and the deadline/
+    cancel transit rules for free.
+    """
+
+    def __init__(self, engines=None, config: FleetConfig = None,
+                 disagg: DisaggConfig = None, **kw):
+        self.disagg = disagg or DisaggConfig()
+        d = self.disagg
+        if engines is not None:
+            engines = list(engines)
+            if len(engines) != d.n_prefill + d.n_decode:
+                raise ValueError(
+                    f"{len(engines)} engines for n_prefill="
+                    f"{d.n_prefill} + n_decode={d.n_decode}")
+        roles = [ReplicaRole.PREFILL] * d.n_prefill + \
+            [ReplicaRole.DECODE] * d.n_decode
+        if config is None:
+            config = FleetConfig(n_replicas=len(roles))
+        config.n_replicas = len(roles)
+        super().__init__(engines=engines, config=config, roles=roles,
+                         **kw)
+        #: uids pinned to their prefill replica by the colocation
+        #: fallback. Sticky on purpose: a fallen-back request has
+        #: decode state and momentum where it is — re-shipping it
+        #: mid-stream the moment the decode tier dips below the bar
+        #: would charge it the handoff+restore tax twice for no
+        #: benefit (placement stability beats point-in-time balance)
+        self._colocated: set = set()
+
+    # ------------------------------------------------------------- #
+    # tier-aware routing hooks
+    # ------------------------------------------------------------- #
+    def _tier_dead(self, roles) -> bool:
+        return all(r.state in _DEAD_STATES
+                   for r in self.replicas if r.role in roles)
+
+    def _intake_roles(self):
+        return _INTAKE_ROLES
+
+    def _intake_snapshots(self, routable) -> List[ReplicaSnapshot]:
+        snaps = self._snapshots(routable, roles=_INTAKE_ROLES)
+        if not snaps and self._tier_dead((ReplicaRole.PREFILL,
+                                          ReplicaRole.COLOCATED)):
+            # the whole prefill tier is gone for good: degrade into
+            # the decode tier (a decode replica is a full engine)
+            # rather than parking the queue forever
+            return self._snapshots(routable, roles=_DECODE_ROLES)
+        return snaps
+
+    def _landing_snapshots(self, migration: Migration,
+                           routable) -> List[ReplicaSnapshot]:
+        snaps = self._snapshots(routable, roles=_DECODE_ROLES)
+        if not snaps and self._tier_dead(_DECODE_ROLES):
+            # decode tier gone for good: land on whatever survives
+            return self._snapshots(routable)
+        return snaps
+
+    def _rebalance_pass(self, routable) -> None:
+        # pressure rebalance stays INSIDE the decode tier: moving a
+        # suspended decode payload onto a prefill replica would undo
+        # the disaggregation the fleet exists to provide
+        plans = self.router.plan_migrations(
+            self._snapshots(routable, with_migratable=True,
+                            roles=_DECODE_ROLES))
+        for uid, src, dst in plans:
+            r = self.replicas[src]
+            with self._locked(r):
+                req = r.scheduler.detach_for_migration(uid)
+            if req is None:
+                continue
+            self._begin_migration(req, src, dst, "rebalance")
+
+    # ------------------------------------------------------------- #
+    # the tier pass: finished prompts leave the prefill tier
+    # ------------------------------------------------------------- #
+    def _decode_saturated(self, snaps) -> bool:
+        if not snaps:
+            return True
+        d = self.disagg
+        return all(s.kv_utilization >= d.saturation_kv_utilization or
+                   (s.queue_depth + s.suspended) >=
+                   d.saturation_backlog
+                   for s in snaps)
+
+    def _handoff_wire_bytes(self, req: Request) -> int:
+        """Wire bytes for ``req``'s latent payload; in int8 mode the
+        payload is replaced by its dequantized round-trip (the wire's
+        effect on what the decode side replays) and the matched
+        wire/unquantized-equiv byte pair is attributed to the comms
+        logger under ``op_kind="latent_handoff"``."""
+        if req.latents is None or req.latents.shape[1] == 0:
+            return 0
+        full = np.asarray(req.latents)
+        equiv = int(full.nbytes)
+        if self.disagg.handoff_wire_bits != 8:
+            get_comms_logger().log_collective(
+                HANDOFF_OP, equiv, op_kind="latent_handoff")
+            return equiv
+        from ..ops.quantizer import (reference_dequantize,
+                                     reference_quantize)
+        q, scale, shape, n = reference_quantize(
+            full.astype(np.float32),
+            group_size=self.disagg.handoff_quant_group, num_bits=8)
+        q, scale = np.asarray(q), np.asarray(scale)
+        wire = int(q.nbytes + scale.nbytes)
+        deq = np.asarray(reference_dequantize(q, scale, shape, n),
+                         dtype=full.dtype)
+        req.latents = HostLatentStore(deq)
+        get_comms_logger().log_quantized(
+            HANDOFF_OP, wire, equiv, op_kind="latent_handoff")
+        return wire
+
+    def _tier_pass(self, now: float, routable) -> None:
+        """Detach every finished-prefill request from the prefill
+        tier and put it on the tier link — BEFORE the replicas step,
+        so a handed-off request never dispatches a decode token on
+        its prefill replica. Runs in deterministic (replica, uid)
+        order. When the decode tier is saturated the colocation
+        fallback pins the request to its prefill replica (sticky) —
+        the fleet keeps serving under skew instead of queueing the
+        world behind a full tier, and the pin avoids paying the
+        handoff tax mid-stream on a transient dip. The restore-grace
+        guard (``ServerConfig.preempt_restore_grace``) keeps a
+        fallback-heavy prefill replica free of restore→preempt
+        livelock under its own admission pressure."""
+        d = self.disagg
+        for r in self.replicas:
+            if r.role is not ReplicaRole.PREFILL or \
+                    r.state is not ReplicaState.UP or \
+                    r.id not in routable:
+                continue
+            s = r.scheduler
+            # decode state on a prefill replica = running requests
+            # whose prefill completed, plus suspended decode payloads
+            # (preempted mid-admission churn); mid-chunk PREFILL
+            # residents stay — they have nothing decodable yet
+            cands = sorted(
+                [u for u, q in s.running.items()
+                 if q.state is RequestState.DECODE
+                 and not q.cancelled] +
+                [u for u, q in s.suspended.items()
+                 if not q.cancelled])
+            if not cands:
+                continue
+            snaps = self._snapshots(routable, roles=_DECODE_ROLES)
+            saturated = self._decode_saturated(snaps)
+            for uid in cands:
+                if uid in self._colocated:
+                    continue
+                req = s.request(uid)
+                amort = d.handoff_amortization
+                if amort > 0 and req.cached_tokens > \
+                        amort * max(req.remaining_tokens, 1):
+                    # the payload cannot amortize its transfer +
+                    # restore over what is left to decode: keep it
+                    # where its KV already lives (crossover pricing
+                    # applied at the tier boundary)
+                    self._colocated.add(uid)
+                    req.colocated_fallback = True
+                    self.counters["colocated_decodes"] += 1
+                    self._event("colocate", uid,
+                                f"replica={r.id} payload "
+                                f"cached={req.cached_tokens} "
+                                f"remaining={req.remaining_tokens}")
+                    continue
+                if saturated:
+                    self._colocated.add(uid)
+                    req.colocated_fallback = True
+                    self.counters["colocated_decodes"] += 1
+                    self._event("colocate", uid,
+                                f"replica={r.id} decode_saturated")
+                    continue
+                dst = self.router.route_handoff(req, snaps)
+                with self._locked(r):
+                    req = s.detach_for_migration(uid)
+                if req is None or req.state is RequestState.QUEUED:
+                    # nothing decodable left (raced a rewind): requeue
+                    if req is not None:
+                        req.replica = None
+                        self.counters["requeued"] += 1
+                        self.pending.append(req)
+                    continue
+                nbytes = self._handoff_wire_bytes(req)
+                self.counters["handoffs"] += 1
+                self._begin_migration(
+                    req, r.id, dst if dst is not None else -1,
+                    "handoff", nbytes=nbytes,
+                    link_bytes_per_s=d.tier_link_bytes_per_s,
+                    overhead_s=d.handoff_overhead_s)
+
+    # ------------------------------------------------------------- #
+    # observability
+    # ------------------------------------------------------------- #
+    def tier_summary(self) -> Dict:
+        """Per-tier rollup of the per-replica summaries."""
+        out: Dict[str, Dict] = {}
+        base = self.summary()
+        for r in self.replicas:
+            tier = r.role.name.lower()
+            t = out.setdefault(tier, {
+                "replicas": [], "done": 0, "preemptions": 0,
+                "restores": 0, "recompute_reentries": 0,
+                "mean_occupancy": 0.0, "kv_util_peak": 0.0})
+            rep = base["replicas"][str(r.id)]
+            t["replicas"].append(r.id)
+            t["done"] += rep["done"]
+            t["preemptions"] += rep["counters"]["preemptions"]
+            t["restores"] += rep["counters"]["restores"]
+            t["recompute_reentries"] += \
+                rep["counters"]["recompute_reentries"]
+            t["mean_occupancy"] += rep["mean_occupancy"]
+            t["kv_util_peak"] = max(t["kv_util_peak"],
+                                    rep["kv_util_peak"])
+        for t in out.values():
+            t["mean_occupancy"] = round(
+                t["mean_occupancy"] / max(len(t["replicas"]), 1), 6)
+        return out
+
+
+# ----------------------------------------------------------------- #
+# the canonical deterministic comparison (bench + golden test share it)
+# ----------------------------------------------------------------- #
+def build_mixed_trace(seed: int, n_requests: int = 72, vocab: int = 64,
+                      rps: float = 150.0, long_every: int = 3,
+                      long_prompt: Tuple[int, int] = (40, 56),
+                      long_max_new: int = 16,
+                      chat_prompt: Tuple[int, int] = (6, 10),
+                      chat_max_new: int = 20) -> List[Request]:
+    """The interference workload: a chatty short-turn majority decoding
+    steadily, punctured by long high-priority prompts — the mix where
+    colocated serving preempts resident decodes (p99 TPOT spikes) and
+    a disaggregated fleet does not. Pure function of ``seed``."""
+    rng = np.random.default_rng([seed, 0xD15A])
+    arrive = np.cumsum(rng.exponential(1.0 / rps, n_requests))
+    reqs = []
+    for i in range(n_requests):
+        long = (i % long_every) == long_every - 1
+        lo, hi = long_prompt if long else chat_prompt
+        plen = int(rng.integers(lo, hi + 1))
+        prompt = [int(t) for t in rng.integers(0, vocab, (plen,))]
+        reqs.append(Request(
+            uid=i, prompt=prompt,
+            max_new_tokens=long_max_new if long else chat_max_new,
+            arrival_time=float(arrive[i]),
+            priority=2 if long else 0))
+    return reqs
+
+
+def _digest(event_log) -> str:
+    payload = json.dumps(event_log, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+def _pct(values, q) -> Optional[float]:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return None
+    return round(float(np.percentile(np.asarray(vals), q)), 6)
+
+
+@dataclass
+class DisaggCompareResult:
+    """One disagg-vs-colocated comparison on a shared trace/seed."""
+    seed: int
+    n_prefill: int
+    n_decode: int
+    trace_kw: Dict
+    #: per-uid token streams (both runs) — the parity evidence
+    stream_parity: bool = False
+    disagg_digests: List[str] = field(default_factory=list)
+    colocated_digest: str = ""
+    deterministic: bool = False
+    summary: Dict = field(default_factory=dict)
+    tier_summary: Dict = field(default_factory=dict)
+    colocated_summary: Dict = field(default_factory=dict)
+    #: span-derived handoff/decode overlap (must equal the counters)
+    span_handoff_ratio: float = 0.0
+    span_counter_agreement: bool = False
+    requests: List[Dict] = field(default_factory=list)
+    handoffs: List[Dict] = field(default_factory=list)
+    metrics: Dict = field(default_factory=dict)
+    violations: List[str] = field(default_factory=list)
+    ok: bool = False
+
+
+def _default_engine_kw() -> Dict:
+    return dict(num_blocks=14, block_size=8, max_lanes=4,
+                max_tracked=10, max_context=112)
+
+
+def _make_engine(num_blocks, block_size, max_lanes, max_tracked,
+                 max_context, prefill_chunk=0):
+    from ..inference.config import RaggedInferenceEngineConfig
+    from .sim import SimulatedEngine
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": max_tracked,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": max_lanes,
+                       "max_context": max_context,
+                       "prefill_chunk": prefill_chunk},
+        kv_cache={"block_size": block_size, "num_blocks": num_blocks},
+        hcache={"enable_latents": True}))
+
+
+def _run_fleet_once(reqs: List[Request], *, disagg=None,
+                    n_replicas=None, engine_kw=None,
+                    prefill_chunk: int = 0,
+                    restore_chunks_per_step: int = 2):
+    """One traced virtual-clock run (disagg when ``disagg`` given,
+    colocated otherwise). Returns (fleet, span_events)."""
+    from .server import ServerConfig
+
+    engine_kw = dict(engine_kw or _default_engine_kw())
+    engine_kw["prefill_chunk"] = prefill_chunk
+    server = ServerConfig(max_queue_depth=len(reqs) + 1,
+                          kv_demand_fraction=float("inf"),
+                          prefill_chunk=prefill_chunk,
+                          restore_chunks_per_step=
+                          restore_chunks_per_step,
+                          # both modes get the livelock guard and the
+                          # head-of-line restore barrier — the
+                          # comparison measures the architecture, not
+                          # a victim/restore-policy asymmetry
+                          preempt_restore_grace=1,
+                          restore_priority_barrier=True)
+    n = (disagg.n_prefill + disagg.n_decode) if disagg is not None \
+        else n_replicas
+    engines = [_make_engine(**engine_kw) for _ in range(n)]
+    cfg = FleetConfig(n_replicas=n, server=server)
+    if disagg is not None:
+        fleet = DisaggregatedFleet(engines=engines, config=cfg,
+                                   disagg=disagg,
+                                   clock=VirtualClock())
+    else:
+        fleet = ServingFleet(engines=engines, config=cfg,
+                             clock=VirtualClock())
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    try:
+        fleet.run_trace(reqs)
+        events = tracer.events()
+    finally:
+        tracer.configure(enabled=was)
+    return fleet, events
+
+
+def compare_disagg_vs_colocated(seed: int = 0, n_prefill: int = 1,
+                                n_decode: int = 3, runs: int = 2,
+                                disagg: DisaggConfig = None,
+                                engine_kw: Dict = None,
+                                prefill_chunk: int = 0,
+                                restore_chunks_per_step: int = 2,
+                                trace_kw: Dict = None
+                                ) -> DisaggCompareResult:
+    """The committed-evidence harness: replay one seeded mixed trace
+    through a disaggregated fleet (``runs`` times, for the digest
+    determinism gate) and an equal-replica colocated fleet, and gate
+
+    * bitwise token-stream parity (disagg == colocated, per uid);
+    * byte-identical disagg event digests across same-seed runs;
+    * span-derived handoff/decode overlap ratio == counter ratio;
+    * decode-tier TPOT p99 strictly better than the colocated fleet's;
+    * migration/handoff accounting balance + zero leaks + all-DONE.
+
+    Deterministic on the virtual clock: same args ⇒ same result.
+    """
+    trace_kw = dict(trace_kw or {})
+    dcfg = disagg or DisaggConfig(n_prefill=n_prefill,
+                                  n_decode=n_decode,
+                                  handoff_amortization=2.0)
+    n_total = dcfg.n_prefill + dcfg.n_decode
+
+    # colocated baseline at equal replica count, same trace
+    base_reqs = build_mixed_trace(seed, **trace_kw)
+    base_fleet, _ = _run_fleet_once(
+        base_reqs, n_replicas=n_total, engine_kw=engine_kw,
+        prefill_chunk=prefill_chunk,
+        restore_chunks_per_step=restore_chunks_per_step)
+
+    # disagg runs (first one keeps its spans for the overlap claim)
+    disagg_fleets, digests, span_events = [], [], None
+    for _ in range(max(1, runs)):
+        reqs = build_mixed_trace(seed, **trace_kw)
+        fleet, events = _run_fleet_once(
+            reqs, disagg=DisaggConfig(**vars(dcfg)),
+            engine_kw=engine_kw, prefill_chunk=prefill_chunk,
+            restore_chunks_per_step=restore_chunks_per_step)
+        disagg_fleets.append((fleet, reqs))
+        digests.append(_digest(fleet.event_log()))
+        if span_events is None:
+            span_events = events
+
+    fleet, reqs = disagg_fleets[0]
+    result = DisaggCompareResult(
+        seed=seed, n_prefill=dcfg.n_prefill, n_decode=dcfg.n_decode,
+        trace_kw=trace_kw, disagg_digests=digests,
+        colocated_digest=_digest(base_fleet.event_log()),
+        deterministic=len(set(digests)) == 1)
+    violations = result.violations
+
+    # -- hard serving invariants ---------------------------------- #
+    for pool, name in ((reqs, "disagg"), (base_reqs, "colocated")):
+        for r in pool:
+            if r.state is not RequestState.DONE:
+                violations.append(
+                    f"{name} request {r.uid} ended "
+                    f"{r.state.name} ({r.error or r.reject_reason})")
+    for f, name in ((fleet, "disagg"), (base_fleet, "colocated")):
+        if not f.migration_balance_ok:
+            violations.append(f"{name} migration imbalance: "
+                              f"{dict(f.counters)}")
+        for rep in f.replicas:
+            if rep.state is ReplicaState.DEAD:
+                continue
+            if rep.engine.state.free_blocks != \
+                    rep.initial_free_blocks:
+                violations.append(
+                    f"{name} replica {rep.id} leaked blocks")
+    for m in fleet.migrations:
+        if m.reason == "handoff" and not m.mode:
+            violations.append(f"handoff {m.uid} never terminal")
+
+    # -- bitwise stream parity ------------------------------------ #
+    base_by_uid = {r.uid: list(r.tokens_out) for r in base_reqs}
+    result.stream_parity = all(
+        list(r.tokens_out) == base_by_uid[r.uid] for r in reqs)
+    if not result.stream_parity:
+        bad = [r.uid for r in reqs
+               if list(r.tokens_out) != base_by_uid[r.uid]]
+        violations.append(f"stream parity broken for uids {bad[:8]}")
+
+    # -- span-derived handoff/decode overlap ---------------------- #
+    steps = [e for e in span_events
+             if e.get("ph") == "X" and e.get("name") == "fleet.step"]
+    transit = [e for e in steps
+               if (e.get("args") or {}).get("handoffs_in_transit",
+                                            0) > 0]
+    overlapped = [e for e in transit
+                  if (e.get("args") or {}).get("decode_tier_lanes",
+                                               0) > 0]
+    result.span_handoff_ratio = \
+        len(overlapped) / len(transit) if transit else 0.0
+    result.span_counter_agreement = abs(
+        result.span_handoff_ratio - fleet.handoff_overlap_ratio) \
+        < 1e-9
+    if not result.span_counter_agreement:
+        violations.append(
+            f"span handoff ratio {result.span_handoff_ratio} != "
+            f"counter {fleet.handoff_overlap_ratio}")
+
+    # -- latency decomposition + the decode-tail claim ------------- #
+    decode_ids = {r.id for r in fleet.replicas
+                  if r.role in _DECODE_ROLES}
+
+    def rows(pool, decode_only=False):
+        out = []
+        for r in pool:
+            if decode_only and r.replica not in decode_ids:
+                continue
+            out.append(r)
+        return out
+
+    disagg_decode = rows(reqs, decode_only=True)
+    metrics = {
+        "disagg": {
+            "ttft_p50": _pct([r.ttft() for r in reqs], 50),
+            "ttft_p99": _pct([r.ttft() for r in reqs], 99),
+            "tpot_p50": _pct([r.tpot() for r in reqs], 50),
+            "tpot_p95": _pct([r.tpot() for r in reqs], 95),
+            "tpot_p99": _pct([r.tpot() for r in reqs], 99),
+            "decode_tier_tpot_p95":
+                _pct([r.tpot() for r in disagg_decode], 95),
+            "decode_tier_tpot_p99":
+                _pct([r.tpot() for r in disagg_decode], 99),
+            "queue_wait_p99": _pct([r.queue_wait() for r in reqs], 99),
+            "prefill_compute_p99":
+                _pct([r.prefill_compute() for r in reqs], 99),
+            "handoff_transit_p50":
+                _pct([r.handoff_transit_s for r in reqs
+                      if r.n_handoffs], 50),
+            "handoff_transit_p99":
+                _pct([r.handoff_transit_s for r in reqs
+                      if r.n_handoffs], 99),
+            "preemptions": sum(r.n_preemptions for r in reqs),
+        },
+        "colocated": {
+            "ttft_p50": _pct([r.ttft() for r in base_reqs], 50),
+            "ttft_p99": _pct([r.ttft() for r in base_reqs], 99),
+            "tpot_p50": _pct([r.tpot() for r in base_reqs], 50),
+            "tpot_p95": _pct([r.tpot() for r in base_reqs], 95),
+            "tpot_p99": _pct([r.tpot() for r in base_reqs], 99),
+            "queue_wait_p99":
+                _pct([r.queue_wait() for r in base_reqs], 99),
+            "prefill_compute_p99":
+                _pct([r.prefill_compute() for r in base_reqs], 99),
+            "preemptions": sum(r.n_preemptions for r in base_reqs),
+        },
+    }
+    result.metrics = metrics
+    dec_p99 = metrics["disagg"]["decode_tier_tpot_p99"]
+    base_p99 = metrics["colocated"]["tpot_p99"]
+    if dec_p99 is None or base_p99 is None:
+        violations.append("missing TPOT percentiles")
+    elif dec_p99 >= base_p99:
+        violations.append(
+            f"decode-tier TPOT p99 {dec_p99} not strictly better "
+            f"than colocated {base_p99}")
+
+    result.summary = fleet.summary()
+    result.tier_summary = fleet.tier_summary()
+    result.colocated_summary = base_fleet.summary()
+    result.requests = [{
+        "uid": r.uid, "priority": r.priority,
+        "prompt_len": len(r.prompt), "tokens": len(r.tokens_out),
+        "replica": r.replica, "handoffs": r.n_handoffs,
+        "colocated_fallback": r.colocated_fallback,
+        "preemptions": r.n_preemptions, "restores": r.n_restores,
+        "recomputes": r.n_recomputes,
+        "ttft_s": None if r.ttft() is None else round(r.ttft(), 6),
+        "tpot_s": None if r.tpot() is None else round(r.tpot(), 6),
+        "queue_wait_s": None if r.queue_wait() is None
+        else round(r.queue_wait(), 6),
+        "prefill_compute_s": None if r.prefill_compute() is None
+        else round(r.prefill_compute(), 6),
+        "handoff_transit_s": round(r.handoff_transit_s, 6),
+    } for r in reqs]
+    result.handoffs = [m.to_row() for m in fleet.migrations
+                       if m.reason == "handoff"]
+    if not result.deterministic:
+        violations.append(f"digests diverged: {digests}")
+    if fleet.handoff_overlap_ratio <= 0.0 and \
+            fleet.counters["handoffs"]:
+        violations.append("handoff transit never overlapped decode")
+    result.ok = not violations
+    return result

@@ -77,6 +77,30 @@ class ReplicaState(Enum):
     STOPPED = 5       # drained clean
 
 
+class ReplicaRole(Enum):
+    """Disaggregated-serving tier membership (docs/serving.md).
+
+    * ``COLOCATED`` — the classic replica: takes new requests AND
+      decodes (every pre-disagg fleet is all-colocated; the default).
+    * ``PREFILL`` — prompt-prefill tier: takes new requests, runs
+      their prefill with latent capture, and hands the finished
+      (latents + first token) off to the decode tier; it never holds
+      steady-state decode work except under the colocation fallback.
+    * ``DECODE`` — decode tier: never routed new requests; adopts
+      handed-off (and migrated/evacuated) decode state through its
+      normal restore lanes.
+    """
+    COLOCATED = 0
+    PREFILL = 1
+    DECODE = 2
+
+
+#: roles whose replicas accept NEW requests at the router
+_INTAKE_ROLES = (ReplicaRole.COLOCATED, ReplicaRole.PREFILL)
+#: roles whose replicas hold steady-state decode work
+_DECODE_ROLES = (ReplicaRole.COLOCATED, ReplicaRole.DECODE)
+
+
 #: states in which the replica's scheduler takes steps
 _STEPPING = (ReplicaState.UP, ReplicaState.DRAINING,
              ReplicaState.PARTITIONED)
@@ -136,8 +160,10 @@ class FleetReplica:
     def __init__(self, replica_id: int, engine, clock,
                  config: FleetConfig,
                  resilience: Optional[ResiliencePolicy] = None,
-                 sample_fn=None):
+                 sample_fn=None,
+                 role: ReplicaRole = ReplicaRole.COLOCATED):
         self.id = replica_id
+        self.role = role
         self.server = ServingServer(
             engine, config=config.server, clock=clock,
             resilience=resilience, sample_fn=sample_fn,
@@ -191,7 +217,8 @@ class ServingFleet:
     def __init__(self, engines=None, config: FleetConfig = None,
                  clock=None, resilience: ResiliencePolicy = None,
                  sample_fn=None,
-                 engine_factory: Callable = None):
+                 engine_factory: Callable = None,
+                 roles: Optional[List] = None):
         self.config = config or FleetConfig()
         self.clock = clock or MonotonicClock()
         self.virtual = isinstance(self.clock, VirtualClock)
@@ -202,9 +229,17 @@ class ServingFleet:
                        for _ in range(self.config.n_replicas)]
         engines = list(engines)
         self.config.n_replicas = len(engines)
+        if roles is None:
+            roles = [ReplicaRole.COLOCATED] * len(engines)
+        roles = [r if isinstance(r, ReplicaRole)
+                 else ReplicaRole[str(r).upper()] for r in roles]
+        if len(roles) != len(engines):
+            raise ValueError(
+                f"{len(roles)} roles for {len(engines)} replicas")
         self.replicas = [
             FleetReplica(i, eng, self.clock, self.config,
-                         resilience=resilience, sample_fn=sample_fn)
+                         resilience=resilience, sample_fn=sample_fn,
+                         role=roles[i])
             for i, eng in enumerate(engines)]
         crossover = None
         if getattr(engines[0].config.hcache, "enable_latents", False) \
@@ -238,12 +273,23 @@ class ServingFleet:
             "failed_in_transit": 0, "requeued": 0, "reroutes": 0,
             "replica_crashes": 0, "replica_hangs": 0,
             "replica_partitions": 0, "drains_completed": 0,
+            # disaggregated-serving accounting (always present; a
+            # role-less fleet never moves them off zero)
+            "handoffs": 0, "handoff_landings": 0,
+            "handoff_recomputes": 0, "colocated_decodes": 0,
         }
         #: migration/decode overlap accounting: fleet steps with >=1
         #: migration in flight, and the subset where some replica also
         #: dispatched decode lanes (transit hides under decode)
         self.transit_steps = 0
         self.overlapped_transit_steps = 0
+        #: the handoff-specific slice of the same accounting: fleet
+        #: steps with >=1 prefill→decode handoff on the tier link, and
+        #: the subset where a decode-capable replica also dispatched
+        #: decode lanes — the ship-overlaps-resident-decode claim the
+        #: disagg bench span-verifies
+        self.handoff_transit_steps = 0
+        self.overlapped_handoff_steps = 0
         self._routable: set = {r.id for r in self.replicas}
         self._pump_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -336,6 +382,13 @@ class ServingFleet:
         if not self.transit_steps:
             return 0.0
         return self.overlapped_transit_steps / self.transit_steps
+
+    @property
+    def handoff_overlap_ratio(self) -> float:
+        if not self.handoff_transit_steps:
+            return 0.0
+        return self.overlapped_handoff_steps / \
+            self.handoff_transit_steps
 
     def _fail_fleet(self, req: Request, error: str,
                     now: float) -> None:
@@ -463,11 +516,13 @@ class ServingFleet:
     # snapshots
     # ------------------------------------------------------------- #
     def _snapshots(self, routable,
-                   with_migratable: bool = False
-                   ) -> List[ReplicaSnapshot]:
+                   with_migratable: bool = False,
+                   roles=None) -> List[ReplicaSnapshot]:
         snaps = []
         for r in self.replicas:
             if r.id not in routable:
+                continue
+            if roles is not None and r.role not in roles:
                 continue
             s = r.scheduler
             migratable: Tuple = ()
@@ -486,8 +541,30 @@ class ServingFleet:
                 suspended=len(s.suspended),
                 occupancy=s._occupancy(),
                 degradation=int(s.degradation),
-                migratable=migratable))
+                migratable=migratable,
+                role=r.role.name.lower()))
         return snaps
+
+    # -- tier hooks (overridden by serving.disagg) ------------------ #
+    def _intake_roles(self):
+        """Roles eligible for NEW requests; None = every role (the
+        all-colocated base fleet)."""
+        return None
+
+    def _intake_snapshots(self, routable) -> List[ReplicaSnapshot]:
+        return self._snapshots(routable, roles=self._intake_roles())
+
+    def _landing_snapshots(self, migration: Migration,
+                           routable) -> List[ReplicaSnapshot]:
+        """Replicas a landing migration may re-route to (the disagg
+        coordinator restricts decode-state landings to its decode
+        tier)."""
+        return self._snapshots(routable)
+
+    def _tier_pass(self, now: float, routable) -> None:
+        """Disaggregation hook: runs each fleet step between the drain
+        pass and the replica steps. The base fleet has no tiers —
+        no-op."""
 
     @property
     def degradation_level(self) -> int:
@@ -502,14 +579,30 @@ class ServingFleet:
     # ------------------------------------------------------------- #
     # migration machinery
     # ------------------------------------------------------------- #
+    def _migration_span(self, reason: str) -> str:
+        """Async-span name for a migration: prefill→decode handoffs
+        get their own ``fleet.handoff`` lane in the exported trace so
+        the tier transport is span-attributable apart from rebalance/
+        crash traffic."""
+        return "fleet.handoff" if reason == "handoff" \
+            else "fleet.migrate"
+
     def _begin_migration(self, req: Request, src: int, dst: int,
-                         reason: str) -> Migration:
+                         reason: str,
+                         nbytes: Optional[int] = None,
+                         link_bytes_per_s: Optional[float] = None,
+                         overhead_s: Optional[float] = None
+                         ) -> Migration:
         now = self.clock.now()
-        nbytes = int(req.latents.nbytes) \
-            if req.latents is not None else 0
-        transfer_s = self.config.migration_overhead_s
-        if self.config.link_bytes_per_s > 0:
-            transfer_s += nbytes / self.config.link_bytes_per_s
+        if nbytes is None:
+            nbytes = int(req.latents.nbytes) \
+                if req.latents is not None else 0
+        link = self.config.link_bytes_per_s \
+            if link_bytes_per_s is None else link_bytes_per_s
+        transfer_s = self.config.migration_overhead_s \
+            if overhead_s is None else overhead_s
+        if link > 0:
+            transfer_s += nbytes / link
         m = Migration(uid=req.uid, src=src, dst=dst, nbytes=nbytes,
                       tokens=req.cached_tokens, reason=reason,
                       depart_t=now, land_t=now + transfer_s,
@@ -521,15 +614,16 @@ class ServingFleet:
         self._event("migrate_depart", req.uid,
                     f"src={src} dst={dst} reason={reason} "
                     f"bytes={nbytes}")
-        get_tracer().async_begin("fleet.migrate", req.uid, cat="fleet",
+        get_tracer().async_begin(self._migration_span(reason), req.uid,
+                                 cat="fleet",
                                  src=src, dst=dst, reason=reason,
                                  bytes=nbytes, tokens=m.tokens)
         return m
 
     def _finish_migration(self, m: Migration, mode: str) -> None:
         m.mode = mode
-        get_tracer().async_end("fleet.migrate", m.uid, cat="fleet",
-                               mode=mode, dst=m.dst)
+        get_tracer().async_end(self._migration_span(m.reason), m.uid,
+                               cat="fleet", mode=mode, dst=m.dst)
 
     def _transit_pass(self, now: float, routable) -> None:
         if not self.in_transit:
@@ -563,7 +657,7 @@ class ServingFleet:
                 continue
             if m.dst < 0 or m.dst not in routable:
                 new_dst = self.router.route(
-                    req, self._snapshots(routable))
+                    req, self._landing_snapshots(m, routable))
                 if new_dst is None:
                     if self._all_dead():
                         self.counters["failed_in_transit"] += 1
@@ -587,6 +681,13 @@ class ServingFleet:
             key = "landings" if mode == "restore" \
                 else "recompute_landings"
             self.counters[key] += 1
+            if m.reason == "handoff":
+                # the handoff-transit TTFT component: the priced time
+                # this request's latents rode the tier link
+                req.n_handoffs += 1
+                req.handoff_transit_s += m.land_t - m.depart_t
+                self.counters["handoff_landings" if mode == "restore"
+                              else "handoff_recomputes"] += 1
             self._finish_migration(m, mode)
             self._event("migrate_land", m.uid,
                         f"dst={m.dst} mode={mode}")
@@ -612,7 +713,7 @@ class ServingFleet:
                 self.pending.remove(req)
                 self._fail_fleet(req, "fleet_down", now)
                 continue
-            snaps = self._snapshots(routable)
+            snaps = self._intake_snapshots(routable)
             if not snaps:
                 break                 # nobody routable; wait
             dst = self.router.route(req, snaps)
@@ -696,8 +797,18 @@ class ServingFleet:
             for uid in live_uids:
                 with self._locked(r):
                     req = s.detach_for_migration(uid)
-                if req is not None:
-                    self._begin_migration(req, r.id, -1, "drain")
+                if req is None:
+                    continue
+                if req.state is RequestState.QUEUED:
+                    # mid-chunk prefill rewound to QUEUED: nothing to
+                    # ship — the queue slot re-routes like queued work
+                    req.replica = None
+                    self.counters["requeued"] += 1
+                    self._event("requeue", req.uid,
+                                f"drain replica={r.id}")
+                    self.pending.append(req)
+                    continue
+                self._begin_migration(req, r.id, -1, "drain")
             if r.live_requests == 0:
                 r.state = ReplicaState.STOPPED
                 self.counters["drains_completed"] += 1
@@ -728,10 +839,14 @@ class ServingFleet:
             self._route_pass(now, routable)
             self._rebalance_pass(routable)
             self._drain_pass(routable)
+            self._tier_pass(now, routable)
             had_transit = bool(self.in_transit)
+            handoffs_in_transit = sum(1 for m in self.in_transit
+                                      if m.reason == "handoff")
             reports: Dict[int, object] = {}
             max_cost = 0.0
             decode_lanes = 0
+            decode_tier_lanes = 0
             for r in self.replicas:
                 if r.state not in _STEPPING:
                     continue
@@ -740,6 +855,8 @@ class ServingFleet:
                 r.last_report = report
                 reports[r.id] = report
                 decode_lanes += report.decode_lanes
+                if r.role in _DECODE_ROLES:
+                    decode_tier_lanes += report.decode_lanes
                 r.occupancy_sum += r.scheduler._occupancy()
                 r.kv_util_peak = max(r.kv_util_peak,
                                      r.kv_utilization)
@@ -755,10 +872,19 @@ class ServingFleet:
                 self.transit_steps += 1
                 if decode_lanes:
                     self.overlapped_transit_steps += 1
+            if handoffs_in_transit:
+                # the handoff slice of the same claim, scoped to the
+                # decode tier: the cross-tier latent ship must hide
+                # under the decode replicas' resident decode
+                self.handoff_transit_steps += 1
+                if decode_tier_lanes:
+                    self.overlapped_handoff_steps += 1
             if self.virtual:
                 self.clock.sleep(max_cost + self.config.step_overhead_s)
             sp.set(in_transit=len(self.in_transit),
                    decode_lanes=decode_lanes,
+                   handoffs_in_transit=handoffs_in_transit,
+                   decode_tier_lanes=decode_tier_lanes,
                    routable=len(routable),
                    pending=len(self.pending))
         return reports
@@ -823,6 +949,7 @@ class ServingFleet:
                     self._route_pass(now, routable)
                 self._rebalance_pass(routable)
                 self._drain_pass(routable)
+                self._tier_pass(now, routable)
                 for r in self.replicas:
                     if r.state in _STEPPING and \
                             r.server._thread is not None and \
@@ -853,6 +980,7 @@ class ServingFleet:
         for r in self.replicas:
             per_replica[str(r.id)] = {
                 "state": r.state.name,
+                "role": r.role.name,
                 "steps": r.steps,
                 "kv_utilization": round(r.kv_utilization, 6),
                 "kv_util_peak": round(r.kv_util_peak, 6),
@@ -875,6 +1003,10 @@ class ServingFleet:
             "overlapped_transit_steps": self.overlapped_transit_steps,
             "migration_overlap_ratio":
                 round(self.migration_overlap_ratio, 6),
+            "handoff_transit_steps": self.handoff_transit_steps,
+            "overlapped_handoff_steps": self.overlapped_handoff_steps,
+            "handoff_overlap_ratio":
+                round(self.handoff_overlap_ratio, 6),
             "degradation_level": self.degradation_level,
         }
 
@@ -886,7 +1018,12 @@ class ServingFleet:
         from ..telemetry.prometheus import MetricRegistry
         reg = MetricRegistry(namespace="hds_fleet")
         for r in self.replicas:
-            labels = {"replica": str(r.id)}
+            # per-tier const labels: every serving metric family is
+            # sliceable by tier, so a disagg win is attributable to
+            # the tier that produced it (all-colocated fleets label
+            # uniformly and lose nothing)
+            labels = {"replica": str(r.id),
+                      "tier": r.role.name.lower()}
             r.server.metrics.to_registry(reg, labels=labels)
             reg.set_gauge("replica_state", float(r.state.value),
                           labels=labels,
@@ -905,6 +1042,11 @@ class ServingFleet:
                       self.migration_overlap_ratio,
                       help="fleet steps with transit hidden under "
                            "decode / steps with transit")
+        reg.set_gauge("handoff_overlap_ratio",
+                      self.handoff_overlap_ratio,
+                      help="fleet steps with a prefill→decode handoff "
+                           "hidden under decode-tier decode / steps "
+                           "with a handoff in transit")
         reg.set_gauge("in_transit", float(len(self.in_transit)),
                       help="migrations currently on the wire")
         reg.set_gauge("degradation_level",

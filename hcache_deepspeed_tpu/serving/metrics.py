@@ -110,6 +110,14 @@ class ServingMetrics:
         self.ttft = Histogram(LATENCY_BUCKETS_S, **kw)
         self.tpot = Histogram(LATENCY_BUCKETS_S, **kw)
         self.queue_wait = Histogram(LATENCY_BUCKETS_S, **kw)
+        # the TTFT decomposition (queue-wait / prefill-compute /
+        # handoff-transit): TTFT = queue_wait + prefill_compute; the
+        # handoff-transit component is the cross-tier latent ship a
+        # disaggregated fleet charges between the first and second
+        # token (0-count under colocated serving) — split out so a
+        # disagg win/loss is attributable, not an aggregate mystery
+        self.prefill_compute = Histogram(LATENCY_BUCKETS_S, **kw)
+        self.handoff_transit = Histogram(LATENCY_BUCKETS_S, **kw)
         self.preemptions_per_request = Histogram(**kw)
         #: burn-rate tracker; pass ``slo=False`` to disable entirely
         self.slo = SLOTracker() if slo is None else (slo or None)
@@ -120,6 +128,12 @@ class ServingMetrics:
                          "preemptions": 0, "restores": 0,
                          "recompute_reentries": 0, "restore_chunks": 0,
                          "overlapped_restores": 0, "tokens_out": 0,
+                         # chunked-prefill accounting: prompt slices
+                         # dispatched, and the steps in which a slice
+                         # shared the ragged put with live decode lanes
+                         # (the head-of-line blocking it removes)
+                         "prefill_chunk_steps": 0,
+                         "prefill_chunks": 0,
                          "steps": 0, "idle_steps": 0,
                          # resilience counters (chaos harness asserts
                          # these against the scheduler's own totals)
@@ -152,6 +166,9 @@ class ServingMetrics:
         c["recompute_reentries"] += len(report.recomputed)
         c["restore_chunks"] += report.restore_chunks
         c["overlapped_restores"] += report.overlapped_restores
+        c["prefill_chunks"] += report.prefill_chunks
+        if report.prefill_chunks:
+            c["prefill_chunk_steps"] += 1
         c["failed"] += len(report.failed)
         c["quarantined"] += len(report.quarantined)
         c["faults_injected"] += report.faults
@@ -214,6 +231,10 @@ class ServingMetrics:
             self.tpot.observe(req.tpot())
         if req.queue_wait() is not None:
             self.queue_wait.observe(req.queue_wait())
+        if req.prefill_compute() is not None:
+            self.prefill_compute.observe(req.prefill_compute())
+        if getattr(req, "n_handoffs", 0):
+            self.handoff_transit.observe(req.handoff_transit_s)
         self.preemptions_per_request.observe(req.n_preemptions)
 
     # ------------------------------------------------------------- #
@@ -223,7 +244,9 @@ class ServingMetrics:
         """The monitor event-tuple list for one emission step."""
         out = []
         for name, hist in (("ttft_s", self.ttft), ("tpot_s", self.tpot),
-                           ("queue_wait_s", self.queue_wait)):
+                           ("queue_wait_s", self.queue_wait),
+                           ("prefill_compute_s", self.prefill_compute),
+                           ("handoff_transit_s", self.handoff_transit)):
             for q in (50, 90, 99):
                 v = hist.percentile(q)
                 if v is not None:
@@ -293,7 +316,11 @@ class ServingMetrics:
                           help="SLO burn-rate gauge (see telemetry.slo)")
         for name, hist in (("ttft_seconds", self.ttft),
                            ("tpot_seconds", self.tpot),
-                           ("queue_wait_seconds", self.queue_wait)):
+                           ("queue_wait_seconds", self.queue_wait),
+                           ("prefill_compute_seconds",
+                            self.prefill_compute),
+                           ("handoff_transit_seconds",
+                            self.handoff_transit)):
             if hist.buckets:
                 reg.set_histogram(name, hist.bucket_counts,
                                   hist.buckets, hist.count, hist.sum,
@@ -314,6 +341,8 @@ class ServingMetrics:
             "ttft_s": self.ttft.summary(),
             "tpot_s": self.tpot.summary(),
             "queue_wait_s": self.queue_wait.summary(),
+            "prefill_compute_s": self.prefill_compute.summary(),
+            "handoff_transit_s": self.handoff_transit.summary(),
             "preemptions_per_request":
                 self.preemptions_per_request.summary(),
             "counters": dict(self.counters),

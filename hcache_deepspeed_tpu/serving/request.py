@@ -109,6 +109,12 @@ class Request:
     #: scheduler step index of the most recent suspend (anti-thrash:
     #: never restored in the same step it was evicted)
     suspended_in_step: int = -1
+    #: scheduler step index of the most recent restore/recompute
+    #: re-entry (-1 = never restored). With a preemption grace
+    #: configured, a just-restored resident is protected until it has
+    #: decoded — the guard that breaks restore→preempt livelock under
+    #: a persistent high-priority admission backlog
+    restored_in_step: int = -1
     n_preemptions: int = 0
     n_restores: int = 0
     #: crossover-policy re-entries that re-prefilled instead of
@@ -118,6 +124,10 @@ class Request:
     #: exhaustion, lane aborts, faulted recompute re-entries); at the
     #: policy cap the request hard-fails with ``restore_failed``
     n_restore_failures: int = 0
+    #: chunked-prefill cursor: prompt tokens already fed to the engine
+    #: while this request is mid-prefill (0 = not started / monolithic
+    #: prefill; == len(prompt) once the last chunk has dispatched)
+    prefill_pos: int = 0
     # -- fleet bookkeeping ------------------------------------------ #
     #: replica currently (or last) responsible for this request; None
     #: until the fleet router places it (standalone servers never set
@@ -126,6 +136,17 @@ class Request:
     #: completed cross-replica migrations (landings, including
     #: recompute landings — transit expiry is not a migration)
     n_migrations: int = 0
+    # -- disaggregated-serving bookkeeping -------------------------- #
+    #: completed prefill→decode tier handoffs (a handoff is a
+    #: migration with the tier link as its wire)
+    n_handoffs: int = 0
+    #: total simulated seconds this request's latents spent on the
+    #: cross-tier handoff link (the handoff-transit TTFT component;
+    #: 0.0 for colocated serving)
+    handoff_transit_s: float = 0.0
+    #: the request decoded on its prefill replica because the decode
+    #: tier was saturated (the disagg colocation fallback)
+    colocated_fallback: bool = False
 
     def transition(self, new_state: RequestState) -> None:
         if new_state not in _TRANSITIONS[self.state]:
@@ -182,3 +203,12 @@ class Request:
         if self.admitted_at is None:
             return None
         return self.admitted_at - self.arrival_time
+
+    def prefill_compute(self) -> Optional[float]:
+        """Admission → first token: the prefill-compute TTFT component
+        (TTFT = queue_wait + prefill_compute; the handoff-transit
+        component rides ``handoff_transit_s`` and delays the *second*
+        token under disaggregation, never the first)."""
+        if self.first_token_at is None or self.admitted_at is None:
+            return None
+        return self.first_token_at - self.admitted_at

@@ -91,6 +91,10 @@ class ReplicaSnapshot:
     #: uids of migratable suspended requests, with their cached-token
     #: counts, in deterministic (cached desc, uid) order
     migratable: Tuple[Tuple[int, int], ...] = ()
+    #: disaggregation tier ("colocated" | "prefill" | "decode") — the
+    #: fleet pre-filters snapshots by role, the router records it for
+    #: counters and never needs to re-filter
+    role: str = "colocated"
 
 
 class FleetRouter:
@@ -110,6 +114,7 @@ class FleetRouter:
         self.affinity_hits = 0
         self.migrations_proposed = 0
         self.migrations_refused_by_cost = 0
+        self.handoff_routes = 0
 
     # ------------------------------------------------------------- #
     # health
@@ -180,6 +185,22 @@ class FleetRouter:
             self._prefix_map.popitem(last=False)
         return best.id
 
+    def route_handoff(self, req: Request,
+                      snapshots: Sequence[ReplicaSnapshot]
+                      ) -> Optional[int]:
+        """Pick the decode replica for a prefill→decode handoff: the
+        KV-pressure/backlog score alone (no prefix-affinity bonus —
+        the prompt's KV is leaving its prefill home, so prefix
+        locality carries no value on the decode side) and no prefix-
+        map update, so handoff landings never steer future intake
+        placement. Lowest (score, id) wins — deterministic."""
+        if not snapshots:
+            return None
+        best = min(snapshots,
+                   key=lambda s: (self._score(s, False), s.id))
+        self.handoff_routes += 1
+        return best.id
+
     # ------------------------------------------------------------- #
     # rebalancing
     # ------------------------------------------------------------- #
@@ -221,6 +242,7 @@ class FleetRouter:
         return {
             "routed": self.routed,
             "affinity_hits": self.affinity_hits,
+            "handoff_routes": self.handoff_routes,
             "migrations_proposed": self.migrations_proposed,
             "migrations_refused_by_cost":
                 self.migrations_refused_by_cost,

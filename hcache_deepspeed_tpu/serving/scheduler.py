@@ -70,6 +70,11 @@ class StepReport:
     quarantined: List[int] = field(default_factory=list)
     decode_lanes: int = 0
     prefill_tokens: int = 0
+    #: chunked-prefill slices dispatched this step (Dynamic SplitFuse
+    #: at the scheduler grain: each slice rides the same ragged put as
+    #: the residents' decode tokens, so a long prompt never head-of-
+    #: line blocks decode for more than one chunk's worth of compute)
+    prefill_chunks: int = 0
     restored_tokens: int = 0
     #: restore replay chunks issued this step (lane progress)
     restore_chunks: int = 0
@@ -118,7 +123,10 @@ class ContinuousBatchingScheduler:
                  restore_chunks_per_step: int = 1,
                  calibrate_every: int = 25,
                  resilience: ResiliencePolicy = None,
-                 replica_id: int = 0):
+                 replica_id: int = 0,
+                 prefill_chunk: int = 0,
+                 preempt_restore_grace: int = 0,
+                 restore_priority_barrier: bool = False):
         self.engine = engine
         #: fleet position of this scheduler (0 = standalone/replica 0);
         #: folded into the retry-jitter RNG key so N replicas retrying
@@ -146,6 +154,30 @@ class ContinuousBatchingScheduler:
         #: hide under one restore; 0 = drain a lane in one step)
         self.restore_chunks_per_step = restore_chunks_per_step
         self.calibrate_every = max(1, calibrate_every)
+        #: scheduler-grain chunked prefill (Dynamic SplitFuse): a
+        #: prompt longer than this dispatches in per-step slices that
+        #: share each ragged put with the residents' decode tokens —
+        #: the request stays PREFILL (a resident, never a preemption
+        #: victim) until its last slice samples the first token.
+        #: 0 = monolithic prefill (the historical behavior; committed
+        #: chaos digests replay unchanged)
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        #: restore→preempt livelock guard: a resident restored within
+        #: the last N steps is not a preemption victim until it has
+        #: had a decode dispatch — without it, a persistent higher-
+        #: priority admission can evict each freshly-restored resident
+        #: every step while the restore pass restores another, and the
+        #: step makes no token progress forever. 0 = no protection
+        #: (the historical victim policy; committed digests replay)
+        self.preempt_restore_grace = max(0, int(preempt_restore_grace))
+        #: head-of-line restore: when the best suspended candidate
+        #: does not fit, do NOT let smaller lower-ranked payloads
+        #: leapfrog it — freed blocks accrue to the head instead, so
+        #: a large (long-context) restore cannot be starved by a
+        #: stream of small landings. False = the historical
+        #: smaller-may-still-fit policy (better pool utilization,
+        #: unbounded big-payload wait; committed digests replay)
+        self.restore_priority_barrier = bool(restore_priority_barrier)
 
         self.queue: List[Request] = []           # QUEUED, submit order
         self.running: Dict[int, Request] = {}    # DECODE residents
@@ -417,6 +449,19 @@ class ContinuousBatchingScheduler:
             self._event("migrate_out", uid, "from=restoring")
             return req
         if uid in self.running:
+            req = self.running[uid]
+            if req.state == RequestState.PREFILL:
+                # mid-chunk prefill: nothing restorable exists yet —
+                # rewind to QUEUED (partial latents dropped, engine
+                # state freed); the caller re-routes the queue slot
+                del self.running[uid]
+                self._safe_flush(uid)
+                req.latents = None
+                req.prefill_pos = 0
+                req.admitted_at = None
+                req.transition(RequestState.QUEUED)
+                self._event("migrate_out", uid, "from=prefill")
+                return req
             req = self.running.pop(uid)
             if self.latent_preemption and req.latents is not None and \
                     req.latents.shape[1] == req.cached_tokens:
@@ -479,6 +524,18 @@ class ContinuousBatchingScheduler:
                 self._overlap_credited.discard(uid)
                 self.watchdog.drop(uid)
                 origin = req.state.name
+                if req.state == RequestState.PREFILL and \
+                        not req.tokens_out:
+                    # crashed mid-prompt (chunked prefill): nothing
+                    # decodable exists — rewind to QUEUED so the fleet
+                    # requeues it onto a surviving (prefill) replica
+                    req.latents = None
+                    req.prefill_pos = 0
+                    req.admitted_at = None
+                    req.transition(RequestState.QUEUED)
+                    self._event("evacuate", uid, f"from={origin}")
+                    queued.append(req)
+                    continue
                 if req.latents is None or \
                         req.latents.shape[1] != req.cached_tokens:
                     req.latents = None      # partial payload: recompute
@@ -617,6 +674,8 @@ class ContinuousBatchingScheduler:
                 seq = self.engine.state.get_sequence(req.uid)
                 need = self.engine.state.blocks_needed(seq, 0)
             if need > free - headroom:
+                if self.restore_priority_barrier:
+                    break     # head-of-line: nobody leapfrogs
                 continue      # smaller suspendees may still fit
             free -= need
             lanes += 1
@@ -667,6 +726,7 @@ class ContinuousBatchingScheduler:
                 raise
         req.absorb_latents(latents[0])
         req.n_recomputes += 1
+        req.restored_in_step = self.step_idx
         self.total_recomputes += 1
         report.recomputed.append(req.uid)
         self._event("restore", req.uid,
@@ -805,6 +865,7 @@ class ContinuousBatchingScheduler:
             # now, decoding again from the NEXT step's batch (its next
             # fed token is tokens_out[-1])
             req.n_restores += 1
+            req.restored_in_step = self.step_idx
             self.total_restores += 1
             report.restored.append(req.uid)
             report.restored_tokens += req.cached_tokens
@@ -918,6 +979,7 @@ class ContinuousBatchingScheduler:
             self.watchdog.drop(uid)
             self.breaker.record_success(self.step_idx)
             req.n_restores += 1
+            req.restored_in_step = self.step_idx
             report.restored.append(uid)
             report.restored_tokens += req.cached_tokens
             self._event("restore", uid,
@@ -933,13 +995,27 @@ class ContinuousBatchingScheduler:
         return sorted(self.queue,
                       key=lambda r: (-r.priority, r.arrival_time, r.uid))
 
-    def _victims(self, exclude=()) -> List[Request]:
+    def _victims(self, exclude=(),
+                 grace: bool = False) -> List[Request]:
         """Preemption victims, best-victim-first: lowest priority, then
         latest deadline (no deadline = least urgent), youngest last-in
-        first-evicted, uid as the deterministic tiebreak."""
+        first-evicted, uid as the deterministic tiebreak.
+
+        ``grace=True`` additionally protects freshly-restored residents
+        (``preempt_restore_grace``) — used by ADMISSION preemption
+        only: a persistent high-priority admission otherwise evicts
+        each just-restored resident every step while the restore pass
+        restores another, and the loop makes no token progress. The
+        pressure pass never applies the grace — when the residents
+        alone exceed the pool, someone must go."""
         cand = [r for r in self.running.values()
                 if r.uid not in exclude and
                 r.state == RequestState.DECODE]
+        if grace and self.preempt_restore_grace:
+            cand = [r for r in cand
+                    if r.restored_in_step < 0 or
+                    self.step_idx - r.restored_in_step >
+                    self.preempt_restore_grace]
         return sorted(
             cand,
             key=lambda r: (r.priority,
@@ -967,11 +1043,30 @@ class ContinuousBatchingScheduler:
         report.preempted.append(req.uid)
         self._event("preempt", req.uid, f"mode={mode}")
 
+    def _next_feed(self, req: Request) -> int:
+        """Tokens this *resident* feeds the next ragged put: one decode
+        token, or the next prompt slice for a mid-chunk PREFILL
+        resident (scheduler-grain chunked prefill)."""
+        if req.state == RequestState.PREFILL:
+            rest = len(req.prompt) - req.prefill_pos
+            return min(rest, self.prefill_chunk) \
+                if self.prefill_chunk else rest
+        return 1
+
+    def _first_feed(self, req: Request) -> int:
+        """Tokens an admission candidate would feed this step (its
+        first prompt slice under chunked prefill, the whole prompt
+        otherwise). Chunked admission budgets per slice — "fits
+        eventually" is handled dynamically, like decode growth."""
+        return min(len(req.prompt), self.prefill_chunk) \
+            if self.prefill_chunk else len(req.prompt)
+
     def _trial_verdict(self, admits: List[Request],
                        cand: Optional[Request]) -> SchedulingResult:
         reqs = admits + ([cand] if cand is not None else [])
         uids = list(self.running) + [r.uid for r in reqs]
-        lens = [1] * len(self.running) + [len(r.prompt) for r in reqs]
+        lens = [self._next_feed(r) for r in self.running.values()] + \
+            [self._first_feed(r) for r in reqs]
         if not uids:
             return SchedulingResult.Success
         return self.engine.can_schedule(uids, lens)
@@ -993,8 +1088,9 @@ class ContinuousBatchingScheduler:
                 self._reject(req, "SequenceTokenLimitExceeded", report)
                 continue
             sm = self.engine.config.state_manager
-            per_fwd = min(len(req.prompt), sm.prefill_chunk) \
-                if sm.prefill_chunk else len(req.prompt)
+            chunk = self.prefill_chunk or sm.prefill_chunk
+            per_fwd = min(len(req.prompt), chunk) if chunk \
+                else len(req.prompt)
             if per_fwd > sm.max_ragged_batch_size:
                 # also permanent: the prompt alone overflows every
                 # forward's token budget and nothing will chunk it
@@ -1006,7 +1102,7 @@ class ContinuousBatchingScheduler:
                 action = BACKPRESSURE_ACTION[verdict]
                 if action != BackpressureAction.PREEMPT:
                     break
-                victims = [v for v in self._victims()
+                victims = [v for v in self._victims(grace=True)
                            if v.priority < req.priority]
                 if not victims:
                     if not self.running and not self.suspended and \
@@ -1067,10 +1163,34 @@ class ContinuousBatchingScheduler:
             # suspend the worst victim (it is in the batch itself)
             victims = self._victims()
             if not victims:
+                # mid-chunk PREFILL residents are not preemptible (no
+                # complete latent payload) but CAN rewind: drop the
+                # partial prefill back to the queue head and retry the
+                # prompt later — the chunked-prefill anti-wedge valve
+                mids = sorted(
+                    (r for r in self.running.values()
+                     if r.state == RequestState.PREFILL),
+                    key=lambda r: (-r.arrival_time, -r.uid))
+                if mids:
+                    self._rewind_prefill(mids[0], "kv_pressure")
+                    continue
                 raise RuntimeError(
                     f"scheduler wedged: verdict {verdict} with no "
                     "admissions and no preemptible residents")
             self._preempt(victims[0], report)
+
+    def _rewind_prefill(self, req: Request, why: str) -> None:
+        """Abandon a mid-chunk prefill: free its engine state, drop the
+        partial latents, and put it back at the queue head in QUEUED —
+        the chunked analog of rewinding an untouched admit."""
+        del self.running[req.uid]
+        self._safe_flush(req.uid)
+        req.latents = None
+        req.prefill_pos = 0
+        req.admitted_at = None
+        req.transition(RequestState.QUEUED)
+        self.queue.insert(0, req)
+        self._event("prefill_rewind", req.uid, why)
 
     # ------------------------------------------------------------- #
     # dispatch: ONE ragged put for decodes + admitted prefills
@@ -1089,8 +1209,17 @@ class ContinuousBatchingScheduler:
                 report.overlapped_restores = len(report.restored)
                 self.overlapped_restores += len(report.restored)
 
-        decodes = [r for u, r in self.running.items()
-                   if u not in set(report.restored)]
+        restored_set = set(report.restored)
+        residents = [r for u, r in self.running.items()
+                     if u not in restored_set]
+        decodes = [r for r in residents
+                   if r.state == RequestState.DECODE]
+        # mid-chunk PREFILL residents (scheduler-grain chunked
+        # prefill): their next prompt slice rides THIS ragged put
+        # beside the decode tokens, so a long prompt costs the batch
+        # one chunk per step instead of the whole prompt at once
+        chunking = [r for r in residents
+                    if r.state == RequestState.PREFILL]
         for req in admits:
             self.queue.remove(req)
             req.transition(RequestState.PREFILL)
@@ -1098,16 +1227,23 @@ class ContinuousBatchingScheduler:
             report.admitted.append(req.uid)
             self._event("admit", req.uid,
                         f"prompt={len(req.prompt)}")
-        step_reqs = decodes + admits
+        step_reqs = decodes + chunking + admits
         if not step_reqs:
             # restore-only step: the lanes still trickle (no overlap
             # credit — nothing computed under the ships)
             self._advance_restore_lanes(report, had_decode=False)
             return
-        toks = [[r.tokens_out[-1]] for r in decodes] + \
-            [r.prompt for r in admits]
+        slices: Dict[int, List[int]] = {}
+        toks: List = [[r.tokens_out[-1]] for r in decodes]
+        for req in chunking + admits:
+            n = self._next_feed(req)
+            slices[req.uid] = list(
+                req.prompt[req.prefill_pos:req.prefill_pos + n])
+            toks.append(slices[req.uid])
         report.decode_lanes = len(decodes)
-        report.prefill_tokens = sum(len(r.prompt) for r in admits)
+        report.prefill_tokens = sum(len(s) for s in slices.values())
+        if self.prefill_chunk:
+            report.prefill_chunks = len(slices)
         # the decode half of the restore-overlap span pair (see
         # _restore_pass): the decode dispatch computes while the open
         # lanes' latent ships ride the link; the replay chunks issued
@@ -1130,8 +1266,8 @@ class ContinuousBatchingScheduler:
                 # unattributable, the whole batch), rewind untouched
                 # admits, and keep the loop alive — the step simply did
                 # no token work
-                self._quarantine_dispatch(exc, decodes, admits, report,
-                                          now)
+                self._quarantine_dispatch(exc, decodes + chunking,
+                                          admits, report, now)
                 report.decode_lanes = 0
                 report.prefill_tokens = 0
                 if self.latent_preemption and self.restoring:
@@ -1159,6 +1295,13 @@ class ContinuousBatchingScheduler:
                                f"latent_fault:"
                                f"{getattr(exc, 'site', 'host')}",
                                report, now, quarantined=True)
+                    continue
+            if req.state == RequestState.PREFILL:
+                req.prefill_pos += len(slices[req.uid])
+                if req.prefill_pos < len(req.prompt):
+                    # prompt not fully fed yet: stays a PREFILL
+                    # resident, no token sampled from a mid-chunk row
+                    self.running[req.uid] = req
                     continue
             tok = self.sample_fn(req, logits[j])
             req.tokens_out.append(tok)

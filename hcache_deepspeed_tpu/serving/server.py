@@ -45,6 +45,21 @@ class ServerConfig:
     #: replay chunks issued per scheduler step while a restore lane is
     #: open (the decode-interleave grain; 0 drains a lane in one step)
     restore_chunks_per_step: int = 1
+    #: scheduler-grain chunked prefill (Dynamic SplitFuse): long
+    #: prompts dispatch in per-step slices of this many tokens so they
+    #: never head-of-line block resident decode (0 = monolithic
+    #: prefill, the historical behavior). Pair with the engine's
+    #: ``state_manager.prefill_chunk`` when its per-forward token
+    #: budget also needs the chunk accounting.
+    prefill_chunk: int = 0
+    #: restore→preempt livelock guard (see the scheduler): a resident
+    #: restored within the last N steps is not a preemption victim.
+    #: 0 = historical victim policy (committed chaos digests replay)
+    preempt_restore_grace: int = 0
+    #: head-of-line restore admission (see the scheduler): a large
+    #: suspended payload that does not fit blocks smaller ones from
+    #: leapfrogging it. False = historical smaller-may-still-fit
+    restore_priority_barrier: bool = False
     # -- virtual-clock cost model (seconds) -------------------------- #
     step_overhead_s: float = 1e-3
     prefill_token_s: float = 1e-4
@@ -70,7 +85,11 @@ class ServingServer:
             engine, clock=self.clock, sample_fn=sample_fn,
             metrics=self.metrics, crossover=crossover,
             restore_chunks_per_step=self.config.restore_chunks_per_step,
-            resilience=resilience, replica_id=self.replica_id)
+            resilience=resilience, replica_id=self.replica_id,
+            prefill_chunk=self.config.prefill_chunk,
+            preempt_restore_grace=self.config.preempt_restore_grace,
+            restore_priority_barrier=
+            self.config.restore_priority_barrier)
         self.monitor = monitor
         self.emit_every_steps = emit_every_steps
         self._lock = threading.Lock()

@@ -1,0 +1,215 @@
+"""Scheduler-grain chunked prefill (Dynamic SplitFuse at the serving
+layer): long prompts dispatch in per-step slices that share the ragged
+put with resident decode, so prefill never head-of-line blocks decode
+— plus the two policy knobs that ride along (preempt-restore grace,
+head-of-line restore barrier)."""
+
+import pytest
+
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.serving import (Request, RequestState,
+                                          ServerConfig, ServingServer,
+                                          SimulatedEngine,
+                                          VirtualClock)
+
+
+def sim_engine(num_blocks=32, max_seqs=6, batch_budget=256,
+               max_context=256, prefill_chunk=0, max_tracked=12):
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": max_tracked,
+                       "max_ragged_batch_size": batch_budget,
+                       "max_ragged_sequence_count": max_seqs,
+                       "max_context": max_context,
+                       "prefill_chunk": prefill_chunk},
+        kv_cache={"block_size": 8, "num_blocks": num_blocks},
+        hcache={"enable_latents": True}))
+
+
+def make_server(prefill_chunk=0, engine=None, **server_kw):
+    server_kw.setdefault("kv_demand_fraction", float("inf"))
+    server_kw.setdefault("max_queue_depth", 256)
+    return ServingServer(
+        engine if engine is not None else sim_engine(),
+        clock=VirtualClock(),
+        config=ServerConfig(prefill_chunk=prefill_chunk, **server_kw))
+
+
+def run_to_done(server, reqs, max_steps=4000):
+    reports = []
+    steps = 0
+    while server.scheduler.has_work or server._ingress:
+        reports.append(server.step())
+        steps += 1
+        assert steps < max_steps, server._snapshot()
+    assert all(r.state == RequestState.DONE for r in reqs), \
+        [(r.uid, r.state.name, r.error, r.reject_reason)
+         for r in reqs]
+    return reports
+
+
+def test_chunked_stream_bitwise_equals_monolithic():
+    prompts = [list(range(40)), list(range(7)), list(range(23))]
+    streams = {}
+    for chunk in (0, 8):
+        server = make_server(prefill_chunk=chunk)
+        reqs = [Request(uid=i, prompt=list(p), max_new_tokens=9)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            server.submit(request=r)
+        run_to_done(server, reqs)
+        streams[chunk] = [list(r.tokens_out) for r in reqs]
+    assert streams[0] == streams[8]
+
+
+def test_chunk_slices_share_the_put_with_decode_lanes():
+    """The head-of-line fix itself: while a long prompt chunks, the
+    resident keeps decoding in the SAME steps."""
+    server = make_server(prefill_chunk=4)
+    chat = Request(uid=0, prompt=list(range(6)), max_new_tokens=30)
+    server.submit(request=chat)
+    server.step()
+    server.step()
+    assert chat.state == RequestState.DECODE
+    long = Request(uid=1, prompt=list(range(32)), max_new_tokens=4)
+    server.submit(request=long)
+    overlapped_chunk_steps = 0
+    while not long.finished or not chat.finished:
+        report = server.step()
+        if report.prefill_chunks and report.decode_lanes:
+            overlapped_chunk_steps += 1
+    # 32 tokens / 4-token chunks = 8 slices, all beside live decode
+    assert overlapped_chunk_steps >= 7
+    m = server.metrics.counters
+    assert m["prefill_chunks"] >= 8
+    assert m["prefill_chunk_steps"] >= 8
+
+
+def test_chunked_admission_fits_past_monolithic_token_budget():
+    """A prompt longer than the per-forward token budget is admitted
+    (and served) when the scheduler chunks it — the scheduler-level
+    analog of the engine's Dynamic SplitFuse test."""
+    long_prompt = list(range(100))
+    mono = make_server(
+        prefill_chunk=0,
+        engine=sim_engine(batch_budget=32, max_context=256))
+    r0 = Request(uid=0, prompt=list(long_prompt), max_new_tokens=4)
+    mono.submit(request=r0)
+    while mono.scheduler.has_work or mono._ingress:
+        mono.step()
+    assert r0.state == RequestState.REJECTED
+    assert r0.reject_reason == "BatchTokenLimitExceeded"
+
+    chunked = make_server(
+        prefill_chunk=32,
+        engine=sim_engine(batch_budget=32, max_context=256,
+                          prefill_chunk=32))
+    r1 = Request(uid=0, prompt=list(long_prompt), max_new_tokens=4)
+    chunked.submit(request=r1)
+    run_to_done(chunked, [r1])
+    assert len(r1.tokens_out) == 4
+
+
+def test_mid_chunk_pressure_rewinds_instead_of_wedging():
+    """A mid-chunk prefill that outgrows the pool with no preemptible
+    decode residents rewinds to QUEUED (anti-wedge valve) and is
+    served later."""
+    engine = sim_engine(num_blocks=6, max_seqs=4, max_context=64)
+    server = make_server(prefill_chunk=8, engine=engine)
+    big = Request(uid=0, prompt=list(range(30)), max_new_tokens=2)
+    bigger = Request(uid=1, prompt=list(range(30)), max_new_tokens=2,
+                     priority=1)
+    server.submit(request=big)
+    server.submit(request=bigger)
+    run_to_done(server, [big, bigger])
+    events = [e for e in server.scheduler.events
+              if e[1] == "prefill_rewind"]
+    assert events, "pressure never exercised the rewind valve"
+    assert engine.state.free_blocks == 5   # initial minus scratch
+
+
+def test_mid_chunk_detach_requeues():
+    server = make_server(prefill_chunk=4)
+    req = Request(uid=0, prompt=list(range(20)), max_new_tokens=4)
+    server.submit(request=req)
+    server.step()
+    assert req.state == RequestState.PREFILL
+    assert 0 < req.prefill_pos < len(req.prompt)
+    out = server.scheduler.detach_for_migration(0)
+    assert out is req
+    assert req.state == RequestState.QUEUED
+    assert req.prefill_pos == 0 and req.latents is None
+    assert server.scheduler.engine.state.n_tracked_sequences == 0
+    # resubmittable: the rewound request still completes exactly
+    server.scheduler.submit(req)
+    run_to_done(server, [req])
+    ref = make_server(prefill_chunk=0)
+    ref_req = Request(uid=0, prompt=list(range(20)), max_new_tokens=4)
+    ref.submit(request=ref_req)
+    run_to_done(ref, [ref_req])
+    assert req.tokens_out == ref_req.tokens_out
+
+
+def test_monolithic_default_reports_no_chunks():
+    server = make_server(prefill_chunk=0)
+    req = Request(uid=0, prompt=list(range(40)), max_new_tokens=4)
+    server.submit(request=req)
+    run_to_done(server, [req])
+    assert server.metrics.counters["prefill_chunks"] == 0
+    assert server.metrics.counters["prefill_chunk_steps"] == 0
+
+
+# ------------------------------------------------------------------ #
+# policy knobs: preempt-restore grace + restore priority barrier
+# ------------------------------------------------------------------ #
+def test_preempt_restore_grace_protects_fresh_restores():
+    from hcache_deepspeed_tpu.serving.scheduler import \
+        ContinuousBatchingScheduler
+    engine = sim_engine()
+    sched = ContinuousBatchingScheduler(engine, clock=VirtualClock(),
+                                        preempt_restore_grace=1)
+    a = Request(uid=0, prompt=list(range(8)), max_new_tokens=4)
+    a.state = RequestState.DECODE
+    a.restored_in_step = 5
+    sched.running[0] = a
+    sched.step_idx = 6
+    assert sched._victims(grace=True) == []      # protected
+    assert sched._victims() == [a]               # pressure pass sees it
+    sched.step_idx = 8
+    assert sched._victims(grace=True) == [a]     # grace expired
+
+
+def test_restore_priority_barrier_blocks_leapfrog():
+    """With the barrier, a big suspended payload that does not fit
+    stops smaller ones from landing past it; without it they leapfrog
+    (the historical policy)."""
+    import numpy as np
+
+    from hcache_deepspeed_tpu.inference.ragged.latents import \
+        HostLatentStore
+
+    def build(barrier):
+        # 7 blocks => 6 usable: the 49-token payload needs 7 and can
+        # NEVER fit right now; the 6-token one needs 1 and could
+        engine = sim_engine(num_blocks=7, max_seqs=4, max_context=64)
+        server = ServingServer(
+            engine, clock=VirtualClock(),
+            config=ServerConfig(kv_demand_fraction=float("inf"),
+                                restore_priority_barrier=barrier))
+        sched = server.scheduler
+        for uid, plen, prio in ((0, 49, 2), (1, 6, 0)):
+            r = Request(uid=uid, prompt=list(range(plen)),
+                        max_new_tokens=8, priority=prio)
+            r.tokens_out.append(1)
+            r.latents = HostLatentStore(
+                np.zeros((2, plen, 4), np.float32))
+            r.state = RequestState.SUSPENDED
+            r.suspended_in_step = -1
+            sched.suspended[uid] = r
+        sched.step_idx = 5
+        return sched
+
+    sched = build(barrier=False)
+    cands = sched._restore_candidates()
+    assert [r.uid for r in cands] == [1]         # small leapfrogs
+    sched = build(barrier=True)
+    assert sched._restore_candidates() == []     # head-of-line holds

@@ -1,0 +1,356 @@
+"""Disaggregated prefill/decode serving: replica roles, latent-wire
+handoff (full-width + int8), colocation fallback, payload
+amortization, TTFT decomposition, tier-dead degradation, and the
+committed-evidence comparison harness."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.comm.comms_logging import get_comms_logger
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.resilience import (FaultPlan, FaultRule,
+                                             injected)
+from hcache_deepspeed_tpu.serving import (DisaggConfig,
+                                          DisaggregatedFleet,
+                                          FleetConfig, ReplicaRole,
+                                          ReplicaState, Request,
+                                          RequestState, ServerConfig,
+                                          ServingServer,
+                                          SimulatedEngine,
+                                          VirtualClock,
+                                          compare_disagg_vs_colocated)
+from hcache_deepspeed_tpu.telemetry.prometheus import \
+    validate_prometheus_text
+from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+
+
+def sim_engine(num_blocks=16, max_seqs=4, max_context=128,
+               prefill_chunk=0):
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": max_seqs,
+                       "max_context": max_context,
+                       "prefill_chunk": prefill_chunk},
+        kv_cache={"block_size": 8, "num_blocks": num_blocks},
+        hcache={"enable_latents": True}))
+
+
+def make_disagg(n_prefill=1, n_decode=2, num_blocks=16,
+                disagg_kw=None, server_kw=None, engine_kw=None):
+    server_kw = dict(server_kw or {})
+    server_kw.setdefault("max_queue_depth", 256)
+    server_kw.setdefault("kv_demand_fraction", float("inf"))
+    engine_kw = dict(engine_kw or {})
+    engine_kw["num_blocks"] = num_blocks
+    n = n_prefill + n_decode
+    return DisaggregatedFleet(
+        engines=[sim_engine(**engine_kw) for _ in range(n)],
+        clock=VirtualClock(),
+        config=FleetConfig(n_replicas=n,
+                           server=ServerConfig(**server_kw)),
+        disagg=DisaggConfig(n_prefill=n_prefill, n_decode=n_decode,
+                            **(disagg_kw or {})))
+
+
+def drive(fleet, max_steps=8000):
+    steps = 0
+    while fleet.has_work:
+        fleet.step()
+        steps += 1
+        assert steps < max_steps, \
+            "fleet did not converge\n" + fleet.snapshot()
+
+
+def reference_stream(prompt, max_new, uid):
+    srv = ServingServer(
+        sim_engine(), clock=VirtualClock(),
+        config=ServerConfig(kv_demand_fraction=float("inf")))
+    req = Request(uid=uid, prompt=list(prompt), max_new_tokens=max_new)
+    srv.submit(request=req)
+    while srv.scheduler.has_work or srv._ingress:
+        srv.step()
+    assert req.state == RequestState.DONE
+    return list(req.tokens_out)
+
+
+# ------------------------------------------------------------------ #
+# roles + handoff mechanics
+# ------------------------------------------------------------------ #
+def test_roles_partition_the_fleet():
+    fleet = make_disagg(n_prefill=2, n_decode=3)
+    roles = [r.role for r in fleet.replicas]
+    assert roles == [ReplicaRole.PREFILL] * 2 + \
+        [ReplicaRole.DECODE] * 3
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        DisaggConfig(n_prefill=0, n_decode=2)
+    with pytest.raises(ValueError):
+        DisaggConfig(handoff_wire_bits=4)
+    with pytest.raises(ValueError):
+        DisaggregatedFleet(engines=[sim_engine()],
+                           disagg=DisaggConfig(n_prefill=1,
+                                               n_decode=2),
+                           clock=VirtualClock())
+
+
+def test_handoff_preserves_token_stream():
+    fleet = make_disagg()
+    prompt = list(range(12))
+    req = fleet.submit(prompt=prompt, max_new_tokens=10)
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.n_handoffs == 1
+    assert req.replica in (1, 2)          # finished on the decode tier
+    assert req.handoff_transit_s > 0
+    assert req.tokens_out == reference_stream(prompt, 10, req.uid)
+    assert fleet.counters["handoffs"] == 1
+    assert fleet.counters["handoff_landings"] == 1
+    assert fleet.migration_balance_ok
+
+
+def test_prefill_replica_never_dispatches_decode():
+    """The tier contract: with a healthy decode tier, the prefill
+    replica's scheduler never runs a decode lane — every finished
+    prompt leaves before its first decode step."""
+    fleet = make_disagg(n_prefill=1, n_decode=2)
+    for i in range(6):
+        fleet.submit(prompt=list(range(8 + i)), max_new_tokens=8)
+    steps = 0
+    while fleet.has_work:
+        reports = fleet.step()
+        r0 = reports.get(0)
+        if r0 is not None:
+            assert r0.decode_lanes == 0, \
+                f"prefill replica ran decode lanes at step {steps}"
+        steps += 1
+        assert steps < 5000
+    assert fleet.counters["handoffs"] == 6
+    assert fleet.counters["colocated_decodes"] == 0
+
+
+def test_new_requests_only_route_to_prefill_tier():
+    fleet = make_disagg(n_prefill=2, n_decode=2)
+    reqs = [fleet.submit(prompt=list(range(8)), max_new_tokens=4)
+            for _ in range(6)]
+    fleet.step()
+    assert all(q.replica in (0, 1) for q in reqs
+               if q.replica is not None)
+    drive(fleet)
+    assert all(q.state == RequestState.DONE for q in reqs)
+
+
+def test_handoff_routes_to_least_pressured_decode_replica():
+    fleet = make_disagg(n_prefill=1, n_decode=2)
+    # preload decode replica 1 directly so its backlog dominates
+    for i in range(3):
+        fleet.replicas[1].server.submit(
+            request=Request(uid=900 + i, prompt=list(range(8)),
+                            max_new_tokens=8))
+    req = fleet.submit(prompt=list(range(10)), max_new_tokens=6)
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.replica == 2               # the idle decode replica
+    assert fleet.router.handoff_routes >= 1
+
+
+# ------------------------------------------------------------------ #
+# colocation fallback + payload amortization
+# ------------------------------------------------------------------ #
+def test_colocation_fallback_when_decode_tier_saturated():
+    fleet = make_disagg(disagg_kw=dict(saturation_backlog=0,
+                                       saturation_kv_utilization=0.0))
+    reqs = [fleet.submit(prompt=list(range(8)), max_new_tokens=6)
+            for _ in range(4)]
+    drive(fleet)
+    assert all(q.state == RequestState.DONE for q in reqs)
+    assert fleet.counters["handoffs"] == 0
+    assert fleet.counters["colocated_decodes"] == 4
+    assert all(q.colocated_fallback and q.replica == 0 for q in reqs)
+    # the fallback streams are still exact
+    for q in reqs:
+        assert q.tokens_out == reference_stream(q.prompt,
+                                                6, q.uid)
+
+
+def test_payload_amortization_keeps_big_prefixes_local():
+    fleet = make_disagg(disagg_kw=dict(handoff_amortization=1.0))
+    big = fleet.submit(prompt=list(range(40)), max_new_tokens=4)
+    small = fleet.submit(prompt=list(range(6)), max_new_tokens=12)
+    drive(fleet)
+    assert big.state == small.state == RequestState.DONE
+    assert big.colocated_fallback and big.n_handoffs == 0
+    assert big.replica == 0
+    assert small.n_handoffs == 1 and small.replica in (1, 2)
+
+
+def test_intake_degrades_into_decode_tier_when_prefill_dead():
+    fleet = make_disagg(n_prefill=1, n_decode=2)
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.crash", at_hits=(1,))])):
+        fleet.step()
+    assert fleet.replicas[0].state is ReplicaState.DEAD
+    req = fleet.submit(prompt=list(range(8)), max_new_tokens=5)
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.replica in (1, 2)
+
+
+def test_decode_crash_reships_surviving_latents():
+    fleet = make_disagg(n_prefill=1, n_decode=2)
+    req = fleet.submit(prompt=list(range(10)), max_new_tokens=12)
+    while req.n_handoffs == 0 and fleet.has_work:
+        fleet.step()
+    drive_until = 0
+    while req.state is not RequestState.DECODE and fleet.has_work:
+        fleet.step()
+        drive_until += 1
+        assert drive_until < 1000
+    victim = req.replica
+    assert victim in (1, 2)
+    # crash exactly the decode replica holding the request
+    with injected(FaultPlan(rules=[
+            FaultRule("replica.crash", at_hits=(victim + 1,))])):
+        fleet.step()
+    assert fleet.replicas[victim].state is ReplicaState.DEAD
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.replica in (1, 2) and req.replica != victim
+    assert req.tokens_out == reference_stream(req.prompt, 12, req.uid)
+    assert fleet.migration_balance_ok
+
+
+# ------------------------------------------------------------------ #
+# TTFT decomposition + observability
+# ------------------------------------------------------------------ #
+def test_ttft_components_split_and_exposed():
+    fleet = make_disagg()
+    req = fleet.submit(prompt=list(range(10)), max_new_tokens=8)
+    drive(fleet)
+    assert req.state == RequestState.DONE
+    assert req.queue_wait() is not None
+    assert req.prefill_compute() is not None
+    assert req.ttft() == pytest.approx(
+        req.queue_wait() + req.prefill_compute())
+    assert req.handoff_transit_s > 0
+    # the decode replica's metrics observed the components
+    dst = fleet.replicas[req.replica].server.metrics
+    assert dst.prefill_compute.count == 1
+    assert dst.handoff_transit.count == 1
+    assert dst.handoff_transit.sum == pytest.approx(
+        req.handoff_transit_s)
+    # per-tier const labels in the fleet-wide exposition
+    text = fleet.prometheus_text()
+    assert validate_prometheus_text(text) == []
+    assert 'tier="prefill"' in text and 'tier="decode"' in text
+    assert "hds_fleet_handoff_transit_seconds" in text
+    assert "hds_fleet_handoff_overlap_ratio" in text
+
+
+def test_handoff_spans_derive_the_overlap_ratio():
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    try:
+        fleet = make_disagg(n_prefill=1, n_decode=2)
+        for i in range(8):
+            fleet.submit(prompt=list(range(8 + i)),
+                         max_new_tokens=10,
+                         request=None)
+        drive(fleet)
+        events = tracer.events()
+    finally:
+        tracer.configure(enabled=was)
+    steps = [e for e in events
+             if e.get("ph") == "X" and e["name"] == "fleet.step"]
+    transit = [e for e in steps
+               if e["args"].get("handoffs_in_transit", 0) > 0]
+    overlapped = [e for e in transit
+                  if e["args"].get("decode_tier_lanes", 0) > 0]
+    assert transit, "no fleet.step span saw a handoff in transit"
+    span_ratio = len(overlapped) / len(transit)
+    assert span_ratio == pytest.approx(fleet.handoff_overlap_ratio)
+    assert fleet.handoff_transit_steps == len(transit)
+    # per-handoff async lanes exported under their own name
+    spans = [e for e in events if e.get("name") == "fleet.handoff"]
+    assert any(e.get("ph") == "b" for e in spans)
+    assert any(e.get("ph") == "e" for e in spans)
+    # the whole disagg trace renders to a schema-valid Chrome trace
+    # (async b/e pairing per (cat,id,name) included)
+    from hcache_deepspeed_tpu.telemetry.export import (to_trace_events,
+                                                       validate_trace)
+    counts = validate_trace(to_trace_events(events))
+    assert counts["pairs"] > 0
+
+
+# ------------------------------------------------------------------ #
+# int8 latent wire
+# ------------------------------------------------------------------ #
+def test_int8_wire_bytes_attributed_and_stream_parity():
+    logger = get_comms_logger()
+    was = logger.enabled
+    logger.configure(enabled=True)
+    logger.reset()
+    try:
+        fleet = make_disagg(
+            disagg_kw=dict(handoff_wire_bits=8,
+                           handoff_quant_group=32))
+        reqs = [fleet.submit(prompt=list(range(8 + i)),
+                             max_new_tokens=8) for i in range(4)]
+        drive(fleet)
+        savings = logger.wire_savings_summary()
+    finally:
+        logger.reset()
+        logger.configure(enabled=was)
+    assert all(q.state == RequestState.DONE for q in reqs)
+    rec = savings["latent_handoff"]
+    assert rec["op_kind"] == "latent_handoff"
+    assert 0 < rec["wire_bytes"] < rec["unquantized_equiv_bytes"]
+    assert rec["fraction"] < 0.5       # int8 + scales vs float32
+    # restore parity vs the full-width wire: identical streams
+    full = make_disagg()
+    ref = [full.submit(prompt=list(range(8 + i)), max_new_tokens=8)
+           for i in range(4)]
+    drive(full)
+    for a, b in zip(reqs, ref):
+        assert a.tokens_out == b.tokens_out
+
+
+def test_int8_latent_roundtrip_error_bound():
+    from hcache_deepspeed_tpu.ops.quantizer import (
+        reference_dequantize, reference_quantize)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 17, 4)).astype(np.float32)
+    q, s, shape, n = reference_quantize(x, group_size=32, num_bits=8)
+    back = np.asarray(reference_dequantize(q, s, shape, n))
+    # symmetric int8: error bounded by half a quantization step
+    step = np.max(np.abs(x)) / 127
+    assert np.max(np.abs(back - x)) <= step * 0.5 + 1e-7
+
+
+# ------------------------------------------------------------------ #
+# the committed-evidence comparison harness (acceptance gates)
+# ------------------------------------------------------------------ #
+def test_compare_harness_passes_all_gates():
+    r = compare_disagg_vs_colocated(seed=0, runs=2)
+    assert r.ok, r.violations
+    assert r.deterministic
+    assert len(set(r.disagg_digests)) == 1
+    assert r.stream_parity
+    assert r.span_counter_agreement
+    assert r.span_handoff_ratio > 0
+    m = r.metrics
+    assert m["disagg"]["decode_tier_tpot_p99"] < \
+        m["colocated"]["tpot_p99"]
+    # the trace actually mixes the two classes
+    plens = {row["prompt_len"] for row in r.requests}
+    assert max(plens) >= 40 and min(plens) <= 10
+
+
+def test_compare_harness_seed_changes_digest():
+    a = compare_disagg_vs_colocated(seed=0, runs=1)
+    b = compare_disagg_vs_colocated(seed=1, runs=1)
+    assert a.disagg_digests[0] != b.disagg_digests[0]
