@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# One-shot repo lint: the concurrency & determinism analyzer (which
+# folds in `perf lint` as its fourth rule family) plus the standalone
+# perf-registry lint for belt-and-braces parity with the tier-1 gate.
+#
+#   bin/lint.sh            # gate against the committed baseline
+#   bin/lint.sh --verbose  # also list sanctioned (pragma'd) sites
+#
+# Exit: nonzero iff any check fails (new finding, stale baseline
+# entry, or schema-less artifact literal).
+set -u
+cd "$(dirname "$0")/.."
+
+PYTHON=${PYTHON:-python3}
+rc=0
+
+echo "== analysis (locks / purity / convention / perf) =="
+JAX_PLATFORMS=cpu "$PYTHON" -m hcache_deepspeed_tpu.analysis "$@" \
+    || rc=$?
+
+echo "== perf lint =="
+JAX_PLATFORMS=cpu "$PYTHON" -m hcache_deepspeed_tpu.perf lint \
+    || rc=$?
+
+exit $rc

@@ -1,0 +1,22 @@
+"""Concurrency & determinism analyzer.
+
+``python -m hcache_deepspeed_tpu.analysis`` runs four rule families
+over the tree (lock discipline, determinism purity, repo conventions,
+perf-artifact provenance) against the committed
+``analysis/BASELINE.json``; :mod:`.runtime` is the dynamic lock-order
+sentinel the serving/chaos test suites enable. See docs/analysis.md.
+"""
+
+from .core import (AnalysisConfig, Finding, Report, baseline_path,
+                   gate, load_baseline, run_analysis, save_baseline)
+from .runtime import (LockOrderError, OrderedLock, disable_sentinel,
+                      enable_sentinel, make_lock, observed_edges,
+                      sentinel, sentinel_enabled)
+
+__all__ = [
+    "AnalysisConfig", "Finding", "Report", "run_analysis", "gate",
+    "load_baseline", "save_baseline", "baseline_path",
+    "LockOrderError", "OrderedLock", "make_lock", "sentinel",
+    "sentinel_enabled", "enable_sentinel", "disable_sentinel",
+    "observed_edges",
+]

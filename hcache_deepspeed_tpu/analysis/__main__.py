@@ -1,0 +1,118 @@
+"""``python -m hcache_deepspeed_tpu.analysis`` — the analyzer CLI.
+
+Default run: walk the package (plus ``bench.py`` when run inside the
+repo), apply every rule family, fold in ``perf lint``, and gate
+against the committed baseline.
+
+Exit codes: 0 clean; 1 new (non-baselined) findings; 2 stale baseline
+entries (a baselined finding no longer fires — remove it or
+regenerate); 3 bad invocation.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (AnalysisConfig, baseline_path, gate,
+                   load_baseline, run_analysis, save_baseline)
+
+
+def _default_config(root, families):
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    repo = os.path.dirname(os.path.abspath(root))
+    bench = os.path.join(repo, "bench.py")
+    extra = (bench,) if os.path.exists(bench) else ()
+    return AnalysisConfig(
+        root=root, extra_files=extra,
+        perf_lint=bool(extra), repo_root=repo if extra else None,
+        families=tuple(families) if families else None)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "python -m hcache_deepspeed_tpu.analysis",
+        description="concurrency & determinism analyzer "
+                    "(lock discipline / purity / conventions / perf)")
+    p.add_argument("--root", default=None,
+                   help="package dir to scan (default: the installed "
+                        "hcache_deepspeed_tpu package)")
+    p.add_argument("--families", default=None,
+                   help="comma list: locks,purity,convention,perf")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {baseline_path()})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report raw findings, ignore the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings into the baseline "
+                        "(existing reasons are preserved)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list sanctioned (pragma'd) sites")
+    args = p.parse_args(argv)
+
+    families = None
+    if args.families:
+        families = [f.strip() for f in args.families.split(",")
+                    if f.strip()]
+        known = {"locks", "purity", "convention", "perf"}
+        bad = set(families) - known
+        if bad:
+            print(f"unknown families: {sorted(bad)} "
+                  f"(known: {sorted(known)})")
+            return 3
+    config = _default_config(args.root, families)
+    report = run_analysis(config)
+
+    if args.write_baseline:
+        old = load_baseline(args.baseline)
+        entries = {}
+        for f in report.findings:
+            entries[f.fingerprint] = old.get(
+                f.fingerprint,
+                f"baselined pre-existing finding: {f.message}")
+        path = save_baseline(entries, args.baseline)
+        print(f"wrote {len(entries)} entries -> {path}")
+        return 0
+
+    baseline = {} if args.no_baseline \
+        else load_baseline(args.baseline)
+    new, stale = gate(report, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "modules": report.n_modules,
+            "findings": [f.render() for f in report.findings],
+            "new": [f.render() for f in new],
+            "stale_baseline": stale,
+            "sanctioned": [f.render() for f, _ in report.sanctioned],
+            "by_family": report.by_family,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(f"STALE BASELINE: {fp} no longer fires — remove "
+                  f"the entry (or --write-baseline)")
+        if args.verbose and report.sanctioned:
+            print(f"-- {len(report.sanctioned)} sanctioned site(s):")
+            for f, _ in report.sanctioned:
+                print(f"   {f.render()}")
+        fam = ", ".join(f"{k}={v}" for k, v in
+                        sorted(report.by_family.items())) or "none"
+        print(f"analysis: {report.n_modules} modules, "
+              f"{len(report.findings)} finding(s) [{fam}], "
+              f"{len(new)} new, {len(stale)} stale baseline, "
+              f"{len(report.sanctioned)} sanctioned")
+    if new:
+        return 1
+    if stale:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Repo-convention lints (family ``convention``).
+
+* **HDS-C001** — async tracer spans must be begin/end paired: a
+  literal span name passed to ``async_begin`` somewhere in the tree
+  must have a matching literal ``async_end`` somewhere in the tree
+  (cross-module: the scheduler opens ``"request"``; the scheduler OR
+  the fleet may close it). Computed names (``async_begin(
+  self._migration_span(reason), ...)``) are skipped — pairing them is
+  the trace validator's runtime job. Checked package-wide in
+  ``finalize``.
+* **HDS-C002** — the "no silent clamps" rule: ``validate_*``
+  functions must reject with a typed :class:`HDSConfigError`, not a
+  bare builtin. Data-format validators that *document* their raise
+  type in the docstring (e.g. ``validate_trace`` raising
+  ``ValueError`` by contract) are exempt — the contract is explicit,
+  which is the point.
+* **HDS-C003** — an ``# hds: allow(...)`` pragma without a reason:
+  suppressions document deliberate exceptions; a bare one is just a
+  mute button and is rejected (the pragma is also ignored, so the
+  underlying finding still fires).
+"""
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from .core import AnalysisContext, Finding, ModuleInfo, Rule
+
+_TYPED_ERRORS = ("HDSConfigError",)
+
+
+class ConventionRule(Rule):
+    family = "convention"
+    codes = ("HDS-C001", "HDS-C002", "HDS-C003")
+
+    def check_module(self, mod: ModuleInfo,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        begins = ctx.shared.setdefault("span_begins", {})
+        ends = ctx.shared.setdefault("span_ends", set())
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in ("async_begin", "async_end") and node.args:
+                    first = node.args[0]
+                    if isinstance(first, ast.Constant) and \
+                            isinstance(first.value, str):
+                        if attr == "async_begin":
+                            begins.setdefault(
+                                first.value,
+                                (mod.relpath, node.lineno))
+                        else:
+                            ends.add(first.value)
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name.startswith("validate_"):
+                findings.extend(self._check_validator(node, mod))
+        for line, codes in mod.bad_pragmas:
+            findings.append(Finding(
+                code="HDS-C003", family=self.family,
+                path=mod.relpath, line=line, qualname="<module>",
+                symbol=codes,
+                message=(f"allow pragma for {codes} has no reason — "
+                         f"suppressions must document why the site "
+                         f"is sanctioned")))
+        return findings
+
+    # ------------------------------------------------------------- #
+    def _check_validator(self, fn: ast.FunctionDef,
+                         mod: ModuleInfo) -> List[Finding]:
+        doc = ast.get_docstring(fn) or ""
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call):
+                f = exc.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name is None or name in _TYPED_ERRORS:
+                continue
+            if name in doc:
+                # documented raise contract (a data-format validator,
+                # not a config validator) — the exemption that keeps
+                # validate_trace's declared ValueError legal
+                continue
+            out.append(Finding(
+                code="HDS-C002", family=self.family,
+                path=mod.relpath, line=node.lineno,
+                qualname=fn.name, symbol=name,
+                message=(f"config validator raises {name} — raise "
+                         f"typed HDSConfigError (or document the "
+                         f"raise type in the docstring for data-"
+                         f"format validators)")))
+        return out
+
+    # ------------------------------------------------------------- #
+    def finalize(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        begins: Dict[str, Tuple[str, int]] = ctx.shared.get(
+            "span_begins", {})
+        ends = ctx.shared.get("span_ends", set())
+        out: List[Finding] = []
+        for name, (relpath, line) in sorted(begins.items()):
+            if name not in ends:
+                out.append(Finding(
+                    code="HDS-C001", family=self.family,
+                    path=relpath, line=line, qualname="<module>",
+                    symbol=name,
+                    message=(f"async span {name!r} is begun but "
+                             f"never ended by any literal "
+                             f"async_end in the tree")))
+        return out

@@ -1,0 +1,411 @@
+"""Lock-discipline race detection (family ``locks``).
+
+Per class that uses ``with self.<lock>`` anywhere, infer the set of
+attribute paths the lock guards and flag undisciplined access:
+
+* **HDS-L001** — a guarded attribute is *mutated* outside the lock
+  (assignment, aug-assignment, subscript store, or a mutating method
+  call such as ``append``/``clear``/``pop``) in any method other than
+  ``__init__``.
+* **HDS-L002** — a guarded attribute is *snapshot-read* outside the
+  lock: used as the iterable of a ``for``/comprehension or passed to a
+  copying builtin (``list``/``dict``/``sorted``/``sum``/...). Bare
+  reference reads, truthiness, ``len``, membership tests and single
+  subscript reads are deliberately NOT flagged — under the GIL those
+  are single atomic operations, and flagging them drowned the real
+  races in noise (that exemption is the rule refinement the fleet's
+  ``has_work`` / the server's ``healthy`` demanded; see
+  docs/analysis.md).
+* **HDS-L003** — a lock acquisition lexically nested inside another
+  lock's ``with`` block in a module that does not declare its order
+  via a module-level ``__hds_lock_order__ = ("OuterClass._lock",
+  "InnerClass._lock")`` tuple. (Cross-method nesting — taking lock B
+  inside a helper called under lock A — is invisible to lexical
+  analysis; the *dynamic* lock-order sentinel in
+  :mod:`.runtime` owns that half.)
+
+Inference details that keep the rule quiet on disciplined code:
+
+* Guarded paths are dotted up to two levels (``_ingress``,
+  ``scheduler.done``): a subscript store into ``self.scheduler.done``
+  guards that path, not the whole ``scheduler`` object.
+* A *private* method whose every intra-class call site sits inside the
+  lock inherits the lock context (fixpoint) — helpers like the
+  server's ``_estimated_demand_blocks`` are analyzed as locked.
+  Public methods and properties never inherit: they are externally
+  callable by definition.
+* Call sites inside a method suppressed by a def-line allow pragma do
+  not count toward the fixpoint — the fleet's virtual-clock ``step()``
+  is single-threaded by contract and must not leak "unlocked caller"
+  evidence onto the helpers the thread-mode pump calls under the
+  lock.
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, ModuleInfo, Rule
+
+#: method names that mutate their receiver in place
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "difference_update",
+    "intersection_update", "symmetric_difference_update",
+})
+
+#: builtins that take a snapshot of (iterate) their argument
+SNAPSHOT_BUILTINS = frozenset({
+    "list", "tuple", "dict", "set", "frozenset", "sorted", "sum",
+    "min", "max", "any", "all", "enumerate", "map", "filter",
+    "reversed",
+})
+
+
+def _is_lockish_name(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def _lock_ctx_name(expr: ast.expr) -> Optional[str]:
+    """The lock-ish name a ``with`` context expr acquires, if any:
+    ``self._lock`` -> "_lock"; ``self._locked(r)`` -> "_locked";
+    anything else -> None."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id == "self" and _is_lockish_name(expr.attr):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        return _lock_ctx_name(expr.func)
+    return None
+
+
+def _self_path(expr: ast.expr, max_depth: int = 2) -> Optional[str]:
+    """Dotted attribute path rooted at ``self``, up to ``max_depth``
+    levels: ``self._ingress`` -> "_ingress";
+    ``self.scheduler.done`` -> "scheduler.done"; deeper chains
+    truncate to their two-level prefix."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not (isinstance(node, ast.Name) and node.id == "self"):
+        return None
+    parts.reverse()
+    if not parts:
+        return None
+    return ".".join(parts[:max_depth])
+
+
+def _read_path(expr: ast.expr) -> Optional[str]:
+    """Self-path of a read expression, seeing through the dict view
+    calls (``self.counters.items()`` reads ``counters``)."""
+    p = _self_path(expr)
+    if p is not None:
+        return p
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr in ("items", "values", "keys"):
+        return _self_path(expr.func.value)
+    return None
+
+
+@dataclass
+class _Access:
+    path: str
+    line: int
+    locked: bool
+    method: str
+    kind: str        # "mutate" | "iter" | "snapshot"
+    symbol: str
+
+
+@dataclass
+class _MethodFacts:
+    name: str
+    node: ast.FunctionDef
+    is_public: bool = False
+    is_property: bool = False
+    accesses: List[_Access] = field(default_factory=list)
+    #: (callee, call_site_locked) for self.method() calls
+    calls: List[Tuple[str, bool]] = field(default_factory=list)
+    #: whole method covered by an allow pragma for L-codes — its call
+    #: sites don't count as "unlocked caller" evidence
+    suppressed: bool = False
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Walk one method body tracking lexical lock depth and recording
+    guarded-path accesses + intra-class calls."""
+
+    def __init__(self, facts: _MethodFacts, mod: ModuleInfo):
+        self.facts = facts
+        self.mod = mod
+        self.depth = 0
+
+    # -- lock blocks ---------------------------------------------- #
+    def visit_With(self, node: ast.With) -> None:
+        # only a *direct* self-lock attribute guards this class's
+        # state; ``self._locked(r)`` (a Call) acquires some OTHER
+        # object's lock and contributes nothing to self-discipline
+        own = sum(1 for item in node.items
+                  if isinstance(item.context_expr, ast.Attribute) and
+                  _lock_ctx_name(item.context_expr) is not None)
+        for item in node.items:
+            self.visit(item.context_expr)
+        self.depth += own
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= own
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # nested defs: skip (their lock context is unknowable)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- mutations ------------------------------------------------- #
+    def _record(self, path: Optional[str], node: ast.AST,
+                kind: str, symbol: str) -> None:
+        if path is None:
+            return
+        self.facts.accesses.append(_Access(
+            path=path, line=node.lineno, locked=self.depth > 0,
+            method=self.facts.name, kind=kind, symbol=symbol))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._target(tgt)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._target(node.target)
+        self.visit(node.value)
+
+    def _target(self, tgt: ast.expr) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._target(elt)
+        elif isinstance(tgt, ast.Attribute):
+            self._record(_self_path(tgt), tgt, "mutate", tgt.attr)
+        elif isinstance(tgt, ast.Subscript):
+            base = _self_path(tgt.value)
+            if base is not None:
+                self._record(base, tgt, "mutate",
+                             base.rsplit(".", 1)[-1])
+            else:
+                self.visit(tgt.value)
+            self.visit(tgt.slice)
+
+    # -- calls: mutators + intra-class edges ----------------------- #
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            recv_path = _self_path(func.value)
+            if recv_path is not None and func.attr in MUTATORS:
+                self._record(recv_path, node, "mutate",
+                             recv_path.rsplit(".", 1)[-1])
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == "self":
+                self.facts.calls.append((func.attr, self.depth > 0))
+        if isinstance(func, ast.Name) and \
+                func.id in SNAPSHOT_BUILTINS:
+            for arg in node.args:
+                p = _read_path(arg)
+                if p is not None:
+                    self._record(p, arg, "snapshot",
+                                 p.rsplit(".", 1)[-1])
+        self.generic_visit(node)
+
+    # -- iteration ------------------------------------------------- #
+    def visit_For(self, node: ast.For) -> None:
+        p = _read_path(node.iter)
+        if p is not None:
+            self._record(p, node.iter, "iter", p.rsplit(".", 1)[-1])
+        self.generic_visit(node)
+
+    def _comp(self, node) -> None:
+        for gen in node.generators:
+            p = _read_path(gen.iter)
+            if p is not None:
+                self._record(p, gen.iter, "iter",
+                             p.rsplit(".", 1)[-1])
+        self.generic_visit(node)
+
+    visit_ListComp = _comp
+    visit_SetComp = _comp
+    visit_DictComp = _comp
+    visit_GeneratorExp = _comp
+
+
+def _method_facts(cls: ast.ClassDef,
+                  mod: ModuleInfo) -> Dict[str, _MethodFacts]:
+    out: Dict[str, _MethodFacts] = {}
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        facts = _MethodFacts(name=node.name, node=node)
+        facts.is_public = not node.name.startswith("_")
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Name) and dec.id == "property":
+                facts.is_property = True
+        facts.suppressed = any(
+            code.startswith("HDS-L")
+            for code in mod.allows.get(node.lineno, ()))
+        walker = _MethodWalker(facts, mod)
+        for stmt in node.body:     # not .visit(node): the nested-def
+            walker.visit(stmt)     # skip would swallow the method
+        out[node.name] = facts
+    return out
+
+
+def _uses_self_lock(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Attribute) and \
+                        isinstance(expr.value, ast.Name) and \
+                        expr.value.id == "self" and \
+                        _is_lockish_name(expr.attr):
+                    return True
+    return False
+
+
+def _locked_context_fixpoint(
+        methods: Dict[str, _MethodFacts]) -> Set[str]:
+    """Private, non-property methods whose every intra-class call site
+    is lock-held (directly or via an already-locked caller) inherit
+    the lock context. Call sites inside suppressed methods are
+    ignored. Methods with no intra-class call sites stay unlocked
+    (someone external calls them)."""
+    callers: Dict[str, List[Tuple[str, bool]]] = {}
+    for m in methods.values():
+        if m.suppressed:
+            continue
+        for callee, locked in m.calls:
+            if callee in methods:
+                callers.setdefault(callee, []).append(
+                    (m.name, locked))
+    locked_ctx: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, m in methods.items():
+            if name in locked_ctx or m.is_public or m.is_property \
+                    or name == "__init__":
+                continue
+            sites = callers.get(name)
+            if not sites:
+                continue
+            if all(locked or caller in locked_ctx
+                   for caller, locked in sites):
+                locked_ctx.add(name)
+                changed = True
+    return locked_ctx
+
+
+class LockDisciplineRule(Rule):
+    family = "locks"
+    codes = ("HDS-L001", "HDS-L002", "HDS-L003")
+
+    def check_module(self, mod: ModuleInfo,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    _uses_self_lock(node):
+                findings.extend(self._check_class(node, mod))
+        findings.extend(self._check_nesting(mod))
+        return findings
+
+    # ------------------------------------------------------------- #
+    def _check_class(self, cls: ast.ClassDef,
+                     mod: ModuleInfo) -> List[Finding]:
+        methods = _method_facts(cls, mod)
+        locked_ctx = _locked_context_fixpoint(methods)
+
+        def effective(acc: _Access) -> bool:
+            return acc.locked or acc.method in locked_ctx
+
+        guarded: Set[str] = set()
+        for m in methods.values():
+            for acc in m.accesses:
+                if acc.kind == "mutate" and effective(acc) and \
+                        m.name != "__init__":
+                    guarded.add(acc.path)
+        # a lock attribute itself is not "state" it guards
+        guarded = {p for p in guarded
+                   if not _is_lockish_name(p.split(".")[0])}
+        out: List[Finding] = []
+        for m in methods.values():
+            if m.name == "__init__":
+                continue
+            for acc in m.accesses:
+                if acc.path not in guarded or effective(acc):
+                    continue
+                if acc.kind == "mutate":
+                    out.append(Finding(
+                        code="HDS-L001", family=self.family,
+                        path=mod.relpath, line=acc.line,
+                        qualname=f"{cls.name}.{m.name}",
+                        symbol=acc.path,
+                        message=(f"'self.{acc.path}' is mutated "
+                                 f"under the lock elsewhere in "
+                                 f"{cls.name} but mutated here "
+                                 f"without it")))
+                elif acc.kind in ("iter", "snapshot"):
+                    out.append(Finding(
+                        code="HDS-L002", family=self.family,
+                        path=mod.relpath, line=acc.line,
+                        qualname=f"{cls.name}.{m.name}",
+                        symbol=acc.path,
+                        message=(f"snapshot read of guarded "
+                                 f"'self.{acc.path}' outside the "
+                                 f"lock ({acc.kind} is not atomic "
+                                 f"against concurrent mutation)")))
+        return out
+
+    # ------------------------------------------------------------- #
+    def _check_nesting(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+
+        def lockish(expr: ast.expr) -> Optional[str]:
+            # any receiver counts here — the inner lock is usually
+            # someone ELSE's (``other.inner_lock``, ``self._locked(r)``)
+            if isinstance(expr, ast.Attribute) and \
+                    _is_lockish_name(expr.attr):
+                return expr.attr
+            if isinstance(expr, ast.Name) and \
+                    _is_lockish_name(expr.id):
+                return expr.id
+            if isinstance(expr, ast.Call):
+                return lockish(expr.func)
+            return None
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                held_here = held
+                if isinstance(child, ast.With):
+                    names = [
+                        lockish(i.context_expr)
+                        for i in child.items
+                        if lockish(i.context_expr) is not None]
+                    if names and held and mod.lock_order is None:
+                        out.append(Finding(
+                            code="HDS-L003", family=self.family,
+                            path=mod.relpath, line=child.lineno,
+                            qualname="<module>",
+                            symbol=f"{held[-1]}->{names[0]}",
+                            message=(
+                                f"lock '{names[0]}' acquired while "
+                                f"holding '{held[-1]}' with no "
+                                f"module-level __hds_lock_order__ "
+                                f"declaration")))
+                    held_here = held + tuple(names)
+                walk(child, held_here)
+
+        walk(mod.tree, ())
+        return out

@@ -1,0 +1,263 @@
+"""Virtual-clock / determinism purity lints (family ``purity``).
+
+The committed chaos digests (CHAOS_SERVE, FLEET_SERVE, DISAGG_SERVE)
+assert byte-identical same-seed replay of the whole serving stack.
+These rules forbid, in declared sim-deterministic modules, exactly the
+constructs that would silently break that property:
+
+* **HDS-P001** — ambient wall-clock reads: ``time.time()``,
+  ``time.monotonic()`` (+ ``_ns`` variants), ``datetime.now()`` /
+  ``utcnow()`` / ``today()``. Interval timing via
+  ``time.perf_counter`` is NOT flagged — measuring how long something
+  took doesn't steer the simulation; reading "now" does. Sanctioned
+  sites (the ``MonotonicClock`` implementation, the perf registry's
+  CLI-injectable freshness default) carry allow pragmas.
+* **HDS-P002** — unseeded RNG: any call through the module-level
+  ``random.*`` / ``np.random.*`` global streams, or
+  ``default_rng()`` / ``random.Random()`` constructed without a seed.
+  Checked package-wide (not just sim modules): a shared global stream
+  is a cross-test, cross-thread determinism hazard everywhere in this
+  repo. Seeded generators (``default_rng(seed)``) pass.
+* **HDS-P003** — ``id()`` / ``hash()`` inside an ordering key
+  (``sorted``/``sort``/``min``/``max`` ``key=``): CPython ids are
+  allocation addresses and str hashes are salted per process — both
+  silently reorder events between runs.
+* **HDS-P004** — iterating a ``set`` (literal, comprehension,
+  ``set()`` call, or a local variable bound to one) without
+  ``sorted()``: hash-salted iteration order feeding event ordering is
+  the classic digest-breaker.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import AnalysisContext, Finding, ModuleInfo, Rule
+
+_WALL_CLOCK = {
+    ("time", "time"), ("time", "time_ns"),
+    ("time", "monotonic"), ("time", "monotonic_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"),
+    ("datetime", "today"),
+}
+
+#: np.random module-level functions that consume the GLOBAL stream
+_NP_GLOBAL_OK = {"default_rng", "Generator", "SeedSequence",
+                 "PCG64", "Philox", "BitGenerator", "RandomState"}
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return ".".join(parts)
+    return None
+
+
+def _is_unseeded(call: ast.Call) -> bool:
+    """``default_rng()`` / ``Random()`` with no positional seed (or an
+    explicit ``None``) draws OS entropy — unseeded."""
+    if not call.args:
+        return True
+    first = call.args[0]
+    return isinstance(first, ast.Constant) and first.value is None
+
+
+def _set_locals(func: ast.AST) -> Set[str]:
+    """Local names bound (once) to a set expression in this scope —
+    the cheap flow-insensitive approximation that catches
+    ``s = set(...) ... for x in s``."""
+    bound: Dict[str, bool] = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            is_set = isinstance(node.value, (ast.Set, ast.SetComp)) \
+                or (isinstance(node.value, ast.Call) and
+                    isinstance(node.value.func, ast.Name) and
+                    node.value.func.id in ("set", "frozenset"))
+            # rebinding to a non-set clears the mark
+            bound[name] = is_set if name not in bound \
+                else (bound[name] and is_set)
+    return {n for n, ok in bound.items() if ok}
+
+
+class PurityRule(Rule):
+    family = "purity"
+    codes = ("HDS-P001", "HDS-P002", "HDS-P003", "HDS-P004")
+
+    def check_module(self, mod: ModuleInfo,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        qual = _QualTracker(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                findings.extend(self._check_call(node, mod, qual))
+        if mod.sim_deterministic:
+            findings.extend(self._check_set_iteration(mod, qual))
+        return findings
+
+    # ------------------------------------------------------------- #
+    def _check_call(self, call: ast.Call, mod: ModuleInfo,
+                    qual) -> List[Finding]:
+        out: List[Finding] = []
+        name = _dotted(call.func)
+        if name is None:
+            return out
+        head, _, tail = name.partition(".")
+        # P001: ambient clock in sim-deterministic modules
+        if mod.sim_deterministic:
+            leaf = name.rsplit(".", 1)[-1]
+            if (head, leaf) in _WALL_CLOCK or \
+                    ("datetime", leaf) in _WALL_CLOCK and \
+                    "datetime" in name:
+                out.append(Finding(
+                    code="HDS-P001", family=self.family,
+                    path=mod.relpath, line=call.lineno,
+                    qualname=qual.at(call.lineno), symbol=name,
+                    message=(f"wall-clock call {name}() in a "
+                             f"sim-deterministic module — read the "
+                             f"injected Clock/now= instead")))
+        # P002: global-stream / unseeded RNG (package-wide)
+        if name.startswith("np.random.") or \
+                name.startswith("numpy.random."):
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in _NP_GLOBAL_OK:
+                out.append(self._p002(call, mod, qual, name,
+                                      "module-level numpy RNG stream"))
+            elif leaf in ("default_rng", "RandomState") and \
+                    _is_unseeded(call):
+                out.append(self._p002(call, mod, qual, name,
+                                      "unseeded generator"))
+        elif head == "random" and tail and "." not in tail:
+            if tail == "Random":
+                if _is_unseeded(call):
+                    out.append(self._p002(call, mod, qual, name,
+                                          "unseeded random.Random"))
+            elif tail[0].islower():
+                out.append(self._p002(call, mod, qual, name,
+                                      "module-level stdlib RNG "
+                                      "stream"))
+        # P003: id()/hash() ordering keys
+        if mod.sim_deterministic and isinstance(call.func, (
+                ast.Name, ast.Attribute)):
+            fn_leaf = name.rsplit(".", 1)[-1]
+            if fn_leaf in ("sorted", "sort", "min", "max"):
+                for kw in call.keywords:
+                    if kw.arg == "key" and _mentions_id_hash(kw.value):
+                        out.append(Finding(
+                            code="HDS-P003", family=self.family,
+                            path=mod.relpath, line=call.lineno,
+                            qualname=qual.at(call.lineno),
+                            symbol=fn_leaf,
+                            message=("ordering key uses id()/hash() "
+                                     "— address/salt dependent, "
+                                     "reorders between runs")))
+        return out
+
+    def _p002(self, call: ast.Call, mod: ModuleInfo, qual,
+              name: str, why: str) -> Finding:
+        return Finding(
+            code="HDS-P002", family=self.family, path=mod.relpath,
+            line=call.lineno, qualname=qual.at(call.lineno),
+            symbol=name,
+            message=(f"{name}() draws from a {why} — use a seeded "
+                     f"np.random.default_rng(seed) (overridable "
+                     f"default)"))
+
+    # ------------------------------------------------------------- #
+    def _check_set_iteration(self, mod: ModuleInfo,
+                             qual) -> List[Finding]:
+        out: List[Finding] = []
+
+        def scope_check(scope: ast.AST) -> None:
+            set_names = _set_locals(scope)
+
+            def is_set_expr(e: ast.expr) -> bool:
+                if isinstance(e, (ast.Set, ast.SetComp)):
+                    return True
+                if isinstance(e, ast.Call) and \
+                        isinstance(e.func, ast.Name) and \
+                        e.func.id in ("set", "frozenset"):
+                    return True
+                return isinstance(e, ast.Name) and \
+                    e.id in set_names
+
+            for node in ast.walk(scope):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node is not scope:
+                    continue
+                iters: List[ast.expr] = []
+                if isinstance(node, ast.For):
+                    iters.append(node.iter)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp,
+                                       ast.GeneratorExp)):
+                    iters.extend(g.iter for g in node.generators)
+                for it in iters:
+                    if is_set_expr(it):
+                        out.append(Finding(
+                            code="HDS-P004", family=self.family,
+                            path=mod.relpath, line=it.lineno,
+                            qualname=qual.at(it.lineno),
+                            symbol="set-iteration",
+                            message=("iterating a set in a sim-"
+                                     "deterministic module — wrap in "
+                                     "sorted() so hash salting can't "
+                                     "reorder events")))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                scope_check(node)
+        return out
+
+
+def _mentions_id_hash(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("id", "hash"):
+            return True
+        if isinstance(node, ast.Name) and node.id in ("id", "hash"):
+            # bare ``key=id``
+            return True
+    return False
+
+
+class _QualTracker:
+    """line -> enclosing Class.method / function qualname."""
+
+    def __init__(self, mod: ModuleInfo):
+        self._spans: List = []
+
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    name = (f"{prefix}.{child.name}"
+                            if prefix else child.name)
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        self._spans.append(
+                            (child.lineno,
+                             child.end_lineno or child.lineno, name))
+                    walk(child, name)
+                else:
+                    walk(child, prefix)
+
+        walk(mod.tree, "")
+        self._spans.sort()
+
+    def at(self, line: int) -> str:
+        best = "<module>"
+        for start, end, name in self._spans:
+            if start <= line <= end:
+                best = name   # innermost wins (spans sorted by start)
+        return best

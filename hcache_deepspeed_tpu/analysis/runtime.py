@@ -1,0 +1,213 @@
+"""Dynamic lock-order sentinel.
+
+The static L003 rule only sees *lexically* nested ``with`` blocks; the
+real hazard in this codebase is inter-procedural — the fleet pump holds
+``ServingFleet._lock`` while ``_drain_pass`` takes a replica's
+``ServingServer._lock`` three calls down. This module instruments the
+locks themselves:
+
+* :func:`make_lock` is the factory the serving stack uses instead of
+  ``threading.Lock()``. With the sentinel disabled (the default, and
+  production) it returns a plain ``threading.Lock`` — zero overhead,
+  the same contract as the tracer and the fault injector. With the
+  sentinel enabled (the fleet/server/chaos test suites turn it on via
+  an autouse fixture) it returns an :class:`OrderedLock`.
+* Each :class:`OrderedLock` acquisition records, per thread, the stack
+  of held lock *names* and adds an edge ``held -> acquiring`` to a
+  process-wide lock-order graph. A new edge that closes a cycle means
+  two code paths acquire the same locks in opposite orders — a future
+  deadlock — and raises :class:`LockOrderError` **deterministically at
+  the acquisition that closed the cycle**, turning a would-be hung CI
+  into a red test with both acquisition stacks in the message.
+
+Names are class-granular (``"ServingFleet._lock"``), so N replica
+server locks share one node: the graph checks the *discipline*
+("fleet before server"), which is also what a module declares
+statically via ``__hds_lock_order__``.
+"""
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+
+class LockOrderError(RuntimeError):
+    """Two code paths acquire locks in opposite orders (graph cycle).
+
+    Raised at the acquisition that closed the cycle, with the stack
+    that created each conflicting edge — deterministic, unlike the
+    deadlock it predicts."""
+
+
+class _SentinelState:
+    def __init__(self):
+        self.enabled = False
+        self._graph_lock = threading.Lock()
+        #: edge (held, acquiring) -> abbreviated stack that added it
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self._held = threading.local()
+
+    # -- per-thread held stack ------------------------------------ #
+    def held(self) -> List[str]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    # -- graph ----------------------------------------------------- #
+    def note_acquire(self, name: str) -> None:
+        if not self.enabled:
+            # an OrderedLock outliving its sentinel scope (e.g. a
+            # fleet kept across tests) goes inert, it never raises
+            return
+        stack = self.held()
+        if stack:
+            self._add_edge(stack[-1], name)
+        stack.append(name)
+
+    def note_release(self, name: str) -> None:
+        stack = self.held()
+        # release order may differ from acquire order (with-blocks
+        # guarantee LIFO, but bare acquire/release pairs may not)
+        if name in stack:
+            stack.reverse()
+            stack.remove(name)
+            stack.reverse()
+
+    def _add_edge(self, held: str, acquiring: str) -> None:
+        if held == acquiring:
+            raise LockOrderError(
+                f"re-acquiring {acquiring!r} while already holding "
+                f"it (non-reentrant lock deadlock)\n"
+                + "".join(traceback.format_stack(limit=8)))
+        key = (held, acquiring)
+        with self._graph_lock:
+            if key in self.edges:
+                return
+            cycle = self._path(acquiring, held)
+            if cycle is not None:
+                prior = " ; ".join(
+                    f"{a}->{b}: {self.edges[(a, b)]}"
+                    for a, b in zip(cycle, cycle[1:]))
+                raise LockOrderError(
+                    f"lock-order cycle: acquiring {acquiring!r} "
+                    f"while holding {held!r}, but the reverse order "
+                    f"{' -> '.join(cycle)} was already observed.\n"
+                    f"prior edge(s): {prior}\n"
+                    f"this acquisition:\n"
+                    + "".join(traceback.format_stack(limit=8)))
+            self.edges[key] = "".join(
+                traceback.format_stack(limit=4)[:-1])[-400:]
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS for a path src -> dst through recorded edges."""
+        stack = [(src, [src])]
+        seen = {src}
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in sorted(adj.get(node, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def reset(self) -> None:
+        with self._graph_lock:
+            self.edges.clear()
+        self._held = threading.local()
+
+
+_STATE = _SentinelState()
+
+
+class OrderedLock:
+    """``threading.Lock`` wrapper that feeds the lock-order graph.
+
+    Drop-in for the ``with``-statement and acquire/release/locked
+    surface the serving stack uses. The order check happens BEFORE
+    blocking on the underlying lock, so a violation raises instead of
+    deadlocking."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        _STATE.note_acquire(self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            _STATE.note_release(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _STATE.note_release(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def held_by_current_thread(self) -> bool:
+        return self.name in _STATE.held()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.name!r})"
+
+
+def make_lock(name: str):
+    """The lock factory the serving stack calls in ``__init__``:
+    plain ``threading.Lock`` unless the sentinel is enabled."""
+    if _STATE.enabled:
+        return OrderedLock(name)
+    return threading.Lock()
+
+
+def sentinel_enabled() -> bool:
+    return _STATE.enabled
+
+
+def enable_sentinel() -> _SentinelState:
+    """Turn the sentinel on (fresh graph). Locks created by
+    :func:`make_lock` from now on are instrumented; existing plain
+    locks are unaffected."""
+    _STATE.reset()
+    _STATE.enabled = True
+    return _STATE
+
+
+def disable_sentinel() -> None:
+    _STATE.enabled = False
+    _STATE.reset()
+
+
+class sentinel:
+    """``with sentinel() as state:`` — scoped enable, always disables,
+    exposes the observed edge set for assertions."""
+
+    def __enter__(self) -> _SentinelState:
+        return enable_sentinel()
+
+    def __exit__(self, *exc) -> bool:
+        disable_sentinel()
+        return False
+
+
+def observed_edges() -> Dict[Tuple[str, str], str]:
+    """Copy of the current lock-order graph (test assertion surface)."""
+    with _STATE._graph_lock:
+        return dict(_STATE.edges)

@@ -246,7 +246,8 @@ def ring_all_reduce_sum(x, axis_name, *, chunks: int = 1,
 def validate_collective_impl(impl: str) -> str:
     """Literal check for the transport knob; returns the value."""
     if impl not in COLLECTIVE_IMPLS:
-        raise ValueError(
+        from ..runtime.config import HDSConfigError
+        raise HDSConfigError(
             f"zero_collective_impl={impl!r}: expected one of "
             f"{COLLECTIVE_IMPLS}")
     return impl

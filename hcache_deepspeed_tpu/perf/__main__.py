@@ -25,7 +25,7 @@ import sys
 def _cmd_index(args) -> int:
     from .registry import write_index
     index = write_index(path=args.out, root=args.root,
-                        with_git=args.git)
+                        with_git=args.git, now=args.now)
     n_pts = sum(len(v) for v in index["series"].values())
     print(f"indexed {len(index['artifacts'])} artifacts -> "
           f"{len(index['series'])} series / {n_pts} points; "
@@ -70,7 +70,7 @@ def _cmd_check(args) -> int:
     else:
         # repo mode: the tree's best evidence per metric must still
         # reach the committed headline (history is not re-judged)
-        fresh = build_index(root)
+        fresh = build_index(root, now=args.now)
         for v in check_headline(fresh, baseline):
             if v.status == "regression":
                 failed = True
@@ -116,6 +116,11 @@ def main(argv=None) -> int:
         description="perf-artifact registry + regression sentinel")
     p.add_argument("--root", default=None,
                    help="repo root (default: auto-detect)")
+    p.add_argument("--now", type=float, default=None,
+                   help="freshness reference time (UTC epoch "
+                        "seconds); injects the ONE sanctioned wall-"
+                        "clock default in registry.build_index, "
+                        "making index/check runs reproducible")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pi = sub.add_parser("index", help="rebuild PERF_TRAJECTORY.json")

@@ -120,6 +120,10 @@ def build_index(root: Optional[str] = None, now: Optional[float] = None,
     subprocess git calls."""
     from .check import TOLERANCES
     root = root or repo_root()
+    # the ONE sanctioned wall-clock site in the deterministic-given-
+    # (tree, now) index build: the freshness default when the CLI did
+    # not inject --now; every other consumer threads now= through
+    # hds: allow(HDS-P001) sanctioned freshness default, CLI --now injects
     now = time.time() if now is None else now
     artifacts: List[Dict] = []
     series: Dict[str, List[Dict]] = {}

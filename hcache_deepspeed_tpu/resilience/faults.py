@@ -158,7 +158,8 @@ class FaultInjector:
 
     @property
     def total_fired(self) -> int:
-        return sum(self.fired.values())
+        with self._lock:
+            return sum(self.fired.values())
 
     def fire(self, site: str, **ctx) -> None:
         """Count a hit at ``site``; raise if the plan says it fails."""
@@ -200,8 +201,13 @@ class FaultInjector:
             raise fault
 
     def summary(self) -> Dict:
-        return {"hits": dict(self.hits), "fired": dict(self.fired),
-                "total_fired": self.total_fired}
+        # locked: the injector is shared across the server loop, the
+        # fleet pump and the chaos driver; dict() copies here raced
+        # concurrent fire() mutation (HDS-L002)
+        with self._lock:
+            return {"hits": dict(self.hits),
+                    "fired": dict(self.fired),
+                    "total_fired": sum(self.fired.values())}
 
 
 #: planless, permanently-disabled injector — the default the hooks see

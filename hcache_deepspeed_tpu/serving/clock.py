@@ -15,6 +15,10 @@ class MonotonicClock:
     """Wall clock (monotonic): real serving and on-hardware benches."""
 
     def now(self) -> float:
+        # sanctioned: this IS the real-clock implementation behind
+        # the Clock interface — everything sim-deterministic reads a
+        # Clock, never time.* directly
+        # hds: allow(HDS-P001) the real-clock impl behind Clock
         return time.monotonic()
 
     def sleep(self, dt: float) -> None:

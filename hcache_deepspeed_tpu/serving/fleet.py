@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.runtime import make_lock
 from ..resilience.faults import InjectedFault, get_injector
 from ..resilience.policy import ResiliencePolicy
 from ..telemetry.tracer import get_tracer
@@ -66,6 +67,14 @@ from .crossover import RestoreCrossoverModel
 from .request import Request, RequestState
 from .router import FleetRouter, ReplicaSnapshot, RouterConfig
 from .server import ServerConfig, ServingServer
+
+#: declared lock order (the static L003 rule checks the declaration
+#: exists; the dynamic lock-order sentinel enforces it at runtime):
+#: the fleet lock is always taken BEFORE any replica server's lock —
+#: the pump/operator surface holds the fleet lock while reaching into
+#: a replica via ``_locked``; no server code path ever calls back up
+#: into the fleet.
+__hds_lock_order__ = ("ServingFleet._lock", "ServingServer._lock")
 
 
 class ReplicaState(Enum):
@@ -253,7 +262,7 @@ class ServingFleet:
         self.router = FleetRouter(
             self.config.router, crossover=crossover,
             link_bytes_per_s=self.config.link_bytes_per_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingFleet._lock")
         #: not-yet-placed requests (unroutable ones wait here)
         self.pending: List[Request] = []
         self.in_transit: List[Migration] = []
@@ -327,14 +336,15 @@ class ServingFleet:
             r.server.cancel(uid)
 
     def request(self, uid: int) -> Optional[Request]:
-        if uid in self.done:
-            return self.done[uid]
-        for req in self.pending:
-            if req.uid == uid:
-                return req
-        for m in self.in_transit:
-            if m.uid == uid:
-                return m.request
+        with self._lock:
+            if uid in self.done:
+                return self.done[uid]
+            for req in self.pending:
+                if req.uid == uid:
+                    return req
+            for m in self.in_transit:
+                if m.uid == uid:
+                    return m.request
         for r in self.replicas:
             req = r.scheduler.request(uid)
             if req is not None:
@@ -359,12 +369,13 @@ class ServingFleet:
     def event_log(self) -> Dict:
         """The replayable fleet-wide event structure the chaos digest
         hashes: the fleet's own log plus every replica scheduler's."""
-        return {
-            "fleet": [list(e) for e in self.events],
-            "replicas": {str(r.id): [list(e)
-                                     for e in r.scheduler.events]
-                         for r in self.replicas},
-        }
+        with self._lock:
+            return {
+                "fleet": [list(e) for e in self.events],
+                "replicas": {str(r.id): [list(e)
+                                         for e in r.scheduler.events]
+                             for r in self.replicas},
+            }
 
     @property
     def migration_balance_ok(self) -> bool:
@@ -740,23 +751,25 @@ class ServingFleet:
         first) and put it in transit to ``dst`` (-1 = router picks at
         landing). Returns the Migration, or None when no replica holds
         a live ``uid``."""
-        for r in self.replicas:
-            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
-                continue
-            if r.scheduler.request(uid) is None or \
-                    uid in r.scheduler.done:
-                continue
-            with self._locked(r):
-                req = r.scheduler.detach_for_migration(uid)
-            if req is None:
-                return None
-            if req.state is RequestState.QUEUED:
-                # nothing cached to ship — just re-route the queue slot
-                req.replica = None
-                self.counters["requeued"] += 1
-                self.pending.append(req)
-                return None
-            return self._begin_migration(req, r.id, dst, reason)
+        with self._lock:
+            for r in self.replicas:
+                if r.state in (ReplicaState.DEAD,
+                               ReplicaState.STOPPED):
+                    continue
+                if r.scheduler.request(uid) is None or \
+                        uid in r.scheduler.done:
+                    continue
+                with self._locked(r):
+                    req = r.scheduler.detach_for_migration(uid)
+                if req is None:
+                    return None
+                if req.state is RequestState.QUEUED:
+                    # nothing cached to ship — re-route the queue slot
+                    req.replica = None
+                    self.counters["requeued"] += 1
+                    self.pending.append(req)
+                    return None
+                return self._begin_migration(req, r.id, dst, reason)
         return None
 
     # ------------------------------------------------------------- #
@@ -768,14 +781,15 @@ class ServingFleet:
         latents (running ones preempted first) until it is empty, then
         it stops with its block pool intact."""
         r = self.replicas[replica_id]
-        if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
-            raise ValueError(
-                f"replica {replica_id} is {r.state.name}")
-        if r.state is ReplicaState.UP:
-            r.state = ReplicaState.DRAINING
-        else:
-            r.prev_state = ReplicaState.DRAINING
-        self._event("drain_begin", -1, f"replica={replica_id}")
+        with self._lock:
+            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                raise ValueError(
+                    f"replica {replica_id} is {r.state.name}")
+            if r.state is ReplicaState.UP:
+                r.state = ReplicaState.DRAINING
+            else:
+                r.prev_state = ReplicaState.DRAINING
+            self._event("drain_begin", -1, f"replica={replica_id}")
 
     def _drain_pass(self, routable) -> None:
         for r in self.replicas:
@@ -819,6 +833,10 @@ class ServingFleet:
     # ------------------------------------------------------------- #
     # one fleet step (virtual-clock deterministic core)
     # ------------------------------------------------------------- #
+    # the virtual-clock sim driver is single-threaded by contract
+    # (raises under a live pump thread; thread mode mutates only via
+    # the locked _pump_once):
+    # hds: allow(HDS-L001,HDS-L002) sim step() is single-threaded
     def step(self) -> Dict[int, object]:
         """One fleet step: fault sites -> heals -> probes -> transit
         landings -> routing -> rebalance -> drain -> every live
@@ -938,15 +956,29 @@ class ServingFleet:
 
     def _pump(self) -> None:
         while not self._stop.is_set():
-            self.step_idx += 1
-            now = self.clock.now()
-            try:
+            self._pump_once()
+            self._stop.wait(self.config.pump_interval_s)
+
+    def _pump_once(self) -> None:
+        """One pump iteration (thread mode). EVERY fleet-state
+        mutation pass runs under the fleet lock: the rebalance/drain/
+        tier passes mutate ``pending``/``in_transit``/counters through
+        ``_begin_migration`` and raced concurrent ``submit``/
+        ``cancel`` callers when they ran outside it (HDS-L001 — the
+        lock-discipline analyzer's first true positive in this file).
+        Replica server locks are taken strictly INSIDE the fleet lock
+        (``__hds_lock_order__``); no server path calls back into the
+        fleet, so the order is acyclic — enforced by the dynamic
+        lock-order sentinel in the fleet test suites."""
+        now = self.clock.now()
+        try:
+            with self._lock:
+                self.step_idx += 1
                 self._fault_pass()
                 self._heal_pass()
                 routable = self._probe_pass()
-                with self._lock:
-                    self._transit_pass(now, routable)
-                    self._route_pass(now, routable)
+                self._transit_pass(now, routable)
+                self._route_pass(now, routable)
                 self._rebalance_pass(routable)
                 self._drain_pass(routable)
                 self._tier_pass(now, routable)
@@ -955,9 +987,9 @@ class ServingFleet:
                             r.server._thread is not None and \
                             r.server._thread.is_alive():
                         r.steps += 1
-            except Exception as exc:    # noqa: BLE001 — keep pumping
+        except Exception as exc:    # noqa: BLE001 — keep pumping
+            with self._lock:
                 self._event("pump_error", -1, repr(exc))
-            self._stop.wait(self.config.pump_interval_s)
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         if self._pump_thread is None:
@@ -976,6 +1008,15 @@ class ServingFleet:
     # observability
     # ------------------------------------------------------------- #
     def summary(self) -> Dict:
+        """Whole-fleet introspection dict. Locked: in thread mode this
+        is the operator surface and reads the counters/transit/pending
+        state the pump mutates — an unlocked read here is a torn
+        snapshot (HDS-L002, the analyzer's second true positive in
+        this file)."""
+        with self._lock:
+            return self._summary_locked()
+
+    def _summary_locked(self) -> Dict:
         per_replica = {}
         for r in self.replicas:
             per_replica[str(r.id)] = {
@@ -1016,6 +1057,10 @@ class ServingFleet:
         ``{"replica": "<id>"}`` plus fleet-scope migration counters
         and per-replica state/occupancy gauges."""
         from ..telemetry.prometheus import MetricRegistry
+        with self._lock:
+            return self._registry_locked(MetricRegistry)
+
+    def _registry_locked(self, MetricRegistry):
         reg = MetricRegistry(namespace="hds_fleet")
         for r in self.replicas:
             # per-tier const labels: every serving metric family is
@@ -1059,6 +1104,10 @@ class ServingFleet:
         return self.metrics_registry().render()
 
     def snapshot(self, last_events: int = 20) -> str:
+        with self._lock:
+            return self._snapshot_locked(last_events)
+
+    def _snapshot_locked(self, last_events: int = 20) -> str:
         lines = [
             "fleet snapshot:",
             f"  step={self.step_idx} pending={len(self.pending)} "

@@ -24,6 +24,7 @@ import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
+from ..analysis.runtime import make_lock
 from .clock import MonotonicClock, VirtualClock
 from .metrics import ServingMetrics
 from .request import Request, RequestState
@@ -92,7 +93,7 @@ class ServingServer:
             self.config.restore_priority_barrier)
         self.monitor = monitor
         self.emit_every_steps = emit_every_steps
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingServer._lock")
         self._ingress: List[Request] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -240,7 +241,16 @@ class ServingServer:
 
     def _snapshot(self, last_events: int = 20) -> str:
         """Diagnostic scheduler snapshot attached to livelock/crash
-        errors — the state one actually needs to debug a wedge."""
+        errors — the state one actually needs to debug a wedge.
+        Locked: it renders ``_ingress`` and the scheduler pools that
+        the loop thread mutates, and its callers (``run_trace``'s
+        livelock raise, the post-mortem log in ``_on_loop_error``)
+        hold nothing — an unlocked render here was a torn diagnostic
+        (HDS-L002)."""
+        with self._lock:
+            return self._snapshot_locked(last_events)
+
+    def _snapshot_locked(self, last_events: int = 20) -> str:
         s = self.scheduler
         lanes = list(getattr(s.engine, "restoring_uids", ()))
         lines = [

@@ -139,7 +139,11 @@ class Tracer:
                 fn = platform.annotate
         except Exception:
             fn = None
-        self._annotate_fn = fn
+        # ``configure(xla=...)`` writes _annotate_fn under the lock;
+        # resolving from a span on another thread must too, or a
+        # concurrent reconfigure can be clobbered by a stale resolve
+        with self._lock:
+            self._annotate_fn = fn
         return fn
 
     def _tid(self):
@@ -174,7 +178,10 @@ class Tracer:
         if args:
             ev["args"] = args
         ev.update(extra)
-        self._events.append(ev)           # deque append: atomic
+        # the lock-free hot path is the design; readers copy under
+        # the lock (module docstring)
+        # hds: allow(HDS-L001) deque.append is atomic under the GIL
+        self._events.append(ev)
 
     # -------------------------------------------------------------- #
     # recording API

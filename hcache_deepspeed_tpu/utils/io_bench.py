@@ -24,14 +24,23 @@ def _mb(n):
 
 def run_bench(path: str, size_mb: int = 256, threads: int = 4,
               queue_depth: int = 32, block_mb: int = 8,
-              read: bool = True, write: bool = True) -> dict:
-    """Returns {write_gbs, read_gbs} for one configuration point."""
+              read: bool = True, write: bool = True,
+              seed: int = 0) -> dict:
+    """Returns {write_gbs, read_gbs} for one configuration point.
+
+    Block contents come from a generator seeded with ``seed``
+    (deterministic by default, overridable): identical payload bytes
+    across runs make throughput numbers comparable — compressing or
+    dedup'ing storage sees the same entropy every time — and keep the
+    module clean under the determinism purity lint (HDS-P002).
+    """
     from ..ops.native.aio import AsyncIOHandle
     handle = AsyncIOHandle(num_threads=threads, queue_depth=queue_depth)
+    rng = np.random.default_rng(seed)
     nblocks = max(size_mb // block_mb, 1)
     total_mb = nblocks * block_mb   # bytes actually moved (!= size_mb
     # when block_mb does not divide it — throughput must use this)
-    blocks = [np.random.randint(0, 256, _mb(block_mb), np.uint8)
+    blocks = [rng.integers(0, 256, _mb(block_mb), np.uint8)
               for _ in range(min(nblocks, 4))]
     out = {"size_mb": total_mb, "threads": threads,
            "queue_depth": queue_depth, "block_mb": block_mb}
@@ -87,7 +96,7 @@ def run_bench(path: str, size_mb: int = 256, threads: int = 4,
     return out
 
 
-def tune(path: str, size_mb: int = 256) -> dict:
+def tune(path: str, size_mb: int = 256, seed: int = 0) -> dict:
     """Sweep (threads, queue_depth, block) and report the best point
     (reference: ds_nvme_tune's grid over the same knobs)."""
     best, results = None, []
@@ -95,7 +104,8 @@ def tune(path: str, size_mb: int = 256) -> dict:
         for qd in (8, 32):
             for block_mb in (1, 8):
                 r = run_bench(path, size_mb=size_mb, threads=threads,
-                              queue_depth=qd, block_mb=block_mb)
+                              queue_depth=qd, block_mb=block_mb,
+                              seed=seed)
                 results.append(r)
                 score = r.get("read_gbs", 0) + r.get("write_gbs", 0)
                 if best is None or score > best[0]:
@@ -113,12 +123,16 @@ def main(argv=None):
     p.add_argument("--block-mb", type=int, default=8)
     p.add_argument("--tune", action="store_true",
                    help="sweep the knob grid (hds_nvme_tune mode)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="payload-content seed (deterministic default)")
     args = p.parse_args(argv)
     path = args.path or os.path.join(tempfile.gettempdir(), "hds_io_bench")
     if args.tune:
-        print(json.dumps(tune(path, size_mb=args.size_mb), indent=2))
+        print(json.dumps(tune(path, size_mb=args.size_mb,
+                              seed=args.seed), indent=2))
     else:
         print(json.dumps(run_bench(
             path, size_mb=args.size_mb, threads=args.threads,
-            queue_depth=args.queue_depth, block_mb=args.block_mb)))
+            queue_depth=args.queue_depth, block_mb=args.block_mb,
+            seed=args.seed)))
     return 0

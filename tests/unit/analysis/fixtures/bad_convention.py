@@ -1,0 +1,18 @@
+"""Golden BAD fixture for the convention family (C001/C002/C003)."""
+
+from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+
+
+def open_span(uid):
+    get_tracer().async_begin("orphan.span", uid)     # HDS-C001
+    # (no async_end("orphan.span") anywhere in this tree)
+
+
+def validate_widget(cfg):
+    if cfg.widgets < 0:
+        raise ValueError("widgets must be >= 0")     # HDS-C002
+
+
+def muted():
+    # hds: allow(HDS-P001)
+    return 1                                         # HDS-C003 above

@@ -1,0 +1,57 @@
+"""Golden BAD fixture: a serving-shaped module that must trip the
+lock-discipline and purity families. Each marked line is asserted by
+finding code in tests/unit/analysis/test_rules.py — this is also the
+demonstration that a NEW unguarded access or wall-clock call
+introduced into serving/ would fail the tier-1 gate."""
+
+import threading
+import time
+
+import numpy as np
+
+__hds_sim_deterministic__ = True
+
+
+class BadServer:
+    """Mutates and snapshot-reads guarded state outside its lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue = []
+        self.counters = {}
+        self.error = None
+
+    def submit(self, item):
+        with self._lock:
+            self.queue.append(item)          # guards 'queue'
+            self.counters["in"] = 1          # guards 'counters'
+
+    def drop_unlocked(self):
+        self.queue.clear()                   # HDS-L001
+
+    def torn_snapshot(self):
+        return list(self.queue)              # HDS-L002
+
+    def iter_counters(self):
+        return [k for k in self.counters.items()]   # HDS-L002
+
+    def wall_clock_deadline(self):
+        return time.time() + 5.0             # HDS-P001
+
+    def nested_no_order(self, other):
+        with self._lock:
+            with other.inner_lock:           # HDS-L003 (no declared
+                return True                  # __hds_lock_order__)
+
+
+def unsorted_fanout(replicas):
+    ready = set(replicas)
+    return [r for r in ready]                # HDS-P004
+
+
+def order_by_identity(reqs):
+    return sorted(reqs, key=lambda r: id(r))   # HDS-P003
+
+
+def retry_jitter():
+    return np.random.random()                  # HDS-P002

@@ -1,0 +1,24 @@
+"""Golden GOOD fixture: the corrected convention twin."""
+
+from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+from hcache_deepspeed_tpu.telemetry.tracer import get_tracer
+
+
+def open_span(uid):
+    get_tracer().async_begin("paired.span", uid)
+
+
+def close_span(uid):
+    get_tracer().async_end("paired.span", uid)
+
+
+def validate_widget(cfg):
+    if cfg.widgets < 0:
+        raise HDSConfigError("widgets must be >= 0")
+
+
+def validate_payload(blob):
+    """Data-format validator; raises ``ValueError`` by documented
+    contract (the C002 exemption)."""
+    if not isinstance(blob, dict):
+        raise ValueError("payload must be a dict")

@@ -1,0 +1,56 @@
+"""Golden GOOD fixture: the corrected twin of bad_serving.py — the
+same operations under the documented discipline must produce ZERO
+findings."""
+
+import threading
+
+import numpy as np
+
+__hds_sim_deterministic__ = True
+__hds_lock_order__ = ("GoodServer._lock", "Other.inner_lock")
+
+
+class GoodServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue = []
+        self.counters = {}
+        self.clock = None
+
+    def submit(self, item):
+        with self._lock:
+            self.queue.append(item)
+            self.counters["in"] = 1
+
+    def drop_locked(self):
+        with self._lock:
+            self.queue.clear()
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.queue)
+
+    def iter_counters(self):
+        with self._lock:
+            return [k for k in self.counters.items()]
+
+    def injected_deadline(self):
+        return self.clock.now() + 5.0
+
+    def nested_declared(self, other):
+        with self._lock:
+            with other.inner_lock:
+                return True
+
+
+def sorted_fanout(replicas):
+    ready = set(replicas)
+    return [r for r in sorted(ready)]
+
+
+def order_by_uid(reqs):
+    return sorted(reqs, key=lambda r: r.uid)
+
+
+def retry_jitter(seed=0):
+    return np.random.default_rng(seed).random()

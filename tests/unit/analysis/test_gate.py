@@ -1,0 +1,88 @@
+"""Tier-1 gate: the analyzer over the WHOLE package must be clean.
+
+* zero non-baselined findings (a new unguarded access, wall-clock
+  call, unpaired span, untyped validator raise, or schema-less
+  artifact literal anywhere in the tree fails tier-1 — the fixture
+  tests in test_rules.py prove each code actually trips);
+* zero stale baseline entries (a baselined finding that no longer
+  fires is rot that would mask a future regression at the same
+  fingerprint — remove it);
+* the sanctioned-site ledger stays exactly the documented set (a new
+  pragma is a reviewed decision, not a drive-by mute).
+"""
+
+import os
+import subprocess
+import sys
+
+import hcache_deepspeed_tpu
+from hcache_deepspeed_tpu.analysis import (AnalysisConfig, gate,
+                                           load_baseline,
+                                           run_analysis)
+
+PKG = os.path.dirname(os.path.abspath(hcache_deepspeed_tpu.__file__))
+REPO = os.path.dirname(PKG)
+
+
+def repo_config():
+    bench = os.path.join(REPO, "bench.py")
+    extra = (bench,) if os.path.exists(bench) else ()
+    return AnalysisConfig(root=PKG, extra_files=extra,
+                          perf_lint=bool(extra),
+                          repo_root=REPO if extra else None)
+
+
+def test_tree_is_clean_against_baseline():
+    report = run_analysis(repo_config())
+    new, stale = gate(report, load_baseline())
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], stale
+
+
+def test_rule_families_all_ran():
+    """An empty finding list must mean 'clean', not 'rules skipped':
+    the walk covered the serving stack and the known sanctioned
+    sites were classified (they only exist if their rules ran)."""
+    report = run_analysis(repo_config())
+    assert report.n_modules > 100
+    sanctioned_codes = {f.code for f, _ in report.sanctioned}
+    assert "HDS-P001" in sanctioned_codes   # purity ran
+    assert "HDS-L001" in sanctioned_codes   # lock discipline ran
+
+
+def test_sanctioned_ledger_is_exact():
+    """Every pragma'd site is a reviewed exception; this is the
+    review. New pragmas must be added here deliberately."""
+    report = run_analysis(repo_config())
+    sites = sorted((f.path, f.code) for f, _ in report.sanctioned)
+    assert sites == [
+        ("hcache_deepspeed_tpu/perf/registry.py", "HDS-P001"),
+        ("hcache_deepspeed_tpu/serving/clock.py", "HDS-P001"),
+        ("hcache_deepspeed_tpu/serving/fleet.py", "HDS-L001"),
+        ("hcache_deepspeed_tpu/serving/fleet.py", "HDS-L002"),
+        ("hcache_deepspeed_tpu/telemetry/tracer.py", "HDS-L001"),
+    ], sites
+
+
+def test_cli_exit_codes(tmp_path):
+    """``python -m hcache_deepspeed_tpu.analysis`` exits 0 on the
+    tree (the committed contract) and nonzero on a tree with a fresh
+    finding."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "hcache_deepspeed_tpu.analysis"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "racy.py").write_text(
+        "__hds_sim_deterministic__ = True\n"
+        "import time\n\n"
+        "def now():\n"
+        "    return time.time()\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "hcache_deepspeed_tpu.analysis",
+         "--root", str(bad), "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "HDS-P001" in res.stdout

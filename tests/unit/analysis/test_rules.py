@@ -1,0 +1,186 @@
+"""Golden-fixture tests per rule family: every finding code must trip
+on its bad snippet and stay silent on the corrected twin. This is
+also the demonstration required by the tier-1 gate acceptance: a NEW
+unguarded-attribute access or wall-clock call in a serving-shaped
+(sim-deterministic, locked) module IS caught by the analyzer — so
+introducing one into ``serving/`` would fail ``test_gate.py``.
+"""
+
+import os
+
+import pytest
+
+from hcache_deepspeed_tpu.analysis import (AnalysisConfig, gate,
+                                           run_analysis)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def analyze(*names):
+    cfg = AnalysisConfig(
+        root=FIXTURES, sim_deterministic=(), perf_lint=False)
+    report = run_analysis(cfg)
+    if names:
+        keep = {f"fixtures/{n}" for n in names}
+        report.findings = [f for f in report.findings
+                           if f.path in keep]
+    return report
+
+
+@pytest.fixture(scope="module")
+def full_report():
+    return analyze()
+
+
+# ------------------------------------------------------------------ #
+# the acceptance bar: >= 6 distinct codes across >= 3 families
+# ------------------------------------------------------------------ #
+def test_fixture_coverage_bar(full_report):
+    bad = [f for f in full_report.findings
+           if f.path.startswith("fixtures/bad_")]
+    codes = {f.code for f in bad}
+    families = {f.family for f in bad}
+    assert len(codes) >= 6, sorted(codes)
+    assert len(families) >= 3, sorted(families)
+
+
+def test_good_twins_are_clean(full_report):
+    good = [f for f in full_report.findings
+            if f.path.startswith("fixtures/good_")]
+    assert good == [], [f.render() for f in good]
+
+
+# ------------------------------------------------------------------ #
+# lock family
+# ------------------------------------------------------------------ #
+def fired(report, code, qual_contains=""):
+    return [f for f in report.findings
+            if f.code == code and qual_contains in f.qualname]
+
+
+def test_l001_unlocked_mutation(full_report):
+    hits = fired(full_report, "HDS-L001", "drop_unlocked")
+    assert len(hits) == 1 and hits[0].symbol == "queue"
+
+
+def test_l002_torn_snapshot_and_iteration(full_report):
+    assert fired(full_report, "HDS-L002", "torn_snapshot")
+    assert fired(full_report, "HDS-L002", "iter_counters")
+
+
+def test_l003_undeclared_nested_locks(full_report):
+    hits = fired(full_report, "HDS-L003")
+    assert any("inner_lock" in f.symbol for f in hits)
+
+
+def test_locked_twin_inference():
+    """The good twin exercises the SAME operations under the lock —
+    the guarded-set inference must recognize the discipline, not the
+    operation."""
+    rep = analyze("good_serving.py")
+    assert not [f for f in rep.findings
+                if f.code.startswith("HDS-L")]
+
+
+# ------------------------------------------------------------------ #
+# purity family
+# ------------------------------------------------------------------ #
+def test_p001_wall_clock(full_report):
+    hits = fired(full_report, "HDS-P001", "wall_clock_deadline")
+    assert hits and hits[0].symbol == "time.time"
+
+
+def test_p002_unseeded_rng(full_report):
+    assert fired(full_report, "HDS-P002", "retry_jitter")
+
+
+def test_p003_identity_ordering(full_report):
+    assert fired(full_report, "HDS-P003", "order_by_identity")
+
+
+def test_p004_set_iteration(full_report):
+    assert fired(full_report, "HDS-P004", "unsorted_fanout")
+
+
+def test_purity_scoped_to_declared_modules(tmp_path):
+    """Without the sim-deterministic declaration the wall-clock rule
+    stays quiet — purity is an opt-in contract, not a global ban."""
+    src = "import time\n\ndef f():\n    return time.time()\n"
+    (tmp_path / "plain.py").write_text(src)
+    rep = run_analysis(AnalysisConfig(
+        root=str(tmp_path), sim_deterministic=(), perf_lint=False))
+    assert not [f for f in rep.findings if f.code == "HDS-P001"]
+    (tmp_path / "declared.py").write_text(
+        "__hds_sim_deterministic__ = True\n" + src)
+    rep = run_analysis(AnalysisConfig(
+        root=str(tmp_path), sim_deterministic=(), perf_lint=False))
+    assert [f for f in rep.findings if f.code == "HDS-P001"]
+
+
+# ------------------------------------------------------------------ #
+# convention family
+# ------------------------------------------------------------------ #
+def test_c001_unpaired_async_span(full_report):
+    hits = [f for f in full_report.findings if f.code == "HDS-C001"]
+    assert any(f.symbol == "orphan.span" for f in hits)
+    assert not any(f.symbol == "paired.span" for f in hits)
+
+
+def test_c002_untyped_config_raise(full_report):
+    hits = fired(full_report, "HDS-C002", "validate_widget")
+    assert hits and hits[0].symbol == "ValueError"
+
+
+def test_c002_documented_raise_exempt(full_report):
+    # good_convention.validate_payload documents its ValueError
+    assert not [f for f in full_report.findings
+                if f.code == "HDS-C002" and
+                "validate_payload" in f.qualname]
+
+
+def test_c003_reasonless_pragma(full_report):
+    assert [f for f in full_report.findings
+            if f.code == "HDS-C003" and
+            f.path == "fixtures/bad_convention.py"]
+
+
+# ------------------------------------------------------------------ #
+# pragma + baseline machinery
+# ------------------------------------------------------------------ #
+def test_allow_pragma_sanctions_with_reason(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "__hds_sim_deterministic__ = True\n"
+        "import time\n\n"
+        "def f():\n"
+        "    # hds: allow(HDS-P001) the one sanctioned clock here\n"
+        "    return time.time()\n")
+    rep = run_analysis(AnalysisConfig(
+        root=str(tmp_path), sim_deterministic=(), perf_lint=False))
+    assert rep.findings == []
+    assert len(rep.sanctioned) == 1
+
+
+def test_baseline_gate_new_and_stale(full_report):
+    report = analyze("bad_serving.py")
+    assert report.findings
+    # everything baselined -> no new, nothing stale
+    baseline = {f.fingerprint: "seeded" for f in report.findings}
+    new, stale = gate(report, baseline)
+    assert new == [] and stale == []
+    # one entry removed -> that finding is new again
+    fp0 = report.findings[0].fingerprint
+    del baseline[fp0]
+    new, stale = gate(report, baseline)
+    assert [f.fingerprint for f in new] == [fp0] and stale == []
+    # a fixed (no-longer-firing) entry is STALE -> gate failure
+    baseline[fp0] = "back"
+    baseline["HDS-L001:gone.py:Cls.m:attr"] = "fixed long ago"
+    new, stale = gate(report, baseline)
+    assert new == [] and \
+        stale == ["HDS-L001:gone.py:Cls.m:attr"]
+
+
+def test_fingerprints_are_line_free(full_report):
+    for f in full_report.findings:
+        assert str(f.line) not in f.fingerprint.split(":")

@@ -1,0 +1,151 @@
+"""Dynamic lock-order sentinel: the cycle repro the ISSUE requires —
+a future deadlock becomes a deterministic raise, not a hung CI."""
+
+import threading
+
+import pytest
+
+from hcache_deepspeed_tpu.analysis.runtime import (
+    LockOrderError, OrderedLock, disable_sentinel, enable_sentinel,
+    make_lock, observed_edges, sentinel, sentinel_enabled)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    disable_sentinel()
+    yield
+    disable_sentinel()
+
+
+def test_make_lock_plain_when_disabled():
+    lock = make_lock("X")
+    assert isinstance(lock, type(threading.Lock()))
+
+
+def test_make_lock_instrumented_when_enabled():
+    with sentinel():
+        lock = make_lock("X")
+        assert isinstance(lock, OrderedLock)
+        assert sentinel_enabled()
+    assert not sentinel_enabled()
+
+
+def test_nesting_records_edge():
+    with sentinel():
+        a, b = OrderedLock("A"), OrderedLock("B")
+        with a:
+            with b:
+                pass
+        assert ("A", "B") in observed_edges()
+
+
+def test_cycle_raises_deterministically():
+    """A->B observed, then B->A attempted: raises at the acquisition
+    that closes the cycle — every run, no thread timing involved."""
+    for _ in range(3):           # deterministic across repeats
+        disable_sentinel()
+        enable_sentinel()
+        a, b = OrderedLock("A"), OrderedLock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderError) as err:
+                a.acquire()
+        assert "A" in str(err.value) and "B" in str(err.value)
+
+
+def test_three_lock_cycle():
+    with sentinel():
+        a, b, c = OrderedLock("A"), OrderedLock("B"), OrderedLock("C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with pytest.raises(LockOrderError):
+                a.acquire()
+
+
+def test_self_reacquire_raises_instead_of_deadlocking():
+    with sentinel():
+        a = OrderedLock("A")
+        with a:
+            with pytest.raises(LockOrderError):
+                a.acquire()
+
+
+def test_consistent_order_never_raises():
+    with sentinel():
+        a, b = OrderedLock("A"), OrderedLock("B")
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+
+def test_cross_thread_edges_meet_in_one_graph():
+    """Thread 1 establishes A->B; thread 2's B->A attempt raises —
+    the graph is process-wide, which is exactly what makes a
+    *potential* deadlock (opposite orders that happened not to
+    interleave this run) a failure anyway."""
+    with sentinel():
+        a, b = OrderedLock("A"), OrderedLock("B")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=forward)
+        t.start()
+        t.join()
+        assert ("A", "B") in observed_edges()
+        caught = []
+
+        def reverse():
+            try:
+                with b:
+                    with a:
+                        pass
+            except LockOrderError as exc:
+                caught.append(exc)
+
+        t2 = threading.Thread(target=reverse)
+        t2.start()
+        t2.join()
+        assert caught, "reverse order on another thread must raise"
+
+
+def test_failed_timeout_acquire_rolls_back_held_stack():
+    with sentinel():
+        a = OrderedLock("A")
+        holder = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            with a:
+                holder.set()
+                release.wait(5)
+
+        t = threading.Thread(target=hold)
+        t.start()
+        holder.wait(5)
+        assert a.acquire(timeout=0.01) is False
+        assert not a.held_by_current_thread()
+        release.set()
+        t.join()
+
+
+def test_outliving_lock_goes_inert():
+    with sentinel():
+        a, b = OrderedLock("A"), OrderedLock("B")
+        with a:
+            with b:
+                pass
+    # sentinel off: the reverse order must NOT raise in production
+    with b:
+        with a:
+            pass

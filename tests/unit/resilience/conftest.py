@@ -1,0 +1,14 @@
+"""Resilience/chaos suite harness: dynamic lock-order sentinel ON
+(see tests/unit/serving/conftest.py — same contract: a lock-order
+cycle anywhere in a chaos run is a deterministic test failure, not a
+hung CI)."""
+
+import pytest
+
+from hcache_deepspeed_tpu.analysis.runtime import sentinel
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sentinel():
+    with sentinel() as state:
+        yield state

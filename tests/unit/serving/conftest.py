@@ -1,0 +1,19 @@
+"""Serving suite harness: the dynamic lock-order sentinel is ON.
+
+Every ``ServingServer``/``ServingFleet`` built in these tests gets
+instrumented locks (``analysis.runtime.make_lock``): each acquisition
+feeds the process-wide lock-order graph and a cycle — two code paths
+taking the same locks in opposite orders — raises
+``LockOrderError`` deterministically instead of deadlocking a future
+CI run. The graph resets per test.
+"""
+
+import pytest
+
+from hcache_deepspeed_tpu.analysis.runtime import sentinel
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sentinel():
+    with sentinel() as state:
+        yield state
