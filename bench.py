@@ -535,9 +535,14 @@ def run_zero_overlap(out_path=None):
     QUANTIZED-WIRE config (bucketed int8 reduce-scatter + error
     feedback + fused qwZ matmul consumption) with wire-bytes-saved per
     collective op recorded from the comms logger AND the compiled
-    module, re-runs the Domino half-batch all-reduce audit (full-width
-    + int8-wire) through the explicit async-issue helper, and emits one
-    JSONL row per measurement plus a summary line. Runs entirely on
+    module, audits the decomposed flat-ring AND hierarchical (2-D mesh,
+    ``comm/hierarchical.py``) transports — bitwise parity vs native,
+    per-mesh-axis wire bytes, inter-axis quantized fraction, and
+    modeled pod-scale wire seconds from the declared per-axis
+    bandwidths — re-runs the Domino half-batch all-reduce audit
+    (full-width + int8-wire + decomposed + hierarchical) through the
+    explicit async-issue helper, and emits one JSONL row per
+    measurement plus a summary line. Runs entirely on
     CPU — never touches the TPU relay — so the artifact is reproducible
     anywhere (native async pairs are expected to be 0 here; the derived
     tier is the CPU-decidable evidence).
@@ -752,6 +757,129 @@ def run_zero_overlap(out_path=None):
         "max_permute_chain_len": dec_chain_max,
     })
 
+    # ---- hierarchical (2-D mesh) collectives, zero_collective_impl=
+    # hierarchical: the flat data axis declared as a 2x4 mesh
+    # (outer/long-haul "inter" axis of 2, fast "intra" axis of 4), the
+    # gather/reduce lanes riding per-axis grouped ring phases
+    # (comm/hierarchical.py). Gates: bitwise parity vs the native AND
+    # flat-ring transports (plain + quantized wire), inter-axis wire
+    # bytes of the quantized run <= 0.35x the all-full-width
+    # hierarchical run, structural overlap >= the flat rings on at
+    # least one lane, and modeled pod-scale wire seconds per axis.
+    HIER = {"zero_collective_impl": "hierarchical",
+            "zero_mesh_shape": [2, 4]}
+    #: declared wire-cost model inputs (NOT measurements): the v5e-256
+    #: pod target as a 16x16 mesh, fast axis at ICI-class 45 GB/s per
+    #: device, long-haul axis priced at DCN-class 6.75 GB/s — the
+    #: EQuARX bandwidth asymmetry the axis-selective quantization spends
+    #: its bits against
+    HIER_TOY_SIZES = {"inter": 2, "intra": 4}
+    HIER_POD_SIZES = {"inter": 16, "intra": 16}
+    HIER_GBPS = {"inter": 6.75, "intra": 45.0}
+
+    def hier_run(phase, **extra):
+        comms.reset()
+        engine = build(True, **extra)
+        report, row = engine.zero_overlap_report(data)
+        losses = [float(engine.train_batch(batch=data))
+                  for _ in range(3)]
+        params = jax.tree.leaves(engine.state["params"])
+        row.update({
+            "phase": phase, "prefetch": True,
+            "ring_permute_bytes": comms.permute_bytes_summary(),
+            "ring_permute_axis_bytes": comms.permute_axis_bytes(),
+            "axis_bytes": comms.total_axis_bytes(),
+            "wire_savings": comms.wire_savings_summary(),
+        })
+        rows.append(row)
+        return row, losses, params
+
+    h_row, h_losses, h_params = hier_run("zero3-audit-hierarchical",
+                                         **HIER)
+    hier_bitwise_native = (h_losses == losses[True] and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(h_params, params[True])))
+    hier_bitwise_flat = (h_losses == d_losses[True] and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(h_params, d_params[True])))
+
+    # all-full-width hierarchical (qwZ off) — the inter-axis byte
+    # DENOMINATOR, plus a full-width flat-ring twin for bitwise parity
+    comms.reset()
+    engine = build(True, zero_quantized_weights=False,
+                   zero_collective_impl="decomposed")
+    fwd_losses = [float(engine.train_batch(batch=data))
+                  for _ in range(3)]
+    fwd_params = jax.tree.leaves(engine.state["params"])
+    fw_row, fw_losses, fw_params = hier_run(
+        "zero3-audit-hierarchical-fullwidth",
+        zero_quantized_weights=False, **HIER)
+    hier_fw_bitwise_flat = (fw_losses == fwd_losses and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(fw_params, fwd_params)))
+
+    # quantized wire over the hierarchical transport (qwZ gather +
+    # bucketed int8 reduce-scatter + EF + fused matmul consumption):
+    # every long-haul byte rides int8 — the inter-axis NUMERATOR
+    hq_row, hq_losses, hq_params = hier_run(
+        "zero3-audit-hierarchical-qwire",
+        zero_quantized_reduce_scatter=True,
+        zero_reduce_scatter_error_feedback=True,
+        zero_quantized_weights_fused_matmul=True, **HIER)
+    hier_qwire_bitwise = (hq_losses == q_losses[True] and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(hq_params, q_params[True])))
+
+    # axis-selective long-haul quantization of the fp gather lane
+    # (zero_longhaul_wire_bits): full width intra, int8 inter — values
+    # change only for long-haul rows, gated on trajectory tolerance
+    # like every lossy wire, plus the matched-pair wire fraction
+    lh_row, lh_losses, _ = hier_run(
+        "zero3-audit-hierarchical-longhaul",
+        zero_quantized_weights=False, zero_longhaul_wire_bits=8, **HIER)
+    lh_frac = lh_row["wire_savings"].get(
+        "zero_hier_all_gather_longhaul", {}).get("fraction")
+    lh_traj_ok = bool(np.allclose(lh_losses, fw_losses, rtol=5e-2))
+
+    fw_inter = fw_row["axis_bytes"].get("inter", 0)
+    hq_inter = hq_row["axis_bytes"].get("inter", 0)
+    hier_interaxis_fraction = round(hq_inter / fw_inter, 4) \
+        if fw_inter else None
+    hier_structural = max(h_row["structural_overlap_ratio"],
+                          hq_row["structural_overlap_ratio"])
+
+    # modeled wire seconds: measured per-axis bytes of the quantized
+    # run priced at the declared toy bandwidths, and projected to the
+    # declared 16x16 pod mesh (assumption recorded in the row)
+    from hcache_deepspeed_tpu.profiling.hlo_audit import (
+        pod_scale_wire_seconds, wire_cost_seconds)
+    hier_cost_toy = wire_cost_seconds(hq_row["axis_bytes"], HIER_GBPS)
+    hier_cost_pod = pod_scale_wire_seconds(
+        hq_row["axis_bytes"], HIER_TOY_SIZES, HIER_POD_SIZES, HIER_GBPS)
+    fw_cost_pod = pod_scale_wire_seconds(
+        fw_row["axis_bytes"], HIER_TOY_SIZES, HIER_POD_SIZES, HIER_GBPS)
+    rows.append({
+        "phase": "hierarchical-parity", "steps": 3,
+        "mesh_spec": h_row.get("mesh_spec"),
+        "bitwise_vs_native": hier_bitwise_native,
+        "bitwise_vs_flat": hier_bitwise_flat,
+        "fullwidth_bitwise_vs_flat": hier_fw_bitwise_flat,
+        "qwire_bitwise_vs_native_qwire": hier_qwire_bitwise,
+        "losses": h_losses,
+        "structural_overlap_ratio": hier_structural,
+        "structural_ge_flat": bool(hier_structural >= structural),
+        "interaxis_wire_bytes_quantized": hq_inter,
+        "interaxis_wire_bytes_fullwidth": fw_inter,
+        "interaxis_wire_fraction": hier_interaxis_fraction,
+        "longhaul_gather_wire_fraction": lh_frac,
+        "longhaul_trajectory_within_tol": lh_traj_ok,
+        "wire_cost_toy": hier_cost_toy,
+        "wire_cost_pod_quantized": hier_cost_pod,
+        "wire_cost_pod_fullwidth": fw_cost_pod,
+        "pod_axis_sizes": HIER_POD_SIZES,
+        "link_gbytes_per_s": HIER_GBPS,
+    })
+
     # ---- Domino half-batch all-reduce, through the async-issue helper
     from hcache_deepspeed_tpu.runtime.domino import domino_split_async
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("tensor",))
@@ -834,6 +962,41 @@ def run_zero_overlap(out_path=None):
                  "ring_permute_bytes": comms.permute_bytes_summary()})
     rows.append(drow)
 
+    # hierarchical mesh rings for the half-batch all-reduces: the same
+    # scheduler-independent overlap on the declared 2x4 factoring of
+    # the tensor axis, with per-axis byte attribution
+    from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+    domino_spec = make_mesh_spec([2, 4])
+
+    def domino_hier(x, a, b):
+        return domino_split_async(
+            lambda h: jax.nn.gelu(h @ a) @ b,
+            lambda t: jax.lax.psum(t, "tensor"),
+            x, overlap=True, collective_impl="hierarchical",
+            axis="tensor", mesh_spec=domino_spec)
+
+    comms.reset()
+    compiled_hier = jax.jit(jax.shard_map(
+        domino_hier, mesh=mesh,
+        in_specs=(P(), P(None, "tensor"), P("tensor",)),
+        out_specs=P(), check_vma=False)).lower(xd, w1, w2).compile()
+    drep_hier = audit_compiled(compiled_hier)
+    y_hier = np.asarray(compiled_hier(xd, w1, w2))
+    domino_hier_pairs = len(drep_hier.pairs("collective-permute",
+                                            min_interleaved=1))
+    domino_hier_parity = bool(np.allclose(y_hier, y_native,
+                                          rtol=1e-5, atol=1e-5))
+    domino_hier_bitwise_flat = bool(np.array_equal(y_hier, y_dec))
+    drow = drep_hier.to_row()
+    drow.update({"phase": "domino-audit-hierarchical", "overlap": True,
+                 "helper": "domino_split_async",
+                 "mesh_spec": domino_spec.describe(),
+                 "overlapped_pairs": domino_hier_pairs,
+                 "value_parity_vs_native": domino_hier_parity,
+                 "bitwise_vs_flat_rings": domino_hier_bitwise_flat,
+                 "ring_permute_axis_bytes": comms.permute_axis_bytes()})
+    rows.append(drow)
+
     summary = {
         "phase": "summary",
         "metric": "zero3 2-layer toy: overlappable all-gather pairs "
@@ -861,6 +1024,22 @@ def run_zero_overlap(out_path=None):
             structural >= on["reduce_overlap_ratio"]),
         "domino_decomposed_overlapped_pairs": domino_dec_pairs,
         "domino_decomposed_value_parity": domino_dec_parity,
+        "hier_bitwise_vs_native": hier_bitwise_native,
+        "hier_bitwise_vs_flat": hier_bitwise_flat,
+        "hier_fullwidth_bitwise_vs_flat": hier_fw_bitwise_flat,
+        "hier_qwire_bitwise": hier_qwire_bitwise,
+        "hier_structural_overlap_ratio": hier_structural,
+        "hier_structural_ge_flat": bool(hier_structural >= structural),
+        "hier_interaxis_wire_fraction": hier_interaxis_fraction,
+        "hier_longhaul_gather_fraction": lh_frac,
+        "hier_longhaul_trajectory_within_tol": lh_traj_ok,
+        "hier_pod_wire_seconds_inter": hier_cost_pod["per_axis"]
+        .get("inter", {}).get("seconds"),
+        "hier_pod_wire_seconds_intra": hier_cost_pod["per_axis"]
+        .get("intra", {}).get("seconds"),
+        "hier_pod_bottleneck_axis": hier_cost_pod["bottleneck_axis"],
+        "domino_hier_overlapped_pairs": domino_hier_pairs,
+        "domino_hier_value_parity": domino_hier_parity,
         "wire_saved_bytes_per_op": {
             op: rec["saved_bytes"]
             for op, rec in qrs_row["wire_savings"].items()},
@@ -897,7 +1076,18 @@ def run_zero_overlap(out_path=None):
           and dec_bitwise and dq_bitwise
           and structural >= on["gather_overlap_ratio"]
           and structural >= on["reduce_overlap_ratio"]
-          and domino_dec_pairs >= 2 and domino_dec_parity)
+          and domino_dec_pairs >= 2 and domino_dec_parity
+          # hierarchical gates (ISSUE 12): bitwise vs native AND flat
+          # for plain + quantized wire, inter-axis quantized bytes
+          # <= 0.35x full width, structural >= the flat rings
+          and hier_bitwise_native and hier_bitwise_flat
+          and hier_fw_bitwise_flat and hier_qwire_bitwise
+          and hier_interaxis_fraction is not None
+          and hier_interaxis_fraction <= 0.35
+          and hier_structural >= structural
+          and lh_frac is not None and lh_frac <= 0.35 and lh_traj_ok
+          and domino_hier_pairs >= 2 and domino_hier_parity
+          and domino_hier_bitwise_flat)
     return 0 if ok else 4
 
 

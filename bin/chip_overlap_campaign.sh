@@ -2,15 +2,19 @@
 # ROADMAP item 5 — the chip-truth overlap campaign, as one command.
 #
 # Runs the full zero-overlap audit suite (native + decomposed-ring +
-# quantized-wire + Domino phases) ON TPU the moment the axon relay is
-# up, capturing ZERO_OVERLAP_TPU.jsonl. Either outcome resolves the
-# COMPONENTS.md Domino contradiction with evidence:
+# hierarchical 2-D mesh + quantized-wire + Domino phases) ON TPU the
+# moment the axon relay is up, capturing ZERO_OVERLAP_TPU.jsonl — one
+# command refreshes BOTH the flat-ring and hierarchical verdicts.
+# Either outcome resolves the COMPONENTS.md Domino contradiction with
+# evidence:
 #   * native async start/done pairs appear -> XLA schedules overlap for
 #     the monolithic collectives after all (record it, close item 5);
 #   * native pairs stay 0 -> the decomposed collective-permute chains
-#     in the same capture show the overlap is carried STRUCTURALLY
-#     (permute steps with dependence-free dots need no scheduler
-#     goodwill) — the fallback The Big Send-off / T3 prescribe.
+#     (flat AND hierarchical rows) in the same capture show the overlap
+#     is carried STRUCTURALLY (permute steps with dependence-free dots
+#     need no scheduler goodwill) — the fallback The Big Send-off / T3
+#     prescribe, with the hierarchical rows adding per-mesh-axis wire
+#     bytes and modeled pod-scale wire seconds on real-chip programs.
 #
 #   bin/chip_overlap_campaign.sh            # probe, then the campaign
 #   bin/chip_overlap_campaign.sh --wait     # poll the relay until up
@@ -66,6 +70,16 @@ print("chip verdict: native_async_pairs =", s.get("native_async_pairs"),
       s.get("structural_overlap_ratio_decomposed"),
       "| domino_decomposed_overlapped_pairs =",
       s.get("domino_decomposed_overlapped_pairs"))
+print("hierarchical verdict: structural =",
+      s.get("hier_structural_overlap_ratio"),
+      "| bitwise native/flat/qwire =",
+      s.get("hier_bitwise_vs_native"), s.get("hier_bitwise_vs_flat"),
+      s.get("hier_qwire_bitwise"),
+      "| interaxis wire fraction =",
+      s.get("hier_interaxis_wire_fraction"),
+      "| pod wire s (inter/intra) =",
+      s.get("hier_pod_wire_seconds_inter"),
+      s.get("hier_pod_wire_seconds_intra"))
 EOF
   echo "next: commit ZERO_OVERLAP_TPU.jsonl, refresh PERF_TRAJECTORY" \
        "(python -m hcache_deepspeed_tpu.perf index --out" \

@@ -127,12 +127,54 @@ class CommsLogger:
         """Total bytes per op carried by decomposed ring permutes
         (``op_kind == "collective_permute"``): ``{op: total_bytes}``.
         The matched-pair complement of :meth:`wire_savings_summary` for
-        the ring transport — proves ring-chunk traffic is attributed."""
+        the ring transport — proves ring-chunk traffic is attributed.
+        Per-mesh-axis breakdown: :meth:`permute_axis_bytes`."""
         out = {}
         for op, by_axis in self.axis_summary().items():
             if self.op_kinds.get(op) == "collective_permute":
                 out[op] = sum(t for _, t in by_axis.values())
         return out
+
+    def permute_axis_bytes(self):
+        """Ring-permute bytes attributed PER MESH-AXIS NAME:
+        ``{op: {axis_label: total_bytes}}`` — the hierarchical
+        transport (``comm/hierarchical.py``) labels every phase with
+        the mesh axis its bytes physically ride (the LAST component of
+        the axis group; flat rings label with the collective axis
+        itself), so intra- vs inter-axis wire volume is separately
+        queryable and the per-axis wire-cost model
+        (``profiling/hlo_audit.py``) can price it. The matched-pair
+        convention is untouched: quantized long-haul phases still
+        report ``<op>_longhaul`` / ``..._unquantized_equiv`` pairs
+        through :meth:`wire_savings_summary`."""
+        out = {}
+        for op, by_axis in self.axis_summary().items():
+            if self.op_kinds.get(op) != "collective_permute":
+                continue
+            per_axis = {}
+            for axes, (_, total) in by_axis.items():
+                label = axes.rpartition(",")[2] or axes
+                per_axis[label] = per_axis.get(label, 0) + total
+            out[op] = per_axis
+        return out
+
+    def total_axis_bytes(self, kinds=("collective_permute",)):
+        """Aggregate ``{axis_label: bytes}`` over every op of the given
+        kinds — the direct input to ``hlo_audit.wire_cost_seconds``.
+        ``_unquantized_equiv`` shadow rows and ``_longhaul``
+        matched-pair site markers are excluded (bookkeeping, not wire —
+        the long-haul phase's actual sends are already logged per
+        permute step by the underlying rings)."""
+        totals = {}
+        for op, by_axis in self.axis_summary().items():
+            if self.op_kinds.get(op) not in kinds \
+                    or op.endswith("_unquantized_equiv") \
+                    or op.endswith("_longhaul"):
+                continue
+            for axes, (_, total) in by_axis.items():
+                label = axes.rpartition(",")[2] or axes
+                totals[label] = totals.get(label, 0) + total
+        return totals
 
     def append(self, op_name, axes, msg_size):
         if not self.should_log(op_name):

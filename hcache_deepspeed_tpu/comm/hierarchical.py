@@ -1,0 +1,513 @@
+"""Topology-aware hierarchical collectives: multi-axis mesh ring
+decomposition with long-haul-only quantization.
+
+Reference analogs:
+* ZeRO++ hpZ (PAPERS.md) — hierarchy beats flat at scale: secondary
+  groups keep the heavy traffic on the fast links,
+* EQuARX (PAPERS.md) — quantization should be spent
+  bandwidth-proportionally: compress the slow-axis hops, leave the
+  fast-axis hops full width,
+* The Big Send-off / T3 (PAPERS.md) — multi-dimensional decomposed
+  collectives built from point-to-point sends.
+
+The flat rings in ``comm/ring.py`` (PR 9) treat the data axis as a 1-D
+ring, but the v5e-256 target (BASELINE.json) is a 2-D ICI torus: a flat
+ring's logical neighbor hops stripe over physically different links,
+so its wire bytes are unattributable to an axis and its quantization
+(when on) is spent uniformly. This module factors the flat shard_map
+axis into a declared multi-axis mesh (:class:`HierMeshSpec`, e.g.
+``2 x 4`` over 8 devices, rank = outer * a1 + inner) and re-expresses
+every collective as a sequence of **grouped ring phases, one per mesh
+axis** (inner/fast axis first, outer/long-haul axis last), reusing the
+hpZ ``axis_index_groups`` machinery in ``comm/ring.py``:
+
+* **hierarchical all-gather** — intra-axis ring gather, then the
+  gathered block rides the inter-axis rings; final row order is global
+  rank order, so the result is bitwise-equal to
+  ``jax.lax.all_gather`` and to the flat :func:`~.ring.ring_all_gather`
+  (pure data movement).
+* **hierarchical all-to-all / reduce-scatter** — per-phase grouped
+  direct delivery (:func:`~.ring.decomposed_all_to_all_rows`): after
+  the phase for mesh dim ``j``, the payload's dim-``j`` index has been
+  exchanged from DEST coordinate to SOURCE coordinate. Every raw
+  contribution still arrives unreduced, so the destination folds all
+  ``n`` rows in source-index order — the same fold as the flat
+  decomposed reduce-scatter and (measured, pinned by test_ring.py) as
+  XLA's native ``psum_scatter``: bitwise-equal to both.
+* **axis-selective quantization** (``longhaul_bits=8`` or ``4``) — the
+  long-haul phase's payload is int8 group-quantized (nibble-packed for
+  4 bits — the ``qwire.py`` packing) with fp32 group scales; fast-axis
+  phases stay full width. The receiver dequantizes on arrival except
+  its OWN long-haul row, which never crossed the slow wire and stays
+  exact. For the reduce direction an error-feedback residual
+  (``runtime/onebit.py error_feedback_step`` — the same machinery as
+  the qrs wire) carries the quantization error forward; the own-row
+  residual is pinned to zero because that row ships exact. Quantized
+  sites report matched ``<op>_longhaul`` / ``..._unquantized_equiv``
+  byte pairs through the comms logger, like every quantized wire site.
+
+Wire attribution: every ring phase passes its mesh-axis name as
+``wire_axis``, so permute bytes land per axis in the comms logger
+(``CommsLogger.permute_axis_bytes()``) — intra- vs inter-axis wire
+volume is separately queryable, and ``profiling/hlo_audit.py``'s
+per-axis wire-cost model can price it in seconds against declared
+per-axis link bandwidths.
+
+Cost honesty: the hierarchical exchange moves MORE total logical bytes
+than the flat direct-delivery ring (transit duplication at the phase
+corners: ``sum_j (n_j - 1) * n / n_j`` row-sends vs the flat ring's
+``n - 1``), but every byte is attributed to the axis it rides, the
+long-haul axis carries exactly its unavoidable share, and that share
+alone can be compressed. On a pod whose inter-axis links are several
+times slower than ICI, modeled wire seconds drop even as logical bytes
+rise — which is the point, and what the wire-cost model makes visible.
+
+Everything here must run INSIDE a ``shard_map`` region (manual axis)
+and is sim-deterministic (no ambient clock/RNG — the analysis purity
+rules gate this module).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .comms_logging import get_comms_logger
+from .ring import (_index_order_fold, decomposed_all_to_all_rows,
+                   ring_all_gather)
+
+#: legal wire widths for the long-haul phase (int8 / nibble-packed int4)
+LONGHAUL_WIRE_BITS = (4, 8)
+
+#: default axis names for a 2-D spec: outer = long haul, inner = fast
+DEFAULT_2D_AXIS_NAMES = ("inter", "intra")
+
+
+@dataclass(frozen=True)
+class MeshAxis:
+    """One mesh axis: name, size, and (for the wire-cost model) the
+    per-device link bandwidth bytes ride on this axis."""
+    name: str
+    size: int
+    gbytes_per_s: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class HierMeshSpec:
+    """A declared multi-axis factoring of the flat collective axis.
+
+    ``axes`` is outer-to-inner; global rank ``r`` has coordinate
+    ``(r // stride_j) % size_j`` on axis ``j`` (row-major mixed radix),
+    so the INNER-most axis is the contiguous/fast one — the hpZ
+    convention (consecutive ranks share a node/slice). ``longhaul``
+    names the axis whose hops are the slow wire (quantization target,
+    inter-axis wire accounting); by default the outermost axis."""
+    axes: Tuple[MeshAxis, ...]
+    longhaul: str
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(ax.size for ax in self.axes)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(ax.name for ax in self.axes)
+
+    @property
+    def world(self) -> int:
+        return int(np.prod(self.sizes))
+
+    @property
+    def longhaul_dim(self) -> int:
+        return self.names.index(self.longhaul)
+
+    def bandwidths(self) -> Dict[str, Optional[float]]:
+        return {ax.name: ax.gbytes_per_s for ax in self.axes}
+
+    def describe(self) -> Dict:
+        """JSON-safe spec row (bench artifact payload)."""
+        return {
+            "shape": list(self.sizes), "axis_names": list(self.names),
+            "longhaul_axis": self.longhaul,
+            "link_gbytes_per_s": {
+                ax.name: ax.gbytes_per_s for ax in self.axes},
+        }
+
+
+def make_mesh_spec(shape: Sequence[int],
+                   axis_names: Optional[Sequence[str]] = None,
+                   link_gbytes_per_s: Optional[Sequence[float]] = None,
+                   longhaul_axis: Optional[str] = None) -> HierMeshSpec:
+    """Build and validate a :class:`HierMeshSpec` from config values —
+    typed ``HDSConfigError`` rejections for every degenerate shape, no
+    silent clamps (the PR 5 convention)."""
+    from ..runtime.config import HDSConfigError
+    shape = [int(s) for s in (shape or ())]
+    if len(shape) < 2:
+        raise HDSConfigError(
+            f"zero_mesh_shape={shape}: a hierarchical mesh needs at "
+            f"least 2 axes (a 1-axis mesh IS the flat ring — use "
+            f"zero_collective_impl=decomposed)")
+    for s in shape:
+        if s < 2:
+            raise HDSConfigError(
+                f"zero_mesh_shape={shape}: axis of size {s} — every "
+                f"mesh axis must have size >= 2 (a size-1 axis has no "
+                f"ring; drop it from the shape)")
+    if axis_names is None:
+        axis_names = DEFAULT_2D_AXIS_NAMES if len(shape) == 2 else \
+            tuple(f"axis{j}" for j in range(len(shape)))
+    axis_names = [str(a) for a in axis_names]
+    if len(axis_names) != len(shape):
+        raise HDSConfigError(
+            f"zero_mesh_axis_names={axis_names} must match "
+            f"zero_mesh_shape={shape} ({len(shape)} axes)")
+    if len(set(axis_names)) != len(axis_names):
+        raise HDSConfigError(
+            f"zero_mesh_axis_names={axis_names}: duplicate axis names")
+    if link_gbytes_per_s is not None \
+            and len(link_gbytes_per_s) != len(shape):
+        raise HDSConfigError(
+            f"zero_mesh_link_gbps={list(link_gbytes_per_s)} must give "
+            f"one per-axis bandwidth per mesh axis ({len(shape)})")
+    if longhaul_axis is None:
+        longhaul_axis = axis_names[0]
+    if longhaul_axis not in axis_names:
+        raise HDSConfigError(
+            f"zero_longhaul_axis={longhaul_axis!r} names an unknown "
+            f"mesh axis; declared axes are {axis_names}")
+    axes = tuple(
+        MeshAxis(name=axis_names[j], size=shape[j],
+                 gbytes_per_s=(float(link_gbytes_per_s[j])
+                               if link_gbytes_per_s is not None else None))
+        for j in range(len(shape)))
+    return HierMeshSpec(axes=axes, longhaul=longhaul_axis)
+
+
+def mesh_spec_from_zero_config(zcfg) -> Optional[HierMeshSpec]:
+    """The spec a ``ZeroConfig`` declares, or ``None`` when the
+    transport is not hierarchical (parse-time validation already ran;
+    this is the engine-build constructor)."""
+    if getattr(zcfg, "zero_collective_impl", "native") != "hierarchical":
+        return None
+    return make_mesh_spec(zcfg.zero_mesh_shape,
+                          zcfg.zero_mesh_axis_names,
+                          zcfg.zero_mesh_link_gbps,
+                          zcfg.zero_longhaul_axis)
+
+
+def validate_mesh_spec(spec: HierMeshSpec, *, world_size: int,
+                       longhaul_bits: Optional[int] = None) -> None:
+    """Topology-time checks (engine build, where the world size is
+    known): the mesh must exactly factor the flat axis, and the
+    long-haul wire width must be one the packing supports."""
+    from ..runtime.config import HDSConfigError
+    if spec.world != world_size:
+        raise HDSConfigError(
+            f"zero_mesh_shape={list(spec.sizes)} describes "
+            f"{spec.world} devices but the data world size is "
+            f"{world_size}; the mesh shape must factor the axis "
+            f"exactly")
+    if longhaul_bits is not None and longhaul_bits not in \
+            LONGHAUL_WIRE_BITS:
+        raise HDSConfigError(
+            f"zero_longhaul_wire_bits={longhaul_bits}: the long-haul "
+            f"wire ships int8 or nibble-packed int4 payloads — use 8 "
+            f"or 4 (or null for full width)")
+
+
+def axis_groups(sizes: Sequence[int], dim: int) -> List[List[int]]:
+    """``axis_index_groups`` for mesh dim ``dim`` of a row-major rank
+    factoring: every group holds the ranks that vary ONLY along that
+    dim (the hpZ group-construction generalized to any axis)."""
+    ranks = np.arange(int(np.prod(sizes))).reshape(tuple(sizes))
+    moved = np.moveaxis(ranks, dim, -1).reshape(-1, sizes[dim])
+    return [[int(r) for r in g] for g in moved]
+
+
+def _my_coord(axis_name, sizes, dim):
+    """This device's (traced) coordinate along mesh dim ``dim``."""
+    stride = int(np.prod(sizes[dim + 1:])) if dim + 1 < len(sizes) else 1
+    return (jax.lax.axis_index(axis_name) // stride) % sizes[dim]
+
+
+def _quantize_block(x, group_size, bits):
+    """Groupwise-quantize ``x`` as ONE block: ``(payload, scale,
+    qlast)`` — payload nibble-packed for bits=4 (the ``qwire.py``
+    packing)."""
+    from ..ops.quantizer import quantize
+    from ..runtime.zero.qwire import pack_int4
+    gsz = max(1, min(int(group_size), x.size))
+    q, scale, _, _ = quantize(x, group_size=gsz,
+                              num_bits=4 if bits == 4 else 8)
+    payload = pack_int4(q) if bits == 4 else q
+    return payload, scale, q.shape[-1]
+
+
+def _dequantize_rows(payload, scale, qlast, shape, count, bits):
+    """Per-leading-row inverse of :func:`_quantize_block`: ``[m, ...]``
+    payload+scales (each row one independently quantized block) ->
+    ``[m, *shape]`` fp32."""
+    from ..ops.quantizer import dequantize
+    from ..runtime.zero.qwire import unpack_int4
+
+    def one(p, s):
+        q = unpack_int4(p, qlast) if bits == 4 else p
+        return dequantize(q, s, shape, count)
+
+    return jax.vmap(one)(payload, scale)
+
+
+def _row_quantizer(width, group_size, bits):
+    """Per-row groupwise quantize / dequantize for ``[a, width]``
+    buffers (the long-haul reduce phase: each row is one peer's block,
+    quantized independently so the receiver can dequantize it alone).
+    Same group layout and int4 packing as ``runtime/zero/qwire.py``."""
+    from ..ops.quantizer import quantize
+    from ..runtime.zero.qwire import pack_int4
+    gsz = max(1, min(int(group_size), int(width)))
+    num_bits = 4 if bits == 4 else 8
+
+    def quant(c):
+        def one(row):
+            return quantize(row, group_size=gsz, num_bits=num_bits)[:2]
+        q, s = jax.vmap(one)(c)
+        payload = pack_int4(q) if bits == 4 else q
+        return payload, s, q.shape[-1]
+
+    def deq(payload, scale, qlast):
+        return _dequantize_rows(payload, scale, qlast, (int(width),),
+                                int(width), bits)
+
+    return quant, deq
+
+
+def _log_longhaul_pair(op_name, axis_name, wire_axis, payload, scale,
+                       equiv_bytes):
+    """Matched quantized/unquantized-equiv byte pair for a long-haul
+    quantized phase — the same convention every quantized wire site
+    uses, so ``wire_savings_summary`` pairs it mechanically."""
+    get_comms_logger().log_quantized(
+        op_name + "_longhaul",
+        payload.size * payload.dtype.itemsize + 4 * scale.size,
+        int(equiv_bytes), (axis_name, wire_axis),
+        op_kind="collective_permute")
+
+
+def hierarchical_all_gather(x, axis_name, spec: HierMeshSpec, *,
+                            chunks: int = 1,
+                            longhaul_bits: Optional[int] = None,
+                            group_size: int = 2048,
+                            op_name: str = "hier_all_gather"):
+    """Hierarchical ring all-gather: ``[n, *x.shape]`` stacked result in
+    GLOBAL RANK order — the same layout (and, full-width, the same
+    bits) as ``jax.lax.all_gather(x, axis_name)`` and the flat
+    :func:`~.ring.ring_all_gather`.
+
+    Phases run inner (fast) axis to outer: each phase ring-gathers the
+    block gathered so far over that axis's groups, so the fast wire
+    carries ``(a_inner - 1) * |x|`` per device and the long haul
+    ``(a_outer - 1) * a_inner * |x|`` — separately attributed.
+
+    ``longhaul_bits`` (8 / 4): the long-haul phase ships the gathered
+    block int8/int4 group-quantized + fp32 scales instead of full
+    width. Rows from this device's OWN long-haul coordinate never cross
+    the slow wire and stay bit-exact; every other row dequantizes on
+    arrival (deterministic — a re-gather reconstructs identical
+    values, which is what keeps forward and backward re-gathers at the
+    same linearization point). Matched byte pairs are logged under
+    ``<op_name>_longhaul``."""
+    sizes = spec.sizes
+    cur = x[None]                                  # [lead=1, *x.shape]
+    for dim in range(len(sizes) - 1, -1, -1):
+        ax = spec.axes[dim]
+        groups = axis_groups(sizes, dim)
+        if longhaul_bits is not None and ax.name == spec.longhaul:
+            payload, scale, qlast = _quantize_block(cur, group_size,
+                                                    longhaul_bits)
+            _log_longhaul_pair(op_name, axis_name, ax.name, payload,
+                               scale, cur.size * cur.dtype.itemsize)
+            p_all = ring_all_gather(
+                payload, axis_name, axis_index_groups=groups,
+                chunks=chunks, op_name=op_name, wire_axis=ax.name)
+            s_all = ring_all_gather(
+                scale, axis_name, axis_index_groups=groups,
+                chunks=chunks, op_name=op_name, wire_axis=ax.name)
+            deq = _dequantize_rows(p_all, s_all, qlast, cur.shape,
+                                   cur.size, longhaul_bits)
+            deq = deq.astype(cur.dtype)
+            # own long-haul row never shipped: keep it bit-exact
+            my_c = _my_coord(axis_name, sizes, dim)
+            wide = jax.lax.dynamic_update_slice_in_dim(
+                deq, cur[None], my_c, axis=0)
+        else:
+            wide = ring_all_gather(
+                cur, axis_name, axis_index_groups=groups, chunks=chunks,
+                op_name=op_name, wire_axis=ax.name)
+        cur = wide.reshape((wide.shape[0] * cur.shape[0],) + x.shape)
+    return cur                                     # [n, *x.shape]
+
+
+def hierarchical_all_to_all_rows(rows, axis_name, spec: HierMeshSpec, *,
+                                 chunks: int = 1,
+                                 op_name: str = "hier_all_to_all"):
+    """Hierarchical row exchange: ``rows`` is ``[n, ...]`` with row
+    ``d`` destined for global rank ``d``; returns ``[n, ...]`` received
+    rows in SOURCE-rank order — the same layout (and bits) as
+    ``jax.lax.all_to_all(rows, axis_name, 0, 0)`` and the flat
+    :func:`~.ring.decomposed_all_to_all_rows`.
+
+    One grouped direct-delivery phase per mesh axis, inner to outer:
+    the phase for dim ``j`` exchanges, within each dim-``j`` group, the
+    blocks indexed by the dim-``j`` DEST coordinate — afterwards that
+    index holds the dim-``j`` SOURCE coordinate. Every byte is
+    attributed to the mesh axis it rides."""
+    sizes = spec.sizes
+    n = int(np.prod(sizes))
+    if rows.shape[0] != n:
+        raise ValueError(f"hierarchical_all_to_all_rows needs leading "
+                         f"dim == mesh world {n}; got {rows.shape}")
+    rest = rows.shape[1:]
+    cur = rows.reshape(tuple(sizes) + (-1,))
+    for dim in range(len(sizes) - 1, -1, -1):
+        groups = axis_groups(sizes, dim)
+        lead = jnp.moveaxis(cur, dim, 0)
+        got = decomposed_all_to_all_rows(
+            lead.reshape(sizes[dim], -1), axis_name,
+            axis_index_groups=groups, chunks=chunks, op_name=op_name,
+            wire_axis=spec.axes[dim].name)
+        cur = jnp.moveaxis(got.reshape(lead.shape), 0, dim)
+    return cur.reshape((n,) + rest)
+
+
+def hierarchical_reduce_scatter_sum(x, axis_name, spec: HierMeshSpec, *,
+                                    chunks: int = 1,
+                                    longhaul_bits: Optional[int] = None,
+                                    residual=None,
+                                    group_size: int = 2048,
+                                    op_name: str = "hier_reduce_scatter"):
+    """Hierarchical reduce-scatter SUM over the leading dim: ``x`` is
+    ``[n * m, ...]``, returns ``[m, ...]`` — bitwise-equal (full-width)
+    to ``jax.lax.psum_scatter(..., tiled=True)`` and to the flat
+    :func:`~.ring.decomposed_reduce_scatter_sum`, because the transport
+    (:func:`hierarchical_all_to_all_rows`) delivers every raw
+    contribution and the destination folds them in source-index order
+    (fp32 accumulation for sub-fp32 floats) — reduction is never done
+    in-network, which is the only way any decomposition matches the
+    native fold.
+
+    ``longhaul_bits`` (8 / 4): contributions CROSSING the long-haul
+    axis ship int8/int4 + fp32 scales; contributions that stay on the
+    fast axis (this device's own long-haul coordinate) ship full width
+    and fold bit-exactly. ``residual`` is the error-feedback state for
+    the quantized portion (fp32, shaped like the long-haul phase
+    payload; ``None`` with bits set seeds zeros) — the own-coordinate
+    slice is pinned to zero since those rows never quantize. Returns
+    ``(out, new_residual)`` when ``longhaul_bits`` is set, else
+    ``out`` (the flat-ring signature)."""
+    sizes = spec.sizes
+    n = int(np.prod(sizes))
+    if x.shape[0] % n:
+        raise ValueError(f"hierarchical_reduce_scatter_sum needs "
+                         f"leading dim divisible by mesh world {n}; "
+                         f"got {x.shape}")
+    m = x.shape[0] // n
+    chunk_shape = (m,) + x.shape[1:]
+    rows = x.reshape(n, -1)
+    if longhaul_bits is None:
+        ordered = hierarchical_all_to_all_rows(
+            rows, axis_name, spec, chunks=chunks, op_name=op_name)
+        return _index_order_fold(ordered).reshape(chunk_shape)
+    ordered, new_res = _longhaul_quantized_exchange(
+        rows, axis_name, spec, chunks=chunks, bits=longhaul_bits,
+        residual=residual, group_size=group_size, op_name=op_name)
+    # mixed exact/dequantized rows: fold in fp32 (source-index order,
+    # like every decomposed reduce) and cast back to the input dtype
+    out = _index_order_fold(ordered.astype(jnp.float32))
+    return out.astype(x.dtype).reshape(chunk_shape), new_res
+
+
+def _longhaul_quantized_exchange(rows, axis_name, spec, *, chunks, bits,
+                                 residual, group_size, op_name):
+    """The quantized-long-haul variant of
+    :func:`hierarchical_all_to_all_rows` (reduce direction): fast-axis
+    phases run full width (their rows stay in the input dtype); at the
+    long-haul phase each outgoing per-peer block is error-feedback
+    quantized, shipped as int8/int4 + fp32 scales, and dequantized on
+    arrival — except the own-coordinate block, which is delivered
+    locally and stays exact. Returns ``(ordered_rows [n, W] fp32,
+    new_residual [a_longhaul, W * n/a_longhaul] fp32)``."""
+    from ..runtime.onebit import error_feedback_step
+    sizes = spec.sizes
+    n = int(np.prod(sizes))
+    L = spec.longhaul_dim
+    residual_out = None
+    cur = rows.reshape(tuple(sizes) + (-1,))
+    for dim in range(len(sizes) - 1, -1, -1):
+        ax = spec.axes[dim]
+        groups = axis_groups(sizes, dim)
+        lead = jnp.moveaxis(cur, dim, 0)
+        a = sizes[dim]
+        lead2 = lead.reshape(a, -1)
+        if dim == L:
+            my_c = _my_coord(axis_name, sizes, dim)
+            quant, deq = _row_quantizer(lead2.shape[1], group_size,
+                                        bits)
+            if residual is None:
+                residual = jnp.zeros(lead2.shape, jnp.float32)
+            qlast_box = {}
+
+            def compress(c):
+                payload, scale, qlast = quant(c)
+                qlast_box["v"] = qlast
+                return (payload, scale), deq(payload, scale, qlast)
+
+            (payload, scale), _, new_res = error_feedback_step(
+                lead2.astype(jnp.float32), residual, compress)
+            _log_longhaul_pair(op_name, axis_name, ax.name, payload,
+                               scale, lead2.size * lead2.dtype.itemsize)
+            p_t = decomposed_all_to_all_rows(
+                payload, axis_name, axis_index_groups=groups,
+                chunks=chunks, op_name=op_name, wire_axis=ax.name)
+            s_t = decomposed_all_to_all_rows(
+                scale, axis_name, axis_index_groups=groups,
+                chunks=chunks, op_name=op_name, wire_axis=ax.name)
+            got = deq(p_t, s_t, qlast_box["v"])
+            # own block is delivered locally: exact, and its residual
+            # is pinned to zero (that error never rides a wire, so
+            # feeding it back would inject a phantom correction)
+            own = jnp.take(lead2, my_c, axis=0).astype(jnp.float32)
+            got = jax.lax.dynamic_update_slice_in_dim(
+                got, own[None], my_c, axis=0)
+            own_mask = (jnp.arange(a) == my_c)[:, None]
+            residual_out = jnp.where(own_mask, 0.0, new_res)
+            cur = jnp.moveaxis(
+                got.reshape((a,) + lead.shape[1:]), 0, dim)
+        else:
+            got = decomposed_all_to_all_rows(
+                lead2, axis_name, axis_index_groups=groups,
+                chunks=chunks, op_name=op_name, wire_axis=ax.name)
+            cur = jnp.moveaxis(got.reshape(lead.shape), 0, dim)
+    return cur.reshape((n, -1)), residual_out
+
+
+def hierarchical_all_reduce_sum(x, axis_name, spec: HierMeshSpec, *,
+                                chunks: int = 1,
+                                op_name: str = "hier_all_reduce"):
+    """Hierarchical all-reduce SUM = hierarchical reduce-scatter +
+    hierarchical all-gather (value-equivalent to ``jax.lax.psum``,
+    bitwise-equal to the flat :func:`~.ring.ring_all_reduce_sum` — both
+    fold all ``n`` raw contributions at the destination in source-index
+    order). Arbitrary shapes: flattened and zero-padded to a multiple
+    of the mesh world size."""
+    n = spec.world
+    shape, size = x.shape, x.size
+    pad = (-size) % n
+    flat = jnp.pad(x.reshape(-1), (0, pad))
+    mine = hierarchical_reduce_scatter_sum(flat, axis_name, spec,
+                                           chunks=chunks, op_name=op_name)
+    full = hierarchical_all_gather(mine, axis_name, spec, chunks=chunks,
+                                   op_name=op_name)
+    return full.reshape(-1)[:size].reshape(shape)

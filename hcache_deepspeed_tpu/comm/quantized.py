@@ -107,7 +107,7 @@ def all_to_all_quant_reduce(x, axis=DATA_AXIS, group_size=256, num_bits=8,
 
 
 def quantized_allreduce_body(x, error, axis, group_size=2048, num_bits=8,
-                             collective_impl="native"):
+                             collective_impl="native", mesh_spec=None):
     """Error-feedback INT8-wire allreduce body for use INSIDE a manual
     (shard_map) region — Domino's opt-in compressed half-batch
     all-reduce (``runtime/domino.py``, full-width remains the default).
@@ -161,6 +161,15 @@ def quantized_allreduce_body(x, error, axis, group_size=2048, num_bits=8,
             q, axis, op_name="domino_ring_allreduce_int8")
         s_t = decomposed_all_to_all_rows(
             scale, axis, op_name="domino_ring_allreduce_int8")
+    elif collective_impl == "hierarchical":
+        # mesh-ring transport (comm/hierarchical.py): same int8 rows,
+        # same EF residual, bytes attributed per mesh axis. Source-
+        # order delivery keeps the dequant-accumulate graph identical.
+        from .hierarchical import hierarchical_all_to_all_rows
+        q_t = hierarchical_all_to_all_rows(
+            q, axis, mesh_spec, op_name="domino_hier_allreduce_int8")
+        s_t = hierarchical_all_to_all_rows(
+            scale, axis, mesh_spec, op_name="domino_hier_allreduce_int8")
     else:
         q_t = jax.lax.all_to_all(q, axis, 0, 0)      # int8 on the wire
         s_t = jax.lax.all_to_all(scale, axis, 0, 0)
@@ -171,6 +180,12 @@ def quantized_allreduce_body(x, error, axis, group_size=2048, num_bits=8,
                                op_name="domino_ring_allreduce_int8")
         s2_a = ring_all_gather(s2, axis,
                                op_name="domino_ring_allreduce_int8")
+    elif collective_impl == "hierarchical":
+        from .hierarchical import hierarchical_all_gather
+        q2_a = hierarchical_all_gather(
+            q2, axis, mesh_spec, op_name="domino_hier_allreduce_int8")
+        s2_a = hierarchical_all_gather(
+            s2, axis, mesh_spec, op_name="domino_hier_allreduce_int8")
     else:
         q2_a = jax.lax.all_gather(q2, axis)          # int8 on the wire
         s2_a = jax.lax.all_gather(s2, axis)

@@ -62,14 +62,25 @@ import numpy as np
 from .comms_logging import get_comms_logger
 
 #: config values for the ZeRO collective transport knob
-#: (``zero_optimization.zero_collective_impl``)
-COLLECTIVE_IMPLS = ("native", "decomposed")
+#: (``zero_optimization.zero_collective_impl``): ``decomposed`` = flat
+#: 1-D ring chains; ``hierarchical`` = multi-axis mesh decomposition
+#: (``comm/hierarchical.py``) built from the grouped forms below.
+COLLECTIVE_IMPLS = ("native", "decomposed", "hierarchical")
 
 
-def _log_permute(op_name, n_bytes, axis_name):
+def _log_permute(op_name, n_bytes, axis_name, wire_axis=None):
+    """Attribute one permute step's bytes. ``wire_axis`` is the MESH
+    axis label the bytes physically ride (``comm/hierarchical.py``
+    phases pass e.g. ``"intra"``/``"inter"``); it lands as the last
+    component of the comms-logger axis group, so
+    ``CommsLogger.permute_axis_bytes()`` can split intra- vs
+    inter-axis wire volume. ``None`` (flat rings) keeps the plain
+    ``(axis_name,)`` attribution."""
     logger = get_comms_logger()
     if op_name and logger.should_log(op_name):
-        logger.log_collective(op_name, int(n_bytes), (axis_name,),
+        axes = (axis_name,) if wire_axis is None else (axis_name,
+                                                       wire_axis)
+        logger.log_collective(op_name, int(n_bytes), axes,
                               op_kind="collective_permute")
 
 
@@ -112,7 +123,7 @@ def _group_layout(axis_name, axis_index_groups):
 
 
 def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
-                    op_name: str = "ring_all_gather"):
+                    op_name: str = "ring_all_gather", wire_axis=None):
     """Chunked ring all-gather: ``[n_g, *x.shape]`` stacked result, row
     ``j`` = group-rank ``j``'s ``x`` — the same layout (and bits) as
     ``jax.lax.all_gather(x, axis_name, axis_index_groups=...)``.
@@ -134,7 +145,7 @@ def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
         cur = piece
         for _ in range(m - 1):
             _log_permute(op_name, piece.size * piece.dtype.itemsize,
-                         axis_name)
+                         axis_name, wire_axis)
             cur = jax.lax.ppermute(cur, axis_name, neighbor)
             arrived.append(cur)
         stacked = jnp.stack(arrived)               # [m, w]
@@ -143,42 +154,48 @@ def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
     return wide.reshape((m,) + x.shape)
 
 
-def decomposed_all_to_all_rows(rows, axis_name, *, chunks: int = 1,
-                               op_name: str = "ring_all_to_all"):
-    """Decomposed row exchange: ``rows`` is ``[n, ...]`` with row ``d``
-    destined for device ``d``; returns ``[n, ...]`` received rows in
-    SOURCE order — the same layout (and bits) as
-    ``jax.lax.all_to_all(rows, axis_name, 0, 0)``.
+def decomposed_all_to_all_rows(rows, axis_name, *, axis_index_groups=None,
+                               chunks: int = 1,
+                               op_name: str = "ring_all_to_all",
+                               wire_axis=None):
+    """Decomposed row exchange: ``rows`` is ``[n_g, ...]`` with row
+    ``j`` destined for group-rank ``j``; returns ``[n_g, ...]``
+    received rows in SOURCE order — the same layout (and bits) as
+    ``jax.lax.all_to_all(rows, axis_name, 0, 0,
+    axis_index_groups=...)``.
 
     Step ``s`` is one distance-``s`` permute delivering row
-    ``(i+s) % n`` directly to its destination: ``n-1`` chunk sends per
-    device (the in-network-ring wire volume, reached by direct delivery
-    instead of accumulate-and-forward), every step dependent only on
-    the local input rows."""
-    n = jax.lax.axis_size(axis_name)
-    if n == 1:
+    ``(i+s) % n_g`` directly to its destination: ``n_g - 1`` chunk
+    sends per device (the in-network-ring wire volume, reached by
+    direct delivery instead of accumulate-and-forward), every step
+    dependent only on the local input rows. ``axis_index_groups``
+    (equal-size disjoint, the hpZ layout) restricts the exchange to
+    each group — the building block of the multi-axis mesh exchange
+    (``comm/hierarchical.py``), where every phase is a grouped
+    all-to-all along one mesh axis."""
+    m, my_rank, perm_at = _group_layout(axis_name, axis_index_groups)
+    if m == 1:
         return rows
-    if rows.shape[0] != n:
+    if rows.shape[0] != m:
         raise ValueError(f"decomposed_all_to_all_rows needs leading dim "
-                         f"== axis size {n}; got {rows.shape}")
-    idx = jax.lax.axis_index(axis_name)
+                         f"== group size {m}; got {rows.shape}")
     row_shape = rows.shape[1:]
-    flat = rows.reshape(n, -1)
+    flat = rows.reshape(m, -1)
     bounds = _chunk_bounds(flat.shape[1], chunks)
-    received = [jnp.take(flat, idx, axis=0)]       # own row (source = me)
-    for s in range(1, n):
-        perm = [(j, (j + s) % n) for j in range(n)]
-        sent = jnp.take(flat, (idx + s) % n, axis=0)
+    received = [jnp.take(flat, my_rank, axis=0)]   # own row (source = me)
+    for s in range(1, m):
+        perm = perm_at(s)
+        sent = jnp.take(flat, (my_rank + s) % m, axis=0)
         pieces = []
         for lo, hi in bounds:
             _log_permute(op_name, (hi - lo) * flat.dtype.itemsize,
-                         axis_name)
+                         axis_name, wire_axis)
             pieces.append(jax.lax.ppermute(sent[lo:hi], axis_name, perm))
         received.append(pieces[0] if len(pieces) == 1
                         else jnp.concatenate(pieces))
-    stacked = jnp.stack(received)          # pos s = source (idx - s) % n
-    ordered = jnp.roll(stacked[::-1], idx + 1, axis=0)  # row j = source j
-    return ordered.reshape((n,) + row_shape)
+    stacked = jnp.stack(received)      # pos s = source (my_rank - s) % m
+    ordered = jnp.roll(stacked[::-1], my_rank + 1, axis=0)  # row j = src j
+    return ordered.reshape((m,) + row_shape)
 
 
 def _index_order_fold(ordered):
@@ -196,30 +213,37 @@ def _index_order_fold(ordered):
     return acc.astype(dtype)
 
 
-def decomposed_reduce_scatter_sum(x, axis_name, *, chunks: int = 1,
-                                  op_name: str = "ring_reduce_scatter"):
+def decomposed_reduce_scatter_sum(x, axis_name, *, axis_index_groups=None,
+                                  chunks: int = 1,
+                                  op_name: str = "ring_reduce_scatter",
+                                  wire_axis=None):
     """Decomposed reduce-scatter SUM over leading dim: ``x`` is
-    ``[n * m, ...]``, returns ``[m, ...]`` — device ``i`` ends with the
-    cross-device sum of slice ``[i*m:(i+1)*m]``, bitwise-equal to
-    ``jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
-    tiled=True)`` on a deterministic backend (index-order fold, fp32
-    accumulation for sub-fp32 floats — pinned by test_ring.py).
+    ``[n_g * m, ...]``, returns ``[m, ...]`` — group-rank ``i`` ends
+    with the cross-device sum of slice ``[i*m:(i+1)*m]``, bitwise-equal
+    to ``jax.lax.psum_scatter(x, axis_name, scatter_dimension=0,
+    tiled=True, axis_index_groups=...)`` on a deterministic backend
+    (index-order fold, fp32 accumulation for sub-fp32 floats — pinned
+    by test_ring.py, grouped forms included).
 
     Transport is :func:`decomposed_all_to_all_rows` (direct chunk
-    delivery, ``n-1`` sends per device); the reduction happens at the
-    destination, in a fixed order, instead of in-network — which is the
-    only way a decomposed reduce can match the native fold order."""
-    n = jax.lax.axis_size(axis_name)
+    delivery, ``n_g - 1`` sends per device); the reduction happens at
+    the destination, in a fixed order, instead of in-network — which is
+    the only way a decomposed reduce can match the native fold order."""
+    if axis_index_groups is None:
+        n = jax.lax.axis_size(axis_name)
+    else:
+        n = len(axis_index_groups[0])
     if x.shape[0] % n:
         raise ValueError(f"decomposed_reduce_scatter_sum needs leading "
-                         f"dim divisible by axis size {n}; got {x.shape}")
+                         f"dim divisible by group size {n}; got {x.shape}")
     m = x.shape[0] // n
     if n == 1:
         return x
     chunk_shape = (m,) + x.shape[1:]
-    rows = x.reshape(n, -1)                       # row d -> device d
-    ordered = decomposed_all_to_all_rows(rows, axis_name, chunks=chunks,
-                                         op_name=op_name)
+    rows = x.reshape(n, -1)                       # row d -> group-rank d
+    ordered = decomposed_all_to_all_rows(
+        rows, axis_name, axis_index_groups=axis_index_groups,
+        chunks=chunks, op_name=op_name, wire_axis=wire_axis)
     return _index_order_fold(ordered).reshape(chunk_shape)
 
 

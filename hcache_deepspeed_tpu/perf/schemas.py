@@ -265,7 +265,19 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("structural_overlap_ratio_decomposed",
                      "zero_overlap.structural_overlap_ratio"),
                     ("domino_decomposed_overlapped_pairs",
-                     "domino.decomposed_overlapped_pairs")):
+                     "domino.decomposed_overlapped_pairs"),
+                    ("hier_structural_overlap_ratio",
+                     "zero_overlap.hier_structural_overlap_ratio"),
+                    ("hier_interaxis_wire_fraction",
+                     "zero_overlap.hier_interaxis_wire_fraction"),
+                    ("hier_longhaul_gather_fraction",
+                     "zero_overlap.hier_longhaul_gather_fraction"),
+                    ("hier_pod_wire_seconds_inter",
+                     "zero_overlap.hier_pod_wire_seconds_inter"),
+                    ("hier_pod_wire_seconds_intra",
+                     "zero_overlap.hier_pod_wire_seconds_intra"),
+                    ("domino_hier_overlapped_pairs",
+                     "domino.hier_overlapped_pairs")):
                 if isinstance(row.get(key), (int, float)):
                     pts.append(MetricPoint(metric, float(row[key]),
                                            file, phase=phase, utc=utc))
@@ -280,7 +292,17 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("decomposed_qwire_bitwise",
                      "zero_overlap.decomposed_qwire_bitwise"),
                     ("domino_decomposed_value_parity",
-                     "domino.decomposed_value_parity")):
+                     "domino.decomposed_value_parity"),
+                    ("hier_bitwise_vs_native",
+                     "zero_overlap.hier_bitwise_vs_native"),
+                    ("hier_bitwise_vs_flat",
+                     "zero_overlap.hier_bitwise_vs_flat"),
+                    ("hier_qwire_bitwise",
+                     "zero_overlap.hier_qwire_bitwise"),
+                    ("hier_longhaul_trajectory_within_tol",
+                     "zero_overlap.hier_longhaul_trajectory_within_tol"),
+                    ("domino_hier_value_parity",
+                     "domino.hier_value_parity")):
                 if key in row:
                     pts.append(MetricPoint(metric,
                                            1.0 if row[key] else 0.0,

@@ -449,6 +449,72 @@ class AuditReport:
         return json.dumps(self.to_row())
 
 
+# ------------------------------------------------------------------ #
+# Per-axis wire-cost model (ISSUE 12): bytes x declared per-axis link
+# bandwidth -> modeled wire SECONDS. The auditor measures bytes (above,
+# and the comms logger attributes ring-permute bytes per mesh axis via
+# CommsLogger.total_axis_bytes()); this prices them against a DECLARED
+# mesh spec — a model input (what the target pod's links do), never a
+# measurement. Everything is plain dicts so the auditor stays
+# stdlib-only and the spec can come from config, bench, or a test.
+# ------------------------------------------------------------------ #
+
+def wire_cost_seconds(axis_bytes: Dict[str, float],
+                      axis_gbytes_per_s: Dict[str, float]) -> Dict:
+    """Price per-axis wire bytes in seconds: ``bytes / (GB/s * 1e9)``
+    per axis. Axes with no declared bandwidth report ``seconds: None``
+    (unpriceable is not free — the row stays visible). Returns
+    ``{"per_axis": {axis: {bytes, gbytes_per_s, seconds}},
+    "total_seconds", "bottleneck_axis"}`` — ``total_seconds`` sums the
+    priced axes (serialized-wire upper bound; phases on different axes
+    may overlap on hardware), ``bottleneck_axis`` is the slowest."""
+    per_axis = {}
+    total = 0.0
+    bottleneck, worst = None, -1.0
+    for axis, nbytes in sorted(axis_bytes.items()):
+        bw = axis_gbytes_per_s.get(axis)
+        seconds = None
+        if bw:
+            seconds = float(nbytes) / (float(bw) * 1e9)
+            total += seconds
+            if seconds > worst:
+                bottleneck, worst = axis, seconds
+        per_axis[axis] = {"bytes": int(nbytes),
+                          "gbytes_per_s": bw,
+                          "seconds": seconds}
+    return {"per_axis": per_axis,
+            "total_seconds": total,
+            "bottleneck_axis": bottleneck}
+
+
+def pod_scale_wire_seconds(axis_bytes: Dict[str, float],
+                           toy_axis_sizes: Dict[str, int],
+                           pod_axis_sizes: Dict[str, int],
+                           axis_gbytes_per_s: Dict[str, float]) -> Dict:
+    """Project toy-mesh per-axis wire bytes to a pod-scale mesh and
+    price them: a ring phase over an axis of size ``k`` makes ``k - 1``
+    sends of the same per-device payload, so bytes scale by
+    ``(K - 1) / (k - 1)`` when the axis grows ``k -> K`` with the
+    per-device payload held fixed (the ZeRO case: shard sizes are set
+    per device, not per world). That is the whole model — declared,
+    deliberately simple, and labeled as such in the artifact row via
+    ``assumption``. Returns the :func:`wire_cost_seconds` dict plus
+    ``{"scaled_axis_bytes", "assumption"}``."""
+    scaled = {}
+    for axis, nbytes in axis_bytes.items():
+        k = toy_axis_sizes.get(axis)
+        K = pod_axis_sizes.get(axis)
+        if k and K and k > 1:
+            scaled[axis] = float(nbytes) * (K - 1) / (k - 1)
+        else:
+            scaled[axis] = float(nbytes)
+    out = wire_cost_seconds(scaled, axis_gbytes_per_s)
+    out["scaled_axis_bytes"] = {a: int(b) for a, b in scaled.items()}
+    out["assumption"] = ("ring bytes scale (K-1)/(k-1) per axis at "
+                         "fixed per-device payload")
+    return out
+
+
 def audit_hlo_text(text: str) -> AuditReport:
     """Audit one optimized-HLO module's async-overlap structure."""
     native, derived, sequential = [], [], []

@@ -136,10 +136,41 @@ class ZeroConfig(HDSConfigModel):
     #: ``ppermute`` ring chains (``comm/ring.py``) whose steps are
     #: dependence-free of block compute by dataflow construction —
     #: bitwise-equal to native, structural overlap scored by
-    #: ``hlo_audit.structural_overlap_ratio``. Decomposed requires the
-    #: layered step, a data axis > 1, and ``overlap_comm=true``
+    #: ``hlo_audit.structural_overlap_ratio``; ``"hierarchical"``
+    #: factors the flat data axis into a declared multi-axis mesh
+    #: (``zero_mesh_shape``) and runs per-axis grouped ring phases
+    #: (``comm/hierarchical.py``) — still bitwise-equal, with wire
+    #: bytes attributed per mesh axis and the long-haul axis
+    #: quantizable on its own (``zero_longhaul_wire_bits``).
+    #: Decomposed/hierarchical require the layered step, a data axis
+    #: > 1, and ``overlap_comm=true``; hierarchical additionally needs
+    #: ``zero_mesh_shape`` to factor the data world size exactly
     #: (validated with typed errors, no silent fallthrough).
     zero_collective_impl: str = "native"
+    #: Mesh factoring of the flat data axis for the hierarchical
+    #: transport, outer (long-haul) axis first — e.g. ``[2, 4]`` on 8
+    #: devices, ``[16, 16]`` on a v5e-256 pod. Every axis must have
+    #: size >= 2 and the product must equal the data world size.
+    zero_mesh_shape: Optional[List[int]] = None
+    #: Names for the mesh axes (default ``["inter", "intra"]`` for 2-D
+    #: meshes): the labels wire bytes are attributed under
+    #: (``CommsLogger.permute_axis_bytes``) and the per-axis wire-cost
+    #: model prices.
+    zero_mesh_axis_names: Optional[List[str]] = None
+    #: Declared per-axis link bandwidth (GB/s per device) for the
+    #: wire-cost model — a MODEL input (what the pod's links do), not a
+    #: measurement; aligned with ``zero_mesh_shape``.
+    zero_mesh_link_gbps: Optional[List[float]] = None
+    #: Which mesh axis is the slow/long-haul wire (default: the
+    #: outermost). Must name a declared axis — an unknown name is a
+    #: typed config error, not a silent fallback.
+    zero_longhaul_axis: Optional[str] = None
+    #: Axis-selective quantization (EQuARX's bandwidth-proportional
+    #: scheme): ship the LONG-HAUL phase of hierarchical gathers
+    #: int8 (8) or nibble-packed int4 (4) + fp32 group scales, full
+    #: width on the fast axis. ``null`` = full width everywhere.
+    #: Requires ``zero_collective_impl: hierarchical``.
+    zero_longhaul_wire_bits: Optional[int] = None
     #: ZeRO++ stage-3 gather granularity: scan-over-layers (gather one
     #: block at a time inside the micro step) when the model provides a
     #: layered spec (models/layered.py). False forces the whole-tree
@@ -156,22 +187,55 @@ class ZeroConfig(HDSConfigModel):
         # combinations (stage interplay re-checked at engine build,
         # where the topology is known)
         from .zero.overlap import validate_quantized_wire
-        if self.zero_collective_impl not in ("native", "decomposed"):
+        if self.zero_collective_impl not in ("native", "decomposed",
+                                             "hierarchical"):
             raise HDSConfigError(
                 f"zero_collective_impl="
                 f"{self.zero_collective_impl!r}: expected 'native' "
-                f"(monolithic collectives) or 'decomposed' (chunked "
-                f"ppermute ring transport, comm/ring.py)")
-        if self.zero_collective_impl == "decomposed" \
+                f"(monolithic collectives), 'decomposed' (chunked "
+                f"ppermute ring transport, comm/ring.py) or "
+                f"'hierarchical' (multi-axis mesh rings, "
+                f"comm/hierarchical.py)")
+        if self.zero_collective_impl in ("decomposed", "hierarchical") \
                 and not self.overlap_comm:
             # world-size interplay is re-checked at engine build
             # (validate_overlap_config), where the topology is known;
             # the overlap_comm contradiction is knowable right here
             raise HDSConfigError(
-                "zero_collective_impl=decomposed with "
-                "overlap_comm=false: the decomposed ring transport "
-                "exists to make overlap structural — enable "
+                f"zero_collective_impl={self.zero_collective_impl} "
+                "with overlap_comm=false: the decomposed transports "
+                "exist to make overlap structural — enable "
                 "overlap_comm or use zero_collective_impl=native")
+        if self.zero_collective_impl == "hierarchical":
+            # shape/name sanity is knowable at parse time (the
+            # world-size product check needs the topology: engine
+            # build re-validates via validate_overlap_config)
+            from ..comm.hierarchical import make_mesh_spec
+            if self.zero_mesh_shape is None:
+                raise HDSConfigError(
+                    "zero_collective_impl=hierarchical needs "
+                    "zero_mesh_shape (the mesh factoring of the data "
+                    "axis, outer/long-haul axis first — e.g. [2, 4])")
+            spec = make_mesh_spec(
+                self.zero_mesh_shape, self.zero_mesh_axis_names,
+                self.zero_mesh_link_gbps, self.zero_longhaul_axis)
+            if self.zero_longhaul_wire_bits is not None \
+                    and self.zero_longhaul_wire_bits not in (4, 8):
+                raise HDSConfigError(
+                    f"zero_longhaul_wire_bits="
+                    f"{self.zero_longhaul_wire_bits}: the long-haul "
+                    f"wire ships int8 or nibble-packed int4 — use 8, "
+                    f"4, or null for full width")
+            del spec
+        else:
+            for knob in ("zero_mesh_shape", "zero_longhaul_axis",
+                         "zero_longhaul_wire_bits"):
+                if getattr(self, knob) is not None:
+                    raise HDSConfigError(
+                        f"{knob} has no effect without "
+                        f"zero_collective_impl=hierarchical; set the "
+                        f"transport or drop the knob (no silent "
+                        f"ignores)")
         validate_quantized_wire(
             quantized_reduce_scatter=self.zero_quantized_reduce_scatter,
             error_feedback=self.zero_reduce_scatter_error_feedback,

@@ -67,7 +67,8 @@ def domino_split(layer_fn, x, *args, **kwargs):
 def domino_split_async(compute_fn, collective_fn, x, *args,
                        overlap=True, wire_bits=None, axis=None,
                        wire_error=None, group_size=2048,
-                       collective_impl="native", **kwargs):
+                       collective_impl="native", mesh_spec=None,
+                       **kwargs):
     """Half-batch split with the collective EXPLICITLY issued through
     :class:`comm.overlap.CollectiveIssue` instead of buried inside an
     opaque layer function — the reference's hand-scheduled form
@@ -115,22 +116,47 @@ def domino_split_async(compute_fn, collective_fn, x, *args,
     the int8 body's two collectives ride rings instead — bit-identical
     to the native int8 body (quantization happens before the transport
     choice).
+
+    ``collective_impl="hierarchical"`` additionally needs ``mesh_spec``
+    (``comm.hierarchical.make_mesh_spec``): each half's all-reduce runs
+    as per-mesh-axis grouped ring phases (hierarchical reduce-scatter +
+    all-gather), bitwise-equal to the flat rings with wire bytes
+    attributed to the mesh axis they ride — the 2-D torus form of the
+    same scheduler-independent overlap.
     """
     B = x.shape[0]
-    if collective_impl not in ("native", "decomposed"):
+    if collective_impl not in ("native", "decomposed", "hierarchical"):
         raise ValueError(f"collective_impl={collective_impl!r}: "
-                         f"expected 'native' or 'decomposed'")
-    if collective_impl == "decomposed":
+                         f"expected 'native', 'decomposed' or "
+                         f"'hierarchical'")
+    if collective_impl in ("decomposed", "hierarchical"):
         if axis is None:
             raise ValueError(
-                "domino_split_async(collective_impl='decomposed') "
-                "needs the mesh axis the layer reduces over (axis=...)")
+                f"domino_split_async(collective_impl="
+                f"{collective_impl!r}) needs the mesh axis the layer "
+                f"reduces over (axis=...)")
+        if collective_impl == "hierarchical" and mesh_spec is None:
+            raise ValueError(
+                "domino_split_async(collective_impl='hierarchical') "
+                "needs the declared mesh factoring (mesh_spec=..., "
+                "comm.hierarchical.make_mesh_spec)")
         if wire_bits is None:
-            from ..comm.ring import ring_all_reduce_sum
+            if collective_impl == "decomposed":
+                from ..comm.ring import ring_all_reduce_sum
 
-            def collective_fn(t):
-                return ring_all_reduce_sum(
-                    t, axis, op_name="domino_ring_allreduce")
+                def collective_fn(t):
+                    return ring_all_reduce_sum(
+                        t, axis, op_name="domino_ring_allreduce")
+            else:
+                # hierarchical RS+AG mesh rings: per-axis grouped
+                # phases, destination index-order fold — bitwise-equal
+                # to the flat rings, value-equal to psum
+                from ..comm.hierarchical import hierarchical_all_reduce_sum
+
+                def collective_fn(t):
+                    return hierarchical_all_reduce_sum(
+                        t, axis, mesh_spec,
+                        op_name="domino_hier_allreduce")
     if wire_bits is not None:
         if axis is None:
             raise ValueError(
@@ -141,7 +167,7 @@ def domino_split_async(compute_fn, collective_fn, x, *args,
         def q_collective(t, e):
             return quantized_allreduce_body(
                 t, e, axis, group_size=group_size, num_bits=wire_bits,
-                collective_impl=collective_impl)
+                collective_impl=collective_impl, mesh_spec=mesh_spec)
 
         if B < 2 or not overlap:
             t = compute_fn(x, *args, **kwargs)

@@ -267,10 +267,16 @@ class HDSEngine:
         self._batch_spec_fn = batch_spec_fn
 
         # ---- ZeRO++ (qwZ / qgZ / hpZ / quantized reduce-scatter) ----
+        # a non-native collective transport (decomposed rings,
+        # hierarchical mesh rings) also engages the explicit step: the
+        # transports only exist on its hand-written gather/reduce
+        # lanes, and silently running GSPMD-native instead would be
+        # exactly the fallthrough the config validation forbids
         self._zeropp = (zcfg.zero_quantized_weights
                         or zcfg.zero_quantized_gradients
                         or zcfg.zero_hpz_partition_size > 1
-                        or zcfg.zero_quantized_reduce_scatter)
+                        or zcfg.zero_quantized_reduce_scatter
+                        or zcfg.zero_collective_impl != "native")
         if self._zeropp:
             from .config import HDSConfigError
             from .zero.zeropp import validate_zeropp

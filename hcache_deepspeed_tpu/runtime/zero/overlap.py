@@ -170,7 +170,10 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
                             knob: str = "reduce_bucket_size",
                             collective_impl: Optional[str] = None,
                             world_size: int = 0,
-                            overlap_comm: bool = True) -> None:
+                            overlap_comm: bool = True,
+                            mesh_spec=None,
+                            longhaul_bits: Optional[int] = None,
+                            hpz: int = 1) -> None:
     """Build-time rejection of nonsensical overlap knobs — a clear
     error instead of the silent clamping the knobs used to get.
 
@@ -191,21 +194,40 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
       fallthrough to the native transport.
     """
     from ..config import HDSConfigError
-    if collective_impl is not None and collective_impl == "decomposed":
+    if collective_impl in ("decomposed", "hierarchical"):
         if world_size == 1:
             raise HDSConfigError(
-                "zero_collective_impl=decomposed with data world size "
-                "1: a one-device ring has no permutes to decompose "
-                "into — use zero_collective_impl=native (or a data "
-                "axis > 1)")
+                f"zero_collective_impl={collective_impl} with data "
+                f"world size 1: a one-device ring has no permutes to "
+                f"decompose into — use zero_collective_impl=native "
+                f"(or a data axis > 1)")
         if not overlap_comm:
             raise HDSConfigError(
-                "zero_collective_impl=decomposed with "
-                "overlap_comm=false: the decomposed ring transport "
-                "exists to make comm/compute overlap structural, and "
-                "overlap_comm=false is the explicit serialization "
-                "fallback — enable overlap_comm or use "
-                "zero_collective_impl=native")
+                f"zero_collective_impl={collective_impl} with "
+                f"overlap_comm=false: the decomposed transports exist "
+                f"to make comm/compute overlap structural, and "
+                f"overlap_comm=false is the explicit serialization "
+                f"fallback — enable overlap_comm or use "
+                f"zero_collective_impl=native")
+    if collective_impl == "hierarchical":
+        from ...comm.hierarchical import validate_mesh_spec
+        if mesh_spec is None:
+            raise HDSConfigError(
+                "zero_collective_impl=hierarchical needs "
+                "zero_mesh_shape (the mesh factoring of the data "
+                "axis); declare it — the transport never guesses a "
+                "factoring")
+        if hpz > 1:
+            raise HDSConfigError(
+                "zero_collective_impl=hierarchical with "
+                "zero_hpz_partition_size > 1: hpZ's secondary groups "
+                "and the mesh's intra axis both claim the fast tier — "
+                "the hierarchical transport already keeps gather "
+                "traffic grouped per axis; use one mechanism, not "
+                "both")
+        if world_size:
+            validate_mesh_spec(mesh_spec, world_size=world_size,
+                               longhaul_bits=longhaul_bits)
     if largest_leaf > reduce_bucket_elements:
         name = f" ({largest_leaf_name})" if largest_leaf_name else ""
         raise HDSConfigError(

@@ -91,7 +91,8 @@ def plan_wire_residual_widths(sizes, dims, *, bucket_elements,
 
 
 def _quantized_wide_reduce(wide, residual, *, group_size, bits,
-                           equiv_bytes, collective_impl="native"):
+                           equiv_bytes, collective_impl="native",
+                           mesh_spec=None):
     """One bucket: ``wide`` is the full ``[n, W]`` cotangent buffer
     (row j -> device j). Returns ``(mean [W] fp32,
     new_residual [n, W] fp32)``. ``residual`` None means error
@@ -138,6 +139,18 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
             payload, DATA_AXIS, op_name="zero_ring_qrs")
         scale_t = decomposed_all_to_all_rows(
             scale, DATA_AXIS, op_name="zero_ring_qrs")
+    elif collective_impl == "hierarchical":
+        # per-mesh-axis grouped delivery of the SAME int8 payload +
+        # scales (quantization still happens before the transport
+        # choice, EF residuals untouched) — source-order arrival, so
+        # the dequant-accumulate below is the same local graph:
+        # bitwise-equal to the native and flat-ring qrs wires, with
+        # every byte attributed to the mesh axis it rides
+        from ...comm.hierarchical import hierarchical_all_to_all_rows
+        payload_t = hierarchical_all_to_all_rows(
+            payload, DATA_AXIS, mesh_spec, op_name="zero_hier_qrs")
+        scale_t = hierarchical_all_to_all_rows(
+            scale, DATA_AXIS, mesh_spec, op_name="zero_hier_qrs")
     else:
         payload_t = jax.lax.all_to_all(payload, DATA_AXIS, 0, 0)
         scale_t = jax.lax.all_to_all(scale, DATA_AXIS, 0, 0)
@@ -150,7 +163,8 @@ def quantized_bucket_reduce_scatter_mean(flat, dims, *, bucket_elements,
                                          group_size, bits=8,
                                          residuals: Optional[list] = None,
                                          error_feedback=True,
-                                         collective_impl="native"):
+                                         collective_impl="native",
+                                         mesh_spec=None):
     """Bucketed QUANTIZED reduce-mean of the sharded leaves of ``flat``
     (full cotangents) onto their data-axis shards — the qgZ all-to-all
     topology at IPG-bucket granularity, one collective pair (payload +
@@ -193,7 +207,8 @@ def quantized_bucket_reduce_scatter_mean(flat, dims, *, bucket_elements,
                 else jnp.zeros(wide.shape, jnp.float32)
         red, nr = _quantized_wide_reduce(
             wide, res, group_size=group_size, bits=bits,
-            equiv_bytes=equiv_bytes, collective_impl=collective_impl)
+            equiv_bytes=equiv_bytes, collective_impl=collective_impl,
+            mesh_spec=mesh_spec)
         if error_feedback:
             new_res.append(nr)
         off = 0

@@ -152,7 +152,8 @@ def _quant_reduce_mean_dim(g, dim, *, group_size):
     return jnp.moveaxis(jnp.mean(deq, axis=0), 0, dim)
 
 
-def _psum_scatter_mean_dim(g, dim, collective_impl="native"):
+def _psum_scatter_mean_dim(g, dim, collective_impl="native",
+                           mesh_spec=None):
     n = jax.lax.axis_size(DATA_AXIS)
     _log_plain("zero_reduce_scatter", g.size * g.dtype.itemsize)
     gm = jnp.moveaxis(g, dim, 0)
@@ -160,6 +161,10 @@ def _psum_scatter_mean_dim(g, dim, collective_impl="native"):
         from ...comm.ring import decomposed_reduce_scatter_sum
         out = decomposed_reduce_scatter_sum(
             gm, DATA_AXIS, op_name="zero_ring_reduce_scatter")
+    elif collective_impl == "hierarchical":
+        from ...comm.hierarchical import hierarchical_reduce_scatter_sum
+        out = hierarchical_reduce_scatter_sum(
+            gm, DATA_AXIS, mesh_spec, op_name="zero_hier_reduce_scatter")
     else:
         out = jax.lax.psum_scatter(gm, DATA_AXIS,
                                    scatter_dimension=0, tiled=True)
@@ -176,7 +181,8 @@ def _log_plain(op, n_bytes):
 
 
 def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
-                                 group_size, collective_impl="native"):
+                                 group_size, collective_impl="native",
+                                 mesh_spec=None):
     """Reduce-mean the sharded leaves of ``flat`` (full cotangents) onto
     their data-axis shards — coalesced into flat reduce-scatter buckets
     of at most ``bucket_elements`` elements (the stage-1/2 IPG-bucket
@@ -229,6 +235,15 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
                 red = decomposed_reduce_scatter_sum(
                     wide, DATA_AXIS,
                     op_name="zero_ring_reduce_scatter")
+            elif collective_impl == "hierarchical":
+                # per-mesh-axis grouped delivery, same destination
+                # index-order fold: still bitwise-equal to psum_scatter
+                # (comm/hierarchical.py contract)
+                from ...comm.hierarchical import \
+                    hierarchical_reduce_scatter_sum
+                red = hierarchical_reduce_scatter_sum(
+                    wide, DATA_AXIS, mesh_spec,
+                    op_name="zero_hier_reduce_scatter")
             else:
                 red = jax.lax.psum_scatter(wide, DATA_AXIS,
                                            scatter_dimension=0,
@@ -245,7 +260,8 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
 
 def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                               bucket_elements, matmul_plan=None,
-                              collective_impl="native"):
+                              collective_impl="native", mesh_spec=None,
+                              longhaul_bits=None):
     """ISSUE half of the layer-granular gather: coalesce the sharded
     leaves of ``flat`` (local shards; the hpZ ``sec`` partition when
     hpz > 1) into flat all-gather payloads of at most
@@ -294,9 +310,12 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
         groups, n_g = None, n
         src = list(flat)
 
-    def pack(items, log_op):
+    def pack(items, log_op, lh_bits=None):
         # items: [(leaf index, 1-D payload)]; one all-gather per
-        # dtype-bucket; payloads flattened to 1-D for the carry
+        # dtype-bucket; payloads flattened to 1-D for the carry.
+        # ``lh_bits``: axis-selective quantization of this family's
+        # long-haul phase (hierarchical transport only, fp payloads —
+        # the qwZ families are already int8 on every axis)
         by_dtype = {}
         for it in items:
             by_dtype.setdefault(jnp.dtype(it[1].dtype), []).append(it)
@@ -318,6 +337,17 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                     wide = ring_all_gather(
                         payload, DATA_AXIS, axis_index_groups=groups,
                         op_name="zero_ring_all_gather")
+                elif collective_impl == "hierarchical":
+                    # per-mesh-axis ring phases, same [n_g, W] row
+                    # order; the long-haul phase optionally ships
+                    # int8/int4 (comm/hierarchical.py — hpZ groups are
+                    # rejected with this transport at validation)
+                    from ...comm.hierarchical import \
+                        hierarchical_all_gather
+                    wide = hierarchical_all_gather(
+                        payload, DATA_AXIS, mesh_spec,
+                        longhaul_bits=lh_bits, group_size=group_size,
+                        op_name="zero_hier_all_gather")
                 else:
                     wide = jax.lax.all_gather(payload, DATA_AXIS,
                                               axis_index_groups=groups)
@@ -361,7 +391,8 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
         items = [(i, p.reshape(-1))
                  for i, (p, d) in enumerate(zip(src, dims))
                  if d is not None]
-        pr, plan_r = pack(items, "zero_bucket_all_gather")
+        pr, plan_r = pack(items, "zero_bucket_all_gather",
+                          lh_bits=longhaul_bits)
         meta.update(plan_r=plan_r, n_r=len(pr),
                     shapes={i: tuple(src[i].shape) for i, _ in items})
         payloads = pr
@@ -444,14 +475,19 @@ def bucketed_all_gather_finish(payloads, meta, fused=False):
 
 def bucketed_all_gather(flat, sec, dims, *, qw, hpz, group_size,
                         bucket_elements, matmul_plan=None, fused=False,
-                        collective_impl="native"):
+                        collective_impl="native", mesh_spec=None,
+                        longhaul_bits=None):
     """One-shot layer-granular gather: start + finish back to back
     (the sequential form). Values are bitwise-identical to the
-    per-leaf gathers — buckets only batch the data movement."""
+    per-leaf gathers — buckets only batch the data movement (the
+    axis-selective ``longhaul_bits`` wire is the one declared
+    exception: long-haul rows dequantize, documented in
+    comm/hierarchical.py)."""
     payloads, meta = bucketed_all_gather_start(
         flat, sec, dims, qw=qw, hpz=hpz, group_size=group_size,
         bucket_elements=bucket_elements, matmul_plan=matmul_plan,
-        collective_impl=collective_impl)
+        collective_impl=collective_impl, mesh_spec=mesh_spec,
+        longhaul_bits=longhaul_bits)
     return bucketed_all_gather_finish(payloads, meta, fused=fused)
 
 
@@ -483,7 +519,7 @@ def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
 def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
                       group_size: int = 2048,
                       reduce_bucket_elements: int = 500_000_000,
-                      collective_impl: str = "native"):
+                      collective_impl: str = "native", mesh_spec=None):
     """Build ``gather(primary, secondary) -> full params`` with a custom
     VJP that performs the (optionally quantized) gradient reduce-scatter.
 
@@ -504,7 +540,8 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
         if qg:
             return _quant_reduce_mean_dim(g, dim, group_size=group_size)
         return _psum_scatter_mean_dim(g, dim,
-                                      collective_impl=collective_impl)
+                                      collective_impl=collective_impl,
+                                      mesh_spec=mesh_spec)
 
     @jax.custom_vjp
     def gather(primary, secondary):
@@ -528,7 +565,7 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
             treedef, bucketed_reduce_scatter_mean(
                 flat, param_dims, bucket_elements=reduce_bucket_elements,
                 qg=qg, group_size=group_size,
-                collective_impl=collective_impl))
+                collective_impl=collective_impl, mesh_spec=mesh_spec))
         # secondary is a value-copy of primary; its cotangent is defined
         # to be zero (all gradient flows to the primary partition).
         return g_primary, [None] * len(param_dims)
@@ -617,12 +654,16 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
         fused_matmul=zcfg.zero_quantized_weights_fused_matmul,
         quantized_weights=zcfg.zero_quantized_weights,
         stage=stage)
-    # decomposed ring transport: world-size/overlap interplay is only
-    # knowable here (topology in hand) — typed rejection, no silent
-    # fallthrough to the native transport
+    # decomposed/hierarchical ring transports: world-size/overlap/mesh
+    # interplay is only knowable here (topology in hand) — typed
+    # rejection, no silent fallthrough to the native transport
+    from ...comm.hierarchical import mesh_spec_from_zero_config
     validate_overlap_config(
         collective_impl=getattr(zcfg, "zero_collective_impl", "native"),
-        world_size=data_size, overlap_comm=zcfg.overlap_comm)
+        world_size=data_size, overlap_comm=zcfg.overlap_comm,
+        mesh_spec=mesh_spec_from_zero_config(zcfg),
+        longhaul_bits=getattr(zcfg, "zero_longhaul_wire_bits", None),
+        hpz=hpz)
 
 
 def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
@@ -664,24 +705,30 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     qg = zcfg.zero_quantized_gradients
     hpz = zcfg.zero_hpz_partition_size
     collective_impl = getattr(zcfg, "zero_collective_impl", "native")
+    mesh_spec = None
 
-    if collective_impl == "decomposed":
-        # the ring transport rides the layered step's explicit lanes;
+    if collective_impl in ("decomposed", "hierarchical"):
+        # the ring transports ride the layered step's explicit lanes;
         # the whole-tree fallback's gathers are AD-generated per-leaf
         # ops with no bucket site to decompose. Reject loudly instead
         # of silently running a half-native hybrid.
+        from ...comm.hierarchical import mesh_spec_from_zero_config
         from .overlap import validate_overlap_config
+        mesh_spec = mesh_spec_from_zero_config(zcfg)
         validate_overlap_config(
             collective_impl=collective_impl,
             world_size=int(mesh.shape[DATA_AXIS]),
-            overlap_comm=zcfg.overlap_comm)
+            overlap_comm=zcfg.overlap_comm,
+            mesh_spec=mesh_spec,
+            longhaul_bits=getattr(zcfg, "zero_longhaul_wire_bits", None),
+            hpz=hpz)
         if layered is None:
             from ..config import HDSConfigError
             raise HDSConfigError(
-                "zero_collective_impl=decomposed requires the layered "
-                "ZeRO-3 step: keep zero_optimization.layered_gather="
-                "true and use a model with a layered spec "
-                "(models/layered.py)")
+                f"zero_collective_impl={collective_impl} requires the "
+                f"layered ZeRO-3 step: keep zero_optimization."
+                f"layered_gather=true and use a model with a layered "
+                f"spec (models/layered.py)")
 
     if (zcfg.zero_quantized_reduce_scatter
             or zcfg.zero_quantized_weights_fused_matmul) \
@@ -734,7 +781,7 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     gather, reduce_grads = make_param_gather(
         param_dims, grad_dims, qw=qw, qg=qg, hpz=hpz,
         reduce_bucket_elements=zcfg.reduce_bucket_size,
-        collective_impl=collective_impl)
+        collective_impl=collective_impl, mesh_spec=mesh_spec)
 
     if layered is not None:
         return _build_layered(
@@ -743,7 +790,7 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
             grad_accum_dtype=grad_accum_dtype, remat_policy=remat_policy,
             qw=qw, qg=qg, hpz=hpz, reduce_grads=reduce_grads,
             params_proj=params_proj, grads_proj=grads_proj,
-            zcfg=zcfg, param_shapes=param_shapes)
+            zcfg=zcfg, param_shapes=param_shapes, mesh_spec=mesh_spec)
 
     prepare_secondary = None
     if hpz > 1:
@@ -824,7 +871,7 @@ _ZO_DEBUG = False
 def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                    grad_accum_dtype, remat_policy, qw, qg, hpz,
                    reduce_grads, params_proj, grads_proj, zcfg,
-                   param_shapes=None):
+                   param_shapes=None, mesh_spec=None):
     """Software-pipelined scan-over-layers ZeRO-3 micro step.
 
     The fwd+bwd over transformer blocks is written by hand (no
@@ -883,9 +930,12 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
     fused_mm = zcfg.zero_quantized_weights_fused_matmul
     # collective transport of the gather/reduce lanes: "native" =
     # monolithic all-gather / psum_scatter / all-to-all; "decomposed"
-    # = chunked ppermute ring chains (comm/ring.py) — bitwise-equal,
-    # structurally overlappable by dataflow construction
+    # = chunked ppermute ring chains (comm/ring.py); "hierarchical" =
+    # per-mesh-axis grouped ring phases (comm/hierarchical.py, with
+    # optional long-haul-only wire quantization) — both bitwise-equal
+    # to native, structurally overlappable by dataflow construction
     impl = getattr(zcfg, "zero_collective_impl", "native")
+    longhaul_bits = getattr(zcfg, "zero_longhaul_wire_bits", None)
     if (qrs or fused_mm) and param_shapes is None:
         from ..config import HDSConfigError
         raise HDSConfigError(
@@ -1119,7 +1169,8 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                 payloads, meta = bucketed_all_gather_start(
                     flat, sec, block_pdims, qw=qw, hpz=hpz,
                     group_size=group_size, bucket_elements=ag_bucket,
-                    matmul_plan=matmul_plan, collective_impl=impl)
+                    matmul_plan=matmul_plan, collective_impl=impl,
+                    mesh_spec=mesh_spec, longhaul_bits=longhaul_bits)
                 gmeta.setdefault("m", meta)
                 return list(iso(tuple(payloads)))
 
@@ -1142,13 +1193,13 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                         bucket_elements=bucket_elems,
                         group_size=group_size, bits=qrs_bits,
                         residuals=res, error_feedback=qrs_ef,
-                        collective_impl=impl)
+                        collective_impl=impl, mesh_spec=mesh_spec)
                 else:
                     out = bucketed_reduce_scatter_mean(
                         flat_cots, block_pdims,
                         bucket_elements=bucket_elems,
                         qg=qg, group_size=group_size,
-                        collective_impl=impl)
+                        collective_impl=impl, mesh_spec=mesh_spec)
                     nres = []
                 out = list(iso(tuple(out)))
                 if nres:
@@ -1354,12 +1405,13 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                         bucket_elements=bucket_elems,
                         group_size=group_size, bits=qrs_bits,
                         residuals=res_outer, error_feedback=qrs_ef,
-                        collective_impl=impl)
+                        collective_impl=impl, mesh_spec=mesh_spec)
             else:
                 outer_red = bucketed_reduce_scatter_mean(
                     jax.tree.flatten(outer_cot)[0], outer_pdims,
                     bucket_elements=bucket_elems, qg=qg,
-                    group_size=group_size, collective_impl=impl)
+                    group_size=group_size, collective_impl=impl,
+                    mesh_spec=mesh_spec)
 
             grads = dict(jax.tree.unflatten(outer_def, outer_red))
             for i in range(n_layer):
@@ -1421,6 +1473,9 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         "fused_matmul_leaves": len(matmul_plan) if matmul_plan else 0,
         "wire_error_buckets": len(block_res_widths)
         + len(outer_res_widths),
+        "mesh_spec": mesh_spec.describe() if mesh_spec is not None
+        else None,
+        "longhaul_wire_bits": longhaul_bits,
     }
     if qrs_ef:
         # non-JSON engine hook: allocates the error-feedback state

@@ -1,0 +1,277 @@
+"""Tier-1 gates for the hierarchical mesh collectives
+(``comm/hierarchical.py``): mesh-spec construction/validation, the
+axis-selective long-haul quantization contract (own-coordinate rows
+bit-exact, crossing rows dequantized, EF residuals pinned to zero on
+the own slice), per-mesh-axis wire-byte attribution, and the matched
+quantized/unquantized-equiv byte pairs. Full-width bitwise parity vs
+native and the flat rings lives in ``test_ring.py``
+(``TestGroupedMultiAxis``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hcache_deepspeed_tpu.comm.comms_logging import get_comms_logger
+from hcache_deepspeed_tpu.comm.hierarchical import (
+    axis_groups, hierarchical_all_gather, hierarchical_all_reduce_sum,
+    hierarchical_reduce_scatter_sum, make_mesh_spec, validate_mesh_spec)
+from hcache_deepspeed_tpu.ops.quantizer import dequantize, quantize
+from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+
+
+def _mesh(n):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return Mesh(np.array(devs[:n]).reshape(n), ("d",))
+
+
+def _shm(mesh, f, ins, outs):
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=ins,
+                                 out_specs=outs, check_vma=False))
+
+
+class TestMeshSpec:
+
+    def test_defaults_2d(self):
+        spec = make_mesh_spec([2, 4])
+        assert spec.names == ("inter", "intra")
+        assert spec.longhaul == "inter"
+        assert spec.longhaul_dim == 0
+        assert spec.world == 8
+        assert spec.describe()["shape"] == [2, 4]
+
+    def test_axis_groups_match_rank_factoring(self):
+        # 2x4 row-major: inner groups contiguous, outer groups strided
+        assert axis_groups((2, 4), 1) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert axis_groups((2, 4), 0) == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+    def test_bandwidths_ride_the_spec(self):
+        spec = make_mesh_spec([2, 4], link_gbytes_per_s=[6.75, 45.0])
+        assert spec.bandwidths() == {"inter": 6.75, "intra": 45.0}
+
+    def test_degenerate_shapes_rejected(self):
+        with pytest.raises(HDSConfigError, match="at least 2 axes"):
+            make_mesh_spec([8])
+        with pytest.raises(HDSConfigError, match="size >= 2"):
+            make_mesh_spec([8, 1])
+        with pytest.raises(HDSConfigError, match="duplicate"):
+            make_mesh_spec([2, 4], axis_names=["x", "x"])
+        with pytest.raises(HDSConfigError, match="match"):
+            make_mesh_spec([2, 4], axis_names=["x"])
+        with pytest.raises(HDSConfigError, match="unknown"):
+            make_mesh_spec([2, 4], longhaul_axis="dcn")
+        with pytest.raises(HDSConfigError, match="per-axis bandwidth"):
+            make_mesh_spec([2, 4], link_gbytes_per_s=[1.0])
+
+    def test_world_and_bits_validation(self):
+        spec = make_mesh_spec([2, 4])
+        validate_mesh_spec(spec, world_size=8, longhaul_bits=4)
+        with pytest.raises(HDSConfigError, match="factor the axis"):
+            validate_mesh_spec(spec, world_size=16)
+        with pytest.raises(HDSConfigError, match="wire_bits"):
+            validate_mesh_spec(spec, world_size=8, longhaul_bits=16)
+
+
+class TestLonghaulQuantizedGather:
+    """The axis-selective contract: rows from this device's own
+    long-haul coordinate arrive BIT-EXACT (they never crossed the slow
+    wire); every other row is the dequantized form of the source's
+    intra-gathered block — genuinely lossy (not the exact values) but
+    within the int8/int4 groupwise error envelope. The dequant value is
+    checked against an eagerly-computed reference to ~1 ulp (XLA may
+    re-associate the identical multiply inside the compiled program, so
+    bit-for-bit is the wrong assertion for the crossing rows)."""
+
+    @pytest.mark.parametrize("bits", (8, 4))
+    def test_exact_vs_dequant_pattern(self, eight_devices, bits):
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 13)), jnp.float32)
+
+        def hq(xl):
+            return hierarchical_all_gather(
+                xl[0], "d", spec, longhaul_bits=bits,
+                group_size=16)[None]
+
+        got = np.asarray(_shm(mesh, hq, (P("d"),), P("d"))(x))
+        full = np.asarray(x)
+        from hcache_deepspeed_tpu.runtime.zero.qwire import (pack_int4,
+                                                             unpack_int4)
+        for r in range(8):
+            o = r // 4
+            for s in range(8):
+                so = s // 4
+                if so == o:
+                    # fast-axis rows: bit-exact, no quantization ever
+                    np.testing.assert_array_equal(got[r, s], full[s])
+                else:
+                    # source (so, *) quantized its intra-gathered
+                    # [4, 13] block as one payload
+                    blk = jnp.asarray(full[so * 4:(so + 1) * 4])
+                    q, sc, sh, ct = quantize(
+                        blk, group_size=16,
+                        num_bits=4 if bits == 4 else 8)
+                    if bits == 4:
+                        q = unpack_int4(pack_int4(q), q.shape[-1])
+                    deq = np.asarray(dequantize(q, sc, sh, ct))
+                    np.testing.assert_allclose(got[r, s], deq[s % 4],
+                                               rtol=1e-6, atol=1e-6)
+            # the crossing block as a whole really was quantized —
+            # it must NOT equal the exact values
+            other = 1 - o
+            assert not np.array_equal(
+                got[r, other * 4:(other + 1) * 4],
+                full[other * 4:(other + 1) * 4])
+
+    def test_longhaul_pair_logged(self, eight_devices):
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        logger = get_comms_logger()
+        logger.configure(enabled=True)
+        logger.reset()
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 64)),
+                        jnp.float32)
+
+        def hq(xl):
+            return hierarchical_all_gather(
+                xl[0], "d", spec, longhaul_bits=8,
+                op_name="t_hier_ag")[None]
+
+        _shm(mesh, hq, (P("d"),), P("d"))(x)
+        savings = logger.wire_savings_summary()
+        assert "t_hier_ag_longhaul" in savings, savings
+        rec = savings["t_hier_ag_longhaul"]
+        # int8 + fp32 group scales: well under half of fp32 full width
+        assert rec["fraction"] < 0.5
+        # per-axis attribution: intra full-width, inter quantized
+        per_axis = logger.permute_axis_bytes()["t_hier_ag"]
+        assert set(per_axis) == {"intra", "inter"}
+        # intra phase: 3 neighbor sends x 64 fp32 per trace
+        assert per_axis["intra"] == 3 * 64 * 4
+        # inter phase ships payload+scales (int8-dominated): fewer
+        # bytes than the full-width equivalent (1 send x intra block)
+        assert per_axis["inter"] < 4 * 64 * 4
+        totals = logger.total_axis_bytes()
+        assert totals["intra"] == per_axis["intra"]
+        assert totals["inter"] == per_axis["inter"]
+        logger.reset()
+        logger.configure(enabled=False)
+
+
+class TestLonghaulQuantizedReduce:
+
+    @pytest.mark.parametrize("bits", (8, 4))
+    def test_close_to_native_and_ef_improves(self, eight_devices, bits):
+        """Quantized long-haul reduce: close to the native sum within
+        the groupwise error envelope, and CUMULATIVE error over
+        repeated residual-threaded passes stays bounded (the 1-bit /
+        EF contract: the error is re-injected, not compounded — without
+        EF the same deterministic bias repeats every pass). The
+        own-coordinate slice of the residual is pinned to zero — that
+        block shipped exact."""
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(2)
+        w = jnp.asarray(rng.normal(size=(8, 16, 3)), jnp.float32)
+        steps = 4
+
+        def hq(wl):
+            outs, res = [], None
+            for _ in range(steps):
+                out, res = hierarchical_reduce_scatter_sum(
+                    wl[0], "d", spec, longhaul_bits=bits, residual=res)
+                outs.append(out)
+            return tuple(outs) + (res,)
+
+        f = jax.jit(jax.shard_map(
+            hq, mesh=mesh, in_specs=(P("d"),),
+            out_specs=tuple([P("d")] * (steps + 1)), check_vma=False))
+        *outs, res_last = f(w)
+        ref = np.asarray(_shm(mesh, lambda wl: jax.lax.psum_scatter(
+            wl[0], "d", scatter_dimension=0, tiled=True),
+            (P("d"),), P("d"))(w))
+        # 4 of the 8 contributions per output element cross the long
+        # haul; each carries up to scale/2 = absmax/(2*qmax) error
+        absmax = float(np.abs(np.asarray(w)).max())
+        qmax = 127 if bits == 8 else 7
+        tol = 4 * absmax / (2 * qmax) * 1.1
+        assert np.allclose(np.asarray(outs[0]), ref, atol=tol)
+        # cumulative EF error << repeating the first pass's bias
+        cum_ef = np.abs(sum(np.asarray(o) for o in outs)
+                        - steps * ref).sum()
+        cum_noef = steps * np.abs(np.asarray(outs[0]) - ref).sum()
+        assert cum_ef < cum_noef
+        # own-coordinate residual slice is zero on every device: the
+        # global stacked view [8 * 2, W] interleaves devices' [2, W]
+        # residuals; device (o, i)'s own row o must be zero
+        res = np.asarray(res_last).reshape(8, 2, -1)
+        for dev in range(8):
+            own = dev // 4
+            assert np.all(res[dev, own] == 0.0)
+            assert np.any(res[dev, 1 - own] != 0.0)
+
+    def test_plain_signature_unchanged(self, eight_devices):
+        """Without longhaul_bits the return is the flat-ring signature
+        (no residual tuple) — pinned so transport swaps stay drop-in."""
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        w = jnp.asarray(np.random.default_rng(3).normal(size=(8, 8, 2)),
+                        jnp.float32)
+
+        def hier(wl):
+            return hierarchical_reduce_scatter_sum(wl[0], "d", spec)
+
+        out = np.asarray(_shm(mesh, hier, (P("d"),), P("d"))(w))
+        # local [m=1, 2] shards stack to [8, 2] under P("d")
+        assert out.shape == (8, 2)
+
+
+class TestAllReduceAndAttribution:
+
+    def test_all_reduce_bitwise_vs_flat(self, eight_devices):
+        from hcache_deepspeed_tpu.comm.ring import ring_all_reduce_sum
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        x = jnp.asarray(np.random.default_rng(4).normal(size=(8, 7, 5)),
+                        jnp.float32)
+
+        def hier(xl):
+            return hierarchical_all_reduce_sum(xl[0], "d", spec)[None]
+
+        def flat(xl):
+            return ring_all_reduce_sum(xl[0], "d")[None]
+
+        a = np.asarray(_shm(mesh, hier, (P("d"),), P("d"))(x))
+        b = np.asarray(_shm(mesh, flat, (P("d"),), P("d"))(x))
+        np.testing.assert_array_equal(a, b)
+
+    def test_per_axis_bytes_split_the_flat_bucket(self, eight_devices):
+        """The satellite contract: permute bytes are attributable per
+        mesh-axis name, intra- vs inter-axis separately queryable, and
+        the per-op totals still reconcile with the lumped summary."""
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        logger = get_comms_logger()
+        logger.configure(enabled=True)
+        logger.reset()
+        x = jnp.asarray(np.random.default_rng(5).normal(size=(8, 40)),
+                        jnp.float32)
+
+        def hier(xl):
+            return hierarchical_all_gather(
+                xl[0], "d", spec, op_name="t_axis_ag")[None]
+
+        _shm(mesh, hier, (P("d"),), P("d"))(x)
+        per_axis = logger.permute_axis_bytes()["t_axis_ag"]
+        # intra ring: 3 sends x 40 fp32; inter ring: 1 send x the
+        # intra-gathered [4, 40] block
+        assert per_axis == {"intra": 3 * 40 * 4, "inter": 1 * 4 * 40 * 4}
+        lumped = logger.permute_bytes_summary()["t_axis_ag"]
+        assert lumped == sum(per_axis.values())
+        logger.reset()
+        logger.configure(enabled=False)

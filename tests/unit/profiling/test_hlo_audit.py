@@ -267,3 +267,56 @@ class TestReport:
         rep = audit_hlo_text("not hlo at all\n{}\nrandom { tokens }")
         assert rep.pairs() == []
         assert rep.overlap_ratio() == 1.0  # nothing on the critical path
+
+
+class TestWireCostModel:
+    """Per-axis wire-cost model (ISSUE 12): bytes x declared per-axis
+    link bandwidth -> modeled seconds, plus the (K-1)/(k-1) pod-scale
+    ring projection. Pure dict math — deliberately unit-testable
+    without any HLO."""
+
+    def test_seconds_are_bytes_over_bandwidth(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            wire_cost_seconds
+        out = wire_cost_seconds({"inter": 6.75e9, "intra": 45e9},
+                                {"inter": 6.75, "intra": 45.0})
+        assert out["per_axis"]["inter"]["seconds"] == 1.0
+        assert out["per_axis"]["intra"]["seconds"] == 1.0
+        assert out["total_seconds"] == 2.0
+        # ties resolve to the first-seen slowest; both are 1.0 here
+        assert out["bottleneck_axis"] in ("inter", "intra")
+
+    def test_bottleneck_is_slowest_axis(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            wire_cost_seconds
+        out = wire_cost_seconds({"inter": 100.0, "intra": 100.0},
+                                {"inter": 1.0, "intra": 10.0})
+        assert out["bottleneck_axis"] == "inter"
+
+    def test_undeclared_bandwidth_visible_not_free(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            wire_cost_seconds
+        out = wire_cost_seconds({"inter": 100.0, "mystery": 100.0},
+                                {"inter": 1.0})
+        assert out["per_axis"]["mystery"]["seconds"] is None
+        assert out["per_axis"]["mystery"]["bytes"] == 100
+        # total sums only the priced axes
+        assert out["total_seconds"] == out["per_axis"]["inter"]["seconds"]
+
+    def test_pod_projection_scales_ring_sends(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            pod_scale_wire_seconds
+        # toy axis of 2 -> pod axis of 16: (16-1)/(2-1) = 15x bytes
+        out = pod_scale_wire_seconds(
+            {"inter": 100.0, "intra": 300.0},
+            {"inter": 2, "intra": 4}, {"inter": 16, "intra": 16},
+            {"inter": 1.0, "intra": 1.0})
+        assert out["scaled_axis_bytes"]["inter"] == 1500
+        assert out["scaled_axis_bytes"]["intra"] == 300 * 15 // 3
+        assert "assumption" in out
+
+    def test_unknown_axis_size_passes_through_unscaled(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            pod_scale_wire_seconds
+        out = pod_scale_wire_seconds({"x": 64.0}, {}, {}, {"x": 1.0})
+        assert out["scaled_axis_bytes"]["x"] == 64

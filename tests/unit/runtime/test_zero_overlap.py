@@ -288,6 +288,84 @@ class TestDecomposedKnobValidation:
                                 overlap_comm=False)
 
 
+class TestHierarchicalKnobValidation:
+    """Typed rejection of degenerate hierarchical configs (ISSUE 12
+    satellite): axis of size 1, mesh shape not factoring the world
+    size, unknown long-haul axis for the axis-selective quantization,
+    hpZ/hierarchy overlap — no silent clamps."""
+
+    def test_missing_mesh_shape_rejected_at_parse(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="zero_mesh_shape"):
+            ZeroConfig(zero_collective_impl="hierarchical")
+
+    def test_size_one_axis_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="size >= 2"):
+            ZeroConfig(zero_collective_impl="hierarchical",
+                       zero_mesh_shape=[1, 8])
+
+    def test_single_axis_mesh_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="at least 2 axes"):
+            ZeroConfig(zero_collective_impl="hierarchical",
+                       zero_mesh_shape=[8])
+
+    def test_shape_not_factoring_world_rejected(self):
+        from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+        spec = make_mesh_spec([2, 4])
+        with pytest.raises(HDSConfigError, match="factor the axis"):
+            validate_overlap_config(collective_impl="hierarchical",
+                                    world_size=16, mesh_spec=spec)
+
+    def test_unknown_longhaul_axis_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="unknown"):
+            ZeroConfig(zero_collective_impl="hierarchical",
+                       zero_mesh_shape=[2, 4],
+                       zero_longhaul_axis="dcn")
+
+    def test_bad_longhaul_bits_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="wire_bits"):
+            ZeroConfig(zero_collective_impl="hierarchical",
+                       zero_mesh_shape=[2, 4],
+                       zero_longhaul_wire_bits=16)
+
+    def test_mesh_knobs_without_hierarchical_rejected(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="no effect"):
+            ZeroConfig(zero_mesh_shape=[2, 4])
+        with pytest.raises(HDSConfigError, match="no effect"):
+            ZeroConfig(zero_collective_impl="decomposed",
+                       zero_longhaul_wire_bits=8)
+
+    def test_hpz_with_hierarchical_rejected(self):
+        from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+        spec = make_mesh_spec([2, 4])
+        with pytest.raises(HDSConfigError, match="hpz|hpZ"):
+            validate_overlap_config(collective_impl="hierarchical",
+                                    world_size=8, mesh_spec=spec,
+                                    hpz=4)
+
+    def test_overlap_comm_false_rejected_at_parse(self):
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        with pytest.raises(HDSConfigError, match="overlap_comm"):
+            ZeroConfig(zero_collective_impl="hierarchical",
+                       zero_mesh_shape=[2, 4], overlap_comm=False)
+
+    def test_valid_hierarchical_config_accepted(self):
+        from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        zcfg = ZeroConfig(zero_collective_impl="hierarchical",
+                          zero_mesh_shape=[2, 4],
+                          zero_longhaul_wire_bits=8)
+        assert zcfg.zero_mesh_shape == [2, 4]
+        validate_overlap_config(
+            collective_impl="hierarchical", world_size=8,
+            mesh_spec=make_mesh_spec([2, 4]), longhaul_bits=8)
+
+
 class TestKnobValidation:
 
     def test_reduce_bucket_smaller_than_leaf_rejected(self, eight_devices):

@@ -200,9 +200,11 @@ class TestDecomposedTransportMatrix:
     bytes move, never what they say."""
 
     def _assert_transport_bitwise(self, bf16=False, depth0=False,
-                                  steps=3, **zero_extra):
-        extra_dec = dict(zero_extra,
-                         zero_collective_impl="decomposed")
+                                  steps=3, impl="decomposed",
+                                  **zero_extra):
+        extra_dec = dict(zero_extra, zero_collective_impl=impl)
+        if impl == "hierarchical":
+            extra_dec["zero_mesh_shape"] = [2, 4]
         if depth0:
             zero_extra = dict(zero_extra,
                               stage3_prefetch_bucket_size=0)
@@ -212,7 +214,7 @@ class TestDecomposedTransportMatrix:
         want = 0 if depth0 else 1
         assert a.zero_overlap_plan["depth"] == want
         assert b.zero_overlap_plan["depth"] == want
-        assert b.zero_overlap_plan["collective_impl"] == "decomposed"
+        assert b.zero_overlap_plan["collective_impl"] == impl
         batch = _batch()
         la = [float(a.train_batch(batch=batch)) for _ in range(steps)]
         lb = [float(b.train_batch(batch=batch)) for _ in range(steps)]
@@ -274,6 +276,27 @@ class TestDecomposedTransportMatrix:
         (axis_index_groups)."""
         self._assert_transport_bitwise(zero_quantized_weights=True,
                                        zero_hpz_partition_size=2)
+
+    # ---- hierarchical (2-D mesh) transport: same bitwise contract,
+    # the 2x4 factoring of the 8-device axis (comm/hierarchical.py)
+    def test_fp32_qwz_hier_depth1(self, eight_devices):
+        self._assert_transport_bitwise(impl="hierarchical",
+                                       zero_quantized_weights=True)
+
+    def test_bf16_qwz_hier_depth0(self, eight_devices):
+        self._assert_transport_bitwise(bf16=True, depth0=True,
+                                       impl="hierarchical",
+                                       zero_quantized_weights=True)
+
+    def test_fp32_qrs_ef_hier_depth1(self, eight_devices):
+        """The quantized wire rides the mesh rings: quantization
+        happens before the transport choice, EF residuals intact —
+        still bitwise vs the native transport."""
+        self._assert_transport_bitwise(
+            impl="hierarchical",
+            zero_quantized_weights=True,
+            zero_quantized_reduce_scatter=True,
+            zero_reduce_scatter_error_feedback=True)
 
 
 class TestGradAccumulation:
