@@ -1179,6 +1179,48 @@ def run_disagg(out_path="DISAGG_SERVE.jsonl"):
     return 0 if ok else 4
 
 
+def run_request_trace(out_path="REQUEST_TRACE.jsonl"):
+    """``--request-trace``: CPU-deterministic causal-tracing audit —
+    replay the chaos/fleet/disagg workloads and gate connected
+    cross-replica span DAGs, per-request attribution closure (sum ==
+    measured E2E within 1%), same-seed digest determinism, and
+    byte-identical flight-recorder bundle digests
+    (docs/observability.md). Self-compares against the committed perf
+    trajectory before writing. Never touches the TPU relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_request_trace as run_rt
+    try:
+        results = run_rt(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(
+            f"request-trace gate failed: {exc}")), flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "request-trace-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "causal request tracing: traced requests with "
+                  "connected DAGs + closed attribution",
+        "value": summary["traced_requests"],
+        "unit": "requests",
+        "vs_baseline": 1.0 if summary["dag_connected"] and
+        summary["closure_ok"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("dag_connected", "closure_ok",
+                   "closure_max_residual", "deterministic",
+                   "flight_deterministic", "flight_bundles",
+                   "crash_evacuations", "handoffs",
+                   "ttft_attr_p99_s")},
+    }), flush=True)
+    ok = (summary["dag_connected"] and summary["closure_ok"] and
+          summary["deterministic"] and
+          summary["flight_deterministic"] and
+          not summary["violations"])
+    return 0 if ok else 4
+
+
 def main():
     if "--zero-overlap" in sys.argv[1:]:
         return run_zero_overlap()
@@ -1186,6 +1228,8 @@ def main():
         return run_fleet()
     if "--disagg" in sys.argv[1:]:
         return run_disagg()
+    if "--request-trace" in sys.argv[1:]:
+        return run_request_trace()
     child = os.environ.get("HDS_BENCH_CHILD")
     if child or os.environ.get("HDS_BENCH_TINY") == "1":
         # child / smoke mode: measure exactly one config in-process

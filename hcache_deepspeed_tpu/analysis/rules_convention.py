@@ -18,19 +18,35 @@
   suppressions document deliberate exceptions; a bare one is just a
   mute button and is rejected (the pragma is also ignored, so the
   underlying finding still fires).
+* **HDS-C004** — a serving-path async span (literal name under the
+  ``sched.`` / ``serve.`` / ``fleet.`` prefixes) carrying neither a
+  ``uid=`` nor a ``trace=`` attribute: without the request identity on
+  the span, the multi-tracer assembler cannot link it into the
+  per-request causal DAG, and the span is unattributable noise in the
+  exported timeline. Computed names are skipped (the trace validator
+  owns their runtime pairing, and the real emitters stamp identity on
+  the live objects).
 """
 
 import ast
+import re
 from typing import Dict, Iterable, List, Tuple
 
 from .core import AnalysisContext, Finding, ModuleInfo, Rule
 
 _TYPED_ERRORS = ("HDSConfigError",)
 
+#: async-span name prefixes that identify serving-path request flow —
+#: the spans the causal assembler must be able to key by request
+_REQUEST_SPAN_RE = re.compile(r"^(sched|serve|fleet)\.")
+
+#: keyword attributes that satisfy the request-identity requirement
+_IDENTITY_ATTRS = ("uid", "trace")
+
 
 class ConventionRule(Rule):
     family = "convention"
-    codes = ("HDS-C001", "HDS-C002", "HDS-C003")
+    codes = ("HDS-C001", "HDS-C002", "HDS-C003", "HDS-C004")
 
     def check_module(self, mod: ModuleInfo,
                      ctx: AnalysisContext) -> Iterable[Finding]:
@@ -51,6 +67,20 @@ class ConventionRule(Rule):
                                 (mod.relpath, node.lineno))
                         else:
                             ends.add(first.value)
+                        if _REQUEST_SPAN_RE.match(first.value) and \
+                                not any(kw.arg in _IDENTITY_ATTRS
+                                        for kw in node.keywords):
+                            findings.append(Finding(
+                                code="HDS-C004", family=self.family,
+                                path=mod.relpath, line=node.lineno,
+                                qualname="<module>",
+                                symbol=first.value,
+                                message=(
+                                    f"serving async span "
+                                    f"{first.value!r} carries no "
+                                    f"uid=/trace= attribute — the "
+                                    f"causal assembler cannot link "
+                                    f"it into a per-request DAG")))
             if isinstance(node, ast.FunctionDef) and \
                     node.name.startswith("validate_"):
                 findings.extend(self._check_validator(node, mod))

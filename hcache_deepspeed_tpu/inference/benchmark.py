@@ -1313,6 +1313,159 @@ def run_disagg_serve(seed=0, n_prefill=1, n_decode=3, runs=2,
     return results
 
 
+def run_request_trace(seed=0, runs=2, out="REQUEST_TRACE.jsonl",
+                      closure_tol=0.01):
+    """Causal request-tracing audit (``bench.py --request-trace``):
+    replay the committed chaos workloads — the single-engine storm,
+    the fleet crash/hang/partition run, and the disaggregated tier
+    run — and gate, per leg and fleet-wide:
+
+    * **connected span DAGs** — every terminal request's TraceContext
+      chain tiles its timeline with no orphan spans, across >=1 crash
+      evacuation and >=1 prefill→decode handoff;
+    * **attribution closure** — per-request critical-path attribution
+      sums to the measured E2E latency within ``closure_tol`` (1%);
+    * **determinism** — same-seed event digests byte-identical across
+      ``runs`` replays;
+    * **flight recorder** — each leg's anomaly triggers (breaker
+      trips, SLO burn) produce the same bundle count with pairwise
+      byte-identical bundle digests across same-seed runs.
+
+    The summary row carries the headline p99-TTFT attribution profile
+    (which stage owns the TTFT tail). Raises on any gate failure —
+    the artifact IS the acceptance evidence. Pure CPU/virtual-clock.
+    """
+    from ..resilience.chaos import (run_chaos, run_disagg_chaos,
+                                    run_fleet_chaos)
+    from ..telemetry.flight import get_flight_recorder
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    emit({"phase": "request-trace-plan", "seed": seed, "runs": runs,
+          "closure_tol": closure_tol,
+          "legs": ["chaos", "fleet", "disagg"]})
+
+    recorder = get_flight_recorder()
+    legs = (("chaos", lambda: run_chaos(seed=seed)),
+            ("fleet", lambda: run_fleet_chaos(seed=seed)),
+            ("disagg", lambda: run_disagg_chaos(seed=seed)))
+    violations, leg_results = [], {}
+    ttft_attrs, flight_total = [], 0
+    flight_det_all, det_all, connected_all, closure_ok = \
+        True, True, True, True
+    max_residual = 0.0
+    for name, fn in legs:
+        digests, flight_digests, first = [], [], None
+        for _ in range(max(1, runs)):
+            recorder.clear()
+            res = fn()
+            digests.append(res.event_digest)
+            flight_digests.append(recorder.digests())
+            if first is None:
+                first = res
+        leg_results[name] = first
+        if not first.ok:
+            violations.append(f"{name}: invariants failed: "
+                              f"{first.violations[:4]}")
+        tr = first.invariants.get("trace", {})
+        if not tr.get("connected", False):
+            connected_all = False
+            violations.append(f"{name}: span DAG not connected")
+        res_max = float(tr.get("max_closure_residual", 1.0))
+        max_residual = max(max_residual, res_max)
+        if res_max > closure_tol:
+            closure_ok = False
+            violations.append(
+                f"{name}: closure residual {res_max} > {closure_tol}")
+        deterministic = len(set(digests)) == 1
+        det_all = det_all and deterministic
+        if not deterministic:
+            violations.append(f"{name}: digests diverged {digests}")
+        flight_det = len({tuple(d) for d in flight_digests}) == 1
+        flight_det_all = flight_det_all and flight_det
+        if not flight_det:
+            violations.append(
+                f"{name}: flight bundles diverged across same-seed "
+                f"runs ({[len(d) for d in flight_digests]})")
+        flight_total += len(flight_digests[0])
+        for row in first.requests:
+            if row.get("ttft_attr"):
+                ttft_attrs.append(row["ttft_attr"])
+        emit({"phase": "request-trace-leg", "leg": name,
+              "runs": len(digests),
+              "event_digest": digests[0],
+              "deterministic": deterministic,
+              "connected": tr.get("connected", False),
+              "traced_requests": tr.get("traced_requests", 0),
+              "max_closure_residual": res_max,
+              "flight_bundles": len(flight_digests[0]),
+              "flight_triggers": sorted(
+                  {b["trigger"] for b in recorder.bundles}),
+              "flight_digests": flight_digests[0],
+              "flight_deterministic": flight_det})
+        for row in first.requests:
+            emit({"phase": "request-trace-request", "leg": name,
+                  **row})
+
+    # the coverage floor: the legs must actually exercise the wire —
+    # a crash evacuation (fleet) and a tier handoff (disagg)
+    fleet_c = leg_results["fleet"].invariants["counters"]
+    disagg_c = leg_results["disagg"].invariants["counters"]
+    if not fleet_c.get("replica_crashes"):
+        violations.append("fleet leg had no crash evacuation")
+    if not disagg_c.get("handoffs"):
+        violations.append("disagg leg had no handoffs")
+    if not flight_total:
+        violations.append("no flight-recorder bundle was triggered")
+
+    # headline p99-TTFT attribution across the fleet+disagg requests:
+    # absent phases count 0.0 so percentiles compare like-for-like
+    phases = sorted({p for a in ttft_attrs for p in a})
+    ttft_p99 = {p: round(float(np.percentile(
+        np.asarray([a.get(p, 0.0) for a in ttft_attrs]), 99)), 9)
+        for p in phases} if ttft_attrs else {}
+    ttft_totals = [sum(a.values()) for a in ttft_attrs]
+    summary = {
+        "phase": "request-trace-summary", "seed": seed,
+        "runs": runs, "closure_tol": closure_tol,
+        "dag_connected": connected_all,
+        "closure_ok": closure_ok,
+        "closure_max_residual": round(max_residual, 9),
+        "deterministic": det_all,
+        "flight_deterministic": flight_det_all,
+        "flight_bundles": flight_total,
+        "traced_requests": sum(
+            r.invariants["trace"]["traced_requests"]
+            for r in leg_results.values()),
+        "crash_evacuations": fleet_c.get("replica_crashes", 0),
+        "handoffs": disagg_c.get("handoffs", 0),
+        "ttft_p99_s": round(float(np.percentile(
+            np.asarray(ttft_totals), 99)), 9) if ttft_totals else None,
+        "ttft_attr_p99_s": ttft_p99,
+        "violations": violations,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    emit(summary)
+
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "REQUEST_TRACE.jsonl", results))
+    if fh is not None:
+        fh.close()
+    if violations:
+        raise RuntimeError(
+            f"request-trace gates failed: {violations}")
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False, lookup=False):
@@ -1533,10 +1686,21 @@ def _main_serve_loop(argv):
                    help="prefill-tier replicas in disagg mode")
     p.add_argument("--n-decode", type=int, default=3,
                    help="decode-tier replicas in disagg mode")
+    p.add_argument("--request-trace", action="store_true",
+                   help="causal-tracing mode: connected cross-replica "
+                        "span DAGs + attribution closure + flight-"
+                        "recorder determinism over the chaos/fleet/"
+                        "disagg legs, REQUEST_TRACE.jsonl artifact")
     p.add_argument("--out", default="SERVE_LOOP.jsonl",
                    help="also append rows to this jsonl file "
                         "('' = stdout only)")
     args = p.parse_args(argv)
+    if args.request_trace:
+        out = args.out if args.out != "SERVE_LOOP.jsonl" \
+            else "REQUEST_TRACE.jsonl"
+        run_request_trace(seed=args.seed, runs=args.chaos_runs,
+                          out=out)
+        return 0
     if args.disagg:
         out = args.out if args.out != "SERVE_LOOP.jsonl" \
             else "DISAGG_SERVE.jsonl"

@@ -134,6 +134,24 @@ TOLERANCES: Dict[str, Tolerance] = {
     "disagg.decode_tpot_p99_speedup": Tolerance("higher", rel=0.25),
     "disagg.handoff_overlap_ratio": Tolerance("higher", rel=0.25),
     "disagg.int8_wire_fraction": Tolerance("lower", rel=0.10),
+    # causal request tracing (CPU-deterministic; the booleans are hard
+    # gates, the closure residual has an absolute bar — attribution
+    # must sum to measured E2E within 1% regardless of baseline)
+    "request_trace.dag_connected": Tolerance("higher", rel=0.0),
+    "request_trace.closure_ok": Tolerance("higher", rel=0.0),
+    "request_trace.deterministic": Tolerance("higher", rel=0.0),
+    "request_trace.flight_deterministic": Tolerance("higher", rel=0.0),
+    "request_trace.closure_max_residual":
+        Tolerance("lower", rel=0.0, abs=0.01),
+    "request_trace.violations": Tolerance("lower", rel=0.0),
+    # the headline p99-TTFT attribution keys: which stage owns the
+    # tail. Scheduler-policy evolution legitimately moves these, so
+    # wide slack — what must not happen silently is the queue/prefill
+    # share of the p99 TTFT exploding
+    "request_trace.ttft_attr_queue_p99_s":
+        Tolerance("lower", rel=0.50, abs=0.05),
+    "request_trace.ttft_attr_prefill_p99_s":
+        Tolerance("lower", rel=0.50, abs=0.05),
     # freshness alarm (ROADMAP item 5): informational headline — the
     # gate never fails on it (direction "lower" but compared via the
     # freshness block, not check_points)

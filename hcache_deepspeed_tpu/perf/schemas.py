@@ -538,6 +538,76 @@ def parse_disagg_serve(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_request_trace(text: str, file: str) -> List[MetricPoint]:
+    """REQUEST_TRACE.jsonl: fleet-wide causal-tracing gates — DAG
+    connectivity, attribution closure, run/flight determinism, and
+    the p99 TTFT attribution profile."""
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        phase = row.get("phase", "")
+        if phase == "request-trace-summary":
+            utc = row.get("utc")
+            for key, metric in (
+                    ("dag_connected", "request_trace.dag_connected"),
+                    ("closure_ok", "request_trace.closure_ok"),
+                    ("deterministic", "request_trace.deterministic"),
+                    ("flight_deterministic",
+                     "request_trace.flight_deterministic")):
+                if key in row:
+                    pts.append(MetricPoint(metric,
+                                           1.0 if row[key] else 0.0,
+                                           file, phase=phase, utc=utc))
+            for key, metric in (
+                    ("closure_max_residual",
+                     "request_trace.closure_max_residual"),
+                    ("flight_bundles", "request_trace.flight_bundles"),
+                    ("handoffs", "request_trace.handoffs"),
+                    ("crash_evacuations",
+                     "request_trace.crash_evacuations"),
+                    ("traced_requests",
+                     "request_trace.traced_requests"),
+                    ("ttft_p99_s", "request_trace.ttft_p99_s")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase, utc=utc))
+            # the headline p99-TTFT attribution profile: seconds per
+            # phase at the 99th percentile across the traced requests
+            for attr_phase, v in sorted(
+                    (row.get("ttft_attr_p99_s") or {}).items()):
+                if isinstance(v, (int, float)):
+                    pts.append(MetricPoint(
+                        f"request_trace.ttft_attr_{attr_phase}_p99_s",
+                        float(v), file, unit="s", phase=phase,
+                        utc=utc))
+            pts.append(MetricPoint(
+                "request_trace.violations",
+                float(len(row.get("violations", []))), file,
+                phase=phase, utc=utc))
+        elif phase == "request-trace-leg":
+            tags = {"leg": str(row.get("leg", ""))}
+            for key, metric in (
+                    ("deterministic", "request_trace.leg_deterministic"),
+                    ("connected", "request_trace.leg_connected"),
+                    ("flight_deterministic",
+                     "request_trace.leg_flight_deterministic")):
+                if key in row:
+                    pts.append(MetricPoint(metric,
+                                           1.0 if row[key] else 0.0,
+                                           file, phase=phase,
+                                           tags=tags))
+            for key, metric in (
+                    ("max_closure_residual",
+                     "request_trace.leg_max_closure_residual"),
+                    ("flight_bundles",
+                     "request_trace.leg_flight_bundles")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase,
+                                           tags=tags))
+    return pts
+
+
 def _workload_tag(file: str) -> Dict[str, str]:
     """The workload identity is the filename stem — SERVE_7B_INT8 and
     SERVE_7B measure different programs and must never be compared as
@@ -773,6 +843,13 @@ FAMILIES: List[ArtifactFamily] = [
         "equal-replica colocated baseline (decode-tail win, stream "
         "parity, span-derived handoff overlap, int8 latent wire, "
         "chunked prefill, tier chaos, determinism gates)"),
+    ArtifactFamily(
+        "request-trace", r"^REQUEST_TRACE\.jsonl$",
+        parse_request_trace,
+        "fleet-wide causal request tracing: cross-replica span-DAG "
+        "connectivity, additive critical-path attribution with the "
+        "closure gate, p99-TTFT attribution profile, and the "
+        "anomaly-triggered flight-recorder determinism gate"),
     ArtifactFamily(
         "restore-bench",
         r"^RESTORE_[A-Z0-9_]+\.jsonl$", parse_restore_bench,

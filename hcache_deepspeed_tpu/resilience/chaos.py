@@ -28,6 +28,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..telemetry.critical_path import (attribute, closure, connected,
+                                       CLOSURE_TOL)
+from ..telemetry.flight import get_flight_recorder
 from .faults import FaultPlan, FaultRule, injected
 from .policy import ResiliencePolicy
 
@@ -75,6 +78,81 @@ def _digest(events) -> str:
     payload = json.dumps(events, sort_keys=True,
                          separators=(",", ":")).encode()
     return hashlib.sha256(payload).hexdigest()
+
+
+def _trace_gates(reqs, violations: List[str],
+                 tol: float = CLOSURE_TOL) -> Dict:
+    """Causal-trace continuity invariants over a finished trace: every
+    terminal request's span DAG must be connected (no orphan spans —
+    even across crash evacuations and tier handoffs) and its additive
+    attribution must close against the measured E2E latency within
+    ``tol``. Appends violations in place; returns the invariant block
+    the artifacts record."""
+    connected_all, max_residual, traced = True, 0.0, 0
+    for r in reqs:
+        ctx = getattr(r, "trace", None)
+        if ctx is None:
+            continue
+        traced += 1
+        ok, reason = connected(ctx)
+        if not ok:
+            connected_all = False
+            violations.append(
+                f"request {r.uid}: trace DAG not connected: {reason}")
+        e2e = None if r.finished_at is None \
+            else r.finished_at - r.arrival_time
+        cok, residual = closure(ctx, e2e, tol=tol)
+        if residual != float("inf"):
+            max_residual = max(max_residual, residual)
+        if not cok:
+            violations.append(
+                f"request {r.uid}: attribution closure failed "
+                f"(residual {residual!r} > {tol})")
+    return {"traced_requests": traced,
+            "connected": connected_all,
+            "max_closure_residual": round(max_residual, 9),
+            "closure_tol": tol}
+
+
+def _trace_row(r) -> Dict:
+    """Per-request trace fields for the artifact rows: id, continuity
+    verdicts, and the additive TTFT/E2E attribution (seconds)."""
+    ctx = getattr(r, "trace", None)
+    if ctx is None:
+        return {}
+    ok, _ = connected(ctx)
+    e2e = None if r.finished_at is None \
+        else r.finished_at - r.arrival_time
+    _, residual = closure(ctx, e2e)
+    out = {"trace": ctx.trace_id,
+           "trace_connected": ok,
+           "trace_hops": ctx.hops,
+           "trace_closure_residual":
+               None if residual == float("inf")
+               else round(residual, 9),
+           "e2e_attr": {k: round(v, 9) for k, v in
+                        sorted(attribute(ctx).items())}}
+    if r.first_token_at is not None:
+        out["ttft_attr"] = {
+            k: round(v, 9) for k, v in
+            sorted(attribute(ctx, until=r.first_token_at).items())}
+    return out
+
+
+def _flight_on_violations(kind: str, seed: int,
+                          violations: List[str]) -> None:
+    """A failed chaos invariant IS an anomaly: dump a postmortem
+    bundle so the failure ships with its context."""
+    if not violations:
+        return
+    get_flight_recorder().dump(
+        "chaos_invariant",
+        "; ".join(violations[:3]) +
+        (f" (+{len(violations) - 3} more)"
+         if len(violations) > 3 else ""),
+        source=f"chaos:{kind}", step=0, t=0.0,
+        snapshot={"kind": kind, "seed": seed,
+                  "violations": list(violations)})
 
 
 def build_chaos_trace(seed: int, n_requests: int, vocab: int,
@@ -282,6 +360,10 @@ def run_fleet_chaos(seed: int = 0, n_replicas: int = 3,
                 f"replica {rep.id}: restore_stats.restores "
                 f"{rs['restores']} != scheduler total_restores "
                 f"{sched.total_restores}")
+    # 5. causal-trace continuity: connected cross-replica span DAGs
+    # (crash evacuations included) + attribution closure
+    trace_inv = _trace_gates(reqs, violations)
+    _flight_on_violations("fleet", seed, violations)
 
     digest = _digest(fleet.event_log())
     result = FleetChaosResult(
@@ -296,6 +378,7 @@ def run_fleet_chaos(seed: int = 0, n_replicas: int = 3,
             "restores": r.n_restores,
             "recomputes": r.n_recomputes,
             "migrations": r.n_migrations,
+            **_trace_row(r),
         } for r in reqs],
         event_digest=digest,
         fleet_summary=fleet.summary(),
@@ -309,6 +392,7 @@ def run_fleet_chaos(seed: int = 0, n_replicas: int = 3,
             "migration_balance_ok": fleet.migration_balance_ok,
             "migration_overlap_ratio":
                 round(fleet.migration_overlap_ratio, 6),
+            "trace": trace_inv,
         },
         violations=violations,
         ok=not violations)
@@ -472,6 +556,10 @@ def run_disagg_chaos(seed: int = 0, n_prefill: int = 2,
             violations.append(
                 f"prefill replica {rep.id} still holds decode "
                 f"state: {stranded}")
+    # trace continuity across the tier link: a handoff must leave one
+    # connected DAG spanning both tiers, closure intact
+    trace_inv = _trace_gates(reqs, violations)
+    _flight_on_violations("disagg", seed, violations)
 
     digest = _digest(fleet.event_log())
     crashed_tiers = sorted({rep.role.name for rep in fleet.replicas
@@ -490,6 +578,7 @@ def run_disagg_chaos(seed: int = 0, n_prefill: int = 2,
             "restores": r.n_restores,
             "recomputes": r.n_recomputes,
             "migrations": r.n_migrations,
+            **_trace_row(r),
         } for r in reqs],
         event_digest=digest,
         fleet_summary=fleet.summary(),
@@ -510,6 +599,7 @@ def run_disagg_chaos(seed: int = 0, n_prefill: int = 2,
             "prefill_chunks": sum(
                 rep.server.metrics.counters["prefill_chunks"]
                 for rep in fleet.replicas),
+            "trace": trace_inv,
         },
         violations=violations,
         ok=not violations)
@@ -586,6 +676,9 @@ def run_chaos(seed: int = 0, n_requests: int = 32,
             f"total_restores {sched.total_restores}")
     if rs["chunks_issued"] > rs["restores"] * engine.N_LAYER:
         violations.append("more chunks issued than lanes could hold")
+    # 5. causal-trace continuity + attribution closure
+    trace_inv = _trace_gates(reqs, violations)
+    _flight_on_violations("chaos", seed, violations)
 
     events = [list(e) for e in sched.events]
     m = server.metrics.summary()
@@ -601,6 +694,7 @@ def run_chaos(seed: int = 0, n_requests: int = 32,
             "restores": r.n_restores,
             "recomputes": r.n_recomputes,
             "restore_failures": r.n_restore_failures,
+            **_trace_row(r),
         } for r in reqs],
         events=events,
         event_digest=_digest(events),
@@ -614,6 +708,7 @@ def run_chaos(seed: int = 0, n_requests: int = 32,
             "restore_stats": dict(rs),
             "breaker_trips": sched.breaker.trips,
             "degraded_steps": sched.ladder.degraded_steps,
+            "trace": trace_inv,
         },
         violations=violations,
         ok=not violations)

@@ -61,6 +61,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..analysis.runtime import make_lock
 from ..resilience.faults import InjectedFault, get_injector
 from ..resilience.policy import ResiliencePolicy
+from ..telemetry.context import TraceContext
+from ..telemetry.flight import get_flight_recorder
 from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock, VirtualClock
 from .crossover import RestoreCrossoverModel
@@ -153,6 +155,12 @@ class Migration:
     #: "cancelled" | "failed"; "" while in transit
     mode: str = ""
     request: Optional[Request] = None
+    #: serialized TraceContext snapshot taken at departure — the
+    #: context-propagation half of the wire payload. The landing pass
+    #: rehydrates it, so the live path continuously exercises the
+    #: byte-level round trip the future cross-process latent wire
+    #: (ROADMAP item 1) will ship for real
+    trace_wire: Optional[Dict] = None
 
     def to_row(self) -> Dict:
         return {"uid": self.uid, "src": self.src, "dst": self.dst,
@@ -318,6 +326,13 @@ class ServingFleet:
                 request = Request(uid=self._next_uid,
                                   prompt=list(prompt),
                                   arrival_time=self.clock.now(), **kw)
+            if request.trace is None:
+                # trace context is minted once, fleet-wide, at the
+                # front door; replica servers see it already set and
+                # never re-mint
+                request.trace = TraceContext.mint(
+                    request.uid, clock=self.clock,
+                    t0=request.arrival_time)
             self._next_uid = max(self._next_uid, request.uid) + 1
             self.pending.append(request)
             return request
@@ -404,12 +419,15 @@ class ServingFleet:
     def _fail_fleet(self, req: Request, error: str,
                     now: float) -> None:
         req.error = error
-        req.transition(RequestState.FAILED)
         req.finished_at = now
+        req.transition(RequestState.FAILED)
         req.replica = None
         self.done[req.uid] = req
         self._event("fail", req.uid, error)
-        get_tracer().async_end("request", req.uid, error=error)
+        if req.async_span_begun:
+            # pending requests the fleet fails before any replica
+            # scheduler saw them never opened the interval
+            get_tracer().async_end("request", req.uid, error=error)
 
     def _all_dead(self) -> bool:
         return all(r.state in (ReplicaState.DEAD, ReplicaState.STOPPED)
@@ -619,6 +637,16 @@ class ServingFleet:
                       depart_t=now, land_t=now + transfer_s,
                       request=req)
         req.replica = None
+        if req.trace is not None:
+            # the wire crossing: open the transit span, then snapshot
+            # the context into the migration payload exactly as the
+            # cross-process wire will carry it — the landing pass
+            # rehydrates from this dict, not from the live object, so
+            # a lossy wire format breaks the closure gate loudly
+            req.trace.begin("transit", t=now, replica=None,
+                            reason=reason, src=src, dst=dst,
+                            bytes=nbytes)
+            m.trace_wire = req.trace.to_wire()
         self.in_transit.append(m)
         self.migrations.append(m)
         self.counters["evictions"] += 1
@@ -628,7 +656,9 @@ class ServingFleet:
         get_tracer().async_begin(self._migration_span(reason), req.uid,
                                  cat="fleet",
                                  src=src, dst=dst, reason=reason,
-                                 bytes=nbytes, tokens=m.tokens)
+                                 bytes=nbytes, tokens=m.tokens,
+                                 trace="" if req.trace is None
+                                 else req.trace.trace_id)
         return m
 
     def _finish_migration(self, m: Migration, mode: str) -> None:
@@ -651,8 +681,9 @@ class ServingFleet:
                 req.transition(RequestState.DONE)
                 self.done[req.uid] = req
                 self._event("cancel", req.uid, "in_transit")
-                get_tracer().async_end("request", req.uid,
-                                       cancelled=True)
+                if req.async_span_begun:
+                    get_tracer().async_end("request", req.uid,
+                                           cancelled=True)
                 continue
             if req.deadline is not None and now > req.deadline:
                 # transit time counts against the deadline; nothing is
@@ -682,11 +713,23 @@ class ServingFleet:
                     self._event("migrate_reroute", m.uid,
                                 f"{m.dst}->{new_dst}")
                 m.dst = new_dst
+            if m.trace_wire is not None:
+                # rehydrate the context from the WIRE snapshot (not
+                # the live object): the landing side of the context-
+                # propagation contract, exercised on every migration
+                req.trace = TraceContext.from_wire(m.trace_wire,
+                                                   clock=self.clock)
             dst = self.replicas[m.dst]
             with self._locked(dst):
                 dst.scheduler.adopt_suspended(req)
             req.replica = m.dst
             req.n_migrations += 1
+            if req.trace is not None:
+                # close the transit span on the landing replica; the
+                # request sits SUSPENDED until the destination's
+                # ordinary restore pass re-enters it
+                req.trace.begin("suspended", t=now, replica=m.dst,
+                                landed=m.reason)
             mode = "restore" if req.latents is not None \
                 else "recompute"
             key = "landings" if mode == "restore" \
@@ -714,9 +757,9 @@ class ServingFleet:
         for req in due:
             if req.cancelled:
                 self.pending.remove(req)
-                req.transition(RequestState.REJECTED)
                 req.reject_reason = "cancelled"
                 req.finished_at = now
+                req.transition(RequestState.REJECTED)
                 self.done[req.uid] = req
                 self._event("cancel", req.uid, "pending")
                 continue
@@ -1098,6 +1141,15 @@ class ServingFleet:
                       float(self.degradation_level),
                       help="worst degradation-ladder level among "
                            "stepping replicas (fleet escalation)")
+        reg.set_counter("tracer_dropped_events",
+                        get_tracer().dropped,
+                        help="events displaced by the span tracer's "
+                             "ring buffer (non-zero = exported "
+                             "traces are incomplete)")
+        reg.set_counter("flight_recorder_dumps",
+                        get_flight_recorder().dumps,
+                        help="anomaly-triggered flight-recorder "
+                             "postmortem bundles captured")
         return reg
 
     def prometheus_text(self) -> str:

@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry.critical_path import (CriticalPathProfile, attribute,
+                                       closure, connected)
 from ..telemetry.sketch import QuantileSketch
 from ..telemetry.slo import SLOTracker
 
@@ -146,6 +148,19 @@ class ServingMetrics:
         #: typed failure causes -> counts (the FAILED-state analog of
         #: ``rejected``)
         self.failures: Dict[str, int] = {}
+        # -- per-request critical-path attribution profiles ---------- #
+        #: E2E attribution (every terminal traced request) and the
+        #: TTFT decomposition (requests that produced a first token),
+        #: per phase, on the bounded quantile sketches — "which stage
+        #: owns my p99" as a live metric, not an offline query
+        self.critical_path_e2e = CriticalPathProfile()
+        self.critical_path_ttft = CriticalPathProfile()
+        #: attribution-closure / DAG-connectivity gate failures seen
+        #: on finished requests (0 is the contract; non-zero means an
+        #: instrumentation hole, surfaced rather than averaged away)
+        self.trace_closure_failures = 0
+        self.trace_disconnected = 0
+        self.trace_max_closure_residual = 0.0
         # last-step gauges
         self.gauges = {"batch_occupancy": 0.0, "kv_utilization": 0.0,
                        "queue_depth": 0.0, "suspended": 0.0,
@@ -209,6 +224,7 @@ class ServingMetrics:
             self.slo_gauges = self.slo.gauges(report.t)
 
     def on_finish(self, req) -> None:
+        self._observe_trace(req)
         if self.slo is not None and req.finished_at is not None:
             # every terminal request feeds availability; latency SLIs
             # only see requests that measured them (a FAILED request
@@ -236,6 +252,39 @@ class ServingMetrics:
         if getattr(req, "n_handoffs", 0):
             self.handoff_transit.observe(req.handoff_transit_s)
         self.preemptions_per_request.observe(req.n_preemptions)
+
+    def _observe_trace(self, req) -> None:
+        """Fold a terminal request's causal trace into the critical-
+        path profiles, gating closure + connectivity as it lands."""
+        ctx = getattr(req, "trace", None)
+        if ctx is None or not ctx.spans:
+            return
+        ok, _reason = connected(ctx)
+        if not ok:
+            self.trace_disconnected += 1
+        e2e = None
+        if req.finished_at is not None:
+            e2e = req.finished_at - req.arrival_time
+        closed, residual = closure(ctx, e2e)
+        if residual != float("inf"):
+            self.trace_max_closure_residual = max(
+                self.trace_max_closure_residual, residual)
+        if not closed:
+            self.trace_closure_failures += 1
+        self.critical_path_e2e.observe(attribute(ctx))
+        if req.first_token_at is not None:
+            self.critical_path_ttft.observe(
+                attribute(ctx, until=req.first_token_at))
+
+    def critical_path_summary(self) -> Dict:
+        return {
+            "e2e": self.critical_path_e2e.summary(),
+            "ttft": self.critical_path_ttft.summary(),
+            "closure_failures": self.trace_closure_failures,
+            "disconnected": self.trace_disconnected,
+            "max_closure_residual":
+                round(self.trace_max_closure_residual, 9),
+        }
 
     # ------------------------------------------------------------- #
     # sinks
@@ -331,6 +380,18 @@ class ServingMetrics:
                 if v is not None:
                     reg.set_gauge(f"{name}_p{q}", v, labels=lbl(),
                                   help=f"{name} p{q} (sketch)")
+        self.critical_path_e2e.to_registry(
+            reg, prefix="critical_path_e2e", labels=lbl())
+        self.critical_path_ttft.to_registry(
+            reg, prefix="critical_path_ttft", labels=lbl())
+        reg.set_counter("trace_closure_failures",
+                        self.trace_closure_failures, labels=lbl(),
+                        help="terminal requests whose attribution "
+                             "failed the closure gate")
+        reg.set_counter("trace_disconnected",
+                        self.trace_disconnected, labels=lbl(),
+                        help="terminal requests whose span DAG was "
+                             "not connected")
         return reg
 
     def prometheus_text(self) -> str:
@@ -349,6 +410,7 @@ class ServingMetrics:
             "rejected": dict(self.rejected),
             "failures": dict(self.failures),
             "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
+            "critical_path": self.critical_path_summary(),
         }
         if self.slo is not None:
             out["slo"] = self.slo.summary()

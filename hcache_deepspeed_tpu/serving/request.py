@@ -31,6 +31,7 @@ from enum import Enum
 from typing import List, Optional
 
 from ..inference.ragged.latents import HostLatentStore
+from ..telemetry.context import TraceContext
 
 
 class RequestState(Enum):
@@ -147,6 +148,19 @@ class Request:
     #: the request decoded on its prefill replica because the decode
     #: tier was saturated (the disagg colocation fallback)
     colocated_fallback: bool = False
+    # -- causal tracing --------------------------------------------- #
+    #: per-request causal trace context (minted at submit by the
+    #: server/fleet frontend; None for bare Requests built in tests —
+    #: recording is then a no-op). Serialized into the migration/
+    #: handoff payload and rehydrated on the landing replica, so the
+    #: span chain crosses replicas (docs/observability.md)
+    trace: Optional[TraceContext] = None
+    #: the wall-clock tracer's ``request`` async interval has been
+    #: opened — exactly once per request lifetime, even when a crash
+    #: evacuation re-submits the request through another replica's
+    #: scheduler (a re-begin would leave an unclosed interval and
+    #: fail the trace validator)
+    async_span_begun: bool = False
 
     def transition(self, new_state: RequestState) -> None:
         if new_state not in _TRANSITIONS[self.state]:
@@ -154,6 +168,16 @@ class Request:
                 f"request {self.uid}: illegal transition "
                 f"{self.state.name} -> {new_state.name}")
         self.state = new_state
+        if self.trace is not None:
+            # every legal lifecycle edge is a causal-trace span edge;
+            # the context stamps it from the owning serving clock (the
+            # virtual clock in simulation), never the wall clock. A
+            # terminal edge closes at finished_at — callers set it
+            # BEFORE transitioning — so attribution closes against
+            # the exact E2E the metrics layer measures
+            self.trace.on_state(new_state.name, replica=self.replica,
+                                t=self.finished_at
+                                if self.finished else None)
 
     # ------------------------------------------------------------- #
     # derived quantities the scheduler/budgeter reads

@@ -39,6 +39,7 @@ from ..inference.scheduling import (BACKPRESSURE_ACTION, BackpressureAction,
 from ..resilience.degradation import DegradationLadder, DegradationLevel
 from ..resilience.policy import ResiliencePolicy
 from ..resilience.retry import CircuitBreaker, Watchdog
+from ..telemetry.flight import get_flight_recorder
 from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock
 from .crossover import RestoreCrossoverModel
@@ -231,9 +232,18 @@ class ContinuousBatchingScheduler:
         # DONE/REJECTED in _close/_reject — the per-request lane in the
         # exported trace; state edges ride the sched.* instants _event
         # emits
-        get_tracer().async_begin("request", req.uid,
-                                 prio=req.priority,
-                                 prompt=len(req.prompt))
+        if not req.async_span_begun:
+            # once per request LIFETIME: a crash-evacuated request
+            # re-submitted through a surviving replica's scheduler
+            # keeps its original interval (ended exactly once at its
+            # terminal state, wherever that lands)
+            req.async_span_begun = True
+            get_tracer().async_begin("request", req.uid,
+                                     prio=req.priority,
+                                     prompt=len(req.prompt),
+                                     replica=self.replica_id,
+                                     trace="" if req.trace is None
+                                     else req.trace.trace_id)
         self._event("queued", req.uid, f"prio={req.priority}")
         self.queue.append(req)
 
@@ -277,7 +287,8 @@ class ContinuousBatchingScheduler:
         now = self.clock.now()
         report = StepReport(step=self.step_idx, t=now)
         with get_tracer().span("sched.step",
-                               sched_step=self.step_idx) as sp:
+                               sched_step=self.step_idx,
+                               replica=self.replica_id) as sp:
             self._cancellation_pass(report)
             self._deadline_pass(report, now)
             self._degradation_pass(report)
@@ -295,6 +306,7 @@ class ContinuousBatchingScheduler:
                     # contract, the tracker never steers the scheduler
                     sp.set(**{k: round(float(v), 6) for k, v in
                               self.metrics.slo_gauges.items()})
+                    self._flight_slo_check(now)
         if self.crossover is not None and \
                 self.step_idx % self.calibrate_every == 0:
             tracer = get_tracer()
@@ -310,9 +322,68 @@ class ContinuousBatchingScheduler:
     def _event(self, event: str, uid: int, detail: str = "") -> None:
         self.events.append((self.step_idx, event, uid, detail))
         # every lifecycle edge doubles as a trace instant (preempt /
-        # restore / admit / finish ... on the request's timeline)
+        # restore / admit / finish ... on the request's timeline);
+        # the replica stamp is what lets the assembler fan a fleet
+        # run out into per-replica Perfetto process rows
         get_tracer().instant(f"sched.{event}", uid=uid,
-                             sched_step=self.step_idx, detail=detail)
+                             sched_step=self.step_idx,
+                             replica=self.replica_id, detail=detail)
+
+    # ------------------------------------------------------------- #
+    # flight-recorder triggers (read-only: never touches the event
+    # log, the RNG or the clock — chaos digests replay unchanged)
+    # ------------------------------------------------------------- #
+    def flight_snapshot(self, last_events: int = 32) -> Dict:
+        """Deterministic postmortem core: pool depths, breaker/ladder
+        state, fault accounting, the event-log tail — everything is a
+        pure function of (trace, seed) under the virtual clock."""
+        snap = {
+            "replica": self.replica_id,
+            "step": self.step_idx,
+            "t": round(self.clock.now(), 9),
+            "pools": {"queue": len(self.queue),
+                      "running": len(self.running),
+                      "suspended": len(self.suspended),
+                      "restoring": len(self.restoring),
+                      "done": len(self.done)},
+            "breaker": self.breaker.state.name,
+            "degradation": int(self.degradation),
+            "fault_summary": self.fault_summary(),
+            "free_blocks": self.engine.state.free_blocks,
+            "events_tail": [list(e)
+                            for e in self.events[-last_events:]],
+        }
+        if self.metrics is not None:
+            snap["counters"] = dict(self.metrics.counters)
+            snap["failures"] = dict(self.metrics.failures)
+            snap["slo_gauges"] = {k: round(float(v), 6) for k, v in
+                                  self.metrics.slo_gauges.items()}
+        return snap
+
+    def _flight(self, trigger: str, reason: str) -> None:
+        rec = get_flight_recorder()
+        src = f"replica{self.replica_id}"
+        if not rec.should_fire(trigger, src, self.step_idx):
+            return
+        tracer = get_tracer()
+        rec.dump(trigger, reason, source=src, step=self.step_idx,
+                 t=self.clock.now(), snapshot=self.flight_snapshot(),
+                 spans=tracer.events()[-rec.span_tail:]
+                 if tracer.enabled else None)
+
+    def _flight_slo_check(self, now: float) -> None:
+        """Arm the ``slo_burn`` trigger when any burn-rate gauge
+        crosses the recorder's threshold (default 10x — the error
+        budget gone in a tenth of its window)."""
+        rec = get_flight_recorder()
+        worst_name, worst = "", 0.0
+        for name, v in self.metrics.slo_gauges.items():
+            if name.endswith("_burn_rate") and float(v) > worst:
+                worst_name, worst = name, float(v)
+        if worst >= rec.slo_burn_threshold:
+            self._flight("slo_burn",
+                         f"{worst_name}={worst:.3f} >= "
+                         f"{rec.slo_burn_threshold:g}")
 
     def _close(self, req: Request, report: StepReport, now: float,
                cancelled: bool = False) -> None:
@@ -325,19 +396,21 @@ class ContinuousBatchingScheduler:
         get_tracer().async_end("request", req.uid,
                                tokens=len(req.tokens_out),
                                preemptions=req.n_preemptions,
-                               restores=req.n_restores)
+                               restores=req.n_restores,
+                               replica=self.replica_id)
         if self.metrics is not None:
             self.metrics.on_finish(req)
 
     def _reject(self, req: Request, reason: str,
                 report: StepReport) -> None:
         req.reject_reason = reason
-        req.transition(RequestState.REJECTED)
         req.finished_at = self.clock.now()
+        req.transition(RequestState.REJECTED)
         self.done[req.uid] = req
         report.rejected.append((req.uid, reason))
         self._event("reject", req.uid, reason)
-        get_tracer().async_end("request", req.uid, reject=reason)
+        get_tracer().async_end("request", req.uid, reject=reason,
+                               replica=self.replica_id)
         if self.metrics is not None:
             self.metrics.on_finish(req)
 
@@ -349,14 +422,15 @@ class ContinuousBatchingScheduler:
         """Close ``req`` in the typed FAILED terminal state."""
         now = self.clock.now() if now is None else now
         req.error = error
-        req.transition(RequestState.FAILED)
         req.finished_at = now
+        req.transition(RequestState.FAILED)
         self.done[req.uid] = req
         report.failed.append((req.uid, error))
         if quarantined:
             report.quarantined.append(req.uid)
         self._event("fail", req.uid, error)
-        get_tracer().async_end("request", req.uid, error=error)
+        get_tracer().async_end("request", req.uid, error=error,
+                               replica=self.replica_id)
         if self.metrics is not None:
             self.metrics.on_finish(req)
 
@@ -710,9 +784,15 @@ class ContinuousBatchingScheduler:
         payload re-captured by the prefill itself."""
         del self.suspended[req.uid]
         req.transition(RequestState.RESTORING)
+        if req.trace is not None:
+            # the crossover chose the re-prefill side: relabel the
+            # re-entry span so attribution separates recompute compute
+            # from restore-lane ship/replay time
+            req.trace.relabel("recompute")
         tokens = list(req.prompt) + req.tokens_out
         with get_tracer().span("sched.recompute_issue", uid=req.uid,
                                sched_step=self.step_idx,
+                               replica=self.replica_id,
                                tokens=len(tokens)):
             # the prefill re-captures the latents — but hold the old
             # payload until the put succeeds: a faulted re-prefill must
@@ -771,6 +851,8 @@ class ContinuousBatchingScheduler:
             if self.breaker.record_failure(self.step_idx):
                 report.breaker_trips += 1
                 self._event("breaker_trip", req.uid, reason)
+                self._flight("breaker_open",
+                             f"uid={req.uid} {reason}")
         req.n_restore_failures += 1
         req.suspended_in_step = self.step_idx
         report.restore_aborts += 1
@@ -835,6 +917,7 @@ class ContinuousBatchingScheduler:
             # wall-clock adjacency
             with get_tracer().span("sched.restore_issue", uid=req.uid,
                                    sched_step=self.step_idx,
+                                   replica=self.replica_id,
                                    tokens=req.cached_tokens):
                 if self.latent_preemption:
                     tokens = list(req.prompt) + req.tokens_out[:-1]
@@ -904,6 +987,12 @@ class ContinuousBatchingScheduler:
                     f"site={getattr(exc, 'site', 'engine')} "
                     f"attempt={attempt} delay={delay:.5f}")
                 self.clock.sleep(delay)
+                # attribution honesty: the backoff sleep is wall the
+                # open lanes waited through — carve it out of their
+                # restore spans as its own category
+                for r in self.restoring.values():
+                    if r.trace is not None:
+                        r.trace.charge("retry_backoff", delay)
 
     def _abort_lane(self, uid: Optional[int], report: StepReport,
                     reason: str) -> None:
@@ -941,6 +1030,9 @@ class ContinuousBatchingScheduler:
                 report.watchdog_aborts += 1
                 self._event("watchdog_abort", u,
                             f"no_progress>{self.watchdog.limit}")
+                self._flight("watchdog",
+                             f"uid={u} no_progress>"
+                             f"{self.watchdog.limit}")
                 self._abort_lane(u, report, "watchdog")
 
     def _advance_restore_lanes(self, report: StepReport,
@@ -1253,6 +1345,7 @@ class ContinuousBatchingScheduler:
         # so the ratio is read straight off the pair's attributes.
         with get_tracer().span(
                 "sched.decode_dispatch", sched_step=self.step_idx,
+                replica=self.replica_id,
                 lanes=report.decode_lanes,
                 prefill_tokens=report.prefill_tokens,
                 overlapped_restores=report.overlapped_restores) as sp:

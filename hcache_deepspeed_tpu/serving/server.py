@@ -25,6 +25,9 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from ..analysis.runtime import make_lock
+from ..telemetry.context import TraceContext
+from ..telemetry.flight import get_flight_recorder
+from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock, VirtualClock
 from .metrics import ServingMetrics
 from .request import Request, RequestState
@@ -133,6 +136,14 @@ class ServingServer:
             if request is None:
                 request = Request(uid=self._next_uid, prompt=list(prompt),
                                   arrival_time=self.clock.now(), **kw)
+            if request.trace is None:
+                # causal tracing starts at the front door: the root
+                # queue span opens at arrival so queue-wait attribution
+                # matches Request.queue_wait(); ingress rejects below
+                # still close the chain with a terminal outcome
+                request.trace = TraceContext.mint(
+                    request.uid, clock=self.clock,
+                    t0=request.arrival_time)
             self._next_uid = max(self._next_uid, request.uid) + 1
             depth = len(self._ingress) + len(self.scheduler.queue)
             reason = ""
@@ -149,8 +160,8 @@ class ServingServer:
                     reason = "kv_overload"
             if reason:
                 request.reject_reason = reason
-                request.transition(RequestState.REJECTED)
                 request.finished_at = self.clock.now()
+                request.transition(RequestState.REJECTED)
                 self.scheduler.done[request.uid] = request
                 self.scheduler.events.append(
                     (self.scheduler.step_idx, "reject_ingress",
@@ -276,6 +287,7 @@ class ServingServer:
         (histograms, counters, gauges, SLO burn rates), scheduler pool
         depths, health, and the Prometheus text rendering — everything
         an operator probe or test needs in one locked read."""
+        tracer = get_tracer()
         with self._lock:
             s = self.scheduler
             return {
@@ -291,6 +303,10 @@ class ServingServer:
                           "done": len(s.done)},
                 "metrics": self.metrics.summary(),
                 "slo_gauges": dict(self.metrics.slo_gauges),
+                "critical_path": self.metrics.critical_path_summary(),
+                "tracer": {"dropped_events": tracer.dropped,
+                           "buffered": tracer.buffered},
+                "flight": get_flight_recorder().summary(),
                 "prometheus": self.metrics.prometheus_text(),
             }
 
@@ -389,8 +405,22 @@ class ServingServer:
             self.scheduler.events.append(
                 (self.scheduler.step_idx, "server_error", -1,
                  repr(exc)))
-        from ..telemetry.tracer import get_tracer
-        get_tracer().instant("server.error", error=repr(exc))
+        get_tracer().instant("server.error", error=repr(exc),
+                             replica=self.replica_id)
+        try:
+            # the crash-path flight dump: the postmortem bundle is the
+            # whole point of the recorder — capture it before the log
+            # line, while the scheduler state is still coherent
+            rec = get_flight_recorder()
+            rec.dump("server_crash", repr(exc),
+                     source=f"replica{self.replica_id}",
+                     step=self.scheduler.step_idx,
+                     t=self.clock.now(),
+                     snapshot=self.scheduler.flight_snapshot(),
+                     spans=get_tracer().events()[-rec.span_tail:]
+                     if get_tracer().enabled else None)
+        except Exception:       # noqa: BLE001 — the server is already
+            pass                # dying; the dump must not mask why
         from ..utils.logging import logger
         logger.error(f"serving loop died: {exc!r}\n{self._snapshot()}")
 

@@ -16,13 +16,29 @@
   text exposition with a strict validator/parser pair.
 * :mod:`.slo` — declared TTFT/TPOT/availability objectives evaluated
   over sliding windows into burn-rate gauges.
+* :mod:`.context` — serializable per-request :class:`TraceContext`
+  (trace id + baggage + virtual-clock phase spans) propagated across
+  replicas inside the migration/handoff payload.
+* :mod:`.critical_path` — per-request critical-path extraction with
+  additive attribution, the closure + connectivity gates, and the
+  per-tier quantile profile.
+* :mod:`.flight` — always-on bounded flight recorder dumping
+  deterministic postmortem bundles on anomaly triggers.
+* :mod:`.assemble` — multi-tracer merge: per-replica Perfetto process
+  rows + cross-track migration/handoff flow arrows.
 
 CLI: ``python -m hcache_deepspeed_tpu.telemetry dump|summarize``.
 See ``docs/observability.md``.
 """
 
+from .assemble import (assemble_fleet_trace, merge_streams,  # noqa: F401
+                       migration_flows)
+from .context import TraceContext, TraceSpan  # noqa: F401
+from .critical_path import (CriticalPathProfile, attribute,  # noqa: F401
+                            closure, connected, critical_path)
 from .export import (load_trace, to_trace_events, validate_trace,  # noqa: F401
                      write_trace)
+from .flight import FlightRecorder, get_flight_recorder  # noqa: F401
 from .metrics import (StepMetrics, bench_extra, render_table,  # noqa: F401
                       step_breakdown, summarize)
 from .prometheus import (MetricRegistry, parse_prometheus_text,  # noqa: F401
@@ -37,5 +53,8 @@ __all__ = [
     "step_breakdown", "bench_extra", "render_table",
     "QuantileSketch", "MetricRegistry", "validate_prometheus_text",
     "parse_prometheus_text", "SLOObjective", "SLOTracker",
-    "default_objectives",
+    "default_objectives", "TraceContext", "TraceSpan",
+    "CriticalPathProfile", "attribute", "closure", "connected",
+    "critical_path", "FlightRecorder", "get_flight_recorder",
+    "assemble_fleet_trace", "merge_streams", "migration_flows",
 ]

@@ -6,9 +6,17 @@
     a Perfetto-loadable ``trace.json`` and print the per-step
     breakdown table. Load the file at https://ui.perfetto.dev.
 
-``python -m hcache_deepspeed_tpu.telemetry summarize trace.json``
-    Validate a previously exported trace and print its per-step
-    breakdown, restore-overlap and comm-volume attribution.
+``python -m hcache_deepspeed_tpu.telemetry dump --fleet``
+    Run a small deterministic disaggregated-fleet chaos trace instead
+    and export the **assembled multi-replica** timeline: each replica
+    renders as its own Perfetto process row (stable labels), with
+    cross-track flow arrows for every migration/handoff.
+
+``python -m hcache_deepspeed_tpu.telemetry summarize trace.json ...``
+    Validate + summarize one exported trace — or SEVERAL: multiple
+    files are merged as separate tracer streams with stable labels
+    (one process row per input, in argument order). Traces whose
+    source tracer dropped events print an incompleteness warning.
 """
 
 import argparse
@@ -21,6 +29,8 @@ def _cmd_dump(args):
     # host-only by construction: the reference workload is the tier-1
     # acceptance path and must not touch a TPU relay
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.fleet:
+        return _dump_fleet(args)
     from . import render_table, summarize, validate_trace, write_trace
     from .demo import run_demo
     from .tracer import get_tracer
@@ -28,7 +38,8 @@ def _cmd_dump(args):
     events, ctx = run_demo(steps=args.steps)
     tracer = get_tracer()
     trace = write_trace(events, args.out,
-                        thread_names=tracer.thread_names())
+                        thread_names=tracer.thread_names(),
+                        dropped=tracer.dropped)
     stats = validate_trace(trace)
     summary = summarize(events)
     print(render_table(summary))
@@ -36,15 +47,76 @@ def _cmd_dump(args):
     print(f"scheduler counters: restores={sched.total_restores} "
           f"overlapped={sched.overlapped_restores}")
     print(f"engine restore_stats: {ctx['serve_engine'].restore_stats}")
+    if tracer.dropped:
+        print(f"WARNING: tracer dropped {tracer.dropped} events "
+              "(ring buffer overflow) — trace is incomplete")
     print(f"wrote {args.out} ({stats['events']} events, "
           f"{stats['spans']} spans) — load at https://ui.perfetto.dev")
     return 0
 
 
+def _dump_fleet(args):
+    """Deterministic multi-replica capture: a small disaggregated
+    chaos run traced end-to-end, fanned out into per-replica process
+    rows + migration flow arrows by ``telemetry.assemble``."""
+    from ..resilience.chaos import run_disagg_chaos
+    from .assemble import assemble_fleet_trace, replica_labels
+    from .export import validate_trace, write_trace
+    from .tracer import get_tracer
+
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    try:
+        result = run_disagg_chaos(seed=args.seed)
+        events = tracer.events()
+        dropped = tracer.dropped
+    finally:
+        tracer.configure(enabled=was)
+    assembled, warnings = assemble_fleet_trace(events, dropped=dropped)
+    trace = write_trace(assembled, args.out)
+    stats = validate_trace(trace)
+    for w in warnings:
+        print(f"WARNING: {w}")
+    replicas = replica_labels(events)
+    arrows = sum(1 for e in assembled if e.get("ph") == "s")
+    print(f"disagg chaos seed={args.seed}: ok={result.ok} "
+          f"handoffs={result.invariants['counters']['handoffs']} "
+          f"digest={result.event_digest[:12]}…")
+    print(f"wrote {args.out} ({stats['events']} events, "
+          f"{stats['spans']} spans, {len(replicas)} replica process "
+          f"rows + fleet row, {arrows} migration arrows) — load at "
+          "https://ui.perfetto.dev")
+    return 0 if result.ok else 4
+
+
 def _cmd_summarize(args):
     from . import load_trace, render_table, summarize, validate_trace
+    from .assemble import merge_streams, stream_drop_count
 
-    events = load_trace(args.trace)
+    paths = args.trace or ["trace.json"]
+    if len(paths) == 1:
+        events = load_trace(paths[0])
+        dropped = stream_drop_count(events)
+        if dropped:
+            print(f"WARNING: {os.path.basename(paths[0])}: source "
+                  f"tracer dropped {dropped} events — trace is "
+                  "incomplete")
+    else:
+        # multi-tracer input: each file is its own stream; labels are
+        # the file basenames, process rows in argument order
+        streams = {}
+        for p in paths:
+            label = os.path.basename(p)
+            base, n = label, 1
+            while label in streams:          # duplicate basenames
+                n += 1
+                label = f"{base}#{n}"
+            streams[label] = load_trace(p)
+        events, warnings = merge_streams(streams)
+        for w in warnings:
+            print(f"WARNING: {w}")
     stats = validate_trace(events)
     summary = summarize(events)
     if args.json:
@@ -52,7 +124,9 @@ def _cmd_summarize(args):
     else:
         print(render_table(summary))
         print(f"({stats['events']} events, {stats['spans']} spans, "
-              f"{stats['pairs']} async pairs)")
+              f"{stats['pairs']} async pairs"
+              + (f", {len(paths)} merged streams"
+                 if len(paths) > 1 else "") + ")")
     return 0
 
 
@@ -67,11 +141,19 @@ def main(argv=None):
         "dump", help="run the CPU reference workload and export a trace")
     p_dump.add_argument("--out", default="trace.json")
     p_dump.add_argument("--steps", type=int, default=3)
+    p_dump.add_argument("--fleet", action="store_true",
+                        help="trace a deterministic disaggregated "
+                             "fleet run instead and export the "
+                             "assembled per-replica timeline")
+    p_dump.add_argument("--seed", type=int, default=0,
+                        help="fleet-mode chaos seed")
     p_dump.set_defaults(fn=_cmd_dump)
 
     p_sum = sub.add_parser(
-        "summarize", help="validate + summarize an exported trace")
-    p_sum.add_argument("trace", nargs="?", default="trace.json")
+        "summarize", help="validate + summarize exported trace(s); "
+                          "multiple files merge as labeled streams")
+    p_sum.add_argument("trace", nargs="*",
+                       help="trace file(s); default trace.json")
     p_sum.add_argument("--json", action="store_true",
                        help="print the summary as JSON")
     p_sum.set_defaults(fn=_cmd_summarize)

@@ -1,0 +1,166 @@
+"""Multi-tracer trace assembly: one Perfetto timeline per fleet.
+
+Two merge shapes, both producing a single ``trace_event`` list that
+``export.validate_trace`` accepts and https://ui.perfetto.dev renders
+with **one process row per replica**:
+
+* :func:`merge_streams` — genuinely separate tracer buffers (one per
+  process; the future cross-process fabric, or N trace files handed to
+  the CLI). Streams keep their internal pids/tids but are namespaced
+  into disjoint pid ranges with stable labels, so two replicas' tid 0
+  never collide.
+* :func:`assemble_fleet_trace` — the current single-process fleet
+  simulation: ONE tracer buffer whose serving events carry a
+  ``replica`` attribute (the scheduler stamps it). Events are fanned
+  out to per-replica process rows; fleet-scope events (routing,
+  transits, migrations) get their own row.
+
+On top of the fan-out, :func:`migration_flows` derives Perfetto flow
+arrows (``s``/``f`` phase pairs) from the scheduler's
+``sched.migrate_out`` / ``sched.migrate_in`` instants, matched per
+uid in time order — a cross-replica handoff renders as an arrow from
+the prefill replica's track to the decode replica's track.
+
+Drop honesty: a tracer ring buffer that overflowed has *holes*; both
+mergers surface the exporter's ``tracer_dropped_events`` metadata (and
+the live tracer's counter) as warnings so an assembled trace is never
+silently incomplete.
+"""
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: pid stride per input stream in merge_streams — large enough that
+#: any real tid/pid fits inside one stream's namespace
+_STREAM_STRIDE = 1000
+
+#: metadata event name the exporter writes when the source tracer
+#: dropped events (see tracer.Tracer.dropped / export.write_trace)
+DROPPED_META = "tracer_dropped_events"
+
+
+def stream_drop_count(events: Iterable[Dict]) -> int:
+    """Dropped-event count recorded in a stream's exporter metadata
+    (0 when the stream never overflowed)."""
+    total = 0
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == DROPPED_META:
+            total += int((ev.get("args") or {}).get("count", 0))
+    return total
+
+
+def _process_meta(pid: int, name: str) -> Dict:
+    return {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def merge_streams(streams: "Dict[str, List[Dict]]",
+                  ) -> Tuple[List[Dict], List[str]]:
+    """Merge separate tracer event streams (``{label: events}``,
+    label order = process-row order) into one list with disjoint pid
+    namespaces and a ``process_name`` row per label. Returns
+    ``(events, warnings)`` — warnings name streams whose source tracer
+    dropped events, so the merged trace is never silently partial."""
+    out: List[Dict] = []
+    warnings: List[str] = []
+    for idx, (label, events) in enumerate(streams.items()):
+        base = idx * _STREAM_STRIDE
+        out.append(_process_meta(base, label))
+        dropped = stream_drop_count(events)
+        if dropped:
+            warnings.append(
+                f"stream {label!r}: source tracer dropped {dropped} "
+                "events (ring buffer overflow) — trace incomplete")
+        for ev in events:
+            if ev.get("ph") == "M" and \
+                    ev.get("name") == "process_name":
+                continue            # replaced by the stream label row
+            ev = dict(ev)
+            ev["pid"] = base + int(ev.get("pid", 0) or 0)
+            out.append(ev)
+    out.sort(key=lambda e: e.get("ts", 0.0))
+    return out, warnings
+
+
+# ----------------------------------------------------------------- #
+# single-buffer fleet fan-out
+# ----------------------------------------------------------------- #
+def _event_replica(ev: Dict) -> Optional[int]:
+    r = (ev.get("args") or {}).get("replica")
+    return int(r) if isinstance(r, (int, float)) and not \
+        isinstance(r, bool) else None
+
+
+def replica_labels(events: Iterable[Dict]) -> List[int]:
+    """Stable (sorted) replica ids present in a fleet event stream."""
+    return sorted({r for r in (_event_replica(e) for e in events)
+                   if r is not None})
+
+
+def migration_flows(events: List[Dict],
+                    pid_of: Dict[Optional[int], int]) -> List[Dict]:
+    """Perfetto flow arrows for cross-replica moves: each
+    ``sched.migrate_out`` instant is paired with the next
+    ``sched.migrate_in`` of the same uid (time order), yielding an
+    ``s``/``f`` pair binding the source replica's track to the
+    destination's — the visible handoff arrow."""
+    outs: Dict[int, List[Dict]] = {}
+    flows: List[Dict] = []
+    n = 0
+    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        name = ev.get("name", "")
+        if ev.get("ph") != "i" or not name.startswith("sched.migrate"):
+            continue
+        uid = (ev.get("args") or {}).get("uid")
+        if uid is None:
+            continue
+        if name == "sched.migrate_out":
+            outs.setdefault(int(uid), []).append(ev)
+        elif name == "sched.migrate_in":
+            pending = outs.get(int(uid))
+            if not pending:
+                continue
+            src = pending.pop(0)
+            fid = f"mig-{uid}-{n}"
+            n += 1
+            common = {"name": "migrate", "cat": "fleet", "id": fid,
+                      "tid": 0}
+            flows.append({"ph": "s", **common,
+                          "pid": pid_of.get(_event_replica(src),
+                                            pid_of[None]),
+                          "ts": src.get("ts", 0.0)})
+            flows.append({"ph": "f", "bp": "e", **common,
+                          "pid": pid_of.get(_event_replica(ev),
+                                            pid_of[None]),
+                          "ts": ev.get("ts", 0.0)})
+    return flows
+
+
+def assemble_fleet_trace(events: List[Dict],
+                         dropped: int = 0) -> Tuple[List[Dict],
+                                                    List[str]]:
+    """Fan one fleet-simulation tracer buffer out into per-replica
+    process rows (events stamped ``replica=N`` land on pid ``N``;
+    fleet-scope events land on a dedicated last row) plus migration
+    flow arrows. Returns ``(events, warnings)``."""
+    replicas = replica_labels(events)
+    fleet_pid = (replicas[-1] + 1) if replicas else 0
+    pid_of: Dict[Optional[int], int] = {r: r for r in replicas}
+    pid_of[None] = fleet_pid
+    out: List[Dict] = [_process_meta(r, f"replica {r}")
+                       for r in replicas]
+    out.append(_process_meta(fleet_pid, "fleet"))
+    warnings: List[str] = []
+    total_dropped = dropped + stream_drop_count(events)
+    if total_dropped:
+        warnings.append(
+            f"source tracer dropped {total_dropped} events (ring "
+            "buffer overflow) — assembled trace incomplete")
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        ev = dict(ev)
+        ev["pid"] = pid_of.get(_event_replica(ev), fleet_pid)
+        out.append(ev)
+    out.extend(migration_flows(events, pid_of))
+    out.sort(key=lambda e: (e.get("ts", 0.0), e.get("ph") != "M"))
+    return out, warnings

@@ -1,0 +1,295 @@
+"""Causal request-trace context: the cross-replica propagation format.
+
+Every serving request gets ONE :class:`TraceContext`, minted at submit
+and carried on the ``Request`` for its whole life — through scheduler
+dispatch, preemption, restore lanes, crossover recompute re-entry,
+retries, quarantine rewinds, and (critically) **inside the migration /
+handoff payload**: the context serializes to a JSON-safe wire dict at
+departure and rehydrates on the destination replica, so every
+replica's spans link into one per-request causal DAG. This is the
+context-propagation format the future cross-process latent wire
+(ROADMAP item 1) ships verbatim — a byte-level round trip is already a
+tier-1 contract.
+
+Design constraints:
+
+* **virtual-clock native** — span timestamps come from the owning
+  serving ``Clock`` (virtual in the deterministic simulation, monotonic
+  in production), NOT from the wall-clock span tracer. That is what
+  makes per-request attribution *sum to the measured TTFT/E2E* (the
+  closure gate in ``telemetry.critical_path``) and makes the whole
+  trace a pure function of (trace, seed).
+* **tiling by construction** — ``begin()`` closes the open span at the
+  new span's start time, so the span chain always tiles
+  ``[arrival, finish]`` with no gaps; a missed instrumentation point
+  can only *mislabel* time, never lose it. Losing time (a missed
+  ``end``) is exactly what the closure gate catches.
+* **zero interference** — recording never touches the scheduler event
+  log, the retry RNG, or the clock, so the committed chaos digests
+  replay byte-identical with tracing contexts attached.
+
+Phases (the attribution vocabulary ``critical_path`` aggregates):
+``queue`` (fleet pending + replica queue + ingress), ``prefill``,
+``decode``, ``suspended`` (KV on host, waiting re-entry), ``restore``
+(open restore lane), ``recompute`` (crossover re-prefill re-entry),
+``transit`` (on the inter-replica or tier wire; ``reason="handoff"``
+marks the prefill→decode tier link). Sub-span charges (``charge()``)
+carve named slices — e.g. ``retry_backoff`` — out of their enclosing
+phase without breaking the closure sum.
+"""
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: wire-format version (bump on incompatible change; ``from_wire``
+#: rejects unknown versions rather than mis-parsing them)
+WIRE_VERSION = 1
+
+#: request lifecycle states -> attribution phases; terminal states end
+#: the context instead
+_STATE_PHASE = {
+    "QUEUED": "queue",
+    "PREFILL": "prefill",
+    "DECODE": "decode",
+    "SUSPENDED": "suspended",
+    "RESTORING": "restore",
+}
+
+_TERMINAL = ("DONE", "REJECTED", "FAILED")
+
+
+def deterministic_trace_id(uid: int) -> str:
+    """16-hex-char trace id, a pure function of the request uid — the
+    same request replayed under the same seed gets the same id, which
+    is what lets same-seed trace artifacts diff byte-identical."""
+    return hashlib.sha256(f"hds-request-{uid}".encode()).hexdigest()[:16]
+
+
+@dataclass
+class TraceSpan:
+    """One phase residency interval in a request's causal chain."""
+    span_id: int
+    parent_id: int               # previous span in the chain; -1 = root
+    phase: str
+    t0: float
+    t1: Optional[float] = None   # None while open
+    #: replica that owned this interval (None = fleet scope / wire)
+    replica: Optional[int] = None
+    attrs: Dict = field(default_factory=dict)
+    #: named sub-slices carved out of this span's duration (seconds);
+    #: attribution subtracts them from the phase and reports them as
+    #: their own categories — the sum is preserved
+    charges: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        if self.t1 is None:
+            return 0.0
+        return max(self.t1 - self.t0, 0.0)
+
+    def to_wire(self) -> Dict:
+        out = {"id": self.span_id, "parent": self.parent_id,
+               "phase": self.phase, "t0": self.t0, "t1": self.t1,
+               "replica": self.replica}
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.charges:
+            out["charges"] = dict(self.charges)
+        return out
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "TraceSpan":
+        return cls(span_id=int(d["id"]), parent_id=int(d["parent"]),
+                   phase=str(d["phase"]), t0=float(d["t0"]),
+                   t1=None if d.get("t1") is None else float(d["t1"]),
+                   replica=d.get("replica"),
+                   attrs=dict(d.get("attrs") or {}),
+                   charges={k: float(v) for k, v in
+                            (d.get("charges") or {}).items()})
+
+
+class TraceContext:
+    """Per-request causal trace: id + baggage + the phase-span chain.
+
+    Not thread-safe by itself — a request is owned by exactly one
+    scheduler step at a time (the same single-writer discipline the
+    ``Request`` object already relies on).
+    """
+
+    __slots__ = ("trace_id", "uid", "baggage", "spans", "open",
+                 "_next_span_id", "hops", "clock", "outcome")
+
+    def __init__(self, trace_id: str, uid: int, clock=None,
+                 baggage: Optional[Dict] = None):
+        self.trace_id = trace_id
+        self.uid = int(uid)
+        #: propagated key/value baggage (tenant, priority class, ...)
+        self.baggage: Dict[str, str] = dict(baggage or {})
+        self.spans: List[TraceSpan] = []
+        self.open: Optional[TraceSpan] = None
+        self._next_span_id = 0
+        #: completed wire crossings (serialize→rehydrate round trips)
+        self.hops = 0
+        #: the serving clock spans are stamped from (re-attached after
+        #: a wire crossing; never serialized)
+        self.clock = clock
+        #: terminal state name once ended ("" while live)
+        self.outcome = ""
+
+    # ------------------------------------------------------------- #
+    # construction
+    # ------------------------------------------------------------- #
+    @classmethod
+    def mint(cls, uid: int, clock=None, t0: Optional[float] = None,
+             baggage: Optional[Dict] = None) -> "TraceContext":
+        """Mint the context at submit: deterministic trace id, root
+        ``queue`` span opened at ``t0`` (the request's arrival time, so
+        queue-wait attribution matches ``Request.queue_wait()``)."""
+        ctx = cls(deterministic_trace_id(uid), uid, clock=clock,
+                  baggage=baggage)
+        if t0 is None:
+            t0 = clock.now() if clock is not None else 0.0
+        ctx.begin("queue", t=t0, replica=None)
+        return ctx
+
+    # ------------------------------------------------------------- #
+    # recording
+    # ------------------------------------------------------------- #
+    def _now(self, t: Optional[float]) -> float:
+        if t is not None:
+            return float(t)
+        if self.clock is not None:
+            return float(self.clock.now())
+        return self.spans[-1].t1 if self.spans else 0.0
+
+    def begin(self, phase: str, t: Optional[float] = None,
+              replica: Optional[int] = None, **attrs) -> TraceSpan:
+        """Open a new phase span at ``t``, closing the open one at the
+        same instant (the chain tiles by construction)."""
+        t = self._now(t)
+        parent = -1
+        if self.open is not None:
+            self.open.t1 = max(t, self.open.t0)
+            parent = self.open.span_id
+        elif self.spans:
+            parent = self.spans[-1].span_id
+        span = TraceSpan(span_id=self._next_span_id, parent_id=parent,
+                         phase=phase, t0=t, replica=replica,
+                         attrs=dict(attrs))
+        self._next_span_id += 1
+        self.spans.append(span)
+        self.open = span
+        return span
+
+    def end(self, t: Optional[float] = None, outcome: str = "",
+            **attrs) -> None:
+        """Close the chain (terminal state). Idempotent — a second end
+        only refreshes the outcome."""
+        t = self._now(t)
+        if self.open is not None:
+            self.open.t1 = max(t, self.open.t0)
+            if attrs:
+                self.open.attrs.update(attrs)
+            self.open = None
+        if outcome:
+            self.outcome = outcome
+
+    def on_state(self, state_name: str,
+                 replica: Optional[int] = None,
+                 t: Optional[float] = None) -> None:
+        """The ``Request.transition`` hook: lifecycle states map to
+        attribution phases; terminal states end the chain at ``t``
+        (the request's ``finished_at`` — the same instant the E2E
+        latency is measured against, which is what makes the closure
+        gate exact even when the clock advanced mid-step, e.g. across
+        a retry-backoff sleep). The ``queue`` phase is recorded
+        fleet-scope (replica ``None``) — queued work carries no device
+        state, so a requeue onto another replica is not a wire
+        crossing."""
+        if state_name in _TERMINAL:
+            self.end(t=t, outcome=state_name)
+            return
+        phase = _STATE_PHASE.get(state_name, state_name.lower())
+        self.begin(phase, t=t,
+                   replica=None if phase == "queue" else replica)
+
+    def relabel(self, phase: str) -> None:
+        """Rename the open span's phase (restore → recompute when the
+        crossover policy re-enters by re-prefilling)."""
+        if self.open is not None:
+            self.open.phase = phase
+
+    def charge(self, name: str, seconds: float) -> None:
+        """Carve a named slice (e.g. ``retry_backoff``) out of the
+        open span; attribution reports it as its own category."""
+        if self.open is not None and seconds > 0:
+            self.open.charges[name] = \
+                self.open.charges.get(name, 0.0) + float(seconds)
+
+    # ------------------------------------------------------------- #
+    # reading
+    # ------------------------------------------------------------- #
+    @property
+    def ended(self) -> bool:
+        return self.open is None and bool(self.spans)
+
+    @property
+    def start_t(self) -> Optional[float]:
+        return self.spans[0].t0 if self.spans else None
+
+    @property
+    def end_t(self) -> Optional[float]:
+        if not self.spans or self.spans[-1].t1 is None:
+            return None
+        return self.spans[-1].t1
+
+    def replicas_visited(self) -> List[int]:
+        seen: List[int] = []
+        for s in self.spans:
+            if s.replica is not None and \
+                    (not seen or seen[-1] != s.replica):
+                seen.append(s.replica)
+        return seen
+
+    # ------------------------------------------------------------- #
+    # the wire format (rides inside the Migration/handoff payload)
+    # ------------------------------------------------------------- #
+    def to_wire(self) -> Dict:
+        """JSON-safe snapshot: everything except the clock. The open
+        span serializes with ``t1: None`` and stays open after
+        rehydration — the destination replica continues the chain."""
+        return {
+            "v": WIRE_VERSION,
+            "trace_id": self.trace_id,
+            "uid": self.uid,
+            "baggage": dict(self.baggage),
+            "hops": self.hops,
+            "next_span_id": self._next_span_id,
+            "outcome": self.outcome,
+            "open": None if self.open is None else self.open.span_id,
+            "spans": [s.to_wire() for s in self.spans],
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict, clock=None) -> "TraceContext":
+        """Rehydrate a wire dict on the landing side; raises
+        ``ValueError`` on an unknown wire version (documented contract
+        — a silent mis-parse would corrupt attribution)."""
+        if d.get("v") != WIRE_VERSION:
+            raise ValueError(
+                f"unknown TraceContext wire version {d.get('v')!r} "
+                f"(this build speaks {WIRE_VERSION})")
+        ctx = cls(str(d["trace_id"]), int(d["uid"]), clock=clock,
+                  baggage=d.get("baggage"))
+        ctx.hops = int(d.get("hops", 0)) + 1
+        ctx._next_span_id = int(d["next_span_id"])
+        ctx.outcome = str(d.get("outcome", ""))
+        ctx.spans = [TraceSpan.from_wire(s) for s in d["spans"]]
+        open_id = d.get("open")
+        if open_id is not None:
+            for s in ctx.spans:
+                if s.span_id == open_id:
+                    ctx.open = s
+                    break
+        return ctx

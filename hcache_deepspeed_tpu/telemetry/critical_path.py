@@ -1,0 +1,204 @@
+"""Per-request critical-path extraction + additive latency attribution.
+
+A serving request is sequential — at any instant it is in exactly one
+phase (queued, prefilling, decoding, suspended, restoring, on the
+wire) — so its :class:`~.context.TraceContext` span chain IS its
+critical path, and latency attribution is additive by construction:
+
+    sum(phase seconds) == E2E latency  (the **closure gate**)
+
+The closure gate is what separates this from vibes-based attribution:
+an instrumentation hole (a missed ``end``, a span chain broken across
+a migration) shows up as a residual, not as silently misattributed
+time. :func:`connected` is the companion structural gate: the chain
+must tile the timeline with no gaps, parent ids must link, and a
+replica change is only legal across a ``transit``/``queue`` boundary —
+no orphan spans across crash evacuations or prefill→decode handoffs.
+
+:class:`CriticalPathProfile` aggregates per-request attributions into
+per-phase quantile profiles using the existing bounded-memory
+:class:`~.sketch.QuantileSketch`, so a week-long serving process can
+answer "which stage owns my p99 TTFT" in O(1) memory; the serving
+metrics layer exposes it through ``metrics_snapshot()`` and the
+Prometheus registry, labeled per replica/tier by the fleet.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from .context import TraceContext
+from .sketch import QuantileSketch
+
+#: chain-tiling tolerance (seconds) — spans are written back-to-back
+#: at the same clock read, so any real gap is an instrumentation bug
+GAP_EPS = 1e-9
+
+#: default closure tolerance: |sum(attribution) - measured E2E| must
+#: stay within this fraction of E2E (the artifact gate uses the same)
+CLOSURE_TOL = 0.01
+
+#: phases whose boundary legitimately changes the owning replica —
+#: ``transit`` is the priced wire, ``queue`` holds no device state
+_REPLICA_CROSSING = ("transit", "queue")
+
+
+def _category(span) -> str:
+    """Attribution category for a span: transit splits by wire —
+    prefill→decode handoffs are a separately provisioned link and must
+    be separately attributable from rebalance/crash migration."""
+    if span.phase == "transit" and \
+            span.attrs.get("reason") == "handoff":
+        return "handoff_transit"
+    return span.phase
+
+
+def attribute(ctx: TraceContext,
+              until: Optional[float] = None) -> Dict[str, float]:
+    """Additive per-category seconds for the request, optionally
+    clipped to ``[start, until]`` (pass ``first_token_at`` for the
+    TTFT decomposition). Charges (``retry_backoff`` ...) are reported
+    as their own categories and subtracted from their enclosing
+    phase, so the total is preserved."""
+    out: Dict[str, float] = {}
+    for span in ctx.spans:
+        t1 = span.t1
+        if t1 is None:
+            continue
+        t0 = span.t0
+        if until is not None:
+            if t0 >= until:
+                break
+            t1 = min(t1, until)
+        dur = max(t1 - t0, 0.0)
+        charged = 0.0
+        if span.charges and (until is None or span.t1 <= until):
+            # charges are point-attributed inside the span; clipping a
+            # span mid-way keeps the charge only when fully covered
+            for name, secs in span.charges.items():
+                take = min(secs, dur - charged)
+                if take <= 0:
+                    break
+                out[name] = out.get(name, 0.0) + take
+                charged += take
+        cat = _category(span)
+        out[cat] = out.get(cat, 0.0) + (dur - charged)
+    return out
+
+
+def closure(ctx: TraceContext, e2e_s: Optional[float],
+            tol: float = CLOSURE_TOL) -> Tuple[bool, float]:
+    """The attribution-closure gate: ``(ok, residual_fraction)``.
+    ``residual = |sum(attribution) - e2e| / max(e2e, eps)``; a request
+    whose chain never ended (no ``e2e``) fails closed."""
+    if e2e_s is None or not ctx.ended:
+        return False, float("inf")
+    total = sum(attribute(ctx).values())
+    denom = max(abs(e2e_s), 1e-12)
+    residual = abs(total - e2e_s) / denom
+    return residual <= tol, residual
+
+
+def connected(ctx: TraceContext) -> Tuple[bool, str]:
+    """The structural DAG gate: ``(ok, reason)``. Checks that the
+    chain ended, tiles the timeline (no gaps/overlaps beyond
+    ``GAP_EPS``), parent ids link each span to its predecessor, and
+    every replica change crosses a ``transit``/``queue`` boundary."""
+    if not ctx.spans:
+        return False, "no spans recorded"
+    if not ctx.ended:
+        return False, "chain never ended (request non-terminal?)"
+    prev = None
+    for span in ctx.spans:
+        if span.t1 is None:
+            return False, f"span {span.span_id} ({span.phase}) open"
+        if span.t1 < span.t0 - GAP_EPS:
+            return False, f"span {span.span_id} negative duration"
+        if prev is not None:
+            if span.parent_id != prev.span_id:
+                return False, (f"span {span.span_id} parent "
+                               f"{span.parent_id} != {prev.span_id} "
+                               "(orphan)")
+            if abs(span.t0 - prev.t1) > GAP_EPS:
+                return False, (f"gap {span.t0 - prev.t1:.3e}s before "
+                               f"span {span.span_id} ({span.phase})")
+            if span.replica is not None and \
+                    prev.replica is not None and \
+                    span.replica != prev.replica and \
+                    span.phase not in _REPLICA_CROSSING and \
+                    prev.phase not in _REPLICA_CROSSING:
+                return False, (f"replica {prev.replica}->"
+                               f"{span.replica} without transit at "
+                               f"span {span.span_id}")
+        prev = span
+    return True, ""
+
+
+def critical_path(ctx: TraceContext) -> List[Dict]:
+    """The ordered critical path as JSON-safe rows (span chain with
+    categories + durations) — what the flight recorder and the
+    REQUEST_TRACE artifact embed per request."""
+    return [{
+        "span": s.span_id, "phase": _category(s),
+        "t0": round(s.t0, 9),
+        "t1": None if s.t1 is None else round(s.t1, 9),
+        "dur_s": round(s.duration, 9),
+        "replica": s.replica,
+        **({"charges": {k: round(v, 9)
+                        for k, v in s.charges.items()}}
+           if s.charges else {}),
+    } for s in ctx.spans]
+
+
+class CriticalPathProfile:
+    """Streaming per-phase attribution profile (p50/p99 via the
+    bounded-memory quantile sketch) — the aggregate the control loops
+    (SLO autoscaler, degradation ladder) can act on."""
+
+    def __init__(self):
+        self._sketches: Dict[str, QuantileSketch] = {}
+        self.count = 0
+
+    def observe(self, attribution: Dict[str, float]) -> None:
+        self.count += 1
+        for phase, secs in attribution.items():
+            sk = self._sketches.get(phase)
+            if sk is None:
+                sk = self._sketches[phase] = QuantileSketch()
+            sk.add(float(secs))
+
+    def percentile(self, phase: str, q: float) -> Optional[float]:
+        sk = self._sketches.get(phase)
+        if sk is None or not sk.n:
+            return None
+        return sk.quantile(q)
+
+    @property
+    def phases(self) -> List[str]:
+        return sorted(self._sketches)
+
+    def summary(self) -> Dict:
+        out: Dict = {"count": self.count, "phases": {}}
+        for phase in self.phases:
+            sk = self._sketches[phase]
+            out["phases"][phase] = {
+                "count": sk.n,
+                "mean": round(sk.sum / sk.n, 9) if sk.n else None,
+                "p50": round(sk.quantile(50), 9),
+                "p99": round(sk.quantile(99), 9),
+            }
+        return out
+
+    def to_registry(self, registry, prefix: str = "critical_path",
+                    labels: Optional[Dict] = None) -> None:
+        """Render per-phase p50/p99 gauges into a
+        ``telemetry.prometheus.MetricRegistry`` (phase rides as a
+        label so scrapers see one family per quantile)."""
+        for phase in self.phases:
+            lbl = dict(labels or {})
+            lbl["phase"] = phase
+            for q in (50, 99):
+                v = self.percentile(phase, q)
+                if v is not None:
+                    registry.set_gauge(
+                        f"{prefix}_seconds_p{q}", v, labels=lbl,
+                        help=f"per-request critical-path {prefix} "
+                             f"p{q} by phase (s)")

@@ -27,10 +27,24 @@ def to_trace_events(events, thread_names=None, pid=0):
     return out
 
 
-def write_trace(events, path, thread_names=None, pid=0):
-    """Write a Perfetto-loadable ``trace.json``; returns the trace dict."""
+def write_trace(events, path, thread_names=None, pid=0, dropped=0):
+    """Write a Perfetto-loadable ``trace.json``; returns the trace
+    dict. ``dropped`` is the source tracer's ring-buffer displacement
+    count: non-zero means the trace has holes, so the exporter warns
+    and records it as metadata (``tracer_dropped_events``) that the
+    assembler and ``summarize`` surface downstream."""
+    trace_events = to_trace_events(events, thread_names, pid)
+    if dropped:
+        trace_events.insert(0, {
+            "ph": "M", "name": "tracer_dropped_events", "pid": pid,
+            "tid": 0, "args": {"count": int(dropped)}})
+        from ..utils.logging import logger
+        logger.warning(
+            f"trace export {path}: source tracer dropped {dropped} "
+            "events at ring-buffer capacity — trace is incomplete "
+            "(raise Tracer capacity or clear() between captures)")
     trace = {
-        "traceEvents": to_trace_events(events, thread_names, pid),
+        "traceEvents": trace_events,
         "displayTimeUnit": "ms",
     }
     with open(path, "w") as fh:

@@ -1,0 +1,154 @@
+"""Always-on bounded flight recorder: anomaly-triggered postmortems.
+
+Production incidents are diagnosed from the state *around* the
+anomaly, and by the time a human asks, that state is gone. The flight
+recorder is the black box: always armed, ~free until a trigger fires,
+and bounded (a deque of at most ``capacity`` bundles) so a week-long
+serving process cannot grow it. Trigger sites (wired in
+``serving/scheduler.py``, ``serving/server.py`` and
+``resilience/chaos.py``):
+
+* ``slo_burn`` — an SLO burn-rate gauge crossed the threshold;
+* ``breaker_open`` — the restore-path circuit breaker tripped;
+* ``watchdog`` — the stuck-lane watchdog aborted a restore lane;
+* ``chaos_invariant`` — a chaos-harness invariant failed;
+* ``server_crash`` — the serving loop died (``_on_loop_error``).
+
+Each dump is a **deterministic postmortem bundle**: trigger + reason,
+the scheduler's virtual-clock snapshot (pools, breaker, degradation,
+event-log tail), metrics counters — plus the last-K wall-clock tracer
+spans for humans. The bundle digest is computed over everything
+EXCEPT the wall-clock spans (and the arrival sequence number), so the
+same seed produces byte-identical digests: the determinism gate in
+``REQUEST_TRACE.jsonl`` replays a chaos run twice and compares.
+
+Per-(trigger, source) cooldowns are counted in *scheduler steps*, not
+wall time — deterministic rate limiting, same replay guarantee.
+"""
+
+import hashlib
+import json
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class FlightRecorder:
+    """Bounded postmortem-bundle recorder (module singleton via
+    :func:`get_flight_recorder`)."""
+
+    def __init__(self, capacity: int = 64, cooldown_steps: int = 25,
+                 slo_burn_threshold: float = 10.0,
+                 span_tail: int = 128):
+        self.enabled = True
+        self.capacity = int(capacity)
+        #: minimum scheduler steps between two dumps of the same
+        #: (trigger, source) pair — deterministic rate limiting
+        self.cooldown_steps = int(cooldown_steps)
+        #: burn-rate gauge level that arms the ``slo_burn`` trigger
+        #: (10 = the error budget burns 10x faster than the objective
+        #: allows — the classic page-now threshold)
+        self.slo_burn_threshold = float(slo_burn_threshold)
+        #: wall-clock tracer spans attached to each bundle (excluded
+        #: from the digest)
+        self.span_tail = int(span_tail)
+        self.bundles: "deque[Dict]" = deque(maxlen=self.capacity)
+        self._last_fire: Dict = {}        # (trigger, source) -> step
+        self._lock = threading.Lock()
+        self.dumps = 0
+        self.suppressed = 0
+
+    # ------------------------------------------------------------- #
+    def should_fire(self, trigger: str, source: str,
+                    step: int) -> bool:
+        """Cooldown check WITHOUT recording a fire — callers use it to
+        skip building the snapshot when the dump would be dropped."""
+        if not self.enabled:
+            return False
+        last = self._last_fire.get((trigger, source))
+        return last is None or step - last >= self.cooldown_steps
+
+    def dump(self, trigger: str, reason: str, source: str = "",
+             step: int = 0, t: float = 0.0,
+             snapshot: Optional[Dict] = None,
+             spans: Optional[List] = None) -> Optional[Dict]:
+        """Record one bundle (honoring the cooldown); returns it, or
+        None when suppressed. ``snapshot`` must be JSON-safe and
+        deterministic under the virtual clock — it is digested."""
+        with self._lock:
+            if not self.should_fire(trigger, source, step):
+                self.suppressed += 1
+                return None
+            self._last_fire[(trigger, source)] = step
+            bundle = {
+                "trigger": trigger,
+                "reason": str(reason),
+                "source": source,
+                "step": int(step),
+                "t": round(float(t), 9),
+                "snapshot": snapshot or {},
+            }
+            bundle["digest"] = self.bundle_digest(bundle)
+            # wall-clock context for humans, outside the digest
+            bundle["spans"] = list(spans or [])[-self.span_tail:]
+            bundle["seq"] = self.dumps
+            self.dumps += 1
+            self.bundles.append(bundle)
+            return bundle
+
+    @staticmethod
+    def bundle_digest(bundle: Dict) -> str:
+        """sha256 over the deterministic core of a bundle (everything
+        except the wall-clock ``spans`` tail, the arrival ``seq`` and
+        the digest itself)."""
+        core = {k: v for k, v in bundle.items()
+                if k not in ("spans", "seq", "digest")}
+        payload = json.dumps(core, sort_keys=True,
+                             separators=(",", ":"),
+                             default=repr).encode()
+        return hashlib.sha256(payload).hexdigest()
+
+    # ------------------------------------------------------------- #
+    def digests(self) -> List[str]:
+        with self._lock:
+            return [b["digest"] for b in self.bundles]
+
+    def triggers(self) -> List[str]:
+        with self._lock:
+            return [b["trigger"] for b in self.bundles]
+
+    def clear(self) -> None:
+        with self._lock:
+            self.bundles.clear()
+            self._last_fire.clear()
+            self.dumps = 0
+            self.suppressed = 0
+
+    def summary(self) -> Dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "bundles": len(self.bundles),
+                "dumps": self.dumps,
+                "suppressed": self.suppressed,
+                "last_trigger": self.bundles[-1]["trigger"]
+                if self.bundles else "",
+                "triggers": sorted({b["trigger"]
+                                    for b in self.bundles}),
+            }
+
+    def export(self, path: str) -> int:
+        """Write the buffered bundles as JSONL; returns the count."""
+        with self._lock:
+            bundles = list(self.bundles)
+        with open(path, "w") as fh:
+            for b in bundles:
+                fh.write(json.dumps(b, default=repr) + "\n")
+        return len(bundles)
+
+
+_recorder = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _recorder

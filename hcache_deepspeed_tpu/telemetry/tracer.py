@@ -92,6 +92,10 @@ class Tracer:
         self.enabled = False
         self._capacity = capacity
         self._events = deque(maxlen=capacity)
+        #: events silently displaced by the ring buffer since the last
+        #: ``clear()`` — surfaced by the CLI/exporter/assembler so a
+        #: trace with holes is never mistaken for a complete one
+        self.dropped = 0
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._tids = {}          # thread ident -> (small tid, name)
@@ -119,6 +123,7 @@ class Tracer:
     def clear(self):
         with self._lock:
             self._events.clear()
+            self.dropped = 0
             self._t0 = time.perf_counter()
 
     # -------------------------------------------------------------- #
@@ -180,6 +185,12 @@ class Tracer:
         ev.update(extra)
         # the lock-free hot path is the design; readers copy under
         # the lock (module docstring)
+        if len(self._events) == self._events.maxlen:
+            # the append below displaces the oldest event; count it —
+            # a benign-race += is acceptable for a diagnostics counter
+            # (GIL keeps it approximately exact, never negative)
+            # hds: allow(HDS-L001) diagnostics counter, see above
+            self.dropped += 1
         # hds: allow(HDS-L001) deque.append is atomic under the GIL
         self._events.append(ev)
 
@@ -241,12 +252,20 @@ class Tracer:
         with self._lock:
             return {tid: name for tid, name in self._tids.values()}
 
+    @property
+    def buffered(self) -> int:
+        """Events currently in the ring buffer (O(1), lock-free)."""
+        return len(self._events)
+
     def export(self, path):
-        """Write the current buffer as a Perfetto-loadable trace."""
+        """Write the current buffer as a Perfetto-loadable trace.
+        A non-zero drop count rides into the trace as metadata and is
+        warned about — an overflowed buffer is an incomplete trace."""
         from .export import write_trace
         return write_trace(self.events(), path,
                            thread_names=self.thread_names(),
-                           pid=self._process_index())
+                           pid=self._process_index(),
+                           dropped=self.dropped)
 
 
 _tracer = Tracer()

@@ -16,3 +16,12 @@ def validate_widget(cfg):
 def muted():
     # hds: allow(HDS-P001)
     return 1                                         # HDS-C003 above
+
+
+def open_serving_span(uid):
+    # serving-path async span without request identity attrs
+    get_tracer().async_begin("fleet.migrate.demo", uid)  # HDS-C004
+
+
+def close_serving_span(uid):
+    get_tracer().async_end("fleet.migrate.demo", uid)    # HDS-C004

@@ -22,3 +22,13 @@ def validate_payload(blob):
     contract (the C002 exemption)."""
     if not isinstance(blob, dict):
         raise ValueError("payload must be a dict")
+
+
+def open_serving_span(uid, trace_id):
+    # the corrected twin: request identity rides on the span
+    get_tracer().async_begin("fleet.migrate.demo", uid,
+                             uid=uid, trace=trace_id)
+
+
+def close_serving_span(uid):
+    get_tracer().async_end("fleet.migrate.demo", uid, uid=uid)

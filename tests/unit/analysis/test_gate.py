@@ -60,6 +60,9 @@ def test_sanctioned_ledger_is_exact():
         ("hcache_deepspeed_tpu/serving/clock.py", "HDS-P001"),
         ("hcache_deepspeed_tpu/serving/fleet.py", "HDS-L001"),
         ("hcache_deepspeed_tpu/serving/fleet.py", "HDS-L002"),
+        # two tracer sites: the lock-free event append and its
+        # dropped-event diagnostics counter (same GIL argument)
+        ("hcache_deepspeed_tpu/telemetry/tracer.py", "HDS-L001"),
         ("hcache_deepspeed_tpu/telemetry/tracer.py", "HDS-L001"),
     ], sites
 

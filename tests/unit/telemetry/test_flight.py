@@ -1,0 +1,110 @@
+"""Flight-recorder unit tests: trigger/cooldown semantics, digest
+determinism (the same-seed ⇒ byte-identical-bundle contract), span
+exclusion from the digest, bounded capacity, and the live trigger
+sites (breaker trip + SLO burn under the chaos storm, server-crash
+path in thread mode)."""
+
+import pytest
+
+from hcache_deepspeed_tpu.telemetry.flight import (FlightRecorder,
+                                                   get_flight_recorder)
+
+
+def test_dump_and_deterministic_digest():
+    rec = FlightRecorder()
+    snap = {"step": 7, "pools": {"queue": 3}, "breaker": "OPEN"}
+    b1 = rec.dump("breaker_open", "uid=3", source="r0", step=7,
+                  t=1.25, snapshot=snap, spans=[{"ph": "i", "ts": 1}])
+    rec2 = FlightRecorder()
+    b2 = rec2.dump("breaker_open", "uid=3", source="r0", step=7,
+                   t=1.25, snapshot=dict(snap),
+                   spans=[{"ph": "i", "ts": 999}])   # different spans
+    assert b1 is not None and b2 is not None
+    # spans and seq are wall-clock/arrival artifacts: NOT in the digest
+    assert b1["digest"] == b2["digest"]
+    b3 = FlightRecorder().dump("breaker_open", "uid=4", source="r0",
+                               step=7, t=1.25, snapshot=dict(snap))
+    assert b3["digest"] != b1["digest"]        # content changes digest
+
+
+def test_cooldown_is_per_trigger_source_and_step_counted():
+    rec = FlightRecorder(cooldown_steps=10)
+    assert rec.dump("slo_burn", "x", source="r0", step=5) is not None
+    assert rec.dump("slo_burn", "x", source="r0", step=9) is None
+    assert rec.suppressed == 1
+    # different source / different trigger are independent streams
+    assert rec.dump("slo_burn", "x", source="r1", step=9) is not None
+    assert rec.dump("watchdog", "x", source="r0", step=9) is not None
+    # cooldown expiry re-arms
+    assert rec.dump("slo_burn", "x", source="r0", step=15) is not None
+    assert not rec.should_fire("slo_burn", "r0", 16)
+
+
+def test_capacity_bounds_and_clear():
+    rec = FlightRecorder(capacity=3, cooldown_steps=0)
+    for i in range(10):
+        rec.dump("t", f"r{i}", source="s", step=i)
+    assert len(rec.bundles) == 3 and rec.dumps == 10
+    assert rec.summary()["bundles"] == 3
+    rec.clear()
+    assert rec.bundles == rec.bundles.__class__(maxlen=3) or \
+        len(rec.bundles) == 0
+    assert rec.dumps == 0 and rec.suppressed == 0
+
+
+def test_export_jsonl(tmp_path):
+    rec = FlightRecorder(cooldown_steps=0)
+    rec.dump("t", "one", source="s", step=1, snapshot={"a": 1})
+    path = tmp_path / "flight.jsonl"
+    assert rec.export(str(path)) == 1
+    import json
+    (row,) = [json.loads(l) for l in path.read_text().splitlines()]
+    assert row["trigger"] == "t" and row["snapshot"] == {"a": 1}
+
+
+def test_chaos_storm_fires_breaker_and_slo_triggers_deterministically():
+    """The canonical chaos seed trips the breaker (by plan design) and
+    burns the availability SLO: the recorder must capture bundles, and
+    two same-seed runs must produce byte-identical digest lists."""
+    from hcache_deepspeed_tpu.resilience.chaos import run_chaos
+    rec = get_flight_recorder()
+    digests = []
+    for _ in range(2):
+        rec.clear()
+        run_chaos(seed=0)
+        digests.append(rec.digests())
+        assert {"breaker_open", "slo_burn"} <= set(rec.triggers())
+        # the bundle snapshot is the deterministic postmortem core
+        b = rec.bundles[0]
+        assert b["snapshot"]["pools"] is not None
+        assert b["digest"] == FlightRecorder.bundle_digest(b)
+    assert digests[0] == digests[1] and digests[0]
+    rec.clear()
+
+
+def test_server_crash_path_dumps_bundle():
+    """Thread-mode loop death must leave a server_crash postmortem."""
+    from hcache_deepspeed_tpu.inference.config import \
+        RaggedInferenceEngineConfig
+    from hcache_deepspeed_tpu.serving import ServingServer
+    from hcache_deepspeed_tpu.serving.sim import SimulatedEngine
+
+    engine = SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 4,
+                       "max_ragged_batch_size": 64,
+                       "max_ragged_sequence_count": 2,
+                       "max_context": 64},
+        kv_cache={"block_size": 8, "num_blocks": 8},
+        hcache={"enable_latents": True}))
+    server = ServingServer(engine)
+    rec = get_flight_recorder()
+    rec.clear()
+    boom = RuntimeError("engine exploded")
+    server._on_loop_error(boom)
+    assert "server_crash" in rec.triggers()
+    (bundle,) = [b for b in rec.bundles
+                 if b["trigger"] == "server_crash"]
+    assert "engine exploded" in bundle["reason"]
+    assert bundle["snapshot"]["pools"]["queue"] == 0
+    assert not server.healthy
+    rec.clear()

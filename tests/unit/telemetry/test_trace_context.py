@@ -1,0 +1,169 @@
+"""TraceContext + critical-path unit tests: deterministic ids, span
+tiling, wire round-trip fidelity, the closure/connectivity gates (both
+directions — intact chains pass, each corruption class is caught), and
+attribution arithmetic including charges and the TTFT split."""
+
+import pytest
+
+from hcache_deepspeed_tpu.serving.clock import VirtualClock
+from hcache_deepspeed_tpu.telemetry.context import (
+    TraceContext, deterministic_trace_id)
+from hcache_deepspeed_tpu.telemetry.critical_path import (
+    CriticalPathProfile, attribute, closure, connected, critical_path)
+
+
+def chain(uid=7):
+    """A representative cross-replica chain: queue -> prefill ->
+    decode -> suspended -> transit(handoff) -> suspended -> restore
+    -> decode -> DONE, with a retry-backoff charge inside restore."""
+    clock = VirtualClock()
+    ctx = TraceContext.mint(uid, clock=clock, t0=0.0)
+    clock.advance_to(1.0)
+    ctx.begin("prefill", replica=0)
+    clock.advance_to(1.5)
+    ctx.begin("decode", replica=0)
+    clock.advance_to(3.0)
+    ctx.begin("suspended", replica=0)
+    clock.advance_to(3.25)
+    ctx.begin("transit", replica=None, reason="handoff", src=0, dst=1)
+    clock.advance_to(3.75)
+    ctx.begin("suspended", replica=1)
+    clock.advance_to(4.0)
+    ctx.begin("restore", replica=1)
+    ctx.charge("retry_backoff", 0.25)
+    clock.advance_to(5.0)
+    ctx.begin("decode", replica=1)
+    clock.advance_to(6.0)
+    ctx.end(outcome="DONE")
+    return ctx
+
+
+def test_trace_id_is_deterministic_function_of_uid():
+    assert deterministic_trace_id(42) == deterministic_trace_id(42)
+    assert deterministic_trace_id(42) != deterministic_trace_id(43)
+    ctx = TraceContext.mint(42, clock=VirtualClock())
+    assert ctx.trace_id == deterministic_trace_id(42)
+
+
+def test_chain_tiles_and_connects():
+    ctx = chain()
+    ok, reason = connected(ctx)
+    assert ok, reason
+    assert ctx.replicas_visited() == [0, 1]
+    path = critical_path(ctx)
+    assert [p["phase"] for p in path] == [
+        "queue", "prefill", "decode", "suspended", "handoff_transit",
+        "suspended", "restore", "decode"]
+    # tiling: each span starts where the previous ended
+    for a, b in zip(path, path[1:]):
+        assert a["t1"] == b["t0"]
+
+
+def test_attribution_closes_and_splits_charges():
+    ctx = chain()
+    attr = attribute(ctx)
+    assert attr["queue"] == pytest.approx(1.0)
+    assert attr["handoff_transit"] == pytest.approx(0.5)
+    assert attr["retry_backoff"] == pytest.approx(0.25)
+    assert attr["restore"] == pytest.approx(0.75)   # 1.0 minus charge
+    assert sum(attr.values()) == pytest.approx(6.0)
+    ok, residual = closure(ctx, 6.0)
+    assert ok and residual == pytest.approx(0.0)
+    # the TTFT split: clip at first token (prefill end, t=1.5)
+    ttft = attribute(ctx, until=1.5)
+    assert ttft == {"queue": pytest.approx(1.0),
+                    "prefill": pytest.approx(0.5)}
+
+
+def test_closure_gate_catches_unended_and_mismatched_chains():
+    clock = VirtualClock()
+    ctx = TraceContext.mint(1, clock=clock, t0=0.0)
+    clock.advance_to(2.0)
+    ok, residual = closure(ctx, 2.0)        # never ended
+    assert not ok and residual == float("inf")
+    ctx.end(outcome="DONE")
+    ok, _ = closure(ctx, 2.0)
+    assert ok
+    ok, residual = closure(ctx, 3.0)        # measured E2E disagrees
+    assert not ok and residual == pytest.approx(1.0 / 3.0)
+
+
+def test_connectivity_gate_catches_each_corruption_class():
+    # orphan span (broken parent link)
+    ctx = chain()
+    ctx.spans[3].parent_id = 99
+    ok, reason = connected(ctx)
+    assert not ok and "orphan" in reason
+    # timeline gap
+    ctx = chain()
+    ctx.spans[2].t0 += 0.1
+    ok, reason = connected(ctx)
+    assert not ok and "gap" in reason
+    # replica teleport without a transit/queue boundary
+    ctx = chain()
+    ctx.spans[2].replica = 5       # decode hops replica mid-stream
+    ok, reason = connected(ctx)
+    assert not ok and "without transit" in reason
+    # open chain
+    ctx = chain()
+    ctx.spans[-1].t1 = None
+    ctx.open = ctx.spans[-1]
+    ok, reason = connected(ctx)
+    assert not ok and "ended" in reason
+
+
+def test_wire_round_trip_preserves_everything():
+    clock = VirtualClock()
+    ctx = TraceContext.mint(9, clock=clock, t0=0.0,
+                            baggage={"tenant": "acme"})
+    clock.advance_to(1.0)
+    ctx.begin("prefill", replica=0)
+    clock.advance_to(2.0)
+    ctx.begin("transit", replica=None, reason="handoff")
+    wire = ctx.to_wire()
+    # wire dict must be JSON-safe
+    import json
+    wire = json.loads(json.dumps(wire))
+    land_clock = VirtualClock(2.5)
+    ctx2 = TraceContext.from_wire(wire, clock=land_clock)
+    assert ctx2.trace_id == ctx.trace_id
+    assert ctx2.baggage == {"tenant": "acme"}
+    assert ctx2.hops == 1
+    assert ctx2.open is not None and ctx2.open.phase == "transit"
+    # the landing side continues the chain seamlessly
+    ctx2.begin("suspended", replica=1)
+    land_clock.advance_to(3.0)
+    ctx2.end(outcome="DONE")
+    ok, reason = connected(ctx2)
+    assert ok, reason
+    ok, _ = closure(ctx2, 3.0)
+    assert ok
+    # span ids stay unique across the hop
+    ids = [s.span_id for s in ctx2.spans]
+    assert len(ids) == len(set(ids))
+
+
+def test_wire_rejects_unknown_version():
+    ctx = TraceContext.mint(1, clock=VirtualClock())
+    wire = ctx.to_wire()
+    wire["v"] = 99
+    with pytest.raises(ValueError, match="wire version"):
+        TraceContext.from_wire(wire)
+
+
+def test_profile_aggregates_percentiles_per_phase():
+    prof = CriticalPathProfile()
+    for i in range(100):
+        prof.observe({"queue": i / 100.0, "decode": 1.0})
+    assert prof.count == 100
+    assert prof.percentile("decode", 50) == pytest.approx(1.0)
+    assert prof.percentile("queue", 50) == pytest.approx(0.5,
+                                                         abs=0.02)
+    s = prof.summary()
+    assert set(s["phases"]) == {"queue", "decode"}
+    # registry rendering: one labeled gauge family per quantile
+    from hcache_deepspeed_tpu.telemetry.prometheus import MetricRegistry
+    reg = MetricRegistry(namespace="t")
+    prof.to_registry(reg, prefix="cp", labels={"tier": "decode"})
+    text = reg.render()
+    assert 'cp_seconds_p99{phase="decode",tier="decode"}' in text

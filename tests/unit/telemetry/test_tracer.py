@@ -163,3 +163,38 @@ def test_validator_rejects_bad_toplevel():
         validate_trace({"events": []})
     with pytest.raises(ValueError, match="dict or list"):
         validate_trace("nope")
+
+
+# ------------------------------------------------------------------ #
+# dropped-event accounting (ring-buffer overflow honesty)
+# ------------------------------------------------------------------ #
+def test_dropped_counter_counts_ring_displacements():
+    t = tracer(capacity=4)
+    for i in range(4):
+        t.instant(f"e{i}")
+    assert t.dropped == 0 and t.buffered == 4
+    for i in range(3):
+        t.instant(f"late{i}")
+    assert t.dropped == 3             # 3 oldest events displaced
+    assert t.buffered == 4
+    t.clear()
+    assert t.dropped == 0 and t.buffered == 0
+
+
+def test_export_records_drop_metadata_and_assembler_warns(tmp_path):
+    from hcache_deepspeed_tpu.telemetry.assemble import (
+        merge_streams, stream_drop_count)
+    t = tracer(capacity=2)
+    for i in range(5):
+        t.instant(f"e{i}")
+    path = tmp_path / "trace.json"
+    t.export(str(path))
+    events = load_trace(str(path))
+    assert stream_drop_count(events) == 3
+    merged, warnings = merge_streams({"lossy": events})
+    assert warnings and "dropped 3 events" in warnings[0]
+    # a clean stream merges silently
+    clean = tracer()
+    clean.instant("ok")
+    _, warnings = merge_streams({"clean": clean.events()})
+    assert warnings == []
