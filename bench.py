@@ -1179,6 +1179,51 @@ def run_disagg(out_path="DISAGG_SERVE.jsonl"):
     return 0 if ok else 4
 
 
+def run_spec_serve(out_path="SPEC_SERVE.jsonl"):
+    """``--spec-serve``: CPU-deterministic audit of scheduler-
+    dispatched speculative decoding + fleet-wide radix prefix reuse
+    with latent prefix broadcast (docs/serving.md). Gates inline:
+    bitwise stream parity vs non-speculative greedy, accepted-tokens/
+    step > 1.3 on the lookup-friendly trace, >= 1 latent prefix
+    broadcast with positive re-prefill savings on the affinity-vs-
+    load conflict trace, the SLO-aware ladder escalating under an
+    unmeetable objective, and byte-identical two-run event digests.
+    Self-compares against the committed perf trajectory before
+    writing. Never touches the TPU relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_spec_serve as run_ss
+    try:
+        results = run_ss(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(
+            f"spec-serve gate failed: {exc}")), flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "spec-serve-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "speculative serving: accepted tokens per "
+                  "speculative lane-step (1.0 = non-speculative "
+                  "floor)",
+        "value": summary["accepted_tokens_per_step"],
+        "unit": "tokens/step",
+        "vs_baseline": 1.0 if summary["invariants_ok"] and
+        summary["deterministic"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("deterministic", "stream_parity",
+                   "lookup_virtual_speedup", "mixed_virtual_speedup",
+                   "reprefill_savings", "prefix_broadcasts",
+                   "prefix_tokens_reused", "slo_final_level")},
+    }), flush=True)
+    ok = (summary["invariants_ok"] and summary["deterministic"] and
+          summary["stream_parity"] and
+          summary["accepted_tokens_per_step"] > 1.3 and
+          summary["reprefill_savings"] > 0)
+    return 0 if ok else 4
+
+
 def run_request_trace(out_path="REQUEST_TRACE.jsonl"):
     """``--request-trace``: CPU-deterministic causal-tracing audit —
     replay the chaos/fleet/disagg workloads and gate connected
@@ -1228,6 +1273,8 @@ def main():
         return run_fleet()
     if "--disagg" in sys.argv[1:]:
         return run_disagg()
+    if "--spec-serve" in sys.argv[1:]:
+        return run_spec_serve()
     if "--request-trace" in sys.argv[1:]:
         return run_request_trace()
     child = os.environ.get("HDS_BENCH_CHILD")

@@ -1313,6 +1313,391 @@ def run_disagg_serve(seed=0, n_prefill=1, n_decode=3, runs=2,
     return results
 
 
+def _spec_digest(events) -> str:
+    import hashlib
+    payload = json.dumps(events, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+def run_spec_serve(seed=0, runs=2, out="SPEC_SERVE.jsonl"):
+    """``--spec-serve``: CPU-deterministic audit of scheduler-
+    dispatched speculative decoding + fleet-wide radix prefix reuse
+    with latent prefix broadcast (docs/serving.md), on the shared
+    virtual clock. Four phases, each gated inline — the artifact IS
+    the acceptance evidence:
+
+    * ``spec-lookup`` — lookup-friendly trace on one replica:
+      speculative vs non-speculative scheduler, gating bitwise stream
+      parity, accepted-tokens/step > 1.3 and a virtual-clock speedup;
+    * ``spec-mixed`` — chatty + agent-swarm shared-prefix +
+      long-prompt mix on a 3-replica fleet, speculation + prefix
+      reuse + broadcast ON vs the affinity-only non-speculative
+      fleet: stream parity, TTFT/TPOT p99s, leak/terminal invariants;
+    * ``spec-prefix`` — the affinity-vs-load conflict trace: the warm
+      replica is pinned hot so the router places sharers cold and the
+      fleet must broadcast the common prefix ONCE over the latent
+      wire; gates broadcasts >= 1, landings == planned terminal, and
+      re-prefill savings (prompt tokens restored instead of
+      re-prefilled) > 0;
+    * ``spec-slo`` — an unmeetable TTFT objective drives the
+      SLO-aware ladder (speculation off => chunked prefill => shed);
+      gates that it escalated and that the trace still drained.
+
+    Every phase runs ``runs`` times with one seed and gates
+    byte-identical event digests. Self-compares against the committed
+    perf trajectory before writing. Never touches the TPU relay."""
+    from ..inference.config import RaggedInferenceEngineConfig
+    from ..serving import (FleetConfig, PrefixReuseConfig, Request,
+                           RouterConfig, ServerConfig, ServingFleet,
+                           ServingServer, SimulatedEngine,
+                           SLOModeConfig, SpeculationConfig,
+                           VirtualClock)
+    from ..serving.metrics import ServingMetrics
+    from ..telemetry.slo import SLOObjective, SLOTracker
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    SPEC = SpeculationConfig(ngram=2, max_draft=4, window=64)
+    violations = []
+
+    def make_engine(num_blocks=64, lanes=6, tracked=10,
+                    max_context=160, vocab=16):
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": tracked,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": lanes,
+                           "max_context": max_context},
+            kv_cache={"block_size": 8, "num_blocks": num_blocks},
+            hcache={"enable_latents": True}), vocab_size=vocab)
+
+    # ---------------- phase 1: spec-lookup ------------------------- #
+    def lookup_trace():
+        rng = np.random.default_rng([seed, 0x51EC])
+        return [Request(uid=i,
+                        prompt=[int(t) for t in
+                                rng.integers(1, 14, (8,))],
+                        max_new_tokens=48,
+                        arrival_time=0.01 * i) for i in range(12)]
+
+    def run_single(speculation):
+        server = ServingServer(
+            make_engine(), clock=VirtualClock(),
+            config=ServerConfig(max_queue_depth=64,
+                                kv_demand_fraction=float("inf"),
+                                speculation=speculation))
+        reqs = lookup_trace()
+        server.run_trace(reqs)
+        return (server, reqs,
+                _spec_digest([list(e)
+                              for e in server.scheduler.events]))
+
+    base_srv, base_reqs, _ = run_single(None)
+    spec_runs = [run_single(SPEC) for _ in range(max(1, runs))]
+    spec_srv, spec_reqs, _ = spec_runs[0]
+    spec_digests = [d for _, _, d in spec_runs]
+    lookup_parity = ({r.uid: r.tokens_out for r in base_reqs} ==
+                     {r.uid: r.tokens_out for r in spec_reqs})
+    accepted_per_step = spec_srv.metrics.gauges[
+        "spec_accepted_tokens_per_step"]
+    lookup_speedup = base_srv.clock.now() / max(spec_srv.clock.now(),
+                                                1e-12)
+    if not lookup_parity:
+        violations.append("spec-lookup: stream parity broken")
+    if accepted_per_step <= 1.3:
+        violations.append(
+            f"spec-lookup: accepted_tokens_per_step "
+            f"{accepted_per_step:.3f} <= 1.3")
+    emit({"phase": "spec-lookup", "seed": seed,
+          "requests": len(base_reqs),
+          "stream_parity": lookup_parity,
+          "accepted_tokens_per_step": round(accepted_per_step, 6),
+          "virtual_speedup": round(lookup_speedup, 6),
+          "spec_counters": {
+              k: spec_srv.metrics.counters[k]
+              for k in ("spec_steps", "spec_lane_steps",
+                        "spec_drafted", "spec_accepted",
+                        "spec_emitted", "spec_rollback_tokens")},
+          "baseline_virtual_s": round(base_srv.clock.now(), 6),
+          "spec_virtual_s": round(spec_srv.clock.now(), 6),
+          "deterministic": len(set(spec_digests)) == 1,
+          "event_digest": spec_digests[0]})
+
+    # ---------------- phase 2: spec-mixed fleet -------------------- #
+    def mixed_trace():
+        rng = np.random.default_rng([seed, 0x513D])
+        reqs = []
+        uid = 0
+        shared = [int(t) for t in rng.integers(1, 14, (20,))]
+        for i in range(10):          # chatty
+            reqs.append(Request(
+                uid=uid, prompt=[int(t) for t in
+                                 rng.integers(1, 14, (6,))],
+                max_new_tokens=6,
+                arrival_time=float(i) * 0.01))
+            uid += 1
+        for i in range(12):          # agent swarm: shared prefix
+            reqs.append(Request(
+                uid=uid, prompt=shared + [i % 7 + 1, i % 5 + 1],
+                max_new_tokens=10,
+                arrival_time=0.05 + 0.008 * i))
+            uid += 1
+        for i in range(4):           # long prompt, long decode
+            reqs.append(Request(
+                uid=uid, prompt=[int(t) for t in
+                                 rng.integers(1, 14, (40,))],
+                max_new_tokens=40,
+                arrival_time=0.02 + 0.03 * i))
+            uid += 1
+        return reqs
+
+    def run_fleet(speculation, prefix, trace_fn, n_replicas=3,
+                  prefix_weight=0.30):
+        fleet = ServingFleet(
+            engines=[make_engine(num_blocks=48, lanes=4, tracked=8)
+                     for _ in range(n_replicas)],
+            clock=VirtualClock(),
+            config=FleetConfig(
+                n_replicas=n_replicas,
+                server=ServerConfig(max_queue_depth=128,
+                                    kv_demand_fraction=float("inf"),
+                                    speculation=speculation),
+                router=RouterConfig(prefix_weight=prefix_weight),
+                prefix=prefix))
+        reqs = trace_fn()
+        fleet.run_trace(reqs)
+        return fleet, reqs, _spec_digest(fleet.event_log())
+
+    def fleet_invariants(tag, fleet, reqs):
+        terminal = {"DONE", "REJECTED", "FAILED"}
+        for r in reqs:
+            if r.state.name not in terminal:
+                violations.append(
+                    f"{tag}: request {r.uid} non-terminal")
+            holders = sum(1 for rep in fleet.replicas
+                          if r.uid in rep.scheduler.done)
+            holders += 1 if r.uid in fleet.done else 0
+            if holders != 1:
+                violations.append(
+                    f"{tag}: request {r.uid} terminal in "
+                    f"{holders} places")
+        for rep in fleet.replicas:
+            if rep.engine.state.free_blocks != \
+                    rep.initial_free_blocks:
+                violations.append(f"{tag}: replica {rep.id} leaked")
+            if rep.engine.state.n_tracked_sequences:
+                violations.append(
+                    f"{tag}: replica {rep.id} still tracking")
+        if not fleet.migration_balance_ok:
+            violations.append(f"{tag}: migration imbalance")
+
+    def p99(fleet, which):
+        vals = []
+        for rep in fleet.replicas:
+            hist = getattr(rep.server.metrics, which)
+            v = hist.percentile(99)
+            if v is not None:
+                vals.append(v)
+        return max(vals) if vals else None
+
+    prefix_cfg = PrefixReuseConfig(min_adopt_tokens=6,
+                                   min_broadcast_tokens=6)
+    base_fleet, base_mreqs, _ = run_fleet(None, None, mixed_trace)
+    mixed_runs = [run_fleet(SPEC, prefix_cfg, mixed_trace)
+                  for _ in range(max(1, runs))]
+    mix_fleet, mix_reqs, _ = mixed_runs[0]
+    mix_digests = [d for _, _, d in mixed_runs]
+    mixed_parity = ({r.uid: r.tokens_out for r in base_mreqs} ==
+                    {r.uid: r.tokens_out for r in mix_reqs})
+    if not mixed_parity:
+        violations.append("spec-mixed: stream parity broken")
+    fleet_invariants("spec-mixed", mix_fleet, mix_reqs)
+    mixed_row = {
+        "phase": "spec-mixed", "seed": seed,
+        "requests": len(mix_reqs),
+        "stream_parity": mixed_parity,
+        "deterministic": len(set(mix_digests)) == 1,
+        "event_digest": mix_digests[0],
+        "baseline_virtual_s": round(base_fleet.clock.now(), 6),
+        "spec_virtual_s": round(mix_fleet.clock.now(), 6),
+        "virtual_speedup": round(
+            base_fleet.clock.now() /
+            max(mix_fleet.clock.now(), 1e-12), 6),
+        "ttft_p99_baseline": p99(base_fleet, "ttft"),
+        "ttft_p99_spec": p99(mix_fleet, "ttft"),
+        "tpot_p99_baseline": p99(base_fleet, "tpot"),
+        "tpot_p99_spec": p99(mix_fleet, "tpot"),
+        "spec_lane_steps": sum(
+            rep.server.metrics.counters["spec_lane_steps"]
+            for rep in mix_fleet.replicas),
+        "prefix_adoptions": sum(
+            rep.server.metrics.counters["prefix_adoptions"]
+            for rep in mix_fleet.replicas),
+    }
+    emit(mixed_row)
+
+    # ---------------- phase 3: spec-prefix broadcast --------------- #
+    def conflict_trace():
+        """One sharer warms a replica; affinity-pinned long decodes
+        then saturate it, so later sharers route cold and the fleet
+        must broadcast the prefix once instead of re-prefilling it."""
+        shared = [(7 * j) % 13 + 1 for j in range(16)]
+        reqs = [Request(uid=0, prompt=shared + [9, 9],
+                        max_new_tokens=4, arrival_time=0.0)]
+        for i in range(1, 5):
+            reqs.append(Request(uid=i, prompt=shared + [i],
+                                max_new_tokens=60,
+                                arrival_time=0.03 + 0.001 * i))
+        for i in range(5, 14):
+            reqs.append(Request(uid=i, prompt=shared + [i % 7 + 1,
+                                                        i % 5 + 1],
+                                max_new_tokens=6,
+                                arrival_time=0.06 + 0.004 * i))
+        return reqs
+
+    def run_conflict(prefix):
+        return run_fleet(SPEC, prefix, conflict_trace, n_replicas=2,
+                         prefix_weight=0.05)
+
+    aff_fleet, aff_reqs, _ = run_conflict(None)
+    pfx_runs = [run_conflict(prefix_cfg) for _ in range(max(1, runs))]
+    pfx_fleet, pfx_reqs, _ = pfx_runs[0]
+    pfx_digests = [d for _, _, d in pfx_runs]
+    pfx_parity = ({r.uid: r.tokens_out for r in aff_reqs} ==
+                  {r.uid: r.tokens_out for r in pfx_reqs})
+    fleet_invariants("spec-prefix", pfx_fleet, pfx_reqs)
+    reused = sum(rep.server.metrics.counters["prefix_tokens_reused"]
+                 for rep in pfx_fleet.replicas)
+    aff_prefill = sum(rep.server.metrics.counters["prefill_tokens"]
+                      for rep in aff_fleet.replicas)
+    pfx_prefill = sum(rep.server.metrics.counters["prefill_tokens"]
+                      for rep in pfx_fleet.replicas)
+    savings = (aff_prefill - pfx_prefill) / max(aff_prefill, 1)
+    broadcasts = pfx_fleet.counters["prefix_broadcasts"]
+    landings = pfx_fleet.counters["prefix_broadcast_landings"]
+    failed_bc = pfx_fleet.counters["prefix_broadcast_failed"]
+    if not pfx_parity:
+        violations.append("spec-prefix: stream parity broken")
+    if broadcasts < 1:
+        violations.append("spec-prefix: no prefix broadcast fired")
+    if landings + failed_bc != broadcasts:
+        violations.append(
+            f"spec-prefix: broadcast imbalance ({broadcasts} sent, "
+            f"{landings} landed, {failed_bc} failed)")
+    if reused <= 0 or savings <= 0:
+        violations.append(
+            f"spec-prefix: no re-prefill savings (reused={reused}, "
+            f"savings={savings:.4f})")
+    emit({"phase": "spec-prefix", "seed": seed,
+          "requests": len(pfx_reqs),
+          "stream_parity": pfx_parity,
+          "deterministic": len(set(pfx_digests)) == 1,
+          "event_digest": pfx_digests[0],
+          "prefix_broadcasts": broadcasts,
+          "prefix_broadcast_landings": landings,
+          "prefix_broadcast_failed": failed_bc,
+          "prefix_adoptions": sum(
+              rep.server.metrics.counters["prefix_adoptions"]
+              for rep in pfx_fleet.replicas),
+          "prefix_tokens_reused": reused,
+          "affinity_prefill_tokens": aff_prefill,
+          "reuse_prefill_tokens": pfx_prefill,
+          "reprefill_savings": round(savings, 6),
+          "affinity_virtual_s": round(aff_fleet.clock.now(), 6),
+          "reuse_virtual_s": round(pfx_fleet.clock.now(), 6),
+          "router": {k: v for k, v
+                     in pfx_fleet.router.summary().items()
+                     if "prefix" in k or "reuse" in k}})
+
+    # ---------------- phase 4: SLO-aware ladder -------------------- #
+    def run_slo():
+        slo = SLOTracker(objectives=[
+            SLOObjective("ttft", target=0.95, threshold_s=1e-9,
+                         window_s=60.0)])
+        server = ServingServer(
+            make_engine(), clock=VirtualClock(),
+            metrics=ServingMetrics(slo=slo),
+            config=ServerConfig(
+                max_queue_depth=128,
+                kv_demand_fraction=float("inf"),
+                speculation=SPEC,
+                slo_mode=SLOModeConfig(ttft_burn_threshold=1.0,
+                                       tpot_burn_threshold=1e9,
+                                       hot_steps=2, calm_steps=1000,
+                                       chunked_prefill_tokens=4)))
+        rng = np.random.default_rng([seed, 0x510])
+        reqs = [Request(uid=i,
+                        prompt=[int(t) for t in
+                                rng.integers(1, 14, (10,))],
+                        max_new_tokens=12,
+                        arrival_time=0.002 * i) for i in range(24)]
+        server.run_trace(reqs)
+        return (server, reqs,
+                _spec_digest([list(e)
+                              for e in server.scheduler.events]))
+
+    slo_runs = [run_slo() for _ in range(max(1, runs))]
+    slo_srv, slo_reqs, _ = slo_runs[0]
+    slo_digests = [d for _, _, d in slo_runs]
+    slo_level = slo_srv.scheduler.slo.level
+    slo_degraded = slo_srv.metrics.counters["slo_degraded_steps"]
+    if slo_degraded <= 0 or slo_level < 1:
+        violations.append(
+            f"spec-slo: ladder never escalated (level={slo_level}, "
+            f"degraded_steps={slo_degraded})")
+    if any(not r.finished for r in slo_reqs):
+        violations.append("spec-slo: trace did not drain")
+    emit({"phase": "spec-slo", "seed": seed,
+          "requests": len(slo_reqs),
+          "final_level": int(slo_level),
+          "slo_degraded_steps": slo_degraded,
+          "shed": slo_srv.metrics.counters["shed"],
+          "rejected": dict(slo_srv.metrics.rejected),
+          "prefill_chunks":
+              slo_srv.metrics.counters["prefill_chunks"],
+          "deterministic": len(set(slo_digests)) == 1,
+          "event_digest": slo_digests[0]})
+
+    # ---------------- summary + self-compare ----------------------- #
+    deterministic = (len(set(spec_digests)) == 1 and
+                     len(set(mix_digests)) == 1 and
+                     len(set(pfx_digests)) == 1 and
+                     len(set(slo_digests)) == 1)
+    if not deterministic:
+        violations.append("determinism gate failed")
+    emit({"phase": "spec-serve-summary", "seed": seed,
+          "runs": max(1, runs),
+          "accepted_tokens_per_step": round(accepted_per_step, 6),
+          "lookup_virtual_speedup": round(lookup_speedup, 6),
+          "mixed_virtual_speedup": mixed_row["virtual_speedup"],
+          "reprefill_savings": round(savings, 6),
+          "prefix_broadcasts": broadcasts,
+          "prefix_tokens_reused": reused,
+          "stream_parity": bool(lookup_parity and mixed_parity and
+                                pfx_parity),
+          "deterministic": deterministic,
+          "slo_final_level": int(slo_level),
+          "invariants_ok": not violations,
+          "violations": violations})
+
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "SPEC_SERVE.jsonl", results))
+    if fh is not None:
+        fh.close()
+    if violations:
+        raise RuntimeError(f"spec-serve gates failed: {violations}")
+    return results
+
+
 def run_request_trace(seed=0, runs=2, out="REQUEST_TRACE.jsonl",
                       closure_tol=0.01):
     """Causal request-tracing audit (``bench.py --request-trace``):

@@ -904,9 +904,14 @@ class InferenceEngineV2:
         Greedy-exact like both. ``window`` caps the on-device n-gram
         search to each lane's most recent tokens (static shape).
 
-        Returns ``(outs, stats)`` like :meth:`generate_lookup`
-        (``drafted`` is the upper bound iters*max_draft — per-lane
-        draft counts don't leave the device)."""
+        Returns ``(outs, stats)`` like :meth:`generate_lookup`, plus
+        per-lane attribution: ``accepted_per_lane`` / ``drafted_per_
+        lane`` ride the loop carry as [B] counters, so a serving layer
+        can attribute acceptance per request instead of
+        batch-averaging (``drafted`` remains the per-lane upper bound
+        ``lane_iters*max_draft``, now summed over actual live
+        iterations instead of ``iters*max_draft`` for the whole
+        batch)."""
         if self.prefix_caching:
             raise ValueError(
                 "generate_lookup_fused with prefix_caching is "
@@ -945,7 +950,9 @@ class InferenceEngineV2:
                     eos_token_id is not None
                     and all(t == eos_token_id for t in first)):
                 return outs, {"drafted": 0, "accepted": 0,
-                              "dispatches": 0, "tokens": n}
+                              "dispatches": 0, "tokens": n,
+                              "accepted_per_lane": [0] * n,
+                              "drafted_per_lane": [0] * n}
             B = _bucket(n)
             first_tok, pos, t_blank, tables = self._blank_lanes(B)
             del t_blank
@@ -967,7 +974,7 @@ class InferenceEngineV2:
                 live[j] = not (eos_token_id is not None
                                and first[j] == eos_token_id)
             tables[:n] = self._tables(list(range(n)), uids)
-            out_buf, out_len, iters, accepted = \
+            out_buf, out_len, iters, accepted, lane_iters = \
                 self.model.lookup_decode_loop(
                     self.cache, first_tok[:, 0], pos, tables, live,
                     hist, hist_len, max_new=max_new_tokens - 1,
@@ -975,15 +982,113 @@ class InferenceEngineV2:
                     eos_token_id=eos_token_id)
             for j in range(n):
                 outs[j].extend(int(t) for t in out_buf[j, :out_len[j]])
-            stats = {"drafted": int(iters) * max_draft,
-                     "accepted": int(accepted),
+            drafted_per_lane = [int(lane_iters[j]) * max_draft
+                                for j in range(n)]
+            stats = {"drafted": sum(drafted_per_lane),
+                     "accepted": int(accepted[:n].sum()),
                      "dispatches": int(iters),
-                     "tokens": n + int(out_len[:n].sum())}
+                     "tokens": n + int(out_len[:n].sum()),
+                     "accepted_per_lane": [int(accepted[j])
+                                           for j in range(n)],
+                     "drafted_per_lane": drafted_per_lane}
         finally:
             for uid in uids:
                 if self.state.get_sequence(uid) is not None:
                     self.flush(uid)
         return [o[:max_new_tokens] for o in outs], stats
+
+    # -------------------------------------------------------------- #
+    # fused speculative verify step (the serving speculation surface)
+    # -------------------------------------------------------------- #
+    #: ``put_spec`` does not capture latents (the tail forward has no
+    #: capture path) — the serving scheduler only speculates against
+    #: this engine in exact-KV suspension mode
+    spec_latent_capture = False
+
+    @_annotated("hds.serve.put_spec")
+    def put_spec(self, batch_uids: Iterable[int], batch_feeds,
+                 do_checks: bool = True):
+        """One fused speculative verify step over tracked decode
+        residents: each feed is ``[fed_token] + draft``; ONE tail-
+        logits dispatch (``model.forward_chunk_tail``, the same
+        verification forward :meth:`generate_lookup` drives) verifies
+        every stretch, the matching draft prefix plus the bonus token
+        is accepted, and rejected draft KV rolls back
+        (``SequenceDescriptor.rollback``). Greedy-exact per lane.
+
+        Returns ``(emitted, latents)`` with ``latents`` all None:
+        speculation on this engine requires
+        ``hcache.enable_latents=false`` (the rolled-back tail must
+        never reach a latent payload, and the tail forward has no
+        capture path) and ``prefix_caching=false`` (rolled-back KV
+        must never register as a sharable prefix) — the serving
+        scheduler suspends speculative residents in exact-KV mode."""
+        if self.config.hcache.enable_latents:
+            raise RuntimeError(
+                "put_spec does not capture latents; disable "
+                "hcache.enable_latents (exact-KV suspension) to "
+                "speculate on this engine")
+        if self.prefix_caching:
+            raise RuntimeError(
+                "put_spec with prefix_caching is unsupported: "
+                "rolled-back draft KV must never be registered as a "
+                "sharable prefix")
+        batch_uids = list(batch_uids)
+        batch_feeds = [list(np.asarray(f, np.int32).reshape(-1))
+                       for f in batch_feeds]
+        if any(len(f) < 1 for f in batch_feeds):
+            raise ValueError("put_spec feeds need >= 1 token "
+                             "(the fed token)")
+        if do_checks:
+            result = self.can_schedule(
+                batch_uids, [len(f) for f in batch_feeds])
+            if result != SchedulingResult.Success:
+                raise SchedulingError(result)
+        self._reject_suspended(batch_uids)
+        for uid in batch_uids:
+            if self.state.get_sequence(uid) is None:
+                raise KeyError(
+                    f"put_spec: unknown sequence {uid} (speculation "
+                    "runs on decode residents only)")
+        inj = get_injector()
+        if inj.enabled and batch_uids:
+            inj.fire("engine.spec", uid=batch_uids[-1],
+                     uids=tuple(batch_uids))
+        n = len(batch_uids)
+        T = max(len(f) for f in batch_feeds)
+        B = _bucket(n)
+        tok, start, t_len, tables = self._blank_lanes(B, T)
+        starts = []
+        for j, (uid, feed) in enumerate(zip(batch_uids, batch_feeds)):
+            seq = self.state.get_sequence(uid)
+            self.state.maybe_allocate_kv(seq, len(feed))
+            starts.append(seq.seen_tokens)
+            seq.pre_forward(len(feed))
+            tok[j, :len(feed)] = feed
+            start[j] = starts[j]
+            t_len[j] = len(feed)
+        tables[:n] = self._tables(list(range(n)), batch_uids)
+        with get_tracer().span("serve.spec_dispatch", lanes=n,
+                               tokens=int(sum(len(f)
+                                              for f in batch_feeds))):
+            tail_logits = np.asarray(self.model.forward_chunk_tail(
+                self.cache, tok, start, tables, t_len, T))
+        emitted_out: List[List[int]] = []
+        for j, (uid, feed) in enumerate(zip(batch_uids, batch_feeds)):
+            seq = self.state.get_sequence(uid)
+            seq.post_forward()
+            d = len(feed) - 1
+            # logits for the last t_len positions sit at the END of
+            # the tail window (the forward_chunk_tail contract)
+            lane = tail_logits[j, T - len(feed):]
+            greedy = [int(np.argmax(lane[t]))
+                      for t in range(len(feed))]
+            acc = 0
+            while acc < d and feed[1 + acc] == greedy[acc]:
+                acc += 1
+            seq.rollback(d - acc)        # rejected draft KV
+            emitted_out.append(greedy[:acc + 1])
+        return emitted_out, [None] * n
 
     # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)

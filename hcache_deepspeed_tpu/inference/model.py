@@ -893,7 +893,11 @@ class PagedInferenceModel:
         recent tokens; hist_len0: [B] valid counts. eos_id traced;
         max_new/ngram/max_draft/window/has_eos static. Returns
         (cache_k', cache_v', outs [B, max_new], out_len [B], iters,
-        accepted_total)."""
+        accepted [B], lane_iters [B]) — accepted and lane_iters ride
+        the loop carry PER LANE, so serving can attribute acceptance
+        per request instead of batch-averaging (the old scalar total
+        is their sum; the old ``drafted`` upper bound is
+        ``lane_iters * max_draft`` per lane)."""
         B = first_tok.shape[0]
         T = 1 + max_draft
         W = window
@@ -932,7 +936,7 @@ class PagedInferenceModel:
 
         def body(st):
             (i, ck, cv, last_tok, pos, hist, hist_len, done, outs,
-             out_len, accepted) = st
+             out_len, accepted, lane_iters) = st
             d = draft(hist, hist_len, last_tok)              # [B, k]
             toks = jnp.concatenate([last_tok[:, None], d], axis=1)
             t_step = jnp.where(done, 0, T)
@@ -978,27 +982,33 @@ class PagedInferenceModel:
             pos = pos + jnp.where(done, 0, c)
             last_tok = jnp.take_along_axis(
                 outs, jnp.maximum(out_len - 1, 0)[:, None], axis=1)[:, 0]
-            accepted = accepted + jnp.sum(
-                jnp.where(done, 0, jnp.maximum(c - 1, 0)))
+            # per-lane carries: accepted drafts and live iterations —
+            # the serving attribution the batch-scalar version lost
+            accepted = accepted + jnp.where(done, 0,
+                                            jnp.maximum(c - 1, 0))
+            lane_iters = lane_iters + jnp.where(done, 0, 1)
             return (i + 1, ck, cv, last_tok, pos, hist, hist_len,
-                    new_done, outs, out_len, accepted)
+                    new_done, outs, out_len, accepted, lane_iters)
 
         st = (jnp.int32(0), cache_k, cache_v, first_tok, pos0, hist0,
               hist_len0, done0, outs0, jnp.zeros((B,), jnp.int32),
-              jnp.int32(0))
+              jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32))
         st = jax.lax.while_loop(cond, body, st)
         (iters, cache_k, cache_v, _, _, _, _, _, outs, out_len,
-         accepted) = st
+         accepted, lane_iters) = st
         return cache_k, cache_v, outs[:, :max_new], out_len, iters, \
-            accepted
+            accepted, lane_iters
 
     def lookup_decode_loop(self, cache, first_tok, pos, tables, live,
                            hist, hist_len, *, max_new, ngram, max_draft,
                            window, eos_token_id=None):
-        """Public fused speculative decoder (see _lookup_decode_loop)."""
+        """Public fused speculative decoder (see _lookup_decode_loop).
+        Returns ``(outs, out_len, iters, accepted, lane_iters)`` with
+        ``accepted`` and ``lane_iters`` PER LANE ([B] int arrays)."""
         has_eos = eos_token_id is not None
         eos = jnp.int32(eos_token_id if has_eos else -1)
-        ck, cv, outs, out_len, iters, accepted = self._lookup_loop_jit(
+        (ck, cv, outs, out_len, iters, accepted,
+         lane_iters) = self._lookup_loop_jit(
             self.params, cache.k, cache.v,
             jnp.asarray(first_tok, jnp.int32),
             jnp.asarray(pos, jnp.int32),
@@ -1009,7 +1019,7 @@ class PagedInferenceModel:
             eos, max_new, ngram, max_draft, window, has_eos)
         cache.replace(ck, cv)
         return (np.asarray(outs), np.asarray(out_len), int(iters),
-                int(accepted))
+                np.asarray(accepted), np.asarray(lane_iters))
 
     def decode_loop(self, cache, tokens, start, t_len, tables, n_steps,
                     temperature=0.0, top_k=0, top_p=1.0, seed=0,

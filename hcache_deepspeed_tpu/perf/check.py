@@ -117,6 +117,17 @@ TOLERANCES: Dict[str, Tolerance] = {
     "fleet.span_counter_agreement": Tolerance("higher", rel=0.0),
     "fleet.migration_overlap_ratio": Tolerance("higher", rel=0.25),
     "fleet.violations": Tolerance("lower", rel=0.0),
+    # speculative serving + prefix reuse gates (CPU-deterministic:
+    # booleans are hard gates; the two headline ratios tolerate trace
+    # evolution like the other serving families)
+    "spec.accepted_tokens_per_step": Tolerance("higher", rel=0.25),
+    "spec.prefix_reprefill_savings": Tolerance("higher", rel=0.25),
+    "spec.lookup_virtual_speedup": Tolerance("higher", rel=0.25),
+    "spec.mixed_virtual_speedup": Tolerance("higher", rel=0.25),
+    "spec.stream_parity": Tolerance("higher", rel=0.0),
+    "spec.deterministic": Tolerance("higher", rel=0.0),
+    "spec.invariants_ok": Tolerance("higher", rel=0.0),
+    "spec.violations": Tolerance("lower", rel=0.0),
     # disaggregated serving gates (CPU-deterministic; booleans are
     # hard gates, the ratios tolerate scheduler-policy evolution)
     "disagg.deterministic": Tolerance("higher", rel=0.0),

@@ -704,6 +704,59 @@ def parse_restore_bench(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_spec_serve(text: str, file: str) -> List[MetricPoint]:
+    """SPEC_SERVE.jsonl: scheduler-dispatched speculative decode +
+    fleet-wide radix prefix reuse with latent prefix broadcast
+    (``bench.py --spec-serve``). The summary row carries the headline
+    gates; the phase rows carry their own verdicts as trajectory."""
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+
+    def flag(metric, row, key, phase):
+        if key in row:
+            pts.append(MetricPoint(metric,
+                                   1.0 if row[key] else 0.0, file,
+                                   phase=phase))
+
+    for row in rows:
+        phase = row.get("phase", "")
+        if phase == "spec-serve-summary":
+            for key, metric in (
+                    ("accepted_tokens_per_step",
+                     "spec.accepted_tokens_per_step"),
+                    ("reprefill_savings",
+                     "spec.prefix_reprefill_savings"),
+                    ("lookup_virtual_speedup",
+                     "spec.lookup_virtual_speedup"),
+                    ("mixed_virtual_speedup",
+                     "spec.mixed_virtual_speedup"),
+                    ("prefix_broadcasts", "spec.prefix_broadcasts"),
+                    ("prefix_tokens_reused",
+                     "spec.prefix_tokens_reused")):
+                if isinstance(row.get(key), (int, float)):
+                    pts.append(MetricPoint(metric, float(row[key]),
+                                           file, phase=phase))
+            flag("spec.stream_parity", row, "stream_parity", phase)
+            flag("spec.deterministic", row, "deterministic", phase)
+            flag("spec.invariants_ok", row, "invariants_ok", phase)
+            pts.append(MetricPoint(
+                "spec.violations",
+                float(len(row.get("violations", []))), file,
+                phase=phase))
+        elif phase == "spec-lookup":
+            flag("spec.lookup_stream_parity", row, "stream_parity",
+                 phase)
+        elif phase == "spec-prefix":
+            flag("spec.prefix_stream_parity", row, "stream_parity",
+                 phase)
+        elif phase == "spec-slo":
+            if isinstance(row.get("final_level"), (int, float)):
+                pts.append(MetricPoint(
+                    "spec.slo_final_level", float(row["final_level"]),
+                    file, phase=phase))
+    return pts
+
+
 def parse_paged_vet(text: str, file: str) -> List[MetricPoint]:
     rows = read_jsonl_rows(text)
     pts = []
@@ -843,6 +896,12 @@ FAMILIES: List[ArtifactFamily] = [
         "equal-replica colocated baseline (decode-tail win, stream "
         "parity, span-derived handoff overlap, int8 latent wire, "
         "chunked prefill, tier chaos, determinism gates)"),
+    ArtifactFamily(
+        "spec-serve", r"^SPEC_SERVE\.jsonl$", parse_spec_serve,
+        "scheduler-dispatched speculative decode + fleet-wide radix "
+        "prefix reuse with latent prefix broadcast (accepted-tokens/"
+        "step, re-prefill savings, stream parity, SLO-aware ladder, "
+        "determinism gates)"),
     ArtifactFamily(
         "request-trace", r"^REQUEST_TRACE\.jsonl$",
         parse_request_trace,

@@ -17,6 +17,14 @@ planning priced by the crossover's per-link transfer term) and
 ``fleet.py`` (N replicas sharing one clock, cross-replica migration
 with HCache latents as the transfer payload, replica failure domains:
 crash/hang/partition, graceful drain, crash recovery).
+
+Two newer layers ride the same machinery: ``spec.py`` (scheduler-
+dispatched fused speculative decoding — host-side prompt-lookup
+drafting, the engine's ``put_spec`` verify step with per-lane KV
+rollback, and the SLO-aware degradation mode driven by TTFT/TPOT
+burn) and ``prefix_tree.py`` (the fleet-shared radix prefix tree over
+full token-id paths, per-replica warm-prefix caches, and the latent
+prefix-broadcast primitive the router prices through ``crossover.py``).
 """
 
 from .clock import MonotonicClock, VirtualClock  # noqa: F401
@@ -28,6 +36,9 @@ from .fleet import (FleetConfig, FleetReplica,  # noqa: F401
                     Migration, ReplicaRole, ReplicaState,
                     ServingFleet)
 from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .prefix_tree import (PrefixReuseConfig,  # noqa: F401
+                          RadixPrefixTree, ReplicaPrefixCache,
+                          validate_prefix_reuse_config)
 from .request import Request, RequestState  # noqa: F401
 from .router import (FleetRouter, ReplicaSnapshot,  # noqa: F401
                      RouterConfig)
@@ -35,3 +46,7 @@ from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         StepReport)
 from .server import ServerConfig, ServingServer  # noqa: F401
 from .sim import SimulatedEngine  # noqa: F401
+from .spec import (SLODegradation, SLOModeConfig,  # noqa: F401
+                   SpeculationConfig, lookup_draft,
+                   validate_slo_mode_config,
+                   validate_speculation_config)

@@ -257,6 +257,47 @@ class RestoreCrossoverModel:
             return "migrate"
         return "stay"
 
+    # ------------------------------------------------------------- #
+    # latent prefix broadcast (broadcast+restore vs re-prefill)
+    # ------------------------------------------------------------- #
+    def prefix_broadcast_cost_s(self, tokens: int,
+                                dst_occupancy: float,
+                                link_bytes_per_s: float) -> float:
+        """Price shipping a ``tokens``-long warm prefix over the
+        inter-replica latent wire and restoring it on the cold
+        replica: the same transfer + destination-restore form as a
+        migration — the HCache restore path used as a prefix-broadcast
+        primitive."""
+        return self.migrate_cost_s(tokens, dst_occupancy,
+                                   link_bytes_per_s)
+
+    def reprefill_cost_s(self, tokens: int,
+                         occupancy: float = 0.0) -> float:
+        """Price re-prefilling the same prefix from scratch on the
+        cold replica (what every shared-prefix request pays without
+        reuse) — the recompute form at the destination's occupancy."""
+        return self.recompute_cost_s(tokens, occupancy)
+
+    def decide_prefix_broadcast(self, tokens: int,
+                                dst_occupancy: float,
+                                link_bytes_per_s: float) -> str:
+        """``"broadcast"`` or ``"reprefill"`` — ship the prefix once
+        iff wire + destination restore beats one re-prefill of the
+        prefix (with the migration hysteresis margin; the broadcast
+        amortizes over every future sharer, so beating a SINGLE
+        re-prefill is the conservative floor). Uncalibrated ⇒
+        ``"broadcast"`` — the caller only asks after a warm hit, and
+        refusing on an uncalibrated model would disable reuse exactly
+        when no telemetry exists yet."""
+        if not self.calibrated:
+            return "broadcast"
+        ship = self.prefix_broadcast_cost_s(tokens, dst_occupancy,
+                                            link_bytes_per_s)
+        if ship * self.config.migrate_hysteresis <= \
+                self.reprefill_cost_s(tokens, dst_occupancy):
+            return "broadcast"
+        return "reprefill"
+
     def decide(self, tokens: int, occupancy: float = 0.0) -> str:
         """``"restore"`` or ``"recompute"`` — whichever the model
         prices cheaper for a ``tokens``-long cached prefix at the

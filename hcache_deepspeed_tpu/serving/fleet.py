@@ -66,6 +66,9 @@ from ..telemetry.flight import get_flight_recorder
 from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock, VirtualClock
 from .crossover import RestoreCrossoverModel
+from .prefix_tree import (PrefixReuseConfig, RadixPrefixTree,
+                          ReplicaPrefixCache,
+                          validate_prefix_reuse_config)
 from .request import Request, RequestState
 from .router import FleetRouter, ReplicaSnapshot, RouterConfig
 from .server import ServerConfig, ServingServer
@@ -138,23 +141,40 @@ class FleetConfig:
     probe_every: int = 1
     #: thread mode: pump-thread cadence (seconds)
     pump_interval_s: float = 0.005
+    #: fleet-wide prefix reuse (a :class:`~.prefix_tree.
+    #: PrefixReuseConfig`): a shared radix tree over full token-id
+    #: paths, per-replica warm-prefix caches, route-to-reuse, and
+    #: latent prefix broadcast when affinity and load conflict.
+    #: None = the affinity-only fleet (committed digests replay).
+    prefix: Optional[PrefixReuseConfig] = None
 
 
 @dataclass
 class Migration:
-    """One cross-replica move, from eviction to its terminal mode."""
+    """One cross-replica move, from eviction to its terminal mode.
+
+    ``reason == "prefix_broadcast"`` is the requestless variant: the
+    wire carries a warm-prefix latent payload (``prefix_tokens`` +
+    ``payload``) instead of an evicted request — the HCache restore
+    path used as a prefix-broadcast primitive. It lands by installing
+    the payload into the destination replica's prefix cache (terminal
+    mode ``"installed"``) and never counts as an eviction."""
     uid: int
     src: int
     dst: int                   # -1 until (re)routed at landing
     nbytes: int
     tokens: int
-    reason: str                # "rebalance" | "drain" | "crash"
+    reason: str                # "rebalance" | "drain" | "crash" |
+    #                            "handoff" | "prefix_broadcast"
     depart_t: float
     land_t: float
     #: terminal mode: "restore" | "recompute" | "expired" |
-    #: "cancelled" | "failed"; "" while in transit
+    #: "cancelled" | "failed" | "installed"; "" while in transit
     mode: str = ""
     request: Optional[Request] = None
+    #: prefix-broadcast payload: the token path and its latent slab
+    prefix_tokens: Optional[Tuple[int, ...]] = None
+    payload: Optional[object] = None
     #: serialized TraceContext snapshot taken at departure — the
     #: context-propagation half of the wire payload. The landing pass
     #: rehydrates it, so the live path continuously exercises the
@@ -178,13 +198,15 @@ class FleetReplica:
                  config: FleetConfig,
                  resilience: Optional[ResiliencePolicy] = None,
                  sample_fn=None,
-                 role: ReplicaRole = ReplicaRole.COLOCATED):
+                 role: ReplicaRole = ReplicaRole.COLOCATED,
+                 prefix_cache: Optional[ReplicaPrefixCache] = None):
         self.id = replica_id
         self.role = role
+        self.prefix_cache = prefix_cache
         self.server = ServingServer(
             engine, config=config.server, clock=clock,
             resilience=resilience, sample_fn=sample_fn,
-            replica_id=replica_id)
+            replica_id=replica_id, prefix_cache=prefix_cache)
         self.state = ReplicaState.UP
         self.prev_state = ReplicaState.UP
         self.initial_free_blocks = engine.state.free_blocks
@@ -253,10 +275,33 @@ class ServingFleet:
         if len(roles) != len(engines):
             raise ValueError(
                 f"{len(roles)} roles for {len(engines)} replicas")
+        #: fleet-wide prefix reuse: ONE shared radix tree (full
+        #: token-id paths; route-to-reuse + broadcast planning read
+        #: it) + one warm-prefix payload cache per replica
+        self.prefix_tree: Optional[RadixPrefixTree] = None
+        prefix_caches: List[Optional[ReplicaPrefixCache]] = \
+            [None] * len(engines)
+        if self.config.prefix is not None and \
+                self.config.prefix.enabled:
+            validate_prefix_reuse_config(self.config.prefix,
+                                         in_fleet=True)
+            self.prefix_tree = RadixPrefixTree(
+                max_paths=self.config.prefix.max_paths)
+            prefix_caches = [
+                ReplicaPrefixCache(self.config.prefix,
+                                   tree=self.prefix_tree,
+                                   replica_id=i, in_fleet=True)
+                for i in range(len(engines))]
+            # the router consults the same tree for reuse decisions
+            self.config.router.prefix_reuse = True
+            if self.config.router.broadcast_min_tokens < \
+                    self.config.prefix.min_broadcast_tokens:
+                self.config.router.broadcast_min_tokens = \
+                    self.config.prefix.min_broadcast_tokens
         self.replicas = [
             FleetReplica(i, eng, self.clock, self.config,
                          resilience=resilience, sample_fn=sample_fn,
-                         role=roles[i])
+                         role=roles[i], prefix_cache=prefix_caches[i])
             for i, eng in enumerate(engines)]
         crossover = None
         if getattr(engines[0].config.hcache, "enable_latents", False) \
@@ -269,7 +314,8 @@ class ServingFleet:
         self.crossover = crossover
         self.router = FleetRouter(
             self.config.router, crossover=crossover,
-            link_bytes_per_s=self.config.link_bytes_per_s)
+            link_bytes_per_s=self.config.link_bytes_per_s,
+            prefix_tree=self.prefix_tree)
         self._lock = make_lock("ServingFleet._lock")
         #: not-yet-placed requests (unroutable ones wait here)
         self.pending: List[Request] = []
@@ -294,6 +340,11 @@ class ServingFleet:
             # role-less fleet never moves them off zero)
             "handoffs": 0, "handoff_landings": 0,
             "handoff_recomputes": 0, "colocated_decodes": 0,
+            # latent prefix broadcast (prefix-reuse fleets only; NOT
+            # counted as evictions — the wire carries a payload copy,
+            # no request leaves anywhere)
+            "prefix_broadcasts": 0, "prefix_broadcast_landings": 0,
+            "prefix_broadcast_failed": 0,
         }
         #: migration/decode overlap accounting: fleet steps with >=1
         #: migration in flight, and the subset where some replica also
@@ -344,7 +395,7 @@ class ServingFleet:
                     req.cancelled = True
                     return
             for m in self.in_transit:
-                if m.uid == uid:
+                if m.uid == uid and m.request is not None:
                     m.request.cancelled = True
                     return
         for r in self.replicas:
@@ -358,7 +409,7 @@ class ServingFleet:
                 if req.uid == uid:
                     return req
             for m in self.in_transit:
-                if m.uid == uid:
+                if m.uid == uid and m.request is not None:
                     return m.request
         for r in self.replicas:
             req = r.scheduler.request(uid)
@@ -401,7 +452,14 @@ class ServingFleet:
         terminal = (c["landings"] + c["recompute_landings"] +
                     c["expired_in_transit"] +
                     c["cancelled_in_transit"] + c["failed_in_transit"])
-        return c["evictions"] == terminal + len(self.in_transit)
+        # prefix broadcasts ride the same wire but carry no request —
+        # subtract the ones still in flight (counter arithmetic, so
+        # this stays a lock-free atomic-len read like before)
+        bc_in_flight = (c["prefix_broadcasts"] -
+                        c["prefix_broadcast_landings"] -
+                        c["prefix_broadcast_failed"])
+        carrying = len(self.in_transit) - bc_in_flight
+        return c["evictions"] == terminal + carrying
 
     @property
     def migration_overlap_ratio(self) -> float:
@@ -472,6 +530,11 @@ class ServingFleet:
         self.counters["replica_crashes"] += 1
         self._event("replica_crash", -1,
                     f"replica={r.id} hit={getattr(fault, 'hit', 0)}")
+        if r.prefix_cache is not None:
+            # its warm prefixes died with it: drop the payloads and
+            # un-mark the shared tree so nobody routes-to-reuse (or
+            # broadcasts from) a dead cache
+            r.prefix_cache.drop_all()
         with self._locked(r):
             r.server.error = fault
             ingress = list(r.server._ingress)
@@ -613,8 +676,11 @@ class ServingFleet:
         get their own ``fleet.handoff`` lane in the exported trace so
         the tier transport is span-attributable apart from rebalance/
         crash traffic."""
-        return "fleet.handoff" if reason == "handoff" \
-            else "fleet.migrate"
+        if reason == "handoff":
+            return "fleet.handoff"
+        if reason == "prefix_broadcast":
+            return "fleet.prefix_broadcast"
+        return "fleet.migrate"
 
     def _begin_migration(self, req: Request, src: int, dst: int,
                          reason: str,
@@ -666,6 +732,75 @@ class ServingFleet:
         get_tracer().async_end(self._migration_span(m.reason), m.uid,
                                cat="fleet", mode=mode, dst=m.dst)
 
+    def _begin_prefix_broadcast(self, req: Request, src: int,
+                                dst: int, tokens: int) -> None:
+        """Ship the warm prefix ``req`` shares with ``src`` over the
+        latent wire to ``dst`` — once: the payload is copied out of
+        the source cache at departure, so the broadcast survives any
+        later fate of the source replica. Never counted as an
+        eviction (nothing leaves anywhere); the balance invariant is
+        scoped to request-carrying migrations."""
+        src_cache = self.replicas[src].prefix_cache
+        if src_cache is None:
+            return
+        payload = src_cache.payload_for(req.prompt, tokens)
+        if payload is None:
+            return             # evicted between planning and ship
+        path = tuple(int(t) for t in req.prompt[:tokens])
+        now = self.clock.now()
+        nbytes = int(payload.nbytes)
+        transfer_s = self.config.migration_overhead_s
+        if self.config.link_bytes_per_s > 0:
+            transfer_s += nbytes / self.config.link_bytes_per_s
+        m = Migration(uid=req.uid, src=src, dst=dst, nbytes=nbytes,
+                      tokens=tokens, reason="prefix_broadcast",
+                      depart_t=now, land_t=now + transfer_s,
+                      request=None, prefix_tokens=path,
+                      payload=payload.copy())
+        self.in_transit.append(m)
+        self.migrations.append(m)
+        self.counters["prefix_broadcasts"] += 1
+        self._event("prefix_broadcast_depart", req.uid,
+                    f"src={src} dst={dst} tokens={tokens} "
+                    f"bytes={nbytes}")
+        get_tracer().async_begin("fleet.prefix_broadcast", req.uid,
+                                 cat="fleet", src=src, dst=dst,
+                                 tokens=tokens, bytes=nbytes,
+                                 uid=req.uid)
+
+    def _finish_prefix_broadcast(self, m: Migration,
+                                 mode: str) -> None:
+        m.mode = mode
+        get_tracer().async_end("fleet.prefix_broadcast", m.uid,
+                               cat="fleet", mode=mode, dst=m.dst,
+                               uid=m.uid)
+
+    def _land_prefix_broadcast(self, m: Migration, now: float,
+                               routable) -> bool:
+        """Terminal handling of a landed prefix broadcast. Returns
+        False when the payload must keep waiting (destination exists
+        but is temporarily unroutable)."""
+        dst = self.replicas[m.dst] if 0 <= m.dst < len(self.replicas) \
+            else None
+        if dst is None or dst.state in (ReplicaState.DEAD,
+                                        ReplicaState.STOPPED):
+            self.counters["prefix_broadcast_failed"] += 1
+            self._finish_prefix_broadcast(m, "failed")
+            self._event("prefix_broadcast_fail", m.uid,
+                        f"dst={m.dst}")
+            return True
+        if m.dst not in routable:
+            return False          # wait for the breaker to re-admit
+        if dst.prefix_cache is not None:
+            with self._locked(dst):
+                dst.prefix_cache.install(m.prefix_tokens, m.payload,
+                                         stamp=self.step_idx)
+        self.counters["prefix_broadcast_landings"] += 1
+        self._finish_prefix_broadcast(m, "installed")
+        self._event("prefix_broadcast_land", m.uid,
+                    f"dst={m.dst} tokens={m.tokens}")
+        return True
+
     def _transit_pass(self, now: float, routable) -> None:
         if not self.in_transit:
             return
@@ -673,6 +808,13 @@ class ServingFleet:
         for m in sorted(self.in_transit,
                         key=lambda m: (m.land_t, m.uid)):
             req = m.request
+            if req is None:
+                # requestless prefix broadcast: only landing applies
+                if now < m.land_t or \
+                        not self._land_prefix_broadcast(m, now,
+                                                        routable):
+                    survivors.append(m)
+                continue
             if req.cancelled:
                 self.counters["cancelled_in_transit"] += 1
                 self._finish_migration(m, "cancelled")
@@ -774,6 +916,29 @@ class ServingFleet:
             self.pending.remove(req)
             req.replica = dst
             self._event("route", req.uid, f"dst={dst}")
+            if self.prefix_tree is not None:
+                # affinity lost to load? ship the warm prefix once
+                # over the latent wire instead of re-prefilling it on
+                # the cold replica (and on every later sharer there)
+                plan = self.router.plan_prefix_broadcast(req, dst,
+                                                         snaps)
+                if plan is not None:
+                    src, tokens = plan
+                    path = tuple(int(t)
+                                 for t in req.prompt[:tokens])
+                    # ship ONCE: a matching payload already on the
+                    # wire to this destination covers every sharer
+                    # landing behind it
+                    dup = any(
+                        m.reason == "prefix_broadcast" and
+                        m.dst == dst and m.prefix_tokens is not None
+                        and (m.prefix_tokens[:tokens] == path or
+                             path[:len(m.prefix_tokens)] ==
+                             m.prefix_tokens)
+                        for m in self.in_transit)
+                    if not dup:
+                        self._begin_prefix_broadcast(req, src, dst,
+                                                     tokens)
             self.replicas[dst].server.submit(request=req)
 
     def _rebalance_pass(self, routable) -> None:
@@ -869,6 +1034,11 @@ class ServingFleet:
             if r.live_requests == 0:
                 r.state = ReplicaState.STOPPED
                 self.counters["drains_completed"] += 1
+                if r.prefix_cache is not None:
+                    # a stopped replica serves nothing: un-mark the
+                    # shared tree (the payloads stay with the stopped
+                    # cache, pool intact, but are unreachable)
+                    r.prefix_cache.drop_all()
                 self._event("drain_complete", -1,
                             f"replica={r.id} "
                             f"free={r.engine.state.free_blocks}")
@@ -915,9 +1085,13 @@ class ServingFleet:
                 r.steps += 1
                 r.last_report = report
                 reports[r.id] = report
-                decode_lanes += report.decode_lanes
+                # speculative lanes are decode compute too (transits
+                # hide under them just the same); zero with spec off,
+                # so committed digests replay
+                lanes = report.decode_lanes + report.spec_lanes
+                decode_lanes += lanes
                 if r.role in _DECODE_ROLES:
-                    decode_tier_lanes += report.decode_lanes
+                    decode_tier_lanes += lanes
                 r.occupancy_sum += r.scheduler._occupancy()
                 r.kv_util_peak = max(r.kv_util_peak,
                                      r.kv_utilization)

@@ -136,6 +136,24 @@ class ServingMetrics:
                          # (the head-of-line blocking it removes)
                          "prefill_chunk_steps": 0,
                          "prefill_chunks": 0,
+                         # prompt tokens dispatched through prefill
+                         # (the re-prefill savings baseline prefix
+                         # reuse is measured against)
+                         "prefill_tokens": 0,
+                         # speculative-decode accounting (fused
+                         # multi-token steps): lane-steps dispatched
+                         # through put_spec, draft/accept/emit token
+                         # totals, rejected-KV rollbacks
+                         "spec_steps": 0, "spec_lane_steps": 0,
+                         "spec_drafted": 0, "spec_accepted": 0,
+                         "spec_emitted": 0, "spec_rollback_tokens": 0,
+                         # fleet-wide prefix reuse: admissions that
+                         # adopted a warm prefix via the restore path
+                         # and the prompt tokens never re-prefilled
+                         "prefix_adoptions": 0,
+                         "prefix_tokens_reused": 0,
+                         # SLO-aware degradation mode
+                         "slo_degraded_steps": 0,
                          "steps": 0, "idle_steps": 0,
                          # resilience counters (chaos harness asserts
                          # these against the scheduler's own totals)
@@ -165,7 +183,13 @@ class ServingMetrics:
         self.gauges = {"batch_occupancy": 0.0, "kv_utilization": 0.0,
                        "queue_depth": 0.0, "suspended": 0.0,
                        "restore_overlap_ratio": 0.0,
-                       "degradation_level": 0.0}
+                       "degradation_level": 0.0,
+                       # tokens emitted per speculative lane-step
+                       # (1.0 is the non-speculative floor; the
+                       # SPEC_SERVE artifact gates > 1.3 on the
+                       # lookup-friendly trace)
+                       "spec_accepted_tokens_per_step": 0.0,
+                       "slo_level": 0.0}
 
     # ------------------------------------------------------------- #
     # scheduler hooks
@@ -184,6 +208,18 @@ class ServingMetrics:
         c["prefill_chunks"] += report.prefill_chunks
         if report.prefill_chunks:
             c["prefill_chunk_steps"] += 1
+        c["prefill_tokens"] += report.prefill_tokens
+        if report.spec_lanes:
+            c["spec_steps"] += 1
+        c["spec_lane_steps"] += report.spec_lanes
+        c["spec_drafted"] += report.spec_drafted
+        c["spec_accepted"] += report.spec_accepted
+        c["spec_emitted"] += report.spec_emitted
+        c["spec_rollback_tokens"] += report.spec_rollback_tokens
+        c["prefix_adoptions"] += len(report.prefix_adoptions)
+        c["prefix_tokens_reused"] += report.prefix_tokens_reused
+        if report.slo_level > 0:
+            c["slo_degraded_steps"] += 1
         c["failed"] += len(report.failed)
         c["quarantined"] += len(report.quarantined)
         c["faults_injected"] += report.faults
@@ -202,7 +238,8 @@ class ServingMetrics:
             self.rejected[reason] = self.rejected.get(reason, 0) + 1
         engine = scheduler.engine
         sm = engine.config.state_manager
-        lanes = report.decode_lanes + len(report.admitted)
+        lanes = report.decode_lanes + report.spec_lanes + \
+            len(report.admitted)
         self.gauges["batch_occupancy"] = \
             lanes / max(sm.max_ragged_sequence_count, 1)
         alloc = engine.state.allocator
@@ -215,6 +252,11 @@ class ServingMetrics:
         if scheduler.total_restores:
             self.gauges["restore_overlap_ratio"] = \
                 scheduler.overlapped_restores / scheduler.total_restores
+        if scheduler.total_spec_lane_steps:
+            self.gauges["spec_accepted_tokens_per_step"] = \
+                scheduler.total_spec_emitted / \
+                scheduler.total_spec_lane_steps
+        self.gauges["slo_level"] = float(report.slo_level)
         if self.slo is not None:
             # degradation level is SLO *context* (read-only), and the
             # burn-rate gauges are refreshed on this step's clock so

@@ -1,0 +1,466 @@
+"""Fleet-wide radix prefix tree + per-replica warm-prefix cache.
+
+The router's original prefix-affinity map was an LRU of
+``crc32(first 16 prompt tokens) -> last replica`` — it could only
+*steer* a shared-prefix request toward a warm replica, never *reuse*
+anything, and two distinct prefixes could CRC-collide into one bonus.
+This module promotes that map into real fleet-wide prefix reuse:
+
+* :class:`RadixPrefixTree` — an edge-compressed radix tree over **full
+  token-id paths** (CRC is demoted to a per-node *fingerprint*, an
+  equality hint; edges always compare actual token ids, so a
+  fingerprint collision can mislead nothing — the collision regression
+  test drives the tree with a constant fingerprint function and the
+  lookups still separate every path). Nodes record which replicas hold
+  a registered prefix *through* them, so ``longest_match`` answers both
+  routing questions in one walk: how many leading tokens of this
+  prompt are warm somewhere, and where.
+* :class:`ReplicaPrefixCache` — the per-replica payload store: the
+  HCache latent slab covering a registered prompt (captured for free by
+  the prefill that served it). A new request whose prompt shares ``m``
+  leading tokens with a stored path re-enters through the engine's
+  restore path for those ``m`` tokens and prefills only the tail —
+  restore is link-bound and ~5x cheaper per token than prefill in the
+  serving cost model, and the saved prompt tokens stop competing for
+  the ragged batch's token budget.
+* **latent prefix broadcast** — when affinity and load conflict (the
+  warm replica is hot, the router places the request cold), the fleet
+  ships the common prefix payload ONCE over the inter-replica latent
+  wire (``Migration`` reason ``prefix_broadcast``) and installs it in
+  the cold replica's cache, instead of re-prefilling the prefix per
+  replica. Priced by the crossover model's broadcast-vs-re-prefill
+  term; refused when the wire costs more than the prefill it saves.
+
+Everything here is deterministic host state: insertion order drives
+iteration, eviction is LRU by a caller-supplied monotonically
+increasing stamp (the fleet step / scheduler step — never a wall
+clock), so same-seed runs produce byte-identical trees.
+"""
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from zlib import crc32
+
+import numpy as np
+
+from ..runtime.config import HDSConfigError
+
+
+def default_fingerprint(tokens: Sequence[int]) -> int:
+    """CRC-32 of a token path — the node *fingerprint* (a cheap
+    equality hint for diagnostics/digests). Never used as a key: the
+    tree always compares actual token ids."""
+    return crc32(np.asarray(tuple(tokens), np.int64).tobytes())
+
+
+@dataclass(frozen=True)
+class PrefixReuseConfig:
+    """Knobs for fleet-wide prefix reuse (docs/serving.md)."""
+    enabled: bool = True
+    #: minimum matched tokens before a request adopts a warm prefix
+    #: (tiny matches are not worth a restore dispatch)
+    min_adopt_tokens: int = 8
+    #: minimum matched tokens before the fleet broadcasts a prefix to
+    #: a cold replica
+    min_broadcast_tokens: int = 8
+    #: longest prompt prefix registered per request (caps tree depth
+    #: and payload bytes per entry)
+    max_prefix_tokens: int = 512
+    #: registered paths the shared tree retains (LRU)
+    max_paths: int = 1024
+    #: per-replica payload budget (bytes) of the warm-prefix cache
+    max_cache_bytes: int = 64 * 1024 * 1024
+    #: ship the prefix once over the latent wire when affinity and
+    #: load conflict (fleet deployments only)
+    broadcast: bool = True
+
+
+def validate_prefix_reuse_config(cfg: PrefixReuseConfig,
+                                 in_fleet: bool = True) -> None:
+    """Typed validation (the ``validate_overlap_config`` pattern)."""
+    if cfg is None or not cfg.enabled:
+        return
+    if cfg.min_adopt_tokens < 1:
+        raise HDSConfigError(
+            f"prefix min_adopt_tokens must be >= 1, got "
+            f"{cfg.min_adopt_tokens}")
+    if cfg.min_broadcast_tokens < 1:
+        raise HDSConfigError(
+            f"prefix min_broadcast_tokens must be >= 1, got "
+            f"{cfg.min_broadcast_tokens}")
+    if cfg.max_prefix_tokens < cfg.min_adopt_tokens:
+        raise HDSConfigError(
+            f"prefix max_prefix_tokens ({cfg.max_prefix_tokens}) < "
+            f"min_adopt_tokens ({cfg.min_adopt_tokens}): no prefix "
+            "could ever register AND adopt")
+    if cfg.max_paths < 1 or cfg.max_cache_bytes < 1:
+        raise HDSConfigError(
+            "prefix max_paths and max_cache_bytes must be >= 1 "
+            f"(paths={cfg.max_paths}, bytes={cfg.max_cache_bytes})")
+    if cfg.broadcast and not in_fleet:
+        raise HDSConfigError(
+            "prefix_broadcast without a fleet: broadcasting ships the "
+            "prefix over the inter-replica latent wire, which a "
+            "standalone server does not have (set broadcast=False or "
+            "deploy under ServingFleet)")
+
+
+class _Node:
+    """One radix-tree node: the edge (token run) from its parent, the
+    replicas holding a registered path through it, and a per-replica
+    key of one registered path at-or-below it (the payload locator)."""
+
+    __slots__ = ("edge", "children", "plen", "fp", "owners",
+                 "entry_below")
+
+    def __init__(self, edge: Tuple[int, ...], plen: int, fp: int):
+        self.edge = edge                 # tokens on the incoming edge
+        self.children: Dict[int, "_Node"] = {}
+        self.plen = plen                 # path length root -> here
+        self.fp = fp                     # path fingerprint (hint only)
+        #: replica id -> LRU stamp of the newest registered path
+        #: through this node
+        self.owners: Dict[int, int] = {}
+        #: replica id -> full path key of one registered path at or
+        #: below this node (any such path's payload covers this node's
+        #: prefix — latents are per-token, a slice restores it)
+        self.entry_below: Dict[int, Tuple[int, ...]] = {}
+
+
+class RadixPrefixTree:
+    """Edge-compressed radix tree over token-id paths.
+
+    ``fingerprint`` is injectable so the collision regression test can
+    force every node to share one fingerprint and prove lookups still
+    separate distinct paths (token ids are the key; the fingerprint is
+    a hint)."""
+
+    def __init__(self, max_paths: int = 1024,
+                 fingerprint: Callable[[Sequence[int]], int] =
+                 default_fingerprint):
+        self.max_paths = int(max_paths)
+        self.fingerprint = fingerprint
+        self.root = _Node((), 0, fingerprint(()))
+        #: registered paths, LRU order (oldest first):
+        #: path -> {replica -> stamp}
+        self.paths: "OrderedDict[Tuple[int, ...], Dict[int, int]]" = \
+            OrderedDict()
+        self.inserts = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- #
+    # structure walks
+    # ------------------------------------------------------------- #
+    def _walk(self, tokens: Sequence[int]):
+        """Yield ``(node, matched)`` pairs along the longest path of
+        ``tokens`` present in the tree (root first, matched = tokens
+        consumed INCLUDING partial edge matches into the last node)."""
+        node, i, n = self.root, 0, len(tokens)
+        yield node, 0
+        while i < n:
+            child = node.children.get(tokens[i])
+            if child is None:
+                return
+            e = child.edge
+            k = 0
+            while k < len(e) and i + k < n and e[k] == tokens[i + k]:
+                k += 1
+            i += k
+            yield child, i
+            if k < len(e):
+                return            # partial edge: cannot descend
+            node = child
+
+    def longest_match(self, tokens: Sequence[int]
+                      ) -> Tuple[int, Dict[int, int]]:
+        """``(matched_tokens, owners)``: the longest leading run of
+        ``tokens`` lying on a registered path, and the replicas holding
+        a registered path through (or below) the match point. A match
+        inside an edge still counts — the covering payload's first
+        ``matched`` tokens restore it."""
+        best_m, best_owners = 0, {}
+        for node, matched in self._walk(tokens):
+            if matched and node.owners:
+                best_m, best_owners = matched, dict(node.owners)
+        return best_m, best_owners
+
+    def payload_key(self, tokens: Sequence[int], replica: int
+                    ) -> Tuple[int, Tuple[int, ...]]:
+        """``(matched_tokens, path_key)`` for the deepest match point
+        that ``replica`` can serve a payload for (``(0, ())`` when it
+        holds nothing useful)."""
+        best = (0, ())
+        for node, matched in self._walk(tokens):
+            if matched and replica in node.entry_below:
+                best = (matched, node.entry_below[replica])
+        return best
+
+    # ------------------------------------------------------------- #
+    # mutation
+    # ------------------------------------------------------------- #
+    def _split(self, parent: _Node, child: _Node, k: int,
+               prefix: Tuple[int, ...]) -> _Node:
+        """Split ``child``'s edge after ``k`` tokens, returning the new
+        intermediate node. ``prefix`` is the root→mid token path (its
+        fingerprint source)."""
+        head, tail = child.edge[:k], child.edge[k:]
+        mid = _Node(head, child.plen - len(tail),
+                    self.fingerprint(prefix))
+        mid.owners = dict(child.owners)
+        mid.entry_below = dict(child.entry_below)
+        parent.children[head[0]] = mid
+        child.edge = tail
+        mid.children[tail[0]] = child
+        return mid
+
+    def insert(self, tokens: Sequence[int], replica: int,
+               stamp: int) -> Tuple[int, ...]:
+        """Register ``tokens`` as a warm path on ``replica``; returns
+        the canonical path key. ``stamp`` must be monotonically
+        increasing (scheduler/fleet step) — it drives LRU eviction."""
+        path = tuple(int(t) for t in tokens)
+        if not path:
+            return path
+        node, i, n = self.root, 0, len(path)
+        node.owners[replica] = stamp
+        node.entry_below[replica] = path
+        while i < n:
+            child = node.children.get(path[i])
+            if child is None:
+                leaf = _Node(path[i:], n, self.fingerprint(path))
+                node.children[path[i]] = leaf
+                node = leaf
+                i = n
+            else:
+                e = child.edge
+                k = 0
+                while k < len(e) and i + k < n and \
+                        e[k] == path[i + k]:
+                    k += 1
+                if k < len(e):
+                    child = self._split(node, child, k,
+                                        path[:i + k])
+                i += k
+                node = child
+            node.owners[replica] = stamp
+            node.entry_below[replica] = path
+        owners = self.paths.get(path)
+        if owners is None:
+            owners = self.paths[path] = {}
+        owners[replica] = stamp
+        self.paths.move_to_end(path)
+        self.inserts += 1
+        while len(self.paths) > self.max_paths:
+            old_path, _ = self.paths.popitem(last=False)
+            self._unregister(old_path)
+            self.evictions += 1
+        return path
+
+    def _unregister(self, path: Tuple[int, ...]) -> None:
+        """Remove a registered path: walk down clearing owner marks
+        that pointed at it, pruning childless unowned nodes."""
+        stack: List[Tuple[_Node, _Node]] = []
+        node, i, n = self.root, 0, len(path)
+        while i < n:
+            child = node.children.get(path[i])
+            if child is None:
+                break
+            stack.append((node, child))
+            i += len(child.edge)
+            node = child
+        for parent, child in reversed(stack):
+            # recompute owners/entry_below from surviving paths below
+            self._refresh_marks(child)
+            if not child.children and not child.owners:
+                del parent.children[child.edge[0]]
+
+    def _refresh_marks(self, node: _Node) -> None:
+        """Rebuild ``owners``/``entry_below`` for one node from the
+        surviving registered paths (called on the eviction path only —
+        eviction is rare and the path set is LRU-bounded)."""
+        owners: Dict[int, int] = {}
+        entry: Dict[int, Tuple[int, ...]] = {}
+        for key, key_owners in self.paths.items():
+            if len(key) < node.plen:
+                continue
+            tip = self._exact_prefix_of(key, node)
+            if not tip:
+                continue
+            for rid, stamp in key_owners.items():
+                if stamp >= owners.get(rid, -1):
+                    owners[rid] = stamp
+                    entry[rid] = key
+        node.owners = owners
+        node.entry_below = entry
+
+    def _exact_prefix_of(self, key: Tuple[int, ...],
+                         node: _Node) -> bool:
+        """Does registered path ``key`` run through ``node``?"""
+        walked = 0
+        for n2, matched in self._walk(key):
+            if n2 is node:
+                walked = matched
+                break
+        return walked == node.plen and walked > 0
+
+    def evict_replica(self, replica: int) -> int:
+        """Drop every mark for ``replica`` (crash / drain-complete —
+        its warm prefixes died with its cache). Returns paths whose
+        last owner this was."""
+        orphaned = 0
+        for path in list(self.paths):
+            owners = self.paths[path]
+            if replica in owners:
+                del owners[replica]
+                if not owners:
+                    del self.paths[path]
+                    self._unregister(path)
+                    orphaned += 1
+        self._evict_marks(self.root, replica)
+        return orphaned
+
+    def _evict_marks(self, node: _Node, replica: int) -> None:
+        node.owners.pop(replica, None)
+        node.entry_below.pop(replica, None)
+        for child in list(node.children.values()):
+            self._evict_marks(child, replica)
+
+    # ------------------------------------------------------------- #
+    def node_count(self) -> int:
+        count, stack = 0, [self.root]
+        while stack:
+            n = stack.pop()
+            count += 1
+            stack.extend(n.children.values())
+        return count
+
+    def summary(self) -> Dict:
+        return {"paths": len(self.paths),
+                "nodes": self.node_count(),
+                "inserts": self.inserts,
+                "evictions": self.evictions}
+
+
+class ReplicaPrefixCache:
+    """Per-replica warm-prefix payload store, sharing one fleet tree.
+
+    ``register`` is called by the scheduler when a prefill completes
+    with latent capture (the payload is free); ``lookup`` is consulted
+    at admission; ``install`` is the landing half of a latent prefix
+    broadcast. Payload arrays are stored contiguous float copies —
+    adoption slices the first ``m`` tokens.
+    """
+
+    def __init__(self, config: PrefixReuseConfig = None,
+                 tree: Optional[RadixPrefixTree] = None,
+                 replica_id: int = 0, in_fleet: bool = False):
+        self.config = config or PrefixReuseConfig()
+        validate_prefix_reuse_config(self.config, in_fleet=in_fleet)
+        self.tree = tree if tree is not None else \
+            RadixPrefixTree(max_paths=self.config.max_paths)
+        self.replica_id = int(replica_id)
+        #: path -> payload [L, T, H]; LRU order, byte-bounded
+        self.store: "OrderedDict[Tuple[int, ...], np.ndarray]" = \
+            OrderedDict()
+        self.bytes = 0
+        self.registrations = 0
+        self.installs = 0
+        self.hits = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- #
+    def _put(self, path: Tuple[int, ...], payload: np.ndarray,
+             stamp: int) -> None:
+        payload = np.ascontiguousarray(payload)
+        old = self.store.pop(path, None)
+        if old is not None:
+            self.bytes -= old.nbytes
+        self.store[path] = payload
+        self.bytes += payload.nbytes
+        self.tree.insert(path, self.replica_id, stamp)
+        while self.bytes > self.config.max_cache_bytes and \
+                len(self.store) > 1:
+            old_path, old_payload = self.store.popitem(last=False)
+            self.bytes -= old_payload.nbytes
+            self.evictions += 1
+
+    def register(self, tokens: Sequence[int], payload,
+                 stamp: int) -> bool:
+        """Store the latent slab covering ``tokens`` (a served prompt).
+        ``payload`` must cover at least ``len(tokens)`` positions on
+        axis 1; longer slabs are sliced."""
+        if not self.config.enabled:
+            return False
+        path = tuple(int(t) for t in tokens)
+        n = len(path)
+        if n < self.config.min_adopt_tokens:
+            return False
+        if n > self.config.max_prefix_tokens:
+            n = self.config.max_prefix_tokens
+            path = path[:n]
+        arr = np.asarray(payload)
+        if arr.ndim != 3 or arr.shape[1] < n:
+            return False
+        self._put(path, arr[:, :n], stamp)
+        self.registrations += 1
+        return True
+
+    def install(self, tokens: Sequence[int], payload,
+                stamp: int) -> None:
+        """Broadcast landing: adopt a prefix payload shipped from a
+        warm replica (counted separately from local registrations)."""
+        path = tuple(int(t) for t in tokens)
+        arr = np.asarray(payload)
+        if not path or arr.ndim != 3 or arr.shape[1] < len(path):
+            return
+        self._put(path, arr[:, :len(path)], stamp)
+        self.installs += 1
+
+    def lookup(self, prompt: Sequence[int]
+               ) -> Tuple[int, Optional[np.ndarray]]:
+        """Longest stored prefix of ``prompt`` on THIS replica:
+        ``(m, payload_slice)`` with ``m`` capped at
+        ``len(prompt) - 1`` (at least one prompt token must prefill —
+        its logits sample the first token) — or ``(0, None)``."""
+        if not self.config.enabled or len(prompt) < 2:
+            return 0, None
+        query = tuple(int(t) for t in prompt)
+        m, key = self.tree.payload_key(query, self.replica_id)
+        m = min(m, len(query) - 1)
+        if m < self.config.min_adopt_tokens:
+            return 0, None
+        payload = self.store.get(key)
+        if payload is None or payload.shape[1] < m:
+            # registered in the tree but evicted from the byte-bounded
+            # store (or a broadcast raced the eviction): no payload
+            return 0, None
+        self.store.move_to_end(key)
+        self.hits += 1
+        return m, payload[:, :m]
+
+    def payload_for(self, prompt: Sequence[int], m: int
+                    ) -> Optional[np.ndarray]:
+        """The broadcast source hook: the first ``m`` tokens' payload
+        for ``prompt`` if this replica stores a covering path."""
+        query = tuple(int(t) for t in prompt)
+        got, key = self.tree.payload_key(query, self.replica_id)
+        if got < m:
+            return None
+        payload = self.store.get(key)
+        if payload is None or payload.shape[1] < m:
+            return None
+        return payload[:, :m]
+
+    def drop_all(self) -> None:
+        """Crash path: the cache died with its replica."""
+        self.store.clear()
+        self.bytes = 0
+        self.tree.evict_replica(self.replica_id)
+
+    def summary(self) -> Dict:
+        return {"entries": len(self.store), "bytes": self.bytes,
+                "registrations": self.registrations,
+                "installs": self.installs, "hits": self.hits,
+                "evictions": self.evictions,
+                "tree": self.tree.summary()}

@@ -36,12 +36,10 @@ mutation in one file.
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
-from zlib import crc32
-
-import numpy as np
 
 from ..resilience.retry import BreakerState, CircuitBreaker
 from .crossover import RestoreCrossoverModel
+from .prefix_tree import RadixPrefixTree, default_fingerprint
 from .request import Request
 
 
@@ -60,10 +58,20 @@ class RouterConfig:
     #: prefix-affinity bonus subtracted from the score of the replica
     #: that last served this prompt prefix; 0 disables prefix routing
     prefix_weight: float = 0.30
-    #: prompt tokens hashed into the prefix key
+    #: prompt tokens keyed into the affinity map. The map is keyed on
+    #: the ACTUAL token ids (CRC survives only as a radix-tree node
+    #: fingerprint) — two distinct prefixes can never collide into one
+    #: affinity bonus
     prefix_len: int = 16
     #: LRU capacity of the prefix map
     prefix_map_size: int = 1024
+    # -- fleet-wide prefix reuse (the radix tree above affinity) ------ #
+    #: consult the shared radix tree for reuse + broadcast decisions
+    #: (False = affinity-only, the historical router; committed fleet
+    #: digests replay)
+    prefix_reuse: bool = False
+    #: minimum shared leading tokens before a broadcast is considered
+    broadcast_min_tokens: int = 8
     #: KV-utilization gap (hottest - coldest) that triggers a
     #: rebalance migration proposal
     migrate_pressure_gap: float = 0.25
@@ -101,17 +109,31 @@ class FleetRouter:
 
     def __init__(self, config: RouterConfig = None,
                  crossover: Optional[RestoreCrossoverModel] = None,
-                 link_bytes_per_s: float = 0.0):
+                 link_bytes_per_s: float = 0.0,
+                 prefix_tree: Optional[RadixPrefixTree] = None):
         self.config = config or RouterConfig()
         #: crossover model pricing migrate-vs-stay (None/uncalibrated
         #: = pressure gap alone decides, the pre-policy behavior)
         self.crossover = crossover
         self.link_bytes_per_s = float(link_bytes_per_s)
         self.breakers: Dict[int, CircuitBreaker] = {}
-        self._prefix_map: "OrderedDict[int, int]" = OrderedDict()
+        #: affinity LRU: the first ``prefix_len`` prompt TOKEN IDS (a
+        #: tuple — never a hash of them) -> the replica that last
+        #: served that exact prefix
+        self._prefix_map: "OrderedDict[Tuple[int, ...], int]" = \
+            OrderedDict()
+        #: the fleet-shared radix tree over full token-id paths
+        #: (installed by the fleet when prefix reuse is on; consulted
+        #: for route-to-reuse and broadcast planning only — affinity
+        #: keeps its own exact-prefix LRU so the historical routing
+        #: digests replay with reuse off)
+        self.prefix_tree = prefix_tree
         # counters the fleet metrics surface
         self.routed = 0
         self.affinity_hits = 0
+        self.reuse_routes = 0
+        self.prefix_broadcasts_planned = 0
+        self.prefix_broadcasts_refused_by_cost = 0
         self.migrations_proposed = 0
         self.migrations_refused_by_cost = 0
         self.handoff_routes = 0
@@ -149,9 +171,18 @@ class FleetRouter:
     # ------------------------------------------------------------- #
     # placement
     # ------------------------------------------------------------- #
-    def prefix_key(self, prompt: Sequence[int]) -> int:
-        head = tuple(prompt[:self.config.prefix_len])
-        return crc32(np.asarray(head, np.int64).tobytes())
+    def prefix_key(self, prompt: Sequence[int]) -> Tuple[int, ...]:
+        """The affinity key: the leading ``prefix_len`` token IDS
+        themselves. The old router hashed them (``crc32``) — two
+        distinct prefixes could collide into one bonus; the token
+        tuple cannot. (CRC survives only as the radix tree's node
+        *fingerprint*: :func:`~.prefix_tree.default_fingerprint`.)"""
+        return tuple(int(t) for t in prompt[:self.config.prefix_len])
+
+    def prefix_fingerprint(self, prompt: Sequence[int]) -> int:
+        """Diagnostic CRC of the affinity key (logs/digests only —
+        never a lookup key)."""
+        return default_fingerprint(self.prefix_key(prompt))
 
     def _score(self, snap: ReplicaSnapshot, affinity: bool) -> float:
         c = self.config
@@ -168,22 +199,70 @@ class FleetRouter:
         """Pick the destination replica for ``req`` among
         ``snapshots`` (the fleet passes only routable replicas).
         Returns None when no replica is routable. Lowest
-        (score, id) wins — deterministic under ties."""
+        (score, id) wins — deterministic under ties.
+
+        With ``prefix_reuse`` on, a replica holding the request's
+        longest warm prefix in the shared radix tree earns the
+        affinity bonus too (route-to-reuse): landing where the prefix
+        is warm converts the bonus from locality folklore into an
+        actual skipped re-prefill."""
         if not snapshots:
             return None
         key = self.prefix_key(req.prompt)
         preferred = self._prefix_map.get(key)
+        warm: Dict[int, int] = {}
+        if self.config.prefix_reuse and self.prefix_tree is not None:
+            m, owners = self.prefix_tree.longest_match(req.prompt)
+            if m >= self.config.broadcast_min_tokens:
+                warm = owners
         best = min(snapshots,
-                   key=lambda s: (self._score(s, s.id == preferred),
-                                  s.id))
+                   key=lambda s: (self._score(
+                       s, s.id == preferred or s.id in warm), s.id))
         self.routed += 1
         if preferred == best.id:
             self.affinity_hits += 1
+        if warm and best.id in warm:
+            self.reuse_routes += 1
         self._prefix_map[key] = best.id
         self._prefix_map.move_to_end(key)
         while len(self._prefix_map) > self.config.prefix_map_size:
             self._prefix_map.popitem(last=False)
         return best.id
+
+    def plan_prefix_broadcast(
+            self, req: Request, dst: int,
+            snapshots: Sequence[ReplicaSnapshot]
+    ) -> Optional[Tuple[int, int]]:
+        """Affinity lost to load: the request routed to ``dst`` but a
+        DIFFERENT replica holds its longest warm prefix. Propose
+        shipping that prefix once over the latent wire —
+        ``(src_replica, matched_tokens)`` — so ``dst`` restores it
+        through its normal lanes instead of re-prefilling it (and so
+        does every later sharer landing there). Priced by the
+        crossover model's broadcast-vs-re-prefill term; None when
+        reuse is off, no warm prefix exists, ``dst`` already holds
+        it, or the wire costs more than the prefill it saves."""
+        if not self.config.prefix_reuse or self.prefix_tree is None:
+            return None
+        m, owners = self.prefix_tree.longest_match(req.prompt)
+        m = min(m, len(req.prompt) - 1)
+        if m < self.config.broadcast_min_tokens or not owners:
+            return None
+        if dst in owners:
+            return None           # already warm where it landed
+        dst_snap = next((s for s in snapshots if s.id == dst), None)
+        if self.crossover is not None and \
+                self.crossover.decide_prefix_broadcast(
+                    m,
+                    dst_snap.occupancy if dst_snap is not None else 0.0,
+                    self.link_bytes_per_s) == "reprefill":
+            self.prefix_broadcasts_refused_by_cost += 1
+            return None
+        # deterministic source pick: the owner with the freshest
+        # registration (newest stamp), lowest id as tiebreak
+        src = max(owners.items(), key=lambda kv: (kv[1], -kv[0]))[0]
+        self.prefix_broadcasts_planned += 1
+        return src, m
 
     def route_handoff(self, req: Request,
                       snapshots: Sequence[ReplicaSnapshot]
@@ -239,7 +318,7 @@ class FleetRouter:
 
     # ------------------------------------------------------------- #
     def summary(self) -> Dict:
-        return {
+        out = {
             "routed": self.routed,
             "affinity_hits": self.affinity_hits,
             "handoff_routes": self.handoff_routes,
@@ -252,3 +331,12 @@ class FleetRouter:
                 1 for br in self.breakers.values()
                 if br.state != BreakerState.CLOSED),
         }
+        if self.config.prefix_reuse:
+            out["reuse_routes"] = self.reuse_routes
+            out["prefix_broadcasts_planned"] = \
+                self.prefix_broadcasts_planned
+            out["prefix_broadcasts_refused_by_cost"] = \
+                self.prefix_broadcasts_refused_by_cost
+            if self.prefix_tree is not None:
+                out["prefix_tree"] = self.prefix_tree.summary()
+        return out

@@ -39,11 +39,15 @@ from ..inference.scheduling import (BACKPRESSURE_ACTION, BackpressureAction,
 from ..resilience.degradation import DegradationLadder, DegradationLevel
 from ..resilience.policy import ResiliencePolicy
 from ..resilience.retry import CircuitBreaker, Watchdog
+from ..runtime.config import HDSConfigError
 from ..telemetry.flight import get_flight_recorder
 from ..telemetry.tracer import get_tracer
 from .clock import MonotonicClock
 from .crossover import RestoreCrossoverModel
 from .request import Request, RequestState
+from .spec import (SLODegradation, SLOModeConfig, SpeculationConfig,
+                   lookup_draft, validate_slo_mode_config,
+                   validate_speculation_config)
 
 
 def greedy_sample(req: Request, logits_row) -> int:
@@ -98,11 +102,33 @@ class StepReport:
     shed: int = 0
     #: degradation ladder level applied to this step's decisions
     degradation_level: int = 0
+    # -- speculative-decode accounting -------------------------------- #
+    #: decode lanes dispatched through the fused speculative step this
+    #: step (subset of ``decode_lanes``)
+    spec_lanes: int = 0
+    #: draft tokens fed for verification this step
+    spec_drafted: int = 0
+    #: draft tokens accepted (bonus tokens not counted)
+    spec_accepted: int = 0
+    #: tokens emitted by speculative lanes (accepted + bonus; 1 per
+    #: lane is the non-speculative floor)
+    spec_emitted: int = 0
+    #: rejected draft KV rolled back (tokens)
+    spec_rollback_tokens: int = 0
+    # -- fleet-wide prefix reuse -------------------------------------- #
+    #: admissions that adopted a warm prefix via the restore path
+    prefix_adoptions: List[int] = field(default_factory=list)
+    #: prompt tokens NOT re-prefilled thanks to adoption this step
+    prefix_tokens_reused: int = 0
+    #: SLO-aware degradation level applied this step (0 = normal,
+    #: 1 = speculation off, 2 = + forced chunked prefill, 3 = + shed)
+    slo_level: int = 0
 
     @property
     def work_done(self) -> bool:
         return bool(self.admitted or self.restored or self.finished or
-                    self.decode_lanes or self.prefill_tokens or
+                    self.decode_lanes or self.spec_lanes or
+                    self.prefill_tokens or
                     self.rejected or self.preempted or self.cancelled or
                     self.recomputed or self.restore_chunks or
                     self.failed or self.faults or self.restore_aborts)
@@ -127,7 +153,10 @@ class ContinuousBatchingScheduler:
                  replica_id: int = 0,
                  prefill_chunk: int = 0,
                  preempt_restore_grace: int = 0,
-                 restore_priority_barrier: bool = False):
+                 restore_priority_barrier: bool = False,
+                 speculation: SpeculationConfig = None,
+                 slo_mode: SLOModeConfig = None,
+                 prefix_cache=None):
         self.engine = engine
         #: fleet position of this scheduler (0 = standalone/replica 0);
         #: folded into the retry-jitter RNG key so N replicas retrying
@@ -179,6 +208,45 @@ class ContinuousBatchingScheduler:
         #: smaller-may-still-fit policy (better pool utilization,
         #: unbounded big-payload wait; committed digests replay)
         self.restore_priority_barrier = bool(restore_priority_barrier)
+        #: scheduler-dispatched speculative decode (None/disabled =
+        #: the historical one-token-per-lane step; committed chaos
+        #: digests replay). Validated typed at build — no silent
+        #: clamps (the validate_overlap_config pattern).
+        self.speculation = speculation
+        if speculation is not None and speculation.enabled:
+            validate_speculation_config(speculation, engine.config)
+            if not hasattr(engine, "put_spec"):
+                raise HDSConfigError(
+                    "speculation requires an engine exposing the "
+                    "fused put_spec verify step "
+                    f"({type(engine).__name__} does not)")
+            if self.latent_preemption and \
+                    not getattr(engine, "spec_latent_capture", False):
+                raise HDSConfigError(
+                    "speculation under latent preemption requires an "
+                    "engine whose put_spec captures accepted-span "
+                    "latents; this engine only speculates with "
+                    "hcache.enable_latents=false (exact-KV "
+                    "suspension)")
+            if sample_fn is not None and sample_fn is not greedy_sample:
+                raise HDSConfigError(
+                    "speculation is greedy-exact only: acceptance "
+                    "verifies drafts against greedy targets, so a "
+                    "custom sample_fn would silently change the "
+                    "stream — disable speculation or drop sample_fn")
+        #: current step's drafts: uid -> proposed tokens (rebuilt per
+        #: step by _draft_pass; consulted by _next_feed so admission /
+        #: pressure verdicts budget the full speculative feed)
+        self._drafts: Dict[int, List[int]] = {}
+        #: SLO-aware degradation (TTFT/TPOT burn -> speculation off =>
+        #: chunked prefill => shed); disabled = ladder untouched
+        if slo_mode is not None:
+            validate_slo_mode_config(slo_mode)
+        self.slo = SLODegradation(slo_mode)
+        self.slo_level = 0
+        #: fleet-wide prefix reuse: the replica's warm-prefix cache
+        #: (None = no reuse, the historical admission path)
+        self.prefix_cache = prefix_cache
 
         self.queue: List[Request] = []           # QUEUED, submit order
         self.running: Dict[int, Request] = {}    # DECODE residents
@@ -192,6 +260,14 @@ class ContinuousBatchingScheduler:
         self.total_restores = 0
         self.total_recomputes = 0
         self.overlapped_restores = 0
+        # -- speculative-decode + prefix-reuse totals ----------------- #
+        self.total_spec_lane_steps = 0
+        self.total_spec_drafted = 0
+        self.total_spec_accepted = 0
+        self.total_spec_emitted = 0
+        self.total_spec_rolled_back = 0
+        self.total_prefix_adoptions = 0
+        self.total_prefix_tokens_reused = 0
         #: uids whose open lane already earned its (single) overlap
         #: credit — a multi-step lane must not count once per step
         self._overlap_credited = set()
@@ -292,7 +368,9 @@ class ContinuousBatchingScheduler:
             self._cancellation_pass(report)
             self._deadline_pass(report, now)
             self._degradation_pass(report)
+            self._slo_pass(report)
             self._restore_pass(report)
+            self._draft_pass()
             admits = self._admission_pass(report, now)
             admits = self._pressure_pass(admits, report)
             self._dispatch(admits, report, now)
@@ -348,6 +426,7 @@ class ContinuousBatchingScheduler:
                       "done": len(self.done)},
             "breaker": self.breaker.state.name,
             "degradation": int(self.degradation),
+            "slo_level": self.slo_level,
             "fault_summary": self.fault_summary(),
             "free_blocks": self.engine.state.free_blocks,
             "events_tail": [list(e)
@@ -666,6 +745,83 @@ class ContinuousBatchingScheduler:
             self.queue.remove(victim)
             self._reject(victim, "shed_degraded", report)
             report.shed += 1
+
+    def _slo_pass(self, report: StepReport) -> None:
+        """SLO-aware degradation: walk the escalation ladder
+        (speculation off => forced chunked prefill => shed) from the
+        TTFT/TPOT burn-rate gauges the metrics layer computed at the
+        end of the previous step. Deterministic under the virtual
+        clock — the gauges are pure functions of virtual timestamps."""
+        if not self.slo.enabled:
+            return
+        gauges = self.metrics.slo_gauges if self.metrics is not None \
+            else {}
+        prev = self.slo_level
+        self.slo_level = self.slo.observe(gauges)
+        report.slo_level = self.slo_level
+        if self.slo_level != prev:
+            self._event(
+                "slo_degrade" if self.slo_level > prev
+                else "slo_recover", -1,
+                f"level={self.slo_level} "
+                f"({SLODegradation.LEVELS[self.slo_level]})")
+        backlog = len(self.queue) > \
+            self.engine.config.state_manager.max_ragged_sequence_count
+        if self.slo_level >= 3 and backlog:
+            victim = min(self.queue,
+                         key=lambda r: (r.priority, -r.arrival_time,
+                                        -r.uid))
+            self.queue.remove(victim)
+            self._reject(victim, "shed_slo", report)
+            report.shed += 1
+
+    @property
+    def _prefill_chunk_now(self) -> int:
+        """Effective scheduler-grain prefill chunk: the configured one,
+        tightened by the SLO ladder at level >= 2 (forced Dynamic
+        SplitFuse — long prompts stop head-of-line blocking decode
+        while the TTFT budget burns)."""
+        chunk = self.prefill_chunk
+        if self.slo.enabled and self.slo_level >= 2:
+            forced = self.slo.config.chunked_prefill_tokens
+            chunk = min(chunk, forced) if chunk else forced
+        return chunk
+
+    def _spec_active(self) -> bool:
+        """Speculation dispatches this step: configured, and not
+        suppressed by the SLO ladder (level >= 1 turns it off — the
+        drafted tokens stop inflating the per-step token budget)."""
+        return (self.speculation is not None and
+                self.speculation.enabled and self.slo_level < 1)
+
+    def _draft_pass(self) -> None:
+        """Build this step's prompt-lookup drafts for DECODE residents
+        (host-side PLD over ``prompt + tokens_out``). Draft length is
+        capped by the remaining generation budget (minus the bonus
+        token) and the context window, so a speculative stretch can
+        never overshoot ``max_new_tokens`` or ``max_context``."""
+        self._drafts = {}
+        if not self._spec_active():
+            return
+        cfg = self.speculation
+        min_hist = cfg.min_history or (cfg.ngram + 1)
+        for uid, req in self.running.items():
+            if req.state != RequestState.DECODE:
+                continue
+            if req.restored_in_step == self.step_idx:
+                continue          # re-entered this step; decodes next
+            cap = req.max_new_tokens - len(req.tokens_out) - 1
+            cap = min(cap,
+                      self.engine.max_context - req.cached_tokens - 1,
+                      cfg.max_draft)
+            if cap <= 0:
+                continue
+            hist = list(req.prompt) + req.tokens_out
+            if len(hist) < min_hist:
+                continue
+            draft = lookup_draft(hist, cfg.ngram, cap, cfg.window)
+            if draft:
+                self._drafts[uid] = draft
 
     def _cancellation_pass(self, report: StepReport) -> None:
         now = self.clock.now()
@@ -1137,21 +1293,24 @@ class ContinuousBatchingScheduler:
 
     def _next_feed(self, req: Request) -> int:
         """Tokens this *resident* feeds the next ragged put: one decode
-        token, or the next prompt slice for a mid-chunk PREFILL
-        resident (scheduler-grain chunked prefill)."""
+        token (plus this step's speculative draft, which transiently
+        occupies batch-token and KV budget until verification rolls
+        the rejected tail back), or the next prompt slice for a
+        mid-chunk PREFILL resident (scheduler-grain chunked
+        prefill)."""
         if req.state == RequestState.PREFILL:
             rest = len(req.prompt) - req.prefill_pos
-            return min(rest, self.prefill_chunk) \
-                if self.prefill_chunk else rest
-        return 1
+            chunk = self._prefill_chunk_now
+            return min(rest, chunk) if chunk else rest
+        return 1 + len(self._drafts.get(req.uid, ()))
 
     def _first_feed(self, req: Request) -> int:
         """Tokens an admission candidate would feed this step (its
         first prompt slice under chunked prefill, the whole prompt
         otherwise). Chunked admission budgets per slice — "fits
         eventually" is handled dynamically, like decode growth."""
-        return min(len(req.prompt), self.prefill_chunk) \
-            if self.prefill_chunk else len(req.prompt)
+        chunk = self._prefill_chunk_now
+        return min(len(req.prompt), chunk) if chunk else len(req.prompt)
 
     def _trial_verdict(self, admits: List[Request],
                        cand: Optional[Request]) -> SchedulingResult:
@@ -1180,7 +1339,7 @@ class ContinuousBatchingScheduler:
                 self._reject(req, "SequenceTokenLimitExceeded", report)
                 continue
             sm = self.engine.config.state_manager
-            chunk = self.prefill_chunk or sm.prefill_chunk
+            chunk = self._prefill_chunk_now or sm.prefill_chunk
             per_fwd = min(len(req.prompt), chunk) if chunk \
                 else len(req.prompt)
             if per_fwd > sm.max_ragged_batch_size:
@@ -1192,6 +1351,14 @@ class ContinuousBatchingScheduler:
             while True:
                 verdict = self._trial_verdict(admits, req)
                 action = BACKPRESSURE_ACTION[verdict]
+                if action != BackpressureAction.ADMIT and self._drafts:
+                    # drafts yield to admissions: dropping them first
+                    # restores the historical verdict arithmetic, so
+                    # speculation can never cause a preempt/wait that
+                    # the non-speculative scheduler would not have
+                    self._event("spec_throttle", -1, verdict.name)
+                    self._drafts = {}
+                    continue
                 if action != BackpressureAction.PREEMPT:
                     break
                 victims = [v for v in self._victims(grace=True)
@@ -1239,6 +1406,14 @@ class ContinuousBatchingScheduler:
             verdict = self._trial_verdict(admits, None)
             if verdict == SchedulingResult.Success:
                 return admits
+            if self._drafts:
+                # speculative drafts are opportunistic batch growth:
+                # under pressure they are the first thing to go —
+                # dropping them restores the historical one-token
+                # decode budget before anyone is preempted or shed
+                self._event("spec_throttle", -1, verdict.name)
+                self._drafts = {}
+                continue
             if verdict == SchedulingResult.KVCacheLimitExceeded:
                 exclude = {r.uid for r in admits}
                 victims = self._victims(exclude=exclude)
@@ -1285,6 +1460,150 @@ class ContinuousBatchingScheduler:
         self._event("prefill_rewind", req.uid, why)
 
     # ------------------------------------------------------------- #
+    # speculative decode dispatch + warm-prefix adoption
+    # ------------------------------------------------------------- #
+    def _spec_dispatch(self, lanes: List[Request], report: StepReport,
+                       now: float) -> bool:
+        """One fused speculative verify step over the drafted decode
+        residents: the engine verifies each ``[fed] + draft`` stretch
+        against its own greedy targets, accepts the matching prefix
+        plus the bonus token, and rolls rejected draft KV back before
+        returning — so every lane leaves this call at its last
+        ACCEPTED token, which is exactly what preemption-to-latents,
+        restore lanes and fault quarantine require of it. Greedy-exact:
+        the emitted stream is bitwise identical to one-token-per-step
+        decode. Returns True iff the dispatch did decode work (the
+        restore-lane overlap credit)."""
+        feeds = [[r.tokens_out[-1]] + self._drafts[r.uid]
+                 for r in lanes]
+        drafted = sum(len(f) - 1 for f in feeds)
+        with get_tracer().span("sched.spec_dispatch",
+                               sched_step=self.step_idx,
+                               replica=self.replica_id,
+                               lanes=len(lanes),
+                               drafted=drafted) as sp:
+            try:
+                emitted, latents = self.engine.put_spec(
+                    [r.uid for r in lanes], feeds)
+            except SchedulingError:
+                raise           # budget arithmetic bug — surface it
+            except Exception as exc:
+                # speculative dispatch fault: same quarantine
+                # semantics as the ragged put — the injector fires
+                # before any state mutates, so every lane is still at
+                # its last accepted token
+                self._quarantine_dispatch(exc, lanes, [], report, now)
+                return False
+            report.spec_lanes += len(lanes)
+            report.spec_drafted += drafted
+            self.total_spec_lane_steps += len(lanes)
+            self.total_spec_drafted += drafted
+            for j, req in enumerate(lanes):
+                toks = list(emitted[j])
+                accepted = len(toks) - 1
+                rolled = (len(feeds[j]) - 1) - accepted
+                report.spec_accepted += accepted
+                report.spec_emitted += len(toks)
+                report.spec_rollback_tokens += rolled
+                self.total_spec_accepted += accepted
+                self.total_spec_emitted += len(toks)
+                self.total_spec_rolled_back += rolled
+                if self.latent_preemption:
+                    try:
+                        req.absorb_latents(latents[j])
+                    except Exception as exc:
+                        self._note_fault(exc, report)
+                        self.running.pop(req.uid, None)
+                        self._safe_flush(req.uid)
+                        self._fail(req,
+                                   f"latent_fault:"
+                                   f"{getattr(exc, 'site', 'host')}",
+                                   report, now, quarantined=True)
+                        continue
+                if req.trace is not None:
+                    # speculation phase stamped into the causal trace:
+                    # the open decode span accumulates the per-request
+                    # acceptance facts (closure-safe — attrs, not time)
+                    req.trace.note(spec_steps=1,
+                                   spec_drafted=len(feeds[j]) - 1,
+                                   spec_accepted=accepted)
+                if req.eos_token_id is not None and \
+                        req.eos_token_id in toks:
+                    toks = toks[:toks.index(req.eos_token_id) + 1]
+                req.tokens_out.extend(toks)
+                if len(req.tokens_out) >= req.max_new_tokens or (
+                        req.eos_token_id is not None and toks and
+                        toks[-1] == req.eos_token_id):
+                    del self.running[req.uid]
+                    self.engine.flush(req.uid)
+                    self._close(req, report, now)
+            sp.set(accepted=report.spec_accepted,
+                   emitted=report.spec_emitted)
+        return True
+
+    def _try_adopt_prefix(self, req: Request,
+                          report: StepReport) -> None:
+        """Warm-prefix adoption at admission: when this replica's
+        prefix cache holds the leading ``m`` tokens of the prompt
+        (served locally, or installed by a latent prefix broadcast),
+        re-enter them through the engine's restore path — link-bound
+        replay instead of a full re-prefill — and prefill only the
+        tail. Composes with chunked prefill (``prefill_pos`` starts at
+        ``m``); failure of any kind falls back to the plain prefill
+        the request was already budgeted for."""
+        if req.tokens_out or req.prefill_pos:
+            return
+        if getattr(self.engine, "restoring_uids", ()):
+            # the run-to-completion restore would drain the open
+            # scheduler lanes out from under their chunk accounting;
+            # adopt on a later admission instead
+            return
+        m, payload = self.prefix_cache.lookup(req.prompt)
+        if m <= 0:
+            return
+        with get_tracer().span("sched.prefix_adopt", uid=req.uid,
+                               sched_step=self.step_idx,
+                               replica=self.replica_id, tokens=m):
+            try:
+                self.engine.restore_kv([req.uid],
+                                       [list(req.prompt[:m])],
+                                       [payload])
+            except SchedulingError:
+                return          # budget shortfall: plain prefill
+            except Exception as exc:
+                self._note_fault(exc, report)
+                self.engine.abort_restore(req.uid)
+                self._safe_flush(req.uid)
+                return
+        req.prefill_pos = m
+        req.absorb_latents(payload)
+        self.total_prefix_adoptions += 1
+        self.total_prefix_tokens_reused += m
+        report.prefix_adoptions.append(req.uid)
+        report.prefix_tokens_reused += m
+        # virtual-cost honesty: the adopted span is restore traffic
+        # (ship + replay), not prefill compute
+        report.restored_tokens += m
+        self._event("prefix_adopt", req.uid, f"tokens={m}")
+        if req.trace is not None:
+            req.trace.note(prefix_adopted=m)
+
+    def _register_prefix(self, req: Request) -> None:
+        """Prefill completed with latent capture: the prompt's latent
+        slab is a free warm-prefix payload — register it in the
+        replica cache (and through it, the fleet-shared radix tree)."""
+        if self.prefix_cache is None or not self.latent_preemption:
+            return
+        if req.latents is None or \
+                req.latents.shape[1] < len(req.prompt):
+            return
+        if self.prefix_cache.register(
+                req.prompt, np.asarray(req.latents)[:, :len(req.prompt)],
+                stamp=self.step_idx):
+            self._event("prefix_register", req.uid,
+                        f"tokens={len(req.prompt)}")
+
+    # ------------------------------------------------------------- #
     # dispatch: ONE ragged put for decodes + admitted prefills
     # ------------------------------------------------------------- #
     def _dispatch(self, admits: List[Request], report: StepReport,
@@ -1312,6 +1631,15 @@ class ContinuousBatchingScheduler:
         # one chunk per step instead of the whole prompt at once
         chunking = [r for r in residents
                     if r.state == RequestState.PREFILL]
+        # lanes holding a prompt-lookup draft dispatch through the
+        # fused speculative verify step; everyone else rides the
+        # historical ragged put (with speculation off the split is
+        # empty and this step is byte-identical to the old path)
+        spec_lanes: List[Request] = []
+        if self._drafts:
+            spec_lanes = [r for r in decodes if r.uid in self._drafts]
+            decodes = [r for r in decodes
+                       if r.uid not in self._drafts]
         for req in admits:
             self.queue.remove(req)
             req.transition(RequestState.PREFILL)
@@ -1319,11 +1647,18 @@ class ContinuousBatchingScheduler:
             report.admitted.append(req.uid)
             self._event("admit", req.uid,
                         f"prompt={len(req.prompt)}")
+            if self.prefix_cache is not None and \
+                    self.latent_preemption:
+                self._try_adopt_prefix(req, report)
+        spec_ok = False
+        if spec_lanes:
+            spec_ok = self._spec_dispatch(spec_lanes, report, now)
         step_reqs = decodes + chunking + admits
         if not step_reqs:
-            # restore-only step: the lanes still trickle (no overlap
-            # credit — nothing computed under the ships)
-            self._advance_restore_lanes(report, had_decode=False)
+            # restore-only (or speculation-only) step: the lanes still
+            # trickle; a successful speculative dispatch is decode
+            # compute the open lanes' ships hide under
+            self._advance_restore_lanes(report, had_decode=spec_ok)
             return
         slices: Dict[int, List[int]] = {}
         toks: List = [[r.tokens_out[-1]] for r in decodes]
@@ -1334,7 +1669,7 @@ class ContinuousBatchingScheduler:
             toks.append(slices[req.uid])
         report.decode_lanes = len(decodes)
         report.prefill_tokens = sum(len(s) for s in slices.values())
-        if self.prefill_chunk:
+        if self._prefill_chunk_now:
             report.prefill_chunks = len(slices)
         # the decode half of the restore-overlap span pair (see
         # _restore_pass): the decode dispatch computes while the open
@@ -1365,11 +1700,11 @@ class ContinuousBatchingScheduler:
                 report.prefill_tokens = 0
                 if self.latent_preemption and self.restoring:
                     self._advance_restore_lanes(report,
-                                                had_decode=False)
+                                                had_decode=spec_ok)
                 return
             if self.latent_preemption and self.restoring:
                 self._advance_restore_lanes(
-                    report, had_decode=bool(decodes))
+                    report, had_decode=bool(decodes) or spec_ok)
                 sp.set(overlapped_restores=report.overlapped_restores,
                        restore_chunks=report.restore_chunks)
         for j, req in enumerate(step_reqs):
@@ -1403,6 +1738,7 @@ class ContinuousBatchingScheduler:
             if req.state == RequestState.PREFILL:
                 req.transition(RequestState.DECODE)
                 self.running[req.uid] = req
+                self._register_prefix(req)
             if len(req.tokens_out) >= req.max_new_tokens or (
                     req.eos_token_id is not None and
                     tok == req.eos_token_id):

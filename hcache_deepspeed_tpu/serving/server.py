@@ -64,12 +64,24 @@ class ServerConfig:
     #: suspended payload that does not fit blocks smaller ones from
     #: leapfrogging it. False = historical smaller-may-still-fit
     restore_priority_barrier: bool = False
+    #: scheduler-dispatched speculative decode (a
+    #: :class:`~.spec.SpeculationConfig`; None = the historical
+    #: one-token-per-lane step — committed chaos digests replay)
+    speculation: object = None
+    #: SLO-aware degradation mode (a :class:`~.spec.SLOModeConfig`;
+    #: None = the fault-driven ladder alone)
+    slo_mode: object = None
     # -- virtual-clock cost model (seconds) -------------------------- #
     step_overhead_s: float = 1e-3
     prefill_token_s: float = 1e-4
     decode_lane_s: float = 5e-4
     restore_token_s: float = 2e-5
     restore_chunk_s: float = 1e-4
+    #: per drafted-token verification cost of a fused speculative
+    #: step: drafts verify inside one dispatch on lanes the MXU
+    #: already occupies, so a verified token is far cheaper than a
+    #: dispatched decode step — that gap is the whole speedup
+    spec_draft_token_s: float = 5e-5
 
 
 class ServingServer:
@@ -77,7 +89,8 @@ class ServingServer:
     def __init__(self, engine, config: ServerConfig = None, clock=None,
                  metrics: ServingMetrics = None, sample_fn=None,
                  monitor=None, emit_every_steps: int = 50,
-                 crossover=None, resilience=None, replica_id: int = 0):
+                 crossover=None, resilience=None, replica_id: int = 0,
+                 prefix_cache=None):
         self.config = config or ServerConfig()
         self.clock = clock or MonotonicClock()
         self.virtual = isinstance(self.clock, VirtualClock)
@@ -93,7 +106,10 @@ class ServingServer:
             prefill_chunk=self.config.prefill_chunk,
             preempt_restore_grace=self.config.preempt_restore_grace,
             restore_priority_barrier=
-            self.config.restore_priority_barrier)
+            self.config.restore_priority_barrier,
+            speculation=self.config.speculation,
+            slo_mode=self.config.slo_mode,
+            prefix_cache=prefix_cache)
         self.monitor = monitor
         self.emit_every_steps = emit_every_steps
         self._lock = make_lock("ServingServer._lock")
@@ -188,9 +204,11 @@ class ServingServer:
         return (c.step_overhead_s +
                 c.prefill_token_s * report.prefill_tokens +
                 c.decode_lane_s * (report.decode_lanes +
+                                   report.spec_lanes +
                                    len(report.admitted)) +
                 c.restore_token_s * report.restored_tokens +
-                c.restore_chunk_s * report.restore_chunks)
+                c.restore_chunk_s * report.restore_chunks +
+                c.spec_draft_token_s * report.spec_drafted)
 
     def step(self, advance_clock: bool = True):
         """Drain ingress + one scheduler step (thread mode calls this

@@ -28,6 +28,10 @@ class SimulatedEngine:
     #: latent stack shape stand-ins (restore contract: [L, T, H])
     N_LAYER = 2
     HIDDEN = 4
+    #: ``put_spec`` captures accepted-span latents, so speculation
+    #: composes with latent preemption (the real engine's tail forward
+    #: has no capture path yet and only speculates in exact-KV mode)
+    spec_latent_capture = True
 
     def __init__(self, config: RaggedInferenceEngineConfig = None,
                  vocab_size: int = 64):
@@ -53,6 +57,11 @@ class SimulatedEngine:
                        "resume": 0, "flush": 0}
         self.restore_stats = {"restores": 0, "sequences": 0,
                               "chunks_issued": 0, "bytes_shipped": 0}
+        #: fused speculative-step accounting (``put_spec``): the
+        #: scheduler's accepted-tokens/step metric cross-checks these
+        self.spec_stats = {"steps": 0, "lanes": 0, "drafted": 0,
+                           "accepted": 0, "emitted": 0,
+                           "rolled_back": 0}
         #: open restore lanes, mirroring the real engine's decode-
         #: interleaved surface: each lane is a dict with the staged
         #: items, a chunk cursor and the owed post_forward state ops
@@ -163,6 +172,94 @@ class SimulatedEngine:
             else:
                 latents.append(None)
         return logits, latents
+
+    # ------------------------------------------------------------- #
+    # fused speculative verify step (the serving speculation surface)
+    # ------------------------------------------------------------- #
+    def put_spec(self, batch_uids: Iterable[int], batch_feeds,
+                 do_checks: bool = True):
+        """One fused speculative step over DECODE residents: each feed
+        is ``[fed_token] + draft``. The engine verifies the stretch
+        against its own greedy targets, accepts the matching draft
+        prefix plus the bonus token, rolls the rejected draft KV back
+        (``SequenceDescriptor.rollback`` — blocks stay allocated, the
+        next dispatch overwrites the same slots, exactly the real
+        engine's arithmetic), and captures latents **only for the
+        accepted span** — a preempt after this call trivially holds a
+        latent payload ending at the last accepted token.
+
+        Returns ``(emitted, latents)``: ``emitted[i]`` is the accepted
+        greedy tokens (``>= 1``, ``<= len(feed)``), ``latents[i]`` a
+        ``[L, len(emitted[i]), H]`` slab (None without latent capture).
+        Greedy-exact: the emitted stream is bitwise identical to
+        feeding the same lanes one token at a time through ``put``."""
+        batch_uids = list(batch_uids)
+        batch_feeds = [list(np.asarray(f, np.int32).reshape(-1))
+                       for f in batch_feeds]
+        if any(len(f) < 1 for f in batch_feeds):
+            raise ValueError("put_spec feeds need >= 1 token "
+                             "(the fed token)")
+        if do_checks:
+            result = self.can_schedule(
+                batch_uids, [len(f) for f in batch_feeds])
+            if result != SchedulingResult.Success:
+                raise SchedulingError(result)
+        self._reject_suspended(batch_uids)
+        for uid in batch_uids:
+            if self.state.get_sequence(uid) is None:
+                raise KeyError(
+                    f"put_spec: unknown sequence {uid} (speculation "
+                    "runs on decode residents only)")
+        inj = get_injector()
+        if inj.enabled and batch_uids:
+            # fires BEFORE any state mutates (same discipline as put):
+            # a faulted speculative dispatch is cleanly retryable /
+            # quarantinable with every lane still at its last accepted
+            # token
+            inj.fire("engine.spec", uid=batch_uids[-1],
+                     uids=tuple(batch_uids))
+        # allocation pre-pass for the WORST case (full feed incl. the
+        # draft tail) — claimed-but-rolled-back blocks stay with the
+        # sequence and are reused by later growth
+        for uid, feed in zip(batch_uids, batch_feeds):
+            seq = self.state.get_sequence(uid)
+            try:
+                self.state.maybe_allocate_kv(seq, len(feed))
+            except InjectedFault as f:
+                if f.uid is None:
+                    f.uid = uid
+                    f.ctx["uid"] = uid
+                raise
+        self.counts["put"] += 1
+        self.spec_stats["steps"] += 1
+        emitted_out: List[List[int]] = []
+        latents: List = []
+        for uid, feed in zip(batch_uids, batch_feeds):
+            seq = self.state.get_sequence(uid)
+            start = seq.seen_tokens
+            d = len(feed) - 1
+            greedy = [self._token(uid, start + 1 + t)
+                      for t in range(d + 1)]
+            acc = 0
+            while acc < d and feed[1 + acc] == greedy[acc]:
+                acc += 1
+            seq.pre_forward(len(feed))
+            seq.post_forward()
+            seq.rollback(d - acc)       # rejected draft KV
+            emitted = greedy[:acc + 1]
+            emitted_out.append(emitted)
+            self.spec_stats["lanes"] += 1
+            self.spec_stats["drafted"] += d
+            self.spec_stats["accepted"] += acc
+            self.spec_stats["emitted"] += len(emitted)
+            self.spec_stats["rolled_back"] += d - acc
+            if self.config.hcache.enable_latents:
+                latents.append(np.full(
+                    (self.N_LAYER, acc + 1, self.HIDDEN),
+                    float(seq.seen_tokens), np.float32))
+            else:
+                latents.append(None)
+        return emitted_out, latents
 
     # ------------------------------------------------------------- #
     def restore_kv(self, batch_uids: Iterable[int], batch_tokens,
@@ -385,6 +482,7 @@ class SimulatedEngine:
             "scratch_block": self._scratch_block,
             "counts": dict(self.counts),
             "restore_stats": dict(self.restore_stats),
+            "spec_stats": dict(self.spec_stats),
             "restore_lanes": [
                 {"uids": list(l["uids"]), "nbytes": l["nbytes"],
                  "next_chunk": l["next_chunk"], "chunks": l["chunks"]}
@@ -422,6 +520,9 @@ class SimulatedEngine:
         eng.counts = {k: int(v) for k, v in snapshot["counts"].items()}
         eng.restore_stats = {k: int(v) for k, v
                              in snapshot["restore_stats"].items()}
+        eng.spec_stats = {k: int(v) for k, v
+                          in snapshot.get("spec_stats",
+                                          eng.spec_stats).items()}
         eng._restore_lanes = []
         for lane in snapshot["restore_lanes"]:
             uids = [int(u) for u in lane["uids"]]

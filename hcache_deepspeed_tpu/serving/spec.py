@@ -1,0 +1,223 @@
+"""Scheduler-grain speculative decoding: config, drafting, validation.
+
+The engine has had fused prompt-lookup speculative decoding
+(``engine_v2.generate_lookup_fused``) since the LOOKUP_1B campaign, but
+only as a whole-generation API the serving scheduler never dispatched.
+This module is the serving half: the *per-step* speculation contract
+the continuous-batching scheduler drives.
+
+Per step, for every DECODE resident, the scheduler
+
+1. **drafts** up to ``max_draft`` tokens from the request's own history
+   with prompt-lookup (:func:`lookup_draft` — the same PLD n-gram match
+   as the engine's fused loop, host-side over ``prompt + tokens_out``);
+2. **dispatches** ONE fused verify step (``engine.put_spec``): the
+   ragged batch feeds ``[fed_token] + draft`` per lane, the engine
+   verifies the whole stretch against its own greedy targets, accepts
+   the matching prefix plus the bonus token, and **rolls the rejected
+   draft KV back** before any state leaves the call — so the scheduler
+   only ever observes sequences whose cached span equals their accepted
+   span. A mid-speculation preempt therefore trivially "rolls back to
+   the last accepted token before capturing latents": rejected drafts
+   never reach the latent store at all;
+3. **accounts** accepted-tokens/step in ``ServingMetrics`` and stamps
+   the speculation phase attrs into the request's ``TraceContext``.
+
+Speculation is greedy-exact by construction (acceptance compares drafts
+against the verified greedy targets), so the output stream is bitwise
+identical to non-speculative greedy decoding — the parity gate the
+SPEC_SERVE artifact commits.
+
+Validation follows the ``validate_overlap_config`` pattern: impossible
+knob combinations raise :class:`~..runtime.config.HDSConfigError`
+at parse/build time — no silent clamps.
+"""
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..runtime.config import HDSConfigError
+
+
+@dataclass(frozen=True)
+class SpeculationConfig:
+    """Scheduler-dispatched speculative decode knobs (docs/serving.md).
+
+    Defaults mirror the engine's fused lookup loop; ``enabled=False``
+    is the historical scheduler (committed chaos digests replay)."""
+    enabled: bool = True
+    #: trailing n-gram matched against the history window
+    ngram: int = 2
+    #: max tokens drafted (and verified) per lane per step
+    max_draft: int = 4
+    #: history tokens the n-gram search scans (host-side here, so the
+    #: window costs nothing on device; kept as a knob for parity with
+    #: the fused on-device loop's static shape)
+    window: int = 128
+    #: residents with fewer than this many history tokens skip
+    #: drafting (0 = auto: ngram + 1, the match-feasibility floor)
+    min_history: int = 0
+
+
+@dataclass(frozen=True)
+class SLOModeConfig:
+    """SLO-aware degradation mode: drive the serving degradation ladder
+    from TTFT/TPOT error-budget burn instead of the fault rate.
+
+    Escalation (each level includes the ones below)::
+
+        level 1   speculation off (drafted tokens stop inflating the
+                  per-step token budget)
+        level 2   chunked prefill forced (long prompts stop head-of-
+                  line blocking the decode batch)
+        level 3   shed one lowest-priority queued request per step
+                  (typed reason "shed_slo")
+
+    A level escalates after ``hot_steps`` consecutive steps with any
+    watched burn rate above its threshold, and de-escalates after
+    ``calm_steps`` consecutive steps with every burn rate below —
+    the same hysteresis discipline as the fault-driven ladder."""
+    enabled: bool = True
+    #: TTFT burn-rate threshold (1.0 = burning the budget exactly as
+    #: fast as the objective allows)
+    ttft_burn_threshold: float = 2.0
+    #: TPOT burn-rate threshold
+    tpot_burn_threshold: float = 2.0
+    #: consecutive hot steps before stepping one level up
+    hot_steps: int = 4
+    #: consecutive calm steps before stepping one level down
+    calm_steps: int = 8
+    #: prefill chunk forced at level >= 2 (scheduler-grain Dynamic
+    #: SplitFuse; ignored when a smaller chunk is already configured)
+    chunked_prefill_tokens: int = 16
+
+
+def validate_speculation_config(spec: SpeculationConfig,
+                                engine_config=None) -> None:
+    """Reject impossible speculation knob combinations with a typed
+    :class:`HDSConfigError` (the ``validate_overlap_config`` pattern:
+    fail loudly at parse/build, never clamp silently)."""
+    if spec is None or not spec.enabled:
+        return
+    if spec.ngram < 1:
+        raise HDSConfigError(
+            f"speculation_ngram must be >= 1, got {spec.ngram}")
+    if spec.max_draft < 1:
+        raise HDSConfigError(
+            f"speculation max_draft must be >= 1, got {spec.max_draft}")
+    if spec.window <= spec.ngram:
+        raise HDSConfigError(
+            f"speculation window ({spec.window}) must exceed ngram "
+            f"({spec.ngram}): a window that cannot hold one n-gram "
+            "plus a draft can never match")
+    if spec.min_history < 0:
+        raise HDSConfigError(
+            f"speculation min_history must be >= 0, got "
+            f"{spec.min_history}")
+    if engine_config is not None and \
+            getattr(engine_config.state_manager, "prefix_caching",
+                    False):
+        raise HDSConfigError(
+            "speculation with prefix_caching on the same engine is "
+            "unsupported: rolled-back draft KV must never be "
+            "registered as a sharable prefix (disable one of them)")
+
+
+def validate_slo_mode_config(slo: SLOModeConfig) -> None:
+    """Typed validation for the SLO-aware degradation mode knobs."""
+    if slo is None or not slo.enabled:
+        return
+    if slo.ttft_burn_threshold <= 0 or slo.tpot_burn_threshold <= 0:
+        raise HDSConfigError(
+            "SLO-mode burn thresholds must be > 0 "
+            f"(ttft={slo.ttft_burn_threshold}, "
+            f"tpot={slo.tpot_burn_threshold})")
+    if slo.hot_steps < 1 or slo.calm_steps < 1:
+        raise HDSConfigError(
+            "SLO-mode hot_steps/calm_steps must be >= 1 "
+            f"(hot={slo.hot_steps}, calm={slo.calm_steps})")
+    if slo.chunked_prefill_tokens < 1:
+        raise HDSConfigError(
+            "SLO-mode chunked_prefill_tokens must be >= 1, got "
+            f"{slo.chunked_prefill_tokens}")
+
+
+def lookup_draft(history: Sequence[int], ngram: int, k: int,
+                 window: int = 0) -> List[int]:
+    """Prompt-lookup drafting over a token history: find the most
+    recent PRIOR occurrence of the trailing ``ngram`` tokens inside the
+    last ``window`` tokens (0 = whole history) and propose the ``k``
+    tokens that followed it. The host-side twin of the engine's fused
+    on-device n-gram search — a bad draft only costs speed, never
+    correctness, because acceptance compares against verified greedy
+    targets."""
+    n = len(history)
+    if n < ngram + 1 or k < 1:
+        return []
+    if window and n > window:
+        history = history[n - window:]
+        n = window
+    arr = np.asarray(history, np.int64)
+    key = arr[-ngram:]
+    limit = n - ngram
+    if limit <= 0:
+        return []
+    windows = np.lib.stride_tricks.sliding_window_view(
+        arr[:n - 1], ngram)[:limit]
+    hits = np.flatnonzero((windows == key).all(axis=1))
+    if hits.size == 0:
+        return []
+    i = int(hits[-1]) + ngram          # first token after the match
+    return [int(t) for t in arr[i:i + k]]
+
+
+class SLODegradation:
+    """The SLO-aware escalation state machine the scheduler steps.
+
+    Pure host state, deterministic under the virtual clock: the inputs
+    are the burn-rate gauges the metrics layer computed from virtual
+    timestamps, so two same-seed runs walk identical level sequences.
+    Levels: 0 normal, 1 speculation off, 2 + forced chunked prefill,
+    3 + shed."""
+
+    #: level semantics (indexable by level for events/logs)
+    LEVELS = ("normal", "spec_off", "chunked_prefill", "shed")
+
+    def __init__(self, config: Optional[SLOModeConfig]):
+        self.config = config
+        self.level = 0
+        self._hot = 0
+        self._calm = 0
+        self.degraded_steps = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.config is not None and self.config.enabled
+
+    def observe(self, gauges) -> int:
+        """Feed one step's burn-rate gauges; returns the level to apply
+        to the next scheduling decisions."""
+        if not self.enabled:
+            return 0
+        c = self.config
+        ttft = float(gauges.get("slo_ttft_burn_rate", 0.0))
+        tpot = float(gauges.get("slo_tpot_burn_rate", 0.0))
+        hot = (ttft > c.ttft_burn_threshold or
+               tpot > c.tpot_burn_threshold)
+        if hot:
+            self._hot += 1
+            self._calm = 0
+            if self._hot >= c.hot_steps and self.level < 3:
+                self.level += 1
+                self._hot = 0
+        else:
+            self._calm += 1
+            self._hot = 0
+            if self._calm >= c.calm_steps and self.level > 0:
+                self.level -= 1
+                self._calm = 0
+        if self.level > 0:
+            self.degraded_steps += 1
+        return self.level

@@ -220,6 +220,22 @@ class TraceContext:
         if self.open is not None:
             self.open.phase = phase
 
+    def note(self, **attrs) -> None:
+        """Stamp attrs onto the open span (additively for numeric
+        values): the closure-safe way to record per-phase facts that
+        are not time — e.g. the speculation counters (drafted /
+        accepted / spec steps) a decode span accumulated. Numeric
+        attrs sum across calls so a span carries its phase totals;
+        non-numeric attrs overwrite."""
+        if self.open is None:
+            return
+        for k, v in attrs.items():
+            if isinstance(v, (int, float)) and \
+                    isinstance(self.open.attrs.get(k), (int, float)):
+                self.open.attrs[k] = self.open.attrs[k] + v
+            else:
+                self.open.attrs[k] = v
+
     def charge(self, name: str, seconds: float) -> None:
         """Carve a named slice (e.g. ``retry_backoff``) out of the
         open span; attribution reports it as its own category."""

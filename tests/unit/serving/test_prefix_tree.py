@@ -1,0 +1,172 @@
+"""Radix prefix tree + replica warm-prefix cache (tier-1).
+
+The honesty contract under test: the tree is keyed on FULL token-id
+paths (CRC survives only as a node fingerprint, so a fingerprint
+collision can never merge two distinct prefixes — the regression the
+old CRC-keyed affinity LRU was vulnerable to), matches are exact
+leading-token runs, LRU eviction is stamp-driven and deterministic,
+and the per-replica cache slices covering payloads for partial
+matches.
+"""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+from hcache_deepspeed_tpu.serving import (PrefixReuseConfig,
+                                          RadixPrefixTree,
+                                          ReplicaPrefixCache,
+                                          validate_prefix_reuse_config)
+
+
+def payload(n, layers=2, hidden=3, base=0.0):
+    return (np.arange(layers * n * hidden, dtype=np.float32)
+            .reshape(layers, n, hidden) + base)
+
+
+class TestRadixTree:
+
+    def test_longest_match_through_edge_splits(self):
+        t = RadixPrefixTree()
+        t.insert([1, 2, 3, 4, 5, 6], replica=0, stamp=1)
+        t.insert([1, 2, 3, 9, 9, 9], replica=1, stamp=2)
+        assert t.longest_match([1, 2, 3, 4, 5, 6, 7]) == (6, {0: 1})
+        assert t.longest_match([1, 2, 3, 9, 0]) == (4, {1: 2})
+        # mid-edge partial match: both owners hold the shared head
+        m, owners = t.longest_match([1, 2])
+        assert m == 2 and owners == {0: 1, 1: 2}
+        assert t.longest_match([8, 8]) == (0, {})
+
+    def test_payload_key_returns_covering_path(self):
+        t = RadixPrefixTree()
+        t.insert([5, 6, 7, 8, 9, 10], replica=0, stamp=1)
+        m, key = t.payload_key([5, 6, 7, 8, 0, 0], 0)
+        assert m == 4 and key == (5, 6, 7, 8, 9, 10)
+        assert t.payload_key([5, 6, 7, 8], 1) == (0, ())
+
+    def test_fingerprint_collision_regression(self):
+        """The old affinity map keyed on crc32(prefix): two distinct
+        prefixes with one CRC collapsed into one bonus. The tree must
+        separate every distinct path even when EVERY node shares one
+        fingerprint — token ids are the key, the fingerprint is a
+        diagnostic hint."""
+        t = RadixPrefixTree(fingerprint=lambda tokens: 0xDEAD)
+        t.insert([1, 1, 1, 1], replica=0, stamp=1)
+        t.insert([2, 2, 2, 2], replica=1, stamp=2)
+        t.insert([1, 1, 2, 2], replica=2, stamp=3)
+        assert t.longest_match([1, 1, 1, 1]) == (4, {0: 1})
+        assert t.longest_match([2, 2, 2, 2]) == (4, {1: 2})
+        assert t.longest_match([1, 1, 2, 2]) == (4, {2: 3})
+        # the shared [1, 1] head is owned by both its registrants
+        assert t.longest_match([1, 1]) == (2, {0: 1, 2: 3})
+
+    def test_lru_eviction_by_stamp(self):
+        t = RadixPrefixTree(max_paths=2)
+        for i in range(5):
+            t.insert([i, i + 1, i + 2], replica=0, stamp=i)
+        assert len(t.paths) == 2
+        assert t.evictions == 3
+        assert t.longest_match([0, 1, 2]) == (0, {})
+        assert t.longest_match([4, 5, 6])[0] == 3
+
+    def test_evict_replica_clears_marks(self):
+        t = RadixPrefixTree()
+        t.insert([1, 2, 3, 4], replica=0, stamp=1)
+        t.insert([1, 2, 5, 6], replica=1, stamp=2)
+        t.evict_replica(0)
+        assert t.longest_match([1, 2, 3, 4]) == (2, {1: 2})
+        assert t.payload_key([1, 2, 3, 4], 0) == (0, ())
+        assert len(t.paths) == 1
+
+    def test_reinsert_after_evict(self):
+        t = RadixPrefixTree()
+        t.insert([3, 1, 4], replica=0, stamp=1)
+        t.evict_replica(0)
+        t.insert([3, 1, 4], replica=2, stamp=5)
+        assert t.longest_match([3, 1, 4]) == (3, {2: 5})
+
+
+class TestReplicaPrefixCache:
+
+    def cfg(self, **kw):
+        base = dict(min_adopt_tokens=4, min_broadcast_tokens=4,
+                    broadcast=False)
+        base.update(kw)
+        return PrefixReuseConfig(**base)
+
+    def test_register_lookup_slices_partial_match(self):
+        c = ReplicaPrefixCache(self.cfg(), replica_id=0)
+        assert c.register(list(range(8)), payload(8), stamp=1)
+        m, p = c.lookup(list(range(6)) + [99, 98])
+        assert m == 6 and p.shape == (2, 6, 3)
+        np.testing.assert_array_equal(p, payload(8)[:, :6])
+
+    def test_lookup_caps_at_prompt_minus_one(self):
+        c = ReplicaPrefixCache(self.cfg(), replica_id=0)
+        c.register(list(range(8)), payload(8), stamp=1)
+        m, p = c.lookup(list(range(8)))
+        assert m == 7      # the last prompt token must still prefill
+
+    def test_short_prefix_not_registered(self):
+        c = ReplicaPrefixCache(self.cfg(min_adopt_tokens=8),
+                               replica_id=0)
+        assert not c.register([1, 2, 3], payload(3), stamp=1)
+        assert c.lookup([1, 2, 3, 4]) == (0, None)
+
+    def test_byte_bounded_eviction(self):
+        c = ReplicaPrefixCache(
+            self.cfg(max_cache_bytes=payload(8).nbytes + 1),
+            replica_id=0)
+        c.register(list(range(8)), payload(8), stamp=1)
+        c.register(list(range(50, 58)), payload(8, base=5.0), stamp=2)
+        assert c.evictions == 1 and len(c.store) == 1
+        # evicted entry: tree may still know the path but the store
+        # answers (0, None) rather than a dangling payload
+        assert c.lookup(list(range(8)) + [9])[1] is None
+
+    def test_install_marks_shared_tree(self):
+        tree = RadixPrefixTree()
+        a = ReplicaPrefixCache(self.cfg(), tree=tree, replica_id=0)
+        b = ReplicaPrefixCache(self.cfg(), tree=tree, replica_id=1)
+        a.register(list(range(8)), payload(8), stamp=1)
+        b.install(tuple(range(8)), payload(8), stamp=2)
+        m, owners = tree.longest_match(list(range(8)))
+        assert m == 8 and set(owners) == {0, 1}
+        assert b.lookup(list(range(8)) + [0])[0] == 8
+        assert b.installs == 1
+
+    def test_drop_all_on_crash(self):
+        tree = RadixPrefixTree()
+        a = ReplicaPrefixCache(self.cfg(), tree=tree, replica_id=0)
+        a.register(list(range(8)), payload(8), stamp=1)
+        a.drop_all()
+        assert tree.longest_match(list(range(8))) == (0, {})
+        assert a.lookup(list(range(8)) + [0]) == (0, None)
+
+
+class TestValidation:
+
+    def test_broadcast_without_fleet_rejected(self):
+        with pytest.raises(HDSConfigError, match="fleet"):
+            validate_prefix_reuse_config(
+                PrefixReuseConfig(broadcast=True), in_fleet=False)
+        # ...and the cache constructor applies the same gate
+        with pytest.raises(HDSConfigError, match="fleet"):
+            ReplicaPrefixCache(PrefixReuseConfig(broadcast=True),
+                               in_fleet=False)
+
+    def test_bad_bounds_rejected(self):
+        with pytest.raises(HDSConfigError):
+            validate_prefix_reuse_config(
+                PrefixReuseConfig(min_adopt_tokens=0))
+        with pytest.raises(HDSConfigError):
+            validate_prefix_reuse_config(
+                PrefixReuseConfig(max_prefix_tokens=4,
+                                  min_adopt_tokens=8))
+        with pytest.raises(HDSConfigError):
+            validate_prefix_reuse_config(PrefixReuseConfig(max_paths=0))
+
+    def test_disabled_config_skips_validation(self):
+        validate_prefix_reuse_config(
+            PrefixReuseConfig(enabled=False, min_adopt_tokens=0),
+            in_fleet=False)

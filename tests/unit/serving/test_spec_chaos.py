@@ -1,0 +1,210 @@
+"""Speculation x resilience composition (tier-1, chaos-marked).
+
+The rollback-composes-with-everything contract: faults and preempts
+landing mid-speculation must leave zero block leaks, exactly one
+terminal state per request, and token streams bitwise-equal to
+non-speculative greedy decoding (the sim's deterministic token
+function makes every DONE request's expected stream computable in
+closed form). Plus the fleet-scope half: prefix reuse + broadcast
+under replica crash keeps the never-dropped and balance invariants.
+"""
+
+import pytest
+
+from hcache_deepspeed_tpu.inference.config import \
+    RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.resilience import FaultPlan, FaultRule
+from hcache_deepspeed_tpu.resilience.faults import injected
+from hcache_deepspeed_tpu.serving import (
+    FleetConfig, PrefixReuseConfig, Request, RouterConfig,
+    ServerConfig, ServingFleet, ServingServer, SimulatedEngine,
+    SpeculationConfig, VirtualClock)
+
+pytestmark = pytest.mark.chaos
+
+SPEC = SpeculationConfig(ngram=2, max_draft=4, window=64)
+
+
+def make_engine(num_blocks=12, lanes=4, tracked=8, vocab=16):
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": tracked,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": lanes,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": num_blocks},
+        hcache={"enable_latents": True}), vocab_size=vocab)
+
+
+def expected_stream(engine, req):
+    """Closed-form greedy stream of the deterministic sim: token t of
+    request uid depends only on (uid, cached position)."""
+    plen = len(req.prompt)
+    return [engine._token(req.uid, plen + k)
+            for k in range(len(req.tokens_out))]
+
+
+def trace(n=8, max_new=24, plen=10):
+    return [Request(uid=i,
+                    prompt=[(3 * i + j) % 13 + 1 for j in range(plen)],
+                    max_new_tokens=max_new,
+                    arrival_time=0.004 * i) for i in range(n)]
+
+
+def spec_fault_plan(seed=0):
+    """Faults aimed at the speculative path: the engine.spec site
+    fires mid-storm (before any state mutates), alongside the restore
+    and latent sites speculation must co-exist with."""
+    return FaultPlan(seed=seed, rules=[
+        FaultRule("engine.spec", at_hits=(3, 9), probability=0.05,
+                  max_faults=4),
+        FaultRule("engine.decode", probability=0.02, max_faults=2),
+        FaultRule("restore.ship", at_hits=(2,), probability=0.05,
+                  max_faults=3),
+        FaultRule("host.latents", at_hits=(17,), probability=0.005,
+                  max_faults=1),
+    ])
+
+
+def run_spec_chaos(seed=0):
+    engine = make_engine()
+    initial_free = engine.state.free_blocks
+    server = ServingServer(
+        engine, clock=VirtualClock(),
+        config=ServerConfig(max_queue_depth=64,
+                            kv_demand_fraction=float("inf"),
+                            speculation=SPEC))
+    reqs = trace()
+    with injected(spec_fault_plan(seed)):
+        server.run_trace(reqs)
+    return engine, server, reqs, initial_free
+
+
+class TestFaultMidSpeculation:
+
+    def test_invariants_and_stream_parity(self):
+        engine, server, reqs, initial_free = run_spec_chaos()
+        # exactly-one-terminal-state
+        terminal = {"DONE", "REJECTED", "FAILED"}
+        for r in reqs:
+            assert r.state.name in terminal, r
+            assert r.uid in server.scheduler.done
+        # zero block leaks, nothing tracked
+        assert engine.state.free_blocks == initial_free
+        assert engine.state.n_tracked_sequences == 0
+        # every DONE request's stream is bitwise the non-speculative
+        # greedy stream (closed form of the deterministic sim)
+        done = [r for r in reqs if r.state.name == "DONE"]
+        assert done
+        for r in done:
+            assert r.tokens_out == expected_stream(engine, r), r.uid
+        # the spec fault site actually fired and was contained
+        assert server.scheduler.total_faults > 0
+        assert server.metrics.counters["spec_lane_steps"] > 0
+
+    def test_two_runs_byte_identical(self):
+        def go():
+            _, server, _, _ = run_spec_chaos(seed=3)
+            return [tuple(e) for e in server.scheduler.events]
+        assert go() == go()
+
+    def test_spec_fault_quarantines_offender_only(self):
+        engine = make_engine()
+        server = ServingServer(
+            engine, clock=VirtualClock(),
+            config=ServerConfig(max_queue_depth=64,
+                                kv_demand_fraction=float("inf"),
+                                speculation=SPEC))
+        reqs = trace(n=6)
+        plan = FaultPlan(seed=1, rules=[
+            FaultRule("engine.spec", at_hits=(4,), max_faults=1)])
+        with injected(plan):
+            server.run_trace(reqs)
+        failed = [r for r in reqs if r.state.name == "FAILED"]
+        done = [r for r in reqs if r.state.name == "DONE"]
+        # blame was attributable: exactly one request quarantined,
+        # everyone else finished with exact streams
+        assert len(failed) == 1
+        assert failed[0].error.startswith("engine_fault:")
+        for r in done:
+            assert r.tokens_out == expected_stream(engine, r)
+        assert engine.state.n_tracked_sequences == 0
+
+
+class TestPrefixReuseUnderChaos:
+
+    def _fleet(self, prefix=True):
+        def eng():
+            return make_engine(num_blocks=40, lanes=4, tracked=8)
+        return ServingFleet(
+            engines=[eng() for _ in range(3)], clock=VirtualClock(),
+            config=FleetConfig(
+                n_replicas=3,
+                server=ServerConfig(max_queue_depth=128,
+                                    kv_demand_fraction=float("inf"),
+                                    speculation=SPEC),
+                router=RouterConfig(prefix_weight=0.05),
+                prefix=PrefixReuseConfig(min_adopt_tokens=6,
+                                         min_broadcast_tokens=6)
+                if prefix else None))
+
+    def _shared_trace(self, n=20):
+        shared = list(range(1, 17))
+        return [Request(uid=i, prompt=shared + [i % 7 + 1, i % 5 + 1],
+                        max_new_tokens=10,
+                        arrival_time=0.006 * i) for i in range(n)]
+
+    def test_crash_mid_reuse_never_drops(self):
+        fleet = self._fleet()
+        reqs = self._shared_trace()
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule("replica.crash", at_hits=(30,), max_faults=1)])
+        with injected(plan):
+            fleet.run_trace(reqs)
+        terminal = {"DONE", "REJECTED", "FAILED"}
+        for r in reqs:
+            assert r.state.name in terminal
+            holders = sum(1 for rep in fleet.replicas
+                          if r.uid in rep.scheduler.done)
+            holders += 1 if r.uid in fleet.done else 0
+            assert holders == 1, r.uid
+        assert fleet.counters["replica_crashes"] == 1
+        assert fleet.migration_balance_ok
+        # the dead replica's warm prefixes left the shared tree
+        dead = [rep for rep in fleet.replicas
+                if rep.state.name == "DEAD"]
+        assert len(dead) == 1
+        assert dead[0].id not in {
+            rid for _, owners in fleet.prefix_tree.paths.items()
+            for rid in owners}
+        # survivors leak nothing
+        for rep in fleet.replicas:
+            if rep.state.name == "DEAD":
+                continue
+            assert rep.engine.state.free_blocks == \
+                rep.initial_free_blocks
+            assert rep.engine.state.n_tracked_sequences == 0
+
+    def test_reuse_fleet_streams_match_affinity_only_fleet(self):
+        base_fleet = self._fleet(prefix=False)
+        base = self._shared_trace()
+        base_fleet.run_trace(base)
+        reuse_fleet = self._fleet(prefix=True)
+        reuse = self._shared_trace()
+        reuse_fleet.run_trace(reuse)
+        assert {r.uid: r.tokens_out for r in base} == \
+               {r.uid: r.tokens_out for r in reuse}
+        adopted = sum(rep.server.metrics.counters["prefix_adoptions"]
+                      for rep in reuse_fleet.replicas)
+        assert adopted > 0
+        # reuse actually removed prompt tokens from the prefill path
+        reused = sum(
+            rep.server.metrics.counters["prefix_tokens_reused"]
+            for rep in reuse_fleet.replicas)
+        assert reused >= 6 * adopted
+
+    def test_two_reuse_runs_byte_identical(self):
+        def go():
+            fleet = self._fleet()
+            fleet.run_trace(self._shared_trace())
+            return fleet.event_log()
+        assert go() == go()
