@@ -80,9 +80,24 @@ print("hierarchical verdict: structural =",
       "| pod wire s (inter/intra) =",
       s.get("hier_pod_wire_seconds_inter"),
       s.get("hier_pod_wire_seconds_intra"))
+print("calibration leg (MEASURED per-axis GB/s vs declared;",
+      "re-prices the pod projection with hardware numbers):",
+      "gbps inter/intra =", s.get("wire_cal_gbps_inter"),
+      s.get("wire_cal_gbps_intra"),
+      "| divergence vs declared =", s.get("wire_cal_divergence_inter"),
+      s.get("wire_cal_divergence_intra"))
+print("pod-scale legs: unified hpZ bitwise =",
+      s.get("hier_hpz_unified_bitwise"),
+      "| pipelined bitwise/structural/cross-axis =",
+      s.get("hier_pipelined_bitwise"),
+      s.get("hier_pipelined_structural_ratio"),
+      s.get("hier_pipelined_cross_axis_pairs"),
+      "| 16-dev parity =", s.get("hier_16dev_parity"))
 EOF
   echo "next: commit ZERO_OVERLAP_TPU.jsonl, refresh PERF_TRAJECTORY" \
        "(python -m hcache_deepspeed_tpu.perf index --out" \
-       "PERF_TRAJECTORY.json) and update the COMPONENTS.md Domino row" >&2
+       "PERF_TRAJECTORY.json) and update the COMPONENTS.md Domino row;" \
+       "fold the measured wire_cal_gbps_* into zero_mesh_link_gbps for" \
+       "future declared-model runs" >&2
 fi
 exit $rc

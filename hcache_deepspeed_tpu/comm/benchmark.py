@@ -3,6 +3,18 @@
 Reference analog: ``bin/ds_bench`` → DeepSpeed's comm benchmark — sweeps
 message sizes through allreduce/allgather/etc. and reports busbw/algbw.
 Here the collectives are the jax.lax set over the live mesh axes.
+
+``calibrate_mesh_axes`` (ISSUE 15) is the MEASURED counterpart of the
+per-axis wire-cost model's declared bandwidths: it times grouped
+neighbor-``ppermute`` rounds along each axis of a ``HierMeshSpec``
+(wall clock — this module is the explicit measurement entry point, the
+one place outside the sim-determinism purity perimeter that may read
+the clock) and emits calibrated per-axis GB/s with declared-vs-measured
+divergence. ``profiling/hlo_audit.py wire_cost_seconds`` consumes the
+result with ``calibration="measured"`` so an artifact row always says
+where its bandwidths came from. On CPU the numbers are shape-valid but
+physically meaningless (the harness self-validates structure); on chip
+this is the ``bin/chip_overlap_campaign.sh`` calibration leg.
 """
 
 import sys
@@ -71,6 +83,217 @@ def run_collective_bench(op="all_reduce", sizes=None, trials=10,
         print(f"{numel:>12} {size_bytes:>12} {ms:>10.3f} {bw:>12.2f}",
               file=out)
     return rows
+
+
+def calibrate_mesh_axes(spec, *, mesh=None, axis="data",
+                        payload_bytes=(1 << 16, 1 << 20), trials=5,
+                        rounds=None, seed=0):
+    """Measured per-axis wire calibration: time grouped neighbor
+    ``ppermute`` rounds along EACH axis of ``spec`` (a
+    ``comm.hierarchical.HierMeshSpec``) at the given payload sizes and
+    fit per-axis GB/s.
+
+    Per axis ``j``: every device sends its payload to its ring
+    neighbor within the dim-``j`` groups (``axis_groups`` — exactly
+    the grouped transport the hierarchical collectives ride), chained
+    ``rounds`` times (default ``size - 1``, one full ring revolution).
+    Wall-clock per round / payload bytes = the measured per-device
+    link bandwidth on that axis. Each timed iteration is synced
+    (``block_until_ready``) — the conservative, launch-gap-free
+    number.
+
+    Returns ``{"rows": [per (axis, payload) rows], "gbytes_per_s":
+    {axis: headline GB/s (largest payload)}, "divergence_vs_declared":
+    {axis: measured/declared or None}, "calibration": "measured",
+    "backend": ...}``. The declared bandwidths come from the spec's
+    own ``gbytes_per_s`` fields; axes without one report divergence
+    ``None`` (visible, not silently dropped).
+    """
+    from functools import partial
+
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from .hierarchical import axis_groups
+
+    n = spec.world
+    if mesh is None:
+        devs = jax.devices()
+        if len(devs) < n:
+            raise ValueError(
+                f"calibrate_mesh_axes: mesh spec {list(spec.sizes)} "
+                f"needs {n} devices, found {len(devs)}")
+        mesh = Mesh(np.array(devs[:n]).reshape(n), (axis,))
+
+    rows = []
+    headline = {}
+    divergence = {}
+    rng = np.random.default_rng(seed)
+    for dim, ax in enumerate(spec.axes):
+        groups = axis_groups(spec.sizes, dim)
+        m = ax.size
+        perm = [(g[k], g[(k + 1) % m]) for g in groups for k in range(m)]
+        n_rounds = int(rounds) if rounds else max(1, m - 1)
+
+        def chain(xl, perm=perm, n_rounds=n_rounds):
+            cur = xl[0]
+            for _ in range(n_rounds):
+                cur = jax.lax.ppermute(cur, axis, perm)
+            return cur[None]
+
+        for nbytes in payload_bytes:
+            elems = max(1, int(nbytes) // 4)
+            x = jnp.asarray(rng.standard_normal((n, elems)), jnp.float32)
+            fn = jax.jit(partial(
+                jax.shard_map, mesh=mesh, axis_names={axis},
+                in_specs=P(axis), out_specs=P(axis),
+                check_vma=False)(chain))
+            jax.block_until_ready(fn(x))           # compile
+            t0 = time.perf_counter()
+            for _ in range(trials):
+                jax.block_until_ready(fn(x))
+            per_round = (time.perf_counter() - t0) / trials / n_rounds
+            gbps = (elems * 4) / per_round / 1e9
+            rows.append({
+                "axis": ax.name, "axis_size": m, "rounds": n_rounds,
+                "payload_bytes": elems * 4, "trials": trials,
+                "seconds_per_round": per_round,
+                "measured_gbytes_per_s": gbps,
+                "declared_gbytes_per_s": ax.gbytes_per_s,
+            })
+            headline[ax.name] = gbps
+        decl = ax.gbytes_per_s
+        divergence[ax.name] = (headline[ax.name] / decl) if decl \
+            else None
+    return {"rows": rows, "gbytes_per_s": headline,
+            "divergence_vs_declared": divergence,
+            "calibration": "measured",
+            "backend": jax.default_backend()}
+
+
+#: child program for the 16-device factoring parity leg: 4x4 and 2x8
+#: hierarchical collectives bitwise vs native (fp32 + bf16), the
+#: unified hpZ tier at hpz=4 on 4x4, and pipelined-gather parity —
+#: run in its own interpreter because the parent harness pins the CPU
+#: device count at 8. Shared by ``bench.py --zero-overlap``'s
+#: hier-16dev phase and tests/unit/comm/test_hier_16dev.py, so the
+#: committed artifact and the slow test exercise the same program.
+SIXTEEN_DEV_CHILD = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hcache_deepspeed_tpu.comm.hierarchical import (
+    hierarchical_all_gather, hierarchical_all_to_all_rows,
+    hierarchical_reduce_scatter_sum, make_mesh_spec)
+
+devs = jax.devices()
+assert len(devs) >= 16, f"need 16 virtual devices, got {len(devs)}"
+mesh = Mesh(np.array(devs[:16]).reshape(16), ("d",))
+
+
+def shm(f, ins, outs):
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=ins,
+                                 out_specs=outs, check_vma=False))
+
+
+facts = {"shapes": [], "parity": True}
+rng = np.random.default_rng(0)
+for shape in ((4, 4), (2, 8)):
+    spec = make_mesh_spec(list(shape))
+    for dtype in (jnp.float32, jnp.bfloat16):
+        x = jnp.asarray(rng.normal(size=(16, 37)), dtype)
+        wide = jnp.asarray(rng.normal(size=(16, 16, 11)), dtype)
+        rows = jnp.asarray(rng.normal(size=(16, 16, 7)), dtype)
+
+        def hag(xl):
+            return hierarchical_all_gather(xl[0], "d", spec)[None]
+
+        def nag(xl):
+            return jax.lax.all_gather(xl[0], "d")[None]
+
+        def hrs(w):
+            return hierarchical_reduce_scatter_sum(w[0], "d", spec)
+
+        def nrs(w):
+            return jax.lax.psum_scatter(w[0], "d",
+                                        scatter_dimension=0, tiled=True)
+
+        def ha2a(r):
+            return hierarchical_all_to_all_rows(r[0], "d", spec)[None]
+
+        def na2a(r):
+            return jax.lax.all_to_all(r[0], "d", 0, 0)[None]
+
+        def piped(xl):
+            return hierarchical_all_gather(
+                xl[0], "d", spec, pipeline_chunks=2)[None]
+
+        checks = {
+            "all_gather": (hag, nag, x),
+            "reduce_scatter": (hrs, nrs, wide),
+            "all_to_all": (ha2a, na2a, rows),
+            "pipelined_gather": (piped, nag, x),
+        }
+        ok = {}
+        for name, (hf, nf, arg) in checks.items():
+            a = np.asarray(shm(hf, (P("d"),), P("d"))(arg))
+            b = np.asarray(shm(nf, (P("d"),), P("d"))(arg))
+            ok[name] = bool(np.array_equal(a.astype(np.float32),
+                                           b.astype(np.float32)))
+            facts["parity"] = facts["parity"] and ok[name]
+        facts["shapes"].append({"mesh": list(shape),
+                                "dtype": jnp.dtype(dtype).name,
+                                "bitwise": ok})
+
+# unified hpZ tier at 16 devices: hpz=4 on 4x4 = one intra row
+spec44 = make_mesh_spec([4, 4])
+x = jnp.asarray(rng.normal(size=(16, 23)), jnp.float32)
+groups = [list(range(g * 4, (g + 1) * 4)) for g in range(4)]
+
+
+def tier(xl):
+    return hierarchical_all_gather(xl[0], "d", spec44, hpz=4)[None]
+
+
+def native_grouped(xl):
+    return jax.lax.all_gather(xl[0], "d",
+                              axis_index_groups=groups)[None]
+
+
+a = np.asarray(shm(tier, (P("d"),), P("d"))(x))
+b = np.asarray(shm(native_grouped, (P("d"),), P("d"))(x))
+facts["hpz_tier_bitwise"] = bool(np.array_equal(a, b))
+facts["parity"] = facts["parity"] and facts["hpz_tier_bitwise"]
+print(json.dumps(facts))
+"""
+
+
+def run_16dev_parity(repo_root=None, timeout=900):
+    """Run the 16-device factoring parity child (own interpreter with
+    ``--xla_force_host_platform_device_count=16``) and return its JSON
+    facts. Raises on a failed child — never a silent skip."""
+    import json as _json
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    if repo_root:
+        env["PYTHONPATH"] = repo_root
+    env["JAX_PLATFORMS"] = "cpu"
+    kept = [t for t in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in t]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=16"])
+    out = subprocess.run([sys.executable, "-c", SIXTEEN_DEV_CHILD],
+                         env=env, capture_output=True, text=True,
+                         timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"16-dev parity child failed: {out.stderr[-2000:]}")
+    return _json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def main(argv=None):

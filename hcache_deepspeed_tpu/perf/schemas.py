@@ -277,7 +277,19 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("hier_pod_wire_seconds_intra",
                      "zero_overlap.hier_pod_wire_seconds_intra"),
                     ("domino_hier_overlapped_pairs",
-                     "domino.hier_overlapped_pairs")):
+                     "domino.hier_overlapped_pairs"),
+                    ("hier_pipelined_structural_ratio",
+                     "zero_overlap.hier_pipelined_structural_ratio"),
+                    ("hier_pipelined_cross_axis_pairs",
+                     "zero_overlap.hier_pipelined_cross_axis_pairs"),
+                    ("wire_cal_gbps_inter",
+                     "zero_overlap.wire_cal_gbps_inter"),
+                    ("wire_cal_gbps_intra",
+                     "zero_overlap.wire_cal_gbps_intra"),
+                    ("wire_cal_divergence_inter",
+                     "zero_overlap.wire_cal_divergence_inter"),
+                    ("wire_cal_divergence_intra",
+                     "zero_overlap.wire_cal_divergence_intra")):
                 if isinstance(row.get(key), (int, float)):
                     pts.append(MetricPoint(metric, float(row[key]),
                                            file, phase=phase, utc=utc))
@@ -302,7 +314,17 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("hier_longhaul_trajectory_within_tol",
                      "zero_overlap.hier_longhaul_trajectory_within_tol"),
                     ("domino_hier_value_parity",
-                     "domino.hier_value_parity")):
+                     "domino.hier_value_parity"),
+                    ("hier_hpz_unified_bitwise",
+                     "zero_overlap.hier_hpz_unified_bitwise"),
+                    ("hier_hpz_secondary_on_mesh",
+                     "zero_overlap.hier_hpz_secondary_on_mesh"),
+                    ("hier_pipelined_bitwise",
+                     "zero_overlap.hier_pipelined_bitwise"),
+                    ("hier_16dev_parity",
+                     "zero_overlap.hier_16dev_parity"),
+                    ("wire_cal_shape_ok",
+                     "zero_overlap.wire_cal_shape_ok")):
                 if key in row:
                     pts.append(MetricPoint(metric,
                                            1.0 if row[key] else 0.0,

@@ -61,6 +61,8 @@ DERIVED_COMPUTE_OPS = ("dot", "convolution")
 
 _HEADER_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*(\(.*\))?\s*"
                         r"(?:->\s*.*?)?\s*{\s*$")
+_STP_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+_STP_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
 _INSTR_RE = re.compile(r"^(ROOT\s+)?(%?[\w.\-]+)\s+=\s+(.*?)"
                        r"([a-z][a-z0-9\-]*)\((.*)$")
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
@@ -320,6 +322,91 @@ def _dot_fusion_names(comps: List[Computation]) -> Dict[str, set]:
     return out
 
 
+def _permute_group_signature(raw: str):
+    """The rank-group PARTITION a ``collective-permute``'s
+    ``source_target_pairs`` induce (union-find over the pairs).
+    ``None`` when the instruction carries no pair list. Compared with
+    :func:`_same_axis` (partition refinement), not equality: a
+    distance-``s`` delivery step splits its ring into ``gcd(s, m)``
+    cosets — finer than the distance-1 partition but still INSIDE the
+    same axis groups — while a different mesh axis's partition crosses
+    them."""
+    m = _STP_RE.search(raw)
+    if not m:
+        return None
+    pairs = [(int(a), int(b)) for a, b in _STP_PAIR_RE.findall(m.group(1))]
+    if not pairs:
+        return None
+    parent: Dict[int, int] = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in pairs:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+    comps: Dict[int, List[int]] = {}
+    for rank in parent:
+        comps.setdefault(find(rank), []).append(rank)
+    return frozenset(frozenset(v) for v in comps.values())
+
+
+def _refines(a, b) -> bool:
+    """Partition ``a`` refines ``b``: every component of ``a`` lies
+    inside some component of ``b``."""
+    return all(any(ca <= cb for cb in b) for ca in a)
+
+
+def _same_axis(a, b) -> bool:
+    """Two permute partitions ride the same mesh axis when one refines
+    the other — ring steps, delivery distances, and hpZ sub-runs of
+    one axis all nest inside that axis's groups; a genuinely different
+    axis's groups cross them."""
+    return _refines(a, b) or _refines(b, a)
+
+
+def _cross_axis_pairs(comp: Computation) -> Dict:
+    """CROSS-AXIS permute tier (phase pipelining evidence, ISSUE 15):
+    count pairs of ``collective-permute`` ops that (a) ride DIFFERENT
+    mesh axes (distinct rank-group partitions in their
+    ``source_target_pairs``) and (b) are mutually dependence-free —
+    i.e. chunk k's long-haul phase can be on the wire at the same time
+    as chunk k+1's intra phase, by dataflow construction. An
+    UNPIPELINED hierarchical collective has zero such pairs inside one
+    gather: every long-haul permute consumes the concatenation of ALL
+    intra chunks, so every intra permute is its ancestor. Returns
+    ``{"pairs", "partnered", "permutes"}``."""
+    permutes = [i for i in comp.instrs
+                if i.opcode in ("collective-permute",
+                                "collective-permute-start")]
+    if len(permutes) < 2:
+        return {"pairs": 0, "partnered": 0, "permutes": len(permutes)}
+    sigs = {p.name: _permute_group_signature(p.raw) for p in permutes}
+    graph = _graph(comp)
+    anc = {p.name: _ancestors(graph, p.name) for p in permutes}
+    pairs = 0
+    partnered = set()
+    for i, a in enumerate(permutes):
+        if sigs[a.name] is None:
+            continue
+        for b in permutes[i + 1:]:
+            if sigs[b.name] is None \
+                    or _same_axis(sigs[a.name], sigs[b.name]):
+                continue
+            if a.name in anc[b.name] or b.name in anc[a.name]:
+                continue
+            pairs += 1
+            partnered.add(a.name)
+            partnered.add(b.name)
+    return {"pairs": pairs, "partnered": len(partnered),
+            "permutes": len(permutes)}
+
+
 def _permute_chains(comp: Computation) -> List[Dict]:
     """Group this computation's ``collective-permute`` ops into CHAINS:
     permutes connected by a def-use path (step ``s`` consumes step
@@ -372,6 +459,11 @@ class AuditReport:
     #: module (``[{computation, length}]``; length >= 2 = a ppermute
     #: step chain, length 1 = a point-to-point delivery send)
     permute_chains: List[Dict] = field(default_factory=list)
+    #: CROSS-AXIS tier (phase pipelining, ISSUE 15): module-wide
+    #: totals of mutually dependence-free permute pairs riding
+    #: DIFFERENT mesh axes — ``{"pairs", "partnered", "permutes"}``
+    cross_axis: Dict = field(default_factory=lambda: {
+        "pairs": 0, "partnered": 0, "permutes": 0})
 
     def pairs(self, kind: Optional[str] = None,
               min_interleaved: int = 1) -> List[Pair]:
@@ -422,6 +514,19 @@ class AuditReport:
         return sum(1 for p in every
                    if p.interleaved + p.free_fused >= 1) / len(every)
 
+    def cross_axis_overlap_ratio(self) -> float:
+        """Fraction of the module's collective-permutes with at least
+        one dependence-free partner on a DIFFERENT mesh axis — the
+        phase-pipelining evidence (chunk k's long-haul phase live
+        beside chunk k+1's intra phase). 0.0 on a module with no
+        permutes (nothing is phase-pipelined), and 0.0 for any
+        single-axis (flat-ring) program — this tier only scores
+        multi-axis structure."""
+        n = self.cross_axis.get("permutes", 0)
+        if not n:
+            return 0.0
+        return self.cross_axis.get("partnered", 0) / n
+
     def to_row(self) -> Dict:
         """JSON-safe summary row (the ZERO_OVERLAP.jsonl payload)."""
         return {
@@ -438,6 +543,9 @@ class AuditReport:
                 self.overlap_ratio("collective-permute"), 4),
             "structural_overlap_ratio": round(
                 self.structural_overlap_ratio(), 4),
+            "cross_axis_pairs": self.cross_axis.get("pairs", 0),
+            "cross_axis_overlap_ratio": round(
+                self.cross_axis_overlap_ratio(), 4),
             "permute_chains": list(self.permute_chains),
             "collective_counts": self.counts(),
             "wire_bytes": self.wire_bytes,
@@ -460,14 +568,20 @@ class AuditReport:
 # ------------------------------------------------------------------ #
 
 def wire_cost_seconds(axis_bytes: Dict[str, float],
-                      axis_gbytes_per_s: Dict[str, float]) -> Dict:
+                      axis_gbytes_per_s: Dict[str, float],
+                      calibration: str = "declared") -> Dict:
     """Price per-axis wire bytes in seconds: ``bytes / (GB/s * 1e9)``
     per axis. Axes with no declared bandwidth report ``seconds: None``
     (unpriceable is not free — the row stays visible). Returns
     ``{"per_axis": {axis: {bytes, gbytes_per_s, seconds}},
-    "total_seconds", "bottleneck_axis"}`` — ``total_seconds`` sums the
-    priced axes (serialized-wire upper bound; phases on different axes
-    may overlap on hardware), ``bottleneck_axis`` is the slowest."""
+    "total_seconds", "bottleneck_axis", "calibration"}`` —
+    ``total_seconds`` sums the priced axes (serialized-wire upper
+    bound; phases on different axes may overlap on hardware),
+    ``bottleneck_axis`` is the slowest. ``calibration`` labels WHERE
+    the bandwidths came from — ``"declared"`` (a model input) or
+    ``"measured"`` (``comm/benchmark.py calibrate_mesh_axes`` wall
+    clock) — and rides in the row so a projection can never pass
+    itself off as a measurement (ISSUE 15 satellite)."""
     per_axis = {}
     total = 0.0
     bottleneck, worst = None, -1.0
@@ -484,13 +598,15 @@ def wire_cost_seconds(axis_bytes: Dict[str, float],
                           "seconds": seconds}
     return {"per_axis": per_axis,
             "total_seconds": total,
-            "bottleneck_axis": bottleneck}
+            "bottleneck_axis": bottleneck,
+            "calibration": calibration}
 
 
 def pod_scale_wire_seconds(axis_bytes: Dict[str, float],
                            toy_axis_sizes: Dict[str, int],
                            pod_axis_sizes: Dict[str, int],
-                           axis_gbytes_per_s: Dict[str, float]) -> Dict:
+                           axis_gbytes_per_s: Dict[str, float],
+                           calibration: str = "declared") -> Dict:
     """Project toy-mesh per-axis wire bytes to a pod-scale mesh and
     price them: a ring phase over an axis of size ``k`` makes ``k - 1``
     sends of the same per-device payload, so bytes scale by
@@ -498,8 +614,11 @@ def pod_scale_wire_seconds(axis_bytes: Dict[str, float],
     per-device payload held fixed (the ZeRO case: shard sizes are set
     per device, not per world). That is the whole model — declared,
     deliberately simple, and labeled as such in the artifact row via
-    ``assumption``. Returns the :func:`wire_cost_seconds` dict plus
-    ``{"scaled_axis_bytes", "assumption"}``."""
+    ``assumption``; the projection TARGET is configurable (``--pod-
+    shape`` in bench), never hard-coded here. Returns the
+    :func:`wire_cost_seconds` dict plus ``{"scaled_axis_bytes",
+    "assumption", "pod_axis_sizes", "toy_axis_sizes"}`` and the
+    ``calibration`` source label."""
     scaled = {}
     for axis, nbytes in axis_bytes.items():
         k = toy_axis_sizes.get(axis)
@@ -508,10 +627,13 @@ def pod_scale_wire_seconds(axis_bytes: Dict[str, float],
             scaled[axis] = float(nbytes) * (K - 1) / (k - 1)
         else:
             scaled[axis] = float(nbytes)
-    out = wire_cost_seconds(scaled, axis_gbytes_per_s)
+    out = wire_cost_seconds(scaled, axis_gbytes_per_s,
+                            calibration=calibration)
     out["scaled_axis_bytes"] = {a: int(b) for a, b in scaled.items()}
     out["assumption"] = ("ring bytes scale (K-1)/(k-1) per axis at "
                          "fixed per-device payload")
+    out["toy_axis_sizes"] = dict(toy_axis_sizes)
+    out["pod_axis_sizes"] = dict(pod_axis_sizes)
     return out
 
 
@@ -520,6 +642,7 @@ def audit_hlo_text(text: str) -> AuditReport:
     native, derived, sequential = [], [], []
     chains: List[Dict] = []
     wire: Dict[str, Dict] = {}
+    cross = {"pairs": 0, "partnered": 0, "permutes": 0}
     comps = parse_hlo_computations(text)
     dot_fusions = _dot_fusion_names(comps)
     for comp in comps:
@@ -529,6 +652,9 @@ def audit_hlo_text(text: str) -> AuditReport:
         derived.extend(over)
         sequential.extend(seq)
         chains.extend(_permute_chains(comp))
+        ca = _cross_axis_pairs(comp)
+        for k in cross:
+            cross[k] += ca[k]
         for i in comp.instrs:
             if not (i.is_collective or i.opcode.endswith("-start")):
                 continue
@@ -543,7 +669,7 @@ def audit_hlo_text(text: str) -> AuditReport:
     return AuditReport(native_pairs=native, derived_pairs=derived,
                        sequential_collectives=sequential,
                        computations=len(comps), wire_bytes=wire,
-                       permute_chains=chains)
+                       permute_chains=chains, cross_axis=cross)
 
 
 def audit_compiled(compiled) -> AuditReport:

@@ -171,6 +171,17 @@ class ZeroConfig(HDSConfigModel):
     #: width on the fast axis. ``null`` = full width everywhere.
     #: Requires ``zero_collective_impl: hierarchical``.
     zero_longhaul_wire_bits: Optional[int] = None
+    #: PHASE PIPELINING of the hierarchical collectives: split every
+    #: gather/exchange payload into this many column chunks, each
+    #: riding its own full intra->long-haul phase chain — chunk k's
+    #: long-haul phase is structurally independent of chunk k+1's intra
+    #: phase (the PR 9 def-use discipline applied ACROSS mesh axes),
+    #: scored by ``hlo_audit``'s cross-axis permute-pair tier. 1 =
+    #: unpipelined (phases back to back). Full-width results are
+    #: bitwise-identical at any chunk count; a quantized long-haul
+    #: wire quantizes per chunk (deterministic, trajectory-gated).
+    #: Requires ``zero_collective_impl: hierarchical``.
+    zero_mesh_pipeline_chunks: int = Field(1, ge=1)
     #: ZeRO++ stage-3 gather granularity: scan-over-layers (gather one
     #: block at a time inside the micro step) when the model provides a
     #: layered spec (models/layered.py). False forces the whole-tree
@@ -236,6 +247,14 @@ class ZeroConfig(HDSConfigModel):
                         f"zero_collective_impl=hierarchical; set the "
                         f"transport or drop the knob (no silent "
                         f"ignores)")
+            if self.zero_mesh_pipeline_chunks != 1:
+                raise HDSConfigError(
+                    f"zero_mesh_pipeline_chunks="
+                    f"{self.zero_mesh_pipeline_chunks} has no effect "
+                    f"without zero_collective_impl=hierarchical "
+                    f"(phase pipelining overlaps a gather's intra and "
+                    f"long-haul PHASES); set the transport or drop "
+                    f"the knob (no silent ignores)")
         validate_quantized_wire(
             quantized_reduce_scatter=self.zero_quantized_reduce_scatter,
             error_feedback=self.zero_reduce_scatter_error_feedback,

@@ -173,7 +173,8 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
                             overlap_comm: bool = True,
                             mesh_spec=None,
                             longhaul_bits: Optional[int] = None,
-                            hpz: int = 1) -> None:
+                            hpz: int = 1,
+                            pipeline_chunks: int = 1) -> None:
     """Build-time rejection of nonsensical overlap knobs — a clear
     error instead of the silent clamping the knobs used to get.
 
@@ -210,7 +211,7 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
                 f"fallback — enable overlap_comm or use "
                 f"zero_collective_impl=native")
     if collective_impl == "hierarchical":
-        from ...comm.hierarchical import validate_mesh_spec
+        from ...comm.hierarchical import hpz_tier_dims, validate_mesh_spec
         if mesh_spec is None:
             raise HDSConfigError(
                 "zero_collective_impl=hierarchical needs "
@@ -218,16 +219,29 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
                 "axis); declare it — the transport never guesses a "
                 "factoring")
         if hpz > 1:
-            raise HDSConfigError(
-                "zero_collective_impl=hierarchical with "
-                "zero_hpz_partition_size > 1: hpZ's secondary groups "
-                "and the mesh's intra axis both claim the fast tier — "
-                "the hierarchical transport already keeps gather "
-                "traffic grouped per axis; use one mechanism, not "
-                "both")
+            # UNIFIED hpZ tiering (ISSUE 15): hpZ's secondary groups
+            # map onto the mesh's innermost axes — per-micro gathers
+            # ride the fast tier's grouped rings, the secondary refresh
+            # rides the full mesh. Only GENUINE mismatches (hpz neither
+            # a divisor nor a whole-axis multiple of the fast-tier
+            # axes) are rejected, by hpz_tier_dims itself.
+            hpz_tier_dims(mesh_spec, hpz)
         if world_size:
             validate_mesh_spec(mesh_spec, world_size=world_size,
                                longhaul_bits=longhaul_bits)
+    if pipeline_chunks != 1:
+        if pipeline_chunks < 1:
+            raise HDSConfigError(
+                f"zero_mesh_pipeline_chunks={pipeline_chunks}: the "
+                f"phase pipeline needs a positive chunk count (1 = "
+                f"unpipelined)")
+        if collective_impl != "hierarchical":
+            raise HDSConfigError(
+                f"zero_mesh_pipeline_chunks={pipeline_chunks} has no "
+                f"effect without zero_collective_impl=hierarchical "
+                f"(phase pipelining overlaps a gather's intra and "
+                f"long-haul PHASES — flat transports have one phase); "
+                f"set the transport or drop the knob")
     if largest_leaf > reduce_bucket_elements:
         name = f" ({largest_leaf_name})" if largest_leaf_name else ""
         raise HDSConfigError(

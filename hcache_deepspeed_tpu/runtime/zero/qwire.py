@@ -92,7 +92,7 @@ def plan_wire_residual_widths(sizes, dims, *, bucket_elements,
 
 def _quantized_wide_reduce(wide, residual, *, group_size, bits,
                            equiv_bytes, collective_impl="native",
-                           mesh_spec=None):
+                           mesh_spec=None, pipeline_chunks=1):
     """One bucket: ``wide`` is the full ``[n, W]`` cotangent buffer
     (row j -> device j). Returns ``(mean [W] fp32,
     new_residual [n, W] fp32)``. ``residual`` None means error
@@ -148,9 +148,11 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
         # every byte attributed to the mesh axis it rides
         from ...comm.hierarchical import hierarchical_all_to_all_rows
         payload_t = hierarchical_all_to_all_rows(
-            payload, DATA_AXIS, mesh_spec, op_name="zero_hier_qrs")
+            payload, DATA_AXIS, mesh_spec,
+            pipeline_chunks=pipeline_chunks, op_name="zero_hier_qrs")
         scale_t = hierarchical_all_to_all_rows(
-            scale, DATA_AXIS, mesh_spec, op_name="zero_hier_qrs")
+            scale, DATA_AXIS, mesh_spec,
+            pipeline_chunks=pipeline_chunks, op_name="zero_hier_qrs")
     else:
         payload_t = jax.lax.all_to_all(payload, DATA_AXIS, 0, 0)
         scale_t = jax.lax.all_to_all(scale, DATA_AXIS, 0, 0)
@@ -164,7 +166,8 @@ def quantized_bucket_reduce_scatter_mean(flat, dims, *, bucket_elements,
                                          residuals: Optional[list] = None,
                                          error_feedback=True,
                                          collective_impl="native",
-                                         mesh_spec=None):
+                                         mesh_spec=None,
+                                         pipeline_chunks=1):
     """Bucketed QUANTIZED reduce-mean of the sharded leaves of ``flat``
     (full cotangents) onto their data-axis shards — the qgZ all-to-all
     topology at IPG-bucket granularity, one collective pair (payload +
@@ -208,7 +211,7 @@ def quantized_bucket_reduce_scatter_mean(flat, dims, *, bucket_elements,
         red, nr = _quantized_wide_reduce(
             wide, res, group_size=group_size, bits=bits,
             equiv_bytes=equiv_bytes, collective_impl=collective_impl,
-            mesh_spec=mesh_spec)
+            mesh_spec=mesh_spec, pipeline_chunks=pipeline_chunks)
         if error_feedback:
             new_res.append(nr)
         off = 0

@@ -108,14 +108,21 @@ def _log_wire(op, n_int8, n_scale_f32, equiv_bytes):
         (DATA_AXIS,))
 
 
-def _quantized_all_gather_dim(x, dim, *, group_size, axis_index_groups=None):
-    """int8-wire all-gather of ``x`` along named DATA_AXIS into dim ``dim``."""
+def _quantized_all_gather_dim(x, dim, *, group_size, axis_index_groups=None,
+                              gather_fn=None):
+    """int8-wire all-gather of ``x`` along named DATA_AXIS into dim
+    ``dim``. ``gather_fn`` overrides the transport (the hierarchical
+    mesh rings pass one): any ``arr -> [n_g, *arr.shape]`` stacked
+    gather in group-rank order — the int8 payload + scales are pure
+    data movement, so the swap is bitwise-free."""
     group_size = min(group_size, x.size)  # avoid pad blowup on small leaves
     q, scale, shape, count = quantize(x, group_size=group_size, num_bits=8)
-    q_all = jax.lax.all_gather(q, DATA_AXIS,
-                               axis_index_groups=axis_index_groups)
-    s_all = jax.lax.all_gather(scale, DATA_AXIS,
-                               axis_index_groups=axis_index_groups)
+    if gather_fn is None:
+        def gather_fn(arr):
+            return jax.lax.all_gather(arr, DATA_AXIS,
+                                      axis_index_groups=axis_index_groups)
+    q_all = gather_fn(q)
+    s_all = gather_fn(scale)
     _log_wire("qwZ_all_gather", q.size, scale.size,
               x.size * x.dtype.itemsize)
     deq = jax.vmap(lambda qi, si: dequantize(qi, si, shape, count))(
@@ -153,7 +160,7 @@ def _quant_reduce_mean_dim(g, dim, *, group_size):
 
 
 def _psum_scatter_mean_dim(g, dim, collective_impl="native",
-                           mesh_spec=None):
+                           mesh_spec=None, pipeline_chunks=1):
     n = jax.lax.axis_size(DATA_AXIS)
     _log_plain("zero_reduce_scatter", g.size * g.dtype.itemsize)
     gm = jnp.moveaxis(g, dim, 0)
@@ -164,7 +171,8 @@ def _psum_scatter_mean_dim(g, dim, collective_impl="native",
     elif collective_impl == "hierarchical":
         from ...comm.hierarchical import hierarchical_reduce_scatter_sum
         out = hierarchical_reduce_scatter_sum(
-            gm, DATA_AXIS, mesh_spec, op_name="zero_hier_reduce_scatter")
+            gm, DATA_AXIS, mesh_spec, pipeline_chunks=pipeline_chunks,
+            op_name="zero_hier_reduce_scatter")
     else:
         out = jax.lax.psum_scatter(gm, DATA_AXIS,
                                    scatter_dimension=0, tiled=True)
@@ -182,7 +190,7 @@ def _log_plain(op, n_bytes):
 
 def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
                                  group_size, collective_impl="native",
-                                 mesh_spec=None):
+                                 mesh_spec=None, pipeline_chunks=1):
     """Reduce-mean the sharded leaves of ``flat`` (full cotangents) onto
     their data-axis shards — coalesced into flat reduce-scatter buckets
     of at most ``bucket_elements`` elements (the stage-1/2 IPG-bucket
@@ -243,6 +251,7 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
                     hierarchical_reduce_scatter_sum
                 red = hierarchical_reduce_scatter_sum(
                     wide, DATA_AXIS, mesh_spec,
+                    pipeline_chunks=pipeline_chunks,
                     op_name="zero_hier_reduce_scatter")
             else:
                 red = jax.lax.psum_scatter(wide, DATA_AXIS,
@@ -261,7 +270,7 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
 def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                               bucket_elements, matmul_plan=None,
                               collective_impl="native", mesh_spec=None,
-                              longhaul_bits=None):
+                              longhaul_bits=None, pipeline_chunks=1):
     """ISSUE half of the layer-granular gather: coalesce the sharded
     leaves of ``flat`` (local shards; the hpZ ``sec`` partition when
     hpz > 1) into flat all-gather payloads of at most
@@ -340,13 +349,18 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                 elif collective_impl == "hierarchical":
                     # per-mesh-axis ring phases, same [n_g, W] row
                     # order; the long-haul phase optionally ships
-                    # int8/int4 (comm/hierarchical.py — hpZ groups are
-                    # rejected with this transport at validation)
+                    # int8/int4 (comm/hierarchical.py). Under hpZ the
+                    # gather runs the UNIFIED tier — grouped ring
+                    # phases over only the mesh axes the hpZ box
+                    # covers (n_g = hpz), bitwise-equal to the native
+                    # grouped gather
                     from ...comm.hierarchical import \
                         hierarchical_all_gather
                     wide = hierarchical_all_gather(
                         payload, DATA_AXIS, mesh_spec,
+                        hpz=hpz if hpz > 1 else None,
                         longhaul_bits=lh_bits, group_size=group_size,
+                        pipeline_chunks=pipeline_chunks,
                         op_name="zero_hier_all_gather")
                 else:
                     wide = jax.lax.all_gather(payload, DATA_AXIS,
@@ -476,7 +490,7 @@ def bucketed_all_gather_finish(payloads, meta, fused=False):
 def bucketed_all_gather(flat, sec, dims, *, qw, hpz, group_size,
                         bucket_elements, matmul_plan=None, fused=False,
                         collective_impl="native", mesh_spec=None,
-                        longhaul_bits=None):
+                        longhaul_bits=None, pipeline_chunks=1):
     """One-shot layer-granular gather: start + finish back to back
     (the sequential form). Values are bitwise-identical to the
     per-leaf gathers — buckets only batch the data movement (the
@@ -487,18 +501,40 @@ def bucketed_all_gather(flat, sec, dims, *, qw, hpz, group_size,
         flat, sec, dims, qw=qw, hpz=hpz, group_size=group_size,
         bucket_elements=bucket_elements, matmul_plan=matmul_plan,
         collective_impl=collective_impl, mesh_spec=mesh_spec,
-        longhaul_bits=longhaul_bits)
+        longhaul_bits=longhaul_bits, pipeline_chunks=pipeline_chunks)
     return bucketed_all_gather_finish(payloads, meta, fused=fused)
 
 
-def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
+def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048,
+                     collective_impl: str = "native", mesh_spec=None,
+                     longhaul_bits=None, pipeline_chunks: int = 1):
     """Per-leaf ``(primary, secondary, dim) -> full`` gather: quantized
     wire under qwZ, intra-group (ICI-only) under hpZ, identity for
-    replicated leaves. Must run inside the shard_map region."""
+    replicated leaves. Must run inside the shard_map region.
+
+    ``collective_impl="hierarchical"``: full-width (fp) leaf gathers
+    ride the mesh's grouped ring phases (``comm/hierarchical.py``) —
+    under hpZ the UNIFIED tier (only the mesh axes the hpZ box
+    covers), otherwise the full mesh with the optional
+    ``longhaul_bits`` axis-selective wire — so the per-leaf OUTER
+    gathers of the layered step get per-mesh-axis byte attribution
+    instead of staying native (ISSUE 15); pure data movement, bitwise
+    vs the native grouped gather. The qwZ (int8) per-leaf gather is
+    the one documented exception: it keeps the native transport (see
+    the in-function comment — its wire is already compressed, and the
+    quantize math is not round-stable next to ring ops on XLA CPU)."""
 
     def _hpz_groups():
         n = jax.lax.axis_size(DATA_AXIS)
         return [list(range(g * hpz, (g + 1) * hpz)) for g in range(n // hpz)]
+
+    def _hier_gather(arr, lh_bits):
+        from ...comm.hierarchical import hierarchical_all_gather
+        return hierarchical_all_gather(
+            arr, DATA_AXIS, mesh_spec, hpz=hpz if hpz > 1 else None,
+            longhaul_bits=lh_bits, group_size=group_size,
+            pipeline_chunks=pipeline_chunks,
+            op_name="zero_hier_leaf_gather")
 
     def gather_leaf(primary, secondary, dim):
         if dim is None:
@@ -508,8 +544,34 @@ def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
         else:
             src, groups = primary, None
         if qw:
+            # the qwZ per-leaf gather keeps the native grouped
+            # transport under EVERY collective_impl: its wire is
+            # already int8 + scales (the compressed format the mesh
+            # would carry unchanged), and measured on XLA CPU the
+            # quantize/dequantize math does NOT compile round-stably
+            # next to ring permute/concat ops — routing it through the
+            # rings flips low bits of the dequantized weights and
+            # breaks the cross-engine bitwise contract. The fp-width
+            # leaves below (where the longhaul-bits option applies)
+            # and the hpZ secondary refresh DO ride the mesh.
             return _quantized_all_gather_dim(src, dim, group_size=group_size,
                                              axis_index_groups=groups)
+        if collective_impl == "hierarchical" and hpz > 1:
+            # UNIFIED hpZ tier: the per-leaf gather rides only the
+            # mesh axes the hpZ box covers (grouped ring phases,
+            # per-axis byte attribution; longhaul_bits fires when the
+            # tier spans the slow axis) — bitwise vs the native
+            # grouped gather, proven at engine scope. At hpz == 1 the
+            # flat per-leaf gather keeps the native transport: on XLA
+            # CPU the embed/head consumers do not compile round-stably
+            # against a full-mesh ring producer (measured), and the
+            # cross-engine bitwise gates outrank attribution of the
+            # two outer collectives — the bucketed lanes and the hpZ
+            # secondary refresh carry the mesh evidence there.
+            wide = _hier_gather(src, longhaul_bits)
+            parts = jnp.moveaxis(wide, 0, dim)
+            new_shape = src.shape[:dim] + (-1,) + src.shape[dim + 1:]
+            return parts.reshape(new_shape)
         return jax.lax.all_gather(src, DATA_AXIS, axis=dim, tiled=True,
                                   axis_index_groups=groups)
 
@@ -519,7 +581,8 @@ def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048):
 def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
                       group_size: int = 2048,
                       reduce_bucket_elements: int = 500_000_000,
-                      collective_impl: str = "native", mesh_spec=None):
+                      collective_impl: str = "native", mesh_spec=None,
+                      longhaul_bits=None, pipeline_chunks: int = 1):
     """Build ``gather(primary, secondary) -> full params`` with a custom
     VJP that performs the (optionally quantized) gradient reduce-scatter.
 
@@ -531,7 +594,11 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
     shard_map region.
     """
 
-    _gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size)
+    _gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size,
+                                    collective_impl=collective_impl,
+                                    mesh_spec=mesh_spec,
+                                    longhaul_bits=longhaul_bits,
+                                    pipeline_chunks=pipeline_chunks)
 
     def _reduce_leaf(g, dim):
         n = jax.lax.axis_size(DATA_AXIS)
@@ -541,7 +608,8 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
             return _quant_reduce_mean_dim(g, dim, group_size=group_size)
         return _psum_scatter_mean_dim(g, dim,
                                       collective_impl=collective_impl,
-                                      mesh_spec=mesh_spec)
+                                      mesh_spec=mesh_spec,
+                                      pipeline_chunks=pipeline_chunks)
 
     @jax.custom_vjp
     def gather(primary, secondary):
@@ -565,7 +633,8 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
             treedef, bucketed_reduce_scatter_mean(
                 flat, param_dims, bucket_elements=reduce_bucket_elements,
                 qg=qg, group_size=group_size,
-                collective_impl=collective_impl, mesh_spec=mesh_spec))
+                collective_impl=collective_impl, mesh_spec=mesh_spec,
+                pipeline_chunks=pipeline_chunks))
         # secondary is a value-copy of primary; its cotangent is defined
         # to be zero (all gradient flows to the primary partition).
         return g_primary, [None] * len(param_dims)
@@ -585,18 +654,42 @@ def make_param_gather(param_dims, grad_dims, *, qw: bool, qg: bool, hpz: int,
     return gather, reduce_grads
 
 
-def build_secondary(params, param_dims, hpz: int):
+def build_secondary(params, param_dims, hpz: int, *,
+                    collective_impl: str = "native", mesh_spec=None,
+                    longhaul_bits=None, pipeline_chunks: int = 1):
     """hpZ secondary partition: from the primary 1/n shard, build this
     device's 1/hpz shard (reference: the ZeRO-param secondary groups,
     ``utils/groups.py:650``). Runs INSIDE the shard_map region, once per
     optimizer step. Wire: one full-parameter all-gather over the data
     axis (the amortized refresh the reference does after each step).
-    Returns a flat list in ``jax.tree.flatten`` order."""
+    Returns a flat list in ``jax.tree.flatten`` order.
+
+    ``collective_impl="hierarchical"``: the refresh rides the full
+    mesh's grouped ring phases (``zero_hier_secondary``) so the ONE
+    cross-mesh collective of the hpZ step gets per-axis byte
+    attribution and, with ``longhaul_bits``, the axis-selective
+    quantized wire — the EQuARX trade applied exactly where hpZ's
+    traffic actually crosses the slow axis. Full width is bitwise-equal
+    to the native refresh; a quantized long haul dequantizes
+    deterministically and IDENTICALLY on every member of an hpZ group
+    (they share the long-haul coordinate), so the secondary stays
+    consistent within each group (trajectory-gated like every lossy
+    wire)."""
 
     def leaf(p, dim):
         if dim is None or hpz <= 1:
             return None
-        full = jax.lax.all_gather(p, DATA_AXIS, axis=dim, tiled=True)
+        if collective_impl == "hierarchical":
+            from ...comm.hierarchical import hierarchical_all_gather
+            wide = hierarchical_all_gather(
+                p, DATA_AXIS, mesh_spec, longhaul_bits=longhaul_bits,
+                pipeline_chunks=pipeline_chunks,
+                op_name="zero_hier_secondary")
+            parts = jnp.moveaxis(wide, 0, dim)
+            full = parts.reshape(p.shape[:dim] + (-1,)
+                                 + p.shape[dim + 1:])
+        else:
+            full = jax.lax.all_gather(p, DATA_AXIS, axis=dim, tiled=True)
         idx = jax.lax.axis_index(DATA_AXIS)
         within = idx % hpz
         # my 1/hpz slice of the sharded dim
@@ -663,7 +756,8 @@ def validate_zeropp(zcfg, stage: int, data_size: int):
         world_size=data_size, overlap_comm=zcfg.overlap_comm,
         mesh_spec=mesh_spec_from_zero_config(zcfg),
         longhaul_bits=getattr(zcfg, "zero_longhaul_wire_bits", None),
-        hpz=hpz)
+        hpz=hpz,
+        pipeline_chunks=getattr(zcfg, "zero_mesh_pipeline_chunks", 1))
 
 
 def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
@@ -721,7 +815,9 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
             overlap_comm=zcfg.overlap_comm,
             mesh_spec=mesh_spec,
             longhaul_bits=getattr(zcfg, "zero_longhaul_wire_bits", None),
-            hpz=hpz)
+            hpz=hpz,
+            pipeline_chunks=getattr(zcfg, "zero_mesh_pipeline_chunks",
+                                    1))
         if layered is None:
             from ..config import HDSConfigError
             raise HDSConfigError(
@@ -781,7 +877,9 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     gather, reduce_grads = make_param_gather(
         param_dims, grad_dims, qw=qw, qg=qg, hpz=hpz,
         reduce_bucket_elements=zcfg.reduce_bucket_size,
-        collective_impl=collective_impl, mesh_spec=mesh_spec)
+        collective_impl=collective_impl, mesh_spec=mesh_spec,
+        longhaul_bits=getattr(zcfg, "zero_longhaul_wire_bits", None),
+        pipeline_chunks=getattr(zcfg, "zero_mesh_pipeline_chunks", 1))
 
     if layered is not None:
         return _build_layered(
@@ -936,6 +1034,7 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
     # to native, structurally overlappable by dataflow construction
     impl = getattr(zcfg, "zero_collective_impl", "native")
     longhaul_bits = getattr(zcfg, "zero_longhaul_wire_bits", None)
+    mesh_pipeline = getattr(zcfg, "zero_mesh_pipeline_chunks", 1)
     if (qrs or fused_mm) and param_shapes is None:
         from ..config import HDSConfigError
         raise HDSConfigError(
@@ -996,7 +1095,14 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
              f"({plan.reason}); reduce bucket={bucket_elems} elements",
              ranks=[0])
 
-    gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size)
+    # per-leaf OUTER (embedding/head) gathers ride the same transport
+    # as the bucketed lanes — under the hierarchical impl they become
+    # grouped mesh rings with per-axis byte attribution (ISSUE 15)
+    gather_leaf = make_leaf_gather(qw=qw, hpz=hpz, group_size=group_size,
+                                   collective_impl=impl,
+                                   mesh_spec=mesh_spec,
+                                   longhaul_bits=longhaul_bits,
+                                   pipeline_chunks=mesh_pipeline)
 
     # ---- fused qwZ consumption plan: which block leaves gather in the
     # matmul (per-(k-group, n) scale) layout. Dense kernels only — the
@@ -1064,9 +1170,15 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
 
     def build_layered_secondary(params_local):
         outer_local, stacked_local = split(params_local)
-        sec_outer = build_secondary(outer_local, outer_pdims, hpz)
+        sec_outer = build_secondary(
+            outer_local, outer_pdims, hpz, collective_impl=impl,
+            mesh_spec=mesh_spec, longhaul_bits=longhaul_bits,
+            pipeline_chunks=mesh_pipeline)
         sec_stacked = build_secondary(
-            jax.tree.flatten(stacked_local)[0], stacked_pdims, hpz)
+            jax.tree.flatten(stacked_local)[0], stacked_pdims, hpz,
+            collective_impl=impl, mesh_spec=mesh_spec,
+            longhaul_bits=longhaul_bits,
+            pipeline_chunks=mesh_pipeline)
         return sec_outer, sec_stacked
 
     def _sec_specs():
@@ -1170,7 +1282,8 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                     flat, sec, block_pdims, qw=qw, hpz=hpz,
                     group_size=group_size, bucket_elements=ag_bucket,
                     matmul_plan=matmul_plan, collective_impl=impl,
-                    mesh_spec=mesh_spec, longhaul_bits=longhaul_bits)
+                    mesh_spec=mesh_spec, longhaul_bits=longhaul_bits,
+                    pipeline_chunks=mesh_pipeline)
                 gmeta.setdefault("m", meta)
                 return list(iso(tuple(payloads)))
 
@@ -1193,13 +1306,15 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                         bucket_elements=bucket_elems,
                         group_size=group_size, bits=qrs_bits,
                         residuals=res, error_feedback=qrs_ef,
-                        collective_impl=impl, mesh_spec=mesh_spec)
+                        collective_impl=impl, mesh_spec=mesh_spec,
+                        pipeline_chunks=mesh_pipeline)
                 else:
                     out = bucketed_reduce_scatter_mean(
                         flat_cots, block_pdims,
                         bucket_elements=bucket_elems,
                         qg=qg, group_size=group_size,
-                        collective_impl=impl, mesh_spec=mesh_spec)
+                        collective_impl=impl, mesh_spec=mesh_spec,
+                        pipeline_chunks=mesh_pipeline)
                     nres = []
                 out = list(iso(tuple(out)))
                 if nres:
@@ -1405,13 +1520,14 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                         bucket_elements=bucket_elems,
                         group_size=group_size, bits=qrs_bits,
                         residuals=res_outer, error_feedback=qrs_ef,
-                        collective_impl=impl, mesh_spec=mesh_spec)
+                        collective_impl=impl, mesh_spec=mesh_spec,
+                        pipeline_chunks=mesh_pipeline)
             else:
                 outer_red = bucketed_reduce_scatter_mean(
                     jax.tree.flatten(outer_cot)[0], outer_pdims,
                     bucket_elements=bucket_elems, qg=qg,
                     group_size=group_size, collective_impl=impl,
-                    mesh_spec=mesh_spec)
+                    mesh_spec=mesh_spec, pipeline_chunks=mesh_pipeline)
 
             grads = dict(jax.tree.unflatten(outer_def, outer_red))
             for i in range(n_layer):
@@ -1476,7 +1592,15 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         "mesh_spec": mesh_spec.describe() if mesh_spec is not None
         else None,
         "longhaul_wire_bits": longhaul_bits,
+        "mesh_pipeline_chunks": mesh_pipeline
+        if impl == "hierarchical" else None,
+        "hpz_tiers": None,
     }
+    if impl == "hierarchical" and hpz > 1:
+        from ...comm.hierarchical import hpz_tier_dims
+        plan_info["hpz_tiers"] = [
+            {"axis": mesh_spec.axes[dim].name, "span": span}
+            for dim, span in hpz_tier_dims(mesh_spec, hpz)]
     if qrs_ef:
         # non-JSON engine hook: allocates the error-feedback state
         # (the engine pops it off before logging the plan)

@@ -1,0 +1,86 @@
+"""Shape/contract validation of the measured wire calibration
+(``comm/benchmark.py calibrate_mesh_axes``, ISSUE 15). On CPU the
+GB/s numbers are physically meaningless — these tests pin the
+STRUCTURE the wire-cost model consumes (per-axis rows, headline
+bandwidths, declared-vs-measured divergence, the "measured"
+calibration label), which is exactly what the committed
+wire-calibration artifact phase gates. On chip the same entry point is
+the ``bin/chip_overlap_campaign.sh`` calibration leg.
+"""
+
+import math
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from hcache_deepspeed_tpu.comm.benchmark import calibrate_mesh_axes
+from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+
+
+def _mesh(n, axis="d"):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return Mesh(np.array(devs[:n]).reshape(n), (axis,))
+
+
+class TestCalibrateMeshAxes:
+
+    def test_rows_and_headline_shape(self, eight_devices):
+        spec = make_mesh_spec([2, 4], link_gbytes_per_s=[6.75, 45.0])
+        cal = calibrate_mesh_axes(spec, mesh=_mesh(8), axis="d",
+                                  payload_bytes=(1 << 12, 1 << 14),
+                                  trials=2)
+        assert cal["calibration"] == "measured"
+        assert set(cal["gbytes_per_s"]) == {"inter", "intra"}
+        assert all(math.isfinite(v) and v > 0
+                   for v in cal["gbytes_per_s"].values())
+        # one row per (axis, payload), each carrying both the measured
+        # and the declared number — the in-row divergence evidence
+        assert len(cal["rows"]) == 4
+        for row in cal["rows"]:
+            assert row["payload_bytes"] in (1 << 12, 1 << 14)
+            assert row["seconds_per_round"] > 0
+            assert row["declared_gbytes_per_s"] in (6.75, 45.0)
+            assert row["rounds"] == row["axis_size"] - 1
+
+    def test_divergence_vs_declared(self, eight_devices):
+        spec = make_mesh_spec([2, 4], link_gbytes_per_s=[6.75, 45.0])
+        cal = calibrate_mesh_axes(spec, mesh=_mesh(8), axis="d",
+                                  payload_bytes=(1 << 12,), trials=1)
+        div = cal["divergence_vs_declared"]
+        assert set(div) == {"inter", "intra"}
+        for axis, ratio in div.items():
+            assert ratio == pytest.approx(
+                cal["gbytes_per_s"][axis]
+                / spec.bandwidths()[axis])
+
+    def test_undeclared_bandwidth_divergence_is_none(self,
+                                                     eight_devices):
+        """No declared bandwidth => divergence None — visible, never
+        silently dropped or faked."""
+        spec = make_mesh_spec([2, 4])
+        cal = calibrate_mesh_axes(spec, mesh=_mesh(8), axis="d",
+                                  payload_bytes=(1 << 12,), trials=1)
+        assert cal["divergence_vs_declared"] == {"inter": None,
+                                                 "intra": None}
+
+    def test_feeds_wire_cost_model_as_measured(self, eight_devices):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            wire_cost_seconds
+        spec = make_mesh_spec([2, 4], link_gbytes_per_s=[6.75, 45.0])
+        cal = calibrate_mesh_axes(spec, mesh=_mesh(8), axis="d",
+                                  payload_bytes=(1 << 12,), trials=1)
+        cost = wire_cost_seconds({"inter": 1e6, "intra": 3e6},
+                                 cal["gbytes_per_s"],
+                                 calibration=cal["calibration"])
+        assert cost["calibration"] == "measured"
+        assert all(v["seconds"] is not None and v["seconds"] > 0
+                   for v in cost["per_axis"].values())
+
+    def test_too_few_devices_rejected(self):
+        spec = make_mesh_spec([16, 16])
+        with pytest.raises(ValueError, match="needs 256 devices"):
+            calibrate_mesh_axes(spec)

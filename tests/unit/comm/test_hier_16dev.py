@@ -1,0 +1,42 @@
+"""16-device hierarchical factorings (ISSUE 15): the grouped-ring
+machinery past the 8-device toy matrix — 4x4 and 2x8 meshes on a
+16-virtual-device CPU child process (the conftest pins the parent at 8
+devices, so the child re-launches with its own
+``--xla_force_host_platform_device_count=16``). Slow tier: one child
+interpreter + several 16-way compiles.
+
+The child program lives in ``comm/benchmark.py`` (SIXTEEN_DEV_CHILD /
+``run_16dev_parity``) and is shared with ``bench.py --zero-overlap``'s
+hier-16dev phase, so the committed artifact and this test exercise the
+same program. Gates: hierarchical all-gather / reduce-scatter /
+all-to-all bitwise vs native at both factorings (fp32 + bf16), the
+unified hpZ tier at hpz=4 on 4x4 bitwise vs the native grouped
+gather, and phase-pipelined parity at pipeline_chunks=2. The 256 =
+16x16 factoring is covered at spec level (no arrays) in
+test_hierarchical.py ``TestPodScaleSpecBookkeeping``.
+"""
+
+import os
+
+import pytest
+
+from hcache_deepspeed_tpu.comm.benchmark import run_16dev_parity
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     "..", "..", ".."))
+
+
+class TestHierarchical16Devices:
+
+    def test_4x4_and_2x8_parity(self):
+        facts = run_16dev_parity(repo_root=_REPO)
+        assert facts["parity"], facts
+        meshes = {tuple(s["mesh"]) for s in facts["shapes"]}
+        assert meshes == {(4, 4), (2, 8)}
+        dtypes = {s["dtype"] for s in facts["shapes"]}
+        assert dtypes == {"float32", "bfloat16"}
+        for s in facts["shapes"]:
+            assert all(s["bitwise"].values()), s
+        assert facts["hpz_tier_bitwise"], facts

@@ -275,3 +275,281 @@ class TestAllReduceAndAttribution:
         assert lumped == sum(per_axis.values())
         logger.reset()
         logger.configure(enabled=False)
+
+
+class TestPhasePipelining:
+    """ISSUE 15: ``pipeline_chunks > 1`` splits every payload into
+    column chunks riding independent full phase chains — chunk k's
+    long-haul phase structurally independent of chunk k+1's intra
+    phase. Pure data movement: bitwise-equal to the unpipelined form
+    AND to native at any chunk count (uneven splits included)."""
+
+    @pytest.mark.parametrize("dtype", (jnp.float32, jnp.bfloat16),
+                             ids=lambda d: d.__name__)
+    @pytest.mark.parametrize("pc", (2, 3))
+    def test_pipelined_gather_bitwise(self, eight_devices, dtype, pc):
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(10)
+        x = jnp.asarray(rng.normal(size=(8, 37)), dtype)
+
+        def piped(xl):
+            return hierarchical_all_gather(xl[0], "d", spec,
+                                           pipeline_chunks=pc)[None]
+
+        def native(xl):
+            return jax.lax.all_gather(xl[0], "d")[None]
+
+        a = np.asarray(_shm(mesh, piped, (P("d"),), P("d"))(x))
+        b = np.asarray(_shm(mesh, native, (P("d"),), P("d"))(x))
+        np.testing.assert_array_equal(a.astype(np.float32),
+                                      b.astype(np.float32))
+
+    @pytest.mark.parametrize("dtype", (jnp.float32, jnp.bfloat16),
+                             ids=lambda d: d.__name__)
+    @pytest.mark.parametrize("pc", (2, 3))
+    def test_pipelined_reduce_scatter_bitwise(self, eight_devices,
+                                              dtype, pc):
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(11)
+        wide = jnp.asarray(rng.normal(size=(8, 8, 21)), dtype)
+
+        def piped(w):
+            return hierarchical_reduce_scatter_sum(
+                w[0], "d", spec, pipeline_chunks=pc)
+
+        def native(w):
+            return jax.lax.psum_scatter(w[0], "d",
+                                        scatter_dimension=0, tiled=True)
+
+        a = np.asarray(_shm(mesh, piped, (P("d"),), P("d"))(wide))
+        b = np.asarray(_shm(mesh, native, (P("d"),), P("d"))(wide))
+        np.testing.assert_array_equal(a.astype(np.float32),
+                                      b.astype(np.float32))
+
+    def test_pipelined_cross_axis_structure(self, eight_devices):
+        """The structural claim itself, on the compiled module: the
+        unpipelined gather has ZERO dependence-free cross-axis permute
+        pairs (every long-haul permute descends from every intra
+        permute); the pipelined form has them, one per co-resident
+        chunk pair."""
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            audit_compiled
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        x = jnp.ones((8, 64), jnp.float32)
+        reps = {}
+        for pc in (1, 2):
+            def f(xl, pc=pc):
+                return hierarchical_all_gather(
+                    xl[0], "d", spec, pipeline_chunks=pc)[None]
+            compiled = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=(P("d"),), out_specs=P("d"),
+                check_vma=False)).lower(x).compile()
+            reps[pc] = audit_compiled(compiled)
+        assert reps[1].cross_axis["pairs"] == 0
+        assert reps[1].cross_axis_overlap_ratio() == 0.0
+        assert reps[2].cross_axis["pairs"] >= 1
+        assert reps[2].cross_axis_overlap_ratio() > 0.0
+
+    @pytest.mark.parametrize("bits", (8, 4))
+    def test_pipelined_longhaul_reduce_residual_layout(
+            self, eight_devices, bits):
+        """Quantized long-haul reduce under pipelining: per-chunk
+        quantization is deterministic and SELF-CONSISTENT — the
+        residual columns follow the chunk-concatenation layout, so a
+        residual produced by one pipelined pass feeds the next pass's
+        identical chunk split, and the EF contract (own-coordinate
+        slice zero) holds per chunk."""
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(12)
+        w = jnp.asarray(rng.normal(size=(8, 16, 3)), jnp.float32)
+
+        def hq(wl):
+            out1, res1 = hierarchical_reduce_scatter_sum(
+                wl[0], "d", spec, longhaul_bits=bits,
+                pipeline_chunks=3)
+            out2, res2 = hierarchical_reduce_scatter_sum(
+                wl[0], "d", spec, longhaul_bits=bits,
+                pipeline_chunks=3, residual=res1)
+            return out1, out2, res1, res2
+
+        out1, out2, res1, res2 = jax.jit(jax.shard_map(
+            hq, mesh=mesh, in_specs=(P("d"),),
+            out_specs=(P("d"), P("d"), P("d"), P("d")),
+            check_vma=False))(w)
+        ref = np.asarray(_shm(mesh, lambda wl: jax.lax.psum_scatter(
+            wl[0], "d", scatter_dimension=0, tiled=True),
+            (P("d"),), P("d"))(w))
+        absmax = float(np.abs(np.asarray(w)).max())
+        qmax = 127 if bits == 8 else 7
+        tol = 4 * absmax / (2 * qmax) * 1.1
+        assert np.allclose(np.asarray(out1), ref, atol=tol)
+        assert np.allclose(np.asarray(out2), ref, atol=tol)
+        # residual shapes stable across passes (the chunk-concat
+        # layout is deterministic), own-coordinate slices zero
+        assert np.asarray(res1).shape == np.asarray(res2).shape
+        res = np.asarray(res1).reshape(8, 2, -1)
+        for dev in range(8):
+            own = dev // 4
+            assert np.all(res[dev, own] == 0.0)
+
+
+class TestUnifiedHpzTier:
+    """ISSUE 15 tentpole: ``hpz`` maps onto the mesh's innermost axes
+    — the hpZ gather becomes grouped ring phases over exactly the mesh
+    axes the hpZ box covers, bitwise-equal to the native grouped
+    gather over hpz consecutive ranks."""
+
+    @pytest.mark.parametrize("dtype", (jnp.float32, jnp.bfloat16),
+                             ids=lambda d: d.__name__)
+    @pytest.mark.parametrize("hpz", (2, 4, 8))
+    def test_tier_gather_bitwise_vs_native_groups(self, eight_devices,
+                                                  dtype, hpz):
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(13)
+        x = jnp.asarray(rng.normal(size=(8, 23)), dtype)
+        groups = [list(range(g * hpz, (g + 1) * hpz))
+                  for g in range(8 // hpz)]
+
+        def tier(xl):
+            return hierarchical_all_gather(xl[0], "d", spec,
+                                           hpz=hpz)[None]
+
+        def native(xl):
+            return jax.lax.all_gather(xl[0], "d",
+                                      axis_index_groups=groups)[None]
+
+        a = np.asarray(_shm(mesh, tier, (P("d"),), P("d"))(x))
+        b = np.asarray(_shm(mesh, native, (P("d"),), P("d"))(x))
+        np.testing.assert_array_equal(a.astype(np.float32),
+                                      b.astype(np.float32))
+
+    @pytest.mark.parametrize("bits", (8, 4))
+    def test_tier_spanning_longhaul_quantizes_crossings(
+            self, eight_devices, bits):
+        """hpz=8 on a 2x4 mesh covers BOTH axes: the tier's inter
+        phase is a real long-haul phase, so longhaul_bits applies —
+        own-coordinate rows exact, crossing rows dequantized (int8 and
+        nibble-packed int4)."""
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(14)
+        x = jnp.asarray(rng.normal(size=(8, 13)), jnp.float32)
+
+        def hq(xl):
+            return hierarchical_all_gather(
+                xl[0], "d", spec, hpz=8, longhaul_bits=bits,
+                group_size=16)[None]
+
+        got = np.asarray(_shm(mesh, hq, (P("d"),), P("d"))(x))
+        full = np.asarray(x)
+        for r in range(8):
+            o = r // 4
+            np.testing.assert_array_equal(
+                got[r, o * 4:(o + 1) * 4], full[o * 4:(o + 1) * 4])
+            assert not np.array_equal(
+                got[r, (1 - o) * 4:(2 - o) * 4],
+                full[(1 - o) * 4:(2 - o) * 4])
+
+    def test_tier_gather_attributes_only_covered_axes(
+            self, eight_devices):
+        """hpz=4 covers ONLY the intra axis: per-axis permute bytes
+        must show intra traffic and ZERO inter traffic — the whole
+        point of the tier (per-micro gathers never touch the slow
+        wire)."""
+        mesh = _mesh(8)
+        spec = make_mesh_spec([2, 4])
+        logger = get_comms_logger()
+        logger.configure(enabled=True)
+        logger.reset()
+        x = jnp.asarray(np.random.default_rng(15).normal(size=(8, 40)),
+                        jnp.float32)
+
+        def tier(xl):
+            return hierarchical_all_gather(
+                xl[0], "d", spec, hpz=4, op_name="t_hpz_ag")[None]
+
+        _shm(mesh, tier, (P("d"),), P("d"))(x)
+        per_axis = logger.permute_axis_bytes()["t_hpz_ag"]
+        assert set(per_axis) == {"intra"}, per_axis
+        assert per_axis["intra"] == 3 * 40 * 4
+        logger.reset()
+        logger.configure(enabled=False)
+
+
+class TestPodScaleSpecBookkeeping:
+    """The 256-device (16x16) spec-level construction gate (ISSUE 15):
+    group/coordinate/chunk bookkeeping at the BASELINE.json v5e-256
+    factoring, pure host-side — no device arrays materialize (tier-1
+    safe on an 8-device CPU harness)."""
+
+    def test_16x16_groups_and_coords(self):
+        from hcache_deepspeed_tpu.comm.hierarchical import (
+            _gather_phases, validate_mesh_spec)
+        spec = make_mesh_spec([16, 16],
+                              link_gbytes_per_s=[6.75, 45.0])
+        assert spec.world == 256
+        validate_mesh_spec(spec, world_size=256, longhaul_bits=8)
+        inter = axis_groups(spec.sizes, 0)
+        intra = axis_groups(spec.sizes, 1)
+        assert len(inter) == 16 and len(intra) == 16
+        assert all(len(g) == 16 for g in inter + intra)
+        # intra rows contiguous, inter columns strided by 16
+        assert intra[0] == list(range(16))
+        assert inter[0] == list(range(0, 256, 16))
+        # every rank appears exactly once per dim's groups
+        for groups in (inter, intra):
+            flat = sorted(r for g in groups for r in g)
+            assert flat == list(range(256))
+        phases = _gather_phases(spec)
+        assert [dim for dim, _, _ in phases] == [1, 0]  # inner first
+        assert [span for _, _, span in phases] == [16, 16]
+
+    def test_16x16_hpz_tiers(self):
+        from hcache_deepspeed_tpu.comm.hierarchical import (
+            axis_subgroups, hpz_tier_dims)
+        from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+        spec = make_mesh_spec([16, 16])
+        assert hpz_tier_dims(spec, 16) == [(1, 16)]
+        assert hpz_tier_dims(spec, 4) == [(1, 4)]
+        assert hpz_tier_dims(spec, 64) == [(1, 16), (0, 4)]
+        assert hpz_tier_dims(spec, 256) == [(1, 16), (0, 16)]
+        with pytest.raises(HDSConfigError, match="multiple"):
+            hpz_tier_dims(spec, 24)    # 24 = 16*1.5: genuine mismatch
+        sub = axis_subgroups((16, 16), 1, 4)
+        assert len(sub) == 64 and all(len(g) == 4 for g in sub)
+        assert sub[0] == [0, 1, 2, 3]
+        # aligned runs: every subgroup stays inside one intra row
+        assert all(g[0] // 16 == g[-1] // 16 for g in sub)
+
+    def test_16x16_chunk_bookkeeping(self):
+        """Pipeline chunk bounds + per-phase send counts at pod
+        scale: the (K-1) ring sends per phase the wire-cost model
+        assumes."""
+        from hcache_deepspeed_tpu.comm.ring import _chunk_bounds
+        bounds = _chunk_bounds(10_000_000, 4)
+        assert bounds[0][0] == 0 and bounds[-1][1] == 10_000_000
+        assert all(a < b for a, b in bounds)
+        # uneven split keeps every element exactly once
+        bounds = _chunk_bounds(257, 4)
+        assert sum(b - a for a, b in bounds) == 257
+
+    def test_16x16_pod_projection(self):
+        """The configurable projection target (satellite): a 16x16
+        pod-shape row prices both axes, records the assumption and
+        the calibration source."""
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            pod_scale_wire_seconds
+        out = pod_scale_wire_seconds(
+            {"inter": 1000.0, "intra": 3000.0},
+            {"inter": 2, "intra": 4}, {"inter": 16, "intra": 16},
+            {"inter": 6.75, "intra": 45.0})
+        assert out["scaled_axis_bytes"]["inter"] == 15000
+        assert out["scaled_axis_bytes"]["intra"] == 15000
+        assert out["pod_axis_sizes"] == {"inter": 16, "intra": 16}
+        assert out["calibration"] == "declared"
+        assert out["bottleneck_axis"] == "inter"

@@ -197,3 +197,57 @@ class TestInt4Pack:
         assert packed.shape == (4, 17)
         back = unpack_int4(packed, 33)
         np.testing.assert_array_equal(np.asarray(back), np.asarray(q))
+
+
+class TestHierSiteReconciliation:
+    """ISSUE 15 satellite bugfix gate: with the hpZ secondary refresh
+    and the bucketed/per-leaf gathers riding the mesh, the per-axis
+    map (``permute_axis_bytes``) must still reconcile EXACTLY with the
+    lumped ``permute_bytes_summary`` — every new mesh site attributes
+    each byte exactly once (no double-count between the new
+    ``zero_hier_secondary`` / ``zero_hier_leaf_gather`` ops and the
+    bucketed lanes' ``zero_hier_all_gather``)."""
+
+    def test_per_axis_reconciles_with_lumped_summary(
+            self, eight_devices, comms):
+        import jax.numpy as jnp
+
+        from hcache_deepspeed_tpu.comm.hierarchical import \
+            make_mesh_spec
+        from hcache_deepspeed_tpu.runtime.zero.zeropp import (
+            bucketed_all_gather, build_secondary, make_leaf_gather)
+        spec = make_mesh_spec([2, 4])
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(64, 4)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(32, 2)), jnp.float32)
+
+        def f(a, b):
+            sec = build_secondary(
+                {"a": a, "b": b}, [0, 0], 4,
+                collective_impl="hierarchical", mesh_spec=spec)
+            lg = make_leaf_gather(qw=False, hpz=4, group_size=64,
+                                  collective_impl="hierarchical",
+                                  mesh_spec=spec)
+            full_a = lg(a, sec[0], 0)
+            out = bucketed_all_gather(
+                [b], [sec[1]], [0], qw=False, hpz=4, group_size=64,
+                bucket_elements=10 ** 9,
+                collective_impl="hierarchical", mesh_spec=spec)
+            return full_a, out[0]
+
+        _shmap(f, (P(DATA_AXIS), P(DATA_AXIS)), (P(), P()))(x, y)
+        lumped = comms.permute_bytes_summary()
+        per_axis = comms.permute_axis_bytes()
+        # all three mesh sites present...
+        assert {"zero_hier_secondary", "zero_hier_leaf_gather",
+                "zero_hier_all_gather"} <= set(lumped), sorted(lumped)
+        # ...and every op's per-axis map sums exactly to its lumped
+        # total — byte-exact reconciliation, no double-count
+        for op, total in lumped.items():
+            assert sum(per_axis[op].values()) == total, (op, per_axis)
+        # the secondary refresh crosses the mesh (both axes); the
+        # hpZ-tier gathers stay intra-only
+        assert set(per_axis["zero_hier_secondary"]) == {"intra",
+                                                        "inter"}
+        assert set(per_axis["zero_hier_leaf_gather"]) == {"intra"}
+        assert set(per_axis["zero_hier_all_gather"]) == {"intra"}

@@ -320,3 +320,97 @@ class TestWireCostModel:
             pod_scale_wire_seconds
         out = pod_scale_wire_seconds({"x": 64.0}, {}, {}, {"x": 1.0})
         assert out["scaled_axis_bytes"]["x"] == 64
+
+
+CROSS_AXIS = """
+HloModule crossaxis
+
+ENTRY %main (p: f32[8,16]) -> f32[8,16] {
+  %p = f32[8,16] parameter(0)
+  %intra1 = f32[8,16] collective-permute(f32[8,16] %p), source_target_pairs={{0,1},{1,2},{2,3},{3,0},{4,5},{5,6},{6,7},{7,4}}
+  %inter1 = f32[8,16] collective-permute(f32[8,16] %p), source_target_pairs={{0,4},{4,0},{1,5},{5,1},{2,6},{6,2},{3,7},{7,3}}
+  %dep = f32[8,16] add(f32[8,16] %intra1, f32[8,16] %intra1)
+  ROOT %inter2 = f32[8,16] collective-permute(f32[8,16] %dep), source_target_pairs={{0,4},{4,0},{1,5},{5,1},{2,6},{6,2},{3,7},{7,3}}
+}
+"""
+
+SAME_AXIS_STEPS = """
+HloModule sameaxis
+
+ENTRY %main (p: f32[8,16]) -> f32[8,16] {
+  %p = f32[8,16] parameter(0)
+  %s1 = f32[8,16] collective-permute(f32[8,16] %p), source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  ROOT %s2 = f32[8,16] collective-permute(f32[8,16] %p), source_target_pairs={{0,2},{1,3},{2,0},{3,1}}
+}
+"""
+
+
+class TestCrossAxisTier:
+    """Phase-pipelining evidence (ISSUE 15): permute pairs on
+    DIFFERENT mesh axes (distinct rank-group partitions in their
+    source_target_pairs) that are mutually dependence-free. The
+    unpipelined hierarchical gather has none (every long-haul permute
+    descends from every intra permute); the pipelined form has one per
+    co-resident chunk pair."""
+
+    def test_signature_classifies_axes_not_steps(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import (
+            _permute_group_signature, _same_axis)
+        intra = _permute_group_signature(
+            "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+        intra_d2 = _permute_group_signature(
+            "source_target_pairs={{0,2},{1,3},{2,0},{3,1}}")
+        inter = _permute_group_signature(
+            "source_target_pairs={{0,4},{4,0},{1,5},{5,1}}")
+        # a distance-2 delivery splits the ring into cosets — finer
+        # than distance-1 but nested inside the SAME axis groups; the
+        # strided (other-axis) exchange crosses them
+        assert _same_axis(intra, intra_d2)
+        assert not _same_axis(intra, inter)
+        assert not _same_axis(intra_d2, inter)
+        assert _permute_group_signature("no pairs here") is None
+
+    def test_independent_cross_axis_pair_counted(self):
+        rep = audit_hlo_text(CROSS_AXIS)
+        # intra1 x inter1 independent (1 pair); inter2 DEPENDS on
+        # intra1 (not counted); inter1 x inter2 same axis (not
+        # counted)
+        assert rep.cross_axis == {"pairs": 1, "partnered": 2,
+                                  "permutes": 3}
+        assert 0.0 < rep.cross_axis_overlap_ratio() < 1.0
+
+    def test_same_axis_steps_never_pair(self):
+        rep = audit_hlo_text(SAME_AXIS_STEPS)
+        assert rep.cross_axis["pairs"] == 0
+        assert rep.cross_axis_overlap_ratio() == 0.0
+
+    def test_row_carries_cross_axis_fields(self):
+        import json
+        row = audit_hlo_text(CROSS_AXIS).to_row()
+        json.dumps(row)
+        assert row["cross_axis_pairs"] == 1
+        assert row["cross_axis_overlap_ratio"] > 0.0
+
+
+class TestCalibrationSource:
+    """Every emitted wire-cost row must say where its bandwidths came
+    from (ISSUE 15 satellite): declared model inputs vs measured
+    calibration — and the pod projection must carry its target shape
+    and ring-send assumption."""
+
+    def test_default_is_declared(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            wire_cost_seconds
+        out = wire_cost_seconds({"inter": 1.0}, {"inter": 1.0})
+        assert out["calibration"] == "declared"
+
+    def test_measured_label_rides_through_projection(self):
+        from hcache_deepspeed_tpu.profiling.hlo_audit import \
+            pod_scale_wire_seconds
+        out = pod_scale_wire_seconds(
+            {"inter": 100.0}, {"inter": 2}, {"inter": 16},
+            {"inter": 1.0}, calibration="measured")
+        assert out["calibration"] == "measured"
+        assert out["pod_axis_sizes"] == {"inter": 16}
+        assert out["toy_axis_sizes"] == {"inter": 2}
+        assert "assumption" in out

@@ -1,0 +1,188 @@
+"""Bitwise gates for the unified hpZ-on-mesh step pieces (ISSUE 15):
+the hierarchical hpZ secondary refresh (``build_secondary``), the
+per-leaf gathers (``make_leaf_gather``), and the bucketed hpZ gather
+(``bucketed_all_gather``) vs their NATIVE forms — primitive level, no
+engine builds, tier-1 cheap. The engine-scope bitwise gates live in
+the committed ZERO_OVERLAP.jsonl (``bench.py --zero-overlap``,
+hier-hpz-unified phase).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+from hcache_deepspeed_tpu.parallel.topology import DATA_AXIS
+from hcache_deepspeed_tpu.runtime.zero.zeropp import (build_secondary,
+                                                      bucketed_all_gather,
+                                                      make_leaf_gather)
+
+
+def _mesh():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(np.array(devs[:8]).reshape(8), (DATA_AXIS,))
+
+
+def _shmap(fn, in_specs, out_specs):
+    return jax.jit(functools.partial(
+        jax.shard_map, mesh=_mesh(), axis_names={DATA_AXIS},
+        in_specs=in_specs, out_specs=out_specs, check_vma=False)(fn))
+
+
+SPEC = make_mesh_spec([2, 4])
+
+
+class TestHierSecondaryRefresh:
+    """The hpZ secondary refresh as grouped hierarchical rings:
+    full-width bitwise vs the native refresh; the quantized long-haul
+    variant stays CONSISTENT within each hpZ group (all members share
+    the long-haul coordinate, so they dequantize identically)."""
+
+    @pytest.mark.parametrize("dtype", (jnp.float32, jnp.bfloat16),
+                             ids=lambda d: d.__name__)
+    @pytest.mark.parametrize("dim,shape", ((0, (64, 6)), (1, (6, 64))),
+                             ids=("dim0", "dim1"))
+    def test_fullwidth_bitwise_vs_native(self, eight_devices, dtype,
+                                         dim, shape):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=shape), dtype)
+        in_spec = P(*([None] * dim + [DATA_AXIS]))
+
+        def sec(impl):
+            def f(xl):
+                return build_secondary(
+                    {"w": xl}, [dim], 4, collective_impl=impl,
+                    mesh_spec=SPEC if impl == "hierarchical" else None
+                )[0]
+            return f
+
+        a = np.asarray(_shmap(sec("native"), (in_spec,), in_spec)(x))
+        b = np.asarray(_shmap(sec("hierarchical"), (in_spec,),
+                              in_spec)(x))
+        np.testing.assert_array_equal(a.astype(np.float32),
+                                      b.astype(np.float32))
+
+    @pytest.mark.parametrize("bits", (8, 4))
+    def test_longhaul_secondary_exact_vs_lossy_pattern(
+            self, eight_devices, bits):
+        """With longhaul_bits the refresh keeps own-long-haul-
+        coordinate rows EXACT (they never cross the slow wire) and
+        dequantizes the crossing rows deterministically — so a group's
+        reconstructed full view is exact on its own rows, lossy on the
+        rest."""
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(64, 6)), jnp.float32)
+
+        def f(xl):
+            return build_secondary(
+                {"w": xl}, [0], 4, collective_impl="hierarchical",
+                mesh_spec=SPEC, longhaul_bits=bits)[0]
+
+        # out_spec P(DATA_AXIS) stacks each device's 1/hpz (16-row)
+        # slice: [8 * 16, 6]; device d's slice is the `within = d % 4`
+        # quarter of the full tensor as that device refreshed it
+        out = np.asarray(_shmap(f, (P(DATA_AXIS),),
+                                P(DATA_AXIS))(x)).reshape(8, 16, 6)
+        full = np.asarray(x)
+        for o in range(2):                       # each long-haul coord
+            recon = np.concatenate(
+                [out[o * 4 + w] for w in range(4)])   # within-order
+            own = slice(o * 32, (o + 1) * 32)
+            other = slice((1 - o) * 32, (2 - o) * 32)
+            # own rows bit-exact; crossing rows genuinely quantized
+            np.testing.assert_array_equal(recon[own], full[own])
+            assert not np.array_equal(recon[other], full[other])
+            # ...but close (within the groupwise error envelope)
+            absmax = float(np.abs(full).max())
+            qmax = 127 if bits == 8 else 7
+            assert np.allclose(recon[other], full[other],
+                               atol=absmax / qmax * 1.1)
+
+
+class TestHierLeafAndBucketedGather:
+    """Per-leaf and bucketed hpZ gathers on the unified tier: bitwise
+    vs the native grouped forms, qw (int8 wire) and full width."""
+
+    @pytest.mark.parametrize("qw", (False, True), ids=("fw", "qw"))
+    @pytest.mark.parametrize("hpz", (2, 4))
+    def test_leaf_gather_bitwise(self, eight_devices, qw, hpz):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(64, 6)), jnp.float32)
+
+        def leaf(impl):
+            def f(xl):
+                sec = build_secondary(
+                    {"w": xl}, [0], hpz, collective_impl=impl,
+                    mesh_spec=SPEC if impl == "hierarchical" else None)
+                g = make_leaf_gather(
+                    qw=qw, hpz=hpz, group_size=64,
+                    collective_impl=impl,
+                    mesh_spec=SPEC if impl == "hierarchical" else None)
+                return g(xl, sec[0], 0)
+            return f
+
+        a = np.asarray(_shmap(leaf("native"), (P(DATA_AXIS),), P())(x))
+        b = np.asarray(_shmap(leaf("hierarchical"), (P(DATA_AXIS),),
+                              P())(x))
+        np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize("qw", (False, True), ids=("fw", "qw"))
+    def test_bucketed_gather_bitwise(self, eight_devices, qw):
+        """The bucketed lane under hpz=4 + hierarchical rides the
+        intra-tier grouped rings — bitwise vs the native grouped
+        bucketed gather, multi-leaf buckets included."""
+        rng = np.random.default_rng(3)
+        leaves = [jnp.asarray(rng.normal(size=(64, 4)), jnp.float32),
+                  jnp.asarray(rng.normal(size=(32, 2)), jnp.float32)]
+
+        def bucket(impl):
+            def f(a, b):
+                sec = build_secondary(
+                    {"a": a, "b": b}, [0, 0], 4, collective_impl=impl,
+                    mesh_spec=SPEC if impl == "hierarchical" else None)
+                out = bucketed_all_gather(
+                    [a, b], sec, [0, 0], qw=qw, hpz=4, group_size=64,
+                    bucket_elements=10 ** 9, collective_impl=impl,
+                    mesh_spec=SPEC if impl == "hierarchical" else None)
+                return tuple(out)
+            return f
+
+        ins = (P(DATA_AXIS), P(DATA_AXIS))
+        a = [np.asarray(o) for o in
+             _shmap(bucket("native"), ins, (P(), P()))(*leaves)]
+        b = [np.asarray(o) for o in
+             _shmap(bucket("hierarchical"), ins, (P(), P()))(*leaves)]
+        for xa, xb in zip(a, b):
+            np.testing.assert_array_equal(xa, xb)
+
+    def test_secondary_attribution_rides_the_mesh(self, eight_devices):
+        """Wire evidence: the hierarchical secondary refresh attributes
+        its permute bytes per mesh axis under zero_hier_secondary —
+        the one cross-mesh collective of the hpZ step is no longer a
+        native blind spot."""
+        from hcache_deepspeed_tpu.comm.comms_logging import \
+            get_comms_logger
+        logger = get_comms_logger()
+        logger.configure(enabled=True)
+        logger.reset()
+        x = jnp.asarray(np.random.default_rng(4).normal(size=(64, 6)),
+                        jnp.float32)
+
+        def f(xl):
+            return build_secondary(
+                {"w": xl}, [0], 4, collective_impl="hierarchical",
+                mesh_spec=SPEC)[0]
+
+        _shmap(f, (P(DATA_AXIS),), P(DATA_AXIS))(x)
+        per_axis = logger.permute_axis_bytes().get("zero_hier_secondary")
+        assert per_axis and set(per_axis) == {"intra", "inter"}
+        assert per_axis["intra"] > 0 and per_axis["inter"] > 0
+        logger.reset()
+        logger.configure(enabled=False)

@@ -340,13 +340,52 @@ class TestHierarchicalKnobValidation:
             ZeroConfig(zero_collective_impl="decomposed",
                        zero_longhaul_wire_bits=8)
 
-    def test_hpz_with_hierarchical_rejected(self):
+    def test_hpz_unified_tier_accepted(self):
+        """ISSUE 15: hpZ + hierarchical is no longer a blanket
+        rejection — hpz maps onto the mesh's innermost axes (the
+        unified tier) whenever the hpZ box tiles a contiguous
+        row-major sub-box: divisor of the intra axis, the whole intra
+        axis, or whole-axis multiples."""
         from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
         spec = make_mesh_spec([2, 4])
-        with pytest.raises(HDSConfigError, match="hpz|hpZ"):
+        for hpz in (2, 4, 8):
             validate_overlap_config(collective_impl="hierarchical",
                                     world_size=8, mesh_spec=spec,
-                                    hpz=4)
+                                    hpz=hpz)
+
+    def test_hpz_genuine_mismatch_rejected(self):
+        """Only GENUINE mismatches raise: hpz neither a divisor nor a
+        whole-axis multiple of the fast-tier axes, or exceeding the
+        mesh world."""
+        from hcache_deepspeed_tpu.comm.hierarchical import (hpz_tier_dims,
+                                                            make_mesh_spec)
+        spec = make_mesh_spec([2, 4])
+        with pytest.raises(HDSConfigError, match="divisor"):
+            validate_overlap_config(collective_impl="hierarchical",
+                                    world_size=8, mesh_spec=spec,
+                                    hpz=3)
+        spec44 = make_mesh_spec([4, 4])
+        with pytest.raises(HDSConfigError, match="multiple"):
+            validate_overlap_config(collective_impl="hierarchical",
+                                    world_size=16, mesh_spec=spec44,
+                                    hpz=6)
+        with pytest.raises(HDSConfigError, match="exceeds"):
+            hpz_tier_dims(spec, 16)
+
+    def test_hpz_tier_dims_structure(self):
+        """The tier plan is the innermost-first contiguous-box
+        factoring of hpz over the row-major mesh."""
+        from hcache_deepspeed_tpu.comm.hierarchical import (axis_subgroups,
+                                                            hpz_tier_dims,
+                                                            make_mesh_spec)
+        spec = make_mesh_spec([2, 4])
+        assert hpz_tier_dims(spec, 2) == [(1, 2)]
+        assert hpz_tier_dims(spec, 4) == [(1, 4)]
+        assert hpz_tier_dims(spec, 8) == [(1, 4), (0, 2)]
+        assert hpz_tier_dims(spec, 1) == []
+        # subgroup construction: aligned runs within each axis group
+        assert axis_subgroups((2, 4), 1, 2) == [[0, 1], [2, 3],
+                                                [4, 5], [6, 7]]
 
     def test_overlap_comm_false_rejected_at_parse(self):
         from hcache_deepspeed_tpu.runtime.config import ZeroConfig
@@ -364,6 +403,28 @@ class TestHierarchicalKnobValidation:
         validate_overlap_config(
             collective_impl="hierarchical", world_size=8,
             mesh_spec=make_mesh_spec([2, 4]), longhaul_bits=8)
+
+    def test_pipeline_chunks_knob(self):
+        """Phase pipelining (ISSUE 15): valid with the hierarchical
+        transport, typed 'no effect' rejection without it — no silent
+        ignores."""
+        from hcache_deepspeed_tpu.comm.hierarchical import make_mesh_spec
+        from hcache_deepspeed_tpu.runtime.config import ZeroConfig
+        zcfg = ZeroConfig(zero_collective_impl="hierarchical",
+                          zero_mesh_shape=[2, 4],
+                          zero_mesh_pipeline_chunks=2)
+        assert zcfg.zero_mesh_pipeline_chunks == 2
+        with pytest.raises(HDSConfigError, match="no effect"):
+            ZeroConfig(zero_mesh_pipeline_chunks=2)
+        with pytest.raises(HDSConfigError, match="no effect"):
+            ZeroConfig(zero_collective_impl="decomposed",
+                       zero_mesh_pipeline_chunks=2)
+        validate_overlap_config(
+            collective_impl="hierarchical", world_size=8,
+            mesh_spec=make_mesh_spec([2, 4]), pipeline_chunks=4)
+        with pytest.raises(HDSConfigError, match="no effect"):
+            validate_overlap_config(collective_impl="decomposed",
+                                    world_size=8, pipeline_chunks=2)
 
 
 class TestKnobValidation:
