@@ -1412,6 +1412,54 @@ def run_spec_serve(out_path="SPEC_SERVE.jsonl"):
     return 0 if ok else 4
 
 
+def run_fabric(out_path="FABRIC_SERVE.jsonl"):
+    """``--fabric``: deployment-fabric audit — the seeded migration-
+    heavy trace served through both replica transports (in-memory
+    twin vs spawned worker processes shipping real bytes over real
+    sockets; docs/fabric.md), plus the literal kill-a-process chaos
+    leg. Gates inline: two-run digest determinism on the in-memory
+    twin, digest invariance and bitwise token-stream parity across
+    transports, >= 1 two-hop worker-to-worker crossing, measured wire
+    throughput recorded beside the priced link, >= 2 trace hops
+    across real process boundaries with a connected causal DAG, and
+    crash recovery with never-dropped accounting. Self-compares
+    against the committed perf trajectory before writing. Never
+    touches the TPU relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_fabric_serve
+    try:
+        results = run_fabric_serve(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(
+            f"fabric gate failed: {exc}")), flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "fabric-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "deployment fabric: real-wire deliveries with "
+                  "digest/stream parity vs the in-memory twin",
+        "value": summary["two_hop_deliveries"],
+        "unit": "two-hop crossings",
+        "vs_baseline": 1.0 if summary["invariants_ok"] and
+        summary["deterministic"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("deterministic", "digest_transport_invariant",
+                   "stream_parity", "max_trace_hops",
+                   "trace_connected", "measured_wire_bytes_per_s",
+                   "priced_link_bytes_per_s", "chaos_ok",
+                   "chaos_kills", "replica_crashes",
+                   "bootstrap_mismatches")},
+    }), flush=True)
+    ok = (summary["invariants_ok"] and summary["deterministic"] and
+          summary["stream_parity"] and
+          summary["digest_transport_invariant"] and
+          summary["chaos_ok"])
+    return 0 if ok else 4
+
+
 def run_request_trace(out_path="REQUEST_TRACE.jsonl"):
     """``--request-trace``: CPU-deterministic causal-tracing audit —
     replay the chaos/fleet/disagg workloads and gate connected
@@ -1463,6 +1511,8 @@ def main():
         return run_disagg()
     if "--spec-serve" in sys.argv[1:]:
         return run_spec_serve()
+    if "--fabric" in sys.argv[1:]:
+        return run_fabric()
     if "--request-trace" in sys.argv[1:]:
         return run_request_trace()
     child = os.environ.get("HDS_BENCH_CHILD")

@@ -48,6 +48,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 SIM_DETERMINISTIC = (
     "hcache_deepspeed_tpu/serving/",
     "hcache_deepspeed_tpu/resilience/",
+    "hcache_deepspeed_tpu/fabric/",
     "hcache_deepspeed_tpu/comm/ring.py",
     "hcache_deepspeed_tpu/comm/hierarchical.py",
     "hcache_deepspeed_tpu/runtime/zero/qwire.py",

@@ -1,0 +1,29 @@
+"""Cross-process deployment fabric: pluggable replica transports.
+
+The serving fleet prices every latent migration/handoff/broadcast on
+its shared virtual clock; this package decides how the payload
+actually moves (docs/fabric.md):
+
+* :class:`InMemoryTransport` — same address space, bookkeeping only;
+  behavior-invisible (the committed-digest twin) and the default.
+* :class:`ProcessTransport` — one supervised worker process per
+  replica, a socket latent wire framing the int8+scales latent format
+  and the versioned ``TraceContext`` wire dict, wall-clock transfer
+  timing recorded beside the virtual-clock pricing.
+"""
+
+from .frame import (FRAME_VERSION, Frame, FrameError,
+                    FrameVersionError, decode_frame, dequantize_q8,
+                    encode_frame, quantize_q8)
+from .process import ProcessTransport
+from .transport import (InMemoryTransport, ReplicaTransport,
+                        WorkerDied, apply_frame, canonical_digest,
+                        migration_frame)
+
+__all__ = [
+    "FRAME_VERSION", "Frame", "FrameError", "FrameVersionError",
+    "decode_frame", "encode_frame", "quantize_q8", "dequantize_q8",
+    "ReplicaTransport", "InMemoryTransport", "ProcessTransport",
+    "WorkerDied", "migration_frame", "apply_frame",
+    "canonical_digest",
+]

@@ -1,0 +1,192 @@
+"""Versioned binary wire frame for the replica deployment fabric.
+
+One frame is one latent-wire message: a fixed preamble, a JSON header
+(the ``TraceContext`` wire dict rides here verbatim), and zero or more
+binary array segments. Two segment encodings exist:
+
+* ``raw`` — the array's exact bytes (dtype + shape in the descriptor).
+  This is what migrations/handoffs/prefix broadcasts ship by default:
+  a decode is bit-identical to the encode input, which is the property
+  the process-transport token-stream parity gate leans on.
+* ``q8`` — the already-defined int8+scales latent format (group-wise
+  absmax, the same arithmetic as ``ops.quantizer.reference_quantize``):
+  an int8 payload plus float32 scales plus the original shape/count.
+  Decoding dequantizes; the encode→decode round trip is exactly the
+  quantize→dequantize round trip the disagg int8 wire already prices,
+  now crossing a real process boundary.
+
+Format (all integers little-endian)::
+
+    b"HDSF" | u16 version | u32 header_len | header JSON | segments
+
+The header is an arbitrary JSON object; ``decode_frame`` tolerates
+unknown header fields (forward compatibility) and rejects unknown
+frame versions with a typed :class:`FrameVersionError` — the same
+contract ``TraceContext.from_wire`` keeps for its own version field.
+Segment descriptors live under the reserved ``_segments`` header key,
+in wire order.
+
+Determinism: encoding is a pure function of its inputs (``sort_keys``
+JSON, no timestamps), so a frame is content-addressable — the golden
+fixture test pins the bytes.
+"""
+
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+#: frame-format version (bump on incompatible change; ``decode_frame``
+#: rejects unknown versions rather than mis-parsing them)
+FRAME_VERSION = 1
+
+MAGIC = b"HDSF"
+
+_PREAMBLE = struct.Struct("<4sHI")   # magic, version, header_len
+
+
+class FrameError(ValueError):
+    """Malformed fabric frame (bad magic, truncation, bad segment)."""
+
+
+class FrameVersionError(FrameError):
+    """Frame carries a version this build does not speak."""
+
+
+# ----------------------------------------------------------------- #
+# int8+scales latent codec (numpy mirror of reference_quantize — the
+# worker side must not need a JAX import to decode a frame)
+# ----------------------------------------------------------------- #
+def quantize_q8(x: np.ndarray, group_size: int = 256
+                ) -> Tuple[np.ndarray, np.ndarray, Tuple[int, ...], int]:
+    """Group-wise absmax int8 quantization, bit-compatible with
+    ``ops.quantizer.reference_quantize(num_bits=8)``: returns
+    ``(q int8 [G, group], scales f32 [G, 1], orig_shape, orig_n)``."""
+    x = np.asarray(x, np.float32)
+    flat = x.reshape(-1)
+    n = flat.size
+    pad = (-n) % group_size
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    groups = flat.reshape(-1, group_size)
+    scale = np.max(np.abs(groups), axis=-1, keepdims=True) / 127.0
+    scale = np.where(scale == 0, 1.0, scale).astype(np.float32)
+    q = np.clip(np.round(groups / scale), -128, 127).astype(np.int8)
+    return q, scale, x.shape, n
+
+
+def dequantize_q8(q: np.ndarray, scale: np.ndarray,
+                  orig_shape, orig_n: int) -> np.ndarray:
+    out = (q.astype(np.float32) * scale).reshape(-1)[:int(orig_n)]
+    return out.reshape(tuple(orig_shape))
+
+
+# ----------------------------------------------------------------- #
+# encode / decode
+# ----------------------------------------------------------------- #
+def encode_frame(kind: str, header: Optional[Dict] = None,
+                 arrays: Optional[Dict[str, np.ndarray]] = None,
+                 q8: Optional[Dict[str, np.ndarray]] = None,
+                 q8_group: int = 256,
+                 version: int = FRAME_VERSION) -> bytes:
+    """Build a frame. ``arrays`` ship raw (exact bytes); ``q8`` arrays
+    ship as int8+scales. The reserved ``_segments``/``kind`` header
+    keys are frame-owned."""
+    hdr = dict(header or {})
+    if "_segments" in hdr:
+        raise FrameError("header key '_segments' is reserved")
+    hdr["kind"] = str(kind)
+    descs = []
+    blobs = []
+    for name in sorted(arrays or {}):
+        a = np.ascontiguousarray(arrays[name])
+        descs.append({"name": name, "enc": "raw",
+                      "dtype": a.dtype.str, "shape": list(a.shape),
+                      "nbytes": int(a.nbytes)})
+        blobs.append(a.tobytes())
+    for name in sorted(q8 or {}):
+        q, scale, shape, n = quantize_q8(q8[name], group_size=q8_group)
+        descs.append({"name": name, "enc": "q8",
+                      "group": int(q8_group),
+                      "orig_shape": list(shape), "orig_n": int(n),
+                      "q_nbytes": int(q.nbytes),
+                      "scale_nbytes": int(scale.nbytes),
+                      "groups": int(q.shape[0])})
+        blobs.append(q.tobytes())
+        blobs.append(scale.tobytes())
+    hdr["_segments"] = descs
+    payload = json.dumps(hdr, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return (_PREAMBLE.pack(MAGIC, int(version), len(payload)) +
+            payload + b"".join(blobs))
+
+
+class Frame:
+    """Decoded frame: ``kind``, the JSON ``header`` (unknown fields
+    preserved), and ``arrays`` — raw segments bit-identical to the
+    encoder's input, ``q8`` segments dequantized (``meta`` records the
+    on-wire encoding per segment, so callers can attribute quantized
+    bytes separately from raw bytes)."""
+
+    def __init__(self, kind: str, header: Dict,
+                 arrays: Dict[str, np.ndarray], meta: Dict[str, Dict],
+                 nbytes: int):
+        self.kind = kind
+        self.header = header
+        self.arrays = arrays
+        self.meta = meta
+        self.nbytes = nbytes
+
+
+def decode_frame(buf: bytes) -> Frame:
+    if len(buf) < _PREAMBLE.size:
+        raise FrameError(f"frame truncated at {len(buf)} bytes "
+                         f"(needs >= {_PREAMBLE.size})")
+    magic, version, header_len = _PREAMBLE.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if version != FRAME_VERSION:
+        raise FrameVersionError(
+            f"unknown frame version {version} "
+            f"(this build speaks {FRAME_VERSION})")
+    off = _PREAMBLE.size
+    if len(buf) < off + header_len:
+        raise FrameError("frame truncated inside header")
+    try:
+        header = json.loads(buf[off:off + header_len].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise FrameError(f"undecodable frame header: {exc}") from exc
+    if not isinstance(header, dict):
+        raise FrameError("frame header is not a JSON object")
+    off += header_len
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Dict] = {}
+    for d in header.get("_segments", ()):
+        name, enc = str(d.get("name")), d.get("enc")
+        if enc == "raw":
+            nbytes = int(d["nbytes"])
+            if len(buf) < off + nbytes:
+                raise FrameError(f"segment {name!r} truncated")
+            arrays[name] = np.frombuffer(
+                buf[off:off + nbytes], dtype=np.dtype(d["dtype"])
+            ).reshape(tuple(d["shape"])).copy()
+            off += nbytes
+        elif enc == "q8":
+            qn, sn = int(d["q_nbytes"]), int(d["scale_nbytes"])
+            if len(buf) < off + qn + sn:
+                raise FrameError(f"segment {name!r} truncated")
+            g = int(d["groups"])
+            q = np.frombuffer(buf[off:off + qn],
+                              dtype=np.int8).reshape(g, -1)
+            scale = np.frombuffer(buf[off + qn:off + qn + sn],
+                                  dtype=np.float32).reshape(g, 1)
+            arrays[name] = dequantize_q8(q, scale, d["orig_shape"],
+                                         d["orig_n"])
+            off += qn + sn
+        else:
+            raise FrameError(
+                f"segment {name!r} has unknown encoding {enc!r}")
+        meta[name] = dict(d)
+    kind = str(header.get("kind", ""))
+    return Frame(kind, header, arrays, meta, len(buf))

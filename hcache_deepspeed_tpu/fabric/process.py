@@ -1,0 +1,320 @@
+"""Process transport: real replica workers, real bytes, real wires.
+
+Each fleet replica gets a supervised worker process (the
+``comm/benchmark.py`` child-orchestration pattern, promoted to a
+long-lived supervised fleet). The parent keeps one control socket per
+worker; workers keep peer sockets to each other. A migration landing
+then crosses REAL process boundaries:
+
+    parent --control--> src worker --peer--> dst worker
+                                   <--peer-- (reply)
+           <--control-- src worker
+
+The inner frame (:func:`~.transport.migration_frame`) carries the
+int8-framable latent slab plus the versioned ``TraceContext`` wire
+dict; the destination worker rehydrates the context (``from_wire``
+counts the hop) and echoes the payload bytes, which the parent adopts
+back onto the ``Migration``. Raw segments decode bit-identically, so
+the fleet's token streams are unchanged vs the in-memory transport —
+that is the process-parity gate FABRIC_SERVE commits.
+
+Timing contract: every crossing is timed with ``time.perf_counter``
+(interval measurement — sanctioned in sim-deterministic modules) and
+recorded in :meth:`wire_stats` BESIDE the virtual-clock pricing. The
+measured bytes/s never steers the simulation; it exists so the priced
+``link_bytes_per_s`` / crossover ``migrate_cost_s`` can be calibrated
+against a measured wire (``FleetRouter.observe_wire``).
+
+Supervision: ``alive()`` polls the worker process — a worker that died
+(or was ``kill()``-ed by chaos) makes the fleet's liveness pass crash
+the replica from the survivors' view, which is the literal
+kill-a-process failure mode the fabric chaos leg exercises. A crossing
+that fails mid-flight falls back to the in-memory path for that
+delivery (counted, never silent) — transport faults must not invent
+request failures the simulation didn't price.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .frame import Frame, decode_frame, encode_frame
+from .transport import (ReplicaTransport, apply_frame,
+                        canonical_digest, migration_frame)
+from .worker import recv_frame_bytes, send_frame_bytes
+
+
+def _deadline(seconds: float) -> float:
+    """Wall-clock deadline for worker supervision (spawn/exit waits).
+    The ONE sanctioned ambient-clock read in the fabric: supervising
+    real processes needs real time; nothing here feeds the sim."""
+    # hds: allow(HDS-P001) process-supervision deadline, wall time only
+    return time.monotonic() + seconds
+
+
+class WorkerHandle:
+    """Parent-side record of one spawned replica worker."""
+
+    def __init__(self, replica_id: int, proc: subprocess.Popen):
+        self.replica_id = replica_id
+        self.proc = proc
+        self.conn: Optional[socket.socket] = None
+        self.peer_port: int = -1
+        self.bootstrap_digest: str = ""
+        self.dead = False
+
+    @property
+    def alive(self) -> bool:
+        return (not self.dead) and self.proc.poll() is None
+
+
+class ProcessTransport(ReplicaTransport):
+
+    name = "process"
+
+    def __init__(self, spawn_timeout_s: float = 120.0,
+                 io_timeout_s: float = 60.0):
+        super().__init__()
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.io_timeout_s = float(io_timeout_s)
+        self.workers: Dict[int, WorkerHandle] = {}
+        self._srv: Optional[socket.socket] = None
+        self._started = False
+        # measured-wire accounting (wall clock, never the sim clock)
+        self.shipped = 0
+        self.deliveries = 0
+        self.two_hop_deliveries = 0
+        self.direct_deliveries = 0
+        self.local_fallbacks = 0
+        self.wire_bytes = 0
+        self.wire_seconds = 0.0
+        self.worker_hops = 0
+        self.kills = 0
+        self.bootstrap_mismatches = 0
+
+    # ----------------------------------------------------------- #
+    # lifecycle
+    # ----------------------------------------------------------- #
+    def start(self) -> None:
+        if self._started:
+            return
+        if self.fleet is None:
+            raise RuntimeError("attach(fleet) before start()")
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(len(self.fleet.replicas) + 4)
+        self._srv = srv
+        port = srv.getsockname()[1]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for r in self.fleet.replicas:
+            # -c entry (not -m): the package __init__ already imports
+            # .worker, and runpy warns when re-executing such a module
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import sys; "
+                 "from hcache_deepspeed_tpu.fabric.worker import main; "
+                 "sys.exit(main(sys.argv[1:]))",
+                 "127.0.0.1", str(port), str(r.id)],
+                env=env, stdout=subprocess.DEVNULL)
+            self.workers[r.id] = WorkerHandle(r.id, proc)
+        deadline = _deadline(self.spawn_timeout_s)
+        pending = set(self.workers)
+        while pending:
+            remaining = deadline - _deadline(0.0)
+            if remaining <= 0:
+                self.close()
+                raise RuntimeError(
+                    f"fabric workers {sorted(pending)} missed the "
+                    f"{self.spawn_timeout_s:.0f}s spawn deadline")
+            srv.settimeout(remaining)
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            conn.settimeout(self.io_timeout_s)
+            conn.setsockopt(socket.IPPROTO_TCP,
+                            socket.TCP_NODELAY, 1)
+            hello = decode_frame(recv_frame_bytes(conn))
+            rid = int(hello.header["replica"])
+            h = self.workers[rid]
+            h.conn = conn
+            h.peer_port = int(hello.header["peer_port"])
+            pending.discard(rid)
+        self._started = True
+        self._bootstrap_all()
+
+    def _bootstrap_all(self) -> None:
+        """Ship each replica's engine snapshot to its worker and gate
+        on digest parity: the worker's re-serialization must hash
+        identically to the parent's snapshot."""
+        for r in self.fleet.replicas:
+            eng = r.engine
+            if not hasattr(eng, "serialize"):
+                continue
+            snap = eng.serialize()
+            reply = self._rpc(r.id, encode_frame(
+                "bootstrap", {"snapshot": snap}))
+            if reply.header.get("digest") != canonical_digest(snap):
+                self.bootstrap_mismatches += 1
+            self.workers[r.id].bootstrap_digest = \
+                str(reply.header.get("digest", ""))
+
+    def close(self) -> None:
+        for h in self.workers.values():
+            if h.conn is not None and h.alive:
+                try:
+                    h.conn.settimeout(2.0)
+                    send_frame_bytes(h.conn, encode_frame("exit", {}))
+                    recv_frame_bytes(h.conn)
+                except (OSError, ConnectionError):
+                    pass
+            if h.conn is not None:
+                h.conn.close()
+                h.conn = None
+            if h.proc.poll() is None:
+                h.proc.terminate()
+                try:
+                    h.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    h.proc.kill()
+                    h.proc.wait()
+            h.dead = True
+        if self._srv is not None:
+            self._srv.close()
+            self._srv = None
+        self._started = False
+
+    # ----------------------------------------------------------- #
+    # supervision
+    # ----------------------------------------------------------- #
+    def alive(self, replica_id: int) -> bool:
+        if not self._started:
+            return True
+        h = self.workers.get(replica_id)
+        return h is not None and h.alive
+
+    def kill(self, replica_id: int) -> None:
+        h = self.workers[replica_id]
+        if h.proc.poll() is None:
+            h.proc.kill()
+            h.proc.wait()
+        if h.conn is not None:
+            h.conn.close()
+            h.conn = None
+        if not h.dead:
+            self.kills += 1
+        h.dead = True
+
+    def on_replica_dead(self, replica_id: int) -> None:
+        """A replica the FLEET crashed (injected fault or liveness) no
+        longer has a living engine — reap its worker so the process
+        picture matches the simulation's."""
+        if self._started and self.alive(replica_id):
+            self.kill(replica_id)
+
+    # ----------------------------------------------------------- #
+    # data path
+    # ----------------------------------------------------------- #
+    def _rpc(self, replica_id: int, frame_bytes: bytes) -> Frame:
+        h = self.workers[replica_id]
+        if h.conn is None or not h.alive:
+            raise ConnectionError(
+                f"replica {replica_id} worker is down")
+        send_frame_bytes(h.conn, frame_bytes)
+        return decode_frame(recv_frame_bytes(h.conn))
+
+    def ship(self, m) -> int:
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self.shipped += 1
+        return ticket
+
+    def deliver(self, m, dst: int) -> None:
+        if not self._started:
+            raise RuntimeError(
+                "ProcessTransport.deliver before start()")
+        self.last_wire_sample = None
+        inner = migration_frame(m)
+        src_ok = (m.src is not None and m.src >= 0 and
+                  m.src != dst and self.alive(m.src))
+        t0 = time.perf_counter()
+        try:
+            if src_ok:
+                wrapped = encode_frame(
+                    "forward",
+                    {"peer_port": self.workers[dst].peer_port},
+                    arrays={"inner": np.frombuffer(inner, np.uint8)})
+                reply = self._rpc(m.src, wrapped)
+                inner_reply = reply.arrays["inner"].tobytes()
+                hops = 2
+                self.two_hop_deliveries += 1
+            else:
+                inner_reply = None
+                hops = 1
+            if inner_reply is None:
+                reply_frame = self._rpc(dst, inner)
+                self.direct_deliveries += 1
+            else:
+                reply_frame = decode_frame(inner_reply)
+        except (ConnectionError, OSError):
+            # the wire failed, not the request: deliver in-memory for
+            # this payload (the Migration still holds the objects) and
+            # let the liveness pass account for the dead worker
+            self._mark_dead_conns()
+            self.local_fallbacks += 1
+            self.deliveries += 1
+            return
+        dt = time.perf_counter() - t0
+        if reply_frame.kind != "migration_ok":
+            self.local_fallbacks += 1
+            self.deliveries += 1
+            return
+        apply_frame(m, reply_frame)
+        self.deliveries += 1
+        self.wire_bytes += len(inner) + reply_frame.nbytes
+        self.wire_seconds += dt
+        self.worker_hops += hops
+        # one measured-calibration sample per real crossing; the
+        # fleet forwards it to ``FleetRouter.observe_wire``
+        self.last_wire_sample = (len(inner) + reply_frame.nbytes, dt)
+
+    def _mark_dead_conns(self) -> None:
+        for h in self.workers.values():
+            if not h.dead and h.proc.poll() is not None:
+                h.dead = True
+                if h.conn is not None:
+                    h.conn.close()
+                    h.conn = None
+
+    # ----------------------------------------------------------- #
+    def snapshot_digest(self, replica_id: int) -> str:
+        """Current engine-snapshot digest from the worker side (test /
+        audit surface)."""
+        reply = self._rpc(replica_id, encode_frame("snapshot", {}))
+        return str(reply.header.get("digest", ""))
+
+    def wire_stats(self) -> Dict:
+        bps = (self.wire_bytes / self.wire_seconds
+               if self.wire_seconds > 0 else 0.0)
+        return {
+            "transport": self.name,
+            "workers": len(self.workers),
+            "workers_alive": sum(1 for h in self.workers.values()
+                                 if h.alive),
+            "shipped": self.shipped,
+            "deliveries": self.deliveries,
+            "two_hop_deliveries": self.two_hop_deliveries,
+            "direct_deliveries": self.direct_deliveries,
+            "local_fallbacks": self.local_fallbacks,
+            "worker_hops": self.worker_hops,
+            "kills": self.kills,
+            "bootstrap_mismatches": self.bootstrap_mismatches,
+            "wire_bytes": self.wire_bytes,
+            "wire_seconds": round(self.wire_seconds, 6),
+            "measured_wire_bytes_per_s": round(bps, 3),
+        }

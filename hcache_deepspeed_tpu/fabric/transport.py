@@ -1,0 +1,229 @@
+"""Pluggable replica transport: how migration payloads cross replicas.
+
+The fleet's migration machinery (``fleet.migrate`` / disagg handoff /
+latent prefix broadcast) prices every transfer on the shared virtual
+clock, but HOW the payload moves is a transport decision:
+
+* :class:`InMemoryTransport` — the historical same-address-space path,
+  now explicit: ship/deliver are bookkeeping only, the payload objects
+  ride the ``Migration`` untouched. Zero clock reads, zero events,
+  zero RNG — every committed CHAOS/FLEET/DISAGG/SPEC digest replays
+  byte-identical with this transport installed (the transport-swap
+  twin pattern: same interface, behavior-invisible default).
+* :class:`~.process.ProcessTransport` — real replica worker processes
+  connected by a socket latent wire; ``deliver`` serializes the
+  payload into a :mod:`~.frame` frame, crosses real process
+  boundaries, and returns the decoded bytes, timing the crossing on a
+  wall clock NEXT TO the virtual-clock pricing (never instead of it).
+
+The split contract (why ship/deliver are two calls): a migration
+departs before its destination is final — crash evacuations leave with
+``dst=-1`` and get routed at landing, reroutes retarget mid-flight.
+``ship`` therefore only registers the payload at departure;
+``deliver`` performs the actual crossing at landing time, when the
+destination is known. Virtual transit pricing is unchanged either way:
+the fleet charges ``overhead + bytes/link`` between depart and land
+exactly as before.
+"""
+
+import hashlib
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+from .frame import Frame, decode_frame, encode_frame
+
+
+def canonical_digest(obj) -> str:
+    """SHA-256 over the canonical JSON form — the same digest
+    convention the chaos harnesses hash event logs with, reused here
+    for engine-snapshot bootstrap parity."""
+    return hashlib.sha256(json.dumps(
+        obj, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+class WorkerDied(Exception):
+    """A replica's worker process is gone (crashed or killed): the
+    engine and its KV died with it. Shaped like an injected fault
+    (``hit`` attribute) so the fleet's crash path logs it uniformly."""
+
+    def __init__(self, replica: int, detail: str = ""):
+        super().__init__(f"replica {replica} worker died"
+                         + (f": {detail}" if detail else ""))
+        self.replica = replica
+        self.hit = 0
+
+
+# ----------------------------------------------------------------- #
+# migration <-> frame (shared by the process transport and its tests)
+# ----------------------------------------------------------------- #
+def migration_frame(m) -> bytes:
+    """Serialize a ``Migration``'s wire payload: the trace wire dict +
+    the latent slab (request-carrying) or the prefix payload
+    (broadcast). Raw encoding — the decode must be bit-identical."""
+    arrays = {}
+    lat = None if m.request is None else m.request.latents
+    if lat is not None and lat.shape[1] > 0:
+        arrays["latents"] = np.asarray(lat)
+    if m.payload is not None:
+        arrays["payload"] = np.asarray(m.payload)
+    header = {
+        "uid": int(m.uid), "src": int(m.src), "dst": int(m.dst),
+        "reason": str(m.reason), "tokens": int(m.tokens),
+        "trace": m.trace_wire,
+        "prefix_tokens": (None if m.prefix_tokens is None
+                          else [int(t) for t in m.prefix_tokens]),
+    }
+    return encode_frame("migration", header, arrays=arrays)
+
+
+def apply_frame(m, frame: Frame) -> None:
+    """Land a decoded migration frame back onto the ``Migration``:
+    the payload objects the scheduler adopts are now EXACTLY the bytes
+    that crossed the wire."""
+    m.trace_wire = frame.header.get("trace")
+    if m.request is not None and "latents" in frame.arrays:
+        # the restore contract wants a HostLatentStore ([L, T, H]
+        # slab with token-count __len__), not a bare ndarray
+        from ..inference.ragged.latents import HostLatentStore
+        m.request.latents = HostLatentStore.from_array(
+            frame.arrays["latents"])
+    if "payload" in frame.arrays:
+        m.payload = frame.arrays["payload"]
+
+
+class ReplicaTransport:
+    """Transport interface the fleet drives. Implementations must not
+    read the serving clock or touch fleet event logs / counters /
+    RNG — transit pricing and replay determinism belong to the fleet;
+    a transport only moves (and may measure) bytes."""
+
+    name = "abstract"
+
+    #: last real crossing as ``(nbytes, wall_seconds)`` — set by
+    #: measuring transports after a successful :meth:`deliver`, read
+    #: (and cleared) by the fleet to feed ``FleetRouter.observe_wire``
+    #: calibration. ``None`` when nothing was measured: the in-memory
+    #: transport moves no bytes, so it never reports a sample and the
+    #: router's measured-link block stays absent — keeping the
+    #: historical summaries (and their digests) untouched.
+    last_wire_sample = None
+
+    def __init__(self):
+        self.fleet = None
+        self._next_ticket = 0
+
+    # -- lifecycle ------------------------------------------------- #
+    def attach(self, fleet) -> None:
+        """Bind to the owning fleet (called from the fleet ctor)."""
+        self.fleet = fleet
+
+    def start(self) -> None:
+        """Bring the wire up (spawn workers, open sockets). The
+        in-memory transport has nothing to start."""
+
+    def close(self) -> None:
+        """Tear the wire down. Idempotent."""
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- data path ------------------------------------------------- #
+    def ship(self, m) -> int:
+        """Register ``m``'s payload at departure; returns the ticket
+        stamped onto the migration. No crossing happens yet (the
+        destination may not exist until landing)."""
+        raise NotImplementedError
+
+    def deliver(self, m, dst: int) -> None:
+        """Perform the crossing at landing time: after this returns,
+        ``m.trace_wire`` / ``m.request.latents`` / ``m.payload`` are
+        the post-wire payload the destination adopts."""
+        raise NotImplementedError
+
+    # -- supervision ----------------------------------------------- #
+    def alive(self, replica_id: int) -> bool:
+        """Liveness from the transport's view (a worker process that
+        died IS a crashed replica, whatever the simulation planned)."""
+        return True
+
+    def kill(self, replica_id: int) -> None:
+        """Hard-kill the replica's backing worker (chaos surface)."""
+        raise NotImplementedError(
+            f"{self.name} transport has no process to kill")
+
+    def on_replica_dead(self, replica_id: int) -> None:
+        """Fleet hook: replica ``replica_id`` just crashed in the
+        fleet's view — reap whatever backs it. No-op by default."""
+
+    def wire_stats(self) -> Dict:
+        """Measured-wire accounting (wall-clock side; empty for the
+        in-memory path, which crosses nothing)."""
+        return {}
+
+
+class InMemoryTransport(ReplicaTransport):
+    """Same-address-space transport: the committed-digest twin.
+
+    ``ship``/``deliver`` are pure bookkeeping — payload objects stay
+    on the ``Migration`` untouched, so behavior (and every committed
+    digest) is bit-identical to the pre-fabric fleet. With
+    ``verify_frames=True`` every delivery additionally round-trips the
+    payload through the binary frame codec and asserts bit-exactness —
+    the codec soak the fabric tests run on live fleet traffic (still
+    digest-invisible: raw frames decode to identical bytes)."""
+
+    name = "in-memory"
+
+    def __init__(self, verify_frames: bool = False):
+        super().__init__()
+        self.verify_frames = verify_frames
+        self.shipped = 0
+        self.delivered = 0
+        self.bytes_registered = 0
+        self.frames_verified = 0
+
+    def ship(self, m) -> int:
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self.shipped += 1
+        self.bytes_registered += int(m.nbytes)
+        return ticket
+
+    def deliver(self, m, dst: int) -> None:
+        self.delivered += 1
+        if not self.verify_frames:
+            return
+        before_latents = None if m.request is None \
+            else m.request.latents
+        frame = decode_frame(migration_frame(m))
+        if before_latents is not None:
+            got = frame.arrays["latents"]
+            if got.dtype != before_latents.dtype or \
+                    not np.array_equal(got, before_latents):
+                raise AssertionError(
+                    f"frame round trip corrupted latents for uid "
+                    f"{m.uid}")
+        if m.payload is not None and \
+                not np.array_equal(frame.arrays["payload"], m.payload):
+            raise AssertionError(
+                f"frame round trip corrupted prefix payload for uid "
+                f"{m.uid}")
+        if frame.header.get("trace") != m.trace_wire:
+            raise AssertionError(
+                f"frame round trip corrupted trace wire dict for uid "
+                f"{m.uid}")
+        self.frames_verified += 1
+
+    def wire_stats(self) -> Dict:
+        return {"transport": self.name, "shipped": self.shipped,
+                "delivered": self.delivered,
+                "bytes_registered": self.bytes_registered,
+                "frames_verified": self.frames_verified}

@@ -1,0 +1,210 @@
+"""Replica worker process: the far end of the process transport.
+
+One worker backs one fleet replica. It is spawned by
+:class:`~.process.ProcessTransport` with a control address on argv,
+connects back, and then serves framed commands:
+
+* ``bootstrap`` — rebuild a :class:`~..serving.sim.SimulatedEngine`
+  from the parent's ``serialize()`` snapshot and answer with the
+  canonical digest of its own re-serialization. Digest equality with
+  the parent's snapshot is the bootstrap-parity gate: the snapshot
+  format IS the process-side engine bootstrap, so a serialization gap
+  shows up here as a digest mismatch, not as silent divergence later.
+* ``migration`` — land a migration frame: rehydrate the carried
+  ``TraceContext`` wire dict (``from_wire`` counts the hop), stamp the
+  worker onto the frame's ``path``, and echo the payload back
+  re-framed. The same handler serves the control channel (parent →
+  this worker) and the peer channel (another worker → this worker), so
+  a two-hop src→dst crossing rehydrates on the true destination.
+* ``forward`` — src-side of the two-hop crossing: unwrap the inner
+  frame, ship it to the destination worker's peer port over a cached
+  socket, and relay the reply.
+* ``snapshot`` / ``ping`` / ``exit`` — supervision surface.
+
+Concurrency: the control loop is single-threaded; each accepted peer
+connection gets its own handler thread but touches only its own socket
+and the shared read-only engine reference. No locks, by construction.
+"""
+
+import socket
+import struct
+import sys
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .frame import Frame, decode_frame, encode_frame
+
+_LEN = struct.Struct("<I")
+
+#: refuse absurd frames rather than allocating unbounded buffers
+MAX_FRAME_BYTES = 1 << 30
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame_bytes(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(f"oversized frame ({n} bytes)")
+    return recv_exact(sock, n)
+
+
+def send_frame_bytes(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+class FabricWorker:
+
+    def __init__(self, host: str, port: int, replica_id: int):
+        self.replica_id = int(replica_id)
+        self.engine = None
+        self.ctrl = socket.create_connection((host, port))
+        self.ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._peer_srv = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._peer_srv.bind(("127.0.0.1", 0))
+        self._peer_srv.listen(16)
+        self.peer_port = self._peer_srv.getsockname()[1]
+        #: cached outbound peer sockets, keyed by peer port (touched
+        #: only by the control loop — forward commands are serial)
+        self._peers: Dict[int, socket.socket] = {}
+
+    # ----------------------------------------------------------- #
+    def run(self) -> None:
+        accept = threading.Thread(target=self._accept_loop,
+                                  name="hds-fabric-peer-accept",
+                                  daemon=True)
+        accept.start()
+        send_frame_bytes(self.ctrl, encode_frame(
+            "hello", {"replica": self.replica_id,
+                      "peer_port": self.peer_port}))
+        while True:
+            frame = decode_frame(recv_frame_bytes(self.ctrl))
+            if frame.kind == "exit":
+                send_frame_bytes(self.ctrl, encode_frame(
+                    "bye", {"replica": self.replica_id}))
+                break
+            send_frame_bytes(self.ctrl, self.handle(frame))
+        self.ctrl.close()
+        self._peer_srv.close()
+
+    # ----------------------------------------------------------- #
+    def handle(self, frame: Frame) -> bytes:
+        if frame.kind == "bootstrap":
+            return self._bootstrap(frame)
+        if frame.kind == "migration":
+            return self._land_migration(frame)
+        if frame.kind == "forward":
+            return self._forward(frame)
+        if frame.kind == "snapshot":
+            return self._snapshot()
+        if frame.kind == "ping":
+            return encode_frame("pong", {"replica": self.replica_id})
+        return encode_frame(
+            "error", {"replica": self.replica_id,
+                      "error": f"unknown command {frame.kind!r}"})
+
+    def _bootstrap(self, frame: Frame) -> bytes:
+        from ..serving.sim import SimulatedEngine
+        from .transport import canonical_digest
+        self.engine = SimulatedEngine.deserialize(
+            frame.header["snapshot"])
+        return encode_frame("bootstrap_ok", {
+            "replica": self.replica_id,
+            "digest": canonical_digest(self.engine.serialize())})
+
+    def _snapshot(self) -> bytes:
+        from .transport import canonical_digest
+        if self.engine is None:
+            return encode_frame("error", {
+                "replica": self.replica_id,
+                "error": "no engine bootstrapped"})
+        snap = self.engine.serialize()
+        return encode_frame("snapshot_ok", {
+            "replica": self.replica_id, "snapshot": snap,
+            "digest": canonical_digest(snap)})
+
+    def _land_migration(self, frame: Frame) -> bytes:
+        """The landing half of the wire: rehydrate the trace context
+        from its wire dict (a real cross-process hop — ``from_wire``
+        increments ``hops``), record this worker on the path, and echo
+        the payload bytes back framed."""
+        from ..telemetry.context import TraceContext
+        hdr = {k: v for k, v in frame.header.items()
+               if k not in ("_segments", "kind")}
+        if hdr.get("trace") is not None:
+            hdr["trace"] = TraceContext.from_wire(
+                hdr["trace"]).to_wire()
+        path = [int(p) for p in (hdr.get("path") or [])]
+        path.append(self.replica_id)
+        hdr["path"] = path
+        return encode_frame("migration_ok", hdr,
+                            arrays=dict(frame.arrays))
+
+    def _forward(self, frame: Frame) -> bytes:
+        """Src-side of a two-hop crossing: relay the inner frame to
+        the destination worker's peer port and return its reply."""
+        port = int(frame.header["peer_port"])
+        inner = frame.arrays["inner"].tobytes()
+        conn = self._peers.get(port)
+        if conn is None:
+            conn = socket.create_connection(("127.0.0.1", port))
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._peers[port] = conn
+        send_frame_bytes(conn, inner)
+        reply = recv_frame_bytes(conn)
+        return encode_frame(
+            "forward_ok", {"replica": self.replica_id},
+            arrays={"inner": np.frombuffer(reply, np.uint8)})
+
+    # ----------------------------------------------------------- #
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._peer_srv.accept()
+            except OSError:
+                return               # server socket closed: exiting
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_peer, args=(conn,),
+                             name="hds-fabric-peer", daemon=True
+                             ).start()
+
+    def _serve_peer(self, conn: socket.socket) -> None:
+        """Handle one inbound peer connection: a stream of migration
+        frames, each answered in place. Only this thread touches
+        ``conn``; the engine reference is read-only here."""
+        try:
+            while True:
+                frame = decode_frame(recv_frame_bytes(conn))
+                send_frame_bytes(conn, self.handle(frame))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 3:
+        print("usage: python -m hcache_deepspeed_tpu.fabric.worker "
+              "<host> <port> <replica_id>", file=sys.stderr)
+        return 2
+    host, port, replica_id = argv[0], int(argv[1]), int(argv[2])
+    FabricWorker(host, port, replica_id).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1698,6 +1698,214 @@ def run_spec_serve(seed=0, runs=2, out="SPEC_SERVE.jsonl"):
     return results
 
 
+def run_fabric_serve(seed=0, n_replicas=3, n_requests=24, runs=2,
+                     out="FABRIC_SERVE.jsonl"):
+    """``--fabric``: deployment-fabric audit — the same seeded
+    migration-heavy trace served through BOTH replica transports
+    (docs/fabric.md), plus the literal kill-a-process chaos leg. The
+    artifact IS the acceptance evidence; gates run inline:
+
+    * ``fabric-parity`` — one fleet per transport on one seed. The
+      in-memory twin runs ``runs`` times gating byte-identical event
+      digests; the process fleet (one spawned worker per replica,
+      migrations crossing real sockets as int8-framable latent frames
+      + versioned trace wire dicts) must produce the SAME digest and
+      bitwise-identical per-request token streams — the transport
+      moves bytes, never outcomes. Gates at least one two-hop
+      (src worker -> dst worker) crossing, measured wall-clock wire
+      throughput recorded beside the priced ``link_bytes_per_s``
+      (``FleetRouter.observe_wire`` calibration), and at least one
+      request whose trace context counts >= 2 wire hops — real
+      process boundaries in the causal DAG, which must stay connected;
+    * ``fabric-chaos`` — ``resilience.run_fabric_chaos``: the busiest
+      worker is SIGKILLed mid-trace and the fleet recovers with
+      never-dropped accounting (exactly one terminal state per
+      request, zero survivor leaks, migration balance, >= 1 request
+      finished after the kill, zero bootstrap digest mismatches).
+
+    CPU-only, never touches the TPU relay. Wall-clock readings appear
+    ONLY in measured-wire fields — every gate the digests depend on is
+    virtual-clock deterministic."""
+    from ..fabric import (InMemoryTransport, ProcessTransport,
+                          canonical_digest)
+    from ..resilience import run_fabric_chaos
+    from ..resilience.chaos import (_trace_gates, _trace_row,
+                                    build_chaos_trace)
+    from ..serving import (FleetConfig, RouterConfig, ServerConfig,
+                           ServingFleet, SimulatedEngine, VirtualClock)
+    from .config import RaggedInferenceEngineConfig
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    violations = []
+
+    def make_engine():
+        # deliberately tight KV budget: pressure evictions make the
+        # trace migration-heavy, so bytes actually cross the fabric
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 64},
+            kv_cache={"block_size": 8, "num_blocks": 12},
+            hcache={"enable_latents": True}))
+
+    def drive(transport):
+        """One full kill-free serve of the seeded trace."""
+        fleet = ServingFleet(
+            engines=[make_engine() for _ in range(n_replicas)],
+            clock=VirtualClock(),
+            config=FleetConfig(
+                n_replicas=n_replicas,
+                server=ServerConfig(max_queue_depth=n_requests + 1,
+                                    kv_demand_fraction=float("inf")),
+                router=RouterConfig(),
+                transport=transport))
+        reqs = build_chaos_trace(
+            seed, n_requests, fleet.replicas[0].engine.vocab_size,
+            max_new=10, rps=400.0, prompt_hi=24)
+        with fleet.transport:
+            arrivals = sorted(reqs,
+                              key=lambda r: (r.arrival_time, r.uid))
+            steps = 0
+            while arrivals or fleet.has_work:
+                now = fleet.clock.now()
+                while arrivals and arrivals[0].arrival_time <= now:
+                    fleet.submit(request=arrivals.pop(0))
+                if not fleet.has_work and arrivals:
+                    fleet.clock.advance_to(arrivals[0].arrival_time)
+                    continue
+                fleet.step()
+                steps += 1
+                if steps > 1_000_000:
+                    raise RuntimeError("fabric serve livelock:\n"
+                                       + fleet.snapshot())
+        return fleet, reqs, canonical_digest(fleet.event_log())
+
+    # ------------- phase 1: cross-transport parity ----------------- #
+    mem_runs = [drive(InMemoryTransport())
+                for _ in range(max(1, runs))]
+    mem_digests = [d for _, _, d in mem_runs]
+    deterministic = len(set(mem_digests)) == 1
+    mem_fleet, mem_reqs, mem_digest = mem_runs[0]
+    proc_fleet, proc_reqs, proc_digest = drive(ProcessTransport())
+    wire = proc_fleet.transport.wire_stats()
+    stream_parity = ({r.uid: r.tokens_out for r in mem_reqs} ==
+                     {r.uid: r.tokens_out for r in proc_reqs})
+    digest_invariant = proc_digest == mem_digest
+    max_hops = max((getattr(r.trace, "hops", 0) or 0)
+                   for r in proc_reqs)
+    trace_inv = _trace_gates(proc_reqs, violations)
+    measured_link = proc_fleet.summary()["router"].get(
+        "measured_link")
+    if not deterministic:
+        violations.append(
+            f"fabric-parity: in-memory twin digests diverged across "
+            f"{len(mem_digests)} runs")
+    if not stream_parity:
+        violations.append(
+            "fabric-parity: process-vs-in-memory token streams differ")
+    if not digest_invariant:
+        violations.append(
+            "fabric-parity: event digest depends on the transport "
+            f"({proc_digest[:12]} != {mem_digest[:12]})")
+    if wire["shipped"] < 1 or wire["deliveries"] < 1:
+        violations.append(
+            f"fabric-parity: no bytes crossed the fabric ({wire})")
+    if wire["two_hop_deliveries"] < 1:
+        violations.append(
+            "fabric-parity: no two-hop (worker-to-worker) crossing")
+    if wire["measured_wire_bytes_per_s"] <= 0:
+        violations.append(
+            "fabric-parity: measured wire throughput missing")
+    if measured_link is None or measured_link["samples"] < 1:
+        violations.append(
+            "fabric-parity: router measured-link calibration absent")
+    if max_hops < 2:
+        violations.append(
+            f"fabric-parity: max trace hops {max_hops} < 2 — no trace "
+            "crossed a real process boundary")
+    if wire["bootstrap_mismatches"]:
+        violations.append(
+            f"fabric-parity: {wire['bootstrap_mismatches']} bootstrap "
+            "digest mismatches")
+    for r in proc_reqs:
+        emit({"phase": "fabric-request", "uid": r.uid,
+              "state": r.state.name, "tokens": len(r.tokens_out),
+              "migrations": r.n_migrations, **_trace_row(r)})
+    emit({"phase": "fabric-parity", "seed": seed,
+          "n_replicas": n_replicas, "n_requests": n_requests,
+          "runs": len(mem_runs),
+          "deterministic": deterministic,
+          "event_digest": mem_digest,
+          "process_digest": proc_digest,
+          "digest_transport_invariant": digest_invariant,
+          "stream_parity": stream_parity,
+          "transports": [mem_fleet.transport.name,
+                         proc_fleet.transport.name],
+          "wire": wire,
+          "priced_link_bytes_per_s":
+              proc_fleet.config.link_bytes_per_s,
+          "measured_link": measured_link,
+          "max_trace_hops": max_hops,
+          "trace": trace_inv})
+
+    # ------------- phase 2: literal kill-a-process ----------------- #
+    chaos = run_fabric_chaos(seed=seed, n_replicas=n_replicas)
+    violations.extend(f"fabric-chaos: {v}" for v in chaos.violations)
+    emit({"phase": "fabric-chaos", "seed": seed,
+          "victim": chaos.victim,
+          "event_digest": chaos.event_digest,
+          "ok": chaos.ok,
+          "wire": chaos.wire,
+          "invariants": chaos.invariants})
+
+    c = chaos.invariants["counters"]
+    emit({"phase": "fabric-summary", "seed": seed,
+          "n_replicas": n_replicas, "n_requests": n_requests,
+          "runs": len(mem_runs),
+          "deterministic": deterministic,
+          "event_digest": mem_digest,
+          "digest_transport_invariant": digest_invariant,
+          "stream_parity": stream_parity,
+          "two_hop_deliveries": wire["two_hop_deliveries"],
+          "wire_bytes": wire["wire_bytes"],
+          "measured_wire_bytes_per_s":
+              wire["measured_wire_bytes_per_s"],
+          "priced_link_bytes_per_s":
+              proc_fleet.config.link_bytes_per_s,
+          "max_trace_hops": max_hops,
+          "trace_connected": trace_inv["connected"],
+          "chaos_ok": chaos.ok,
+          "chaos_kills": chaos.wire["kills"],
+          "replica_crashes": c["replica_crashes"],
+          "done_after_kill": chaos.invariants["done_after"],
+          "bootstrap_mismatches":
+              wire["bootstrap_mismatches"] +
+              chaos.wire["bootstrap_mismatches"],
+          "invariants_ok": not violations,
+          "violations": violations})
+
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "FABRIC_SERVE.jsonl", results))
+    if fh is not None:
+        fh.close()
+    if violations:
+        raise RuntimeError(
+            f"fabric serve gates violated: {violations}")
+    return results
+
+
+
 def run_request_trace(seed=0, runs=2, out="REQUEST_TRACE.jsonl",
                       closure_tol=0.01):
     """Causal request-tracing audit (``bench.py --request-trace``):

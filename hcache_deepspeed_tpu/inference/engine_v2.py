@@ -1000,10 +1000,11 @@ class InferenceEngineV2:
     # -------------------------------------------------------------- #
     # fused speculative verify step (the serving speculation surface)
     # -------------------------------------------------------------- #
-    #: ``put_spec`` does not capture latents (the tail forward has no
-    #: capture path) — the serving scheduler only speculates against
-    #: this engine in exact-KV suspension mode
-    spec_latent_capture = False
+    #: ``put_spec`` captures accepted-span latents through the
+    #: latent-capturing tail forward (``forward_chunk_tail_lat``), so
+    #: the serving scheduler may speculate against this engine under
+    #: latent preemption as well as in exact-KV suspension mode
+    spec_latent_capture = True
 
     @_annotated("hds.serve.put_spec")
     def put_spec(self, batch_uids: Iterable[int], batch_feeds,
@@ -1016,18 +1017,15 @@ class InferenceEngineV2:
         is accepted, and rejected draft KV rolls back
         (``SequenceDescriptor.rollback``). Greedy-exact per lane.
 
-        Returns ``(emitted, latents)`` with ``latents`` all None:
-        speculation on this engine requires
-        ``hcache.enable_latents=false`` (the rolled-back tail must
-        never reach a latent payload, and the tail forward has no
-        capture path) and ``prefix_caching=false`` (rolled-back KV
-        must never register as a sharable prefix) — the serving
-        scheduler suspends speculative residents in exact-KV mode."""
-        if self.config.hcache.enable_latents:
-            raise RuntimeError(
-                "put_spec does not capture latents; disable "
-                "hcache.enable_latents (exact-KV suspension) to "
-                "speculate on this engine")
+        Returns ``(emitted, latents)``. Under
+        ``hcache.enable_latents`` the dispatch runs the
+        latent-capturing tail forward and each lane's entry is its
+        ACCEPTED span's latent chunk ``[L, acc+1, H]`` (the fed token
+        plus accepted drafts — rolled-back positions never reach a
+        latent payload); in exact-KV mode the entries are all None.
+        ``prefix_caching`` stays unsupported (rolled-back KV must
+        never register as a sharable prefix)."""
+        capture = bool(self.config.hcache.enable_latents)
         if self.prefix_caching:
             raise RuntimeError(
                 "put_spec with prefix_caching is unsupported: "
@@ -1071,9 +1069,16 @@ class InferenceEngineV2:
         with get_tracer().span("serve.spec_dispatch", lanes=n,
                                tokens=int(sum(len(f)
                                               for f in batch_feeds))):
-            tail_logits = np.asarray(self.model.forward_chunk_tail(
-                self.cache, tok, start, tables, t_len, T))
+            if capture:
+                tail_logits, lat = self.model.forward_chunk_tail_lat(
+                    self.cache, tok, start, tables, t_len, T)
+                tail_logits = np.asarray(tail_logits)
+                lat = np.asarray(lat)          # [L, B, T, H]
+            else:
+                tail_logits = np.asarray(self.model.forward_chunk_tail(
+                    self.cache, tok, start, tables, t_len, T))
         emitted_out: List[List[int]] = []
+        lat_out: List = []
         for j, (uid, feed) in enumerate(zip(batch_uids, batch_feeds)):
             seq = self.state.get_sequence(uid)
             seq.post_forward()
@@ -1088,7 +1093,11 @@ class InferenceEngineV2:
                 acc += 1
             seq.rollback(d - acc)        # rejected draft KV
             emitted_out.append(greedy[:acc + 1])
-        return emitted_out, [None] * n
+            # feeds are left-aligned at column 0, so the accepted
+            # span's latents are the first acc+1 columns of the lane
+            lat_out.append(lat[:, j, :acc + 1].copy() if capture
+                           else None)
+        return emitted_out, lat_out
 
     # -------------------------------------------------------------- #
     # HCache restore (fork: engine_v2.py:108)

@@ -148,6 +148,7 @@ class PagedInferenceModel:
         self._fwd = jax.jit(fwd, donate_argnums=(1, 2))
         self._restore = jax.jit(restore, donate_argnums=(1, 2))
         self._fwd_tail_cache = {}
+        self._fwd_tail_lat_cache = {}
         self._fwd_tail_inner_cache = {}
         self._lookup_loop_jit = jax.jit(
             self._lookup_decode_loop,
@@ -601,6 +602,26 @@ class PagedInferenceModel:
                                         tiled=True)
         return cache_k, cache_v, logits
 
+    def _forward_chunk_tail_lat(self, params, cache_k, cache_v,
+                                tokens, start, tables, t_len, tail):
+        """``_forward_chunk_tail`` that also returns the trunk's
+        captured latents [L, B, T, H] — the verification forward of
+        speculative decoding under latent preemption: the caller keeps
+        the accepted span's latents (columns ``:acc+1`` of each lane)
+        and discards the rolled-back tail. A separate compiled family
+        (``_fwd_tail_lat_cache``): engines running exact-KV suspension
+        never pay for the latent output."""
+        params, cache_k, cache_v, x, latents = self._trunk(
+            params, cache_k, cache_v, tokens, start, tables, t_len)
+        idx = jnp.maximum(
+            t_len[:, None] - tail + jnp.arange(tail)[None, :], 0)
+        xt = jnp.take_along_axis(x, idx[..., None], axis=1)
+        logits = self._head_logits(params, xt)
+        if self.tp > 1:
+            logits = jax.lax.all_gather(logits, TENSOR_AXIS, axis=2,
+                                        tiled=True)
+        return cache_k, cache_v, logits, latents
+
     def _final_norm(self, params, x):
         """Final RMSNorm; LayerNorm families (falcon) override."""
         return rms_norm(x, params["norm"], eps=self.cfg.rms_norm_eps)
@@ -676,6 +697,44 @@ class PagedInferenceModel:
             jnp.asarray(t_len, jnp.int32))
         cache.replace(ck, cv)
         return logits
+
+    def _fwd_tail_lat_for(self, tail: int):
+        """Latent-capturing sibling of :meth:`_fwd_tail_for` (its own
+        program cache — the exact-KV tail forward never retraces when
+        a latent engine shares the process)."""
+        fn = self._fwd_tail_lat_cache.get(tail)
+        if fn is None:
+            def fwd_tail(params, ck, cv, tokens, start, tables, t_len):
+                return self._forward_chunk_tail_lat(
+                    params, ck, cv, tokens, start, tables, t_len, tail)
+            if self.tp > 1:
+                from jax.sharding import PartitionSpec as P
+                cache_spec = P(None, TENSOR_AXIS, None, None)
+                rep = P()
+                fwd_tail = jax.shard_map(
+                    fwd_tail, mesh=self.topology.mesh,
+                    axis_names={TENSOR_AXIS},
+                    in_specs=(self._param_spec_tree(), cache_spec,
+                              cache_spec, rep, rep, rep, rep),
+                    out_specs=(cache_spec, cache_spec, rep, rep),
+                    check_vma=False)
+            fn = jax.jit(fwd_tail, donate_argnums=(1, 2))
+            self._fwd_tail_lat_cache[tail] = fn
+        return fn
+
+    def forward_chunk_tail_lat(self, cache, tokens, start, tables,
+                               t_len, tail: int):
+        """Verification forward that also captures latents: the
+        speculative verify step under latent preemption. Returns
+        ``(logits [B, tail, V], latents [L, B, T, H])`` — latent
+        columns align with ``tokens`` columns (left-aligned feeds), so
+        a lane's accepted span is ``latents[:, j, :acc+1]``."""
+        ck, cv, logits, latents = self._fwd_tail_lat_for(tail)(
+            self.params, cache.k, cache.v, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(tables, jnp.int32),
+            jnp.asarray(t_len, jnp.int32))
+        cache.replace(ck, cv)
+        return logits, latents
 
     # -------------------------------------------------------------- #
     # HCache restore (the fork's flagship delta)

@@ -41,6 +41,22 @@ class HostLatentStore:
         if first_chunk is not None:
             self.append(first_chunk)
 
+    @classmethod
+    def from_array(cls, arr) -> "HostLatentStore":
+        """Rebuild a store around a complete ``[L, T, H]`` latent slab
+        (e.g. one that just crossed a wire). Unlike :meth:`append`
+        this is not an absorb — no fault site fires — and the slab is
+        adopted as the valid span verbatim, preserving dtype."""
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim != 3:
+            raise ValueError(
+                f"latent slab must be [L, T, H], got {arr.shape}")
+        store = cls()
+        if arr.size:
+            store._buf = arr
+            store._len = arr.shape[1]
+        return store
+
     def append(self, chunk) -> None:
         """Absorb one ``[L, t, H]`` latent chunk (t >= 1)."""
         from ...resilience.faults import get_injector

@@ -779,6 +779,51 @@ def parse_spec_serve(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_fabric_serve(text: str, file: str) -> List[MetricPoint]:
+    """FABRIC_SERVE.jsonl: the deployment fabric audit (``bench.py
+    --fabric``) — process-vs-in-memory transport parity plus the
+    literal kill-a-process chaos leg. The boolean gates are hard
+    (rel=0.0 in TOLERANCES); the measured wire throughput is
+    wall-clock on whatever host ran the bench and is recorded as
+    informational trajectory only."""
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        if row.get("phase") != "fabric-summary":
+            continue
+        phase = "fabric-summary"
+        for key, metric in (
+                ("deterministic", "fabric.deterministic"),
+                ("stream_parity", "fabric.stream_parity"),
+                ("digest_transport_invariant",
+                 "fabric.digest_transport_invariant"),
+                ("trace_connected", "fabric.trace_connected"),
+                ("chaos_ok", "fabric.chaos_ok"),
+                ("invariants_ok", "fabric.invariants_ok")):
+            if key in row:
+                pts.append(MetricPoint(metric,
+                                       1.0 if row[key] else 0.0,
+                                       file, phase=phase))
+        for key, metric in (
+                ("two_hop_deliveries", "fabric.two_hop_deliveries"),
+                ("max_trace_hops", "fabric.max_trace_hops"),
+                ("chaos_kills", "fabric.chaos_kills"),
+                ("replica_crashes", "fabric.replica_crashes"),
+                ("done_after_kill", "fabric.done_after_kill"),
+                ("bootstrap_mismatches",
+                 "fabric.bootstrap_mismatches"),
+                ("measured_wire_bytes_per_s",
+                 "fabric.measured_wire_bytes_per_s")):
+            if isinstance(row.get(key), (int, float)):
+                pts.append(MetricPoint(metric, float(row[key]),
+                                       file, phase=phase))
+        pts.append(MetricPoint(
+            "fabric.violations",
+            float(len(row.get("violations", []))), file,
+            phase=phase))
+    return pts
+
+
 def parse_paged_vet(text: str, file: str) -> List[MetricPoint]:
     rows = read_jsonl_rows(text)
     pts = []
@@ -924,6 +969,12 @@ FAMILIES: List[ArtifactFamily] = [
         "prefix reuse with latent prefix broadcast (accepted-tokens/"
         "step, re-prefill savings, stream parity, SLO-aware ladder, "
         "determinism gates)"),
+    ArtifactFamily(
+        "fabric-serve", r"^FABRIC_SERVE\.jsonl$", parse_fabric_serve,
+        "deployment fabric: process-vs-in-memory replica transport "
+        "parity (digest invariance, bitwise streams, two-hop socket "
+        "crossings, cross-process trace hops, measured-vs-priced "
+        "wire) + the literal kill-a-process chaos leg"),
     ArtifactFamily(
         "request-trace", r"^REQUEST_TRACE\.jsonl$",
         parse_request_trace,

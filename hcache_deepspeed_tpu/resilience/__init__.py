@@ -25,8 +25,9 @@ from .retry import (BreakerState, CircuitBreaker,  # noqa: F401
                     RetryPolicy, Watchdog, call_with_retry)
 
 from .chaos import (ChaosResult, DisaggChaosResult,  # noqa: F401
-                    FleetChaosResult,
+                    FabricChaosResult, FleetChaosResult,
                     build_chaos_trace, default_fault_plan,
                     default_disagg_fault_plan,
                     default_fleet_fault_plan, run_chaos,
-                    run_disagg_chaos, run_fleet_chaos)
+                    run_disagg_chaos, run_fabric_chaos,
+                    run_fleet_chaos)

@@ -713,3 +713,203 @@ def run_chaos(seed: int = 0, n_requests: int = 32,
         violations=violations,
         ok=not violations)
     return result
+
+
+# ------------------------------------------------------------------ #
+# fabric scope: literal kill-a-process over the process transport
+# ------------------------------------------------------------------ #
+@dataclass
+class FabricChaosResult:
+    seed: int
+    n_replicas: int
+    victim: int
+    requests: List[Dict]
+    event_digest: str
+    fleet_summary: Dict
+    wire: Dict
+    invariants: Dict
+    ok: bool = False
+    violations: List[str] = field(default_factory=list)
+
+
+def run_fabric_chaos(seed: int = 0, n_replicas: int = 3,
+                     n_requests: int = 24,
+                     kill_at_step: int = 12,
+                     num_blocks: int = 12, block_size: int = 8,
+                     max_lanes: int = 4, max_tracked: int = 8,
+                     max_context: int = 64, max_new: int = 10,
+                     rps: float = 400.0) -> FabricChaosResult:
+    """Fabric-scope chaos: the replica crash is a LITERAL process
+    kill. The fleet runs on :class:`~..fabric.ProcessTransport` (one
+    supervised worker process per replica, migrations crossing real
+    sockets); at fleet step ``kill_at_step`` the busiest replica's
+    worker is ``SIGKILL``-ed and the fleet discovers the death through
+    its liveness pass — from the survivors' view, exactly as an
+    operator would. No fault injector runs: the dead process IS the
+    fault.
+
+    Invariants (the never-dropped contract, now across real process
+    boundaries):
+
+    1. exactly one terminal state per request across the whole fleet —
+       the kill may fail individual requests only through the priced
+       crash path, never by silently dropping them;
+    2. zero KV-block leaks / zero tracked sequences on survivors;
+    3. migration accounting balance (evacuations included);
+    4. the crash is observed end-to-end: transport ``kills == 1``,
+       fleet ``replica_crashes >= 1``, victim DEAD, at least one
+       request finished AFTER the kill (the fleet kept serving);
+    5. wire accounting recorded beside the virtual clock: measured
+       bytes/s present whenever any crossing happened.
+    """
+    from ..fabric import ProcessTransport
+    from ..inference.config import RaggedInferenceEngineConfig
+    from ..serving import (FleetConfig, ReplicaState, RouterConfig,
+                           ServerConfig, ServingFleet, SimulatedEngine,
+                           VirtualClock)
+
+    def make_engine():
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": max_tracked,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": max_lanes,
+                           "max_context": max_context},
+            kv_cache={"block_size": block_size,
+                      "num_blocks": num_blocks},
+            hcache={"enable_latents": True}))
+
+    transport = ProcessTransport()
+    fleet = ServingFleet(
+        engines=[make_engine() for _ in range(n_replicas)],
+        clock=VirtualClock(),
+        config=FleetConfig(
+            n_replicas=n_replicas,
+            server=ServerConfig(max_queue_depth=n_requests + 1,
+                                kv_demand_fraction=float("inf")),
+            router=RouterConfig(),
+            transport=transport))
+    reqs = build_chaos_trace(seed, n_requests,
+                             fleet.replicas[0].engine.vocab_size,
+                             max_new=max_new, rps=rps,
+                             prompt_hi=min(24,
+                                           max_context - max_new - 1))
+    victim = -1
+    done_before_kill = 0
+    with transport:
+        arrivals = sorted(reqs, key=lambda r: (r.arrival_time, r.uid))
+        steps = 0
+        while arrivals or fleet.has_work:
+            now = fleet.clock.now()
+            while arrivals and arrivals[0].arrival_time <= now:
+                fleet.submit(request=arrivals.pop(0))
+            if not fleet.has_work and arrivals:
+                fleet.clock.advance_to(arrivals[0].arrival_time)
+                continue
+            if victim < 0 and fleet.step_idx >= kill_at_step:
+                # deterministic victim: the busiest live replica
+                # (ties to the lowest id)
+                live = [r for r in fleet.replicas
+                        if r.state is ReplicaState.UP]
+                victim = max(live, key=lambda r:
+                             (len(r.scheduler.running), -r.id)).id
+                done_before_kill = sum(
+                    1 for r in reqs if r.state.name == "DONE")
+                transport.kill(victim)
+            fleet.step()
+            steps += 1
+            if steps > 1_000_000:
+                raise RuntimeError("fabric chaos livelock:\n"
+                                   + fleet.snapshot())
+
+    violations: List[str] = []
+    terminal = {"DONE", "REJECTED", "FAILED"}
+    # 1. exactly-one-terminal-state, fleet-wide (never dropped)
+    for r in reqs:
+        if r.state.name not in terminal:
+            violations.append(
+                f"request {r.uid} ended non-terminal: {r.state.name}")
+        holders = sum(1 for rep in fleet.replicas
+                      if r.uid in rep.scheduler.done)
+        holders += 1 if r.uid in fleet.done else 0
+        if holders != 1:
+            violations.append(
+                f"request {r.uid} terminal in {holders} places "
+                "(must be exactly 1)")
+    # 2. zero leaks on survivors
+    for rep in fleet.replicas:
+        if rep.state is ReplicaState.DEAD:
+            continue
+        if rep.engine.state.free_blocks != rep.initial_free_blocks:
+            violations.append(
+                f"replica {rep.id}: block leak "
+                f"({rep.initial_free_blocks} -> "
+                f"{rep.engine.state.free_blocks})")
+        if rep.engine.state.n_tracked_sequences != 0:
+            violations.append(
+                f"replica {rep.id}: "
+                f"{rep.engine.state.n_tracked_sequences} sequences "
+                "still tracked post-trace")
+    # 3. migration balance
+    if fleet.in_transit:
+        violations.append(
+            f"{len(fleet.in_transit)} migrations still in transit")
+    c = fleet.counters
+    landed = (c["landings"] + c["recompute_landings"] +
+              c["expired_in_transit"] + c["cancelled_in_transit"] +
+              c["failed_in_transit"])
+    if c["evictions"] != landed:
+        violations.append(
+            f"migration imbalance: {c['evictions']} evictions vs "
+            f"{landed} terminal migrations ({dict(c)})")
+    # 4. the kill was real and the fleet survived it
+    wire = transport.wire_stats()
+    if wire["kills"] != 1:
+        violations.append(f"expected exactly 1 kill, saw "
+                          f"{wire['kills']}")
+    if c["replica_crashes"] < 1:
+        violations.append("liveness pass never observed the kill as "
+                          "a replica crash")
+    if victim < 0 or fleet.replicas[victim].state \
+            is not ReplicaState.DEAD:
+        violations.append(f"victim replica {victim} is not DEAD")
+    done_after = sum(1 for r in reqs if r.state.name == "DONE")
+    if done_after <= done_before_kill:
+        violations.append(
+            "no request finished after the kill — the fleet did not "
+            "keep serving")
+    if wire["bootstrap_mismatches"]:
+        violations.append(
+            f"{wire['bootstrap_mismatches']} bootstrap digest "
+            "mismatches (serialize() snapshot is not a faithful "
+            "process-side bootstrap)")
+    # 5. measured wire recorded whenever bytes crossed
+    if wire["deliveries"] > wire["local_fallbacks"] and \
+            wire["measured_wire_bytes_per_s"] <= 0:
+        violations.append("crossings happened but no measured wire "
+                          "throughput was recorded")
+    trace_inv = _trace_gates(reqs, violations)
+    _flight_on_violations("fabric", seed, violations)
+
+    return FabricChaosResult(
+        seed=seed, n_replicas=n_replicas, victim=victim,
+        requests=[{
+            "uid": r.uid, "state": r.state.name, "error": r.error,
+            "tokens": len(r.tokens_out), "replica": r.replica,
+            "migrations": r.n_migrations,
+            "recomputes": r.n_recomputes,
+            **_trace_row(r),
+        } for r in reqs],
+        event_digest=_digest(fleet.event_log()),
+        fleet_summary=fleet.summary(),
+        wire=wire,
+        invariants={
+            "terminal_states": sorted({r.state.name for r in reqs}),
+            "replica_states": {str(rep.id): rep.state.name
+                               for rep in fleet.replicas},
+            "counters": dict(fleet.counters),
+            "done_before_kill": done_before_kill,
+            "done_after": done_after,
+            "trace": trace_inv,
+        },
+        violations=violations,
+        ok=not violations)

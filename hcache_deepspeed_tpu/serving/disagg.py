@@ -10,7 +10,12 @@ tier and a decode tier so the interference cannot happen; the part
 those systems build bespoke is the transport that moves a finished
 prompt's KV between tiers.
 
-This repo already has that transport: **HCache latents**. A prompt
+This repo already has that transport: **HCache latents**. (And since
+handoffs are ordinary fleet migrations, they also inherit the
+deployment fabric for free: under
+:class:`~..fabric.ProcessTransport` a tier handoff's latent payload +
+trace context crosses real process boundaries as framed bytes —
+docs/fabric.md — with zero disagg-specific wire code.) A prompt
 prefilled with latent capture holds a host-side ``[L, T, H]`` payload
 that is ~half the KV bytes (halved again under fp8 capture, and again
 under the opt-in int8 wire below), and the decode side rebuilds the KV

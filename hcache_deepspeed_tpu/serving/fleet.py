@@ -59,6 +59,8 @@ from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis.runtime import make_lock
+from ..fabric.transport import (InMemoryTransport, ReplicaTransport,
+                                WorkerDied)
 from ..resilience.faults import InjectedFault, get_injector
 from ..resilience.policy import ResiliencePolicy
 from ..telemetry.context import TraceContext
@@ -147,6 +149,16 @@ class FleetConfig:
     #: latent prefix broadcast when affinity and load conflict.
     #: None = the affinity-only fleet (committed digests replay).
     prefix: Optional[PrefixReuseConfig] = None
+    #: replica transport (a :class:`~..fabric.transport.
+    #: ReplicaTransport`): HOW migration/handoff/broadcast payloads
+    #: cross replicas. None = :class:`~..fabric.transport.
+    #: InMemoryTransport`, the same-address-space path every committed
+    #: digest was recorded on; :class:`~..fabric.process.
+    #: ProcessTransport` ships real bytes between real worker
+    #: processes (docs/fabric.md). Transit PRICING is transport-
+    #: independent — the virtual clock charges ``overhead +
+    #: bytes/link`` either way.
+    transport: Optional[ReplicaTransport] = None
 
 
 @dataclass
@@ -178,9 +190,12 @@ class Migration:
     #: serialized TraceContext snapshot taken at departure — the
     #: context-propagation half of the wire payload. The landing pass
     #: rehydrates it, so the live path continuously exercises the
-    #: byte-level round trip the future cross-process latent wire
-    #: (ROADMAP item 1) will ship for real
+    #: byte-level round trip the cross-process latent wire ships for
+    #: real under the process transport
     trace_wire: Optional[Dict] = None
+    #: transport ticket stamped at ``ship`` (departure); the landing
+    #: pass hands it back to ``deliver``
+    ticket: int = -1
 
     def to_row(self) -> Dict:
         return {"uid": self.uid, "src": self.src, "dst": self.dst,
@@ -316,6 +331,12 @@ class ServingFleet:
             self.config.router, crossover=crossover,
             link_bytes_per_s=self.config.link_bytes_per_s,
             prefix_tree=self.prefix_tree)
+        #: how migration payloads cross replicas (docs/fabric.md);
+        #: the in-memory default is behavior-invisible — committed
+        #: digests replay byte-identical with it installed
+        self.transport: ReplicaTransport = \
+            self.config.transport or InMemoryTransport()
+        self.transport.attach(self)
         self._lock = make_lock("ServingFleet._lock")
         #: not-yet-placed requests (unroutable ones wait here)
         self.pending: List[Request] = []
@@ -530,6 +551,10 @@ class ServingFleet:
         self.counters["replica_crashes"] += 1
         self._event("replica_crash", -1,
                     f"replica={r.id} hit={getattr(fault, 'hit', 0)}")
+        # reap whatever backs the replica (a worker process, under the
+        # process transport; nothing, under the in-memory one) so the
+        # deployment picture matches the simulation's
+        self.transport.on_replica_dead(r.id)
         if r.prefix_cache is not None:
             # its warm prefixes died with it: drop the payloads and
             # un-mark the shared tree so nobody routes-to-reuse (or
@@ -570,6 +595,19 @@ class ServingFleet:
             self._event("replica_partition", -1, f"replica={r.id}")
         r.state = ReplicaState.PARTITIONED
         r.partition_until = self.step_idx + self.config.partition_steps
+
+    def _liveness_pass(self) -> None:
+        """Transport-view liveness: a replica whose backing worker
+        process died IS a crashed replica, whatever the fault plan
+        said — evacuate it from the survivors' view through the
+        ordinary crash path. The in-memory transport backs replicas
+        with nothing (``alive`` is always True), so this pass is a
+        no-op there and the committed digests replay."""
+        for r in self.replicas:
+            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                continue
+            if not self.transport.alive(r.id):
+                self._crash(r, WorkerDied(r.id))
 
     def _heal_pass(self) -> None:
         for r in self.replicas:
@@ -713,6 +751,7 @@ class ServingFleet:
                             reason=reason, src=src, dst=dst,
                             bytes=nbytes)
             m.trace_wire = req.trace.to_wire()
+        m.ticket = self.transport.ship(m)
         self.in_transit.append(m)
         self.migrations.append(m)
         self.counters["evictions"] += 1
@@ -757,6 +796,7 @@ class ServingFleet:
                       depart_t=now, land_t=now + transfer_s,
                       request=None, prefix_tokens=path,
                       payload=payload.copy())
+        m.ticket = self.transport.ship(m)
         self.in_transit.append(m)
         self.migrations.append(m)
         self.counters["prefix_broadcasts"] += 1
@@ -791,6 +831,11 @@ class ServingFleet:
             return True
         if m.dst not in routable:
             return False          # wait for the breaker to re-admit
+        # the wire crossing happens now, destination final: under the
+        # process transport the payload bytes round-trip through the
+        # destination worker; in-memory it is bookkeeping only
+        self.transport.deliver(m, m.dst)
+        self._observe_wire()
         if dst.prefix_cache is not None:
             with self._locked(dst):
                 dst.prefix_cache.install(m.prefix_tokens, m.payload,
@@ -800,6 +845,16 @@ class ServingFleet:
         self._event("prefix_broadcast_land", m.uid,
                     f"dst={m.dst} tokens={m.tokens}")
         return True
+
+    def _observe_wire(self) -> None:
+        """Drain the transport's last measured crossing into the
+        router's calibration accumulator (``observe_wire``). One
+        sample per real delivery; the in-memory transport never sets
+        one, so this is a no-op there."""
+        sample = self.transport.last_wire_sample
+        if sample is not None:
+            self.router.observe_wire(*sample)
+            self.transport.last_wire_sample = None
 
     def _transit_pass(self, now: float, routable) -> None:
         if not self.in_transit:
@@ -855,6 +910,13 @@ class ServingFleet:
                     self._event("migrate_reroute", m.uid,
                                 f"{m.dst}->{new_dst}")
                 m.dst = new_dst
+            # destination is final: perform the transport crossing.
+            # Under the process transport the latent slab + trace wire
+            # dict serialize into a frame, cross real process
+            # boundaries, and come back as the bytes the destination
+            # adopts; the in-memory transport moves nothing
+            self.transport.deliver(m, m.dst)
+            self._observe_wire()
             if m.trace_wire is not None:
                 # rehydrate the context from the WIRE snapshot (not
                 # the live object): the landing side of the context-
@@ -1063,6 +1125,7 @@ class ServingFleet:
         now = self.clock.now()
         with get_tracer().span("fleet.step",
                                fleet_step=self.step_idx) as sp:
+            self._liveness_pass()
             self._fault_pass()
             self._heal_pass()
             routable = self._probe_pass()
@@ -1191,6 +1254,7 @@ class ServingFleet:
         try:
             with self._lock:
                 self.step_idx += 1
+                self._liveness_pass()
                 self._fault_pass()
                 self._heal_pass()
                 routable = self._probe_pass()
@@ -1252,6 +1316,7 @@ class ServingFleet:
         return {
             "replicas": per_replica,
             "counters": dict(self.counters),
+            "transport": self.transport.name,
             "router": self.router.summary(),
             "in_transit": len(self.in_transit),
             "pending": len(self.pending),

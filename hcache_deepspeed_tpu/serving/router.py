@@ -137,6 +137,29 @@ class FleetRouter:
         self.migrations_proposed = 0
         self.migrations_refused_by_cost = 0
         self.handoff_routes = 0
+        # measured-wire calibration samples (fed by the fleet from a
+        # measuring transport; see observe_wire)
+        self.wire_samples = 0
+        self.wire_sample_bytes = 0
+        self.wire_sample_seconds = 0.0
+
+    # ------------------------------------------------------------- #
+    # measured-wire calibration
+    # ------------------------------------------------------------- #
+    def observe_wire(self, nbytes: int, seconds: float) -> None:
+        """Record one measured transport crossing (real bytes over a
+        real wire, wall-clock seconds). Calibration-only: routing
+        decisions keep pricing transits with the configured
+        ``link_bytes_per_s`` — the measured link NEVER steers the
+        simulation (that would leak wall-clock jitter into the replay
+        digests). It is surfaced in :meth:`summary` beside the priced
+        link so an operator can see how far the configured price is
+        from the wire this deployment actually has."""
+        if seconds <= 0 or nbytes <= 0:
+            return
+        self.wire_samples += 1
+        self.wire_sample_bytes += int(nbytes)
+        self.wire_sample_seconds += float(seconds)
 
     # ------------------------------------------------------------- #
     # health
@@ -331,6 +354,16 @@ class FleetRouter:
                 1 for br in self.breakers.values()
                 if br.state != BreakerState.CLOSED),
         }
+        if self.wire_samples:
+            # absent entirely when no measuring transport fed samples,
+            # so historical (in-memory) summaries stay byte-identical
+            out["measured_link"] = {
+                "samples": self.wire_samples,
+                "bytes": self.wire_sample_bytes,
+                "bytes_per_s": self.wire_sample_bytes /
+                self.wire_sample_seconds,
+                "priced_bytes_per_s": self.link_bytes_per_s,
+            }
         if self.config.prefix_reuse:
             out["reuse_routes"] = self.reuse_routes
             out["prefix_broadcasts_planned"] = \

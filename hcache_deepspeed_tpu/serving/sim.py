@@ -29,8 +29,8 @@ class SimulatedEngine:
     N_LAYER = 2
     HIDDEN = 4
     #: ``put_spec`` captures accepted-span latents, so speculation
-    #: composes with latent preemption (the real engine's tail forward
-    #: has no capture path yet and only speculates in exact-KV mode)
+    #: composes with latent preemption (matching the real engine, whose
+    #: ``forward_chunk_tail_lat`` capture path keeps the same contract)
     spec_latent_capture = True
 
     def __init__(self, config: RaggedInferenceEngineConfig = None,

@@ -33,7 +33,8 @@ See ``docs/observability.md``.
 
 from .assemble import (assemble_fleet_trace, merge_streams,  # noqa: F401
                        migration_flows)
-from .context import TraceContext, TraceSpan  # noqa: F401
+from .context import (TraceContext, TraceSpan,  # noqa: F401
+                      WireVersionError)
 from .critical_path import (CriticalPathProfile, attribute,  # noqa: F401
                             closure, connected, critical_path)
 from .export import (load_trace, to_trace_events, validate_trace,  # noqa: F401

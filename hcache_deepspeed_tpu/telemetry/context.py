@@ -46,6 +46,14 @@ from typing import Dict, List, Optional
 #: rejects unknown versions rather than mis-parsing them)
 WIRE_VERSION = 1
 
+
+class WireVersionError(ValueError):
+    """An incompatible TraceContext wire version. Typed (rather than a
+    bare ``ValueError``) so a landing replica can distinguish "peer
+    speaks a different protocol" — a deploy-skew condition worth its
+    own counter/alert — from a merely corrupt dict. Subclasses
+    ``ValueError`` so existing broad handlers keep working."""
+
 #: request lifecycle states -> attribution phases; terminal states end
 #: the context instead
 _STATE_PHASE = {
@@ -290,10 +298,13 @@ class TraceContext:
     @classmethod
     def from_wire(cls, d: Dict, clock=None) -> "TraceContext":
         """Rehydrate a wire dict on the landing side; raises
-        ``ValueError`` on an unknown wire version (documented contract
-        — a silent mis-parse would corrupt attribution)."""
+        :class:`WireVersionError` on an unknown wire version
+        (documented contract — a silent mis-parse would corrupt
+        attribution). Unknown top-level fields are tolerated: a newer
+        same-version peer may append additive fields, and decoders
+        must keep working."""
         if d.get("v") != WIRE_VERSION:
-            raise ValueError(
+            raise WireVersionError(
                 f"unknown TraceContext wire version {d.get('v')!r} "
                 f"(this build speaks {WIRE_VERSION})")
         ctx = cls(str(d["trace_id"]), int(d["uid"]), clock=clock,

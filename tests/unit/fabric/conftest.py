@@ -1,0 +1,12 @@
+"""Fabric suite harness: fleets built here get the same dynamic
+lock-order sentinel the serving suite runs under."""
+
+import pytest
+
+from hcache_deepspeed_tpu.analysis.runtime import sentinel
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_sentinel():
+    with sentinel() as state:
+        yield state

@@ -1,0 +1,199 @@
+"""Fabric wire frame: versioned binary codec round-trip, int8+scales
+latent segment parity with ``ops.quantizer.reference_quantize``,
+forward-compatible header handling, typed version rejection, and the
+golden fixture pinning the v1 bytes."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.fabric import (FRAME_VERSION, FrameError,
+                                         FrameVersionError,
+                                         decode_frame, dequantize_q8,
+                                         encode_frame, quantize_q8)
+from hcache_deepspeed_tpu.fabric.frame import _PREAMBLE, MAGIC
+
+GOLDEN = os.path.join(os.path.dirname(__file__),
+                      "golden_frame_v1.bin")
+
+
+def golden_frame_bytes() -> bytes:
+    """The fixture's logical content, re-encoded from scratch. The
+    golden test asserts these bytes equal the committed file — i.e.
+    the encoder is a pure function of its inputs and the v1 format
+    has not drifted."""
+    rng = np.random.default_rng(1234)
+    return encode_frame(
+        "migration",
+        header={"uid": 42, "src": 1, "dst": 2, "reason": "rebalance",
+                "tokens": 11,
+                "trace": {"v": 1, "trace_id": "cafe", "uid": 42,
+                          "hops": 1, "baggage": {"tenant": "gold"}},
+                "prefix_tokens": None,
+                "future_field_decoders_must_keep": {"x": [1, 2]}},
+        arrays={"latents": rng.standard_normal(
+                    (2, 11, 4)).astype(np.float32),
+                "tokens": np.arange(11, dtype=np.int32)},
+        q8={"latents_q8": rng.standard_normal(
+                (2, 11, 4)).astype(np.float32)},
+        q8_group=16)
+
+
+# ------------------------------------------------------------------ #
+# raw round trip: bit-exactness is the process-parity foundation
+# ------------------------------------------------------------------ #
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, np.int8,
+                                   np.int32, np.uint8, np.int64])
+def test_raw_round_trip_bit_exact(dtype):
+    rng = np.random.default_rng(7)
+    a = (rng.standard_normal((3, 5, 4)) * 100).astype(dtype)
+    f = decode_frame(encode_frame("t", {"k": 1}, arrays={"a": a}))
+    assert f.kind == "t" and f.header["k"] == 1
+    assert f.arrays["a"].dtype == a.dtype
+    assert f.arrays["a"].tobytes() == a.tobytes()
+    assert f.meta["a"]["enc"] == "raw"
+
+
+def test_round_trip_multiple_segments_and_empty_frame():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.arange(4, dtype=np.int8)
+    f = decode_frame(encode_frame("multi", {}, arrays={"b": b, "a": a}))
+    assert set(f.arrays) == {"a", "b"}
+    assert np.array_equal(f.arrays["a"], a)
+    assert np.array_equal(f.arrays["b"], b)
+    g = decode_frame(encode_frame("empty", {"only": "header"}))
+    assert g.arrays == {} and g.header["only"] == "header"
+
+
+def test_encode_is_deterministic_and_key_order_free():
+    a = np.arange(8, dtype=np.float32)
+    one = encode_frame("d", {"x": 1, "y": 2}, arrays={"a": a})
+    two = encode_frame("d", {"y": 2, "x": 1}, arrays={"a": a})
+    assert one == two
+
+
+# ------------------------------------------------------------------ #
+# q8 segments: the int8+scales latent format on the wire
+# ------------------------------------------------------------------ #
+def test_quantize_q8_matches_reference_quantize():
+    jq = pytest.importorskip("jax.numpy")
+    from hcache_deepspeed_tpu.ops.quantizer import reference_quantize
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 7, 5)).astype(np.float32)
+    q, s, shape, n = quantize_q8(x, group_size=16)
+    rq, rs, rshape, rn = reference_quantize(jq.asarray(x),
+                                            group_size=16)
+    assert np.array_equal(q, np.asarray(rq))
+    assert np.array_equal(s, np.asarray(rs))
+    assert tuple(shape) == tuple(rshape) and n == rn
+
+
+def test_q8_round_trip_error_bounded_and_zero_group_exact():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((4, 64)).astype(np.float32)
+    x[1, :] = 0.0                       # all-zero group: scale -> 1.0
+    q, s, shape, n = quantize_q8(x, group_size=64)
+    back = dequantize_q8(q, s, shape, n)
+    assert back.shape == x.shape
+    assert np.array_equal(back[1], x[1])
+    # absmax grouping bounds the per-element error by scale/2
+    assert np.all(np.abs(back - x) <= s.reshape(4, 1) / 2 + 1e-7)
+
+
+def test_q8_segment_through_frame_matches_direct_quantize():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 9, 4)).astype(np.float32)
+    f = decode_frame(encode_frame("q", q8={"x": x}, q8_group=16))
+    q, s, shape, n = quantize_q8(x, group_size=16)
+    assert np.array_equal(f.arrays["x"],
+                          dequantize_q8(q, s, shape, n))
+    assert f.meta["x"]["enc"] == "q8"
+    assert f.meta["x"]["group"] == 16
+
+
+# ------------------------------------------------------------------ #
+# error surface + forward compatibility
+# ------------------------------------------------------------------ #
+def test_reserved_segments_header_key_rejected():
+    with pytest.raises(FrameError):
+        encode_frame("t", {"_segments": []})
+
+
+def test_bad_magic_and_truncations_raise_typed_errors():
+    buf = encode_frame("t", {"a": 1},
+                       arrays={"x": np.arange(4, dtype=np.float32)})
+    with pytest.raises(FrameError):
+        decode_frame(b"NOPE" + buf[4:])
+    with pytest.raises(FrameError):
+        decode_frame(buf[:3])                  # inside preamble
+    with pytest.raises(FrameError):
+        decode_frame(buf[:_PREAMBLE.size + 2])  # inside header
+    with pytest.raises(FrameError):
+        decode_frame(buf[:-1])                 # inside segment
+
+
+def test_unknown_version_raises_frame_version_error():
+    buf = encode_frame("t", {"a": 1}, version=FRAME_VERSION + 1)
+    with pytest.raises(FrameVersionError):
+        decode_frame(buf)
+    # the typed error is still a FrameError (and a ValueError), so
+    # blanket frame handling catches it
+    assert issubclass(FrameVersionError, FrameError)
+    assert issubclass(FrameError, ValueError)
+
+
+def test_unknown_header_fields_are_tolerated_and_preserved():
+    buf = encode_frame("t", {"known": 1,
+                             "from_the_future": {"deep": [1, 2]}})
+    f = decode_frame(buf)
+    assert f.header["from_the_future"] == {"deep": [1, 2]}
+
+
+def test_unknown_segment_encoding_rejected():
+    # hand-craft a frame whose descriptor names an encoding this
+    # build does not speak
+    hdr = json.dumps({"kind": "t", "_segments": [
+        {"name": "x", "enc": "zstd-of-the-future", "nbytes": 0}]},
+        sort_keys=True, separators=(",", ":")).encode()
+    buf = _PREAMBLE.pack(MAGIC, FRAME_VERSION, len(hdr)) + hdr
+    with pytest.raises(FrameError):
+        decode_frame(buf)
+
+
+def test_header_must_be_json_object():
+    hdr = b"[1,2,3]"
+    buf = _PREAMBLE.pack(MAGIC, FRAME_VERSION, len(hdr)) + hdr
+    with pytest.raises(FrameError):
+        decode_frame(buf)
+
+
+# ------------------------------------------------------------------ #
+# golden fixture: the committed v1 bytes
+# ------------------------------------------------------------------ #
+def test_golden_frame_bytes_are_stable():
+    with open(GOLDEN, "rb") as fh:
+        committed = fh.read()
+    assert golden_frame_bytes() == committed, \
+        "frame encoder output drifted from the committed v1 fixture " \
+        "— bump FRAME_VERSION instead of silently changing the format"
+
+
+def test_golden_frame_decodes_with_pinned_content():
+    with open(GOLDEN, "rb") as fh:
+        f = decode_frame(fh.read())
+    assert f.kind == "migration"
+    assert f.header["uid"] == 42 and f.header["reason"] == "rebalance"
+    assert f.header["trace"]["baggage"] == {"tenant": "gold"}
+    # unknown-field tolerance on the committed bytes, not just fresh
+    assert f.header["future_field_decoders_must_keep"] == {"x": [1, 2]}
+    assert f.arrays["latents"].shape == (2, 11, 4)
+    assert f.arrays["latents"].dtype == np.float32
+    assert np.array_equal(f.arrays["tokens"],
+                          np.arange(11, dtype=np.int32))
+    assert f.meta["latents_q8"]["enc"] == "q8"
+    magic, version, _ = struct.unpack_from("<4sHI", open(
+        GOLDEN, "rb").read(), 0)
+    assert magic == MAGIC and version == 1

@@ -596,3 +596,93 @@ class TestFusedEosEarlyExit:
         assert outs[0] == full[0][:5]
         assert len(lps[0]) == len(outs[0])
         assert lat[0].shape[1] == len(prompt) + len(outs[0]) - 1
+
+
+class TestSpecLatentCapture:
+    """put_spec under latent preemption: the latent-capturing tail
+    forward returns accepted-span latents that are restore-grade —
+    a speculated-then-preempted sequence resumes through restore_kv
+    exactly like a plainly decoded one."""
+
+    def _greedy_ref(self, cfg, params, prompt, steps):
+        eng = make_engine(cfg, params)
+        logits, _ = eng.put([0], [prompt])
+        out = [int(np.argmax(logits[0]))]
+        for _ in range(steps - 1):
+            logits, _ = eng.put([0], [[out[-1]]])
+            out.append(int(np.argmax(logits[0])))
+        return out
+
+    def test_put_spec_captures_accepted_span_latents(self, tiny_model):
+        cfg, params = tiny_model[0], tiny_model[2]
+        rng = np.random.default_rng(31)
+        prompt = list(rng.integers(0, cfg.vocab_size, (9,)))
+        ref = self._greedy_ref(cfg, params, prompt, 8)
+
+        eng = make_engine(cfg, params)          # latents ON (default)
+        assert eng.spec_latent_capture is True
+        logits, lat0 = eng.put([5], [prompt])
+        assert lat0[0].shape == (cfg.n_layer, len(prompt),
+                                 cfg.hidden_size)
+        out = [int(np.argmax(logits[0]))]
+        chunks = [np.asarray(lat0[0])]
+        while len(out) < 8:
+            # draft from the reference stream: prefix-accepted
+            k = len(out)
+            draft = ref[k:k + 2][:8 - k - 1]
+            emitted, lats = eng.put_spec([5], [[out[-1]] + draft])
+            assert len(emitted[0]) >= 1
+            # the latent chunk covers EXACTLY the fed+accepted span
+            assert lats[0] is not None
+            assert lats[0].shape == (cfg.n_layer, len(emitted[0]),
+                                     cfg.hidden_size)
+            out.extend(emitted[0])
+            chunks.append(np.asarray(lats[0]))
+        # greedy-exact: the speculated stream IS the greedy stream
+        assert out[:8] == ref
+        # cumulative latents cover prompt + every fed token (all but
+        # the still-unfed last emission)
+        total = np.concatenate(chunks, axis=1)
+        assert total.shape[1] == len(prompt) + len(out) - 1
+
+    def test_spec_latents_are_restore_grade(self, tiny_model):
+        cfg, params = tiny_model[0], tiny_model[2]
+        rng = np.random.default_rng(32)
+        prompt = list(rng.integers(0, cfg.vocab_size, (8,)))
+        ref = self._greedy_ref(cfg, params, prompt, 6)
+
+        eng = make_engine(cfg, params)
+        logits, lat0 = eng.put([3], [prompt])
+        out = [int(np.argmax(logits[0]))]
+        chunks = [np.asarray(lat0[0])]
+        while len(out) < 5:
+            k = len(out)
+            emitted, lats = eng.put_spec(
+                [3], [[out[-1]] + ref[k:k + 2][:5 - k - 1]])
+            out.extend(emitted[0])
+            chunks.append(np.asarray(lats[0]))
+        # preempt to latents: drop the KV entirely, keep the chunks
+        eng.flush(3)
+        fed = prompt + out[:-1]
+        eng.restore_kv([3], [fed], [np.concatenate(chunks, axis=1)])
+        logits, _ = eng.put([3], [[out[-1]]])
+        resumed = int(np.argmax(logits[0]))
+
+        # ground truth: the same stream decoded without interruption
+        uninterrupted = make_engine(cfg, params)
+        l2, _ = uninterrupted.put([3], [prompt])
+        for t in out:
+            l2, _ = uninterrupted.put([3], [[t]])
+        assert resumed == int(np.argmax(l2[0]))
+        np.testing.assert_allclose(np.asarray(logits[0]),
+                                   np.asarray(l2[0]), atol=1e-3)
+
+    def test_put_spec_exact_kv_mode_still_returns_none(self, tiny_model):
+        cfg, params = tiny_model[0], tiny_model[2]
+        eng = make_engine(cfg, params,
+                          hcache={"enable_latents": False})
+        logits, lat = eng.put([1], [[2, 7, 1, 8]])
+        assert lat[0] is None
+        emitted, lats = eng.put_spec(
+            [1], [[int(np.argmax(logits[0]))]])
+        assert lats == [None]

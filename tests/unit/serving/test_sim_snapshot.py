@@ -88,3 +88,46 @@ def test_snapshot_round_trip_with_custom_config():
     sm = e2.config.state_manager
     assert sm.max_tracked_sequences == 5
     assert e2.serialize() == e.serialize()
+
+
+def test_snapshot_round_trip_covers_spec_lanes_and_aborts():
+    """Completeness audit as a regression: speculative-decode state
+    (partial draft acceptance with its rollback'd block layout and
+    spec_stats) and an aborted restore lane must survive the snapshot
+    — a restored engine replays the exact same speculative step."""
+    e = SimulatedEngine()
+    logits, _ = e.put([1], [list(range(12))])
+    fed = int(np.argmax(logits[0]))
+    # derive the greedy target from the snapshot itself: a restored
+    # probe must predict exactly what the live engine would
+    probe = SimulatedEngine.deserialize(
+        json.loads(json.dumps(e.serialize())))
+    t1 = int(np.argmax(probe.put([1], [[fed]])[0][0]))
+    wrong = (t1 + 1) % e.vocab_size
+    emitted, lats = e.put_spec([1], [[fed, t1, wrong]])
+    assert len(emitted[0]) == 2          # accepted draft + bonus
+    assert e.spec_stats["rolled_back"] == 1
+    assert np.asarray(lats[0]).shape[1] == 2
+    # an aborted restore lane must leave no residue in the snapshot
+    l4, lat4 = e.put([4], [list(range(6))])
+    e.flush(4)
+    e.begin_restore([4], [list(range(6))], [lat4[0]])
+    e.abort_restore(4)
+    snap = e.serialize()
+    assert snap["restore_lanes"] == []
+    assert snap["counts"]["abort"] == 1
+    e2 = SimulatedEngine.deserialize(json.loads(json.dumps(snap)))
+    assert json.dumps(e2.serialize(), sort_keys=True) == \
+        json.dumps(snap, sort_keys=True)
+    # behavior parity: the NEXT speculative step is identical, so the
+    # rollback'd spec-lane block arithmetic fully crossed the snapshot
+    fed2 = emitted[0][-1]
+    t2 = int(np.argmax(
+        SimulatedEngine.deserialize(json.loads(json.dumps(snap)))
+        .put([1], [[fed2]])[0][0]))
+    ea, la = e.put_spec([1], [[fed2, t2]])
+    eb, lb = e2.put_spec([1], [[fed2, t2]])
+    assert ea == eb
+    assert np.array_equal(np.asarray(la[0]), np.asarray(lb[0]))
+    assert e.spec_stats == e2.spec_stats
+    assert e.state.free_blocks == e2.state.free_blocks

@@ -312,13 +312,14 @@ class TestLookupDraftHelper:
 
 class TestRealEnginePutSpec:
 
-    def test_put_spec_refuses_latents(self):
-        # the sim engine captures accepted-span latents; the real
-        # engine advertises that it cannot (scheduler build gates it)
+    def test_put_spec_advertises_latent_capture(self):
+        # both engines capture accepted-span latents (the real engine
+        # through the latent-capturing tail forward), so the scheduler
+        # may speculate under latent preemption against either
         assert SimulatedEngine.spec_latent_capture is True
         from hcache_deepspeed_tpu.inference.engine_v2 import \
             InferenceEngineV2
-        assert InferenceEngineV2.spec_latent_capture is False
+        assert InferenceEngineV2.spec_latent_capture is True
 
     def test_sim_put_spec_rejects_unknown_uid(self):
         eng = make_engine()

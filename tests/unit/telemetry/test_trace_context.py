@@ -7,7 +7,7 @@ import pytest
 
 from hcache_deepspeed_tpu.serving.clock import VirtualClock
 from hcache_deepspeed_tpu.telemetry.context import (
-    TraceContext, deterministic_trace_id)
+    TraceContext, WireVersionError, deterministic_trace_id)
 from hcache_deepspeed_tpu.telemetry.critical_path import (
     CriticalPathProfile, attribute, closure, connected, critical_path)
 
@@ -143,12 +143,66 @@ def test_wire_round_trip_preserves_everything():
     assert len(ids) == len(set(ids))
 
 
-def test_wire_rejects_unknown_version():
+def test_wire_rejects_unknown_version_with_typed_error():
     ctx = TraceContext.mint(1, clock=VirtualClock())
     wire = ctx.to_wire()
-    wire["v"] = 99
-    with pytest.raises(ValueError, match="wire version"):
-        TraceContext.from_wire(wire)
+    for bad in (99, 0, None, "1"):
+        wire["v"] = bad
+        with pytest.raises(WireVersionError, match="wire version"):
+            TraceContext.from_wire(wire)
+    # typed, but still a ValueError — broad handlers keep working
+    assert issubclass(WireVersionError, ValueError)
+
+
+def test_wire_tolerates_unknown_additive_fields():
+    """Same-version forward compatibility: a newer peer may append
+    top-level or per-span fields; decoders must ignore, not reject."""
+    ctx = TraceContext.mint(4, clock=VirtualClock(), t0=0.0,
+                            baggage={"tier": "gold"})
+    ctx.begin("prefill", replica=0, t=1.0)
+    wire = ctx.to_wire()
+    wire["future_shard_hint"] = {"rack": 3}
+    wire["spans"][0]["future_gpu_ns"] = 1234
+    ctx2 = TraceContext.from_wire(wire)
+    assert ctx2.trace_id == ctx.trace_id
+    assert ctx2.baggage == {"tier": "gold"}
+    assert [s.phase for s in ctx2.spans] == ["queue", "prefill"]
+    # and the round trip back out is clean current-version wire
+    assert ctx2.to_wire()["v"] == ctx.to_wire()["v"]
+
+
+def test_wire_fuzz_multi_hop_round_trips_are_lossless():
+    """Deterministic fuzz: random-ish chains (seeded) survive N wire
+    hops bit-identically modulo the hop counter — the exact contract
+    the process fabric relies on when a migration relays through a
+    source worker before landing."""
+    import json
+    import random
+    rng = random.Random(0xC0FFEE)
+    phases = ["prefill", "decode", "suspended", "restore", "transit"]
+    for case in range(25):
+        clock = VirtualClock()
+        ctx = TraceContext.mint(case, clock=clock, t0=0.0,
+                                baggage={"case": str(case)})
+        t = 0.0
+        for _ in range(rng.randrange(1, 8)):
+            t += rng.random()
+            ctx.begin(rng.choice(phases), t=t,
+                      replica=rng.randrange(4))
+            if rng.random() < 0.3:
+                ctx.charge("retry_backoff", rng.random())
+            if rng.random() < 0.3:
+                ctx.note(drafted=rng.randrange(5))
+        if rng.random() < 0.5:
+            ctx.end(t=t + 1.0, outcome="DONE")
+        wire = json.loads(json.dumps(ctx.to_wire()))
+        hops = rng.randrange(1, 4)
+        for _ in range(hops):
+            wire = json.loads(json.dumps(
+                TraceContext.from_wire(wire).to_wire()))
+        ref = ctx.to_wire()
+        ref["hops"] = hops
+        assert wire == ref, f"case {case} diverged after {hops} hops"
 
 
 def test_profile_aggregates_percentiles_per_phase():
