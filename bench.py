@@ -1460,6 +1460,54 @@ def run_fabric(out_path="FABRIC_SERVE.jsonl"):
     return 0 if ok else 4
 
 
+def run_fabric_obs(out_path="FABRIC_OBS.jsonl"):
+    """``--fabric-obs``: cross-process telemetry-plane audit — worker
+    span/metric harvest over the fabric control channel, assembled
+    process-fleet timelines, SIGKILL postmortem telemetry, per-link
+    wire percentiles (docs/observability.md). Gates inline: harvest
+    on/off digest invariance against the in-memory twin, 2-run
+    determinism, Perfetto-clean cross-process timeline with >= 1
+    arrow spanning two real worker processes, the killed worker's
+    last-harvested telemetry in the flight bundle, and harvest
+    overhead <= 5% of the fabric leg. Self-compares against the
+    committed perf trajectory before writing. Never touches the TPU
+    relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_fabric_obs as run_fo
+    try:
+        results = run_fo(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(
+            f"fabric-obs gate failed: {exc}")), flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "fabric-obs-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "cross-process telemetry plane: harvested worker "
+                  "spans on a digest-invisible control channel",
+        "value": summary["worker_spans"],
+        "unit": "harvested spans",
+        "vs_baseline": 1.0 if summary["invariants_ok"] and
+        summary["harvest_digest_invariant"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("deterministic", "harvest_digest_invariant",
+                   "timeline_valid", "worker_rows",
+                   "cross_worker_arrows",
+                   "postmortem_has_telemetry",
+                   "harvest_overhead_fraction", "harvests",
+                   "chaos_ok", "busiest_link")},
+    }), flush=True)
+    ok = (summary["invariants_ok"] and summary["deterministic"] and
+          summary["harvest_digest_invariant"] and
+          summary["timeline_valid"] and
+          summary["postmortem_has_telemetry"] and
+          summary["chaos_ok"])
+    return 0 if ok else 4
+
+
 def run_request_trace(out_path="REQUEST_TRACE.jsonl"):
     """``--request-trace``: CPU-deterministic causal-tracing audit —
     replay the chaos/fleet/disagg workloads and gate connected
@@ -1511,6 +1559,8 @@ def main():
         return run_disagg()
     if "--spec-serve" in sys.argv[1:]:
         return run_spec_serve()
+    if "--fabric-obs" in sys.argv[1:]:
+        return run_fabric_obs()
     if "--fabric" in sys.argv[1:]:
         return run_fabric()
     if "--request-trace" in sys.argv[1:]:

@@ -19,13 +19,15 @@
   mute button and is rejected (the pragma is also ignored, so the
   underlying finding still fires).
 * **HDS-C004** — a serving-path async span (literal name under the
-  ``sched.`` / ``serve.`` / ``fleet.`` prefixes) carrying neither a
-  ``uid=`` nor a ``trace=`` attribute: without the request identity on
-  the span, the multi-tracer assembler cannot link it into the
-  per-request causal DAG, and the span is unattributable noise in the
-  exported timeline. Computed names are skipped (the trace validator
-  owns their runtime pairing, and the real emitters stamp identity on
-  the live objects).
+  ``sched.`` / ``serve.`` / ``fleet.`` / ``fabric.`` prefixes)
+  carrying neither a ``uid=`` nor a ``trace=`` attribute: without the
+  request identity on the span, the multi-tracer assembler cannot
+  link it into the per-request causal DAG, and the span is
+  unattributable noise in the exported timeline (for ``fabric.*``
+  spans the cross-process assembler additionally pairs worker rows by
+  uid — an identity-less crossing can never render as an arrow).
+  Computed names are skipped (the trace validator owns their runtime
+  pairing, and the real emitters stamp identity on the live objects).
 """
 
 import ast
@@ -38,7 +40,7 @@ _TYPED_ERRORS = ("HDSConfigError",)
 
 #: async-span name prefixes that identify serving-path request flow —
 #: the spans the causal assembler must be able to key by request
-_REQUEST_SPAN_RE = re.compile(r"^(sched|serve|fleet)\.")
+_REQUEST_SPAN_RE = re.compile(r"^(sched|serve|fleet|fabric)\.")
 
 #: keyword attributes that satisfy the request-identity requirement
 _IDENTITY_ATTRS = ("uid", "trace")

@@ -57,6 +57,12 @@ def _deadline(seconds: float) -> float:
     return time.monotonic() + seconds
 
 
+#: parent-side cap on accumulated harvested events per worker — the
+#: harvest plane must stay bounded like the tracers feeding it; trimmed
+#: events are counted into the stream's drop count, never silent
+TELEMETRY_EVENT_CAP = 20000
+
+
 class WorkerHandle:
     """Parent-side record of one spawned replica worker."""
 
@@ -67,6 +73,15 @@ class WorkerHandle:
         self.peer_port: int = -1
         self.bootstrap_digest: str = ""
         self.dead = False
+        #: last-known harvested telemetry — survives the worker: a
+        #: SIGKILL'd worker's final pre-kill harvest stays here and
+        #: rides into the flight-recorder postmortem bundle
+        self.telemetry: Dict = {
+            "events": [], "counters": {}, "metrics": [],
+            "thread_names": {}, "dropped": 0, "trimmed": 0,
+            "clock_offset_us": 0.0, "rss_max_bytes": 0,
+            "harvests": 0,
+        }
 
     @property
     def alive(self) -> bool:
@@ -78,10 +93,21 @@ class ProcessTransport(ReplicaTransport):
     name = "process"
 
     def __init__(self, spawn_timeout_s: float = 120.0,
-                 io_timeout_s: float = 60.0):
+                 io_timeout_s: float = 60.0,
+                 harvest_telemetry: bool = True,
+                 harvest_every: int = 16):
         super().__init__()
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.io_timeout_s = float(io_timeout_s)
+        #: telemetry-harvest plane on/off. MUST be digest-invisible:
+        #: harvest RPCs ride the control channel between fleet work,
+        #: touch only parent-side caches, and never enter fleet event
+        #: logs — the FABRIC_OBS gate replays the same trace with
+        #: harvest on and off and compares event digests byte-for-byte
+        self.harvest_telemetry = bool(harvest_telemetry)
+        #: deliveries between two cadence harvests (shutdown and
+        #: pre-kill harvests run regardless)
+        self.harvest_every = int(harvest_every)
         self.workers: Dict[int, WorkerHandle] = {}
         self._srv: Optional[socket.socket] = None
         self._started = False
@@ -96,6 +122,12 @@ class ProcessTransport(ReplicaTransport):
         self.worker_hops = 0
         self.kills = 0
         self.bootstrap_mismatches = 0
+        # telemetry-harvest accounting (also wall clock; the overhead
+        # fraction FABRIC_OBS gates is harvest_seconds / leg wall time)
+        self.harvests = 0
+        self.harvest_failures = 0
+        self.harvest_seconds = 0.0
+        self._deliveries_since_harvest = 0
 
     # ----------------------------------------------------------- #
     # lifecycle
@@ -165,6 +197,8 @@ class ProcessTransport(ReplicaTransport):
                 str(reply.header.get("digest", ""))
 
     def close(self) -> None:
+        if self._started and self.harvest_telemetry:
+            self.harvest_all()          # final drain before exit
         for h in self.workers.values():
             if h.conn is not None and h.alive:
                 try:
@@ -200,6 +234,11 @@ class ProcessTransport(ReplicaTransport):
 
     def kill(self, replica_id: int) -> None:
         h = self.workers[replica_id]
+        if self.harvest_telemetry and h.alive and h.conn is not None:
+            # best-effort pre-kill drain: the victim's spans/counters
+            # must land in the postmortem bundle even though SIGKILL
+            # gives the worker no chance to flush anything itself
+            self.harvest(replica_id)
         if h.proc.poll() is None:
             h.proc.kill()
             h.proc.wait()
@@ -239,15 +278,20 @@ class ProcessTransport(ReplicaTransport):
             raise RuntimeError(
                 "ProcessTransport.deliver before start()")
         self.last_wire_sample = None
+        self.last_wire_link = None
         inner = migration_frame(m)
         src_ok = (m.src is not None and m.src >= 0 and
                   m.src != dst and self.alive(m.src))
         t0 = time.perf_counter()
         try:
             if src_ok:
+                # the wrapper carries the uid so the src worker can
+                # mark ``fabric.forward_out`` without decoding the
+                # opaque inner frame (flow-arrow departure anchor)
                 wrapped = encode_frame(
                     "forward",
-                    {"peer_port": self.workers[dst].peer_port},
+                    {"peer_port": self.workers[dst].peer_port,
+                     "uid": int(m.uid)},
                     arrays={"inner": np.frombuffer(inner, np.uint8)})
                 reply = self._rpc(m.src, wrapped)
                 inner_reply = reply.arrays["inner"].tobytes()
@@ -280,8 +324,15 @@ class ProcessTransport(ReplicaTransport):
         self.wire_seconds += dt
         self.worker_hops += hops
         # one measured-calibration sample per real crossing; the
-        # fleet forwards it to ``FleetRouter.observe_wire``
+        # fleet forwards it to ``FleetRouter.observe_wire`` together
+        # with the (src, dst) link it crossed (src -1 = parent-direct)
         self.last_wire_sample = (len(inner) + reply_frame.nbytes, dt)
+        self.last_wire_link = ((int(m.src) if src_ok else -1),
+                               int(dst))
+        self._deliveries_since_harvest += 1
+        if self.harvest_telemetry and \
+                self._deliveries_since_harvest >= self.harvest_every:
+            self.harvest_all()
 
     def _mark_dead_conns(self) -> None:
         for h in self.workers.values():
@@ -290,6 +341,91 @@ class ProcessTransport(ReplicaTransport):
                 if h.conn is not None:
                     h.conn.close()
                     h.conn = None
+
+    # ----------------------------------------------------------- #
+    # telemetry harvest (the cross-process observability plane)
+    # ----------------------------------------------------------- #
+    def harvest(self, replica_id: int) -> bool:
+        """Drain one worker's local tracer + metric registry over the
+        control channel (best-effort: a dead wire returns False and
+        leaves the last-known cache intact — it never raises and never
+        counts a ``local_fallback``, because no request payload is at
+        stake). The request/reply carries the clock-offset handshake:
+        the parent stamps its tracer-relative ``now_us`` at send and
+        recv, the worker replies with its own, and the NTP-style
+        midpoint estimate maps the worker stream onto the parent
+        timeline for assembly."""
+        h = self.workers.get(replica_id)
+        if h is None or h.conn is None or not h.alive:
+            return False
+        from ..telemetry.tracer import get_tracer
+        parent = get_tracer()
+        t0 = time.perf_counter()
+        try:
+            sent_us = parent.now_us()
+            reply = self._rpc(replica_id, encode_frame(
+                "telemetry", {"t_send_us": sent_us}))
+            recv_us = parent.now_us()
+        except (ConnectionError, OSError):
+            self._mark_dead_conns()
+            self.harvest_failures += 1
+            self.harvest_seconds += time.perf_counter() - t0
+            return False
+        self.harvest_seconds += time.perf_counter() - t0
+        if reply.kind != "telemetry_ok":
+            self.harvest_failures += 1
+            return False
+        hdr = reply.header
+        tel = h.telemetry
+        tel["clock_offset_us"] = \
+            (sent_us + recv_us) / 2.0 - float(hdr.get("now_us", 0.0))
+        tel["events"].extend(hdr.get("events") or [])
+        overflow = len(tel["events"]) - TELEMETRY_EVENT_CAP
+        if overflow > 0:
+            del tel["events"][:overflow]
+            tel["trimmed"] += overflow
+        tel["counters"] = dict(hdr.get("counters") or {})
+        tel["metrics"] = list(hdr.get("metrics") or [])
+        tel["thread_names"] = dict(hdr.get("thread_names") or {})
+        tel["dropped"] = int(hdr.get("dropped", 0)) + tel["trimmed"]
+        tel["rss_max_bytes"] = int(hdr.get("rss_max_bytes", 0))
+        tel["harvests"] += 1
+        self.harvests += 1
+        return True
+
+    def harvest_all(self) -> int:
+        """Harvest every live worker (cadence / shutdown / chaos
+        sweep); returns how many succeeded."""
+        self._deliveries_since_harvest = 0
+        return sum(1 for rid in sorted(self.workers)
+                   if self.harvest(rid))
+
+    @property
+    def worker_telemetry(self) -> Dict[int, Dict]:
+        """Last-known harvested telemetry per replica (includes dead
+        workers' final pre-kill harvests)."""
+        return {rid: h.telemetry
+                for rid, h in sorted(self.workers.items())}
+
+    def telemetry_stats(self) -> Dict:
+        """Harvest-plane accounting (wall clock, beside — never
+        inside — the virtual-clock pricing)."""
+        return {
+            "enabled": self.harvest_telemetry,
+            "harvests": self.harvests,
+            "harvest_failures": self.harvest_failures,
+            "harvest_seconds": round(self.harvest_seconds, 6),
+            "workers": {
+                str(rid): {
+                    "harvests": h.telemetry["harvests"],
+                    "events": len(h.telemetry["events"]),
+                    "dropped": h.telemetry["dropped"],
+                    "clock_offset_us":
+                        round(h.telemetry["clock_offset_us"], 3),
+                    "rss_max_bytes": h.telemetry["rss_max_bytes"],
+                    "alive": h.alive,
+                } for rid, h in sorted(self.workers.items())},
+        }
 
     # ----------------------------------------------------------- #
     def snapshot_digest(self, replica_id: int) -> str:

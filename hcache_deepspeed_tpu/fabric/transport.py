@@ -111,6 +111,13 @@ class ReplicaTransport:
     #: historical summaries (and their digests) untouched.
     last_wire_sample = None
 
+    #: which link the last sample crossed, as ``(src, dst)`` replica
+    #: ids (``src == -1`` for a parent-direct crossing). Feeds the
+    #: router's per-link quantile sketches so wire percentiles carry
+    #: ``{replica, link}`` labels in the fleet exposition. Same
+    #: absence contract as ``last_wire_sample``.
+    last_wire_link = None
+
     def __init__(self):
         self.fleet = None
         self._next_ticket = 0

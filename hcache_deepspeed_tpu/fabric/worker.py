@@ -19,17 +19,29 @@ connects back, and then serves framed commands:
 * ``forward`` — src-side of the two-hop crossing: unwrap the inner
   frame, ship it to the destination worker's peer port over a cached
   socket, and relay the reply.
+* ``telemetry`` — drain this worker's local observability plane: the
+  bounded span tracer (spans around the bootstrap/migration/forward/
+  peer-relay handlers) plus a ``MetricRegistry`` of frame/byte/q8/
+  codec-time counters and a process-RSS gauge, answered in one JSON
+  frame header together with the worker's tracer-relative ``now_us``
+  (the parent's half of the clock-offset handshake rides in on the
+  request). Telemetry born in this process would otherwise die with
+  it — the parent harvests on a cadence, at shutdown, and best-effort
+  before chaos kills (``docs/observability.md``).
 * ``snapshot`` / ``ping`` / ``exit`` — supervision surface.
 
 Concurrency: the control loop is single-threaded; each accepted peer
 connection gets its own handler thread but touches only its own socket
-and the shared read-only engine reference. No locks, by construction.
+and the shared read-only engine reference. The only shared mutable
+telemetry state is the counters dict (guarded by one leaf lock) and
+the tracer's own thread-safe ring buffer.
 """
 
 import socket
 import struct
 import sys
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -65,6 +77,23 @@ def send_frame_bytes(sock: socket.socket, data: bytes) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
+#: worker-local tracer ring capacity — bounded so an always-on tracer
+#: in a long-lived worker cannot grow without limit; overflow is
+#: surfaced as a drop count in every harvest reply
+TRACER_CAPACITY = 8192
+
+
+def _rss_max_bytes() -> int:
+    """Peak RSS of this process in bytes (``ru_maxrss`` is KiB on
+    Linux); 0 where the ``resource`` module is unavailable."""
+    try:
+        import resource
+        return int(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:
+        return 0
+
+
 class FabricWorker:
 
     def __init__(self, host: str, port: int, replica_id: int):
@@ -80,6 +109,48 @@ class FabricWorker:
         #: cached outbound peer sockets, keyed by peer port (touched
         #: only by the control loop — forward commands are serial)
         self._peers: Dict[int, socket.socket] = {}
+        # -- worker-local observability plane (harvested by the parent
+        # over the control channel via the ``telemetry`` command; this
+        # process's spans/counters never touch the serving core, so
+        # the plane is digest-invisible by construction)
+        from ..telemetry.prometheus import MetricRegistry
+        from ..telemetry.tracer import Tracer
+        self.tracer = Tracer(capacity=TRACER_CAPACITY)
+        self.tracer.configure(enabled=True, xla=False)
+        self.registry = MetricRegistry(
+            namespace="hds_fabric_worker",
+            const_labels={"replica": str(self.replica_id)})
+        self._counters_lock = threading.Lock()   # leaf lock, no order
+        self.counters: Dict[str, float] = {
+            "frames": 0.0, "bytes_in": 0.0, "bytes_out": 0.0,
+            "q8_segments": 0.0, "decode_seconds": 0.0,
+            "encode_seconds": 0.0, "migrations": 0.0,
+            "forwards": 0.0, "peer_connections": 0.0,
+        }
+
+    # ----------------------------------------------------------- #
+    # telemetry accounting
+    # ----------------------------------------------------------- #
+    def _count(self, **deltas) -> None:
+        with self._counters_lock:
+            for key, delta in deltas.items():
+                self.counters[key] = \
+                    self.counters.get(key, 0.0) + delta
+
+    def _decode(self, data: bytes) -> Frame:
+        """Decode + account one inbound frame (control or peer)."""
+        t0 = time.perf_counter()
+        frame = decode_frame(data)
+        dt = time.perf_counter() - t0
+        q8 = sum(1 for d in frame.meta.values()
+                 if d.get("enc") == "q8")
+        self._count(frames=1, bytes_in=len(data) + _LEN.size,
+                    decode_seconds=dt, q8_segments=q8)
+        return frame
+
+    def _send(self, sock: socket.socket, data: bytes) -> None:
+        self._count(bytes_out=len(data) + _LEN.size)
+        send_frame_bytes(sock, data)
 
     # ----------------------------------------------------------- #
     def run(self) -> None:
@@ -87,29 +158,41 @@ class FabricWorker:
                                   name="hds-fabric-peer-accept",
                                   daemon=True)
         accept.start()
-        send_frame_bytes(self.ctrl, encode_frame(
+        self._send(self.ctrl, encode_frame(
             "hello", {"replica": self.replica_id,
                       "peer_port": self.peer_port}))
         while True:
-            frame = decode_frame(recv_frame_bytes(self.ctrl))
+            frame = self._decode(recv_frame_bytes(self.ctrl))
             if frame.kind == "exit":
-                send_frame_bytes(self.ctrl, encode_frame(
+                self._send(self.ctrl, encode_frame(
                     "bye", {"replica": self.replica_id}))
                 break
-            send_frame_bytes(self.ctrl, self.handle(frame))
+            self._send(self.ctrl, self.handle(frame))
         self.ctrl.close()
         self._peer_srv.close()
 
     # ----------------------------------------------------------- #
     def handle(self, frame: Frame) -> bytes:
         if frame.kind == "bootstrap":
-            return self._bootstrap(frame)
+            with self.tracer.span("fabric.bootstrap",
+                                  replica=self.replica_id):
+                return self._bootstrap(frame)
         if frame.kind == "migration":
-            return self._land_migration(frame)
+            with self.tracer.span(
+                    "fabric.migration", replica=self.replica_id,
+                    uid=frame.header.get("uid")):
+                return self._land_migration(frame)
         if frame.kind == "forward":
-            return self._forward(frame)
+            with self.tracer.span(
+                    "fabric.forward", replica=self.replica_id,
+                    uid=frame.header.get("uid")):
+                return self._forward(frame)
+        if frame.kind == "telemetry":
+            return self._telemetry(frame)
         if frame.kind == "snapshot":
-            return self._snapshot()
+            with self.tracer.span("fabric.snapshot",
+                                  replica=self.replica_id):
+                return self._snapshot()
         if frame.kind == "ping":
             return encode_frame("pong", {"replica": self.replica_id})
         return encode_frame(
@@ -124,6 +207,37 @@ class FabricWorker:
         return encode_frame("bootstrap_ok", {
             "replica": self.replica_id,
             "digest": canonical_digest(self.engine.serialize())})
+
+    def _telemetry(self, frame: Frame) -> bytes:
+        """Harvest reply: drain the local tracer + flatten the metric
+        registry into one JSON header. ``now_us`` is this worker's
+        tracer-relative clock reading at reply-build time — paired
+        with the parent's send/recv stamps it estimates the clock
+        offset that maps this stream onto the parent timeline."""
+        with self._counters_lock:
+            counters = dict(self.counters)
+        rss = _rss_max_bytes()
+        for name, value in sorted(counters.items()):
+            self.registry.set_counter(
+                name, value, help=f"fabric worker {name}")
+        self.registry.set_gauge(
+            "rss_max_bytes", float(rss),
+            help="peak worker-process resident set size")
+        events = self.tracer.drain()
+        return encode_frame("telemetry_ok", {
+            "replica": self.replica_id,
+            "v": 1,
+            "now_us": self.tracer.now_us(),
+            "t_send_us": frame.header.get("t_send_us"),
+            "events": events,
+            "dropped": self.tracer.dropped,
+            "thread_names": {str(k): v for k, v in
+                             sorted(self.tracer.thread_names()
+                                    .items())},
+            "counters": counters,
+            "metrics": self.registry.samples(),
+            "rss_max_bytes": rss,
+        })
 
     def _snapshot(self) -> bytes:
         from .transport import canonical_digest
@@ -142,6 +256,12 @@ class FabricWorker:
         increments ``hops``), record this worker on the path, and echo
         the payload bytes back framed."""
         from ..telemetry.context import TraceContext
+        if frame.header.get("uid") is not None:
+            # landing marker: the cross-process flow-arrow anchor the
+            # assembler pairs with the src worker's ``forward_out``
+            self.tracer.instant("fabric.migrate_in",
+                                uid=int(frame.header["uid"]),
+                                replica=self.replica_id)
         hdr = {k: v for k, v in frame.header.items()
                if k not in ("_segments", "kind")}
         if hdr.get("trace") is not None:
@@ -150,8 +270,12 @@ class FabricWorker:
         path = [int(p) for p in (hdr.get("path") or [])]
         path.append(self.replica_id)
         hdr["path"] = path
-        return encode_frame("migration_ok", hdr,
-                            arrays=dict(frame.arrays))
+        t0 = time.perf_counter()
+        out = encode_frame("migration_ok", hdr,
+                           arrays=dict(frame.arrays))
+        self._count(migrations=1,
+                    encode_seconds=time.perf_counter() - t0)
+        return out
 
     def _forward(self, frame: Frame) -> bytes:
         """Src-side of a two-hop crossing: relay the inner frame to
@@ -163,8 +287,20 @@ class FabricWorker:
             conn = socket.create_connection(("127.0.0.1", port))
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._peers[port] = conn
+            self._count(peer_connections=1)
+        if frame.header.get("uid") is not None:
+            # departure marker (recorded BEFORE the relay leaves):
+            # pairs with the dst worker's ``fabric.migrate_in`` into
+            # the two-hop flow arrow across real worker processes
+            self.tracer.instant("fabric.forward_out",
+                                uid=int(frame.header["uid"]),
+                                replica=self.replica_id,
+                                peer_port=port)
         send_frame_bytes(conn, inner)
+        self._count(forwards=1,
+                    bytes_out=len(inner) + _LEN.size)
         reply = recv_frame_bytes(conn)
+        self._count(bytes_in=len(reply) + _LEN.size)
         return encode_frame(
             "forward_ok", {"replica": self.replica_id},
             arrays={"inner": np.frombuffer(reply, np.uint8)})
@@ -187,8 +323,8 @@ class FabricWorker:
         ``conn``; the engine reference is read-only here."""
         try:
             while True:
-                frame = decode_frame(recv_frame_bytes(conn))
-                send_frame_bytes(conn, self.handle(frame))
+                frame = self._decode(recv_frame_bytes(conn))
+                self._send(conn, self.handle(frame))
         except (ConnectionError, OSError):
             pass
         finally:

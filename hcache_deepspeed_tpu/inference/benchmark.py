@@ -1905,6 +1905,285 @@ def run_fabric_serve(seed=0, n_replicas=3, n_requests=24, runs=2,
     return results
 
 
+def run_fabric_obs(seed=0, n_replicas=3, n_requests=24, runs=2,
+                   out="FABRIC_OBS.jsonl"):
+    """``--fabric-obs``: cross-process telemetry-plane audit
+    (docs/observability.md). The fabric's observability must be
+    *free* where it matters — the serving core's committed digests —
+    and *real* where humans look. Gates run inline:
+
+    * ``obs-invariance`` — the seeded kill-free trace served through
+      the process fleet with harvest ON (``runs`` times, gating
+      2-run digest determinism), harvest OFF, and the in-memory twin:
+      all event digests must be byte-identical (the telemetry plane
+      is digest-invisible), and the measured harvest overhead
+      (``transport.harvest_seconds`` against the fabric leg's wall
+      time) must stay <= 5%;
+    * ``obs-timeline`` — the fabric chaos run traced end-to-end; the
+      assembled cross-process timeline must be Perfetto-validator
+      clean with one real process row per worker carrying harvested
+      spans and >= 1 migration flow arrow spanning two actual worker
+      processes;
+    * ``obs-postmortem`` — the SIGKILL's ``worker_kill``
+      flight-recorder bundle must carry the victim's last-harvested
+      telemetry (spans + counters) as wall-clock attachments;
+    * per-link wire percentiles (p50/p99 latency and bytes/s from the
+      router's quantile sketches) are recorded as informational
+      trajectory — wall-clock readings on whatever host ran this.
+
+    CPU-only, never touches the TPU relay."""
+    from ..fabric import (InMemoryTransport, ProcessTransport,
+                          canonical_digest)
+    from ..resilience import run_fabric_chaos
+    from ..resilience.chaos import build_chaos_trace
+    from ..serving import (FleetConfig, RouterConfig, ServerConfig,
+                           ServingFleet, SimulatedEngine, VirtualClock)
+    from ..telemetry import get_flight_recorder, get_tracer
+    from ..telemetry.assemble import (WORKER_PID_BASE,
+                                      assemble_process_fleet_trace)
+    from ..telemetry.export import validate_trace
+    from .config import RaggedInferenceEngineConfig
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    violations = []
+
+    def make_engine():
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 64},
+            kv_cache={"block_size": 8, "num_blocks": 12},
+            hcache={"enable_latents": True}))
+
+    def drive(transport):
+        """One full kill-free serve; returns the fleet, the event
+        digest, and the leg's wall time (overhead denominator)."""
+        fleet = ServingFleet(
+            engines=[make_engine() for _ in range(n_replicas)],
+            clock=VirtualClock(),
+            config=FleetConfig(
+                n_replicas=n_replicas,
+                server=ServerConfig(max_queue_depth=n_requests + 1,
+                                    kv_demand_fraction=float("inf")),
+                router=RouterConfig(),
+                transport=transport))
+        reqs = build_chaos_trace(
+            seed, n_requests, fleet.replicas[0].engine.vocab_size,
+            max_new=10, rps=400.0, prompt_hi=24)
+        t0 = time.perf_counter()
+        with fleet.transport:
+            arrivals = sorted(reqs,
+                              key=lambda r: (r.arrival_time, r.uid))
+            steps = 0
+            while arrivals or fleet.has_work:
+                now = fleet.clock.now()
+                while arrivals and arrivals[0].arrival_time <= now:
+                    fleet.submit(request=arrivals.pop(0))
+                if not fleet.has_work and arrivals:
+                    fleet.clock.advance_to(arrivals[0].arrival_time)
+                    continue
+                fleet.step()
+                steps += 1
+                if steps > 1_000_000:
+                    raise RuntimeError("fabric obs livelock:\n"
+                                       + fleet.snapshot())
+        wall = time.perf_counter() - t0
+        return fleet, canonical_digest(fleet.event_log()), wall
+
+    # ------------- phase 1: harvest digest invariance -------------- #
+    _, mem_digest, _ = drive(InMemoryTransport())
+    on_runs = [drive(ProcessTransport()) for _ in range(max(1, runs))]
+    on_digests = [d for _, d, _ in on_runs]
+    _, off_digest, _ = drive(ProcessTransport(harvest_telemetry=False))
+    deterministic = len(set(on_digests)) == 1
+    harvest_digest_invariant = (
+        deterministic and on_digests[0] == off_digest ==
+        mem_digest)
+    on_fleet, _, on_wall = on_runs[0]
+    tr = on_fleet.transport
+    overhead = (tr.harvest_seconds / on_wall) if on_wall > 0 else 0.0
+    if not deterministic:
+        violations.append(
+            f"obs-invariance: harvest-on digests diverged across "
+            f"{len(on_digests)} runs")
+    if not harvest_digest_invariant:
+        violations.append(
+            "obs-invariance: telemetry harvest is digest-VISIBLE "
+            f"(on {on_digests[0][:12]} / off {off_digest[:12]} / "
+            f"mem {mem_digest[:12]})")
+    if tr.harvests < 1:
+        violations.append(
+            "obs-invariance: harvest plane never harvested (the "
+            "invariance gate tested nothing)")
+    if overhead > 0.05:
+        violations.append(
+            f"obs-invariance: harvest overhead {overhead:.4f} of "
+            "fabric-leg wall time exceeds the 5% budget")
+    measured_link = on_fleet.summary()["router"].get(
+        "measured_link") or {}
+    links = measured_link.get("links", {})
+    busiest = max(sorted(links),
+                  key=lambda k: links[k]["latency_s"]["count"]) \
+        if links else ""
+    if not links:
+        violations.append(
+            "obs-invariance: no per-link wire sketches recorded")
+    emit({"phase": "obs-invariance", "seed": seed,
+          "runs": len(on_runs),
+          "deterministic": deterministic,
+          "harvest_digest_invariant": harvest_digest_invariant,
+          "event_digest": mem_digest,
+          "harvest_on_digest": on_digests[0],
+          "harvest_off_digest": off_digest,
+          "harvests": tr.harvests,
+          "harvest_failures": tr.harvest_failures,
+          "harvest_seconds": round(tr.harvest_seconds, 6),
+          "leg_wall_seconds": round(on_wall, 6),
+          "harvest_overhead_fraction": round(overhead, 6),
+          "worker_telemetry": tr.telemetry_stats()})
+    emit({"phase": "obs-wire", "seed": seed,
+          "links": links, "busiest_link": busiest,
+          "priced_link_bytes_per_s":
+              on_fleet.config.link_bytes_per_s})
+
+    # ------------- phase 2: assembled cross-process timeline ------- #
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    flight = get_flight_recorder()
+    flight.clear()
+    try:
+        chaos = run_fabric_chaos(seed=seed, n_replicas=n_replicas)
+        parent_events = tracer.events()
+        parent_dropped = tracer.dropped
+    finally:
+        tracer.configure(enabled=was)
+    violations.extend(f"obs-chaos: {v}" for v in chaos.violations)
+    workers = chaos.telemetry.get("workers", {})
+    assembled, warnings = assemble_process_fleet_trace(
+        parent_events, workers, dropped=parent_dropped)
+    timeline_valid = True
+    timeline_error = ""
+    try:
+        stats = validate_trace(assembled)
+    except ValueError as exc:
+        timeline_valid = False
+        timeline_error = str(exc)
+        stats = {"events": len(assembled), "spans": 0, "pairs": 0}
+        violations.append(
+            f"obs-timeline: assembled trace invalid: {exc}")
+    worker_rows = sum(
+        1 for e in assembled
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("pid", 0) >= WORKER_PID_BASE)
+    worker_spans = sum(
+        1 for e in assembled
+        if e.get("pid", 0) >= WORKER_PID_BASE and
+        e.get("ph") in ("X", "B", "i"))
+    cross_worker_arrows = sum(
+        1 for e in assembled
+        if e.get("ph") == "s" and e.get("cat") == "fabric")
+    if worker_rows < n_replicas:
+        violations.append(
+            f"obs-timeline: only {worker_rows} worker process rows "
+            f"for {n_replicas} workers")
+    if worker_spans < 1:
+        violations.append(
+            "obs-timeline: no harvested spans landed on any worker "
+            "row")
+    if cross_worker_arrows < 1:
+        violations.append(
+            "obs-timeline: no migration flow arrow spans two real "
+            "worker processes")
+    emit({"phase": "obs-timeline", "seed": seed,
+          "timeline_valid": timeline_valid,
+          "timeline_error": timeline_error,
+          "events": stats["events"], "spans": stats["spans"],
+          "worker_rows": worker_rows,
+          "worker_spans": worker_spans,
+          "cross_worker_arrows": cross_worker_arrows,
+          "assembly_warnings": warnings,
+          "chaos_ok": chaos.ok,
+          "chaos_digest": chaos.event_digest,
+          "harvest": chaos.telemetry.get("harvest", {})})
+
+    # ------------- phase 3: SIGKILL postmortem bundle -------------- #
+    kill_bundles = [b for b in list(flight.bundles)
+                    if b["trigger"] == "worker_kill"]
+    bundle = kill_bundles[0] if kill_bundles else {}
+    attach = bundle.get("attachments", {})
+    postmortem_has_telemetry = bool(
+        kill_bundles and
+        bundle.get("snapshot", {}).get("victim") == chaos.victim and
+        attach.get("counters") and
+        attach.get("harvests", 0) >= 1)
+    if not kill_bundles:
+        violations.append(
+            "obs-postmortem: no worker_kill flight bundle recorded")
+    elif not postmortem_has_telemetry:
+        violations.append(
+            "obs-postmortem: worker_kill bundle lacks the victim's "
+            "last-harvested telemetry")
+    emit({"phase": "obs-postmortem", "seed": seed,
+          "bundles": len(kill_bundles),
+          "victim": chaos.victim,
+          "postmortem_has_telemetry": postmortem_has_telemetry,
+          "bundle_digest": bundle.get("digest", ""),
+          "bundle_spans": len(bundle.get("spans", [])),
+          "attachment_counters":
+              sorted(attach.get("counters", {})),
+          "attachment_harvests": attach.get("harvests", 0)})
+
+    # ------------- summary ----------------------------------------- #
+    blink = links.get(busiest, {})
+    emit({"phase": "fabric-obs-summary", "seed": seed,
+          "n_replicas": n_replicas, "n_requests": n_requests,
+          "runs": len(on_runs),
+          "deterministic": deterministic,
+          "harvest_digest_invariant": harvest_digest_invariant,
+          "event_digest": mem_digest,
+          "harvests": tr.harvests,
+          "harvest_failures": tr.harvest_failures,
+          "harvest_overhead_fraction": round(overhead, 6),
+          "timeline_valid": timeline_valid,
+          "worker_rows": worker_rows,
+          "worker_spans": worker_spans,
+          "cross_worker_arrows": cross_worker_arrows,
+          "postmortem_has_telemetry": postmortem_has_telemetry,
+          "chaos_ok": chaos.ok,
+          "busiest_link": busiest,
+          "wire_latency_p50_s":
+              blink.get("latency_s", {}).get("p50"),
+          "wire_latency_p99_s":
+              blink.get("latency_s", {}).get("p99"),
+          "wire_bytes_per_s_p50":
+              blink.get("bytes_per_s", {}).get("p50"),
+          "wire_bytes_per_s_p99":
+              blink.get("bytes_per_s", {}).get("p99"),
+          "invariants_ok": not violations,
+          "violations": violations})
+
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "FABRIC_OBS.jsonl", results))
+    if fh is not None:
+        fh.close()
+    if violations:
+        raise RuntimeError(
+            f"fabric obs gates violated: {violations}")
+    return results
+
 
 def run_request_trace(seed=0, runs=2, out="REQUEST_TRACE.jsonl",
                       closure_tol=0.01):

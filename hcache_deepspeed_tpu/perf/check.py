@@ -180,6 +180,29 @@ TOLERANCES: Dict[str, Tolerance] = {
     "fabric.violations": Tolerance("lower", rel=0.0),
     "fabric.two_hop_deliveries": Tolerance("higher", rel=0.50),
     "fabric.max_trace_hops": Tolerance("higher", rel=0.50),
+    # cross-process telemetry plane (ISSUE 17): observation must be
+    # digest-invisible and cheap — the invisibility/validity booleans
+    # are hard gates, violations must be exactly zero, and the
+    # measured harvest overhead is upper-bounded with absolute
+    # headroom (it is a wall-clock ratio on whatever host ran the
+    # bench, but the 5% budget is part of the contract). Span/arrow
+    # counts may evolve with routing policy (loose); the per-link
+    # wire percentiles are wall clock and deliberately NOT gated.
+    "fabric_obs.deterministic": Tolerance("higher", rel=0.0),
+    "fabric_obs.harvest_digest_invariant": Tolerance("higher",
+                                                     rel=0.0),
+    "fabric_obs.timeline_valid": Tolerance("higher", rel=0.0),
+    "fabric_obs.postmortem_has_telemetry": Tolerance("higher",
+                                                     rel=0.0),
+    "fabric_obs.chaos_ok": Tolerance("higher", rel=0.0),
+    "fabric_obs.invariants_ok": Tolerance("higher", rel=0.0),
+    "fabric_obs.violations": Tolerance("lower", rel=0.0),
+    "fabric_obs.harvest_failures": Tolerance("lower", rel=0.0),
+    "fabric_obs.harvest_overhead_fraction":
+        Tolerance("lower", rel=0.0, abs=0.05),
+    "fabric_obs.worker_rows": Tolerance("higher", rel=0.0),
+    "fabric_obs.worker_spans": Tolerance("higher", rel=0.50),
+    "fabric_obs.cross_worker_arrows": Tolerance("higher", rel=0.50),
     # causal request tracing (CPU-deterministic; the booleans are hard
     # gates, the closure residual has an absolute bar — attribution
     # must sum to measured E2E within 1% regardless of baseline)

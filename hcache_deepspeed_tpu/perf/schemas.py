@@ -824,6 +824,60 @@ def parse_fabric_serve(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_fabric_obs(text: str, file: str) -> List[MetricPoint]:
+    """FABRIC_OBS.jsonl: the cross-process telemetry-plane audit
+    (``bench.py --fabric-obs``) — harvest digest invariance, assembled
+    cross-process timeline validity, SIGKILL postmortem telemetry, and
+    the harvest-overhead budget. The boolean gates are hard (rel=0.0
+    in TOLERANCES) and the overhead fraction is upper-bounded; the
+    per-link wire percentiles are wall-clock on whatever host ran the
+    bench and index as informational trajectory only."""
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        if row.get("phase") != "fabric-obs-summary":
+            continue
+        phase = "fabric-obs-summary"
+        for key, metric in (
+                ("deterministic", "fabric_obs.deterministic"),
+                ("harvest_digest_invariant",
+                 "fabric_obs.harvest_digest_invariant"),
+                ("timeline_valid", "fabric_obs.timeline_valid"),
+                ("postmortem_has_telemetry",
+                 "fabric_obs.postmortem_has_telemetry"),
+                ("chaos_ok", "fabric_obs.chaos_ok"),
+                ("invariants_ok", "fabric_obs.invariants_ok")):
+            if key in row:
+                pts.append(MetricPoint(metric,
+                                       1.0 if row[key] else 0.0,
+                                       file, phase=phase))
+        for key, metric in (
+                ("harvests", "fabric_obs.harvests"),
+                ("harvest_failures", "fabric_obs.harvest_failures"),
+                ("harvest_overhead_fraction",
+                 "fabric_obs.harvest_overhead_fraction"),
+                ("worker_rows", "fabric_obs.worker_rows"),
+                ("worker_spans", "fabric_obs.worker_spans"),
+                ("cross_worker_arrows",
+                 "fabric_obs.cross_worker_arrows"),
+                ("wire_latency_p50_s",
+                 "fabric_obs.wire_latency_p50_s"),
+                ("wire_latency_p99_s",
+                 "fabric_obs.wire_latency_p99_s"),
+                ("wire_bytes_per_s_p50",
+                 "fabric_obs.wire_bytes_per_s_p50"),
+                ("wire_bytes_per_s_p99",
+                 "fabric_obs.wire_bytes_per_s_p99")):
+            if isinstance(row.get(key), (int, float)):
+                pts.append(MetricPoint(metric, float(row[key]),
+                                       file, phase=phase))
+        pts.append(MetricPoint(
+            "fabric_obs.violations",
+            float(len(row.get("violations", []))), file,
+            phase=phase))
+    return pts
+
+
 def parse_paged_vet(text: str, file: str) -> List[MetricPoint]:
     rows = read_jsonl_rows(text)
     pts = []
@@ -975,6 +1029,13 @@ FAMILIES: List[ArtifactFamily] = [
         "parity (digest invariance, bitwise streams, two-hop socket "
         "crossings, cross-process trace hops, measured-vs-priced "
         "wire) + the literal kill-a-process chaos leg"),
+    ArtifactFamily(
+        "fabric-obs", r"^FABRIC_OBS\.jsonl$", parse_fabric_obs,
+        "cross-process telemetry plane: worker span/metric harvest "
+        "over the fabric control channel (digest-invisibility gate, "
+        "assembled cross-process timeline with real worker rows + "
+        "cross-worker arrows, SIGKILL postmortem telemetry, harvest "
+        "overhead budget, per-link wire percentiles)"),
     ArtifactFamily(
         "request-trace", r"^REQUEST_TRACE\.jsonl$",
         parse_request_trace,

@@ -728,6 +728,10 @@ class FabricChaosResult:
     fleet_summary: Dict
     wire: Dict
     invariants: Dict
+    #: harvested cross-process telemetry: per-worker spans/counters
+    #: plus the parent-side harvest accounting. Wall-clock context —
+    #: rides OUTSIDE event_digest, like flight-recorder spans.
+    telemetry: Dict = field(default_factory=dict)
     ok: bool = False
     violations: List[str] = field(default_factory=list)
 
@@ -815,6 +819,28 @@ def run_fabric_chaos(seed: int = 0, n_replicas: int = 3,
                 done_before_kill = sum(
                     1 for r in reqs if r.state.name == "DONE")
                 transport.kill(victim)
+                # the kill() path harvested the victim best-effort
+                # just before the SIGKILL — its last-known spans and
+                # counters land in the postmortem bundle (outside the
+                # digest, which stays harvest-invariant)
+                vt = transport.worker_telemetry.get(victim, {})
+                get_flight_recorder().dump(
+                    "worker_kill",
+                    f"fabric chaos SIGKILL replica {victim}",
+                    source="chaos:fabric", step=fleet.step_idx,
+                    t=fleet.clock.now(),
+                    snapshot={"kind": "fabric", "seed": seed,
+                              "victim": victim},
+                    spans=list(vt.get("events") or []),
+                    attachments={
+                        "counters": dict(vt.get("counters") or {}),
+                        "metrics": list(vt.get("metrics") or []),
+                        "rss_max_bytes": int(
+                            vt.get("rss_max_bytes", 0)),
+                        "clock_offset_us": float(
+                            vt.get("clock_offset_us", 0.0)),
+                        "harvests": int(vt.get("harvests", 0)),
+                    })
             fleet.step()
             steps += 1
             if steps > 1_000_000:
@@ -863,6 +889,11 @@ def run_fabric_chaos(seed: int = 0, n_replicas: int = 3,
             f"{landed} terminal migrations ({dict(c)})")
     # 4. the kill was real and the fleet survived it
     wire = transport.wire_stats()
+    # close() ran the shutdown harvest: survivors' final streams plus
+    # the victim's pre-kill last-known state are all on the handles
+    telemetry = {"harvest": transport.telemetry_stats(),
+                 "workers": {int(rid): dict(tel) for rid, tel in
+                             transport.worker_telemetry.items()}}
     if wire["kills"] != 1:
         violations.append(f"expected exactly 1 kill, saw "
                           f"{wire['kills']}")
@@ -902,6 +933,7 @@ def run_fabric_chaos(seed: int = 0, n_replicas: int = 3,
         event_digest=_digest(fleet.event_log()),
         fleet_summary=fleet.summary(),
         wire=wire,
+        telemetry=telemetry,
         invariants={
             "terminal_states": sorted({r.state.name for r in reqs}),
             "replica_states": {str(rep.id): rep.state.name

@@ -853,8 +853,11 @@ class ServingFleet:
         one, so this is a no-op there."""
         sample = self.transport.last_wire_sample
         if sample is not None:
-            self.router.observe_wire(*sample)
+            self.router.observe_wire(
+                *sample,
+                link=getattr(self.transport, "last_wire_link", None))
             self.transport.last_wire_sample = None
+            self.transport.last_wire_link = None
 
     def _transit_pass(self, now: float, routable) -> None:
         if not self.in_transit:
@@ -1389,10 +1392,59 @@ class ServingFleet:
                         get_flight_recorder().dumps,
                         help="anomaly-triggered flight-recorder "
                              "postmortem bundles captured")
+        # measured-wire percentiles, one series per crossed link (a
+        # measuring transport names each sample's (src, dst); absent
+        # entirely under the in-memory transport — same conditional-
+        # presence contract as the router's measured_link block)
+        for (src, dst), entry in sorted(
+                self.router.wire_links.items()):
+            labels = {"replica": str(dst), "link": f"{src}->{dst}"}
+            lat = entry["latency_s"].summary()
+            bps = entry["bytes_per_s"].summary()
+            reg.set_counter("wire_link_samples",
+                            float(lat.get("count", 0)),
+                            labels=labels,
+                            help="measured crossings on this link")
+            for q in ("p50", "p99"):
+                if q in lat:
+                    reg.set_gauge(f"wire_latency_seconds_{q}",
+                                  lat[q], labels=labels,
+                                  help="measured per-link crossing "
+                                       f"latency {q} (wall clock, "
+                                       "calibration only)")
+                if q in bps:
+                    reg.set_gauge(f"wire_bytes_per_s_{q}",
+                                  bps[q], labels=labels,
+                                  help="measured per-link throughput "
+                                       f"{q} (wall clock, "
+                                       "calibration only)")
         return reg
 
     def prometheus_text(self) -> str:
         return self.metrics_registry().render()
+
+    def metrics_snapshot(self) -> Dict:
+        """Fleet-scope observability snapshot (the fleet analog of
+        ``ServingServer.metrics_snapshot``): the router summary with
+        its measured-link calibration block broken out (count/min/max
+        beside the mean, per-link percentile sketches), the
+        transport's wire + telemetry-harvest accounting, and the
+        tracer/flight-recorder health counters."""
+        with self._lock:
+            router = self.router.summary()
+        out = {
+            "transport": self.transport.name,
+            "router": router,
+            "measured_link": router.get("measured_link", {}),
+            "wire": self.transport.wire_stats(),
+            "tracer": {"buffered": get_tracer().buffered,
+                       "dropped": get_tracer().dropped},
+            "flight": get_flight_recorder().summary(),
+        }
+        tel = getattr(self.transport, "telemetry_stats", None)
+        if tel is not None:
+            out["worker_telemetry"] = tel()
+        return out
 
     def snapshot(self, last_events: int = 20) -> str:
         with self._lock:

@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..resilience.retry import BreakerState, CircuitBreaker
+from ..telemetry.sketch import QuantileSketch
 from .crossover import RestoreCrossoverModel
 from .prefix_tree import RadixPrefixTree, default_fingerprint
 from .request import Request
@@ -142,11 +143,24 @@ class FleetRouter:
         self.wire_samples = 0
         self.wire_sample_bytes = 0
         self.wire_sample_seconds = 0.0
+        # calibration-quality spread: per-sample bytes/s and latency
+        # extrema next to the running mean, so a mean built from two
+        # wildly different links is inspectable as such
+        self.wire_min_bytes_per_s: Optional[float] = None
+        self.wire_max_bytes_per_s: Optional[float] = None
+        self.wire_min_seconds: Optional[float] = None
+        self.wire_max_seconds: Optional[float] = None
+        #: (src, dst) -> {"latency_s": QuantileSketch,
+        #:                "bytes_per_s": QuantileSketch} — per-link
+        #: wire histograms (p50/p99 in the fleet exposition with
+        #: {replica, link} labels); src -1 = parent-direct crossing
+        self.wire_links: Dict[Tuple[int, int], Dict] = {}
 
     # ------------------------------------------------------------- #
     # measured-wire calibration
     # ------------------------------------------------------------- #
-    def observe_wire(self, nbytes: int, seconds: float) -> None:
+    def observe_wire(self, nbytes: int, seconds: float,
+                     link: Optional[Tuple[int, int]] = None) -> None:
         """Record one measured transport crossing (real bytes over a
         real wire, wall-clock seconds). Calibration-only: routing
         decisions keep pricing transits with the configured
@@ -154,12 +168,42 @@ class FleetRouter:
         simulation (that would leak wall-clock jitter into the replay
         digests). It is surfaced in :meth:`summary` beside the priced
         link so an operator can see how far the configured price is
-        from the wire this deployment actually has."""
+        from the wire this deployment actually has.
+
+        Beyond the running mean, each sample updates min/max extrema
+        (calibration quality: how spread the samples behind the mean
+        are) and, when ``link=(src, dst)`` names the crossing, feeds
+        per-link :class:`~..telemetry.sketch.QuantileSketch`
+        histograms whose p50/p99 land in the fleet Prometheus
+        exposition with ``{replica, link}`` labels."""
         if seconds <= 0 or nbytes <= 0:
             return
         self.wire_samples += 1
         self.wire_sample_bytes += int(nbytes)
         self.wire_sample_seconds += float(seconds)
+        bps = float(nbytes) / float(seconds)
+        if self.wire_min_bytes_per_s is None or \
+                bps < self.wire_min_bytes_per_s:
+            self.wire_min_bytes_per_s = bps
+        if self.wire_max_bytes_per_s is None or \
+                bps > self.wire_max_bytes_per_s:
+            self.wire_max_bytes_per_s = bps
+        if self.wire_min_seconds is None or \
+                seconds < self.wire_min_seconds:
+            self.wire_min_seconds = float(seconds)
+        if self.wire_max_seconds is None or \
+                seconds > self.wire_max_seconds:
+            self.wire_max_seconds = float(seconds)
+        if link is None:
+            return
+        key = (int(link[0]), int(link[1]))
+        entry = self.wire_links.get(key)
+        if entry is None:
+            entry = self.wire_links[key] = {
+                "latency_s": QuantileSketch(),
+                "bytes_per_s": QuantileSketch()}
+        entry["latency_s"].add(float(seconds))
+        entry["bytes_per_s"].add(bps)
 
     # ------------------------------------------------------------- #
     # health
@@ -339,6 +383,34 @@ class FleetRouter:
             self.migrations_proposed += 1
         return out
 
+    def measured_link(self) -> Dict:
+        """The measured-wire calibration block: running mean BESIDE
+        its sample count and per-sample extrema (a mean without its
+        spread is a point estimate pretending to be a measurement),
+        plus per-link p50/p99 sketch summaries. Empty dict when no
+        measuring transport fed samples."""
+        if not self.wire_samples:
+            return {}
+        out = {
+            "samples": self.wire_samples,
+            "bytes": self.wire_sample_bytes,
+            "bytes_per_s": self.wire_sample_bytes /
+            self.wire_sample_seconds,
+            "priced_bytes_per_s": self.link_bytes_per_s,
+            "min_bytes_per_s": round(self.wire_min_bytes_per_s, 3),
+            "max_bytes_per_s": round(self.wire_max_bytes_per_s, 3),
+            "min_seconds": round(self.wire_min_seconds, 9),
+            "max_seconds": round(self.wire_max_seconds, 9),
+        }
+        if self.wire_links:
+            out["links"] = {
+                f"{src}->{dst}": {
+                    "latency_s": entry["latency_s"].summary(),
+                    "bytes_per_s": entry["bytes_per_s"].summary(),
+                } for (src, dst), entry
+                in sorted(self.wire_links.items())}
+        return out
+
     # ------------------------------------------------------------- #
     def summary(self) -> Dict:
         out = {
@@ -357,13 +429,7 @@ class FleetRouter:
         if self.wire_samples:
             # absent entirely when no measuring transport fed samples,
             # so historical (in-memory) summaries stay byte-identical
-            out["measured_link"] = {
-                "samples": self.wire_samples,
-                "bytes": self.wire_sample_bytes,
-                "bytes_per_s": self.wire_sample_bytes /
-                self.wire_sample_seconds,
-                "priced_bytes_per_s": self.link_bytes_per_s,
-            }
+            out["measured_link"] = self.measured_link()
         if self.config.prefix_reuse:
             out["reuse_routes"] = self.reuse_routes
             out["prefix_broadcasts_planned"] = \

@@ -31,8 +31,9 @@ CLI: ``python -m hcache_deepspeed_tpu.telemetry dump|summarize``.
 See ``docs/observability.md``.
 """
 
-from .assemble import (assemble_fleet_trace, merge_streams,  # noqa: F401
-                       migration_flows)
+from .assemble import (assemble_fleet_trace,  # noqa: F401
+                       assemble_process_fleet_trace, merge_streams,
+                       migration_flows, worker_flows)
 from .context import (TraceContext, TraceSpan,  # noqa: F401
                       WireVersionError)
 from .critical_path import (CriticalPathProfile, attribute,  # noqa: F401
@@ -57,5 +58,6 @@ __all__ = [
     "default_objectives", "TraceContext", "TraceSpan",
     "CriticalPathProfile", "attribute", "closure", "connected",
     "critical_path", "FlightRecorder", "get_flight_recorder",
-    "assemble_fleet_trace", "merge_streams", "migration_flows",
+    "assemble_fleet_trace", "assemble_process_fleet_trace",
+    "merge_streams", "migration_flows", "worker_flows",
 ]

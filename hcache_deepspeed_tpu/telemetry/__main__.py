@@ -12,6 +12,14 @@
     renders as its own Perfetto process row (stable labels), with
     cross-track flow arrows for every migration/handoff.
 
+``python -m hcache_deepspeed_tpu.telemetry dump --fabric``
+    Run the process-fabric chaos trace (real worker processes, a
+    literal SIGKILL) and export the **assembled cross-process**
+    timeline: parent rows as in ``--fleet``, PLUS one real process
+    row per worker carrying its harvested spans (clock-offset
+    aligned), with flow arrows spanning actual worker processes for
+    every two-hop migration.
+
 ``python -m hcache_deepspeed_tpu.telemetry summarize trace.json ...``
     Validate + summarize one exported trace — or SEVERAL: multiple
     files are merged as separate tracer streams with stable labels
@@ -31,6 +39,8 @@ def _cmd_dump(args):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.fleet:
         return _dump_fleet(args)
+    if args.fabric:
+        return _dump_fabric(args)
     from . import render_table, summarize, validate_trace, write_trace
     from .demo import run_demo
     from .tracer import get_tracer
@@ -91,6 +101,56 @@ def _dump_fleet(args):
     return 0 if result.ok else 4
 
 
+def _dump_fabric(args):
+    """Deterministic cross-process capture: the fabric chaos run
+    (process transport, literal SIGKILL) with the parent tracer on;
+    harvested worker streams land as real per-process rows via
+    ``telemetry.assemble.assemble_process_fleet_trace``."""
+    from ..resilience.chaos import run_fabric_chaos
+    from .assemble import (WORKER_PID_BASE,
+                           assemble_process_fleet_trace,
+                           replica_labels)
+    from .export import validate_trace, write_trace
+    from .tracer import get_tracer
+
+    tracer = get_tracer()
+    was = tracer.enabled
+    tracer.configure(enabled=True)
+    tracer.clear()
+    try:
+        result = run_fabric_chaos(seed=args.seed)
+        events = tracer.events()
+        dropped = tracer.dropped
+    finally:
+        tracer.configure(enabled=was)
+    workers = result.telemetry.get("workers", {})
+    assembled, warnings = assemble_process_fleet_trace(
+        events, workers, dropped=dropped)
+    trace = write_trace(assembled, args.out)
+    stats = validate_trace(trace)
+    for w in warnings:
+        print(f"WARNING: {w}")
+    replicas = replica_labels(events)
+    arrows = sum(1 for e in assembled if e.get("ph") == "s")
+    worker_arrows = sum(
+        1 for e in assembled
+        if e.get("ph") == "s" and e.get("cat") == "fabric")
+    worker_rows = sum(
+        1 for e in assembled
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("pid", 0) >= WORKER_PID_BASE)
+    harvest = result.telemetry.get("harvest", {})
+    print(f"fabric chaos seed={args.seed}: ok={result.ok} "
+          f"victim={result.victim} harvests={harvest.get('harvests')} "
+          f"digest={result.event_digest[:12]}…")
+    print(f"wrote {args.out} ({stats['events']} events, "
+          f"{stats['spans']} spans, {len(replicas)} replica rows + "
+          f"{worker_rows} worker process rows, {arrows} flow arrows "
+          f"of which {worker_arrows} cross worker processes) — load "
+          "at https://ui.perfetto.dev")
+    return 0 if result.ok else 4
+
+
 def _cmd_summarize(args):
     from . import load_trace, render_table, summarize, validate_trace
     from .assemble import merge_streams, stream_drop_count
@@ -145,8 +205,13 @@ def main(argv=None):
                         help="trace a deterministic disaggregated "
                              "fleet run instead and export the "
                              "assembled per-replica timeline")
+    p_dump.add_argument("--fabric", action="store_true",
+                        help="trace the process-fabric chaos run "
+                             "instead and export the assembled "
+                             "cross-process timeline (harvested "
+                             "worker rows + cross-worker arrows)")
     p_dump.add_argument("--seed", type=int, default=0,
-                        help="fleet-mode chaos seed")
+                        help="fleet/fabric-mode chaos seed")
     p_dump.set_defaults(fn=_cmd_dump)
 
     p_sum = sub.add_parser(

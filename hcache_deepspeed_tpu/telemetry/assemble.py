@@ -14,12 +14,23 @@ with **one process row per replica**:
   ``replica`` attribute (the scheduler stamps it). Events are fanned
   out to per-replica process rows; fleet-scope events (routing,
   transits, migrations) get their own row.
+* :func:`assemble_process_fleet_trace` — the cross-process fabric:
+  the parent buffer fans out as above, and each worker process's
+  HARVESTED stream (``ProcessTransport.worker_telemetry``) lands as a
+  real per-process row, shifted onto the parent timeline by the
+  harvest handshake's estimated clock offset (each tracer's ``ts`` is
+  µs relative to its own ``perf_counter`` origin; the NTP-style
+  midpoint estimate aligns them).
 
 On top of the fan-out, :func:`migration_flows` derives Perfetto flow
 arrows (``s``/``f`` phase pairs) from the scheduler's
 ``sched.migrate_out`` / ``sched.migrate_in`` instants, matched per
 uid in time order — a cross-replica handoff renders as an arrow from
 the prefill replica's track to the decode replica's track.
+:func:`worker_flows` does the same for the fabric's two-hop
+crossings: the src worker's ``fabric.forward_out`` instant pairs with
+the dst worker's ``fabric.migrate_in``, so a two-hop migration
+renders as an arrow between actual worker processes.
 
 Drop honesty: a tracer ring buffer that overflowed has *holes*; both
 mergers surface the exporter's ``tracer_dropped_events`` metadata (and
@@ -32,6 +43,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 #: pid stride per input stream in merge_streams — large enough that
 #: any real tid/pid fits inside one stream's namespace
 _STREAM_STRIDE = 1000
+
+#: pid base for harvested worker-process rows in
+#: assemble_process_fleet_trace — clears every per-replica pid and the
+#: fleet row (replica ids) by a wide margin
+WORKER_PID_BASE = 9000
 
 #: metadata event name the exporter writes when the source tracer
 #: dropped events (see tracer.Tracer.dropped / export.write_trace)
@@ -133,6 +149,87 @@ def migration_flows(events: List[Dict],
                                             pid_of[None]),
                           "ts": ev.get("ts", 0.0)})
     return flows
+
+
+def worker_flows(events: List[Dict]) -> List[Dict]:
+    """Perfetto flow arrows for the fabric's two-hop crossings: each
+    src worker's ``fabric.forward_out`` instant pairs with the next
+    ``fabric.migrate_in`` of the same uid (time order, after clock
+    alignment), yielding an ``s``/``f`` pair between the two worker
+    process rows. Same-pid pairs are skipped — a direct delivery lands
+    on one worker and crosses no worker-to-worker wire."""
+    outs: Dict[int, List[Dict]] = {}
+    flows: List[Dict] = []
+    n = 0
+    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        if ev.get("ph") != "i":
+            continue
+        name = ev.get("name", "")
+        uid = (ev.get("args") or {}).get("uid")
+        if uid is None:
+            continue
+        if name == "fabric.forward_out":
+            outs.setdefault(int(uid), []).append(ev)
+        elif name == "fabric.migrate_in":
+            pending = outs.get(int(uid))
+            if not pending:
+                continue
+            src = pending.pop(0)
+            if src.get("pid") == ev.get("pid"):
+                continue
+            fid = f"fab-{uid}-{n}"
+            n += 1
+            common = {"name": "fabric.migrate", "cat": "fabric",
+                      "id": fid, "tid": 0}
+            flows.append({"ph": "s", **common,
+                          "pid": src.get("pid", 0),
+                          "ts": src.get("ts", 0.0)})
+            flows.append({"ph": "f", "bp": "e", **common,
+                          "pid": ev.get("pid", 0),
+                          "ts": ev.get("ts", 0.0)})
+    return flows
+
+
+def assemble_process_fleet_trace(
+        parent_events: List[Dict],
+        worker_streams: "Dict[int, Dict]",
+        dropped: int = 0) -> Tuple[List[Dict], List[str]]:
+    """Assemble the cross-process fabric timeline: the parent tracer
+    buffer fans out exactly like :func:`assemble_fleet_trace`, then
+    each harvested worker stream (``{replica_id: {"events": [...],
+    "clock_offset_us": float, "dropped": int}}`` — the shape
+    ``ProcessTransport.worker_telemetry`` keeps) becomes its own
+    Perfetto process row with every timestamp shifted by the
+    handshake-estimated clock offset onto the parent timeline, plus
+    :func:`worker_flows` arrows for two-hop crossings. Returns
+    ``(events, warnings)``."""
+    out, warnings = assemble_fleet_trace(parent_events,
+                                         dropped=dropped)
+    shifted: List[Dict] = []
+    for rid in sorted(worker_streams):
+        stream = worker_streams[rid] or {}
+        events = list(stream.get("events") or [])
+        pid = WORKER_PID_BASE + int(rid)
+        out.append(_process_meta(pid, f"worker {rid}"))
+        wdropped = int(stream.get("dropped", 0)) + \
+            stream_drop_count(events)
+        if wdropped:
+            warnings.append(
+                f"worker {rid}: source tracer dropped {wdropped} "
+                "events (ring overflow / harvest trim) — worker row "
+                "incomplete")
+        offset = float(stream.get("clock_offset_us", 0.0))
+        for ev in events:
+            if ev.get("ph") == "M":
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            ev["ts"] = float(ev.get("ts", 0.0)) + offset
+            out.append(ev)
+            shifted.append(ev)
+    out.extend(worker_flows(shifted))
+    out.sort(key=lambda e: (e.get("ts", 0.0), e.get("ph") != "M"))
+    return out, warnings
 
 
 def assemble_fleet_trace(events: List[Dict],

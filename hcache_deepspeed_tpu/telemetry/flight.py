@@ -12,14 +12,18 @@ serving process cannot grow it. Trigger sites (wired in
 * ``breaker_open`` — the restore-path circuit breaker tripped;
 * ``watchdog`` — the stuck-lane watchdog aborted a restore lane;
 * ``chaos_invariant`` — a chaos-harness invariant failed;
-* ``server_crash`` — the serving loop died (``_on_loop_error``).
+* ``server_crash`` — the serving loop died (``_on_loop_error``);
+* ``worker_kill`` — the fabric chaos harness SIGKILL'd a worker
+  process: the bundle carries the victim's LAST-HARVESTED telemetry
+  (spans + counters) as wall-clock attachments.
 
 Each dump is a **deterministic postmortem bundle**: trigger + reason,
 the scheduler's virtual-clock snapshot (pools, breaker, degradation,
 event-log tail), metrics counters — plus the last-K wall-clock tracer
-spans for humans. The bundle digest is computed over everything
-EXCEPT the wall-clock spans (and the arrival sequence number), so the
-same seed produces byte-identical digests: the determinism gate in
+spans (and optional wall-clock ``attachments``) for humans. The
+bundle digest is computed over everything EXCEPT the wall-clock spans
+and attachments (and the arrival sequence number), so the same seed
+produces byte-identical digests: the determinism gate in
 ``REQUEST_TRACE.jsonl`` replays a chaos run twice and compares.
 
 Per-(trigger, source) cooldowns are counted in *scheduler steps*, not
@@ -71,10 +75,14 @@ class FlightRecorder:
     def dump(self, trigger: str, reason: str, source: str = "",
              step: int = 0, t: float = 0.0,
              snapshot: Optional[Dict] = None,
-             spans: Optional[List] = None) -> Optional[Dict]:
+             spans: Optional[List] = None,
+             attachments: Optional[Dict] = None) -> Optional[Dict]:
         """Record one bundle (honoring the cooldown); returns it, or
         None when suppressed. ``snapshot`` must be JSON-safe and
-        deterministic under the virtual clock — it is digested."""
+        deterministic under the virtual clock — it is digested.
+        ``attachments`` is wall-clock context (harvested worker
+        counters, RSS, clock offsets) and rides OUTSIDE the digest,
+        like ``spans``."""
         with self._lock:
             if not self.should_fire(trigger, source, step):
                 self.suppressed += 1
@@ -91,6 +99,8 @@ class FlightRecorder:
             bundle["digest"] = self.bundle_digest(bundle)
             # wall-clock context for humans, outside the digest
             bundle["spans"] = list(spans or [])[-self.span_tail:]
+            if attachments:
+                bundle["attachments"] = dict(attachments)
             bundle["seq"] = self.dumps
             self.dumps += 1
             self.bundles.append(bundle)
@@ -99,10 +109,10 @@ class FlightRecorder:
     @staticmethod
     def bundle_digest(bundle: Dict) -> str:
         """sha256 over the deterministic core of a bundle (everything
-        except the wall-clock ``spans`` tail, the arrival ``seq`` and
-        the digest itself)."""
+        except the wall-clock ``spans`` tail and ``attachments``, the
+        arrival ``seq`` and the digest itself)."""
         core = {k: v for k, v in bundle.items()
-                if k not in ("spans", "seq", "digest")}
+                if k not in ("spans", "seq", "digest", "attachments")}
         payload = json.dumps(core, sort_keys=True,
                              separators=(",", ":"),
                              default=repr).encode()

@@ -148,6 +148,28 @@ class MetricRegistry:
              "count": int(count), "sum": float(sum_)})
 
     # ------------------------------------------------------------- #
+    def samples(self) -> List[Dict]:
+        """JSON-safe flat view of every registered sample, sorted by
+        (name, labels) — the wire shape the fabric telemetry harvest
+        ships a worker-process registry in (a frame header is JSON, so
+        the registry must flatten losslessly for scalar families;
+        histograms export their count/sum)."""
+        out: List[Dict] = []
+        for name in sorted(self._families):
+            fam = self._families[name]
+            for key in sorted(fam["samples"]):
+                labels, value = fam["samples"][key]
+                row = {"name": name, "type": fam["type"],
+                       "labels": dict(labels)}
+                if isinstance(value, dict):     # histogram
+                    row["value"] = {"count": value["count"],
+                                    "sum": value["sum"]}
+                else:
+                    row["value"] = float(value)
+                out.append(row)
+        return out
+
+    # ------------------------------------------------------------- #
     @staticmethod
     def _render_labels(labels: Dict) -> str:
         if not labels:

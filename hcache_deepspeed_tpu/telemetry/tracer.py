@@ -126,6 +126,16 @@ class Tracer:
             self.dropped = 0
             self._t0 = time.perf_counter()
 
+    def now_us(self) -> float:
+        """Current position on this tracer's timeline (µs since its
+        ``_t0``). Each process's tracer has its own origin, so this is
+        the anchor the cross-process clock-offset handshake exchanges:
+        the parent stamps its ``now_us`` on a telemetry harvest
+        request, the worker replies with its own, and the assembler
+        shifts the worker's stream onto the parent timeline
+        (``assemble.assemble_process_fleet_trace``)."""
+        return (time.perf_counter() - self._t0) * 1e6
+
     # -------------------------------------------------------------- #
     # internals
     # -------------------------------------------------------------- #

@@ -25,3 +25,13 @@ def open_serving_span(uid):
 
 def close_serving_span(uid):
     get_tracer().async_end("fleet.migrate.demo", uid)    # HDS-C004
+
+
+def open_fabric_span(uid):
+    # fabric crossing without request identity: the cross-process
+    # assembler can never pair it into a worker-to-worker arrow
+    get_tracer().async_begin("fabric.relay.demo", uid)   # HDS-C004
+
+
+def close_fabric_span(uid):
+    get_tracer().async_end("fabric.relay.demo", uid)     # HDS-C004

@@ -32,3 +32,13 @@ def open_serving_span(uid, trace_id):
 
 def close_serving_span(uid):
     get_tracer().async_end("fleet.migrate.demo", uid, uid=uid)
+
+
+def open_fabric_span(uid):
+    # the corrected fabric twin: uid identity makes the crossing
+    # pairable into a cross-process arrow
+    get_tracer().async_begin("fabric.relay.demo", uid, uid=uid)
+
+
+def close_fabric_span(uid):
+    get_tracer().async_end("fabric.relay.demo", uid, uid=uid)

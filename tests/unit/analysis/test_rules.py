@@ -146,14 +146,17 @@ def test_c003_reasonless_pragma(full_report):
 
 
 def test_c004_serving_span_without_request_identity(full_report):
-    """A sched.*/serve.*/fleet.* async span with no uid=/trace= attr
-    fires on BOTH the begin and the end; the attributed twin (and
-    non-serving names like the plain 'request' interval) stay
-    silent."""
+    """A sched.*/serve.*/fleet.*/fabric.* async span with no
+    uid=/trace= attr fires on BOTH the begin and the end; the
+    attributed twin (and non-serving names like the plain 'request'
+    interval) stay silent."""
     hits = [f for f in full_report.findings if f.code == "HDS-C004"]
     assert sum(1 for f in hits
                if f.path == "fixtures/bad_convention.py" and
                f.symbol == "fleet.migrate.demo") == 2, hits
+    assert sum(1 for f in hits
+               if f.path == "fixtures/bad_convention.py" and
+               f.symbol == "fabric.relay.demo") == 2, hits
     assert not any(f.path == "fixtures/good_convention.py"
                    for f in hits), hits
     assert not any(f.symbol == "orphan.span" or

@@ -18,6 +18,8 @@ from hcache_deepspeed_tpu.fabric.frame import _PREAMBLE, MAGIC
 
 GOLDEN = os.path.join(os.path.dirname(__file__),
                       "golden_frame_v1.bin")
+GOLDEN_TELEMETRY = os.path.join(os.path.dirname(__file__),
+                                "golden_telemetry_v1.bin")
 
 
 def golden_frame_bytes() -> bytes:
@@ -40,6 +42,39 @@ def golden_frame_bytes() -> bytes:
         q8={"latents_q8": rng.standard_normal(
                 (2, 11, 4)).astype(np.float32)},
         q8_group=16)
+
+
+def golden_telemetry_bytes() -> bytes:
+    """A representative ``telemetry_ok`` harvest reply — the new
+    header-only frame kind the supervision channel speaks. Everything
+    rides in the JSON header (no array segments), so the golden file
+    pins the exact canonical-JSON byte layout a v1 worker replies
+    with."""
+    return encode_frame(
+        "telemetry_ok",
+        header={"replica": 1, "v": 1,
+                "now_us": 1234.5, "t_send_us": 1200.25,
+                "events": [
+                    {"ph": "X", "name": "fabric.migration",
+                     "ts": 10.0, "dur": 2.5, "pid": 0, "tid": 1,
+                     "args": {"replica": 1, "uid": 42}},
+                    {"ph": "i", "name": "fabric.migrate_in",
+                     "ts": 11.0, "pid": 0, "tid": 1,
+                     "args": {"uid": 42, "replica": 1}}],
+                "dropped": 0,
+                "thread_names": {"1": "fabric-worker"},
+                "counters": {"frames": 3, "bytes_in": 4096,
+                             "bytes_out": 2048, "q8_segments": 1,
+                             "decode_seconds": 0.001,
+                             "encode_seconds": 0.002,
+                             "migrations": 1, "forwards": 0,
+                             "peer_connections": 0},
+                "metrics": [{"name": "hds_fabric_worker_frames",
+                             "type": "counter",
+                             "labels": {"replica": "1"},
+                             "value": 3.0}],
+                "rss_max_bytes": 104857600,
+                "future_field_decoders_must_keep": {"x": [1]}})
 
 
 # ------------------------------------------------------------------ #
@@ -197,3 +232,78 @@ def test_golden_frame_decodes_with_pinned_content():
     magic, version, _ = struct.unpack_from("<4sHI", open(
         GOLDEN, "rb").read(), 0)
     assert magic == MAGIC and version == 1
+
+
+# ------------------------------------------------------------------ #
+# telemetry frame kind: the harvest channel's wire format
+# ------------------------------------------------------------------ #
+def test_golden_telemetry_bytes_are_stable():
+    with open(GOLDEN_TELEMETRY, "rb") as fh:
+        committed = fh.read()
+    assert golden_telemetry_bytes() == committed, \
+        "telemetry frame bytes drifted from the committed v1 " \
+        "fixture — bump FRAME_VERSION instead of silently changing " \
+        "the harvest wire format"
+
+
+def test_golden_telemetry_decodes_with_pinned_content():
+    with open(GOLDEN_TELEMETRY, "rb") as fh:
+        f = decode_frame(fh.read())
+    assert f.kind == "telemetry_ok"
+    assert f.arrays == {}                 # header-only frame kind
+    assert f.header["replica"] == 1 and f.header["v"] == 1
+    assert f.header["now_us"] == 1234.5
+    assert len(f.header["events"]) == 2
+    assert f.header["events"][0]["name"] == "fabric.migration"
+    assert f.header["events"][1]["args"]["uid"] == 42
+    assert f.header["counters"]["q8_segments"] == 1
+    assert f.header["thread_names"] == {"1": "fabric-worker"}
+    # version tolerance on the committed bytes: unknown header fields
+    # survive the decode (a v1 parent can harvest a richer worker)
+    assert f.header["future_field_decoders_must_keep"] == {"x": [1]}
+
+
+def test_telemetry_frame_rejects_unknown_version():
+    buf = encode_frame("telemetry", {"t_send_us": 1.0},
+                       version=FRAME_VERSION + 1)
+    with pytest.raises(FrameVersionError):
+        decode_frame(buf)
+
+
+def test_telemetry_frame_seeded_fuzz_round_trip():
+    """Seeded fuzz over harvest-reply shapes: arbitrary JSON-safe
+    headers (random counters, event lists, nested metric rows) must
+    round-trip exactly, and every truncation must raise a typed
+    FrameError — a half-written harvest reply can never decode as a
+    valid one."""
+    rng = np.random.default_rng(20260807)
+    for trial in range(20):
+        n_events = int(rng.integers(0, 6))
+        header = {
+            "replica": int(rng.integers(0, 8)),
+            "v": 1,
+            "now_us": float(np.round(rng.uniform(0, 1e7), 3)),
+            "events": [
+                {"ph": "i", "name": f"fabric.ev{j}",
+                 "ts": float(np.round(rng.uniform(0, 1e6), 3)),
+                 "pid": 0, "tid": int(rng.integers(1, 4)),
+                 "args": {"uid": int(rng.integers(0, 100))}}
+                for j in range(n_events)],
+            "dropped": int(rng.integers(0, 3)),
+            "counters": {f"c{j}": int(rng.integers(0, 1 << 30))
+                         for j in range(int(rng.integers(0, 5)))},
+            "metrics": [{"name": "m", "labels": {"k": "v"},
+                         "value": float(np.round(
+                             rng.uniform(0, 1e9), 6))}],
+            "rss_max_bytes": int(rng.integers(0, 1 << 33)),
+        }
+        buf = encode_frame("telemetry_ok", header)
+        f = decode_frame(buf)
+        assert f.kind == "telemetry_ok"
+        got = {k: v for k, v in f.header.items()
+               if k not in ("_segments", "kind")}
+        assert got == header
+        # truncation at a random interior point must raise, typed
+        cut = int(rng.integers(1, len(buf)))
+        with pytest.raises(FrameError):
+            decode_frame(buf[:cut])

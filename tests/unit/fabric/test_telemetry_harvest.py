@@ -1,0 +1,162 @@
+"""Cross-process telemetry harvest: worker spans/counters crossing
+the framed supervision channel, SIGKILL-resilient last-known caching
+(a dead worker's telemetry survives on the parent-side handle, and a
+harvest against it fails fast instead of hanging), and digest
+invisibility — harvest on/off leaves the fleet's committed event
+digest byte-identical to the in-memory twin's."""
+
+import time
+
+import pytest
+
+from hcache_deepspeed_tpu.fabric import (ProcessTransport,
+                                         canonical_digest)
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.serving import (FleetConfig, RequestState,
+                                          ServerConfig, ServingFleet,
+                                          SimulatedEngine,
+                                          VirtualClock)
+from hcache_deepspeed_tpu.telemetry import validate_prometheus_text
+
+pytestmark = pytest.mark.chaos
+
+
+def sim_engine():
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": 16},
+        hcache={"enable_latents": True}))
+
+
+def make_fleet(transport, n=3):
+    return ServingFleet(
+        engines=[sim_engine() for _ in range(n)],
+        clock=VirtualClock(),
+        config=FleetConfig(
+            server=ServerConfig(max_queue_depth=256,
+                                kv_demand_fraction=float("inf")),
+            transport=transport))
+
+
+def drive(fleet, max_steps=5000):
+    steps = 0
+    while fleet.has_work:
+        fleet.step()
+        steps += 1
+        assert steps < max_steps, fleet.snapshot()
+
+
+def migrated_scenario(fleet):
+    req = fleet.submit(prompt=list(range(10)), max_new_tokens=12)
+    fleet.step()
+    fleet.step()
+    assert req.state is RequestState.DECODE
+    m = fleet.migrate(req.uid, dst=(req.replica + 1) % 3)
+    assert m is not None
+    drive(fleet)
+    return req, m
+
+
+def test_harvest_and_sigkill_resilience():
+    """One spawn amortized over the harvest contract: live harvest
+    carries spans + counters + a clock-offset estimate, the arrow
+    pair (forward_out on the src worker, migrate_in on the dst) lands
+    in real harvested streams, SIGKILL caches last-known telemetry on
+    the parent handle without hanging the control loop, and the fleet
+    surfaces stay wired up."""
+    tr = ProcessTransport(spawn_timeout_s=120, harvest_every=2)
+    fleet = make_fleet(tr)
+    with tr:
+        req, m = migrated_scenario(fleet)
+        assert req.state is RequestState.DONE
+        assert tr.harvest_all() == 3
+        assert tr.harvests >= 3 and tr.harvest_failures == 0
+
+        tel = tr.worker_telemetry
+        assert sorted(tel) == [0, 1, 2]
+        src, dst = int(m.src), int(m.dst)
+        src_names = [e.get("name") for e in tel[src]["events"]]
+        dst_names = [e.get("name") for e in tel[dst]["events"]]
+        # the two-hop crossing is visible in REAL harvested streams:
+        # the src worker marked the relay leaving, the dst worker
+        # marked it landing (plus the span around the processing)
+        assert "fabric.forward_out" in src_names
+        assert "fabric.migrate_in" in dst_names
+        assert "fabric.migration" in dst_names
+        fwd = next(e for e in tel[src]["events"]
+                   if e.get("name") == "fabric.forward_out")
+        assert fwd["args"]["uid"] == req.uid
+        # handshake-estimated clock offset: the workers spawned before
+        # any harvest, so their perf_counter origins trail the
+        # parent's — offset must be positive and finite
+        for rid in (0, 1, 2):
+            assert tel[rid]["clock_offset_us"] > 0
+            assert tel[rid]["counters"]["frames"] >= 1
+            assert tel[rid]["rss_max_bytes"] > 0
+
+        stats = tr.telemetry_stats()
+        assert stats["enabled"] and stats["harvests"] == tr.harvests
+        assert stats["workers"]["0"]["alive"]
+
+        # -- fleet surfaces: metrics_snapshot carries the measured
+        # per-link block + the harvest accounting, and the Prometheus
+        # exposition renders {replica, link}-labeled percentiles
+        # validator-clean
+        snap = fleet.metrics_snapshot()
+        assert snap["worker_telemetry"]["harvests"] >= 3
+        assert snap["measured_link"]["samples"] >= 1
+        assert snap["measured_link"]["links"]
+        text = fleet.prometheus_text()
+        assert validate_prometheus_text(text) == []
+        assert "wire_link_samples_total{" in text
+        assert "wire_latency_seconds_p50{" in text
+        assert 'link="' in text
+
+        # -- SIGKILL: best-effort pre-kill harvest caches last-known
+        # state; a later harvest fails FAST (no hang) and leaves the
+        # cache intact
+        victim = dst
+        before = dict(tr.worker_telemetry[victim])
+        before_events = len(before["events"])
+        tr.kill(victim)
+        t0 = time.perf_counter()
+        assert tr.harvest(victim) is False
+        assert time.perf_counter() - t0 < 5.0
+        cached = tr.worker_telemetry[victim]
+        assert len(cached["events"]) >= before_events
+        assert cached["counters"]["frames"] >= 1
+        # a dead worker never hangs harvest_all either, and failures
+        # are tracked separately from the request-path fallbacks
+        assert tr.harvest_all() == 2
+        assert tr.wire_stats()["local_fallbacks"] == 0
+    # close() is idempotent and ran its shutdown harvest
+    assert tr.harvests > 3
+
+
+def test_harvest_plane_is_digest_invisible():
+    """The whole observability plane must not perturb the serving
+    core: the same scenario with harvest aggressively on, harvest
+    off, and on the in-memory twin produces byte-identical fleet
+    event digests."""
+    digests = {}
+    for label, transport in (
+            ("mem", None),
+            ("harvest-on", ProcessTransport(spawn_timeout_s=120,
+                                            harvest_every=1)),
+            ("harvest-off", ProcessTransport(
+                spawn_timeout_s=120, harvest_telemetry=False))):
+        fleet = make_fleet(transport)
+        if transport is None:
+            req, _ = migrated_scenario(fleet)
+        else:
+            with transport:
+                req, _ = migrated_scenario(fleet)
+            assert (transport.harvests > 0) == \
+                transport.harvest_telemetry
+        assert req.state is RequestState.DONE
+        digests[label] = canonical_digest(fleet.event_log())
+    assert digests["harvest-on"] == digests["harvest-off"] == \
+        digests["mem"], digests

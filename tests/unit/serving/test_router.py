@@ -135,3 +135,39 @@ def test_decide_migration_uncalibrated_defaults_to_migrate():
         {"n_layer": 2, "latent_bytes_per_token": 64,
          "replay_flops_frac": 0.5})
     assert model.decide_migration(32, 0.9, 0.0, 1e9) == "migrate"
+
+
+def test_observe_wire_extrema_and_per_link_sketches():
+    """Measured-wire calibration: running mean rides beside count +
+    min/max extrema, and link-tagged samples feed per-link quantile
+    sketches keyed "src->dst" (src -1 = a parent-direct crossing)."""
+    router = FleetRouter(RouterConfig(), link_bytes_per_s=1e6)
+    assert router.measured_link() == {}
+    assert "measured_link" not in router.summary()
+    # zero/negative samples are dropped before any state mutates
+    router.observe_wire(0, 1.0, link=(0, 1))
+    router.observe_wire(100, 0.0)
+    assert router.measured_link() == {}
+
+    router.observe_wire(1000, 0.001, link=(0, 1))    # 1e6 B/s
+    router.observe_wire(4000, 0.004, link=(0, 1))    # 1e6 B/s
+    router.observe_wire(2000, 0.0005, link=(-1, 2))  # 4e6 B/s
+    ml = router.measured_link()
+    assert ml["samples"] == 3 and ml["bytes"] == 7000
+    assert ml["min_bytes_per_s"] == 1e6
+    assert ml["max_bytes_per_s"] == 4e6
+    assert ml["min_seconds"] == 0.0005
+    assert ml["max_seconds"] == 0.004
+    assert ml["priced_bytes_per_s"] == 1e6
+    links = ml["links"]
+    assert sorted(links) == ["-1->2", "0->1"]
+    assert links["0->1"]["latency_s"]["count"] == 2
+    assert links["0->1"]["bytes_per_s"]["p50"] == 1e6
+    assert links["-1->2"]["latency_s"]["p99"] == 0.0005
+    # the block is surfaced (conditionally) through summary()
+    assert router.summary()["measured_link"] == ml
+    # an un-linked sample still counts globally, no sketch entry
+    router.observe_wire(500, 0.001)
+    ml2 = router.measured_link()
+    assert ml2["samples"] == 4
+    assert sorted(ml2["links"]) == ["-1->2", "0->1"]

@@ -108,3 +108,31 @@ def test_server_crash_path_dumps_bundle():
     assert bundle["snapshot"]["pools"]["queue"] == 0
     assert not server.healthy
     rec.clear()
+
+
+def test_attachments_ride_outside_the_digest():
+    """Harvested worker telemetry attaches to a worker_kill bundle as
+    wall-clock context: two runs with different attachments (and one
+    with none) keep byte-identical digests, and the attachment block
+    survives on the bundle for humans."""
+    snap = {"kind": "fabric", "seed": 0, "victim": 1}
+    a1 = FlightRecorder().dump(
+        "worker_kill", "SIGKILL replica 1", source="chaos:fabric",
+        step=12, t=3.5, snapshot=snap,
+        spans=[{"ph": "i", "ts": 1}],
+        attachments={"counters": {"frames": 9}, "harvests": 2,
+                     "rss_max_bytes": 1 << 27})
+    a2 = FlightRecorder().dump(
+        "worker_kill", "SIGKILL replica 1", source="chaos:fabric",
+        step=12, t=3.5, snapshot=dict(snap),
+        attachments={"counters": {"frames": 777}, "harvests": 5})
+    a3 = FlightRecorder().dump(
+        "worker_kill", "SIGKILL replica 1", source="chaos:fabric",
+        step=12, t=3.5, snapshot=dict(snap))
+    assert a1["digest"] == a2["digest"] == a3["digest"]
+    assert a1["attachments"]["counters"]["frames"] == 9
+    assert "attachments" not in a3          # empty block stays absent
+    # recomputing the digest over the stored bundle (attachments and
+    # all) still lands on the committed value — the exclusion set is
+    # part of the format
+    assert FlightRecorder.bundle_digest(a1) == a1["digest"]
