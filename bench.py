@@ -1039,6 +1039,142 @@ def run_zero_overlap(out_path=None):
         "shape_ok": wire_cal_shape_ok,
     })
 
+    # ---- fused computation-collective kernels (ISSUE 18 tentpole):
+    # zero_collective_impl=fused rides the hierarchical transport
+    # twins for bucket payloads and consumes qwZ matmul leaves
+    # MID-GATHER (ops/fused_collective_matmul.py — on CPU the bitwise
+    # reference twin; the streamed/Pallas schedules carry the audit
+    # and wall-clock evidence). Gates: engine bitwise vs native on the
+    # plain AND quantized wire, the auditor's in-kernel tier scoring
+    # >= 1 subsumed permute+dot pair where the unfused program scores
+    # 0, fused <= unfused wall clock at the largest rig payload, 3-D
+    # mesh bookkeeping at the 16x16 pod factoring, and the 16-device
+    # fused parity legs.
+    FUSED = {"zero_collective_impl": "fused", "zero_mesh_shape": [2, 4],
+             "zero_mesh_axis_roles": ["data", "data"]}
+
+    # (a) plain wire: fused transports are the hierarchical twins —
+    # bitwise vs the native AND hierarchical engines
+    f_row, f_losses, f_params = hier_run("zero3-audit-fused", **FUSED)
+    f_fused_bytes = comms.fused_bytes_summary()
+    f_row["fused_permute_bytes"] = f_fused_bytes
+    fused_parity_plain = (f_losses == losses[True] and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(f_params, params[True])))
+    fused_bitwise_hier = (f_losses == h_losses and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(f_params, h_params)))
+
+    # (b) quantized wire + mid-gather consumption: qwZ mm-leaves ship
+    # as raw (int8, scales) shard pairs and fold through the fused
+    # gather-matmul at the Dense; the cotangent bucket folds through
+    # the fused quant-EF + qrs-exchange epilogue — still bitwise vs
+    # the native quantized-wire engine
+    fq_row, fq_losses, fq_params = hier_run(
+        "zero3-audit-fused-qwire",
+        zero_quantized_reduce_scatter=True,
+        zero_reduce_scatter_error_feedback=True,
+        zero_quantized_weights_fused_matmul=True, **FUSED)
+    fq_fused_bytes = comms.fused_bytes_summary()
+    fq_row["fused_permute_bytes"] = fq_fused_bytes
+    fused_parity_qwire = (fq_losses == q_losses[True] and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(fq_params, q_params[True])))
+    fused_mid_gather_leaves = fq_row.get("mid_gather_leaves", 0)
+    rows.append({
+        "phase": "fused-parity", "steps": 3,
+        "bitwise_vs_native": fused_parity_plain,
+        "bitwise_vs_hierarchical": fused_bitwise_hier,
+        "qwire_bitwise_vs_native_qwire": fused_parity_qwire,
+        "mid_gather_leaves": fused_mid_gather_leaves,
+        "losses": f_losses,
+        "fused_permute_bytes_qwire": fq_fused_bytes,
+    })
+
+    # (c) in-kernel audit tier: the STREAMED fused schedule (per ring
+    # step, the next chunk's permute beside the resident chunk's
+    # dequant-dot) compiled next to the unfused gather-then-matmul —
+    # the fused module must score scoped subsumed pairs, the unfused
+    # module must score zero
+    from hcache_deepspeed_tpu.ops.fused_collective_matmul import (
+        streamed_fused_gather_matmul)
+    from hcache_deepspeed_tpu.ops.quantized_matmul import (
+        quantize_for_matmul, quantized_matmul)
+    fa_mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("d",))
+    fwq, fws = quantize_for_matmul(
+        jnp.asarray(rng.normal(size=(128, 64)), jnp.float32), 8)
+    fx = jnp.asarray(rng.normal(size=(16, 128)), jnp.float32)
+
+    def fgm_stream(xl, ql, sl):
+        return streamed_fused_gather_matmul(xl, ql, sl, group_k=8,
+                                            shard_dim=0, axis_name="d")
+
+    def fgm_unfused(xl, ql, sl):
+        qa = jax.lax.all_gather(ql, "d")
+        sa = jax.lax.all_gather(sl, "d")
+        return quantized_matmul(xl, qa.reshape(-1, 64),
+                                sa.reshape(-1, 64), group_k=8)
+
+    def _fused_audit(f):
+        return audit_compiled(jax.jit(jax.shard_map(
+            f, mesh=fa_mesh, in_specs=(P(), P("d"), P("d")),
+            out_specs=P(), check_vma=False)).lower(fx, fwq,
+                                                   fws).compile())
+
+    aud_fused = _fused_audit(fgm_stream)
+    aud_unfused = _fused_audit(fgm_unfused)
+    fused_subsumed = aud_fused.fused_kernel["subsumed_pairs"]
+    unfused_subsumed = aud_unfused.fused_kernel["subsumed_pairs"]
+    fused_audit_gate = bool(fused_subsumed >= 1
+                            and unfused_subsumed == 0)
+    farow = aud_fused.to_row()
+    farow.update({
+        "phase": "fused-audit", "variant": "streamed",
+        "fused_kernel": dict(aud_fused.fused_kernel),
+        "unfused_subsumed_pairs": unfused_subsumed,
+        "unfused_fused_wire_bytes":
+            aud_unfused.fused_kernel["wire_bytes"],
+        "audit_gate": fused_audit_gate,
+    })
+    rows.append(farow)
+
+    # (d) wall-clock rig: streamed fused vs the native unfused
+    # pipeline per payload (best-of-trials), with the qmm/fused
+    # fallback counters snapshot riding in the row — on CPU the
+    # counters record the deliberate reference dispatch
+    from hcache_deepspeed_tpu.comm.benchmark import fused_vs_unfused_bench
+    fb = fused_vs_unfused_bench(mesh=fa_mesh, axis="d", trials=3)
+    fb_largest = max(fb["rows"], key=lambda r: r["k"] * r["n"])
+    fused_wallclock_speedup = fb_largest["speedup"]
+    fused_le_unfused_largest = fb["fused_le_unfused_largest"]
+    rows.append(dict(fb, phase="fused-bench",
+                     largest_payload=fb_largest))
+
+    # (e) 3-D mesh composition: declared non-ZeRO axis roles — the
+    # fused ring rides the data sub-box of a (data, model, pipe)
+    # factoring; host-side bookkeeping gates at the 16x16 pod
+    # factoring and a composed 3-D spec (rank/coord round-trips,
+    # axis-group partitions, role sub-factoring)
+    from hcache_deepspeed_tpu.comm.hierarchical import (
+        mesh_bookkeeping_report)
+    book_16x16 = mesh_bookkeeping_report(make_mesh_spec([16, 16]))
+    book_3d = mesh_bookkeeping_report(make_mesh_spec(
+        [4, 2, 2], ["data0", "model", "pipe"],
+        axis_roles=["data", "model", "pipe"]))
+    mesh3d_bookkeeping_ok = bool(book_16x16["ok"] and book_3d["ok"])
+    fused_16dev = facts16.get("fused_bitwise", {}) \
+        if isinstance(facts16, dict) else {}
+    fused_16dev_parity = bool(fused_16dev.get("gather_matmul")
+                              and fused_16dev.get("qrs_exchange"))
+    rows.append({
+        "phase": "fused-mesh3d",
+        "bookkeeping_16x16": book_16x16,
+        "bookkeeping_3d": book_3d,
+        "bookkeeping_ok": mesh3d_bookkeeping_ok,
+        "fused_16dev_bitwise": fused_16dev,
+        "fused_16dev_parity": fused_16dev_parity,
+    })
+
     # ---- Domino half-batch all-reduce, through the async-issue helper
     from hcache_deepspeed_tpu.runtime.domino import domino_split_async
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("tensor",))
@@ -1210,6 +1346,19 @@ def run_zero_overlap(out_path=None):
         "hier_pipelined_cross_axis_pairs": prim_cross[2]["pairs"],
         "hier_unpipelined_cross_axis_pairs": prim_cross[1]["pairs"],
         "hier_16dev_parity": hier_16dev_parity,
+        # ISSUE 18: fused computation-collective kernels + 3-D mesh
+        "fused_parity_plain": fused_parity_plain,
+        "fused_parity_qwire": fused_parity_qwire,
+        "fused_bitwise_vs_hier": fused_bitwise_hier,
+        "fused_mid_gather_leaves": fused_mid_gather_leaves,
+        "fused_subsumed_pairs": fused_subsumed,
+        "unfused_subsumed_pairs": unfused_subsumed,
+        "fused_audit_gate": fused_audit_gate,
+        "fused_wallclock_speedup": fused_wallclock_speedup,
+        "fused_le_unfused_largest": fused_le_unfused_largest,
+        "mesh3d_bookkeeping_ok": mesh3d_bookkeeping_ok,
+        "fused_16dev_parity": fused_16dev_parity,
+        "fused_fallbacks": fb["fused_fallbacks"],
         "wire_cal_shape_ok": wire_cal_shape_ok,
         "wire_cal_gbps_inter": cal["gbytes_per_s"].get("inter"),
         "wire_cal_gbps_intra": cal["gbytes_per_s"].get("intra"),
@@ -1275,7 +1424,16 @@ def run_zero_overlap(out_path=None):
           and hpz_unified_bitwise and hpz_secondary_on_mesh
           and pipelined_bitwise and pipelined_structural >= structural
           and pipelined_cross_ok
-          and hier_16dev_parity and wire_cal_shape_ok)
+          and hier_16dev_parity and wire_cal_shape_ok
+          # ISSUE 18 gates: fused engine bitwise on plain + quantized
+          # wire with mid-gather leaves actually routed, the in-kernel
+          # audit differential (fused >= 1 subsumed pair, unfused 0),
+          # fused <= unfused at the largest rig payload, 3-D mesh
+          # bookkeeping, and the 16-dev fused parity legs
+          and fused_parity_plain and fused_parity_qwire
+          and fused_mid_gather_leaves >= 1
+          and fused_audit_gate and fused_le_unfused_largest
+          and mesh3d_bookkeeping_ok and fused_16dev_parity)
     return 0 if ok else 4
 
 

@@ -93,6 +93,19 @@ print("pod-scale legs: unified hpZ bitwise =",
       s.get("hier_pipelined_structural_ratio"),
       s.get("hier_pipelined_cross_axis_pairs"),
       "| 16-dev parity =", s.get("hier_16dev_parity"))
+print("fused-kernel verdict (the remote-DMA Pallas form only exists",
+      "on chip — this block is the ISSUE 18 chip truth):",
+      "bitwise plain/qwire =", s.get("fused_parity_plain"),
+      s.get("fused_parity_qwire"),
+      "| mid-gather leaves =", s.get("fused_mid_gather_leaves"),
+      "| in-kernel subsumed pairs fused/unfused =",
+      s.get("fused_subsumed_pairs"), s.get("unfused_subsumed_pairs"))
+print("  wall-clock: speedup at largest payload =",
+      s.get("fused_wallclock_speedup"),
+      "| fused <= unfused =", s.get("fused_le_unfused_largest"),
+      "| fallbacks =", s.get("fused_fallbacks"),
+      "| 3-D mesh gates =", s.get("mesh3d_bookkeeping_ok"),
+      "| 16-dev fused parity =", s.get("fused_16dev_parity"))
 EOF
   echo "next: commit ZERO_OVERLAP_TPU.jsonl, refresh PERF_TRAJECTORY" \
        "(python -m hcache_deepspeed_tpu.perf index --out" \

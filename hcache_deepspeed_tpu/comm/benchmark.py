@@ -171,9 +171,109 @@ def calibrate_mesh_axes(spec, *, mesh=None, axis="data",
             "backend": jax.default_backend()}
 
 
+def fused_vs_unfused_bench(payloads=((512, 256), (1024, 512),
+                                     (2048, 1024)),
+                           *, batch=64, trials=5, mesh=None,
+                           axis="data", group_k=None, seed=0):
+    """Wall-clock verdict leg for the fused gather-matmul (ISSUE 18):
+    time the STREAMED fused schedule (``ops/fused_collective_matmul.
+    streamed_fused_gather_matmul`` — per ring step, chunk ``r+1`` on
+    the wire beside chunk ``r``'s dequant-dot) against the UNFUSED
+    pipeline (native ``all_gather`` of the int8+scales shards, then one
+    ``quantized_matmul``) per ``(K, N)`` payload, jit(shard_map),
+    best-of-``trials`` with a sync per iteration. The unfused baseline
+    deliberately rides the NATIVE gather — the strongest opponent, not
+    the ring twin — so ``fused_le_unfused_largest`` is a real verdict.
+
+    Returns ``{"rows": [{k, n, batch, group_k, fused_ms, unfused_ms,
+    speedup, maxdiff}], "fused_le_unfused_largest", "qmm_fallbacks",
+    "fused_fallbacks", "backend", "devices"}``. ``maxdiff`` is the
+    fused-vs-unfused output divergence (chunked-K sum: value-equal,
+    not bitwise — the bitwise contract belongs to the reference twin,
+    gated elsewhere). The two fallback dicts snapshot
+    ``ops.quantized_matmul.fallback_debug_info()`` and
+    ``ops.fused_collective_matmul.fused_fallback_debug_info()`` AFTER
+    the runs — on CPU they record the deliberate reference dispatch,
+    on chip an unexpectedly non-empty fused dict means the Pallas
+    kernel bailed and the row is timing the fallback."""
+    from functools import partial
+
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.fused_collective_matmul import (
+        fused_fallback_debug_info, streamed_fused_gather_matmul)
+    from ..ops.quantized_matmul import (
+        fallback_debug_info, quantize_for_matmul, quantized_matmul)
+
+    if mesh is None:
+        devs = jax.devices()
+        mesh = Mesh(np.array(devs).reshape(len(devs)), (axis,))
+    n = int(mesh.devices.size)
+    rng = np.random.default_rng(seed)
+    rows = []
+    for K, N in payloads:
+        if K % n:
+            raise ValueError(
+                f"fused_vs_unfused_bench: K={K} not divisible by the "
+                f"{n}-device gather axis")
+        k_sh = K // n
+        gk = group_k or max(1, k_sh // 2)
+        if k_sh % gk:
+            raise ValueError(
+                f"fused_vs_unfused_bench: group_k={gk} must divide the "
+                f"per-device K shard {k_sh}")
+        w = rng.standard_normal((K, N)).astype(np.float32)
+        q, s = quantize_for_matmul(jnp.asarray(w), gk)
+        x = jnp.asarray(rng.standard_normal((batch, K)), jnp.float32)
+
+        def fused(xl, ql, sl, gk=gk):
+            return streamed_fused_gather_matmul(
+                xl, ql, sl, group_k=gk, shard_dim=0, axis_name=axis)
+
+        def unfused(xl, ql, sl, gk=gk):
+            qa = jax.lax.all_gather(ql, axis)
+            sa = jax.lax.all_gather(sl, axis)
+            return quantized_matmul(xl, qa.reshape(-1, qa.shape[-1]),
+                                    sa.reshape(-1, sa.shape[-1]),
+                                    group_k=gk)
+
+        def timed(f):
+            fn = jax.jit(partial(
+                jax.shard_map, mesh=mesh, axis_names={axis},
+                in_specs=(P(), P(axis), P(axis)), out_specs=P(),
+                check_vma=False)(f))
+            y = fn(x, q, s)
+            jax.block_until_ready(y)               # compile
+            best = float("inf")
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x, q, s))
+                best = min(best, time.perf_counter() - t0)
+            return best, np.asarray(y)
+
+        tf, yf = timed(fused)
+        tu, yu = timed(unfused)
+        rows.append({
+            "k": K, "n": N, "batch": batch, "group_k": gk,
+            "devices": n, "trials": trials,
+            "fused_ms": tf * 1e3, "unfused_ms": tu * 1e3,
+            "speedup": tu / tf if tf else None,
+            "maxdiff": float(np.max(np.abs(yf - yu))),
+        })
+    largest = max(rows, key=lambda r: r["k"] * r["n"])
+    return {"rows": rows,
+            "fused_le_unfused_largest":
+                bool(largest["fused_ms"] <= largest["unfused_ms"]),
+            "qmm_fallbacks": fallback_debug_info(),
+            "fused_fallbacks": fused_fallback_debug_info(),
+            "backend": jax.default_backend(), "devices": n}
+
+
 #: child program for the 16-device factoring parity leg: 4x4 and 2x8
 #: hierarchical collectives bitwise vs native (fp32 + bf16), the
-#: unified hpZ tier at hpz=4 on 4x4, and pipelined-gather parity —
+#: unified hpZ tier at hpz=4 on 4x4, pipelined-gather parity, and the
+#: fused gather-matmul / qrs-exchange twins bitwise at 16 devices —
 #: run in its own interpreter because the parent harness pins the CPU
 #: device count at 8. Shared by ``bench.py --zero-overlap``'s
 #: hier-16dev phase and tests/unit/comm/test_hier_16dev.py, so the
@@ -267,6 +367,57 @@ a = np.asarray(shm(tier, (P("d"),), P("d"))(x))
 b = np.asarray(shm(native_grouped, (P("d"),), P("d"))(x))
 facts["hpz_tier_bitwise"] = bool(np.array_equal(a, b))
 facts["parity"] = facts["parity"] and facts["hpz_tier_bitwise"]
+
+# fused computation-collective parity at 16 devices (ISSUE 18): the
+# reference gather-matmul twin vs the unfused native pipeline, and the
+# fused reduce-scatter epilogue exchange vs the native all_to_all —
+# both must be BITWISE at the 16-way factoring too
+from hcache_deepspeed_tpu.ops.fused_collective_matmul import (
+    fused_qrs_exchange, reference_fused_gather_matmul)
+from hcache_deepspeed_tpu.ops.quantized_matmul import (
+    quantize_for_matmul, quantized_matmul)
+
+wq, ws = quantize_for_matmul(
+    jnp.asarray(rng.normal(size=(64, 16)), jnp.float32), 4)
+xb = jnp.asarray(rng.normal(size=(8, 64)), jnp.float32)
+
+
+def fgm(ql, sl):
+    return reference_fused_gather_matmul(
+        xb, ql, sl, group_k=4, shard_dim=0, axis_name="d")
+
+
+def ugm(ql, sl):
+    qa = jax.lax.all_gather(ql, "d")
+    sa = jax.lax.all_gather(sl, "d")
+    return quantized_matmul(xb, qa.reshape(-1, 16),
+                            sa.reshape(-1, 16), group_k=4)
+
+
+a = np.asarray(shm(fgm, (P("d"), P("d")), P())(wq, ws))
+b = np.asarray(shm(ugm, (P("d"), P("d")), P())(wq, ws))
+gm_ok = bool(np.array_equal(a, b))
+
+pay = jnp.asarray(rng.integers(-127, 128, size=(16, 16, 6)), jnp.int8)
+sc = jnp.asarray(rng.normal(size=(16, 16, 2)), jnp.float32)
+
+
+def fqrs(p, s):
+    a, b = fused_qrs_exchange(p[0], s[0], axis_name="d")
+    return a[None], b[None]
+
+
+def nqrs(p, s):
+    return (jax.lax.all_to_all(p[0], "d", 0, 0)[None],
+            jax.lax.all_to_all(s[0], "d", 0, 0)[None])
+
+
+fa = shm(fqrs, (P("d"), P("d")), (P("d"), P("d")))(pay, sc)
+na = shm(nqrs, (P("d"), P("d")), (P("d"), P("d")))(pay, sc)
+qrs_ok = bool(all(np.array_equal(np.asarray(u), np.asarray(v))
+                  for u, v in zip(fa, na)))
+facts["fused_bitwise"] = {"gather_matmul": gm_ok, "qrs_exchange": qrs_ok}
+facts["parity"] = facts["parity"] and gm_ok and qrs_ok
 print(json.dumps(facts))
 """
 

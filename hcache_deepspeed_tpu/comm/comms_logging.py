@@ -123,15 +123,33 @@ class CommsLogger:
             }
         return out
 
-    def permute_bytes_summary(self):
+    def permute_bytes_summary(self, kinds=("collective_permute",)):
         """Total bytes per op carried by decomposed ring permutes
         (``op_kind == "collective_permute"``): ``{op: total_bytes}``.
         The matched-pair complement of :meth:`wire_savings_summary` for
         the ring transport — proves ring-chunk traffic is attributed.
-        Per-mesh-axis breakdown: :meth:`permute_axis_bytes`."""
+        Per-mesh-axis breakdown: :meth:`permute_axis_bytes`. ``kinds``
+        widens the filter (e.g. ``("collective_permute",
+        "fused_permute")`` for the lumped summary a fused run must
+        reconcile against byte-exactly)."""
         out = {}
         for op, by_axis in self.axis_summary().items():
-            if self.op_kinds.get(op) == "collective_permute":
+            if self.op_kinds.get(op) in kinds:
+                out[op] = sum(t for _, t in by_axis.values())
+        return out
+
+    def fused_bytes_summary(self):
+        """Total bytes per op carried INSIDE fused
+        computation-collective kernels (``op_kind == "fused_permute"``,
+        logged per in-kernel ring step by
+        ``ops/fused_collective_matmul.py``): ``{op: total_bytes}``.
+        The fused kernel's wire volume is never silent: these rows
+        reconcile byte-exactly with what the unfused transport of the
+        same payload logs as ``collective_permute`` rows (gated by
+        test_wire_bytes.py)."""
+        out = {}
+        for op, by_axis in self.axis_summary().items():
+            if self.op_kinds.get(op) == "fused_permute":
                 out[op] = sum(t for _, t in by_axis.values())
         return out
 
@@ -149,7 +167,8 @@ class CommsLogger:
         through :meth:`wire_savings_summary`."""
         out = {}
         for op, by_axis in self.axis_summary().items():
-            if self.op_kinds.get(op) != "collective_permute":
+            if self.op_kinds.get(op) not in ("collective_permute",
+                                             "fused_permute"):
                 continue
             per_axis = {}
             for axes, (_, total) in by_axis.items():
@@ -158,7 +177,8 @@ class CommsLogger:
             out[op] = per_axis
         return out
 
-    def total_axis_bytes(self, kinds=("collective_permute",)):
+    def total_axis_bytes(self, kinds=("collective_permute",
+                                      "fused_permute")):
         """Aggregate ``{axis_label: bytes}`` over every op of the given
         kinds — the direct input to ``hlo_audit.wire_cost_seconds``.
         ``_unquantized_equiv`` shadow rows and ``_longhaul``

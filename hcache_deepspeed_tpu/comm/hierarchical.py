@@ -84,14 +84,26 @@ LONGHAUL_WIRE_BITS = (4, 8)
 #: default axis names for a 2-D spec: outer = long haul, inner = fast
 DEFAULT_2D_AXIS_NAMES = ("inter", "intra")
 
+#: legal axis roles for a composed factoring: ``data`` axes carry the
+#: ZeRO collectives (shards, gathers, the fused kernel's ring);
+#: ``model``/``pipe``/``expert`` axes are declared-but-orthogonal
+#: parallelism dims the ZeRO transport must NOT ride (the (data,
+#: model, pipe) 3-D factoring of the v5e-256 target).
+MESH_AXIS_ROLES = ("data", "model", "pipe", "expert")
+
 
 @dataclass(frozen=True)
 class MeshAxis:
-    """One mesh axis: name, size, and (for the wire-cost model) the
-    per-device link bandwidth bytes ride on this axis."""
+    """One mesh axis: name, size, (for the wire-cost model) the
+    per-device link bandwidth bytes ride on this axis, and its
+    parallelism ``role`` (``MESH_AXIS_ROLES``; non-``data`` roles make
+    the spec a composed multi-parallelism factoring whose ZeRO
+    collectives ride only the data axes — :meth:`HierMeshSpec.
+    zero_subspec`)."""
     name: str
     size: int
     gbytes_per_s: Optional[float] = None
+    role: str = "data"
 
 
 @dataclass(frozen=True)
@@ -123,6 +135,36 @@ class HierMeshSpec:
     def longhaul_dim(self) -> int:
         return self.names.index(self.longhaul)
 
+    @property
+    def roles(self) -> Tuple[str, ...]:
+        return tuple(ax.role for ax in self.axes)
+
+    @property
+    def data_dims(self) -> Tuple[int, ...]:
+        """Axis indices whose role is ``data`` — the dims the ZeRO
+        collectives (and the fused kernel's ring) ride."""
+        return tuple(j for j, ax in enumerate(self.axes)
+                     if ax.role == "data")
+
+    @property
+    def zero_world(self) -> int:
+        """Product of the data-role axis sizes: the ZeRO shard count a
+        composed factoring yields (== ``world`` for all-data specs)."""
+        return int(np.prod([self.axes[j].size for j in self.data_dims]))
+
+    def zero_subspec(self) -> "HierMeshSpec":
+        """The spec restricted to its data-role axes — what every
+        hierarchical transport actually rides. Identity for all-data
+        specs (every pre-roles spec). When the declared long-haul axis
+        is a non-data axis, the subspec's long haul falls to its
+        outermost data axis (the slowest link the ZeRO wire touches)."""
+        if all(ax.role == "data" for ax in self.axes):
+            return self
+        axes = tuple(self.axes[j] for j in self.data_dims)
+        longhaul = self.longhaul if any(
+            ax.name == self.longhaul for ax in axes) else axes[0].name
+        return HierMeshSpec(axes=axes, longhaul=longhaul)
+
     def bandwidths(self) -> Dict[str, Optional[float]]:
         return {ax.name: ax.gbytes_per_s for ax in self.axes}
 
@@ -131,6 +173,8 @@ class HierMeshSpec:
         return {
             "shape": list(self.sizes), "axis_names": list(self.names),
             "longhaul_axis": self.longhaul,
+            "axis_roles": list(self.roles),
+            "zero_world": self.zero_world,
             "link_gbytes_per_s": {
                 ax.name: ax.gbytes_per_s for ax in self.axes},
         }
@@ -139,7 +183,9 @@ class HierMeshSpec:
 def make_mesh_spec(shape: Sequence[int],
                    axis_names: Optional[Sequence[str]] = None,
                    link_gbytes_per_s: Optional[Sequence[float]] = None,
-                   longhaul_axis: Optional[str] = None) -> HierMeshSpec:
+                   longhaul_axis: Optional[str] = None,
+                   axis_roles: Optional[Sequence[str]] = None
+                   ) -> HierMeshSpec:
     """Build and validate a :class:`HierMeshSpec` from config values —
     typed ``HDSConfigError`` rejections for every degenerate shape, no
     silent clamps (the PR 5 convention)."""
@@ -178,10 +224,28 @@ def make_mesh_spec(shape: Sequence[int],
         raise HDSConfigError(
             f"zero_longhaul_axis={longhaul_axis!r} names an unknown "
             f"mesh axis; declared axes are {axis_names}")
+    if axis_roles is None:
+        axis_roles = ["data"] * len(shape)
+    axis_roles = [str(r) for r in axis_roles]
+    if len(axis_roles) != len(shape):
+        raise HDSConfigError(
+            f"zero_mesh_axis_roles={axis_roles} must give one role per "
+            f"mesh axis ({len(shape)})")
+    for r in axis_roles:
+        if r not in MESH_AXIS_ROLES:
+            raise HDSConfigError(
+                f"zero_mesh_axis_roles={axis_roles}: unknown role "
+                f"{r!r}; legal roles are {MESH_AXIS_ROLES}")
+    if "data" not in axis_roles:
+        raise HDSConfigError(
+            f"zero_mesh_axis_roles={axis_roles}: a composed factoring "
+            f"needs at least one data-role axis — the ZeRO collectives "
+            f"(and the fused kernel's ring) have no axis to ride")
     axes = tuple(
         MeshAxis(name=axis_names[j], size=shape[j],
                  gbytes_per_s=(float(link_gbytes_per_s[j])
-                               if link_gbytes_per_s is not None else None))
+                               if link_gbytes_per_s is not None else None),
+                 role=axis_roles[j])
         for j in range(len(shape)))
     return HierMeshSpec(axes=axes, longhaul=longhaul_axis)
 
@@ -190,12 +254,14 @@ def mesh_spec_from_zero_config(zcfg) -> Optional[HierMeshSpec]:
     """The spec a ``ZeroConfig`` declares, or ``None`` when the
     transport is not hierarchical (parse-time validation already ran;
     this is the engine-build constructor)."""
-    if getattr(zcfg, "zero_collective_impl", "native") != "hierarchical":
+    if getattr(zcfg, "zero_collective_impl", "native") not in (
+            "hierarchical", "fused"):
         return None
     return make_mesh_spec(zcfg.zero_mesh_shape,
                           zcfg.zero_mesh_axis_names,
                           zcfg.zero_mesh_link_gbps,
-                          zcfg.zero_longhaul_axis)
+                          zcfg.zero_longhaul_axis,
+                          getattr(zcfg, "zero_mesh_axis_roles", None))
 
 
 def validate_mesh_spec(spec: HierMeshSpec, *, world_size: int,
@@ -204,12 +270,16 @@ def validate_mesh_spec(spec: HierMeshSpec, *, world_size: int,
     known): the mesh must exactly factor the flat axis, and the
     long-haul wire width must be one the packing supports."""
     from ..runtime.config import HDSConfigError
-    if spec.world != world_size:
+    if spec.zero_world != world_size:
+        detail = "" if spec.zero_world == spec.world else (
+            f" (the spec's data-role axes "
+            f"{[spec.names[j] for j in spec.data_dims]} of the "
+            f"{spec.world}-device composed factoring)")
         raise HDSConfigError(
             f"zero_mesh_shape={list(spec.sizes)} describes "
-            f"{spec.world} devices but the data world size is "
-            f"{world_size}; the mesh shape must factor the axis "
-            f"exactly")
+            f"{spec.zero_world} ZeRO shards{detail} but the data "
+            f"world size is {world_size}; the mesh shape must factor "
+            f"the axis exactly")
     if longhaul_bits is not None and longhaul_bits not in \
             LONGHAUL_WIRE_BITS:
         raise HDSConfigError(
@@ -258,6 +328,9 @@ def hpz_tier_dims(spec: HierMeshSpec, hpz: int) -> List[Tuple[int, int]]:
     hpz = int(hpz)
     if hpz <= 1:
         return []
+    # composed factorings: hpZ tiers over the data-role sub-box only
+    # (identity for all-data specs); returned dims index the subspec
+    spec = spec.zero_subspec()
     sizes = spec.sizes
     covered: List[Tuple[int, int]] = []
     remaining = hpz
@@ -455,6 +528,9 @@ def hierarchical_all_gather(x, axis_name, spec: HierMeshSpec, *,
     values, which is what keeps forward and backward re-gathers at the
     same linearization point). Matched byte pairs are logged under
     ``<op_name>_longhaul``."""
+    # composed (data, model, pipe, ...) factorings: the ZeRO gather
+    # rides only the data-role axes (identity for all-data specs)
+    spec = spec.zero_subspec()
     phases = _gather_phases(spec, hpz)
     n_g = 1
     for _, _, span in phases:
@@ -514,6 +590,7 @@ def hierarchical_all_to_all_rows(rows, axis_name, spec: HierMeshSpec, *,
     phase chain — chunk k's long-haul delivery is structurally
     independent of chunk k+1's intra delivery. Pure data movement:
     bitwise-equal to the unpipelined form."""
+    spec = spec.zero_subspec()
     sizes = spec.sizes
     n = int(np.prod(sizes))
     if rows.shape[0] != n:
@@ -569,6 +646,7 @@ def hierarchical_reduce_scatter_sum(x, axis_name, spec: HierMeshSpec, *,
     rows never quantize. Returns ``(out, new_residual)`` when
     ``longhaul_bits`` is set, else ``out`` (the flat-ring
     signature)."""
+    spec = spec.zero_subspec()
     sizes = spec.sizes
     n = int(np.prod(sizes))
     if x.shape[0] % n:
@@ -689,6 +767,7 @@ def hierarchical_all_reduce_sum(x, axis_name, spec: HierMeshSpec, *,
     fold all ``n`` raw contributions at the destination in source-index
     order). Arbitrary shapes: flattened and zero-padded to a multiple
     of the mesh world size."""
+    spec = spec.zero_subspec()
     n = spec.world
     shape, size = x.shape, x.size
     pad = (-size) % n
@@ -700,3 +779,63 @@ def hierarchical_all_reduce_sum(x, axis_name, spec: HierMeshSpec, *,
         mine, axis_name, spec, chunks=chunks,
         pipeline_chunks=pipeline_chunks, op_name=op_name)
     return full.reshape(-1)[:size].reshape(shape)
+
+
+def mesh_bookkeeping_report(spec: HierMeshSpec) -> Dict:
+    """Host-side (pure numpy, no devices) consistency gate for a
+    declared — possibly composed — factoring: the spec-level 16x16
+    bookkeeping evidence the fused-kernel bench phase commits. Checks,
+    for EVERY rank of the declared world:
+
+    * mixed-radix round trip — the row-major coordinate tuple
+      ``(r // stride_j) % size_j`` reconstructs ``r`` exactly,
+    * group partition — for every axis, :func:`axis_groups` partitions
+      ``range(world)`` into disjoint groups of exactly that axis's
+      size (the ``axis_index_groups`` every grouped ring phase runs
+      on),
+    * role factoring — ``zero_world * (non-data world) == world`` and
+      the data-only :meth:`~HierMeshSpec.zero_subspec` round-trips its
+      own coordinates (the sub-box the ZeRO transports and the fused
+      kernel's ring actually ride).
+
+    Returns a JSON-safe dict with per-check booleans and an ``ok``
+    conjunction — artifact evidence, not an exception path (config
+    validation already raises on malformed specs)."""
+    sizes = spec.sizes
+    world = spec.world
+    strides = [int(np.prod(sizes[j + 1:])) for j in range(len(sizes))]
+    ranks = np.arange(world)
+    coords = [(ranks // strides[j]) % sizes[j]
+              for j in range(len(sizes))]
+    rebuilt = sum(coords[j] * strides[j] for j in range(len(sizes)))
+    roundtrip_ok = bool(np.array_equal(rebuilt, ranks))
+    groups_ok = True
+    for dim in range(len(sizes)):
+        groups = axis_groups(sizes, dim)
+        seen = [r for g in groups for r in g]
+        groups_ok &= all(len(g) == sizes[dim] for g in groups)
+        groups_ok &= sorted(seen) == list(range(world))
+    sub = spec.zero_subspec()
+    nondata = world // spec.zero_world if spec.zero_world else 0
+    factoring_ok = spec.zero_world * nondata == world \
+        and sub.world == spec.zero_world \
+        and all(sub.axes[i].role == "data" for i in range(len(sub.axes)))
+    sub_strides = [int(np.prod(sub.sizes[j + 1:]))
+                   for j in range(len(sub.sizes))]
+    sub_ranks = np.arange(sub.world)
+    sub_rebuilt = sum(((sub_ranks // sub_strides[j]) % sub.sizes[j])
+                      * sub_strides[j] for j in range(len(sub.sizes)))
+    sub_ok = bool(np.array_equal(sub_rebuilt, sub_ranks)) \
+        and sub.longhaul in sub.names
+    ok = roundtrip_ok and bool(groups_ok) and factoring_ok and sub_ok
+    return {
+        "spec": spec.describe(),
+        "world": world,
+        "zero_world": spec.zero_world,
+        "nondata_world": nondata,
+        "rank_coord_roundtrip_ok": roundtrip_ok,
+        "axis_groups_partition_ok": bool(groups_ok),
+        "role_factoring_ok": factoring_ok,
+        "zero_subspec_ok": sub_ok,
+        "ok": ok,
+    }

@@ -170,6 +170,15 @@ def quantized_allreduce_body(x, error, axis, group_size=2048, num_bits=8,
             q, axis, mesh_spec, op_name="domino_hier_allreduce_int8")
         s_t = hierarchical_all_to_all_rows(
             scale, axis, mesh_spec, op_name="domino_hier_allreduce_int8")
+    elif collective_impl == "fused":
+        # FUSED reduce-scatter epilogue exchange
+        # (ops/fused_collective_matmul.py): same int8 rows, same EF
+        # residual; payload + scales ride the in-kernel exchange and
+        # log op_kind="fused_permute" byte rows. Source-order delivery
+        # keeps the dequant-accumulate graph identical — bit-identical
+        # to the native int8 body.
+        from ..ops.fused_collective_matmul import fused_qrs_exchange
+        q_t, s_t = fused_qrs_exchange(q, scale, axis_name=axis)
     else:
         q_t = jax.lax.all_to_all(q, axis, 0, 0)      # int8 on the wire
         s_t = jax.lax.all_to_all(scale, axis, 0, 0)
@@ -180,7 +189,10 @@ def quantized_allreduce_body(x, error, axis, group_size=2048, num_bits=8,
                                op_name="domino_ring_allreduce_int8")
         s2_a = ring_all_gather(s2, axis,
                                op_name="domino_ring_allreduce_int8")
-    elif collective_impl == "hierarchical":
+    elif collective_impl in ("hierarchical", "fused"):
+        # the broadcast leg has no consuming matmul to fuse into —
+        # "fused" rides the hierarchical mesh rings (normal
+        # collective_permute byte rows: wire honesty)
         from .hierarchical import hierarchical_all_gather
         q2_a = hierarchical_all_gather(
             q2, axis, mesh_spec, op_name="domino_hier_allreduce_int8")

@@ -64,24 +64,32 @@ from .comms_logging import get_comms_logger
 #: config values for the ZeRO collective transport knob
 #: (``zero_optimization.zero_collective_impl``): ``decomposed`` = flat
 #: 1-D ring chains; ``hierarchical`` = multi-axis mesh decomposition
-#: (``comm/hierarchical.py``) built from the grouped forms below.
-COLLECTIVE_IMPLS = ("native", "decomposed", "hierarchical")
+#: (``comm/hierarchical.py``) built from the grouped forms below;
+#: ``fused`` = the hierarchical transports plus in-kernel
+#: computation-collective fusion at the consumption sites
+#: (``ops/fused_collective_matmul.py``) — requires a declared mesh
+#: whose data-role axis carries the fused kernel's ring.
+COLLECTIVE_IMPLS = ("native", "decomposed", "hierarchical", "fused")
 
 
-def _log_permute(op_name, n_bytes, axis_name, wire_axis=None):
+def _log_permute(op_name, n_bytes, axis_name, wire_axis=None,
+                 op_kind="collective_permute"):
     """Attribute one permute step's bytes. ``wire_axis`` is the MESH
     axis label the bytes physically ride (``comm/hierarchical.py``
     phases pass e.g. ``"intra"``/``"inter"``); it lands as the last
     component of the comms-logger axis group, so
     ``CommsLogger.permute_axis_bytes()`` can split intra- vs
     inter-axis wire volume. ``None`` (flat rings) keeps the plain
-    ``(axis_name,)`` attribution."""
+    ``(axis_name,)`` attribution. ``op_kind="fused_permute"`` marks
+    steps that execute INSIDE a fused computation-collective kernel
+    (``ops/fused_collective_matmul.py``) — same bytes, separately
+    queryable (``CommsLogger.fused_bytes_summary``)."""
     logger = get_comms_logger()
     if op_name and logger.should_log(op_name):
         axes = (axis_name,) if wire_axis is None else (axis_name,
                                                        wire_axis)
         logger.log_collective(op_name, int(n_bytes), axes,
-                              op_kind="collective_permute")
+                              op_kind=op_kind)
 
 
 def _chunk_bounds(width: int, chunks: int) -> List[Tuple[int, int]]:
@@ -123,7 +131,8 @@ def _group_layout(axis_name, axis_index_groups):
 
 
 def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
-                    op_name: str = "ring_all_gather", wire_axis=None):
+                    op_name: str = "ring_all_gather", wire_axis=None,
+                    op_kind="collective_permute"):
     """Chunked ring all-gather: ``[n_g, *x.shape]`` stacked result, row
     ``j`` = group-rank ``j``'s ``x`` — the same layout (and bits) as
     ``jax.lax.all_gather(x, axis_name, axis_index_groups=...)``.
@@ -145,7 +154,7 @@ def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
         cur = piece
         for _ in range(m - 1):
             _log_permute(op_name, piece.size * piece.dtype.itemsize,
-                         axis_name, wire_axis)
+                         axis_name, wire_axis, op_kind=op_kind)
             cur = jax.lax.ppermute(cur, axis_name, neighbor)
             arrived.append(cur)
         stacked = jnp.stack(arrived)               # [m, w]
@@ -157,7 +166,8 @@ def ring_all_gather(x, axis_name, *, axis_index_groups=None, chunks: int = 1,
 def decomposed_all_to_all_rows(rows, axis_name, *, axis_index_groups=None,
                                chunks: int = 1,
                                op_name: str = "ring_all_to_all",
-                               wire_axis=None):
+                               wire_axis=None,
+                               op_kind="collective_permute"):
     """Decomposed row exchange: ``rows`` is ``[n_g, ...]`` with row
     ``j`` destined for group-rank ``j``; returns ``[n_g, ...]``
     received rows in SOURCE order — the same layout (and bits) as
@@ -189,7 +199,7 @@ def decomposed_all_to_all_rows(rows, axis_name, *, axis_index_groups=None,
         pieces = []
         for lo, hi in bounds:
             _log_permute(op_name, (hi - lo) * flat.dtype.itemsize,
-                         axis_name, wire_axis)
+                         axis_name, wire_axis, op_kind=op_kind)
             pieces.append(jax.lax.ppermute(sent[lo:hi], axis_name, perm))
         received.append(pieces[0] if len(pieces) == 1
                         else jnp.concatenate(pieces))

@@ -115,6 +115,26 @@ TOLERANCES: Dict[str, Tolerance] = {
         Tolerance("higher", rel=0.0),
     "zero_overlap.hier_16dev_parity": Tolerance("higher", rel=0.0),
     "zero_overlap.wire_cal_shape_ok": Tolerance("higher", rel=0.0),
+    # ISSUE 18: fused computation-collective kernels. The bitwise
+    # parity bools, the in-kernel audit differential, the
+    # fused<=unfused wall-clock verdict, the 3-D mesh bookkeeping
+    # gates, and the 16-dev fused parity are HARD gates; the subsumed
+    # pair count must never drop below the committed count; the
+    # wall-clock speedup is trajectory-gated loosely (shared CI
+    # hosts), never a hard floor above 1.0 — the boolean verdict at
+    # the largest payload is the hard form of that claim.
+    "zero_overlap.fused_parity_plain": Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_parity_qwire": Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_audit_gate": Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_subsumed_pairs": Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_mid_gather_leaves":
+        Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_le_unfused_largest":
+        Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_wallclock_speedup":
+        Tolerance("higher", rel=0.50),
+    "zero_overlap.mesh3d_bookkeeping_ok": Tolerance("higher", rel=0.0),
+    "zero_overlap.fused_16dev_parity": Tolerance("higher", rel=0.0),
     # serve-loop percentiles (wall-clock on shared CI hosts: loose)
     "serve_loop.ttft_s_p50": Tolerance("lower", rel=0.50, abs=0.5),
     "serve_loop.ttft_s_p99": Tolerance("lower", rel=0.50, abs=0.5),

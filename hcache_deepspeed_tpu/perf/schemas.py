@@ -289,7 +289,13 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("wire_cal_divergence_inter",
                      "zero_overlap.wire_cal_divergence_inter"),
                     ("wire_cal_divergence_intra",
-                     "zero_overlap.wire_cal_divergence_intra")):
+                     "zero_overlap.wire_cal_divergence_intra"),
+                    ("fused_subsumed_pairs",
+                     "zero_overlap.fused_subsumed_pairs"),
+                    ("fused_mid_gather_leaves",
+                     "zero_overlap.fused_mid_gather_leaves"),
+                    ("fused_wallclock_speedup",
+                     "zero_overlap.fused_wallclock_speedup")):
                 if isinstance(row.get(key), (int, float)):
                     pts.append(MetricPoint(metric, float(row[key]),
                                            file, phase=phase, utc=utc))
@@ -324,7 +330,19 @@ def parse_zero_overlap(text: str, file: str) -> List[MetricPoint]:
                     ("hier_16dev_parity",
                      "zero_overlap.hier_16dev_parity"),
                     ("wire_cal_shape_ok",
-                     "zero_overlap.wire_cal_shape_ok")):
+                     "zero_overlap.wire_cal_shape_ok"),
+                    ("fused_parity_plain",
+                     "zero_overlap.fused_parity_plain"),
+                    ("fused_parity_qwire",
+                     "zero_overlap.fused_parity_qwire"),
+                    ("fused_audit_gate",
+                     "zero_overlap.fused_audit_gate"),
+                    ("fused_le_unfused_largest",
+                     "zero_overlap.fused_le_unfused_largest"),
+                    ("mesh3d_bookkeeping_ok",
+                     "zero_overlap.mesh3d_bookkeeping_ok"),
+                    ("fused_16dev_parity",
+                     "zero_overlap.fused_16dev_parity")):
                 if key in row:
                     pts.append(MetricPoint(metric,
                                            1.0 if row[key] else 0.0,

@@ -15,6 +15,16 @@ Two evidence tiers, reported side by side and never conflated:
   between start and done — the measured overlap. ``DOMINO_TPU_r4.log``
   is the cautionary tale: a backend may compile ZERO such pairs, which
   is exactly what this tier detects.
+* **in-kernel tier** — fused computation-collective kernels
+  (``ops/fused_collective_matmul.py``) stamp every op they emit with a
+  ``hds_fused*`` ``jax.named_scope``, which XLA threads through to the
+  optimized module's ``metadata op_name``. This tier counts the scoped
+  permute+dot pairs a fused kernel SUBSUMES (each ring step's permute
+  rides beside the previous chunk's dot by construction — no scheduler
+  needed), the fused ``custom-call``s themselves (the Pallas form on a
+  real chip), and the wire bytes moving inside fused scopes. An
+  unfused program reports zero on all three — the differential is the
+  evidence that the fused route compiled, not just traced.
 * **derived pairs** — for backends that keep collectives synchronous
   (the CPU backend at every flag combination we probed; injecting async
   HLO via MHLO ``async_start`` segfaults the CPU compiler), the auditor
@@ -79,6 +89,12 @@ _DTYPE_BYTES = {
 
 #: element types that count as a QUANTIZED wire (int8/int4/fp8 payloads)
 _QUANT_DTYPES = ("s8", "u8", "s4", "u4")
+
+#: metadata marker of ops emitted inside a fused computation-collective
+#: kernel's ``jax.named_scope`` (ops/fused_collective_matmul.py
+#: FUSED_SCOPE_GATHER_MM / FUSED_SCOPE_RS) — XLA threads the scope into
+#: the optimized module's per-instruction ``op_name``
+_FUSED_META_RE = re.compile(r'op_name="[^"]*hds_fused[^"]*"')
 
 
 def _type_bytes(type_str: str):
@@ -407,6 +423,35 @@ def _cross_axis_pairs(comp: Computation) -> Dict:
             "permutes": len(permutes)}
 
 
+def _fused_in_kernel(comp: Computation, dot_fusions=frozenset()) -> Dict:
+    """IN-KERNEL tier for one computation: ops stamped with the
+    ``hds_fused*`` scope marker. ``subsumed_pairs`` is
+    ``min(scoped permutes, scoped dots)`` — each ring step of a fused
+    gather-matmul pairs one in-flight permute with one resident-chunk
+    dot BY CONSTRUCTION (the permute's chunk is not the dot's operand),
+    so the pairing needs no scheduler and no dependence analysis; the
+    min is conservative when a schedule is permute- or dot-heavy.
+    Dot-bearing fusions count as dots (CPU folds the dequant-dot into
+    one fusion). ``custom_calls`` counts scoped ``custom-call``s — the
+    Pallas kernel itself on a compiled-for-TPU module. ``wire_bytes``
+    sums the scoped permutes' result buffers (the bytes moving INSIDE
+    the kernel's window)."""
+    scoped = [i for i in comp.instrs if _FUSED_META_RE.search(i.raw)]
+    permutes = [i for i in scoped
+                if i.opcode in ("collective-permute",
+                                "collective-permute-start")]
+    dots = [i for i in scoped
+            if i.opcode in DERIVED_COMPUTE_OPS or i.name in dot_fusions]
+    return {
+        "custom_calls": sum(1 for i in scoped
+                            if i.opcode == "custom-call"),
+        "scoped_permutes": len(permutes),
+        "scoped_dots": len(dots),
+        "subsumed_pairs": min(len(permutes), len(dots)),
+        "wire_bytes": sum(i.result_bytes for i in permutes),
+    }
+
+
 def _permute_chains(comp: Computation) -> List[Dict]:
     """Group this computation's ``collective-permute`` ops into CHAINS:
     permutes connected by a def-use path (step ``s`` consumes step
@@ -464,6 +509,14 @@ class AuditReport:
     #: DIFFERENT mesh axes — ``{"pairs", "partnered", "permutes"}``
     cross_axis: Dict = field(default_factory=lambda: {
         "pairs": 0, "partnered": 0, "permutes": 0})
+    #: IN-KERNEL tier (fused computation-collective kernels, ISSUE 18):
+    #: module-wide totals over ops stamped with the ``hds_fused*``
+    #: scope marker — ``{"custom_calls", "scoped_permutes",
+    #: "scoped_dots", "subsumed_pairs", "wire_bytes"}``. All zero on an
+    #: unfused module.
+    fused_kernel: Dict = field(default_factory=lambda: {
+        "custom_calls": 0, "scoped_permutes": 0, "scoped_dots": 0,
+        "subsumed_pairs": 0, "wire_bytes": 0})
 
     def pairs(self, kind: Optional[str] = None,
               min_interleaved: int = 1) -> List[Pair]:
@@ -546,6 +599,11 @@ class AuditReport:
             "cross_axis_pairs": self.cross_axis.get("pairs", 0),
             "cross_axis_overlap_ratio": round(
                 self.cross_axis_overlap_ratio(), 4),
+            "fused_custom_calls": self.fused_kernel.get(
+                "custom_calls", 0),
+            "fused_subsumed_pairs": self.fused_kernel.get(
+                "subsumed_pairs", 0),
+            "fused_wire_bytes": self.fused_kernel.get("wire_bytes", 0),
             "permute_chains": list(self.permute_chains),
             "collective_counts": self.counts(),
             "wire_bytes": self.wire_bytes,
@@ -643,6 +701,8 @@ def audit_hlo_text(text: str) -> AuditReport:
     chains: List[Dict] = []
     wire: Dict[str, Dict] = {}
     cross = {"pairs": 0, "partnered": 0, "permutes": 0}
+    fused = {"custom_calls": 0, "scoped_permutes": 0, "scoped_dots": 0,
+             "subsumed_pairs": 0, "wire_bytes": 0}
     comps = parse_hlo_computations(text)
     dot_fusions = _dot_fusion_names(comps)
     for comp in comps:
@@ -655,6 +715,10 @@ def audit_hlo_text(text: str) -> AuditReport:
         ca = _cross_axis_pairs(comp)
         for k in cross:
             cross[k] += ca[k]
+        fk = _fused_in_kernel(comp,
+                              dot_fusions.get(comp.name, frozenset()))
+        for k in fused:
+            fused[k] += fk[k]
         for i in comp.instrs:
             if not (i.is_collective or i.opcode.endswith("-start")):
                 continue
@@ -669,7 +733,8 @@ def audit_hlo_text(text: str) -> AuditReport:
     return AuditReport(native_pairs=native, derived_pairs=derived,
                        sequential_collectives=sequential,
                        computations=len(comps), wire_bytes=wire,
-                       permute_chains=chains, cross_axis=cross)
+                       permute_chains=chains, cross_axis=cross,
+                       fused_kernel=fused)
 
 
 def audit_compiled(compiled) -> AuditReport:

@@ -141,11 +141,20 @@ class ZeroConfig(HDSConfigModel):
     #: (``zero_mesh_shape``) and runs per-axis grouped ring phases
     #: (``comm/hierarchical.py``) — still bitwise-equal, with wire
     #: bytes attributed per mesh axis and the long-haul axis
-    #: quantizable on its own (``zero_longhaul_wire_bits``).
-    #: Decomposed/hierarchical require the layered step, a data axis
-    #: > 1, and ``overlap_comm=true``; hierarchical additionally needs
-    #: ``zero_mesh_shape`` to factor the data world size exactly
-    #: (validated with typed errors, no silent fallthrough).
+    #: quantizable on its own (``zero_longhaul_wire_bits``);
+    #: ``"fused"`` is the IN-KERNEL tier (ROADMAP item 3,
+    #: ``ops/fused_collective_matmul.py``): bucket transports ride the
+    #: hierarchical mesh rings, but each qwZ matmul leaf stays a
+    #: mid-gather shard consumed by the fused gather-matmul kernel at
+    #: its Dense (chunk k's partial dot overlaps chunk k+1's in-kernel
+    #: permute), and the quantized reduce lane folds through the fused
+    #: quantize+error-feedback epilogue — bitwise-equal to the unfused
+    #: pipeline via the transport-swap twin contract.
+    #: Decomposed/hierarchical/fused require the layered step, a data
+    #: axis > 1, and ``overlap_comm=true``; hierarchical and fused
+    #: additionally need ``zero_mesh_shape`` to factor the data world
+    #: size exactly (validated with typed errors, no silent
+    #: fallthrough).
     zero_collective_impl: str = "native"
     #: Mesh factoring of the flat data axis for the hierarchical
     #: transport, outer (long-haul) axis first — e.g. ``[2, 4]`` on 8
@@ -161,6 +170,15 @@ class ZeroConfig(HDSConfigModel):
     #: wire-cost model — a MODEL input (what the pod's links do), not a
     #: measurement; aligned with ``zero_mesh_shape``.
     zero_mesh_link_gbps: Optional[List[float]] = None
+    #: Parallelism ROLE per mesh axis (``data`` / ``model`` / ``pipe``
+    #: / ``expert``, aligned with ``zero_mesh_shape``; default: all
+    #: ``data``). Non-data roles declare a COMPOSED multi-parallelism
+    #: factoring — e.g. ``["data", "model", "pipe"]`` for the 3-D
+    #: v5e-256 target: the ZeRO collectives (and the fused kernel's
+    #: ring) ride only the data-role axes
+    #: (``HierMeshSpec.zero_subspec``), and the data-axis product must
+    #: factor the data world size. At least one axis must be ``data``.
+    zero_mesh_axis_roles: Optional[List[str]] = None
     #: Which mesh axis is the slow/long-haul wire (default: the
     #: outermost). Must name a declared axis — an unknown name is a
     #: typed config error, not a silent fallback.
@@ -199,15 +217,18 @@ class ZeroConfig(HDSConfigModel):
         # where the topology is known)
         from .zero.overlap import validate_quantized_wire
         if self.zero_collective_impl not in ("native", "decomposed",
-                                             "hierarchical"):
+                                             "hierarchical", "fused"):
             raise HDSConfigError(
                 f"zero_collective_impl="
                 f"{self.zero_collective_impl!r}: expected 'native' "
                 f"(monolithic collectives), 'decomposed' (chunked "
-                f"ppermute ring transport, comm/ring.py) or "
+                f"ppermute ring transport, comm/ring.py), "
                 f"'hierarchical' (multi-axis mesh rings, "
-                f"comm/hierarchical.py)")
-        if self.zero_collective_impl in ("decomposed", "hierarchical") \
+                f"comm/hierarchical.py) or 'fused' (in-kernel "
+                f"gather-matmul / reduce-scatter epilogue, "
+                f"ops/fused_collective_matmul.py)")
+        if self.zero_collective_impl in ("decomposed", "hierarchical",
+                                         "fused") \
                 and not self.overlap_comm:
             # world-size interplay is re-checked at engine build
             # (validate_overlap_config), where the topology is known;
@@ -217,19 +238,21 @@ class ZeroConfig(HDSConfigModel):
                 "with overlap_comm=false: the decomposed transports "
                 "exist to make overlap structural — enable "
                 "overlap_comm or use zero_collective_impl=native")
-        if self.zero_collective_impl == "hierarchical":
+        if self.zero_collective_impl in ("hierarchical", "fused"):
             # shape/name sanity is knowable at parse time (the
             # world-size product check needs the topology: engine
             # build re-validates via validate_overlap_config)
             from ..comm.hierarchical import make_mesh_spec
             if self.zero_mesh_shape is None:
                 raise HDSConfigError(
-                    "zero_collective_impl=hierarchical needs "
-                    "zero_mesh_shape (the mesh factoring of the data "
-                    "axis, outer/long-haul axis first — e.g. [2, 4])")
+                    f"zero_collective_impl="
+                    f"{self.zero_collective_impl} needs "
+                    f"zero_mesh_shape (the mesh factoring of the data "
+                    f"axis, outer/long-haul axis first — e.g. [2, 4])")
             spec = make_mesh_spec(
                 self.zero_mesh_shape, self.zero_mesh_axis_names,
-                self.zero_mesh_link_gbps, self.zero_longhaul_axis)
+                self.zero_mesh_link_gbps, self.zero_longhaul_axis,
+                self.zero_mesh_axis_roles)
             if self.zero_longhaul_wire_bits is not None \
                     and self.zero_longhaul_wire_bits not in (4, 8):
                 raise HDSConfigError(
@@ -240,19 +263,21 @@ class ZeroConfig(HDSConfigModel):
             del spec
         else:
             for knob in ("zero_mesh_shape", "zero_longhaul_axis",
-                         "zero_longhaul_wire_bits"):
+                         "zero_longhaul_wire_bits",
+                         "zero_mesh_axis_roles"):
                 if getattr(self, knob) is not None:
                     raise HDSConfigError(
-                        f"{knob} has no effect without "
-                        f"zero_collective_impl=hierarchical; set the "
-                        f"transport or drop the knob (no silent "
-                        f"ignores)")
+                        f"{knob} has no effect without a mesh "
+                        f"transport (zero_collective_impl=hierarchical "
+                        f"or fused); set the transport or drop the "
+                        f"knob (no silent ignores)")
             if self.zero_mesh_pipeline_chunks != 1:
                 raise HDSConfigError(
                     f"zero_mesh_pipeline_chunks="
                     f"{self.zero_mesh_pipeline_chunks} has no effect "
-                    f"without zero_collective_impl=hierarchical "
-                    f"(phase pipelining overlaps a gather's intra and "
+                    f"without a mesh transport "
+                    f"(zero_collective_impl=hierarchical or fused — "
+                    f"phase pipelining overlaps a gather's intra and "
                     f"long-haul PHASES); set the transport or drop "
                     f"the knob (no silent ignores)")
         validate_quantized_wire(

@@ -123,23 +123,33 @@ def domino_split_async(compute_fn, collective_fn, x, *args,
     all-gather), bitwise-equal to the flat rings with wire bytes
     attributed to the mesh axis they ride — the 2-D torus form of the
     same scheduler-independent overlap.
+
+    ``collective_impl="fused"``: the full-width all-reduce rides the
+    hierarchical mesh rings (the transport twin — an all-reduce has no
+    consuming matmul to fuse into), and composed with ``wire_bits`` the
+    int8 body's reduce exchange runs the FUSED reduce-scatter epilogue
+    (``ops/fused_collective_matmul.fused_qrs_exchange`` — in-kernel
+    ``fused_permute`` byte rows), bit-identical to the native int8 body.
     """
     B = x.shape[0]
-    if collective_impl not in ("native", "decomposed", "hierarchical"):
+    if collective_impl not in ("native", "decomposed", "hierarchical",
+                               "fused"):
         raise ValueError(f"collective_impl={collective_impl!r}: "
-                         f"expected 'native', 'decomposed' or "
-                         f"'hierarchical'")
-    if collective_impl in ("decomposed", "hierarchical"):
+                         f"expected 'native', 'decomposed', "
+                         f"'hierarchical' or 'fused'")
+    if collective_impl in ("decomposed", "hierarchical", "fused"):
         if axis is None:
             raise ValueError(
                 f"domino_split_async(collective_impl="
                 f"{collective_impl!r}) needs the mesh axis the layer "
                 f"reduces over (axis=...)")
-        if collective_impl == "hierarchical" and mesh_spec is None:
+        if collective_impl in ("hierarchical", "fused") \
+                and mesh_spec is None:
             raise ValueError(
-                "domino_split_async(collective_impl='hierarchical') "
-                "needs the declared mesh factoring (mesh_spec=..., "
-                "comm.hierarchical.make_mesh_spec)")
+                f"domino_split_async(collective_impl="
+                f"{collective_impl!r}) needs the declared mesh "
+                f"factoring (mesh_spec=..., "
+                f"comm.hierarchical.make_mesh_spec)")
         if wire_bits is None:
             if collective_impl == "decomposed":
                 from ..comm.ring import ring_all_reduce_sum

@@ -195,7 +195,7 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
       fallthrough to the native transport.
     """
     from ..config import HDSConfigError
-    if collective_impl in ("decomposed", "hierarchical"):
+    if collective_impl in ("decomposed", "hierarchical", "fused"):
         if world_size == 1:
             raise HDSConfigError(
                 f"zero_collective_impl={collective_impl} with data "
@@ -210,14 +210,14 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
                 f"overlap_comm=false is the explicit serialization "
                 f"fallback — enable overlap_comm or use "
                 f"zero_collective_impl=native")
-    if collective_impl == "hierarchical":
+    if collective_impl in ("hierarchical", "fused"):
         from ...comm.hierarchical import hpz_tier_dims, validate_mesh_spec
         if mesh_spec is None:
             raise HDSConfigError(
-                "zero_collective_impl=hierarchical needs "
-                "zero_mesh_shape (the mesh factoring of the data "
-                "axis); declare it — the transport never guesses a "
-                "factoring")
+                f"zero_collective_impl={collective_impl} needs "
+                f"zero_mesh_shape (the mesh factoring of the data "
+                f"axis); declare it — the transport never guesses a "
+                f"factoring")
         if hpz > 1:
             # UNIFIED hpZ tiering (ISSUE 15): hpZ's secondary groups
             # map onto the mesh's innermost axes — per-micro gathers
@@ -235,13 +235,14 @@ def validate_overlap_config(*, reduce_bucket_elements: int = 0,
                 f"zero_mesh_pipeline_chunks={pipeline_chunks}: the "
                 f"phase pipeline needs a positive chunk count (1 = "
                 f"unpipelined)")
-        if collective_impl != "hierarchical":
+        if collective_impl not in ("hierarchical", "fused"):
             raise HDSConfigError(
                 f"zero_mesh_pipeline_chunks={pipeline_chunks} has no "
-                f"effect without zero_collective_impl=hierarchical "
-                f"(phase pipelining overlaps a gather's intra and "
-                f"long-haul PHASES — flat transports have one phase); "
-                f"set the transport or drop the knob")
+                f"effect without a mesh transport "
+                f"(zero_collective_impl=hierarchical or fused — phase "
+                f"pipelining overlaps a gather's intra and long-haul "
+                f"PHASES; flat transports have one phase); set the "
+                f"transport or drop the knob")
     if largest_leaf > reduce_bucket_elements:
         name = f" ({largest_leaf_name})" if largest_leaf_name else ""
         raise HDSConfigError(

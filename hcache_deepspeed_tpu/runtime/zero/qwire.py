@@ -104,7 +104,15 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
     same EF residual semantics — quantization happens BEFORE the
     transport choice), shipped point-to-point, and reordered to source
     order on arrival, so the dequant-accumulate is the same local
-    computation graph as the native path — bitwise-equal."""
+    computation graph as the native path — bitwise-equal.
+
+    ``collective_impl="fused"`` runs the FUSED EPILOGUE
+    (``ops/fused_collective_matmul.py``): the quantize + error-feedback
+    trio folds through one ``fused_quant_ef`` op (Pallas on TPU, the
+    bitwise host twin elsewhere — same bucket layout, same residual
+    state, so depth parity stays bitwise) and the wire rides
+    :func:`~...ops.fused_collective_matmul.fused_qrs_exchange`
+    (source-order direct delivery, ``fused_permute`` byte rows)."""
     n, W = wide.shape
     gsz = max(1, min(group_size, W))
     num_bits = 4 if bits == 4 else 8
@@ -123,8 +131,14 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
         return (q, s), deq_rows(q, s)
 
     if residual is not None:
-        (q, scale), _, new_residual = error_feedback_step(
-            wide, residual, compress)
+        if collective_impl == "fused" and W % gsz == 0:
+            from ...ops import get_op
+            q, s_flat, new_residual = get_op("fused_quant_ef")(
+                wide, residual, group_size=gsz, num_bits=num_bits)
+            scale = s_flat[..., None]
+        else:
+            (q, scale), _, new_residual = error_feedback_step(
+                wide, residual, compress)
     else:
         q, scale = quant_rows(wide)
         new_residual = None
@@ -139,6 +153,10 @@ def _quantized_wide_reduce(wide, residual, *, group_size, bits,
             payload, DATA_AXIS, op_name="zero_ring_qrs")
         scale_t = decomposed_all_to_all_rows(
             scale, DATA_AXIS, op_name="zero_ring_qrs")
+    elif collective_impl == "fused":
+        from ...ops.fused_collective_matmul import fused_qrs_exchange
+        payload_t, scale_t = fused_qrs_exchange(
+            payload, scale, axis_name=DATA_AXIS)
     elif collective_impl == "hierarchical":
         # per-mesh-axis grouped delivery of the SAME int8 payload +
         # scales (quantization still happens before the transport

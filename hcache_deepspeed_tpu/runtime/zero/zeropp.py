@@ -168,7 +168,9 @@ def _psum_scatter_mean_dim(g, dim, collective_impl="native",
         from ...comm.ring import decomposed_reduce_scatter_sum
         out = decomposed_reduce_scatter_sum(
             gm, DATA_AXIS, op_name="zero_ring_reduce_scatter")
-    elif collective_impl == "hierarchical":
+    elif collective_impl in ("hierarchical", "fused"):
+        # fused rides the hierarchical twin for the fp reduce lane —
+        # the fused epilogue applies to the QUANTIZED reduce (qwire)
         from ...comm.hierarchical import hierarchical_reduce_scatter_sum
         out = hierarchical_reduce_scatter_sum(
             gm, DATA_AXIS, mesh_spec, pipeline_chunks=pipeline_chunks,
@@ -243,10 +245,11 @@ def bucketed_reduce_scatter_mean(flat, dims, *, bucket_elements, qg,
                 red = decomposed_reduce_scatter_sum(
                     wide, DATA_AXIS,
                     op_name="zero_ring_reduce_scatter")
-            elif collective_impl == "hierarchical":
+            elif collective_impl in ("hierarchical", "fused"):
                 # per-mesh-axis grouped delivery, same destination
                 # index-order fold: still bitwise-equal to psum_scatter
-                # (comm/hierarchical.py contract)
+                # (comm/hierarchical.py contract; fused rides the same
+                # twin for the fp bucket reduce)
                 from ...comm.hierarchical import \
                     hierarchical_reduce_scatter_sum
                 red = hierarchical_reduce_scatter_sum(
@@ -346,14 +349,16 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                     wide = ring_all_gather(
                         payload, DATA_AXIS, axis_index_groups=groups,
                         op_name="zero_ring_all_gather")
-                elif collective_impl == "hierarchical":
+                elif collective_impl in ("hierarchical", "fused"):
                     # per-mesh-axis ring phases, same [n_g, W] row
                     # order; the long-haul phase optionally ships
                     # int8/int4 (comm/hierarchical.py). Under hpZ the
                     # gather runs the UNIFIED tier — grouped ring
                     # phases over only the mesh axes the hpZ box
                     # covers (n_g = hpz), bitwise-equal to the native
-                    # grouped gather
+                    # grouped gather. The fused impl's BUCKET payloads
+                    # ride the same twin — only the matmul-plan leaves
+                    # bypass the bucket for mid-gather consumption
                     from ...comm.hierarchical import \
                         hierarchical_all_gather
                     wide = hierarchical_all_gather(
@@ -376,12 +381,25 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
         from ...ops.quantized_matmul import quantize_for_matmul
         matmul_plan = matmul_plan or {}
         qitems, sitems, qmeta = [], [], {}
+        mm_sharded, mm_payloads = [], []
         for i, (p, d) in enumerate(zip(src, dims)):
             if d is None:
                 continue
             if i in matmul_plan:
                 group_k = matmul_plan[i]
                 q, scale = quantize_for_matmul(p, group_k=group_k)
+                if collective_impl == "fused":
+                    # MID-GATHER bypass: the shard pair rides the
+                    # payload list UN-gathered — the gather happens
+                    # inside the fused gather-matmul kernel when the
+                    # consuming Dense fires (its in-kernel permute
+                    # bytes land as ``fused_permute`` rows, so this
+                    # leaf's wire is attributed there, not here)
+                    qmeta[i] = ("mm_sharded", q.shape, scale.shape,
+                                group_k, d)
+                    mm_sharded.append(i)
+                    mm_payloads += [q.reshape(-1), scale.reshape(-1)]
+                    continue
                 qmeta[i] = ("mm", q.shape, scale.shape, group_k, d)
             else:
                 gsz = min(group_size, p.size)
@@ -395,12 +413,13 @@ def bucketed_all_gather_start(flat, sec, dims, *, qw, hpz, group_size,
                       sum(int(q.size) for _, q in qitems),
                       sum(int(s.size) for _, s in sitems),
                       sum(int(flat[i].size) * flat[i].dtype.itemsize
-                          for i in qmeta))
+                          for i in qmeta if qmeta[i][0] != "mm_sharded"))
         pq, plan_q = pack(qitems, None)
         ps, plan_s = pack(sitems, None)
         meta.update(plan_q=plan_q, plan_s=plan_s, qmeta=qmeta,
-                    n_q=len(pq), n_s=len(ps))
-        payloads = pq + ps
+                    n_q=len(pq), n_s=len(ps), mm_sharded=mm_sharded,
+                    hpz_groups=groups)
+        payloads = pq + ps + mm_payloads
     else:
         items = [(i, p.reshape(-1))
                  for i, (p, d) in enumerate(zip(src, dims))
@@ -430,7 +449,16 @@ def bucketed_all_gather_finish(payloads, meta, fused=False):
     materializes. The backward re-gather calls this with
     ``fused=False``: the block VJP needs cotangents against the fp
     weight, so the recompute consumes the dequantized form (same
-    linearization point, the dequant value)."""
+    linearization point, the dequant value).
+
+    ``zero_collective_impl: fused`` leaves (``qmeta`` tag
+    ``"mm_sharded"``): the payload carries the UN-gathered shard pair.
+    With ``fused=True`` it comes back as a ``ShardedQuantizedTensor``
+    — the gather happens INSIDE the fused gather-matmul kernel at the
+    consuming Dense (the in-kernel overlap site); with ``fused=False``
+    it gathers + dequantizes here (``ShardedQuantizedTensor.gather()``
+    — same assembly, same bits as the unfused bucketed gather, the
+    transport-swap twin contract)."""
     n_g = meta["n_g"]
     out = [None] * meta["n_leaves"]
 
@@ -453,12 +481,25 @@ def bucketed_all_gather_finish(payloads, meta, fused=False):
         return parts.reshape(new_shape)
 
     if meta["qw"]:
+        from ...ops.fused_collective_matmul import ShardedQuantizedTensor
         from ...ops.quantized_matmul import MatmulQuantizedTensor
         q_all = unpack(payloads[:meta["n_q"]], meta["plan_q"])
         s_all = unpack(payloads[meta["n_q"]:meta["n_q"] + meta["n_s"]],
                        meta["plan_s"])
         n_buckets = meta["n_q"] + meta["n_s"]
+        mm_sharded = meta.get("mm_sharded", [])
+        for j, i in enumerate(mm_sharded):
+            _, qshape, sshape, group_k, d = meta["qmeta"][i]
+            sqt = ShardedQuantizedTensor(
+                payloads[n_buckets + 2 * j].reshape(qshape),
+                payloads[n_buckets + 2 * j + 1].reshape(sshape),
+                group_k=group_k, dim=d, axis_name=DATA_AXIS,
+                groups=meta.get("hpz_groups"))
+            out[i] = sqt if fused else sqt.gather().dequantize()
+        n_buckets += 2 * len(mm_sharded)
         for i, ent in meta["qmeta"].items():
+            if ent[0] == "mm_sharded":
+                continue
             if ent[0] == "mm":
                 _, qshape, sshape, group_k, d = ent
                 qa = q_all[i].reshape((n_g,) + tuple(qshape))
@@ -556,7 +597,7 @@ def make_leaf_gather(*, qw: bool, hpz: int, group_size: int = 2048,
             # and the hpZ secondary refresh DO ride the mesh.
             return _quantized_all_gather_dim(src, dim, group_size=group_size,
                                              axis_index_groups=groups)
-        if collective_impl == "hierarchical" and hpz > 1:
+        if collective_impl in ("hierarchical", "fused") and hpz > 1:
             # UNIFIED hpZ tier: the per-leaf gather rides only the
             # mesh axes the hpZ box covers (grouped ring phases,
             # per-axis byte attribution; longhaul_bits fires when the
@@ -679,7 +720,7 @@ def build_secondary(params, param_dims, hpz: int, *,
     def leaf(p, dim):
         if dim is None or hpz <= 1:
             return None
-        if collective_impl == "hierarchical":
+        if collective_impl in ("hierarchical", "fused"):
             from ...comm.hierarchical import hierarchical_all_gather
             wide = hierarchical_all_gather(
                 p, DATA_AXIS, mesh_spec, longhaul_bits=longhaul_bits,
@@ -801,7 +842,7 @@ def build_zeropp_micro_fn(*, adapter_loss, mesh, param_specs, grad_specs,
     collective_impl = getattr(zcfg, "zero_collective_impl", "native")
     mesh_spec = None
 
-    if collective_impl in ("decomposed", "hierarchical"):
+    if collective_impl in ("decomposed", "hierarchical", "fused"):
         # the ring transports ride the layered step's explicit lanes;
         # the whole-tree fallback's gathers are AD-generated per-leaf
         # ops with no bucket site to decompose. Reject loudly instead
@@ -1333,11 +1374,20 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
                 if fused_mm:
                     # Dense kernels arrive as (int8, scales); the
                     # interceptor routes them through quantized_matmul
-                    # so the fp weight never materializes
+                    # so the fp weight never materializes. Under the
+                    # fused transport they arrive as MID-GATHER shards
+                    # (ShardedQuantizedTensor) and the interceptor runs
+                    # the fused gather-matmul kernel — the in-kernel
+                    # overlap site
                     import flax.linen as fnn
-                    from ...ops.quantized_matmul import \
-                        fused_dense_interceptor
-                    with fnn.intercept_methods(fused_dense_interceptor()):
+                    if impl == "fused":
+                        from ...ops.fused_collective_matmul import \
+                            fused_collective_dense_interceptor as \
+                            _make_interceptor
+                    else:
+                        from ...ops.quantized_matmul import \
+                            fused_dense_interceptor as _make_interceptor
+                    with fnn.intercept_methods(_make_interceptor()):
                         return iso(block_fn(layer_tree, x, batch_local,
                                             key, train))
                 return iso(block_fn(layer_tree, x, batch_local, key,
@@ -1587,19 +1637,24 @@ def _build_layered(*, layered, mesh, param_specs, batch_spec_of, gas,
         "error_feedback": qrs_ef,
         "wire_bits": qrs_bits if qrs else None,
         "fused_matmul_leaves": len(matmul_plan) if matmul_plan else 0,
+        # in-kernel overlap sites: matmul leaves consumed MID-GATHER by
+        # the fused gather-matmul kernel (zero_collective_impl=fused)
+        "mid_gather_leaves": (len(matmul_plan)
+                              if impl == "fused" and matmul_plan else 0),
         "wire_error_buckets": len(block_res_widths)
         + len(outer_res_widths),
         "mesh_spec": mesh_spec.describe() if mesh_spec is not None
         else None,
         "longhaul_wire_bits": longhaul_bits,
         "mesh_pipeline_chunks": mesh_pipeline
-        if impl == "hierarchical" else None,
+        if impl in ("hierarchical", "fused") else None,
         "hpz_tiers": None,
     }
-    if impl == "hierarchical" and hpz > 1:
+    if impl in ("hierarchical", "fused") and hpz > 1:
         from ...comm.hierarchical import hpz_tier_dims
+        sub = mesh_spec.zero_subspec()
         plan_info["hpz_tiers"] = [
-            {"axis": mesh_spec.axes[dim].name, "span": span}
+            {"axis": sub.axes[dim].name, "span": span}
             for dim, span in hpz_tier_dims(mesh_spec, hpz)]
     if qrs_ef:
         # non-JSON engine hook: allocates the error-feedback state

@@ -185,6 +185,124 @@ class TestDecomposedTransportAttribution:
             "domino_ring_allreduce_int8", 0) > 0
 
 
+class TestFusedPermuteReconciliation:
+    """ISSUE 18 satellite gate: the fused computation-collective
+    kernels log their in-kernel ring steps as ``op_kind =
+    "fused_permute"`` rows — and those rows must reconcile BYTE-EXACTLY
+    with what the unfused transport of the same payload logs as
+    ``collective_permute`` rows. Fusing the permute into the kernel
+    never makes wire volume silent, and never double-counts it: the
+    default lumped summary excludes fused rows, the widened-``kinds``
+    summary and ``total_axis_bytes`` include them exactly once."""
+
+    def _shards(self):
+        from hcache_deepspeed_tpu.ops.quantized_matmul import \
+            quantize_for_matmul
+        rng = np.random.default_rng(18)
+        w = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+        q, s = quantize_for_matmul(w, 8)          # q [64,16], s [8,16]
+        x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+        return x, q, s
+
+    def test_fused_gather_rows_reconcile_with_unfused_ring(
+            self, eight_devices, comms):
+        from hcache_deepspeed_tpu.comm.ring import ring_all_gather
+        from hcache_deepspeed_tpu.ops.fused_collective_matmul import (
+            FUSED_GATHER_MM_OP, reference_fused_gather_matmul)
+        x, q, s = self._shards()
+
+        def fused(q_sh, s_sh):
+            return reference_fused_gather_matmul(
+                x, q_sh, s_sh, group_k=8, axis_name=DATA_AXIS,
+                shard_dim=0)
+
+        _shmap(fused, (P(DATA_AXIS), P(DATA_AXIS)), P())(q, s)
+        fused_rows = comms.fused_bytes_summary()
+        assert FUSED_GATHER_MM_OP in fused_rows, sorted(fused_rows)
+        assert comms.op_kinds[FUSED_GATHER_MM_OP] == "fused_permute"
+        # fused rows are NOT in the default (collective_permute-only)
+        # lumped summary, ARE in the widened-kinds summary, exactly once
+        assert FUSED_GATHER_MM_OP not in comms.permute_bytes_summary()
+        widened = comms.permute_bytes_summary(
+            kinds=("collective_permute", "fused_permute"))
+        assert widened[FUSED_GATHER_MM_OP] == \
+            fused_rows[FUSED_GATHER_MM_OP]
+        # ...and they land in the wire-cost aggregate under the ring's
+        # axis label
+        assert comms.total_axis_bytes().get(DATA_AXIS, 0) >= \
+            fused_rows[FUSED_GATHER_MM_OP]
+
+        # unfused transport of the SAME payload: the plain ring gather
+        # the bucketed pipeline would run — byte-exact reconciliation
+        comms.reset()
+
+        def unfused(q_sh, s_sh):
+            wq = ring_all_gather(q_sh.reshape(-1), DATA_AXIS,
+                                 op_name="unfused_gather")
+            ws = ring_all_gather(s_sh.reshape(-1), DATA_AXIS,
+                                 op_name="unfused_gather")
+            return wq, ws
+
+        _shmap(unfused, (P(DATA_AXIS), P(DATA_AXIS)),
+               (P(DATA_AXIS), P(DATA_AXIS)))(q, s)
+        unfused_rows = comms.permute_bytes_summary()
+        assert unfused_rows["unfused_gather"] == \
+            fused_rows[FUSED_GATHER_MM_OP], (unfused_rows, fused_rows)
+
+    def test_streamed_schedule_same_wire_bytes(self, eight_devices,
+                                               comms):
+        """The in-flight lane (streamed schedule) moves the SAME bytes
+        as the gather-then-matmul reference twin — overlap changes
+        wall-clock, never wire volume."""
+        from hcache_deepspeed_tpu.ops.fused_collective_matmul import (
+            FUSED_GATHER_MM_OP, reference_fused_gather_matmul,
+            streamed_fused_gather_matmul)
+        x, q, s = self._shards()
+
+        def run(fn):
+            comms.reset()
+            _shmap(lambda q_sh, s_sh: fn(
+                x, q_sh, s_sh, group_k=8, axis_name=DATA_AXIS,
+                shard_dim=0), (P(DATA_AXIS), P(DATA_AXIS)), P())(q, s)
+            return comms.fused_bytes_summary()[FUSED_GATHER_MM_OP]
+
+        assert run(reference_fused_gather_matmul) == \
+            run(streamed_fused_gather_matmul)
+
+    def test_fused_qrs_rows_reconcile_with_ring_a2a(
+            self, eight_devices, comms):
+        from hcache_deepspeed_tpu.comm.ring import \
+            decomposed_all_to_all_rows
+        from hcache_deepspeed_tpu.ops.fused_collective_matmul import (
+            FUSED_QRS_OP, fused_qrs_exchange)
+        rng = np.random.default_rng(7)
+        pay = jnp.asarray(rng.integers(-127, 128, (8, 8, 6)), jnp.int8)
+        sc = jnp.asarray(rng.normal(size=(8, 8, 2)), jnp.float32)
+
+        def fused(p, s):
+            return fused_qrs_exchange(p[0], s[0], axis_name=DATA_AXIS)
+
+        _shmap(fused, (P(DATA_AXIS), P(DATA_AXIS)),
+               (P(DATA_AXIS), P(DATA_AXIS)))(pay, sc)
+        fused_rows = comms.fused_bytes_summary()
+        assert FUSED_QRS_OP in fused_rows, sorted(fused_rows)
+        assert comms.op_kinds[FUSED_QRS_OP] == "fused_permute"
+        comms.reset()
+
+        def unfused(p, s):
+            pt = decomposed_all_to_all_rows(p[0], DATA_AXIS,
+                                            op_name="unfused_a2a")
+            st = decomposed_all_to_all_rows(s[0], DATA_AXIS,
+                                            op_name="unfused_a2a")
+            return pt, st
+
+        _shmap(unfused, (P(DATA_AXIS), P(DATA_AXIS)),
+               (P(DATA_AXIS), P(DATA_AXIS)))(pay, sc)
+        unfused_rows = comms.permute_bytes_summary()
+        assert unfused_rows["unfused_a2a"] == \
+            fused_rows[FUSED_QRS_OP], (unfused_rows, fused_rows)
+
+
 class TestInt4Pack:
 
     def test_roundtrip(self):

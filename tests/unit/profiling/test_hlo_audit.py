@@ -414,3 +414,70 @@ class TestCalibrationSource:
         assert out["pod_axis_sizes"] == {"inter": 16}
         assert out["toy_axis_sizes"] == {"inter": 2}
         assert "assumption" in out
+
+
+# A module with fused-kernel markers (ISSUE 18): the named-scope
+# metadata ``hds_fused_*`` survives into optimized-HLO ``op_name``, and
+# the in-kernel tier scores ONLY the scoped instructions — two scoped
+# ring permutes riding beside a scoped dot and a scoped dot-bearing
+# fusion, with an unscoped permute+dot pair alongside that must not
+# leak into the fused counts.
+FUSED_KERNEL = """
+HloModule fused
+
+%mathy (a: f32[8,8]) -> f32[8,8] {
+  %a = f32[8,8] parameter(0)
+  %dm = f32[8,8] dot(f32[8,8] %a, f32[8,8] %a), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+}
+
+ENTRY %main (p: (f32[8,16], f32[8,8])) -> (f32[8,16], f32[8,8]) {
+  %p = (f32[8,16], f32[8,8]) parameter(0)
+  %shard = f32[8,16] get-tuple-element(%p), index=0
+  %x = f32[8,8] get-tuple-element(%p), index=1
+  %cp1 = f32[8,16] collective-permute(f32[8,16] %shard), source_target_pairs={{0,1},{1,0}}, metadata={op_name="jit(step)/hds_fused_gather_matmul/ppermute"}
+  %cp2 = f32[8,16] collective-permute(f32[8,16] %cp1), source_target_pairs={{0,1},{1,0}}, metadata={op_name="jit(step)/hds_fused_gather_matmul/ppermute"}
+  %cp3 = f32[8,16] collective-permute(f32[8,16] %shard), source_target_pairs={{0,1},{1,0}}
+  %d1 = f32[8,8] dot(f32[8,8] %x, f32[8,8] %x), lhs_contracting_dims={1}, rhs_contracting_dims={1}, metadata={op_name="jit(step)/hds_fused_gather_matmul/dot_general"}
+  %f1 = f32[8,8] fusion(f32[8,8] %x), kind=kOutput, calls=%mathy, metadata={op_name="jit(step)/hds_fused_rs_epilogue/quant"}
+  %d2 = f32[8,8] dot(f32[8,8] %x, f32[8,8] %x), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+  %cc = f32[8,8] custom-call(f32[8,8] %x), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/hds_fused_gather_matmul/pallas_call"}
+  ROOT %out = (f32[8,16], f32[8,8]) tuple(%cp2, %d1)
+}
+"""
+
+
+class TestFusedInKernelTier:
+    """ISSUE 18: the in-kernel tier recognizes ``hds_fused_*``
+    named-scope markers in instruction metadata and scores the permutes
+    a fused kernel SUBSUMES (pairs with scoped dots, incl. dot-bearing
+    fusions), attributing their wire bytes — while unscoped
+    instructions stay invisible to it."""
+
+    def test_scoped_counts_and_pairs(self):
+        rep = audit_hlo_text(FUSED_KERNEL)
+        fk = rep.fused_kernel
+        # cp3 (unscoped) excluded; d2 (unscoped) excluded; f1 counts as
+        # a dot via its dot-bearing called computation
+        assert fk["scoped_permutes"] == 2
+        assert fk["scoped_dots"] == 2
+        assert fk["subsumed_pairs"] == 2
+        assert fk["custom_calls"] == 1
+
+    def test_wire_bytes_attributed_to_scoped_permutes_only(self):
+        rep = audit_hlo_text(FUSED_KERNEL)
+        # two scoped f32[8,16] permutes — the unscoped cp3 is priced by
+        # the permute-chain tier, never by the fused tier
+        assert rep.fused_kernel["wire_bytes"] == 2 * 8 * 16 * 4
+
+    def test_unfused_module_scores_zero(self):
+        rep = audit_hlo_text(RING_BODY)
+        assert rep.fused_kernel["subsumed_pairs"] == 0
+        assert rep.fused_kernel["wire_bytes"] == 0
+
+    def test_row_carries_fused_fields(self):
+        import json
+        row = audit_hlo_text(FUSED_KERNEL).to_row()
+        json.dumps(row)
+        assert row["fused_subsumed_pairs"] == 2
+        assert row["fused_wire_bytes"] == 2 * 8 * 16 * 4
+        assert row["fused_custom_calls"] == 1

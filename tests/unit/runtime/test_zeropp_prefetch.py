@@ -203,7 +203,7 @@ class TestDecomposedTransportMatrix:
                                   steps=3, impl="decomposed",
                                   **zero_extra):
         extra_dec = dict(zero_extra, zero_collective_impl=impl)
-        if impl == "hierarchical":
+        if impl in ("hierarchical", "fused"):
             extra_dec["zero_mesh_shape"] = [2, 4]
         if depth0:
             zero_extra = dict(zero_extra,
@@ -297,6 +297,59 @@ class TestDecomposedTransportMatrix:
             zero_quantized_weights=True,
             zero_quantized_reduce_scatter=True,
             zero_reduce_scatter_error_feedback=True)
+
+    # ---- fused (ISSUE 18) transport: the fused gather-matmul /
+    # reduce-scatter-epilogue kernels behind zero_collective_impl=fused
+    # must be BITWISE-equal to the native transport on every cell —
+    # fp32/bf16 x qwZ / qrs-EF / int4, depth 1 AND depth 0. On
+    # platforms without Pallas the fused paths dispatch to their
+    # reference twins (same assembly, same consumption kernel), so
+    # parity here is the transport-swap contract, not luck.
+    def test_fp32_qwz_fused_depth1(self, eight_devices):
+        self._assert_transport_bitwise(impl="fused",
+                                       zero_quantized_weights=True)
+
+    def test_fp32_qwz_fused_depth0(self, eight_devices):
+        self._assert_transport_bitwise(impl="fused", depth0=True,
+                                       zero_quantized_weights=True)
+
+    def test_bf16_qwz_fused_depth1(self, eight_devices):
+        self._assert_transport_bitwise(bf16=True, impl="fused",
+                                       zero_quantized_weights=True)
+
+    def test_fp32_qwz_fused_matmul_depth1(self, eight_devices):
+        """Mid-gather consumption: qwZ leaves are handed to the Dense
+        kernel as ShardedQuantizedTensor and consumed by the fused
+        gather-matmul — bitwise vs the native gather-then-matmul."""
+        self._assert_transport_bitwise(
+            impl="fused",
+            zero_quantized_weights=True,
+            zero_quantized_weights_fused_matmul=True)
+
+    def test_fp32_qrs_ef_fused_depth1(self, eight_devices):
+        """The fused reduce-scatter epilogue quantizes + error-feeds
+        the cotangent bucket as it folds — same deterministic bucket
+        layout and residual state as the unfused lagged lane."""
+        self._assert_transport_bitwise(
+            impl="fused",
+            zero_quantized_weights=True,
+            zero_quantized_reduce_scatter=True,
+            zero_reduce_scatter_error_feedback=True)
+
+    def test_bf16_qrs_ef_fused_depth0(self, eight_devices):
+        self._assert_transport_bitwise(
+            bf16=True, depth0=True, impl="fused",
+            zero_quantized_weights=True,
+            zero_quantized_reduce_scatter=True,
+            zero_reduce_scatter_error_feedback=True)
+
+    def test_fp32_qrs_int4_fused_depth1(self, eight_devices):
+        self._assert_transport_bitwise(
+            impl="fused",
+            zero_quantized_weights=True,
+            zero_quantized_reduce_scatter=True,
+            zero_reduce_scatter_error_feedback=True,
+            zero_quantized_reduce_scatter_bits=4)
 
 
 class TestGradAccumulation:
