@@ -1708,6 +1708,56 @@ def run_request_trace(out_path="REQUEST_TRACE.jsonl"):
     return 0 if ok else 4
 
 
+def run_autoscale(out_path="AUTOSCALE_SERVE.jsonl"):
+    """``--autoscale``: SLO-driven elastic autoscaling audit — the
+    hysteresis control loop over the bursty diurnal multi-tenant
+    trace, with scale events as a first-class failure domain
+    (docs/serving.md). Gates inline: 2-run digest determinism with
+    the autoscaler active, SLO attainment >= the best static fleet of
+    equal peak size at strictly lower replica-step cost, every scale
+    event span-verified through the causal trace DAG, scale-event
+    chaos (aborted bootstrap / mid-drain crash / faulted pre-warm)
+    with byte-identical replays, and a process-mode leg where a real
+    worker is spawned by scale-up (first spawn killed and recovered)
+    and reaped on drain-retirement. Self-compares against the
+    committed perf trajectory before writing. Never touches the TPU
+    relay."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from hcache_deepspeed_tpu.inference.benchmark import \
+        run_autoscale_serve
+    try:
+        results = run_autoscale_serve(out=out_path)
+    except RuntimeError as exc:
+        print(json.dumps(_error_payload(
+            f"autoscale gate failed: {exc}")), flush=True)
+        _DONE.set()
+        return 4
+    summary = next(r for r in results
+                   if r.get("phase") == "autoscale-summary")
+    _DONE.set()
+    print(json.dumps({
+        "metric": "elastic autoscaling: SLO attainment at lower cost "
+                  "than the equal-peak static fleet",
+        "value": summary["slo_attainment"],
+        "unit": "attainment fraction",
+        "vs_baseline": 1.0 if summary["invariants_ok"] and
+        summary["deterministic"] else 0.0,
+        "extra": {k: summary[k] for k in
+                  ("deterministic", "slo_vs_static_ok",
+                   "cost_vs_static_ok", "cost_savings_fraction",
+                   "cost_replica_steps", "static_peak_cost",
+                   "scale_ups", "retires_completed", "flaps",
+                   "scale_events_span_verified",
+                   "chaos_deterministic", "chaos_invariants_ok",
+                   "process_ok", "trace_connected")},
+    }), flush=True)
+    ok = (summary["invariants_ok"] and summary["deterministic"] and
+          summary["slo_vs_static_ok"] and
+          summary["cost_vs_static_ok"] and
+          summary["chaos_invariants_ok"] and summary["process_ok"])
+    return 0 if ok else 4
+
+
 def main():
     if "--zero-overlap" in sys.argv[1:]:
         return run_zero_overlap()
@@ -1723,6 +1773,8 @@ def main():
         return run_fabric()
     if "--request-trace" in sys.argv[1:]:
         return run_request_trace()
+    if "--autoscale" in sys.argv[1:]:
+        return run_autoscale()
     child = os.environ.get("HDS_BENCH_CHILD")
     if child or os.environ.get("HDS_BENCH_TINY") == "1":
         # child / smoke mode: measure exactly one config in-process

@@ -16,7 +16,8 @@ from .frame import (FRAME_VERSION, Frame, FrameError,
                     FrameVersionError, decode_frame, dequantize_q8,
                     encode_frame, quantize_q8)
 from .process import ProcessTransport
-from .transport import (InMemoryTransport, ReplicaTransport,
+from .transport import (FabricTimeout, InMemoryTransport,
+                        ReplicaTransport, ScaleBootstrapError,
                         WorkerDied, apply_frame, canonical_digest,
                         migration_frame)
 
@@ -24,6 +25,7 @@ __all__ = [
     "FRAME_VERSION", "Frame", "FrameError", "FrameVersionError",
     "decode_frame", "encode_frame", "quantize_q8", "dequantize_q8",
     "ReplicaTransport", "InMemoryTransport", "ProcessTransport",
+    "FabricTimeout", "ScaleBootstrapError",
     "WorkerDied", "migration_frame", "apply_frame",
     "canonical_digest",
 ]

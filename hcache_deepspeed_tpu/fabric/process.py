@@ -44,7 +44,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from .frame import Frame, decode_frame, encode_frame
-from .transport import (ReplicaTransport, apply_frame,
+from .transport import (FabricTimeout, ReplicaTransport,
+                        ScaleBootstrapError, apply_frame,
                         canonical_digest, migration_frame)
 from .worker import recv_frame_bytes, send_frame_bytes
 
@@ -95,10 +96,18 @@ class ProcessTransport(ReplicaTransport):
     def __init__(self, spawn_timeout_s: float = 120.0,
                  io_timeout_s: float = 60.0,
                  harvest_telemetry: bool = True,
-                 harvest_every: int = 16):
+                 harvest_every: int = 16,
+                 spawn_retries: int = 3,
+                 spawn_backoff_s: float = 0.2):
         super().__init__()
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.io_timeout_s = float(io_timeout_s)
+        #: bounded scale-up bring-up: how many spawn+bootstrap
+        #: attempts one ``on_replica_added`` makes before raising
+        #: :class:`~.transport.ScaleBootstrapError`, and the linear
+        #: backoff between attempts
+        self.spawn_retries = max(1, int(spawn_retries))
+        self.spawn_backoff_s = float(spawn_backoff_s)
         #: telemetry-harvest plane on/off. MUST be digest-invisible:
         #: harvest RPCs ride the control channel between fleet work,
         #: touch only parent-side caches, and never enter fleet event
@@ -122,6 +131,11 @@ class ProcessTransport(ReplicaTransport):
         self.worker_hops = 0
         self.kills = 0
         self.bootstrap_mismatches = 0
+        self.io_timeouts = 0
+        # scale-event lifecycle accounting
+        self.scale_spawns = 0
+        self.scale_spawn_failures = 0
+        self.scale_retired = 0
         # telemetry-harvest accounting (also wall clock; the overhead
         # fraction FABRIC_OBS gates is harvest_seconds / leg wall time)
         self.harvests = 0
@@ -141,31 +155,54 @@ class ProcessTransport(ReplicaTransport):
         srv.bind(("127.0.0.1", 0))
         srv.listen(len(self.fleet.replicas) + 4)
         self._srv = srv
-        port = srv.getsockname()[1]
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
         for r in self.fleet.replicas:
-            # -c entry (not -m): the package __init__ already imports
-            # .worker, and runpy warns when re-executing such a module
-            proc = subprocess.Popen(
-                [sys.executable, "-c",
-                 "import sys; "
-                 "from hcache_deepspeed_tpu.fabric.worker import main; "
-                 "sys.exit(main(sys.argv[1:]))",
-                 "127.0.0.1", str(port), str(r.id)],
-                env=env, stdout=subprocess.DEVNULL)
-            self.workers[r.id] = WorkerHandle(r.id, proc)
+            self._spawn_proc(r.id)
         deadline = _deadline(self.spawn_timeout_s)
-        pending = set(self.workers)
+        pending = {rid for rid, h in self.workers.items()
+                   if h.conn is None}
         while pending:
-            remaining = deadline - _deadline(0.0)
-            if remaining <= 0:
+            try:
+                rid = self._accept_one(deadline, "spawn")
+            except FabricTimeout:
                 self.close()
                 raise RuntimeError(
                     f"fabric workers {sorted(pending)} missed the "
                     f"{self.spawn_timeout_s:.0f}s spawn deadline")
-            srv.settimeout(remaining)
+            pending.discard(rid)
+        self._started = True
+        self._bootstrap_all()
+
+    def _spawn_proc(self, rid: int) -> "WorkerHandle":
+        """Launch one worker process (no handshake yet) and register
+        its handle, replacing any dead prior handle for the id."""
+        port = self._srv.getsockname()[1]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # -c entry (not -m): the package __init__ already imports
+        # .worker, and runpy warns when re-executing such a module
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; "
+             "from hcache_deepspeed_tpu.fabric.worker import main; "
+             "sys.exit(main(sys.argv[1:]))",
+             "127.0.0.1", str(port), str(rid)],
+            env=env, stdout=subprocess.DEVNULL)
+        h = WorkerHandle(rid, proc)
+        self.workers[rid] = h
+        return h
+
+    def _accept_one(self, deadline: float, op: str) -> int:
+        """Accept ONE worker handshake on the (persistent) server
+        socket before ``deadline`` and wire up its handle; returns the
+        replica id that connected. Raises
+        :class:`~.transport.FabricTimeout` past the deadline — a
+        worker that never dials in must not wedge the parent."""
+        while True:
+            remaining = deadline - _deadline(0.0)
+            if remaining <= 0:
+                raise FabricTimeout(-1, op, self.spawn_timeout_s)
+            self._srv.settimeout(remaining)
             try:
-                conn, _ = srv.accept()
+                conn, _ = self._srv.accept()
             except socket.timeout:
                 continue
             conn.settimeout(self.io_timeout_s)
@@ -173,28 +210,117 @@ class ProcessTransport(ReplicaTransport):
                             socket.TCP_NODELAY, 1)
             hello = decode_frame(recv_frame_bytes(conn))
             rid = int(hello.header["replica"])
-            h = self.workers[rid]
+            h = self.workers.get(rid)
+            if h is None:
+                conn.close()
+                continue
             h.conn = conn
             h.peer_port = int(hello.header["peer_port"])
-            pending.discard(rid)
-        self._started = True
-        self._bootstrap_all()
+            return rid
 
     def _bootstrap_all(self) -> None:
         """Ship each replica's engine snapshot to its worker and gate
         on digest parity: the worker's re-serialization must hash
         identically to the parent's snapshot."""
         for r in self.fleet.replicas:
-            eng = r.engine
-            if not hasattr(eng, "serialize"):
-                continue
-            snap = eng.serialize()
-            reply = self._rpc(r.id, encode_frame(
-                "bootstrap", {"snapshot": snap}))
-            if reply.header.get("digest") != canonical_digest(snap):
-                self.bootstrap_mismatches += 1
-            self.workers[r.id].bootstrap_digest = \
-                str(reply.header.get("digest", ""))
+            self._bootstrap_one(r)
+
+    def _bootstrap_one(self, r, strict: bool = False) -> None:
+        eng = r.engine
+        if not hasattr(eng, "serialize"):
+            return
+        snap = eng.serialize()
+        reply = self._rpc(r.id, encode_frame(
+            "bootstrap", {"snapshot": snap}), op="bootstrap")
+        digest = reply.header.get("digest")
+        if digest != canonical_digest(snap):
+            self.bootstrap_mismatches += 1
+            if strict:
+                raise ConnectionError(
+                    f"replica {r.id} bootstrap digest mismatch")
+        self.workers[r.id].bootstrap_digest = str(digest or "")
+
+    # ----------------------------------------------------------- #
+    # scale-event lifecycle (fleet add/retire hooks)
+    # ----------------------------------------------------------- #
+    def on_replica_added(self, replica) -> None:
+        """Bring up a supervised worker for a scale-up: spawn +
+        handshake + strict bootstrap under a bounded retry with linear
+        backoff. Every failure mode — the process dying, a wedged
+        handshake (:class:`~.transport.FabricTimeout`), a bootstrap
+        digest mismatch, the ``scale.spawn`` chaos kill — burns one
+        attempt; exhausting them raises
+        :class:`~.transport.ScaleBootstrapError`, which the fleet
+        turns into a clean scale-up abort (prior shape, zero requests
+        touched)."""
+        if not self._started:
+            return
+        from ..resilience.faults import InjectedFault, get_injector
+        rid = replica.id
+        last = ""
+        for attempt in range(1, self.spawn_retries + 1):
+            h = self._spawn_proc(rid)
+            try:
+                inj = get_injector()
+                if inj.enabled:
+                    try:
+                        inj.fire("scale.spawn", replica=rid,
+                                 attempt=attempt)
+                    except InjectedFault:
+                        # chaos: the worker is killed mid-scale-up,
+                        # after spawn but before it ever bootstraps
+                        raise ConnectionError(
+                            f"replica {rid} worker killed "
+                            f"mid-scale-up (injected)")
+                deadline = _deadline(self.spawn_timeout_s)
+                while self._accept_one(deadline,
+                                       "scale-spawn") != rid:
+                    pass
+                self._bootstrap_one(replica, strict=True)
+                self.scale_spawns += 1
+                return
+            except (FabricTimeout, ConnectionError, OSError) as exc:
+                last = repr(exc)
+                self.scale_spawn_failures += 1
+                self._reap(h)
+                if attempt < self.spawn_retries:
+                    time.sleep(self.spawn_backoff_s * attempt)
+        raise ScaleBootstrapError(rid, self.spawn_retries, last)
+
+    def on_replica_retired(self, replica_id: int) -> None:
+        """Reap a retired replica's worker — called by the fleet only
+        AFTER its drain landed, so the process never dies holding
+        request state. Final telemetry harvest, polite exit frame,
+        then terminate/kill under the supervision deadline."""
+        h = self.workers.get(replica_id)
+        if h is None:
+            return
+        if self.harvest_telemetry and h.alive and h.conn is not None:
+            self.harvest(replica_id)
+        if h.conn is not None and h.alive:
+            try:
+                h.conn.settimeout(2.0)
+                send_frame_bytes(h.conn, encode_frame("exit", {}))
+                recv_frame_bytes(h.conn)
+            except (OSError, ConnectionError):
+                pass
+        self._reap(h)
+        self.scale_retired += 1
+
+    def _reap(self, h: "WorkerHandle") -> None:
+        """Tear one worker down hard: close its control socket and
+        make sure the process is gone."""
+        if h.conn is not None:
+            h.conn.close()
+            h.conn = None
+        if h.proc.poll() is None:
+            h.proc.terminate()
+            try:
+                h.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait()
+        h.dead = True
 
     def close(self) -> None:
         if self._started and self.harvest_telemetry:
@@ -259,13 +385,27 @@ class ProcessTransport(ReplicaTransport):
     # ----------------------------------------------------------- #
     # data path
     # ----------------------------------------------------------- #
-    def _rpc(self, replica_id: int, frame_bytes: bytes) -> Frame:
+    def _rpc(self, replica_id: int, frame_bytes: bytes,
+             op: str = "rpc") -> Frame:
+        """One control-channel round trip. EVERY blocking read here
+        sits behind the connection's ``io_timeout_s`` deadline: a
+        wedged worker (SIGSTOP'd, livelocked) raises a typed
+        :class:`~.transport.FabricTimeout` instead of hanging the
+        parent forever. ``FabricTimeout`` subclasses ``OSError``, so
+        the delivery path's wire-failure fallback handles it like a
+        dead worker while bootstrap/harvest callers see the type."""
         h = self.workers[replica_id]
         if h.conn is None or not h.alive:
             raise ConnectionError(
                 f"replica {replica_id} worker is down")
-        send_frame_bytes(h.conn, frame_bytes)
-        return decode_frame(recv_frame_bytes(h.conn))
+        try:
+            send_frame_bytes(h.conn, frame_bytes)
+            return decode_frame(recv_frame_bytes(h.conn))
+        except socket.timeout as exc:
+            self.io_timeouts += 1
+            raise FabricTimeout(
+                replica_id, op,
+                h.conn.gettimeout() or self.io_timeout_s) from exc
 
     def ship(self, m) -> int:
         ticket = self._next_ticket
@@ -293,7 +433,7 @@ class ProcessTransport(ReplicaTransport):
                     {"peer_port": self.workers[dst].peer_port,
                      "uid": int(m.uid)},
                     arrays={"inner": np.frombuffer(inner, np.uint8)})
-                reply = self._rpc(m.src, wrapped)
+                reply = self._rpc(m.src, wrapped, op="deliver")
                 inner_reply = reply.arrays["inner"].tobytes()
                 hops = 2
                 self.two_hop_deliveries += 1
@@ -301,7 +441,7 @@ class ProcessTransport(ReplicaTransport):
                 inner_reply = None
                 hops = 1
             if inner_reply is None:
-                reply_frame = self._rpc(dst, inner)
+                reply_frame = self._rpc(dst, inner, op="deliver")
                 self.direct_deliveries += 1
             else:
                 reply_frame = decode_frame(inner_reply)
@@ -364,7 +504,7 @@ class ProcessTransport(ReplicaTransport):
         try:
             sent_us = parent.now_us()
             reply = self._rpc(replica_id, encode_frame(
-                "telemetry", {"t_send_us": sent_us}))
+                "telemetry", {"t_send_us": sent_us}), op="harvest")
             recv_us = parent.now_us()
         except (ConnectionError, OSError):
             self._mark_dead_conns()
@@ -431,7 +571,8 @@ class ProcessTransport(ReplicaTransport):
     def snapshot_digest(self, replica_id: int) -> str:
         """Current engine-snapshot digest from the worker side (test /
         audit surface)."""
-        reply = self._rpc(replica_id, encode_frame("snapshot", {}))
+        reply = self._rpc(replica_id, encode_frame("snapshot", {}),
+                          op="snapshot")
         return str(reply.header.get("digest", ""))
 
     def wire_stats(self) -> Dict:
@@ -450,6 +591,10 @@ class ProcessTransport(ReplicaTransport):
             "worker_hops": self.worker_hops,
             "kills": self.kills,
             "bootstrap_mismatches": self.bootstrap_mismatches,
+            "io_timeouts": self.io_timeouts,
+            "scale_spawns": self.scale_spawns,
+            "scale_spawn_failures": self.scale_spawn_failures,
+            "scale_retired": self.scale_retired,
             "wire_bytes": self.wire_bytes,
             "wire_seconds": round(self.wire_seconds, 6),
             "measured_wire_bytes_per_s": round(bps, 3),

@@ -44,6 +44,37 @@ def canonical_digest(obj) -> str:
     ).hexdigest()
 
 
+class FabricTimeout(OSError):
+    """A blocking control-socket read exceeded its deadline: the
+    worker on the other end is wedged (SIGSTOP'd, livelocked) rather
+    than dead. Subclasses :class:`OSError` deliberately — the delivery
+    path's existing socket-failure handling treats a wedged worker
+    like a broken one (graceful local fallback) while bootstrap/
+    harvest callers see the typed error."""
+
+    def __init__(self, replica: int, op: str, seconds: float):
+        super().__init__(
+            f"replica {replica} {op} exceeded {seconds:.1f}s deadline")
+        self.replica = replica
+        self.op = op
+        self.seconds = seconds
+
+
+class ScaleBootstrapError(RuntimeError):
+    """A scale-up's worker could not be brought up: every bounded
+    spawn+bootstrap attempt failed (crash, digest mismatch, or
+    :class:`FabricTimeout`). The fleet aborts the scale-up cleanly
+    back to its prior shape when it sees this."""
+
+    def __init__(self, replica: int, attempts: int, last_error: str):
+        super().__init__(
+            f"replica {replica} bootstrap failed after {attempts} "
+            f"attempt(s): {last_error}")
+        self.replica = replica
+        self.attempts = attempts
+        self.last_error = last_error
+
+
 class WorkerDied(Exception):
     """A replica's worker process is gone (crashed or killed): the
     engine and its KV died with it. Shaped like an injected fault
@@ -169,6 +200,23 @@ class ReplicaTransport:
     def on_replica_dead(self, replica_id: int) -> None:
         """Fleet hook: replica ``replica_id`` just crashed in the
         fleet's view — reap whatever backs it. No-op by default."""
+
+    def on_replica_added(self, replica) -> None:
+        """Fleet hook: a scale-up wants ``replica`` brought up on this
+        transport BEFORE the fleet commits the membership change. A
+        process transport spawns + bootstraps a supervised worker here
+        (bounded retry + typed timeout) and raises
+        :class:`ScaleBootstrapError` when it gives up — the fleet then
+        aborts the scale-up with zero state mutated. No-op by default
+        (the in-memory transport has nothing to spawn), which keeps
+        fixed-membership digests untouched."""
+
+    def on_replica_retired(self, replica_id: int) -> None:
+        """Fleet hook: replica ``replica_id``'s drain-to-retirement
+        just completed (every resident migrated out) — reap whatever
+        backs it. Called strictly AFTER the drain lands, so a process
+        worker is never killed while still holding request state.
+        No-op by default."""
 
     def wire_stats(self) -> Dict:
         """Measured-wire accounting (wall-clock side; empty for the

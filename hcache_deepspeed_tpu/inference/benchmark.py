@@ -2338,6 +2338,383 @@ def run_request_trace(seed=0, runs=2, out="REQUEST_TRACE.jsonl",
     return results
 
 
+def run_autoscale_serve(seed=7, n_requests=800, horizon_s=20.0,
+                        runs=2, out="AUTOSCALE_SERVE.jsonl"):
+    """``--autoscale``: SLO-driven elastic autoscaling audit — the
+    hysteresis control loop (``serving/autoscale.py``) over the bursty
+    diurnal multi-tenant trace, with scale events treated as a
+    first-class failure domain. The artifact IS the acceptance
+    evidence; gates run inline:
+
+    * ``autoscale-main`` — the autoscaled fleet serves the seeded
+      trace ``runs`` times gating byte-identical event digests. Every
+      scale event must be span-verified through the causal trace DAG:
+      each ``fleet.scale_up`` / ``fleet.retire`` async span opened by
+      the fleet must close with a terminal status, and the span counts
+      must equal the fleet's scale counters. Per-request trace DAGs
+      stay connected across migrations caused by drain-retirement.
+    * ``autoscale-static`` — the SAME trace through static fleets at
+      the start size and at the autoscaler's peak size. Gates: SLO
+      attainment (TTFT <= threshold over DONE requests) >= the best
+      static fleet of equal peak size, at strictly lower cost
+      (replica-steps actually consumed).
+    * ``autoscale-chaos`` — ``resilience.run_autoscale_chaos`` twice:
+      scale-up killed mid-bootstrap, replica crashed mid-drain, faulted
+      pre-warm; identical digests + all invariants.
+    * ``autoscale-process`` — ProcessTransport leg: a REAL worker
+      process is spawned by scale-up with the first spawn killed by an
+      injected ``scale.spawn`` fault (supervised retry recovers), and
+      the retired replica's worker is reaped only after its drain
+      lands. Zero requests lost.
+
+    CPU-only, virtual-clock deterministic in every gated field."""
+    from ..fabric import ProcessTransport, canonical_digest
+    from ..resilience import (FaultPlan, FaultRule, injected,
+                              run_autoscale_chaos)
+    from ..resilience.chaos import _trace_gates
+    from ..serving import (AutoscaleConfig, Autoscaler, FleetConfig,
+                           PrefixReuseConfig, RequestState,
+                           ServerConfig, ServingFleet,
+                           SimulatedEngine, VirtualClock,
+                           build_autoscale_trace)
+    from ..serving.spec import SLOModeConfig
+    from ..telemetry.tracer import get_tracer
+    from .config import RaggedInferenceEngineConfig
+
+    results = []
+    fh = open(out, "w") if out else None
+
+    def emit(row):
+        results.append(row)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if fh is not None:
+            fh.write(line + "\n")
+            fh.flush()
+
+    violations = []
+
+    def make_engine():
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 64},
+            kv_cache={"block_size": 8, "num_blocks": 16},
+            hcache={"enable_latents": True}))
+
+    slo_ttft_s = 1.0
+    start_replicas, peak_replicas = 2, 4
+
+    def make_fleet(n):
+        return ServingFleet(
+            engine_factory=make_engine,
+            clock=VirtualClock(),
+            config=FleetConfig(
+                n_replicas=n,
+                server=ServerConfig(max_queue_depth=n_requests + 1,
+                                    kv_demand_fraction=float("inf"),
+                                    slo_mode=SLOModeConfig()),
+                prefix=PrefixReuseConfig(broadcast=True,
+                                         min_adopt_tokens=4)))
+
+    def make_trace():
+        return build_autoscale_trace(seed=seed, n_requests=n_requests,
+                                     horizon_s=horizon_s,
+                                     new_tokens=(8, 16))
+
+    def score(fleet, reqs):
+        done = [r for r in reqs if r.state is RequestState.DONE]
+        attained = [r for r in done
+                    if r.ttft() is not None
+                    and r.ttft() <= slo_ttft_s]
+        cost = sum(rep.steps for rep in fleet.replicas)
+        return {"done": len(done),
+                "slo_attainment": round(len(attained)
+                                        / max(1, len(reqs)), 6),
+                "cost_replica_steps": cost}
+
+    def drive_auto():
+        fleet = make_fleet(start_replicas)
+        asc = Autoscaler(fleet, AutoscaleConfig(
+            min_replicas=1, max_replicas=peak_replicas,
+            hot_steps=2, calm_steps=60, cooldown_steps=40,
+            flap_window_steps=60))
+        reqs = make_trace()
+        summary = asc.run(reqs)
+        return fleet, asc, reqs, summary, \
+            canonical_digest(fleet.event_log())
+
+    # ------------- phase 1: autoscaled serve + spans --------------- #
+    # every run traced (the crossover model mines the span buffer when
+    # the tracer is on; mixing traced/untraced runs would change the
+    # digest) at a capacity that cannot displace scale-event spans
+    tracer = get_tracer()
+    was = tracer.enabled
+    cap_was = tracer._capacity
+    tracer.configure(enabled=True, capacity=1 << 20)
+    auto_runs = []
+    span_events = None
+    try:
+        for _ in range(max(1, runs)):
+            tracer.clear()
+            auto_runs.append(drive_auto())
+            if span_events is None:
+                span_events = tracer.events()
+        digests = [d for *_, d in auto_runs]
+        deterministic = len(set(digests)) == 1
+        fleet, asc, reqs, summary, digest = auto_runs[0]
+
+        # span-verify every scale event through the trace DAG: each
+        # fleet.scale_up / fleet.retire async begin pairs with exactly
+        # one terminal-status end, and span counts match the counters
+        def _async(names):
+            by = {}
+            for e in span_events:
+                if e.get("name") in names and e.get("ph") in ("b", "e"):
+                    key = (e["name"], e.get("cat"), e.get("id"))
+                    by.setdefault(key, []).append(e)
+            return by
+        spans = _async({"fleet.scale_up", "fleet.retire"})
+        # the same replica id may scale up / retire repeatedly, so a
+        # key holds an interleaved history — it must strictly
+        # alternate b, e, b, e, ... and close
+        unpaired = sorted(
+            k[0] + ":" + str(k[2]) for k, evs in spans.items()
+            if [x["ph"] for x in evs]
+            != ["b", "e"] * (len(evs) // 2) or len(evs) % 2)
+        statuses = sorted(
+            (e.get("args") or {}).get("status", "?")
+            for evs in spans.values() for e in evs
+            if e["ph"] == "e")
+        c = fleet.counters
+        n_up_spans = sum(
+            1 for k, evs in spans.items() for e in evs
+            if k[0] == "fleet.scale_up" and e["ph"] == "b")
+        n_ret_spans = sum(
+            1 for k, evs in spans.items() for e in evs
+            if k[0] == "fleet.retire" and e["ph"] == "b")
+        span_counts_agree = (
+            n_up_spans == c["scale_ups"] + c["scale_up_aborts"]
+            and n_ret_spans == c["retires"])
+        scale_events_span_verified = (
+            not unpaired and span_counts_agree
+            and n_up_spans >= 1 and n_ret_spans >= 1
+            and all(s in ("ready", "aborted", "completed", "crashed")
+                    for s in statuses))
+        if tracer.dropped:
+            violations.append(
+                f"autoscale-main: tracer displaced {tracer.dropped} "
+                "events — span verification is not trustworthy")
+    finally:
+        tracer.configure(enabled=was, capacity=cap_was)
+
+    trace_inv = _trace_gates(reqs, violations)
+    auto_score = score(fleet, reqs)
+    if not deterministic:
+        violations.append(
+            f"autoscale-main: digests diverged across "
+            f"{len(digests)} runs")
+    if unpaired:
+        violations.append(
+            f"autoscale-main: unpaired scale spans {unpaired}")
+    if not span_counts_agree:
+        violations.append(
+            f"autoscale-main: scale spans ({n_up_spans} up, "
+            f"{n_ret_spans} retire) disagree with counters "
+            f"(ups {c['scale_ups']}+{c['scale_up_aborts']} aborted, "
+            f"retires {c['retires']})")
+    if not scale_events_span_verified:
+        violations.append(
+            "autoscale-main: scale events not span-verified "
+            f"(statuses {statuses})")
+    if c["scale_ups"] < 1 or c["retires_completed"] < 1:
+        violations.append(
+            "autoscale-main: the trace never exercised a full "
+            f"scale-up + drain-retirement cycle ({dict(c)})")
+    if asc.flaps > asc.config.max_flaps:
+        violations.append(
+            f"autoscale-main: flap bound {asc.flaps} > "
+            f"{asc.config.max_flaps}")
+    for step, action, detail in asc.decisions:
+        emit({"phase": "autoscale-decision", "step": step,
+              "action": action, "detail": detail})
+    emit({"phase": "autoscale-main", "seed": seed,
+          "n_requests": n_requests, "runs": len(auto_runs),
+          "deterministic": deterministic,
+          "event_digest": digest,
+          "scale_ups": c["scale_ups"],
+          "scale_up_aborts": c["scale_up_aborts"],
+          "retires": c["retires"],
+          "retires_completed": c["retires_completed"],
+          "reroles": c["reroles"],
+          "prewarm_broadcasts": c["prewarm_broadcasts"],
+          "flaps": asc.flaps,
+          "replicas_final": len(fleet.replicas),
+          "replicas_live": fleet.live_replicas,
+          "scale_events_span_verified": scale_events_span_verified,
+          "span_statuses": statuses,
+          "trace": trace_inv,
+          **auto_score})
+
+    # ------------- phase 2: vs static fleets ----------------------- #
+    statics = {}
+    for n in (start_replicas, peak_replicas):
+        sfleet = make_fleet(n)
+        sreqs = make_trace()
+        sfleet.run_trace(sreqs)
+        statics[n] = score(sfleet, sreqs)
+        emit({"phase": "autoscale-static", "seed": seed,
+              "n_replicas": n, **statics[n]})
+    peak = statics[peak_replicas]
+    slo_vs_static_ok = (auto_score["slo_attainment"]
+                        >= peak["slo_attainment"])
+    cost_vs_static_ok = (auto_score["cost_replica_steps"]
+                         < peak["cost_replica_steps"])
+    savings = 1.0 - (auto_score["cost_replica_steps"]
+                     / max(1, peak["cost_replica_steps"]))
+    if not slo_vs_static_ok:
+        violations.append(
+            f"autoscale-static: attainment "
+            f"{auto_score['slo_attainment']} < static-{peak_replicas}"
+            f" {peak['slo_attainment']}")
+    if not cost_vs_static_ok:
+        violations.append(
+            f"autoscale-static: cost "
+            f"{auto_score['cost_replica_steps']} not strictly below "
+            f"static-{peak_replicas} {peak['cost_replica_steps']}")
+
+    # ------------- phase 3: scale-event chaos ---------------------- #
+    chaos = [run_autoscale_chaos(seed=seed)
+             for _ in range(max(1, runs))]
+    chaos_det = len({x.event_digest for x in chaos}) == 1
+    violations.extend(f"autoscale-chaos: {v}"
+                      for x in chaos for v in x.violations)
+    if not chaos_det:
+        violations.append(
+            "autoscale-chaos: digests diverged across runs")
+    emit({"phase": "autoscale-chaos", "seed": seed,
+          "runs": len(chaos),
+          "deterministic": chaos_det,
+          "event_digest": chaos[0].event_digest,
+          "ok": all(x.ok for x in chaos),
+          "fault_fired": chaos[0].invariants["fault_fired"],
+          "invariants": chaos[0].invariants})
+
+    # ------------- phase 4: process-mode scale lifecycle ----------- #
+    pfleet = ServingFleet(
+        engine_factory=make_engine,
+        clock=VirtualClock(),
+        config=FleetConfig(
+            n_replicas=start_replicas,
+            server=ServerConfig(max_queue_depth=n_requests + 1,
+                                kv_demand_fraction=float("inf")),
+            prefix=PrefixReuseConfig(broadcast=True,
+                                     min_adopt_tokens=4),
+            transport=ProcessTransport()))
+    preqs = build_autoscale_trace(seed=seed, n_requests=48,
+                                  horizon_s=3.0, new_tokens=(6, 10))
+    spawn_kill = FaultPlan(seed=seed, rules=[
+        FaultRule("scale.spawn", at_hits=(1,), max_faults=1)])
+    with injected(spawn_kill) as inj:
+        with pfleet.transport:
+            arrivals = sorted(preqs,
+                              key=lambda r: (r.arrival_time, r.uid))
+            steps = 0
+            new_rid = None
+            while arrivals or pfleet.has_work:
+                now = pfleet.clock.now()
+                while arrivals and arrivals[0].arrival_time <= now:
+                    pfleet.submit(request=arrivals.pop(0))
+                if not pfleet.has_work and arrivals:
+                    pfleet.clock.advance_to(arrivals[0].arrival_time)
+                    continue
+                pfleet.step()
+                steps += 1
+                if steps == 4:
+                    # scale-up mid-trace: first spawn is killed by the
+                    # injected fault, the supervisor must retry
+                    new_rid = pfleet.add_replica()
+                if steps == 12 and new_rid is not None:
+                    pfleet.retire_replica(new_rid)
+                if steps > 1_000_000:
+                    raise RuntimeError("autoscale process livelock:\n"
+                                       + pfleet.snapshot())
+            pwire = pfleet.transport.wire_stats()
+        spawn_fired = dict(inj.fired)
+    terminal = {"DONE", "REJECTED", "FAILED"}
+    lost = [r.uid for r in preqs if r.state.name not in terminal]
+    process_ok = True
+    if new_rid is None:
+        process_ok = False
+        violations.append("autoscale-process: scale-up never ran")
+    if spawn_fired.get("scale.spawn", 0) < 1 \
+            or pwire["scale_spawn_failures"] < 1:
+        process_ok = False
+        violations.append(
+            "autoscale-process: the mid-scale-up kill never fired "
+            f"({spawn_fired}, {pwire['scale_spawn_failures']} spawn "
+            "failures)")
+    if pwire["scale_spawns"] < 1:
+        process_ok = False
+        violations.append(
+            "autoscale-process: no worker spawned by scale-up")
+    if pwire["scale_retired"] < 1:
+        process_ok = False
+        violations.append(
+            "autoscale-process: retired worker never reaped")
+    if lost:
+        process_ok = False
+        violations.append(
+            f"autoscale-process: requests lost {lost}")
+    if not pfleet.migration_balance_ok or pfleet.in_transit:
+        process_ok = False
+        violations.append(
+            "autoscale-process: migration imbalance "
+            f"({dict(pfleet.counters)})")
+    emit({"phase": "autoscale-process", "seed": seed,
+          "n_requests": len(preqs),
+          "new_replica": new_rid,
+          "process_ok": process_ok,
+          "scale_spawns": pwire["scale_spawns"],
+          "scale_spawn_failures": pwire["scale_spawn_failures"],
+          "scale_retired": pwire["scale_retired"],
+          "io_timeouts": pwire["io_timeouts"],
+          "fault_fired": spawn_fired,
+          "counters": dict(pfleet.counters)})
+
+    emit({"phase": "autoscale-summary", "seed": seed,
+          "n_requests": n_requests, "runs": len(auto_runs),
+          "deterministic": deterministic,
+          "event_digest": digest,
+          "slo_attainment": auto_score["slo_attainment"],
+          "cost_replica_steps": auto_score["cost_replica_steps"],
+          "static_peak_attainment": peak["slo_attainment"],
+          "static_peak_cost": peak["cost_replica_steps"],
+          "slo_vs_static_ok": slo_vs_static_ok,
+          "cost_vs_static_ok": cost_vs_static_ok,
+          "cost_savings_fraction": round(savings, 6),
+          "scale_ups": c["scale_ups"],
+          "retires_completed": c["retires_completed"],
+          "flaps": asc.flaps,
+          "scale_events_span_verified": scale_events_span_verified,
+          "chaos_deterministic": chaos_det,
+          "chaos_invariants_ok": all(x.ok for x in chaos),
+          "process_ok": process_ok,
+          "trace_connected": trace_inv["connected"],
+          "invariants_ok": not violations,
+          "violations": violations})
+
+    from ..perf import self_check_rows
+    emit(self_check_rows(out or "AUTOSCALE_SERVE.jsonl", results))
+    if fh is not None:
+        fh.close()
+    if violations:
+        raise RuntimeError(
+            f"autoscale serve gates violated: {violations}")
+    return results
+
+
 def run(model_size="tiny", max_context=512, prompt_len=128,
         decode_steps=64, batches=(1, 4, 8), quantize="",
         prefill_chunk=0, fused=False, lookup=False):

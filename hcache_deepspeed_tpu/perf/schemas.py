@@ -896,6 +896,57 @@ def parse_fabric_obs(text: str, file: str) -> List[MetricPoint]:
     return pts
 
 
+def parse_autoscale_serve(text: str, file: str) -> List[MetricPoint]:
+    """AUTOSCALE_SERVE.jsonl: the elastic-autoscaling audit
+    (``bench.py --autoscale``) — the hysteresis control loop vs static
+    fleets on the bursty multi-tenant trace, scale-event chaos, and
+    the process-mode spawn/reap leg. The boolean gates are hard
+    (rel=0.0 in TOLERANCES); SLO attainment and the cost-savings
+    fraction are the headline trajectory."""
+    rows = read_jsonl_rows(text)
+    pts: List[MetricPoint] = []
+    for row in rows:
+        if row.get("phase") != "autoscale-summary":
+            continue
+        phase = "autoscale-summary"
+        for key, metric in (
+                ("deterministic", "autoscale.deterministic"),
+                ("slo_vs_static_ok", "autoscale.slo_vs_static_ok"),
+                ("cost_vs_static_ok", "autoscale.cost_vs_static_ok"),
+                ("scale_events_span_verified",
+                 "autoscale.scale_events_span_verified"),
+                ("chaos_deterministic",
+                 "autoscale.chaos_deterministic"),
+                ("chaos_invariants_ok",
+                 "autoscale.chaos_invariants_ok"),
+                ("process_ok", "autoscale.process_ok"),
+                ("trace_connected", "autoscale.trace_connected"),
+                ("invariants_ok", "autoscale.invariants_ok")):
+            if key in row:
+                pts.append(MetricPoint(metric,
+                                       1.0 if row[key] else 0.0,
+                                       file, phase=phase))
+        for key, metric in (
+                ("slo_attainment", "autoscale.slo_attainment"),
+                ("cost_savings_fraction",
+                 "autoscale.cost_savings_fraction"),
+                ("cost_replica_steps",
+                 "autoscale.cost_replica_steps"),
+                ("static_peak_cost", "autoscale.static_peak_cost"),
+                ("scale_ups", "autoscale.scale_ups"),
+                ("retires_completed",
+                 "autoscale.retires_completed"),
+                ("flaps", "autoscale.flaps")):
+            if isinstance(row.get(key), (int, float)):
+                pts.append(MetricPoint(metric, float(row[key]),
+                                       file, phase=phase))
+        pts.append(MetricPoint(
+            "autoscale.violations",
+            float(len(row.get("violations", []))), file,
+            phase=phase))
+    return pts
+
+
 def parse_paged_vet(text: str, file: str) -> List[MetricPoint]:
     rows = read_jsonl_rows(text)
     pts = []
@@ -1054,6 +1105,14 @@ FAMILIES: List[ArtifactFamily] = [
         "assembled cross-process timeline with real worker rows + "
         "cross-worker arrows, SIGKILL postmortem telemetry, harvest "
         "overhead budget, per-link wire percentiles)"),
+    ArtifactFamily(
+        "autoscale-serve", r"^AUTOSCALE_SERVE\.jsonl$",
+        parse_autoscale_serve,
+        "SLO-driven elastic autoscaling: hysteresis control loop vs "
+        "equal-peak static fleets (attainment at strictly lower "
+        "replica-step cost), span-verified scale events, scale-event "
+        "chaos (aborted bootstrap / mid-drain crash / faulted "
+        "pre-warm), process-mode worker spawn/kill-recovery/reap"),
     ArtifactFamily(
         "request-trace", r"^REQUEST_TRACE\.jsonl$",
         parse_request_trace,

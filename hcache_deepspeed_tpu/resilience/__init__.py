@@ -24,10 +24,12 @@ from .policy import ResiliencePolicy  # noqa: F401
 from .retry import (BreakerState, CircuitBreaker,  # noqa: F401
                     RetryPolicy, Watchdog, call_with_retry)
 
-from .chaos import (ChaosResult, DisaggChaosResult,  # noqa: F401
+from .chaos import (AutoscaleChaosResult,  # noqa: F401
+                    ChaosResult, DisaggChaosResult,
                     FabricChaosResult, FleetChaosResult,
-                    build_chaos_trace, default_fault_plan,
+                    build_chaos_trace, default_autoscale_fault_plan,
+                    default_fault_plan,
                     default_disagg_fault_plan,
-                    default_fleet_fault_plan, run_chaos,
-                    run_disagg_chaos, run_fabric_chaos,
+                    default_fleet_fault_plan, run_autoscale_chaos,
+                    run_chaos, run_disagg_chaos, run_fabric_chaos,
                     run_fleet_chaos)

@@ -945,3 +945,198 @@ def run_fabric_chaos(seed: int = 0, n_replicas: int = 3,
         },
         violations=violations,
         ok=not violations)
+
+
+# ----------------------------------------------------------------- #
+# autoscale chaos: scale events as a first-class failure domain
+# ----------------------------------------------------------------- #
+def default_autoscale_fault_plan(seed: int = 0) -> FaultPlan:
+    """One fault per scale-event failure domain: the FIRST scale-up
+    bootstrap aborts (``scale.bootstrap``), the FIRST retirement's
+    drain victim crashes mid-drain (``scale.drain``), and the first
+    pre-warm broadcast is dropped (``scale.prewarm``, non-fatal). The
+    control loop must recover from all three with every request still
+    reaching exactly one terminal state."""
+    return FaultPlan(seed=seed, rules=[
+        FaultRule("scale.bootstrap", at_hits=(1,), max_faults=1),
+        FaultRule("scale.drain", at_hits=(1,), max_faults=1),
+        FaultRule("scale.prewarm", at_hits=(1,), max_faults=1),
+    ])
+
+
+@dataclass
+class AutoscaleChaosResult:
+    seed: int
+    plan: Dict
+    requests: List[Dict]
+    event_digest: str
+    fleet_summary: Dict
+    autoscale: Dict
+    invariants: Dict
+    ok: bool = False
+    violations: List[str] = field(default_factory=list)
+
+
+def run_autoscale_chaos(seed: int = 0, n_requests: int = 360,
+                        horizon_s: float = 10.0,
+                        fault_plan: Optional[FaultPlan] = None,
+                        start_replicas: int = 2,
+                        max_replicas: int = 4) -> AutoscaleChaosResult:
+    """One deterministic autoscaled chaos run: the bursty multi-tenant
+    trace drives the control loop over a virtual-clock fleet while
+    every scale-event failure domain fires from the plan — a scale-up
+    killed mid-bootstrap (clean abort back to the prior fleet shape),
+    a replica crashed mid-drain-retirement (degrades into the crash
+    evacuation path), and a faulted pre-warm broadcast (the new
+    replica joins cold).
+
+    Invariants (the scale-event robustness contract):
+
+    1. exactly-one-terminal-state per request at fleet scope;
+    2. zero KV/tracked leaks on every surviving replica — including
+       STOPPED (retired) ones, whose pools must be intact;
+    3. fleet-scope migration balance including retired replicas'
+       evacuations and all pre-warm broadcasts;
+    4. the flap bound: direction reversals never exceed the
+       configured ``max_flaps``;
+    5. every injected scale fault left its mark (abort counted,
+       retirement crash event, pre-warm fault event);
+    6. determinism — the caller runs twice and compares
+       ``event_digest`` byte-for-byte;
+    7. causal-trace continuity (connected DAGs, closure) for every
+       request, scale events included.
+    """
+    from ..inference.config import RaggedInferenceEngineConfig
+    from ..serving import (AutoscaleConfig, Autoscaler, FleetConfig,
+                           PrefixReuseConfig, ReplicaState,
+                           ServerConfig, ServingFleet,
+                           SimulatedEngine, VirtualClock,
+                           build_autoscale_trace)
+    from ..serving.spec import SLOModeConfig
+
+    plan = fault_plan if fault_plan is not None \
+        else default_autoscale_fault_plan(seed)
+
+    def make_engine():
+        return SimulatedEngine(RaggedInferenceEngineConfig(
+            state_manager={"max_tracked_sequences": 8,
+                           "max_ragged_batch_size": 256,
+                           "max_ragged_sequence_count": 4,
+                           "max_context": 64},
+            kv_cache={"block_size": 8, "num_blocks": 12},
+            hcache={"enable_latents": True}))
+
+    fleet = ServingFleet(
+        engine_factory=make_engine,
+        clock=VirtualClock(),
+        config=FleetConfig(
+            n_replicas=start_replicas,
+            server=ServerConfig(max_queue_depth=n_requests + 1,
+                                kv_demand_fraction=float("inf"),
+                                slo_mode=SLOModeConfig()),
+            prefix=PrefixReuseConfig(broadcast=True,
+                                     min_adopt_tokens=4)))
+    asc_cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=max_replicas,
+        hot_steps=2, calm_steps=30, cooldown_steps=20,
+        flap_window_steps=40, max_flaps=2)
+    asc = Autoscaler(fleet, asc_cfg)
+    reqs = build_autoscale_trace(seed=seed, n_requests=n_requests,
+                                 horizon_s=horizon_s,
+                                 new_tokens=(8, 14))
+    with injected(plan) as inj:
+        asc.run(reqs)
+        fault_fired = dict(inj.fired)
+
+    violations: List[str] = []
+    # 1. exactly-one-terminal-state per request, fleet scope
+    terminal = {"DONE", "REJECTED", "FAILED"}
+    for r in reqs:
+        if r.state.name not in terminal:
+            violations.append(
+                f"request {r.uid} ended non-terminal: {r.state.name}")
+        holders = sum(1 for rep in fleet.replicas
+                      if r.uid in rep.scheduler.done)
+        holders += 1 if r.uid in fleet.done else 0
+        if holders != 1:
+            violations.append(
+                f"request {r.uid} terminal in {holders} places "
+                "(must be exactly 1)")
+    # 2. zero leaks on every surviving replica (STOPPED included:
+    # a retired pool must be intact)
+    for rep in fleet.replicas:
+        if rep.state is ReplicaState.DEAD:
+            continue
+        free = rep.engine.state.free_blocks
+        if free != rep.initial_free_blocks:
+            violations.append(
+                f"replica {rep.id} ({rep.state.name}): block leak "
+                f"({rep.initial_free_blocks} before, {free} after)")
+        tracked = rep.engine.state.n_tracked_sequences
+        if tracked != 0:
+            violations.append(
+                f"replica {rep.id}: {tracked} sequences still "
+                "tracked post-trace")
+    # 3. fleet-scope migration balance, retired replicas included
+    if fleet.in_transit:
+        violations.append(
+            f"{len(fleet.in_transit)} migrations still in transit")
+    if not fleet.migration_balance_ok:
+        violations.append(
+            f"migration imbalance: {dict(fleet.counters)}")
+    # 4. flap bound
+    if asc.flaps > asc_cfg.max_flaps:
+        violations.append(
+            f"flap bound violated: {asc.flaps} > "
+            f"{asc_cfg.max_flaps}")
+    # 5. every injected scale fault left its mark
+    c = fleet.counters
+    if fault_fired.get("scale.bootstrap", 0) and \
+            c["scale_up_aborts"] < 1:
+        violations.append("scale.bootstrap fired but no scale-up "
+                          "abort was counted")
+    event_names = [e[1] for e in fleet.events]
+    if fault_fired.get("scale.drain", 0) and \
+            "retire_crash" not in event_names:
+        violations.append("scale.drain fired but no retire_crash "
+                          "event was logged")
+    if fault_fired.get("scale.prewarm", 0) and \
+            "prewarm_fault" not in event_names:
+        violations.append("scale.prewarm fired but no prewarm_fault "
+                          "event was logged")
+    if c["scale_ups"] < 1:
+        violations.append("no successful scale-up happened under "
+                          "chaos")
+    if c["retires_completed"] < 1:
+        violations.append("no drain-retirement completed under "
+                          "chaos")
+    # 7. causal-trace continuity across scale events
+    trace_inv = _trace_gates(reqs, violations)
+    _flight_on_violations("autoscale", seed, violations)
+
+    return AutoscaleChaosResult(
+        seed=seed, plan=plan.to_dict(),
+        requests=[{
+            "uid": r.uid, "state": r.state.name, "error": r.error,
+            "tokens": len(r.tokens_out), "replica": r.replica,
+            "migrations": r.n_migrations,
+            "recomputes": r.n_recomputes,
+            **_trace_row(r),
+        } for r in reqs],
+        event_digest=_digest(fleet.event_log()),
+        fleet_summary=fleet.summary(),
+        autoscale=asc.summary(),
+        invariants={
+            "terminal_states": sorted({r.state.name for r in reqs}),
+            "replica_states": {str(rep.id): rep.state.name
+                               for rep in fleet.replicas},
+            "fault_fired": fault_fired,
+            "counters": dict(fleet.counters),
+            "autoscale_counters": dict(asc.counters),
+            "flaps": asc.flaps,
+            "flap_bound": asc_cfg.max_flaps,
+            "migration_balance_ok": fleet.migration_balance_ok,
+            "trace": trace_inv,
+        },
+        violations=violations,
+        ok=not violations)

@@ -27,6 +27,9 @@ full token-id paths, per-replica warm-prefix caches, and the latent
 prefix-broadcast primitive the router prices through ``crossover.py``).
 """
 
+from .autoscale import (AutoscaleConfig, Autoscaler,  # noqa: F401
+                        build_autoscale_trace,
+                        validate_autoscale_config)
 from .clock import MonotonicClock, VirtualClock  # noqa: F401
 from .crossover import (CrossoverConfig,  # noqa: F401
                         RestoreCrossoverModel)
@@ -34,7 +37,7 @@ from .disagg import (DisaggConfig, DisaggregatedFleet,  # noqa: F401
                      build_mixed_trace, compare_disagg_vs_colocated)
 from .fleet import (FleetConfig, FleetReplica,  # noqa: F401
                     Migration, ReplicaRole, ReplicaState,
-                    ServingFleet)
+                    ScaleUpAborted, ServingFleet)
 from .metrics import Histogram, ServingMetrics  # noqa: F401
 from .prefix_tree import (PrefixReuseConfig,  # noqa: F401
                           RadixPrefixTree, ReplicaPrefixCache,

@@ -60,7 +60,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis.runtime import make_lock
 from ..fabric.transport import (InMemoryTransport, ReplicaTransport,
-                                WorkerDied)
+                                ScaleBootstrapError, WorkerDied)
 from ..resilience.faults import InjectedFault, get_injector
 from ..resilience.policy import ResiliencePolicy
 from ..telemetry.context import TraceContext
@@ -82,6 +82,20 @@ from .server import ServerConfig, ServingServer
 #: a replica via ``_locked``; no server code path ever calls back up
 #: into the fleet.
 __hds_lock_order__ = ("ServingFleet._lock", "ServingServer._lock")
+
+
+class ScaleUpAborted(RuntimeError):
+    """A scale-up failed to bootstrap (injected ``scale.bootstrap``
+    fault, or the process transport exhausting its bounded spawn
+    retries) and was rolled back cleanly: the fleet is in its prior
+    shape, no request was touched, and the abort left a flight-
+    recorder bundle (trigger ``scale_abort``)."""
+
+    def __init__(self, replica: int, reason: str):
+        super().__init__(
+            f"scale-up of replica {replica} aborted: {reason}")
+        self.replica = replica
+        self.reason = reason
 
 
 class ReplicaState(Enum):
@@ -366,6 +380,11 @@ class ServingFleet:
             # no request leaves anywhere)
             "prefix_broadcasts": 0, "prefix_broadcast_landings": 0,
             "prefix_broadcast_failed": 0,
+            # elastic scale events (zero forever on fixed-membership
+            # fleets — the committed digests never see them)
+            "scale_ups": 0, "scale_up_aborts": 0,
+            "retires": 0, "retires_completed": 0,
+            "reroles": 0, "prewarm_broadcasts": 0,
         }
         #: migration/decode overlap accounting: fleet steps with >=1
         #: migration in flight, and the subset where some replica also
@@ -382,6 +401,15 @@ class ServingFleet:
         self._routable: set = {r.id for r in self.replicas}
         self._pump_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # elastic-membership state: the construction inputs needed to
+        # build replicas later (scale-up), the set of replica ids in
+        # drain-to-retirement, and the optional attached autoscaler
+        # (an observability pointer only — the fleet never calls it)
+        self._engine_factory = engine_factory
+        self._resilience = resilience
+        self._sample_fn = sample_fn
+        self._retiring: set = set()
+        self.autoscaler = None
 
     # ------------------------------------------------------------- #
     # intake
@@ -409,6 +437,7 @@ class ServingFleet:
             self.pending.append(request)
             return request
 
+    # hds: allow(HDS-L002) replicas is append-only under _lock
     def cancel(self, uid: int) -> None:
         with self._lock:
             for req in self.pending:
@@ -422,6 +451,7 @@ class ServingFleet:
         for r in self.replicas:
             r.server.cancel(uid)
 
+    # hds: allow(HDS-L002) replicas is append-only under _lock
     def request(self, uid: int) -> Optional[Request]:
         with self._lock:
             if uid in self.done:
@@ -439,6 +469,7 @@ class ServingFleet:
         return None
 
     @property
+    # hds: allow(HDS-L002) replicas is append-only under _lock
     def has_work(self) -> bool:
         return bool(self.pending or self.in_transit or
                     any(r.scheduler.has_work or r.server._ingress
@@ -555,6 +586,17 @@ class ServingFleet:
         # process transport; nothing, under the in-memory one) so the
         # deployment picture matches the simulation's
         self.transport.on_replica_dead(r.id)
+        if r.id in self._retiring:
+            # crashed mid-drain-retirement: the scale event degrades
+            # into the crash failure domain — same evacuation below,
+            # same never-dropped invariant; the worker is already
+            # reaped, so only the retirement bookkeeping closes here
+            self._retiring.discard(r.id)
+            self.router.forget_replica(r.id)
+            self._event("retire_crash", -1, f"replica={r.id}")
+            # hds: allow(HDS-C004) replica-lifecycle span, no uid
+            get_tracer().async_end("fleet.retire", r.id, cat="fleet",
+                                   status="crashed")
         if r.prefix_cache is not None:
             # its warm prefixes died with it: drop the payloads and
             # un-mark the shared tree so nobody routes-to-reuse (or
@@ -697,6 +739,7 @@ class ServingFleet:
         no-op."""
 
     @property
+    # hds: allow(HDS-L002) replicas is append-only under _lock
     def degradation_level(self) -> int:
         """Fleet-level degradation: the worst ladder level among
         stepping replicas — the fleet-scope escalation signal (routing
@@ -1064,10 +1107,334 @@ class ServingFleet:
                 r.prev_state = ReplicaState.DRAINING
             self._event("drain_begin", -1, f"replica={replica_id}")
 
+    # ------------------------------------------------------------- #
+    # elastic membership (scale events as a failure domain)
+    # ------------------------------------------------------------- #
+    @property
+    # hds: allow(HDS-L002) replicas append-only; callers hold _lock
+    def live_replicas(self) -> int:
+        """Replicas currently participating (not DEAD/STOPPED) — the
+        autoscaler's replica-count gauge and the denominator of every
+        per-replica pressure signal."""
+        return sum(1 for r in self.replicas
+                   if r.state not in (ReplicaState.DEAD,
+                                      ReplicaState.STOPPED))
+
+    def add_replica(self, engine=None,
+                    role: ReplicaRole = ReplicaRole.COLOCATED,
+                    prewarm_paths: int = 4) -> int:
+        """Scale-up: bring one more replica into the fleet and return
+        its id. A STOPPED (drained-clean) replica is revived in place
+        when ``engine`` is None — its pool is intact and, because the
+        router forgot it at retirement, the re-added id starts with a
+        clean breaker/affinity/wire slate; otherwise a fresh replica
+        is appended (``engine`` or the construction-time
+        ``engine_factory`` supplies the engine).
+
+        The scale event is a failure domain: the ``scale.bootstrap``
+        fault site fires first, and the transport's
+        :meth:`~..fabric.transport.ReplicaTransport.on_replica_added`
+        hook may itself fail (the process transport spawns a
+        supervised worker under a bounded retry + typed timeout). Any
+        bootstrap failure rolls back to the prior fleet shape — zero
+        requests touched — dumps a ``scale_abort`` flight bundle, and
+        raises :class:`ScaleUpAborted`.
+
+        On success the new replica is pre-warmed: the freshest
+        ``prewarm_paths`` registered radix-tree prefixes ship to it
+        over the ordinary latent prefix-broadcast wire."""
+        role = role if isinstance(role, ReplicaRole) \
+            else ReplicaRole[str(role).upper()]
+        tracer = get_tracer()
+        with self._lock:
+            revived = None
+            if engine is None:
+                for r in self.replicas:
+                    if r.state is ReplicaState.STOPPED:
+                        revived = r
+                        break
+            if revived is not None:
+                rid, r = revived.id, revived
+            else:
+                if engine is None:
+                    if self._engine_factory is None:
+                        raise ValueError(
+                            "add_replica needs an engine or an "
+                            "engine_factory (and no STOPPED replica "
+                            "to revive)")
+                    engine = self._engine_factory()
+                rid = len(self.replicas)
+                prefix_cache = None
+                if self.prefix_tree is not None:
+                    prefix_cache = ReplicaPrefixCache(
+                        self.config.prefix, tree=self.prefix_tree,
+                        replica_id=rid, in_fleet=True)
+                r = FleetReplica(rid, engine, self.clock, self.config,
+                                 resilience=self._resilience,
+                                 sample_fn=self._sample_fn,
+                                 role=role,
+                                 prefix_cache=prefix_cache)
+            # hds: allow(HDS-C004) replica-lifecycle span, no uid
+            tracer.async_begin("fleet.scale_up", rid, cat="fleet",
+                               replica=rid, role=role.name.lower(),
+                               revived=revived is not None)
+            self._event("scale_up_begin", -1,
+                        f"replica={rid} role={role.name.lower()} "
+                        f"revived={revived is not None}")
+            try:
+                inj = get_injector()
+                if inj.enabled:
+                    inj.fire("scale.bootstrap", replica=rid)
+                # the transport half of the scale event: under the
+                # process transport this spawns + bootstraps a real
+                # supervised worker (bounded retry, typed timeout)
+                # and raises ScaleBootstrapError when it gives up —
+                # BEFORE any fleet state changed
+                self.transport.on_replica_added(r)
+            except (InjectedFault, ScaleBootstrapError) as exc:
+                self._abort_scale_up(rid, revived is not None, exc)
+                raise ScaleUpAborted(rid, repr(exc)) from exc
+            # bootstrap succeeded: commit the membership change
+            if revived is not None:
+                # a re-added id starts clean (satellite contract):
+                # no breaker history, no stale affinity entries, no
+                # stale per-link wire sketches
+                self.router.forget_replica(rid)
+                r.role = role
+                r.state = ReplicaState.UP
+                r.prev_state = ReplicaState.UP
+                r.hang_until = 0
+                r.partition_until = 0
+            else:
+                self.replicas.append(r)
+                self.config.n_replicas = len(self.replicas)
+            self.counters["scale_ups"] += 1
+            self._event("scale_up", -1,
+                        f"replica={rid} role={role.name.lower()} "
+                        f"live={self.live_replicas}")
+            prewarmed = self._prewarm_replica(r, prewarm_paths)
+            # hds: allow(HDS-C004) replica-lifecycle span, no uid
+            tracer.async_end("fleet.scale_up", rid, cat="fleet",
+                             status="ready", prewarmed=prewarmed)
+            return rid
+
+    def _abort_scale_up(self, rid: int, revived: bool,
+                        exc: BaseException) -> None:
+        """Roll a failed scale-up back to the prior fleet shape (the
+        replica object was never committed, so there is nothing to
+        remove — revival never flipped the STOPPED state) and leave
+        the postmortem: abort event, closed span, flight bundle."""
+        self.counters["scale_up_aborts"] += 1
+        self._event("scale_up_abort", -1,
+                    f"replica={rid} reason={type(exc).__name__}")
+        # hds: allow(HDS-C004) replica-lifecycle span, no uid
+        get_tracer().async_end("fleet.scale_up", rid, cat="fleet",
+                               status="aborted")
+        fr = get_flight_recorder()
+        if fr.should_fire("scale_abort", f"fleet:{rid}",
+                          self.step_idx):
+            fr.dump(trigger="scale_abort",
+                    reason=f"{type(exc).__name__}: {exc}",
+                    source=f"fleet:{rid}", step=self.step_idx,
+                    t=self.clock.now(),
+                    snapshot={
+                        "replica": rid,
+                        "revived": revived,
+                        "live_replicas": self.live_replicas,
+                        "pending": len(self.pending),
+                        "in_transit": len(self.in_transit),
+                        "counters": dict(self.counters),
+                        "events_tail": [list(e)
+                                        for e in self.events[-10:]],
+                    })
+
+    def retire_replica(self, replica_id: int) -> None:
+        """Scale-down: drain-to-retirement. The replica drains through
+        the ordinary latent-migration path (never-dropped invariant at
+        fleet scope — every resident lands somewhere or terminates
+        exactly once) and, when its drain completes, the transport
+        reaps whatever backs it (the worker process, under the process
+        transport) and the router forgets the id. The ``scale.drain``
+        fault site fires on every retirement drain step, so a replica
+        crashing mid-drain-retirement is an injectable failure domain
+        that degrades into the crash path."""
+        r = self.replicas[replica_id]
+        with self._lock:
+            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                raise ValueError(
+                    f"replica {replica_id} is {r.state.name}")
+            if replica_id in self._retiring:
+                return
+            self._retiring.add(replica_id)
+            self.counters["retires"] += 1
+            self._event("retire_begin", -1, f"replica={replica_id}")
+            # hds: allow(HDS-C004) replica-lifecycle span, no uid
+            get_tracer().async_begin("fleet.retire", replica_id,
+                                     cat="fleet", replica=replica_id)
+            if r.state is ReplicaState.UP:
+                r.state = ReplicaState.DRAINING
+            else:
+                r.prev_state = ReplicaState.DRAINING
+            self._event("drain_begin", -1, f"replica={replica_id}")
+
+    def set_role(self, replica_id: int, role) -> None:
+        """Re-role a replica between the prefill/decode/colocated
+        tiers (the disagg coordinator's tier hooks read ``r.role``
+        live, so the change takes effect at the next fleet step).
+        Tier contracts are preserved by evacuating work the new role
+        cannot hold: a replica re-roled to PREFILL migrates its
+        resident decode state out over the latent wire (the disagg
+        landing filter keeps it on the decode tier); one re-roled to
+        DECODE re-routes its queued intake."""
+        role = role if isinstance(role, ReplicaRole) \
+            else ReplicaRole[str(role).upper()]
+        r = self.replicas[replica_id]
+        with self._lock:
+            if r.role is role:
+                return
+            if r.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                raise ValueError(
+                    f"replica {replica_id} is {r.state.name}")
+            old = r.role
+            r.role = role
+            self.counters["reroles"] += 1
+            self._event("rerole", -1,
+                        f"replica={replica_id} "
+                        f"{old.name.lower()}->{role.name.lower()}")
+            s = r.scheduler
+            if role is ReplicaRole.PREFILL:
+                # a pure prefill replica holds no steady decode state
+                with self._locked(r):
+                    live_uids = (list(s.suspended) +
+                                 list(s.restoring) + list(s.running))
+                for uid in live_uids:
+                    with self._locked(r):
+                        req = s.detach_for_migration(uid)
+                    if req is None:
+                        continue
+                    if req.state is RequestState.QUEUED:
+                        req.replica = None
+                        self.counters["requeued"] += 1
+                        self._event("requeue", req.uid,
+                                    f"rerole replica={r.id}")
+                        self.pending.append(req)
+                        continue
+                    self._begin_migration(req, r.id, -1, "rerole")
+            elif role is ReplicaRole.DECODE:
+                # a decode replica takes no new intake
+                with self._locked(r):
+                    queued = list(r.server._ingress) + list(s.queue)
+                    r.server._ingress.clear()
+                    s.queue.clear()
+                for req in queued:
+                    req.replica = None
+                    self.counters["requeued"] += 1
+                    self._event("requeue", req.uid,
+                                f"rerole replica={r.id}")
+                    self.pending.append(req)
+
+    def _prewarm_replica(self, dst: "FleetReplica",
+                         max_paths: int) -> int:
+        """Radix-prefix-tree pre-warm: ship the freshest registered
+        prefix paths to a newly added replica over the ordinary latent
+        prefix-broadcast wire, so shared-prefix traffic routed there
+        restores instead of re-prefilling from step one. A faulted
+        broadcast (``scale.prewarm`` site) is non-fatal — the replica
+        joins cold and warms through ordinary broadcasts."""
+        if self.prefix_tree is None or dst.prefix_cache is None or \
+                max_paths <= 0:
+            return 0
+        sent = 0
+        inj = get_injector()
+        # newest registrations first (the paths dict is LRU order,
+        # oldest first) — insertion order, never hash order
+        for path in reversed(list(self.prefix_tree.paths)):
+            if sent >= max_paths:
+                break
+            owners = self.prefix_tree.paths.get(path, {})
+            if dst.id in owners:
+                continue
+            payload, src_id = None, None
+            # freshest owner holding an actual payload, lowest id
+            # breaking stamp ties — deterministic
+            for rid, _stamp in sorted(owners.items(),
+                                      key=lambda kv: (-kv[1], kv[0])):
+                if rid == dst.id or not 0 <= rid < len(self.replicas):
+                    continue
+                src_r = self.replicas[rid]
+                if src_r.state in (ReplicaState.DEAD,
+                                   ReplicaState.STOPPED) or \
+                        src_r.prefix_cache is None:
+                    continue
+                payload = src_r.prefix_cache.payload_for(path,
+                                                         len(path))
+                if payload is not None:
+                    src_id = rid
+                    break
+            if payload is None:
+                continue
+            try:
+                if inj.enabled:
+                    inj.fire("scale.prewarm", replica=dst.id,
+                             src=src_id)
+            except InjectedFault:
+                self._event("prewarm_fault", -1,
+                            f"replica={dst.id} src={src_id}")
+                continue
+            self._begin_prewarm_broadcast(src_id, dst.id, path,
+                                          payload)
+            sent += 1
+        return sent
+
+    def _begin_prewarm_broadcast(self, src: int, dst: int,
+                                 path: Tuple[int, ...],
+                                 payload) -> None:
+        """The requestless ship half of a pre-warm: identical to a
+        planned prefix broadcast on the wire (reason
+        ``prefix_broadcast`` — the landing machinery installs it the
+        same way) but minted with a fleet uid of its own, since no
+        request triggered it."""
+        now = self.clock.now()
+        uid = self._next_uid
+        self._next_uid += 1
+        nbytes = int(payload.nbytes)
+        transfer_s = self.config.migration_overhead_s
+        if self.config.link_bytes_per_s > 0:
+            transfer_s += nbytes / self.config.link_bytes_per_s
+        m = Migration(uid=uid, src=src, dst=dst, nbytes=nbytes,
+                      tokens=len(path), reason="prefix_broadcast",
+                      depart_t=now, land_t=now + transfer_s,
+                      request=None,
+                      prefix_tokens=tuple(int(t) for t in path),
+                      payload=payload.copy())
+        m.ticket = self.transport.ship(m)
+        self.in_transit.append(m)
+        self.migrations.append(m)
+        self.counters["prefix_broadcasts"] += 1
+        self.counters["prewarm_broadcasts"] += 1
+        self._event("prewarm_depart", uid,
+                    f"src={src} dst={dst} tokens={len(path)} "
+                    f"bytes={nbytes}")
+        get_tracer().async_begin("fleet.prefix_broadcast", uid,
+                                 cat="fleet", src=src, dst=dst,
+                                 tokens=len(path), bytes=nbytes,
+                                 uid=uid, prewarm=True)
+
     def _drain_pass(self, routable) -> None:
         for r in self.replicas:
             if r.state is not ReplicaState.DRAINING:
                 continue
+            if r.id in self._retiring:
+                inj = get_injector()
+                if inj.enabled:
+                    try:
+                        inj.fire("scale.drain", replica=r.id)
+                    except InjectedFault as f:
+                        # the drain victim died mid-retirement: hand
+                        # the scale event to the crash failure domain
+                        # (evacuation + never-dropped, fleet scope)
+                        self._crash(r, f)
+                        continue
             s = r.scheduler
             with self._locked(r):
                 queued = list(r.server._ingress) + list(s.queue)
@@ -1107,6 +1474,22 @@ class ServingFleet:
                 self._event("drain_complete", -1,
                             f"replica={r.id} "
                             f"free={r.engine.state.free_blocks}")
+                if r.id in self._retiring:
+                    # the retirement's reap point: the worker (under a
+                    # process transport) is reaped ONLY after its
+                    # drain landed — every resident already migrated
+                    # out over the latent wire — and the router
+                    # forgets the id so a later re-add starts clean
+                    self._retiring.discard(r.id)
+                    self.transport.on_replica_retired(r.id)
+                    self.router.forget_replica(r.id)
+                    self.counters["retires_completed"] += 1
+                    self._event("retire_complete", -1,
+                                f"replica={r.id}")
+                    # hds: allow(HDS-C004) lifecycle span, no uid
+                    get_tracer().async_end("fleet.retire", r.id,
+                                           cat="fleet",
+                                           status="completed")
 
     # ------------------------------------------------------------- #
     # one fleet step (virtual-clock deterministic core)
@@ -1224,6 +1607,7 @@ class ServingFleet:
     # ------------------------------------------------------------- #
     # thread mode (real clock)
     # ------------------------------------------------------------- #
+    # hds: allow(HDS-L002) replicas is append-only under _lock
     def start(self) -> None:
         if self.virtual:
             raise RuntimeError("thread mode needs a real clock; use "
@@ -1275,6 +1659,7 @@ class ServingFleet:
             with self._lock:
                 self._event("pump_error", -1, repr(exc))
 
+    # hds: allow(HDS-L002) replicas is append-only under _lock
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         if self._pump_thread is None:
             return
@@ -1318,6 +1703,7 @@ class ServingFleet:
             }
         return {
             "replicas": per_replica,
+            "replicas_live": self.live_replicas,
             "counters": dict(self.counters),
             "transport": self.transport.name,
             "router": self.router.summary(),
@@ -1379,6 +1765,19 @@ class ServingFleet:
                            "with a handoff in transit")
         reg.set_gauge("in_transit", float(len(self.in_transit)),
                       help="migrations currently on the wire")
+        reg.set_gauge("replicas_live", float(self.live_replicas),
+                      help="replicas currently participating "
+                           "(not DEAD/STOPPED) — the autoscaler's "
+                           "replica-count gauge")
+        if self.autoscaler is not None:
+            for name, value in self.autoscaler.counters.items():
+                reg.set_counter(f"autoscale_{name}", value,
+                                help=f"autoscaler counter {name}")
+            reg.set_gauge("autoscale_flaps",
+                          float(self.autoscaler.flaps),
+                          help="scale-direction reversals inside the "
+                               "flap window (the hysteresis guard's "
+                               "failure counter)")
         reg.set_gauge("degradation_level",
                       float(self.degradation_level),
                       help="worst degradation-ladder level among "
@@ -1444,6 +1843,9 @@ class ServingFleet:
         tel = getattr(self.transport, "telemetry_stats", None)
         if tel is not None:
             out["worker_telemetry"] = tel()
+        out["replicas_live"] = self.live_replicas
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.summary()
         return out
 
     def snapshot(self, last_events: int = 20) -> str:

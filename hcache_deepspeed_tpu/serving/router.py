@@ -138,6 +138,8 @@ class FleetRouter:
         self.migrations_proposed = 0
         self.migrations_refused_by_cost = 0
         self.handoff_routes = 0
+        #: retired-replica hygiene calls (see :meth:`forget_replica`)
+        self.replicas_forgotten = 0
         # measured-wire calibration samples (fed by the fleet from a
         # measuring transport; see observe_wire)
         self.wire_samples = 0
@@ -234,6 +236,29 @@ class FleetRouter:
     def breaker_states(self) -> Dict[int, str]:
         return {rid: br.state.name
                 for rid, br in sorted(self.breakers.items())}
+
+    # ------------------------------------------------------------- #
+    # membership hygiene
+    # ------------------------------------------------------------- #
+    def forget_replica(self, replica_id: int) -> None:
+        """Drop every piece of per-replica state the router holds for
+        a departed replica: its health breaker, every prefix-affinity
+        LRU entry pointing at it, and every per-link wire sketch whose
+        endpoint it was. All router state historically assumed fixed
+        membership forever; elastic fleets retire replicas, and a
+        retired id's breaker history / affinity entries / wire
+        percentiles must not leak into a replica later re-added under
+        the same id (a re-added id starts clean). Idempotent."""
+        rid = int(replica_id)
+        self.breakers.pop(rid, None)
+        stale = [k for k, v in self._prefix_map.items() if v == rid]
+        for k in stale:
+            del self._prefix_map[k]
+        dead_links = [key for key in self.wire_links
+                      if key[0] == rid or key[1] == rid]
+        for key in dead_links:
+            del self.wire_links[key]
+        self.replicas_forgotten += 1
 
     # ------------------------------------------------------------- #
     # placement
@@ -426,6 +451,10 @@ class FleetRouter:
                 1 for br in self.breakers.values()
                 if br.state != BreakerState.CLOSED),
         }
+        if self.replicas_forgotten:
+            # absent until a replica actually retires, so historical
+            # fixed-membership summaries stay byte-identical
+            out["replicas_forgotten"] = self.replicas_forgotten
         if self.wire_samples:
             # absent entirely when no measuring transport fed samples,
             # so historical (in-memory) summaries stay byte-identical

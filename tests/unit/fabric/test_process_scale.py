@@ -1,0 +1,145 @@
+"""Scale events on the process transport: a SIGSTOP'd worker hits the
+typed control-socket deadline instead of wedging the parent, a
+mid-scale-up spawn kill burns one supervised attempt and recovers,
+retirement reaps the worker only after the drain landed, and retry
+exhaustion turns into a clean ``ScaleUpAborted`` with the prior fleet
+shape intact (ISSUE 19)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+from hcache_deepspeed_tpu.fabric import (FabricTimeout,
+                                         ProcessTransport,
+                                         canonical_digest)
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.resilience import (FaultPlan, FaultRule,
+                                             injected)
+from hcache_deepspeed_tpu.serving import (FleetConfig, ReplicaState,
+                                          RequestState, ScaleUpAborted,
+                                          ServerConfig, ServingFleet,
+                                          SimulatedEngine,
+                                          VirtualClock)
+
+pytestmark = pytest.mark.chaos
+
+
+def sim_engine():
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": 16},
+        hcache={"enable_latents": True}))
+
+
+def make_fleet(transport, n):
+    return ServingFleet(
+        engine_factory=sim_engine,
+        clock=VirtualClock(),
+        config=FleetConfig(
+            n_replicas=n,
+            server=ServerConfig(max_queue_depth=256,
+                                kv_demand_fraction=float("inf")),
+            transport=transport))
+
+
+def drive(fleet, max_steps=5000):
+    steps = 0
+    while fleet.has_work:
+        fleet.step()
+        steps += 1
+        assert steps < max_steps, fleet.snapshot()
+
+
+def test_sigstop_worker_hits_typed_deadline_not_a_wedge():
+    """Satellite 1: every blocking control-socket read sits behind a
+    typed deadline — a SIGSTOP'd worker raises ``FabricTimeout``
+    (an ``OSError``, carrying replica + op) and bumps the
+    ``io_timeouts`` counter instead of hanging the parent forever."""
+    tr = ProcessTransport(spawn_timeout_s=120, io_timeout_s=1.0)
+    fleet = make_fleet(tr, n=1)
+    with tr:
+        assert fleet.replicas[0].state is ReplicaState.UP
+        h = tr.workers[0]
+        os.kill(h.proc.pid, signal.SIGSTOP)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(FabricTimeout) as ei:
+                tr.snapshot_digest(0)
+            elapsed = time.monotonic() - t0
+        finally:
+            os.kill(h.proc.pid, signal.SIGCONT)
+        assert isinstance(ei.value, OSError)
+        assert ei.value.replica == 0
+        assert ei.value.op == "snapshot"
+        # bounded by the io deadline, nowhere near a wedge
+        assert elapsed < 30.0
+        assert tr.io_timeouts == 1
+        assert tr.wire_stats()["io_timeouts"] == 1
+
+
+def test_scale_lifecycle_under_process_transport():
+    """One fleet amortized over the whole scale contract: a scale-up
+    whose first spawn is chaos-killed recovers under the supervisor's
+    bounded retry, the new worker passes strict bootstrap parity and
+    serves real requests, retirement drains then reaps the process,
+    and a retry-exhausted revival aborts cleanly."""
+    tr = ProcessTransport(spawn_timeout_s=120, io_timeout_s=60,
+                          spawn_retries=2, spawn_backoff_s=0.05)
+    fleet = make_fleet(tr, n=2)
+    with tr:
+        # -- scale-up with the first spawn killed mid-bring-up
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule("scale.spawn", at_hits=(1,), max_faults=1)])
+        with injected(plan) as inj:
+            rid = fleet.add_replica()
+        assert inj.fired.get("scale.spawn", 0) == 1
+        assert rid == 2
+        assert tr.scale_spawns == 1
+        assert tr.scale_spawn_failures == 1
+        assert fleet.counters["scale_ups"] == 1
+
+        # -- the retried worker is really up, with bootstrap parity
+        h = tr.workers[rid]
+        assert h.alive
+        assert h.bootstrap_digest == \
+            canonical_digest(fleet.replicas[rid].engine.serialize())
+
+        # -- and it serves: traffic lands on 3 live replicas
+        reqs = [fleet.submit(prompt=list(range(6 + i)),
+                             max_new_tokens=6) for i in range(9)]
+        drive(fleet)
+        assert all(r.state is RequestState.DONE for r in reqs)
+
+        # -- retire: drain lands first, then the process is reaped
+        fleet.retire_replica(rid)
+        for _ in range(50):
+            if fleet.replicas[rid].state is ReplicaState.STOPPED:
+                break
+            fleet.step()
+        assert fleet.replicas[rid].state is ReplicaState.STOPPED
+        assert fleet.counters["retires_completed"] == 1
+        assert tr.scale_retired == 1
+        assert not tr.workers[rid].alive
+        assert tr.workers[rid].proc.poll() is not None
+
+        # -- revival with every spawn attempt killed: clean abort,
+        # prior shape, replica stays STOPPED
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule("scale.spawn", at_hits=(1, 2), max_faults=2)])
+        with injected(plan):
+            with pytest.raises(ScaleUpAborted):
+                fleet.add_replica()
+        assert fleet.replicas[rid].state is ReplicaState.STOPPED
+        assert len(fleet.replicas) == 3
+        assert fleet.counters["scale_up_aborts"] == 1
+        assert tr.scale_spawn_failures == 1 + 2
+        assert tr.wire_stats()["workers_alive"] == 2
+
+        # zero requests touched by any of it
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert fleet.migration_balance_ok and not fleet.in_transit

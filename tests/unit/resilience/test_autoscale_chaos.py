@@ -1,0 +1,137 @@
+"""Scale events as a first-class failure domain: the autoscale chaos
+harness (aborted bootstrap / mid-drain crash / faulted pre-warm), its
+2-run determinism gate, and the regression gate that replays every
+committed chaos digest with an autoscaler present-but-disabled
+(ISSUE 19)."""
+
+import json
+import os
+
+import pytest
+
+from hcache_deepspeed_tpu.resilience import (
+    default_autoscale_fault_plan, run_autoscale_chaos, run_chaos,
+    run_disagg_chaos, run_fabric_chaos, run_fleet_chaos)
+from hcache_deepspeed_tpu.serving import (AutoscaleConfig, Autoscaler,
+                                          ServingFleet)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def committed_digest(artifact, phase, key="event_digest"):
+    path = os.path.join(REPO, artifact)
+    if not os.path.exists(path):
+        pytest.skip(f"{artifact} not committed")
+    with open(path) as fh:
+        for line in fh:
+            row = json.loads(line)
+            if row.get("phase") == phase and key in row:
+                return row[key]
+    pytest.skip(f"{artifact} has no {phase}.{key}")
+
+
+def test_autoscale_chaos_all_fault_domains_recover():
+    r = run_autoscale_chaos(seed=0)
+    assert r.ok, r.violations
+    # every scale-event failure domain actually fired
+    fired = r.invariants["fault_fired"]
+    assert fired.get("scale.bootstrap", 0) >= 1
+    assert fired.get("scale.drain", 0) >= 1
+    assert fired.get("scale.prewarm", 0) >= 1
+    # ...and left its mark
+    c = r.invariants["counters"]
+    assert c["scale_up_aborts"] >= 1
+    assert c["scale_ups"] >= 1
+    assert c["retires_completed"] >= 1
+    # terminal states are exactly-once at fleet scope
+    assert set(r.invariants["terminal_states"]) <= {
+        "DONE", "REJECTED", "FAILED"}
+    assert r.invariants["flaps"] <= r.invariants["flap_bound"]
+    assert r.invariants["migration_balance_ok"]
+    assert r.invariants["trace"]["connected"]
+
+
+def test_autoscale_chaos_two_runs_byte_identical():
+    a = run_autoscale_chaos(seed=1)
+    b = run_autoscale_chaos(seed=1)
+    assert a.ok and b.ok, (a.violations, b.violations)
+    assert a.event_digest == b.event_digest
+    assert a.requests == b.requests
+
+
+def test_autoscale_chaos_different_seed_differs():
+    a = run_autoscale_chaos(seed=0)
+    b = run_autoscale_chaos(seed=2)
+    assert a.event_digest != b.event_digest
+
+
+def test_default_fault_plan_covers_all_scale_sites():
+    plan = default_autoscale_fault_plan(seed=0)
+    sites = {r.site for r in plan.rules}
+    assert sites == {"scale.bootstrap", "scale.drain",
+                     "scale.prewarm"}
+
+
+# ----------------------------------------------------------------- #
+# regression gate: a present-but-disabled autoscaler is invisible in
+# every committed chaos digest — CHAOS / FLEET / DISAGG / FABRIC /
+# SPEC all replay byte-identical with an Autoscaler attached to every
+# fleet but switched off
+# ----------------------------------------------------------------- #
+@pytest.fixture
+def disabled_autoscaler_on_every_fleet(monkeypatch):
+    orig = ServingFleet.__init__
+
+    def patched(self, *args, **kwargs):
+        orig(self, *args, **kwargs)
+        Autoscaler(self, AutoscaleConfig(enabled=False))
+
+    monkeypatch.setattr(ServingFleet, "__init__", patched)
+    yield
+
+
+def test_committed_chaos_digest_replays_with_disabled_autoscaler(
+        disabled_autoscaler_on_every_fleet):
+    want = committed_digest("CHAOS_SERVE.jsonl", "chaos-summary")
+    got = run_chaos(seed=0, n_requests=32)
+    assert got.ok, got.violations
+    assert got.event_digest == want
+
+
+def test_committed_fleet_digest_replays_with_disabled_autoscaler(
+        disabled_autoscaler_on_every_fleet):
+    want = committed_digest("FLEET_SERVE.jsonl", "fleet-summary")
+    got = run_fleet_chaos(seed=0, n_replicas=3, n_requests=48)
+    assert got.ok, got.violations
+    assert got.event_digest == want
+
+
+def test_committed_disagg_digest_replays_with_disabled_autoscaler(
+        disabled_autoscaler_on_every_fleet):
+    want = committed_digest("DISAGG_SERVE.jsonl", "disagg-chaos")
+    got = run_disagg_chaos(seed=0)
+    assert got.ok, got.violations
+    assert got.event_digest == want
+
+
+def test_committed_fabric_digest_replays_with_disabled_autoscaler(
+        disabled_autoscaler_on_every_fleet):
+    want = committed_digest("FABRIC_SERVE.jsonl", "fabric-chaos")
+    got = run_fabric_chaos(seed=0, n_replicas=3)
+    assert got.ok, got.violations
+    assert got.event_digest == want
+
+
+def test_committed_spec_digests_replay_with_disabled_autoscaler(
+        disabled_autoscaler_on_every_fleet, tmp_path):
+    from hcache_deepspeed_tpu.inference.benchmark import run_spec_serve
+    out = tmp_path / "SPEC_SERVE.jsonl"
+    run_spec_serve(seed=0, out=str(out))
+    got = {row["phase"]: row["event_digest"]
+           for row in map(json.loads, out.read_text().splitlines())
+           if "event_digest" in row}
+    for phase in ("spec-lookup", "spec-mixed",
+                  "spec-prefix", "spec-slo"):
+        assert got[phase] == committed_digest(
+            "SPEC_SERVE.jsonl", phase), phase

@@ -1,0 +1,354 @@
+"""SLO-driven elastic autoscaling: dynamic fleet membership
+(``add_replica``/``retire_replica``/``set_role``), the hysteresis
+control loop with its flap guard, the bursty trace generator, the
+digest-invisibility contract of a disabled autoscaler, and the
+observability surface (ISSUE 19)."""
+
+import numpy as np
+import pytest
+
+from hcache_deepspeed_tpu.inference import RaggedInferenceEngineConfig
+from hcache_deepspeed_tpu.fabric import canonical_digest
+from hcache_deepspeed_tpu.resilience import (FaultPlan, FaultRule,
+                                             injected)
+from hcache_deepspeed_tpu.runtime.config import HDSConfigError
+from hcache_deepspeed_tpu.serving import (AutoscaleConfig, Autoscaler,
+                                          FleetConfig,
+                                          PrefixReuseConfig,
+                                          ReplicaRole, ReplicaState,
+                                          Request, RequestState,
+                                          ScaleUpAborted,
+                                          ServerConfig, ServingFleet,
+                                          SimulatedEngine,
+                                          VirtualClock,
+                                          build_autoscale_trace,
+                                          validate_autoscale_config)
+from hcache_deepspeed_tpu.telemetry.flight import get_flight_recorder
+from hcache_deepspeed_tpu.telemetry.prometheus import \
+    validate_prometheus_text
+
+
+def sim_engine(num_blocks=16):
+    return SimulatedEngine(RaggedInferenceEngineConfig(
+        state_manager={"max_tracked_sequences": 8,
+                       "max_ragged_batch_size": 256,
+                       "max_ragged_sequence_count": 4,
+                       "max_context": 128},
+        kv_cache={"block_size": 8, "num_blocks": num_blocks},
+        hcache={"enable_latents": True}))
+
+
+def make_fleet(n=2, prefix=None, **cfg_kw):
+    cfg_kw.setdefault("server",
+                      ServerConfig(max_queue_depth=256,
+                                   kv_demand_fraction=float("inf")))
+    if prefix is not None:
+        cfg_kw["prefix"] = prefix
+    return ServingFleet(engine_factory=sim_engine,
+                        clock=VirtualClock(),
+                        config=FleetConfig(n_replicas=n, **cfg_kw))
+
+
+def drive(fleet, max_steps=5000):
+    steps = 0
+    while fleet.has_work:
+        fleet.step()
+        steps += 1
+        assert steps < max_steps, \
+            "fleet did not converge\n" + fleet.snapshot()
+
+
+def submit(fleet, uid, prompt, max_new=6):
+    req = Request(uid=uid, prompt=list(prompt),
+                  max_new_tokens=max_new)
+    fleet.submit(request=req)
+    return req
+
+
+# ----------------------------------------------------------------- #
+# config
+# ----------------------------------------------------------------- #
+def test_validate_config_rejects_bad_knobs():
+    validate_autoscale_config(AutoscaleConfig())
+    with pytest.raises(HDSConfigError):
+        validate_autoscale_config(AutoscaleConfig(min_replicas=0))
+    with pytest.raises(HDSConfigError):
+        validate_autoscale_config(
+            AutoscaleConfig(min_replicas=3, max_replicas=2))
+    with pytest.raises(HDSConfigError):
+        validate_autoscale_config(
+            AutoscaleConfig(kv_low=0.9, kv_high=0.5))
+    with pytest.raises(HDSConfigError):
+        validate_autoscale_config(AutoscaleConfig(hot_steps=0))
+
+
+# ----------------------------------------------------------------- #
+# elastic membership
+# ----------------------------------------------------------------- #
+def test_add_replica_appends_and_prewarms():
+    fleet = make_fleet(
+        n=2, prefix=PrefixReuseConfig(broadcast=True,
+                                      min_adopt_tokens=4))
+    base = [11, 12, 13, 14, 15, 16]
+    reqs = [submit(fleet, uid, base + [100 + uid]) for uid in range(4)]
+    drive(fleet)
+    assert all(r.state is RequestState.DONE for r in reqs)
+    assert len(fleet.prefix_tree.paths) >= 1
+
+    rid = fleet.add_replica()
+    assert rid == 2
+    assert len(fleet.replicas) == 3
+    assert fleet.live_replicas == 3
+    assert fleet.replicas[rid].state is ReplicaState.UP
+    assert fleet.counters["scale_ups"] == 1
+    assert fleet.counters["prewarm_broadcasts"] >= 1
+    drive(fleet)  # let the pre-warm broadcasts land
+    assert not fleet.in_transit
+    assert fleet.migration_balance_ok
+    # the new replica actually adopted at least one warm prefix
+    assert fleet.replicas[rid].prefix_cache is not None
+    assert len(fleet.replicas[rid].prefix_cache.store) >= 1
+    names = [e[1] for e in fleet.events]
+    assert "scale_up" in names and "prewarm_depart" in names
+
+
+def test_retire_drains_never_dropped():
+    fleet = make_fleet(n=3)
+    reqs = [submit(fleet, uid, [20 + uid] * 8, max_new=10)
+            for uid in range(6)]
+    for _ in range(3):
+        fleet.step()
+    victim = 0
+    fleet.retire_replica(victim)
+    assert fleet.counters["retires"] == 1
+    drive(fleet)
+    assert all(r.state is RequestState.DONE for r in reqs)
+    assert fleet.replicas[victim].state is ReplicaState.STOPPED
+    assert fleet.counters["retires_completed"] == 1
+    assert fleet.migration_balance_ok
+    # retired pool is intact — drain moved work, never dropped it
+    rep = fleet.replicas[victim]
+    assert rep.engine.state.free_blocks == rep.initial_free_blocks
+    names = [e[1] for e in fleet.events]
+    assert "retire_begin" in names and "retire_complete" in names
+
+
+def test_add_replica_revives_stopped_with_clean_router_state():
+    fleet = make_fleet(n=2)
+    fleet.retire_replica(1)
+    fleet.step()     # idle drain completes inside the step loop
+    assert fleet.replicas[1].state is ReplicaState.STOPPED
+    forgotten_before = fleet.router.replicas_forgotten
+    rid = fleet.add_replica()
+    assert rid == 1          # revived in place, not appended
+    assert len(fleet.replicas) == 2
+    assert fleet.replicas[1].state is ReplicaState.UP
+    assert fleet.replicas[1].hang_until == 0
+    assert fleet.replicas[1].partition_until == 0
+    # the router forgot the id again on revival: clean slate
+    assert fleet.router.replicas_forgotten == forgotten_before + 1
+    reqs = [submit(fleet, 90 + k, [7, 8, 9, 10 + k]) for k in range(3)]
+    drive(fleet)
+    assert all(r.state is RequestState.DONE for r in reqs)
+
+
+def test_scale_up_abort_rolls_back_cleanly():
+    fleet = make_fleet(n=2)
+    reqs = [submit(fleet, uid, [5, 6, 7 + uid]) for uid in range(3)]
+    fr = get_flight_recorder()
+    fr.clear()
+    plan = FaultPlan(seed=0, rules=[
+        FaultRule("scale.bootstrap", at_hits=(1,), max_faults=1)])
+    with injected(plan):
+        with pytest.raises(ScaleUpAborted):
+            fleet.add_replica()
+    assert len(fleet.replicas) == 2       # prior fleet shape
+    assert fleet.counters["scale_up_aborts"] == 1
+    assert fleet.counters["scale_ups"] == 0
+    assert "scale_abort" in fr.triggers()
+    names = [e[1] for e in fleet.events]
+    assert "scale_up_abort" in names
+    drive(fleet)                          # zero requests touched
+    assert all(r.state is RequestState.DONE for r in reqs)
+
+
+def test_set_role_reroles_live_replica():
+    fleet = make_fleet(n=2)
+    reqs = [submit(fleet, uid, [30 + uid] * 6, max_new=8)
+            for uid in range(4)]
+    for _ in range(2):
+        fleet.step()
+    fleet.set_role(1, ReplicaRole.PREFILL)
+    assert fleet.replicas[1].role is ReplicaRole.PREFILL
+    assert fleet.counters["reroles"] == 1
+    drive(fleet)
+    assert all(r.state is RequestState.DONE for r in reqs)
+    assert fleet.migration_balance_ok
+    with pytest.raises(KeyError):
+        fleet.set_role(0, "nonsense")
+
+
+# ----------------------------------------------------------------- #
+# the control loop
+# ----------------------------------------------------------------- #
+def scripted(fleet, cfg, script):
+    """Autoscaler whose signals are scripted: each observe() pops the
+    next {burn, kv, backlog} row (the last row repeats)."""
+    asc = Autoscaler(fleet, cfg)
+    rows = list(script)
+
+    def fake_signals():
+        row = rows.pop(0) if len(rows) > 1 else rows[0]
+        return {"burn": row.get("burn", 0.0),
+                "kv": row.get("kv", 0.0),
+                "backlog": row.get("backlog", 0.0),
+                "replicas_live": float(fleet.live_replicas)}
+    asc._signals = fake_signals
+    return asc
+
+
+def tick(fleet, asc, n=1):
+    out = []
+    for _ in range(n):
+        fleet.step()
+        out.append(asc.observe())
+    return out
+
+
+def test_synthetic_burn_signal_triggers_scale_up():
+    fleet = make_fleet(n=1)
+    asc = scripted(fleet, AutoscaleConfig(hot_steps=2, max_replicas=2),
+                   [{"burn": 2.0}])
+    actions = tick(fleet, asc, 3)
+    assert "scale_up" in actions
+    assert fleet.live_replicas == 2
+    assert asc.counters["scale_ups"] == 1
+    # burn was the driver: the decision detail records it
+    assert any("burn=2.00" in d for _, a, d in asc.decisions
+               if a == "scale_up")
+
+
+def test_calm_streak_retires_coldest():
+    fleet = make_fleet(n=2)
+    asc = scripted(fleet, AutoscaleConfig(calm_steps=3,
+                                          cooldown_steps=1),
+                   [{}])
+    actions = tick(fleet, asc, 4)
+    assert "retire" in actions
+    assert asc.counters["retires"] == 1
+    drive(fleet)
+    assert fleet.replicas[0].state is ReplicaState.STOPPED
+
+
+def test_bounds_block_scaling_past_min_and_max():
+    fleet = make_fleet(n=1)
+    asc = scripted(fleet, AutoscaleConfig(
+        min_replicas=1, max_replicas=1, hot_steps=1, calm_steps=1),
+        [{"burn": 2.0}, {"burn": 2.0}, {}, {}])
+    actions = tick(fleet, asc, 4)
+    assert actions == [None, None, None, None]
+    assert asc.counters["blocked_bounds"] >= 2
+    assert fleet.live_replicas == 1
+
+
+def test_flap_guard_bounds_direction_reversals():
+    fleet = make_fleet(n=1)
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3,
+                          hot_steps=1, calm_steps=1,
+                          cooldown_steps=1, flap_window_steps=1000,
+                          max_flaps=1)
+    # hot, calm, hot, calm, ... every reversal inside the window
+    script = []
+    for _ in range(12):
+        script.append({"burn": 2.0})
+        script.append({})
+    asc = scripted(fleet, cfg, script + [{}])
+    tick(fleet, asc, 24)
+    assert asc.flaps <= cfg.max_flaps
+    assert asc.counters["blocked_flap"] >= 1
+
+
+def test_cooldown_charged_after_event():
+    fleet = make_fleet(n=1)
+    asc = scripted(fleet, AutoscaleConfig(
+        hot_steps=1, cooldown_steps=50, max_replicas=4),
+        [{"burn": 2.0}])
+    actions = tick(fleet, asc, 5)
+    assert actions.count("scale_up") == 1     # dead time holds
+    assert asc.counters["blocked_cooldown"] >= 1
+
+
+def test_aborted_scale_up_charges_cooldown():
+    fleet = make_fleet(n=1)
+    asc = scripted(fleet, AutoscaleConfig(
+        hot_steps=1, cooldown_steps=50, max_replicas=4),
+        [{"burn": 2.0}])
+    plan = FaultPlan(seed=0, rules=[
+        FaultRule("scale.bootstrap", at_hits=(1,), max_faults=1)])
+    with injected(plan):
+        actions = tick(fleet, asc, 4)
+    assert actions.count("scale_up") == 0
+    assert asc.counters["scale_up_aborts"] == 1
+    # a broken bootstrap must not hot-loop spawn attempts
+    assert fleet.counters["scale_up_aborts"] == 1
+    assert asc.counters["blocked_cooldown"] >= 1
+
+
+def test_disabled_autoscaler_is_digest_invisible():
+    def serve(with_asc):
+        fleet = make_fleet(n=2)
+        if with_asc:
+            asc = Autoscaler(fleet, AutoscaleConfig(enabled=False))
+        reqs = build_autoscale_trace(seed=3, n_requests=24,
+                                     horizon_s=2.0)
+        fleet.run_trace(reqs)
+        if with_asc:
+            assert asc.observe() is None
+            assert asc.counters["scale_ups"] == 0
+        return canonical_digest(fleet.event_log())
+    assert serve(False) == serve(True)
+
+
+# ----------------------------------------------------------------- #
+# trace generator
+# ----------------------------------------------------------------- #
+def test_trace_generator_deterministic_and_bursty():
+    a = build_autoscale_trace(seed=5, n_requests=64, horizon_s=6.0)
+    b = build_autoscale_trace(seed=5, n_requests=64, horizon_s=6.0)
+    assert [(r.uid, r.arrival_time, tuple(r.prompt),
+             r.max_new_tokens) for r in a] == \
+           [(r.uid, r.arrival_time, tuple(r.prompt),
+             r.max_new_tokens) for r in b]
+    c = build_autoscale_trace(seed=6, n_requests=64, horizon_s=6.0)
+    assert [r.arrival_time for r in a] != [r.arrival_time for r in c]
+    arrivals = np.array([r.arrival_time for r in a])
+    assert arrivals.min() >= 0 and arrivals.max() <= 6.0
+    assert (np.diff(np.sort(arrivals)) >= 0).all()
+    # swarm requests share a tenant prefix — the pre-warm fuel
+    prompts = [tuple(r.prompt[:8]) for r in a]
+    assert max(prompts.count(p) for p in set(prompts)) >= 2
+
+
+# ----------------------------------------------------------------- #
+# observability surface
+# ----------------------------------------------------------------- #
+def test_metrics_surface_and_prometheus_clean():
+    fleet = make_fleet(n=2)
+    asc = Autoscaler(fleet, AutoscaleConfig(
+        min_replicas=1, max_replicas=3, hot_steps=2, calm_steps=60,
+        cooldown_steps=40, flap_window_steps=60))
+    reqs = build_autoscale_trace(seed=2, n_requests=48,
+                                 horizon_s=3.0)
+    asc.run(reqs)
+    snap = fleet.metrics_snapshot()
+    assert snap["replicas_live"] == fleet.live_replicas
+    assert snap["autoscale"]["enabled"] is True
+    assert set(snap["autoscale"]["counters"]) >= {
+        "scale_ups", "retires", "blocked_cooldown", "blocked_flap",
+        "blocked_bounds", "valve_steps"}
+    assert "flaps" in snap["autoscale"]
+    text = fleet.prometheus_text()
+    validate_prometheus_text(text)
+    assert "replicas_live" in text
+    assert "autoscale_flaps" in text
+    assert "autoscale_scale_ups" in text
